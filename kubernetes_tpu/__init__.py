@@ -1,0 +1,27 @@
+"""kubernetes_tpu — a TPU-native cluster scheduling framework.
+
+A ground-up redesign of the Kubernetes control-plane scheduling stack
+(reference: kubernetes v1.11-dev) built on JAX/XLA: cluster state is
+mirrored into HBM as dense tensors and the scheduler's Filter+Score
+pipeline runs as a single batched (pending-pods x nodes) computation,
+while the behavioral contracts of the reference (priority queue,
+assume/bind pipeline, preemption, extension points) are kept host-side.
+
+Layout:
+  api/      -- object model: Pod, Node, labels/selectors, quantities
+               (analog of staging/src/k8s.io/api + apimachinery)
+  state/    -- scheduler cache, NodeInfo, vocab interning, tensor snapshot
+               (analog of pkg/scheduler/schedulercache)
+  ops/      -- batched filter (predicate) and score (priority) kernels
+               (analog of pkg/scheduler/algorithm/{predicates,priorities})
+  sched/    -- scheduling queue, scheduler loop, preemption, binding
+               (analog of pkg/scheduler/{core,scheduler.go})
+  plugins/  -- extension-point registry, default profiles, extenders
+               (analog of pkg/scheduler/{factory/plugins.go,algorithmprovider})
+  runtime/  -- in-process object store, watch, informers, workqueues
+               (analog of client-go + the apiserver edge)
+  parallel/ -- device mesh / pjit sharding of the (pods x nodes) compute
+  utils/    -- tracing, metrics, feature gates, backoff
+"""
+
+__version__ = "0.1.0"

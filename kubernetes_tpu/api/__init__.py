@@ -1,0 +1,1 @@
+from . import labels, resources, types  # noqa: F401

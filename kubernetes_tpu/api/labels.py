@@ -1,0 +1,115 @@
+"""Label selector semantics.
+
+Host-side golden implementation of apimachinery's labels.Selector
+(reference: staging/src/k8s.io/apimachinery/pkg/labels/selector.go).
+This is the behavioral contract the tensor kernels in ops/selectors.py
+must reproduce; parity tests compare the two on identical fixtures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+# Operators (reference: apimachinery/pkg/selection/operator.go and
+# api/core/v1 NodeSelectorOperator values).
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+OPS = (IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT)
+
+
+def _as_int(s: str) -> Optional[int]:
+    try:
+        return int(s)
+    except (ValueError, TypeError):
+        return None
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One (key op values) clause.
+
+    Matching rules (reference: apimachinery/pkg/labels/selector.go:159
+    `Requirement.Matches`):
+      In       -> key exists and value in set
+      NotIn    -> key missing OR value not in set
+      Exists   -> key exists
+      DoesNotExist -> key missing
+      Gt/Lt    -> key exists, both label value and operand parse as int,
+                  and labelValue > / < operand
+    """
+
+    key: str
+    op: str
+    values: tuple = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        has = self.key in labels
+        if self.op == IN:
+            return has and labels[self.key] in self.values
+        if self.op == NOT_IN:
+            return (not has) or labels[self.key] not in self.values
+        if self.op == EXISTS:
+            return has
+        if self.op == DOES_NOT_EXIST:
+            return not has
+        if self.op in (GT, LT):
+            if not has or len(self.values) != 1:
+                return False
+            lv = _as_int(labels[self.key])
+            rv = _as_int(self.values[0])
+            if lv is None or rv is None:
+                return False
+            return lv > rv if self.op == GT else lv < rv
+        raise ValueError(f"unknown operator {self.op!r}")
+
+
+@dataclass(frozen=True)
+class Selector:
+    """AND of requirements; empty selector matches everything
+    (reference: labels.SelectorFromSet / internalSelector)."""
+
+    requirements: tuple = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.requirements)
+
+    @staticmethod
+    def from_set(label_set: Mapping[str, str]) -> "Selector":
+        """Equality selector from a map (reference: labels.SelectorFromSet)."""
+        return Selector(
+            tuple(Requirement(k, IN, (v,)) for k, v in sorted(label_set.items()))
+        )
+
+    @staticmethod
+    def from_requirements(reqs: Sequence[Requirement]) -> "Selector":
+        return Selector(tuple(reqs))
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """The versioned meta/v1.LabelSelector (matchLabels + matchExpressions),
+    as used by services/replicasets/pod-affinity terms
+    (reference: apimachinery/pkg/apis/meta/v1/types.go LabelSelector).
+
+    None ~ nil selector: matches nothing when used for pod affinity;
+    an empty LabelSelector matches everything.
+    """
+
+    match_labels: Mapping[str, str] = field(default_factory=dict)
+    match_expressions: tuple = ()  # tuple[Requirement]
+
+    def to_selector(self) -> Selector:
+        reqs: List[Requirement] = [
+            Requirement(k, IN, (v,)) for k, v in sorted(self.match_labels.items())
+        ]
+        reqs.extend(self.match_expressions)
+        return Selector(tuple(reqs))
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        return self.to_selector().matches(labels)

@@ -1,0 +1,83 @@
+"""Resource quantities.
+
+A deliberately small replacement for apimachinery's resource.Quantity
+(reference: staging/src/k8s.io/apimachinery/pkg/api/resource): quantities
+are canonicalized at parse time to int64 scalars — milli-units for CPU,
+bytes for memory/storage, raw counts for everything else — which is the
+form the scheduler's NodeInfo already uses internally (reference:
+pkg/scheduler/schedulercache/node_info.go:131-140 `Resource`).
+"""
+
+from __future__ import annotations
+
+import re
+
+# Canonical resource names (reference: staging/src/k8s.io/api/core/v1/types.go).
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+
+_BINARY_SUFFIX = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+_DECIMAL_SUFFIX = {
+    "n": 1e-9,
+    "u": 1e-6,
+    "m": 1e-3,
+    "": 1.0,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+}
+
+_QTY_RE = re.compile(r"^([+-]?[0-9.]+(?:[eE][+-]?[0-9]+)?)(Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE]?)$")
+
+
+def parse_quantity(value) -> float:
+    """Parse a Kubernetes quantity string ("100m", "1Gi", "2") to a float
+    of base units (cores, bytes, counts)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QTY_RE.match(value.strip())
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    num, suffix = m.groups()
+    base = float(num)
+    if suffix in _BINARY_SUFFIX:
+        return base * _BINARY_SUFFIX[suffix]
+    return base * _DECIMAL_SUFFIX[suffix]
+
+
+def milli(value) -> int:
+    """Quantity -> integer milli-units (reference Quantity.MilliValue)."""
+    import math
+
+    return int(math.ceil(parse_quantity(value) * 1000 - 1e-9))
+
+
+def value(value_) -> int:
+    """Quantity -> integer base units, rounded up (reference Quantity.Value)."""
+    import math
+
+    return int(math.ceil(parse_quantity(value_) - 1e-9))
+
+
+def is_extended(name: str) -> bool:
+    """Whether a resource name is an extended (non-core) resource.
+
+    Reference: pkg/apis/core/v1/helper/helpers.go IsExtendedResourceName —
+    anything not in the default (kubernetes.io) namespace and not
+    hugepages/attachable prefixed counts as extended.
+    """
+    if name in (CPU, MEMORY, EPHEMERAL_STORAGE, PODS):
+        return False
+    return "/" in name and not name.startswith("kubernetes.io/")

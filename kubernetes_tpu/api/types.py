@@ -1,0 +1,470 @@
+"""Core object model.
+
+A slim, scheduler-complete analog of the reference's API types
+(reference: staging/src/k8s.io/api/core/v1/types.go — Pod, Node, Taint,
+Toleration, Affinity; apps/v1 ReplicaSet/StatefulSet; policy/v1beta1
+PodDisruptionBudget). Resource quantities are canonicalized at
+construction: CPU in milli-units, memory/ephemeral-storage in bytes,
+extended resources in raw counts — matching the int64 `Resource` struct
+the reference scheduler uses (pkg/scheduler/schedulercache/node_info.go:131).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from . import resources as res
+from .labels import LabelSelector, Requirement, Selector
+
+# --- metadata ---------------------------------------------------------------
+
+_uid_counter = itertools.count(1)
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    deletion_timestamp: Optional[float] = None
+    owner_references: List["OwnerReference"] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+
+# --- resources --------------------------------------------------------------
+
+
+def resource_list(
+    cpu=None, memory=None, ephemeral_storage=None, pods=None, **extended
+) -> Dict[str, int]:
+    """Build a canonical resource map: cpu -> milli, memory/eph -> bytes,
+    pods/extended -> counts. Accepts quantity strings or numbers."""
+    out: Dict[str, int] = {}
+    if cpu is not None:
+        out[res.CPU] = res.milli(cpu)
+    if memory is not None:
+        out[res.MEMORY] = res.value(memory)
+    if ephemeral_storage is not None:
+        out[res.EPHEMERAL_STORAGE] = res.value(ephemeral_storage)
+    if pods is not None:
+        out[res.PODS] = res.value(pods)
+    for name, q in extended.items():
+        out[name.replace("__", "/")] = res.value(q)
+    return out
+
+
+@dataclass
+class ResourceRequirements:
+    """Canonical requests/limits maps (see resource_list)."""
+
+    requests: Dict[str, int] = field(default_factory=dict)
+    limits: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = "c"
+    image: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+
+# --- taints & tolerations ---------------------------------------------------
+
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EQUAL = "Equal"
+TOLERATION_OP_EXISTS = "Exists"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Reference: staging/src/k8s.io/api/core/v1/toleration.go:37
+        Toleration.ToleratesTaint."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator in ("", TOLERATION_OP_EQUAL):
+            return self.value == taint.value
+        return self.operator == TOLERATION_OP_EXISTS
+
+
+def tolerations_tolerate_taint(tolerations: Sequence[Toleration], taint: Taint) -> bool:
+    """Reference: pkg/apis/core/v1/helper/helpers.go:350."""
+    return any(t.tolerates(taint) for t in tolerations)
+
+
+# --- affinity ---------------------------------------------------------------
+
+
+@dataclass
+class NodeSelectorTerm:
+    """AND of expressions; an empty term matches nothing once it is part of
+    a required selector (reference: predicates.go nodeMatchesNodeSelectorTerms
+    via NodeSelectorRequirementsAsSelector)."""
+
+    match_expressions: List[Requirement] = field(default_factory=list)
+    match_fields: List[Requirement] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelector:
+    """OR of terms; an empty term list matches nothing
+    (reference: predicates.go:753 nodeMatchesNodeSelectorTerms comment)."""
+
+    node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: List[str] = field(default_factory=list)  # empty -> pod's own ns
+    topology_key: str = ""
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# --- pod --------------------------------------------------------------------
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    # Non-empty source kind marks volumes that participate in NoDiskConflict
+    # (reference: predicates.go:279 NoDiskConflict — GCEPD/AWSEBS/RBD/ISCSI).
+    source_kind: str = ""  # "GCEPersistentDisk" | "AWSElasticBlockStore" | "RBD" | "ISCSI" | ""
+    source_id: str = ""  # pd name / volume id / image spec
+    read_only: bool = False
+    pvc_name: str = ""  # non-empty for persistentVolumeClaim volumes
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    restart_policy: str = "Always"
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    nominated_node_name: str = ""
+    conditions: List[Tuple[str, str]] = field(default_factory=list)
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+    @property
+    def namespace(self):
+        return self.metadata.namespace
+
+    @property
+    def uid(self):
+        return self.metadata.uid
+
+    def full_name(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# --- node -------------------------------------------------------------------
+
+# Well-known labels (reference: pkg/kubelet/apis/well_known_labels.go).
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_REGION = "failure-domain.beta.kubernetes.io/region"
+
+# Node condition types (reference: api/core/v1/types.go NodeConditionType).
+NODE_READY = "Ready"
+NODE_OUT_OF_DISK = "OutOfDisk"
+NODE_MEMORY_PRESSURE = "MemoryPressure"
+NODE_DISK_PRESSURE = "DiskPressure"
+NODE_PID_PRESSURE = "PIDPressure"
+NODE_NETWORK_UNAVAILABLE = "NetworkUnavailable"
+
+COND_TRUE = "True"
+COND_FALSE = "False"
+COND_UNKNOWN = "Unknown"
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str = COND_TRUE
+
+
+@dataclass
+class ContainerImage:
+    names: List[str] = field(default_factory=list)
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, int] = field(default_factory=dict)
+    allocatable: Dict[str, int] = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+    images: List[ContainerImage] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+
+def get_zone_key(node: Node) -> str:
+    """Reference: pkg/util/node/node.go GetZoneKey — region + zone labels
+    joined with a NUL separator; empty when neither label is present."""
+    labels = node.metadata.labels or {}
+    region = labels.get(LABEL_REGION, "")
+    zone = labels.get(LABEL_ZONE, "")
+    if not region and not zone:
+        return ""
+    return region + ":\x00:" + zone
+
+
+# --- workload owners (for spreading) & PDBs ---------------------------------
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)  # spec.selector
+
+
+@dataclass
+class ReplicationController:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)  # spec.selector
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class StatefulSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0  # status.PodDisruptionsAllowed
+
+
+# --- derived pod semantics ---------------------------------------------------
+
+
+def get_resource_request(pod: Pod) -> Dict[str, int]:
+    """Effective pod request: sum over containers, max against each init
+    container (reference: predicates.go:667 GetResourceRequest)."""
+    out: Dict[str, int] = {}
+    for c in pod.spec.containers:
+        for name, q in c.resources.requests.items():
+            out[name] = out.get(name, 0) + q
+    for c in pod.spec.init_containers:
+        for name, q in c.resources.requests.items():
+            if q > out.get(name, 0):
+                out[name] = q
+    return out
+
+
+DEFAULT_MILLI_CPU_REQUEST = 100  # 0.1 core
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # 200 MB
+
+
+def get_nonzero_requests(pod: Pod) -> Tuple[int, int]:
+    """(milliCPU, memory) with per-container defaulting of *unset* values
+    (reference: algorithm/priorities/util/non_zero.go:38 and
+    resource_allocation.go:115 getNonZeroRequests)."""
+    cpu = mem = 0
+    for c in pod.spec.containers:
+        reqs = c.resources.requests
+        cpu += reqs[res.CPU] if res.CPU in reqs else DEFAULT_MILLI_CPU_REQUEST
+        mem += reqs[res.MEMORY] if res.MEMORY in reqs else DEFAULT_MEMORY_REQUEST
+    return cpu, mem
+
+
+def is_best_effort(pod: Pod) -> bool:
+    """QoS == BestEffort: no container has any requests or limits
+    (reference: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS)."""
+    for c in pod.spec.containers:
+        if c.resources.requests or c.resources.limits:
+            return False
+    return True
+
+
+def get_container_ports(*pods: Pod) -> List[ContainerPort]:
+    """Host ports requested by the pods' containers, host_port != 0
+    (reference: pkg/scheduler/util/utils.go GetContainerPorts)."""
+    out = []
+    for pod in pods:
+        for c in pod.spec.containers:
+            out.extend(p for p in c.ports if p.host_port != 0)
+    return out
+
+
+def pod_priority(pod: Pod) -> int:
+    """Reference: pkg/apis/scheduling has DefaultPriorityWhenNoDefaultClassExists=0;
+    pod.Spec.Priority nil -> 0 (util.GetPodPriority, pkg/scheduler/util/utils.go:57)."""
+    return pod.spec.priority if pod.spec.priority is not None else 0
+
+
+# --- node selector / affinity matching (golden host-side) --------------------
+
+# matchFields supports only metadata.name
+# (reference: pkg/scheduler/algorithm/scheduler_interface.go NodeFieldSelectorKeys).
+NODE_FIELD_NAME = "metadata.name"
+
+
+def _term_matches_node(term: NodeSelectorTerm, node: Node) -> bool:
+    """Reference: predicates.go:753 nodeMatchesNodeSelectorTerms. Terms with
+    neither expressions nor fields match nothing (requirement conversion of
+    an empty list yields a nothing-selector in the required path)."""
+    if not term.match_expressions and not term.match_fields:
+        return False
+    if term.match_expressions:
+        sel = Selector(tuple(term.match_expressions))
+        if not sel.matches(node.metadata.labels):
+            return False
+    if term.match_fields:
+        fields = {NODE_FIELD_NAME: node.metadata.name}
+        sel = Selector(tuple(term.match_fields))
+        if not sel.matches(fields):
+            return False
+    return True
+
+
+def pod_matches_node_selector(pod: Pod, node: Node) -> bool:
+    """Golden semantics of the MatchNodeSelector predicate
+    (reference: predicates.go:813 PodMatchNodeSelector ->
+    :771 podMatchesNodeSelectorAndAffinityTerms):
+      - spec.nodeSelector: all pairs must match node labels
+      - requiredDuringScheduling node affinity: OR over terms; nil matches
+    """
+    if pod.spec.node_selector:
+        if not Selector.from_set(pod.spec.node_selector).matches(node.metadata.labels):
+            return False
+    aff = pod.spec.affinity
+    if aff and aff.node_affinity and aff.node_affinity.required is not None:
+        terms = aff.node_affinity.required.node_selector_terms
+        return any(_term_matches_node(t, node) for t in terms)
+    return True
+
+
+def clone_pod(pod: Pod, **meta_overrides) -> Pod:
+    import copy
+
+    p = copy.deepcopy(pod)
+    if meta_overrides:
+        p.metadata = replace(p.metadata, **meta_overrides)
+    return p

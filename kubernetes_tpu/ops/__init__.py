@@ -1,0 +1,1 @@
+from .encoding import Caps, NodeTensors, PodMatrix, PodBatch  # noqa: F401

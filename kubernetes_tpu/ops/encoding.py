@@ -1,0 +1,220 @@
+"""Dense tensor encoding of cluster state.
+
+This is the HBM mirror of the scheduler cache (SURVEY.md §7 step 1): the
+reference's NodeInfo (pkg/scheduler/schedulercache/node_info.go:40) is
+already denormalized to int64 scalars per node, so the jump to dense
+arrays is natural. Strings (label keys/values, taints, ports, image
+names, namespaces) are interned to integer ids by state/vocab.py; match
+expressions compile to fixed-shape "selector programs" evaluated by
+ops/selectors.py.
+
+All shapes are static and bucketed (powers of two) so XLA compiles once
+per bucket configuration, not per cluster mutation.
+
+dtype policy:
+  float32  resources. CPU milli / memory bytes / storage bytes fit f32's
+           24-bit mantissa for all practical node sizes at the precision
+           the *scores* need; exact feasibility of the final pick is
+           re-verified host-side in int64 (state/node_info.py
+           fits_exactly), so f32 rounding can never produce an invalid
+           binding.
+  int32    every id / count / score (reference scores are ints 0-10).
+  bool     masks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import numpy as np
+
+# --- resource dims (column layout of alloc/requested/req) -------------------
+RES_CPU = 0  # milli-cores
+RES_MEM = 1  # bytes
+RES_EPH = 2  # bytes
+RES_FIXED = 3  # first extended-resource column
+
+# --- node condition flag columns (cond[:, c]) -------------------------------
+# CheckNodeCondition blockers (reference: predicates.go:1583).
+COND_NOT_READY = 0  # Ready != True
+COND_OUT_OF_DISK = 1  # OutOfDisk != False
+COND_NET_UNAVAIL = 2  # NetworkUnavailable != False
+COND_UNSCHEDULABLE = 3  # node.Spec.Unschedulable
+COND_MEM_PRESSURE = 4  # MemoryPressure == True
+COND_DISK_PRESSURE = 5  # DiskPressure == True
+COND_PID_PRESSURE = 6  # PIDPressure == True
+N_COND = 7
+
+# --- taint effects ----------------------------------------------------------
+EFFECT_NONE = 0  # pad
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+
+EFFECT_IDS = {
+    "NoSchedule": EFFECT_NO_SCHEDULE,
+    "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
+    "NoExecute": EFFECT_NO_EXECUTE,
+    "": EFFECT_NONE,
+}
+
+# --- toleration operators ---------------------------------------------------
+TOL_PAD = -1
+TOL_EQUAL = 0
+TOL_EXISTS = 1
+
+# --- selector-program op codes ----------------------------------------------
+OP_PAD = -1  # padding expression: always true
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_DOES_NOT_EXIST = 3
+OP_GT = 4
+OP_LT = 5
+OP_NODE_NAME_IN = 6  # matchFields metadata.name; vals are node indices
+OP_FALSE = 7  # compiled "matches nothing" (e.g. unknown label value... NotIn still true)
+
+_OP_IDS = {
+    "In": OP_IN,
+    "NotIn": OP_NOT_IN,
+    "Exists": OP_EXISTS,
+    "DoesNotExist": OP_DOES_NOT_EXIST,
+    "Gt": OP_GT,
+    "Lt": OP_LT,
+}
+
+
+def op_id(op: str) -> int:
+    return _OP_IDS[op]
+
+
+# --- capacity buckets -------------------------------------------------------
+
+
+@dataclass
+class Caps:
+    """Static padded dimensions. Growing any of these triggers a retrace;
+    all start small and grow by powers of two."""
+
+    N: int = 8  # nodes
+    Z: int = 8  # zone vocabulary
+    K: int = 8  # node label keys
+    KP: int = 8  # pod label keys (separate vocab; see state/snapshot.py)
+    R: int = RES_FIXED  # resource columns (3 + extended)
+    T: int = 4  # taint slots per node
+    PP: int = 8  # used host-port slots per node
+    NI: int = 8  # image slots per node
+    M: int = 64  # existing-pod matrix rows
+    # pod-batch dims
+    P: int = 8  # wavefront width
+    NS: int = 8  # nodeSelector equality pairs
+    AT: int = 4  # required node-affinity terms
+    AE: int = 4  # expressions per term
+    AV: int = 4  # values per expression
+    PT: int = 4  # preferred node-affinity terms
+    TL: int = 4  # tolerations
+    PQ: int = 4  # host ports requested per pod
+    SG: int = 4  # spreading group selectors
+    SE: int = 8  # expressions per spreading selector
+    SV: int = 2  # values per spreading expression
+    PI: int = 4  # images per pod
+
+
+class NodeTensors(NamedTuple):
+    """Per-node cluster state, mirrored into HBM."""
+
+    alloc: np.ndarray  # f32 [N, R]  allocatable
+    requested: np.ndarray  # f32 [N, R]  sum of pod requests
+    nonzero: np.ndarray  # f32 [N, 2]  nonzero-defaulted (cpu, mem)
+    pod_count: np.ndarray  # i32 [N]
+    allowed_pods: np.ndarray  # i32 [N]
+    labels: np.ndarray  # i32 [N, K]   value id per key col (0 absent)
+    label_nums: np.ndarray  # f32 [N, K] parsed ints (NaN if unparseable)
+    taint_key: np.ndarray  # i32 [N, T]
+    taint_val: np.ndarray  # i32 [N, T]
+    taint_effect: np.ndarray  # i32 [N, T]
+    cond: np.ndarray  # bool [N, N_COND]
+    ports: np.ndarray  # i32 [N, PP]  interned proto/port ids (0 pad)
+    zone_id: np.ndarray  # i32 [N]  (0 = no zone key)
+    img_id: np.ndarray  # i32 [N, NI]
+    img_size: np.ndarray  # f32 [N, NI]
+    avoid: np.ndarray  # bool [N]  preferAvoidPods annotation present
+    valid: np.ndarray  # bool [N]
+
+
+class PodMatrix(NamedTuple):
+    """Existing (scheduled) pods — input to spreading and inter-pod
+    affinity. Incrementally maintained slots."""
+
+    labels: np.ndarray  # i32 [M, KP]
+    ns: np.ndarray  # i32 [M]
+    node: np.ndarray  # i32 [M]   node index
+    valid: np.ndarray  # bool [M]
+    alive: np.ndarray  # bool [M]  deletionTimestamp unset
+
+
+class PodBatch(NamedTuple):
+    """A featurized wavefront of pending pods."""
+
+    req: np.ndarray  # f32 [P, R]
+    nonzero: np.ndarray  # f32 [P, 2]
+    best_effort: np.ndarray  # bool [P]
+    host_idx: np.ndarray  # i32 [P]  (-1: no spec.nodeName)
+    # spec.nodeSelector equality pairs (key id 0 = pad; val -1 = unknown value)
+    ns_key: np.ndarray  # i32 [P, NS]
+    ns_val: np.ndarray  # i32 [P, NS]
+    # required node affinity
+    has_aff: np.ndarray  # bool [P]
+    at_valid: np.ndarray  # bool [P, AT]
+    at_key: np.ndarray  # i32 [P, AT, AE]
+    at_op: np.ndarray  # i32 [P, AT, AE]
+    at_vals: np.ndarray  # i32 [P, AT, AE, AV]
+    at_num: np.ndarray  # f32 [P, AT, AE]
+    # preferred node affinity (weight 0 = pad term)
+    pt_weight: np.ndarray  # f32 [P, PT]
+    pt_key: np.ndarray  # i32 [P, PT, AE]
+    pt_op: np.ndarray  # i32 [P, PT, AE]
+    pt_vals: np.ndarray  # i32 [P, PT, AE, AV]
+    pt_num: np.ndarray  # f32 [P, PT, AE]
+    # tolerations
+    tol_key: np.ndarray  # i32 [P, TL]  (0 = match all keys)
+    tol_val: np.ndarray  # i32 [P, TL]
+    tol_op: np.ndarray  # i32 [P, TL]  (-1 pad / 0 equal / 1 exists)
+    tol_effect: np.ndarray  # i32 [P, TL] (0 = all effects)
+    # host ports
+    ports: np.ndarray  # i32 [P, PQ] (0 pad)
+    # spreading selectors over pod-label space
+    ns_id: np.ndarray  # i32 [P]  pod namespace id
+    sg_valid: np.ndarray  # bool [P, SG]
+    sg_key: np.ndarray  # i32 [P, SG, SE]
+    sg_op: np.ndarray  # i32 [P, SG, SE]
+    sg_vals: np.ndarray  # i32 [P, SG, SE, SV]
+    sg_num: np.ndarray  # f32 [P, SG, SE]
+    # misc
+    owned: np.ndarray  # bool [P]  has RC/RS controller ref (prefer-avoid)
+    img_id: np.ndarray  # i32 [P, PI]
+    prio: np.ndarray  # i32 [P]  pod priority
+    valid: np.ndarray  # bool [P]
+
+
+# Names + order of the device-evaluated predicates; the stacked mask output
+# of the kernel indexes into this list. Order mirrors the reference's
+# predicatesOrdering (predicates.go:133) restricted to tensorized ones.
+DEVICE_PREDICATES = (
+    "CheckNodeCondition",
+    "CheckNodeUnschedulable",
+    "PodFitsResources",
+    "HostName",
+    "PodFitsHostPorts",
+    "MatchNodeSelector",
+    "PodToleratesNodeTaints",
+    "CheckNodeMemoryPressure",
+    "CheckNodeDiskPressure",
+    "CheckNodePIDPressure",
+)
+PRED_IDX = {name: i for i, name in enumerate(DEVICE_PREDICATES)}
+
+# Full mask-stack row names as emitted by ops/kernel.py (device predicates
+# plus the host-plugin pseudo-row appended at the end).
+MASK_STACK_NAMES = DEVICE_PREDICATES + ("HostPlugins",)

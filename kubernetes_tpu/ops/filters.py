@@ -1,0 +1,168 @@
+"""Batched filter (predicate) kernels: [P, N] boolean feasibility masks.
+
+Each function reproduces one reference fit predicate
+(pkg/scheduler/algorithm/predicates/predicates.go) as a dense batched
+computation over the whole wavefront x cluster at once — replacing the
+reference's 16-goroutine per-node fan-out
+(pkg/scheduler/core/generic_scheduler.go:378) with one XLA program.
+
+Resource fit is split: `resource_fit_static` covers the [P, N] check at
+wave start; the in-scan dynamic recheck lives in ops/kernel.py because
+requested[] evolves as the wave commits.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import encoding as enc
+from .encoding import NodeTensors, PodBatch
+from .selectors import eval_and_program
+
+
+def check_node_condition(nt: NodeTensors) -> jnp.ndarray:
+    """[N] — reference predicates.go:1583 CheckNodeConditionPredicate
+    (Ready/OutOfDisk/NetworkUnavailable; Unschedulable handled separately
+    so failure reasons stay distinguishable)."""
+    c = nt.cond
+    return ~(c[:, enc.COND_NOT_READY] | c[:, enc.COND_OUT_OF_DISK]
+             | c[:, enc.COND_NET_UNAVAIL])
+
+
+def check_node_unschedulable(nt: NodeTensors) -> jnp.ndarray:
+    """[N] — node.Spec.Unschedulable (reference folds this into
+    CheckNodeConditionPredicate's reason list, predicates.go:1610)."""
+    return ~nt.cond[:, enc.COND_UNSCHEDULABLE]
+
+
+def host_name(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """[P, N] — reference predicates.go:825 PodFitsHost. host_idx -1 means
+    unconstrained; -2 means pinned to an unknown node (matches nothing)."""
+    N = nt.valid.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    return (pb.host_idx[:, None] == -1) | (idx[None, :] == pb.host_idx[:, None])
+
+
+def host_ports(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """[P, N] — reference predicates.go:991 PodFitsHostPorts. Interned
+    (proto, port) ids; the rare hostIP-wildcard distinction is resolved by
+    the exact host-side recheck at commit (state/node_info.py)."""
+    P, PQ = pb.ports.shape
+    N = nt.ports.shape[0]
+    conflict = jnp.zeros((P, N), bool)
+    for q in range(PQ):
+        pq = pb.ports[:, q]  # [P]
+        hit = jnp.any(pq[:, None, None] == nt.ports[None, :, :], axis=-1)  # [P, N]
+        conflict |= (pq > 0)[:, None] & hit
+    return ~conflict
+
+
+def match_node_selector(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """[P, N] — reference predicates.go:813 PodMatchNodeSelector:
+    spec.nodeSelector (AND of equality pairs) AND required node affinity
+    (OR of terms; nil required -> match; empty term list -> match nothing)."""
+    N = nt.labels.shape[0]
+    node_ids = jnp.arange(N, dtype=jnp.int32)
+    # nodeSelector equality pairs
+    ok = jnp.ones((pb.ns_key.shape[0], N), bool)
+    K = nt.labels.shape[1]
+    for s in range(pb.ns_key.shape[1]):
+        key = pb.ns_key[:, s]
+        val = pb.ns_val[:, s]
+        safe = jnp.clip(key, 0, K - 1)
+        node_val = jnp.take(nt.labels, safe, axis=1).T  # [P, N]
+        pair_ok = node_val == val[:, None]
+        ok &= jnp.where((key == 0)[:, None], True,
+                        jnp.where((key < 0)[:, None], False, pair_ok))
+    # required node affinity: OR over valid terms of (AND over exprs)
+    term_match = eval_and_program(nt.labels, nt.label_nums, pb.at_key, pb.at_op,
+                                  pb.at_vals, pb.at_num, node_ids)  # [P, AT, N]
+    any_term = jnp.any(term_match & pb.at_valid[:, :, None], axis=1)  # [P, N]
+    aff_ok = jnp.where(pb.has_aff[:, None], any_term, True)
+    return ok & aff_ok
+
+
+def _tolerated(nt: NodeTensors, pb: PodBatch, t: int):
+    """[P, N] whether taint slot t is tolerated by any of the pod's
+    tolerations. Reference: staging api/core/v1/toleration.go:37
+    ToleratesTaint."""
+    tk = nt.taint_key[:, t]  # [N]
+    tv = nt.taint_val[:, t]
+    te = nt.taint_effect[:, t]
+    # toleration axes: [P, TL]; broadcast vs node [N]
+    key_ok = (pb.tol_key == 0)[:, :, None] | (pb.tol_key[:, :, None] == tk[None, None, :])
+    val_ok = (pb.tol_op == enc.TOL_EXISTS)[:, :, None] | (
+        pb.tol_val[:, :, None] == tv[None, None, :])
+    eff_ok = (pb.tol_effect == 0)[:, :, None] | (
+        pb.tol_effect[:, :, None] == te[None, None, :])
+    live = (pb.tol_op != enc.TOL_PAD)[:, :, None]
+    return jnp.any(live & key_ok & val_ok & eff_ok, axis=1)  # [P, N]
+
+
+def tolerates_taints(nt: NodeTensors, pb: PodBatch, effects) -> jnp.ndarray:
+    """[P, N] — reference predicates.go:1504 PodToleratesNodeTaints with an
+    effect filter (NoSchedule+NoExecute; or NoExecute only for the
+    NoExecute variant)."""
+    P = pb.req.shape[0]
+    N = nt.taint_key.shape[0]
+    untol = jnp.zeros((P, N), bool)
+    T = nt.taint_key.shape[1]
+    for t in range(T):
+        te = nt.taint_effect[:, t]  # [N]
+        relevant = jnp.zeros((N,), bool)
+        for e in effects:
+            relevant |= te == e
+        untol |= relevant[None, :] & ~_tolerated(nt, pb, t)
+    return ~untol
+
+
+def pressure_checks(nt: NodeTensors, pb: PodBatch):
+    """Returns (mem_ok [P,N], disk_ok [N], pid_ok [N]) — reference
+    predicates.go:1541/:1560/:1571. Memory pressure only rejects
+    BestEffort pods."""
+    mem = ~(pb.best_effort[:, None] & nt.cond[None, :, enc.COND_MEM_PRESSURE])
+    disk = ~nt.cond[:, enc.COND_DISK_PRESSURE]
+    pid = ~nt.cond[:, enc.COND_PID_PRESSURE]
+    return mem, disk, pid
+
+
+def resource_fit(alloc, allowed_pods, requested, pod_count, req, is_core):
+    """Resource feasibility of a request vector against current usage.
+
+    alloc/requested: f32 [N, R]; allowed_pods/pod_count: i32 [N]
+    req: f32 [..., R] (leading batch dims broadcast against N)
+    is_core: bool [R] — cpu/mem/eph columns are always checked once the
+    request is non-empty; extended columns only when requested
+    (reference predicates.go:688 PodFitsResources, incl. the all-zero
+    shortcut at :712).
+    returns bool [..., N]
+    """
+    reqb = req[..., None, :]  # [..., 1, R]
+    fits_col = requested[None, :, :] + reqb <= alloc[None, :, :]  # [..., N, R]
+    check = is_core[None, :] | (reqb > 0)  # [..., 1/N?, R] broadcast
+    dims_ok = jnp.all(fits_col | ~check, axis=-1)  # [..., N]
+    empty = jnp.all(req == 0, axis=-1)[..., None]  # all-zero request shortcut
+    pods_ok = pod_count + 1 <= allowed_pods  # [N]
+    return (dims_ok | empty) & pods_ok[None, :]
+
+
+def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core) -> jnp.ndarray:
+    """Stack of per-predicate masks [Q, P, N] in enc.DEVICE_PREDICATES
+    order. Resource fit here uses wave-start usage; the scan in
+    ops/kernel.py re-applies it with live usage."""
+    P = pb.req.shape[0]
+    N = nt.valid.shape[0]
+    ones = jnp.ones((P, N), bool)
+    cond = check_node_condition(nt)[None, :] & ones
+    unsched = check_node_unschedulable(nt)[None, :] & ones
+    res = resource_fit(nt.alloc, nt.allowed_pods, nt.requested, nt.pod_count,
+                       pb.req, is_core)
+    host = host_name(nt, pb)
+    ports = host_ports(nt, pb)
+    sel = match_node_selector(nt, pb)
+    taints = tolerates_taints(
+        nt, pb, (enc.EFFECT_NO_SCHEDULE, enc.EFFECT_NO_EXECUTE))
+    mem, disk, pid = pressure_checks(nt, pb)
+    disk = disk[None, :] & ones
+    pid = pid[None, :] & ones
+    return jnp.stack([cond, unsched, res, host, ports, sel, taints, mem, disk, pid])

@@ -1,0 +1,157 @@
+"""The fused scheduling wave kernel.
+
+One jitted program schedules an entire wavefront of pending pods:
+
+  1. static predicate masks + raw priority scores, batched [P, N]
+     (replaces hot loops generic_scheduler.go:378 findNodesThatFit and
+     :609 PrioritizeNodes across BOTH axes at once);
+  2. a lax.scan over the wave that, per pod: re-applies resource fit
+     against live usage, runs the normalizing reduces over the pod's
+     feasible set, weighted-sums, and commits the argmax into the
+     carried usage tensors — so later pods in the wave see earlier
+     placements exactly like the reference's assume step
+     (scheduler.go:486) makes assumed pods visible to the next cycle;
+  3. host-name round-robin tie-break emulating selectHost
+     (generic_scheduler.go:178) with a carried counter.
+
+Failure attribution follows the reference's short-circuit predicate
+ordering (generic_scheduler.go:503 breaks at the first failed predicate;
+predicates.go:133 predicatesOrdering): a node is charged only to its
+first failing predicate, which is what FitError aggregation and
+preemption's unresolvable-reason filter (generic_scheduler.go:972)
+consume.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import encoding as enc
+from .filters import resource_fit, static_predicate_masks
+from .scores import (
+    balanced_allocation,
+    image_locality,
+    least_requested,
+    most_requested,
+    node_affinity_raw,
+    normalize_reduce,
+    prefer_avoid,
+    spread_counts,
+    spread_reduce,
+    taint_intolerable_raw,
+)
+
+
+class Weights(NamedTuple):
+    """Priority weights (reference defaults:
+    algorithmprovider/defaults/defaults.go:219 — weight 1 each, except
+    NodePreferAvoidPods at 10000; ImageLocality/MostRequested optional)."""
+
+    least_requested: float = 1.0
+    balanced: float = 1.0
+    most_requested: float = 0.0
+    node_affinity: float = 1.0
+    taint_toleration: float = 1.0
+    selector_spread: float = 1.0
+    prefer_avoid: float = 10000.0
+    image_locality: float = 0.0
+
+
+class WaveResult(NamedTuple):
+    chosen: jnp.ndarray  # i32 [P]  node index or -1
+    score: jnp.ndarray  # f32 [P]  winning weighted score (-1 if none)
+    feasible_count: jnp.ndarray  # i32 [P]
+    fail_counts: jnp.ndarray  # i32 [Q, P]  first-fail per predicate
+    masks: jnp.ndarray  # bool [Q, P, N]  per-predicate pass masks
+    rr_end: jnp.ndarray  # i32  round-robin counter after the wave
+
+
+@functools.partial(jax.jit, static_argnames=("weights", "num_zones"))
+def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, pb: enc.PodBatch,
+                  extra_mask, rr_start, *, weights: Weights,
+                  num_zones: int) -> WaveResult:
+    """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
+    volume predicates) for the rare pods that need them; all-True rows for
+    everyone else. Appended to the mask stack as a final "HostPlugins"
+    pseudo-predicate for failure attribution."""
+    N = nt.valid.shape[0]
+    R = nt.alloc.shape[1]
+    is_core = jnp.arange(R) < enc.RES_FIXED
+    masks = static_predicate_masks(nt, pb, is_core)  # [Q, P, N]
+    masks = jnp.concatenate([masks, extra_mask[None]], axis=0)
+    res_i = enc.PRED_IDX["PodFitsResources"]
+    static_nonres = jnp.all(masks.at[res_i].set(True), axis=0)  # [P, N]
+    alloc2 = nt.alloc[:, :2]
+
+    w = weights
+    aff_raw = node_affinity_raw(nt, pb) if w.node_affinity else None
+    taint_raw = taint_intolerable_raw(nt, pb) if w.taint_toleration else None
+    spread_cnt = (spread_counts(pm, pb, N) if w.selector_spread
+                  else jnp.zeros(static_nonres.shape, jnp.int32))
+    static_score = jnp.zeros(static_nonres.shape, jnp.float32)
+    if w.image_locality:
+        static_score += w.image_locality * image_locality(nt, pb)
+    if w.prefer_avoid:
+        static_score += w.prefer_avoid * prefer_avoid(nt, pb)
+    P = pb.req.shape[0]
+    if aff_raw is None:
+        aff_raw = jnp.zeros((P, N), jnp.float32)
+    if taint_raw is None:
+        taint_raw = jnp.zeros((P, N), jnp.float32)
+
+    def step(carry, x):
+        req_c, nz_c, cnt_c, rr = carry
+        preq, pnz, mask_sn, araw, traw, scnt, sscore, pvalid = x
+        fits = resource_fit(nt.alloc, nt.allowed_pods, req_c, cnt_c,
+                            preq[None, :], is_core)[0]  # [N]
+        feasible = mask_sn & fits & nt.valid & pvalid
+        total = sscore
+        if w.node_affinity:
+            total = total + w.node_affinity * normalize_reduce(araw, feasible, False)
+        if w.taint_toleration:
+            total = total + w.taint_toleration * normalize_reduce(traw, feasible, True)
+        if w.selector_spread:
+            total = total + w.selector_spread * spread_reduce(
+                scnt, feasible, nt.zone_id, num_zones)
+        if w.least_requested:
+            total = total + w.least_requested * least_requested(nz_c, alloc2, pnz)
+        if w.balanced:
+            total = total + w.balanced * balanced_allocation(nz_c, alloc2, pnz)
+        if w.most_requested:
+            total = total + w.most_requested * most_requested(nz_c, alloc2, pnz)
+        sm = jnp.where(feasible, total, -1.0)
+        best = jnp.max(sm)
+        has = best >= 0
+        ties = feasible & (sm == best)
+        k = jnp.maximum(jnp.sum(ties), 1)
+        rank = jnp.cumsum(ties.astype(jnp.int32)) - 1
+        chosen = jnp.argmax(ties & (rank == rr % k)).astype(jnp.int32)
+        chosen = jnp.where(has, chosen, -1)
+        safe = jnp.maximum(chosen, 0)
+        gain = jnp.where(has, 1.0, 0.0)
+        req_c = req_c.at[safe].add(preq * gain)
+        nz_c = nz_c.at[safe].add(pnz * gain)
+        cnt_c = cnt_c.at[safe].add(jnp.where(has, 1, 0))
+        rr = rr + jnp.where(has, 1, 0)
+        out = (chosen, best, fits, jnp.sum(feasible.astype(jnp.int32)))
+        return (req_c, nz_c, cnt_c, rr), out
+
+    carry0 = (nt.requested, nt.nonzero, nt.pod_count, jnp.asarray(rr_start, jnp.int32))
+    xs = (pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw, spread_cnt,
+          static_score, pb.valid)
+    (_, _, _, rr_end), (chosen, best, dyn_fits, feas_cnt) = lax.scan(step, carry0, xs)
+
+    masks = masks.at[res_i].set(dyn_fits)
+    # short-circuit first-fail attribution in predicate order
+    prefix_ok = jnp.cumprod(masks.astype(jnp.int8), axis=0).astype(bool)
+    first = jnp.concatenate(
+        [jnp.ones((1,) + masks.shape[1:], bool), prefix_ok[:-1]], axis=0)
+    first_fail = ~masks & first & nt.valid[None, None, :]
+    fail_counts = jnp.sum(first_fail.astype(jnp.int32), axis=-1)  # [Q, P]
+    return WaveResult(chosen=chosen, score=best, feasible_count=feas_cnt,
+                      fail_counts=fail_counts, masks=masks, rr_end=rr_end)

@@ -1,0 +1,181 @@
+"""Batched score (priority) kernels.
+
+Each function reproduces one reference priority
+(pkg/scheduler/algorithm/priorities/) as a dense computation. Scores are
+integers 0..10 per the reference's Map/Reduce model
+(generic_scheduler.go:544 PrioritizeNodes, :636 weighted sum); integer
+divisions are emulated as float32 floor with a +1e-5 guard (all
+quotients live in [0, 10], far above f32 resolution).
+
+Normalizing reduces (NormalizeReduce, priorities/reduce.go:29) run over
+the *feasible* node set of each pod — in the reference, Reduce sees only
+nodes that passed filtering — so they execute inside the commit scan in
+ops/kernel.py where per-pod feasibility is known.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import encoding as enc
+from .encoding import NodeTensors, PodBatch, PodMatrix
+from .selectors import eval_and_program
+
+MAX_PRIORITY = 10.0
+EPS = 1e-5
+
+
+def floor_div(x):
+    """Go integer-division / truncation emulation for non-negative values."""
+    return jnp.floor(x + EPS)
+
+
+# --- resource allocation family (in-scan dynamic) ---------------------------
+
+
+def least_requested(nz, alloc2, pod_nz):
+    """[N] — reference least_requested.go:36 leastResourceScorer:
+    (cpuScore + memScore) / 2, score_r = (cap - req) * 10 / cap.
+    nz: f32 [N, 2] current nonzero-defaulted usage; alloc2: f32 [N, 2];
+    pod_nz: f32 [2]."""
+    r = nz + pod_nz[None, :]
+    per = floor_div((alloc2 - r) * MAX_PRIORITY / jnp.maximum(alloc2, 1.0))
+    per = jnp.where((alloc2 == 0) | (r > alloc2), 0.0, per)
+    return floor_div((per[:, 0] + per[:, 1]) / 2.0)
+
+
+def most_requested(nz, alloc2, pod_nz):
+    """[N] — reference most_requested.go mostResourceScorer."""
+    r = nz + pod_nz[None, :]
+    per = floor_div(r * MAX_PRIORITY / jnp.maximum(alloc2, 1.0))
+    per = jnp.where((alloc2 == 0) | (r > alloc2), 0.0, per)
+    return floor_div((per[:, 0] + per[:, 1]) / 2.0)
+
+
+def balanced_allocation(nz, alloc2, pod_nz):
+    """[N] — reference balanced_resource_allocation.go:41
+    balancedResourceScorer: 10 - |cpuFrac - memFrac| * 10 (truncated)."""
+    r = nz + pod_nz[None, :]
+    frac = jnp.where(alloc2 == 0, 1.0, r / jnp.maximum(alloc2, 1.0))
+    diff = jnp.abs(frac[:, 0] - frac[:, 1])
+    score = floor_div((1.0 - diff) * MAX_PRIORITY)
+    return jnp.where(jnp.any(frac >= 1.0, axis=1), 0.0, score)
+
+
+# --- static [P, N] raw scores ------------------------------------------------
+
+
+def node_affinity_raw(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """f32 [P, N] — sum of matched preferred-term weights (reference:
+    priorities/node_affinity.go:34 CalculateNodeAffinityPriorityMap).
+    Normalized per-pod in the scan (NormalizeReduce(10, false))."""
+    N = nt.labels.shape[0]
+    node_ids = jnp.arange(N, dtype=jnp.int32)
+    term_match = eval_and_program(nt.labels, nt.label_nums, pb.pt_key, pb.pt_op,
+                                  pb.pt_vals, pb.pt_num, node_ids)  # [P, PT, N]
+    w = pb.pt_weight[:, :, None]
+    return jnp.sum(jnp.where(term_match, w, 0.0), axis=1)
+
+
+def taint_intolerable_raw(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """f32 [P, N] — count of PreferNoSchedule taints not tolerated by the
+    pod's PreferNoSchedule-eligible tolerations (reference:
+    priorities/taint_toleration.go:55; tolerations with empty effect or
+    PreferNoSchedule are eligible, :43). Normalized reversed in the scan."""
+    P = pb.req.shape[0]
+    N = nt.taint_key.shape[0]
+    eligible = (pb.tol_effect == 0) | (pb.tol_effect == enc.EFFECT_PREFER_NO_SCHEDULE)
+    eligible &= pb.tol_op != enc.TOL_PAD
+    count = jnp.zeros((P, N), jnp.float32)
+    for t in range(nt.taint_key.shape[1]):
+        tk = nt.taint_key[:, t]
+        tv = nt.taint_val[:, t]
+        te = nt.taint_effect[:, t]
+        relevant = te == enc.EFFECT_PREFER_NO_SCHEDULE  # [N]
+        key_ok = (pb.tol_key == 0)[:, :, None] | (pb.tol_key[:, :, None] == tk[None, None, :])
+        val_ok = (pb.tol_op == enc.TOL_EXISTS)[:, :, None] | (
+            pb.tol_val[:, :, None] == tv[None, None, :])
+        eff_ok = (pb.tol_effect == 0)[:, :, None] | (
+            pb.tol_effect[:, :, None] == te[None, None, :])
+        tol = jnp.any((eligible[:, :, None]) & key_ok & val_ok & eff_ok, axis=1)
+        count += (relevant[None, :] & ~tol).astype(jnp.float32)
+    return count
+
+
+def spread_counts(pm: PodMatrix, pb: PodBatch, num_nodes: int) -> jnp.ndarray:
+    """i32 [P, N] — per-node count of existing same-namespace, live pods
+    matching any of the pod's group selectors (reference:
+    priorities/selector_spreading.go:66 CalculateSpreadPriorityMap).
+    The zone-weighted reduce happens in the scan."""
+    M = pm.labels.shape[0]
+    ep_ids = jnp.arange(M, dtype=jnp.int32)
+    m = eval_and_program(pm.labels, None, pb.sg_key, pb.sg_op, pb.sg_vals,
+                         pb.sg_num, ep_ids)  # [P, SG, M]
+    any_sel = jnp.any(m & pb.sg_valid[:, :, None], axis=1)  # [P, M]
+    has_sel = jnp.any(pb.sg_valid, axis=1)  # [P] — no selectors -> count 0
+    eligible = pm.valid & pm.alive
+    same_ns = pm.ns[None, :] == pb.ns_id[:, None]
+    matched = any_sel & eligible[None, :] & same_ns & has_sel[:, None]
+
+    def seg(row):
+        return jax.ops.segment_sum(row.astype(jnp.int32), pm.node,
+                                   num_segments=num_nodes)
+
+    return jax.vmap(seg)(matched)
+
+
+def spread_reduce(cnt, feasible, zone_id, num_zones: int):
+    """[N] — reference selector_spreading.go:122 CalculateSpreadPriorityReduce
+    with zoneWeighting = 2/3."""
+    cntf = jnp.where(feasible, cnt, 0).astype(jnp.float32)
+    max_node = jnp.max(cntf)
+    zc = jax.ops.segment_sum(jnp.where(zone_id > 0, cntf, 0.0), zone_id,
+                             num_segments=num_zones)
+    max_zone = jnp.max(zc.at[0].set(0.0))
+    have_zones = jnp.any(feasible & (zone_id > 0))
+    f = jnp.where(max_node > 0, MAX_PRIORITY * (max_node - cntf) / jnp.maximum(max_node, 1.0),
+                  MAX_PRIORITY)
+    node_zc = zc[zone_id]
+    zscore = jnp.where(max_zone > 0, MAX_PRIORITY * (max_zone - node_zc) / jnp.maximum(max_zone, 1.0),
+                       MAX_PRIORITY)
+    f = jnp.where(have_zones & (zone_id > 0), f / 3.0 + (2.0 / 3.0) * zscore, f)
+    return floor_div(f)
+
+
+def image_locality(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """i32-valued f32 [P, N] — reference priorities/image_locality.go:39:
+    bucketed sum of present image sizes, 23MB..1000MB -> 0..10."""
+    P, PI = pb.img_id.shape
+    N = nt.img_id.shape[0]
+    total = jnp.zeros((P, N), jnp.float32)
+    for i in range(PI):
+        pid = pb.img_id[:, i]  # [P]
+        hit = pid[:, None, None] == nt.img_id[None, :, :]  # [P, N, NI]
+        sz = jnp.sum(jnp.where(hit, nt.img_size[None, :, :], 0.0), axis=-1)
+        total += jnp.where((pid > 0)[:, None], sz, 0.0)
+    mb = 1024.0 * 1024.0
+    min_img, max_img = 23.0 * mb, 1000.0 * mb
+    mid = floor_div(MAX_PRIORITY * (total - min_img) / (max_img - min_img)) + 1.0
+    return jnp.where(total < min_img, 0.0,
+                     jnp.where(total >= max_img, MAX_PRIORITY, mid))
+
+
+def prefer_avoid(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
+    """f32 [P, N] — reference priorities/node_prefer_avoid_pods.go:32.
+    Simplified: any preferAvoidPods annotation on the node zeroes the
+    score for RC/RS-controlled pods (the reference matches the exact
+    controller ref; host-side plugin refines this in later rounds)."""
+    avoid = nt.avoid[None, :] & pb.owned[:, None]
+    return jnp.where(avoid, 0.0, MAX_PRIORITY)
+
+
+def normalize_reduce(raw, feasible, reverse: bool):
+    """[N] — reference priorities/reduce.go:29 NormalizeReduce(10, reverse)
+    over the feasible set."""
+    m = jnp.max(jnp.where(feasible, raw, 0.0))
+    score = floor_div(MAX_PRIORITY * raw / jnp.maximum(m, 1.0))
+    if reverse:
+        score = MAX_PRIORITY - score
+        return jnp.where(m > 0, score, MAX_PRIORITY)
+    return jnp.where(m > 0, score, 0.0)

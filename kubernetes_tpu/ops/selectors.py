@@ -1,0 +1,100 @@
+"""Batched selector-program evaluation.
+
+Match expressions (node selectors, node affinity, spreading/affinity
+label selectors) are compiled host-side (state/featurize.py) into
+fixed-shape integer programs; this module evaluates them against a label
+matrix entirely with tensor ops — the TPU replacement for the per-node
+`labels.Selector.Matches` calls in the reference hot loop
+(pkg/scheduler/algorithm/predicates/predicates.go:813 via
+apimachinery labels/selector.go).
+
+Semantics table (reference: apimachinery labels/selector.go:159):
+    In           key present AND value in set
+    NotIn        NOT (key present AND value in set)
+    Exists       key present
+    DoesNotExist key absent
+    Gt / Lt      key present AND int(label) > / < int(operand)
+                 (unparseable either side -> no match; encoded as NaN)
+    NodeNameIn   node index in operand set (matchFields metadata.name)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import encoding as enc
+
+
+def eval_expr_batch(labels, label_nums, key, op, vals, num, entity_ids):
+    """Evaluate one expression slot for a batch of programs against all rows
+    of a label matrix.
+
+    labels:    i32 [X, K]  value id per key (0 = absent)
+    label_nums:f32 [X, K]  numeric parse of the value (NaN unparseable)
+    key:       i32 [B]     column index (clipped; pads use col 0 = never set)
+    op:        i32 [B]
+    vals:      i32 [B, V]  value-id set (-1 pads)
+    num:       f32 [B]
+    entity_ids:i32 [X]     row ids for OP_NODE_NAME_IN
+    returns    bool [B, X]
+    """
+    K = labels.shape[1]
+    safe_key = jnp.clip(key, 0, K - 1)
+    row_vals = jnp.take(labels, safe_key, axis=1).T  # [B, X]
+    has_key = row_vals != 0
+    in_set = jnp.any(row_vals[:, :, None] == vals[:, None, :], axis=-1)
+    name_in = jnp.any(entity_ids[None, :, None] == vals[:, None, :], axis=-1)
+    opc = op[:, None]
+    if label_nums is not None:
+        row_nums = jnp.take(label_nums, safe_key, axis=1).T
+        gt = has_key & (row_nums > num[:, None])  # NaN -> False
+        lt = has_key & (row_nums < num[:, None])
+    else:
+        gt = lt = jnp.zeros_like(has_key)
+    return jnp.select(
+        [
+            opc == enc.OP_IN,
+            opc == enc.OP_NOT_IN,
+            opc == enc.OP_EXISTS,
+            opc == enc.OP_DOES_NOT_EXIST,
+            opc == enc.OP_GT,
+            opc == enc.OP_LT,
+            opc == enc.OP_NODE_NAME_IN,
+            opc == enc.OP_FALSE,
+        ],
+        [
+            has_key & in_set,
+            ~(has_key & in_set),
+            has_key,
+            ~has_key,
+            gt,
+            lt,
+            name_in,
+            jnp.zeros_like(has_key),
+        ],
+        default=jnp.ones_like(has_key),  # OP_PAD
+    )
+
+
+def eval_and_program(labels, label_nums, key, op, vals, num, entity_ids):
+    """AND over the expression axis (last program axis).
+
+    key/op: i32 [..., E]; vals: i32 [..., E, V]; num: f32 [..., E]
+    returns bool [..., X]
+    """
+    lead = key.shape[:-1]
+    E = key.shape[-1]
+    B = 1
+    for s in lead:
+        B *= s
+    k2 = key.reshape(B, E)
+    o2 = op.reshape(B, E)
+    v2 = vals.reshape(B, E, vals.shape[-1])
+    n2 = num.reshape(B, E)
+    X = labels.shape[0]
+    out = jnp.ones((B, X), bool)
+    for e in range(E):  # E is small & static; XLA fuses the chain
+        out &= eval_expr_batch(labels, label_nums, k2[:, e], o2[:, e],
+                               v2[:, e], n2[:, e], entity_ids)
+    return out.reshape(*lead, X)

@@ -1,0 +1,1 @@
+from .registry import Registry, default_registry  # noqa: F401

@@ -1,0 +1,334 @@
+"""Golden host-side predicate & priority implementations.
+
+Exact behavioral ports of the reference's fit predicates
+(pkg/scheduler/algorithm/predicates/predicates.go) and priorities
+(pkg/scheduler/algorithm/priorities/) in plain Python over NodeInfo.
+Three consumers:
+  1. parity tests — the tensor kernels in ops/ must agree with these on
+     identical fixtures (SURVEY.md §4 testing blueprint (a));
+  2. preemption what-if simulation (sched/preemption.py), which mutates
+     cloned NodeInfos pod-by-pod exactly like the reference
+     (generic_scheduler.go:898 selectVictimsOnNode);
+  3. the host-side plugin runner for predicates not yet tensorized
+     (NoDiskConflict, volume predicates) — mirroring how the reference
+     mixes cheap and expensive predicates via ordering.
+
+Each predicate returns (fits: bool, reasons: list[str]) with reason
+strings from sched/errors.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as lbl
+from ..api import resources as res
+from ..api import types as api
+from ..sched.errors import REASONS, insufficient_resource_reason
+from ..state.node_info import NodeInfo, Resource, _ports_conflict
+
+PredicateResult = Tuple[bool, List[str]]
+
+
+# --- predicates -------------------------------------------------------------
+
+
+def check_node_condition(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:1583 CheckNodeConditionPredicate."""
+    if ni.node is None:
+        return False, [REASONS["NodeUnknownCondition"]]
+    reasons = []
+    for c in ni.node.status.conditions:
+        if c.type == api.NODE_READY and c.status != api.COND_TRUE:
+            reasons.append(REASONS["NodeNotReady"])
+        elif c.type == api.NODE_OUT_OF_DISK and c.status != api.COND_FALSE:
+            reasons.append(REASONS["NodeOutOfDisk"])
+        elif c.type == api.NODE_NETWORK_UNAVAILABLE and c.status != api.COND_FALSE:
+            reasons.append(REASONS["NodeNetworkUnavailable"])
+    if ni.node.spec.unschedulable:
+        reasons.append(REASONS["NodeUnschedulable"])
+    return not reasons, reasons
+
+
+def pod_fits_resources(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:688 PodFitsResources."""
+    if ni.node is None:
+        return False, [REASONS["NodeUnknownCondition"]]
+    reasons = []
+    if len(ni.pods) + 1 > ni.allocatable.allowed_pod_number:
+        reasons.append(insufficient_resource_reason(res.PODS))
+    r = Resource.from_map(api.get_resource_request(pod))
+    if r.milli_cpu == 0 and r.memory == 0 and r.ephemeral_storage == 0 and not r.scalars:
+        return not reasons, reasons
+    if ni.requested.milli_cpu + r.milli_cpu > ni.allocatable.milli_cpu:
+        reasons.append(insufficient_resource_reason(res.CPU))
+    if ni.requested.memory + r.memory > ni.allocatable.memory:
+        reasons.append(insufficient_resource_reason(res.MEMORY))
+    if ni.requested.ephemeral_storage + r.ephemeral_storage > ni.allocatable.ephemeral_storage:
+        reasons.append(insufficient_resource_reason(res.EPHEMERAL_STORAGE))
+    for name, q in r.scalars.items():
+        if ni.requested.scalars.get(name, 0) + q > ni.allocatable.scalars.get(name, 0):
+            reasons.append(insufficient_resource_reason(name))
+    return not reasons, reasons
+
+
+def pod_fits_host(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:825 PodFitsHost."""
+    if not pod.spec.node_name:
+        return True, []
+    if ni.node is not None and pod.spec.node_name == ni.node.name:
+        return True, []
+    return False, [REASONS["HostName"]]
+
+
+def pod_fits_host_ports(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:991 PodFitsHostPorts."""
+    wanted = api.get_container_ports(pod)
+    if not wanted:
+        return True, []
+    for p in wanted:
+        if _ports_conflict(ni.used_ports, (p.protocol, p.host_ip or "0.0.0.0", p.host_port)):
+            return False, [REASONS["PodFitsHostPorts"]]
+    return True, []
+
+
+def pod_matches_node_selector(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:813 PodMatchNodeSelector."""
+    if ni.node is None:
+        return False, [REASONS["NodeUnknownCondition"]]
+    if api.pod_matches_node_selector(pod, ni.node):
+        return True, []
+    return False, [REASONS["MatchNodeSelector"]]
+
+
+def pod_tolerates_node_taints(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:1504 — NoSchedule + NoExecute taints."""
+    return _tolerates(pod, ni, (api.NO_SCHEDULE, api.NO_EXECUTE))
+
+
+def pod_tolerates_no_execute_taints(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:1514 — NoExecute only."""
+    return _tolerates(pod, ni, (api.NO_EXECUTE,))
+
+
+def _tolerates(pod: api.Pod, ni: NodeInfo, effects) -> PredicateResult:
+    if ni.node is None:
+        return False, [REASONS["NodeUnknownCondition"]]
+    for taint in ni.taints:
+        if taint.effect not in effects:
+            continue
+        if not api.tolerations_tolerate_taint(pod.spec.tolerations, taint):
+            return False, [REASONS["PodToleratesNodeTaints"]]
+    return True, []
+
+
+def check_node_memory_pressure(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:1541 — only BestEffort pods are rejected."""
+    if api.is_best_effort(pod) and ni.memory_pressure:
+        return False, [REASONS["NodeUnderMemoryPressure"]]
+    return True, []
+
+
+def check_node_disk_pressure(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    if ni.disk_pressure:
+        return False, [REASONS["NodeUnderDiskPressure"]]
+    return True, []
+
+
+def check_node_pid_pressure(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    if ni.pid_pressure:
+        return False, [REASONS["NodeUnderPIDPressure"]]
+    return True, []
+
+
+def no_disk_conflict(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    """predicates.go:279 NoDiskConflict — GCEPD (same pd, any RO mix unless
+    both read-only), AWS EBS (same volume id), RBD/ISCSI (same image, not
+    all read-only). Simplified to source-kind + id equality with the
+    read-only escape hatch."""
+    mine = [v for v in pod.spec.volumes if v.source_kind]
+    if not mine:
+        return True, []
+    for existing in ni.pods:
+        for ev in existing.spec.volumes:
+            if not ev.source_kind:
+                continue
+            for v in mine:
+                if v.source_kind == ev.source_kind and v.source_id == ev.source_id:
+                    if not (v.read_only and ev.read_only):
+                        return False, [REASONS["NoDiskConflict"]]
+    return True, []
+
+
+# GeneralPredicates (predicates.go:1031): resources + host + ports + selector.
+def general_predicates(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+    fits, reasons = True, []
+    for p in (pod_fits_resources, pod_fits_host, pod_fits_host_ports,
+              pod_matches_node_selector):
+        ok, r = p(pod, ni)
+        fits &= ok
+        reasons.extend(r)
+    return fits, reasons
+
+
+# Ordered as the reference's predicatesOrdering (predicates.go:133),
+# with GeneralPredicates expanded to its members.
+ORDERED_PREDICATES: List[Tuple[str, Callable[[api.Pod, NodeInfo], PredicateResult]]] = [
+    ("CheckNodeCondition", check_node_condition),
+    ("PodFitsResources", pod_fits_resources),
+    ("HostName", pod_fits_host),
+    ("PodFitsHostPorts", pod_fits_host_ports),
+    ("MatchNodeSelector", pod_matches_node_selector),
+    ("NoDiskConflict", no_disk_conflict),
+    ("PodToleratesNodeTaints", pod_tolerates_node_taints),
+    ("CheckNodeMemoryPressure", check_node_memory_pressure),
+    ("CheckNodePIDPressure", check_node_pid_pressure),
+    ("CheckNodeDiskPressure", check_node_disk_pressure),
+]
+
+
+def pod_fits_on_node(pod: api.Pod, ni: NodeInfo,
+                     always_check_all: bool = False) -> PredicateResult:
+    """Reference: generic_scheduler.go:456 podFitsOnNode inner loop with
+    short-circuit ordering (:503)."""
+    reasons: List[str] = []
+    for name, pred in ORDERED_PREDICATES:
+        ok, r = pred(pod, ni)
+        if not ok:
+            reasons.extend(r)
+            if not always_check_all:
+                break
+    return not reasons, reasons
+
+
+# --- priorities (Map phase; ints) -------------------------------------------
+
+
+def least_requested_map(pod: api.Pod, ni: NodeInfo) -> int:
+    cpu, mem = api.get_nonzero_requests(pod)
+    return _resource_score(ni, cpu, mem, _least)
+
+
+def most_requested_map(pod: api.Pod, ni: NodeInfo) -> int:
+    cpu, mem = api.get_nonzero_requests(pod)
+    return _resource_score(ni, cpu, mem, _most)
+
+
+def _least(requested: int, capacity: int) -> int:
+    if capacity == 0 or requested > capacity:
+        return 0
+    return (capacity - requested) * 10 // capacity
+
+
+def _most(requested: int, capacity: int) -> int:
+    if capacity == 0 or requested > capacity:
+        return 0
+    return requested * 10 // capacity
+
+
+def _resource_score(ni: NodeInfo, cpu: int, mem: int, f) -> int:
+    rc = ni.nonzero_milli_cpu + cpu
+    rm = ni.nonzero_memory + mem
+    return (f(rc, ni.allocatable.milli_cpu) + f(rm, ni.allocatable.memory)) // 2
+
+
+def balanced_allocation_map(pod: api.Pod, ni: NodeInfo) -> int:
+    cpu, mem = api.get_nonzero_requests(pod)
+    rc = ni.nonzero_milli_cpu + cpu
+    rm = ni.nonzero_memory + mem
+    cf = rc / ni.allocatable.milli_cpu if ni.allocatable.milli_cpu else 1.0
+    mf = rm / ni.allocatable.memory if ni.allocatable.memory else 1.0
+    if cf >= 1 or mf >= 1:
+        return 0
+    return int((1 - abs(cf - mf)) * 10)
+
+
+def node_affinity_map(pod: api.Pod, ni: NodeInfo) -> int:
+    """priorities/node_affinity.go:34 — sum of matched preferred weights."""
+    aff = pod.spec.affinity
+    if not (aff and aff.node_affinity):
+        return 0
+    count = 0
+    for term in aff.node_affinity.preferred:
+        if term.weight == 0:
+            continue
+        sel = lbl.Selector(tuple(term.preference.match_expressions))
+        if ni.node is not None and sel.matches(ni.node.metadata.labels):
+            count += term.weight
+    return count
+
+
+def taint_toleration_map(pod: api.Pod, ni: NodeInfo) -> int:
+    """priorities/taint_toleration.go:55 — # intolerable PreferNoSchedule."""
+    eligible = [t for t in pod.spec.tolerations
+                if not t.effect or t.effect == api.PREFER_NO_SCHEDULE]
+    count = 0
+    for taint in ni.taints:
+        if taint.effect != api.PREFER_NO_SCHEDULE:
+            continue
+        if not api.tolerations_tolerate_taint(eligible, taint):
+            count += 1
+    return count
+
+
+def selector_spread_map(pod: api.Pod, ni: NodeInfo,
+                        selectors: Sequence[lbl.Selector]) -> int:
+    """priorities/selector_spreading.go:66."""
+    if not selectors:
+        return 0
+    count = 0
+    for np_ in ni.pods:
+        if np_.namespace != pod.namespace or np_.metadata.deletion_timestamp is not None:
+            continue
+        if any(s.matches(np_.metadata.labels) for s in selectors):
+            count += 1
+    return count
+
+
+def selector_spread_reduce(counts: Dict[str, int], zones: Dict[str, str]) -> Dict[str, int]:
+    """priorities/selector_spreading.go:122 — counts: node -> matched pods;
+    zones: node -> zone key ('' if none). Returns node -> 0..10."""
+    max_node = max(counts.values(), default=0)
+    zone_counts: Dict[str, int] = {}
+    for n, c in counts.items():
+        z = zones.get(n, "")
+        if z:
+            zone_counts[z] = zone_counts.get(z, 0) + c
+    max_zone = max(zone_counts.values(), default=0)
+    have_zones = len(zone_counts) > 0
+    out = {}
+    for n, c in counts.items():
+        f = 10.0
+        if max_node > 0:
+            f = 10.0 * (max_node - c) / max_node
+        z = zones.get(n, "")
+        if have_zones and z:
+            zs = 10.0
+            if max_zone > 0:
+                zs = 10.0 * (max_zone - zone_counts[z]) / max_zone
+            f = f * (1.0 / 3.0) + (2.0 / 3.0) * zs
+        out[n] = int(f)
+    return out
+
+
+def image_locality_map(pod: api.Pod, ni: NodeInfo) -> int:
+    """priorities/image_locality.go:39."""
+    total = sum(ni.image_sizes.get(c.image, 0) for c in pod.spec.containers)
+    mb = 1024 * 1024
+    if total == 0 or total < 23 * mb:
+        return 0
+    if total >= 1000 * mb:
+        return 10
+    return int(10 * (total - 23 * mb) // (1000 * mb - 23 * mb)) + 1
+
+
+def normalize_reduce(scores: Dict[str, int], reverse: bool) -> Dict[str, int]:
+    """priorities/reduce.go:29 NormalizeReduce(10, reverse)."""
+    max_count = max(scores.values(), default=0)
+    if max_count == 0:
+        return {n: (10 if reverse else 0) for n in scores}
+    out = {}
+    for n, s in scores.items():
+        v = 10 * s // max_count
+        out[n] = 10 - v if reverse else v
+    return out

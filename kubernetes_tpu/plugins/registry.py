@@ -1,0 +1,124 @@
+"""Extension-point registry and profiles.
+
+Modern extension-point names (PreFilter/Filter/Score/NormalizeScore) over
+the reference's registry mechanics (pkg/scheduler/factory/plugins.go
+RegisterFitPredicate / RegisterPriorityConfigFactory and the provider
+registry in pkg/scheduler/algorithmprovider/defaults/defaults.go:105).
+
+A profile selects which Filter plugins run on-device (the tensorized
+set, ops/filters.py), which run host-side (plugins/golden.py callables),
+and the Score weight vector compiled into the wave kernel
+(ops/kernel.py Weights). A Policy-JSON analog
+(pkg/scheduler/api/types.go) can override the default provider.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..api import types as api
+from ..ops.encoding import DEVICE_PREDICATES
+from ..ops.kernel import Weights
+from ..state.node_info import NodeInfo
+from . import golden
+
+HostPredicate = Callable[[api.Pod, NodeInfo], golden.PredicateResult]
+
+# score plugin name -> Weights field
+_SCORE_FIELDS = {
+    "LeastRequestedPriority": "least_requested",
+    "BalancedResourceAllocation": "balanced",
+    "MostRequestedPriority": "most_requested",
+    "NodeAffinityPriority": "node_affinity",
+    "TaintTolerationPriority": "taint_toleration",
+    "SelectorSpreadPriority": "selector_spread",
+    "NodePreferAvoidPodsPriority": "prefer_avoid",
+    "ImageLocalityPriority": "image_locality",
+}
+
+
+@dataclass
+class Profile:
+    """One scheduler profile (multi-profile sharding by schedulerName is
+    the reference's multi-scheduler mechanism, factory.go:1211)."""
+
+    scheduler_name: str = "default-scheduler"
+    device_filters: List[str] = field(default_factory=lambda: list(DEVICE_PREDICATES))
+    host_filters: Dict[str, HostPredicate] = field(default_factory=dict)
+    score_weights: Dict[str, int] = field(default_factory=dict)
+    disable_preemption: bool = False
+
+    def weights(self) -> Weights:
+        kw = {}
+        for plugin, w in self.score_weights.items():
+            f = _SCORE_FIELDS.get(plugin)
+            if f is not None:
+                kw[f] = float(w)
+        base = {f: 0.0 for f in Weights._fields}
+        base.update(kw)
+        return Weights(**base)
+
+
+def default_profile() -> Profile:
+    """Reference default provider (algorithmprovider/defaults/defaults.go:105
+    defaultPredicates, :219 defaultPriorities)."""
+    return Profile(
+        host_filters={"NoDiskConflict": golden.no_disk_conflict},
+        score_weights={
+            "SelectorSpreadPriority": 1,
+            "LeastRequestedPriority": 1,
+            "BalancedResourceAllocation": 1,
+            "NodePreferAvoidPodsPriority": 10000,
+            "NodeAffinityPriority": 1,
+            "TaintTolerationPriority": 1,
+            # InterPodAffinityPriority: 1 — pending tensorization (round 2)
+        },
+    )
+
+
+class Registry:
+    """Name -> implementation registries, for Policy-file style config."""
+
+    def __init__(self):
+        self.host_predicates: Dict[str, HostPredicate] = {
+            "NoDiskConflict": golden.no_disk_conflict,
+            "GeneralPredicates": golden.general_predicates,
+            "PodToleratesNodeNoExecuteTaints": golden.pod_tolerates_no_execute_taints,
+        }
+        self.device_predicates = set(DEVICE_PREDICATES)
+        self.score_plugins = set(_SCORE_FIELDS)
+
+    def register_host_predicate(self, name: str, fn: HostPredicate):
+        self.host_predicates[name] = fn
+
+    def profile_from_policy(self, policy_json: str) -> Profile:
+        """Build a profile from a Policy JSON document
+        (reference: pkg/scheduler/api/types.go Policy)."""
+        policy = json.loads(policy_json)
+        prof = Profile()
+        if policy.get("predicates") is not None:
+            prof.device_filters = []
+            prof.host_filters = {}
+            for p in policy["predicates"]:
+                name = p["name"]
+                if name in self.device_predicates:
+                    prof.device_filters.append(name)
+                elif name in self.host_predicates:
+                    prof.host_filters[name] = self.host_predicates[name]
+                else:
+                    raise KeyError(f"unknown predicate {name!r}")
+        else:
+            prof.device_filters = list(DEVICE_PREDICATES)
+            prof.host_filters = {"NoDiskConflict": golden.no_disk_conflict}
+        if policy.get("priorities") is not None:
+            prof.score_weights = {
+                p["name"]: p.get("weight", 1) for p in policy["priorities"]
+            }
+        else:
+            prof.score_weights = default_profile().score_weights
+        return prof
+
+
+default_registry = Registry()

@@ -1,0 +1,2 @@
+from .store import ObjectStore, Event, ADDED, MODIFIED, DELETED  # noqa: F401
+from .informer import SharedInformer  # noqa: F401

@@ -1,0 +1,181 @@
+"""In-process object store with watch fan-out.
+
+The framework's analog of the reference's storage + API + informer edge
+for in-process use (test/integration's in-process apiserver,
+framework/master_utils.go:108, plus the fake clientset object tracker,
+client-go/testing/fixture.go). State-machine replication through etcd
+watch fan-out (SURVEY.md §2.2) becomes: a versioned object map whose
+mutations synchronously fan out to registered watchers — informers —
+in resourceVersion order. Components stay level-triggered: a watcher
+can always relist and resync.
+
+The /bind subresource (pkg/registry/core/pod/storage BindingREST) is
+`bind()`: it sets spec.nodeName and emits the MODIFIED event the
+scheduler cache consumes to confirm its assumption
+(factory.go:608 addPodToCache -> cache.AddPod).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import types as api
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class Event:
+    type: str
+    kind: str
+    obj: object
+    old: Optional[object] = None
+    resource_version: int = 0
+
+
+WatchFn = Callable[[Event], None]
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency failure (etcd3 ModRevision mismatch,
+    reference storage/etcd3/store.go:262 GuaranteedUpdate)."""
+
+
+class ObjectStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, object]] = {}
+        self._rv = 0
+        self._watchers: List[Tuple[Optional[str], WatchFn]] = []
+
+    @staticmethod
+    def _key(obj) -> str:
+        meta = obj.metadata
+        return f"{meta.namespace}/{meta.name}"
+
+    def _notify(self, ev: Event):
+        for kind, fn in list(self._watchers):
+            if kind is None or kind == ev.kind:
+                fn(ev)
+
+    # -- watch ----------------------------------------------------------------
+
+    def watch(self, kind: Optional[str], fn: WatchFn):
+        with self._lock:
+            self._watchers.append((kind, fn))
+
+    # -- CRUD (reference: registry/generic/registry/store.go) -----------------
+
+    def create(self, kind: str, obj) -> object:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key in objs:
+                raise Conflict(f"{kind} {key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            objs[key] = obj
+            ev = Event(ADDED, kind, obj, resource_version=self._rv)
+            self._notify(ev)
+            return obj
+
+    def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> object:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            old = objs.get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key} not found")
+            if expect_rv is not None and old.metadata.resource_version != expect_rv:
+                raise Conflict(
+                    f"{kind} {key}: rv {old.metadata.resource_version} != {expect_rv}")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            objs[key] = obj
+            self._notify(Event(MODIFIED, kind, obj, old=old, resource_version=self._rv))
+            return obj
+
+    def delete(self, kind: str, namespace: str, name: str) -> object:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = f"{namespace}/{name}"
+            old = objs.pop(key, None)
+            if old is None:
+                raise KeyError(f"{kind} {key} not found")
+            self._rv += 1
+            self._notify(Event(DELETED, kind, old, resource_version=self._rv))
+            return old
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            return self._objects.get(kind, {}).get(f"{namespace}/{name}")
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
+        with self._lock:
+            objs = self._objects.get(kind, {})
+            if namespace is None:
+                return list(objs.values())
+            prefix = namespace + "/"
+            return [o for k, o in objs.items() if k.startswith(prefix)]
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return len(self._objects.get(kind, {}))
+
+    # -- pod subresources ------------------------------------------------------
+
+    def bind(self, pod: api.Pod, node_name: str):
+        """POST pods/<name>/binding (reference: scheduler.go:409 bind ->
+        registry/core/pod BindingREST.Create).
+
+        Copy-on-write: the stored object is replaced, never mutated — the
+        serialization boundary the reference gets from etcd. Without it,
+        informers would see old==new aliases and bind confirmation
+        (cache.AddPod) would never fire."""
+        with self._lock:
+            old = self.get("pods", pod.namespace, pod.name)
+            if old is None:
+                raise KeyError(f"pod {pod.full_name()} not found")
+            if old.spec.node_name and old.spec.node_name != node_name:
+                raise Conflict(
+                    f"pod {pod.full_name()} already bound to {old.spec.node_name}")
+            cur = copy.deepcopy(old)
+            cur.spec.node_name = node_name
+            cur.status.phase = "Pending"  # running once kubelet reports
+            self._rv += 1
+            cur.metadata.resource_version = self._rv
+            self._objects["pods"][self._key(cur)] = cur
+            self._notify(Event(MODIFIED, "pods", cur, old=old,
+                               resource_version=self._rv))
+
+    def set_pod_condition(self, pod: api.Pod, cond: Tuple[str, str]):
+        with self._lock:
+            old = self.get("pods", pod.namespace, pod.name)
+            if old is None:
+                return
+            cur = copy.deepcopy(old)
+            cur.status.conditions = [c for c in cur.status.conditions
+                                     if c[0] != cond[0]] + [cond]
+            self._rv += 1
+            cur.metadata.resource_version = self._rv
+            self._objects["pods"][self._key(cur)] = cur
+            self._notify(Event(MODIFIED, "pods", cur, old=old,
+                               resource_version=self._rv))
+
+    def set_nominated_node(self, pod: api.Pod, node_name: str):
+        with self._lock:
+            old = self.get("pods", pod.namespace, pod.name)
+            if old is None:
+                return
+            cur = copy.deepcopy(old)
+            cur.status.nominated_node_name = node_name
+            self._rv += 1
+            cur.metadata.resource_version = self._rv
+            self._objects["pods"][self._key(cur)] = cur
+            self._notify(Event(MODIFIED, "pods", cur, old=old,
+                               resource_version=self._rv))

@@ -1,0 +1,81 @@
+"""Scheduling failure reasons and FitError.
+
+Reason strings mirror the reference exactly (pkg/scheduler/algorithm/
+predicates/error.go:35-79; FitError message format
+pkg/scheduler/core/generic_scheduler.go:62-84) because preemption's
+unresolvable-reason filter and user-facing events key off them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+# predicate name -> human reason (error.go)
+REASONS = {
+    "NoDiskConflict": "node(s) had no available disk",
+    "NoVolumeZoneConflict": "node(s) had no available volume zone",
+    "MatchNodeSelector": "node(s) didn't match node selector",
+    "MatchInterPodAffinity": "node(s) didn't match pod affinity/anti-affinity",
+    "PodToleratesNodeTaints": "node(s) had taints that the pod didn't tolerate",
+    "HostName": "node(s) didn't match the requested hostname",
+    "PodFitsHostPorts": "node(s) didn't have free ports for the requested pod ports",
+    "CheckNodeLabelPresence": "node(s) didn't have the requested labels",
+    "CheckServiceAffinity": "node(s) didn't match service affinity",
+    "MaxVolumeCount": "node(s) exceed max volume count",
+    "NodeUnderMemoryPressure": "node(s) had memory pressure",
+    "NodeUnderDiskPressure": "node(s) had disk pressure",
+    "NodeUnderPIDPressure": "node(s) had pid pressure",
+    "NodeOutOfDisk": "node(s) were out of disk space",
+    "NodeNotReady": "node(s) were not ready",
+    "NodeNetworkUnavailable": "node(s) had unavailable network",
+    "NodeUnschedulable": "node(s) were unschedulable",
+    "NodeUnknownCondition": "node(s) had unknown conditions",
+    "VolumeNodeAffinityConflict": "node(s) had volume node affinity conflict",
+    "VolumeBindingNoMatch": "node(s) didn't find available persistent volumes to bind",
+}
+
+# Failure reasons preemption cannot resolve by evicting pods — EXACTLY the
+# reference's switch list (generic_scheduler.go:980-996); note pressure
+# predicates and OutOfDisk are deliberately absent there. Keys are
+# predicate/error names as produced by the device mask stack and golden
+# predicates.
+UNRESOLVABLE = frozenset({
+    "MatchNodeSelector",  # ErrNodeSelectorNotMatch
+    "HostName",  # ErrPodNotMatchHostName
+    "PodToleratesNodeTaints",  # ErrTaintsTolerationsNotMatch
+    "CheckNodeLabelPresence",  # ErrNodeLabelPresenceViolated
+    "NodeNotReady",
+    "NodeNetworkUnavailable",
+    "NodeUnschedulable",  # also the CheckNodeUnschedulable mask
+    "CheckNodeUnschedulable",
+    "NodeUnknownCondition",
+    "NoVolumeZoneConflict",  # ErrVolumeZoneConflict
+    "VolumeNodeAffinityConflict",
+    "VolumeBindingNoMatch",
+})
+
+
+def insufficient_resource_reason(resource: str) -> str:
+    """Reference: predicates.go NewInsufficientResourceError .GetReason()."""
+    return f"Insufficient {resource}"
+
+
+@dataclass
+class FitError(Exception):
+    """Reference: generic_scheduler.go:52 FitError / :82 Error()."""
+
+    pod_name: str
+    num_all_nodes: int
+    # reason string -> number of nodes that failed with it
+    failed_predicates: Dict[str, int] = field(default_factory=dict)
+
+    def message(self) -> str:
+        reasons = sorted(
+            f"{count} {reason}" for reason, count in self.failed_predicates.items() if count
+        )
+        return (f"0/{self.num_all_nodes} nodes are available: "
+                f"{', '.join(reasons)}.")
+
+    def __str__(self):
+        return self.message()

@@ -1,0 +1,164 @@
+"""Preemption.
+
+Behavioral port of genericScheduler.Preempt
+(pkg/scheduler/core/generic_scheduler.go:200) over cloned NodeInfos and
+the golden predicates: candidate nodes are those whose failure reasons
+are resolvable (:972), victims are selected by the remove-all /
+reprieve-by-priority algorithm (:898 selectVictimsOnNode) with PDB
+awareness, and the node is picked by the reference's lexicographic
+criteria (:702 pickOneNodeForPreemption):
+  fewer PDB violations > lower max victim priority > lower priority sum
+  > fewer victims > first.
+
+What-if simulation here runs host-side per candidate node (the candidate
+set is small: failed-but-resolvable nodes); the resource arithmetic
+reuses the exact int64 NodeInfo. Device-assisted batched simulation is a
+later-round optimization.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as lbl
+from ..api import types as api
+from ..state.cache import SchedulerCache
+from ..state.node_info import NodeInfo
+from ..plugins import golden
+from .errors import UNRESOLVABLE
+
+
+class PreemptionResult:
+    def __init__(self, node_name: str, victims: List[api.Pod],
+                 num_pdb_violations: int):
+        self.node_name = node_name
+        self.victims = victims
+        self.num_pdb_violations = num_pdb_violations
+
+
+def pod_eligible_to_preempt_others(pod: api.Pod, cache: SchedulerCache) -> bool:
+    """Reference :1015 — a pod that already nominated a node where a
+    lower-priority pod is terminating must wait."""
+    nominated = pod.status.nominated_node_name
+    if nominated:
+        ni = cache.node_infos.get(nominated)
+        if ni is not None:
+            for p in ni.pods:
+                if (p.metadata.deletion_timestamp is not None
+                        and api.pod_priority(p) < api.pod_priority(pod)):
+                    return False
+    return True
+
+
+def nodes_where_preemption_might_help(
+        failed: Dict[str, List[str]]) -> List[str]:
+    """failed: node name -> failed predicate names (from the device mask
+    stack or golden run). Reference :972."""
+    out = []
+    for node_name, preds in failed.items():
+        if not any(p in UNRESOLVABLE for p in preds):
+            out.append(node_name)
+    return out
+
+
+def _pods_violating_pdb(pods: Sequence[api.Pod],
+                        pdbs: Sequence[api.PodDisruptionBudget]):
+    """Reference :862 filterPodsWithPDBViolation. A pod violates if it
+    matches a PDB whose disruptionsAllowed is exhausted (counting this
+    selection round's usage)."""
+    remaining = [pdb.disruptions_allowed for pdb in pdbs]
+    violating, ok = [], []
+    for p in pods:
+        hit = False
+        for i, pdb in enumerate(pdbs):
+            if pdb.selector is None or pdb.metadata.namespace != p.namespace:
+                continue
+            if pdb.selector.matches(p.metadata.labels):
+                if remaining[i] <= 0:
+                    hit = True
+                else:
+                    remaining[i] -= 1
+        (violating if hit else ok).append(p)
+    return violating, ok
+
+
+def select_victims_on_node(
+        pod: api.Pod, ni: NodeInfo,
+        pdbs: Sequence[api.PodDisruptionBudget]) -> Optional[Tuple[List[api.Pod], int]]:
+    """Reference :898. Returns (victims, numPDBViolations) or None."""
+    copy = ni.clone()
+    prio = api.pod_priority(pod)
+    potential = [p for p in copy.pods if api.pod_priority(p) < prio]
+    for p in potential:
+        copy.remove_pod(p)
+    potential.sort(key=api.pod_priority, reverse=True)
+    fits, _ = golden.pod_fits_on_node(pod, copy)
+    if not fits:
+        return None
+    victims: List[api.Pod] = []
+    num_violating = 0
+    violating, non_violating = _pods_violating_pdb(potential, pdbs)
+
+    def reprieve(p: api.Pod) -> bool:
+        copy.add_pod(p)
+        ok, _ = golden.pod_fits_on_node(pod, copy)
+        if not ok:
+            copy.remove_pod(p)
+            victims.append(p)
+        return ok
+
+    for p in violating:
+        if not reprieve(p):
+            num_violating += 1
+    for p in non_violating:
+        reprieve(p)
+    return victims, num_violating
+
+
+def pick_one_node(candidates: Dict[str, Tuple[List[api.Pod], int]]) -> Optional[str]:
+    """Reference :702 pickOneNodeForPreemption."""
+    if not candidates:
+        return None
+    for name, (victims, _) in candidates.items():
+        if not victims:
+            return name
+    names = list(candidates)
+
+    def metric(name):
+        victims, nviol = candidates[name]
+        max_prio = api.pod_priority(victims[0])  # sorted desc by selection
+        sum_prio = sum(api.pod_priority(p) + (2**31) for p in victims)
+        return (nviol, max_prio, sum_prio, len(victims))
+
+    names.sort(key=metric)
+    return names[0]
+
+
+def preempt(pod: api.Pod, cache: SchedulerCache,
+            failed_predicates: Dict[str, List[str]],
+            pdbs: Sequence[api.PodDisruptionBudget]) -> Optional[PreemptionResult]:
+    """Reference :200 Preempt. Returns None when preemption can't help."""
+    if not pod_eligible_to_preempt_others(pod, cache):
+        return None
+    candidates: Dict[str, Tuple[List[api.Pod], int]] = {}
+    for node_name in nodes_where_preemption_might_help(failed_predicates):
+        ni = cache.node_infos.get(node_name)
+        if ni is None or ni.node is None:
+            continue
+        sel = select_victims_on_node(pod, ni, pdbs)
+        if sel is not None:
+            candidates[node_name] = sel
+    chosen = pick_one_node(candidates)
+    if chosen is None:
+        return None
+    victims, nviol = candidates[chosen]
+    return PreemptionResult(chosen, victims, nviol)
+
+
+def get_lower_priority_nominated_pods(pod: api.Pod, node_name: str,
+                                      queue) -> List[api.Pod]:
+    """Reference scheduler.go:249 — other nominated pods on the chosen node
+    with lower priority get their nomination cleared."""
+    prio = api.pod_priority(pod)
+    return [p for p in queue.waiting_pods_for_node(node_name)
+            if api.pod_priority(p) < prio]

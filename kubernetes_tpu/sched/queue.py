@@ -1,0 +1,203 @@
+"""Scheduling queue.
+
+Behavioral port of the reference's SchedulingQueue
+(pkg/scheduler/core/scheduling_queue.go): an active priority heap
+(pod priority desc, then FIFO), an unschedulable map flushed to active
+on cluster events (MoveAllToActiveQueue, :408), nominated-pod tracking
+for preemption, and a FIFO fallback when pod priority is disabled.
+
+One extension for the TPU wave model: `pop_wave(max_n)` drains up to a
+wavefront of pods in one call — the device schedules them in a single
+fused kernel invocation while preserving priority order inside the wave
+(the scan commits in pop order, so higher-priority pods still claim
+capacity first, matching one-at-a-time placement semantics).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..api import types as api
+
+
+class SchedulingQueue:
+    def __init__(self, pod_priority_enabled: bool = True):
+        self.pod_priority = pod_priority_enabled
+        self._lock = threading.Condition()
+        self._heap: List = []  # (-priority, seq, uid)
+        self._items: Dict[str, api.Pod] = {}  # uid -> pod (active)
+        self._unschedulable: Dict[str, api.Pod] = {}
+        self._seq = itertools.count()
+        # uid -> scheduling cycle when it was deemed unschedulable
+        self._cycle: Dict[str, int] = {}
+        self._move_request_cycle = -1
+        self._current_cycle = 0
+        # nominated pods: node name -> {uid: pod} (reference :464
+        # WaitingPodsForNode; used by preemption + two-pass filtering)
+        self._nominated: Dict[str, Dict[str, api.Pod]] = {}
+        self._closed = False
+
+    # -- add / pop -----------------------------------------------------------
+
+    def _key(self, pod: api.Pod):
+        prio = -api.pod_priority(pod) if self.pod_priority else 0
+        return (prio, next(self._seq), pod.uid)
+
+    def add(self, pod: api.Pod):
+        with self._lock:
+            if pod.uid in self._items:
+                return
+            self._unschedulable.pop(pod.uid, None)
+            self._items[pod.uid] = pod
+            heapq.heappush(self._heap, self._key(pod))
+            if pod.status.nominated_node_name:
+                self._nominated.setdefault(
+                    pod.status.nominated_node_name, {})[pod.uid] = pod
+            self._lock.notify()
+
+    def add_if_not_present(self, pod: api.Pod):
+        with self._lock:
+            if pod.uid in self._items or pod.uid in self._unschedulable:
+                return
+        self.add(pod)
+
+    def add_unschedulable_if_not_present(self, pod: api.Pod):
+        """Reference :286 — goes back to active if a move request arrived
+        since this pod's scheduling cycle began (an event may have made it
+        schedulable again)."""
+        with self._lock:
+            if pod.uid in self._items or pod.uid in self._unschedulable:
+                return
+            cycle = self._cycle.pop(pod.uid, self._current_cycle)
+            if self._move_request_cycle >= cycle:
+                self._items[pod.uid] = pod
+                heapq.heappush(self._heap, self._key(pod))
+                self._lock.notify()
+            else:
+                self._unschedulable[pod.uid] = pod
+            if pod.status.nominated_node_name:
+                self._nominated.setdefault(
+                    pod.status.nominated_node_name, {})[pod.uid] = pod
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[api.Pod]:
+        """Blocking pop of the highest-priority pod (reference :311)."""
+        with self._lock:
+            while not self._heap and not self._closed:
+                if not self._lock.wait(timeout):
+                    return None
+            if self._closed and not self._heap:
+                return None
+            return self._pop_locked()
+
+    def _pop_locked(self) -> Optional[api.Pod]:
+        while self._heap:
+            _, _, uid = heapq.heappop(self._heap)
+            pod = self._items.pop(uid, None)
+            if pod is not None:
+                self._current_cycle += 1
+                self._cycle[uid] = self._current_cycle
+                return pod
+        return None
+
+    def pop_wave(self, max_n: int, timeout: Optional[float] = None) -> List[api.Pod]:
+        """Drain up to max_n pods in priority order (blocks for the first)."""
+        out = []
+        first = self.pop(timeout)
+        if first is None:
+            return out
+        out.append(first)
+        with self._lock:
+            while len(out) < max_n:
+                pod = self._pop_locked()
+                if pod is None:
+                    break
+                out.append(pod)
+        return out
+
+    # -- event-driven moves ---------------------------------------------------
+
+    def move_all_to_active(self):
+        """Reference :408 MoveAllToActiveQueue — cluster events (node add,
+        pod delete, ...) flush the unschedulable map."""
+        with self._lock:
+            for uid, pod in self._unschedulable.items():
+                self._items[uid] = pod
+                heapq.heappush(self._heap, self._key(pod))
+            self._unschedulable.clear()
+            self._move_request_cycle = self._current_cycle
+            self._lock.notify_all()
+
+    def assigned_pod_added(self, pod: api.Pod):
+        """Reference :363 — an assigned pod can unblock pods with affinity;
+        conservatively moves everything (targeted matching in later rounds)."""
+        self.move_all_to_active()
+
+    # -- update / delete ------------------------------------------------------
+
+    @staticmethod
+    def _is_pod_updated(old: api.Pod, new: api.Pod) -> bool:
+        """Reference :328 isPodUpdated — strip status/resourceVersion and
+        compare; only such updates can make an unschedulable pod
+        schedulable."""
+        import dataclasses
+
+        def strip(p: api.Pod):
+            meta = dataclasses.replace(p.metadata, resource_version=0)
+            return (meta, p.spec)
+
+        return strip(old) != strip(new)
+
+    def update(self, old: Optional[api.Pod], new: api.Pod):
+        with self._lock:
+            if new.uid in self._items:
+                self._items[new.uid] = new
+                return
+            if new.uid in self._unschedulable:
+                if old is not None and not self._is_pod_updated(old, new):
+                    self._unschedulable[new.uid] = new  # status-only change
+                    return
+                self._unschedulable.pop(new.uid)
+                self._items[new.uid] = new
+                heapq.heappush(self._heap, self._key(new))
+                self._lock.notify()
+                return
+        self.add(new)
+
+    def delete(self, pod: api.Pod):
+        with self._lock:
+            self._items.pop(pod.uid, None)
+            self._unschedulable.pop(pod.uid, None)
+            nom = self._nominated.get(pod.status.nominated_node_name)
+            if nom:
+                nom.pop(pod.uid, None)
+
+    # -- nominated pods --------------------------------------------------------
+
+    def update_nominated_pod(self, pod: api.Pod, node_name: str):
+        with self._lock:
+            for nodes in self._nominated.values():
+                nodes.pop(pod.uid, None)
+            if node_name:
+                self._nominated.setdefault(node_name, {})[pod.uid] = pod
+
+    def waiting_pods_for_node(self, node_name: str) -> List[api.Pod]:
+        with self._lock:
+            return list(self._nominated.get(node_name, {}).values())
+
+    # -- introspection ---------------------------------------------------------
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._items) + len(self._unschedulable)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()

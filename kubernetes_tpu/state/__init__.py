@@ -1,0 +1,3 @@
+from .vocab import Interner, VocabSet  # noqa: F401
+from .node_info import NodeInfo  # noqa: F401
+from .cache import SchedulerCache  # noqa: F401

@@ -1,0 +1,163 @@
+"""Scheduler cache: authoritative in-memory cluster state.
+
+Behavioral port of the reference's schedulerCache (pkg/scheduler/
+schedulercache/cache.go:42, interface.go:62). It aggregates pod/node
+events into NodeInfo structs and runs the assumed-pod state machine
+(interface.go:35-61 state diagram):
+
+    Assume -> (bind finished) -> expire after TTL unless confirmed
+    Assume -> Add (informer confirms) -> normal pod
+    Assume -> Forget (bind failed) -> gone
+
+Default TTL 30s with a 1s sweep (reference: factory/factory.go:161,
+cache.go:35); here the sweep is invoked by the scheduler loop with an
+injectable clock so tests control time.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..api import types as api
+from .node_info import NodeInfo
+
+
+class _PodState:
+    __slots__ = ("pod", "deadline", "binding_finished")
+
+    def __init__(self, pod: api.Pod):
+        self.pod = pod
+        self.deadline: Optional[float] = None
+        self.binding_finished = False
+
+
+class SchedulerCache:
+    def __init__(self, ttl: float = 30.0, clock: Callable[[], float] = time.monotonic):
+        self.ttl = ttl
+        self.clock = clock
+        self.node_infos: Dict[str, NodeInfo] = {}
+        self.nodes: Dict[str, api.Node] = {}
+        self._pod_states: Dict[str, _PodState] = {}
+        self._assumed: Set[str] = set()
+
+    # -- assume / confirm / forget (reference: cache.go AssumePod:88,
+    #    FinishBinding:110, ForgetPod:130, AddPod:171) ------------------------
+
+    def assume_pod(self, pod: api.Pod):
+        if pod.uid in self._pod_states:
+            raise KeyError(f"pod {pod.uid} already in cache")
+        self._add_pod_to_node(pod)
+        self._pod_states[pod.uid] = _PodState(pod)
+        self._assumed.add(pod.uid)
+
+    def finish_binding(self, pod: api.Pod, now: Optional[float] = None):
+        if pod.uid in self._assumed:
+            st = self._pod_states[pod.uid]
+            st.binding_finished = True
+            st.deadline = (now if now is not None else self.clock()) + self.ttl
+
+    def forget_pod(self, pod: api.Pod):
+        st = self._pod_states.get(pod.uid)
+        if st is None:
+            return
+        if pod.uid in self._assumed:
+            self._remove_pod_from_node(st.pod)
+            del self._pod_states[pod.uid]
+            self._assumed.discard(pod.uid)
+        else:
+            raise KeyError(f"pod {pod.uid} not assumed; cannot forget")
+
+    def is_assumed(self, pod: api.Pod) -> bool:
+        return pod.uid in self._assumed
+
+    def add_pod(self, pod: api.Pod):
+        """Informer-confirmed add (reference: cache.go:171). Confirms an
+        assumed pod or, if the pod expired/was never assumed, inserts it."""
+        st = self._pod_states.get(pod.uid)
+        if st is not None and pod.uid in self._assumed:
+            if st.pod.spec.node_name != pod.spec.node_name:
+                # Scheduler's assumption was overridden; move the pod.
+                self._remove_pod_from_node(st.pod)
+                self._add_pod_to_node(pod)
+            self._assumed.discard(pod.uid)
+            st.deadline = None
+            st.pod = pod
+        elif st is None:
+            self._add_pod_to_node(pod)
+            self._pod_states[pod.uid] = _PodState(pod)
+        # else: duplicate add — keep existing confirmed state.
+
+    def update_pod(self, old: api.Pod, new: api.Pod):
+        st = self._pod_states.get(old.uid)
+        if st is not None and old.uid not in self._assumed:
+            self._remove_pod_from_node(st.pod)
+            self._add_pod_to_node(new)
+            st.pod = new
+
+    def remove_pod(self, pod: api.Pod):
+        st = self._pod_states.pop(pod.uid, None)
+        if st is not None:
+            self._remove_pod_from_node(st.pod)
+        self._assumed.discard(pod.uid)
+
+    def cleanup_expired(self, now: Optional[float] = None):
+        """Expire assumed pods whose binding finished > TTL ago
+        (reference: cache.go:422 cleanupAssumedPods)."""
+        now = now if now is not None else self.clock()
+        for uid in list(self._assumed):
+            st = self._pod_states[uid]
+            if st.binding_finished and st.deadline is not None and now >= st.deadline:
+                self._remove_pod_from_node(st.pod)
+                del self._pod_states[uid]
+                self._assumed.discard(uid)
+
+    # -- nodes ---------------------------------------------------------------
+
+    def add_node(self, node: api.Node):
+        ni = self.node_infos.get(node.name)
+        if ni is None:
+            ni = NodeInfo()
+            self.node_infos[node.name] = ni
+        ni.set_node(node)
+        self.nodes[node.name] = node
+
+    def update_node(self, node: api.Node):
+        self.add_node(node)
+
+    def remove_node(self, node: api.Node):
+        ni = self.node_infos.get(node.name)
+        if ni is not None:
+            ni.node = None
+            if not ni.pods:
+                del self.node_infos[node.name]
+        self.nodes.pop(node.name, None)
+
+    # -- listing -------------------------------------------------------------
+
+    def list_pods(self, predicate=None) -> List[api.Pod]:
+        out = []
+        for st in self._pod_states.values():
+            if predicate is None or predicate(st.pod):
+                out.append(st.pod)
+        return out
+
+    def pod_count(self) -> int:
+        return len(self._pod_states)
+
+    # -- internals -----------------------------------------------------------
+
+    def _add_pod_to_node(self, pod: api.Pod):
+        name = pod.spec.node_name
+        ni = self.node_infos.get(name)
+        if ni is None:
+            ni = NodeInfo()
+            self.node_infos[name] = ni
+        ni.add_pod(pod)
+
+    def _remove_pod_from_node(self, pod: api.Pod):
+        ni = self.node_infos.get(pod.spec.node_name)
+        if ni is not None:
+            ni.remove_pod(pod)
+            if ni.node is None and not ni.pods:
+                del self.node_infos[pod.spec.node_name]

@@ -1,0 +1,359 @@
+"""Pending-pod wavefront featurization.
+
+Turns a batch of pending pods into the fixed-shape PodBatch encoding
+(ops/encoding.py). Featurization is the per-cycle "metadata"
+precomputation of the reference (pkg/scheduler/algorithm/predicates/
+metadata.go:111 GetMetadata) fused with its equivalence cache
+(pkg/scheduler/core/equivalence_cache.go:240 getEquivalenceClassInfo):
+pods created by the same controller share an identical spec, so their
+feature rows are computed once and cached by equivalence class. The
+cache is invalidated when the interning vocabularies grow (a previously
+unknown selector operand may have gained an id).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as lbl
+from ..api import types as api
+from ..ops import encoding as enc
+from .snapshot import Snapshot, _parse_label_num
+from .vocab import VocabSet, bucket_size
+
+# A "group selector" for spreading: AND of requirements over pod labels.
+GroupSelectorsFn = Callable[[api.Pod], List[lbl.Selector]]
+
+
+def equivalence_class(pod: api.Pod) -> Optional[str]:
+    """Pods owned by the same controller share scheduling-relevant spec
+    (reference: equivalence_cache.go:240 hashes the controller ref)."""
+    for ref in pod.metadata.owner_references:
+        if ref.controller:
+            return ref.uid
+    return None
+
+
+@dataclass
+class _PodRow:
+    """Cached per-pod feature columns (everything except host_idx, which
+    depends on the node index map)."""
+
+    data: Dict[str, np.ndarray]
+    node_name: str
+    vocab_version: tuple
+
+
+class FeaturizeError(Exception):
+    pass
+
+
+class PodFeaturizer:
+    def __init__(self, snapshot: Snapshot, group_selectors: Optional[GroupSelectorsFn] = None):
+        self.snap = snapshot
+        self.vocabs = snapshot.vocabs
+        self.group_selectors = group_selectors or (lambda pod: [])
+        self._cache: Dict[str, _PodRow] = {}
+
+    # -- selector program compilation ----------------------------------------
+
+    def _compile_reqs(
+        self, reqs: Sequence[lbl.Requirement], keys, AE: int, AV: int,
+        node_space: bool,
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+        """Compile an AND-list of requirements to (key[AE], op[AE],
+        vals[AE,AV], num[AE]). Returns None if it doesn't fit caps (caller
+        grows and retries)."""
+        if len(reqs) > AE:
+            return None
+        key = np.zeros((AE,), np.int32)
+        op = np.full((AE,), enc.OP_PAD, np.int32)
+        vals = np.full((AE, AV), -1, np.int32)
+        num = np.full((AE,), np.nan, np.float32)
+        v = self.vocabs
+        for i, r in enumerate(reqs):
+            if r.key == api.NODE_FIELD_NAME and node_space:
+                # matchFields metadata.name -> node-index membership
+                if r.op not in (lbl.IN,):
+                    # NotIn over node names: rewrite as NODE_NAME_IN inverted is
+                    # not supported yet; treat conservatively as always-false.
+                    op[i] = enc.OP_FALSE
+                    continue
+                if len(r.values) > AV:
+                    return None
+                op[i] = enc.OP_NODE_NAME_IN
+                for j, val in enumerate(r.values):
+                    vals[i, j] = self.snap.node_index.get(val, -1)
+                continue
+            key[i] = keys.lookup(r.key)
+            op[i] = enc.op_id(r.op)
+            if r.op in (lbl.IN, lbl.NOT_IN):
+                if len(r.values) > AV:
+                    return None
+                for j, val in enumerate(r.values):
+                    vals[i, j] = v.label_values.lookup(val)
+            elif r.op in (lbl.GT, lbl.LT):
+                num[i] = _parse_label_num(r.values[0]) if r.values else math.nan
+            if key[i] < 0:
+                # Unknown key: In/Exists/Gt/Lt can never match; NotIn and
+                # DoesNotExist match everything (key absent everywhere).
+                if op[i] in (enc.OP_IN, enc.OP_EXISTS, enc.OP_GT, enc.OP_LT):
+                    op[i] = enc.OP_FALSE
+                else:
+                    op[i] = enc.OP_PAD
+                key[i] = 0
+        return key, op, vals, num
+
+    # -- featurize one pod ----------------------------------------------------
+
+    def _featurize_pod(self, pod: api.Pod) -> Dict[str, np.ndarray]:
+        c = self.snap.caps
+        v = self.vocabs
+        d: Dict[str, np.ndarray] = {}
+        # resources
+        req_map = api.get_resource_request(pod)
+        from .node_info import Resource
+
+        d["req"] = self.snap._res_vec(Resource.from_map(req_map))
+        nz_cpu, nz_mem = api.get_nonzero_requests(pod)
+        d["nonzero"] = np.array([nz_cpu, nz_mem], np.float32)
+        d["best_effort"] = np.bool_(api.is_best_effort(pod))
+        # zero-request fast flag is implicit: req all zeros
+        # nodeSelector equality pairs
+        ns = pod.spec.node_selector or {}
+        if len(ns) > c.NS:
+            self.snap._grow(NS=len(ns))
+            c = self.snap.caps
+        ns_key = np.zeros((c.NS,), np.int32)
+        ns_val = np.full((c.NS,), -1, np.int32)
+        for i, (k, val) in enumerate(sorted(ns.items())):
+            kid = v.label_keys.lookup(k)
+            ns_key[i] = kid if kid > 0 else -2  # -2: unknown key, never matches
+            ns_val[i] = v.label_values.lookup(val)
+        d["ns_key"], d["ns_val"] = ns_key, ns_val
+        # required node affinity
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        terms = list(na.required.node_selector_terms) if (na and na.required is not None) else []
+        d["has_aff"] = np.bool_(na is not None and na.required is not None)
+        while True:
+            c = self.snap.caps
+            at_valid = np.zeros((c.AT,), bool)
+            at_key = np.zeros((c.AT, c.AE), np.int32)
+            at_op = np.full((c.AT, c.AE), enc.OP_PAD, np.int32)
+            at_vals = np.full((c.AT, c.AE, c.AV), -1, np.int32)
+            at_num = np.full((c.AT, c.AE), np.nan, np.float32)
+            if len(terms) > c.AT:
+                self.snap._grow(AT=len(terms))
+                continue
+            ok = True
+            for ti, term in enumerate(terms):
+                reqs = list(term.match_expressions) + list(term.match_fields)
+                if not reqs:
+                    continue  # empty term matches nothing -> leave invalid
+                prog = self._compile_reqs(reqs, v.label_keys, c.AE, c.AV, node_space=True)
+                if prog is None:
+                    self.snap._grow(AE=len(reqs),
+                                    AV=max((len(r.values) for r in reqs), default=0))
+                    ok = False
+                    break
+                at_valid[ti] = True
+                at_key[ti], at_op[ti], at_vals[ti], at_num[ti] = prog
+            if ok:
+                break
+        d["at_valid"], d["at_key"], d["at_op"], d["at_vals"], d["at_num"] = (
+            at_valid, at_key, at_op, at_vals, at_num)
+        # preferred node affinity
+        pref = list(na.preferred) if na else []
+        pref = [t for t in pref if t.weight != 0]
+        while True:
+            c = self.snap.caps
+            if len(pref) > c.PT:
+                self.snap._grow(PT=len(pref))
+                continue
+            pt_weight = np.zeros((c.PT,), np.float32)
+            pt_key = np.zeros((c.PT, c.AE), np.int32)
+            pt_op = np.full((c.PT, c.AE), enc.OP_PAD, np.int32)
+            pt_vals = np.full((c.PT, c.AE, c.AV), -1, np.int32)
+            pt_num = np.full((c.PT, c.AE), np.nan, np.float32)
+            ok = True
+            for ti, term in enumerate(pref):
+                reqs = list(term.preference.match_expressions) + list(term.preference.match_fields)
+                prog = self._compile_reqs(reqs, v.label_keys, c.AE, c.AV, node_space=True)
+                if prog is None:
+                    self.snap._grow(AE=len(reqs),
+                                    AV=max((len(r.values) for r in reqs), default=0))
+                    ok = False
+                    break
+                pt_weight[ti] = term.weight
+                pt_key[ti], pt_op[ti], pt_vals[ti], pt_num[ti] = prog
+            if ok:
+                break
+        d["pt_weight"], d["pt_key"], d["pt_op"], d["pt_vals"], d["pt_num"] = (
+            pt_weight, pt_key, pt_op, pt_vals, pt_num)
+        # tolerations
+        tols = pod.spec.tolerations
+        if len(tols) > self.snap.caps.TL:
+            self.snap._grow(TL=len(tols))
+        c = self.snap.caps
+        tol_key = np.zeros((c.TL,), np.int32)
+        tol_val = np.full((c.TL,), -1, np.int32)
+        tol_op = np.full((c.TL,), enc.TOL_PAD, np.int32)
+        tol_effect = np.zeros((c.TL,), np.int32)
+        for i, t in enumerate(tols):
+            tol_key[i] = v.taint_keys.lookup(t.key) if t.key else 0  # 0 = all keys
+            if t.key and tol_key[i] < 0:
+                tol_key[i] = -2  # unknown key: tolerates nothing present
+            tol_val[i] = v.taint_values.lookup(t.value)
+            tol_op[i] = enc.TOL_EXISTS if t.operator == api.TOLERATION_OP_EXISTS else enc.TOL_EQUAL
+            tol_effect[i] = enc.EFFECT_IDS.get(t.effect, 0)
+        d["tol_key"], d["tol_val"], d["tol_op"], d["tol_effect"] = (
+            tol_key, tol_val, tol_op, tol_effect)
+        # host ports
+        cports = api.get_container_ports(pod)
+        if len(cports) > self.snap.caps.PQ:
+            self.snap._grow(PQ=len(cports))
+        c = self.snap.caps
+        ports = np.zeros((c.PQ,), np.int32)
+        for i, p in enumerate(cports):
+            pid = v.lookup_port(p.protocol, p.host_port)
+            ports[i] = pid if pid > 0 else 0  # unknown port id: no node uses it
+        d["ports"] = ports
+        # spreading selectors (over pod-label space)
+        d["ns_id"] = np.int32(v.namespaces.intern(pod.namespace))
+        sels = self.group_selectors(pod)
+        while True:
+            c = self.snap.caps
+            if len(sels) > c.SG:
+                self.snap._grow(SG=len(sels))
+                continue
+            sg_valid = np.zeros((c.SG,), bool)
+            sg_key = np.zeros((c.SG, c.SE), np.int32)
+            sg_op = np.full((c.SG, c.SE), enc.OP_PAD, np.int32)
+            sg_vals = np.full((c.SG, c.SE, c.SV), -1, np.int32)
+            sg_num = np.full((c.SG, c.SE), np.nan, np.float32)
+            ok = True
+            for si, sel in enumerate(sels):
+                prog = self._compile_reqs(sel.requirements, v.pod_label_keys,
+                                          c.SE, c.SV, node_space=False)
+                if prog is None:
+                    self.snap._grow(SE=len(sel.requirements),
+                                    SV=max((len(r.values) for r in sel.requirements), default=0))
+                    ok = False
+                    break
+                sg_valid[si] = True
+                sg_key[si], sg_op[si], sg_vals[si], sg_num[si] = prog
+            if ok:
+                break
+        d["sg_valid"], d["sg_key"], d["sg_op"], d["sg_vals"], d["sg_num"] = (
+            sg_valid, sg_key, sg_op, sg_vals, sg_num)
+        # misc
+        d["owned"] = np.bool_(any(
+            ref.controller and ref.kind in ("ReplicationController", "ReplicaSet")
+            for ref in pod.metadata.owner_references))
+        imgs = [img for ctr in pod.spec.containers for img in ([getattr(ctr, "image", "")] if getattr(ctr, "image", "") else [])]
+        c = self.snap.caps
+        img_id = np.zeros((c.PI,), np.int32)
+        for i, name in enumerate(imgs[: c.PI]):
+            img_id[i] = v.images.lookup(name)
+        d["img_id"] = img_id
+        d["prio"] = np.int32(api.pod_priority(pod))
+        return d
+
+    # -- batch ----------------------------------------------------------------
+
+    def featurize(self, pods: Sequence[api.Pod]) -> enc.PodBatch:
+        c0 = self.snap.caps
+        P = bucket_size(max(len(pods), 1), c0.P)
+        if P > c0.P:
+            self.snap.caps.P = P
+        ver = self.vocabs.version()
+        rows: List[Dict[str, np.ndarray]] = []
+        for pod in pods:
+            sig = equivalence_class(pod)
+            cached = self._cache.get(sig) if sig else None
+            if cached is not None and cached.vocab_version == ver and self._caps_match(cached.data):
+                d = cached.data
+            else:
+                d = self._featurize_pod(pod)
+                ver = self.vocabs.version()  # may have grown during featurize
+                if sig:
+                    self._cache[sig] = _PodRow(d, pod.spec.node_name, ver)
+            rows.append(d)
+        # capacities may have grown while featurizing later pods: recompute
+        # any row that no longer matches current caps
+        for i, (pod, d) in enumerate(zip(pods, rows)):
+            if not self._caps_match(d):
+                rows[i] = self._featurize_pod(pod)
+                sig = equivalence_class(pod)
+                if sig:
+                    self._cache[sig] = _PodRow(rows[i], pod.spec.node_name, self.vocabs.version())
+        c = self.snap.caps
+        P = bucket_size(max(len(pods), 1), c.P)
+
+        def stack(name, shape, dtype, fill=0):
+            out = np.full((P,) + shape, fill, dtype)
+            for i, d in enumerate(rows):
+                out[i] = d[name]
+            return out
+
+        host_idx = np.full((P,), -1, np.int32)
+        for i, pod in enumerate(pods):
+            if pod.spec.node_name:
+                # -2: pinned to a node we don't know -> matches NO node
+                # (reference PodFitsHost fails everywhere, predicates.go:825);
+                # -1 means "no nodeName constraint".
+                host_idx[i] = self.snap.node_index.get(pod.spec.node_name, -2)
+        batch = enc.PodBatch(
+            req=stack("req", (c.R,), np.float32),
+            nonzero=stack("nonzero", (2,), np.float32),
+            best_effort=stack("best_effort", (), bool),
+            host_idx=host_idx,
+            ns_key=stack("ns_key", (c.NS,), np.int32),
+            ns_val=stack("ns_val", (c.NS,), np.int32, -1),
+            has_aff=stack("has_aff", (), bool),
+            at_valid=stack("at_valid", (c.AT,), bool),
+            at_key=stack("at_key", (c.AT, c.AE), np.int32),
+            at_op=stack("at_op", (c.AT, c.AE), np.int32, enc.OP_PAD),
+            at_vals=stack("at_vals", (c.AT, c.AE, c.AV), np.int32, -1),
+            at_num=stack("at_num", (c.AT, c.AE), np.float32, np.nan),
+            pt_weight=stack("pt_weight", (c.PT,), np.float32),
+            pt_key=stack("pt_key", (c.PT, c.AE), np.int32),
+            pt_op=stack("pt_op", (c.PT, c.AE), np.int32, enc.OP_PAD),
+            pt_vals=stack("pt_vals", (c.PT, c.AE, c.AV), np.int32, -1),
+            pt_num=stack("pt_num", (c.PT, c.AE), np.float32, np.nan),
+            tol_key=stack("tol_key", (c.TL,), np.int32),
+            tol_val=stack("tol_val", (c.TL,), np.int32, -1),
+            tol_op=stack("tol_op", (c.TL,), np.int32, enc.TOL_PAD),
+            tol_effect=stack("tol_effect", (c.TL,), np.int32),
+            ports=stack("ports", (c.PQ,), np.int32),
+            ns_id=stack("ns_id", (), np.int32),
+            sg_valid=stack("sg_valid", (c.SG,), bool),
+            sg_key=stack("sg_key", (c.SG, c.SE), np.int32),
+            sg_op=stack("sg_op", (c.SG, c.SE), np.int32, enc.OP_PAD),
+            sg_vals=stack("sg_vals", (c.SG, c.SE, c.SV), np.int32, -1),
+            sg_num=stack("sg_num", (c.SG, c.SE), np.float32, np.nan),
+            owned=stack("owned", (), bool),
+            img_id=stack("img_id", (c.PI,), np.int32),
+            prio=stack("prio", (), np.int32),
+            valid=np.arange(P) < len(pods),
+        )
+        return batch
+
+    def _caps_match(self, d: Dict[str, np.ndarray]) -> bool:
+        c = self.snap.caps
+        return (
+            d["req"].shape == (c.R,)
+            and d["ns_key"].shape == (c.NS,)
+            and d["at_key"].shape == (c.AT, c.AE)
+            and d["at_vals"].shape == (c.AT, c.AE, c.AV)
+            and d["pt_key"].shape == (c.PT, c.AE)
+            and d["tol_key"].shape == (c.TL,)
+            and d["ports"].shape == (c.PQ,)
+            and d["sg_key"].shape == (c.SG, c.SE)
+            and d["sg_vals"].shape == (c.SG, c.SE, c.SV)
+        )

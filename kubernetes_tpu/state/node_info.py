@@ -1,0 +1,220 @@
+"""Host-side exact per-node accounting.
+
+Analog of the reference's NodeInfo (pkg/scheduler/schedulercache/
+node_info.go:40-78): the denormalized int64 aggregate every predicate
+and priority reads. In this framework it plays two roles:
+  1. the exact (int64) source of truth that featurization reads when
+     building the HBM tensor snapshot, and
+  2. the final-commit verifier — the device kernel's picks are re-checked
+     against NodeInfo before binding, so float32 device arithmetic can
+     never place a pod that does not exactly fit (SURVEY.md §7).
+
+The `generation` counter (reference: node_info.go:89 nextGeneration) is
+the dirty bit driving incremental tensor updates.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api import resources as res
+from ..api import types as api
+
+_generation = itertools.count(1)
+
+
+def next_generation() -> int:
+    return next(_generation)
+
+
+class Resource:
+    """int64 resource vector (reference: node_info.go:131 Resource)."""
+
+    __slots__ = ("milli_cpu", "memory", "ephemeral_storage", "allowed_pod_number", "scalars")
+
+    def __init__(self, milli_cpu=0, memory=0, ephemeral_storage=0, allowed_pod_number=0, scalars=None):
+        self.milli_cpu = milli_cpu
+        self.memory = memory
+        self.ephemeral_storage = ephemeral_storage
+        self.allowed_pod_number = allowed_pod_number
+        self.scalars: Dict[str, int] = dict(scalars or {})
+
+    @staticmethod
+    def from_map(m: Dict[str, int]) -> "Resource":
+        r = Resource()
+        for name, q in m.items():
+            if name == res.CPU:
+                r.milli_cpu = q
+            elif name == res.MEMORY:
+                r.memory = q
+            elif name == res.EPHEMERAL_STORAGE:
+                r.ephemeral_storage = q
+            elif name == res.PODS:
+                r.allowed_pod_number = q
+            else:
+                r.scalars[name] = q
+        return r
+
+    def add_map(self, m: Dict[str, int], sign: int = 1):
+        for name, q in m.items():
+            if name == res.CPU:
+                self.milli_cpu += sign * q
+            elif name == res.MEMORY:
+                self.memory += sign * q
+            elif name == res.EPHEMERAL_STORAGE:
+                self.ephemeral_storage += sign * q
+            elif name == res.PODS:
+                pass  # pod count tracked by len(pods)
+            else:
+                self.scalars[name] = self.scalars.get(name, 0) + sign * q
+
+    def clone(self) -> "Resource":
+        return Resource(self.milli_cpu, self.memory, self.ephemeral_storage,
+                        self.allowed_pod_number, dict(self.scalars))
+
+
+class NodeInfo:
+    """Aggregated node state (reference: node_info.go:40)."""
+
+    def __init__(self, node: Optional[api.Node] = None):
+        self.node: Optional[api.Node] = None
+        self.pods: List[api.Pod] = []
+        self.pods_with_affinity: List[api.Pod] = []
+        self.requested = Resource()
+        self.nonzero_milli_cpu = 0
+        self.nonzero_memory = 0
+        self.allocatable = Resource()
+        self.taints: List[api.Taint] = []
+        self.memory_pressure = False
+        self.disk_pressure = False
+        self.pid_pressure = False
+        self.used_ports: Set[Tuple[str, str, int]] = set()  # (proto, hostIP, port)
+        self.image_sizes: Dict[str, int] = {}
+        self.generation = next_generation()
+        if node is not None:
+            self.set_node(node)
+
+    # -- node ----------------------------------------------------------------
+
+    def set_node(self, node: api.Node):
+        """Reference: node_info.go:551 SetNode."""
+        self.node = node
+        self.allocatable = Resource.from_map(node.status.allocatable)
+        self.taints = list(node.spec.taints)
+        self.memory_pressure = self._cond(node, api.NODE_MEMORY_PRESSURE) == api.COND_TRUE
+        self.disk_pressure = self._cond(node, api.NODE_DISK_PRESSURE) == api.COND_TRUE
+        self.pid_pressure = self._cond(node, api.NODE_PID_PRESSURE) == api.COND_TRUE
+        self.image_sizes = {
+            name: img.size_bytes for img in node.status.images for name in img.names
+        }
+        self.generation = next_generation()
+
+    @staticmethod
+    def _cond(node: api.Node, cond_type: str) -> str:
+        for c in node.status.conditions:
+            if c.type == cond_type:
+                return c.status
+        return ""
+
+    # -- pods ----------------------------------------------------------------
+
+    def add_pod(self, pod: api.Pod):
+        """Reference: node_info.go:431 AddPod."""
+        req = api.get_resource_request(pod)
+        self.requested.add_map(req, +1)
+        nz_cpu, nz_mem = api.get_nonzero_requests(pod)
+        self.nonzero_milli_cpu += nz_cpu
+        self.nonzero_memory += nz_mem
+        self.pods.append(pod)
+        if _has_pod_affinity(pod):
+            self.pods_with_affinity.append(pod)
+        for p in api.get_container_ports(pod):
+            self.used_ports.add((p.protocol, p.host_ip or "0.0.0.0", p.host_port))
+        self.generation = next_generation()
+
+    def remove_pod(self, pod: api.Pod) -> bool:
+        """Reference: node_info.go:456 RemovePod. Returns False if absent."""
+        for i, p in enumerate(self.pods):
+            if p.uid == pod.uid:
+                del self.pods[i]
+                break
+        else:
+            return False
+        self.pods_with_affinity = [p for p in self.pods_with_affinity if p.uid != pod.uid]
+        req = api.get_resource_request(pod)
+        self.requested.add_map(req, -1)
+        nz_cpu, nz_mem = api.get_nonzero_requests(pod)
+        self.nonzero_milli_cpu -= nz_cpu
+        self.nonzero_memory -= nz_mem
+        # Rebuild ports (another pod may still hold the same (proto,ip,port)).
+        self.used_ports = {
+            (cp.protocol, cp.host_ip or "0.0.0.0", cp.host_port)
+            for q in self.pods
+            for cp in api.get_container_ports(q)
+        }
+        self.generation = next_generation()
+        return True
+
+    # -- exact feasibility recheck (commit-time guard) ------------------------
+
+    def fits_exactly(self, pod: api.Pod) -> bool:
+        """Exact int64 re-verification of PodFitsResources + PodFitsHostPorts
+        for one (pod, node) pair (reference: predicates.go:688, :991). Used
+        to guard device float32 picks at commit time."""
+        if self.node is None:
+            return False
+        if len(self.pods) + 1 > self.allocatable.allowed_pod_number:
+            return False
+        req = api.get_resource_request(pod)
+        r = Resource.from_map(req)
+        if r.milli_cpu + self.requested.milli_cpu > self.allocatable.milli_cpu:
+            return False
+        if r.memory + self.requested.memory > self.allocatable.memory:
+            return False
+        if r.ephemeral_storage + self.requested.ephemeral_storage > self.allocatable.ephemeral_storage:
+            return False
+        for name, q in r.scalars.items():
+            if q + self.requested.scalars.get(name, 0) > self.allocatable.scalars.get(name, 0):
+                return False
+        for cp in api.get_container_ports(pod):
+            if _ports_conflict(self.used_ports, (cp.protocol, cp.host_ip or "0.0.0.0", cp.host_port)):
+                return False
+        return True
+
+    def clone(self) -> "NodeInfo":
+        ni = NodeInfo()
+        ni.node = self.node
+        ni.pods = list(self.pods)
+        ni.pods_with_affinity = list(self.pods_with_affinity)
+        ni.requested = self.requested.clone()
+        ni.nonzero_milli_cpu = self.nonzero_milli_cpu
+        ni.nonzero_memory = self.nonzero_memory
+        ni.allocatable = self.allocatable.clone()
+        ni.taints = list(self.taints)
+        ni.memory_pressure = self.memory_pressure
+        ni.disk_pressure = self.disk_pressure
+        ni.pid_pressure = self.pid_pressure
+        ni.used_ports = set(self.used_ports)
+        ni.image_sizes = dict(self.image_sizes)
+        ni.generation = self.generation
+        return ni
+
+
+def _has_pod_affinity(pod: api.Pod) -> bool:
+    a = pod.spec.affinity
+    return bool(a and (a.pod_affinity or a.pod_anti_affinity))
+
+
+def _ports_conflict(used: Set[Tuple[str, str, int]], want: Tuple[str, str, int]) -> bool:
+    """hostIP wildcard-aware conflict (reference: pkg/scheduler/util and
+    predicates.go:991 PodFitsHostPorts): 0.0.0.0 conflicts with any IP on
+    the same proto/port; a specific IP conflicts with the same IP or the
+    wildcard."""
+    proto, ip, port = want
+    for (uproto, uip, uport) in used:
+        if uproto != proto or uport != port:
+            continue
+        if ip == "0.0.0.0" or uip == "0.0.0.0" or uip == ip:
+            return True
+    return False

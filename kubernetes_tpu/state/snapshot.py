@@ -1,0 +1,367 @@
+"""Host->HBM cluster snapshot.
+
+Maintains the dense NodeTensors / PodMatrix arrays (ops/encoding.py) as
+numpy buffers, updated incrementally from scheduler events, and uploads
+dirty groups to the device per scheduling cycle. This replaces the
+reference's per-cycle `UpdateNodeNameToInfoMap` snapshot point
+(pkg/scheduler/core/generic_scheduler.go:124) — instead of copying a Go
+map, we keep the device mirror warm and re-upload only what changed.
+
+Dirtiness is tracked in three groups with very different change rates:
+  * resources  (requested/nonzero/pod_count)      — every bind
+  * topology   (labels/taints/conds/ports/images) — node lifecycle only
+  * pods       (the existing-pod matrix)          — every bind
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import types as api
+from ..ops import encoding as enc
+from .node_info import NodeInfo
+from .vocab import Interner, VocabSet, bucket_size
+
+
+def _parse_label_num(v: str) -> float:
+    try:
+        return float(int(v))
+    except (ValueError, TypeError):
+        return math.nan
+
+
+class Snapshot:
+    """Mutable numpy mirror + device cache."""
+
+    def __init__(self, vocabs: Optional[VocabSet] = None, caps: Optional[enc.Caps] = None):
+        self.vocabs = vocabs or VocabSet()
+        self.caps = caps or enc.Caps()
+        self.node_index: Dict[str, int] = {}
+        self.node_names: List[str] = []
+        self._free_nodes: List[int] = []
+        self.extended = self.vocabs.resources  # extended resource -> column - RES_FIXED + 1
+        self._alloc_nodes()
+        # existing-pod matrix
+        self.pod_slot: Dict[str, int] = {}
+        self._free_slots: List[int] = []
+        self._next_slot = 0
+        self._alloc_pods()
+        self.dirty_resources = True
+        self.dirty_topology = True
+        self.dirty_pods = True
+        self._device_cache: Dict[str, object] = {}
+
+    # ---- allocation / growth ----------------------------------------------
+
+    def _alloc_nodes(self):
+        c = self.caps
+        self.alloc = np.zeros((c.N, c.R), np.float32)
+        self.requested = np.zeros((c.N, c.R), np.float32)
+        self.nonzero = np.zeros((c.N, 2), np.float32)
+        self.pod_count = np.zeros((c.N,), np.int32)
+        self.allowed_pods = np.zeros((c.N,), np.int32)
+        self.labels = np.zeros((c.N, c.K), np.int32)
+        self.label_nums = np.full((c.N, c.K), np.nan, np.float32)
+        self.taint_key = np.zeros((c.N, c.T), np.int32)
+        self.taint_val = np.zeros((c.N, c.T), np.int32)
+        self.taint_effect = np.zeros((c.N, c.T), np.int32)
+        self.cond = np.zeros((c.N, enc.N_COND), bool)
+        self.ports = np.zeros((c.N, c.PP), np.int32)
+        self.zone_id = np.zeros((c.N,), np.int32)
+        self.img_id = np.zeros((c.N, c.NI), np.int32)
+        self.img_size = np.zeros((c.N, c.NI), np.float32)
+        self.avoid = np.zeros((c.N,), bool)
+        self.valid = np.zeros((c.N,), bool)
+
+    def _alloc_pods(self):
+        c = self.caps
+        self.ep_labels = np.zeros((c.M, c.KP), np.int32)
+        self.ep_ns = np.zeros((c.M,), np.int32)
+        self.ep_node = np.zeros((c.M,), np.int32)
+        self.ep_valid = np.zeros((c.M,), bool)
+        self.ep_alive = np.zeros((c.M,), bool)
+
+    def _grow(self, **dims):
+        """Grow capacity dims, preserving data. Triggers jit retrace."""
+        c = self.caps
+        for k, v in dims.items():
+            setattr(c, k, bucket_size(v, getattr(c, k)))
+
+        def pad(a, shape, fill=0):
+            out = np.full(shape, fill, a.dtype)
+            sl = tuple(slice(0, s) for s in a.shape)
+            out[sl] = a
+            return out
+
+        self.alloc = pad(self.alloc, (c.N, c.R))
+        self.requested = pad(self.requested, (c.N, c.R))
+        self.nonzero = pad(self.nonzero, (c.N, 2))
+        self.pod_count = pad(self.pod_count, (c.N,))
+        self.allowed_pods = pad(self.allowed_pods, (c.N,))
+        self.labels = pad(self.labels, (c.N, c.K))
+        self.label_nums = pad(self.label_nums, (c.N, c.K), np.nan)
+        self.taint_key = pad(self.taint_key, (c.N, c.T))
+        self.taint_val = pad(self.taint_val, (c.N, c.T))
+        self.taint_effect = pad(self.taint_effect, (c.N, c.T))
+        self.cond = pad(self.cond, (c.N, enc.N_COND))
+        self.ports = pad(self.ports, (c.N, c.PP))
+        self.zone_id = pad(self.zone_id, (c.N,))
+        self.img_id = pad(self.img_id, (c.N, c.NI))
+        self.img_size = pad(self.img_size, (c.N, c.NI))
+        self.avoid = pad(self.avoid, (c.N,))
+        self.valid = pad(self.valid, (c.N,))
+        self.ep_labels = pad(self.ep_labels, (c.M, c.KP))
+        self.ep_ns = pad(self.ep_ns, (c.M,))
+        self.ep_node = pad(self.ep_node, (c.M,))
+        self.ep_valid = pad(self.ep_valid, (c.M,))
+        self.ep_alive = pad(self.ep_alive, (c.M,))
+        self.dirty_resources = self.dirty_topology = self.dirty_pods = True
+
+    # ---- resource columns ---------------------------------------------------
+
+    def _res_col(self, name: str) -> int:
+        col = enc.RES_FIXED - 1 + self.extended.intern(name)
+        if col >= self.caps.R:
+            self._grow(R=col + 1)
+        return col
+
+    def _res_vec(self, r) -> np.ndarray:
+        """node_info.Resource -> f32 row of width caps.R."""
+        cols = [(self._res_col(name), q) for name, q in r.scalars.items()]
+        out = np.zeros((self.caps.R,), np.float32)  # after growth from _res_col
+        out[enc.RES_CPU] = r.milli_cpu
+        out[enc.RES_MEM] = r.memory
+        out[enc.RES_EPH] = r.ephemeral_storage
+        for col, q in cols:
+            out[col] = q
+        return out
+
+    # ---- node events --------------------------------------------------------
+
+    def ensure_node(self, name: str) -> int:
+        idx = self.node_index.get(name)
+        if idx is None:
+            if self._free_nodes:
+                idx = self._free_nodes.pop()
+                self.node_names[idx] = name
+            else:
+                idx = len(self.node_names)
+                if idx >= self.caps.N:
+                    self._grow(N=idx + 1)
+                self.node_names.append(name)
+            self.node_index[name] = idx
+        return idx
+
+    def set_node(self, ni: NodeInfo):
+        """Refresh a node's topology + allocatable row from its NodeInfo."""
+        node = ni.node
+        assert node is not None
+        idx = self.ensure_node(node.name)
+        v = self.vocabs
+        # labels
+        lbls = node.metadata.labels or {}
+        for key in lbls:
+            kid = v.label_keys.intern(key)
+            if kid >= self.caps.K:
+                self._grow(K=kid + 1)
+        self.labels[idx, :] = 0
+        self.label_nums[idx, :] = np.nan
+        for key, val in lbls.items():
+            kid = v.label_keys.intern(key)
+            self.labels[idx, kid] = v.label_values.intern(val)
+            self.label_nums[idx, kid] = _parse_label_num(val)
+        # taints
+        if len(ni.taints) > self.caps.T:
+            self._grow(T=len(ni.taints))
+        self.taint_key[idx, :] = 0
+        self.taint_val[idx, :] = 0
+        self.taint_effect[idx, :] = 0
+        for i, t in enumerate(ni.taints):
+            self.taint_key[idx, i] = v.taint_keys.intern(t.key)
+            self.taint_val[idx, i] = v.taint_values.intern(t.value)
+            self.taint_effect[idx, i] = enc.EFFECT_IDS[t.effect]
+        # conditions
+        # Reference iterates only *present* conditions (predicates.go:1591):
+        # a node that hasn't reported Ready at all is NOT rejected.
+        cond = NodeInfo._cond
+        ready = cond(node, api.NODE_READY)
+        self.cond[idx, enc.COND_NOT_READY] = ready not in ("", api.COND_TRUE)
+        self.cond[idx, enc.COND_OUT_OF_DISK] = (
+            cond(node, api.NODE_OUT_OF_DISK) not in ("", api.COND_FALSE)
+        )
+        self.cond[idx, enc.COND_NET_UNAVAIL] = (
+            cond(node, api.NODE_NETWORK_UNAVAILABLE) not in ("", api.COND_FALSE)
+        )
+        self.cond[idx, enc.COND_UNSCHEDULABLE] = node.spec.unschedulable
+        self.cond[idx, enc.COND_MEM_PRESSURE] = ni.memory_pressure
+        self.cond[idx, enc.COND_DISK_PRESSURE] = ni.disk_pressure
+        self.cond[idx, enc.COND_PID_PRESSURE] = ni.pid_pressure
+        # allocatable
+        self.alloc[idx, :] = self._res_vec(ni.allocatable)
+        self.allowed_pods[idx] = ni.allocatable.allowed_pod_number
+        # zone
+        zk = api.get_zone_key(node)
+        zid = v.zones.intern(zk) if zk else 0
+        if zid >= self.caps.Z:
+            self._grow(Z=zid + 1)
+        self.zone_id[idx] = zid
+        # images
+        imgs = list(ni.image_sizes.items())
+        if len(imgs) > self.caps.NI:
+            imgs = imgs[: self.caps.NI]  # overflow images simply don't score
+        self.img_id[idx, :] = 0
+        self.img_size[idx, :] = 0.0
+        for i, (name_, sz) in enumerate(imgs):
+            self.img_id[idx, i] = v.images.intern(name_)
+            self.img_size[idx, i] = sz
+        # prefer-avoid annotation (simplified: presence only; see ops/scores.py)
+        self.avoid[idx] = "scheduler.alpha.kubernetes.io/preferAvoidPods" in (
+            node.metadata.annotations or {}
+        )
+        self.valid[idx] = True
+        self.refresh_node_resources(ni)
+        self.dirty_topology = True
+
+    def remove_node(self, name: str):
+        idx = self.node_index.pop(name, None)
+        if idx is not None:
+            self.valid[idx] = False
+            self._free_nodes.append(idx)
+            # Drop this node's rows from the pod matrix so a future node
+            # reusing the index doesn't inherit ghost pods in spreading.
+            stale = (self.ep_node == idx) & self.ep_valid
+            if stale.any():
+                self.ep_valid[stale] = False
+                self.ep_alive[stale] = False
+                for uid, slot in list(self.pod_slot.items()):
+                    if stale[slot]:
+                        del self.pod_slot[uid]
+                        self._free_slots.append(slot)
+                self.dirty_pods = True
+            self.dirty_topology = True
+
+    def refresh_node_resources(self, ni: NodeInfo):
+        """Fast path run on every (un)bind: just the resource aggregates."""
+        if ni.node is None:
+            return
+        idx = self.node_index.get(ni.node.name)
+        if idx is None:
+            return
+        self.requested[idx, :] = self._res_vec(ni.requested)
+        self.nonzero[idx, 0] = ni.nonzero_milli_cpu
+        self.nonzero[idx, 1] = ni.nonzero_memory
+        self.pod_count[idx] = len(ni.pods)
+        # used host ports
+        up = list(ni.used_ports)
+        if len(up) > self.caps.PP:
+            self._grow(PP=len(up))
+        self.ports[idx, :] = 0
+        for i, (proto, _ip, port) in enumerate(up):
+            self.ports[idx, i] = self.vocabs.port_id(proto, port)
+        self.dirty_resources = True
+
+    # ---- existing-pod matrix ------------------------------------------------
+
+    def add_pod(self, pod: api.Pod):
+        """Add/refresh a scheduled pod's row in the PodMatrix."""
+        node_idx = self.node_index.get(pod.spec.node_name)
+        if node_idx is None:
+            return
+        v = self.vocabs
+        slot = self.pod_slot.get(pod.uid)
+        if slot is None:
+            if self._free_slots:
+                slot = self._free_slots.pop()
+            else:
+                slot = self._next_slot
+                self._next_slot += 1
+                if slot >= self.caps.M:
+                    self._grow(M=slot + 1)
+            self.pod_slot[pod.uid] = slot
+        for key in pod.metadata.labels or {}:
+            kid = v.pod_label_keys.intern(key)
+            if kid >= self.caps.KP:
+                self._grow(KP=kid + 1)
+        self.ep_labels[slot, :] = 0
+        for key, val in (pod.metadata.labels or {}).items():
+            self.ep_labels[slot, v.pod_label_keys.intern(key)] = v.label_values.intern(val)
+        self.ep_ns[slot] = v.namespaces.intern(pod.namespace)
+        self.ep_node[slot] = node_idx
+        self.ep_valid[slot] = True
+        self.ep_alive[slot] = pod.metadata.deletion_timestamp is None
+        self.dirty_pods = True
+
+    def remove_pod(self, pod: api.Pod):
+        slot = self.pod_slot.pop(pod.uid, None)
+        if slot is not None:
+            self.ep_valid[slot] = False
+            self.ep_alive[slot] = False
+            self._free_slots.append(slot)
+            self.dirty_pods = True
+
+    # ---- device views -------------------------------------------------------
+
+    def node_tensors(self) -> enc.NodeTensors:
+        return enc.NodeTensors(
+            alloc=self.alloc, requested=self.requested, nonzero=self.nonzero,
+            pod_count=self.pod_count, allowed_pods=self.allowed_pods,
+            labels=self.labels, label_nums=self.label_nums,
+            taint_key=self.taint_key, taint_val=self.taint_val,
+            taint_effect=self.taint_effect, cond=self.cond, ports=self.ports,
+            zone_id=self.zone_id, img_id=self.img_id, img_size=self.img_size,
+            avoid=self.avoid, valid=self.valid,
+        )
+
+    def pod_matrix(self) -> enc.PodMatrix:
+        return enc.PodMatrix(
+            labels=self.ep_labels, ns=self.ep_ns, node=self.ep_node,
+            valid=self.ep_valid, alive=self.ep_alive,
+        )
+
+    def to_device(self, device=None) -> Tuple[enc.NodeTensors, enc.PodMatrix]:
+        """Upload dirty groups; reuse cached device arrays otherwise."""
+        import jax
+
+        cache = self._device_cache
+        shapes_key = (self.caps.N, self.caps.K, self.caps.KP, self.caps.R,
+                      self.caps.T, self.caps.PP, self.caps.NI, self.caps.M)
+        if cache.get("shapes") != shapes_key:
+            cache.clear()
+            cache["shapes"] = shapes_key
+            self.dirty_resources = self.dirty_topology = self.dirty_pods = True
+        if self.dirty_resources or "res" not in cache:
+            cache["res"] = jax.device_put(
+                (self.requested, self.nonzero, self.pod_count, self.ports), device
+            )
+            self.dirty_resources = False
+        if self.dirty_topology or "topo" not in cache:
+            cache["topo"] = jax.device_put(
+                (self.alloc, self.allowed_pods, self.labels, self.label_nums,
+                 self.taint_key, self.taint_val, self.taint_effect, self.cond,
+                 self.zone_id, self.img_id, self.img_size, self.avoid, self.valid),
+                device,
+            )
+            self.dirty_topology = False
+        if self.dirty_pods or "pods" not in cache:
+            cache["pods"] = jax.device_put(
+                (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid, self.ep_alive),
+                device,
+            )
+            self.dirty_pods = False
+        requested, nonzero, pod_count, ports = cache["res"]
+        (alloc, allowed_pods, labels, label_nums, taint_key, taint_val,
+         taint_effect, cond, zone_id, img_id, img_size, avoid, valid) = cache["topo"]
+        ep_labels, ep_ns, ep_node, ep_valid, ep_alive = cache["pods"]
+        nt = enc.NodeTensors(
+            alloc=alloc, requested=requested, nonzero=nonzero,
+            pod_count=pod_count, allowed_pods=allowed_pods, labels=labels,
+            label_nums=label_nums, taint_key=taint_key, taint_val=taint_val,
+            taint_effect=taint_effect, cond=cond, ports=ports, zone_id=zone_id,
+            img_id=img_id, img_size=img_size, avoid=avoid, valid=valid,
+        )
+        pm = enc.PodMatrix(labels=ep_labels, ns=ep_ns, node=ep_node,
+                           valid=ep_valid, alive=ep_alive)
+        return nt, pm

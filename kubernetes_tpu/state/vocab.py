@@ -1,0 +1,91 @@
+"""String interning vocabularies.
+
+The tensor snapshot (state/snapshot.py) encodes label keys/values, taint
+triples, ports, namespaces and spreading groups as dense integer ids.
+Interners are append-only so ids are stable for the lifetime of a
+scheduler process; tensor shapes derived from vocab sizes are bucketed
+to powers of two to keep XLA jit cache hits high (SURVEY.md §7 hard
+part (e): recompilation pressure).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two (>= minimum) so jit shapes stay stable."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class Interner:
+    """Append-only string -> id map. Id 0 is reserved for "absent"/pad."""
+
+    __slots__ = ("_ids", "_strings")
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+        self._strings: List[str] = ["\x00<pad>"]
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._strings)
+            self._ids[s] = i
+            self._strings.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Id of s, or -1 if never interned. -1 never matches any stored id,
+        which encodes "this selector value matches nothing here yet"."""
+        return self._ids.get(s, -1)
+
+    def string(self, i: int) -> str:
+        return self._strings[i]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    @property
+    def size(self) -> int:
+        return len(self._strings)
+
+
+class VocabSet:
+    """All vocabularies used by the tensor encoding."""
+
+    def __init__(self):
+        self.label_keys = Interner()
+        self.label_values = Interner()  # global value vocab (shared across keys)
+        self.taint_keys = Interner()
+        self.taint_values = Interner()
+        self.resources = Interner()  # extended resource names (snapshot columns)
+        self.ports = Interner()  # "proto/port" strings
+        self.namespaces = Interner()
+        self.zones = Interner()  # GetZoneKey strings
+        self.images = Interner()  # container image names
+        self.pod_label_keys = Interner()  # pod-label key space (ep matrix)
+
+    def version(self) -> tuple:
+        """Sizes of the vocabs selector compilation reads; featurizer caches
+        are invalidated when this changes (a -1 'unknown value' lookup may
+        have become valid)."""
+        return (
+            self.label_keys.size,
+            self.label_values.size,
+            self.taint_keys.size,
+            self.taint_values.size,
+            self.pod_label_keys.size,
+        )
+
+    def intern_label(self, key: str, value: str) -> tuple:
+        return self.label_keys.intern(key), self.label_values.intern(value)
+
+    def port_id(self, protocol: str, port: int) -> int:
+        return self.ports.intern(f"{protocol}/{port}")
+
+    def lookup_port(self, protocol: str, port: int) -> int:
+        return self.ports.lookup(f"{protocol}/{port}")

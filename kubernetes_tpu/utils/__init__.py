@@ -1,0 +1,4 @@
+from .trace import Trace  # noqa: F401
+from .metrics import Metrics, Histogram, Counter  # noqa: F401
+from .backoff import PodBackoff  # noqa: F401
+from .feature_gates import FeatureGates, DEFAULT_FEATURES  # noqa: F401

@@ -1,0 +1,57 @@
+"""Per-pod exponential backoff (reference: pkg/scheduler/util/
+backoff_utils.go:97-112 — 1s initial, doubling, 60s max, entries GC'd
+after 2*maxDuration of idleness)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Tuple
+
+
+class _Entry:
+    __slots__ = ("duration", "last_update")
+
+    def __init__(self, duration: float, now: float):
+        self.duration = duration
+        self.last_update = now
+
+
+class PodBackoff:
+    def __init__(self, initial: float = 1.0, maximum: float = 60.0,
+                 clock=time.monotonic):
+        self.initial = initial
+        self.maximum = maximum
+        self.clock = clock
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+
+    def get_backoff(self, pod_id: str) -> float:
+        """Current duration, doubling it for next time (reference
+        getBackoffTime + BackoffPod)."""
+        now = self.clock()
+        with self._lock:
+            e = self._entries.get(pod_id)
+            if e is None:
+                e = _Entry(self.initial, now)
+                self._entries[pod_id] = e
+                return e.duration
+            d = e.duration
+            e.duration = min(e.duration * 2, self.maximum)
+            e.last_update = now
+            return d
+
+    def try_wait(self, pod_id: str) -> float:
+        return self.get_backoff(pod_id)
+
+    def clear(self, pod_id: str):
+        with self._lock:
+            self._entries.pop(pod_id, None)
+
+    def gc(self):
+        """Drop entries idle for > 2*maximum (reference Gc())."""
+        now = self.clock()
+        with self._lock:
+            for k in list(self._entries):
+                if now - self._entries[k].last_update > 2 * self.maximum:
+                    del self._entries[k]

@@ -1,0 +1,88 @@
+"""Prometheus-style metrics registry.
+
+Analog of pkg/scheduler/metrics/metrics.go:30-87 — the same series names
+are registered so dashboards built against the reference carry over:
+e2e_scheduling_latency, scheduling_algorithm_latency,
+scheduling_algorithm_predicate_evaluation,
+scheduling_algorithm_priority_evaluation,
+scheduling_algorithm_preemption_evaluation, binding_latency,
+pod_preemption_victims, total_preemption_attempts.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, delta: float = 1.0):
+        with self._lock:
+            self.value += delta
+
+
+class Histogram:
+    """Fixed-bucket histogram (reference uses exponential buckets starting
+    at 1ms: prometheus.ExponentialBuckets(1000, 2, 15) in microseconds)."""
+
+    def __init__(self, name: str, help_: str = "", buckets: Optional[List[float]] = None):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets or [0.001 * (2**i) for i in range(15)]
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self.sum += v
+            self.total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from buckets (upper bound of the bucket)."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            target = q * self.total
+            acc = 0
+            for i, b in enumerate(self.buckets):
+                acc += self.counts[i]
+                if acc >= target:
+                    return b
+            return math.inf
+
+
+class Metrics:
+    """Registry with the reference scheduler's series pre-registered."""
+
+    def __init__(self):
+        self.e2e_scheduling_latency = Histogram("e2e_scheduling_latency")
+        self.scheduling_algorithm_latency = Histogram("scheduling_algorithm_latency")
+        self.predicate_evaluation = Histogram("scheduling_algorithm_predicate_evaluation")
+        self.priority_evaluation = Histogram("scheduling_algorithm_priority_evaluation")
+        self.preemption_evaluation = Histogram("scheduling_algorithm_preemption_evaluation")
+        self.binding_latency = Histogram("binding_latency")
+        self.pod_preemption_victims = Counter("pod_preemption_victims")
+        self.total_preemption_attempts = Counter("total_preemption_attempts")
+        self.schedule_attempts = Counter("schedule_attempts_total")
+        self.pods_scheduled = Counter("pods_scheduled_total")
+        self.pods_failed = Counter("pods_failed_total")
+
+    def all_series(self):
+        return {
+            k: v for k, v in vars(self).items()
+            if isinstance(v, (Counter, Histogram))
+        }

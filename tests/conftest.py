@@ -1,0 +1,19 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+# exercised without TPU hardware (the driver validates the real-TPU path
+# separately via __graft_entry__.py / bench.py).
+#
+# The environment pins JAX_PLATFORMS=axon and the axon sitecustomize
+# imports jax at interpreter startup, so jax's config has already
+# snapshotted "axon" — setting os.environ here is too late. Update the
+# live config instead (backends are initialized lazily, so this works as
+# long as no device op ran yet).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,74 @@
+"""Shared fixture builders for tests (analog of the reference's
+pkg/scheduler/testing fakes)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+
+
+def make_node(
+    name: str,
+    cpu="4",
+    memory="8Gi",
+    pods=110,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[api.Taint]] = None,
+    unschedulable: bool = False,
+    conditions: Optional[List[api.NodeCondition]] = None,
+    **kw,
+) -> api.Node:
+    alloc = api.resource_list(cpu=cpu, memory=memory, pods=pods,
+                              ephemeral_storage=kw.pop("ephemeral_storage", "100Gi"),
+                              **kw)
+    conds = conditions if conditions is not None else [
+        api.NodeCondition(api.NODE_READY, api.COND_TRUE)
+    ]
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=api.NodeSpec(unschedulable=unschedulable, taints=list(taints or [])),
+        status=api.NodeStatus(capacity=dict(alloc), allocatable=alloc, conditions=conds),
+    )
+
+
+def make_pod(
+    name: str,
+    cpu=None,
+    memory=None,
+    namespace="default",
+    labels: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    node_selector: Optional[Dict[str, str]] = None,
+    affinity: Optional[api.Affinity] = None,
+    tolerations: Optional[List[api.Toleration]] = None,
+    ports: Optional[List[int]] = None,
+    priority: Optional[int] = None,
+    owner_uid: str = "",
+    owner_kind: str = "ReplicaSet",
+    **kw,
+) -> api.Pod:
+    reqs = {}
+    if cpu is not None or memory is not None or kw:
+        reqs = api.resource_list(cpu=cpu, memory=memory, **kw)
+    container = api.Container(
+        name="c",
+        resources=api.ResourceRequirements(requests=reqs),
+        ports=[api.ContainerPort(container_port=p, host_port=p) for p in (ports or [])],
+    )
+    owners = []
+    if owner_uid:
+        owners = [api.OwnerReference(kind=owner_kind, name=owner_uid,
+                                     uid=owner_uid, controller=True)]
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=namespace,
+                                labels=dict(labels or {}), owner_references=owners),
+        spec=api.PodSpec(
+            node_name=node_name,
+            node_selector=dict(node_selector or {}),
+            affinity=affinity,
+            tolerations=list(tolerations or []),
+            containers=[container],
+            priority=priority,
+        ),
+    )

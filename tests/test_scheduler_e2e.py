@@ -1,0 +1,122 @@
+"""End-to-end scheduler tests: store -> informers -> queue -> wave ->
+assume -> bind (analog of the reference's test/integration/scheduler/)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+
+from helpers import make_node, make_pod
+
+
+def make_world(n_nodes=4, **node_kw):
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=16)
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", **node_kw))
+    return store, sched
+
+
+def test_end_to_end_bind():
+    store, sched = make_world(4)
+    for i in range(6):
+        store.create("pods", make_pod(f"p{i}", cpu="500m", memory="512Mi"))
+    placed = sched.schedule_pending()
+    assert placed == 6
+    for i in range(6):
+        pod = store.get("pods", "default", f"p{i}")
+        assert pod.spec.node_name, f"pod p{i} not bound"
+    # cache confirmed the binds (assume -> informer add path)
+    assert sched.cache.pod_count() == 6
+    assert not any(sched.cache.is_assumed(store.get("pods", "default", f"p{i}"))
+                   for i in range(6))
+
+
+def test_unschedulable_goes_to_backoff_queue():
+    store, sched = make_world(2, cpu="1")
+    store.create("pods", make_pod("big", cpu="4"))
+    placed = sched.schedule_pending()
+    assert placed == 0
+    assert sched.queue.pending_count() == 1
+    assert sched.queue.active_count() == 0  # parked unschedulable
+    pod = store.get("pods", "default", "big")
+    assert pod.spec.node_name == ""
+    # a new node event flushes the unschedulable queue
+    store.create("nodes", make_node("bignode", cpu="8"))
+    assert sched.queue.active_count() == 1
+    assert sched.schedule_pending() == 1
+    assert store.get("pods", "default", "big").spec.node_name == "bignode"
+
+
+def test_wave_sees_own_commitments():
+    # 3 nodes x 2 cpu; six 1-cpu pods must land exactly 2 per node
+    store, sched = make_world(3, cpu="2", memory="16Gi")
+    for i in range(6):
+        store.create("pods", make_pod(f"p{i}", cpu="1"))
+    assert sched.schedule_pending() == 6
+    from collections import Counter
+
+    c = Counter(store.get("pods", "default", f"p{i}").spec.node_name
+                for i in range(6))
+    assert all(v == 2 for v in c.values()), c
+
+
+def test_pod_deletion_frees_capacity():
+    store, sched = make_world(1, cpu="2")
+    store.create("pods", make_pod("a", cpu="2"))
+    assert sched.schedule_pending() == 1
+    store.create("pods", make_pod("b", cpu="2"))
+    assert sched.schedule_pending() == 0
+    store.delete("pods", "default", "a")
+    # deletion event moves unschedulable pods back to active
+    assert sched.queue.active_count() == 1
+    assert sched.schedule_pending() == 1
+    assert store.get("pods", "default", "b").spec.node_name == "n0"
+
+
+def test_priority_order_within_wave():
+    store, sched = make_world(1, cpu="1")
+    store.create("pods", make_pod("low", cpu="1", priority=1))
+    store.create("pods", make_pod("high", cpu="1", priority=100))
+    sched.schedule_pending()
+    assert store.get("pods", "default", "high").spec.node_name == "n0"
+    assert store.get("pods", "default", "low").spec.node_name == ""
+
+
+def test_preemption():
+    store, sched = make_world(1, cpu="2")
+    store.create("pods", make_pod("victim", cpu="2", priority=1))
+    assert sched.schedule_pending() == 1
+    store.create("pods", make_pod("vip", cpu="2", priority=100))
+    # synchronous store: eviction + nomination events land inside the same
+    # schedule_pending loop, so vip preempts AND binds here
+    sched.schedule_pending()
+    assert store.get("pods", "default", "victim") is None
+    vip = store.get("pods", "default", "vip")
+    assert vip.status.nominated_node_name == "n0"
+    assert vip.spec.node_name == "n0"
+
+
+def test_preemption_respects_priority_order_of_victims():
+    store, sched = make_world(1, cpu="2")
+    store.create("pods", make_pod("cheap", cpu="1", priority=1))
+    store.create("pods", make_pod("mid", cpu="1", priority=50))
+    assert sched.schedule_pending() == 2
+    store.create("pods", make_pod("vip", cpu="1", priority=100))
+    sched.schedule_pending()
+    # only the cheapest pod needed eviction
+    assert store.get("pods", "default", "cheap") is None
+    assert store.get("pods", "default", "mid") is not None
+
+
+def test_no_preemption_for_unresolvable_failure():
+    store, sched = make_world(2, cpu="2")
+    store.create("pods", make_pod("existing", cpu="1", priority=1))
+    sched.schedule_pending()
+    # selector can't match any node: preemption must not evict anything
+    store.create("pods", make_pod("picky", cpu="1", priority=100,
+                                  node_selector={"nope": "nope"}))
+    sched.schedule_pending()
+    assert store.get("pods", "default", "existing") is not None
+    assert store.get("pods", "default", "picky").status.nominated_node_name == ""
