@@ -1,0 +1,116 @@
+"""Scheduler throughput benchmark — scheduler_perf density analog.
+
+Reproduces the reference's TestSchedule100Node3KPods shape
+(test/integration/scheduler_perf/scheduler_test.go:68 schedulePods:127):
+N fake nodes are registered, P pods are created, and we measure the
+sustained rate at which the scheduler binds them all.
+
+Baseline: the reference perf harness hard-fails below 30 pods/s and
+warns below 100 pods/s on this exact configuration
+(scheduler_test.go:35-36); vs_baseline is measured against the 100
+pods/s warning level — the throughput the reference considers healthy.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_cluster(store, n_nodes):
+    from kubernetes_tpu.api import types as api
+
+    for i in range(n_nodes):
+        store.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name=f"node-{i}", labels={
+                "failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
+                "kubernetes.io/hostname": f"node-{i}",
+            }),
+            status=api.NodeStatus(
+                allocatable=api.resource_list(cpu="16", memory="32Gi", pods=110,
+                                              ephemeral_storage="200Gi"),
+                conditions=[api.NodeCondition(api.NODE_READY, api.COND_TRUE)],
+            )))
+
+
+def make_pods(store, n_pods):
+    """Density workload: uniform small pods from one RC (the reference's
+    testutils.NewCustomCreatePodStrategy default pod)."""
+    make_pods_named(store, n_pods, "density-pod")
+
+
+def make_pods_named(store, n_pods, prefix):
+    from kubernetes_tpu.api import types as api
+
+    for i in range(n_pods):
+        store.create("pods", api.Pod(
+            metadata=api.ObjectMeta(
+                name=f"{prefix}-{i}", labels={"type": prefix},
+                owner_references=[api.OwnerReference(
+                    kind="ReplicationController", name=prefix, uid=f"rc-{prefix}",
+                    controller=True)]),
+            spec=api.PodSpec(containers=[api.Container(
+                resources=api.ResourceRequirements(
+                    requests=api.resource_list(cpu="100m", memory="128Mi")))])))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--pods", type=int, default=3000)
+    ap.add_argument("--wave", type=int, default=256)
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+
+    store = ObjectStore()
+    caps = Caps(M=bucket_size(args.pods + 64), P=args.wave)
+    sched = Scheduler(store, wave_size=args.wave, caps=caps)
+    build_cluster(store, args.nodes)
+
+    # warm-up: compile the wave kernel with the same shapes on throwaway
+    # pods (first TPU compile is 10-40s and is not a throughput property)
+    make_pods_named(store, 32, "warmup")
+    sched.schedule_pending()
+    for i in range(32):
+        store.delete("pods", "default", f"warmup-{i}")
+
+    from kubernetes_tpu.utils import Metrics
+
+    sched.metrics = Metrics()  # drop warm-up/compile observations
+
+    make_pods(store, args.pods)
+    t0 = time.time()
+    placed = sched.schedule_pending()
+    dt = time.time() - t0
+    if placed != args.pods:
+        print(f"FATAL: placed {placed}/{args.pods}", file=sys.stderr)
+        sys.exit(1)
+    rate = placed / dt if dt > 0 else 0.0
+    p99 = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    print(json.dumps({
+        "metric": f"scheduler_density_pods_per_sec_{args.nodes}n_{args.pods}p",
+        "value": round(rate, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(rate / 100.0, 2),
+    }))
+    print(f"# placed={placed} wall={dt:.2f}s wave={args.wave} "
+          f"p99_wave_latency={p99*1e3:.0f}ms", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

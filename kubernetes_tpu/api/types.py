@@ -468,3 +468,14 @@ def clone_pod(pod: Pod, **meta_overrides) -> Pod:
     if meta_overrides:
         p.metadata = replace(p.metadata, **meta_overrides)
     return p
+
+
+def with_node_name(pod: Pod, node_name: str) -> Pod:
+    """Cheap bound-pod copy for the scheduling hot path: spec/status are
+    shallow-replaced (sub-objects like containers are shared and treated
+    as immutable during scheduling), avoiding a deepcopy per bind."""
+    return Pod(
+        metadata=pod.metadata,
+        spec=replace(pod.spec, node_name=node_name),
+        status=replace(pod.status),
+    )

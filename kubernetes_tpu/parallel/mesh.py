@@ -1,0 +1,89 @@
+"""Device-mesh sharding of the (pods x nodes) scheduling computation.
+
+The scaling axis of the reference is cluster size x pending pods
+(SURVEY.md §5): its answer is a fixed 16-goroutine fan-out
+(generic_scheduler.go:378). Ours is a jax.sharding.Mesh with two axes:
+
+  "nodes" — the cluster axis, sharded like a context/sequence-parallel
+            axis: every per-node tensor (alloc/requested/labels/taints/
+            masks/scores) is partitioned along N. Per-pod reductions over
+            nodes (normalize maxes, argmax host selection) become XLA
+            collectives over ICI — the moral equivalent of ring
+            attention's KV pass for the [P, N] score matrix.
+  "wave"  — the pending-pod axis, sharded like data parallelism for the
+            batched [P, N] mask/score precomputation. The greedy-commit
+            scan is sequential in P by design (placement-quality
+            contract), so XLA all-gathers the precomputed per-pod rows
+            into the scan; only the O(P*N) precompute — where the FLOPs
+            are — fans out.
+
+GSPMD does the partitioning: inputs are committed to NamedShardings and
+the unmodified ops/kernel.py program is jitted over them; XLA inserts
+the all-reduces/all-gathers. No NCCL-style explicit communication — this
+is the framework's "distributed communication backend" (SURVEY.md §2.2),
+riding ICI within a slice and DCN across hosts via jax distributed
+initialization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import encoding as enc
+
+
+def make_mesh(n_devices: Optional[int] = None, wave_parallel: int = 1) -> Mesh:
+    """2D mesh (wave, nodes). wave_parallel=1 keeps all devices on the
+    nodes axis (the right default: N >> P)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % wave_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by wave_parallel={wave_parallel}")
+    arr = np.array(devices).reshape(wave_parallel, n // wave_parallel)
+    return Mesh(arr, ("wave", "nodes"))
+
+
+def axis_sharding(mesh: Mesh, rank: int, axis_name: str,
+                  axis_idx: int = 0) -> NamedSharding:
+    spec = [None] * rank
+    if rank > 0:
+        spec[axis_idx] = axis_name
+    return NamedSharding(mesh, P(*spec))
+
+
+def node_sharding(mesh: Mesh, rank: int, node_axis: int = 0) -> NamedSharding:
+    return axis_sharding(mesh, rank, "nodes", node_axis)
+
+
+def _put(x, sharding):
+    return jax.device_put(x, sharding)
+
+
+def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
+                 pb: enc.PodBatch, extra_mask) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.PodBatch, object]:
+    """Commit the wave inputs to mesh shardings:
+       node tensors    -> sharded on N ("nodes")
+       pod matrix      -> replicated (M is modest; revisit with sharded
+                          segment-sums when M*K dominates HBM)
+       pod batch       -> sharded on P ("wave")
+       extra mask      -> sharded on both
+    """
+    repl = NamedSharding(mesh, P())
+
+    def nodes0(x):
+        return _put(x, axis_sharding(mesh, np.ndim(x), "nodes"))
+
+    def wave0(x):
+        return _put(x, axis_sharding(mesh, np.ndim(x), "wave"))
+
+    nt_s = enc.NodeTensors(*[nodes0(a) for a in nt])
+    pm_s = enc.PodMatrix(*[_put(a, repl) for a in pm])
+    pb_s = enc.PodBatch(*[wave0(a) for a in pb])
+    extra_s = _put(extra_mask, NamedSharding(mesh, P("wave", "nodes")))
+    return nt_s, pm_s, pb_s, extra_s

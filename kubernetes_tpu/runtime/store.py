@@ -144,9 +144,9 @@ class ObjectStore:
             if old.spec.node_name and old.spec.node_name != node_name:
                 raise Conflict(
                     f"pod {pod.full_name()} already bound to {old.spec.node_name}")
-            cur = copy.deepcopy(old)
-            cur.spec.node_name = node_name
+            cur = api.with_node_name(old, node_name)
             cur.status.phase = "Pending"  # running once kubelet reports
+            cur.metadata = copy.copy(old.metadata)
             self._rv += 1
             cur.metadata.resource_version = self._rv
             self._objects["pods"][self._key(cur)] = cur

@@ -82,14 +82,14 @@ class Scheduler:
     def __init__(self, store: ObjectStore, profile: Optional[Profile] = None,
                  wave_size: int = 128, features: Optional[FeatureGates] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 assume_ttl: float = 30.0):
+                 assume_ttl: float = 30.0, caps=None):
         self.store = store
         self.profile = profile or default_profile()
         self.wave_size = wave_size
         self.features = features or FeatureGates()
         self.clock = clock
         self.cache = SchedulerCache(ttl=assume_ttl, clock=clock)
-        self.snapshot = Snapshot()
+        self.snapshot = Snapshot(caps=caps)
         self.featurizer = PodFeaturizer(self.snapshot, GroupLister(store))
         self.queue = SchedulingQueue(
             pod_priority_enabled=self.features.enabled("PodPriority"))
@@ -234,8 +234,7 @@ class Scheduler:
         ni = self.cache.node_infos.get(node_name)
         if ni is None or not ni.fits_exactly(pod):
             return False
-        bound = api.clone_pod(pod)
-        bound.spec.node_name = node_name
+        bound = api.with_node_name(pod, node_name)
         self.cache.assume_pod(bound)
         self.snapshot.refresh_node_resources(self.cache.node_infos[node_name])
         self.snapshot.add_pod(bound)
