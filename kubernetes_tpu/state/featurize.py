@@ -30,11 +30,17 @@ GroupSelectorsFn = Callable[[api.Pod], List[lbl.Selector]]
 
 
 def equivalence_class(pod: api.Pod) -> Optional[str]:
-    """Pods owned by the same controller share scheduling-relevant spec
-    (reference: equivalence_cache.go:240 hashes the controller ref)."""
+    """Feature-row cache key. The reference's equivalence cache keys by
+    controller ref alone (equivalence_cache.go:240), betting that siblings
+    share spec; we add a cheap spec fingerprint so a same-owner pod with a
+    divergent spec (template update mid-rollout) can never silently reuse
+    stale features."""
     for ref in pod.metadata.owner_references:
         if ref.controller:
-            return ref.uid
+            sig = hash(repr((pod.namespace,
+                             tuple(sorted(pod.metadata.labels.items())),
+                             pod.spec)))
+            return f"{ref.uid}/{sig:x}"
     return None
 
 
