@@ -1,0 +1,186 @@
+"""Batched inter-pod affinity/anti-affinity kernels.
+
+Reproduces the reference's InterPodAffinityMatches predicate
+(pkg/scheduler/algorithm/predicates/predicates.go:1115, metadata path)
+and CalculateInterPodAffinityPriority
+(pkg/scheduler/algorithm/priorities/interpod_affinity.go:118) as dense
+computations — SURVEY.md §7 hard part (a), and the quadratic pod×pod
+term the reference parallelizes across 16 goroutines
+(metadata.go getMatchingAntiAffinityTerms).
+
+Dense shape of the problem:
+
+  * Existing pods' terms live in a TermTable (one row per term, E rows).
+    An [P, E] "entry matches incoming pod" matrix times an [E, N]
+    "entry's topology domain contains node" matrix — an MXU matmul —
+    yields both the anti-affinity symmetry mask and the existing-pod
+    side of the priority in one contraction.
+  * The incoming pod's required terms collapse to one combined AND
+    program (metadata semantics match ALL term properties at once) with
+    a single shared topology key; satisfaction is anchored through the
+    label-value vocabulary: segment-reduce matching pods by the domain
+    value of their node ([P, LV]), then gather at each node's domain
+    value ([P, N]). Pods whose required terms span >1 topology key take
+    the exact host path (plugins/golden.py) instead.
+  * Wave-internal visibility (a pod must see placements made earlier in
+    the same wave, like the reference's one-at-a-time assume) is handled
+    in the commit scan in ops/kernel.py using [P, P] cross-match
+    matrices computed here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import encoding as enc
+from .encoding import NodeTensors, PodBatch, PodMatrix, TermTable
+from .selectors import eval_and_program
+
+
+def ns_match(ns_sets, ns_ids):
+    """bool [..., X]: is ns_ids[x] in ns_sets[...]?
+    ns_sets: i32 [..., TNS] (0 pad — an all-pad set matches nothing);
+    ns_ids:  i32 [X]."""
+    expanded = ns_sets[..., :, None]  # [..., TNS, 1]
+    ids = ns_ids.reshape((1,) * (ns_sets.ndim - 1) + (1, -1))  # [...1, 1, X]
+    return jnp.any((expanded == ids) & (expanded > 0), axis=-2)
+
+
+def _eval_programs(label_matrix, key, op, vals):
+    """Evaluate AND programs (no numeric ops) against a label matrix.
+    key/op: [..., E]; vals: [..., E, V]; label_matrix [X, K] -> bool [..., X]."""
+    num = jnp.full(key.shape, jnp.nan, jnp.float32)
+    ids = jnp.arange(label_matrix.shape[0], dtype=jnp.int32)
+    return eval_and_program(label_matrix, None, key, op, vals, num, ids)
+
+
+def term_entry_match(tt: TermTable, pb: PodBatch) -> jnp.ndarray:
+    """bool [P, E] — does TermTable entry e's (namespaces, selector) match
+    incoming pod p? (predicates.go PodMatchesTermsNamespaceAndSelector,
+    with the term owner's default namespace already baked into tt.ns)."""
+    sel = _eval_programs(pb.pl_val, tt.key, tt.op, tt.vals)  # [E, P]
+    nsm = ns_match(tt.ns, pb.ns_id)  # [E, P]
+    return (sel & nsm & tt.valid[:, None]).T
+
+
+def same_domain(tt: TermTable, nt: NodeTensors) -> jnp.ndarray:
+    """bool [E, N] — is node n in the same topology domain as entry e's
+    owner node under e's topology key? (NodesHaveSameTopologyKey:
+    both labels present and equal.)"""
+    K = nt.labels.shape[1]
+    tk = jnp.clip(tt.tk, 0, K - 1)
+    own = jnp.take_along_axis(nt.labels[tt.node], tk[:, None], axis=1)[:, 0]  # [E]
+    node_dom = jnp.take(nt.labels, tk, axis=1).T  # [E, N]
+    return ((node_dom == own[:, None]) & (own > 0)[:, None] & (node_dom > 0)
+            & (tt.tk > 0)[:, None] & tt.valid[:, None] & nt.valid[None, :])
+
+
+def _bool_matmul(a, b):
+    """bool [P, E] @ bool [E, N] -> bool [P, N] via f32 MXU contraction."""
+    return (a.astype(jnp.float32) @ b.astype(jnp.float32)) > 0.5
+
+
+def node_domains(nt: NodeTensors, tk) -> jnp.ndarray:
+    """i32 [..., N] — each node's domain (label value id) under per-row
+    topology keys tk [...]. 0 = key absent."""
+    K = nt.labels.shape[1]
+    safe = jnp.clip(tk, 0, K - 1)
+    dom = jnp.take(nt.labels, safe.reshape(-1), axis=1).T  # [B, N]
+    dom = jnp.where((tk.reshape(-1) > 0)[:, None], dom, 0)
+    return dom.reshape(tk.shape + (nt.labels.shape[0],))
+
+
+class IncomingStatics(NamedTuple):
+    """Per-wave static (pre-scan) inter-pod affinity state."""
+
+    sym_blocked: jnp.ndarray  # bool [P, N] existing pods' req-anti symmetry
+    ok_aff: jnp.ndarray  # bool [P, N]  incoming req-affinity satisfied (static)
+    any_aff: jnp.ndarray  # bool [P]    any matching pod exists (bootstrap rule)
+    blocked_anti: jnp.ndarray  # bool [P, N] incoming req-anti violated (static)
+    counts: jnp.ndarray  # f32 [P, N]   priority raw counts
+    node_dom_ra: jnp.ndarray  # i32 [P, N] node domain under pod's aff tk
+    node_dom_rn: jnp.ndarray  # i32 [P, N] node domain under pod's anti tk
+    wm_aff: jnp.ndarray  # bool [P, P]  wave pod j matches pod i's aff props
+    wm_anti: jnp.ndarray  # bool [P, P] wave pod j matches pod i's anti props
+
+
+def _anchored_hit(match, dom_m, num_segments, count=False):
+    """match: bool [P, M]; dom_m: i32 [P, M] domain value of each matching
+    pod's node. Segment-reduce over the label-value vocab:
+    returns [P, LV] (bool any, or f32 counts)."""
+    contrib = (match & (dom_m > 0)).astype(jnp.float32)
+
+    def seg(row, dom):
+        return jax.ops.segment_sum(row, dom, num_segments=num_segments)
+
+    hit = jax.vmap(seg)(contrib, dom_m)
+    return hit if count else hit > 0.5
+
+
+def incoming_statics(nt: NodeTensors, pm: PodMatrix, tt: TermTable,
+                     pb: PodBatch, num_label_values: int,
+                     hard_weight: float) -> IncomingStatics:
+    em = term_entry_match(tt, pb)  # [P, E]
+    sd = same_domain(tt, nt)  # [E, N]
+    kind = tt.kind
+    sym_blocked = _bool_matmul(em & (kind == enc.TERM_REQ_ANTI)[None, :], sd)
+
+    # --- incoming required affinity -------------------------------------
+    m_ids = jnp.arange(pm.labels.shape[0], dtype=jnp.int32)
+    aff_sel = _eval_programs(pm.labels, pb.ra_key, pb.ra_op, pb.ra_vals)  # [P, M]
+    aff_m = aff_sel & ns_match(pb.ra_ns, pm.ns) & pm.valid[None, :]
+    node_dom_ra = node_domains(nt, pb.ra_tk)  # [P, N]
+    dom_m_ra = jnp.take_along_axis(
+        node_dom_ra, jnp.broadcast_to(pm.node[None, :], aff_m.shape), axis=1)
+    hit_ra = _anchored_hit(aff_m, dom_m_ra, num_label_values)  # [P, LV]
+    ok_aff = jnp.take_along_axis(hit_ra, node_dom_ra, axis=1) & (node_dom_ra > 0)
+    any_aff = jnp.any(aff_m, axis=1)
+
+    # --- incoming required anti-affinity --------------------------------
+    anti_sel = _eval_programs(pm.labels, pb.rn_key, pb.rn_op, pb.rn_vals)
+    anti_m = anti_sel & ns_match(pb.rn_ns, pm.ns) & pm.valid[None, :]
+    node_dom_rn = node_domains(nt, pb.rn_tk)
+    dom_m_rn = jnp.take_along_axis(
+        node_dom_rn, jnp.broadcast_to(pm.node[None, :], anti_m.shape), axis=1)
+    hit_rn = _anchored_hit(anti_m, dom_m_rn, num_label_values)
+    blocked_anti = jnp.take_along_axis(hit_rn, node_dom_rn, axis=1) & (node_dom_rn > 0)
+
+    # --- priority counts -------------------------------------------------
+    # existing-pod side: hard symmetric weight for required affinity terms,
+    # signed weights for preferred terms (interpod_affinity.go:149-188)
+    we = jnp.select(
+        [kind == enc.TERM_REQ_AFF, kind == enc.TERM_PREF_AFF,
+         kind == enc.TERM_PREF_ANTI],
+        [jnp.full_like(tt.weight, hard_weight), tt.weight, -tt.weight],
+        default=jnp.zeros_like(tt.weight))
+    counts = (em.astype(jnp.float32) * we[None, :]) @ sd.astype(jnp.float32)
+    # incoming pod's preferred terms
+    PA = pb.pa_w.shape[1]
+    for t in range(PA):
+        sel_t = _eval_programs(pm.labels, pb.pa_key[:, t], pb.pa_op[:, t],
+                               pb.pa_vals[:, t])  # [P, M]
+        match_t = sel_t & ns_match(pb.pa_ns[:, t], pm.ns) & pm.valid[None, :]
+        dom_n_t = node_domains(nt, pb.pa_tk[:, t])  # [P, N]
+        dom_m_t = jnp.take_along_axis(
+            dom_n_t, jnp.broadcast_to(pm.node[None, :], match_t.shape), axis=1)
+        cnt_t = _anchored_hit(match_t, dom_m_t, num_label_values, count=True)
+        counts = counts + pb.pa_w[:, t, None] * (
+            jnp.take_along_axis(cnt_t, dom_n_t, axis=1) * (dom_n_t > 0))
+    counts = counts * nt.valid[None, :]
+
+    # --- wave-internal cross matrices ------------------------------------
+    wave_aff_sel = _eval_programs(pb.pl_val, pb.ra_key, pb.ra_op, pb.ra_vals)
+    wm_aff = (wave_aff_sel & ns_match(pb.ra_ns, pb.ns_id)
+              & pb.ra_has[:, None] & pb.valid[None, :])
+    wave_anti_sel = _eval_programs(pb.pl_val, pb.rn_key, pb.rn_op, pb.rn_vals)
+    wm_anti = (wave_anti_sel & ns_match(pb.rn_ns, pb.ns_id)
+               & pb.rn_has[:, None] & pb.valid[None, :])
+
+    return IncomingStatics(
+        sym_blocked=sym_blocked, ok_aff=ok_aff, any_aff=any_aff,
+        blocked_anti=blocked_anti, counts=counts,
+        node_dom_ra=node_dom_ra, node_dom_rn=node_dom_rn,
+        wm_aff=wm_aff, wm_anti=wm_anti)

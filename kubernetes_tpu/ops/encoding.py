@@ -89,6 +89,18 @@ def op_id(op: str) -> int:
     return _OP_IDS[op]
 
 
+# --- inter-pod affinity term kinds (TermTable.kind) -------------------------
+# One TermTable row per affinity term carried by an *existing* pod
+# (reference: metadata.go getMatchingAntiAffinityTerms walks required
+# anti-affinity terms; interpod_affinity.go:149-188 walks required +
+# preferred terms of existing pods for the priority).
+TERM_PAD = 0
+TERM_REQ_ANTI = 1  # requiredDuringScheduling anti-affinity (predicate symmetry)
+TERM_REQ_AFF = 2  # required affinity (hardPodAffinitySymmetricWeight in priority)
+TERM_PREF_AFF = 3  # preferred affinity (priority +w)
+TERM_PREF_ANTI = 4  # preferred anti-affinity (priority -w)
+
+
 # --- capacity buckets -------------------------------------------------------
 
 
@@ -119,6 +131,15 @@ class Caps:
     SE: int = 8  # expressions per spreading selector
     SV: int = 2  # values per spreading expression
     PI: int = 4  # images per pod
+    # inter-pod affinity dims
+    E: int = 8  # TermTable rows (existing-pod affinity terms)
+    TE: int = 4  # expressions per term selector program
+    TV: int = 2  # values per term expression
+    TNS: int = 2  # namespace-set slots per term / per combined program
+    IE: int = 8  # expressions in a pod's combined required (anti)affinity program
+    IV: int = 2  # values per combined-program expression
+    PA: int = 2  # preferred pod-(anti)affinity terms per pending pod
+    LV: int = 64  # label-value vocab bucket (segment count for domain anchoring)
 
 
 class NodeTensors(NamedTuple):
@@ -152,6 +173,25 @@ class PodMatrix(NamedTuple):
     node: np.ndarray  # i32 [M]   node index
     valid: np.ndarray  # bool [M]
     alive: np.ndarray  # bool [M]  deletionTimestamp unset
+
+
+class TermTable(NamedTuple):
+    """Dense table of affinity terms carried by existing (scheduled) pods —
+    the device analog of predicateMetadata.matchingAntiAffinityTerms
+    (metadata.go:58) plus the existing-pod term walk of
+    interpod_affinity.go:149. One row per term; selector programs run
+    against the *incoming* pod's labels (pod-label key space)."""
+
+    kind: np.ndarray  # i32 [E]  TERM_* (0 pad)
+    owner: np.ndarray  # i32 [E]  pod slot in PodMatrix
+    node: np.ndarray  # i32 [E]  owner's node index
+    tk: np.ndarray  # i32 [E]  topology key as node-label key id (0 invalid)
+    weight: np.ndarray  # f32 [E]  preferred weight (REQ_* rows: 1.0)
+    ns: np.ndarray  # i32 [E, TNS]  allowed incoming-pod namespace ids (0 pad)
+    key: np.ndarray  # i32 [E, TE]  selector program over pod-label keys
+    op: np.ndarray  # i32 [E, TE]
+    vals: np.ndarray  # i32 [E, TE, TV]
+    valid: np.ndarray  # bool [E]
 
 
 class PodBatch(NamedTuple):
@@ -191,6 +231,34 @@ class PodBatch(NamedTuple):
     sg_op: np.ndarray  # i32 [P, SG, SE]
     sg_vals: np.ndarray  # i32 [P, SG, SE, SV]
     sg_num: np.ndarray  # f32 [P, SG, SE]
+    # inter-pod affinity (incoming side). Required terms collapse to ONE
+    # combined AND program + one namespace-set intersection per pod —
+    # legal because the metadata path matches existing pods against ALL
+    # term properties at once (predicates.go podMatchesAffinityTermProperties
+    # "matches all the given properties"). The shared topology key
+    # (ra_tk/rn_tk) encodes the single-topology-key fast path; pods whose
+    # required terms use >1 distinct key are routed host-side.
+    pl_val: np.ndarray  # i32 [P, KP]  the pod's own labels (pod-label key space)
+    ra_has: np.ndarray  # bool [P]  has required pod-affinity terms
+    ra_key: np.ndarray  # i32 [P, IE]
+    ra_op: np.ndarray  # i32 [P, IE]
+    ra_vals: np.ndarray  # i32 [P, IE, IV]
+    ra_ns: np.ndarray  # i32 [P, TNS]  ns-set intersection (0 pad)
+    ra_tk: np.ndarray  # i32 [P]  shared topology key (node-label key id)
+    ra_self: np.ndarray  # bool [P]  pod matches its own affinity properties
+    rn_has: np.ndarray  # bool [P]  has required anti-affinity terms
+    rn_key: np.ndarray  # i32 [P, IE]
+    rn_op: np.ndarray  # i32 [P, IE]
+    rn_vals: np.ndarray  # i32 [P, IE, IV]
+    rn_ns: np.ndarray  # i32 [P, TNS]
+    rn_tk: np.ndarray  # i32 [P]
+    # preferred pod-(anti)affinity terms of the incoming pod (priority)
+    pa_w: np.ndarray  # f32 [P, PA]  signed weight (+aff / -anti; 0 pad)
+    pa_tk: np.ndarray  # i32 [P, PA]
+    pa_ns: np.ndarray  # i32 [P, PA, TNS]
+    pa_key: np.ndarray  # i32 [P, PA, TE]
+    pa_op: np.ndarray  # i32 [P, PA, TE]
+    pa_vals: np.ndarray  # i32 [P, PA, TE, TV]
     # misc
     owned: np.ndarray  # bool [P]  has RC/RS controller ref (prefer-avoid)
     img_id: np.ndarray  # i32 [P, PI]
@@ -212,6 +280,7 @@ DEVICE_PREDICATES = (
     "CheckNodeMemoryPressure",
     "CheckNodeDiskPressure",
     "CheckNodePIDPressure",
+    "MatchInterPodAffinity",  # last, as in predicatesOrdering (predicates.go:139)
 )
 PRED_IDX = {name: i for i, name in enumerate(DEVICE_PREDICATES)}
 

@@ -32,8 +32,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import encoding as enc
+from .affinity import incoming_statics
 from .filters import resource_fit, static_predicate_masks
 from .scores import (
+    floor_div,
     balanced_allocation,
     image_locality,
     least_requested,
@@ -60,6 +62,10 @@ class Weights(NamedTuple):
     selector_spread: float = 1.0
     prefer_avoid: float = 10000.0
     image_locality: float = 0.0
+    interpod: float = 1.0
+    # HardPodAffinitySymmetricWeight (componentconfig default 1,
+    # pkg/apis/componentconfig/types.go)
+    hard_pod_affinity: float = 1.0
 
 
 class WaveResult(NamedTuple):
@@ -71,22 +77,34 @@ class WaveResult(NamedTuple):
     rr_end: jnp.ndarray  # i32  round-robin counter after the wave
 
 
-@functools.partial(jax.jit, static_argnames=("weights", "num_zones"))
-def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, pb: enc.PodBatch,
-                  extra_mask, rr_start, *, weights: Weights,
-                  num_zones: int) -> WaveResult:
+@functools.partial(jax.jit, static_argnames=(
+    "weights", "num_zones", "num_label_values", "has_ipa"))
+def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
+                  pb: enc.PodBatch, extra_mask, rr_start, *, weights: Weights,
+                  num_zones: int, num_label_values: int = 64,
+                  has_ipa: bool = False) -> WaveResult:
     """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
     volume predicates) for the rare pods that need them; all-True rows for
     everyone else. Appended to the mask stack as a final "HostPlugins"
-    pseudo-predicate for failure attribution."""
+    pseudo-predicate for failure attribution.
+
+    has_ipa (static): compiles the inter-pod affinity path in. When no
+    affinity terms exist anywhere (the common case), the False variant
+    keeps the program identical to the affinity-free kernel."""
     N = nt.valid.shape[0]
+    P = pb.req.shape[0]
     R = nt.alloc.shape[1]
     is_core = jnp.arange(R) < enc.RES_FIXED
-    masks = static_predicate_masks(nt, pb, is_core)  # [Q, P, N]
-    masks = jnp.concatenate([masks, extra_mask[None]], axis=0)
+    masks = static_predicate_masks(nt, pb, is_core)  # [Q-1, P, N]
+    ipa_placeholder = jnp.ones((1, P, N), bool)  # filled post-scan
+    masks = jnp.concatenate([masks, ipa_placeholder, extra_mask[None]], axis=0)
     res_i = enc.PRED_IDX["PodFitsResources"]
+    ipa_i = enc.PRED_IDX["MatchInterPodAffinity"]
     static_nonres = jnp.all(masks.at[res_i].set(True), axis=0)  # [P, N]
     alloc2 = nt.alloc[:, :2]
+    ipa = (incoming_statics(nt, pm, tt, pb, num_label_values,
+                            weights.hard_pod_affinity)
+           if has_ipa else None)
 
     w = weights
     aff_raw = node_affinity_raw(nt, pb) if w.node_affinity else None
@@ -105,12 +123,60 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, pb: enc.PodBatch,
         taint_raw = jnp.zeros((P, N), jnp.float32)
 
     def step(carry, x):
-        req_c, nz_c, cnt_c, rr = carry
-        preq, pnz, mask_sn, araw, traw, scnt, sscore, pvalid = x
+        req_c, nz_c, cnt_c, rr, placed = carry
+        if has_ipa:
+            (i, preq, pnz, mask_sn, araw, traw, scnt, sscore, pvalid,
+             sym_row, okaff_row, anyaff_s, banti_row, counts_row,
+             dra_row, drn_row, wmaff_row, wmanti_row, wmT_row,
+             ra_has_i, rn_has_i, ra_self_i) = x
+        else:
+            (i, preq, pnz, mask_sn, araw, traw, scnt, sscore, pvalid) = x
         fits = resource_fit(nt.alloc, nt.allowed_pods, req_c, cnt_c,
                             preq[None, :], is_core)[0]  # [N]
         feasible = mask_sn & fits & nt.valid & pvalid
+        if has_ipa:
+            active = placed >= 0
+            safe_pl = jnp.clip(placed, 0)
+            # incoming required affinity vs pods placed earlier this wave
+            pl_dom = dra_row[safe_pl]  # [P] placement domain under MY aff tk
+            src = wmaff_row & active & (pl_dom > 0)
+            wave_aff = jnp.any(
+                src[:, None] & (pl_dom[:, None] == dra_row[None, :]), axis=0
+            ) & (dra_row > 0)
+            # bootstrap existence check is topology-independent
+            # (predicates.go:1410: matchingPods counts props matches on ANY
+            # node, labeled or not)
+            any_aff = anyaff_s | jnp.any(wmaff_row & active)
+            ok_aff = okaff_row | wave_aff | (~any_aff & ra_self_i)
+            ok_aff = jnp.where(ra_has_i, ok_aff, True)
+            # incoming required anti-affinity vs wave placements
+            pl_dom_n = drn_row[safe_pl]
+            srcn = wmanti_row & active & (pl_dom_n > 0)
+            wave_anti = jnp.any(
+                srcn[:, None] & (pl_dom_n[:, None] == drn_row[None, :]), axis=0
+            ) & (drn_row > 0)
+            ok_anti = ~(rn_has_i & (banti_row | wave_anti))
+            # symmetry: wave pod j's required anti terms vs me, under j's tk
+            pd_sym = jnp.take_along_axis(
+                node_dom_rn_full, safe_pl[:, None], axis=1)[:, 0]  # [P]
+            srcs = wmT_row & active & (pd_sym > 0)
+            sym_wave = jnp.any(
+                srcs[:, None] & (pd_sym[:, None] == node_dom_rn_full)
+                & (node_dom_rn_full > 0), axis=0)
+            ipa_ok = ~(sym_row | sym_wave) & ok_aff & ok_anti
+            feasible &= ipa_ok
+        else:
+            ipa_ok = jnp.ones_like(feasible)
         total = sscore
+        if has_ipa and w.interpod:
+            cmasked = jnp.where(feasible, counts_row, 0.0)
+            cmin = jnp.minimum(jnp.min(cmasked), 0.0)
+            cmax = jnp.maximum(jnp.max(cmasked), 0.0)
+            crange = cmax - cmin
+            fscore = jnp.where(crange > 0,
+                               floor_div(10.0 * (counts_row - cmin) / crange),
+                               0.0)
+            total = total + w.interpod * fscore
         if w.node_affinity:
             total = total + w.node_affinity * normalize_reduce(araw, feasible, False)
         if w.taint_toleration:
@@ -138,15 +204,30 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, pb: enc.PodBatch,
         nz_c = nz_c.at[safe].add(pnz * gain)
         cnt_c = cnt_c.at[safe].add(jnp.where(has, 1, 0))
         rr = rr + jnp.where(has, 1, 0)
-        out = (chosen, best, fits, jnp.sum(feasible.astype(jnp.int32)))
-        return (req_c, nz_c, cnt_c, rr), out
+        placed = placed.at[i].set(chosen)
+        out = (chosen, best, fits, jnp.sum(feasible.astype(jnp.int32)), ipa_ok)
+        return (req_c, nz_c, cnt_c, rr, placed), out
 
-    carry0 = (nt.requested, nt.nonzero, nt.pod_count, jnp.asarray(rr_start, jnp.int32))
-    xs = (pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw, spread_cnt,
-          static_score, pb.valid)
-    (_, _, _, rr_end), (chosen, best, dyn_fits, feas_cnt) = lax.scan(step, carry0, xs)
+    carry0 = (nt.requested, nt.nonzero, nt.pod_count,
+              jnp.asarray(rr_start, jnp.int32), jnp.full((P,), -1, jnp.int32))
+    ii = jnp.arange(P, dtype=jnp.int32)
+    if has_ipa:
+        node_dom_rn_full = ipa.node_dom_rn
+        xs = (ii, pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw,
+              spread_cnt, static_score, pb.valid,
+              ipa.sym_blocked, ipa.ok_aff, ipa.any_aff, ipa.blocked_anti,
+              ipa.counts, ipa.node_dom_ra, ipa.node_dom_rn,
+              ipa.wm_aff, ipa.wm_anti, ipa.wm_anti.T,
+              pb.ra_has, pb.rn_has, pb.ra_self)
+    else:
+        xs = (ii, pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw,
+              spread_cnt, static_score, pb.valid)
+    (_, _, _, rr_end, _), (chosen, best, dyn_fits, feas_cnt, ipa_masks) = \
+        lax.scan(step, carry0, xs)
 
     masks = masks.at[res_i].set(dyn_fits)
+    if has_ipa:
+        masks = masks.at[ipa_i].set(ipa_masks)
     # short-circuit first-fail attribution in predicate order
     prefix_ok = jnp.cumprod(masks.astype(jnp.int8), axis=0).astype(bool)
     first = jnp.concatenate(

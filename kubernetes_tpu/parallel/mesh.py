@@ -66,11 +66,14 @@ def _put(x, sharding):
 
 
 def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
-                 pb: enc.PodBatch, extra_mask) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.PodBatch, object]:
+                 tt: enc.TermTable, pb: enc.PodBatch, extra_mask
+                 ) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.TermTable,
+                            enc.PodBatch, object]:
     """Commit the wave inputs to mesh shardings:
        node tensors    -> sharded on N ("nodes")
        pod matrix      -> replicated (M is modest; revisit with sharded
                           segment-sums when M*K dominates HBM)
+       term table      -> replicated (E is small: only pods with affinity)
        pod batch       -> sharded on P ("wave")
        extra mask      -> sharded on both
     """
@@ -84,6 +87,7 @@ def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
 
     nt_s = enc.NodeTensors(*[nodes0(a) for a in nt])
     pm_s = enc.PodMatrix(*[_put(a, repl) for a in pm])
+    tt_s = enc.TermTable(*[_put(a, repl) for a in tt])
     pb_s = enc.PodBatch(*[wave0(a) for a in pb])
     extra_s = _put(extra_mask, NamedSharding(mesh, P("wave", "nodes")))
-    return nt_s, pm_s, pb_s, extra_s
+    return nt_s, pm_s, tt_s, pb_s, extra_s

@@ -160,6 +160,184 @@ def no_disk_conflict(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
     return True, []
 
 
+# --- inter-pod affinity ------------------------------------------------------
+
+
+class ClusterView:
+    """All NodeInfos, with an optional override for the node under test —
+    preemption's what-if simulation clones one NodeInfo
+    (generic_scheduler.go:898) while affinity still reads the rest of the
+    cluster unmodified."""
+
+    def __init__(self, node_infos: Dict[str, NodeInfo],
+                 override: Optional[NodeInfo] = None):
+        self.node_infos = node_infos
+        self.override = override
+
+    def get(self, name: str) -> Optional[NodeInfo]:
+        ov = self.override
+        if ov is not None and ov.node is not None and ov.node.name == name:
+            return ov
+        return self.node_infos.get(name)
+
+    def iter_pods(self):
+        ov_name = (self.override.node.name
+                   if self.override is not None and self.override.node is not None
+                   else None)
+        for name, ni in self.node_infos.items():
+            ni = self.override if name == ov_name else ni
+            for p in ni.pods:
+                yield p, ni
+        if ov_name is not None and ov_name not in self.node_infos:
+            for p in self.override.pods:
+                yield p, self.override
+
+
+def nodes_same_topology(node_a, node_b, topology_key: str) -> bool:
+    """priorities/util/topologies.go:56 NodesHaveSameTopologyKey."""
+    if not topology_key or node_a is None or node_b is None:
+        return False
+    a = node_a.metadata.labels.get(topology_key)
+    b = node_b.metadata.labels.get(topology_key)
+    return a is not None and b is not None and a == b
+
+
+def _term_namespaces(owner: api.Pod, term: api.PodAffinityTerm):
+    """priorities/util/topologies.go:30 GetNamespacesFromPodAffinityTerm."""
+    return set(term.namespaces) if term.namespaces else {owner.namespace}
+
+
+def _pod_matches_all_term_props(target: api.Pod, owner: api.Pod,
+                                terms: Sequence[api.PodAffinityTerm]) -> bool:
+    """predicates/utils.go podMatchesAffinityTermProperties — target must
+    match ALL terms' (namespaces, selector); nil selector matches nothing."""
+    if not terms:
+        return False
+    for term in terms:
+        if target.namespace not in _term_namespaces(owner, term):
+            return False
+        if term.label_selector is None or \
+                not term.label_selector.matches(target.metadata.labels):
+            return False
+    return True
+
+
+def _affinity_terms(pod: api.Pod):
+    aff = pod.spec.affinity
+    return list(aff.pod_affinity.required) if aff and aff.pod_affinity else []
+
+
+def _anti_affinity_terms(pod: api.Pod):
+    aff = pod.spec.affinity
+    return list(aff.pod_anti_affinity.required) if aff and aff.pod_anti_affinity else []
+
+
+def _satisfies_existing_anti(pod: api.Pod, node, view: ClusterView) -> bool:
+    """predicates.go:1310 satisfiesExistingPodsAntiAffinity (metadata-path
+    behavior): no existing pod may carry a required anti-affinity term that
+    matches <pod> while its node shares the term's topology with <node>."""
+    for existing, eni in view.iter_pods():
+        for term in _anti_affinity_terms(existing):
+            if pod.namespace not in _term_namespaces(existing, term):
+                continue
+            if term.label_selector is None or \
+                    not term.label_selector.matches(pod.metadata.labels):
+                continue
+            if nodes_same_topology(node, eni.node, term.topology_key):
+                return False
+    return True
+
+
+def _any_anchor_matches(pod: api.Pod, node, view: ClusterView,
+                        terms: Sequence[api.PodAffinityTerm]) -> Tuple[bool, bool]:
+    """predicates.go:1360 anyPodsMatchingTopologyTerms over the
+    metadata-style matching-pod map. Returns (topology_match_exists,
+    any_pod_matches_properties)."""
+    any_props = False
+    for existing, eni in view.iter_pods():
+        if not _pod_matches_all_term_props(existing, pod, terms):
+            continue
+        any_props = True
+        if all(nodes_same_topology(node, eni.node, t.topology_key) for t in terms):
+            return True, True
+    return False, any_props
+
+
+def interpod_affinity_predicate(pod: api.Pod, ni: NodeInfo,
+                                view: ClusterView) -> PredicateResult:
+    """predicates.go:1115 InterPodAffinityMatches (metadata path)."""
+    node = ni.node
+    if node is None:
+        return False, [REASONS["NodeUnknownCondition"]]
+    if not _satisfies_existing_anti(pod, node, view):
+        return False, [REASONS["MatchInterPodAffinity"]]
+    aff_terms = _affinity_terms(pod)
+    if aff_terms:
+        ok, any_props = _any_anchor_matches(pod, node, view, aff_terms)
+        if not ok:
+            # bootstrap rule (predicates.go:1409): the first pod of a
+            # self-affine group may schedule anywhere
+            if not (not any_props
+                    and _pod_matches_all_term_props(pod, pod, aff_terms)):
+                return False, [REASONS["MatchInterPodAffinity"]]
+    anti_terms = _anti_affinity_terms(pod)
+    if anti_terms:
+        hit, _ = _any_anchor_matches(pod, node, view, anti_terms)
+        if hit:
+            return False, [REASONS["MatchInterPodAffinity"]]
+    return True, []
+
+
+def interpod_affinity_priority(pod: api.Pod, feasible: Sequence[NodeInfo],
+                               view: ClusterView,
+                               hard_weight: int = 1) -> Dict[str, int]:
+    """priorities/interpod_affinity.go:118 CalculateInterPodAffinityPriority.
+    feasible: NodeInfos of filtered nodes; returns node -> 0..10."""
+    aff = pod.spec.affinity
+    pref_aff = list(aff.pod_affinity.preferred) if aff and aff.pod_affinity else []
+    pref_anti = (list(aff.pod_anti_affinity.preferred)
+                 if aff and aff.pod_anti_affinity else [])
+    counts: Dict[str, float] = {ni.node.name: 0.0 for ni in feasible if ni.node}
+
+    def process(term: api.PodAffinityTerm, owner: api.Pod, to_check: api.Pod,
+                fixed_node, weight: float):
+        if to_check.namespace not in _term_namespaces(owner, term):
+            return
+        if term.label_selector is None or \
+                not term.label_selector.matches(to_check.metadata.labels):
+            return
+        for ni in feasible:
+            if ni.node is not None and nodes_same_topology(
+                    ni.node, fixed_node, term.topology_key):
+                counts[ni.node.name] += weight
+
+    for existing, eni in view.iter_pods():
+        for wt in pref_aff:
+            process(wt.pod_affinity_term, pod, existing, eni.node, float(wt.weight))
+        for wt in pref_anti:
+            process(wt.pod_affinity_term, pod, existing, eni.node, -float(wt.weight))
+        eaff = existing.spec.affinity
+        if eaff and eaff.pod_affinity:
+            if hard_weight > 0:
+                for term in eaff.pod_affinity.required:
+                    process(term, existing, pod, eni.node, float(hard_weight))
+            for wt in eaff.pod_affinity.preferred:
+                process(wt.pod_affinity_term, existing, pod, eni.node,
+                        float(wt.weight))
+        if eaff and eaff.pod_anti_affinity:
+            for wt in eaff.pod_anti_affinity.preferred:
+                process(wt.pod_affinity_term, existing, pod, eni.node,
+                        -float(wt.weight))
+
+    max_c = max(list(counts.values()) + [0.0])
+    min_c = min(list(counts.values()) + [0.0])
+    out = {}
+    for name, c in counts.items():
+        out[name] = (int(10.0 * (c - min_c) / (max_c - min_c))
+                     if max_c != min_c else 0)
+    return out
+
+
 # GeneralPredicates (predicates.go:1031): resources + host + ports + selector.
 def general_predicates(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
     fits, reasons = True, []
@@ -188,9 +366,11 @@ ORDERED_PREDICATES: List[Tuple[str, Callable[[api.Pod, NodeInfo], PredicateResul
 
 
 def pod_fits_on_node(pod: api.Pod, ni: NodeInfo,
-                     always_check_all: bool = False) -> PredicateResult:
+                     always_check_all: bool = False,
+                     view: Optional[ClusterView] = None) -> PredicateResult:
     """Reference: generic_scheduler.go:456 podFitsOnNode inner loop with
-    short-circuit ordering (:503)."""
+    short-circuit ordering (:503). view enables MatchInterPodAffinity
+    (last in predicatesOrdering, predicates.go:139)."""
     reasons: List[str] = []
     for name, pred in ORDERED_PREDICATES:
         ok, r = pred(pod, ni)
@@ -198,6 +378,10 @@ def pod_fits_on_node(pod: api.Pod, ni: NodeInfo,
             reasons.extend(r)
             if not always_check_all:
                 break
+    if view is not None and not reasons:
+        ok, r = interpod_affinity_predicate(pod, ni, view)
+        if not ok:
+            reasons.extend(r)
     return not reasons, reasons
 
 

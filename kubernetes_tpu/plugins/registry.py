@@ -36,6 +36,7 @@ _SCORE_FIELDS = {
     "SelectorSpreadPriority": "selector_spread",
     "NodePreferAvoidPodsPriority": "prefer_avoid",
     "ImageLocalityPriority": "image_locality",
+    "InterPodAffinityPriority": "interpod",
 }
 
 
@@ -49,6 +50,9 @@ class Profile:
     host_filters: Dict[str, HostPredicate] = field(default_factory=dict)
     score_weights: Dict[str, int] = field(default_factory=dict)
     disable_preemption: bool = False
+    # componentconfig HardPodAffinitySymmetricWeight (default 1,
+    # pkg/apis/componentconfig/types.go:79)
+    hard_pod_affinity_symmetric_weight: int = 1
 
     def weights(self) -> Weights:
         kw = {}
@@ -58,6 +62,7 @@ class Profile:
                 kw[f] = float(w)
         base = {f: 0.0 for f in Weights._fields}
         base.update(kw)
+        base["hard_pod_affinity"] = float(self.hard_pod_affinity_symmetric_weight)
         return Weights(**base)
 
 
@@ -68,12 +73,12 @@ def default_profile() -> Profile:
         host_filters={"NoDiskConflict": golden.no_disk_conflict},
         score_weights={
             "SelectorSpreadPriority": 1,
+            "InterPodAffinityPriority": 1,
             "LeastRequestedPriority": 1,
             "BalancedResourceAllocation": 1,
             "NodePreferAvoidPodsPriority": 10000,
             "NodeAffinityPriority": 1,
             "TaintTolerationPriority": 1,
-            # InterPodAffinityPriority: 1 — pending tensorization (round 2)
         },
     )
 

@@ -84,15 +84,22 @@ def _pods_violating_pdb(pods: Sequence[api.Pod],
 
 def select_victims_on_node(
         pod: api.Pod, ni: NodeInfo,
-        pdbs: Sequence[api.PodDisruptionBudget]) -> Optional[Tuple[List[api.Pod], int]]:
-    """Reference :898. Returns (victims, numPDBViolations) or None."""
+        pdbs: Sequence[api.PodDisruptionBudget],
+        node_infos: Optional[Dict[str, NodeInfo]] = None,
+        ) -> Optional[Tuple[List[api.Pod], int]]:
+    """Reference :898. Returns (victims, numPDBViolations) or None.
+    node_infos enables inter-pod affinity in the what-if (the cloned
+    NodeInfo overrides the node under test, like meta.RemovePod keeps the
+    shared metadata consistent, metadata.go:141)."""
     copy = ni.clone()
+    view = (golden.ClusterView(node_infos, override=copy)
+            if node_infos is not None else None)
     prio = api.pod_priority(pod)
     potential = [p for p in copy.pods if api.pod_priority(p) < prio]
     for p in potential:
         copy.remove_pod(p)
     potential.sort(key=api.pod_priority, reverse=True)
-    fits, _ = golden.pod_fits_on_node(pod, copy)
+    fits, _ = golden.pod_fits_on_node(pod, copy, view=view)
     if not fits:
         return None
     victims: List[api.Pod] = []
@@ -101,7 +108,7 @@ def select_victims_on_node(
 
     def reprieve(p: api.Pod) -> bool:
         copy.add_pod(p)
-        ok, _ = golden.pod_fits_on_node(pod, copy)
+        ok, _ = golden.pod_fits_on_node(pod, copy, view=view)
         if not ok:
             copy.remove_pod(p)
             victims.append(p)
@@ -136,16 +143,20 @@ def pick_one_node(candidates: Dict[str, Tuple[List[api.Pod], int]]) -> Optional[
 
 def preempt(pod: api.Pod, cache: SchedulerCache,
             failed_predicates: Dict[str, List[str]],
-            pdbs: Sequence[api.PodDisruptionBudget]) -> Optional[PreemptionResult]:
-    """Reference :200 Preempt. Returns None when preemption can't help."""
+            pdbs: Sequence[api.PodDisruptionBudget],
+            with_affinity: bool = False) -> Optional[PreemptionResult]:
+    """Reference :200 Preempt. Returns None when preemption can't help.
+    with_affinity: evaluate MatchInterPodAffinity in the what-if (pass
+    when any affinity terms exist in the cluster)."""
     if not pod_eligible_to_preempt_others(pod, cache):
         return None
+    node_infos = cache.node_infos if with_affinity else None
     candidates: Dict[str, Tuple[List[api.Pod], int]] = {}
     for node_name in nodes_where_preemption_might_help(failed_predicates):
         ni = cache.node_infos.get(node_name)
         if ni is None or ni.node is None:
             continue
-        sel = select_victims_on_node(pod, ni, pdbs)
+        sel = select_victims_on_node(pod, ni, pdbs, node_infos)
         if sel is not None:
             candidates[node_name] = sel
     chosen = pick_one_node(candidates)

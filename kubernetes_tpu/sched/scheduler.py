@@ -190,17 +190,31 @@ class Scheduler:
         pods = self.queue.pop_wave(self.wave_size, timeout=timeout)
         if not pods:
             return 0
+        # pods whose required pod-(anti)affinity spans >1 topology key take
+        # the exact host path (ops/affinity.py single-anchor limitation)
+        host_path = [p for p in pods if self.featurizer.needs_host_path(p)]
+        placed_host = 0
+        if host_path:
+            pods = [p for p in pods if not self.featurizer.needs_host_path(p)]
+            for p in host_path:
+                placed_host += self._schedule_host_path(p)
+            if not pods:
+                return placed_host
         trace = Trace(f"wave of {len(pods)}", clock=self.clock)
         start = self.clock()
         pb = self.featurizer.featurize(pods)
         extra = self._host_plugin_mask(pods, pb.req.shape[0])
         trace.step("featurized")
-        nt, pm = self.snapshot.to_device()
+        nt, pm, tt = self.snapshot.to_device()
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
-        res = schedule_wave(nt, pm, pb, extra, self._rr,
+        has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
+                       or pb.rn_has.any() or (pb.pa_w != 0).any())
+        res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
                             weights=self.profile.weights(),
-                            num_zones=self.snapshot.caps.Z)
+                            num_zones=self.snapshot.caps.Z,
+                            num_label_values=self.snapshot.num_label_values,
+                            has_ipa=bool(has_ipa))
         self._rr = res.rr_end
         chosen = np.asarray(res.chosen)
         trace.step("device wave")
@@ -224,7 +238,64 @@ class Scheduler:
         trace.step("committed")
         self.metrics.e2e_scheduling_latency.observe(self.clock() - start)
         trace.log_if_long(0.1)
-        return placed
+        return placed + placed_host
+
+    def _schedule_host_path(self, pod: api.Pod) -> int:
+        """Exact one-pod golden pass for pods the wave kernel can't encode
+        (multi-topology-key required pod affinity). Mirrors the reference's
+        single-pod cycle over the golden predicates/priorities."""
+        self.metrics.schedule_attempts.inc()
+        view = golden.ClusterView(self.cache.node_infos)
+        feasible: List[str] = []
+        reasons: Dict[str, int] = {}
+        failed: Dict[str, List[str]] = {}
+        for name, ni in self.cache.node_infos.items():
+            ok, rs = golden.pod_fits_on_node(pod, ni, view=view)
+            if ok:
+                for fname, fn in self.profile.host_filters.items():
+                    ok2, rs2 = fn(pod, ni)
+                    if not ok2:
+                        ok, rs = False, rs2
+                        break
+            if ok:
+                feasible.append(name)
+            else:
+                for r in rs[:1]:
+                    reasons[r] = reasons.get(r, 0) + 1
+                failed[name] = rs[:1]
+        if not feasible:
+            self.metrics.pods_failed.inc()
+            err = FitError(pod.full_name(), len(self.cache.node_infos), reasons)
+            if (self.features.enabled("PodPriority")
+                    and not self.profile.disable_preemption):
+                # map reason strings back to predicate names for the
+                # unresolvable filter
+                rev = {v: k for k, v in REASONS.items()}
+                fp = {n: [rev.get(r, r) for r in rs] for n, rs in failed.items()}
+                pr = preempt(pod, self.cache, fp, self._pdbs(), with_affinity=True)
+                if pr is not None:
+                    self._perform_preemption(pod, pr)
+            self.backoff.get_backoff(pod.uid)
+            self.queue.add_unschedulable_if_not_present(pod)
+            self.store.set_pod_condition(pod, ("PodScheduled", "False:" + err.message()))
+            return 0
+        # score: golden interpod priority + least-requested tie-breaking
+        w = self.profile.weights()
+        ipa_scores = golden.interpod_affinity_priority(
+            pod, [self.cache.node_infos[n] for n in feasible], view,
+            hard_weight=int(w.hard_pod_affinity))
+        best_name, best_score = None, None
+        for name in feasible:
+            ni = self.cache.node_infos[name]
+            s = (w.interpod * ipa_scores.get(name, 0)
+                 + golden.least_requested_map(pod, ni)
+                 + golden.balanced_allocation_map(pod, ni))
+            if best_score is None or s > best_score:
+                best_name, best_score = name, s
+        if best_name is not None and self._commit(pod, best_name):
+            return 1
+        self.queue.add_if_not_present(pod)
+        return 0
 
     # -- commit path -----------------------------------------------------------
 
@@ -312,8 +383,12 @@ class Scheduler:
                 and not self.profile.disable_preemption):
             t0 = self.clock()
             self.metrics.total_preemption_attempts.inc()
+            aff = pod.spec.affinity
+            pod_has_ipa = aff is not None and (
+                aff.pod_affinity is not None or aff.pod_anti_affinity is not None)
             pr = preempt(pod, self.cache, self._failed_predicates_by_node(res, idx),
-                         self._pdbs())
+                         self._pdbs(),
+                         with_affinity=self.snapshot.has_affinity_terms or pod_has_ipa)
             self.metrics.preemption_evaluation.observe(self.clock() - t0)
             if pr is not None:
                 self._perform_preemption(pod, pr)

@@ -73,7 +73,13 @@ class PodFeaturizer:
     ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
         """Compile an AND-list of requirements to (key[AE], op[AE],
         vals[AE,AV], num[AE]). Returns None if it doesn't fit caps (caller
-        grows and retries)."""
+        grows and retries).
+
+        Keys and values are INTERNED, not looked up: a freshly interned id
+        matches nothing until some entity carries it — identical semantics
+        to an unknown id — and, unlike lookup, a program compiled early in
+        a batch stays correct when a later pod in the same batch interns
+        the same string (the stale -1 operand hazard)."""
         if len(reqs) > AE:
             return None
         key = np.zeros((AE,), np.int32)
@@ -95,23 +101,21 @@ class PodFeaturizer:
                 for j, val in enumerate(r.values):
                     vals[i, j] = self.snap.node_index.get(val, -1)
                 continue
-            key[i] = keys.lookup(r.key)
+            kid = keys.intern(r.key)
+            if node_space:
+                if kid >= self.snap.caps.K:
+                    self.snap._grow(K=kid + 1)
+            elif kid >= self.snap.caps.KP:
+                self.snap._grow(KP=kid + 1)
+            key[i] = kid
             op[i] = enc.op_id(r.op)
             if r.op in (lbl.IN, lbl.NOT_IN):
                 if len(r.values) > AV:
                     return None
                 for j, val in enumerate(r.values):
-                    vals[i, j] = v.label_values.lookup(val)
+                    vals[i, j] = v.label_values.intern(val)
             elif r.op in (lbl.GT, lbl.LT):
                 num[i] = _parse_label_num(r.values[0]) if r.values else math.nan
-            if key[i] < 0:
-                # Unknown key: In/Exists/Gt/Lt can never match; NotIn and
-                # DoesNotExist match everything (key absent everywhere).
-                if op[i] in (enc.OP_IN, enc.OP_EXISTS, enc.OP_GT, enc.OP_LT):
-                    op[i] = enc.OP_FALSE
-                else:
-                    op[i] = enc.OP_PAD
-                key[i] = 0
         return key, op, vals, num
 
     # -- featurize one pod ----------------------------------------------------
@@ -257,6 +261,8 @@ class PodFeaturizer:
                 break
         d["sg_valid"], d["sg_key"], d["sg_op"], d["sg_vals"], d["sg_num"] = (
             sg_valid, sg_key, sg_op, sg_vals, sg_num)
+        # inter-pod affinity
+        self._featurize_interpod(pod, d)
         # misc
         d["owned"] = np.bool_(any(
             ref.controller and ref.kind in ("ReplicationController", "ReplicaSet")
@@ -269,6 +275,163 @@ class PodFeaturizer:
         d["img_id"] = img_id
         d["prio"] = np.int32(api.pod_priority(pod))
         return d
+
+    # -- inter-pod affinity ----------------------------------------------------
+
+    @staticmethod
+    def needs_host_path(pod: api.Pod) -> bool:
+        """True when the pod's required pod-(anti)affinity terms span more
+        than one distinct topology key. The device kernel's single-anchor
+        encoding (ops/affinity.py) collapses all required terms to one
+        shared topology key — the reference semantics
+        (predicates.go anyPodsMatchingTopologyTerms: one target node must
+        satisfy ALL terms' topologies) need a composite domain otherwise,
+        so such pods take the exact host path (plugins/golden.py)."""
+        aff = pod.spec.affinity
+        if aff is None:
+            return False
+        for group in (aff.pod_affinity, aff.pod_anti_affinity):
+            if group is None:
+                continue
+            tks = {t.topology_key for t in group.required}
+            if len(tks) > 1:
+                return True
+        return False
+
+    def _ns_set(self, pod: api.Pod, terms) -> List[int]:
+        """Intersection of the terms' namespace sets (each term: explicit
+        list, or the pod's own namespace) as interned ids."""
+        v = self.vocabs
+        sets_ = []
+        for t in terms:
+            names = set(t.namespaces) if t.namespaces else {pod.namespace}
+            sets_.append(names)
+        inter = set.intersection(*sets_) if sets_ else set()
+        return sorted(v.namespaces.intern(n) for n in inter)
+
+    def _compile_combined(self, terms, IE: int, IV: int):
+        """All required terms' selectors concatenated into one AND program
+        (metadata-path semantics: podMatchesAffinityTermProperties matches
+        ALL properties). Returns None if caps too small; 'nothing' if any
+        selector is nil."""
+        reqs = []
+        for t in terms:
+            if t.label_selector is None:
+                return "nothing"
+            reqs.extend(t.label_selector.to_selector().requirements)
+        if len(reqs) > IE:
+            return None
+        return self._compile_reqs(reqs, self.vocabs.pod_label_keys, IE, IV,
+                                  node_space=False)
+
+    def _featurize_interpod(self, pod: api.Pod, d: Dict[str, np.ndarray]):
+        v = self.vocabs
+        c = self.snap.caps
+        # the pod's own labels in pod-label key space (matched against
+        # existing pods' term selectors and wave-internal programs)
+        for key in pod.metadata.labels or {}:
+            kid = v.pod_label_keys.intern(key)
+            if kid >= self.snap.caps.KP:
+                self.snap._grow(KP=kid + 1)
+        c = self.snap.caps
+        pl = np.zeros((c.KP,), np.int32)
+        for key, val in (pod.metadata.labels or {}).items():
+            pl[v.pod_label_keys.intern(key)] = v.label_values.intern(val)
+        d["pl_val"] = pl
+
+        aff = pod.spec.affinity
+        pa_terms = []  # (signed weight, term)
+        for side, sign in ((aff.pod_affinity if aff else None, 1.0),
+                           (aff.pod_anti_affinity if aff else None, -1.0)):
+            if side is not None:
+                pa_terms.extend((sign * wt.weight, wt.pod_affinity_term)
+                                for wt in side.preferred if wt.weight)
+        for req_name, side in (("ra", aff.pod_affinity if aff else None),
+                               ("rn", aff.pod_anti_affinity if aff else None)):
+            terms = list(side.required) if side is not None else []
+            d[f"{req_name}_has"] = np.bool_(bool(terms))
+            while True:
+                c = self.snap.caps
+                prog = self._compile_combined(terms, c.IE, c.IV)
+                if prog is None:
+                    nreq = sum(len(t.label_selector.to_selector().requirements)
+                               for t in terms if t.label_selector is not None)
+                    nval = max((len(r.values)
+                                for t in terms if t.label_selector is not None
+                                for r in t.label_selector.to_selector().requirements),
+                               default=0)
+                    self.snap._grow(IE=max(nreq, c.IE + 1), IV=nval)
+                    continue
+                break
+            c = self.snap.caps
+            if prog == "nothing":
+                key = np.zeros((c.IE,), np.int32)
+                op = np.full((c.IE,), enc.OP_PAD, np.int32)
+                op[0] = enc.OP_FALSE
+                vals = np.full((c.IE, c.IV), -1, np.int32)
+                num = np.full((c.IE,), np.nan, np.float32)
+                prog = (key, op, vals, num)
+            d[f"{req_name}_key"], d[f"{req_name}_op"], d[f"{req_name}_vals"], _ = prog
+            ns_ids = self._ns_set(pod, terms)
+            if len(ns_ids) > c.TNS:
+                self.snap._grow(TNS=len(ns_ids))
+                c = self.snap.caps
+            ns_row = np.zeros((c.TNS,), np.int32)
+            ns_row[: len(ns_ids)] = ns_ids
+            d[f"{req_name}_ns"] = ns_row
+            # shared topology key (single-tk fast path; multi-tk pods were
+            # routed host-side by needs_host_path)
+            tk = terms[0].topology_key if terms else ""
+            d[f"{req_name}_tk"] = np.int32(self.snap.label_key_col(tk) if tk else 0)
+        # bootstrap rule input: does the pod match its own affinity props?
+        ra_terms = list(aff.pod_affinity.required) if (aff and aff.pod_affinity) else []
+        self_match = bool(ra_terms)
+        for t in ra_terms:
+            names = set(t.namespaces) if t.namespaces else {pod.namespace}
+            if pod.namespace not in names or t.label_selector is None or \
+                    not t.label_selector.matches(pod.metadata.labels):
+                self_match = False
+                break
+        d["ra_self"] = np.bool_(self_match)
+        # preferred terms (priority)
+        if len(pa_terms) > self.snap.caps.PA:
+            self.snap._grow(PA=len(pa_terms))
+        c = self.snap.caps
+        pa_w = np.zeros((c.PA,), np.float32)
+        pa_tk = np.zeros((c.PA,), np.int32)
+        pa_ns = np.zeros((c.PA, c.TNS), np.int32)
+        pa_key = np.zeros((c.PA, c.TE), np.int32)
+        pa_op = np.full((c.PA, c.TE), enc.OP_PAD, np.int32)
+        pa_vals = np.full((c.PA, c.TE, c.TV), -1, np.int32)
+        for i, (w, term) in enumerate(pa_terms):
+            while True:
+                c = self.snap.caps
+                if term.label_selector is None:
+                    prog = "nothing"
+                else:
+                    reqs = term.label_selector.to_selector().requirements
+                    prog = self._compile_reqs(reqs, v.pod_label_keys, c.TE, c.TV,
+                                              node_space=False)
+                    if prog is None:
+                        self.snap._grow(TE=len(reqs),
+                                        TV=max((len(r.values) for r in reqs), default=0))
+                        # caps grew: restart the whole preferred-term loop with
+                        # freshly sized arrays
+                        return self._featurize_interpod(pod, d)
+                break
+            pa_w[i] = w
+            pa_tk[i] = self.snap.label_key_col(term.topology_key) if term.topology_key else 0
+            ns_ids = self._ns_set(pod, [term])
+            if len(ns_ids) > c.TNS:
+                self.snap._grow(TNS=len(ns_ids))
+                return self._featurize_interpod(pod, d)
+            pa_ns[i, : len(ns_ids)] = ns_ids
+            if prog == "nothing":
+                pa_op[i, 0] = enc.OP_FALSE
+            else:
+                pa_key[i], pa_op[i], pa_vals[i], _ = prog
+        d["pa_w"], d["pa_tk"], d["pa_ns"] = pa_w, pa_tk, pa_ns
+        d["pa_key"], d["pa_op"], d["pa_vals"] = pa_key, pa_op, pa_vals
 
     # -- batch ----------------------------------------------------------------
 
@@ -343,6 +506,26 @@ class PodFeaturizer:
             sg_op=stack("sg_op", (c.SG, c.SE), np.int32, enc.OP_PAD),
             sg_vals=stack("sg_vals", (c.SG, c.SE, c.SV), np.int32, -1),
             sg_num=stack("sg_num", (c.SG, c.SE), np.float32, np.nan),
+            pl_val=stack("pl_val", (c.KP,), np.int32),
+            ra_has=stack("ra_has", (), bool),
+            ra_key=stack("ra_key", (c.IE,), np.int32),
+            ra_op=stack("ra_op", (c.IE,), np.int32, enc.OP_PAD),
+            ra_vals=stack("ra_vals", (c.IE, c.IV), np.int32, -1),
+            ra_ns=stack("ra_ns", (c.TNS,), np.int32),
+            ra_tk=stack("ra_tk", (), np.int32),
+            ra_self=stack("ra_self", (), bool),
+            rn_has=stack("rn_has", (), bool),
+            rn_key=stack("rn_key", (c.IE,), np.int32),
+            rn_op=stack("rn_op", (c.IE,), np.int32, enc.OP_PAD),
+            rn_vals=stack("rn_vals", (c.IE, c.IV), np.int32, -1),
+            rn_ns=stack("rn_ns", (c.TNS,), np.int32),
+            rn_tk=stack("rn_tk", (), np.int32),
+            pa_w=stack("pa_w", (c.PA,), np.float32),
+            pa_tk=stack("pa_tk", (c.PA,), np.int32),
+            pa_ns=stack("pa_ns", (c.PA, c.TNS), np.int32),
+            pa_key=stack("pa_key", (c.PA, c.TE), np.int32),
+            pa_op=stack("pa_op", (c.PA, c.TE), np.int32, enc.OP_PAD),
+            pa_vals=stack("pa_vals", (c.PA, c.TE, c.TV), np.int32, -1),
             owned=stack("owned", (), bool),
             img_id=stack("img_id", (c.PI,), np.int32),
             prio=stack("prio", (), np.int32),
@@ -362,4 +545,11 @@ class PodFeaturizer:
             and d["ports"].shape == (c.PQ,)
             and d["sg_key"].shape == (c.SG, c.SE)
             and d["sg_vals"].shape == (c.SG, c.SE, c.SV)
+            and d["pl_val"].shape == (c.KP,)
+            and d["ra_key"].shape == (c.IE,)
+            and d["ra_vals"].shape == (c.IE, c.IV)
+            and d["ra_ns"].shape == (c.TNS,)
+            and d["pa_key"].shape == (c.PA, c.TE)
+            and d["pa_vals"].shape == (c.PA, c.TE, c.TV)
+            and d["pa_ns"].shape == (c.PA, c.TNS)
         )

@@ -49,6 +49,11 @@ class Snapshot:
         self._free_slots: List[int] = []
         self._next_slot = 0
         self._alloc_pods()
+        # inter-pod affinity term table
+        self.term_rows: Dict[str, List[int]] = {}  # pod uid -> row indices
+        self._free_terms: List[int] = []
+        self._next_term = 0
+        self._alloc_terms()
         self.dirty_resources = True
         self.dirty_topology = True
         self.dirty_pods = True
@@ -84,6 +89,19 @@ class Snapshot:
         self.ep_valid = np.zeros((c.M,), bool)
         self.ep_alive = np.zeros((c.M,), bool)
 
+    def _alloc_terms(self):
+        c = self.caps
+        self.t_kind = np.zeros((c.E,), np.int32)
+        self.t_owner = np.zeros((c.E,), np.int32)
+        self.t_node = np.zeros((c.E,), np.int32)
+        self.t_tk = np.zeros((c.E,), np.int32)
+        self.t_weight = np.zeros((c.E,), np.float32)
+        self.t_ns = np.zeros((c.E, c.TNS), np.int32)
+        self.t_key = np.zeros((c.E, c.TE), np.int32)
+        self.t_op = np.full((c.E, c.TE), enc.OP_PAD, np.int32)
+        self.t_vals = np.full((c.E, c.TE, c.TV), -1, np.int32)
+        self.t_valid = np.zeros((c.E,), bool)
+
     def _grow(self, **dims):
         """Grow capacity dims, preserving data. Triggers jit retrace."""
         c = self.caps
@@ -118,6 +136,16 @@ class Snapshot:
         self.ep_node = pad(self.ep_node, (c.M,))
         self.ep_valid = pad(self.ep_valid, (c.M,))
         self.ep_alive = pad(self.ep_alive, (c.M,))
+        self.t_kind = pad(self.t_kind, (c.E,))
+        self.t_owner = pad(self.t_owner, (c.E,))
+        self.t_node = pad(self.t_node, (c.E,))
+        self.t_tk = pad(self.t_tk, (c.E,))
+        self.t_weight = pad(self.t_weight, (c.E,))
+        self.t_ns = pad(self.t_ns, (c.E, c.TNS))
+        self.t_key = pad(self.t_key, (c.E, c.TE))
+        self.t_op = pad(self.t_op, (c.E, c.TE), enc.OP_PAD)
+        self.t_vals = pad(self.t_vals, (c.E, c.TE, c.TV), -1)
+        self.t_valid = pad(self.t_valid, (c.E,))
         self.dirty_resources = self.dirty_topology = self.dirty_pods = True
 
     # ---- resource columns ---------------------------------------------------
@@ -240,6 +268,7 @@ class Snapshot:
                     if stale[slot]:
                         del self.pod_slot[uid]
                         self._free_slots.append(slot)
+                        self._clear_pod_terms(uid)
                 self.dirty_pods = True
             self.dirty_topology = True
 
@@ -292,6 +321,7 @@ class Snapshot:
         self.ep_node[slot] = node_idx
         self.ep_valid[slot] = True
         self.ep_alive[slot] = pod.metadata.deletion_timestamp is None
+        self._set_pod_terms(pod, slot, node_idx)
         self.dirty_pods = True
 
     def remove_pod(self, pod: api.Pod):
@@ -300,7 +330,118 @@ class Snapshot:
             self.ep_valid[slot] = False
             self.ep_alive[slot] = False
             self._free_slots.append(slot)
+            self._clear_pod_terms(pod.uid)
             self.dirty_pods = True
+
+    # ---- inter-pod affinity term table --------------------------------------
+
+    def label_key_col(self, key: str) -> int:
+        """Intern a node-label key (e.g. an affinity topologyKey), growing
+        the label matrix so the column is addressable."""
+        kid = self.vocabs.label_keys.intern(key)
+        if kid >= self.caps.K:
+            self._grow(K=kid + 1)
+        return kid
+
+    def compile_term_selector(self, selector) -> Optional[List[Tuple[int, int, List[int]]]]:
+        """LabelSelector -> [(key, op, vals)] over pod-label space, interning.
+        None selector matches nothing (LabelSelectorAsSelector(nil) ->
+        labels.Nothing(), apimachinery meta/v1/helpers.go)."""
+        if selector is None:
+            return None
+        v = self.vocabs
+        out: List[Tuple[int, int, List[int]]] = []
+        for r in selector.to_selector().requirements:
+            kid = v.pod_label_keys.intern(r.key)
+            if kid >= self.caps.KP:
+                self._grow(KP=kid + 1)
+            vals = [v.label_values.intern(val) for val in r.values]
+            out.append((kid, enc.op_id(r.op), vals))
+        return out
+
+    def _iter_pod_terms(self, pod: api.Pod):
+        """(kind, weight, PodAffinityTerm) for every term the pod carries."""
+        aff = pod.spec.affinity
+        if aff is None:
+            return
+        if aff.pod_affinity is not None:
+            for t in aff.pod_affinity.required:
+                yield enc.TERM_REQ_AFF, 1.0, t
+            for wt in aff.pod_affinity.preferred:
+                yield enc.TERM_PREF_AFF, float(wt.weight), wt.pod_affinity_term
+        if aff.pod_anti_affinity is not None:
+            for t in aff.pod_anti_affinity.required:
+                yield enc.TERM_REQ_ANTI, 1.0, t
+            for wt in aff.pod_anti_affinity.preferred:
+                yield enc.TERM_PREF_ANTI, float(wt.weight), wt.pod_affinity_term
+
+    def _set_pod_terms(self, pod: api.Pod, slot: int, node_idx: int):
+        self._clear_pod_terms(pod.uid)
+        terms = list(self._iter_pod_terms(pod))
+        if not terms:
+            return
+        v = self.vocabs
+        rows: List[int] = []
+        for kind, weight, term in terms:
+            prog = self.compile_term_selector(term.label_selector)
+            ns_ids = ([v.namespaces.intern(n) for n in term.namespaces]
+                      if term.namespaces else [v.namespaces.intern(pod.namespace)])
+            if len(ns_ids) > self.caps.TNS:
+                self._grow(TNS=len(ns_ids))
+            if prog is not None:
+                if len(prog) > self.caps.TE:
+                    self._grow(TE=len(prog))
+                if any(len(vals) > self.caps.TV for _, _, vals in prog):
+                    self._grow(TV=max(len(vals) for _, _, vals in prog))
+            if self._free_terms:
+                row = self._free_terms.pop()
+            else:
+                row = self._next_term
+                self._next_term += 1
+                if row >= self.caps.E:
+                    self._grow(E=row + 1)
+            c = self.caps
+            self.t_kind[row] = kind
+            self.t_owner[row] = slot
+            self.t_node[row] = node_idx
+            # empty topologyKey: only legal for preferred anti-affinity in the
+            # reference (validation); a 0 id never matches any topology.
+            self.t_tk[row] = self.label_key_col(term.topology_key) if term.topology_key else 0
+            self.t_weight[row] = weight
+            self.t_ns[row, :] = 0
+            self.t_ns[row, : len(ns_ids)] = ns_ids
+            self.t_key[row, :] = 0
+            self.t_op[row, :] = enc.OP_PAD
+            self.t_vals[row, :, :] = -1
+            if prog is None:
+                self.t_op[row, 0] = enc.OP_FALSE  # nil selector matches nothing
+            else:
+                for i, (kid, op, vals) in enumerate(prog):
+                    self.t_key[row, i] = kid
+                    self.t_op[row, i] = op
+                    self.t_vals[row, i, : len(vals)] = vals
+            self.t_valid[row] = True
+            rows.append(row)
+        self.term_rows[pod.uid] = rows
+
+    def _clear_pod_terms(self, uid: str):
+        for row in self.term_rows.pop(uid, ()):
+            self.t_valid[row] = False
+            self.t_kind[row] = enc.TERM_PAD
+            self.t_op[row, :] = enc.OP_PAD
+            self._free_terms.append(row)
+
+    @property
+    def has_affinity_terms(self) -> bool:
+        return bool(self.term_rows)
+
+    @property
+    def num_label_values(self) -> int:
+        """Bucketed label-value vocab size — the segment count for
+        topology-domain anchoring in ops/affinity.py."""
+        if self.vocabs.label_values.size > self.caps.LV:
+            self.caps.LV = bucket_size(self.vocabs.label_values.size, self.caps.LV)
+        return self.caps.LV
 
     # ---- device views -------------------------------------------------------
 
@@ -321,13 +462,21 @@ class Snapshot:
             valid=self.ep_valid, alive=self.ep_alive,
         )
 
-    def to_device(self, device=None) -> Tuple[enc.NodeTensors, enc.PodMatrix]:
+    def term_table(self) -> enc.TermTable:
+        return enc.TermTable(
+            kind=self.t_kind, owner=self.t_owner, node=self.t_node,
+            tk=self.t_tk, weight=self.t_weight, ns=self.t_ns,
+            key=self.t_key, op=self.t_op, vals=self.t_vals, valid=self.t_valid,
+        )
+
+    def to_device(self, device=None) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.TermTable]:
         """Upload dirty groups; reuse cached device arrays otherwise."""
         import jax
 
         cache = self._device_cache
         shapes_key = (self.caps.N, self.caps.K, self.caps.KP, self.caps.R,
-                      self.caps.T, self.caps.PP, self.caps.NI, self.caps.M)
+                      self.caps.T, self.caps.PP, self.caps.NI, self.caps.M,
+                      self.caps.E, self.caps.TE, self.caps.TV, self.caps.TNS)
         if cache.get("shapes") != shapes_key:
             cache.clear()
             cache["shapes"] = shapes_key
@@ -350,11 +499,19 @@ class Snapshot:
                 (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid, self.ep_alive),
                 device,
             )
+            cache["terms"] = jax.device_put(
+                (self.t_kind, self.t_owner, self.t_node, self.t_tk,
+                 self.t_weight, self.t_ns, self.t_key, self.t_op, self.t_vals,
+                 self.t_valid),
+                device,
+            )
             self.dirty_pods = False
         requested, nonzero, pod_count, ports = cache["res"]
         (alloc, allowed_pods, labels, label_nums, taint_key, taint_val,
          taint_effect, cond, zone_id, img_id, img_size, avoid, valid) = cache["topo"]
         ep_labels, ep_ns, ep_node, ep_valid, ep_alive = cache["pods"]
+        (t_kind, t_owner, t_node, t_tk, t_weight, t_ns, t_key, t_op, t_vals,
+         t_valid) = cache["terms"]
         nt = enc.NodeTensors(
             alloc=alloc, requested=requested, nonzero=nonzero,
             pod_count=pod_count, allowed_pods=allowed_pods, labels=labels,
@@ -364,4 +521,7 @@ class Snapshot:
         )
         pm = enc.PodMatrix(labels=ep_labels, ns=ep_ns, node=ep_node,
                            valid=ep_valid, alive=ep_alive)
-        return nt, pm
+        tt = enc.TermTable(kind=t_kind, owner=t_owner, node=t_node, tk=t_tk,
+                           weight=t_weight, ns=t_ns, key=t_key, op=t_op,
+                           vals=t_vals, valid=t_valid)
+        return nt, pm, tt

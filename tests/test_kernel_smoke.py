@@ -26,13 +26,15 @@ def build_world(nodes, scheduled_pods=()):
     return cache, snap
 
 
-def run_wave(snap, pods, weights=Weights()):
-    feat = PodFeaturizer(snap)
+def run_wave(snap, pods, weights=Weights(), feat=None, has_ipa=False):
+    feat = feat or PodFeaturizer(snap)
     pb = feat.featurize(pods)
-    nt, pm = snap.to_device()
+    nt, pm, tt = snap.to_device()
     extra = np.ones((pb.req.shape[0], snap.caps.N), bool)
-    res = schedule_wave(nt, pm, pb, extra, 0, weights=weights,
-                        num_zones=snap.caps.Z)
+    res = schedule_wave(nt, pm, tt, pb, extra, 0, weights=weights,
+                        num_zones=snap.caps.Z,
+                        num_label_values=snap.num_label_values,
+                        has_ipa=has_ipa or snap.has_affinity_terms)
     return res
 
 
@@ -113,10 +115,7 @@ def test_selector_spreading():
 
     feat = PodFeaturizer(
         snap, group_selectors=lambda pod: [Selector.from_set({"app": "web"})])
-    pb = feat.featurize([make_pod("p", labels={"app": "web"}, owner_uid="rs1")])
-    nt, pm = snap.to_device()
-    extra = np.ones((pb.req.shape[0], snap.caps.N), bool)
-    res = schedule_wave(nt, pm, pb, extra, 0, weights=Weights(),
-                        num_zones=snap.caps.Z)
+    res = run_wave(snap, [make_pod("p", labels={"app": "web"}, owner_uid="rs1")],
+                   feat=feat)
     # must avoid n0 (it already holds a replica)
     assert snap.node_names[int(res.chosen[0])] != "n0"

@@ -139,7 +139,7 @@ def test_predicate_parity(seed):
     cache, snap = build(nodes, existing)
     feat = PodFeaturizer(snap)
     pb = feat.featurize(pods)
-    nt, pm = snap.to_device()
+    nt, pm, tt = snap.to_device()
     R = nt.alloc.shape[1]
     is_core = jnp.arange(R) < enc.RES_FIXED
     masks = np.asarray(filters.static_predicate_masks(nt, pb, is_core))
@@ -147,6 +147,8 @@ def test_predicate_parity(seed):
         for ni_idx, node in enumerate(nodes):
             ninfo = cache.node_infos[node.name]
             for q, name in enumerate(enc.DEVICE_PREDICATES):
+                if name == "MatchInterPodAffinity":
+                    continue  # parity covered in test_interpod.py
                 dev = bool(masks[q, pi, ni_idx])
                 if name == "CheckNodeCondition":
                     ok, reasons = golden.check_node_condition(pod, ninfo)
@@ -170,7 +172,7 @@ def test_score_parity(seed):
     cache, snap = build(nodes, existing)
     feat = PodFeaturizer(snap)
     pb = feat.featurize(pods)
-    nt, pm = snap.to_device()
+    nt, pm, tt = snap.to_device()
 
     aff_raw = np.asarray(scores.node_affinity_raw(nt, pb))
     taint_raw = np.asarray(scores.taint_intolerable_raw(nt, pb))
@@ -212,7 +214,7 @@ def test_spread_parity(seed):
 
     feat = PodFeaturizer(snap, group_selectors=group_selectors)
     pb = feat.featurize(pods)
-    nt, pm = snap.to_device()
+    nt, pm, tt = snap.to_device()
     cnt = np.asarray(scores.spread_counts(pm, pb, snap.caps.N))
     for pi, pod in enumerate(pods):
         sels = group_selectors(pod)
