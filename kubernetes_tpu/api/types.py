@@ -327,6 +327,50 @@ def get_zone_key(node: Node) -> str:
     return region + ":\x00:" + zone
 
 
+# --- persistent volumes ------------------------------------------------------
+
+
+@dataclass
+class PersistentVolumeSpec:
+    # Volume source (same convention as Volume.source_kind/source_id):
+    # "AWSElasticBlockStore" | "GCEPersistentDisk" | "AzureDisk" | ...
+    source_kind: str = ""
+    source_id: str = ""
+    capacity: Dict[str, int] = field(default_factory=dict)
+    storage_class_name: str = ""
+    # Volume topology constraint (reference: 1.11-era PVs carry zone/region
+    # labels consumed by VolumeZone, predicates.go:582; node affinity on PVs
+    # is the VolumeScheduling-gated successor checked by VolumeBinding).
+    node_affinity: Optional[NodeSelector] = None
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    storage_class_name: str = ""
+    volume_name: str = ""  # non-empty once bound to a PV
+    requests: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+
 # --- workload owners (for spreading) & PDBs ---------------------------------
 
 

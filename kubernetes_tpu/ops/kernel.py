@@ -80,13 +80,20 @@ class WaveResult(NamedTuple):
 @functools.partial(jax.jit, static_argnames=(
     "weights", "num_zones", "num_label_values", "has_ipa"))
 def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
-                  pb: enc.PodBatch, extra_mask, rr_start, *, weights: Weights,
+                  pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
+                  *, weights: Weights,
                   num_zones: int, num_label_values: int = 64,
                   has_ipa: bool = False) -> WaveResult:
     """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
     volume predicates) for the rare pods that need them; all-True rows for
     everyone else. Appended to the mask stack as a final "HostPlugins"
     pseudo-predicate for failure attribution.
+
+    extra_scores: optional f32 [P, N] — host-evaluated Score contributions
+    (policy host priorities, HTTP extender Prioritize), pre-multiplied by
+    their weights; added to the device weighted sum before argmax
+    (reference: generic_scheduler.go:650 folds extender priorities into
+    the same result list).
 
     has_ipa (static): compiles the inter-pod affinity path in. When no
     affinity terms exist anywhere (the common case), the False variant
@@ -116,6 +123,8 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         static_score += w.image_locality * image_locality(nt, pb)
     if w.prefer_avoid:
         static_score += w.prefer_avoid * prefer_avoid(nt, pb)
+    if extra_scores is not None:
+        static_score += extra_scores
     P = pb.req.shape[0]
     if aff_raw is None:
         aff_raw = jnp.zeros((P, N), jnp.float32)

@@ -160,6 +160,84 @@ def no_disk_conflict(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
     return True, []
 
 
+# Only pods using special volume sources can fail NoDiskConflict — lets the
+# host-plugin runner skip it wholesale (Scheduler._host_plugin_mask).
+no_disk_conflict.relevant = lambda pod: any(
+    v.source_kind for v in pod.spec.volumes)
+
+
+def new_node_label_presence(labels: Sequence[str], presence: bool):
+    """predicates.go:1457 NewNodeLabelPredicate (CheckNodeLabelPresence):
+    every listed label must be present (presence=True) / absent (False) on
+    the node, values ignored. Policy-configured (api/types.go
+    LabelsPresence argument)."""
+
+    def pred(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+        if ni.node is None:
+            return False, [REASONS["NodeUnknownCondition"]]
+        node_labels = ni.node.metadata.labels or {}
+        for l in labels:
+            if (l in node_labels) != presence:
+                return False, [REASONS["CheckNodeLabelPresence"]]
+        return True, []
+
+    pred.predicate_name = "CheckNodeLabelPresence"
+    return pred
+
+
+def new_service_affinity(store, labels: Sequence[str]):
+    """predicates.go:852 ServiceAffinity (CheckServiceAffinity): pods of
+    the same service must run on nodes with identical values for the
+    affinity labels. The pod may pin values via its own nodeSelector;
+    otherwise values are adopted from a node already running a pod of the
+    service (predicates.go:928 checkServiceAffinity)."""
+
+    def _wanted_labels(pod: api.Pod) -> Dict[str, str]:
+        """Node-independent precomputation (the reference does this in
+        predicate metadata, predicates.go:905 serviceAffinityMetadataProducer);
+        memoized per (pod, store revision) because the host-plugin runner
+        calls the predicate once per node."""
+        rv = getattr(store, "latest_resource_version", None)
+        cached = getattr(_wanted_labels, "_memo", None)
+        if cached is not None and cached[0] == (pod.uid, rv):
+            return cached[1]
+        want: Dict[str, str] = {k: v for k, v in pod.spec.node_selector.items()
+                                if k in labels}
+        unset = [l for l in labels if l not in want]
+        if unset:
+            # find pods selected by services that select this pod
+            svc_pods: List[api.Pod] = []
+            for svc in store.list("services", pod.namespace):
+                if svc.selector and lbl.Selector.from_set(svc.selector).matches(
+                        pod.metadata.labels):
+                    for p in store.list("pods", pod.namespace):
+                        if p.uid != pod.uid and p.spec.node_name and \
+                                lbl.Selector.from_set(svc.selector).matches(
+                                    p.metadata.labels):
+                            svc_pods.append(p)
+            if svc_pods:
+                # anchor node may have been deleted while its pods linger
+                anchor = store.get("nodes", "default", svc_pods[0].spec.node_name)
+                if anchor is not None:
+                    for l in unset:
+                        if l in (anchor.metadata.labels or {}):
+                            want[l] = anchor.metadata.labels[l]
+        _wanted_labels._memo = ((pod.uid, rv), want)
+        return want
+
+    def pred(pod: api.Pod, ni: NodeInfo) -> PredicateResult:
+        if ni.node is None:
+            return False, [REASONS["NodeUnknownCondition"]]
+        node_labels = ni.node.metadata.labels or {}
+        for k, v in _wanted_labels(pod).items():
+            if node_labels.get(k) != v:
+                return False, [REASONS["CheckServiceAffinity"]]
+        return True, []
+
+    pred.predicate_name = "CheckServiceAffinity"
+    return pred
+
+
 # --- inter-pod affinity ------------------------------------------------------
 
 
@@ -504,6 +582,80 @@ def image_locality_map(pod: api.Pod, ni: NodeInfo) -> int:
     if total >= 1000 * mb:
         return 10
     return int(10 * (total - 23 * mb) // (1000 * mb - 23 * mb)) + 1
+
+
+def equal_priority_map(pod: api.Pod, ni: NodeInfo) -> int:
+    """core/generic_scheduler.go:1072 EqualPriorityMap — constant 1."""
+    return 1
+
+
+def resource_limits_map(pod: api.Pod, ni: NodeInfo) -> int:
+    """priorities/resource_limits.go:36 ResourceLimitsPriorityMap: score 1
+    if the node's allocatable satisfies the pod's (non-zero) cpu+memory
+    limits, else 0."""
+    cpu = mem = 0
+    for c in pod.spec.containers:
+        cpu += c.resources.limits.get(res.CPU, 0)
+        mem += c.resources.limits.get(res.MEMORY, 0)
+    if cpu == 0 and mem == 0:
+        return 0
+    cpu_ok = cpu == 0 or ni.allocatable.milli_cpu >= cpu
+    mem_ok = mem == 0 or ni.allocatable.memory >= mem
+    return 1 if (cpu_ok and mem_ok) else 0
+
+
+def new_node_label_priority(label: str, presence: bool):
+    """priorities/node_label.go:47 CalculateNodeLabelPriorityMap: 10 when
+    label presence matches the preference, else 0. Policy-configured
+    (LabelPreference argument)."""
+
+    def score(pod: api.Pod, ni: NodeInfo) -> int:
+        if ni.node is None:
+            return 0
+        exists = label in (ni.node.metadata.labels or {})
+        return 10 if exists == presence else 0
+
+    score.priority_name = "NodeLabelPriority"
+    return score
+
+
+def new_service_anti_affinity(store, label: str):
+    """priorities/selector_spreading.go:184 ServiceAntiAffinity: spread
+    pods of a service across values of a node label. Map counts the
+    service's pods on each node; Reduce groups by label value and scores
+    10*(max-group)/max (selector_spreading.go:221 CalculateAntiAffinityPriorityReduce)."""
+
+    def service_selectors(pod: api.Pod) -> List[lbl.Selector]:
+        return [lbl.Selector.from_set(svc.selector)
+                for svc in store.list("services", pod.namespace)
+                if svc.selector and lbl.Selector.from_set(svc.selector).matches(
+                    pod.metadata.labels)]
+
+    def score_nodes(pod: api.Pod, node_infos: Dict[str, NodeInfo]) -> Dict[str, int]:
+        sels = service_selectors(pod)
+        counts: Dict[str, int] = {}
+        for name, ni in node_infos.items():
+            c = 0
+            if sels:
+                for p in ni.pods:
+                    if p.namespace == pod.namespace and \
+                            any(s.matches(p.metadata.labels) for s in sels):
+                        c += 1
+            counts[name] = c
+        # group by label value
+        group: Dict[str, int] = {}
+        for name, ni in node_infos.items():
+            v = (ni.node.metadata.labels or {}).get(label, "") if ni.node else ""
+            group[v] = group.get(v, 0) + counts[name]
+        max_g = max(group.values(), default=0)
+        out = {}
+        for name, ni in node_infos.items():
+            v = (ni.node.metadata.labels or {}).get(label, "") if ni.node else ""
+            out[name] = (10 * (max_g - group[v]) // max_g) if max_g > 0 else 0
+        return out
+
+    score_nodes.priority_name = "ServiceAntiAffinityPriority"
+    return score_nodes
 
 
 def normalize_reduce(scores: Dict[str, int], reverse: bool) -> Dict[str, int]:

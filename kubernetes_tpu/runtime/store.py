@@ -127,6 +127,13 @@ class ObjectStore:
         with self._lock:
             return len(self._objects.get(kind, {}))
 
+    @property
+    def latest_resource_version(self) -> int:
+        """Monotonic global revision (analog of etcd's header revision,
+        storage/etcd3/store.go) — usable as a cheap cache-invalidation key."""
+        with self._lock:
+            return self._rv
+
     # -- pod subresources ------------------------------------------------------
 
     def bind(self, pod: api.Pod, node_name: str):

@@ -53,7 +53,17 @@ UNRESOLVABLE = frozenset({
     "NoVolumeZoneConflict",  # ErrVolumeZoneConflict
     "VolumeNodeAffinityConflict",
     "VolumeBindingNoMatch",
+    # Extender filter rejections: conservative — evicting victims cannot be
+    # shown to help a node an extender rejected, unless the extender itself
+    # participates in preemption (process_preemption_with_extenders), which
+    # operates on the remaining candidates anyway.
+    "ExtenderFilter",
 })
+
+
+# Reverse lookup: human reason string -> predicate/error key. Built once;
+# REASONS values are unique by construction.
+REASON_KEYS = {v: k for k, v in REASONS.items()}
 
 
 def insufficient_resource_reason(resource: str) -> str:

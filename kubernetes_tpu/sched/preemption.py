@@ -86,11 +86,15 @@ def select_victims_on_node(
         pod: api.Pod, ni: NodeInfo,
         pdbs: Sequence[api.PodDisruptionBudget],
         node_infos: Optional[Dict[str, NodeInfo]] = None,
+        extra_fit: Optional[Callable[[api.Pod, NodeInfo], bool]] = None,
         ) -> Optional[Tuple[List[api.Pod], int]]:
     """Reference :898. Returns (victims, numPDBViolations) or None.
     node_infos enables inter-pod affinity in the what-if (the cloned
     NodeInfo overrides the node under test, like meta.RemovePod keeps the
-    shared metadata consistent, metadata.go:141)."""
+    shared metadata consistent, metadata.go:141). extra_fit folds the
+    scheduler's host plugins (volume predicates etc.) into the what-if —
+    victim removal can resolve NoDiskConflict/MaxVolumeCount, and nodes
+    failing unresolvable host predicates must not produce victims."""
     copy = ni.clone()
     view = (golden.ClusterView(node_infos, override=copy)
             if node_infos is not None else None)
@@ -99,8 +103,12 @@ def select_victims_on_node(
     for p in potential:
         copy.remove_pod(p)
     potential.sort(key=api.pod_priority, reverse=True)
-    fits, _ = golden.pod_fits_on_node(pod, copy, view=view)
-    if not fits:
+
+    def fits_now() -> bool:
+        ok, _ = golden.pod_fits_on_node(pod, copy, view=view)
+        return ok and (extra_fit is None or extra_fit(pod, copy))
+
+    if not fits_now():
         return None
     victims: List[api.Pod] = []
     num_violating = 0
@@ -108,7 +116,7 @@ def select_victims_on_node(
 
     def reprieve(p: api.Pod) -> bool:
         copy.add_pod(p)
-        ok, _ = golden.pod_fits_on_node(pod, copy, view=view)
+        ok = fits_now()
         if not ok:
             copy.remove_pod(p)
             victims.append(p)
@@ -141,10 +149,44 @@ def pick_one_node(candidates: Dict[str, Tuple[List[api.Pod], int]]) -> Optional[
     return names[0]
 
 
+def process_preemption_with_extenders(
+        pod: api.Pod, candidates: Dict[str, Tuple[List[api.Pod], int]],
+        extenders, pdbs: Sequence[api.PodDisruptionBudget] = (),
+        ) -> Dict[str, Tuple[List[api.Pod], int]]:
+    """Reference :241 processPreemptionWithExtenders: each preemption-aware
+    extender may drop candidate nodes or trim their victim lists. PDB
+    violation counts are recomputed for trimmed lists so pick_one_node's
+    first criterion stays accurate. An unreachable ignorable extender is
+    skipped; a non-ignorable one aborts preemption for this attempt
+    (reference returns the error up, failing the preempt() call)."""
+    for ext in extenders:
+        if not candidates or not ext.supports_preemption():
+            continue
+        try:
+            kept = ext.process_preemption(
+                pod, {n: vs for n, (vs, _) in candidates.items()})
+        except Exception:
+            if ext.ignorable:
+                continue
+            return {}
+        new: Dict[str, Tuple[List[api.Pod], int]] = {}
+        for n, (vs, nviol) in candidates.items():
+            if n not in kept:
+                continue
+            trimmed = [v for v in vs if v.uid in set(kept[n])]
+            if len(trimmed) != len(vs):
+                violating, _ = _pods_violating_pdb(trimmed, pdbs)
+                nviol = len(violating)
+            new[n] = (trimmed, nviol)
+        candidates = new
+    return candidates
+
+
 def preempt(pod: api.Pod, cache: SchedulerCache,
             failed_predicates: Dict[str, List[str]],
             pdbs: Sequence[api.PodDisruptionBudget],
-            with_affinity: bool = False) -> Optional[PreemptionResult]:
+            with_affinity: bool = False,
+            extenders=(), extra_fit=None) -> Optional[PreemptionResult]:
     """Reference :200 Preempt. Returns None when preemption can't help.
     with_affinity: evaluate MatchInterPodAffinity in the what-if (pass
     when any affinity terms exist in the cluster)."""
@@ -156,9 +198,12 @@ def preempt(pod: api.Pod, cache: SchedulerCache,
         ni = cache.node_infos.get(node_name)
         if ni is None or ni.node is None:
             continue
-        sel = select_victims_on_node(pod, ni, pdbs, node_infos)
+        sel = select_victims_on_node(pod, ni, pdbs, node_infos, extra_fit)
         if sel is not None:
             candidates[node_name] = sel
+    if extenders:
+        candidates = process_preemption_with_extenders(pod, candidates,
+                                                       extenders, pdbs)
     chosen = pick_one_node(candidates)
     if chosen is None:
         return None

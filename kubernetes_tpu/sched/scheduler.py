@@ -44,7 +44,8 @@ from ..state.featurize import PodFeaturizer
 from ..state.snapshot import Snapshot
 from ..utils import Metrics, PodBackoff, Trace
 from ..utils.feature_gates import FeatureGates
-from .errors import REASONS, FitError, insufficient_resource_reason
+from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
+from .extender import ExtenderError
 from .preemption import get_lower_priority_nominated_pods, preempt
 from .queue import SchedulingQueue
 
@@ -84,7 +85,7 @@ class Scheduler:
                  clock: Callable[[], float] = time.monotonic,
                  assume_ttl: float = 30.0, caps=None):
         self.store = store
-        self.profile = profile or default_profile()
+        self.profile = profile or default_profile(store)
         self.wave_size = wave_size
         self.features = features or FeatureGates()
         self.clock = clock
@@ -203,14 +204,25 @@ class Scheduler:
         trace = Trace(f"wave of {len(pods)}", clock=self.clock)
         start = self.clock()
         pb = self.featurizer.featurize(pods)
-        extra = self._host_plugin_mask(pods, pb.req.shape[0])
+        try:
+            extra = self._host_plugin_mask(pods, pb.req.shape[0])
+            extra_scores = self._host_score_matrix(pods, pb.req.shape[0])
+        except ExtenderError:
+            # a non-ignorable extender is unreachable: fail only this
+            # attempt — park the wave for retry on the next cluster event /
+            # flush, don't crash the loop (reference: scheduleOne records
+            # the error and MakeDefaultErrorFunc requeues with backoff)
+            for p in pods:
+                self.backoff.get_backoff(p.uid)
+                self.queue.add_unschedulable_if_not_present(p)
+            return placed_host
         trace.step("featurized")
         nt, pm, tt = self.snapshot.to_device()
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
-        res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
+        res = schedule_wave(nt, pm, tt, pb, extra, self._rr, extra_scores,
                             weights=self.profile.weights(),
                             num_zones=self.snapshot.caps.Z,
                             num_label_values=self.snapshot.num_label_values,
@@ -253,6 +265,8 @@ class Scheduler:
             ok, rs = golden.pod_fits_on_node(pod, ni, view=view)
             if ok:
                 for fname, fn in self.profile.host_filters.items():
+                    if getattr(fn, "relevant", None) is not None and not fn.relevant(pod):
+                        continue
                     ok2, rs2 = fn(pod, ni)
                     if not ok2:
                         ok, rs = False, rs2
@@ -263,6 +277,17 @@ class Scheduler:
                 for r in rs[:1]:
                     reasons[r] = reasons.get(r, 0) + 1
                 failed[name] = rs[:1]
+        try:
+            for ext in self.profile.extenders:
+                if ext.filter_verb and feasible:
+                    feasible, ext_failed = ext.filter(pod, feasible)
+                    for n, r in ext_failed.items():
+                        reasons[r] = reasons.get(r, 0) + 1
+                        failed[n] = ["ExtenderFilter"]
+        except ExtenderError:
+            self.backoff.get_backoff(pod.uid)
+            self.queue.add_unschedulable_if_not_present(pod)
+            return 0
         if not feasible:
             self.metrics.pods_failed.inc()
             err = FitError(pod.full_name(), len(self.cache.node_infos), reasons)
@@ -270,9 +295,11 @@ class Scheduler:
                     and not self.profile.disable_preemption):
                 # map reason strings back to predicate names for the
                 # unresolvable filter
-                rev = {v: k for k, v in REASONS.items()}
-                fp = {n: [rev.get(r, r) for r in rs] for n, rs in failed.items()}
-                pr = preempt(pod, self.cache, fp, self._pdbs(), with_affinity=True)
+                fp = {n: [REASON_KEYS.get(r, r) for r in rs]
+                      for n, rs in failed.items()}
+                pr = preempt(pod, self.cache, fp, self._pdbs(), with_affinity=True,
+                             extenders=self.profile.extenders,
+                             extra_fit=self._host_extra_fit)
                 if pr is not None:
                     self._perform_preemption(pod, pr)
             self.backoff.get_backoff(pod.uid)
@@ -284,12 +311,25 @@ class Scheduler:
         ipa_scores = golden.interpod_affinity_priority(
             pod, [self.cache.node_infos[n] for n in feasible], view,
             hard_weight=int(w.hard_pod_affinity))
+        host_scores: Dict[str, float] = {}
+        for _name, (fn, weight) in self.profile.host_scores.items():
+            for node, s in fn(pod, self.cache.node_infos).items():
+                host_scores[node] = host_scores.get(node, 0.0) + weight * s
+        try:
+            for ext in self.profile.extenders:
+                for node, s in ext.prioritize(pod, feasible).items():
+                    host_scores[node] = host_scores.get(node, 0.0) + s
+        except ExtenderError:
+            self.backoff.get_backoff(pod.uid)
+            self.queue.add_unschedulable_if_not_present(pod)
+            return 0
         best_name, best_score = None, None
         for name in feasible:
             ni = self.cache.node_infos[name]
             s = (w.interpod * ipa_scores.get(name, 0)
                  + golden.least_requested_map(pod, ni)
-                 + golden.balanced_allocation_map(pod, ni))
+                 + golden.balanced_allocation_map(pod, ni)
+                 + host_scores.get(name, 0.0))
             if best_score is None or s > best_score:
                 best_name, best_score = name, s
         if best_name is not None and self._commit(pod, best_name):
@@ -311,6 +351,12 @@ class Scheduler:
         self.snapshot.add_pod(bound)
         t0 = self.clock()
         try:
+            # reference scheduler.go:409 GetBinder: an extender with a bind
+            # verb performs the binding; the in-process store is then updated
+            # so informers observe the placement either way
+            binder = next((e for e in self.profile.extenders if e.bind_verb), None)
+            if binder is not None:
+                binder.bind(pod, node_name)
             self.store.bind(pod, node_name)
             self.cache.finish_binding(bound)
         except Exception:
@@ -327,7 +373,8 @@ class Scheduler:
 
     # -- failure path ----------------------------------------------------------
 
-    def _fit_error(self, pod: api.Pod, idx: int, fail_counts) -> FitError:
+    def _fit_error(self, pod: api.Pod, idx: int, fail_counts,
+                   res=None) -> FitError:
         reasons: Dict[str, int] = {}
         for q, name in enumerate(enc.MASK_STACK_NAMES):
             c = int(fail_counts[q, idx])
@@ -336,7 +383,21 @@ class Scheduler:
             if name == "PodFitsResources":
                 reasons[insufficient_resource_reason("resources")] = c
             elif name == "HostPlugins":
-                reasons[REASONS["NoDiskConflict"]] = c
+                # real per-node reasons recorded by _host_plugin_mask —
+                # counted only for nodes whose FIRST failure was the host
+                # stack (short-circuit attribution, like the device rows)
+                fails = getattr(self, "_wave_host_fails", {}).get(idx, {})
+                if fails and res is not None:
+                    col = np.asarray(res.masks[:, idx, :])  # [Q, N]
+                    valid = self.snapshot.valid
+                    for n, nname in enumerate(self.snapshot.node_names):
+                        if (n < col.shape[1] and valid[n] and not col[q, n]
+                                and col[:q, n].all()):
+                            key = fails.get(nname, "NoDiskConflict")
+                            r = REASONS.get(key, key)
+                            reasons[r] = reasons.get(r, 0) + 1
+                else:
+                    reasons[REASONS["NoDiskConflict"]] = c
             elif name == "CheckNodeCondition":
                 reasons[REASONS["NodeNotReady"]] = c
             elif name == "CheckNodeUnschedulable":
@@ -357,11 +418,15 @@ class Scheduler:
         col = np.asarray(res.masks[:, idx, :])  # [Q, N]
         out: Dict[str, List[str]] = {}
         valid = self.snapshot.valid
+        host_fails = getattr(self, "_wave_host_fails", {}).get(idx, {})
         for n, name in enumerate(self.snapshot.node_names):
             if n < col.shape[1] and valid[n]:
                 fails = np.flatnonzero(~col[:, n])
                 if fails.size:
                     pred = enc.MASK_STACK_NAMES[fails[0]]
+                    if pred == "HostPlugins":
+                        out[name] = [host_fails.get(name, "NoDiskConflict")]
+                        continue
                     if pred == "CheckNodeCondition":
                         # distinguish sub-reasons host-side for the
                         # unresolvable filter
@@ -378,7 +443,7 @@ class Scheduler:
 
     def _handle_failure(self, pod: api.Pod, idx: int, fail_counts, res):
         self.metrics.pods_failed.inc()
-        err = self._fit_error(pod, idx, fail_counts)
+        err = self._fit_error(pod, idx, fail_counts, res)
         if (self.features.enabled("PodPriority")
                 and not self.profile.disable_preemption):
             t0 = self.clock()
@@ -388,7 +453,9 @@ class Scheduler:
                 aff.pod_affinity is not None or aff.pod_anti_affinity is not None)
             pr = preempt(pod, self.cache, self._failed_predicates_by_node(res, idx),
                          self._pdbs(),
-                         with_affinity=self.snapshot.has_affinity_terms or pod_has_ipa)
+                         with_affinity=self.snapshot.has_affinity_terms or pod_has_ipa,
+                         extenders=self.profile.extenders,
+                         extra_fit=self._host_extra_fit)
             self.metrics.preemption_evaluation.observe(self.clock() - t0)
             if pr is not None:
                 self._perform_preemption(pod, pr)
@@ -419,22 +486,86 @@ class Scheduler:
 
     def _host_plugin_mask(self, pods: List[api.Pod], P: int) -> np.ndarray:
         """Evaluate non-tensorized predicates host-side, only for pods that
-        can possibly fail them (e.g. NoDiskConflict needs special volumes)."""
+        can possibly fail them: each host plugin may carry a `relevant(pod)`
+        gate (e.g. volume predicates only fire for pods with PVC/special
+        volumes), mirroring how the reference orders cheap checks first
+        (predicates.go:133).
+
+        Side effect: records the first-failing predicate key per (pod,
+        node) in self._wave_host_fails so FitError reporting and the
+        preemption unresolvable filter see the real reason behind the
+        device mask stack's "HostPlugins" pseudo-predicate."""
         N = self.snapshot.caps.N
         mask = np.ones((P, N), bool)
-        if not self.profile.host_filters:
+        self._wave_host_fails: Dict[int, Dict[str, str]] = {}
+        if not self.profile.host_filters and not self.profile.extenders:
             return mask
         for i, pod in enumerate(pods):
-            needs = any(v.source_kind for v in pod.spec.volumes)
-            if not needs:
-                continue
-            for name, ni_idx in self.snapshot.node_index.items():
-                ni = self.cache.node_infos.get(name)
-                if ni is None:
+            fails: Dict[str, str] = {}
+            fns = [(pname, fn) for pname, fn in self.profile.host_filters.items()
+                   if getattr(fn, "relevant", None) is None or fn.relevant(pod)]
+            if fns:
+                for name, ni_idx in self.snapshot.node_index.items():
+                    ni = self.cache.node_infos.get(name)
+                    if ni is None:
+                        continue
+                    for pname, fn in fns:
+                        ok, rs = fn(pod, ni)
+                        if not ok:
+                            mask[i, ni_idx] = False
+                            fails[name] = REASON_KEYS.get(rs[0], pname) if rs else pname
+                            break
+            for ext in self.profile.extenders:
+                if not ext.filter_verb:
                     continue
-                for fname, fn in self.profile.host_filters.items():
-                    ok, _ = fn(pod, ni)
-                    if not ok:
+                feasible, _failed = ext.filter(
+                    pod, list(self.snapshot.node_index),
+                    node_labels=None if ext.node_cache_capable else {
+                        n: (ni.node.metadata.labels or {})
+                        for n, ni in self.cache.node_infos.items()
+                        if ni.node is not None})
+                keep = {self.snapshot.node_index[n] for n in feasible
+                        if n in self.snapshot.node_index}
+                for name, ni_idx in self.snapshot.node_index.items():
+                    if ni_idx not in keep and mask[i, ni_idx]:
                         mask[i, ni_idx] = False
-                        break
+                        fails[name] = "ExtenderFilter"
+            if fails:
+                self._wave_host_fails[i] = fails
         return mask
+
+    def _host_extra_fit(self, pod: api.Pod, ni) -> bool:
+        """Host filters as a single fit check for preemption's what-if
+        simulation (victim removal can resolve NoDiskConflict /
+        MaxVolumeCount, so the simulation must re-run them)."""
+        for fn in self.profile.host_filters.values():
+            if getattr(fn, "relevant", None) is not None and not fn.relevant(pod):
+                continue
+            ok, _ = fn(pod, ni)
+            if not ok:
+                return False
+        return True
+
+    def _host_score_matrix(self, pods: List[api.Pod], P: int) -> Optional[np.ndarray]:
+        """Host-side Score contributions ([P, N] f32, pre-weighted) from
+        policy host priorities and extender Prioritize webhooks — the
+        kernel's extra_scores input (reference: generic_scheduler.go:615
+        Reduce goroutines + :650 extender prioritize goroutines)."""
+        if not self.profile.host_scores and not any(
+                ext.prioritize_verb for ext in self.profile.extenders):
+            return None
+        N = self.snapshot.caps.N
+        out = np.zeros((P, N), np.float32)
+        idx = self.snapshot.node_index
+        for i, pod in enumerate(pods):
+            for name, (fn, weight) in self.profile.host_scores.items():
+                for node, s in fn(pod, self.cache.node_infos).items():
+                    j = idx.get(node)
+                    if j is not None:
+                        out[i, j] += weight * s
+            for ext in self.profile.extenders:
+                for node, s in ext.prioritize(pod, list(idx)).items():
+                    j = idx.get(node)
+                    if j is not None:
+                        out[i, j] += s
+        return out

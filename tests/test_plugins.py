@@ -1,0 +1,334 @@
+"""Tests for the extended plugin surface: volume predicates, label/service
+predicates, host-side priorities, Policy-file config, and the extra_scores
+kernel input. Mirrors reference table tests in
+pkg/scheduler/algorithm/predicates/predicates_test.go and
+algorithm/priorities/*_test.go."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.plugins import golden, volumes
+from kubernetes_tpu.plugins.registry import Registry, default_profile
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.state.node_info import NodeInfo
+
+from helpers import make_node, make_pod
+
+
+def ni_of(node, pods=()):
+    ni = NodeInfo(node)
+    for p in pods:
+        ni.add_pod(p)
+    return ni
+
+
+def pvc_pod(name, *claims, namespace="default"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=namespace),
+        spec=api.PodSpec(containers=[api.Container()],
+                         volumes=[api.Volume(name=c, pvc_name=c) for c in claims]))
+
+
+def make_pv(name, kind="", vid="", labels=None, affinity=None, cls=""):
+    return api.PersistentVolume(
+        metadata=api.ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=api.PersistentVolumeSpec(source_kind=kind, source_id=vid,
+                                      node_affinity=affinity,
+                                      storage_class_name=cls))
+
+
+def make_pvc(name, volume_name="", cls="", namespace="default"):
+    return api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name=name, namespace=namespace),
+        spec=api.PersistentVolumeClaimSpec(volume_name=volume_name,
+                                           storage_class_name=cls))
+
+
+class TestMaxPDVolumeCount:
+    def _store(self):
+        store = ObjectStore()
+        for i in range(4):
+            store.create("persistentvolumes",
+                         make_pv(f"pv-{i}", kind=volumes.EBS, vid=f"vol-{i}"))
+            store.create("persistentvolumeclaims", make_pvc(f"claim-{i}", f"pv-{i}"))
+        return store
+
+    def test_over_limit(self):
+        store = self._store()
+        pred = volumes.new_max_pd_volume_count(
+            volumes.EBS, 2, volumes.VolumeLister(store))
+        existing = [pvc_pod("e0", "claim-0"), pvc_pod("e1", "claim-1")]
+        ni = ni_of(make_node("n1"), existing)
+        ok, reasons = pred(pvc_pod("p", "claim-2"), ni)
+        assert not ok and reasons == ["node(s) exceed max volume count"]
+
+    def test_same_volume_not_double_counted(self):
+        store = self._store()
+        pred = volumes.new_max_pd_volume_count(
+            volumes.EBS, 2, volumes.VolumeLister(store))
+        existing = [pvc_pod("e0", "claim-0"), pvc_pod("e1", "claim-1")]
+        ni = ni_of(make_node("n1"), existing)
+        ok, _ = pred(pvc_pod("p", "claim-1"), ni)  # already attached
+        assert ok
+
+    def test_missing_pvc_rejects(self):
+        store = self._store()
+        pred = volumes.new_max_pd_volume_count(
+            volumes.EBS, 10, volumes.VolumeLister(store))
+        ok, _ = pred(pvc_pod("p", "nope"), ni_of(make_node("n1")))
+        assert not ok
+
+    def test_irrelevant_pod_skips(self):
+        pred = volumes.new_max_pd_volume_count(
+            volumes.EBS, 1, volumes.VolumeLister(ObjectStore()))
+        assert not pred.relevant(make_pod("p"))
+
+
+class TestVolumeZone:
+    def test_zone_mismatch(self):
+        store = ObjectStore()
+        store.create("persistentvolumes", make_pv(
+            "pv-z", labels={api.LABEL_ZONE: "us-east-1a"}))
+        store.create("persistentvolumeclaims", make_pvc("claim-z", "pv-z"))
+        pred = volumes.new_volume_zone(volumes.VolumeLister(store))
+        pod = pvc_pod("p", "claim-z")
+        ok, _ = pred(pod, ni_of(make_node("n1", labels={api.LABEL_ZONE: "us-east-1b"})))
+        assert not ok
+        ok, _ = pred(pod, ni_of(make_node("n2", labels={api.LABEL_ZONE: "us-east-1a"})))
+        assert ok
+
+    def test_zone_set_value(self):
+        store = ObjectStore()
+        store.create("persistentvolumes", make_pv(
+            "pv-z", labels={api.LABEL_ZONE: "us-east-1a__us-east-1b"}))
+        store.create("persistentvolumeclaims", make_pvc("claim-z", "pv-z"))
+        pred = volumes.new_volume_zone(volumes.VolumeLister(store))
+        ok, _ = pred(pvc_pod("p", "claim-z"),
+                     ni_of(make_node("n1", labels={api.LABEL_ZONE: "us-east-1b"})))
+        assert ok
+
+    def test_unlabeled_node_rejected(self):
+        store = ObjectStore()
+        store.create("persistentvolumes", make_pv(
+            "pv-z", labels={api.LABEL_ZONE: "z1"}))
+        store.create("persistentvolumeclaims", make_pvc("claim-z", "pv-z"))
+        pred = volumes.new_volume_zone(volumes.VolumeLister(store))
+        ok, _ = pred(pvc_pod("p", "claim-z"), ni_of(make_node("n1")))
+        assert not ok
+
+
+class TestVolumeBinding:
+    def _affinity(self, zone):
+        from kubernetes_tpu.api.labels import Requirement
+
+        return api.NodeSelector(node_selector_terms=[api.NodeSelectorTerm(
+            match_expressions=[Requirement(api.LABEL_ZONE, "In", (zone,))])])
+
+    def test_bound_pv_affinity(self):
+        store = ObjectStore()
+        store.create("persistentvolumes",
+                     make_pv("pv-a", affinity=self._affinity("z1")))
+        store.create("persistentvolumeclaims", make_pvc("claim-a", "pv-a"))
+        pred = volumes.new_volume_binding(volumes.VolumeLister(store))
+        pod = pvc_pod("p", "claim-a")
+        ok, _ = pred(pod, ni_of(make_node("n1", labels={api.LABEL_ZONE: "z1"})))
+        assert ok
+        ok, reasons = pred(pod, ni_of(make_node("n2", labels={api.LABEL_ZONE: "z2"})))
+        assert not ok and "volume node affinity" in reasons[0]
+
+    def test_unbound_needs_matching_pv(self):
+        store = ObjectStore()
+        store.create("persistentvolumes",
+                     make_pv("pv-free", affinity=self._affinity("z1"), cls="fast"))
+        store.create("persistentvolumeclaims", make_pvc("claim-u", cls="fast"))
+        pred = volumes.new_volume_binding(volumes.VolumeLister(store))
+        pod = pvc_pod("p", "claim-u")
+        ok, _ = pred(pod, ni_of(make_node("n1", labels={api.LABEL_ZONE: "z1"})))
+        assert ok
+        ok, reasons = pred(pod, ni_of(make_node("n2", labels={api.LABEL_ZONE: "z2"})))
+        assert not ok and "didn't find available persistent volumes" in reasons[0]
+
+
+class TestNodeLabelAndServiceAffinity:
+    def test_label_presence(self):
+        pred = golden.new_node_label_presence(["gpu"], presence=True)
+        ok, _ = pred(make_pod("p"), ni_of(make_node("n1", labels={"gpu": "yes"})))
+        assert ok
+        ok, _ = pred(make_pod("p"), ni_of(make_node("n2")))
+        assert not ok
+        anti = golden.new_node_label_presence(["bad"], presence=False)
+        ok, _ = anti(make_pod("p"), ni_of(make_node("n3", labels={"bad": "x"})))
+        assert not ok
+
+    def test_service_affinity_adopts_anchor(self):
+        store = ObjectStore()
+        store.create("nodes", make_node("n1", labels={"rack": "r1"}))
+        store.create("nodes", make_node("n2", labels={"rack": "r2"}))
+        store.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc"), selector={"app": "a"}))
+        store.create("pods", make_pod("p0", labels={"app": "a"}, node_name="n1"))
+        pred = golden.new_service_affinity(store, ["rack"])
+        pod = make_pod("p1", labels={"app": "a"})
+        ni1 = ni_of(store.get("nodes", "default", "n1"))
+        ni2 = ni_of(store.get("nodes", "default", "n2"))
+        ok, _ = pred(pod, ni1)
+        assert ok
+        ok, _ = pred(pod, ni2)
+        assert not ok
+
+    def test_service_affinity_pod_pins_value(self):
+        store = ObjectStore()
+        pred = golden.new_service_affinity(store, ["rack"])
+        pod = make_pod("p1", node_selector={"rack": "r2"})
+        ok, _ = pred(pod, ni_of(make_node("n1", labels={"rack": "r1"})))
+        assert not ok
+        ok, _ = pred(pod, ni_of(make_node("n2", labels={"rack": "r2"})))
+        assert ok
+
+
+class TestHostPriorities:
+    def test_resource_limits(self):
+        pod = api.Pod(spec=api.PodSpec(containers=[api.Container(
+            resources=api.ResourceRequirements(
+                limits=api.resource_list(cpu="2", memory="4Gi")))]))
+        assert golden.resource_limits_map(pod, ni_of(make_node("big", cpu="4"))) == 1
+        assert golden.resource_limits_map(pod, ni_of(make_node("small", cpu="1"))) == 0
+        assert golden.resource_limits_map(make_pod("nolimit"), ni_of(make_node("n"))) == 0
+
+    def test_node_label_priority(self):
+        score = golden.new_node_label_priority("ssd", True)
+        assert score(make_pod("p"), ni_of(make_node("n1", labels={"ssd": "1"}))) == 10
+        assert score(make_pod("p"), ni_of(make_node("n2"))) == 0
+
+    def test_service_anti_affinity_spreads(self):
+        store = ObjectStore()
+        store.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc"), selector={"app": "a"}))
+        n1 = make_node("n1", labels={"zone": "z1"})
+        n2 = make_node("n2", labels={"zone": "z2"})
+        infos = {
+            "n1": ni_of(n1, [make_pod("e1", labels={"app": "a"}, node_name="n1")]),
+            "n2": ni_of(n2),
+        }
+        score = golden.new_service_anti_affinity(store, "zone")
+        out = score(make_pod("p", labels={"app": "a"}), infos)
+        assert out["n2"] == 10 and out["n1"] == 0
+
+
+class TestPolicyConfig:
+    def test_policy_with_arguments(self):
+        store = ObjectStore()
+        reg = Registry()
+        prof = reg.profile_from_policy("""
+        {"predicates": [
+            {"name": "PodFitsResources"},
+            {"name": "TestLabelPresence",
+             "argument": {"labelsPresence": {"labels": ["gpu"], "presence": true}}},
+            {"name": "TestServiceAffinity",
+             "argument": {"serviceAffinity": {"labels": ["rack"]}}},
+            {"name": "MaxEBSVolumeCount"}
+         ],
+         "priorities": [
+            {"name": "LeastRequestedPriority", "weight": 2},
+            {"name": "ResourceLimitsPriority", "weight": 1},
+            {"name": "TestLabelPreference",
+             "argument": {"labelPreference": {"label": "ssd", "presence": true}},
+             "weight": 3},
+            {"name": "TestServiceAntiAffinity",
+             "argument": {"serviceAntiAffinity": {"label": "zone"}}, "weight": 1}
+         ]}""", store=store)
+        assert "PodFitsResources" in prof.device_filters
+        assert set(prof.host_filters) == {
+            "TestLabelPresence", "TestServiceAffinity", "MaxEBSVolumeCount"}
+        assert prof.score_weights == {"LeastRequestedPriority": 2}
+        assert set(prof.host_scores) == {
+            "ResourceLimitsPriority", "TestLabelPreference", "TestServiceAntiAffinity"}
+        assert prof.weights().least_requested == 2.0
+
+    def test_default_profile_has_volume_predicates(self):
+        prof = default_profile(ObjectStore())
+        assert {"NoDiskConflict", "MaxEBSVolumeCount", "MaxGCEPDVolumeCount",
+                "MaxAzureDiskVolumeCount", "NoVolumeZoneConflict",
+                "CheckVolumeBinding"} <= set(prof.host_filters)
+
+
+class TestHostPluginPreemption:
+    def test_no_preemption_on_zone_conflicted_node(self):
+        """A high-priority pod whose PV pins it to zone z9 (no such node)
+        must NOT evict victims anywhere — zone conflicts are unresolvable
+        (reference: generic_scheduler.go:980 unresolvable switch includes
+        ErrVolumeZoneConflict)."""
+        store = ObjectStore()
+        store.create("persistentvolumes", make_pv(
+            "pv-z9", labels={api.LABEL_ZONE: "z9"}))
+        store.create("persistentvolumeclaims", make_pvc("claim-z9", "pv-z9"))
+        from kubernetes_tpu.utils.feature_gates import FeatureGates
+
+        sched = Scheduler(store, wave_size=8,
+                          features=FeatureGates({"PodPriority": True}))
+        store.create("nodes", make_node("n1", cpu="1",
+                                        labels={api.LABEL_ZONE: "z1"}))
+        victim = make_pod("victim", cpu="900m", priority=0, node_name="n1")
+        store.create("pods", victim)
+        hi = pvc_pod("hi", "claim-z9")
+        hi.spec.priority = 1000
+        hi.spec.containers[0].resources.requests = api.resource_list(cpu="800m")
+        store.create("pods", hi)
+        assert sched.schedule_pending(max_waves=3) == 0
+        # victim survived; no nomination happened
+        assert store.get("pods", "default", "victim") is not None
+        assert store.get("pods", "default", "hi").status.nominated_node_name == ""
+
+    def test_preemption_resolves_disk_conflict(self):
+        """NoDiskConflict IS resolvable by eviction: removing the conflicting
+        victim frees the disk (reference treats ErrDiskConflict as resolvable)."""
+        store = ObjectStore()
+        from kubernetes_tpu.utils.feature_gates import FeatureGates
+
+        sched = Scheduler(store, wave_size=8,
+                          features=FeatureGates({"PodPriority": True}))
+        store.create("nodes", make_node("n1"))
+        holder = make_pod("holder", cpu="100m", priority=0, node_name="n1")
+        holder.spec.volumes = [api.Volume(name="d", source_kind="GCEPersistentDisk",
+                                          source_id="disk-x")]
+        store.create("pods", holder)
+        hi = make_pod("hi", cpu="100m", priority=1000)
+        hi.spec.volumes = [api.Volume(name="d", source_kind="GCEPersistentDisk",
+                                      source_id="disk-x")]
+        store.create("pods", hi)
+        sched.schedule_pending(max_waves=3)
+        # holder got evicted and hi is nominated onto n1
+        assert store.get("pods", "default", "holder") is None
+        assert store.get("pods", "default", "hi").status.nominated_node_name == "n1"
+
+
+class TestSchedulerWithHostScores:
+    def test_host_score_steers_placement(self):
+        """A NodeLabel host priority with a big weight must beat the
+        device priorities' preference (via the kernel extra_scores path)."""
+        store = ObjectStore()
+        prof = default_profile(store)
+        prof.host_scores["NodeLabelPriority"] = (
+            lambda pod, infos: {n: (10 if n == "n3" else 0) for n in infos}, 100)
+        sched = Scheduler(store, profile=prof, wave_size=8)
+        for i in range(1, 5):
+            store.create("nodes", make_node(f"n{i}", cpu="8", memory="16Gi"))
+        store.create("pods", make_pod("p1", cpu="100m"))
+        assert sched.schedule_pending() == 1
+        assert store.get("pods", "default", "p1").spec.node_name == "n3"
+
+    def test_volume_zone_in_wave(self):
+        """PVC pod must land in the PV's zone; other pods unaffected."""
+        store = ObjectStore()
+        store.create("persistentvolumes", make_pv(
+            "pv-z", labels={api.LABEL_ZONE: "z2"}))
+        store.create("persistentvolumeclaims", make_pvc("claim-z", "pv-z"))
+        sched = Scheduler(store, wave_size=8)
+        store.create("nodes", make_node("a1", labels={api.LABEL_ZONE: "z1"}))
+        store.create("nodes", make_node("a2", labels={api.LABEL_ZONE: "z2"}))
+        p = pvc_pod("p", "claim-z")
+        p.spec.containers[0].resources.requests = api.resource_list(cpu="100m")
+        store.create("pods", p)
+        assert sched.schedule_pending() == 1
+        assert store.get("pods", "default", "p").spec.node_name == "a2"
