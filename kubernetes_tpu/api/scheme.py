@@ -1,0 +1,215 @@
+"""Scheme + codecs: the api-machinery serialization layer.
+
+Analog of the reference's runtime.Scheme and serializer stack
+(staging/src/k8s.io/apimachinery/pkg/runtime/scheme.go and
+runtime/serializer/json/): a registry mapping kind names <-> Python
+types <-> storage plurals, plus a generic JSON codec over the dataclass
+object model in api/types.py. Wire format follows the reference's JSON
+conventions — camelCase field names, top-level ``kind``/``apiVersion``
+tags — so objects round-trip through the HTTP apiserver, kubectl, and
+YAML manifests.
+
+Unlike the reference there is no internal/external version split: the
+dataclasses are both the internal types and the wire schema (resource
+quantities stay canonical int64s — milli-CPU, bytes — as in
+schedulercache's Resource, node_info.go:131).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import (Any, Dict, List, Mapping, Optional, Tuple, Union,
+                    get_args, get_origin, get_type_hints)
+
+from . import labels as lbl
+from . import types as api
+
+# -- kind registry (runtime.Scheme analog) ------------------------------------
+
+# kind -> (plural, type, apiVersion, namespaced)
+_REGISTRY: Dict[str, Tuple[str, type, str, bool]] = {}
+_BY_PLURAL: Dict[str, str] = {}
+_BY_TYPE: Dict[type, str] = {}
+
+
+def register(kind: str, plural: str, typ: type, api_version: str = "v1",
+             namespaced: bool = True):
+    _REGISTRY[kind] = (plural, typ, api_version, namespaced)
+    _BY_PLURAL[plural] = kind
+    _BY_TYPE[typ] = kind
+
+
+register("Pod", "pods", api.Pod)
+register("Node", "nodes", api.Node, namespaced=False)
+register("Service", "services", api.Service)
+register("ReplicationController", "replicationcontrollers", api.ReplicationController)
+register("ReplicaSet", "replicasets", api.ReplicaSet, "apps/v1")
+register("StatefulSet", "statefulsets", api.StatefulSet, "apps/v1")
+register("Deployment", "deployments", api.Deployment, "apps/v1")
+register("DaemonSet", "daemonsets", api.DaemonSet, "apps/v1")
+register("Job", "jobs", api.Job, "batch/v1")
+register("CronJob", "cronjobs", api.CronJob, "batch/v1beta1")
+register("PodDisruptionBudget", "poddisruptionbudgets", api.PodDisruptionBudget,
+         "policy/v1beta1")
+register("PersistentVolume", "persistentvolumes", api.PersistentVolume,
+         namespaced=False)
+register("PersistentVolumeClaim", "persistentvolumeclaims", api.PersistentVolumeClaim)
+register("Namespace", "namespaces", api.Namespace, namespaced=False)
+register("Endpoints", "endpoints", api.Endpoints)
+register("Event", "events", api.EventObject)
+register("ResourceQuota", "resourcequotas", api.ResourceQuota)
+register("ServiceAccount", "serviceaccounts", api.ServiceAccount)
+register("Secret", "secrets", api.Secret)
+register("ConfigMap", "configmaps", api.ConfigMap)
+register("PriorityClass", "priorityclasses", api.PriorityClass,
+         "scheduling.k8s.io/v1beta1", namespaced=False)
+register("Lease", "leases", api.LeaseRecord, "coordination.k8s.io/v1",
+         namespaced=False)
+
+
+def kind_for_plural(plural: str) -> Optional[str]:
+    return _BY_PLURAL.get(plural)
+
+
+def plural_for_kind(kind: str) -> str:
+    return _REGISTRY[kind][0]
+
+
+def type_for_kind(kind: str) -> type:
+    return _REGISTRY[kind][1]
+
+
+def kind_of(obj) -> Optional[str]:
+    return _BY_TYPE.get(type(obj))
+
+
+def api_version_for(kind: str) -> str:
+    return _REGISTRY[kind][2]
+
+
+def is_namespaced(kind: str) -> bool:
+    return _REGISTRY[kind][3]
+
+
+def all_kinds() -> List[str]:
+    return list(_REGISTRY)
+
+
+# -- field-name conversion -----------------------------------------------------
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+# Element types for fields whose annotation is a bare ``tuple`` (frozen
+# selector dataclasses in api/labels.py).
+_TUPLE_ELEM: Dict[Tuple[str, str], Any] = {
+    ("Requirement", "values"): str,
+    ("Selector", "requirements"): lbl.Requirement,
+    ("LabelSelector", "match_expressions"): lbl.Requirement,
+}
+
+_HINT_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    h = _HINT_CACHE.get(cls)
+    if h is None:
+        h = get_type_hints(cls)
+        _HINT_CACHE[cls] = h
+    return h
+
+
+# -- encode --------------------------------------------------------------------
+
+
+def encode(value) -> Any:
+    """Object -> plain JSON-able structure (camelCase keys)."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out = {}
+        for f in dataclasses.fields(value):
+            v = getattr(value, f.name)
+            # drop empty/default-ish values for compact wire objects
+            if v is None or v == {} or v == [] or v == ():
+                continue
+            out[_camel(f.name)] = encode(v)
+        return out
+    if isinstance(value, Mapping):
+        return {k: encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [encode(v) for v in value]
+    return value
+
+
+def encode_object(obj) -> Dict[str, Any]:
+    """Top-level object -> dict with kind/apiVersion tags."""
+    kind = kind_of(obj)
+    out = {"kind": kind, "apiVersion": api_version_for(kind) if kind else "v1"}
+    out.update(encode(obj))
+    return out
+
+
+def to_json(obj) -> str:
+    return json.dumps(encode_object(obj))
+
+
+# -- decode --------------------------------------------------------------------
+
+
+def _decode(value, hint, owner: str = "", fname: str = ""):
+    if value is None:
+        return None
+    origin = get_origin(hint)
+    if origin is Union:  # Optional[T]
+        args = [a for a in get_args(hint) if a is not type(None)]
+        return _decode(value, args[0], owner, fname)
+    if dataclasses.is_dataclass(hint):
+        return _decode_dataclass(value, hint)
+    if origin in (dict, Mapping) or hint in (dict, Mapping):
+        args = get_args(hint)
+        vt = args[1] if len(args) == 2 else None
+        return {k: (_decode(v, vt) if vt else v) for k, v in value.items()}
+    if origin is list:
+        (et,) = get_args(hint) or (None,)
+        return [_decode(v, et) if et else v for v in value]
+    if origin is tuple:
+        args = get_args(hint)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(_decode(v, args[0]) for v in value)
+        return tuple(_decode(v, t) for v, t in zip(value, args))
+    if hint is tuple:
+        et = _TUPLE_ELEM.get((owner, fname))
+        return tuple(_decode(v, et) if et and et is not str else v for v in value)
+    return value
+
+
+def _decode_dataclass(data: Mapping, cls: type):
+    hints = _hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        wire = _camel(f.name)
+        if wire not in data:
+            continue
+        kwargs[f.name] = _decode(data[wire], hints[f.name], cls.__name__, f.name)
+    return cls(**kwargs)
+
+
+def decode(kind_or_type, data: Mapping):
+    """kind name (or type) + wire dict -> object."""
+    cls = kind_or_type if isinstance(kind_or_type, type) else type_for_kind(kind_or_type)
+    return _decode_dataclass(data, cls)
+
+
+def decode_object(data: Mapping):
+    """Wire dict with a ``kind`` tag -> object."""
+    kind = data.get("kind")
+    if not kind or kind not in _REGISTRY:
+        raise ValueError(f"unknown kind {kind!r}")
+    return decode(kind, data)
+
+
+def from_json(text: str):
+    return decode_object(json.loads(text))

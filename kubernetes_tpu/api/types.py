@@ -375,34 +375,420 @@ class PersistentVolumeClaim:
 
 
 @dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+    protocol: str = "TCP"
+    node_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
+    type: str = "ClusterIP"
+    session_affinity: str = "None"
+
+
+@dataclass
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
-    selector: Dict[str, str] = field(default_factory=dict)  # spec.selector
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    def __init__(self, metadata=None, spec=None, selector=None):
+        # `selector=` kwarg kept for scheduler-side call sites that treat a
+        # Service as just its label selector (selector_spreading.go view)
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or ServiceSpec()
+        if selector is not None:
+            self.spec.selector = selector
+
+    @property
+    def selector(self) -> Dict[str, str]:
+        return self.spec.selector
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ReplicationControllerSpec:
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicationControllerStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
 
 
 @dataclass
 class ReplicationController:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
-    selector: Dict[str, str] = field(default_factory=dict)  # spec.selector
+    spec: ReplicationControllerSpec = field(default_factory=ReplicationControllerSpec)
+    status: ReplicationControllerStatus = field(default_factory=ReplicationControllerStatus)
+
+    def __init__(self, metadata=None, spec=None, status=None, selector=None):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or ReplicationControllerSpec()
+        self.status = status or ReplicationControllerStatus()
+        if selector is not None:
+            self.spec.selector = selector
+
+    @property
+    def selector(self) -> Dict[str, str]:
+        return self.spec.selector
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    min_ready_seconds: int = 0
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    fully_labeled_replicas: int = 0
+    observed_generation: int = 0
 
 
 @dataclass
 class ReplicaSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+
+    def __init__(self, metadata=None, spec=None, status=None, selector=None):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or ReplicaSetSpec()
+        self.status = status or ReplicaSetStatus()
+        if selector is not None:
+            self.spec.selector = selector
+
+    @property
+    def selector(self) -> Optional[LabelSelector]:
+        return self.spec.selector
+
+
+@dataclass
+class StatefulSetSpec:
+    replicas: int = 1
     selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    service_name: str = ""
+    pod_management_policy: str = "OrderedReady"
+
+
+@dataclass
+class StatefulSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    current_replicas: int = 0
+    observed_generation: int = 0
 
 
 @dataclass
 class StatefulSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+
+    def __init__(self, metadata=None, spec=None, status=None, selector=None):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or StatefulSetSpec()
+        self.status = status or StatefulSetStatus()
+        if selector is not None:
+            self.spec.selector = selector
+
+    @property
+    def selector(self) -> Optional[LabelSelector]:
+        return self.spec.selector
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = "RollingUpdate"  # or "Recreate"
+    max_unavailable: int = 1
+    max_surge: int = 1
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
     selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+    revision_history_limit: int = 10
+    paused: bool = False
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    unavailable_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class DaemonSetStatus:
+    current_number_scheduled: int = 0
+    desired_number_scheduled: int = 0
+    number_ready: int = 0
+    number_misscheduled: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+
+@dataclass
+class JobSpec:
+    parallelism: int = 1
+    completions: int = 1
+    backoff_limit: int = 6
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    completion_time: Optional[float] = None
+    conditions: List[Tuple[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class CronJobSpec:
+    schedule: str = "* * * * *"
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    job_template: Optional[JobSpec] = None
+    job_template_meta: ObjectMeta = field(default_factory=ObjectMeta)
+
+
+@dataclass
+class CronJobStatus:
+    last_schedule_time: Optional[float] = None
+    active: List[str] = field(default_factory=list)  # job names
+
+
+@dataclass
+class CronJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    selector: Optional[LabelSelector] = None
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+    observed_generation: int = 0
 
 
 @dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
-    selector: Optional[LabelSelector] = None
-    disruptions_allowed: int = 0  # status.PodDisruptionsAllowed
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+
+    def __init__(self, metadata=None, spec=None, status=None,
+                 selector=None, disruptions_allowed=None):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or PodDisruptionBudgetSpec()
+        self.status = status or PodDisruptionBudgetStatus()
+        if selector is not None:
+            self.spec.selector = selector
+        if disruptions_allowed is not None:
+            self.status.disruptions_allowed = disruptions_allowed
+
+    @property
+    def selector(self) -> Optional[LabelSelector]:
+        return self.spec.selector
+
+    @property
+    def disruptions_allowed(self) -> int:
+        return self.status.disruptions_allowed
+
+
+# --- namespaces, endpoints, events, quotas, leases ---------------------------
+
+
+@dataclass
+class NamespaceSpec:
+    finalizers: List[str] = field(default_factory=lambda: ["kubernetes"])
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = "Active"  # Active | Terminating
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NamespaceSpec = field(default_factory=NamespaceSpec)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_pod: str = ""  # namespace/name of backing pod
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+
+@dataclass
+class EventObject:
+    """An Event API object (reference: core/v1 Event; recorded via
+    client-go/tools/record/event.go:56 EventRecorder)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    source_component: str = ""
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, int] = field(default_factory=dict)
+    used: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class ServiceAccount:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+
+@dataclass
+class LeaseRecord:
+    """Leader-election lock record (reference: client-go/tools/leaderelection/
+    resourcelock — LeaderElectionRecord stored in an Endpoints annotation)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    leader_transitions: int = 0
 
 
 # --- derived pod semantics ---------------------------------------------------

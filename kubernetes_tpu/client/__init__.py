@@ -1,0 +1,12 @@
+"""Client runtime: REST client, reflector/informers, workqueue,
+leader election, event recording.
+
+Analog of staging/src/k8s.io/client-go: the layer every control-plane
+component uses to speak to the apiserver and run level-triggered loops.
+"""
+
+from .rest import APIStatusError, RESTClient
+from .reflector import Reflector, RemoteStore
+from .workqueue import DelayingQueue, ItemExponentialFailureRateLimiter, RateLimitingQueue
+from .leaderelection import LeaderElector
+from .record import EventRecorder

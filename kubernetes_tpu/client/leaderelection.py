@@ -1,0 +1,117 @@
+"""Leader election via a lease-record lock object.
+
+Analog of client-go/tools/leaderelection/leaderelection.go:70: candidates
+race to create/renew a LeaseRecord; the holder renews every retry_period,
+others acquire when renew_time + lease_duration has expired. Optimistic
+concurrency comes from the store's resourceVersion compare-and-swap
+(resourcelock's Update on the annotation-carrying object).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..api import types as api
+from ..runtime.store import Conflict
+
+
+class LeaderElector:
+    def __init__(self, store, identity: str, lock_name: str = "kube-scheduler",
+                 lease_duration: float = 15.0, renew_deadline: float = 10.0,
+                 retry_period: float = 2.0, clock=time.time,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.identity = identity
+        self.lock_name = lock_name
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lock record access (resourcelock analog) ------------------------------
+
+    def _get(self) -> Optional[api.LeaseRecord]:
+        for ns in ("default", ""):
+            rec = self.store.get("leases", ns, self.lock_name)
+            if rec is not None:
+                return rec
+        return None
+
+    def _try_acquire_or_renew(self) -> bool:
+        """leaderelection.go:221 tryAcquireOrRenew."""
+        now = self.clock()
+        rec = self._get()
+        if rec is None:
+            rec = api.LeaseRecord(
+                metadata=api.ObjectMeta(name=self.lock_name),
+                holder_identity=self.identity,
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=now, renew_time=now)
+            try:
+                self.store.create("leases", rec)
+                return True
+            except Conflict:
+                return False
+        if rec.holder_identity != self.identity:
+            if now < rec.renew_time + rec.lease_duration_seconds:
+                return False  # held by a live leader
+            transitions = rec.leader_transitions + 1
+            acquire = now
+        else:
+            transitions = rec.leader_transitions
+            acquire = rec.acquire_time
+        new = api.LeaseRecord(
+            metadata=rec.metadata, holder_identity=self.identity,
+            lease_duration_seconds=self.lease_duration,
+            acquire_time=acquire, renew_time=now,
+            leader_transitions=transitions)
+        try:
+            self.store.update("leases", new,
+                              expect_rv=rec.metadata.resource_version)
+            return True
+        except (Conflict, KeyError):
+            return False
+
+    # -- run loop --------------------------------------------------------------
+
+    def run(self):
+        """Block until leadership is acquired, call on_started_leading, then
+        renew until renewal fails or stop() (leaderelection.go:148 Run)."""
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                break
+            self._stop.wait(self.retry_period)
+        if self._stop.is_set():
+            return
+        self.is_leader = True
+        if self.on_started_leading:
+            self.on_started_leading()
+        last_renew = self.clock()
+        while not self._stop.is_set():
+            self._stop.wait(self.retry_period)
+            if self._stop.is_set():
+                break
+            if self._try_acquire_or_renew():
+                last_renew = self.clock()
+            elif self.clock() - last_renew > self.renew_deadline:
+                break  # lost the lease
+        self.is_leader = False
+        if self.on_stopped_leading:
+            self.on_stopped_leading()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"leaderelection-{self.identity}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,236 @@
+"""Reflector + remote informer store.
+
+Reflector is the list+watch resync loop (client-go/tools/cache/
+reflector.go:49 ListAndWatch): list to seed, then watch from the list's
+resourceVersion, relist on 410 Gone. It feeds either plain handlers or a
+RemoteStore — an ObjectStore-shaped facade that lets every in-process
+component (Scheduler, controllers, kubelets) run unchanged against the
+HTTP apiserver: reads hit the local mirror (informer cache), writes go
+over REST, and watch callbacks fire from reflector threads (the
+sharedProcessor fan-out, shared_informer.go:375).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api import scheme
+from ..api import types as api
+from ..runtime.store import ADDED, DELETED, MODIFIED, Conflict, Event
+from .rest import APIStatusError, RESTClient
+
+
+class Reflector:
+    def __init__(self, client: RESTClient, plural: str,
+                 on_event: Callable[[Event], None],
+                 relist_backoff: float = 0.5):
+        self.client = client
+        self.plural = plural
+        self.on_event = on_event
+        self.relist_backoff = relist_backoff
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_sync_rv = 0
+        self._known: Dict[str, object] = {}
+
+    @staticmethod
+    def _key(obj) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def start(self) -> "Reflector":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"reflector-{self.plural}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except APIStatusError as e:
+                if e.code != 410:
+                    time.sleep(self.relist_backoff)
+            except Exception:
+                time.sleep(self.relist_backoff)
+
+    def _list_and_watch(self):
+        items, rv = self.client.list(self.plural)
+        # delta replay against the known set (DeltaFIFO Replace semantics,
+        # tools/cache/delta_fifo.go Replace: sync adds + implicit deletes)
+        new_keys = set()
+        for obj in items:
+            key = self._key(obj)
+            new_keys.add(key)
+            old = self._known.get(key)
+            if old is None:
+                self.on_event(Event(ADDED, self.plural, obj))
+            elif old.metadata.resource_version != obj.metadata.resource_version:
+                self.on_event(Event(MODIFIED, self.plural, obj, old=old))
+            self._known[key] = obj
+        for key in list(self._known):
+            if key not in new_keys:
+                self.on_event(Event(DELETED, self.plural, self._known.pop(key)))
+        self.last_sync_rv = rv
+        while not self._stop.is_set():
+            # the stream ends on server timeoutSeconds; re-arm from last rv
+            for etype, obj in self.client.watch(
+                    self.plural, resource_version=rv, timeout_seconds=10.0,
+                    stop=self._stop):
+                rv = max(rv, obj.metadata.resource_version)
+                self.last_sync_rv = rv
+                key = self._key(obj)
+                if etype == DELETED:
+                    self._known.pop(key, None)
+                    self.on_event(Event(DELETED, self.plural, obj))
+                elif key in self._known:
+                    old = self._known[key]
+                    self._known[key] = obj
+                    if etype == ADDED or \
+                            old.metadata.resource_version != obj.metadata.resource_version:
+                        self.on_event(Event(MODIFIED, self.plural, obj, old=old))
+                else:
+                    self._known[key] = obj
+                    self.on_event(Event(ADDED, self.plural, obj))
+
+
+class RemoteStore:
+    """ObjectStore facade backed by the HTTP apiserver.
+
+    Components written against runtime.ObjectStore (scheduler, controllers,
+    kubelet) run unchanged: list/get serve from reflector-maintained local
+    mirrors; create/update/delete/bind go over REST; watch() handlers fire
+    from the reflector threads. mirror(kind) must be called (or implied by
+    watch()) before reads of that kind."""
+
+    def __init__(self, client: RESTClient):
+        self.client = client
+        self._lock = threading.RLock()
+        self._mirrors: Dict[str, Dict[str, object]] = {}
+        self._watchers: List[tuple] = []
+        self._reflectors: Dict[str, Reflector] = {}
+
+    # -- mirror management -----------------------------------------------------
+
+    def mirror(self, kind: str) -> "RemoteStore":
+        with self._lock:
+            if kind in self._reflectors:
+                return self
+            self._mirrors[kind] = {}
+            refl = Reflector(self.client, kind, self._on_event)
+            self._reflectors[kind] = refl
+            refl.start()
+        return self
+
+    def stop(self):
+        for refl in self._reflectors.values():
+            refl.stop()
+
+    def wait_for_sync(self, timeout: float = 5.0):
+        deadline = time.monotonic() + timeout
+        for refl in list(self._reflectors.values()):
+            while refl.last_sync_rv == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+
+    def _on_event(self, ev: Event):
+        with self._lock:
+            objs = self._mirrors.setdefault(ev.kind, {})
+            key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
+            if ev.type == DELETED:
+                objs.pop(key, None)
+            else:
+                objs[key] = ev.obj
+            watchers = list(self._watchers)
+        for kind, fn in watchers:
+            if kind is None or kind == ev.kind:
+                fn(ev)
+
+    # -- ObjectStore interface -------------------------------------------------
+
+    def watch(self, kind: Optional[str], fn: Callable[[Event], None]):
+        if kind is not None:
+            self.mirror(kind)
+        with self._lock:
+            self._watchers.append((kind, fn))
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
+        self.mirror(kind)
+        with self._lock:
+            objs = self._mirrors.get(kind, {})
+            if namespace is None:
+                return list(objs.values())
+            prefix = namespace + "/"
+            return [o for k, o in objs.items() if k.startswith(prefix)]
+
+    def get(self, kind: str, namespace: str, name: str):
+        self.mirror(kind)
+        with self._lock:
+            return self._mirrors.get(kind, {}).get(f"{namespace}/{name}")
+
+    def count(self, kind: str) -> int:
+        return len(self.list(kind))
+
+    @property
+    def latest_resource_version(self) -> int:
+        with self._lock:
+            return max((r.last_sync_rv for r in self._reflectors.values()),
+                       default=0)
+
+    def create(self, kind: str, obj) -> object:
+        try:
+            return self.client.create(kind, obj)
+        except APIStatusError as e:
+            if e.code == 409:
+                raise Conflict(str(e))
+            raise
+
+    def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> object:
+        if expect_rv is not None:
+            # carry the CAS revision on the wire object so the server's
+            # resourceVersion check enforces it (GuaranteedUpdate contract)
+            import copy
+            obj = copy.copy(obj)
+            obj.metadata = copy.copy(obj.metadata)
+            obj.metadata.resource_version = expect_rv
+        try:
+            return self.client.update(kind, obj)
+        except APIStatusError as e:
+            if e.code == 409:
+                raise Conflict(str(e))
+            raise
+
+    def delete(self, kind: str, namespace: str, name: str):
+        try:
+            self.client.delete(kind, namespace, name)
+        except APIStatusError as e:
+            if e.code == 404:
+                raise KeyError(f"{kind} {namespace}/{name} not found")
+            raise
+
+    def bind(self, pod: api.Pod, node_name: str):
+        try:
+            self.client.bind(pod.metadata.namespace, pod.metadata.name, node_name)
+        except APIStatusError as e:
+            if e.code == 409:
+                raise Conflict(str(e))
+            if e.code == 404:
+                raise KeyError(f"pod {pod.full_name()} not found")
+            raise
+
+    def set_pod_condition(self, pod: api.Pod, cond):
+        try:
+            self.client.patch("pods", pod.metadata.namespace, pod.metadata.name,
+                              {"status": {"conditions": [list(cond)]}})
+        except APIStatusError:
+            pass
+
+    def set_nominated_node(self, pod: api.Pod, node_name: str):
+        try:
+            self.client.patch("pods", pod.metadata.namespace, pod.metadata.name,
+                              {"status": {"nominatedNodeName": node_name}})
+        except APIStatusError:
+            pass

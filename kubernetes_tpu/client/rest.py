@@ -1,0 +1,174 @@
+"""REST client over the API server.
+
+Analog of client-go's rest.RESTClient + typed clientset verbs
+(client-go/rest/client.go, kubernetes/typed/core/v1): List/Get/Create/
+Update/Patch/Delete plus the pod binding and eviction subresources, and
+a streaming Watch that decodes JSON-lines watch events.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..api import scheme
+
+
+class APIStatusError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        super().__init__(f"{code} {reason}: {message}")
+        self.code, self.reason = code, reason
+
+
+class RESTClient:
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 user_agent: str = "kubernetes-tpu-client"):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.user_agent = user_agent
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _path(self, plural: str, namespace: Optional[str], name: Optional[str],
+              sub: Optional[str] = None) -> str:
+        kind = scheme.kind_for_plural(plural)
+        ver = scheme.api_version_for(kind)
+        prefix = f"/api/{ver}" if "/" not in ver else f"/apis/{ver}"
+        parts = [prefix]
+        if namespace is not None and scheme.is_namespaced(kind):
+            parts.append(f"namespaces/{namespace}")
+        parts.append(plural)
+        if name:
+            parts.append(name)
+        if sub:
+            parts.append(sub)
+        return "/".join(parts)
+
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                query: str = "") -> dict:
+        url = self.base_url + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        req.add_header("User-Agent", self.user_agent)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                status = json.loads(e.read())
+            except Exception:
+                status = {}
+            raise APIStatusError(e.code, status.get("reason", e.reason or ""),
+                                 status.get("message", ""))
+
+    # -- verbs -----------------------------------------------------------------
+
+    def list(self, plural: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             field_selector: Optional[Dict[str, str]] = None
+             ) -> Tuple[List[object], int]:
+        """Returns (items, list resourceVersion)."""
+        q = []
+        if label_selector:
+            q.append("labelSelector=" + ",".join(
+                f"{k}={v}" for k, v in label_selector.items()))
+        if field_selector:
+            q.append("fieldSelector=" + ",".join(
+                f"{k}={v}" for k, v in field_selector.items()))
+        data = self.request("GET", self._path(plural, namespace, None),
+                            query="&".join(q))
+        kind = scheme.kind_for_plural(plural)
+        items = [scheme.decode(kind, d) for d in data.get("items", [])]
+        rv = int(data.get("metadata", {}).get("resourceVersion", "0"))
+        return items, rv
+
+    def get(self, plural: str, namespace: Optional[str], name: str):
+        data = self.request("GET", self._path(plural, namespace, name))
+        return scheme.decode(scheme.kind_for_plural(plural), data)
+
+    def create(self, plural: str, obj, namespace: Optional[str] = None):
+        ns = namespace if namespace is not None else getattr(
+            obj.metadata, "namespace", None)
+        data = self.request("POST", self._path(plural, ns, None),
+                            body=scheme.encode_object(obj))
+        return scheme.decode(scheme.kind_for_plural(plural), data)
+
+    def update(self, plural: str, obj, sub: Optional[str] = None):
+        path = self._path(plural, obj.metadata.namespace, obj.metadata.name, sub)
+        data = self.request("PUT", path, body=scheme.encode_object(obj))
+        return scheme.decode(scheme.kind_for_plural(plural), data)
+
+    def update_status(self, plural: str, obj):
+        return self.update(plural, obj, sub="status")
+
+    def patch(self, plural: str, namespace: Optional[str], name: str,
+              patch: dict):
+        data = self.request("PATCH", self._path(plural, namespace, name),
+                            body=patch)
+        return scheme.decode(scheme.kind_for_plural(plural), data)
+
+    def delete(self, plural: str, namespace: Optional[str], name: str):
+        self.request("DELETE", self._path(plural, namespace, name))
+
+    def bind(self, namespace: str, pod_name: str, node_name: str):
+        """POST pods/<name>/binding (scheduler.go:409 Bind)."""
+        self.request("POST", self._path("pods", namespace, pod_name, "binding"),
+                     body={"kind": "Binding", "apiVersion": "v1",
+                           "metadata": {"name": pod_name},
+                           "target": {"kind": "Node", "name": node_name}})
+
+    def evict(self, namespace: str, pod_name: str):
+        self.request("POST", self._path("pods", namespace, pod_name, "eviction"),
+                     body={"kind": "Eviction", "apiVersion": "policy/v1beta1"})
+
+    # -- watch -----------------------------------------------------------------
+
+    def watch(self, plural: str, resource_version: Optional[int] = None,
+              timeout_seconds: float = 30.0,
+              stop: Optional[threading.Event] = None
+              ) -> Iterator[Tuple[str, object]]:
+        """Yields (event_type, object). Returns when the server closes the
+        stream (timeout) or `stop` is set. Raises APIStatusError(410) when
+        the resourceVersion is too old — caller relists (reflector.go)."""
+        q = f"watch=true&timeoutSeconds={timeout_seconds:g}"
+        if resource_version is not None:
+            q += f"&resourceVersion={resource_version}"
+        url = self.base_url + self._path(plural, None, None) + "?" + q
+        req = urllib.request.Request(url)
+        req.add_header("User-Agent", self.user_agent)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        kind = scheme.kind_for_plural(plural)
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout_seconds + 10)
+        except urllib.error.HTTPError as e:
+            try:
+                status = json.loads(e.read())
+            except Exception:
+                status = {}
+            raise APIStatusError(e.code, status.get("reason", e.reason or ""),
+                                 status.get("message", ""))
+        with resp:
+            while stop is None or not stop.is_set():
+                try:
+                    line = resp.readline()
+                except (socket.timeout, OSError):
+                    return
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                yield ev["type"], scheme.decode(kind, ev["object"])

@@ -108,6 +108,9 @@ class ObjectStore:
             if old is None:
                 raise KeyError(f"{kind} {key} not found")
             self._rv += 1
+            # stamp the deletion revision (etcd delete ModRevision analog) so
+            # watch clients advance past this event instead of replaying it
+            old.metadata.resource_version = self._rv
             self._notify(Event(DELETED, kind, old, resource_version=self._rv))
             return old
 

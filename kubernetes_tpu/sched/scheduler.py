@@ -26,6 +26,7 @@ the wave batch in later rounds.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -89,6 +90,13 @@ class Scheduler:
         self.wave_size = wave_size
         self.features = features or FeatureGates()
         self.clock = clock
+        # Guards cache + snapshot against concurrent informer delivery:
+        # with RemoteStore, handlers fire on reflector threads while the
+        # wave runs (reference: schedulerCache's mutex, cache.go:42; here
+        # coarser because snapshot mutations must be atomic w.r.t. the
+        # device upload). RLock: in-process stores deliver bind events
+        # re-entrantly on the committing thread.
+        self._mu = threading.RLock()
         self.cache = SchedulerCache(ttl=assume_ttl, clock=clock)
         self.snapshot = Snapshot(caps=caps)
         self.featurizer = PodFeaturizer(self.snapshot, GroupLister(store))
@@ -122,49 +130,54 @@ class Scheduler:
         return pod.spec.scheduler_name == self.profile.scheduler_name
 
     def _on_pod_add(self, pod: api.Pod):
-        if pod.spec.node_name:
-            self.cache.add_pod(pod)
-            ni = self.cache.node_infos.get(pod.spec.node_name)
-            if ni is not None:
-                self.snapshot.refresh_node_resources(ni)
-            self.snapshot.add_pod(pod)
-            self.queue.assigned_pod_added(pod)
-        elif self._responsible(pod) and pod.status.phase in ("", "Pending"):
-            self.queue.add(pod)
+        with self._mu:
+            if pod.spec.node_name:
+                self.cache.add_pod(pod)
+                ni = self.cache.node_infos.get(pod.spec.node_name)
+                if ni is not None:
+                    self.snapshot.refresh_node_resources(ni)
+                self.snapshot.add_pod(pod)
+                self.queue.assigned_pod_added(pod)
+            elif self._responsible(pod) and pod.status.phase in ("", "Pending"):
+                self.queue.add(pod)
 
     def _on_pod_update(self, old: api.Pod, new: api.Pod):
-        if new.spec.node_name:
-            if old.spec.node_name:
-                self.cache.update_pod(old, new)
-            else:
-                self.cache.add_pod(new)  # bind confirmation
-            ni = self.cache.node_infos.get(new.spec.node_name)
-            if ni is not None:
-                self.snapshot.refresh_node_resources(ni)
-            self.snapshot.add_pod(new)
-            self.queue.assigned_pod_added(new)
-        elif self._responsible(new):
-            self.queue.update(old, new)
+        with self._mu:
+            if new.spec.node_name:
+                if old.spec.node_name:
+                    self.cache.update_pod(old, new)
+                else:
+                    self.cache.add_pod(new)  # bind confirmation
+                ni = self.cache.node_infos.get(new.spec.node_name)
+                if ni is not None:
+                    self.snapshot.refresh_node_resources(ni)
+                self.snapshot.add_pod(new)
+                self.queue.assigned_pod_added(new)
+            elif self._responsible(new):
+                self.queue.update(old, new)
 
     def _on_pod_delete(self, pod: api.Pod):
-        if pod.spec.node_name:
-            self.cache.remove_pod(pod)
-            ni = self.cache.node_infos.get(pod.spec.node_name)
-            if ni is not None:
-                self.snapshot.refresh_node_resources(ni)
-            self.snapshot.remove_pod(pod)
-            self.queue.move_all_to_active()
-        else:
-            self.queue.delete(pod)
+        with self._mu:
+            if pod.spec.node_name:
+                self.cache.remove_pod(pod)
+                ni = self.cache.node_infos.get(pod.spec.node_name)
+                if ni is not None:
+                    self.snapshot.refresh_node_resources(ni)
+                self.snapshot.remove_pod(pod)
+                self.queue.move_all_to_active()
+            else:
+                self.queue.delete(pod)
 
     def _on_node_add(self, node: api.Node):
-        self.cache.add_node(node)
-        self.snapshot.set_node(self.cache.node_infos[node.name])
-        self.queue.move_all_to_active()
+        with self._mu:
+            self.cache.add_node(node)
+            self.snapshot.set_node(self.cache.node_infos[node.name])
+            self.queue.move_all_to_active()
 
     def _on_node_delete(self, node: api.Node):
-        self.cache.remove_node(node)
-        self.snapshot.remove_node(node.name)
+        with self._mu:
+            self.cache.remove_node(node)
+            self.snapshot.remove_node(node.name)
 
     def _invalidate_features(self):
         # group membership may have changed -> equivalence rows are stale
@@ -187,10 +200,17 @@ class Scheduler:
         """Schedule one wave. Returns the number of pods bound."""
         import jax.numpy as jnp
 
-        self.cache.cleanup_expired()
+        with self._mu:
+            self.cache.cleanup_expired()
         pods = self.queue.pop_wave(self.wave_size, timeout=timeout)
         if not pods:
             return 0
+        with self._mu:
+            return self._run_wave(pods)
+
+    def _run_wave(self, pods: List[api.Pod]) -> int:
+        import jax.numpy as jnp
+
         # pods whose required pod-(anti)affinity spans >1 topology key take
         # the exact host path (ops/affinity.py single-anchor limitation)
         host_path = [p for p in pods if self.featurizer.needs_host_path(p)]
@@ -280,7 +300,12 @@ class Scheduler:
         try:
             for ext in self.profile.extenders:
                 if ext.filter_verb and feasible:
-                    feasible, ext_failed = ext.filter(pod, feasible)
+                    feasible, ext_failed = ext.filter(
+                        pod, feasible,
+                        node_labels=None if ext.node_cache_capable else {
+                            n: (self.cache.node_infos[n].node.metadata.labels or {})
+                            for n in feasible
+                            if self.cache.node_infos[n].node is not None})
                     for n, r in ext_failed.items():
                         reasons[r] = reasons.get(r, 0) + 1
                         failed[n] = ["ExtenderFilter"]

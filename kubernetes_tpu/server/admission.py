@@ -1,0 +1,181 @@
+"""Admission control chain.
+
+Analog of the reference's admission framework (apiserver/pkg/admission/
+chain.go) with a representative subset of the 23 in-tree plugins
+(plugin/pkg/admission/): NamespaceLifecycle, Priority,
+DefaultTolerationSeconds, ResourceQuota, NodeRestriction. Plugins
+mutate and/or validate the object before it reaches storage
+(endpoints/handlers/create.go admission step).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import types as api
+from ..runtime.store import ObjectStore
+from .auth import UserInfo
+
+
+class AdmissionError(Exception):
+    """Admission denial -> HTTP 403 (reference: admission errors are
+    apierrors.NewForbidden)."""
+
+
+class AdmissionPlugin:
+    name = "plugin"
+
+    def admit(self, op: str, kind: str, obj, old, user: Optional[UserInfo],
+              store: ObjectStore):
+        """op in {create, update, delete}. kind is the storage plural.
+        Mutate obj in place or raise AdmissionError."""
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    """Reject creates in missing or terminating namespaces
+    (plugin/pkg/admission/namespace/lifecycle/admission.go)."""
+
+    name = "NamespaceLifecycle"
+    immortal = ("default", "kube-system", "kube-public")
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind == "namespaces":
+            return
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns:
+            return
+        nsobj = store.get("namespaces", "", ns) or store.get(
+            "namespaces", "default", ns)
+        if nsobj is None:
+            if ns in self.immortal:
+                return  # auto-created namespaces
+            raise AdmissionError(f"namespace {ns} not found")
+        if nsobj.status.phase == "Terminating":
+            raise AdmissionError(f"namespace {ns} is terminating")
+
+
+class PriorityAdmission(AdmissionPlugin):
+    """Resolve priorityClassName -> spec.priority
+    (plugin/pkg/admission/priority/admission.go)."""
+
+    name = "Priority"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "pods":
+            return
+        pcn = obj.spec.priority_class_name
+        if pcn:
+            pc = store.get("priorityclasses", "", pcn) or store.get(
+                "priorityclasses", "default", pcn)
+            if pc is None:
+                raise AdmissionError(f"priority class {pcn} not found")
+            obj.spec.priority = pc.value
+        elif obj.spec.priority is None:
+            default = next((p for p in store.list("priorityclasses")
+                            if getattr(p, "global_default", False)), None)
+            obj.spec.priority = default.value if default else 0
+
+
+class DefaultTolerationSeconds(AdmissionPlugin):
+    """Add default notready/unreachable NoExecute tolerations with
+    tolerationSeconds=300 (plugin/pkg/admission/defaulttolerationseconds)."""
+
+    name = "DefaultTolerationSeconds"
+    NOT_READY = "node.kubernetes.io/not-ready"
+    UNREACHABLE = "node.kubernetes.io/unreachable"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "pods":
+            return
+        tols = obj.spec.tolerations
+        have_nr = any(t.key in ("", self.NOT_READY) and
+                      t.effect in ("", api.NO_EXECUTE) for t in tols)
+        have_ur = any(t.key in ("", self.UNREACHABLE) and
+                      t.effect in ("", api.NO_EXECUTE) for t in tols)
+        if not have_nr:
+            tols.append(api.Toleration(key=self.NOT_READY, operator="Exists",
+                                       effect=api.NO_EXECUTE,
+                                       toleration_seconds=300))
+        if not have_ur:
+            tols.append(api.Toleration(key=self.UNREACHABLE, operator="Exists",
+                                       effect=api.NO_EXECUTE,
+                                       toleration_seconds=300))
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    """Enforce hard pod-count and cpu/memory request quotas per namespace
+    (plugin/pkg/admission/resourcequota + pkg/quota evaluators,
+    simplified to the core-resource evaluator)."""
+
+    name = "ResourceQuota"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "pods":
+            return
+        ns = obj.metadata.namespace
+        quotas = [q for q in store.list("resourcequotas", ns)]
+        if not quotas:
+            return
+        req = api.get_resource_request(obj)
+        pods_in_ns = store.list("pods", ns)
+        for q in quotas:
+            hard = q.spec.hard
+            if "pods" in hard and len(pods_in_ns) + 1 > hard["pods"]:
+                raise AdmissionError(
+                    f"exceeded quota {q.metadata.name}: pods "
+                    f"{len(pods_in_ns) + 1} > {hard['pods']}")
+            for rname, label in (("cpu", "requests.cpu"),
+                                 ("memory", "requests.memory")):
+                key = "cpu" if rname == "cpu" else "memory"
+                limit = hard.get(label, hard.get(key))
+                if limit is None:
+                    continue
+                used = sum(api.get_resource_request(p).get(key, 0)
+                           for p in pods_in_ns)
+                if used + req.get(key, 0) > limit:
+                    raise AdmissionError(
+                        f"exceeded quota {q.metadata.name}: {label} "
+                        f"{used + req.get(key, 0)} > {limit}")
+
+
+class NodeRestriction(AdmissionPlugin):
+    """Kubelet identities (system:nodes group, user system:node:<name>) may
+    only update their own Node object and pods bound to it
+    (plugin/pkg/admission/noderestriction/admission.go)."""
+
+    name = "NodeRestriction"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if user is None or "system:nodes" not in user.groups:
+            return
+        node_name = user.name[len("system:node:"):] \
+            if user.name.startswith("system:node:") else ""
+        if kind == "nodes" and obj is not None:
+            if obj.metadata.name != node_name:
+                raise AdmissionError(
+                    f"node {node_name} cannot modify node {obj.metadata.name}")
+        if kind == "pods" and op in ("update", "delete"):
+            target = obj if obj is not None else old
+            if target is not None and target.spec.node_name and \
+                    target.spec.node_name != node_name:
+                raise AdmissionError(
+                    f"node {node_name} cannot modify pod bound to "
+                    f"{target.spec.node_name}")
+
+
+class AdmissionChain:
+    """Ordered plugin chain (admission/chain.go chainAdmissionHandler)."""
+
+    def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
+        self.plugins = plugins if plugins is not None else []
+
+    @staticmethod
+    def default() -> "AdmissionChain":
+        return AdmissionChain([NamespaceLifecycle(), PriorityAdmission(),
+                               DefaultTolerationSeconds(),
+                               ResourceQuotaAdmission(), NodeRestriction()])
+
+    def admit(self, op: str, kind: str, obj, old, user: Optional[UserInfo],
+              store: ObjectStore):
+        for p in self.plugins:
+            p.admit(op, kind, obj, old, user, store)

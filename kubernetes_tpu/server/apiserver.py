@@ -1,0 +1,482 @@
+"""HTTP REST API server over the object store.
+
+The reference's kube-apiserver reduced to its load-bearing walls:
+
+  handler chain   authn -> authz -> admission -> storage
+                  (apiserver/pkg/server/config.go
+                   DefaultBuildHandlerChainFunc; admission runs inside the
+                   create/update handlers, endpoints/handlers/create.go)
+  REST mapping    /api/v1/... and /apis/<group>/<version>/... routes to
+                  per-resource CRUD (endpoints/installer.go ->
+                  registry/generic/registry/store.go)
+  watch           ?watch=true streams JSON-lines watch events served from
+                  the broadcaster's in-memory window (storage/cacher.go);
+                  a too-old resourceVersion returns 410 Gone
+  subresources    pods/<name>/binding (the scheduler's bind POST,
+                  registry/core/pod/storage BindingREST), pods/<name>/status,
+                  nodes/<name>/status, namespaces/<name>/finalize
+  ops endpoints   /healthz, /metrics, /version, /api, /apis
+
+Wire format: JSON with camelCase keys via api/scheme.py codecs.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api import scheme
+from ..api import types as api
+from ..runtime.store import Conflict, ObjectStore
+from ..runtime.watch import Broadcaster, TooOld
+from .admission import AdmissionChain, AdmissionError
+from .auth import RBACAuthorizer, TokenAuthenticator, UserInfo
+
+
+class APIError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        super().__init__(message)
+        self.code, self.reason, self.message = code, reason, message
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps({"kind": "Status", "apiVersion": "v1", "status": "Failure",
+                       "reason": reason, "message": message, "code": code}).encode()
+
+
+# verbs per HTTP method (reference: endpoints/installer.go mapping)
+_VERBS = {"GET": "get", "POST": "create", "PUT": "update",
+          "PATCH": "patch", "DELETE": "delete"}
+
+
+class APIServer:
+    def __init__(self, store: ObjectStore,
+                 authenticator: Optional[TokenAuthenticator] = None,
+                 authorizer: Optional[RBACAuthorizer] = None,
+                 admission: Optional[AdmissionChain] = None,
+                 audit_sink: Optional[Callable[[dict], None]] = None,
+                 metrics_providers: Optional[List[Callable[[], str]]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        self.broadcaster = Broadcaster(store)
+        self.authenticator = authenticator
+        self.authorizer = authorizer
+        self.admission = admission if admission is not None else AdmissionChain()
+        self.audit_sink = audit_sink
+        self.metrics_providers = metrics_providers or []
+        self.request_count: Dict[str, int] = {}
+        self._count_lock = threading.Lock()
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence default stderr logging
+                pass
+
+            def _dispatch(self):
+                try:
+                    server._handle(self)
+                except APIError as e:
+                    self._send(e.code, _status_body(e.code, e.reason, e.message))
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # 500 InternalError
+                    self._send(500, _status_body(500, "InternalError", repr(e)))
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _dispatch
+
+            def _send(self, code: int, body: bytes,
+                      content_type: str = "application/json"):
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", content_type)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- handler chain ---------------------------------------------------------
+
+    def _handle(self, h):
+        parsed = urlparse(h.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = parse_qs(parsed.query)
+
+        # ops endpoints bypass the resource chain (but not authn)
+        if parts == ["healthz"]:
+            return h._send(200, b"ok", "text/plain")
+        if parts == ["version"]:
+            return h._send(200, json.dumps(
+                {"major": "1", "minor": "11", "gitVersion": "v1.11.0-tpu"}).encode())
+        if parts == ["metrics"]:
+            text = self._metrics_text()
+            return h._send(200, text.encode(), "text/plain")
+        if parts == ["api"]:
+            return h._send(200, json.dumps({"kind": "APIVersions",
+                                            "versions": ["v1"]}).encode())
+        if parts == ["apis"]:
+            groups = sorted({scheme.api_version_for(k).split("/")[0]
+                             for k in scheme.all_kinds()
+                             if "/" in scheme.api_version_for(k)})
+            return h._send(200, json.dumps({"kind": "APIGroupList",
+                                            "groups": groups}).encode())
+
+        # authn (filters/authentication.go)
+        user = None
+        if self.authenticator is not None:
+            user = self.authenticator.authenticate(h.headers.get("Authorization"))
+            if user is None:
+                raise APIError(401, "Unauthorized", "authentication failed")
+
+        route = self._route(parts)
+        if route is None:
+            raise APIError(404, "NotFound", f"path {parsed.path!r} not found")
+        plural, namespace, name, sub = route
+        verb = _VERBS[h.command]
+        if verb == "get" and query.get("watch", ["false"])[0] == "true":
+            verb = "watch"
+        if verb == "get" and name is None:
+            verb = "list"
+
+        # authz (filters/authorization.go)
+        if self.authorizer is not None and user is not None:
+            if not self.authorizer.authorize(user, verb, plural):
+                raise APIError(403, "Forbidden",
+                               f"user {user.name} cannot {verb} {plural}")
+
+        with self._count_lock:
+            key = f"{verb}:{plural}"
+            self.request_count[key] = self.request_count.get(key, 0) + 1
+
+        self._audit(user, verb, plural, namespace, name)
+
+        if verb == "watch":
+            return self._serve_watch(h, plural, query)
+        if verb == "list":
+            return self._serve_list(h, plural, namespace, query)
+        if verb == "get":
+            return self._serve_get(h, plural, namespace, name)
+        if verb == "create":
+            if sub == "binding":
+                return self._serve_binding(h, namespace, name)
+            if sub == "eviction":
+                return self._serve_eviction(h, user, namespace, name)
+            return self._serve_create(h, plural, namespace, user)
+        if verb in ("update", "patch"):
+            return self._serve_update(h, plural, namespace, name, sub, user,
+                                      patch=(verb == "patch"))
+        if verb == "delete":
+            return self._serve_delete(h, plural, namespace, name, user)
+        raise APIError(405, "MethodNotAllowed", f"{h.command} unsupported")
+
+    # -- routing ---------------------------------------------------------------
+
+    def _route(self, parts: List[str]
+               ) -> Optional[Tuple[str, Optional[str], Optional[str], Optional[str]]]:
+        """path segments -> (plural, namespace, name, subresource)."""
+        if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+            rest = parts[2:]
+        elif len(parts) >= 3 and parts[0] == "apis":
+            rest = parts[3:]
+        else:
+            return None
+        if not rest:
+            return None
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            ns, rest2 = rest[1], rest[2:]
+            plural = rest2[0]
+            if scheme.kind_for_plural(plural) is None:
+                return None
+            name = rest2[1] if len(rest2) > 1 else None
+            sub = rest2[2] if len(rest2) > 2 else None
+            return plural, ns, name, sub
+        plural = rest[0]
+        if scheme.kind_for_plural(plural) is None:
+            return None
+        name = rest[1] if len(rest) > 1 else None
+        sub = rest[2] if len(rest) > 2 else None
+        return plural, None, name, sub
+
+    def _find(self, plural: str, namespace: Optional[str], name: str):
+        kind = scheme.kind_for_plural(plural)
+        for ns in ([namespace] if namespace is not None
+                   else ["default", ""]):
+            obj = self.store.get(plural, ns, name)
+            if obj is not None:
+                return obj
+        if namespace is not None and not scheme.is_namespaced(kind):
+            for ns in ("default", ""):
+                obj = self.store.get(plural, ns, name)
+                if obj is not None:
+                    return obj
+        return None
+
+    # -- verbs -----------------------------------------------------------------
+
+    def _serve_list(self, h, plural, namespace, query):
+        objs = self.store.list(plural, namespace)
+        sel = query.get("labelSelector", [None])[0]
+        if sel:
+            pairs = dict(kv.split("=", 1) for kv in sel.split(","))
+            objs = [o for o in objs
+                    if all((o.metadata.labels or {}).get(k) == v
+                           for k, v in pairs.items())]
+        fsel = query.get("fieldSelector", [None])[0]
+        if fsel:
+            for kv in fsel.split(","):
+                k, _, v = kv.partition("=")
+                if k == "spec.nodeName":
+                    objs = [o for o in objs if o.spec.node_name == v]
+                elif k == "metadata.name":
+                    objs = [o for o in objs if o.metadata.name == v]
+        kind = scheme.kind_for_plural(plural)
+        body = json.dumps({
+            "kind": kind + "List", "apiVersion": scheme.api_version_for(kind),
+            "metadata": {"resourceVersion": str(self.store.latest_resource_version)},
+            "items": [scheme.encode_object(o) for o in objs]}).encode()
+        h._send(200, body)
+
+    def _serve_get(self, h, plural, namespace, name):
+        obj = self._find(plural, namespace, name)
+        if obj is None:
+            raise APIError(404, "NotFound", f"{plural} {name!r} not found")
+        h._send(200, scheme.to_json(obj).encode())
+
+    def _read_body(self, h) -> dict:
+        length = int(h.headers.get("Content-Length", 0))
+        raw = h.rfile.read(length) if length else b"{}"
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise APIError(400, "BadRequest", f"invalid JSON: {e}")
+
+    def _serve_create(self, h, plural, namespace, user):
+        kind = scheme.kind_for_plural(plural)
+        data = self._read_body(h)
+        data.setdefault("kind", kind)
+        try:
+            obj = scheme.decode(kind, data)
+        except Exception as e:
+            raise APIError(400, "BadRequest", f"cannot decode {kind}: {e}")
+        if namespace is not None and scheme.is_namespaced(kind):
+            obj.metadata.namespace = namespace
+        try:
+            self.admission.admit("create", plural, obj, None, user, self.store)
+        except AdmissionError as e:
+            raise APIError(403, "Forbidden", str(e))
+        try:
+            self.store.create(plural, obj)
+        except Conflict as e:
+            raise APIError(409, "AlreadyExists", str(e))
+        h._send(201, scheme.to_json(obj).encode())
+
+    def _serve_update(self, h, plural, namespace, name, sub, user, patch):
+        kind = scheme.kind_for_plural(plural)
+        old = self._find(plural, namespace, name)
+        if old is None:
+            raise APIError(404, "NotFound", f"{plural} {name!r} not found")
+        data = self._read_body(h)
+        if patch:
+            merged = scheme.encode_object(old)
+            _merge_patch(merged, data)
+            data = merged
+        elif sub == "status":
+            # status subresource: replace status, keep spec (registry
+            # UpdateStatus strategy)
+            full = scheme.encode_object(old)
+            full["status"] = data.get("status", data)
+            data = full
+        elif sub == "finalize":
+            full = scheme.encode_object(old)
+            if "spec" in data:
+                full["spec"] = data["spec"]
+            data = full
+        try:
+            obj = scheme.decode(kind, data)
+        except Exception as e:
+            raise APIError(400, "BadRequest", f"cannot decode {kind}: {e}")
+        # optimistic concurrency: a nonzero stale resourceVersion is a 409
+        # (GuaranteedUpdate / etcd3 ModRevision CAS, storage/etcd3/store.go:262)
+        if obj.metadata.resource_version and \
+                obj.metadata.resource_version != old.metadata.resource_version:
+            raise APIError(409, "Conflict",
+                           f"resourceVersion {obj.metadata.resource_version} "
+                           f"!= {old.metadata.resource_version}")
+        obj.metadata.namespace = old.metadata.namespace
+        obj.metadata.name = old.metadata.name
+        obj.metadata.uid = old.metadata.uid
+        try:
+            self.admission.admit("update", plural, obj, old, user, self.store)
+        except AdmissionError as e:
+            raise APIError(403, "Forbidden", str(e))
+        try:
+            self.store.update(plural, obj)
+        except Conflict as e:
+            raise APIError(409, "Conflict", str(e))
+        h._send(200, scheme.to_json(obj).encode())
+
+    def _serve_delete(self, h, plural, namespace, name, user):
+        obj = self._find(plural, namespace, name)
+        if obj is None:
+            raise APIError(404, "NotFound", f"{plural} {name!r} not found")
+        try:
+            self.admission.admit("delete", plural, None, obj, user, self.store)
+        except AdmissionError as e:
+            raise APIError(403, "Forbidden", str(e))
+        self.store.delete(plural, obj.metadata.namespace, obj.metadata.name)
+        h._send(200, _status_body(200, "Success", f"{name} deleted")
+                .replace(b"Failure", b"Success"))
+
+    def _serve_binding(self, h, namespace, name):
+        """POST pods/<name>/binding (BindingREST.Create,
+        registry/core/pod/storage/storage.go)."""
+        data = self._read_body(h)
+        target = (data.get("target") or {}).get("name", "")
+        if not target:
+            raise APIError(400, "BadRequest", "binding.target.name required")
+        pod = self._find("pods", namespace, name)
+        if pod is None:
+            raise APIError(404, "NotFound", f"pod {name!r} not found")
+        try:
+            self.store.bind(pod, target)
+        except Conflict as e:
+            raise APIError(409, "Conflict", str(e))
+        h._send(201, _status_body(201, "Success", "bound")
+                .replace(b"Failure", b"Success"))
+
+    def _serve_eviction(self, h, user, namespace, name):
+        """POST pods/<name>/eviction — PDB-respecting delete
+        (registry/core/pod EvictionREST)."""
+        pod = self._find("pods", namespace, name)
+        if pod is None:
+            raise APIError(404, "NotFound", f"pod {name!r} not found")
+        for pdb in self.store.list("poddisruptionbudgets", pod.metadata.namespace):
+            sel = pdb.selector
+            if sel is not None and sel.matches(pod.metadata.labels or {}) \
+                    and pdb.disruptions_allowed <= 0:
+                raise APIError(429, "TooManyRequests",
+                               f"pdb {pdb.metadata.name} disallows eviction")
+        self.store.delete("pods", pod.metadata.namespace, pod.metadata.name)
+        h._send(201, _status_body(201, "Success", "evicted")
+                .replace(b"Failure", b"Success"))
+
+    # -- watch -----------------------------------------------------------------
+
+    def _serve_watch(self, h, plural, query):
+        rv = query.get("resourceVersion", [None])[0]
+        since = int(rv) if rv not in (None, "", "0") else None
+        timeout = float(query.get("timeoutSeconds", ["30"])[0])
+        # resourceVersion=0: deliver current state as synthetic ADDED events
+        # then go live (cacher's GetAllEventsSince for zero version,
+        # storage/watch_cache.go) — must snapshot state and open the live
+        # watcher under one view to not drop or duplicate events
+        initial: List[object] = []
+        try:
+            if rv == "0":
+                with self.store._lock:
+                    initial = self.store.list(plural)
+                    watcher = self.broadcaster.watch(
+                        kind=plural,
+                        since_rv=self.store.latest_resource_version)
+            else:
+                watcher = self.broadcaster.watch(kind=plural, since_rv=since)
+        except TooOld as e:
+            raise APIError(410, "Expired", str(e))
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            for obj in initial:
+                line = (json.dumps(
+                    {"type": "ADDED", "object": scheme.encode_object(obj)})
+                    + "\n").encode()
+                h.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+            if initial:
+                h.wfile.flush()
+            deadline = time.monotonic() + timeout
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                ev = watcher.next(timeout=min(left, 1.0))
+                if ev is None:
+                    if watcher.stopped:
+                        break
+                    continue
+                line = (json.dumps(
+                    {"type": ev.type, "object": scheme.encode_object(ev.obj)})
+                    + "\n").encode()
+                h.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+                h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, socket.error):
+            pass
+        finally:
+            watcher.stop()
+            h.close_connection = True
+
+    # -- cross-cutting ---------------------------------------------------------
+
+    def _audit(self, user: Optional[UserInfo], verb, plural, namespace, name):
+        if self.audit_sink is None:
+            return
+        self.audit_sink({"ts": time.time(),
+                         "user": user.name if user else "",
+                         "verb": verb, "resource": plural,
+                         "namespace": namespace or "", "name": name or ""})
+
+    def _metrics_text(self) -> str:
+        lines = ["# TYPE apiserver_request_count counter"]
+        with self._count_lock:
+            for key, n in sorted(self.request_count.items()):
+                verb, res = key.split(":", 1)
+                lines.append(
+                    f'apiserver_request_count{{verb="{verb}",resource="{res}"}} {n}')
+        for provider in self.metrics_providers:
+            lines.append(provider())
+        return "\n".join(lines) + "\n"
+
+
+def _merge_patch(target: dict, patch: dict):
+    """RFC 7386 merge patch (the reference default is strategic merge;
+    merge patch covers the framework's PATCH uses)."""
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            _merge_patch(target[k], v)
+        else:
+            target[k] = v

@@ -1,0 +1,81 @@
+"""Authentication + RBAC authorization.
+
+Reference: token-file authn (apiserver/pkg/authentication/request/
+bearertoken + plugin/pkg/auth/authenticator/token/tokenfile), RBAC
+authorizer (plugin/pkg/auth/authorizer/rbac/rbac.go RuleAllows).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    name: str
+    groups: Tuple[str, ...] = ()
+
+
+ANONYMOUS = UserInfo("system:anonymous", ("system:unauthenticated",))
+
+
+class TokenAuthenticator:
+    """Bearer-token -> user mapping (token-file authenticator analog)."""
+
+    def __init__(self, tokens: Dict[str, UserInfo],
+                 allow_anonymous: bool = True):
+        self.tokens = tokens
+        self.allow_anonymous = allow_anonymous
+
+    def authenticate(self, authorization_header: Optional[str]) -> Optional[UserInfo]:
+        """Returns the user, or None to reject (401)."""
+        if authorization_header and authorization_header.startswith("Bearer "):
+            tok = authorization_header[len("Bearer "):].strip()
+            user = self.tokens.get(tok)
+            if user is not None:
+                return user
+            return None  # bad token is always a 401
+        return ANONYMOUS if self.allow_anonymous else None
+
+
+@dataclass
+class PolicyRule:
+    """One RBAC rule: verbs x resources (reference: rbac/v1 PolicyRule;
+    '*' wildcards as in rbac.VerbMatches/ResourceMatches)."""
+
+    verbs: Sequence[str]
+    resources: Sequence[str]
+
+    def allows(self, verb: str, resource: str) -> bool:
+        return (("*" in self.verbs or verb in self.verbs)
+                and ("*" in self.resources or resource in self.resources))
+
+
+@dataclass
+class RoleBinding:
+    """Subject (user or group name) -> list of rules. Collapses the
+    reference's ClusterRole + ClusterRoleBinding pair."""
+
+    subject: str  # user name or group name
+    rules: List[PolicyRule] = field(default_factory=list)
+
+
+class RBACAuthorizer:
+    """visitRulesFor analog: union of rules from bindings matching the
+    user's name or any group (rbac.go:74 Authorize)."""
+
+    def __init__(self, bindings: Sequence[RoleBinding]):
+        self.bindings = list(bindings)
+
+    def authorize(self, user: UserInfo, verb: str, resource: str) -> bool:
+        names = {user.name, *user.groups}
+        for b in self.bindings:
+            if b.subject in names:
+                if any(r.allows(verb, resource) for r in b.rules):
+                    return True
+        return False
+
+
+def cluster_admin_bindings(subjects: Sequence[str]) -> List[RoleBinding]:
+    return [RoleBinding(s, [PolicyRule(["*"], ["*"])]) for s in subjects]

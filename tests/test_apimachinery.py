@@ -1,0 +1,169 @@
+"""Scheme/codec + watch broadcaster tests (apimachinery analog).
+
+Reference semantics: runtime.Scheme + JSON serializer round-trips
+(apimachinery/pkg/runtime), watch.Broadcaster fan-out (pkg/watch/mux.go),
+watch-cache replay + 410 Gone (apiserver/pkg/storage/watch_cache.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import scheme
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector, Requirement
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.runtime.watch import Broadcaster, TooOld
+
+
+def rt(obj):
+    return scheme.from_json(scheme.to_json(obj))
+
+
+class TestCodec:
+    def test_pod_round_trip_full(self):
+        p = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="ns", labels={"a": "b"},
+                                    annotations={"k": "v"}),
+            spec=api.PodSpec(
+                node_selector={"disk": "ssd"},
+                tolerations=[api.Toleration(key="k", operator="Exists",
+                                            effect="NoExecute",
+                                            toleration_seconds=30)],
+                priority=100,
+                affinity=api.Affinity(
+                    node_affinity=api.NodeAffinity(
+                        required=api.NodeSelector([api.NodeSelectorTerm(
+                            match_expressions=[Requirement("zone", "In", ("z1",))])]),
+                        preferred=[api.PreferredSchedulingTerm(
+                            weight=5, preference=api.NodeSelectorTerm(
+                                match_expressions=[Requirement("gpu", "Exists")]))]),
+                    pod_anti_affinity=api.PodAntiAffinity(required=[
+                        api.PodAffinityTerm(
+                            label_selector=LabelSelector(match_labels={"app": "x"}),
+                            topology_key="kubernetes.io/hostname")])),
+                containers=[api.Container(
+                    resources=api.ResourceRequirements(
+                        requests=api.resource_list(cpu="250m", memory="64Mi")),
+                    ports=[api.ContainerPort(container_port=80, host_port=80)])],
+                volumes=[api.Volume(name="v", source_kind="GCEPersistentDisk",
+                                    source_id="pd-1")]),
+        )
+        p2 = rt(p)
+        assert p2.metadata.name == "p" and p2.metadata.namespace == "ns"
+        assert p2.spec.tolerations[0].toleration_seconds == 30
+        req = p2.spec.affinity.node_affinity.required
+        assert req.node_selector_terms[0].match_expressions[0].values == ("z1",)
+        assert p2.spec.affinity.pod_anti_affinity.required[0].topology_key \
+            == "kubernetes.io/hostname"
+        assert api.get_resource_request(p2) == api.get_resource_request(p)
+        assert p2.spec.volumes[0].source_kind == "GCEPersistentDisk"
+
+    def test_node_round_trip(self):
+        n = api.Node(
+            metadata=api.ObjectMeta(name="n1", labels={api.LABEL_ZONE: "z"}),
+            spec=api.NodeSpec(unschedulable=True,
+                              taints=[api.Taint("k", "v", api.NO_EXECUTE)]),
+            status=api.NodeStatus(
+                allocatable=api.resource_list(cpu="4", memory="8Gi", pods=110),
+                conditions=[api.NodeCondition(api.NODE_READY, api.COND_FALSE)],
+                images=[api.ContainerImage(names=["img:1"], size_bytes=1 << 20)]))
+        n2 = rt(n)
+        assert n2.spec.unschedulable is True
+        assert n2.spec.taints[0] == api.Taint("k", "v", api.NO_EXECUTE)
+        assert n2.status.allocatable == n.status.allocatable
+        assert n2.status.images[0].size_bytes == 1 << 20
+
+    def test_workload_kinds_round_trip(self):
+        sel = LabelSelector(match_labels={"app": "w"})
+        tmpl = api.PodTemplateSpec(metadata=api.ObjectMeta(labels={"app": "w"}),
+                                   spec=api.PodSpec(containers=[api.Container()]))
+        objs = [
+            api.Deployment(spec=api.DeploymentSpec(replicas=3, selector=sel,
+                                                   template=tmpl)),
+            api.ReplicaSet(spec=api.ReplicaSetSpec(replicas=2, selector=sel,
+                                                   template=tmpl)),
+            api.StatefulSet(spec=api.StatefulSetSpec(replicas=2, selector=sel)),
+            api.DaemonSet(spec=api.DaemonSetSpec(selector=sel, template=tmpl)),
+            api.Job(spec=api.JobSpec(parallelism=2, completions=4, selector=sel,
+                                     template=tmpl)),
+            api.CronJob(spec=api.CronJobSpec(schedule="*/5 * * * *")),
+            api.PodDisruptionBudget(spec=api.PodDisruptionBudgetSpec(
+                selector=sel, min_available=1)),
+            api.Service(spec=api.ServiceSpec(selector={"app": "w"},
+                                             ports=[api.ServicePort(port=80,
+                                                                    target_port=8080)])),
+            api.Endpoints(subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.1", node_name="n1")],
+                ports=[api.EndpointPort(port=8080)])]),
+            api.Namespace(metadata=api.ObjectMeta(name="ns1")),
+            api.ResourceQuota(spec=api.ResourceQuotaSpec(hard={"pods": 10})),
+            api.PriorityClass(metadata=api.ObjectMeta(name="high"), value=1000),
+            api.EventObject(reason="Scheduled", message="ok",
+                            involved_kind="Pod", involved_name="p"),
+        ]
+        for o in objs:
+            o2 = rt(o)
+            assert type(o2) is type(o)
+            assert scheme.kind_of(o2) == scheme.kind_of(o)
+        d2 = rt(objs[0])
+        assert d2.spec.template.metadata.labels == {"app": "w"}
+        assert d2.spec.selector.match_labels == {"app": "w"}
+
+    def test_compat_selector_properties(self):
+        # scheduler-side views preserved after the spec/status restructure
+        assert api.Service(selector={"a": "b"}).selector == {"a": "b"}
+        assert api.ReplicationController(selector={"a": "b"}).selector == {"a": "b"}
+        sel = LabelSelector(match_labels={"a": "b"})
+        assert api.ReplicaSet(selector=sel).selector is sel
+        pdb = api.PodDisruptionBudget(selector=sel, disruptions_allowed=2)
+        assert pdb.disruptions_allowed == 2 and pdb.selector is sel
+
+    def test_plural_registry(self):
+        assert scheme.kind_for_plural("pods") == "Pod"
+        assert scheme.plural_for_kind("ReplicaSet") == "replicasets"
+        assert not scheme.is_namespaced("Node")
+        assert scheme.is_namespaced("Pod")
+
+    def test_decode_unknown_kind(self):
+        with pytest.raises(ValueError):
+            scheme.decode_object({"kind": "Nope"})
+
+
+class TestBroadcaster:
+    def test_fanout_and_kind_filter(self):
+        store = ObjectStore()
+        b = Broadcaster(store)
+        w_all = b.watch()
+        w_pods = b.watch(kind="pods")
+        store.create("pods", api.Pod(metadata=api.ObjectMeta(name="p1")))
+        store.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+        evs = [w_all.next(timeout=1), w_all.next(timeout=1)]
+        assert [e.kind for e in evs] == ["pods", "nodes"]
+        ev = w_pods.next(timeout=1)
+        assert ev.kind == "pods" and ev.obj.metadata.name == "p1"
+        assert w_pods.next(timeout=0.01) is None
+
+    def test_replay_from_rv(self):
+        store = ObjectStore()
+        b = Broadcaster(store)
+        store.create("pods", api.Pod(metadata=api.ObjectMeta(name="p1")))
+        rv1 = store.latest_resource_version
+        store.create("pods", api.Pod(metadata=api.ObjectMeta(name="p2")))
+        w = b.watch(kind="pods", since_rv=rv1)
+        ev = w.next(timeout=1)
+        assert ev.obj.metadata.name == "p2"
+
+    def test_too_old(self):
+        store = ObjectStore()
+        b = Broadcaster(store, window=2)
+        for i in range(5):
+            store.create("pods", api.Pod(metadata=api.ObjectMeta(name=f"p{i}")))
+        with pytest.raises(TooOld):
+            b.watch(since_rv=1)
+
+    def test_stop(self):
+        store = ObjectStore()
+        b = Broadcaster(store)
+        w = b.watch()
+        w.stop()
+        store.create("pods", api.Pod(metadata=api.ObjectMeta(name="p1")))
+        assert w.next(timeout=0.01) is None
