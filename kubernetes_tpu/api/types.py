@@ -86,11 +86,24 @@ class ContainerPort:
 
 
 @dataclass
+class Probe:
+    """Liveness/readiness probe config (reference: core/v1 Probe; the
+    handler itself is delegated to the container runtime here)."""
+
+    initial_delay_seconds: float = 0.0
+    period_seconds: float = 10.0
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
+@dataclass
 class Container:
     name: str = "c"
     image: str = ""
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     ports: List[ContainerPort] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
 
 
 # --- taints & tolerations ---------------------------------------------------

@@ -33,6 +33,7 @@ class Reflector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_sync_rv = 0
+        self.synced = threading.Event()  # set after the first list completes
         self._known: Dict[str, object] = {}
 
     @staticmethod
@@ -76,6 +77,7 @@ class Reflector:
             if key not in new_keys:
                 self.on_event(Event(DELETED, self.plural, self._known.pop(key)))
         self.last_sync_rv = rv
+        self.synced.set()
         while not self._stop.is_set():
             # the stream ends on server timeoutSeconds; re-arm from last rv
             for etype, obj in self.client.watch(
@@ -130,11 +132,15 @@ class RemoteStore:
         for refl in self._reflectors.values():
             refl.stop()
 
-    def wait_for_sync(self, timeout: float = 5.0):
+    def wait_for_sync(self, timeout: float = 5.0) -> bool:
+        """True if every mirror completed its initial list (informer
+        HasSynced). rv is not the sentinel — an empty store lists at rv=0."""
         deadline = time.monotonic() + timeout
+        ok = True
         for refl in list(self._reflectors.values()):
-            while refl.last_sync_rv == 0 and time.monotonic() < deadline:
-                time.sleep(0.005)
+            left = max(0.0, deadline - time.monotonic())
+            ok = refl.synced.wait(left) and ok
+        return ok
 
     def _on_event(self, ev: Event):
         with self._lock:

@@ -1,0 +1,30 @@
+"""Control loops — the reference's pkg/controller/ layer.
+
+Each controller is an informer+workqueue reconciliation loop
+(controller pattern: SharedInformer handlers enqueue keys, workers pop
+and sync to desired state; pkg/controller/*). The set mirrors the
+kube-controller-manager's roster at the capability level: workloads
+(ReplicaSet/RC, Deployment, StatefulSet, DaemonSet, Job, CronJob),
+services (Endpoints), node failure detection (NodeLifecycle), disruption
+budgets, namespace lifecycle, garbage collection (owner references +
+terminated-pod GC), resource quota accounting, service accounts, and
+PV/PVC binding.
+"""
+
+from .base import Controller, is_pod_active, is_pod_ready, pod_owned_by
+from .replicaset import ReplicaSetController, ReplicationControllerController
+from .deployment import DeploymentController
+from .statefulset import StatefulSetController
+from .daemonset import DaemonSetController
+from .job import JobController
+from .cronjob import CronJobController
+from .endpoints import EndpointsController
+from .nodelifecycle import NodeLifecycleController
+from .disruption import DisruptionController
+from .namespace import NamespaceController
+from .podgc import PodGCController
+from .garbagecollector import GarbageCollector
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
+from .volumebinding import PersistentVolumeController
+from .manager import ControllerManager

@@ -1,0 +1,128 @@
+"""DaemonSet: one pod per eligible node.
+
+Reference: pkg/controller/daemon/daemon_controller.go (syncDaemonSet:
+1075 manage:754 — nodesShouldRunDaemonPod:1206 decides per node via the
+scheduler's own GeneralPredicates + taint checks; daemon pods are
+created with spec.nodeName pre-set, bypassing the scheduler in 1.11).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api import types as api
+from ..runtime.store import Conflict
+from ..plugins import golden
+from ..state.node_info import NodeInfo
+from .base import Controller, is_pod_active, make_pod_from_template
+
+
+class DaemonSetController(Controller):
+    name = "daemonset"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.informer("daemonsets")
+        self.informer("nodes", enqueue_fn=lambda o: self._all_dirty())
+        self.informer("pods",
+                      on_add=self._pod_event,
+                      on_update=lambda o, n: self._pod_event(n),
+                      on_delete=self._pod_event)
+
+    def _all_dirty(self):
+        for ds in self.store.list("daemonsets"):
+            self.enqueue(ds)
+
+    def _pod_event(self, pod):
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.kind == "DaemonSet":
+                self.queue.add(f"{pod.metadata.namespace}/{ref.name}")
+
+    def _should_run(self, ds, node: api.Node) -> bool:
+        """nodesShouldRunDaemonPod: simulate the daemon pod on the node —
+        node selector/affinity, taints (daemon pods tolerate
+        memory/disk-pressure implicitly in 1.11), schedulability."""
+        if node.spec.unschedulable:
+            return False
+        pod = make_pod_from_template(ds.spec.template, "DaemonSet", ds, "sim")
+        pod.spec.node_name = node.metadata.name
+        if not api.pod_matches_node_selector(pod, node):
+            return False
+        ni = NodeInfo(node)
+        ok, reasons = golden.pod_tolerates_node_taints(pod, ni)
+        if not ok:
+            return False
+        ok, reasons = golden.check_node_condition(pod, ni)
+        return ok
+
+    def sync(self, key: str):
+        ns, name = key.split("/", 1)
+        ds = self.store.get("daemonsets", ns, name)
+        if ds is None:
+            return
+        nodes = self.store.list("nodes")
+        owned: List[api.Pod] = [
+            p for p in self.store.list("pods", ns)
+            if any(r.controller and r.kind == "DaemonSet" and r.name == name
+                   for r in p.metadata.owner_references)]
+        by_node = {}
+        for p in owned:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        desired = 0
+        scheduled = 0
+        misscheduled = 0
+        for node in nodes:
+            should = self._should_run(ds, node)
+            have = [p for p in by_node.pop(node.metadata.name, [])
+                    if is_pod_active(p)]
+            if should:
+                desired += 1
+                if have:
+                    scheduled += 1
+                    for extra in have[1:]:  # dedupe
+                        self._delete(extra)
+                else:
+                    pod = make_pod_from_template(
+                        ds.spec.template, "DaemonSet", ds,
+                        f"{name}-{node.metadata.name}")
+                    pod.spec.node_name = node.metadata.name
+                    try:
+                        self.store.create("pods", pod)
+                    except Conflict:
+                        pass
+            else:
+                for p in have:
+                    misscheduled += 1
+                    self._delete(p)
+        for orphans in by_node.values():  # pods on deleted nodes
+            for p in orphans:
+                self._delete(p)
+        self._update_status(ds, desired, scheduled, misscheduled)
+
+    def _delete(self, pod):
+        try:
+            self.store.delete("pods", pod.metadata.namespace, pod.metadata.name)
+        except KeyError:
+            pass
+
+    def _update_status(self, ds, desired, scheduled, misscheduled):
+        st = ds.status
+        ready = 0
+        from .base import is_pod_ready
+        for p in self.store.list("pods", ds.metadata.namespace):
+            if any(r.controller and r.kind == "DaemonSet"
+                   and r.name == ds.metadata.name
+                   for r in p.metadata.owner_references) and is_pod_ready(p):
+                ready += 1
+        if (st.desired_number_scheduled, st.current_number_scheduled,
+                st.number_misscheduled, st.number_ready) == \
+                (desired, scheduled, misscheduled, ready):
+            return
+        st.desired_number_scheduled = desired
+        st.current_number_scheduled = scheduled
+        st.number_misscheduled = misscheduled
+        st.number_ready = ready
+        try:
+            self.store.update("daemonsets", ds)
+        except (Conflict, KeyError):
+            pass

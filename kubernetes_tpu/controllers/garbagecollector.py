@@ -1,0 +1,62 @@
+"""Owner-reference garbage collector.
+
+Reference: pkg/controller/garbagecollector/garbagecollector.go — the
+graph builder watches all kinds, and dependents whose controller owner
+is gone are deleted (cascading background deletion; attemptToDelete).
+Reduced here to the same invariant without the full uid graph: any
+object carrying a controller ownerReference to a non-existent owner is
+collected on each sweep.
+"""
+
+from __future__ import annotations
+
+from ..api import scheme
+from .base import Controller
+
+_KIND_TO_PLURAL = {
+    "ReplicaSet": "replicasets", "ReplicationController": "replicationcontrollers",
+    "StatefulSet": "statefulsets", "Deployment": "deployments",
+    "DaemonSet": "daemonsets", "Job": "jobs", "CronJob": "cronjobs",
+    "Service": "services", "Node": "nodes", "Pod": "pods",
+}
+
+# dependents worth sweeping (objects that commonly carry owner refs)
+_DEPENDENT_KINDS = ["pods", "replicasets", "jobs", "endpoints"]
+
+
+class GarbageCollector(Controller):
+    name = "garbagecollector"
+
+    def sync(self, key: str):
+        self.sweep()
+
+    def _owner_exists(self, ns: str, ref) -> bool:
+        plural = _KIND_TO_PLURAL.get(ref.kind)
+        if plural is None:
+            return True  # unknown kind: never collect
+        obj = self.store.get(plural, ns, ref.name)
+        if obj is None and not scheme.is_namespaced(ref.kind):
+            obj = self.store.get(plural, "", ref.name) or \
+                self.store.get(plural, "default", ref.name)
+        if obj is None:
+            return False
+        # uid mismatch = recreated owner; the old dependents are orphans
+        return not ref.uid or not obj.metadata.uid or ref.uid == obj.metadata.uid
+
+    def sweep(self) -> int:
+        deleted = 0
+        for kind in _DEPENDENT_KINDS:
+            for obj in self.store.list(kind):
+                refs = [r for r in obj.metadata.owner_references if r.controller]
+                if not refs:
+                    continue
+                if all(self._owner_exists(obj.metadata.namespace, r)
+                       for r in refs):
+                    continue
+                try:
+                    self.store.delete(kind, obj.metadata.namespace,
+                                      obj.metadata.name)
+                    deleted += 1
+                except KeyError:
+                    pass
+        return deleted

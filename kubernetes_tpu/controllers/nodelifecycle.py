@@ -1,0 +1,205 @@
+"""Node lifecycle: the cluster's failure detector.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go —
+monitorNodeStatus (:544) watches kubelet heartbeats (NodeStatus
+conditions + lastHeartbeatTime); after grace period the node's Ready
+condition is set to Unknown, NoExecute taints are applied
+(not-ready/unreachable, :473 via the taint manager), and pods are
+evicted once their tolerationSeconds expire (scheduler/taint-manager
+NoExecuteTaintManager). Recovery removes the taints when heartbeats
+resume. This is how the framework achieves elastic recovery: failed
+nodes drain automatically and their pods requeue through the scheduler.
+
+Heartbeats arrive as node status updates: kubelet sets
+annotation 'heartbeat' = str(epoch seconds) and Ready=True
+(the analog of LastHeartbeatTime on NodeCondition).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..api import types as api
+from ..runtime.store import Conflict
+from .base import Controller, is_pod_active
+
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+HEARTBEAT_ANNOTATION = "heartbeat"
+
+
+def _heartbeat(node: api.Node) -> Optional[float]:
+    v = (node.metadata.annotations or {}).get(HEARTBEAT_ANNOTATION)
+    try:
+        return float(v) if v is not None else None
+    except ValueError:
+        return None
+
+
+def _ready_status(node: api.Node) -> str:
+    for c in node.status.conditions:
+        if c.type == api.NODE_READY:
+            return c.status
+    return api.COND_UNKNOWN
+
+
+class NodeLifecycleController(Controller):
+    name = "nodelifecycle"
+
+    def __init__(self, store, clock=time.time,
+                 grace_period: float = 40.0,
+                 eviction_wait: float = 300.0):
+        super().__init__(store)
+        self.clock = clock
+        self.grace_period = grace_period
+        self.default_eviction_wait = eviction_wait
+        self.informer("nodes")
+        # taint-expiry bookkeeping: pod key -> eviction deadline
+        self._evict_at: Dict[str, float] = {}
+        self._timer: Optional[threading.Thread] = None
+
+    # -- monitorNodeStatus -----------------------------------------------------
+
+    def monitor(self, now: Optional[float] = None) -> None:
+        """One monitorNodeStatus pass over all nodes + taint-manager sweep."""
+        now = now if now is not None else self.clock()
+        for node in self.store.list("nodes"):
+            self._monitor_node(node, now)
+        self._process_evictions(now)
+
+    def sync(self, key: str):
+        name = key.split("/", 1)[1]
+        node = (self.store.get("nodes", "default", name)
+                or self.store.get("nodes", "", name))
+        if node is not None:
+            self._monitor_node(node, self.clock())
+
+    def _monitor_node(self, node: api.Node, now: float):
+        """One pass over one node. All mutations (Ready condition + taint
+        swap) land in a single update so a CAS conflict never leaves the
+        condition and taint out of sync — the next pass simply retries."""
+        hb = _heartbeat(node)
+        stale = hb is None or (now - hb) > self.grace_period
+        ready = _ready_status(node)
+        changed = False
+        if stale:
+            # kubelet stopped reporting: Ready -> Unknown + unreachable
+            # taint (tryUpdateNodeStatus + markNodeForTainting :473)
+            if ready != api.COND_UNKNOWN:
+                self._set_ready_cond(node, api.COND_UNKNOWN)
+                changed = True
+            changed |= self._swap_taints(node, add=TAINT_UNREACHABLE,
+                                         drop=TAINT_NOT_READY)
+        elif ready == api.COND_FALSE:
+            changed = self._swap_taints(node, add=TAINT_NOT_READY,
+                                        drop=TAINT_UNREACHABLE)
+        elif ready == api.COND_TRUE:
+            changed = self._swap_taints(node, add=None,
+                                        drop=(TAINT_NOT_READY,
+                                              TAINT_UNREACHABLE))
+        if changed:
+            try:
+                self.store.update("nodes", node)
+            except (Conflict, KeyError):
+                return  # stale view; retried on the next pass
+        if any(t.effect == api.NO_EXECUTE for t in node.spec.taints):
+            self._schedule_evictions(node)
+        else:
+            for pod in self.store.list("pods"):
+                if pod.spec.node_name == node.metadata.name:
+                    self._evict_at.pop(pod.full_name(), None)
+
+    @staticmethod
+    def _set_ready_cond(node: api.Node, status: str):
+        node.status.conditions = [c for c in node.status.conditions
+                                  if c.type != api.NODE_READY]
+        node.status.conditions.append(api.NodeCondition(api.NODE_READY, status))
+
+    @staticmethod
+    def _swap_taints(node: api.Node, add: Optional[str], drop) -> bool:
+        """Mutate node.spec.taints in place; True if anything changed
+        (taint manager swapUnreachableTaint analog)."""
+        drops = (drop,) if isinstance(drop, str) else tuple(drop or ())
+        taints = [t for t in node.spec.taints
+                  if t.key not in drops and t.key != add]
+        if add is not None:
+            taints.append(api.Taint(key=add, effect=api.NO_EXECUTE))
+        if [t.key for t in taints] == [t.key for t in node.spec.taints]:
+            return False
+        node.spec.taints = taints
+        return True
+
+    # -- NoExecute taint manager (eviction with tolerationSeconds) -------------
+
+    def _schedule_evictions(self, node: api.Node):
+        now = self.clock()
+        keys = {t.key for t in node.spec.taints
+                if t.effect == api.NO_EXECUTE}
+        if not keys:
+            return
+        for pod in self.store.list("pods"):
+            if pod.spec.node_name != node.metadata.name or \
+                    not is_pod_active(pod):
+                continue
+            k = pod.full_name()
+            wait = self._toleration_wait(pod, keys)
+            if wait is None:
+                # tolerates forever: never evict
+                self._evict_at.pop(k, None)
+            else:
+                deadline = now + wait
+                if k not in self._evict_at or self._evict_at[k] > deadline:
+                    self._evict_at[k] = deadline
+
+    def _toleration_wait(self, pod: api.Pod, taint_keys) -> Optional[float]:
+        """Min tolerationSeconds across NoExecute taints; None = tolerates
+        forever; 0 = evict now (taint manager getMinTolerationTime)."""
+        waits = []
+        for key in taint_keys:
+            taint = api.Taint(key=key, effect=api.NO_EXECUTE)
+            matching = [t for t in pod.spec.tolerations if t.tolerates(taint)]
+            if not matching:
+                waits.append(0.0)
+            else:
+                secs = [t.toleration_seconds for t in matching]
+                if any(s is None for s in secs):
+                    continue  # tolerates this taint forever
+                waits.append(float(max(0, min(secs))))
+        if not waits:
+            return None
+        return min(waits)
+
+    def _process_evictions(self, now: float):
+        for key, deadline in list(self._evict_at.items()):
+            if deadline > now:
+                continue
+            ns, name = key.split("/", 1)
+            pod = self.store.get("pods", ns, name)
+            self._evict_at.pop(key, None)
+            if pod is None or not pod.spec.node_name:
+                continue
+            node = (self.store.get("nodes", "default", pod.spec.node_name)
+                    or self.store.get("nodes", "", pod.spec.node_name))
+            if node is None or not any(t.effect == api.NO_EXECUTE
+                                       for t in node.spec.taints):
+                continue
+            try:
+                self.store.delete("pods", ns, name)
+            except KeyError:
+                pass
+
+    # -- background loop -------------------------------------------------------
+
+    def run(self, workers: int = 1, period: float = 5.0):
+        super().run(workers)
+
+        def loop():
+            while not self._stop.is_set():
+                self.monitor()
+                self._stop.wait(period)
+
+        self._timer = threading.Thread(target=loop, daemon=True,
+                                       name="nodelifecycle-monitor")
+        self._timer.start()

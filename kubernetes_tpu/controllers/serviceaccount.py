@@ -1,0 +1,45 @@
+"""ServiceAccount controller: ensure 'default' SA per namespace.
+
+Reference: pkg/controller/serviceaccount/serviceaccounts_controller.go
+(syncNamespace:178 — every active namespace gets the default
+ServiceAccount; the tokens controller pairs each SA with a token
+Secret, tokens_controller.go).
+"""
+
+from __future__ import annotations
+
+from ..api import types as api
+from ..runtime.store import Conflict
+from .base import Controller
+
+
+class ServiceAccountController(Controller):
+    name = "serviceaccount"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.informer("namespaces")
+
+    def sync(self, key: str):
+        name = key.split("/")[-1]
+        ns_obj = (self.store.get("namespaces", "", name)
+                  or self.store.get("namespaces", "default", name))
+        if ns_obj is None or ns_obj.status.phase != "Active":
+            return
+        if self.store.get("serviceaccounts", name, "default") is not None:
+            return
+        token = api.Secret(
+            metadata=api.ObjectMeta(name="default-token", namespace=name),
+            type="kubernetes.io/service-account-token",
+            data={"token": f"sa-{name}-default"})
+        sa = api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace=name),
+            secrets=[token.metadata.name])
+        try:
+            self.store.create("secrets", token)
+        except Conflict:
+            pass
+        try:
+            self.store.create("serviceaccounts", sa)
+        except Conflict:
+            pass

@@ -1,0 +1,100 @@
+"""StatefulSet: ordered pods with stable identities.
+
+Reference: pkg/controller/statefulset/stateful_set_control.go
+(UpdateStatefulSet: ordinal-ordered create/scale; OrderedReady waits for
+predecessor readiness before creating the next replica; Parallel does
+not). Pod names are <set>-<ordinal> — the stable network identity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..api import types as api
+from ..runtime.store import Conflict
+from .base import (Controller, is_pod_active, is_pod_ready,
+                   make_pod_from_template)
+
+
+class StatefulSetController(Controller):
+    name = "statefulset"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.informer("statefulsets")
+        self.informer("pods",
+                      on_add=self._pod_event,
+                      on_update=lambda o, n: self._pod_event(n),
+                      on_delete=self._pod_event)
+
+    def _pod_event(self, pod):
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.kind == "StatefulSet":
+                self.queue.add(f"{pod.metadata.namespace}/{ref.name}")
+
+    def _pods_by_ordinal(self, ss) -> Dict[int, api.Pod]:
+        out: Dict[int, api.Pod] = {}
+        prefix = ss.metadata.name + "-"
+        for pod in self.store.list("pods", ss.metadata.namespace):
+            if not pod.metadata.name.startswith(prefix):
+                continue
+            if not any(r.controller and r.kind == "StatefulSet"
+                       and r.name == ss.metadata.name
+                       for r in pod.metadata.owner_references):
+                continue
+            suffix = pod.metadata.name[len(prefix):]
+            if suffix.isdigit():
+                out[int(suffix)] = pod
+        return out
+
+    def sync(self, key: str):
+        ns, name = key.split("/", 1)
+        ss = self.store.get("statefulsets", ns, name)
+        if ss is None:
+            return
+        pods = self._pods_by_ordinal(ss)
+        want = ss.spec.replicas
+        ordered = ss.spec.pod_management_policy != "Parallel"
+        # create missing ordinals in order; under OrderedReady stop at the
+        # first not-ready predecessor (stateful_set_control.go:433)
+        for i in range(want):
+            pod = pods.get(i)
+            if pod is None:
+                new = make_pod_from_template(ss.spec.template, "StatefulSet",
+                                             ss, f"{name}-{i}")
+                new.metadata.labels["statefulset.kubernetes.io/pod-name"] = \
+                    new.metadata.name
+                try:
+                    self.store.create("pods", new)
+                except Conflict:
+                    pass
+                if ordered:
+                    raise RuntimeError(f"waiting for ordinal {i}")
+            elif ordered and not (is_pod_active(pod) and is_pod_ready(pod)):
+                # predecessor not ready: halt rollout here
+                break
+        # scale down from the top ordinal (reverse order)
+        for i in sorted((o for o in pods if o >= want), reverse=True):
+            pod = pods[i]
+            try:
+                self.store.delete("pods", pod.metadata.namespace,
+                                  pod.metadata.name)
+            except KeyError:
+                pass
+            if ordered:
+                raise RuntimeError(f"scaling down ordinal {i}")
+        self._update_status(ss, pods)
+
+    def _update_status(self, ss, pods):
+        live = [p for p in pods.values() if is_pod_active(p)]
+        ready = sum(1 for p in live if is_pod_ready(p))
+        st = ss.status
+        if (st.replicas, st.ready_replicas) == (len(live), ready):
+            return
+        st.replicas = len(live)
+        st.ready_replicas = ready
+        st.current_replicas = len(live)
+        try:
+            self.store.update("statefulsets", ss)
+        except (Conflict, KeyError):
+            pass

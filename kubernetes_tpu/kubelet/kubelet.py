@@ -1,0 +1,306 @@
+"""The kubelet sync loop.
+
+Reference call stack (SURVEY.md §3.5): Kubelet.Run (kubelet.go:1317) ->
+syncLoop (:1720) -> syncLoopIteration (:1787) selecting over pod config
+updates, PLEG events, the 1s sync tick, probe results, and housekeeping;
+HandlePodAdditions -> podWorkers -> syncPod (:1389). Here one
+``sync_once(now)`` call is one syncLoopIteration over the fake runtime;
+``run()`` wraps it in a ticking thread. Node-side admission re-runs the
+scheduler's GeneralPredicates (pkg/kubelet/lifecycle/predicate.go — the
+reason predicates live in the scheduler package but are imported by the
+kubelet). Eviction under memory pressure follows pkg/kubelet/eviction/
+(rank by QoS then usage; set the pressure condition the scheduler's
+CheckNodeMemoryPressure predicate reads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import resources as res
+from ..api import types as api
+from ..controllers.nodelifecycle import HEARTBEAT_ANNOTATION
+from ..plugins import golden
+from ..runtime.store import Conflict
+from ..state.node_info import NodeInfo
+from .runtime import EXITED, RUNNING, FakeRuntime
+
+
+class _ProbeState:
+    __slots__ = ("failures", "successes", "last_run")
+
+    def __init__(self):
+        self.failures = 0
+        self.successes = 0
+        self.last_run = 0.0
+
+
+class Kubelet:
+    def __init__(self, store, node_name: str,
+                 allocatable: Optional[Dict[str, int]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 taints: Optional[List[api.Taint]] = None,
+                 runtime: Optional[FakeRuntime] = None,
+                 clock=time.time,
+                 heartbeat_period: float = 10.0,
+                 memory_pressure_threshold: float = 0.9):
+        self.store = store
+        self.node_name = node_name
+        self.clock = clock
+        self.runtime = runtime or FakeRuntime()
+        self.heartbeat_period = heartbeat_period
+        self.memory_pressure_threshold = memory_pressure_threshold
+        self.allocatable = allocatable or api.resource_list(
+            cpu="8", memory="16Gi", pods=110, ephemeral_storage="100Gi")
+        self.labels = {api.LABEL_HOSTNAME: node_name, **(labels or {})}
+        self.taints = list(taints or [])
+        self._probe_state: Dict[tuple, _ProbeState] = {}
+        self._pod_start: Dict[str, float] = {}
+        self._last_heartbeat = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.register_node()
+
+    # -- node registration + heartbeat (kubelet_node_status.go) ----------------
+
+    def register_node(self):
+        node = self._get_node()
+        if node is None:
+            node = api.Node(
+                metadata=api.ObjectMeta(
+                    name=self.node_name, labels=dict(self.labels),
+                    annotations={HEARTBEAT_ANNOTATION: str(self.clock())}),
+                spec=api.NodeSpec(taints=list(self.taints)),
+                status=api.NodeStatus(
+                    capacity=dict(self.allocatable),
+                    allocatable=dict(self.allocatable),
+                    conditions=[api.NodeCondition(api.NODE_READY,
+                                                  api.COND_TRUE)]))
+            try:
+                self.store.create("nodes", node)
+            except Conflict:
+                pass
+
+    def _get_node(self) -> Optional[api.Node]:
+        return (self.store.get("nodes", "default", self.node_name)
+                or self.store.get("nodes", "", self.node_name))
+
+    def heartbeat(self, now: Optional[float] = None,
+                  memory_pressure: Optional[bool] = None):
+        """Update node status: heartbeat annotation + Ready (+ pressure)
+        conditions (tryUpdateNodeStatus)."""
+        now = now if now is not None else self.clock()
+        node = self._get_node()
+        if node is None:
+            self.register_node()
+            return
+        node.metadata.annotations = dict(node.metadata.annotations or {})
+        node.metadata.annotations[HEARTBEAT_ANNOTATION] = str(now)
+        conds = {c.type: c for c in node.status.conditions}
+        conds[api.NODE_READY] = api.NodeCondition(api.NODE_READY, api.COND_TRUE)
+        if memory_pressure is not None:
+            conds[api.NODE_MEMORY_PRESSURE] = api.NodeCondition(
+                api.NODE_MEMORY_PRESSURE,
+                api.COND_TRUE if memory_pressure else api.COND_FALSE)
+        node.status.conditions = list(conds.values())
+        try:
+            self.store.update("nodes", node)
+        except (Conflict, KeyError):
+            pass
+        self._last_heartbeat = now
+
+    # -- pod views -------------------------------------------------------------
+
+    def _my_pods(self) -> List[api.Pod]:
+        return [p for p in self.store.list("pods")
+                if p.spec.node_name == self.node_name]
+
+    # -- admission (lifecycle/predicate.go canAdmitPod) ------------------------
+
+    def _admit(self, pod: api.Pod, active: List[api.Pod]) -> (bool, str):
+        node = self._get_node()
+        if node is None:
+            # node object not visible yet (informer lag right after
+            # registration): admit later, keep the pod Pending
+            return False, "NodeNotVisible"
+        ni = NodeInfo(node)
+        for other in active:
+            if other.metadata.uid != pod.metadata.uid:
+                ni.add_pod(other)
+        ok, reasons = golden.general_predicates(pod, ni)
+        return ok, (reasons[0] if reasons else "")
+
+    # -- the sync loop ---------------------------------------------------------
+
+    def sync_once(self, now: Optional[float] = None) -> None:
+        """One syncLoopIteration: PLEG tick, per-pod sync, probes,
+        eviction housekeeping, heartbeat."""
+        now = now if now is not None else self.clock()
+        self.runtime.tick(now)
+        pods = self._my_pods()
+        active = [p for p in pods
+                  if p.status.phase in ("", "Pending", "Running")]
+        for pod in pods:
+            self._sync_pod(pod, now, active)
+        self._housekeeping(now)
+        if now - self._last_heartbeat >= self.heartbeat_period:
+            self.heartbeat(now, memory_pressure=self._memory_pressure())
+
+    def _sync_pod(self, pod: api.Pod, now: float, active: List[api.Pod]):
+        """syncPod (kubelet.go:1389): admit, start containers, compute
+        phase/readiness from runtime state, apply restart policy."""
+        if pod.status.phase in ("Succeeded", "Failed"):
+            return
+        uid = pod.metadata.uid
+        if uid not in self._pod_start:
+            ok, reason = self._admit(pod, active)
+            if not ok and reason == "NodeNotVisible":
+                return  # transient: retry next sync without failing the pod
+            if not ok:
+                pod.status.phase = "Failed"
+                pod.status.conditions = [("PodScheduled", "True"),
+                                         ("Ready", f"False:{reason}")]
+                self._update_status(pod)
+                return
+            self._pod_start[uid] = now
+        for c in pod.spec.containers:
+            st = self.runtime.get(uid, c.name)
+            if st is None or st.state not in (RUNNING,):
+                if st is not None and st.state == EXITED:
+                    # restart policy (kuberuntime computePodActions)
+                    if pod.spec.restart_policy == "Never" or (
+                            pod.spec.restart_policy == "OnFailure"
+                            and st.exit_code == 0):
+                        continue
+                    st.restart_count += 1
+                self.runtime.start_container(uid, c.name, now)
+        self._run_probes(pod, now)
+        self._update_pod_status(pod, now)
+
+    def _run_probes(self, pod: api.Pod, now: float):
+        """prober/worker.go probe loop against the runtime's health bits."""
+        uid = pod.metadata.uid
+        started = self._pod_start.get(uid, now)
+        for c in pod.spec.containers:
+            st = self.runtime.get(uid, c.name)
+            if st is None or st.state != RUNNING:
+                continue
+            probe = c.liveness_probe
+            if probe is None:
+                continue
+            ps = self._probe_state.setdefault((uid, c.name), _ProbeState())
+            if now - started < probe.initial_delay_seconds:
+                continue
+            if now - ps.last_run < probe.period_seconds:
+                continue
+            ps.last_run = now
+            if st.healthy:
+                ps.failures = 0
+            else:
+                ps.failures += 1
+                if ps.failures >= probe.failure_threshold:
+                    # liveness failure: kill + restart per policy
+                    self.runtime.crash_container(uid, c.name, exit_code=137)
+                    ps.failures = 0
+
+    def _update_pod_status(self, pod: api.Pod, now: float):
+        uid = pod.metadata.uid
+        states = [self.runtime.get(uid, c.name) for c in pod.spec.containers]
+        if not states:
+            return
+        all_running = all(s is not None and s.state == RUNNING for s in states)
+        all_exited = all(s is not None and s.state == EXITED for s in states)
+        phase = pod.status.phase
+        if all_exited and pod.spec.restart_policy in ("Never", "OnFailure"):
+            ok = all(s.exit_code == 0 for s in states)
+            if pod.spec.restart_policy == "OnFailure" and not ok:
+                phase = "Running"  # will restart
+            else:
+                phase = "Succeeded" if ok else "Failed"
+        elif all_running:
+            phase = "Running"
+        ready = all_running and all(
+            s.ready for s in states) and phase == "Running"
+        readiness_gate = all(
+            self.runtime.get(uid, c.name).ready
+            for c in pod.spec.containers
+            if c.readiness_probe is not None
+            and self.runtime.get(uid, c.name) is not None)
+        ready = ready and readiness_gate
+        new_conds = [("PodScheduled", "True"),
+                     ("Ready", "True" if ready else "False")]
+        if phase != pod.status.phase or new_conds != pod.status.conditions:
+            pod.status.phase = phase
+            pod.status.conditions = new_conds
+            if pod.status.start_time is None:
+                pod.status.start_time = self._pod_start.get(uid, now)
+            self._update_status(pod)
+
+    def _update_status(self, pod: api.Pod):
+        """status/status_manager.go syncPod: PATCH status to the apiserver."""
+        try:
+            self.store.update("pods", pod)
+        except (Conflict, KeyError):
+            pass
+
+    # -- eviction manager (pkg/kubelet/eviction/) ------------------------------
+
+    def _memory_requested(self) -> int:
+        total = 0
+        for p in self._my_pods():
+            if p.status.phase in ("", "Pending", "Running"):
+                total += api.get_resource_request(p).get(res.MEMORY, 0)
+        return total
+
+    def _memory_pressure(self) -> bool:
+        alloc = self.allocatable.get(res.MEMORY, 0)
+        return alloc > 0 and \
+            self._memory_requested() > self.memory_pressure_threshold * alloc
+
+    def _housekeeping(self, now: float):
+        # clean up runtime state for pods that vanished from the apiserver
+        live_uids = {p.metadata.uid for p in self._my_pods()}
+        for uid in [u for u in self._pod_start if u not in live_uids]:
+            self.runtime.kill_pod(uid)
+            self._pod_start.pop(uid, None)
+        # eviction: under memory pressure, evict BestEffort pods first,
+        # then highest-usage burstable (eviction/helpers.go rankMemoryPressure)
+        if not self._memory_pressure():
+            return
+        candidates = sorted(
+            (p for p in self._my_pods()
+             if p.status.phase in ("Pending", "Running")),
+            key=lambda p: (not api.is_best_effort(p),
+                           api.pod_priority(p),
+                           -api.get_resource_request(p).get(res.MEMORY, 0)))
+        for victim in candidates:
+            if not self._memory_pressure():
+                break
+            victim.status.phase = "Failed"
+            victim.status.conditions = [("Ready", "False:Evicted")]
+            self._update_status(victim)
+            self.runtime.kill_pod(victim.metadata.uid)
+        self.heartbeat(now, memory_pressure=self._memory_pressure())
+
+    # -- background mode -------------------------------------------------------
+
+    def run(self, period: float = 1.0):
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.sync_once()
+                except Exception:
+                    # a sync failure must not kill the node agent; the next
+                    # iteration retries (syncLoop's crash-only resilience)
+                    pass
+                self._stop.wait(period)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"kubelet-{self.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

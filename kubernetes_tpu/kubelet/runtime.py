@@ -1,0 +1,105 @@
+"""Container runtime interface + fake implementation.
+
+Reference: the CRI boundary (pkg/kubelet/kuberuntime/ over remote gRPC)
+and its hollow stand-in (kubemark's fake docker client,
+pkg/kubemark/hollow_kubelet.go:50). The fake runtime is deterministic
+and injectable: tests and the kubemark-style load harness flip container
+health or crash containers to exercise the kubelet's restart and probe
+machinery.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+RUNNING = "running"
+EXITED = "exited"
+WAITING = "waiting"
+
+
+@dataclass
+class ContainerState:
+    name: str
+    state: str = WAITING
+    exit_code: int = 0
+    restart_count: int = 0
+    healthy: bool = True  # liveness handler result
+    ready: bool = True    # readiness handler result
+
+
+class FakeRuntime:
+    """Per-node container runtime: containers keyed by (pod_uid, name)."""
+
+    def __init__(self, start_latency: float = 0.0):
+        self._lock = threading.Lock()
+        self.containers: Dict[Tuple[str, str], ContainerState] = {}
+        self.start_latency = start_latency  # simulated image pull/start time
+        self._pending_start: Dict[Tuple[str, str], float] = {}
+
+    # -- CRI-ish surface -------------------------------------------------------
+
+    def start_container(self, pod_uid: str, name: str, now: float):
+        with self._lock:
+            key = (pod_uid, name)
+            st = self.containers.get(key)
+            if st is None:
+                st = ContainerState(name)
+                self.containers[key] = st
+            if st.state != RUNNING:
+                if self.start_latency > 0:
+                    self._pending_start.setdefault(key, now + self.start_latency)
+                else:
+                    st.state = RUNNING
+
+    def tick(self, now: float) -> List[Tuple[str, str, str]]:
+        """Advance pending starts; returns lifecycle events
+        (pod_uid, container, event) — the PLEG relist source
+        (pkg/kubelet/pleg/generic.go relist)."""
+        events = []
+        with self._lock:
+            for key, when in list(self._pending_start.items()):
+                if now >= when:
+                    st = self.containers.get(key)
+                    if st is not None and st.state != RUNNING:
+                        st.state = RUNNING
+                        events.append((key[0], key[1], "ContainerStarted"))
+                    self._pending_start.pop(key, None)
+        return events
+
+    def kill_pod(self, pod_uid: str):
+        with self._lock:
+            for key in [k for k in self.containers if k[0] == pod_uid]:
+                self.containers.pop(key, None)
+                self._pending_start.pop(key, None)
+
+    def get(self, pod_uid: str, name: str) -> Optional[ContainerState]:
+        with self._lock:
+            return self.containers.get((pod_uid, name))
+
+    def pod_containers(self, pod_uid: str) -> List[ContainerState]:
+        with self._lock:
+            return [st for (uid, _), st in self.containers.items()
+                    if uid == pod_uid]
+
+    # -- fault injection (tests / chaos harness) -------------------------------
+
+    def crash_container(self, pod_uid: str, name: str, exit_code: int = 1):
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is not None:
+                st.state = EXITED
+                st.exit_code = exit_code
+
+    def set_healthy(self, pod_uid: str, name: str, healthy: bool):
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is not None:
+                st.healthy = healthy
+
+    def set_ready(self, pod_uid: str, name: str, ready: bool):
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is not None:
+                st.ready = ready

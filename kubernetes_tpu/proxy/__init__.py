@@ -1,0 +1,3 @@
+"""Service dataplane — pkg/proxy analog."""
+
+from .proxier import ProxyRule, Proxier

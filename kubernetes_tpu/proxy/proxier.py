@@ -1,0 +1,130 @@
+"""Service VIP proxier: the rule-sync loop.
+
+Reference: pkg/proxy/iptables/proxier.go:612 syncProxyRules — one big
+periodic + event-driven resync translating (services x endpoints) into
+dataplane rules. The reference emits iptables chains; here the dataplane
+is an in-memory rule table (the framework's "iptables"): one ProxyRule
+per service port with its ready backend list, consistent-hash-free
+round-robin pick for connections. A hollow proxy (kubemark
+hollow_proxy.go:48) is this table without an enforcement backend —
+which is exactly what this is, so kubemark reuses Proxier directly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as api
+from ..runtime.informer import SharedInformer
+
+
+@dataclass
+class ProxyRule:
+    """One service-port forwarding entry (an iptables svc chain analog)."""
+
+    namespace: str
+    service: str
+    port_name: str
+    cluster_ip: str
+    port: int
+    protocol: str
+    backends: List[Tuple[str, int]] = field(default_factory=list)  # (ip, port)
+    session_affinity: str = "None"
+
+
+class Proxier:
+    def __init__(self, store, node_name: str = "", min_sync_period: float = 0.0):
+        self.store = store
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        self.rules: Dict[Tuple[str, str, str], ProxyRule] = {}
+        self.sync_count = 0
+        self._rr = itertools.count()
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.min_sync_period = min_sync_period
+        SharedInformer(store, "services").add_event_handler(
+            on_add=lambda o: self._dirty.set(),
+            on_update=lambda o, n: self._dirty.set(),
+            on_delete=lambda o: self._dirty.set())
+        SharedInformer(store, "endpoints").add_event_handler(
+            on_add=lambda o: self._dirty.set(),
+            on_update=lambda o, n: self._dirty.set(),
+            on_delete=lambda o: self._dirty.set())
+        self.sync_proxy_rules()
+
+    # -- the hot loop (syncProxyRules) -----------------------------------------
+
+    def sync_proxy_rules(self):
+        """Full table rebuild from informer state (proxier.go:612 — the
+        reference also always rebuilds the full rule set)."""
+        # clear the dirty flag BEFORE reading state: an event landing
+        # mid-sync re-arms it so the next wait() syncs again instead of
+        # being lost (the reference's async runner has the same contract)
+        self._dirty.clear()
+        new_rules: Dict[Tuple[str, str, str], ProxyRule] = {}
+        eps_by_key = {(e.metadata.namespace, e.metadata.name): e
+                      for e in self.store.list("endpoints")}
+        for svc in self.store.list("services"):
+            ns, name = svc.metadata.namespace, svc.metadata.name
+            ep = eps_by_key.get((ns, name))
+            ports = svc.spec.ports or [api.ServicePort(port=0)]
+            for sp in ports:
+                backends: List[Tuple[str, int]] = []
+                if ep is not None:
+                    for subset in ep.subsets:
+                        tp = next((p.port for p in subset.ports
+                                   if p.name == sp.name), None)
+                        if tp is None and subset.ports:
+                            tp = subset.ports[0].port
+                        for addr in subset.addresses:
+                            backends.append((addr.ip, tp or sp.port))
+                new_rules[(ns, name, sp.name)] = ProxyRule(
+                    namespace=ns, service=name, port_name=sp.name,
+                    cluster_ip=svc.spec.cluster_ip or
+                    f"172.16.{abs(hash((ns, name))) % 255}.{abs(hash(name)) % 254 + 1}",
+                    port=sp.port, protocol=sp.protocol,
+                    backends=sorted(backends),
+                    session_affinity=svc.spec.session_affinity)
+        with self._lock:
+            self.rules = new_rules
+            self.sync_count += 1
+
+    # -- dataplane lookups -----------------------------------------------------
+
+    def resolve(self, namespace: str, service: str,
+                port_name: str = "") -> Optional[Tuple[str, int]]:
+        """Pick a backend for a new connection (round-robin — the
+        iptables-probability analog)."""
+        with self._lock:
+            rule = self.rules.get((namespace, service, port_name))
+            if rule is None or not rule.backends:
+                return None
+            return rule.backends[next(self._rr) % len(rule.backends)]
+
+    def health(self) -> dict:
+        with self._lock:
+            return {"rules": len(self.rules), "syncs": self.sync_count}
+
+    # -- background mode -------------------------------------------------------
+
+    def run(self, period: float = 1.0):
+        def loop():
+            while not self._stop.is_set():
+                if self._dirty.wait(period):
+                    if self.min_sync_period:
+                        time.sleep(self.min_sync_period)
+                    self.sync_proxy_rules()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"proxier-{self.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

@@ -198,8 +198,6 @@ class Scheduler:
 
     def run_once(self, timeout: float = 0.0) -> int:
         """Schedule one wave. Returns the number of pods bound."""
-        import jax.numpy as jnp
-
         with self._mu:
             self.cache.cleanup_expired()
         pods = self.queue.pop_wave(self.wave_size, timeout=timeout)

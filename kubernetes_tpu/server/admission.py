@@ -117,7 +117,11 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         if not quotas:
             return
         req = api.get_resource_request(obj)
-        pods_in_ns = store.list("pods", ns)
+        # only active pods consume quota (quota core evaluator filters
+        # terminal phases the same way the controller's recompute does)
+        pods_in_ns = [p for p in store.list("pods", ns)
+                      if p.status.phase not in ("Succeeded", "Failed")
+                      and p.metadata.deletion_timestamp is None]
         for q in quotas:
             hard = q.spec.hard
             if "pods" in hard and len(pods_in_ns) + 1 > hard["pods"]:
@@ -126,16 +130,15 @@ class ResourceQuotaAdmission(AdmissionPlugin):
                     f"{len(pods_in_ns) + 1} > {hard['pods']}")
             for rname, label in (("cpu", "requests.cpu"),
                                  ("memory", "requests.memory")):
-                key = "cpu" if rname == "cpu" else "memory"
-                limit = hard.get(label, hard.get(key))
+                limit = hard.get(label, hard.get(rname))
                 if limit is None:
                     continue
-                used = sum(api.get_resource_request(p).get(key, 0)
+                used = sum(api.get_resource_request(p).get(rname, 0)
                            for p in pods_in_ns)
-                if used + req.get(key, 0) > limit:
+                if used + req.get(rname, 0) > limit:
                     raise AdmissionError(
                         f"exceeded quota {q.metadata.name}: {label} "
-                        f"{used + req.get(key, 0)} > {limit}")
+                        f"{used + req.get(rname, 0)} > {limit}")
 
 
 class NodeRestriction(AdmissionPlugin):

@@ -44,8 +44,9 @@ class APIError(Exception):
         self.code, self.reason, self.message = code, reason, message
 
 
-def _status_body(code: int, reason: str, message: str) -> bytes:
-    return json.dumps({"kind": "Status", "apiVersion": "v1", "status": "Failure",
+def _status_body(code: int, reason: str, message: str,
+                 status: str = "Failure") -> bytes:
+    return json.dumps({"kind": "Status", "apiVersion": "v1", "status": status,
                        "reason": reason, "message": message, "code": code}).encode()
 
 
@@ -134,7 +135,14 @@ class APIServer:
         parts = [p for p in parsed.path.split("/") if p]
         query = parse_qs(parsed.query)
 
-        # ops endpoints bypass the resource chain (but not authn)
+        # authn runs first — ops endpoints bypass authz/admission but not
+        # authentication (healthz stays open, like the reference's
+        # always-allowed /healthz delegating authorizer path)
+        user = None
+        if self.authenticator is not None and parts != ["healthz"]:
+            user = self.authenticator.authenticate(h.headers.get("Authorization"))
+            if user is None:
+                raise APIError(401, "Unauthorized", "authentication failed")
         if parts == ["healthz"]:
             return h._send(200, b"ok", "text/plain")
         if parts == ["version"]:
@@ -152,13 +160,6 @@ class APIServer:
                              if "/" in scheme.api_version_for(k)})
             return h._send(200, json.dumps({"kind": "APIGroupList",
                                             "groups": groups}).encode())
-
-        # authn (filters/authentication.go)
-        user = None
-        if self.authenticator is not None:
-            user = self.authenticator.authenticate(h.headers.get("Authorization"))
-            if user is None:
-                raise APIError(401, "Unauthorized", "authentication failed")
 
         route = self._route(parts)
         if route is None:
@@ -356,8 +357,8 @@ class APIServer:
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
         self.store.delete(plural, obj.metadata.namespace, obj.metadata.name)
-        h._send(200, _status_body(200, "Success", f"{name} deleted")
-                .replace(b"Failure", b"Success"))
+        h._send(200, _status_body(200, "Success", f"{name} deleted",
+                                  status="Success"))
 
     def _serve_binding(self, h, namespace, name):
         """POST pods/<name>/binding (BindingREST.Create,
@@ -373,8 +374,7 @@ class APIServer:
             self.store.bind(pod, target)
         except Conflict as e:
             raise APIError(409, "Conflict", str(e))
-        h._send(201, _status_body(201, "Success", "bound")
-                .replace(b"Failure", b"Success"))
+        h._send(201, _status_body(201, "Success", "bound", status="Success"))
 
     def _serve_eviction(self, h, user, namespace, name):
         """POST pods/<name>/eviction — PDB-respecting delete
@@ -389,8 +389,7 @@ class APIServer:
                 raise APIError(429, "TooManyRequests",
                                f"pdb {pdb.metadata.name} disallows eviction")
         self.store.delete("pods", pod.metadata.namespace, pod.metadata.name)
-        h._send(201, _status_body(201, "Success", "evicted")
-                .replace(b"Failure", b"Success"))
+        h._send(201, _status_body(201, "Success", "evicted", status="Success"))
 
     # -- watch -----------------------------------------------------------------
 

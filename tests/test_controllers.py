@@ -1,0 +1,518 @@
+"""Controller tests: table-driven reconciliation checks per controller,
+plus a cascade test through the manager (deployment -> replicaset ->
+pods -> endpoints -> pdb status), mirroring the reference's controller
+unit tests (pkg/controller/*/..._test.go patterns over fake clientsets).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.controllers import (ControllerManager, CronJobController,
+                                        DaemonSetController,
+                                        DeploymentController,
+                                        DisruptionController,
+                                        EndpointsController, GarbageCollector,
+                                        JobController, NamespaceController,
+                                        NodeLifecycleController,
+                                        PersistentVolumeController,
+                                        PodGCController, ReplicaSetController,
+                                        ServiceAccountController,
+                                        StatefulSetController)
+from kubernetes_tpu.controllers.cronjob import cron_matches
+from kubernetes_tpu.controllers.nodelifecycle import (HEARTBEAT_ANNOTATION,
+                                                      TAINT_NOT_READY,
+                                                      TAINT_UNREACHABLE)
+from kubernetes_tpu.runtime.store import ObjectStore
+
+SEL = LabelSelector(match_labels={"app": "w"})
+TMPL = api.PodTemplateSpec(
+    metadata=api.ObjectMeta(labels={"app": "w"}),
+    spec=api.PodSpec(containers=[api.Container(
+        resources=api.ResourceRequirements(
+            requests=api.resource_list(cpu="100m", memory="64Mi")))]))
+
+
+def mark_running(store, pod, ready=True):
+    pod.status.phase = "Running"
+    pod.status.conditions = [c for c in pod.status.conditions
+                             if c[0] != "Ready"] + \
+        [("Ready", "True" if ready else "False")]
+    store.update("pods", pod)
+
+
+def mknode(name, ready=True, hb=None):
+    ann = {HEARTBEAT_ANNOTATION: str(hb)} if hb is not None else {}
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, annotations=ann),
+        status=api.NodeStatus(
+            allocatable=api.resource_list(cpu="8", memory="16Gi", pods=110),
+            conditions=[api.NodeCondition(
+                api.NODE_READY, api.COND_TRUE if ready else api.COND_FALSE)]))
+
+
+class TestReplicaSet:
+    def test_scale_up_down_and_status(self):
+        store = ObjectStore()
+        ctrl = ReplicaSetController(store)
+        rs = api.ReplicaSet(
+            metadata=api.ObjectMeta(name="rs1"),
+            spec=api.ReplicaSetSpec(replicas=3, selector=SEL, template=TMPL))
+        store.create("replicasets", rs)
+        ctrl.sync_all()
+        pods = store.list("pods")
+        assert len(pods) == 3
+        assert all(p.metadata.owner_references[0].kind == "ReplicaSet"
+                   for p in pods)
+        for p in pods:
+            mark_running(store, p)
+        ctrl.sync_all()
+        rs = store.get("replicasets", "default", "rs1")
+        assert rs.status.replicas == 3 and rs.status.ready_replicas == 3
+        rs.spec.replicas = 1
+        store.update("replicasets", rs)
+        ctrl.sync_all()
+        assert len(store.list("pods")) == 1
+
+    def test_prefers_not_ready_victims(self):
+        store = ObjectStore()
+        ctrl = ReplicaSetController(store)
+        rs = api.ReplicaSet(
+            metadata=api.ObjectMeta(name="rs1"),
+            spec=api.ReplicaSetSpec(replicas=2, selector=SEL, template=TMPL))
+        store.create("replicasets", rs)
+        ctrl.sync_all()
+        pods = store.list("pods")
+        mark_running(store, pods[0], ready=True)
+        mark_running(store, pods[1], ready=False)
+        rs = store.get("replicasets", "default", "rs1")
+        rs.spec.replicas = 1
+        store.update("replicasets", rs)
+        ctrl.sync_all()
+        left = store.list("pods")
+        assert len(left) == 1
+        assert left[0].metadata.name == pods[0].metadata.name
+
+
+class TestDeployment:
+    def test_rollout_creates_rs_and_scales(self):
+        store = ObjectStore()
+        dep_ctrl = DeploymentController(store)
+        rs_ctrl = ReplicaSetController(store)
+        dep = api.Deployment(
+            metadata=api.ObjectMeta(name="d1"),
+            spec=api.DeploymentSpec(replicas=3, selector=SEL, template=TMPL))
+        store.create("deployments", dep)
+        dep_ctrl.sync_all()
+        rss = store.list("replicasets")
+        assert len(rss) == 1 and rss[0].spec.replicas == 3
+        rs_ctrl.sync_all()
+        assert len(store.list("pods")) == 3
+
+    def test_rolling_update_replaces_rs(self):
+        store = ObjectStore()
+        dep_ctrl = DeploymentController(store)
+        rs_ctrl = ReplicaSetController(store)
+        dep = api.Deployment(
+            metadata=api.ObjectMeta(name="d1"),
+            spec=api.DeploymentSpec(replicas=2, selector=SEL, template=TMPL))
+        store.create("deployments", dep)
+        for _ in range(4):
+            dep_ctrl.sync_all()
+            rs_ctrl.sync_all()
+            for p in store.list("pods"):
+                if p.status.phase != "Running":
+                    mark_running(store, p)
+            rs_ctrl.sync_all()
+        old_rs = store.list("replicasets")[0]
+        # change the template -> new hash -> new RS
+        import copy
+        dep = store.get("deployments", "default", "d1")
+        dep.spec.template = copy.deepcopy(TMPL)
+        dep.spec.template.spec.containers[0].image = "v2"
+        store.update("deployments", dep)
+        for _ in range(10):
+            dep_ctrl.sync_all()
+            rs_ctrl.sync_all()
+            for p in store.list("pods"):
+                if p.status.phase != "Running":
+                    mark_running(store, p)
+            rs_ctrl.sync_all()
+        rss = {r.metadata.name: r for r in store.list("replicasets")}
+        assert len(rss) == 2
+        new_rs = next(r for r in rss.values()
+                      if r.metadata.name != old_rs.metadata.name)
+        assert new_rs.spec.replicas == 2
+        assert rss[old_rs.metadata.name].spec.replicas == 0
+        dep = store.get("deployments", "default", "d1")
+        assert dep.status.updated_replicas == 2
+
+
+class TestStatefulSet:
+    def test_ordered_creation(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        ss = api.StatefulSet(
+            metadata=api.ObjectMeta(name="web"),
+            spec=api.StatefulSetSpec(replicas=3, selector=SEL, template=TMPL))
+        store.create("statefulsets", ss)
+        ctrl.sync_all()
+        pods = sorted(p.metadata.name for p in store.list("pods"))
+        assert pods == ["web-0"]  # waits for readiness before web-1
+        mark_running(store, store.get("pods", "default", "web-0"))
+        ctrl.sync_all()
+        assert sorted(p.metadata.name for p in store.list("pods")) == \
+            ["web-0", "web-1"]
+        mark_running(store, store.get("pods", "default", "web-1"))
+        ctrl.sync_all()
+        assert sorted(p.metadata.name for p in store.list("pods")) == \
+            ["web-0", "web-1", "web-2"]
+
+    def test_scale_down_from_top(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        ss = api.StatefulSet(
+            metadata=api.ObjectMeta(name="web"),
+            spec=api.StatefulSetSpec(replicas=2, selector=SEL, template=TMPL,
+                                     pod_management_policy="Parallel"))
+        store.create("statefulsets", ss)
+        ctrl.sync_all()
+        assert len(store.list("pods")) == 2
+        ss = store.get("statefulsets", "default", "web")
+        ss.spec.replicas = 1
+        store.update("statefulsets", ss)
+        ctrl.sync_all()
+        assert [p.metadata.name for p in store.list("pods")] == ["web-0"]
+
+
+class TestDaemonSet:
+    def test_one_pod_per_eligible_node(self):
+        store = ObjectStore()
+        ctrl = DaemonSetController(store)
+        store.create("nodes", mknode("n1"))
+        store.create("nodes", mknode("n2"))
+        bad = mknode("n3")
+        bad.spec.unschedulable = True
+        store.create("nodes", bad)
+        ds = api.DaemonSet(
+            metadata=api.ObjectMeta(name="agent"),
+            spec=api.DaemonSetSpec(selector=SEL, template=TMPL))
+        store.create("daemonsets", ds)
+        ctrl.sync_all()
+        pods = store.list("pods")
+        assert sorted(p.spec.node_name for p in pods) == ["n1", "n2"]
+        ds = store.get("daemonsets", "default", "agent")
+        assert ds.status.desired_number_scheduled == 2
+        # new node -> new daemon pod
+        store.create("nodes", mknode("n4"))
+        ctrl.sync_all()
+        assert sorted(p.spec.node_name for p in store.list("pods")) == \
+            ["n1", "n2", "n4"]
+
+
+class TestJob:
+    def test_run_to_completion(self):
+        store = ObjectStore()
+        ctrl = JobController(store)
+        job = api.Job(metadata=api.ObjectMeta(name="j1"),
+                      spec=api.JobSpec(parallelism=2, completions=3,
+                                       selector=SEL, template=TMPL))
+        store.create("jobs", job)
+        ctrl.sync_all()
+        pods = store.list("pods")
+        assert len(pods) == 2  # parallelism bound
+        for p in pods:
+            p.status.phase = "Succeeded"
+            store.update("pods", p)
+        ctrl.sync_all()
+        job = store.get("jobs", "default", "j1")
+        assert job.status.succeeded == 2
+        pods = [p for p in store.list("pods")
+                if p.status.phase not in ("Succeeded", "Failed")]
+        assert len(pods) == 1  # one remaining completion
+        pods[0].status.phase = "Succeeded"
+        store.update("pods", pods[0])
+        ctrl.sync_all()
+        job = store.get("jobs", "default", "j1")
+        assert ("Complete", "True") in job.status.conditions
+
+    def test_backoff_limit(self):
+        store = ObjectStore()
+        ctrl = JobController(store)
+        job = api.Job(metadata=api.ObjectMeta(name="j1"),
+                      spec=api.JobSpec(parallelism=1, completions=1,
+                                       backoff_limit=0, template=TMPL))
+        store.create("jobs", job)
+        ctrl.sync_all()
+        p = store.list("pods")[0]
+        p.status.phase = "Failed"
+        store.update("pods", p)
+        ctrl.sync_all()
+        job = store.get("jobs", "default", "j1")
+        assert any(c[0] == "Failed" for c in job.status.conditions)
+
+
+class TestCronJob:
+    def test_cron_matching(self):
+        # 2026-07-29 is a Wednesday (cron dow 3)
+        t = time.mktime((2026, 7, 29, 10, 30, 0, 0, 0, 0)) - time.timezone
+        assert cron_matches("* * * * *", t)
+        assert cron_matches("30 10 * * *", t)
+        assert cron_matches("*/15 * * * *", t)
+        assert not cron_matches("31 10 * * *", t)
+        assert cron_matches("30 10 29 7 *", t)
+        assert cron_matches("* * * * 3", t)
+        assert not cron_matches("* * * * 4", t)
+
+    def test_spawns_job_once_per_minute(self):
+        store = ObjectStore()
+        now = [time.mktime((2026, 7, 29, 10, 30, 0, 0, 0, 0))]
+        ctrl = CronJobController(store, clock=lambda: now[0])
+        cj = api.CronJob(metadata=api.ObjectMeta(name="cj"),
+                         spec=api.CronJobSpec(schedule="* * * * *",
+                                              job_template=api.JobSpec(
+                                                  template=TMPL)))
+        store.create("cronjobs", cj)
+        assert ctrl.tick() == 1
+        assert ctrl.tick() == 0  # same minute: no duplicate
+        now[0] += 60
+        assert ctrl.tick() == 1
+        assert len(store.list("jobs")) == 2
+
+    def test_forbid_concurrency(self):
+        store = ObjectStore()
+        now = [time.mktime((2026, 7, 29, 10, 30, 0, 0, 0, 0))]
+        ctrl = CronJobController(store, clock=lambda: now[0])
+        cj = api.CronJob(metadata=api.ObjectMeta(name="cj"),
+                         spec=api.CronJobSpec(schedule="* * * * *",
+                                              concurrency_policy="Forbid",
+                                              job_template=api.JobSpec(
+                                                  template=TMPL)))
+        store.create("cronjobs", cj)
+        assert ctrl.tick() == 1
+        now[0] += 60
+        assert ctrl.tick() == 0  # previous job still active
+
+
+class TestEndpoints:
+    def test_ready_split_and_ports(self):
+        store = ObjectStore()
+        ctrl = EndpointsController(store)
+        store.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc"),
+            spec=api.ServiceSpec(selector={"app": "w"},
+                                 ports=[api.ServicePort(name="http", port=80,
+                                                        target_port=8080)])))
+        p1 = api.Pod(metadata=api.ObjectMeta(name="p1", labels={"app": "w"}),
+                     spec=api.PodSpec(node_name="n1"))
+        p2 = api.Pod(metadata=api.ObjectMeta(name="p2", labels={"app": "w"}),
+                     spec=api.PodSpec(node_name="n2"))
+        store.create("pods", p1)
+        store.create("pods", p2)
+        mark_running(store, store.get("pods", "default", "p1"), ready=True)
+        mark_running(store, store.get("pods", "default", "p2"), ready=False)
+        ctrl.sync_all()
+        ep = store.get("endpoints", "default", "svc")
+        assert len(ep.subsets[0].addresses) == 1
+        assert len(ep.subsets[0].not_ready_addresses) == 1
+        assert ep.subsets[0].ports[0].port == 8080
+
+
+class TestNodeLifecycle:
+    def test_unreachable_taint_and_eviction(self):
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(store, clock=lambda: now[0],
+                                       grace_period=40.0)
+        store.create("nodes", mknode("n1", hb=now[0]))
+        pod = api.Pod(metadata=api.ObjectMeta(name="p1"),
+                      spec=api.PodSpec(node_name="n1", tolerations=[
+                          api.Toleration(key=TAINT_UNREACHABLE,
+                                         operator="Exists",
+                                         effect=api.NO_EXECUTE,
+                                         toleration_seconds=30)]))
+        store.create("pods", pod)
+        ctrl.monitor()
+        n = store.get("nodes", "default", "n1")
+        assert not n.spec.taints  # healthy
+        # heartbeats stop
+        now[0] += 100
+        ctrl.monitor()
+        n = store.get("nodes", "default", "n1")
+        assert any(c.type == api.NODE_READY and c.status == api.COND_UNKNOWN
+                   for c in n.status.conditions)
+        assert any(t.key == TAINT_UNREACHABLE for t in n.spec.taints)
+        assert store.get("pods", "default", "p1") is not None  # tolerated
+        now[0] += 31  # tolerationSeconds expired
+        ctrl.monitor()
+        assert store.get("pods", "default", "p1") is None  # evicted
+
+    def test_recovery_removes_taint(self):
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(store, clock=lambda: now[0])
+        store.create("nodes", mknode("n1", hb=now[0]))
+        now[0] += 100
+        ctrl.monitor()
+        assert any(t.key == TAINT_UNREACHABLE for t in
+                   store.get("nodes", "default", "n1").spec.taints)
+        # kubelet comes back: fresh heartbeat + Ready=True
+        n = store.get("nodes", "default", "n1")
+        n.metadata.annotations[HEARTBEAT_ANNOTATION] = str(now[0])
+        n.status.conditions = [api.NodeCondition(api.NODE_READY, api.COND_TRUE)]
+        store.update("nodes", n)
+        ctrl.monitor()
+        assert not store.get("nodes", "default", "n1").spec.taints
+
+    def test_not_ready_taint(self):
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(store, clock=lambda: now[0])
+        store.create("nodes", mknode("n1", ready=False, hb=now[0]))
+        ctrl.monitor()
+        taints = store.get("nodes", "default", "n1").spec.taints
+        assert [t.key for t in taints] == [TAINT_NOT_READY]
+
+
+class TestDisruption:
+    def test_pdb_status(self):
+        store = ObjectStore()
+        ctrl = DisruptionController(store)
+        rs = api.ReplicaSet(
+            metadata=api.ObjectMeta(name="rs1"),
+            spec=api.ReplicaSetSpec(replicas=3, selector=SEL, template=TMPL))
+        store.create("replicasets", rs)
+        for i in range(3):
+            p = api.Pod(
+                metadata=api.ObjectMeta(
+                    name=f"p{i}", labels={"app": "w"},
+                    owner_references=[api.OwnerReference(
+                        kind="ReplicaSet", name="rs1", uid=rs.metadata.uid,
+                        controller=True)]),
+                spec=api.PodSpec())
+            store.create("pods", p)
+            mark_running(store, store.get("pods", "default", f"p{i}"),
+                         ready=(i < 2))
+        store.create("poddisruptionbudgets", api.PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="pdb"),
+            spec=api.PodDisruptionBudgetSpec(selector=SEL, min_available=1)))
+        ctrl.sync_all()
+        pdb = store.get("poddisruptionbudgets", "default", "pdb")
+        assert pdb.status.expected_pods == 3
+        assert pdb.status.current_healthy == 2
+        assert pdb.status.desired_healthy == 1
+        assert pdb.status.disruptions_allowed == 1
+
+
+class TestNamespaceAndServiceAccount:
+    def test_terminating_namespace_sweeps_content(self):
+        store = ObjectStore()
+        ctrl = NamespaceController(store)
+        ns = api.Namespace(metadata=api.ObjectMeta(name="doomed"))
+        store.create("namespaces", ns)
+        store.create("pods", api.Pod(metadata=api.ObjectMeta(
+            name="p1", namespace="doomed")))
+        store.create("services", api.Service(metadata=api.ObjectMeta(
+            name="s1", namespace="doomed")))
+        ns.status.phase = "Terminating"
+        store.update("namespaces", ns)
+        ctrl.sync_all()
+        assert store.list("pods", "doomed") == []
+        assert store.list("services", "doomed") == []
+        assert store.get("namespaces", "", "doomed") is None
+
+    def test_default_serviceaccount(self):
+        store = ObjectStore()
+        ctrl = ServiceAccountController(store)
+        store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="team-a")))
+        ctrl.sync_all()
+        sa = store.get("serviceaccounts", "team-a", "default")
+        assert sa is not None and sa.secrets == ["default-token"]
+
+
+class TestGC:
+    def test_podgc_orphans_and_terminated(self):
+        store = ObjectStore()
+        ctrl = PodGCController(store, terminated_threshold=1)
+        store.create("nodes", mknode("n1"))
+        for i, phase in enumerate(["Succeeded", "Failed", "Running"]):
+            p = api.Pod(metadata=api.ObjectMeta(name=f"p{i}"),
+                        spec=api.PodSpec(node_name="n1"))
+            p.status.phase = phase
+            store.create("pods", p)
+        orphan = api.Pod(metadata=api.ObjectMeta(name="orphan"),
+                         spec=api.PodSpec(node_name="gone-node"))
+        store.create("pods", orphan)
+        deleted = ctrl.gc()
+        assert deleted == 2  # 1 excess terminated + 1 orphan
+        names = {p.metadata.name for p in store.list("pods")}
+        assert "orphan" not in names and "p2" in names
+
+    def test_ownerref_gc(self):
+        store = ObjectStore()
+        gc = GarbageCollector(store)
+        rs = api.ReplicaSet(metadata=api.ObjectMeta(name="rs1"),
+                            spec=api.ReplicaSetSpec(selector=SEL))
+        store.create("replicasets", rs)
+        p = api.Pod(metadata=api.ObjectMeta(
+            name="p1", owner_references=[api.OwnerReference(
+                kind="ReplicaSet", name="rs1", uid=rs.metadata.uid,
+                controller=True)]))
+        store.create("pods", p)
+        assert gc.sweep() == 0
+        store.delete("replicasets", "default", "rs1")
+        assert gc.sweep() == 1
+        assert store.list("pods") == []
+
+
+class TestPVBinding:
+    def test_binds_smallest_sufficient_pv(self):
+        store = ObjectStore()
+        ctrl = PersistentVolumeController(store)
+        from kubernetes_tpu.api.resources import value as qty
+        for name, size in [("pv-big", "100Gi"), ("pv-small", "10Gi")]:
+            store.create("persistentvolumes", api.PersistentVolume(
+                metadata=api.ObjectMeta(name=name),
+                spec=api.PersistentVolumeSpec(
+                    capacity={"storage": qty(size)})))
+        pvc = api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="claim"),
+            spec=api.PersistentVolumeClaimSpec(
+                requests={"storage": qty("5Gi")}))
+        store.create("persistentvolumeclaims", pvc)
+        ctrl.sync_all()
+        pvc = store.get("persistentvolumeclaims", "default", "claim")
+        assert pvc.spec.volume_name == "pv-small"
+
+
+class TestManagerCascade:
+    def test_deployment_to_endpoints_cascade(self):
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        store.create("nodes", mknode("n0"))
+        store.create("nodes", mknode("n1"))
+        store.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc"),
+            spec=api.ServiceSpec(selector={"app": "w"},
+                                 ports=[api.ServicePort(port=80)])))
+        store.create("deployments", api.Deployment(
+            metadata=api.ObjectMeta(name="d1"),
+            spec=api.DeploymentSpec(replicas=2, selector=SEL, template=TMPL)))
+        mgr.sync_all()
+        for i, p in enumerate(store.list("pods")):
+            if p.status.phase != "Running":
+                store.bind(p, f"n{i}")  # simulate the scheduler
+                mark_running(store, store.get("pods", p.metadata.namespace,
+                                              p.metadata.name))
+        mgr.sync_all()
+        assert len(store.list("pods")) == 2
+        ep = store.get("endpoints", "default", "svc")
+        assert ep is not None and len(ep.subsets[0].addresses) == 2
+        # deleting the deployment cascades: RS gone -> pods collected
+        store.delete("deployments", "default", "d1")
+        mgr.sync_all(rounds=4)
+        assert store.list("replicasets") == []
+        assert store.list("pods") == []

@@ -1,0 +1,251 @@
+"""Kubelet + kube-proxy tests, ending in a hollow-cluster integration:
+scheduler places pods, kubelets run them, endpoints/proxy converge, a
+kubelet dies and the nodelifecycle controller recovers its pods through
+rescheduling — the framework's elastic-recovery loop.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers import (ControllerManager,
+                                        NodeLifecycleController)
+from kubernetes_tpu.controllers.nodelifecycle import TAINT_UNREACHABLE
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.proxy import Proxier
+from kubernetes_tpu.runtime.store import ObjectStore
+
+
+def mkpod(name, node="", cpu="100m", mem="64Mi", labels=None, **spec_kw):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, labels=labels or {"app": "w"}),
+        spec=api.PodSpec(node_name=node, containers=[api.Container(
+            resources=api.ResourceRequirements(
+                requests=api.resource_list(cpu=cpu, memory=mem)))], **spec_kw))
+
+
+class TestKubelet:
+    def test_pod_lifecycle_to_running(self):
+        store = ObjectStore()
+        now = [100.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0])
+        store.create("pods", mkpod("p1", node="n1"))
+        kl.sync_once()
+        pod = store.get("pods", "default", "p1")
+        assert pod.status.phase == "Running"
+        assert ("Ready", "True") in pod.status.conditions
+        assert pod.status.start_time == 100.0
+
+    def test_start_latency_via_pleg(self):
+        store = ObjectStore()
+        now = [100.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0],
+                     runtime=FakeRuntime(start_latency=5.0))
+        store.create("pods", mkpod("p1", node="n1"))
+        kl.sync_once()
+        assert store.get("pods", "default", "p1").status.phase == "Pending"
+        now[0] += 6
+        kl.sync_once()  # PLEG tick observes ContainerStarted
+        assert store.get("pods", "default", "p1").status.phase == "Running"
+
+    def test_admission_rejects_overcommit(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1",
+                     allocatable=api.resource_list(cpu="1", memory="1Gi",
+                                                   pods=10))
+        store.create("pods", mkpod("big1", node="n1", cpu="800m"))
+        kl.sync_once()
+        store.create("pods", mkpod("big2", node="n1", cpu="800m"))
+        kl.sync_once()
+        p1 = store.get("pods", "default", "big1")
+        p2 = store.get("pods", "default", "big2")
+        assert p1.status.phase == "Running"
+        assert p2.status.phase == "Failed"  # OutOfcpu admission
+
+    def test_crash_restart_policy_always(self):
+        store = ObjectStore()
+        rt = FakeRuntime()
+        kl = Kubelet(store, "n1", runtime=rt)
+        store.create("pods", mkpod("p1", node="n1"))
+        kl.sync_once()
+        pod = store.get("pods", "default", "p1")
+        rt.crash_container(pod.metadata.uid, "c", exit_code=1)
+        kl.sync_once()
+        st = rt.get(pod.metadata.uid, "c")
+        assert st.state == "running" and st.restart_count == 1
+
+    def test_restart_policy_never_terminal(self):
+        store = ObjectStore()
+        rt = FakeRuntime()
+        kl = Kubelet(store, "n1", runtime=rt)
+        store.create("pods", mkpod("p1", node="n1", restart_policy="Never"))
+        kl.sync_once()
+        pod = store.get("pods", "default", "p1")
+        rt.crash_container(pod.metadata.uid, "c", exit_code=0)
+        kl.sync_once()
+        assert store.get("pods", "default", "p1").status.phase == "Succeeded"
+
+    def test_liveness_probe_restarts(self):
+        store = ObjectStore()
+        now = [100.0]
+        rt = FakeRuntime()
+        kl = Kubelet(store, "n1", runtime=rt, clock=lambda: now[0])
+        pod = mkpod("p1", node="n1")
+        pod.spec.containers[0].liveness_probe = api.Probe(
+            period_seconds=1.0, failure_threshold=2)
+        store.create("pods", pod)
+        kl.sync_once()
+        uid = store.get("pods", "default", "p1").metadata.uid
+        rt.set_healthy(uid, "c", False)
+        for _ in range(4):
+            now[0] += 1.1
+            kl.sync_once()
+        st = rt.get(uid, "c")
+        assert st.restart_count >= 1  # killed by probe, restarted
+
+    def test_readiness_probe_gates_ready(self):
+        store = ObjectStore()
+        rt = FakeRuntime()
+        kl = Kubelet(store, "n1", runtime=rt)
+        pod = mkpod("p1", node="n1")
+        pod.spec.containers[0].readiness_probe = api.Probe()
+        store.create("pods", pod)
+        kl.sync_once()
+        uid = store.get("pods", "default", "p1").metadata.uid
+        rt.set_ready(uid, "c", False)
+        kl.sync_once()
+        pod = store.get("pods", "default", "p1")
+        assert pod.status.phase == "Running"
+        assert ("Ready", "False") in pod.status.conditions
+
+    def test_eviction_under_memory_pressure(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1",
+                     allocatable=api.resource_list(cpu="8", memory="1Gi",
+                                                   pods=10))
+        # eviction rank: best-effort first, then largest burstable
+        be = api.Pod(metadata=api.ObjectMeta(name="be"),
+                     spec=api.PodSpec(node_name="n1",
+                                      containers=[api.Container()]))
+        store.create("pods", be)
+        store.create("pods", mkpod("heavy1", node="n1", mem="500Mi"))
+        store.create("pods", mkpod("heavy2", node="n1", mem="450Mi"))
+        kl.sync_once()
+        assert store.get("pods", "default", "be").status.phase == "Failed"
+        assert store.get("pods", "default", "heavy1").status.phase == "Failed"
+        assert store.get("pods", "default", "heavy2").status.phase == "Running"
+        node = store.get("nodes", "default", "n1")
+        mp = next(c for c in node.status.conditions
+                  if c.type == api.NODE_MEMORY_PRESSURE)
+        assert mp.status == api.COND_FALSE  # pressure relieved
+
+    def test_heartbeat_updates_annotation(self):
+        store = ObjectStore()
+        now = [100.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0], heartbeat_period=10)
+        kl.sync_once()
+        from kubernetes_tpu.controllers.nodelifecycle import \
+            HEARTBEAT_ANNOTATION
+        hb1 = store.get("nodes", "default", "n1").metadata.annotations[
+            HEARTBEAT_ANNOTATION]
+        now[0] += 11
+        kl.sync_once()
+        hb2 = store.get("nodes", "default", "n1").metadata.annotations[
+            HEARTBEAT_ANNOTATION]
+        assert float(hb2) > float(hb1)
+
+
+class TestProxier:
+    def test_rules_follow_endpoints(self):
+        store = ObjectStore()
+        store.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc"),
+            spec=api.ServiceSpec(selector={"app": "w"},
+                                 ports=[api.ServicePort(name="http", port=80,
+                                                        target_port=8080)])))
+        store.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="svc"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.1"),
+                           api.EndpointAddress(ip="10.0.0.2")],
+                ports=[api.EndpointPort(name="http", port=8080)])]))
+        px = Proxier(store)
+        rule = px.rules[("default", "svc", "http")]
+        assert rule.port == 80
+        assert [b[0] for b in rule.backends] == ["10.0.0.1", "10.0.0.2"]
+        # round-robin over backends
+        picks = {px.resolve("default", "svc", "http")[0] for _ in range(4)}
+        assert picks == {"10.0.0.1", "10.0.0.2"}
+        # endpoint update -> dirty -> resync
+        store.update("endpoints", api.Endpoints(
+            metadata=store.get("endpoints", "default", "svc").metadata,
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.3")],
+                ports=[api.EndpointPort(name="http", port=8080)])]))
+        px.sync_proxy_rules()
+        assert px.resolve("default", "svc", "http") == ("10.0.0.3", 8080)
+
+
+class TestHollowCluster:
+    """Scheduler + controllers + kubelets over one store: place, run,
+    fail a node, recover. The kubemark-style end-to-end loop."""
+
+    def test_schedule_run_fail_recover(self):
+        from kubernetes_tpu.sched.scheduler import Scheduler
+        store = ObjectStore()
+        now = [1000.0]
+        clock = lambda: now[0]  # noqa: E731
+        kubelets = [Kubelet(store, f"n{i}", clock=clock,
+                            heartbeat_period=5.0) for i in range(3)]
+        sched = Scheduler(store, wave_size=16)
+        nlc = NodeLifecycleController(store, clock=clock, grace_period=30.0)
+        mgr_like = [nlc]
+        # a replicaset-owned workload, created directly as pods for brevity
+        for i in range(6):
+            store.create("pods", mkpod(f"p{i}"))
+        placed = 0
+        deadline = time.monotonic() + 60
+        while placed < 6 and time.monotonic() < deadline:
+            placed += sched.run_once()
+        assert placed == 6
+        for kl in kubelets:
+            kl.sync_once()
+        running = [p for p in store.list("pods")
+                   if p.status.phase == "Running"]
+        assert len(running) == 6
+        by_node = {}
+        for p in store.list("pods"):
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        assert len(by_node) == 3  # spread
+        # kill node n0: its kubelet stops heartbeating
+        dead = "n0"
+        alive = [kl for kl in kubelets if kl.node_name != dead]
+        now[0] += 60  # beyond grace period
+        for kl in alive:
+            kl.sync_once()
+        nlc.monitor()  # marks n0 unreachable + NoExecute taint
+        node = store.get("nodes", "default", dead)
+        assert any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+        # pods created by the test have no tolerations -> evicted now
+        nlc.monitor()
+        orphaned = [p for p in store.list("pods")
+                    if p.spec.node_name == dead]
+        assert orphaned == []
+        # evicted pods are gone; recreate (the RS controller's role) and
+        # verify the scheduler avoids the tainted node
+        lost = 6 - len(store.list("pods"))
+        assert lost > 0
+        for i in range(lost):
+            store.create("pods", mkpod(f"r{i}"))
+        placed = 0
+        deadline = time.monotonic() + 60
+        while placed < lost and time.monotonic() < deadline:
+            placed += sched.run_once()
+        assert placed == lost
+        for p in store.list("pods"):
+            assert p.spec.node_name != dead
+        for kl in alive:
+            kl.sync_once()
+        assert sum(1 for p in store.list("pods")
+                   if p.status.phase == "Running") == 6
