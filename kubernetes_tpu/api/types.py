@@ -837,6 +837,14 @@ def get_nonzero_requests(pod: Pod) -> Tuple[int, int]:
     return cpu, mem
 
 
+def is_pod_active(pod: Pod) -> bool:
+    """Not Succeeded/Failed and not being deleted — the liveness rule
+    shared by controllers and quota (controller_utils.go IsPodActive,
+    quota core evaluator)."""
+    return (pod.status.phase not in ("Succeeded", "Failed")
+            and pod.metadata.deletion_timestamp is None)
+
+
 def is_best_effort(pod: Pod) -> bool:
     """QoS == BestEffort: no container has any requests or limits
     (reference: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS)."""

@@ -1,0 +1,1 @@
+"""User-facing CLI — pkg/kubectl analog."""

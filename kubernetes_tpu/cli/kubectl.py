@@ -1,0 +1,349 @@
+"""kubectl: the user-facing CLI over the REST API.
+
+Reference: pkg/kubectl + cmd/kubectl — verbs get/describe/create/apply/
+delete/scale/cordon/uncordon/drain/label/logs-ish/version, table
+printers (pkg/printers), YAML/JSON output, manifest files (YAML or JSON,
+multi-document). Server address via --server or $KUBECTL_SERVER.
+
+Run as: python -m kubernetes_tpu.cli.kubectl <verb> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from ..api import scheme
+from ..api import types as api
+from ..client.rest import APIStatusError, RESTClient
+
+# -- printers (pkg/printers/internalversion/printers.go table defs) -----------
+
+
+def _age(obj, now=None) -> str:
+    ts = getattr(obj.status, "start_time", None) if hasattr(obj, "status") else None
+    if ts is None:
+        return "-"
+    secs = max(0, (now or time.time()) - ts)
+    if secs < 120:
+        return f"{int(secs)}s"
+    if secs < 7200:
+        return f"{int(secs // 60)}m"
+    return f"{int(secs // 3600)}h"
+
+
+def _pod_row(p: api.Pod):
+    total = len(p.spec.containers)
+    ready = sum(1 for c, s in p.status.conditions
+                if c == "Ready" and str(s).startswith("True"))
+    ready_str = f"{total if ready else 0}/{total}"
+    return [p.metadata.name, ready_str, p.status.phase or "Pending",
+            p.spec.node_name or "<none>", _age(p)]
+
+
+def _node_row(n: api.Node):
+    ready = next((c.status for c in n.status.conditions
+                  if c.type == api.NODE_READY), "Unknown")
+    status = "Ready" if ready == "True" else "NotReady"
+    if n.spec.unschedulable:
+        status += ",SchedulingDisabled"
+    roles = ",".join(sorted(
+        k.rsplit("/", 1)[1] for k in (n.metadata.labels or {})
+        if k.startswith("node-role.kubernetes.io/"))) or "<none>"
+    return [n.metadata.name, status, roles,
+            str(len(n.spec.taints)) + " taints" if n.spec.taints else "-"]
+
+
+_COLUMNS = {
+    "pods": (["NAME", "READY", "STATUS", "NODE", "AGE"], _pod_row),
+    "nodes": (["NAME", "STATUS", "ROLES", "TAINTS"], _node_row),
+    "services": (["NAME", "CLUSTER-IP", "PORTS"],
+                 lambda s: [s.metadata.name, s.spec.cluster_ip or "<auto>",
+                            ",".join(f"{p.port}/{p.protocol}"
+                                     for p in s.spec.ports) or "<none>"]),
+    "deployments": (["NAME", "DESIRED", "CURRENT", "READY"],
+                    lambda d: [d.metadata.name, str(d.spec.replicas),
+                               str(d.status.replicas),
+                               str(d.status.ready_replicas)]),
+    "replicasets": (["NAME", "DESIRED", "CURRENT", "READY"],
+                    lambda r: [r.metadata.name, str(r.spec.replicas),
+                               str(r.status.replicas),
+                               str(r.status.ready_replicas)]),
+    "jobs": (["NAME", "COMPLETIONS", "ACTIVE"],
+             lambda j: [j.metadata.name,
+                        f"{j.status.succeeded}/{j.spec.completions}",
+                        str(j.status.active)]),
+    "events": (["NAME", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE"],
+               lambda e: [e.metadata.name, e.type, e.reason,
+                          f"{e.involved_kind}/{e.involved_name}",
+                          str(e.count), e.message[:60]]),
+}
+
+
+def _print_table(plural: str, objs: List[object], out):
+    headers, row_fn = _COLUMNS.get(
+        plural, (["NAME", "AGE"],
+                 lambda o: [o.metadata.name, _age(o)]))
+    rows = [row_fn(o) for o in objs]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out.write("  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()
+              + "\n")
+    for r in rows:
+        out.write("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                  + "\n")
+
+
+def _dump(obj, fmt: str, out):
+    data = scheme.encode_object(obj)
+    if fmt == "json":
+        out.write(json.dumps(data, indent=2) + "\n")
+    else:
+        import yaml
+        out.write(yaml.safe_dump(data, sort_keys=False) + "---\n")
+
+
+# -- manifest loading ---------------------------------------------------------
+
+
+def load_manifests(path: str) -> List[object]:
+    """YAML (multi-doc) or JSON manifest -> objects."""
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    docs: List[dict] = []
+    if text.lstrip().startswith("{"):
+        docs = [json.loads(text)]
+    else:
+        import yaml
+        docs = [d for d in yaml.safe_load_all(text) if d]
+    return [scheme.decode_object(d) for d in docs]
+
+
+# -- verbs --------------------------------------------------------------------
+
+
+def cmd_get(client, args, out):
+    plural = _resolve_kind(args.kind)
+    if args.name:
+        obj = client.get(plural, args.namespace, args.name)
+        objs = [obj]
+    else:
+        ns = None if args.all_namespaces else args.namespace
+        objs, _ = client.list(plural, ns)
+    if args.output in ("yaml", "json"):
+        for o in objs:
+            _dump(o, args.output, out)
+    else:
+        _print_table(plural, objs, out)
+
+
+def cmd_describe(client, args, out):
+    plural = _resolve_kind(args.kind)
+    obj = client.get(plural, args.namespace, args.name)
+    _dump(obj, "yaml", out)
+    evs, _ = client.list("events", args.namespace)
+    related = [e for e in evs if e.involved_name == args.name]
+    if related:
+        out.write("Events:\n")
+        for e in related:
+            out.write(f"  {e.type}\t{e.reason}\tx{e.count}\t{e.message}\n")
+
+
+def cmd_create(client, args, out):
+    for obj in load_manifests(args.filename):
+        kind = scheme.kind_of(obj)
+        plural = scheme.plural_for_kind(kind)
+        if scheme.is_namespaced(kind) and args.namespace != "default":
+            obj.metadata.namespace = args.namespace
+        client.create(plural, obj)
+        out.write(f"{plural}/{obj.metadata.name} created\n")
+
+
+def cmd_apply(client, args, out):
+    """Create-or-update (the reference's three-way apply reduced to
+    server-side upsert via PUT)."""
+    for obj in load_manifests(args.filename):
+        kind = scheme.kind_of(obj)
+        plural = scheme.plural_for_kind(kind)
+        if scheme.is_namespaced(kind) and args.namespace != "default":
+            obj.metadata.namespace = args.namespace
+        try:
+            cur = client.get(plural, obj.metadata.namespace, obj.metadata.name)
+            obj.metadata.resource_version = cur.metadata.resource_version
+            obj.metadata.uid = cur.metadata.uid
+            client.update(plural, obj)
+            out.write(f"{plural}/{obj.metadata.name} configured\n")
+        except APIStatusError as e:
+            if e.code != 404:
+                raise
+            client.create(plural, obj)
+            out.write(f"{plural}/{obj.metadata.name} created\n")
+
+
+def cmd_delete(client, args, out):
+    plural = _resolve_kind(args.kind)
+    client.delete(plural, args.namespace, args.name)
+    out.write(f"{plural}/{args.name} deleted\n")
+
+
+def cmd_scale(client, args, out):
+    plural = _resolve_kind(args.kind)
+    obj = client.get(plural, args.namespace, args.name)
+    obj.spec.replicas = args.replicas
+    client.update(plural, obj)
+    out.write(f"{plural}/{args.name} scaled to {args.replicas}\n")
+
+
+def _set_unschedulable(client, name: str, value: bool):
+    node = client.get("nodes", None, name)
+    node.spec.unschedulable = value
+    client.update("nodes", node)
+
+
+def cmd_cordon(client, args, out):
+    _set_unschedulable(client, args.name, True)
+    out.write(f"node/{args.name} cordoned\n")
+
+
+def cmd_uncordon(client, args, out):
+    _set_unschedulable(client, args.name, False)
+    out.write(f"node/{args.name} uncordoned\n")
+
+
+def cmd_drain(client, args, out):
+    """Cordon + evict all pods on the node (kubectl drain; uses the
+    eviction subresource so PDBs are honored)."""
+    _set_unschedulable(client, args.name, True)
+    pods, _ = client.list("pods")
+    for p in pods:
+        if p.spec.node_name != args.name:
+            continue
+        try:
+            client.evict(p.metadata.namespace, p.metadata.name)
+            out.write(f"pod/{p.metadata.name} evicted\n")
+        except APIStatusError as e:
+            out.write(f"pod/{p.metadata.name} eviction blocked: {e}\n")
+    out.write(f"node/{args.name} drained\n")
+
+
+def cmd_label(client, args, out):
+    plural = _resolve_kind(args.kind)
+    obj = client.get(plural, args.namespace, args.name)
+    for kv in args.labels:
+        if kv.endswith("-"):
+            obj.metadata.labels.pop(kv[:-1], None)
+        else:
+            k, _, v = kv.partition("=")
+            obj.metadata.labels[k] = v
+    client.update(plural, obj)
+    out.write(f"{plural}/{args.name} labeled\n")
+
+
+def cmd_version(client, args, out):
+    v = client.request("GET", "/version")
+    out.write(f"Server Version: {v.get('gitVersion')}\n")
+
+
+# -- kind aliases (pkg/kubectl short names) -----------------------------------
+
+_ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "deploy": "deployments", "deployment": "deployments",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "rc": "replicationcontrollers",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "job": "jobs", "cj": "cronjobs", "cronjob": "cronjobs",
+    "ns": "namespaces", "namespace": "namespaces",
+    "ep": "endpoints",
+    "pdb": "poddisruptionbudgets",
+    "pv": "persistentvolumes", "pvc": "persistentvolumeclaims",
+    "quota": "resourcequotas", "sa": "serviceaccounts",
+    "pc": "priorityclasses", "ev": "events", "event": "events",
+}
+
+
+def _resolve_kind(kind: str) -> str:
+    plural = _ALIASES.get(kind, kind)
+    if scheme.kind_for_plural(plural) is None:
+        raise SystemExit(f"error: unknown resource type {kind!r}")
+    return plural
+
+
+# -- entry --------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="kubectl")
+    ap.add_argument("--server", "-s", default=None,
+                    help="API server URL (default $KUBECTL_SERVER)")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--namespace", "-n", default="default")
+    sub = ap.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    g.add_argument("name", nargs="?")
+    g.add_argument("--output", "-o", choices=["table", "yaml", "json"],
+                   default="table")
+    g.add_argument("--all-namespaces", "-A", action="store_true")
+
+    d = sub.add_parser("describe")
+    d.add_argument("kind")
+    d.add_argument("name")
+
+    for verb in ("create", "apply"):
+        c = sub.add_parser(verb)
+        c.add_argument("--filename", "-f", required=True)
+
+    dl = sub.add_parser("delete")
+    dl.add_argument("kind")
+    dl.add_argument("name")
+
+    sc = sub.add_parser("scale")
+    sc.add_argument("kind")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    for verb in ("cordon", "uncordon", "drain"):
+        c = sub.add_parser(verb)
+        c.add_argument("name")
+
+    lb = sub.add_parser("label")
+    lb.add_argument("kind")
+    lb.add_argument("name")
+    lb.add_argument("labels", nargs="+")
+
+    sub.add_parser("version")
+    return ap
+
+
+VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
+         "apply": cmd_apply, "delete": cmd_delete, "scale": cmd_scale,
+         "cordon": cmd_cordon, "uncordon": cmd_uncordon, "drain": cmd_drain,
+         "label": cmd_label, "version": cmd_version}
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    import os
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    server = args.server or os.environ.get("KUBECTL_SERVER")
+    if not server:
+        print("error: --server or $KUBECTL_SERVER required", file=sys.stderr)
+        return 1
+    client = RESTClient(server, token=args.token)
+    try:
+        VERBS[args.verb](client, args, out)
+        return 0
+    except APIStatusError as e:
+        print(f"Error from server: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,11 +103,7 @@ class Controller:
 # -- shared pod helpers (pkg/controller/controller_utils.go) -------------------
 
 
-def is_pod_active(pod: api.Pod) -> bool:
-    """controller_utils.go IsPodActive: not Succeeded/Failed, not being
-    deleted."""
-    return (pod.status.phase not in ("Succeeded", "Failed")
-            and pod.metadata.deletion_timestamp is None)
+is_pod_active = api.is_pod_active  # canonical definition in api/types.py
 
 
 def is_pod_ready(pod: api.Pod) -> bool:

@@ -14,7 +14,8 @@ from ..api import types as api
 from ..runtime.store import Conflict
 from ..plugins import golden
 from ..state.node_info import NodeInfo
-from .base import Controller, is_pod_active, make_pod_from_template
+from .base import (Controller, is_pod_active, make_pod_from_template,
+                   pod_owned_by)
 
 
 class DaemonSetController(Controller):
@@ -40,19 +41,26 @@ class DaemonSetController(Controller):
 
     def _should_run(self, ds, node: api.Node) -> bool:
         """nodesShouldRunDaemonPod: simulate the daemon pod on the node —
-        node selector/affinity, taints (daemon pods tolerate
-        memory/disk-pressure implicitly in 1.11), schedulability."""
+        GeneralPredicates (incl. resource fit against existing pods),
+        taints (daemon pods tolerate memory/disk-pressure implicitly in
+        1.11), schedulability (daemon_controller.go:1206)."""
         if node.spec.unschedulable:
             return False
         pod = make_pod_from_template(ds.spec.template, "DaemonSet", ds, "sim")
         pod.spec.node_name = node.metadata.name
-        if not api.pod_matches_node_selector(pod, node):
-            return False
         ni = NodeInfo(node)
-        ok, reasons = golden.pod_tolerates_node_taints(pod, ni)
+        for existing in self.store.list("pods"):
+            if existing.spec.node_name == node.metadata.name and \
+                    is_pod_active(existing) and not pod_owned_by(
+                        existing, "DaemonSet", ds.metadata.name):
+                ni.add_pod(existing)
+        ok, _ = golden.general_predicates(pod, ni)
         if not ok:
             return False
-        ok, reasons = golden.check_node_condition(pod, ni)
+        ok, _ = golden.pod_tolerates_node_taints(pod, ni)
+        if not ok:
+            return False
+        ok, _ = golden.check_node_condition(pod, ni)
         return ok
 
     def sync(self, key: str):

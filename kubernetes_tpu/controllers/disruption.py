@@ -22,8 +22,13 @@ class DisruptionController(Controller):
         self.informer("poddisruptionbudgets")
         self.informer("pods",
                       on_add=self._pod_event,
-                      on_update=lambda o, n: self._pod_event(n),
+                      on_update=self._pod_update,
                       on_delete=self._pod_event)
+
+    def _pod_update(self, old, new):
+        # formerly-matching PDBs must recount when labels change
+        self._pod_event(old)
+        self._pod_event(new)
 
     def _pod_event(self, pod):
         labels = pod.metadata.labels or {}

@@ -29,7 +29,7 @@ class EndpointsController(Controller):
         self.informer("services")
         self.informer("pods",
                       on_add=self._pod_event,
-                      on_update=lambda o, n: self._pod_event(n),
+                      on_update=self._pod_update,
                       on_delete=self._pod_event)
 
     def _pod_event(self, pod: api.Pod):
@@ -37,6 +37,12 @@ class EndpointsController(Controller):
         for svc in self.store.list("services", pod.metadata.namespace):
             if svc.selector and lbl.Selector.from_set(svc.selector).matches(labels):
                 self.enqueue(svc)
+
+    def _pod_update(self, old: api.Pod, new: api.Pod):
+        # enqueue services matching the OLD labels too, so a relabeled pod
+        # is removed from formerly-matching endpoints (reference updatePod)
+        self._pod_event(old)
+        self._pod_event(new)
 
     def sync(self, key: str):
         ns, name = key.split("/", 1)

@@ -56,8 +56,8 @@ class NodeLifecycleController(Controller):
         self.grace_period = grace_period
         self.default_eviction_wait = eviction_wait
         self.informer("nodes")
-        # taint-expiry bookkeeping: pod key -> eviction deadline
-        self._evict_at: Dict[str, float] = {}
+        # taint-expiry bookkeeping: pod key -> (eviction deadline, node)
+        self._evict_at: Dict[str, tuple] = {}
         self._timer: Optional[threading.Thread] = None
 
     # -- monitorNodeStatus -----------------------------------------------------
@@ -105,11 +105,13 @@ class NodeLifecycleController(Controller):
             except (Conflict, KeyError):
                 return  # stale view; retried on the next pass
         if any(t.effect == api.NO_EXECUTE for t in node.spec.taints):
-            self._schedule_evictions(node)
+            self._schedule_evictions(node, now)
         else:
-            for pod in self.store.list("pods"):
-                if pod.spec.node_name == node.metadata.name:
-                    self._evict_at.pop(pod.full_name(), None)
+            # cancel pending evictions for this node (scan only the small
+            # _evict_at map, not the cluster pod list)
+            for key, (_, nname) in list(self._evict_at.items()):
+                if nname == node.metadata.name:
+                    self._evict_at.pop(key, None)
 
     @staticmethod
     def _set_ready_cond(node: api.Node, status: str):
@@ -133,8 +135,8 @@ class NodeLifecycleController(Controller):
 
     # -- NoExecute taint manager (eviction with tolerationSeconds) -------------
 
-    def _schedule_evictions(self, node: api.Node):
-        now = self.clock()
+    def _schedule_evictions(self, node: api.Node, now: Optional[float] = None):
+        now = now if now is not None else self.clock()
         keys = {t.key for t in node.spec.taints
                 if t.effect == api.NO_EXECUTE}
         if not keys:
@@ -150,8 +152,8 @@ class NodeLifecycleController(Controller):
                 self._evict_at.pop(k, None)
             else:
                 deadline = now + wait
-                if k not in self._evict_at or self._evict_at[k] > deadline:
-                    self._evict_at[k] = deadline
+                if k not in self._evict_at or self._evict_at[k][0] > deadline:
+                    self._evict_at[k] = (deadline, node.metadata.name)
 
     def _toleration_wait(self, pod: api.Pod, taint_keys) -> Optional[float]:
         """Min tolerationSeconds across NoExecute taints; None = tolerates
@@ -172,7 +174,7 @@ class NodeLifecycleController(Controller):
         return min(waits)
 
     def _process_evictions(self, now: float):
-        for key, deadline in list(self._evict_at.items()):
+        for key, (deadline, _nname) in list(self._evict_at.items()):
             if deadline > now:
                 continue
             ns, name = key.split("/", 1)

@@ -117,11 +117,10 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         if not quotas:
             return
         req = api.get_resource_request(obj)
-        # only active pods consume quota (quota core evaluator filters
-        # terminal phases the same way the controller's recompute does)
+        # only active pods consume quota — same predicate the controller's
+        # recompute uses (api.is_pod_active)
         pods_in_ns = [p for p in store.list("pods", ns)
-                      if p.status.phase not in ("Succeeded", "Failed")
-                      and p.metadata.deletion_timestamp is None]
+                      if api.is_pod_active(p)]
         for q in quotas:
             hard = q.spec.hard
             if "pods" in hard and len(pods_in_ns) + 1 > hard["pods"]:

@@ -1,0 +1,234 @@
+// Versioned in-memory KV store with a bounded watch ring — the native
+// storage engine behind runtime/nativestore.py.
+//
+// Architectural role: the reference's L0 is a *native external store*
+// (etcd v3.2.18, a Go binary spoken to over gRPC — WORKSPACE:23,
+// staging/src/k8s.io/apiserver/pkg/storage/etcd3/). This library is the
+// framework's equivalent: object bytes live behind a C ABI, every
+// mutation gets a monotonically increasing revision (etcd ModRevision),
+// compare-and-swap updates (etcd3/store.go:262 GuaranteedUpdate txn),
+// and watchers replay history from a revision out of a bounded window
+// (mvcc watchable store; "compacted" history -> error 3, the 410 Gone
+// analog).
+//
+// The C ABI is deliberately narrow (new/free, put, del, get, list,
+// poll, rev) so it binds with ctypes — no pybind11 dependency.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Entry {
+    std::string value;
+    int64_t mod_rev;
+};
+
+struct Event {
+    int64_t rev;
+    bool is_delete;
+    bool is_create;
+    std::string key;
+    std::string value;  // new value for PUT, last value for DELETE
+};
+
+struct Store {
+    std::mutex mu;
+    std::map<std::string, Entry> data;  // ordered: prefix scans are ranges
+    std::deque<Event> ring;
+    size_t ring_capacity;
+    int64_t rev = 0;
+};
+
+char* dup_buffer(const std::string& s) {
+    char* out = static_cast<char*>(std::malloc(s.size() + 1));
+    std::memcpy(out, s.data(), s.size());
+    out[s.size()] = '\0';
+    return out;
+}
+
+void push_event(Store* st, Event ev) {
+    st->ring.push_back(std::move(ev));
+    while (st->ring.size() > st->ring_capacity) st->ring.pop_front();
+}
+
+// JSON string escaping for the poll/list framing (values are already
+// JSON documents; keys need escaping).
+void append_json_string(std::string& out, const std::string& s) {
+    out.push_back('"');
+    for (char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\t': out += "\\t"; break;
+            case '\r': out += "\\r"; break;
+            default:
+                if (static_cast<unsigned char>(c) < 0x20) {
+                    char buf[8];
+                    std::snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out.push_back(c);
+                }
+        }
+    }
+    out.push_back('"');
+}
+
+}  // namespace
+
+extern "C" {
+
+// error codes
+enum { KV_OK = 0, KV_CONFLICT = 1, KV_NOT_FOUND = 2, KV_COMPACTED = 3 };
+
+void* kv_new(int ring_capacity) {
+    Store* st = new Store();
+    st->ring_capacity = ring_capacity > 0 ? ring_capacity : 4096;
+    return st;
+}
+
+void kv_free(void* h) { delete static_cast<Store*>(h); }
+
+void kv_buf_free(char* buf) { std::free(buf); }
+
+int64_t kv_rev(void* h) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    return st->rev;
+}
+
+// expect_rev semantics (etcd txn guards):
+//   -1 : unconditional upsert
+//    0 : create — key must not exist (If ModRevision == 0)
+//   >0 : update — key's mod_rev must equal expect_rev (CAS)
+int64_t kv_put(void* h, const char* key, const char* value,
+               int64_t expect_rev, int* err) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    auto it = st->data.find(key);
+    if (expect_rev == 0 && it != st->data.end()) {
+        *err = KV_CONFLICT;
+        return 0;
+    }
+    if (expect_rev > 0) {
+        if (it == st->data.end()) {
+            *err = KV_NOT_FOUND;
+            return 0;
+        }
+        if (it->second.mod_rev != expect_rev) {
+            *err = KV_CONFLICT;
+            return 0;
+        }
+    }
+    bool created = (it == st->data.end());
+    st->rev += 1;
+    st->data[key] = Entry{value, st->rev};
+    push_event(st, Event{st->rev, false, created, key, value});
+    *err = KV_OK;
+    return st->rev;
+}
+
+int64_t kv_delete(void* h, const char* key, int* err) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    auto it = st->data.find(key);
+    if (it == st->data.end()) {
+        *err = KV_NOT_FOUND;
+        return 0;
+    }
+    st->rev += 1;
+    push_event(st, Event{st->rev, true, false, key,
+                         std::move(it->second.value)});
+    st->data.erase(it);
+    *err = KV_OK;
+    return st->rev;
+}
+
+// Returns malloc'd value or NULL; *mod_rev gets the entry's revision.
+char* kv_get(void* h, const char* key, int64_t* mod_rev) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    auto it = st->data.find(key);
+    if (it == st->data.end()) return nullptr;
+    *mod_rev = it->second.mod_rev;
+    return dup_buffer(it->second.value);
+}
+
+// Prefix scan -> JSON lines `{"key":...,"rev":N,"value":<doc>}`.
+// *rev gets the store revision of the snapshot (list resourceVersion).
+char* kv_list(void* h, const char* prefix, int64_t* rev) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    *rev = st->rev;
+    std::string out;
+    std::string pfx(prefix);
+    for (auto it = st->data.lower_bound(pfx);
+         it != st->data.end() && it->first.compare(0, pfx.size(), pfx) == 0;
+         ++it) {
+        out += "{\"key\":";
+        append_json_string(out, it->first);
+        out += ",\"rev\":" + std::to_string(it->second.mod_rev);
+        out += ",\"value\":" + it->second.value + "}\n";
+    }
+    return dup_buffer(out);
+}
+
+// Events with rev > since_rev as JSON lines
+// `{"rev":N,"type":"PUT"|"DELETE","create":0|1,"key":...,"value":<doc>}`.
+// err: KV_COMPACTED when since_rev predates the ring window.
+// *next_rev gets the last delivered (or current) revision.
+char* kv_poll(void* h, int64_t since_rev, int max_events,
+              int64_t* next_rev, int* err) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    *err = KV_OK;
+    *next_rev = since_rev;
+    if (!st->ring.empty() && since_rev + 1 < st->ring.front().rev &&
+        since_rev < st->rev) {
+        // window check: only events newer than the ring start are
+        // replayable; an older horizon means history was dropped
+        if (since_rev < st->ring.front().rev - 1) {
+            *err = KV_COMPACTED;
+            return nullptr;
+        }
+    }
+    std::string out;
+    int n = 0;
+    for (const Event& ev : st->ring) {
+        if (ev.rev <= since_rev) continue;
+        if (max_events > 0 && n >= max_events) break;
+        out += "{\"rev\":" + std::to_string(ev.rev);
+        out += ",\"type\":\"";
+        out += ev.is_delete ? "DELETE" : "PUT";
+        out += "\",\"create\":";
+        out += ev.is_create ? "1" : "0";
+        out += ",\"key\":";
+        append_json_string(out, ev.key);
+        out += ",\"value\":" + ev.value + "}\n";
+        *next_rev = ev.rev;
+        ++n;
+    }
+    return dup_buffer(out);
+}
+
+int64_t kv_count(void* h, const char* prefix) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    std::string pfx(prefix);
+    int64_t n = 0;
+    for (auto it = st->data.lower_bound(pfx);
+         it != st->data.end() && it->first.compare(0, pfx.size(), pfx) == 0;
+         ++it)
+        ++n;
+    return n;
+}
+
+}  // extern "C"

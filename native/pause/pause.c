@@ -1,0 +1,44 @@
+/*
+ * Pod sandbox holder — the framework's one tiny native daemon, mirroring
+ * the role of the reference's pause container (build/pause/pause.c:
+ * a process that holds the pod's namespaces alive and reaps orphaned
+ * children as pid 1). Re-implemented, not copied: same contract —
+ * ignore-nothing signal handling, zombie reaping, block forever.
+ */
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void on_term(int sig) {
+    (void)sig;
+    _exit(0);
+}
+
+static void on_chld(int sig) {
+    (void)sig;
+    /* reap every exited child (pid-1 duty inside the pod sandbox) */
+    while (waitpid(-1, NULL, WNOHANG) > 0) {
+    }
+}
+
+int main(int argc, char **argv) {
+    (void)argc;
+    (void)argv;
+    struct sigaction sa_term = {0}, sa_chld = {0};
+    sa_term.sa_handler = on_term;
+    sa_chld.sa_handler = on_chld;
+    sa_chld.sa_flags = SA_NOCLDSTOP;
+    if (sigaction(SIGINT, &sa_term, NULL) < 0 ||
+        sigaction(SIGTERM, &sa_term, NULL) < 0 ||
+        sigaction(SIGCHLD, &sa_chld, NULL) < 0) {
+        perror("sigaction");
+        return 1;
+    }
+    for (;;) {
+        pause(); /* wake only for signals; handlers do the rest */
+    }
+}

@@ -1,0 +1,148 @@
+"""kubectl CLI tests against a live in-process apiserver
+(the reference's pkg/kubectl cmd tests drive fake REST; here the real
+server is cheap enough to use directly)."""
+
+import io
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.cli.kubectl import main
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server import APIServer, AdmissionChain
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(ObjectStore(), admission=AdmissionChain()).start()
+    yield srv
+    srv.stop()
+
+
+def run(server, *argv):
+    out = io.StringIO()
+    rc = main(["--server", server.url, *argv], out=out)
+    return rc, out.getvalue()
+
+
+@pytest.fixture()
+def seeded(server):
+    c = RESTClient(server.url)
+    c.create("nodes", api.Node(
+        metadata=api.ObjectMeta(name="n1",
+                                labels={"node-role.kubernetes.io/master": ""}),
+        status=api.NodeStatus(
+            allocatable=api.resource_list(cpu="4", memory="8Gi", pods=110),
+            conditions=[api.NodeCondition(api.NODE_READY, api.COND_TRUE)])))
+    p = api.Pod(metadata=api.ObjectMeta(name="p1", labels={"app": "w"}),
+                spec=api.PodSpec(node_name="n1",
+                                 containers=[api.Container()]))
+    p.status.phase = "Running"
+    p.status.conditions = [("Ready", "True")]
+    c.create("pods", p)
+    return c
+
+
+class TestKubectl:
+    def test_get_pods_table(self, server, seeded):
+        rc, out = run(server, "get", "pods")
+        assert rc == 0
+        assert "NAME" in out and "p1" in out and "Running" in out and "n1" in out
+
+    def test_get_short_alias_and_yaml(self, server, seeded):
+        rc, out = run(server, "get", "po", "p1", "-o", "yaml")
+        assert rc == 0
+        import yaml
+        doc = yaml.safe_load(out.split("---")[0])
+        assert doc["kind"] == "Pod" and doc["metadata"]["name"] == "p1"
+
+    def test_get_nodes(self, server, seeded):
+        rc, out = run(server, "get", "nodes")
+        assert rc == 0 and "master" in out and "Ready" in out
+
+    def test_create_apply_delete_roundtrip(self, server, seeded, tmp_path):
+        manifest = tmp_path / "dep.yaml"
+        manifest.write_text("""
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  replicas: 2
+  selector:
+    matchLabels: {app: web}
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+      - name: c
+        image: web:v1
+""")
+        rc, out = run(server, "create", "-f", str(manifest))
+        assert rc == 0 and "created" in out
+        dep = seeded.get("deployments", "default", "web")
+        assert dep.spec.replicas == 2
+        assert dep.spec.template.spec.containers[0].image == "web:v1"
+        # apply updates in place
+        manifest.write_text(manifest.read_text().replace("replicas: 2",
+                                                         "replicas: 5"))
+        rc, out = run(server, "apply", "-f", str(manifest))
+        assert rc == 0 and "configured" in out
+        assert seeded.get("deployments", "default", "web").spec.replicas == 5
+        rc, out = run(server, "delete", "deploy", "web")
+        assert rc == 0
+
+    def test_scale(self, server, seeded):
+        from kubernetes_tpu.api.labels import LabelSelector
+        seeded.create("replicasets", api.ReplicaSet(
+            metadata=api.ObjectMeta(name="rs1"),
+            spec=api.ReplicaSetSpec(
+                replicas=1, selector=LabelSelector(match_labels={"a": "b"}))))
+        rc, out = run(server, "scale", "rs", "rs1", "--replicas", "4")
+        assert rc == 0
+        assert seeded.get("replicasets", "default", "rs1").spec.replicas == 4
+
+    def test_cordon_drain_uncordon(self, server, seeded):
+        rc, _ = run(server, "cordon", "n1")
+        assert rc == 0
+        assert seeded.get("nodes", "default", "n1").spec.unschedulable
+        rc, out = run(server, "drain", "n1")
+        assert rc == 0 and "evicted" in out
+        pods, _ = seeded.list("pods")
+        assert pods == []
+        rc, _ = run(server, "uncordon", "n1")
+        assert not seeded.get("nodes", "default", "n1").spec.unschedulable
+
+    def test_drain_respects_pdb(self, server, seeded):
+        from kubernetes_tpu.api.labels import LabelSelector
+        seeded.create("poddisruptionbudgets", api.PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="pdb"),
+            selector=LabelSelector(match_labels={"app": "w"}),
+            disruptions_allowed=0))
+        rc, out = run(server, "drain", "n1")
+        assert rc == 0 and "eviction blocked" in out
+        pods, _ = seeded.list("pods")
+        assert len(pods) == 1  # still there
+
+    def test_label(self, server, seeded):
+        rc, _ = run(server, "label", "pods", "p1", "tier=web", "app-")
+        assert rc == 0
+        pod = seeded.get("pods", "default", "p1")
+        assert pod.metadata.labels == {"tier": "web"}
+
+    def test_describe_shows_events(self, server, seeded):
+        seeded.create("events", api.EventObject(
+            metadata=api.ObjectMeta(name="p1.scheduled.x"),
+            involved_kind="Pod", involved_name="p1",
+            reason="Scheduled", message="bound to n1", count=2))
+        rc, out = run(server, "describe", "pods", "p1")
+        assert rc == 0 and "Events:" in out and "bound to n1" in out
+
+    def test_version_and_unknown_kind(self, server):
+        rc, out = run(server, "version")
+        assert rc == 0 and "v1.11.0-tpu" in out
+        with pytest.raises(SystemExit):
+            run(server, "get", "wibbles")
