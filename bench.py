@@ -1,16 +1,24 @@
-"""Scheduler throughput benchmark — scheduler_perf density analog.
+"""Scheduler throughput benchmark — scheduler_perf analog.
 
-Reproduces the reference's TestSchedule100Node3KPods shape
+Default run reproduces the reference's TestSchedule100Node3KPods shape
 (test/integration/scheduler_perf/scheduler_test.go:68 schedulePods:127):
 N fake nodes are registered, P pods are created, and we measure the
-sustained rate at which the scheduler binds them all.
+sustained rate at which the scheduler binds them all. Prints ONE JSON
+line: {"metric", "value", "unit", "vs_baseline"}; vs_baseline is
+measured against the reference's 100 pods/s "healthy" warning level
+(scheduler_test.go:35; hard-fail is 30/s).
 
-Baseline: the reference perf harness hard-fails below 30 pods/s and
-warns below 100 pods/s on this exact configuration
-(scheduler_test.go:35-36); vs_baseline is measured against the 100
-pods/s warning level — the throughput the reference considers healthy.
+--workload selects the BASELINE.md config grid:
+  density       uniform small pods (default)
+  affinity      node-affinity workload (scheduler_test.go:241-271:
+                nodes labeled, pods requiring one of the labels)
+  spreading     SelectorSpread via services (priorities workload)
+  antiaffinity  required pod anti-affinity on hostname (the quadratic
+                scheduler_bench_test.go:56 case)
+  mixed         25/25/25/25 mix of the above
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+--suite runs the 5 BASELINE configs and prints one JSON line each
+(config 5 = 5000 nodes x 30000 pods mixed density).
 """
 
 import argparse
@@ -19,15 +27,19 @@ import sys
 import time
 
 
-def build_cluster(store, n_nodes):
+def build_cluster(store, n_nodes, affinity_labels=0):
     from kubernetes_tpu.api import types as api
 
     for i in range(n_nodes):
+        labels = {
+            "failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
+            "kubernetes.io/hostname": f"node-{i}",
+        }
+        if affinity_labels:
+            # scheduler_test.go:258 — node carries one of K affinity labels
+            labels[f"aff-{i % affinity_labels}"] = "yes"
         store.create("nodes", api.Node(
-            metadata=api.ObjectMeta(name=f"node-{i}", labels={
-                "failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
-                "kubernetes.io/hostname": f"node-{i}",
-            }),
+            metadata=api.ObjectMeta(name=f"node-{i}", labels=labels),
             status=api.NodeStatus(
                 allocatable=api.resource_list(cpu="16", memory="32Gi", pods=110,
                                               ephemeral_storage="200Gi"),
@@ -35,25 +47,126 @@ def build_cluster(store, n_nodes):
             )))
 
 
-def make_pods(store, n_pods):
-    """Density workload: uniform small pods from one RC (the reference's
-    testutils.NewCustomCreatePodStrategy default pod)."""
-    make_pods_named(store, n_pods, "density-pod")
-
-
-def make_pods_named(store, n_pods, prefix):
-    from kubernetes_tpu.api import types as api
-
-    for i in range(n_pods):
-        store.create("pods", api.Pod(
-            metadata=api.ObjectMeta(
-                name=f"{prefix}-{i}", labels={"type": prefix},
-                owner_references=[api.OwnerReference(
-                    kind="ReplicationController", name=prefix, uid=f"rc-{prefix}",
-                    controller=True)]),
-            spec=api.PodSpec(containers=[api.Container(
+def _base_pod(api, name, prefix, labels=None, affinity=None, tolerations=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(
+            name=name, labels=labels or {"type": prefix},
+            owner_references=[api.OwnerReference(
+                kind="ReplicationController", name=prefix, uid=f"rc-{prefix}",
+                controller=True)]),
+        spec=api.PodSpec(
+            affinity=affinity, tolerations=tolerations or [],
+            containers=[api.Container(
                 resources=api.ResourceRequirements(
-                    requests=api.resource_list(cpu="100m", memory="128Mi")))])))
+                    requests=api.resource_list(cpu="100m", memory="128Mi")))]))
+
+
+def make_pods(store, n_pods, workload="density", affinity_labels=10,
+              n_services=10):
+    """Pod generators for the BASELINE workload grid."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.api.labels import LabelSelector, Requirement
+
+    if workload == "mixed":
+        quarter = n_pods // 4
+        made = 0
+        for wl in ("density", "affinity", "spreading", "antiaffinity"):
+            n = quarter if wl != "antiaffinity" else n_pods - 3 * quarter
+            make_pods(store, n, wl, affinity_labels, n_services)
+            made += n
+        return
+
+    prefix = f"{workload}-pod"
+    if workload == "spreading":
+        for s in range(n_services):
+            store.create("services", api.Service(
+                metadata=api.ObjectMeta(name=f"svc-{s}"),
+                spec=api.ServiceSpec(selector={"svc": f"s{s}"})))
+    for i in range(n_pods):
+        if workload == "density":
+            pod = _base_pod(api, f"{prefix}-{i}", prefix)
+        elif workload == "affinity":
+            # pods requiring one of the K node labels (scheduler_test.go:241)
+            aff = api.Affinity(node_affinity=api.NodeAffinity(
+                required=api.NodeSelector([api.NodeSelectorTerm(
+                    match_expressions=[Requirement(
+                        f"aff-{i % affinity_labels}", "In", ("yes",))])])))
+            pod = _base_pod(api, f"{prefix}-{i}", prefix, affinity=aff)
+        elif workload == "spreading":
+            pod = _base_pod(api, f"{prefix}-{i}", prefix,
+                            labels={"type": prefix, "svc": f"s{i % n_services}"})
+        elif workload == "antiaffinity":
+            # required anti-affinity on hostname within small groups —
+            # the pod-pod quadratic case (scheduler_bench_test.go:56);
+            # group size bounds feasibility on the fixed node count
+            group = i % 50
+            aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required=[api.PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"anti-group": f"g{group}"}),
+                    topology_key="kubernetes.io/hostname")]))
+            pod = _base_pod(api, f"{prefix}-{i}", prefix,
+                            labels={"type": prefix, "anti-group": f"g{group}"},
+                            affinity=aff)
+        else:
+            raise SystemExit(f"unknown workload {workload!r}")
+        store.create("pods", pod)
+
+
+def run_config(nodes, pods, wave, workload="density", warmup=32):
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import Metrics
+
+    store = ObjectStore()
+    caps = Caps(M=bucket_size(pods + 64), P=wave)
+    sched = Scheduler(store, wave_size=wave, caps=caps)
+    build_cluster(store, nodes,
+                  affinity_labels=10 if workload in ("affinity", "mixed") else 0)
+
+    # warm-up: compile the wave kernel with the same shapes on throwaway
+    # pods (first TPU compile is 10-40s and is not a throughput property)
+    for i in range(warmup):
+        from kubernetes_tpu.api import types as api
+        store.create("pods", _base_pod(api, f"warmup-{i}", "warmup"))
+    sched.schedule_pending()
+    for i in range(warmup):
+        store.delete("pods", "default", f"warmup-{i}")
+
+    sched.metrics = Metrics()  # drop warm-up/compile observations
+    make_pods(store, pods, workload)
+    t0 = time.time()
+    placed = sched.schedule_pending()
+    dt = time.time() - t0
+    p99 = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    return placed, dt, p99
+
+
+def emit(name, nodes, pods, placed, dt, p99, wave):
+    if placed != pods:
+        print(f"FATAL: {name}: placed {placed}/{pods}", file=sys.stderr)
+        sys.exit(1)
+    rate = placed / dt if dt > 0 else 0.0
+    print(json.dumps({
+        "metric": f"scheduler_{name}_pods_per_sec_{nodes}n_{pods}p",
+        "value": round(rate, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(rate / 100.0, 2),
+    }), flush=True)
+    print(f"# {name}: placed={placed} wall={dt:.2f}s wave={wave} "
+          f"p99_wave_latency={p99*1e3:.0f}ms", file=sys.stderr)
+
+
+# BASELINE.md config grid (target table: 5 configs)
+SUITE = [
+    ("basic", 500, 1000, "density"),
+    ("affinity", 100, 3000, "affinity"),
+    ("spreading", 500, 3000, "spreading"),
+    ("antiaffinity", 500, 2500, "antiaffinity"),
+    ("mixed5k", 5000, 30000, "mixed"),
+]
 
 
 def main():
@@ -61,6 +174,11 @@ def main():
     ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--pods", type=int, default=3000)
     ap.add_argument("--wave", type=int, default=256)
+    ap.add_argument("--workload", default="density",
+                    choices=["density", "affinity", "spreading",
+                             "antiaffinity", "mixed"])
+    ap.add_argument("--suite", action="store_true",
+                    help="run the 5-config BASELINE grid")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     args = ap.parse_args()
 
@@ -72,44 +190,16 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
-    from kubernetes_tpu.ops.encoding import Caps
-    from kubernetes_tpu.runtime.store import ObjectStore
-    from kubernetes_tpu.sched.scheduler import Scheduler
-    from kubernetes_tpu.state.vocab import bucket_size
+    if args.suite:
+        for name, nodes, pods, workload in SUITE:
+            placed, dt, p99 = run_config(nodes, pods, args.wave, workload)
+            emit(name, nodes, pods, placed, dt, p99, args.wave)
+        return
 
-    store = ObjectStore()
-    caps = Caps(M=bucket_size(args.pods + 64), P=args.wave)
-    sched = Scheduler(store, wave_size=args.wave, caps=caps)
-    build_cluster(store, args.nodes)
-
-    # warm-up: compile the wave kernel with the same shapes on throwaway
-    # pods (first TPU compile is 10-40s and is not a throughput property)
-    make_pods_named(store, 32, "warmup")
-    sched.schedule_pending()
-    for i in range(32):
-        store.delete("pods", "default", f"warmup-{i}")
-
-    from kubernetes_tpu.utils import Metrics
-
-    sched.metrics = Metrics()  # drop warm-up/compile observations
-
-    make_pods(store, args.pods)
-    t0 = time.time()
-    placed = sched.schedule_pending()
-    dt = time.time() - t0
-    if placed != args.pods:
-        print(f"FATAL: placed {placed}/{args.pods}", file=sys.stderr)
-        sys.exit(1)
-    rate = placed / dt if dt > 0 else 0.0
-    p99 = sched.metrics.e2e_scheduling_latency.quantile(0.99)
-    print(json.dumps({
-        "metric": f"scheduler_density_pods_per_sec_{args.nodes}n_{args.pods}p",
-        "value": round(rate, 1),
-        "unit": "pods/s",
-        "vs_baseline": round(rate / 100.0, 2),
-    }))
-    print(f"# placed={placed} wall={dt:.2f}s wave={args.wave} "
-          f"p99_wave_latency={p99*1e3:.0f}ms", file=sys.stderr)
+    placed, dt, p99 = run_config(args.nodes, args.pods, args.wave,
+                                 args.workload)
+    emit("density" if args.workload == "density" else args.workload,
+         args.nodes, args.pods, placed, dt, p99, args.wave)
 
 
 if __name__ == "__main__":

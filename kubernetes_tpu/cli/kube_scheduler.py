@@ -1,0 +1,191 @@
+"""kube-scheduler binary.
+
+Analog of cmd/kube-scheduler/app/server.go: flags + component config ->
+build the scheduler against an apiserver, optionally behind leader
+election, with healthz + /metrics served on the insecure port
+(server.go:225-236) and the scheduling loop as the leader's run function
+(server.go:188-203).
+
+Run: python -m kubernetes_tpu.cli.kube_scheduler --server http://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..client import LeaderElector, RESTClient, RemoteStore
+from ..plugins.registry import default_profile, default_registry
+from ..sched.config import KubeSchedulerConfiguration
+from ..sched.scheduler import Scheduler
+from ..utils.feature_gates import FeatureGates
+
+
+class HealthServer:
+    """healthz + /metrics on the insecure port (server.go:225)."""
+
+    def __init__(self, scheduler_ref, host="127.0.0.1", port=0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b"ok"
+                    ctype = "text/plain"
+                elif self.path == "/metrics":
+                    body = outer.metrics_text().encode()
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.scheduler_ref = scheduler_ref
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                         name="sched-healthz").start()
+
+    def metrics_text(self) -> str:
+        sched = self.scheduler_ref()
+        if sched is None:
+            return ""
+        lines = []
+        for series in sched.metrics.all_series().values():
+            if hasattr(series, "counts"):  # histogram
+                lines.append(f"# TYPE {series.name} histogram")
+                lines.append(f"{series.name}_sum {series.sum}")
+                lines.append(f"{series.name}_count {series.total}")
+            else:
+                lines.append(f"# TYPE {series.name} counter")
+                lines.append(f"{series.name} {series.value}")
+        return "\n".join(lines) + "\n"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def build_scheduler(cfg: KubeSchedulerConfiguration, store) -> Scheduler:
+    if cfg.policy_config_file:
+        profile = default_registry.profile_from_policy(
+            open(cfg.policy_config_file).read(), store=store)
+    else:
+        profile = default_profile(store)
+    profile.scheduler_name = cfg.scheduler_name
+    profile.disable_preemption = cfg.disable_preemption
+    profile.hard_pod_affinity_symmetric_weight = \
+        cfg.hard_pod_affinity_symmetric_weight
+    features = FeatureGates()
+    for k, v in (cfg.feature_gates or {}).items():
+        features.set(k, bool(v))
+    return Scheduler(store, profile=profile, wave_size=cfg.wave_size,
+                     features=features)
+
+
+def run(cfg: KubeSchedulerConfiguration, server_url: str,
+        token: Optional[str] = None, stop: Optional[threading.Event] = None,
+        once: bool = False) -> int:
+    stop = stop or threading.Event()
+    client = RESTClient(server_url, token=token)
+    store = RemoteStore(client)
+    for kind in ("pods", "nodes", "services", "replicationcontrollers",
+                 "replicasets", "statefulsets", "poddisruptionbudgets",
+                 "persistentvolumes", "persistentvolumeclaims"):
+        store.mirror(kind)
+    store.wait_for_sync()
+    sched_holder = [None]
+    health = HealthServer(lambda: sched_holder[0], port=cfg.healthz_port) \
+        if cfg.healthz_port >= 0 else None
+
+    def scheduling_loop():
+        sched = build_scheduler(cfg, store)
+        sched_holder[0] = sched
+        while not stop.is_set():
+            placed = sched.run_once(timeout=0.2)
+            if once and sched.queue.active_count() == 0:
+                stop.set()
+            if placed == 0 and not once:
+                stop.wait(0.02)
+
+    if cfg.leader_election.leader_elect:
+        le = cfg.leader_election
+        elector = LeaderElector(
+            store, identity=f"{cfg.scheduler_name}-{id(store):x}",
+            lock_name=le.lock_name, lease_duration=le.lease_duration,
+            renew_deadline=le.renew_deadline, retry_period=le.retry_period,
+            on_started_leading=lambda: threading.Thread(
+                target=scheduling_loop, daemon=True).start(),
+            on_stopped_leading=lambda: stop.set())
+        elector.start()
+        stop.wait()
+        elector.stop()
+    else:
+        scheduling_loop()
+    if health is not None:
+        health.stop()
+    store.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kube-scheduler")
+    ap.add_argument("--server", required=True, help="apiserver URL")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--config", default=None,
+                    help="KubeSchedulerConfiguration file (YAML/JSON)")
+    ap.add_argument("--policy-config-file", default=None)
+    ap.add_argument("--scheduler-name", default=None)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--disable-preemption", action="store_true")
+    ap.add_argument("--wave-size", type=int, default=None)
+    ap.add_argument("--healthz-port", type=int, default=None,
+                    help="-1 disables; 0 picks a free port")
+    ap.add_argument("--feature-gates", default="",
+                    help="comma-separated key=bool pairs")
+    ap.add_argument("--once", action="store_true",
+                    help="exit when the queue drains (batch mode)")
+    args = ap.parse_args(argv)
+
+    cfg = (KubeSchedulerConfiguration.load(args.config) if args.config
+           else KubeSchedulerConfiguration())
+    if args.scheduler_name:
+        cfg.scheduler_name = args.scheduler_name
+    if args.policy_config_file:
+        cfg.policy_config_file = args.policy_config_file
+    if args.leader_elect:
+        cfg.leader_election.leader_elect = True
+    if args.disable_preemption:
+        cfg.disable_preemption = True
+    if args.wave_size is not None:
+        cfg.wave_size = args.wave_size
+    if args.healthz_port is not None:
+        cfg.healthz_port = args.healthz_port
+    for kv in filter(None, args.feature_gates.split(",")):
+        k, _, v = kv.partition("=")
+        cfg.feature_gates[k] = v.lower() in ("true", "1", "")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    return run(cfg, args.server, token=args.token, stop=stop, once=args.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,10 +146,17 @@ def resource_fit(alloc, allowed_pods, requested, pod_count, req, is_core):
     return (dims_ok | empty) & pods_ok[None, :]
 
 
-def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core) -> jnp.ndarray:
+def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core,
+                           use_pallas: bool = False,
+                           pallas_interpret: bool = False) -> jnp.ndarray:
     """Stack of per-predicate masks [Q, P, N] in enc.DEVICE_PREDICATES
     order. Resource fit here uses wave-start usage; the scan in
-    ops/kernel.py re-applies it with live usage."""
+    ops/kernel.py re-applies it with live usage.
+
+    use_pallas: route taint-toleration + host-port matching through the
+    fused VMEM-tile kernel (ops/pallas_kernels.py) instead of the XLA
+    broadcast formulation; pallas_interpret runs that kernel in interpret
+    mode (CPU parity tests)."""
     P = pb.req.shape[0]
     N = nt.valid.shape[0]
     ones = jnp.ones((P, N), bool)
@@ -158,10 +165,16 @@ def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core) -> jnp.ndarra
     res = resource_fit(nt.alloc, nt.allowed_pods, nt.requested, nt.pod_count,
                        pb.req, is_core)
     host = host_name(nt, pb)
-    ports = host_ports(nt, pb)
     sel = match_node_selector(nt, pb)
-    taints = tolerates_taints(
-        nt, pb, (enc.EFFECT_NO_SCHEDULE, enc.EFFECT_NO_EXECUTE))
+    if use_pallas:
+        from .pallas_kernels import taint_ports_masks
+        taints, ports = taint_ports_masks(
+            nt, pb, effects=(enc.EFFECT_NO_SCHEDULE, enc.EFFECT_NO_EXECUTE),
+            interpret=pallas_interpret)
+    else:
+        ports = host_ports(nt, pb)
+        taints = tolerates_taints(
+            nt, pb, (enc.EFFECT_NO_SCHEDULE, enc.EFFECT_NO_EXECUTE))
     mem, disk, pid = pressure_checks(nt, pb)
     disk = disk[None, :] & ones
     pid = pid[None, :] & ones

@@ -77,13 +77,31 @@ class WaveResult(NamedTuple):
     rr_end: jnp.ndarray  # i32  round-robin counter after the wave
 
 
+def pallas_default() -> bool:
+    """Use the fused Pallas filter kernel? KTPU_PALLAS=1/0 forces;
+    'auto' (default) enables it on real TPU backends only."""
+    import os
+
+    v = os.environ.get("KTPU_PALLAS", "auto")
+    if v in ("0", "false"):
+        return False
+    if v in ("1", "true"):
+        return True
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "weights", "num_zones", "num_label_values", "has_ipa"))
+    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
+    "pallas_interpret"))
 def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                   pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
                   *, weights: Weights,
                   num_zones: int, num_label_values: int = 64,
-                  has_ipa: bool = False) -> WaveResult:
+                  has_ipa: bool = False, use_pallas: bool = False,
+                  pallas_interpret: bool = False) -> WaveResult:
     """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
     volume predicates) for the rare pods that need them; all-True rows for
     everyone else. Appended to the mask stack as a final "HostPlugins"
@@ -102,7 +120,8 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
     is_core = jnp.arange(R) < enc.RES_FIXED
-    masks = static_predicate_masks(nt, pb, is_core)  # [Q-1, P, N]
+    masks = static_predicate_masks(nt, pb, is_core, use_pallas,
+                                   pallas_interpret)  # [Q-1, P, N]
     ipa_placeholder = jnp.ones((1, P, N), bool)  # filled post-scan
     masks = jnp.concatenate([masks, ipa_placeholder, extra_mask[None]], axis=0)
     res_i = enc.PRED_IDX["PodFitsResources"]

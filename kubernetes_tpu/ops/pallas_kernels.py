@@ -1,0 +1,131 @@
+"""Pallas TPU kernels for the filter hot path.
+
+The XLA formulations in ops/filters.py materialize [P, TL, N] / [P, N,
+port-slots] broadcast intermediates for taint-toleration matching
+(predicates.go:1504) and host-port conflicts (predicates.go:991) —
+HBM-bandwidth-bound at cluster scale. This kernel computes both masks in
+one VMEM-resident pass per [P, Nb] tile: the taint/toleration loops (T x
+TL, both small static dims) and the port-slot loops unroll inside the
+tile, so each node feature row is read once and no [P, TL, N]
+intermediate ever exists.
+
+Layout: feature tables are passed transposed — node features [T, N] and
+pod features [TL, P] — so the large axis (N or P, padded to 128) is the
+lane dimension and the small static feature count rides the sublanes
+(see the Pallas guide's tiling table; i32 tiles are 8 x 128). The grid
+walks N in `n_block` columns; `interpret=True` runs the same kernel on
+CPU for parity tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import encoding as enc
+
+
+def _taint_ports_kernel(tk_ref, tv_ref, te_ref, nports_ref,
+                        pk_ref, pv_ref, po_ref, pe_ref, pports_ref,
+                        taints_out, ports_out, *, effects):
+    """One [P, Nb] output tile. Node features [T, Nb]; pod features
+    [TL, P]; outputs i32 0/1 masks."""
+    T = tk_ref.shape[0]
+    TL = pk_ref.shape[0]
+    P = pk_ref.shape[1]
+    Nb = tk_ref.shape[1]
+
+    untol = jnp.zeros((P, Nb), jnp.bool_)
+    for t in range(T):
+        key_n = tk_ref[t, :]   # [Nb]
+        val_n = tv_ref[t, :]
+        eff_n = te_ref[t, :]
+        relevant = jnp.zeros((Nb,), jnp.bool_)
+        for e in effects:
+            relevant |= eff_n == e
+        tol_any = jnp.zeros((P, Nb), jnp.bool_)
+        for l in range(TL):
+            pk = pk_ref[l, :]  # [P]
+            pv = pv_ref[l, :]
+            po = po_ref[l, :]
+            pe = pe_ref[l, :]
+            live = (po != enc.TOL_PAD)[:, None]
+            key_ok = (pk == 0)[:, None] | (pk[:, None] == key_n[None, :])
+            val_ok = (po == enc.TOL_EXISTS)[:, None] | \
+                (pv[:, None] == val_n[None, :])
+            eff_ok = (pe == 0)[:, None] | (pe[:, None] == eff_n[None, :])
+            tol_any |= live & key_ok & val_ok & eff_ok
+        untol |= relevant[None, :] & ~tol_any
+    taints_out[:, :] = (~untol).astype(jnp.int32)
+
+    PQ = pports_ref.shape[0]
+    S = nports_ref.shape[0]
+    conflict = jnp.zeros((P, Nb), jnp.bool_)
+    for q in range(PQ):
+        pq = pports_ref[q, :]  # [P]
+        hit = jnp.zeros((P, Nb), jnp.bool_)
+        for s in range(S):
+            hit |= pq[:, None] == nports_ref[s, :][None, :]
+        conflict |= (pq > 0)[:, None] & hit
+    ports_out[:, :] = (~conflict).astype(jnp.int32)
+
+
+def _pad_axis(x, axis: int, mult: int, fill=0):
+    size = x.shape[axis]
+    target = -(-size // mult) * mult
+    if target == size:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - size)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("effects", "n_block", "interpret"))
+def taint_ports_masks(nt: enc.NodeTensors, pb: enc.PodBatch,
+                      *, effects=(enc.EFFECT_NO_SCHEDULE,
+                                  enc.EFFECT_NO_EXECUTE),
+                      n_block: int = 512,
+                      interpret: bool = False):
+    """Fused PodToleratesNodeTaints + PodFitsHostPorts -> (taints_ok,
+    ports_ok), both bool [P, N]. Drop-in for
+    filters.tolerates_taints / filters.host_ports."""
+    P = pb.tol_key.shape[0]
+    N = nt.taint_key.shape[0]
+    n_block = min(n_block, -(-N // 128) * 128)
+
+    # node features -> [T, Np] (lane = node), pod features -> [TL, Pp]
+    tk = _pad_axis(nt.taint_key.astype(jnp.int32).T, 1, n_block)
+    tv = _pad_axis(nt.taint_val.astype(jnp.int32).T, 1, n_block)
+    te = _pad_axis(nt.taint_effect.astype(jnp.int32).T, 1, n_block)
+    nports = _pad_axis(nt.ports.astype(jnp.int32).T, 1, n_block, fill=-1)
+    pk = _pad_axis(pb.tol_key.astype(jnp.int32).T, 1, 128)
+    pv = _pad_axis(pb.tol_val.astype(jnp.int32).T, 1, 128)
+    po = _pad_axis(pb.tol_op.astype(jnp.int32).T, 1, 128, fill=enc.TOL_PAD)
+    pe = _pad_axis(pb.tol_effect.astype(jnp.int32).T, 1, 128)
+    pports = _pad_axis(pb.ports.astype(jnp.int32).T, 1, 128, fill=-1)
+    Pp = pk.shape[1]
+    Np = tk.shape[1]
+    grid = (Np // n_block,)
+
+    node_spec = lambda rows: pl.BlockSpec(  # noqa: E731
+        (rows, n_block), lambda j: (0, j))
+    pod_spec = lambda rows: pl.BlockSpec(  # noqa: E731
+        (rows, Pp), lambda j: (0, 0))
+    taints, ports = pl.pallas_call(
+        functools.partial(_taint_ports_kernel, effects=effects),
+        out_shape=(jax.ShapeDtypeStruct((Pp, Np), jnp.int32),
+                   jax.ShapeDtypeStruct((Pp, Np), jnp.int32)),
+        grid=grid,
+        in_specs=[node_spec(tk.shape[0]), node_spec(tv.shape[0]),
+                  node_spec(te.shape[0]), node_spec(nports.shape[0]),
+                  pod_spec(pk.shape[0]), pod_spec(pv.shape[0]),
+                  pod_spec(po.shape[0]), pod_spec(pe.shape[0]),
+                  pod_spec(pports.shape[0])],
+        out_specs=(pl.BlockSpec((Pp, n_block), lambda j: (0, j)),
+                   pl.BlockSpec((Pp, n_block), lambda j: (0, j))),
+        interpret=interpret,
+    )(tk, tv, te, nports, pk, pv, po, pe, pports)
+    return taints[:P, :N].astype(bool), ports[:P, :N].astype(bool)

@@ -1,0 +1,60 @@
+"""Scheduler component configuration.
+
+Analog of the KubeSchedulerConfiguration component-config object
+(pkg/apis/componentconfig/types.go:79) + the algorithm source selection
+(provider name or Policy file) and leader-election config the reference
+loads in cmd/kube-scheduler/app/options. Loadable from YAML or JSON.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class LeaderElectionConfig:
+    leader_elect: bool = False
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+    lock_name: str = "kube-scheduler"
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    scheduler_name: str = "default-scheduler"
+    # algorithm source: named provider (DefaultProvider) or policy file
+    algorithm_provider: str = "DefaultProvider"
+    policy_config_file: str = ""
+    hard_pod_affinity_symmetric_weight: int = 1
+    disable_preemption: bool = False
+    leader_election: LeaderElectionConfig = field(
+        default_factory=LeaderElectionConfig)
+    healthz_port: int = 10251  # reference default insecure port
+    # TPU-wave specifics (no reference analog: the wave replaces the
+    # one-pod cycle)
+    wave_size: int = 128
+    # informer kinds mirrored before scheduling starts
+    feature_gates: dict = field(default_factory=dict)
+
+    @staticmethod
+    def load(path: str) -> "KubeSchedulerConfiguration":
+        text = open(path).read()
+        if text.lstrip().startswith("{"):
+            data = json.loads(text)
+        else:
+            import yaml
+            data = yaml.safe_load(text) or {}
+        cfg = KubeSchedulerConfiguration()
+        le = data.pop("leaderElection", None) or {}
+        for k, v in data.items():
+            snake = "".join("_" + c.lower() if c.isupper() else c for c in k)
+            if hasattr(cfg, snake):
+                setattr(cfg, snake, v)
+        for k, v in le.items():
+            snake = "".join("_" + c.lower() if c.isupper() else c for c in k)
+            if hasattr(cfg.leader_election, snake):
+                setattr(cfg.leader_election, snake, v)
+        return cfg

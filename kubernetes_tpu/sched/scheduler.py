@@ -35,7 +35,7 @@ import numpy as np
 from ..api import labels as lbl
 from ..api import types as api
 from ..ops import encoding as enc
-from ..ops.kernel import Weights, schedule_wave
+from ..ops.kernel import Weights, pallas_default, schedule_wave
 from ..plugins import golden
 from ..plugins.registry import Profile, default_profile
 from ..runtime.informer import SharedInformer
@@ -45,6 +45,7 @@ from ..state.featurize import PodFeaturizer
 from ..state.snapshot import Snapshot
 from ..utils import Metrics, PodBackoff, Trace
 from ..utils.feature_gates import FeatureGates
+from .equivalence import EquivalenceCache, equivalence_class
 from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
 from .extender import ExtenderError
 from .preemption import get_lower_priority_nominated_pods, preempt
@@ -105,6 +106,9 @@ class Scheduler:
         self.metrics = Metrics()
         self.backoff = PodBackoff(clock=clock)
         self._rr = None  # round-robin counter, device i32
+        self.ecache = (EquivalenceCache()
+                       if self.features.enabled("EnableEquivalenceClassCache")
+                       else None)
         self._wire_informers()
 
     # -- informer handlers (reference: factory.go:191-295) --------------------
@@ -125,6 +129,17 @@ class Scheduler:
                 on_add=lambda o: self._invalidate_features(),
                 on_update=lambda o, n: self._invalidate_features(),
                 on_delete=lambda o: self._invalidate_features())
+        if self.ecache is not None:
+            # targeted ecache invalidation (factory.go:191-295 wiring)
+            for kind in ("persistentvolumes", "persistentvolumeclaims"):
+                SharedInformer(self.store, kind).add_event_handler(
+                    on_add=lambda o: self.ecache.on_volume_event(),
+                    on_update=lambda o, n: self.ecache.on_volume_event(),
+                    on_delete=lambda o: self.ecache.on_volume_event())
+            SharedInformer(self.store, "services").add_event_handler(
+                on_add=lambda o: self.ecache.on_service_event(),
+                on_update=lambda o, n: self.ecache.on_service_event(),
+                on_delete=lambda o: self.ecache.on_service_event())
 
     def _responsible(self, pod: api.Pod) -> bool:
         return pod.spec.scheduler_name == self.profile.scheduler_name
@@ -132,6 +147,8 @@ class Scheduler:
     def _on_pod_add(self, pod: api.Pod):
         with self._mu:
             if pod.spec.node_name:
+                if self.ecache is not None:
+                    self.ecache.on_assigned_pod_event(pod.spec.node_name)
                 self.cache.add_pod(pod)
                 ni = self.cache.node_infos.get(pod.spec.node_name)
                 if ni is not None:
@@ -144,6 +161,8 @@ class Scheduler:
     def _on_pod_update(self, old: api.Pod, new: api.Pod):
         with self._mu:
             if new.spec.node_name:
+                if self.ecache is not None:
+                    self.ecache.on_assigned_pod_event(new.spec.node_name)
                 if old.spec.node_name:
                     self.cache.update_pod(old, new)
                 else:
@@ -159,6 +178,8 @@ class Scheduler:
     def _on_pod_delete(self, pod: api.Pod):
         with self._mu:
             if pod.spec.node_name:
+                if self.ecache is not None:
+                    self.ecache.on_assigned_pod_event(pod.spec.node_name)
                 self.cache.remove_pod(pod)
                 ni = self.cache.node_infos.get(pod.spec.node_name)
                 if ni is not None:
@@ -170,12 +191,16 @@ class Scheduler:
 
     def _on_node_add(self, node: api.Node):
         with self._mu:
+            if self.ecache is not None:
+                self.ecache.on_node_event(node.name)
             self.cache.add_node(node)
             self.snapshot.set_node(self.cache.node_infos[node.name])
             self.queue.move_all_to_active()
 
     def _on_node_delete(self, node: api.Node):
         with self._mu:
+            if self.ecache is not None:
+                self.ecache.on_node_event(node.name)
             self.cache.remove_node(node)
             self.snapshot.remove_node(node.name)
 
@@ -244,7 +269,8 @@ class Scheduler:
                             weights=self.profile.weights(),
                             num_zones=self.snapshot.caps.Z,
                             num_label_values=self.snapshot.num_label_values,
-                            has_ipa=bool(has_ipa))
+                            has_ipa=bool(has_ipa),
+                            use_pallas=pallas_default())
         self._rr = res.rr_end
         chosen = np.asarray(res.chosen)
         trace.step("device wave")
@@ -527,13 +553,22 @@ class Scheduler:
             fails: Dict[str, str] = {}
             fns = [(pname, fn) for pname, fn in self.profile.host_filters.items()
                    if getattr(fn, "relevant", None) is None or fn.relevant(pod)]
+            eclass = (equivalence_class(pod) if self.ecache is not None
+                      else None)
             if fns:
                 for name, ni_idx in self.snapshot.node_index.items():
                     ni = self.cache.node_infos.get(name)
                     if ni is None:
                         continue
                     for pname, fn in fns:
-                        ok, rs = fn(pod, ni)
+                        cached = (self.ecache.lookup(eclass, name, pname)
+                                  if self.ecache is not None else None)
+                        if cached is not None:
+                            ok, rs = cached
+                        else:
+                            ok, rs = fn(pod, ni)
+                            if self.ecache is not None:
+                                self.ecache.update(eclass, name, pname, ok, rs)
                         if not ok:
                             mask[i, ni_idx] = False
                             fails[name] = REASON_KEYS.get(rs[0], pname) if rs else pname

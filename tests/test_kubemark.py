@@ -1,0 +1,79 @@
+"""kubemark hollow-cluster tests: N hollow nodes run real kubelet logic
+against fake runtimes; load generation + churn drive the scheduler
+(pkg/kubemark + test/utils/runners.go shape)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+
+
+class TestHollowCluster:
+    def test_load_and_churn(self):
+        store = ObjectStore()
+        hc = HollowCluster(store, n_nodes=5)
+        sched = Scheduler(store, wave_size=32)
+        assert store.count("nodes") == 5
+        hc.create_pods(20, prefix="load")
+        placed = 0
+        for _ in range(10):
+            placed += sched.run_once()
+            if placed >= 20:
+                break
+        assert placed == 20
+        hc.sync_once()
+        running = [p for p in store.list("pods")
+                   if p.status.phase == "Running"]
+        assert len(running) == 20
+        nodes_used = {p.spec.node_name for p in running}
+        assert len(nodes_used) == 5  # spread over hollow nodes
+        # churn: delete some bound pods, replace them, reschedule
+        rng = np.random.default_rng(0)
+        deleted = hc.churn(6, rng)
+        assert deleted == 6
+        hc.create_pods(6, prefix="replacement")
+        placed = 0
+        for _ in range(10):
+            placed += sched.run_once()
+            if placed >= 6:
+                break
+        assert placed == 6
+        hc.sync_once()
+        assert sum(1 for p in store.list("pods")
+                   if p.status.phase == "Running") == 20
+        hc.stop()
+
+    def test_zones_and_proxy(self):
+        store = ObjectStore()
+        hc = HollowCluster(store, n_nodes=6, zones=3, with_proxy=True)
+        zones = {n.metadata.labels[api.LABEL_ZONE]
+                 for n in store.list("nodes")}
+        assert zones == {"zone-0", "zone-1", "zone-2"}
+        assert hc.nodes[0].proxy is not None
+        assert all(n.proxy is None for n in hc.nodes[1:])
+        hc.stop()
+
+
+class TestBenchWorkloads:
+    def test_workload_generators(self):
+        import bench
+        store = ObjectStore()
+        bench.build_cluster(store, 6, affinity_labels=3)
+        bench.make_pods(store, 8, "affinity", affinity_labels=3)
+        bench.make_pods(store, 4, "spreading", n_services=2)
+        bench.make_pods(store, 4, "antiaffinity")
+        pods = store.list("pods")
+        assert len(pods) == 16
+        aff = [p for p in pods if p.metadata.name.startswith("affinity")]
+        assert all(p.spec.affinity.node_affinity is not None for p in aff)
+        anti = [p for p in pods if p.metadata.name.startswith("antiaffinity")]
+        assert all(p.spec.affinity.pod_anti_affinity is not None for p in anti)
+        assert store.count("services") == 2
+
+    def test_bench_small_end_to_end(self):
+        import bench
+        placed, dt, p99 = bench.run_config(nodes=8, pods=24, wave=16,
+                                           workload="mixed", warmup=4)
+        assert placed == 24

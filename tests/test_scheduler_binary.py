@@ -1,0 +1,168 @@
+"""kube-scheduler binary tests: component config loading, policy files,
+healthz/metrics endpoints, batch (--once) scheduling over the HTTP
+apiserver, and leader-elected operation (cmd/kube-scheduler/app shape).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.cli import kube_scheduler as ks
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.config import KubeSchedulerConfiguration
+from kubernetes_tpu.server import APIServer
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(ObjectStore()).start()
+    yield srv
+    srv.stop()
+
+
+def seed(client, n_nodes=3, n_pods=5):
+    for i in range(n_nodes):
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name=f"n{i}",
+                                    labels={api.LABEL_HOSTNAME: f"n{i}"}),
+            status=api.NodeStatus(
+                allocatable=api.resource_list(cpu="8", memory="16Gi",
+                                              pods=110),
+                conditions=[api.NodeCondition(api.NODE_READY,
+                                              api.COND_TRUE)])))
+    for i in range(n_pods):
+        client.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name=f"p{i}", labels={"app": "w"}),
+            spec=api.PodSpec(containers=[api.Container(
+                resources=api.ResourceRequirements(
+                    requests=api.resource_list(cpu="100m",
+                                               memory="64Mi")))])))
+
+
+class TestConfig:
+    def test_load_yaml(self, tmp_path):
+        f = tmp_path / "config.yaml"
+        f.write_text("""
+schedulerName: tpu-sched
+waveSize: 64
+disablePreemption: true
+hardPodAffinitySymmetricWeight: 10
+leaderElection:
+  leaderElect: true
+  leaseDuration: 5.0
+""")
+        cfg = KubeSchedulerConfiguration.load(str(f))
+        assert cfg.scheduler_name == "tpu-sched"
+        assert cfg.wave_size == 64
+        assert cfg.disable_preemption is True
+        assert cfg.hard_pod_affinity_symmetric_weight == 10
+        assert cfg.leader_election.leader_elect is True
+        assert cfg.leader_election.lease_duration == 5.0
+
+    def test_load_json(self, tmp_path):
+        f = tmp_path / "config.json"
+        f.write_text(json.dumps({"schedulerName": "x", "healthzPort": -1}))
+        cfg = KubeSchedulerConfiguration.load(str(f))
+        assert cfg.scheduler_name == "x" and cfg.healthz_port == -1
+
+
+class TestRun:
+    def test_once_schedules_all(self, server):
+        c = RESTClient(server.url)
+        seed(c)
+        rc = ks.main(["--server", server.url, "--once", "--healthz-port", "-1",
+                      "--wave-size", "8"])
+        assert rc == 0
+        pods, _ = c.list("pods")
+        assert all(p.spec.node_name for p in pods)
+        assert len({p.spec.node_name for p in pods}) == 3
+
+    def test_healthz_and_metrics(self, server):
+        c = RESTClient(server.url)
+        seed(c, n_pods=2)
+        cfg = KubeSchedulerConfiguration(healthz_port=0, wave_size=8)
+        stop = threading.Event()
+        holder = {}
+
+        def target():
+            # capture the health port by monkey-level introspection: run()
+            # constructs HealthServer itself, so instead drive components
+            # directly here
+            holder["rc"] = ks.run(cfg, server.url, stop=stop, once=True)
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert holder.get("rc") == 0
+
+    def test_policy_file(self, server, tmp_path):
+        c = RESTClient(server.url)
+        seed(c, n_pods=2)
+        pol = tmp_path / "policy.json"
+        pol.write_text(json.dumps({
+            "kind": "Policy",
+            "predicates": [{"name": "PodFitsResources"},
+                           {"name": "MatchNodeSelector"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 2}],
+        }))
+        rc = ks.main(["--server", server.url, "--once", "--healthz-port", "-1",
+                      "--policy-config-file", str(pol)])
+        assert rc == 0
+        pods, _ = c.list("pods")
+        assert all(p.spec.node_name for p in pods)
+
+    def test_leader_elect_single_winner(self, server):
+        c = RESTClient(server.url)
+        seed(c, n_pods=3)
+        cfg = KubeSchedulerConfiguration(healthz_port=-1, wave_size=8)
+        cfg.leader_election.leader_elect = True
+        cfg.leader_election.lease_duration = 2.0
+        cfg.leader_election.retry_period = 0.1
+        stop = threading.Event()
+        t = threading.Thread(target=ks.run,
+                             args=(cfg, server.url),
+                             kwargs={"stop": stop, "once": True}, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pods, _ = c.list("pods")
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.2)
+        pods, _ = c.list("pods")
+        assert all(p.spec.node_name for p in pods)
+        rec = c.get("leases", None, "kube-scheduler")
+        assert rec.holder_identity  # lease was taken
+        stop.set()
+        t.join(timeout=10)
+
+
+class TestHealthEndpoint:
+    def test_health_server_serves_metrics(self, server):
+        c = RESTClient(server.url)
+        seed(c, n_pods=1)
+        from kubernetes_tpu.client import RemoteStore
+        from kubernetes_tpu.sched.scheduler import Scheduler
+        store = RemoteStore(c)
+        sched = Scheduler(store, wave_size=4)
+        hs = ks.HealthServer(lambda: sched, port=0)
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if sched.run_once() > 0:
+                    break
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{hs.port}/healthz").read()
+            assert body == b"ok"
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{hs.port}/metrics").read().decode()
+            assert "e2e_scheduling_latency_count" in text
+            assert "pods_scheduled" in text or "schedule_attempts_total" in text
+        finally:
+            hs.stop()
+            store.stop()
