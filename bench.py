@@ -141,10 +141,10 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     placed = sched.schedule_pending()
     dt = time.time() - t0
     p99 = sched.metrics.e2e_scheduling_latency.quantile(0.99)
-    return placed, dt, p99
+    return placed, dt, p99, sched.wave_path()
 
 
-def emit(name, nodes, pods, placed, dt, p99, wave):
+def emit(name, nodes, pods, placed, dt, p99, wave, path="?"):
     if placed != pods:
         print(f"FATAL: {name}: placed {placed}/{pods}", file=sys.stderr)
         sys.exit(1)
@@ -156,7 +156,7 @@ def emit(name, nodes, pods, placed, dt, p99, wave):
         "vs_baseline": round(rate / 100.0, 2),
     }), flush=True)
     print(f"# {name}: placed={placed} wall={dt:.2f}s wave={wave} "
-          f"p99_wave_latency={p99*1e3:.0f}ms", file=sys.stderr)
+          f"path={path} p99_wave_latency={p99*1e3:.0f}ms", file=sys.stderr)
 
 
 # BASELINE.md config grid (target table: 5 configs)
@@ -192,14 +192,14 @@ def main():
 
     if args.suite:
         for name, nodes, pods, workload in SUITE:
-            placed, dt, p99 = run_config(nodes, pods, args.wave, workload)
-            emit(name, nodes, pods, placed, dt, p99, args.wave)
+            placed, dt, p99, path = run_config(nodes, pods, args.wave, workload)
+            emit(name, nodes, pods, placed, dt, p99, args.wave, path)
         return
 
-    placed, dt, p99 = run_config(args.nodes, args.pods, args.wave,
-                                 args.workload)
+    placed, dt, p99, path = run_config(args.nodes, args.pods, args.wave,
+                                       args.workload)
     emit("density" if args.workload == "density" else args.workload,
-         args.nodes, args.pods, placed, dt, p99, args.wave)
+         args.nodes, args.pods, placed, dt, p99, args.wave, path)
 
 
 if __name__ == "__main__":

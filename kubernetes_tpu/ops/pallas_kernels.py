@@ -28,6 +28,16 @@ from jax.experimental import pallas as pl
 from . import encoding as enc
 
 
+def _i32(x):
+    """Mosaic only supports minor-dim insertion ([:, None] / reshape that
+    appends a lane axis) on 32-bit vectors — an `i1` comparison result must
+    be widened BEFORE any [:, None], or TPU lowering fails with "Insertion
+    of minor dim that is not a no-op only supported for 32-bit types". All
+    mask algebra in the kernel is therefore done in i32 (0/1) with bitwise
+    &,| — identical to the boolean algebra on these values."""
+    return x.astype(jnp.int32)
+
+
 def _taint_ports_kernel(tk_ref, tv_ref, te_ref, nports_ref,
                         pk_ref, pv_ref, po_ref, pe_ref, pports_ref,
                         taints_out, ports_out, *, effects):
@@ -38,39 +48,41 @@ def _taint_ports_kernel(tk_ref, tv_ref, te_ref, nports_ref,
     P = pk_ref.shape[1]
     Nb = tk_ref.shape[1]
 
-    untol = jnp.zeros((P, Nb), jnp.bool_)
+    untol = jnp.zeros((P, Nb), jnp.int32)
     for t in range(T):
         key_n = tk_ref[t, :]   # [Nb]
         val_n = tv_ref[t, :]
         eff_n = te_ref[t, :]
-        relevant = jnp.zeros((Nb,), jnp.bool_)
+        relevant = jnp.zeros((Nb,), jnp.int32)
         for e in effects:
-            relevant |= eff_n == e
-        tol_any = jnp.zeros((P, Nb), jnp.bool_)
+            relevant |= _i32(eff_n == e)
+        tol_any = jnp.zeros((P, Nb), jnp.int32)
         for l in range(TL):
             pk = pk_ref[l, :]  # [P]
             pv = pv_ref[l, :]
             po = po_ref[l, :]
             pe = pe_ref[l, :]
-            live = (po != enc.TOL_PAD)[:, None]
-            key_ok = (pk == 0)[:, None] | (pk[:, None] == key_n[None, :])
-            val_ok = (po == enc.TOL_EXISTS)[:, None] | \
-                (pv[:, None] == val_n[None, :])
-            eff_ok = (pe == 0)[:, None] | (pe[:, None] == eff_n[None, :])
+            live = _i32(po != enc.TOL_PAD)[:, None]
+            key_ok = _i32(pk == 0)[:, None] | \
+                _i32(pk[:, None] == key_n[None, :])
+            val_ok = _i32(po == enc.TOL_EXISTS)[:, None] | \
+                _i32(pv[:, None] == val_n[None, :])
+            eff_ok = _i32(pe == 0)[:, None] | \
+                _i32(pe[:, None] == eff_n[None, :])
             tol_any |= live & key_ok & val_ok & eff_ok
-        untol |= relevant[None, :] & ~tol_any
-    taints_out[:, :] = (~untol).astype(jnp.int32)
+        untol |= relevant[None, :] & (1 - tol_any)
+    taints_out[:, :] = 1 - untol
 
     PQ = pports_ref.shape[0]
     S = nports_ref.shape[0]
-    conflict = jnp.zeros((P, Nb), jnp.bool_)
+    conflict = jnp.zeros((P, Nb), jnp.int32)
     for q in range(PQ):
         pq = pports_ref[q, :]  # [P]
-        hit = jnp.zeros((P, Nb), jnp.bool_)
+        hit = jnp.zeros((P, Nb), jnp.int32)
         for s in range(S):
-            hit |= pq[:, None] == nports_ref[s, :][None, :]
-        conflict |= (pq > 0)[:, None] & hit
-    ports_out[:, :] = (~conflict).astype(jnp.int32)
+            hit |= _i32(pq[:, None] == nports_ref[s, :][None, :])
+        conflict |= _i32(pq > 0)[:, None] & hit
+    ports_out[:, :] = 1 - conflict
 
 
 def _pad_axis(x, axis: int, mult: int, fill=0):

@@ -106,6 +106,12 @@ class Scheduler:
         self.metrics = Metrics()
         self.backoff = PodBackoff(clock=clock)
         self._rr = None  # round-robin counter, device i32
+        # None = not yet resolved; resolved on first wave to
+        # pallas_default(), then demoted to False permanently if the fused
+        # pallas kernel fails to compile on this backend (a wave must
+        # always produce a result; the pure-XLA formulation is the
+        # fallback path)
+        self._use_pallas: Optional[bool] = None
         self.ecache = (EquivalenceCache()
                        if self.features.enabled("EnableEquivalenceClassCache")
                        else None)
@@ -208,6 +214,14 @@ class Scheduler:
         # group membership may have changed -> equivalence rows are stale
         self.featurizer._cache.clear()
 
+    def wave_path(self) -> str:
+        """Which filter formulation waves are running: 'pallas', 'xla', or
+        'unresolved' before the first wave (resolution happens lazily so a
+        compile failure on the backend can demote pallas->xla)."""
+        if self._use_pallas is None:
+            return "unresolved"
+        return "pallas" if self._use_pallas else "xla"
+
     # -- the wave cycle --------------------------------------------------------
 
     def schedule_pending(self, max_waves: Optional[int] = None) -> int:
@@ -232,6 +246,7 @@ class Scheduler:
             return self._run_wave(pods)
 
     def _run_wave(self, pods: List[api.Pod]) -> int:
+        import jax
         import jax.numpy as jnp
 
         # pods whose required pod-(anti)affinity spans >1 topology key take
@@ -265,12 +280,39 @@ class Scheduler:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
-        res = schedule_wave(nt, pm, tt, pb, extra, self._rr, extra_scores,
-                            weights=self.profile.weights(),
-                            num_zones=self.snapshot.caps.Z,
-                            num_label_values=self.snapshot.num_label_values,
-                            has_ipa=bool(has_ipa),
-                            use_pallas=pallas_default())
+        if self._use_pallas is None:
+            self._use_pallas = pallas_default()
+        kw = dict(weights=self.profile.weights(),
+                  num_zones=self.snapshot.caps.Z,
+                  num_label_values=self.snapshot.num_label_values,
+                  has_ipa=bool(has_ipa))
+        try:
+            res = schedule_wave(nt, pm, tt, pb, extra, self._rr, extra_scores,
+                                use_pallas=self._use_pallas, **kw)
+            # dispatch is async: a kernel that compiles but faults at
+            # execution raises only when results are consumed, so force
+            # materialization here — inside the try — or the fallback
+            # below could never catch it
+            jax.block_until_ready(res)
+        except Exception as e:
+            if not self._use_pallas:
+                raise
+            import sys
+
+            print(f"# wave failed with pallas enabled, retrying on the "
+                  f"pure-XLA path: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            self._use_pallas = False
+            try:
+                res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
+                                    extra_scores, use_pallas=False, **kw)
+                jax.block_until_ready(res)
+            except Exception:
+                # the XLA path failed too: the error was never
+                # pallas-specific (bad shapes, transient device OOM), so
+                # don't permanently demote the fast path on its account
+                self._use_pallas = True
+                raise
         self._rr = res.rr_end
         chosen = np.asarray(res.chosen)
         trace.step("device wave")

@@ -74,6 +74,7 @@ class TestBenchWorkloads:
 
     def test_bench_small_end_to_end(self):
         import bench
-        placed, dt, p99 = bench.run_config(nodes=8, pods=24, wave=16,
-                                           workload="mixed", warmup=4)
+        placed, dt, p99, path = bench.run_config(nodes=8, pods=24, wave=16,
+                                                 workload="mixed", warmup=4)
+        assert path in ("pallas", "xla")
         assert placed == 24
