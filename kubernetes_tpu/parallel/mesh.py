@@ -65,6 +65,12 @@ def _put(x, sharding):
     return jax.device_put(x, sharding)
 
 
+def shard_extra(mesh: Mesh, x):
+    """Commit a [P, N] host matrix (extra_scores) to the (wave, nodes)
+    sharding."""
+    return _put(x, NamedSharding(mesh, P("wave", "nodes")))
+
+
 def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
                  tt: enc.TermTable, pb: enc.PodBatch, extra_mask
                  ) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.TermTable,
@@ -89,5 +95,15 @@ def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
     pm_s = enc.PodMatrix(*[_put(a, repl) for a in pm])
     tt_s = enc.TermTable(*[_put(a, repl) for a in tt])
     pb_s = enc.PodBatch(*[wave0(a) for a in pb])
-    extra_s = _put(extra_mask, NamedSharding(mesh, P("wave", "nodes")))
+    extra_s = shard_extra(mesh, extra_mask)
     return nt_s, pm_s, tt_s, pb_s, extra_s
+
+
+def mesh_divides(mesh: Mesh, n_nodes: int, n_wave: int) -> bool:
+    """device_put rejects a sharded dim not divisible by its axis size, so
+    a wave whose bucketed dims don't line up with the mesh must run
+    unsharded rather than crash. Capacity buckets are powers of two
+    (state/vocab.bucket_size) — with power-of-two mesh axes (the normal
+    TPU slice shape) this is always True once N >= shards."""
+    return (n_nodes % mesh.shape["nodes"] == 0
+            and n_wave % mesh.shape["wave"] == 0)

@@ -85,8 +85,14 @@ class Scheduler:
     def __init__(self, store: ObjectStore, profile: Optional[Profile] = None,
                  wave_size: int = 128, features: Optional[FeatureGates] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 assume_ttl: float = 30.0, caps=None):
+                 assume_ttl: float = 30.0, caps=None, mesh=None):
         self.store = store
+        # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
+        # committed to NamedShardings before each device step and GSPMD
+        # inserts the ICI collectives (parallel/mesh.py). None = single
+        # device. This replaces the reference's fixed 16-goroutine fan-out
+        # (generic_scheduler.go:378) as the scale-out mechanism.
+        self.mesh = mesh
         self.profile = profile or default_profile(store)
         self.wave_size = wave_size
         self.features = features or FeatureGates()
@@ -280,8 +286,21 @@ class Scheduler:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
+        if self.mesh is not None:
+            from ..parallel.mesh import mesh_divides, shard_extra, shard_inputs
+
+            if mesh_divides(self.mesh, nt.valid.shape[0], pb.req.shape[0]):
+                nt, pm, tt, pb, extra = shard_inputs(self.mesh, nt, pm, tt,
+                                                     pb, extra)
+                if extra_scores is not None:
+                    extra_scores = shard_extra(self.mesh, extra_scores)
         if self._use_pallas is None:
             self._use_pallas = pallas_default()
+            if self.mesh is not None and self.mesh.devices.size > 1:
+                # the fused pallas kernel is a single-device program; under
+                # a multi-device mesh the partitionable XLA formulation is
+                # the correct hot path (GSPMD can't shard a pallas_call)
+                self._use_pallas = False
         kw = dict(weights=self.profile.weights(),
                   num_zones=self.snapshot.caps.Z,
                   num_label_values=self.snapshot.num_label_values,
