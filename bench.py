@@ -120,20 +120,49 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     from kubernetes_tpu.state.vocab import bucket_size
     from kubernetes_tpu.utils import Metrics
 
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.api.labels import LabelSelector
+
     store = ObjectStore()
-    caps = Caps(M=bucket_size(pods + 64), P=wave)
+    # pre-size every dim the run will reach: letting M (existing-pod rows)
+    # or E (affinity term-table rows) grow mid-run costs a full
+    # schedule_wave recompile (~8s on TPU) per power-of-two step — at
+    # 2500 anti-affinity pods that's 4 recompiles eating ~90% of the wall
+    # clock and looks like a throughput collapse
+    has_ipa_load = workload in ("antiaffinity", "mixed")
+    # LV: the label-VALUE vocab is dominated by per-node hostname labels,
+    # plus workload label values (anti-affinity groups, services, zones);
+    # crossing an LV bucket changes num_label_values (a static arg of the
+    # wave kernel) and forces a recompile mid-run
+    caps = Caps(M=bucket_size(pods + 64), P=wave,
+                E=bucket_size(pods + 64) if has_ipa_load else 8,
+                LV=bucket_size(nodes + 256, 64))
     sched = Scheduler(store, wave_size=wave, caps=caps)
     build_cluster(store, nodes,
                   affinity_labels=10 if workload in ("affinity", "mixed") else 0)
 
     # warm-up: compile the wave kernel with the same shapes on throwaway
-    # pods (first TPU compile is 10-40s and is not a throughput property)
+    # pods (first TPU compile is 10-40s and is not a throughput property).
+    # Affinity-heavy workloads compile the has_ipa=True kernel variant, so
+    # the warm-up must include anti-affinity pods to warm that variant too.
     for i in range(warmup):
-        from kubernetes_tpu.api import types as api
         store.create("pods", _base_pod(api, f"warmup-{i}", "warmup"))
+    if has_ipa_load:
+        for i in range(min(warmup, 4)):
+            aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required=[api.PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"warm-anti": "w"}),
+                    topology_key="kubernetes.io/hostname")]))
+            store.create("pods", _base_pod(
+                api, f"warmup-anti-{i}", "warmup",
+                labels={"type": "warmup", "warm-anti": "w"}, affinity=aff))
     sched.schedule_pending()
     for i in range(warmup):
         store.delete("pods", "default", f"warmup-{i}")
+    if has_ipa_load:
+        for i in range(min(warmup, 4)):
+            store.delete("pods", "default", f"warmup-anti-{i}")
 
     sched.metrics = Metrics()  # drop warm-up/compile observations
     make_pods(store, pods, workload)
