@@ -6,6 +6,22 @@ Behavioral port of the reference's SchedulingQueue
 on cluster events (MoveAllToActiveQueue, :408), nominated-pod tracking
 for preemption, and a FIFO fallback when pod priority is disabled.
 
+Two refinements over the 1.11 queue, both from its successors (the
+reference's own evolution), because the wave model amplifies the cost of
+getting them wrong:
+
+* **Backoff gating.** A failed pod carries a backoff deadline
+  (util/backoff_utils.go:97-112 computes it; the reference enforced it in
+  the factory error func's delayed requeue). Here the queue itself holds
+  moved pods in a backoff area until the deadline passes — a pod that
+  just failed cannot be re-popped by the very next wave, even when
+  cluster events flush the unschedulable map.
+* **Targeted moves on assigned pods.** `assigned_pod_added` moves ONLY
+  unschedulable pods with a required pod-affinity term matching the
+  newly-bound pod (reference scheduling_queue.go:363
+  getUnschedulablePodsWithMatchingAffinityTerm); binding a pod no longer
+  flushes every unschedulable pod back into the next wave.
+
 One extension for the TPU wave model: `pop_wave(max_n)` drains up to a
 wavefront of pods in one call — the device schedules them in a single
 fused kernel invocation while preserving priority order inside the wave
@@ -18,18 +34,43 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as api
 
 
+def _matches_affinity_term(unsched: api.Pod, assigned: api.Pod) -> bool:
+    """Does `unsched` carry a required pod-affinity term selecting
+    `assigned`? (reference scheduling_queue.go:377 — only such pods can
+    become schedulable when a pod gets bound)."""
+    aff = unsched.spec.affinity
+    if aff is None or aff.pod_affinity is None:
+        return False
+    for term in aff.pod_affinity.required or []:
+        ns = set(term.namespaces) if term.namespaces else {unsched.namespace}
+        if assigned.namespace not in ns:
+            continue
+        if term.label_selector is not None:
+            sel = term.label_selector.to_selector()
+            if sel.matches(assigned.metadata.labels or {}):
+                return True
+    return False
+
+
 class SchedulingQueue:
-    def __init__(self, pod_priority_enabled: bool = True):
+    def __init__(self, pod_priority_enabled: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
         self.pod_priority = pod_priority_enabled
+        self.clock = clock
         self._lock = threading.Condition()
         self._heap: List = []  # (-priority, seq, uid)
         self._items: Dict[str, api.Pod] = {}  # uid -> pod (active)
         self._unschedulable: Dict[str, api.Pod] = {}
+        # pods moved by an event while still inside their backoff window:
+        # eligible for active only once the deadline passes
+        self._backoff: Dict[str, api.Pod] = {}
+        self._backoff_until: Dict[str, float] = {}
         self._seq = itertools.count()
         # uid -> scheduling cycle when it was deemed unschedulable
         self._cycle: Dict[str, int] = {}
@@ -51,6 +92,7 @@ class SchedulingQueue:
             if pod.uid in self._items:
                 return
             self._unschedulable.pop(pod.uid, None)
+            self._backoff.pop(pod.uid, None)
             self._items[pod.uid] = pod
             heapq.heappush(self._heap, self._key(pod))
             if pod.status.nominated_node_name:
@@ -60,34 +102,85 @@ class SchedulingQueue:
 
     def add_if_not_present(self, pod: api.Pod):
         with self._lock:
-            if pod.uid in self._items or pod.uid in self._unschedulable:
+            if (pod.uid in self._items or pod.uid in self._unschedulable
+                    or pod.uid in self._backoff):
                 return
         self.add(pod)
+
+    def set_backoff(self, uid: str, until: float):
+        """Record a backoff deadline; the pod stays ineligible for the
+        active heap until then (enforced at move/flush time)."""
+        with self._lock:
+            self._backoff_until[uid] = until
+
+    def clear_backoff(self, uid: str):
+        with self._lock:
+            self._backoff_until.pop(uid, None)
+            pod = self._backoff.pop(uid, None)
+        if pod is not None:
+            self.add(pod)
 
     def add_unschedulable_if_not_present(self, pod: api.Pod):
         """Reference :286 — goes back to active if a move request arrived
         since this pod's scheduling cycle began (an event may have made it
-        schedulable again)."""
+        schedulable again); the backoff gate still applies."""
         with self._lock:
-            if pod.uid in self._items or pod.uid in self._unschedulable:
+            if (pod.uid in self._items or pod.uid in self._unschedulable
+                    or pod.uid in self._backoff):
                 return
             cycle = self._cycle.pop(pod.uid, self._current_cycle)
             if self._move_request_cycle >= cycle:
-                self._items[pod.uid] = pod
-                heapq.heappush(self._heap, self._key(pod))
-                self._lock.notify()
+                self._to_active_or_backoff_locked(pod)
             else:
                 self._unschedulable[pod.uid] = pod
             if pod.status.nominated_node_name:
                 self._nominated.setdefault(
                     pod.status.nominated_node_name, {})[pod.uid] = pod
 
+    def _to_active_or_backoff_locked(self, pod: api.Pod):
+        until = self._backoff_until.get(pod.uid, 0.0)
+        if until > self.clock():
+            self._backoff[pod.uid] = pod
+        else:
+            self._items[pod.uid] = pod
+            heapq.heappush(self._heap, self._key(pod))
+            self._lock.notify()
+
+    def _flush_backoff_locked(self):
+        now = self.clock()
+        expired = [uid for uid in self._backoff
+                   if self._backoff_until.get(uid, 0.0) <= now]
+        for uid in expired:
+            pod = self._backoff.pop(uid)
+            self._items[uid] = pod
+            heapq.heappush(self._heap, self._key(pod))
+        if expired:
+            self._lock.notify_all()
+
     def pop(self, timeout: Optional[float] = None) -> Optional[api.Pod]:
-        """Blocking pop of the highest-priority pod (reference :311)."""
+        """Blocking pop of the highest-priority pod (reference :311).
+        The condvar wait is bounded by the earliest backoff deadline so a
+        pod becoming eligible wakes a blocked popper — nothing notifies
+        when a deadline merely passes."""
+        deadline = None if timeout is None else self.clock() + timeout
         with self._lock:
-            while not self._heap and not self._closed:
-                if not self._lock.wait(timeout):
-                    return None
+            while True:
+                self._flush_backoff_locked()
+                if self._heap or self._closed:
+                    break
+                wait = None
+                if deadline is not None:
+                    wait = deadline - self.clock()
+                    if wait <= 0:
+                        return None
+                if self._backoff:
+                    nxt = min(self._backoff_until.get(u, 0.0)
+                              for u in self._backoff)
+                    until_next = nxt - self.clock()
+                    if until_next <= 0:
+                        continue  # expired while computing: reflush
+                    wait = until_next if wait is None else min(wait, until_next)
+                self._lock.wait(wait)
             if self._closed and not self._heap:
                 return None
             return self._pop_locked()
@@ -121,19 +214,27 @@ class SchedulingQueue:
 
     def move_all_to_active(self):
         """Reference :408 MoveAllToActiveQueue — cluster events (node add,
-        pod delete, ...) flush the unschedulable map."""
+        pod delete, ...) flush the unschedulable map. Pods still inside
+        their backoff window go to the backoff area instead."""
         with self._lock:
-            for uid, pod in self._unschedulable.items():
-                self._items[uid] = pod
-                heapq.heappush(self._heap, self._key(pod))
+            for pod in self._unschedulable.values():
+                self._to_active_or_backoff_locked(pod)
             self._unschedulable.clear()
             self._move_request_cycle = self._current_cycle
             self._lock.notify_all()
 
     def assigned_pod_added(self, pod: api.Pod):
-        """Reference :363 — an assigned pod can unblock pods with affinity;
-        conservatively moves everything (targeted matching in later rounds)."""
-        self.move_all_to_active()
+        """Reference :363 — a bound pod moves only the unschedulable pods
+        whose required pod-affinity terms select it; everything else stays
+        parked (no thundering-herd flush on every bind)."""
+        with self._lock:
+            matching = [u for u, p in self._unschedulable.items()
+                        if _matches_affinity_term(p, pod)]
+            for uid in matching:
+                self._to_active_or_backoff_locked(self._unschedulable.pop(uid))
+            if matching:
+                self._move_request_cycle = self._current_cycle
+                self._lock.notify_all()
 
     # -- update / delete ------------------------------------------------------
 
@@ -155,6 +256,9 @@ class SchedulingQueue:
             if new.uid in self._items:
                 self._items[new.uid] = new
                 return
+            if new.uid in self._backoff:
+                self._backoff[new.uid] = new
+                return
             if new.uid in self._unschedulable:
                 if old is not None and not self._is_pod_updated(old, new):
                     self._unschedulable[new.uid] = new  # status-only change
@@ -170,6 +274,8 @@ class SchedulingQueue:
         with self._lock:
             self._items.pop(pod.uid, None)
             self._unschedulable.pop(pod.uid, None)
+            self._backoff.pop(pod.uid, None)
+            self._backoff_until.pop(pod.uid, None)
             nom = self._nominated.get(pod.status.nominated_node_name)
             if nom:
                 nom.pop(pod.uid, None)
@@ -191,11 +297,17 @@ class SchedulingQueue:
 
     def pending_count(self) -> int:
         with self._lock:
-            return len(self._items) + len(self._unschedulable)
+            return (len(self._items) + len(self._unschedulable)
+                    + len(self._backoff))
 
     def active_count(self) -> int:
         with self._lock:
+            self._flush_backoff_locked()
             return len(self._items)
+
+    def backoff_count(self) -> int:
+        with self._lock:
+            return len(self._backoff)
 
     def close(self):
         with self._lock:

@@ -108,7 +108,8 @@ class Scheduler:
         self.snapshot = Snapshot(caps=caps)
         self.featurizer = PodFeaturizer(self.snapshot, GroupLister(store))
         self.queue = SchedulingQueue(
-            pod_priority_enabled=self.features.enabled("PodPriority"))
+            pod_priority_enabled=self.features.enabled("PodPriority"),
+            clock=clock)
         self.metrics = Metrics()
         self.backoff = PodBackoff(clock=clock)
         self._rr = None  # round-robin counter, device i32
@@ -277,8 +278,7 @@ class Scheduler:
             # flush, don't crash the loop (reference: scheduleOne records
             # the error and MakeDefaultErrorFunc requeues with backoff)
             for p in pods:
-                self.backoff.get_backoff(p.uid)
-                self.queue.add_unschedulable_if_not_present(p)
+                self._park_with_backoff(p)
             return placed_host
         trace.step("featurized")
         nt, pm, tt = self.snapshot.to_device()
@@ -395,8 +395,7 @@ class Scheduler:
                         reasons[r] = reasons.get(r, 0) + 1
                         failed[n] = ["ExtenderFilter"]
         except ExtenderError:
-            self.backoff.get_backoff(pod.uid)
-            self.queue.add_unschedulable_if_not_present(pod)
+            self._park_with_backoff(pod)
             return 0
         if not feasible:
             self.metrics.pods_failed.inc()
@@ -412,8 +411,7 @@ class Scheduler:
                              extra_fit=self._host_extra_fit)
                 if pr is not None:
                     self._perform_preemption(pod, pr)
-            self.backoff.get_backoff(pod.uid)
-            self.queue.add_unschedulable_if_not_present(pod)
+            self._park_with_backoff(pod)
             self.store.set_pod_condition(pod, ("PodScheduled", "False:" + err.message()))
             return 0
         # score: golden interpod priority + least-requested tie-breaking
@@ -430,8 +428,7 @@ class Scheduler:
                 for node, s in ext.prioritize(pod, feasible).items():
                     host_scores[node] = host_scores.get(node, 0.0) + s
         except ExtenderError:
-            self.backoff.get_backoff(pod.uid)
-            self.queue.add_unschedulable_if_not_present(pod)
+            self._park_with_backoff(pod)
             return 0
         best_name, best_score = None, None
         for name in feasible:
@@ -478,6 +475,7 @@ class Scheduler:
         self.metrics.binding_latency.observe(self.clock() - t0)
         self.metrics.pods_scheduled.inc()
         self.backoff.clear(pod.uid)
+        self.queue.clear_backoff(pod.uid)
         self.queue.update_nominated_pod(pod, "")
         return True
 
@@ -569,9 +567,18 @@ class Scheduler:
             self.metrics.preemption_evaluation.observe(self.clock() - t0)
             if pr is not None:
                 self._perform_preemption(pod, pr)
-        self.backoff.get_backoff(pod.uid)
-        self.queue.add_unschedulable_if_not_present(pod)
+        self._park_with_backoff(pod)
         self.store.set_pod_condition(pod, ("PodScheduled", "False:" + err.message()))
+
+    def _park_with_backoff(self, pod: api.Pod):
+        """Failure-path requeue: compute the pod's next backoff duration
+        and park it unschedulable; the queue keeps it ineligible for the
+        active heap until the deadline even if cluster events move it
+        (reference: util/backoff_utils.go:97-112, enforced by the factory
+        error func's delayed requeue)."""
+        d = self.backoff.get_backoff(pod.uid)
+        self.queue.set_backoff(pod.uid, self.clock() + d)
+        self.queue.add_unschedulable_if_not_present(pod)
 
     def _pdbs(self) -> List[api.PodDisruptionBudget]:
         return list(self.store.list("poddisruptionbudgets"))

@@ -35,7 +35,6 @@ class PodBackoff:
             if e is None:
                 e = _Entry(self.initial, now)
                 self._entries[pod_id] = e
-                return e.duration
             d = e.duration
             e.duration = min(e.duration * 2, self.maximum)
             e.last_update = now

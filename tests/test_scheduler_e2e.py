@@ -10,9 +10,21 @@ from kubernetes_tpu.sched.scheduler import Scheduler
 from helpers import make_node, make_pod
 
 
-def make_world(n_nodes=4, **node_kw):
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, d):
+        self.t += d
+
+
+def make_world(n_nodes=4, clock=None, **node_kw):
     store = ObjectStore()
-    sched = Scheduler(store, wave_size=16)
+    sched = (Scheduler(store, wave_size=16) if clock is None
+             else Scheduler(store, wave_size=16, clock=clock))
     for i in range(n_nodes):
         store.create("nodes", make_node(f"n{i}", **node_kw))
     return store, sched
@@ -34,7 +46,8 @@ def test_end_to_end_bind():
 
 
 def test_unschedulable_goes_to_backoff_queue():
-    store, sched = make_world(2, cpu="1")
+    clock = FakeClock()
+    store, sched = make_world(2, cpu="1", clock=clock)
     store.create("pods", make_pod("big", cpu="4"))
     placed = sched.schedule_pending()
     assert placed == 0
@@ -42,11 +55,93 @@ def test_unschedulable_goes_to_backoff_queue():
     assert sched.queue.active_count() == 0  # parked unschedulable
     pod = store.get("pods", "default", "big")
     assert pod.spec.node_name == ""
-    # a new node event flushes the unschedulable queue
+    # a new node event moves the pod, but it's still inside its backoff
+    # window (reference backoff_utils.go:97: 1s initial) — held, not active
     store.create("nodes", make_node("bignode", cpu="8"))
+    assert sched.queue.active_count() == 0
+    assert sched.queue.backoff_count() == 1
+    assert sched.schedule_pending() == 0  # not retried inside the window
+    # deadline passes -> eligible again
+    clock.advance(1.1)
     assert sched.queue.active_count() == 1
     assert sched.schedule_pending() == 1
     assert store.get("pods", "default", "big").spec.node_name == "bignode"
+
+
+def test_backoff_window_doubles_per_failure():
+    """Second failure waits 2s, not 1s (backoff_utils.go doubling)."""
+    clock = FakeClock()
+    store, sched = make_world(1, cpu="1", clock=clock)
+    store.create("pods", make_pod("big", cpu="4"))
+    assert sched.schedule_pending() == 0          # failure #1 -> 1s window
+    store.create("nodes", make_node("s1", cpu="1"))
+    clock.advance(1.1)
+    assert sched.schedule_pending() == 0          # failure #2 -> 2s window
+    store.create("nodes", make_node("s2", cpu="1"))
+    clock.advance(1.1)                            # only 1.1s into 2s window
+    assert sched.queue.active_count() == 0
+    assert sched.queue.backoff_count() == 1
+    clock.advance(1.0)                            # 2.1s > 2s: eligible
+    assert sched.queue.active_count() == 1
+
+
+def test_blocking_pop_wakes_on_backoff_expiry():
+    """A popper blocked on an empty active heap must wake when a backoff
+    deadline passes — nothing notifies the condvar at that moment, so the
+    wait has to be bounded by the earliest deadline."""
+    import threading
+    import time as _time
+
+    from kubernetes_tpu.sched.queue import SchedulingQueue
+
+    q = SchedulingQueue()
+    pod = make_pod("p")
+    q.set_backoff(pod.uid, _time.monotonic() + 0.3)
+    q.add_unschedulable_if_not_present(pod)
+    q.move_all_to_active()
+    assert q.backoff_count() == 1
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.pop(timeout=10)))
+    t.start()
+    t.join(3)
+    assert not t.is_alive() and got and got[0] is not None
+
+
+def test_bind_moves_only_affinity_matching_pods():
+    """Reference scheduling_queue.go:363 — binding a pod must not flush
+    unrelated unschedulable pods; only pods whose required pod-affinity
+    terms select the bound pod become eligible again."""
+    from kubernetes_tpu.api import labels as lbl
+
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=16, clock=clock)
+    for i in range(2):
+        store.create("nodes", make_node(
+            f"n{i}", cpu="2", labels={"kubernetes.io/hostname": f"n{i}"}))
+    aff = api.Affinity(pod_affinity=api.PodAffinity(
+        required=[api.PodAffinityTerm(
+            label_selector=lbl.LabelSelector(match_labels={"app": "web"}),
+            topology_key="kubernetes.io/hostname")]))
+    store.create("pods", make_pod("wants-web", cpu="100m", affinity=aff))
+    store.create("pods", make_pod("huge", cpu="4"))
+    assert sched.schedule_pending() == 0
+    assert sched.queue.pending_count() == 2
+    # binding an unrelated pod moves nothing
+    store.create("pods", make_pod("plain", cpu="100m"))
+    assert sched.schedule_pending() == 1
+    assert sched.queue.backoff_count() == 0
+    assert sched.queue.active_count() == 0
+    # binding a matching pod moves only wants-web (into its backoff window)
+    store.create("pods", make_pod("web", cpu="100m", labels={"app": "web"}))
+    assert sched.schedule_pending() == 1
+    assert sched.queue.backoff_count() == 1
+    clock.advance(1.1)
+    assert sched.schedule_pending() == 1
+    bound = store.get("pods", "default", "wants-web")
+    assert bound.spec.node_name == store.get(
+        "pods", "default", "web").spec.node_name
+    assert store.get("pods", "default", "huge").spec.node_name == ""
 
 
 def test_wave_sees_own_commitments():
@@ -63,13 +158,15 @@ def test_wave_sees_own_commitments():
 
 
 def test_pod_deletion_frees_capacity():
-    store, sched = make_world(1, cpu="2")
+    clock = FakeClock()
+    store, sched = make_world(1, cpu="2", clock=clock)
     store.create("pods", make_pod("a", cpu="2"))
     assert sched.schedule_pending() == 1
     store.create("pods", make_pod("b", cpu="2"))
     assert sched.schedule_pending() == 0
     store.delete("pods", "default", "a")
-    # deletion event moves unschedulable pods back to active
+    # deletion event moves b, the backoff window gates its re-pop
+    clock.advance(1.1)
     assert sched.queue.active_count() == 1
     assert sched.schedule_pending() == 1
     assert store.get("pods", "default", "b").spec.node_name == "n0"
