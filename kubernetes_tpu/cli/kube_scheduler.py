@@ -123,6 +123,7 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
                 stop.set()
             if placed == 0 and not once:
                 stop.wait(0.02)
+        sched.close()  # settle in-flight binds + release binder threads
 
     if cfg.leader_election.leader_elect:
         le = cfg.leader_election

@@ -109,6 +109,11 @@ class RemoteStore:
     from the reflector threads. mirror(kind) must be called (or implied by
     watch()) before reads of that kind."""
 
+    # binds are real HTTP posts and watch events arrive on reflector
+    # threads with no store lock held during handler dispatch — safe (and
+    # worthwhile) to post binds from the scheduler's worker pool
+    async_bind_safe = True
+
     def __init__(self, client: RESTClient):
         self.client = client
         self._lock = threading.RLock()

@@ -99,12 +99,20 @@ def _take_string(lib, ptr) -> Optional[str]:
 class NativeObjectStore:
     """ObjectStore-compatible facade over the native engine."""
 
+    # _drain dispatches watch events after releasing self._lock, so
+    # scheduler binder threads can post binds without lock-order inversion
+    async_bind_safe = True
+
     def __init__(self, ring_capacity: int = 65536):
         self._lib = load_library()
         self._handle = ctypes.c_void_p(self._lib.kv_new(ring_capacity))
         self._lock = threading.RLock()
         self._watchers: List[Tuple[Optional[str], Callable[[Event], None]]] = []
         self._dispatched_rev = 0
+        # serializes claim+dispatch so two threads can never deliver
+        # engine revisions out of order (a DELETE overtaken by an older
+        # MODIFIED would resurrect the object in informer caches)
+        self._dispatch_mu = threading.Lock()
 
     def __del__(self):
         try:
@@ -140,7 +148,27 @@ class NativeObjectStore:
 
     def _drain(self):
         """Dispatch all engine events newer than what we've delivered.
-        Called after every local mutation -> synchronous delivery."""
+        Called after every local mutation. At most one thread dispatches
+        at a time (revision order would otherwise be lost between
+        threads); entry is non-blocking — if another thread is already
+        dispatching, it is responsible for this mutation's event too (it
+        re-claims after finishing), and waiting for it here could
+        deadlock a caller that holds a lock the handlers need."""
+        while True:
+            if not self._dispatch_mu.acquire(blocking=False):
+                return
+            try:
+                delivered = self._drain_once()
+            finally:
+                self._dispatch_mu.release()
+            if not delivered:
+                return
+
+    def _drain_once(self) -> bool:
+        """Claim and dispatch all currently-available engine events, in
+        revision order; True if anything was delivered. Caller holds
+        _dispatch_mu."""
+        any_delivered = False
         while True:
             with self._lock:
                 since = self._dispatched_rev
@@ -153,9 +181,9 @@ class NativeObjectStore:
                 if err.value == KV_COMPACTED:
                     # local dispatcher fell behind the ring; jump forward
                     self._dispatched_rev = self._lib.kv_rev(self._handle)
-                    return
+                    return any_delivered
                 if not raw:
-                    return
+                    return any_delivered
                 self._dispatched_rev = nxt.value
                 watchers = list(self._watchers)
             delivered = False
@@ -169,11 +197,12 @@ class NativeObjectStore:
                     ADDED if ev["create"] else MODIFIED)
                 event = Event(etype, kind, obj, resource_version=ev["rev"])
                 delivered = True
+                any_delivered = True
                 for wkind, fn in watchers:
                     if wkind is None or wkind == kind:
                         fn(event)
             if not delivered:
-                return
+                return any_delivered
 
     # -- ObjectStore interface -------------------------------------------------
 

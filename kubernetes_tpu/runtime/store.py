@@ -47,6 +47,13 @@ class Conflict(Exception):
 
 
 class ObjectStore:
+    # Watch events are dispatched synchronously UNDER self._lock (the
+    # determinism contract informers and tests rely on); callers must not
+    # post mutations from worker threads while another thread holds a lock
+    # the handlers need — the scheduler keys its async-bind decision off
+    # this flag.
+    async_bind_safe = False
+
     def __init__(self):
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, object]] = {}

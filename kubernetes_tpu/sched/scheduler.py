@@ -85,7 +85,8 @@ class Scheduler:
     def __init__(self, store: ObjectStore, profile: Optional[Profile] = None,
                  wave_size: int = 128, features: Optional[FeatureGates] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 assume_ttl: float = 30.0, caps=None, mesh=None):
+                 assume_ttl: float = 30.0, caps=None, mesh=None,
+                 bind_workers: int = 4):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -122,6 +123,26 @@ class Scheduler:
         self.ecache = (EquivalenceCache()
                        if self.features.enabled("EnableEquivalenceClassCache")
                        else None)
+        # Async bind pipeline (reference scheduler.go:491 `go sched.bind`):
+        # assume reserves capacity under _mu, the bind POST runs from this
+        # pool OUTSIDE _mu so wave N+1's featurize/device step overlaps
+        # wave N's binding. Only enabled for stores that dispatch watch
+        # events outside their own lock (RemoteStore via reflector
+        # threads, NativeObjectStore) — the in-process ObjectStore
+        # delivers events synchronously UNDER its lock by contract, so a
+        # binder thread dispatching there while the wave thread (holding
+        # _mu) touches the store would deadlock on lock-order inversion;
+        # it also has no I/O latency worth hiding. bind_workers=0 forces
+        # inline binds everywhere.
+        self._bind_pool = None
+        if bind_workers > 0 and getattr(store, "async_bind_safe", False):
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._bind_pool = ThreadPoolExecutor(
+                max_workers=bind_workers, thread_name_prefix="binder")
+        self._inflight_mu = threading.Lock()
+        self._inflight: set = set()
+        self.bind_overlap_hwm = 0  # high-water mark of concurrent binds
         self._wire_informers()
 
     # -- informer handlers (reference: factory.go:191-295) --------------------
@@ -232,18 +253,28 @@ class Scheduler:
     # -- the wave cycle --------------------------------------------------------
 
     def schedule_pending(self, max_waves: Optional[int] = None) -> int:
-        """Run waves until the active queue drains. Returns pods placed."""
+        """Run waves until the active queue drains, then drain in-flight
+        binds so the store state is settled on return. Returns pods
+        placed (assumed + bind dispatched)."""
         placed = 0
         waves = 0
-        while self.queue.active_count() > 0:
+        while True:
+            if self.queue.active_count() == 0:
+                # a failed async bind may requeue a pod: settle and recheck
+                self.wait_for_binds()
+                if self.queue.active_count() == 0:
+                    break
             placed += self.run_once()
             waves += 1
             if max_waves is not None and waves >= max_waves:
                 break
+        self.wait_for_binds()
         return placed
 
     def run_once(self, timeout: float = 0.0) -> int:
-        """Schedule one wave. Returns the number of pods bound."""
+        """Schedule one wave. Returns the number of pods assumed with a
+        bind dispatched (a failed async bind requeues its pod, which then
+        counts again on the successful retry)."""
         with self._mu:
             self.cache.cleanup_expired()
         pods = self.queue.pop_wave(self.wave_size, timeout=timeout)
@@ -447,8 +478,10 @@ class Scheduler:
     # -- commit path -----------------------------------------------------------
 
     def _commit(self, pod: api.Pod, node_name: str) -> bool:
-        """Exact int64 re-verification then assume + bind (reference:
-        scheduler.go:486 assume -> :491 bind)."""
+        """Exact int64 re-verification then assume; the bind posts from
+        the worker pool outside _mu (reference: scheduler.go:486 assume ->
+        :491 `go sched.bind`). True means the pod is assumed and its bind
+        dispatched — a failed bind forgets the assume and requeues."""
         ni = self.cache.node_infos.get(node_name)
         if ni is None or not ni.fits_exactly(pod):
             return False
@@ -456,28 +489,91 @@ class Scheduler:
         self.cache.assume_pod(bound)
         self.snapshot.refresh_node_resources(self.cache.node_infos[node_name])
         self.snapshot.add_pod(bound)
+        if self._bind_pool is None:
+            return self._bind_and_finish(pod, bound, node_name)
+        fut = self._bind_pool.submit(self._bind_and_finish, pod, bound,
+                                     node_name)
+        with self._inflight_mu:
+            self._inflight.add(fut)
+            self.bind_overlap_hwm = max(self.bind_overlap_hwm,
+                                        len(self._inflight))
+        fut.add_done_callback(self._bind_done)
+        return True
+
+    def _bind_done(self, fut):
+        with self._inflight_mu:
+            self._inflight.discard(fut)
+        exc = fut.exception()
+        if exc is not None:
+            # nothing awaits these futures for a value; without this an
+            # exception escaping _bind_and_finish would vanish silently
+            import sys
+            import traceback
+
+            print("# bind worker raised:", file=sys.stderr)
+            traceback.print_exception(type(exc), exc, exc.__traceback__,
+                                      file=sys.stderr)
+
+    def _bind_and_finish(self, pod: api.Pod, bound: api.Pod,
+                         node_name: str) -> bool:
+        """The bind POST + cache confirmation; runs outside _mu. Failure
+        rolls the assume back and requeues (forget-on-failure,
+        scheduler.go:409-432)."""
         t0 = self.clock()
         try:
             # reference scheduler.go:409 GetBinder: an extender with a bind
             # verb performs the binding; the in-process store is then updated
             # so informers observe the placement either way
-            binder = next((e for e in self.profile.extenders if e.bind_verb), None)
+            binder = next((e for e in self.profile.extenders if e.bind_verb),
+                          None)
             if binder is not None:
                 binder.bind(pod, node_name)
             self.store.bind(pod, node_name)
-            self.cache.finish_binding(bound)
         except Exception:
-            self.cache.forget_pod(bound)
-            self.snapshot.refresh_node_resources(self.cache.node_infos[node_name])
-            self.snapshot.remove_pod(bound)
+            # the rollback itself must not raise into the pool: if the
+            # bind actually landed server-side (response lost) the watch
+            # confirmation may already have consumed the assume, making
+            # forget_pod a KeyError — in that case the pod IS bound and
+            # no rollback is wanted
+            with self._mu:
+                try:
+                    self.cache.forget_pod(bound)
+                except KeyError:
+                    return True  # confirmed by informer: bind succeeded
+                ni = self.cache.node_infos.get(node_name)
+                if ni is not None:
+                    self.snapshot.refresh_node_resources(ni)
+                self.snapshot.remove_pod(bound)
             self.queue.add_if_not_present(pod)
             return False
+        with self._mu:
+            self.cache.finish_binding(bound)
         self.metrics.binding_latency.observe(self.clock() - t0)
         self.metrics.pods_scheduled.inc()
         self.backoff.clear(pod.uid)
         self.queue.clear_backoff(pod.uid)
         self.queue.update_nominated_pod(pod, "")
         return True
+
+    def wait_for_binds(self) -> None:
+        """Drain all in-flight binds (callers that need settled store
+        state: end of schedule_pending, tests, shutdown)."""
+        import concurrent.futures
+
+        while True:
+            with self._inflight_mu:
+                pending = list(self._inflight)
+            if not pending:
+                return
+            concurrent.futures.wait(pending)
+
+    def close(self) -> None:
+        """Settle in-flight binds and release the binder pool's threads.
+        The scheduler object stays queryable but schedules no more."""
+        self.wait_for_binds()
+        if self._bind_pool is not None:
+            self._bind_pool.shutdown(wait=True)
+            self._bind_pool = None
 
     # -- failure path ----------------------------------------------------------
 

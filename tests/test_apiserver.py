@@ -359,9 +359,48 @@ class TestSchedulerOverHTTP:
         placed = 0
         while placed < 8 and time.monotonic() < deadline:
             placed += sched.run_once()
+        sched.wait_for_binds()
         assert placed == 8
         bound, _ = client.list("pods")
         nodes_used = {p.spec.node_name for p in bound}
         assert all(p.spec.node_name for p in bound)
         assert len(nodes_used) == 4  # spread over all nodes
+        store.stop()
+
+    def test_async_bind_overlaps_waves(self, server, client):
+        """The bind pipeline (reference scheduler.go:491 `go sched.bind`):
+        with a slow bind POST, wall time must stay well under the serial
+        sum and the in-flight high-water mark must exceed 1 — binds of
+        wave N overlap each other and wave N+1."""
+        from kubernetes_tpu.sched.scheduler import Scheduler
+        for i in range(4):
+            client.create("nodes", mknode(f"n{i}"))
+        store = RemoteStore(client)
+        for k in ("pods", "nodes", "services", "replicationcontrollers",
+                  "replicasets", "statefulsets", "poddisruptionbudgets"):
+            store.mirror(k)
+        store.wait_for_sync()
+        sched = Scheduler(store, wave_size=4)
+        assert sched._bind_pool is not None  # REST store -> async binds
+        orig_bind = store.bind
+
+        def slow_bind(pod, node):
+            time.sleep(0.05)
+            return orig_bind(pod, node)
+
+        store.bind = slow_bind
+        for i in range(16):
+            client.create("pods", mkpod(f"p{i}"))
+        deadline = time.monotonic() + 30
+        t0 = time.monotonic()
+        placed = 0
+        while placed < 16 and time.monotonic() < deadline:
+            placed += sched.run_once(timeout=0.2)
+        sched.wait_for_binds()
+        wall = time.monotonic() - t0
+        assert placed == 16
+        bound, _ = client.list("pods")
+        assert sum(1 for p in bound if p.spec.node_name) == 16
+        assert sched.bind_overlap_hwm > 1
+        assert wall < 16 * 0.05 + 0.5, f"binds serialized: {wall:.2f}s"
         store.stop()

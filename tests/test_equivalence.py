@@ -92,6 +92,7 @@ class TestSchedulerIntegration:
             placed += sched.run_once()
             if placed >= 6:
                 break
+        sched.wait_for_binds()
         assert placed >= 1  # disk conflicts limit placement to one node...
         assert sched.ecache.hits > 0
         assert sched.ecache.misses > 0

@@ -208,6 +208,7 @@ class TestHollowCluster:
         deadline = time.monotonic() + 60
         while placed < 6 and time.monotonic() < deadline:
             placed += sched.run_once()
+        sched.wait_for_binds()
         assert placed == 6
         for kl in kubelets:
             kl.sync_once()
@@ -242,6 +243,7 @@ class TestHollowCluster:
         deadline = time.monotonic() + 60
         while placed < lost and time.monotonic() < deadline:
             placed += sched.run_once()
+        sched.wait_for_binds()
         assert placed == lost
         for p in store.list("pods"):
             assert p.spec.node_name != dead

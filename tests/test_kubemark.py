@@ -22,6 +22,7 @@ class TestHollowCluster:
             placed += sched.run_once()
             if placed >= 20:
                 break
+        sched.wait_for_binds()
         assert placed == 20
         hc.sync_once()
         running = [p for p in store.list("pods")
@@ -39,6 +40,7 @@ class TestHollowCluster:
             placed += sched.run_once()
             if placed >= 6:
                 break
+        sched.wait_for_binds()
         assert placed == 6
         hc.sync_once()
         assert sum(1 for p in store.list("pods")

@@ -171,6 +171,7 @@ class TestSchedulerOnNativeStore:
             placed += sched.run_once()
             if placed >= 8:
                 break
+        sched.wait_for_binds()
         assert placed == 8
         bound = store.list("pods")
         assert all(p.spec.node_name for p in bound)

@@ -155,6 +155,7 @@ class TestHealthEndpoint:
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
                 if sched.run_once() > 0:
+                    sched.wait_for_binds()
                     break
             body = urllib.request.urlopen(
                 f"http://127.0.0.1:{hs.port}/healthz").read()

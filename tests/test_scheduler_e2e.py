@@ -85,6 +85,32 @@ def test_backoff_window_doubles_per_failure():
     assert sched.queue.active_count() == 1
 
 
+def test_failed_bind_forgets_assume_and_requeues():
+    """forget-on-failure: a bind error must roll back the assume so the
+    capacity is schedulable again (reference scheduler.go:409-432). The
+    in-process store binds inline (async_bind_safe=False), so the
+    failure/rollback/retry sequence is fully deterministic here; the
+    async pool variant is exercised over HTTP in test_apiserver.py."""
+    store, sched = make_world(1, cpu="2")
+    assert sched._bind_pool is None  # in-process store -> inline binds
+    orig_bind = store.bind
+    fails = {"n": 1}
+
+    def flaky_bind(pod, node):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise RuntimeError("apiserver hiccup")
+        return orig_bind(pod, node)
+
+    store.bind = flaky_bind
+    store.create("pods", make_pod("a", cpu="2"))
+    assert sched.schedule_pending() == 1  # first attempt fails, retry binds
+    assert store.get("pods", "default", "a").spec.node_name == "n0"
+    # the assume was rolled back and re-applied exactly once: node full
+    store.create("pods", make_pod("b", cpu="2"))
+    assert sched.schedule_pending() == 0
+
+
 def test_blocking_pop_wakes_on_backoff_expiry():
     """A popper blocked on an empty active heap must wake when a backoff
     deadline passes — nothing notifies the condvar at that moment, so the
