@@ -34,7 +34,7 @@ from .store import ADDED, DELETED, MODIFIED, Conflict, Event
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "libkvstore.so"))
 
-KV_OK, KV_CONFLICT, KV_NOT_FOUND, KV_COMPACTED = 0, 1, 2, 3
+KV_OK, KV_CONFLICT, KV_NOT_FOUND, KV_COMPACTED, KV_IO = 0, 1, 2, 3, 4
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -83,6 +83,13 @@ def load_library():
                                 ctypes.POINTER(ctypes.c_int)]
         lib.kv_count.restype = ctypes.c_int64
         lib.kv_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_int64]
+        lib.kv_snapshot.restype = ctypes.c_int
+        lib.kv_snapshot.argtypes = [ctypes.c_void_p]
+        lib.kv_sync.restype = ctypes.c_int
+        lib.kv_sync.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -103,24 +110,64 @@ class NativeObjectStore:
     # scheduler binder threads can post binds without lock-order inversion
     async_bind_safe = True
 
-    def __init__(self, ring_capacity: int = 65536):
+    def __init__(self, ring_capacity: int = 65536,
+                 path: Optional[str] = None, snapshot_every: int = 0):
+        """path=None -> memory-only. With a path, the engine replays
+        <path>/snapshot + <path>/wal on open and WALs every mutation
+        (durable L0: the reference's etcd WAL+snapshot model,
+        storage/etcd3/store.go:262's backing store). After reopen,
+        watchers resuming from a pre-recovery revision get KV_COMPACTED
+        -> they relist (410 Gone)."""
         self._lib = load_library()
-        self._handle = ctypes.c_void_p(self._lib.kv_new(ring_capacity))
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._handle = ctypes.c_void_p(self._lib.kv_open(
+                path.encode(), ring_capacity, snapshot_every))
+            if not self._handle:
+                raise RuntimeError(f"kv_open failed for {path!r}")
+        else:
+            self._handle = ctypes.c_void_p(self._lib.kv_new(ring_capacity))
+        self.path = path
         self._lock = threading.RLock()
         self._watchers: List[Tuple[Optional[str], Callable[[Event], None]]] = []
-        self._dispatched_rev = 0
+        # start dispatch at the recovered revision: recovered state is
+        # served by list(), not replayed as events
+        self._dispatched_rev = self._lib.kv_rev(self._handle)
         # serializes claim+dispatch so two threads can never deliver
         # engine revisions out of order (a DELETE overtaken by an older
         # MODIFIED would resurrect the object in informer caches)
         self._dispatch_mu = threading.Lock()
 
     def __del__(self):
+        self.close()
+
+    @property
+    def _h(self):
+        """Live engine handle; a NULL handle passed into the C ABI would
+        segfault the process, so use-after-close must raise instead."""
+        h = self._handle
+        if not h:
+            raise RuntimeError("native store is closed")
+        return h
+
+    def close(self):
+        """Flush + close the engine (kv_free closes the WAL stream)."""
         try:
             if getattr(self, "_handle", None):
                 self._lib.kv_free(self._handle)
                 self._handle = None
         except Exception:
             pass
+
+    def snapshot(self) -> None:
+        """Force compaction: write a full snapshot and truncate the WAL."""
+        if self._lib.kv_snapshot(self._h) != 0:
+            raise RuntimeError("kv_snapshot failed")
+
+    def sync(self) -> None:
+        """fdatasync the WAL (power-loss durability point)."""
+        if self._lib.kv_sync(self._h) != 0:
+            raise RuntimeError("kv_sync failed")
 
     # -- serialization boundary (etcd3 codec analog) ---------------------------
 
@@ -176,11 +223,11 @@ class NativeObjectStore:
                 err = ctypes.c_int(0)
                 raw = _take_string(
                     self._lib,
-                    self._lib.kv_poll(self._handle, since, 512,
+                    self._lib.kv_poll(self._h, since, 512,
                                       ctypes.byref(nxt), ctypes.byref(err)))
                 if err.value == KV_COMPACTED:
                     # local dispatcher fell behind the ring; jump forward
-                    self._dispatched_rev = self._lib.kv_rev(self._handle)
+                    self._dispatched_rev = self._lib.kv_rev(self._h)
                     return any_delivered
                 if not raw:
                     return any_delivered
@@ -213,12 +260,14 @@ class NativeObjectStore:
     def create(self, kind: str, obj) -> object:
         err = ctypes.c_int(0)
         if not obj.metadata.uid:
-            obj.metadata.uid = f"uid-native-{self._lib.kv_rev(self._handle)+1}"
-        rev = self._lib.kv_put(self._handle, self._obj_key(kind, obj),
+            obj.metadata.uid = f"uid-native-{self._lib.kv_rev(self._h)+1}"
+        rev = self._lib.kv_put(self._h, self._obj_key(kind, obj),
                                self._encode(obj), 0, ctypes.byref(err))
         if err.value == KV_CONFLICT:
             raise Conflict(f"{kind} {obj.metadata.namespace}/"
                            f"{obj.metadata.name} already exists")
+        if err.value == KV_IO:
+            raise OSError(f"{kind}: storage I/O error (WAL append failed)")
         obj.metadata.resource_version = rev
         self._drain()
         return obj
@@ -232,27 +281,31 @@ class NativeObjectStore:
             # resurrect deleted objects for stale-reference callers)
             for _ in range(16):
                 cur_rev = ctypes.c_int64(0)
-                raw = self._lib.kv_get(self._handle, key,
+                raw = self._lib.kv_get(self._h, key,
                                        ctypes.byref(cur_rev))
                 if not raw:
                     raise KeyError(f"{kind} {obj.metadata.name} not found")
                 self._lib.kv_buf_free(raw)
-                rev = self._lib.kv_put(self._handle, key, self._encode(obj),
+                rev = self._lib.kv_put(self._h, key, self._encode(obj),
                                        cur_rev.value, ctypes.byref(err))
                 if err.value == KV_OK:
                     break
                 if err.value == KV_NOT_FOUND:
                     raise KeyError(f"{kind} {obj.metadata.name} not found")
+                if err.value == KV_IO:
+                    raise OSError(f"{kind}: storage I/O error")
             else:
                 raise Conflict(f"{kind} {obj.metadata.name}: CAS retries "
                                f"exhausted")
         else:
-            rev = self._lib.kv_put(self._handle, key, self._encode(obj),
+            rev = self._lib.kv_put(self._h, key, self._encode(obj),
                                    expect_rv, ctypes.byref(err))
             if err.value == KV_CONFLICT:
                 raise Conflict(f"{kind} {obj.metadata.name}: rv mismatch")
             if err.value == KV_NOT_FOUND:
                 raise KeyError(f"{kind} {obj.metadata.name} not found")
+            if err.value == KV_IO:
+                raise OSError(f"{kind}: storage I/O error")
         obj.metadata.resource_version = rev
         self._drain()
         return obj
@@ -260,17 +313,19 @@ class NativeObjectStore:
     def delete(self, kind: str, namespace: str, name: str) -> object:
         old = self.get(kind, namespace, name)
         err = ctypes.c_int(0)
-        self._lib.kv_delete(self._handle, self._key(kind, namespace, name),
+        self._lib.kv_delete(self._h, self._key(kind, namespace, name),
                             ctypes.byref(err))
         if err.value == KV_NOT_FOUND or old is None:
             raise KeyError(f"{kind} {namespace}/{name} not found")
+        if err.value == KV_IO:
+            raise OSError(f"{kind}: storage I/O error (WAL append failed)")
         self._drain()
         return old
 
     def get(self, kind: str, namespace: str, name: str):
         rev = ctypes.c_int64(0)
         raw = _take_string(self._lib, self._lib.kv_get(
-            self._handle, self._key(kind, namespace, name),
+            self._h, self._key(kind, namespace, name),
             ctypes.byref(rev)))
         if raw is None:
             return None
@@ -280,7 +335,7 @@ class NativeObjectStore:
         prefix = f"{kind}/{namespace}/" if namespace is not None else f"{kind}/"
         rev = ctypes.c_int64(0)
         raw = _take_string(self._lib, self._lib.kv_list(
-            self._handle, prefix.encode(), ctypes.byref(rev)))
+            self._h, prefix.encode(), ctypes.byref(rev)))
         out = []
         for line in (raw or "").splitlines():
             if not line:
@@ -290,11 +345,11 @@ class NativeObjectStore:
         return out
 
     def count(self, kind: str) -> int:
-        return int(self._lib.kv_count(self._handle, f"{kind}/".encode()))
+        return int(self._lib.kv_count(self._h, f"{kind}/".encode()))
 
     @property
     def latest_resource_version(self) -> int:
-        return int(self._lib.kv_rev(self._handle))
+        return int(self._lib.kv_rev(self._h))
 
     # -- pod subresources (read-modify-write with CAS retry) -------------------
 

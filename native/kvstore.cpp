@@ -1,5 +1,6 @@
-// Versioned in-memory KV store with a bounded watch ring — the native
-// storage engine behind runtime/nativestore.py.
+// Versioned KV store with a bounded watch ring and optional durability
+// (write-ahead log + snapshot) — the native storage engine behind
+// runtime/nativestore.py.
 //
 // Architectural role: the reference's L0 is a *native external store*
 // (etcd v3.2.18, a Go binary spoken to over gRPC — WORKSPACE:23,
@@ -11,10 +12,22 @@
 // (mvcc watchable store; "compacted" history -> error 3, the 410 Gone
 // analog).
 //
-// The C ABI is deliberately narrow (new/free, put, del, get, list,
-// poll, rev) so it binds with ctypes — no pybind11 dependency.
+// Durability (etcd's WAL + snapshot model, wal/wal.go + snap/): opening
+// with kv_open(dir) replays <dir>/snapshot then <dir>/wal; every
+// mutation appends a length-framed, checksummed WAL record and
+// fflush()es it (crash-of-process safe; kv_sync() adds fdatasync for
+// power-loss durability). When the WAL exceeds a record threshold the
+// store writes a fresh snapshot (atomic tmp+rename) and truncates the
+// WAL — compaction. After reopen the watch ring starts empty at the
+// recovered revision: pollers resuming from an older revision get
+// KV_COMPACTED and must relist, exactly the 410-Gone contract.
+//
+// The C ABI is deliberately narrow (new/open/free, put, del, get, list,
+// poll, rev, snapshot, sync) so it binds with ctypes — no pybind11
+// dependency.
 
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
@@ -22,6 +35,12 @@
 #include <mutex>
 #include <string>
 #include <vector>
+
+#ifndef _WIN32
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#endif
 
 namespace {
 
@@ -44,7 +63,221 @@ struct Store {
     std::deque<Event> ring;
     size_t ring_capacity;
     int64_t rev = 0;
+    // events with rev <= compacted_rev are no longer replayable (ring
+    // overflow or restart); poll() from before this horizon -> KV_COMPACTED
+    int64_t compacted_rev = 0;
+    // durability (empty dir -> memory-only)
+    std::string dir;
+    std::FILE* wal = nullptr;
+    int64_t wal_records = 0;
+    int64_t snapshot_every = 10000;  // WAL records between snapshots
+    bool snap_in_progress = false;   // one background compaction at a time
+    // latched on any WAL append failure: acknowledging a write whose WAL
+    // record did not land would break the durability contract, so all
+    // further mutations fail with KV_IO until reopen
+    bool io_error = false;
 };
+
+// ---- WAL / snapshot encoding ------------------------------------------------
+//
+// WAL record:  u32 len | u8 op(0=put,1=del) | i64 rev | u32 klen |
+//              key bytes | value bytes | u32 check(len ^ 0xA5A5A5A5)
+// A torn tail (crash mid-append) fails the length/check validation and
+// replay stops there — everything before it is intact.
+// Snapshot:    u64 magic | i64 rev | repeated { u32 klen | u32 vlen |
+//              i64 mod_rev | key | value }
+
+constexpr uint64_t kSnapMagic = 0x6b76736e61703031ULL;  // "kvsnap01"
+constexpr uint32_t kWalCheck = 0xA5A5A5A5u;
+
+bool write_all(std::FILE* f, const void* p, size_t n) {
+    return std::fwrite(p, 1, n, f) == n;
+}
+
+bool read_all(std::FILE* f, void* p, size_t n) {
+    return std::fread(p, 1, n, f) == n;
+}
+
+bool append_wal_record(Store* st, bool is_delete, int64_t rev,
+                       const std::string& key, const std::string& value) {
+    if (!st->wal) return true;
+    uint8_t op = is_delete ? 1 : 0;
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t len = static_cast<uint32_t>(1 + 8 + 4 + key.size() + value.size());
+    uint32_t check = len ^ kWalCheck;
+    bool ok = write_all(st->wal, &len, 4) && write_all(st->wal, &op, 1) &&
+              write_all(st->wal, &rev, 8) && write_all(st->wal, &klen, 4) &&
+              write_all(st->wal, key.data(), key.size()) &&
+              write_all(st->wal, value.data(), value.size()) &&
+              write_all(st->wal, &check, 4);
+    if (ok && std::fflush(st->wal) != 0) ok = false;
+    if (ok) st->wal_records += 1;
+    return ok;
+}
+
+void fsync_dir(const std::string& dir) {
+#ifndef _WIN32
+    // a rename is only durable once the directory entry is on disk
+    int fd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (fd >= 0) {
+        fsync(fd);
+        ::close(fd);
+    }
+#else
+    (void)dir;
+#endif
+}
+
+bool file_exists(const std::string& p) {
+#ifndef _WIN32
+    struct stat sb;
+    return ::stat(p.c_str(), &sb) == 0;
+#else
+    std::FILE* f = std::fopen(p.c_str(), "rb");
+    if (f) std::fclose(f);
+    return f != nullptr;
+#endif
+}
+
+// Serialize `data` at `rev` into <dir>/snapshot atomically (tmp + fsync +
+// rename + dir fsync). Pure function of its arguments — callable without
+// the store mutex.
+bool write_snapshot_file(const std::string& dir,
+                         const std::map<std::string, Entry>& data,
+                         int64_t rev) {
+    std::string tmp = dir + "/snapshot.tmp";
+    std::string fin = dir + "/snapshot";
+    std::FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    bool ok = write_all(f, &kSnapMagic, 8) && write_all(f, &rev, 8);
+    for (auto it = data.begin(); ok && it != data.end(); ++it) {
+        uint32_t klen = static_cast<uint32_t>(it->first.size());
+        uint32_t vlen = static_cast<uint32_t>(it->second.value.size());
+        ok = write_all(f, &klen, 4) && write_all(f, &vlen, 4) &&
+             write_all(f, &it->second.mod_rev, 8) &&
+             write_all(f, it->first.data(), klen) &&
+             write_all(f, it->second.value.data(), vlen);
+    }
+    if (ok) {
+        std::fflush(f);
+#ifndef _WIN32
+        fsync(fileno(f));
+#endif
+    }
+    std::fclose(f);
+    if (!ok) { std::remove(tmp.c_str()); return false; }
+    if (std::rename(tmp.c_str(), fin.c_str()) != 0) return false;
+    fsync_dir(dir);
+    return true;
+}
+
+// Compaction in two halves so the expensive file IO never holds st->mu:
+// begin (mu held) rotates the WAL to wal.old and copies the state;
+// finish (no mu) writes the snapshot and removes wal.old. Recovery
+// replays snapshot -> wal.old -> wal, so a crash at ANY point between
+// the halves loses nothing (record revs <= the snapshot rev are skipped).
+struct SnapJob {
+    std::map<std::string, Entry> data;
+    int64_t rev = 0;
+};
+
+bool begin_snapshot_locked(Store* st, SnapJob* job) {
+    if (st->dir.empty() || st->snap_in_progress || !st->wal || st->io_error)
+        return false;
+    std::string w = st->dir + "/wal", wo = st->dir + "/wal.old";
+    if (file_exists(wo)) return false;  // a failed finish left it; keep it
+    std::fflush(st->wal);
+    std::fclose(st->wal);
+    st->wal = nullptr;
+    if (std::rename(w.c_str(), wo.c_str()) != 0) {
+        st->wal = std::fopen(w.c_str(), "ab");
+        if (!st->wal) st->io_error = true;
+        return false;
+    }
+    st->wal = std::fopen(w.c_str(), "wb");
+    if (!st->wal) {
+        st->io_error = true;
+        return false;
+    }
+    st->wal_records = 0;
+    job->data = st->data;
+    job->rev = st->rev;
+    st->snap_in_progress = true;
+    return true;
+}
+
+bool finish_snapshot(Store* st, SnapJob* job) {
+    bool ok = write_snapshot_file(st->dir, job->data, job->rev);
+    if (ok) std::remove((st->dir + "/wal.old").c_str());
+    std::lock_guard<std::mutex> lock(st->mu);
+    st->snap_in_progress = false;
+    // on failure wal.old stays: recovery still replays it, and the next
+    // begin_snapshot_locked is skipped until it's consolidated at reopen
+    return ok;
+}
+
+bool load_snapshot(Store* st) {
+    std::FILE* f = std::fopen((st->dir + "/snapshot").c_str(), "rb");
+    if (!f) return true;  // no snapshot yet
+    uint64_t magic = 0;
+    int64_t rev = 0;
+    if (!read_all(f, &magic, 8) || magic != kSnapMagic ||
+        !read_all(f, &rev, 8)) {
+        std::fclose(f);
+        return false;
+    }
+    st->rev = rev;
+    while (true) {
+        uint32_t klen = 0, vlen = 0;
+        int64_t mod_rev = 0;
+        if (!read_all(f, &klen, 4)) break;  // clean EOF
+        if (!read_all(f, &vlen, 4) || !read_all(f, &mod_rev, 8)) break;
+        std::string key(klen, '\0'), value(vlen, '\0');
+        if (!read_all(f, key.data(), klen) || !read_all(f, value.data(), vlen))
+            break;
+        st->data[std::move(key)] = Entry{std::move(value), mod_rev};
+    }
+    std::fclose(f);
+    return true;
+}
+
+// Replay one WAL file; records at/below the recovered revision are
+// skipped. Returns the byte offset of the last VALID record's end — a
+// torn tail after it must be truncated away before appending, or records
+// written after the tear would be unreachable on the next replay.
+long replay_wal_file(Store* st, const std::string& path) {
+    std::FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) return 0;
+    long valid_end = 0;
+    while (true) {
+        uint32_t len = 0;
+        if (!read_all(f, &len, 4)) break;
+        if (len < 13 || len > (1u << 30)) break;  // corrupt/torn tail
+        std::vector<char> buf(len);
+        if (!read_all(f, buf.data(), len)) break;
+        uint32_t check = 0;
+        if (!read_all(f, &check, 4) || check != (len ^ kWalCheck)) break;
+        uint8_t op = static_cast<uint8_t>(buf[0]);
+        int64_t rev;
+        std::memcpy(&rev, buf.data() + 1, 8);
+        uint32_t klen;
+        std::memcpy(&klen, buf.data() + 9, 4);
+        if (13 + klen > len) break;
+        valid_end = std::ftell(f);
+        std::string key(buf.data() + 13, klen);
+        std::string value(buf.data() + 13 + klen, len - 13 - klen);
+        if (rev <= st->rev) continue;  // already in snapshot
+        st->rev = rev;
+        if (op == 1) {
+            st->data.erase(key);
+        } else {
+            st->data[std::move(key)] = Entry{std::move(value), rev};
+        }
+        st->wal_records += 1;
+    }
+    std::fclose(f);
+    return valid_end;
+}
 
 char* dup_buffer(const std::string& s) {
     char* out = static_cast<char*>(std::malloc(s.size() + 1));
@@ -55,7 +288,10 @@ char* dup_buffer(const std::string& s) {
 
 void push_event(Store* st, Event ev) {
     st->ring.push_back(std::move(ev));
-    while (st->ring.size() > st->ring_capacity) st->ring.pop_front();
+    while (st->ring.size() > st->ring_capacity) {
+        st->compacted_rev = st->ring.front().rev;
+        st->ring.pop_front();
+    }
 }
 
 // JSON string escaping for the poll/list framing (values are already
@@ -87,7 +323,8 @@ void append_json_string(std::string& out, const std::string& s) {
 extern "C" {
 
 // error codes
-enum { KV_OK = 0, KV_CONFLICT = 1, KV_NOT_FOUND = 2, KV_COMPACTED = 3 };
+enum { KV_OK = 0, KV_CONFLICT = 1, KV_NOT_FOUND = 2, KV_COMPACTED = 3,
+       KV_IO = 4 };
 
 void* kv_new(int ring_capacity) {
     Store* st = new Store();
@@ -95,7 +332,73 @@ void* kv_new(int ring_capacity) {
     return st;
 }
 
-void kv_free(void* h) { delete static_cast<Store*>(h); }
+// Open (or create) a durable store rooted at dir: replay snapshot + WAL,
+// then append subsequent mutations to the WAL. snapshot_every <= 0 keeps
+// the default compaction threshold. Returns NULL on unrecoverable IO.
+void* kv_open(const char* dir, int ring_capacity, int64_t snapshot_every) {
+    Store* st = static_cast<Store*>(kv_new(ring_capacity));
+    st->dir = dir ? dir : "";
+    if (st->dir.empty()) return st;
+    if (snapshot_every > 0) st->snapshot_every = snapshot_every;
+    if (!load_snapshot(st)) { delete st; return nullptr; }
+    std::string w = st->dir + "/wal", wo = st->dir + "/wal.old";
+    bool had_old = file_exists(wo);
+    if (had_old) replay_wal_file(st, wo);  // interrupted compaction
+    long valid_end = replay_wal_file(st, w);
+    if (had_old) {
+        // consolidate: the full recovered state replaces snapshot +
+        // wal.old + wal, so the stale segment never shadows new appends
+        if (!write_snapshot_file(st->dir, st->data, st->rev)) {
+            delete st;
+            return nullptr;
+        }
+        std::remove(wo.c_str());
+        st->wal = std::fopen(w.c_str(), "wb");
+        st->wal_records = 0;
+    } else {
+#ifndef _WIN32
+        // chop any torn tail so post-recovery appends stay reachable
+        if (file_exists(w)) ::truncate(w.c_str(), valid_end);
+#endif
+        st->wal = std::fopen(w.c_str(), "ab");
+    }
+    // nothing older than the recovered revision is replayable: watchers
+    // resuming from before it must relist (410 Gone analog)
+    st->compacted_rev = st->rev;
+    if (!st->wal) { delete st; return nullptr; }
+    return st;
+}
+
+void kv_free(void* h) {
+    Store* st = static_cast<Store*>(h);
+    if (st->wal) std::fclose(st->wal);
+    delete st;
+}
+
+// Force a snapshot + WAL truncation now (manual compaction). 0 on success.
+int kv_snapshot(void* h) {
+    Store* st = static_cast<Store*>(h);
+    SnapJob job;
+    {
+        std::lock_guard<std::mutex> lock(st->mu);
+        if (st->dir.empty()) return 0;
+        if (!begin_snapshot_locked(st, &job)) return -1;
+    }
+    return finish_snapshot(st, &job) ? 0 : -1;
+}
+
+// fdatasync the WAL (power-loss durability point). 0 on success.
+int kv_sync(void* h) {
+    Store* st = static_cast<Store*>(h);
+    std::lock_guard<std::mutex> lock(st->mu);
+    if (!st->wal) return 0;
+    if (std::fflush(st->wal) != 0) return -1;
+#ifndef _WIN32
+    return fsync(fileno(st->wal)) == 0 ? 0 : -1;
+#else
+    return 0;
+#endif
+}
 
 void kv_buf_free(char* buf) { std::free(buf); }
 
@@ -112,44 +415,84 @@ int64_t kv_rev(void* h) {
 int64_t kv_put(void* h, const char* key, const char* value,
                int64_t expect_rev, int* err) {
     Store* st = static_cast<Store*>(h);
-    std::lock_guard<std::mutex> lock(st->mu);
-    auto it = st->data.find(key);
-    if (expect_rev == 0 && it != st->data.end()) {
-        *err = KV_CONFLICT;
-        return 0;
-    }
-    if (expect_rev > 0) {
-        if (it == st->data.end()) {
-            *err = KV_NOT_FOUND;
-            return 0;
-        }
-        if (it->second.mod_rev != expect_rev) {
+    SnapJob job;
+    bool do_snap = false;
+    int64_t out;
+    {
+        std::lock_guard<std::mutex> lock(st->mu);
+        auto it = st->data.find(key);
+        if (expect_rev == 0 && it != st->data.end()) {
             *err = KV_CONFLICT;
             return 0;
         }
+        if (expect_rev > 0) {
+            if (it == st->data.end()) {
+                *err = KV_NOT_FOUND;
+                return 0;
+            }
+            if (it->second.mod_rev != expect_rev) {
+                *err = KV_CONFLICT;
+                return 0;
+            }
+        }
+        // WAL-first: the mutation is acknowledged only after its record
+        // is in the log — a failed append must not change state
+        if (st->io_error) {
+            *err = KV_IO;
+            return 0;
+        }
+        int64_t next = st->rev + 1;
+        if (!append_wal_record(st, false, next, key, value)) {
+            st->io_error = true;
+            *err = KV_IO;
+            return 0;
+        }
+        bool created = (it == st->data.end());
+        st->rev = next;
+        st->data[key] = Entry{value, next};
+        push_event(st, Event{next, false, created, key, value});
+        if (st->wal && st->wal_records >= st->snapshot_every)
+            do_snap = begin_snapshot_locked(st, &job);
+        *err = KV_OK;
+        out = next;
     }
-    bool created = (it == st->data.end());
-    st->rev += 1;
-    st->data[key] = Entry{value, st->rev};
-    push_event(st, Event{st->rev, false, created, key, value});
-    *err = KV_OK;
-    return st->rev;
+    if (do_snap) finish_snapshot(st, &job);
+    return out;
 }
 
 int64_t kv_delete(void* h, const char* key, int* err) {
     Store* st = static_cast<Store*>(h);
-    std::lock_guard<std::mutex> lock(st->mu);
-    auto it = st->data.find(key);
-    if (it == st->data.end()) {
-        *err = KV_NOT_FOUND;
-        return 0;
+    SnapJob job;
+    bool do_snap = false;
+    int64_t out;
+    {
+        std::lock_guard<std::mutex> lock(st->mu);
+        auto it = st->data.find(key);
+        if (it == st->data.end()) {
+            *err = KV_NOT_FOUND;
+            return 0;
+        }
+        if (st->io_error) {
+            *err = KV_IO;
+            return 0;
+        }
+        int64_t next = st->rev + 1;
+        if (!append_wal_record(st, true, next, key, std::string())) {
+            st->io_error = true;
+            *err = KV_IO;
+            return 0;
+        }
+        st->rev = next;
+        push_event(st, Event{next, true, false, key,
+                             std::move(it->second.value)});
+        st->data.erase(it);
+        if (st->wal && st->wal_records >= st->snapshot_every)
+            do_snap = begin_snapshot_locked(st, &job);
+        *err = KV_OK;
+        out = next;
     }
-    st->rev += 1;
-    push_event(st, Event{st->rev, true, false, key,
-                         std::move(it->second.value)});
-    st->data.erase(it);
-    *err = KV_OK;
-    return st->rev;
+    if (do_snap) finish_snapshot(st, &job);
+    return out;
 }
 
 // Returns malloc'd value or NULL; *mod_rev gets the entry's revision.
@@ -191,14 +534,12 @@ char* kv_poll(void* h, int64_t since_rev, int max_events,
     std::lock_guard<std::mutex> lock(st->mu);
     *err = KV_OK;
     *next_rev = since_rev;
-    if (!st->ring.empty() && since_rev + 1 < st->ring.front().rev &&
-        since_rev < st->rev) {
-        // window check: only events newer than the ring start are
-        // replayable; an older horizon means history was dropped
-        if (since_rev < st->ring.front().rev - 1) {
-            *err = KV_COMPACTED;
-            return nullptr;
-        }
+    // only events newer than the compaction horizon are replayable: the
+    // horizon advances on ring overflow and jumps to the recovered
+    // revision after kv_open (the ring does not survive restarts)
+    if (since_rev < st->compacted_rev) {
+        *err = KV_COMPACTED;
+        return nullptr;
     }
     std::string out;
     int n = 0;
