@@ -316,6 +316,10 @@ class NodeStatus:
     allocatable: Dict[str, int] = field(default_factory=dict)
     conditions: List[NodeCondition] = field(default_factory=list)
     images: List[ContainerImage] = field(default_factory=list)
+    # attach/detach controller state (core/v1 NodeStatus.VolumesAttached /
+    # VolumesInUse; maintained by controllers/attachdetach.py)
+    volumes_attached: List[str] = field(default_factory=list)
+    volumes_in_use: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -372,6 +376,13 @@ class PersistentVolumeClaimSpec:
     storage_class_name: str = ""
     volume_name: str = ""  # non-empty once bound to a PV
     requests: Dict[str, int] = field(default_factory=dict)
+    # StorageClass volumeBindingMode, flattened onto the claim (no
+    # StorageClass object in this model): "Immediate" claims are bound by
+    # PersistentVolumeController as soon as a PV matches;
+    # "WaitForFirstConsumer" claims are bound by the scheduler's
+    # VolumeBinder at pod commit, when the node is known — exactly one
+    # writer owns each claim, so the two can never race on volume_name
+    volume_binding_mode: str = "Immediate"
 
 
 @dataclass

@@ -27,4 +27,5 @@ from .garbagecollector import GarbageCollector
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .volumebinding import PersistentVolumeController
+from .attachdetach import AttachDetachController
 from .manager import ControllerManager

@@ -27,6 +27,7 @@ from .podgc import PodGCController
 from .replicaset import ReplicaSetController, ReplicationControllerController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
+from .attachdetach import AttachDetachController
 from .statefulset import StatefulSetController
 from .volumebinding import PersistentVolumeController
 
@@ -37,6 +38,7 @@ DEFAULT_CONTROLLERS = [
     NodeLifecycleController, DisruptionController, NamespaceController,
     PodGCController, GarbageCollector, ResourceQuotaController,
     ServiceAccountController, PersistentVolumeController,
+    AttachDetachController,
 ]
 
 

@@ -33,6 +33,11 @@ class PersistentVolumeController(Controller):
         pvc = self.store.get("persistentvolumeclaims", ns, name)
         if pvc is None or pvc.spec.volume_name:
             return
+        if pvc.spec.volume_binding_mode == "WaitForFirstConsumer":
+            # owned by the scheduler's VolumeBinder: bound at pod commit,
+            # when the node (and thus PV topology) is known — binding here
+            # would both race that writer and ignore node affinity
+            return
         want = pvc.spec.requests.get(res.MEMORY, 0) or \
             pvc.spec.requests.get("storage", 0)
         bound_pvs = {c.spec.volume_name

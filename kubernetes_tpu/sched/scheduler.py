@@ -113,6 +113,9 @@ class Scheduler:
             clock=clock)
         self.metrics = Metrics()
         self.backoff = PodBackoff(clock=clock)
+        from .volume_binder import VolumeBinder
+
+        self.volume_binder = VolumeBinder(store)
         self._rr = None  # round-robin counter, device i32
         # None = not yet resolved; resolved on first wave to
         # pallas_default(), then demoted to False permanently if the fused
@@ -481,18 +484,29 @@ class Scheduler:
         """Exact int64 re-verification then assume; the bind posts from
         the worker pool outside _mu (reference: scheduler.go:486 assume ->
         :491 `go sched.bind`). True means the pod is assumed and its bind
-        dispatched — a failed bind forgets the assume and requeues."""
+        dispatched — a failed bind forgets the assume and requeues.
+
+        With the VolumeScheduling gate on, the pod's unbound PVCs are
+        bound to node-compatible PVs first (scheduler.go:268
+        assumeAndBindVolumes); a later bind failure rolls them back."""
         ni = self.cache.node_infos.get(node_name)
         if ni is None or not ni.fits_exactly(pod):
             return False
+        vol_rollback = None
+        if (self.features.enabled("VolumeScheduling")
+                and self.volume_binder.pod_has_claims(pod)):
+            ok, vol_rollback = self.volume_binder.bind_pod_volumes(
+                pod, ni.node)
+            if not ok:
+                return False
         bound = api.with_node_name(pod, node_name)
         self.cache.assume_pod(bound)
         self.snapshot.refresh_node_resources(self.cache.node_infos[node_name])
         self.snapshot.add_pod(bound)
         if self._bind_pool is None:
-            return self._bind_and_finish(pod, bound, node_name)
+            return self._bind_and_finish(pod, bound, node_name, vol_rollback)
         fut = self._bind_pool.submit(self._bind_and_finish, pod, bound,
-                                     node_name)
+                                     node_name, vol_rollback)
         with self._inflight_mu:
             self._inflight.add(fut)
             self.bind_overlap_hwm = max(self.bind_overlap_hwm,
@@ -515,9 +529,10 @@ class Scheduler:
                                       file=sys.stderr)
 
     def _bind_and_finish(self, pod: api.Pod, bound: api.Pod,
-                         node_name: str) -> bool:
+                         node_name: str, vol_rollback=None) -> bool:
         """The bind POST + cache confirmation; runs outside _mu. Failure
-        rolls the assume back and requeues (forget-on-failure,
+        rolls the assume back — including any PVC bindings made during
+        the commit — and requeues (forget-on-failure,
         scheduler.go:409-432)."""
         t0 = self.clock()
         try:
@@ -544,6 +559,8 @@ class Scheduler:
                 if ni is not None:
                     self.snapshot.refresh_node_resources(ni)
                 self.snapshot.remove_pod(bound)
+            if vol_rollback is not None:
+                vol_rollback()
             self.queue.add_if_not_present(pod)
             return False
         with self._mu:

@@ -38,11 +38,14 @@ def make_pv(name, kind="", vid="", labels=None, affinity=None, cls=""):
                                       storage_class_name=cls))
 
 
-def make_pvc(name, volume_name="", cls="", namespace="default"):
+def make_pvc(name, volume_name="", cls="", namespace="default",
+             mode="Immediate", **requests):
     return api.PersistentVolumeClaim(
         metadata=api.ObjectMeta(name=name, namespace=namespace),
         spec=api.PersistentVolumeClaimSpec(volume_name=volume_name,
-                                           storage_class_name=cls))
+                                           storage_class_name=cls,
+                                           volume_binding_mode=mode,
+                                           requests=dict(requests)))
 
 
 class TestMaxPDVolumeCount:

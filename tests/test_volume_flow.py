@@ -1,0 +1,156 @@
+"""Scheduler volume assume/bind flow + attach/detach controller.
+
+Reference: scheduler.go:268 assumeAndBindVolumes (VolumeScheduling gate)
+and pkg/controller/volume/attachdetach/attach_detach_controller.go:95.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers.attachdetach import AttachDetachController
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils.feature_gates import FeatureGates
+
+from helpers import make_node, make_pod
+from test_plugins import make_pv, make_pvc, pvc_pod
+
+
+def zone_affinity(zone):
+    from kubernetes_tpu.api import labels as lbl
+
+    return api.NodeSelector(node_selector_terms=[
+        api.NodeSelectorTerm(match_expressions=[
+            lbl.Requirement(api.LABEL_ZONE, lbl.IN, (zone,))])])
+
+
+def vol_world(gates=None):
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=16, features=FeatureGates(
+        dict({"VolumeScheduling": True}, **(gates or {}))))
+    store.create("nodes", make_node("n-a", cpu="4",
+                                    labels={api.LABEL_ZONE: "z1"}))
+    store.create("nodes", make_node("n-b", cpu="4",
+                                    labels={api.LABEL_ZONE: "z2"}))
+    return store, sched
+
+
+def test_commit_binds_unbound_pvcs_to_node_compatible_pvs():
+    store, sched = vol_world()
+    # one PV per zone: the claim must bind to the PV of the chosen node
+    store.create("persistentvolumes",
+                 make_pv("pv-z1", affinity=zone_affinity("z1")))
+    store.create("persistentvolumes",
+                 make_pv("pv-z2", affinity=zone_affinity("z2")))
+    store.create("persistentvolumeclaims", make_pvc("data", mode="WaitForFirstConsumer"))
+    pod = pvc_pod("p", "data")
+    store.create("pods", pod)
+    assert sched.schedule_pending() == 1
+    bound = store.get("pods", "default", "p")
+    pvc = store.get("persistentvolumeclaims", "default", "data")
+    assert bound.spec.node_name in ("n-a", "n-b")
+    want = {"n-a": "pv-z1", "n-b": "pv-z2"}[bound.spec.node_name]
+    assert pvc.spec.volume_name == want
+
+
+def test_no_feasible_pv_fails_scheduling_without_partial_binding():
+    store, sched = vol_world()
+    store.create("persistentvolumes",
+                 make_pv("pv-z1", affinity=zone_affinity("z1")))
+    store.create("persistentvolumeclaims", make_pvc("a", mode="WaitForFirstConsumer"))
+    store.create("persistentvolumeclaims", make_pvc("b", mode="WaitForFirstConsumer"))  # no 2nd PV
+    store.create("pods", pvc_pod("p", "a", "b"))
+    assert sched.schedule_pending() == 0
+    # neither claim was left half-bound by the failed commit
+    assert store.get("persistentvolumeclaims", "default", "a").spec.volume_name == ""
+    assert store.get("persistentvolumeclaims", "default", "b").spec.volume_name == ""
+
+
+def test_bind_failure_rolls_back_volume_bindings():
+    store, sched = vol_world()
+    store.create("persistentvolumes",
+                 make_pv("pv-z1", affinity=zone_affinity("z1")))
+    store.create("persistentvolumes",
+                 make_pv("pv-z2", affinity=zone_affinity("z2")))
+    store.create("persistentvolumeclaims", make_pvc("data", mode="WaitForFirstConsumer"))
+    orig_bind = store.bind
+    calls = {"n": 0}
+
+    def failing_bind(pod, node):
+        calls["n"] += 1
+        raise RuntimeError("apiserver down")
+
+    store.bind = failing_bind
+    store.create("pods", pvc_pod("p", "data"))
+    sched.run_once()
+    assert calls["n"] == 1
+    # the PVC binding made during the commit was rolled back
+    pvc = store.get("persistentvolumeclaims", "default", "data")
+    assert pvc.spec.volume_name == ""
+    # recovery: bind works again -> claim rebinds and pod lands
+    store.bind = orig_bind
+    assert sched.schedule_pending() >= 1
+    assert store.get("pods", "default", "p").spec.node_name
+    assert store.get("persistentvolumeclaims", "default",
+                     "data").spec.volume_name
+
+
+class TestAttachDetach:
+    def _world(self):
+        store = ObjectStore()
+        ctrl = AttachDetachController(store)
+        store.create("nodes", make_node("n1"))
+        store.create("nodes", make_node("n2"))
+        store.create("persistentvolumes", make_pv("pv1"))
+        store.create("persistentvolumeclaims", make_pvc("c1",
+                                                        volume_name="pv1"))
+        return store, ctrl
+
+    def test_attach_on_scheduled_pod(self):
+        store, ctrl = self._world()
+        store.create("pods", pvc_pod("p", "c1"))
+        pod = store.get("pods", "default", "p")
+        pod.spec.node_name = "n1"
+        store.update("pods", pod)
+        ctrl.sync_all()
+        n1 = store.get("nodes", "default", "n1")
+        assert n1.status.volumes_attached == ["pv1"]
+        assert n1.status.volumes_in_use == ["pv1"]
+
+    def test_detach_when_pod_deleted(self):
+        store, ctrl = self._world()
+        store.create("pods", pvc_pod("p", "c1"))
+        pod = store.get("pods", "default", "p")
+        pod.spec.node_name = "n1"
+        store.update("pods", pod)
+        ctrl.sync_all()
+        store.delete("pods", "default", "p")
+        ctrl.sync_all()
+        n1 = store.get("nodes", "default", "n1")
+        assert n1.status.volumes_attached == []
+
+    def test_multi_attach_guard(self):
+        """An RWO volume attached to n1 must not attach to n2 until n1
+        detaches (reconciler.go:184)."""
+        store, ctrl = self._world()
+        store.create("pods", pvc_pod("p1", "c1"))
+        p1 = store.get("pods", "default", "p1")
+        p1.spec.node_name = "n1"
+        store.update("pods", p1)
+        ctrl.sync_all()
+        # pod moves: delete from n1, new pod using same claim on n2
+        store.delete("pods", "default", "p1")
+        store.create("pods", pvc_pod("p2", "c1"))
+        p2 = store.get("pods", "default", "p2")
+        p2.spec.node_name = "n2"
+        store.update("pods", p2)
+        ctrl.sync_all()
+        n1 = store.get("nodes", "default", "n1")
+        n2 = store.get("nodes", "default", "n2")
+        assert n1.status.volumes_attached == []
+        assert n2.status.volumes_attached == ["pv1"]
+
+    def test_in_manager_roster(self):
+        from kubernetes_tpu.controllers.manager import DEFAULT_CONTROLLERS
+
+        assert AttachDetachController in DEFAULT_CONTROLLERS
