@@ -66,6 +66,9 @@ register("PriorityClass", "priorityclasses", api.PriorityClass,
          "scheduling.k8s.io/v1beta1", namespaced=False)
 register("Lease", "leases", api.LeaseRecord, "coordination.k8s.io/v1",
          namespaced=False)
+register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
+         api.HorizontalPodAutoscaler, "autoscaling/v1")
+register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
 
 
 def kind_for_plural(plural: str) -> Optional[str]:

@@ -646,6 +646,57 @@ class CronJob:
 
 
 @dataclass
+class CrossVersionObjectReference:
+    """autoscaling/v1 CrossVersionObjectReference — the HPA's scale
+    target (Deployment/ReplicaSet/ReplicationController/StatefulSet)."""
+
+    kind: str = "Deployment"
+    name: str = ""
+    api_version: str = "apps/v1"
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    """autoscaling/v1 (reference: pkg/apis/autoscaling/types.go;
+    controller pkg/controller/podautoscaler/horizontal.go:80)."""
+
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference)
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_cpu_utilization_percentage: int = 80
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+    last_scale_time: Optional[float] = None
+    observed_generation: int = 0
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalPodAutoscalerSpec = field(
+        default_factory=HorizontalPodAutoscalerSpec)
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus)
+
+
+@dataclass
+class PodMetrics:
+    """metrics.k8s.io PodMetrics analog (what metrics-server publishes
+    and the HPA's metrics client reads — reference
+    pkg/controller/podautoscaler/metrics/). metadata.name matches the
+    pod; usage holds aggregate container usage (cpu in millicores)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    usage: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
 class PodDisruptionBudgetSpec:
     selector: Optional[LabelSelector] = None
     min_available: Optional[int] = None

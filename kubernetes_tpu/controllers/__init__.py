@@ -28,4 +28,5 @@ from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .volumebinding import PersistentVolumeController
 from .attachdetach import AttachDetachController
+from .podautoscaler import HorizontalPodAutoscalerController
 from .manager import ControllerManager

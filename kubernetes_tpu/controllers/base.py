@@ -83,8 +83,11 @@ class Controller:
             n += 1
         return n
 
-    def run(self, workers: int = 1):
-        """Start background workers (controller Run(workers, stopCh))."""
+    def run(self, workers: int = 1, resync_period: float = 30.0):
+        """Start background workers (controller Run(workers, stopCh)) and
+        a periodic resync ticker — controllers whose state can change
+        without a watch event (HPA forbidden windows, time-based
+        lifecycles) re-enqueue themselves via resync()."""
         def worker():
             while not self._stop.is_set():
                 self.process_one(timeout=0.2)
@@ -92,6 +95,18 @@ class Controller:
         for i in range(workers):
             t = threading.Thread(target=worker, daemon=True,
                                  name=f"{self.name}-{i}")
+            t.start()
+            self._threads.append(t)
+        if resync_period > 0:
+            def resyncer():
+                while not self._stop.wait(resync_period):
+                    try:
+                        self.resync()
+                    except Exception:
+                        self.sync_errors += 1
+
+            t = threading.Thread(target=resyncer, daemon=True,
+                                 name=f"{self.name}-resync")
             t.start()
             self._threads.append(t)
 

@@ -121,6 +121,11 @@ class DeploymentController(Controller):
                 raise RuntimeError("waiting for old replicasets to scale down")
             self._scale(new_rs, want)
         else:
+            # pure scale-down (deployment/sync.go scale(): replica-count
+            # changes apply before rollout arithmetic — without this, a
+            # deployment shrunk by the HPA never scales its new RS down)
+            if new_rs.spec.replicas > want:
+                self._scale(new_rs, want)
             # RollingUpdate (deployment/rolling.go): total <= want+maxSurge;
             # available >= want-maxUnavailable
             max_surge = dep.spec.strategy.max_surge

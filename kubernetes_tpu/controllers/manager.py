@@ -28,6 +28,7 @@ from .replicaset import ReplicaSetController, ReplicationControllerController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .attachdetach import AttachDetachController
+from .podautoscaler import HorizontalPodAutoscalerController
 from .statefulset import StatefulSetController
 from .volumebinding import PersistentVolumeController
 
@@ -38,7 +39,7 @@ DEFAULT_CONTROLLERS = [
     NodeLifecycleController, DisruptionController, NamespaceController,
     PodGCController, GarbageCollector, ResourceQuotaController,
     ServiceAccountController, PersistentVolumeController,
-    AttachDetachController,
+    AttachDetachController, HorizontalPodAutoscalerController,
 ]
 
 

@@ -1,0 +1,179 @@
+"""Horizontal Pod Autoscaler tests.
+
+Reference control law: pkg/controller/podautoscaler/horizontal.go:80 +
+replica_calculator.go (ratio = utilization/target, ceil, 0.1 tolerance,
+min/max clamp, upscale/downscale forbidden windows).
+"""
+
+from kubernetes_tpu.api import labels as lbl
+from kubernetes_tpu.api import resources as res
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers import (DeploymentController,
+                                        HorizontalPodAutoscalerController,
+                                        ReplicaSetController)
+from kubernetes_tpu.runtime.store import ObjectStore
+
+from test_controllers import SEL, TMPL
+
+
+def mkhpa(name="hpa", target="d1", minr=1, maxr=10, cpu=50):
+    return api.HorizontalPodAutoscaler(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.HorizontalPodAutoscalerSpec(
+            scale_target_ref=api.CrossVersionObjectReference(
+                kind="Deployment", name=target),
+            min_replicas=minr, max_replicas=maxr,
+            target_cpu_utilization_percentage=cpu))
+
+
+def set_metrics(store, pod_name, cpu_milli):
+    cur = store.get("podmetrics", "default", pod_name)
+    if cur is None:
+        store.create("podmetrics", api.PodMetrics(
+            metadata=api.ObjectMeta(name=pod_name),
+            usage={res.CPU: cpu_milli}))
+    else:
+        cur.usage[res.CPU] = cpu_milli
+        store.update("podmetrics", cur)
+
+
+def world(replicas=2, target_cpu=50):
+    store = ObjectStore()
+    now = [1000.0]
+    dep_ctrl = DeploymentController(store)
+    rs_ctrl = ReplicaSetController(store)
+    hpa_ctrl = HorizontalPodAutoscalerController(store,
+                                                 clock=lambda: now[0])
+    store.create("deployments", api.Deployment(
+        metadata=api.ObjectMeta(name="d1"),
+        spec=api.DeploymentSpec(replicas=replicas, selector=SEL,
+                                template=TMPL)))
+    store.create("horizontalpodautoscalers", mkhpa(cpu=target_cpu))
+    dep_ctrl.sync_all()
+    rs_ctrl.sync_all()
+    return store, dep_ctrl, rs_ctrl, hpa_ctrl, now
+
+
+def pods(store):
+    return [p for p in store.list("pods") if api.is_pod_active(p)]
+
+
+def test_scales_up_under_load():
+    """Deployment at 2 replicas, each pod at 100m usage vs 100m request
+    (100% util) against a 50% target -> ratio 2.0 -> 4 replicas, and the
+    deployment controller materializes the new pods."""
+    store, dep_ctrl, rs_ctrl, hpa_ctrl, now = world(replicas=2)
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 100)
+    hpa_ctrl.sync_all()
+    dep = store.get("deployments", "default", "d1")
+    assert dep.spec.replicas == 4
+    hpa = store.get("horizontalpodautoscalers", "default", "hpa")
+    assert hpa.status.current_cpu_utilization_percentage == 100
+    assert hpa.status.desired_replicas == 4
+    dep_ctrl.sync_all()
+    rs_ctrl.sync_all()
+    assert len(pods(store)) == 4
+
+
+def test_within_tolerance_does_not_scale():
+    store, _, _, hpa_ctrl, now = world(replicas=2, target_cpu=50)
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 52)  # 52% vs 50% -> ratio 1.04
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 2
+
+
+def test_scale_down_respects_forbidden_window():
+    store, dep_ctrl, rs_ctrl, hpa_ctrl, now = world(replicas=2)
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 100)
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 4
+    dep_ctrl.sync_all()
+    rs_ctrl.sync_all()
+    # load drops immediately: downscale forbidden for 5 minutes
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 5)
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 4
+    now[0] += 5 * 60 + 1
+    hpa_ctrl.resync()
+    hpa_ctrl.sync_all()
+    dep = store.get("deployments", "default", "d1")
+    assert dep.spec.replicas < 4
+
+
+def test_max_replicas_clamp():
+    store, _, _, hpa_ctrl, now = world(replicas=2)
+    hpa = store.get("horizontalpodautoscalers", "default", "hpa")
+    hpa.spec.max_replicas = 3
+    store.update("horizontalpodautoscalers", hpa)
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 500)  # ratio 10
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 3
+
+
+def test_no_metrics_no_action():
+    store, _, _, hpa_ctrl, now = world(replicas=2)
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 2
+
+
+def test_e2e_synthetic_load_cycle():
+    """Full loop: scale up under load, settle, scale back down after the
+    stabilization window — the reference's e2e autoscaling shape
+    (test/e2e/autoscaling) in miniature."""
+    store, dep_ctrl, rs_ctrl, hpa_ctrl, now = world(replicas=1,
+                                                    target_cpu=50)
+    settle = lambda: (dep_ctrl.sync_all(), rs_ctrl.sync_all())  # noqa: E731
+    settle()
+    # load spike: 1 pod at 200% of request
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 200)
+    hpa_ctrl.sync_all()
+    settle()
+    n_up = len(pods(store))
+    assert n_up == 4  # ceil(200/50 * 1)
+    # load spreads out and drops to 10% per pod
+    now[0] += 6 * 60
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 10)
+    hpa_ctrl.resync()
+    hpa_ctrl.sync_all()
+    settle()
+    assert len(pods(store)) == 1  # ceil(0.2 * 4) = 1
+
+
+def test_min_replicas_enforced_even_on_target():
+    """horizontal.go normalizeDesiredReplicas: the [min,max] clamp
+    applies even when utilization is within tolerance."""
+    store, dep_ctrl, rs_ctrl, hpa_ctrl, now = world(replicas=2)
+    for p in pods(store):
+        set_metrics(store, p.metadata.name, 50)  # exactly on target
+    hpa = store.get("horizontalpodautoscalers", "default", "hpa")
+    hpa.spec.min_replicas = 5
+    store.update("horizontalpodautoscalers", hpa)
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 5
+
+
+def test_partial_samples_do_not_overscale():
+    """Missing-metrics pods count as idle for a scale-up decision
+    (replica_calculator.go rebalance): 2 measured pods at 100% among 4
+    must not extrapolate 100% to the whole fleet."""
+    store, dep_ctrl, rs_ctrl, hpa_ctrl, now = world(replicas=4)
+    ps = pods(store)
+    assert len(ps) == 4
+    for p in ps[:2]:
+        set_metrics(store, p.metadata.name, 100)  # 2 sampled at 200% of target
+    # rebalanced: (100+100)/(4*100) = 50% == target -> direction flips -> hold
+    hpa_ctrl.sync_all()
+    assert store.get("deployments", "default", "d1").spec.replicas == 4
+
+
+def test_in_manager_roster():
+    from kubernetes_tpu.controllers.manager import DEFAULT_CONTROLLERS
+
+    assert HorizontalPodAutoscalerController in DEFAULT_CONTROLLERS
