@@ -200,19 +200,40 @@ class RemoteStore:
             raise
 
     def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> object:
+        import copy
+        obj = copy.copy(obj)
+        obj.metadata = copy.copy(obj.metadata)
         if expect_rv is not None:
             # carry the CAS revision on the wire object so the server's
             # resourceVersion check enforces it (GuaranteedUpdate contract)
-            import copy
-            obj = copy.copy(obj)
-            obj.metadata = copy.copy(obj.metadata)
             obj.metadata.resource_version = expect_rv
-        try:
-            return self.client.update(kind, obj)
-        except APIStatusError as e:
-            if e.code == 409:
-                raise Conflict(str(e))
-            raise
+            try:
+                return self.client.update(kind, obj)
+            except APIStatusError as e:
+                if e.code == 409:
+                    raise Conflict(str(e))
+                raise
+        # expect_rv=None: last-writer-wins like ObjectStore.update, but
+        # via refetch-and-retry CAS (NativeObjectStore.update parity) so
+        # writes stay properly serialized — a stale mirror rv must not
+        # 409 into Conflict-swallowing callers (they'd silently drop the
+        # write), and skipping the rv check entirely would let a single
+        # round trip clobber unseen concurrent revisions without even
+        # ordering them
+        for _ in range(16):
+            try:
+                return self.client.update(kind, obj)
+            except APIStatusError as e:
+                if e.code != 409:
+                    raise
+                cur = self.client.get(kind, obj.metadata.namespace,
+                                      obj.metadata.name)
+                if cur is None:
+                    raise KeyError(
+                        f"{kind} {obj.metadata.name} not found")
+                obj.metadata.resource_version = \
+                    cur.metadata.resource_version
+        raise Conflict(f"{kind} {obj.metadata.name}: CAS retries exhausted")
 
     def delete(self, kind: str, namespace: str, name: str):
         try:

@@ -69,17 +69,15 @@ class HorizontalPodAutoscalerController(Controller):
             self.enqueue(hpa)
 
     def _enqueue_for_metric(self, m, new=None):
+        # a pod can only be selected by a same-namespace target, and the
+        # rate-limiting queue dedups keys, so namespace-scoped enqueue
+        # coalesces a publish cycle to at most one sync per local HPA —
+        # resolving the exact target per metric would cost more than the
+        # syncs it saves
         m = new if new is not None else m
-        pod = self.store.get("pods", m.metadata.namespace, m.metadata.name)
-        for hpa in self.store.list("horizontalpodautoscalers"):
-            if pod is None:
-                self.enqueue(hpa)
-                continue
-            _, target = self._get_target(hpa)
-            if target is None:
-                continue
-            if any(p.uid == pod.uid for p in self._selected_pods(target)):
-                self.enqueue(hpa)
+        for hpa in self.store.list("horizontalpodautoscalers",
+                                   m.metadata.namespace):
+            self.enqueue(hpa)
 
     # -- metrics source ---------------------------------------------------------
 
@@ -167,11 +165,15 @@ class HorizontalPodAutoscalerController(Controller):
         total_usage = 0
         missing_request = 0
         sampled = 0
+        eligible = 0  # pods with a CPU request: the replica multiplier
+        # counts only these — a request-less pod can't contribute to
+        # utilization, so extrapolating the ratio over it over-scales
         for p in pods:
             request = sum(c.resources.requests.get(res.CPU, 0)
                           for c in p.spec.containers)
             if request <= 0:
                 continue
+            eligible += 1
             usage = self.metrics_fn(p)
             if usage is None:
                 missing_request += request
@@ -202,7 +204,7 @@ class HorizontalPodAutoscalerController(Controller):
                 return ((None, utilization) if desired == current
                         else (desired, utilization))
             ratio = ratio2
-        desired = clamp(math.ceil(ratio * max(len(pods), 1)))
+        desired = clamp(math.ceil(ratio * max(eligible, 1)))
         return (None, utilization) if desired == current \
             else (desired, utilization)
 

@@ -45,12 +45,25 @@ SERVICE_DERIVED = frozenset({"CheckServiceAffinity"})
 
 
 def equivalence_class(pod: api.Pod) -> Optional[int]:
-    """Hash of the controlling owner reference (equivalence_cache.go:240
-    getEquivalenceClassInfo). Pods without a controller get no class —
-    their spec is not provably shared."""
+    """Hash of the controlling owner reference PLUS the
+    scheduling-relevant spec fields the cached predicates actually read
+    (the reference's equivalencePod struct, equivalence_cache.go:240 —
+    hashing the owner ref alone lets a pod that shares a controller but
+    differs in volumes/ports/labels reuse another pod's cached fit).
+    Pods without a controller get no class — their spec is not provably
+    shared."""
     for ref in pod.metadata.owner_references:
         if ref.controller:
-            return hash((ref.kind, ref.name, ref.uid, pod.metadata.namespace))
+            spec = pod.spec
+            vols = tuple((v.name, v.source_kind, v.source_id, v.pvc_name)
+                         for v in spec.volumes)
+            ports = tuple(sorted((p.host_port, p.container_port)
+                                 for c in spec.containers
+                                 for p in c.ports))
+            labels = tuple(sorted((pod.metadata.labels or {}).items()))
+            selector = tuple(sorted(spec.node_selector.items()))
+            return hash((ref.kind, ref.name, ref.uid, pod.metadata.namespace,
+                         vols, ports, labels, selector))
     return None
 
 

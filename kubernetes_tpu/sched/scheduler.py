@@ -167,16 +167,26 @@ class Scheduler:
                 on_update=lambda o, n: self._invalidate_features(),
                 on_delete=lambda o: self._invalidate_features())
         if self.ecache is not None:
-            # targeted ecache invalidation (factory.go:191-295 wiring)
+            # targeted ecache invalidation (factory.go:191-295 wiring).
+            # Must serialize with _run_wave under _mu like the pod/node
+            # handlers: an invalidation racing a wave would otherwise be
+            # overwritten by the wave's stale ecache.update, resurrecting
+            # the entry the event just killed.
+            def _vol_event(*_):
+                with self._mu:
+                    self.ecache.on_volume_event()
+
+            def _svc_event(*_):
+                with self._mu:
+                    self.ecache.on_service_event()
+
             for kind in ("persistentvolumes", "persistentvolumeclaims"):
                 SharedInformer(self.store, kind).add_event_handler(
-                    on_add=lambda o: self.ecache.on_volume_event(),
-                    on_update=lambda o, n: self.ecache.on_volume_event(),
-                    on_delete=lambda o: self.ecache.on_volume_event())
+                    on_add=_vol_event, on_update=_vol_event,
+                    on_delete=_vol_event)
             SharedInformer(self.store, "services").add_event_handler(
-                on_add=lambda o: self.ecache.on_service_event(),
-                on_update=lambda o, n: self.ecache.on_service_event(),
-                on_delete=lambda o: self.ecache.on_service_event())
+                on_add=_svc_event, on_update=_svc_event,
+                on_delete=_svc_event)
 
     def _responsible(self, pod: api.Pod) -> bool:
         return pod.spec.scheduler_name == self.profile.scheduler_name

@@ -250,7 +250,12 @@ class APIServer:
         objs = self.store.list(plural, namespace)
         sel = query.get("labelSelector", [None])[0]
         if sel:
-            pairs = dict(kv.split("=", 1) for kv in sel.split(","))
+            # malformed selectors are client errors, not 500s
+            try:
+                pairs = dict(kv.split("=", 1) for kv in sel.split(","))
+            except ValueError:
+                raise APIError(400, "BadRequest",
+                               f"unparseable labelSelector {sel!r}")
             objs = [o for o in objs
                     if all((o.metadata.labels or {}).get(k) == v
                            for k, v in pairs.items())]
@@ -259,9 +264,16 @@ class APIServer:
             for kv in fsel.split(","):
                 k, _, v = kv.partition("=")
                 if k == "spec.nodeName":
-                    objs = [o for o in objs if o.spec.node_name == v]
+                    # non-pod kinds have no spec.nodeName: match nothing
+                    # rather than 500 on the attribute access
+                    objs = [o for o in objs
+                            if getattr(getattr(o, "spec", None),
+                                       "node_name", None) == v]
                 elif k == "metadata.name":
                     objs = [o for o in objs if o.metadata.name == v]
+                else:
+                    raise APIError(400, "BadRequest",
+                                   f"unsupported fieldSelector {k!r}")
         kind = scheme.kind_for_plural(plural)
         body = json.dumps({
             "kind": kind + "List", "apiVersion": scheme.api_version_for(kind),

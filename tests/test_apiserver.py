@@ -339,6 +339,60 @@ class TestEventRecorder:
         assert len(evs) == 1 and evs[0].count == 2
 
 
+class TestSelectorValidation:
+    """Client input must produce 400s, not 500s, and field selectors on
+    kinds lacking the field must match nothing (round-1 advisor
+    finding)."""
+
+    def test_malformed_label_selector_is_400(self, server, client):
+        client.create("nodes", mknode("n1"))
+        with pytest.raises(APIStatusError) as ei:
+            client.request("GET", "/api/v1/nodes",
+                           query="labelSelector=nonsense-no-equals")
+        assert ei.value.code == 400
+
+    def test_nodename_selector_on_non_pods_matches_nothing(self, server,
+                                                           client):
+        client.create("nodes", mknode("n1"))
+        data = client.request("GET", "/api/v1/nodes",
+                              query="fieldSelector=spec.nodeName=n1")
+        assert data["items"] == []
+
+    def test_unknown_field_selector_is_400(self, server, client):
+        with pytest.raises(APIStatusError) as ei:
+            client.request("GET", "/api/v1/nodes",
+                           query="fieldSelector=status.bogus=1")
+        assert ei.value.code == 400
+
+
+class TestRemoteStoreUpdateSemantics:
+    def test_update_without_expect_rv_is_last_writer_wins(self, server,
+                                                          client):
+        """RemoteStore.update(expect_rv=None) must not 409 on mirror
+        staleness — ObjectStore's drop-in contract is last-writer-wins
+        (round-1 advisor finding: status writers swallow Conflict and
+        silently dropped updates under churn)."""
+        store = RemoteStore(client)
+        store.mirror("nodes")
+        store.wait_for_sync()
+        client.create("nodes", mknode("n1"))
+        deadline = time.monotonic() + 5
+        while store.get("nodes", "default", "n1") is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stale = store.get("nodes", "default", "n1")
+        # another writer bumps the server-side rv past the mirror's copy
+        fresh, _ = client.list("nodes")
+        fresh[0].metadata.labels["x"] = "y"
+        client.update("nodes", fresh[0])
+        # stale-rv write with expect_rv=None must still land
+        stale.status.volumes_in_use = ["pv9"]
+        store.update("nodes", stale)
+        got, _ = client.list("nodes")
+        assert got[0].status.volumes_in_use == ["pv9"]
+        store.stop()
+
+
 class TestSchedulerOverHTTP:
     """The real scheduler driving placements through the HTTP apiserver —
     the reference's test/integration/scheduler shape."""

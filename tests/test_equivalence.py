@@ -47,6 +47,25 @@ class TestClass:
         p = api.Pod(metadata=api.ObjectMeta(name="solo"))
         assert equivalence_class(p) is None
 
+    def test_differing_spec_splits_class(self):
+        """Same controller ref but different scheduling-relevant spec
+        (e.g. volumes) must NOT share cached predicate results — the
+        reference's equivalencePod hashes the spec fields, not just the
+        owner (round-1 advisor finding)."""
+        plain = owned_pod("a")
+        with_vol = owned_pod("b", volume=api.Volume(
+            name="d", source_kind="GCEPersistentDisk", source_id="disk-1"))
+        assert equivalence_class(plain) != equivalence_class(with_vol)
+        # differing host ports split too (PodFitsHostPorts is cached)
+        ported = owned_pod("c")
+        ported.spec.containers[0].ports = [
+            api.ContainerPort(container_port=80, host_port=80)]
+        assert equivalence_class(plain) != equivalence_class(ported)
+        # labels split (CheckServiceAffinity reads them)
+        relabeled = owned_pod("d")
+        relabeled.metadata.labels = {"app": "other"}
+        assert equivalence_class(plain) != equivalence_class(relabeled)
+
 
 class TestCacheMechanics:
     def test_lookup_update_invalidate(self):
