@@ -37,7 +37,50 @@ def register(kind: str, plural: str, typ: type, api_version: str = "v1",
              namespaced: bool = True):
     _REGISTRY[kind] = (plural, typ, api_version, namespaced)
     _BY_PLURAL[plural] = kind
-    _BY_TYPE[typ] = kind
+    # every CRD-defined kind shares api.CustomObject, which tags itself:
+    # the type->kind map keeps only the first (static) binding
+    if typ not in _BY_TYPE:
+        _BY_TYPE[typ] = kind
+
+
+def crd_conflict(crd: "api.CustomResourceDefinition") -> Optional[str]:
+    """Why this CRD may NOT be registered: its names must not collide
+    with a built-in kind or another CRD's plural — a CRD named 'Pod'
+    would otherwise hijack (and, on deletion, unregister) the built-in
+    server-wide."""
+    names = crd.spec.names
+    existing = _REGISTRY.get(names.kind)
+    if existing is not None and existing[1] is not api.CustomObject:
+        return f"kind {names.kind!r} is a built-in type"
+    served_by = _BY_PLURAL.get(names.plural)
+    if served_by is not None and served_by != names.kind:
+        return f"plural {names.plural!r} already served by {served_by!r}"
+    return None
+
+
+def register_dynamic(crd: "api.CustomResourceDefinition"):
+    """Serve a CRD's kind (apiextensions customresource_handler.go:
+    instances decode to api.CustomObject). Raises ValueError on a name
+    collision (see crd_conflict)."""
+    msg = crd_conflict(crd)
+    if msg is not None:
+        raise ValueError(msg)
+    names = crd.spec.names
+    register(names.kind, names.plural, api.CustomObject,
+             f"{crd.spec.group}/{crd.spec.version}",
+             namespaced=crd.spec.scope == "Namespaced")
+
+
+def unregister(kind: str):
+    """Remove a dynamically-registered kind (CRD deletion). Built-in
+    kinds are never unregistered."""
+    entry = _REGISTRY.get(kind)
+    if entry is None or entry[1] is not api.CustomObject:
+        return
+    del _REGISTRY[kind]
+    _BY_PLURAL.pop(entry[0], None)
+    if _BY_TYPE.get(entry[1]) == kind:
+        _BY_TYPE.pop(entry[1], None)
 
 
 register("Pod", "pods", api.Pod)
@@ -69,6 +112,9 @@ register("Lease", "leases", api.LeaseRecord, "coordination.k8s.io/v1",
 register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
          api.HorizontalPodAutoscaler, "autoscaling/v1")
 register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
+register("CustomResourceDefinition", "customresourcedefinitions",
+         api.CustomResourceDefinition, "apiextensions.k8s.io/v1beta1",
+         namespaced=False)
 
 
 def kind_for_plural(plural: str) -> Optional[str]:
@@ -148,9 +194,14 @@ def encode(value) -> Any:
 
 
 def encode_object(obj) -> Dict[str, Any]:
-    """Top-level object -> dict with kind/apiVersion tags."""
-    kind = kind_of(obj)
-    out = {"kind": kind, "apiVersion": api_version_for(kind) if kind else "v1"}
+    """Top-level object -> dict with kind/apiVersion tags. Custom
+    objects carry their own tags (all CRD kinds share one Python type)."""
+    kind = getattr(obj, "kind", None) or kind_of(obj)
+    if kind and kind in _REGISTRY:
+        version = api_version_for(kind)
+    else:
+        version = getattr(obj, "api_version", None) or "v1"
+    out = {"kind": kind, "apiVersion": version}
     out.update(encode(obj))
     return out
 

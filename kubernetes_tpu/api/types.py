@@ -646,6 +646,58 @@ class CronJob:
 
 
 @dataclass
+class CustomResourceNames:
+    """apiextensions CustomResourceDefinitionNames (reference:
+    staging/src/k8s.io/apiextensions-apiserver/pkg/apis/apiextensions/
+    types.go)."""
+
+    kind: str = ""
+    plural: str = ""
+    singular: str = ""
+
+
+@dataclass
+class CustomResourceDefinitionSpec:
+    group: str = ""
+    version: str = "v1"
+    scope: str = "Namespaced"  # or "Cluster"
+    names: CustomResourceNames = field(default_factory=CustomResourceNames)
+
+
+@dataclass
+class CustomResourceDefinition:
+    """Dynamic resource registration: creating one of these makes the
+    apiserver serve CRUD+watch for the named kind (reference:
+    apiextensions-apiserver customresource_handler.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CustomResourceDefinitionSpec = field(
+        default_factory=CustomResourceDefinitionSpec)
+
+
+@dataclass
+class CustomObject:
+    """An instance of a CRD-defined kind: schema-free spec/status plus
+    standard object metadata (the reference's unstructured.Unstructured).
+    Carries its own kind/apiVersion tags because every custom kind shares
+    this Python type."""
+
+    kind: str = ""
+    api_version: str = ""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict[str, object] = field(default_factory=dict)
+    status: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+    @property
+    def namespace(self):
+        return self.metadata.namespace
+
+
+@dataclass
 class CrossVersionObjectReference:
     """autoscaling/v1 CrossVersionObjectReference — the HPA's scale
     target (Deployment/ReplicaSet/ReplicationController/StatefulSet)."""

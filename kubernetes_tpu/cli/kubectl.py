@@ -109,8 +109,11 @@ def _dump(obj, fmt: str, out):
 # -- manifest loading ---------------------------------------------------------
 
 
-def load_manifests(path: str) -> List[object]:
-    """YAML (multi-doc) or JSON manifest -> objects."""
+def load_manifests(path: str) -> List[dict]:
+    """YAML (multi-doc) or JSON manifest -> raw doc dicts. Decoding is
+    deferred to per-doc apply time: a CustomResourceDefinition earlier in
+    the file must register its kind before later docs of that kind can
+    decode (the reference kubectl's sequential server-side discovery)."""
     text = sys.stdin.read() if path == "-" else open(path).read()
     docs: List[dict] = []
     if text.lstrip().startswith("{"):
@@ -118,7 +121,13 @@ def load_manifests(path: str) -> List[object]:
     else:
         import yaml
         docs = [d for d in yaml.safe_load_all(text) if d]
-    return [scheme.decode_object(d) for d in docs]
+    return docs
+
+
+def _decode_doc(doc: dict):
+    obj = scheme.decode_object(doc)
+    kind = getattr(obj, "kind", None) or scheme.kind_of(obj)
+    return obj, kind
 
 
 # -- verbs --------------------------------------------------------------------
@@ -152,20 +161,22 @@ def cmd_describe(client, args, out):
 
 
 def cmd_create(client, args, out):
-    for obj in load_manifests(args.filename):
-        kind = scheme.kind_of(obj)
+    for doc in load_manifests(args.filename):
+        obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
             obj.metadata.namespace = args.namespace
         client.create(plural, obj)
+        if isinstance(obj, api.CustomResourceDefinition):
+            scheme.register_dynamic(obj)  # later docs may use the kind
         out.write(f"{plural}/{obj.metadata.name} created\n")
 
 
 def cmd_apply(client, args, out):
     """Create-or-update (the reference's three-way apply reduced to
     server-side upsert via PUT)."""
-    for obj in load_manifests(args.filename):
-        kind = scheme.kind_of(obj)
+    for doc in load_manifests(args.filename):
+        obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
             obj.metadata.namespace = args.namespace
@@ -180,6 +191,8 @@ def cmd_apply(client, args, out):
                 raise
             client.create(plural, obj)
             out.write(f"{plural}/{obj.metadata.name} created\n")
+        if isinstance(obj, api.CustomResourceDefinition):
+            scheme.register_dynamic(obj)  # later docs may use the kind
 
 
 def cmd_delete(client, args, out):
@@ -337,6 +350,15 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         print("error: --server or $KUBECTL_SERVER required", file=sys.stderr)
         return 1
     client = RESTClient(server, token=args.token)
+    try:
+        # discovery: register served CRDs so custom kinds resolve in
+        # _resolve_kind / decode (the reference kubectl's RESTMapper
+        # discovery against the apiextensions API)
+        crds, _ = client.list("customresourcedefinitions")
+        for crd in crds:
+            scheme.register_dynamic(crd)
+    except Exception:
+        pass  # pre-CRD servers: discovery is best-effort
     try:
         VERBS[args.verb](client, args, out)
         return 0

@@ -226,11 +226,16 @@ class RemoteStore:
             except APIStatusError as e:
                 if e.code != 409:
                     raise
-                cur = self.client.get(kind, obj.metadata.namespace,
-                                      obj.metadata.name)
-                if cur is None:
-                    raise KeyError(
-                        f"{kind} {obj.metadata.name} not found")
+                try:
+                    cur = self.client.get(kind, obj.metadata.namespace,
+                                          obj.metadata.name)
+                except APIStatusError as ge:
+                    if ge.code == 404:
+                        # deleted between the 409 and the refetch: callers
+                        # expect ObjectStore.update's KeyError here
+                        raise KeyError(
+                            f"{kind} {obj.metadata.name} not found")
+                    raise
                 obj.metadata.resource_version = \
                     cur.metadata.resource_version
         raise Conflict(f"{kind} {obj.metadata.name}: CAS retries exhausted")

@@ -37,6 +37,17 @@ class RESTClient:
     def _path(self, plural: str, namespace: Optional[str], name: Optional[str],
               sub: Optional[str] = None) -> str:
         kind = scheme.kind_for_plural(plural)
+        if kind is None:
+            # unknown plural (e.g. a CRD this client hasn't discovered):
+            # send a core-group request and let the server answer 404 —
+            # a URL-building crash would mask the real error
+            parts = ["/api/v1"]
+            if namespace is not None:
+                parts.append(f"namespaces/{namespace}")
+            parts.append(plural)
+            if name:
+                parts.append(name)
+            return "/".join(parts)
         ver = scheme.api_version_for(kind)
         prefix = f"/api/{ver}" if "/" not in ver else f"/apis/{ver}"
         parts = [prefix]

@@ -72,6 +72,28 @@ class APIServer:
         self.metrics_providers = metrics_providers or []
         self.request_count: Dict[str, int] = {}
         self._count_lock = threading.Lock()
+        # CRD-lite (apiextensions-apiserver): creating a
+        # CustomResourceDefinition registers its kind in the scheme so
+        # /apis/<group>/<version>/<plural> CRUD+watch routes resolve;
+        # deleting it unregisters. Pre-existing CRDs (durable store
+        # restart) register during the informer's initial list.
+        from ..runtime.informer import SharedInformer
+
+        def _crd_add(crd):
+            try:
+                scheme.register_dynamic(crd)
+            except ValueError:
+                pass  # conflicting CRD written by a direct store writer
+
+        def _crd_update(old, new):
+            if old.spec.names.kind != new.spec.names.kind:
+                scheme.unregister(old.spec.names.kind)
+            _crd_add(new)
+
+        self._crd_informer = SharedInformer(store, "customresourcedefinitions")
+        self._crd_informer.add_event_handler(
+            on_add=_crd_add, on_update=_crd_update,
+            on_delete=lambda crd: scheme.unregister(crd.spec.names.kind))
 
         server = self
 
@@ -309,10 +331,21 @@ class APIServer:
             self.admission.admit("create", plural, obj, None, user, self.store)
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
+        if plural == "customresourcedefinitions":
+            msg = scheme.crd_conflict(obj)
+            if msg is not None:
+                raise APIError(409, "AlreadyExists", msg)
         try:
             self.store.create(plural, obj)
         except Conflict as e:
             raise APIError(409, "AlreadyExists", str(e))
+        if plural == "customresourcedefinitions":
+            # register synchronously too: with async event dispatch
+            # (NativeObjectStore) the informer may run after this 201 is
+            # sent, 404ing an immediately-following instance create;
+            # register_dynamic is idempotent so the informer's later
+            # delivery is harmless
+            scheme.register_dynamic(obj)
         h._send(201, scheme.to_json(obj).encode())
 
     def _serve_update(self, h, plural, namespace, name, sub, user, patch):
@@ -354,10 +387,20 @@ class APIServer:
             self.admission.admit("update", plural, obj, old, user, self.store)
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
+        if plural == "customresourcedefinitions":
+            if obj.spec.names.kind != old.spec.names.kind:
+                # renamed: drop the retired registration or it would keep
+                # serving (and leak) forever
+                scheme.unregister(old.spec.names.kind)
+            msg = scheme.crd_conflict(obj)
+            if msg is not None:
+                raise APIError(409, "Conflict", msg)
         try:
             self.store.update(plural, obj)
         except Conflict as e:
             raise APIError(409, "Conflict", str(e))
+        if plural == "customresourcedefinitions":
+            scheme.register_dynamic(obj)
         h._send(200, scheme.to_json(obj).encode())
 
     def _serve_delete(self, h, plural, namespace, name, user):
@@ -369,6 +412,8 @@ class APIServer:
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
         self.store.delete(plural, obj.metadata.namespace, obj.metadata.name)
+        if plural == "customresourcedefinitions":
+            scheme.unregister(obj.spec.names.kind)
         h._send(200, _status_body(200, "Success", f"{name} deleted",
                                   status="Success"))
 

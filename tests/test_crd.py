@@ -1,0 +1,225 @@
+"""CRD-lite: dynamic resource registration.
+
+Reference: staging/src/k8s.io/apiextensions-apiserver — creating a
+CustomResourceDefinition makes the apiserver serve the named kind;
+kubectl discovers CRDs; controllers reconcile custom resources.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import scheme
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server.admission import AdmissionChain
+from kubernetes_tpu.server.apiserver import APIServer
+
+
+def widget_crd():
+    return api.CustomResourceDefinition(
+        metadata=api.ObjectMeta(name="widgets.example.com"),
+        spec=api.CustomResourceDefinitionSpec(
+            group="example.com", version="v1",
+            names=api.CustomResourceNames(kind="Widget", plural="widgets",
+                                          singular="widget")))
+
+
+def widget(name, replicas=1):
+    return api.CustomObject(
+        kind="Widget", api_version="example.com/v1",
+        metadata=api.ObjectMeta(name=name),
+        spec={"replicas": replicas, "color": "blue"})
+
+
+@pytest.fixture()
+def clean_scheme():
+    yield
+    scheme.unregister("Widget")
+
+
+@pytest.fixture()
+def server(clean_scheme):
+    srv = APIServer(ObjectStore(), admission=AdmissionChain()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+class TestDynamicRegistration:
+    def test_crd_roundtrip_over_http(self, server, client):
+        # before registration the custom path does not exist
+        with pytest.raises(APIStatusError) as ei:
+            client.list("widgets")
+        assert ei.value.code == 404
+        client.create("customresourcedefinitions", widget_crd())
+        # CRUD on the custom kind
+        client.create("widgets", widget("w1", replicas=3))
+        got = client.get("widgets", "default", "w1")
+        assert got.kind == "Widget"
+        assert got.spec["replicas"] == 3 and got.spec["color"] == "blue"
+        got.spec["replicas"] = 5
+        client.update("widgets", got)
+        items, _ = client.list("widgets")
+        assert len(items) == 1 and items[0].spec["replicas"] == 5
+        client.delete("widgets", "default", "w1")
+        items, _ = client.list("widgets")
+        assert items == []
+
+    def test_crd_delete_unserves_the_kind(self, server, client):
+        client.create("customresourcedefinitions", widget_crd())
+        client.create("widgets", widget("w1"))
+        client.delete("customresourcedefinitions", None,
+                      "widgets.example.com")
+        with pytest.raises(APIStatusError) as ei:
+            client.list("widgets")
+        assert ei.value.code == 404
+
+    def test_crd_cannot_hijack_builtin_kind(self, server, client):
+        """A CRD naming itself 'Pod'/'pods' must be rejected — otherwise
+        it would overwrite the built-in registration and, on deletion,
+        unregister pods server-wide."""
+        bad = api.CustomResourceDefinition(
+            metadata=api.ObjectMeta(name="pods.example.com"),
+            spec=api.CustomResourceDefinitionSpec(
+                group="example.com", version="v1",
+                names=api.CustomResourceNames(kind="Pod", plural="pods")))
+        with pytest.raises(APIStatusError) as ei:
+            client.create("customresourcedefinitions", bad)
+        assert ei.value.code == 409
+        # built-in still served
+        items, _ = client.list("pods")
+        assert items == []
+
+    def test_crd_rename_drops_old_registration(self, server, client):
+        client.create("customresourcedefinitions", widget_crd())
+        client.create("widgets", widget("w1"))
+        crd = client.get("customresourcedefinitions", None,
+                         "widgets.example.com")
+        crd.spec.names = api.CustomResourceNames(
+            kind="Gadget", plural="gadgets", singular="gadget")
+        client.update("customresourcedefinitions", crd)
+        try:
+            with pytest.raises(APIStatusError) as ei:
+                client.list("widgets")
+            assert ei.value.code == 404
+            items, _ = client.list("gadgets")
+            assert isinstance(items, list)
+        finally:
+            scheme.unregister("Gadget")
+
+    def test_preexisting_crds_registered_at_startup(self, clean_scheme):
+        """Durable-store restart: CRDs already in the store serve
+        immediately (the informer's initial list registers them)."""
+        store = ObjectStore()
+        store.create("customresourcedefinitions", widget_crd())
+        scheme.unregister("Widget")  # simulate a fresh process
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            client = RESTClient(srv.url)
+            client.create("widgets", widget("w1"))
+            assert client.get("widgets", "default", "w1") is not None
+        finally:
+            srv.stop()
+
+
+class TestKubectlCRD:
+    def test_kubectl_apply_and_get_custom_resource(self, server, client,
+                                                   tmp_path):
+        import io
+
+        from kubernetes_tpu.cli import kubectl
+
+        manifest = tmp_path / "widget.yaml"
+        manifest.write_text("""\
+kind: CustomResourceDefinition
+apiVersion: apiextensions.k8s.io/v1beta1
+metadata:
+  name: widgets.example.com
+spec:
+  group: example.com
+  version: v1
+  names:
+    kind: Widget
+    plural: widgets
+    singular: widget
+---
+kind: Widget
+apiVersion: example.com/v1
+metadata:
+  name: from-yaml
+spec:
+  replicas: 2
+""")
+        out = io.StringIO()
+        rc = kubectl.main(["--server", server.url, "apply", "-f",
+                           str(manifest)], out=out)
+        assert rc == 0, out.getvalue()
+        assert "widgets/from-yaml created" in out.getvalue()
+        out = io.StringIO()
+        rc = kubectl.main(["--server", server.url, "get", "widgets"],
+                          out=out)
+        assert rc == 0
+        assert "from-yaml" in out.getvalue()
+
+
+class WidgetController(Controller):
+    """Proof that the controller machinery runs unchanged against a
+    custom resource: reconciles Widget.spec.replicas into pods (the
+    operator pattern the reference enables via CRDs + client-go)."""
+
+    name = "widget"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.informer("widgets")
+        self.informer("pods", enqueue_fn=self._pod_owner)
+
+    def _pod_owner(self, pod, new=None):
+        pod = new if new is not None else pod
+        for ref in pod.metadata.owner_references:
+            if ref.kind == "Widget":
+                self.enqueue(f"{pod.namespace}/{ref.name}")
+
+    def sync(self, key: str):
+        ns, name = key.split("/", 1)
+        w = self.store.get("widgets", ns, name)
+        if w is None:
+            return
+        want = int(w.spec.get("replicas", 1))
+        owned = [p for p in self.store.list("pods", ns)
+                 if any(r.kind == "Widget" and r.name == name
+                        for r in p.metadata.owner_references)]
+        for i in range(len(owned), want):
+            self.store.create("pods", api.Pod(
+                metadata=api.ObjectMeta(
+                    name=f"{name}-{i}", namespace=ns,
+                    owner_references=[api.OwnerReference(
+                        kind="Widget", name=name, uid=w.metadata.uid,
+                        controller=True)]),
+                spec=api.PodSpec(containers=[api.Container()])))
+        for p in owned[want:]:
+            self.store.delete("pods", ns, p.metadata.name)
+        w.status["readyReplicas"] = min(want, len(owned))
+        self.store.update("widgets", w)
+
+
+class TestCustomResourceController:
+    def test_widget_controller_reconciles(self, clean_scheme):
+        store = ObjectStore()
+        scheme.register_dynamic(widget_crd())
+        ctrl = WidgetController(store)
+        store.create("widgets", widget("w1", replicas=3))
+        ctrl.sync_all()
+        assert len(store.list("pods")) == 3
+        w = store.get("widgets", "default", "w1")
+        w.spec["replicas"] = 1
+        store.update("widgets", w)
+        ctrl.sync_all()
+        assert len(store.list("pods")) == 1
