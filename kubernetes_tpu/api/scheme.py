@@ -35,6 +35,12 @@ _BY_TYPE: Dict[type, str] = {}
 
 def register(kind: str, plural: str, typ: type, api_version: str = "v1",
              namespaced: bool = True):
+    old = _REGISTRY.get(kind)
+    if old is not None and old[0] != plural:
+        # re-registration under a new plural (CRD rename): the retired
+        # plural must stop resolving or it would route to a registry
+        # entry that may later disappear (KeyError -> 500)
+        _BY_PLURAL.pop(old[0], None)
     _REGISTRY[kind] = (plural, typ, api_version, namespaced)
     _BY_PLURAL[plural] = kind
     # every CRD-defined kind shares api.CustomObject, which tags itself:
@@ -43,26 +49,32 @@ def register(kind: str, plural: str, typ: type, api_version: str = "v1",
         _BY_TYPE[typ] = kind
 
 
-def crd_conflict(crd: "api.CustomResourceDefinition") -> Optional[str]:
+def crd_conflict(crd: "api.CustomResourceDefinition",
+                 replacing: Optional[str] = None) -> Optional[str]:
     """Why this CRD may NOT be registered: its names must not collide
-    with a built-in kind or another CRD's plural — a CRD named 'Pod'
-    would otherwise hijack (and, on deletion, unregister) the built-in
-    server-wide."""
+    with a built-in kind or another CRD — a CRD named 'Pod' would
+    otherwise hijack (and, on deletion, unregister) the built-in
+    server-wide. `replacing` names the kind an update supersedes, so a
+    CRD renaming its own plural doesn't conflict with itself."""
     names = crd.spec.names
     existing = _REGISTRY.get(names.kind)
-    if existing is not None and existing[1] is not api.CustomObject:
-        return f"kind {names.kind!r} is a built-in type"
+    if existing is not None:
+        if existing[1] is not api.CustomObject:
+            return f"kind {names.kind!r} is a built-in type"
+        if names.kind != replacing and existing[0] != names.plural:
+            return f"kind {names.kind!r} already defined by another CRD"
     served_by = _BY_PLURAL.get(names.plural)
-    if served_by is not None and served_by != names.kind:
+    if served_by is not None and served_by not in (names.kind, replacing):
         return f"plural {names.plural!r} already served by {served_by!r}"
     return None
 
 
-def register_dynamic(crd: "api.CustomResourceDefinition"):
+def register_dynamic(crd: "api.CustomResourceDefinition",
+                     replacing: Optional[str] = None):
     """Serve a CRD's kind (apiextensions customresource_handler.go:
     instances decode to api.CustomObject). Raises ValueError on a name
     collision (see crd_conflict)."""
-    msg = crd_conflict(crd)
+    msg = crd_conflict(crd, replacing)
     if msg is not None:
         raise ValueError(msg)
     names = crd.spec.names
@@ -112,6 +124,7 @@ register("Lease", "leases", api.LeaseRecord, "coordination.k8s.io/v1",
 register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
          api.HorizontalPodAutoscaler, "autoscaling/v1")
 register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
+register("LimitRange", "limitranges", api.LimitRange)
 register("CustomResourceDefinition", "customresourcedefinitions",
          api.CustomResourceDefinition, "apiextensions.k8s.io/v1beta1",
          namespaced=False)
@@ -240,6 +253,31 @@ def _decode(value, hint, owner: str = "", fname: str = ""):
     return value
 
 
+# resource-map fields whose values may arrive as quantity strings from
+# YAML/JSON manifests ("100m", "1Gi") and must canonicalize to the int64
+# convention (cpu -> milli, everything else -> base units) — the
+# reference parses resource.Quantity at decode time
+_RESOURCE_MAP_FIELDS = frozenset({
+    "requests", "limits", "capacity", "allocatable", "hard", "used",
+    "usage", "max", "min", "default", "default_request",
+})
+
+
+def _canon_resources(d: Dict[str, Any]) -> Dict[str, Any]:
+    from . import resources as res
+
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, str):
+            # quota keys spell cpu as "requests.cpu"/"limits.cpu" — all
+            # CPU accounting is in milli-units
+            is_cpu = k == res.CPU or k.endswith("." + res.CPU)
+            out[k] = res.milli(v) if is_cpu else res.value(v)
+        else:
+            out[k] = v
+    return out
+
+
 def _decode_dataclass(data: Mapping, cls: type):
     hints = _hints(cls)
     kwargs = {}
@@ -247,7 +285,10 @@ def _decode_dataclass(data: Mapping, cls: type):
         wire = _camel(f.name)
         if wire not in data:
             continue
-        kwargs[f.name] = _decode(data[wire], hints[f.name], cls.__name__, f.name)
+        v = _decode(data[wire], hints[f.name], cls.__name__, f.name)
+        if f.name in _RESOURCE_MAP_FIELDS and isinstance(v, dict):
+            v = _canon_resources(v)
+        kwargs[f.name] = v
     return cls(**kwargs)
 
 

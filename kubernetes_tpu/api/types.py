@@ -240,6 +240,7 @@ class PodSpec:
     priority_class_name: str = ""
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
+    service_account_name: str = ""
 
 
 @dataclass
@@ -643,6 +644,29 @@ class CronJob:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: CronJobSpec = field(default_factory=CronJobSpec)
     status: CronJobStatus = field(default_factory=CronJobStatus)
+
+
+@dataclass
+class LimitRangeItem:
+    """core/v1 LimitRangeItem (consumed by the LimitRanger admission
+    plugin, plugin/pkg/admission/limitranger)."""
+
+    type: str = "Container"  # "Container" | "Pod"
+    max: Dict[str, int] = field(default_factory=dict)
+    min: Dict[str, int] = field(default_factory=dict)
+    default: Dict[str, int] = field(default_factory=dict)
+    default_request: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
 
 
 @dataclass

@@ -1,0 +1,183 @@
+"""kubeadm-lite: one-command cluster bootstrap.
+
+Reference: cmd/kubeadm/app/cmd/init.go (phases: preflight -> control
+plane -> wait -> post-init) and join.go. `init` stands up the full
+control plane in one process — apiserver (durable native store with
+--data-dir, else in-memory), controller manager, scheduler, and
+optionally N hollow nodes — then prints how to connect kubectl.
+`join` registers a hollow kubelet against a running server.
+
+Run as: python -m kubernetes_tpu.cli.kubeadm init [--data-dir D]
+        [--hollow-nodes N] [--port P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from ..api import types as api
+from ..controllers.manager import ControllerManager
+from ..runtime.store import ObjectStore
+from ..sched.scheduler import Scheduler
+from ..server.admission import AdmissionChain
+from ..server.apiserver import APIServer
+
+
+class Cluster:
+    """A running control plane (the object form of `kubeadm init`)."""
+
+    def __init__(self, data_dir: Optional[str] = None, port: int = 0,
+                 hollow_nodes: int = 0, reconcile_endpoints: bool = True):
+        if data_dir:
+            from ..runtime.nativestore import NativeObjectStore
+
+            self.store = NativeObjectStore(path=data_dir)
+        else:
+            self.store = ObjectStore()
+        self.apiserver = APIServer(
+            self.store, admission=AdmissionChain.default(), port=port,
+            reconcile_endpoints=reconcile_endpoints)
+        self.manager = ControllerManager(self.store)
+        self.scheduler = Scheduler(self.store)
+        self.hollow = None
+        self._hollow_nodes = hollow_nodes
+        self._stop = threading.Event()
+        self._sched_thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return self.apiserver.url
+
+    def start(self) -> "Cluster":
+        # phase order mirrors init.go: serve the API first, then the
+        # controllers that need it, then nodes
+        self.apiserver.start()
+        self.manager.start()
+
+        def sched_loop():
+            while not self._stop.is_set():
+                if self.scheduler.run_once(timeout=0.2) == 0:
+                    self._stop.wait(0.02)
+            self.scheduler.close()
+
+        self._sched_thread = threading.Thread(target=sched_loop,
+                                              name="scheduler", daemon=True)
+        self._sched_thread.start()
+        if self._hollow_nodes:
+            from ..kubemark.hollow import HollowCluster
+
+            self.hollow = HollowCluster(self.store, self._hollow_nodes).run()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=5)
+        if self.hollow is not None:
+            self.hollow.stop()
+        self.manager.stop()
+        self.apiserver.stop()
+        close = getattr(self.store, "close", None)
+        if close is not None:
+            close()
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        """Bootstrap settled: default namespace's service account exists
+        (the init.go 'wait for control plane' phase analog)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.manager.sync_all(rounds=1)
+            if self.store.get("serviceaccounts", "default",
+                              "default") is not None:
+                return True
+            time.sleep(0.02)
+        return False
+
+
+def ensure_bootstrap_objects(store):
+    """Seed objects every cluster needs (init.go uploadconfig +
+    bootstrap-token phases analog): the default namespace object."""
+    from ..runtime.store import Conflict
+
+    for name in ("default", "kube-system"):
+        try:
+            store.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name=name),
+                status=api.NamespaceStatus(phase="Active")))
+        except Conflict:
+            pass
+
+
+def cmd_init(args) -> int:
+    cluster = Cluster(data_dir=args.data_dir, port=args.port,
+                      hollow_nodes=args.hollow_nodes)
+    ensure_bootstrap_objects(cluster.store)
+    cluster.start()
+    cluster.wait_ready()
+    print(f"control plane ready at {cluster.url}")
+    print(f"  export KUBECTL_SERVER={cluster.url}")
+    print(f"  python -m kubernetes_tpu.cli.kubectl get nodes")
+    if args.once:
+        cluster.stop()
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        cluster.stop()
+    return 0
+
+
+def cmd_join(args) -> int:
+    from ..client.reflector import RemoteStore
+    from ..client.rest import RESTClient
+    from ..kubemark.hollow import HollowNode
+
+    store = RemoteStore(RESTClient(args.server))
+    for kind in ("pods", "nodes"):
+        store.mirror(kind)
+    store.wait_for_sync()
+    node = HollowNode(store, args.node_name).run()
+    print(f"node {args.node_name} joined {args.server}")
+    if args.once:
+        node.stop()
+        store.stop()
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+        store.stop()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="kubeadm")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_init = sub.add_parser("init", help="bootstrap a control plane")
+    p_init.add_argument("--data-dir", default=None,
+                        help="durable storage path (native WAL+snapshot "
+                             "engine); omit for in-memory")
+    p_init.add_argument("--port", type=int, default=0)
+    p_init.add_argument("--hollow-nodes", type=int, default=0)
+    p_init.add_argument("--once", action="store_true",
+                        help="start, verify, and exit (smoke test)")
+    p_join = sub.add_parser("join", help="join a hollow node")
+    p_join.add_argument("server")
+    p_join.add_argument("--node-name", default="hollow-0")
+    p_join.add_argument("--once", action="store_true")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return {"init": cmd_init, "join": cmd_join}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,4 +29,5 @@ from .serviceaccount import ServiceAccountController
 from .volumebinding import PersistentVolumeController
 from .attachdetach import AttachDetachController
 from .podautoscaler import HorizontalPodAutoscalerController
+from .ttl import TTLController
 from .manager import ControllerManager

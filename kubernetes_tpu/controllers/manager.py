@@ -30,6 +30,7 @@ from .serviceaccount import ServiceAccountController
 from .attachdetach import AttachDetachController
 from .podautoscaler import HorizontalPodAutoscalerController
 from .statefulset import StatefulSetController
+from .ttl import TTLController
 from .volumebinding import PersistentVolumeController
 
 DEFAULT_CONTROLLERS = [
@@ -40,6 +41,7 @@ DEFAULT_CONTROLLERS = [
     PodGCController, GarbageCollector, ResourceQuotaController,
     ServiceAccountController, PersistentVolumeController,
     AttachDetachController, HorizontalPodAutoscalerController,
+    TTLController,
 ]
 
 

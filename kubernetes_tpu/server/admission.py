@@ -165,6 +165,102 @@ class NodeRestriction(AdmissionPlugin):
                     f"{target.spec.node_name}")
 
 
+class LimitRanger(AdmissionPlugin):
+    """plugin/pkg/admission/limitranger: apply per-container request
+    defaults from the namespace's LimitRanges, then enforce min/max on
+    requests (defaulting BEFORE validation, limitranger/admission.go)."""
+
+    name = "LimitRanger"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op != "create":
+            return
+        items = [it for lr in store.list("limitranges", obj.namespace)
+                 for it in lr.spec.limits if it.type == "Container"]
+        if not items:
+            return
+        for c in obj.spec.containers:
+            reqs = c.resources.requests
+            lims = c.resources.limits
+            for it in items:
+                for r, v in it.default.items():
+                    lims.setdefault(r, v)
+                for r, v in it.default_request.items():
+                    # limitranger/admission.go: absent defaultRequest
+                    # falls back to the default limit
+                    reqs.setdefault(r, v)
+                for r, v in it.default.items():
+                    reqs.setdefault(r, v)
+            for it in items:
+                for r, lo in it.min.items():
+                    if reqs.get(r, 0) < lo:
+                        raise AdmissionError(
+                            f"minimum {r} usage per Container is {lo}; "
+                            f"container {c.name!r} requests {reqs.get(r, 0)}")
+                for r, hi in it.max.items():
+                    if reqs.get(r, 0) > hi:
+                        raise AdmissionError(
+                            f"maximum {r} usage per Container is {hi}; "
+                            f"container {c.name!r} requests {reqs.get(r)}")
+                    if r in lims and lims[r] > hi:
+                        raise AdmissionError(
+                            f"maximum {r} usage per Container is {hi}; "
+                            f"container {c.name!r} limits {lims[r]}")
+
+
+class ServiceAccountAdmission(AdmissionPlugin):
+    """plugin/pkg/admission/serviceaccount: default
+    spec.serviceAccountName to 'default' and require the account to
+    exist (admission.go DefaultServiceAccountName + fetch check)."""
+
+    name = "ServiceAccount"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op != "create":
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+        sa = store.get("serviceaccounts", obj.namespace,
+                       obj.spec.service_account_name)
+        if sa is None:
+            raise AdmissionError(
+                f"service account {obj.namespace}/"
+                f"{obj.spec.service_account_name} not found")
+
+
+POD_NODE_SELECTOR_ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+
+class PodNodeSelector(AdmissionPlugin):
+    """plugin/pkg/admission/podnodeselector: merge the namespace's
+    node-selector annotation into pod.spec.nodeSelector; a conflicting
+    pod selector is forbidden."""
+
+    name = "PodNodeSelector"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op != "create":
+            return
+        ns = store.get("namespaces", "", obj.namespace) or \
+            store.get("namespaces", "default", obj.namespace)
+        if ns is None:
+            return
+        raw = (ns.metadata.annotations or {}).get(
+            POD_NODE_SELECTOR_ANNOTATION, "")
+        if not raw:
+            return
+        for pair in raw.split(","):
+            k, _, v = pair.strip().partition("=")
+            if not k:
+                continue
+            cur = obj.spec.node_selector.get(k)
+            if cur is not None and cur != v:
+                raise AdmissionError(
+                    f"pod node selector {k}={cur} conflicts with namespace "
+                    f"node selector {k}={v}")
+            obj.spec.node_selector[k] = v
+
+
 class AdmissionChain:
     """Ordered plugin chain (admission/chain.go chainAdmissionHandler)."""
 
@@ -173,9 +269,14 @@ class AdmissionChain:
 
     @staticmethod
     def default() -> "AdmissionChain":
-        return AdmissionChain([NamespaceLifecycle(), PriorityAdmission(),
+        """The reference's recommended order (kubeapiserver/options/
+        plugins.go): mutators before validators, quota last."""
+        return AdmissionChain([NamespaceLifecycle(), LimitRanger(),
+                               ServiceAccountAdmission(), PodNodeSelector(),
+                               PriorityAdmission(),
                                DefaultTolerationSeconds(),
-                               ResourceQuotaAdmission(), NodeRestriction()])
+                               NodeRestriction(),
+                               ResourceQuotaAdmission()])
 
     def admit(self, op: str, kind: str, obj, old, user: Optional[UserInfo],
               store: ObjectStore):

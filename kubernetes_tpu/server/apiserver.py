@@ -62,7 +62,8 @@ class APIServer:
                  admission: Optional[AdmissionChain] = None,
                  audit_sink: Optional[Callable[[dict], None]] = None,
                  metrics_providers: Optional[List[Callable[[], str]]] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 reconcile_endpoints: bool = False):
         self.store = store
         self.broadcaster = Broadcaster(store)
         self.authenticator = authenticator
@@ -72,6 +73,8 @@ class APIServer:
         self.metrics_providers = metrics_providers or []
         self.request_count: Dict[str, int] = {}
         self._count_lock = threading.Lock()
+        self._reconcile_endpoints = reconcile_endpoints
+        self.endpoint_reconciler = None
         # CRD-lite (apiextensions-apiserver): creating a
         # CustomResourceDefinition registers its kind in the scheme so
         # /apis/<group>/<version>/<plural> CRUD+watch routes resolve;
@@ -88,7 +91,10 @@ class APIServer:
         def _crd_update(old, new):
             if old.spec.names.kind != new.spec.names.kind:
                 scheme.unregister(old.spec.names.kind)
-            _crd_add(new)
+            try:
+                scheme.register_dynamic(new, replacing=old.spec.names.kind)
+            except ValueError:
+                pass  # conflicting CRD from a direct store writer
 
         self._crd_informer = SharedInformer(store, "customresourcedefinitions")
         self._crd_informer.add_event_handler(
@@ -138,9 +144,23 @@ class APIServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="apiserver", daemon=True)
         self._thread.start()
+        if self._reconcile_endpoints:
+            # HA scale-out: publish this replica in the shared
+            # "kubernetes" Endpoints under a lease (master.go:199-248)
+            from .reconciler import EndpointReconciler
+
+            host, port = self.httpd.server_address[:2]
+            # host:port as the replica identity — unlike the reference's
+            # one-IP-per-master assumption, in-process replicas share the
+            # host and differ by port
+            self.endpoint_reconciler = EndpointReconciler(
+                self.store, f"{host}:{port}", port).start()
         return self
 
     def stop(self):
+        if self.endpoint_reconciler is not None:
+            self.endpoint_reconciler.stop()
+            self.endpoint_reconciler = None
         self.httpd.shutdown()
         self.httpd.server_close()
 
@@ -388,11 +408,9 @@ class APIServer:
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
         if plural == "customresourcedefinitions":
-            if obj.spec.names.kind != old.spec.names.kind:
-                # renamed: drop the retired registration or it would keep
-                # serving (and leak) forever
-                scheme.unregister(old.spec.names.kind)
-            msg = scheme.crd_conflict(obj)
+            # validate BEFORE touching the registry or the store: a
+            # rejected rename must leave the old kind fully served
+            msg = scheme.crd_conflict(obj, replacing=old.spec.names.kind)
             if msg is not None:
                 raise APIError(409, "Conflict", msg)
         try:
@@ -400,7 +418,11 @@ class APIServer:
         except Conflict as e:
             raise APIError(409, "Conflict", str(e))
         if plural == "customresourcedefinitions":
-            scheme.register_dynamic(obj)
+            if obj.spec.names.kind != old.spec.names.kind:
+                # renamed: drop the retired registration only now that
+                # the update is durably stored
+                scheme.unregister(old.spec.names.kind)
+            scheme.register_dynamic(obj, replacing=old.spec.names.kind)
         h._send(200, scheme.to_json(obj).encode())
 
     def _serve_delete(self, h, plural, namespace, name, user):

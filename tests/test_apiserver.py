@@ -214,6 +214,10 @@ class TestAuth:
 class TestAdmission:
     def make(self):
         store = ObjectStore()
+        # the ServiceAccount plugin requires the pod's SA to exist; in a
+        # full stack the SA controller provides it per namespace
+        store.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace="default")))
         srv = APIServer(store, admission=AdmissionChain.default()).start()
         return srv, RESTClient(srv.url)
 
@@ -225,6 +229,8 @@ class TestAdmission:
             assert ei.value.code == 403
             client.create("namespaces", api.Namespace(
                 metadata=api.ObjectMeta(name="made")))
+            client.create("serviceaccounts", api.ServiceAccount(
+                metadata=api.ObjectMeta(name="default", namespace="made")))
             client.create("pods", mkpod("p1", ns="made"))
         finally:
             srv.stop()

@@ -1,0 +1,235 @@
+"""Long-tail control-plane surface: new admission plugins, the TTL
+controller, HA endpoint reconciliation, and kubeadm-lite bootstrap.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server.admission import (AdmissionChain, AdmissionError,
+                                             LimitRanger, PodNodeSelector,
+                                             ServiceAccountAdmission)
+
+from helpers import make_node, make_pod
+
+
+def mkpod(name, cpu=None, **kw):
+    return make_pod(name, cpu=cpu, **kw)
+
+
+class TestLimitRanger:
+    def _store(self):
+        store = ObjectStore()
+        store.create("limitranges", api.LimitRange(
+            metadata=api.ObjectMeta(name="lr"),
+            spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+                type="Container",
+                default_request={"cpu": 200},
+                min={"cpu": 100}, max={"cpu": 2000})])))
+        return store, LimitRanger()
+
+    def test_defaults_applied(self):
+        store, lr = self._store()
+        pod = mkpod("p")  # no cpu request
+        lr.admit("create", "pods", pod, None, None, store)
+        assert pod.spec.containers[0].resources.requests["cpu"] == 200
+
+    def test_min_max_enforced(self):
+        store, lr = self._store()
+        small = mkpod("s", cpu="50m")
+        with pytest.raises(AdmissionError):
+            lr.admit("create", "pods", small, None, None, store)
+        big = mkpod("b", cpu="3")
+        with pytest.raises(AdmissionError):
+            lr.admit("create", "pods", big, None, None, store)
+        ok = mkpod("ok", cpu="1")
+        lr.admit("create", "pods", ok, None, None, store)
+
+
+class TestLimitRangerLimits:
+    def test_default_limits_applied_and_enforced(self):
+        store = ObjectStore()
+        store.create("limitranges", api.LimitRange(
+            metadata=api.ObjectMeta(name="lr"),
+            spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+                type="Container", default={"cpu": 500},
+                max={"cpu": 2000})])))
+        lr = LimitRanger()
+        pod = mkpod("p")
+        lr.admit("create", "pods", pod, None, None, store)
+        c = pod.spec.containers[0]
+        assert c.resources.limits["cpu"] == 500
+        assert c.resources.requests["cpu"] == 500  # falls back to default
+        over = mkpod("o", cpu="1")
+        over.spec.containers[0].resources.limits = {"cpu": 5000}
+        with pytest.raises(AdmissionError):
+            lr.admit("create", "pods", over, None, None, store)
+
+
+class TestQuantityDecoding:
+    def test_quota_cpu_keys_decode_to_milli(self):
+        from kubernetes_tpu.api import scheme
+
+        rq = scheme.decode("ResourceQuota", {
+            "metadata": {"name": "q"},
+            "spec": {"hard": {"requests.cpu": "500m", "cpu": "2",
+                              "requests.memory": "1Gi", "pods": 5}}})
+        assert rq.spec.hard["requests.cpu"] == 500
+        assert rq.spec.hard["cpu"] == 2000
+        assert rq.spec.hard["requests.memory"] == 1 << 30
+        assert rq.spec.hard["pods"] == 5
+
+
+class TestServiceAccountAdmission:
+    def test_defaults_and_requires_sa(self):
+        store = ObjectStore()
+        sa = ServiceAccountAdmission()
+        pod = mkpod("p")
+        with pytest.raises(AdmissionError):
+            sa.admit("create", "pods", pod, None, None, store)
+        store.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace="default")))
+        sa.admit("create", "pods", pod, None, None, store)
+        assert pod.spec.service_account_name == "default"
+
+
+class TestPodNodeSelector:
+    def test_namespace_selector_merged(self):
+        store = ObjectStore()
+        store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(
+                name="default",
+                annotations={
+                    "scheduler.alpha.kubernetes.io/node-selector":
+                        "pool=batch"})))
+        pns = PodNodeSelector()
+        pod = mkpod("p")
+        pns.admit("create", "pods", pod, None, None, store)
+        assert pod.spec.node_selector["pool"] == "batch"
+        conflicting = mkpod("q", node_selector={"pool": "web"})
+        with pytest.raises(AdmissionError):
+            pns.admit("create", "pods", conflicting, None, None, store)
+
+
+class TestTTLController:
+    def test_ttl_scales_with_cluster_size(self):
+        from kubernetes_tpu.controllers.ttl import (TTL_ANNOTATION,
+                                                    TTLController,
+                                                    ttl_for_size)
+
+        assert ttl_for_size(10) == 0
+        assert ttl_for_size(400) == 15
+        assert ttl_for_size(900) == 30
+        assert ttl_for_size(4000) == 60
+        assert ttl_for_size(9000) == 300
+        store = ObjectStore()
+        ctrl = TTLController(store)
+        for i in range(3):
+            store.create("nodes", make_node(f"n{i}"))
+        ctrl.sync_all()
+        for n in store.list("nodes"):
+            assert n.metadata.annotations[TTL_ANNOTATION] == "0"
+
+    def test_in_manager_roster(self):
+        from kubernetes_tpu.controllers.manager import DEFAULT_CONTROLLERS
+        from kubernetes_tpu.controllers.ttl import TTLController
+
+        assert TTLController in DEFAULT_CONTROLLERS
+
+
+class TestEndpointReconciler:
+    def test_two_replicas_publish_and_prune(self):
+        from kubernetes_tpu.server.reconciler import EndpointReconciler
+
+        store = ObjectStore()
+        now = [1000.0]
+        a = EndpointReconciler(store, "10.0.0.1:6443", 6443, ttl=15,
+                               clock=lambda: now[0])
+        b = EndpointReconciler(store, "10.0.0.2:6443", 6443, ttl=15,
+                               clock=lambda: now[0])
+        a.reconcile()
+        b.reconcile()
+        ep = store.get("endpoints", "default", "kubernetes")
+        ips = {addr.ip for addr in ep.subsets[0].addresses}
+        assert ips == {"10.0.0.1:6443", "10.0.0.2:6443"}
+        # replica a dies (stops refreshing); b's reconcile prunes it
+        now[0] += 20
+        b.reconcile()
+        ep = store.get("endpoints", "default", "kubernetes")
+        ips = {addr.ip for addr in ep.subsets[0].addresses}
+        assert ips == {"10.0.0.2:6443"}
+
+    def test_clean_shutdown_removes_address(self):
+        from kubernetes_tpu.server.apiserver import APIServer
+        from kubernetes_tpu.server.admission import AdmissionChain
+
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain(),
+                        reconcile_endpoints=True).start()
+        ep = store.get("endpoints", "default", "kubernetes")
+        assert ep is not None and len(ep.subsets[0].addresses) == 1
+        srv.stop()
+        ep = store.get("endpoints", "default", "kubernetes")
+        assert ep.subsets[0].addresses == []
+
+
+class TestKubeadm:
+    def test_init_boots_a_working_cluster(self, tmp_path):
+        """kubeadm init analog: one call stands up apiserver +
+        controllers + scheduler on the durable store; a deployment
+        applied via kubectl ends up with scheduled pods."""
+        import io
+
+        from kubernetes_tpu.cli import kubeadm, kubectl
+
+        cluster = kubeadm.Cluster(data_dir=str(tmp_path / "kv"),
+                                  hollow_nodes=3)
+        kubeadm.ensure_bootstrap_objects(cluster.store)
+        cluster.start()
+        try:
+            assert cluster.wait_ready(timeout=15)
+            manifest = tmp_path / "dep.yaml"
+            manifest.write_text("""\
+kind: Deployment
+apiVersion: apps/v1
+metadata:
+  name: web
+spec:
+  replicas: 3
+  selector:
+    matchLabels: {app: web}
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+      - name: c
+        resources:
+          requests: {cpu: 100m, memory: 64Mi}
+""")
+            out = io.StringIO()
+            rc = kubectl.main(["--server", cluster.url, "apply", "-f",
+                               str(manifest)], out=out)
+            assert rc == 0, out.getvalue()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                pods = [p for p in cluster.store.list("pods")
+                        if (p.metadata.labels or {}).get("app") == "web"]
+                if len(pods) == 3 and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"pods never scheduled: "
+                    f"{[(p.metadata.name, p.spec.node_name) for p in pods]}")
+        finally:
+            cluster.stop()
+
+    def test_cli_smoke(self, tmp_path):
+        from kubernetes_tpu.cli import kubeadm
+
+        rc = kubeadm.main(["init", "--once",
+                           "--data-dir", str(tmp_path / "kv")])
+        assert rc == 0

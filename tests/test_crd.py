@@ -97,6 +97,40 @@ class TestDynamicRegistration:
         items, _ = client.list("pods")
         assert items == []
 
+    def test_rejected_rename_keeps_old_kind_served(self, server, client):
+        """A rename that fails validation (e.g. to a built-in name) must
+        leave the original registration fully intact."""
+        client.create("customresourcedefinitions", widget_crd())
+        client.create("widgets", widget("w1"))
+        crd = client.get("customresourcedefinitions", None,
+                         "widgets.example.com")
+        crd.spec.names = api.CustomResourceNames(kind="Pod", plural="pods")
+        with pytest.raises(APIStatusError) as ei:
+            client.update("customresourcedefinitions", crd)
+        assert ei.value.code == 409
+        items, _ = client.list("widgets")  # still served
+        assert len(items) == 1
+
+    def test_plural_rename_drops_stale_route(self, server, client):
+        """Renaming only the plural must retire the old URL — a stale
+        _BY_PLURAL entry would 500 after the CRD is later deleted."""
+        client.create("customresourcedefinitions", widget_crd())
+        crd = client.get("customresourcedefinitions", None,
+                         "widgets.example.com")
+        crd.spec.names = api.CustomResourceNames(
+            kind="Widget", plural="doodads", singular="doodad")
+        client.update("customresourcedefinitions", crd)
+        with pytest.raises(APIStatusError) as ei:
+            client.list("widgets")
+        assert ei.value.code == 404
+        items, _ = client.list("doodads")
+        assert isinstance(items, list)
+        client.delete("customresourcedefinitions", None,
+                      "widgets.example.com")
+        with pytest.raises(APIStatusError) as ei:
+            client.list("widgets")  # must 404, not 500
+        assert ei.value.code == 404
+
     def test_crd_rename_drops_old_registration(self, server, client):
         client.create("customresourcedefinitions", widget_crd())
         client.create("widgets", widget("w1"))
