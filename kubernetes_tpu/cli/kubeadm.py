@@ -117,7 +117,11 @@ def cmd_init(args) -> int:
                       hollow_nodes=args.hollow_nodes)
     ensure_bootstrap_objects(cluster.store)
     cluster.start()
-    cluster.wait_ready()
+    if not cluster.wait_ready():
+        print("error: control plane did not become ready "
+              "(default service account never appeared)", file=sys.stderr)
+        cluster.stop()
+        return 1
     print(f"control plane ready at {cluster.url}")
     print(f"  export KUBECTL_SERVER={cluster.url}")
     print(f"  python -m kubernetes_tpu.cli.kubectl get nodes")

@@ -27,10 +27,15 @@ class APIStatusError(Exception):
 
 class RESTClient:
     def __init__(self, base_url: str, token: Optional[str] = None,
-                 user_agent: str = "kubernetes-tpu-client"):
+                 user_agent: str = "kubernetes-tpu-client",
+                 binary: bool = False):
+        """binary=True negotiates the compact binary wire codec for GETs
+        (api/binary.py — the reference's
+        application/vnd.kubernetes.protobuf role)."""
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.user_agent = user_agent
+        self.binary = binary
 
     # -- plumbing --------------------------------------------------------------
 
@@ -60,18 +65,22 @@ class RESTClient:
             parts.append(sub)
         return "/".join(parts)
 
-    def request(self, method: str, path: str, body: Optional[dict] = None,
-                query: str = "") -> dict:
+    def request_bytes(self, method: str, path: str,
+                      body: Optional[dict] = None, query: str = "",
+                      accept: Optional[str] = None):
+        """Raw round trip -> (body bytes, response Content-Type)."""
         url = self.base_url + path + (f"?{query}" if query else "")
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
         req.add_header("User-Agent", self.user_agent)
+        if accept:
+            req.add_header("Accept", accept)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
-                return json.loads(resp.read() or b"{}")
+                return resp.read(), resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             try:
                 status = json.loads(e.read())
@@ -79,6 +88,11 @@ class RESTClient:
                 status = {}
             raise APIStatusError(e.code, status.get("reason", e.reason or ""),
                                  status.get("message", ""))
+
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                query: str = "") -> dict:
+        raw, _ = self.request_bytes(method, path, body=body, query=query)
+        return json.loads(raw or b"{}")
 
     # -- verbs -----------------------------------------------------------------
 
@@ -94,15 +108,35 @@ class RESTClient:
         if field_selector:
             q.append("fieldSelector=" + ",".join(
                 f"{k}={v}" for k, v in field_selector.items()))
-        data = self.request("GET", self._path(plural, namespace, None),
-                            query="&".join(q))
+        path = self._path(plural, namespace, None)
+        if self.binary:
+            from ..api import binary
+
+            raw, ctype = self.request_bytes("GET", path,
+                                            query="&".join(q),
+                                            accept=binary.CONTENT_TYPE)
+            if ctype.startswith(binary.CONTENT_TYPE):
+                return binary.loads_list(raw)
+            data = json.loads(raw or b"{}")
+        else:
+            data = self.request("GET", path, query="&".join(q))
         kind = scheme.kind_for_plural(plural)
         items = [scheme.decode(kind, d) for d in data.get("items", [])]
         rv = int(data.get("metadata", {}).get("resourceVersion", "0"))
         return items, rv
 
     def get(self, plural: str, namespace: Optional[str], name: str):
-        data = self.request("GET", self._path(plural, namespace, name))
+        path = self._path(plural, namespace, name)
+        if self.binary:
+            from ..api import binary
+
+            raw, ctype = self.request_bytes("GET", path,
+                                            accept=binary.CONTENT_TYPE)
+            if ctype.startswith(binary.CONTENT_TYPE):
+                return binary.loads(raw)
+            return scheme.decode(scheme.kind_for_plural(plural),
+                                 json.loads(raw or b"{}"))
+        data = self.request("GET", path)
         return scheme.decode(scheme.kind_for_plural(plural), data)
 
     def create(self, plural: str, obj, namespace: Optional[str] = None):

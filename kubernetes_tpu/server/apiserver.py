@@ -317,16 +317,36 @@ class APIServer:
                     raise APIError(400, "BadRequest",
                                    f"unsupported fieldSelector {k!r}")
         kind = scheme.kind_for_plural(plural)
+        if self._wants_binary(h):
+            from ..api import binary
+
+            h._send(200, binary.dumps_list(
+                kind, objs, self.store.latest_resource_version),
+                content_type=binary.CONTENT_TYPE)
+            return
         body = json.dumps({
             "kind": kind + "List", "apiVersion": scheme.api_version_for(kind),
             "metadata": {"resourceVersion": str(self.store.latest_resource_version)},
             "items": [scheme.encode_object(o) for o in objs]}).encode()
         h._send(200, body)
 
+    @staticmethod
+    def _wants_binary(h) -> bool:
+        """Content negotiation (the reference negotiates
+        application/vnd.kubernetes.protobuf the same way)."""
+        from ..api import binary
+
+        return binary.CONTENT_TYPE in (h.headers.get("Accept") or "")
+
     def _serve_get(self, h, plural, namespace, name):
         obj = self._find(plural, namespace, name)
         if obj is None:
             raise APIError(404, "NotFound", f"{plural} {name!r} not found")
+        if self._wants_binary(h):
+            from ..api import binary
+
+            h._send(200, binary.dumps(obj), content_type=binary.CONTENT_TYPE)
+            return
         h._send(200, scheme.to_json(obj).encode())
 
     def _read_body(self, h) -> dict:
@@ -418,9 +438,13 @@ class APIServer:
         except Conflict as e:
             raise APIError(409, "Conflict", str(e))
         if plural == "customresourcedefinitions":
+            # with the in-process store the CRD informer already applied
+            # this synchronously inside store.update; this inline pass is
+            # for stores with async watch dispatch (NativeObjectStore),
+            # where the informer may run after the 200 is sent. Both
+            # paths are idempotent registry ops, so double execution is
+            # harmless.
             if obj.spec.names.kind != old.spec.names.kind:
-                # renamed: drop the retired registration only now that
-                # the update is durably stored
                 scheme.unregister(old.spec.names.kind)
             scheme.register_dynamic(obj, replacing=old.spec.names.kind)
         h._send(200, scheme.to_json(obj).encode())

@@ -98,4 +98,8 @@ class EndpointReconciler:
 
     def stop(self):
         self._stop.set()
+        if self._thread is not None:
+            # an in-flight reconcile() would re-publish our lease right
+            # after remove() pruned it — drain the loop first
+            self._thread.join(timeout=5)
         self.remove()
