@@ -25,6 +25,8 @@ from ..controllers.nodelifecycle import HEARTBEAT_ANNOTATION
 from ..plugins import golden
 from ..runtime.store import Conflict
 from ..state.node_info import NodeInfo
+from .pleg import PLEG
+from .pod_workers import PodWorkers
 from .runtime import EXITED, RUNNING, FakeRuntime
 
 
@@ -45,11 +47,33 @@ class Kubelet:
                  runtime: Optional[FakeRuntime] = None,
                  clock=time.time,
                  heartbeat_period: float = 10.0,
-                 memory_pressure_threshold: float = 0.9):
+                 memory_pressure_threshold: float = 0.9,
+                 resync_interval: float = 0.0,
+                 async_workers: bool = False):
+        """resync_interval=0 fully resyncs every pod each iteration (the
+        deterministic test mode); >0 switches to event-driven syncs —
+        only pods with config changes or PLEG events sync between full
+        resyncs, the reference's steady-state shape."""
         self.store = store
         self.node_name = node_name
         self.clock = clock
         self.runtime = runtime or FakeRuntime()
+        self.pleg = PLEG(self.runtime)
+        if async_workers and not getattr(store, "async_bind_safe", False):
+            # in-process ObjectStore dispatches watch events under its
+            # lock: status writes from worker threads could deadlock
+            # against another component's handler lock (same reasoning
+            # as the scheduler's async-bind gate) — fall back to inline
+            async_workers = False
+        self.pod_workers = PodWorkers(self._sync_pod,
+                                      async_mode=async_workers)
+        self.resync_interval = resync_interval
+        self._last_full_resync = -1e18
+        self._known_pod_rvs: Dict[str, int] = {}
+        # pods whose sync early-returned on a precondition (node not yet
+        # visible, volumes not attached): re-dispatched next iteration
+        # even without an event/rv change
+        self._needs_retry: set = set()
         self.heartbeat_period = heartbeat_period
         self.memory_pressure_threshold = memory_pressure_threshold
         self.allocatable = allocatable or api.resource_list(
@@ -58,6 +82,7 @@ class Kubelet:
         self.taints = list(taints or [])
         self._probe_state: Dict[tuple, _ProbeState] = {}
         self._pod_start: Dict[str, float] = {}
+        self._iter_node: Optional[api.Node] = None
         self._last_heartbeat = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -120,7 +145,7 @@ class Kubelet:
     # -- admission (lifecycle/predicate.go canAdmitPod) ------------------------
 
     def _admit(self, pod: api.Pod, active: List[api.Pod]) -> (bool, str):
-        node = self._get_node()
+        node = self._iter_node or self._get_node()
         if node is None:
             # node object not visible yet (informer lag right after
             # registration): admit later, keep the pod Pending
@@ -135,18 +160,49 @@ class Kubelet:
     # -- the sync loop ---------------------------------------------------------
 
     def sync_once(self, now: Optional[float] = None) -> None:
-        """One syncLoopIteration: PLEG tick, per-pod sync, probes,
-        eviction housekeeping, heartbeat."""
+        """One syncLoopIteration (kubelet.go:1787): select over config
+        updates (pod spec changes seen via resourceVersion), PLEG events
+        (runtime state transitions), and the periodic full resync; then
+        probes, eviction housekeeping, heartbeat. Pod syncs dispatch
+        through the per-pod workers."""
         now = now if now is not None else self.clock()
         self.runtime.tick(now)
+        self._iter_node = self._get_node()  # one node fetch per iteration
         pods = self._my_pods()
         active = [p for p in pods
                   if p.status.phase in ("", "Pending", "Running")]
-        for pod in pods:
-            self._sync_pod(pod, now, active)
+        pleg_events = self.pleg.relist()
+        full = (self.resync_interval <= 0
+                or now - self._last_full_resync >= self.resync_interval)
+        if full:
+            to_sync = pods
+            self._last_full_resync = now
+        else:
+            dirty = {e.pod_uid for e in pleg_events} | set(self._needs_retry)
+            to_sync = []
+            for p in pods:
+                rv = p.metadata.resource_version
+                if (p.metadata.uid in dirty
+                        or self._known_pod_rvs.get(p.metadata.uid) != rv
+                        or self._has_probes(p)):
+                    # probed pods sync every iteration: health bits flip
+                    # without a state transition or rv change (the
+                    # reference runs probes in their own worker loop)
+                    to_sync.append(p)
+        for pod in to_sync:
+            self._known_pod_rvs[pod.metadata.uid] = \
+                pod.metadata.resource_version
+            self.pod_workers.update_pod(pod, now, active)
         self._housekeeping(now)
         if now - self._last_heartbeat >= self.heartbeat_period:
             self.heartbeat(now, memory_pressure=self._memory_pressure())
+        self._iter_node = None
+
+    @staticmethod
+    def _has_probes(pod: api.Pod) -> bool:
+        return any(c.liveness_probe is not None
+                   or c.readiness_probe is not None
+                   for c in pod.spec.containers)
 
     def _sync_pod(self, pod: api.Pod, now: float, active: List[api.Pod]):
         """syncPod (kubelet.go:1389): admit, start containers, compute
@@ -154,10 +210,13 @@ class Kubelet:
         if pod.status.phase in ("Succeeded", "Failed"):
             return
         uid = pod.metadata.uid
+        self._needs_retry.discard(uid)
         if uid not in self._pod_start:
             ok, reason = self._admit(pod, active)
             if not ok and reason == "NodeNotVisible":
-                return  # transient: retry next sync without failing the pod
+                # transient: retry next sync without failing the pod
+                self._needs_retry.add(uid)
+                return
             if not ok:
                 pod.status.phase = "Failed"
                 pod.status.conditions = [("PodScheduled", "True"),
@@ -165,6 +224,13 @@ class Kubelet:
                 self._update_status(pod)
                 return
             self._pod_start[uid] = now
+        if not self._volumes_ready(pod):
+            # volume manager (pkg/kubelet/volumemanager/):
+            # WaitForAttachAndMount — containers must not start until the
+            # attach/detach controller reports the pod's PVs attached to
+            # this node; retried on later syncs
+            self._needs_retry.add(uid)
+            return
         for c in pod.spec.containers:
             st = self.runtime.get(uid, c.name)
             if st is None or st.state not in (RUNNING,):
@@ -178,6 +244,22 @@ class Kubelet:
                 self.runtime.start_container(uid, c.name, now)
         self._run_probes(pod, now)
         self._update_pod_status(pod, now)
+
+    def _volumes_ready(self, pod: api.Pod) -> bool:
+        """All PV-backed volumes attached to this node?"""
+        claims = [v.pvc_name for v in pod.spec.volumes if v.pvc_name]
+        if not claims:
+            return True
+        node = self._iter_node or self._get_node()
+        attached = set(node.status.volumes_attached) if node else set()
+        for cname in claims:
+            pvc = self.store.get("persistentvolumeclaims", pod.namespace,
+                                 cname)
+            if pvc is None or not pvc.spec.volume_name:
+                return False
+            if pvc.spec.volume_name not in attached:
+                return False
+        return True
 
     def _run_probes(self, pod: api.Pod, now: float):
         """prober/worker.go probe loop against the runtime's health bits."""
@@ -262,9 +344,14 @@ class Kubelet:
     def _housekeeping(self, now: float):
         # clean up runtime state for pods that vanished from the apiserver
         live_uids = {p.metadata.uid for p in self._my_pods()}
-        for uid in [u for u in self._pod_start if u not in live_uids]:
+        # snapshot first: async pod workers may insert into _pod_start
+        # concurrently (plain membership iteration would RuntimeError)
+        for uid in [u for u in list(self._pod_start) if u not in live_uids]:
             self.runtime.kill_pod(uid)
             self._pod_start.pop(uid, None)
+            self._known_pod_rvs.pop(uid, None)
+            self._needs_retry.discard(uid)
+            self.pod_workers.forget(uid)
         # eviction: under memory pressure, evict BestEffort pods first,
         # then highest-usage burstable (eviction/helpers.go rankMemoryPressure)
         if not self._memory_pressure():
@@ -304,3 +391,4 @@ class Kubelet:
 
     def stop(self):
         self._stop.set()
+        self.pod_workers.stop()

@@ -74,6 +74,13 @@ class FakeRuntime:
                 self.containers.pop(key, None)
                 self._pending_start.pop(key, None)
 
+    def snapshot(self):
+        """Consistent {(pod_uid, name): (state, restart_count)} view —
+        the PLEG relist source (keeps the locking in here)."""
+        with self._lock:
+            return {k: (cs.state, cs.restart_count)
+                    for k, cs in self.containers.items()}
+
     def get(self, pod_uid: str, name: str) -> Optional[ContainerState]:
         with self._lock:
             return self.containers.get((pod_uid, name))
