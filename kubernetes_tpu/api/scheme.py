@@ -125,6 +125,9 @@ register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
          api.HorizontalPodAutoscaler, "autoscaling/v1")
 register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
 register("LimitRange", "limitranges", api.LimitRange)
+register("CertificateSigningRequest", "certificatesigningrequests",
+         api.CertificateSigningRequest, "certificates.k8s.io/v1beta1",
+         namespaced=False)
 register("CustomResourceDefinition", "customresourcedefinitions",
          api.CustomResourceDefinition, "apiextensions.k8s.io/v1beta1",
          namespaced=False)

@@ -906,6 +906,41 @@ class ServiceAccount:
 
 
 @dataclass
+class CertificateSigningRequestSpec:
+    """certificates/v1beta1 (reference: pkg/apis/certificates/types.go;
+    controllers pkg/controller/certificates/)."""
+
+    request: str = ""  # CSR payload (PEM in the reference; opaque here)
+    username: str = ""
+    groups: List[str] = field(default_factory=list)
+    usages: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CertificateSigningRequestStatus:
+    # conditions: list of (type, reason) — "Approved"/"Denied"
+    conditions: List[Tuple[str, str]] = field(default_factory=list)
+    certificate: str = ""  # issued by the signer once approved
+
+
+@dataclass
+class CertificateSigningRequest:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CertificateSigningRequestSpec = field(
+        default_factory=CertificateSigningRequestSpec)
+    status: CertificateSigningRequestStatus = field(
+        default_factory=CertificateSigningRequestStatus)
+
+    @property
+    def approved(self) -> bool:
+        return any(t == "Approved" for t, _ in self.status.conditions)
+
+    @property
+    def denied(self) -> bool:
+        return any(t == "Denied" for t, _ in self.status.conditions)
+
+
+@dataclass
 class Secret:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     type: str = "Opaque"

@@ -30,4 +30,5 @@ from .volumebinding import PersistentVolumeController
 from .attachdetach import AttachDetachController
 from .podautoscaler import HorizontalPodAutoscalerController
 from .ttl import TTLController
+from .certificates import CSRApprovingController, CSRSigningController
 from .manager import ControllerManager

@@ -1,0 +1,70 @@
+"""Certificates controllers: CSR auto-approval and signing.
+
+Reference: pkg/controller/certificates/ — the approver
+(approver/sarapprove.go) auto-approves kubelet CSRs whose requestor is
+the node itself (self-node client certs), and the signer
+(signer/signer.go) issues the certificate for approved CSRs. Real x509
+is out of scope for the framework (the reference shells out to a CA
+keypair); the control-loop contract — request -> approve/deny ->
+signed status.certificate consumable by the requester — is what this
+reproduces, with an opaque token standing in for the PEM blob.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..api import types as api
+from .base import Controller
+
+KUBELET_USAGES = {"digital signature", "key encipherment", "client auth"}
+
+
+def is_self_node_csr(csr: api.CertificateSigningRequest) -> bool:
+    """approver/sarapprove.go isSelfNodeClientCert: requested by a node
+    for its own identity, with exactly the kubelet client usages."""
+    if not csr.spec.username.startswith("system:node:"):
+        return False
+    if "system:nodes" not in csr.spec.groups:
+        return False
+    return set(csr.spec.usages) == KUBELET_USAGES
+
+
+class CSRApprovingController(Controller):
+    name = "csrapproving"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.informer("certificatesigningrequests")
+
+    def sync(self, key: str):
+        name = key.split("/", 1)[-1]
+        csr = self.store.get("certificatesigningrequests", "default", name) \
+            or self.store.get("certificatesigningrequests", "", name)
+        if csr is None or csr.approved or csr.denied:
+            return
+        if is_self_node_csr(csr):
+            csr.status.conditions.append(
+                ("Approved", "AutoApproved self node client cert"))
+            self.store.update("certificatesigningrequests", csr)
+
+
+class CSRSigningController(Controller):
+    name = "csrsigning"
+
+    def __init__(self, store, ca_name: str = "kubernetes-tpu-ca"):
+        super().__init__(store)
+        self.ca_name = ca_name
+        self.informer("certificatesigningrequests")
+
+    def sync(self, key: str):
+        name = key.split("/", 1)[-1]
+        csr = self.store.get("certificatesigningrequests", "default", name) \
+            or self.store.get("certificatesigningrequests", "", name)
+        if csr is None or not csr.approved or csr.status.certificate:
+            return
+        digest = hashlib.sha256(
+            f"{self.ca_name}/{csr.spec.username}/{csr.spec.request}"
+            .encode()).hexdigest()
+        csr.status.certificate = f"cert:{csr.spec.username}:{digest[:32]}"
+        self.store.update("certificatesigningrequests", csr)

@@ -28,6 +28,7 @@ from .replicaset import ReplicaSetController, ReplicationControllerController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .attachdetach import AttachDetachController
+from .certificates import CSRApprovingController, CSRSigningController
 from .podautoscaler import HorizontalPodAutoscalerController
 from .statefulset import StatefulSetController
 from .ttl import TTLController
@@ -41,7 +42,7 @@ DEFAULT_CONTROLLERS = [
     PodGCController, GarbageCollector, ResourceQuotaController,
     ServiceAccountController, PersistentVolumeController,
     AttachDetachController, HorizontalPodAutoscalerController,
-    TTLController,
+    TTLController, CSRApprovingController, CSRSigningController,
 ]
 
 

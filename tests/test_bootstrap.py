@@ -233,3 +233,54 @@ spec:
         rc = kubeadm.main(["init", "--once",
                            "--data-dir", str(tmp_path / "kv")])
         assert rc == 0
+
+
+class TestCertificates:
+    def test_kubelet_csr_approved_and_signed(self):
+        from kubernetes_tpu.controllers.certificates import (
+            CSRApprovingController, CSRSigningController)
+
+        store = ObjectStore()
+        approver, signer = CSRApprovingController(store), \
+            CSRSigningController(store)
+        store.create("certificatesigningrequests",
+                     api.CertificateSigningRequest(
+                         metadata=api.ObjectMeta(name="node-csr-n1"),
+                         spec=api.CertificateSigningRequestSpec(
+                             request="csr-bytes",
+                             username="system:node:n1",
+                             groups=["system:nodes"],
+                             usages=["digital signature",
+                                     "key encipherment", "client auth"])))
+        approver.sync_all()
+        signer.sync_all()
+        csr = store.get("certificatesigningrequests", "default",
+                        "node-csr-n1")
+        assert csr.approved and csr.status.certificate.startswith(
+            "cert:system:node:n1:")
+
+    def test_non_node_csr_not_auto_approved(self):
+        from kubernetes_tpu.controllers.certificates import (
+            CSRApprovingController, CSRSigningController)
+
+        store = ObjectStore()
+        approver, signer = CSRApprovingController(store), \
+            CSRSigningController(store)
+        store.create("certificatesigningrequests",
+                     api.CertificateSigningRequest(
+                         metadata=api.ObjectMeta(name="user-csr"),
+                         spec=api.CertificateSigningRequestSpec(
+                             request="x", username="alice",
+                             usages=["client auth"])))
+        approver.sync_all()
+        signer.sync_all()
+        csr = store.get("certificatesigningrequests", "default", "user-csr")
+        assert not csr.approved and csr.status.certificate == ""
+
+    def test_in_manager_roster(self):
+        from kubernetes_tpu.controllers.certificates import (
+            CSRApprovingController, CSRSigningController)
+        from kubernetes_tpu.controllers.manager import DEFAULT_CONTROLLERS
+
+        assert CSRApprovingController in DEFAULT_CONTROLLERS
+        assert CSRSigningController in DEFAULT_CONTROLLERS
