@@ -63,7 +63,9 @@ class APIServer:
                  audit_sink: Optional[Callable[[dict], None]] = None,
                  metrics_providers: Optional[List[Callable[[], str]]] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 reconcile_endpoints: bool = False):
+                 reconcile_endpoints: bool = False,
+                 max_in_flight: int = 0, max_mutating_in_flight: int = 0,
+                 audit_policy: str = "Metadata"):
         self.store = store
         self.broadcaster = Broadcaster(store)
         self.authenticator = authenticator
@@ -75,6 +77,17 @@ class APIServer:
         self._count_lock = threading.Lock()
         self._reconcile_endpoints = reconcile_endpoints
         self.endpoint_reconciler = None
+        # flow control (filters/maxinflight.go): bounded concurrent
+        # requests, split readonly/mutating; saturation -> 429
+        self._readonly_sem = (threading.BoundedSemaphore(max_in_flight)
+                              if max_in_flight > 0 else None)
+        self._mutating_sem = (
+            threading.BoundedSemaphore(max_mutating_in_flight)
+            if max_mutating_in_flight > 0 else None)
+        # audit policy level (auditpolicy: "None" disables the sink,
+        # "Metadata" records verb/resource/user — the reference's levels
+        # minus request-body capture)
+        self.audit_policy = audit_policy
         # CRD-lite (apiextensions-apiserver): creating a
         # CustomResourceDefinition registers its kind in the scheme so
         # /apis/<group>/<version>/<plural> CRUD+watch routes resolve;
@@ -202,6 +215,23 @@ class APIServer:
                              if "/" in scheme.api_version_for(k)})
             return h._send(200, json.dumps({"kind": "APIGroupList",
                                             "groups": groups}).encode())
+        # per-group resource discovery (endpoints/installer.go's
+        # APIResourceList; what a RESTMapper consumes)
+        gv = None
+        if len(parts) == 2 and parts[0] == "api":
+            gv = parts[1]
+        elif len(parts) == 3 and parts[0] == "apis":
+            gv = f"{parts[1]}/{parts[2]}"
+        if gv is not None and h.command == "GET":
+            resources = [
+                {"name": scheme.plural_for_kind(k), "kind": k,
+                 "namespaced": scheme.is_namespaced(k)}
+                for k in sorted(scheme.all_kinds())
+                if scheme.api_version_for(k) == gv]
+            if resources:
+                return h._send(200, json.dumps(
+                    {"kind": "APIResourceList", "groupVersion": gv,
+                     "resources": resources}).encode())
 
         route = self._route(parts)
         if route is None:
@@ -212,6 +242,28 @@ class APIServer:
             verb = "watch"
         if verb == "get" and name is None:
             verb = "list"
+
+        # flow control: watches are long-lived and exempt (the reference
+        # exempts them too, maxinflight.go:49)
+        sem = None
+        if verb != "watch":
+            # nonMutatingRequestVerbs is exactly get/list/watch
+            # (maxinflight.go): patch and the subresource writes are
+            # mutating
+            sem = (self._readonly_sem if verb in ("get", "list") else
+                   self._mutating_sem)
+        if sem is not None and not sem.acquire(blocking=False):
+            raise APIError(429, "TooManyRequests",
+                           "server request limit reached, retry later")
+        try:
+            return self._serve_authorized(h, query, user, plural, namespace,
+                                          name, sub, verb)
+        finally:
+            if sem is not None:
+                sem.release()
+
+    def _serve_authorized(self, h, query, user, plural, namespace, name,
+                          sub, verb):
 
         # authz (filters/authorization.go)
         if self.authorizer is not None and user is not None:
@@ -553,7 +605,7 @@ class APIServer:
     # -- cross-cutting ---------------------------------------------------------
 
     def _audit(self, user: Optional[UserInfo], verb, plural, namespace, name):
-        if self.audit_sink is None:
+        if self.audit_sink is None or self.audit_policy == "None":
             return
         self.audit_sink({"ts": time.time(),
                          "user": user.name if user else "",
