@@ -133,9 +133,15 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     # LV: the label-VALUE vocab is dominated by per-node hostname labels,
     # plus workload label values (anti-affinity groups, services, zones);
     # crossing an LV bucket changes num_label_values (a static arg of the
-    # wave kernel) and forces a recompile mid-run
+    # wave kernel) and forces a recompile mid-run.
+    # E sizing matters doubly: too small recompiles mid-run, but
+    # OVER-sizing multiplies the per-wave inter-pod-affinity precompute,
+    # which is O(E x N) — mixed has one term per anti-affinity pod, i.e.
+    # a quarter of the pods, not all of them.
+    n_terms = pods if workload == "antiaffinity" else \
+        (pods - 3 * (pods // 4)) if workload == "mixed" else 0
     caps = Caps(M=bucket_size(pods + 64), P=wave,
-                E=bucket_size(pods + 64) if has_ipa_load else 8,
+                E=bucket_size(n_terms + 64) if has_ipa_load else 8,
                 LV=bucket_size(nodes + 256, 64))
     sched = Scheduler(store, wave_size=wave, caps=caps)
     build_cluster(store, nodes,
