@@ -413,20 +413,44 @@ class ServiceSpec:
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""
-    type: str = "ClusterIP"
-    session_affinity: str = "None"
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer | ExternalName
+    session_affinity: str = "None"  # None | ClientIP
+    session_affinity_timeout: int = 10800  # sessionAffinityConfig.clientIP
+    external_ips: List[str] = field(default_factory=list)
+    load_balancer_ip: str = ""
+    external_traffic_policy: str = "Cluster"  # Cluster | Local
+    health_check_node_port: int = 0
+    external_name: str = ""
+
+
+@dataclass
+class LoadBalancerIngress:
+    ip: str = ""
+    hostname: str = ""
+
+
+@dataclass
+class LoadBalancerStatus:
+    ingress: List[LoadBalancerIngress] = field(default_factory=list)
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer: LoadBalancerStatus = field(default_factory=LoadBalancerStatus)
 
 
 @dataclass
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
 
-    def __init__(self, metadata=None, spec=None, selector=None):
+    def __init__(self, metadata=None, spec=None, selector=None, status=None):
         # `selector=` kwarg kept for scheduler-side call sites that treat a
         # Service as just its label selector (selector_spreading.go view)
         self.metadata = metadata or ObjectMeta()
         self.spec = spec or ServiceSpec()
+        self.status = status or ServiceStatus()
         if selector is not None:
             self.spec.selector = selector
 

@@ -1,3 +1,3 @@
 """Service dataplane — pkg/proxy analog."""
 
-from .proxier import ProxyRule, Proxier
+from .proxier import Endpoint, HealthCheckServer, ProxyRule, Proxier

@@ -1,0 +1,252 @@
+"""kube-proxy dataplane tests: VIP dispatch, node ports, session
+affinity, externalTrafficPolicy=Local, healthcheck, conntrack cleanup.
+
+Reference test model: pkg/proxy/iptables/proxier_test.go (rule
+translation per service shape), pkg/proxy/healthcheck/healthcheck_test.go.
+"""
+
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.proxy import Proxier
+from kubernetes_tpu.runtime.store import ObjectStore
+
+
+def mksvc(name="svc", ports=None, **spec_kw):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.ServiceSpec(
+            selector={"app": "w"},
+            cluster_ip="10.96.0.10",
+            ports=ports or [api.ServicePort(name="http", port=80,
+                                            target_port=8080)],
+            **spec_kw))
+
+
+def mkeps(name="svc", addrs=None, not_ready=None, port=8080):
+    return api.Endpoints(
+        metadata=api.ObjectMeta(name=name),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(ip=ip, node_name=node)
+                       for ip, node in (addrs or [])],
+            not_ready_addresses=[api.EndpointAddress(ip=ip, node_name=node)
+                                 for ip, node in (not_ready or [])],
+            ports=[api.EndpointPort(name="http", port=port)])])
+
+
+class TestVIPDispatch:
+    def test_cluster_ip_and_external_ips_route(self):
+        store = ObjectStore()
+        store.create("services", mksvc(external_ips=["192.0.2.1"]))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px = Proxier(store, node_name="n1")
+        assert px.resolve_vip("10.96.0.10", 80) == ("10.0.0.1", 8080)
+        assert px.resolve_vip("192.0.2.1", 80) == ("10.0.0.1", 8080)
+        assert px.resolve_vip("10.96.0.10", 81) is None  # wrong port
+        assert px.resolve_vip("10.96.0.99", 80) is None  # unknown VIP
+
+    def test_lb_ingress_ip_routes(self):
+        store = ObjectStore()
+        svc = mksvc(type="LoadBalancer")
+        svc.status.load_balancer.ingress = [api.LoadBalancerIngress(ip="198.51.100.7")]
+        store.create("services", svc)
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px = Proxier(store)
+        assert px.resolve_vip("198.51.100.7", 80) == ("10.0.0.1", 8080)
+
+    def test_node_port(self):
+        store = ObjectStore()
+        store.create("services", mksvc(
+            type="NodePort",
+            ports=[api.ServicePort(name="http", port=80, target_port=8080,
+                                   node_port=30080)]))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px = Proxier(store)
+        assert px.resolve_node_port(30080) == ("10.0.0.1", 8080)
+        assert px.resolve_node_port(30081) is None
+
+    def test_not_ready_endpoints_excluded(self):
+        store = ObjectStore()
+        store.create("services", mksvc())
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")],
+                                        not_ready=[("10.0.0.2", "n2")]))
+        px = Proxier(store)
+        for _ in range(4):
+            assert px.resolve("default", "svc", "http") == ("10.0.0.1", 8080)
+
+    def test_external_name_gets_no_rules(self):
+        store = ObjectStore()
+        store.create("services", mksvc(type="ExternalName",
+                                       external_name="db.example.com"))
+        px = Proxier(store)
+        assert px.rules == {}
+
+
+class TestSessionAffinity:
+    def test_client_ip_stickiness_and_timeout(self):
+        store = ObjectStore()
+        store.create("services", mksvc(session_affinity="ClientIP",
+                                       session_affinity_timeout=100))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1"),
+                                               ("10.0.0.2", "n2")]))
+        now = [1000.0]
+        px = Proxier(store, clock=lambda: now[0])
+        first = px.resolve("default", "svc", "http", client_ip="1.2.3.4")
+        for _ in range(6):
+            assert px.resolve("default", "svc", "http",
+                              client_ip="1.2.3.4") == first
+        # a different client is balanced independently
+        other = {px.resolve("default", "svc", "http", client_ip="5.6.7.8")
+                 for _ in range(6)}
+        assert len(other) == 1
+        # past the timeout the association is re-picked (and may move)
+        now[0] += 101
+        again = px.resolve("default", "svc", "http", client_ip="1.2.3.4")
+        assert again in {("10.0.0.1", 8080), ("10.0.0.2", 8080)}
+
+    def test_affinity_survives_unrelated_resync(self):
+        store = ObjectStore()
+        store.create("services", mksvc(session_affinity="ClientIP"))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1"),
+                                               ("10.0.0.2", "n2")]))
+        px = Proxier(store)
+        first = px.resolve("default", "svc", "http", client_ip="1.2.3.4")
+        px.sync_proxy_rules()
+        assert px.resolve("default", "svc", "http",
+                          client_ip="1.2.3.4") == first
+
+
+class TestLocalTrafficPolicy:
+    def _world(self):
+        store = ObjectStore()
+        store.create("services", mksvc(
+            type="LoadBalancer", external_traffic_policy="Local",
+            health_check_node_port=32000,
+            ports=[api.ServicePort(name="http", port=80, target_port=8080,
+                                   node_port=30080)]))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1"),
+                                               ("10.0.0.2", "n2")]))
+        return store
+
+    def test_node_port_local_only(self):
+        px1 = Proxier(self._world(), node_name="n1")
+        for _ in range(4):
+            assert px1.resolve_node_port(30080) == ("10.0.0.1", 8080)
+        px3 = Proxier(self._world(), node_name="n3")
+        assert px3.resolve_node_port(30080) is None  # no local endpoint
+
+    def test_cluster_ip_unaffected_by_local_policy(self):
+        px3 = Proxier(self._world(), node_name="n3")
+        picks = {px3.resolve_vip("10.96.0.10", 80) for _ in range(8)}
+        assert picks == {("10.0.0.1", 8080), ("10.0.0.2", 8080)}
+
+    def test_healthcheck_probe(self):
+        px1 = Proxier(self._world(), node_name="n1")
+        code, body = px1.healthcheck.probe(32000)
+        assert code == 200 and body["localEndpoints"] == 1
+        px3 = Proxier(self._world(), node_name="n3")
+        code, _ = px3.healthcheck.probe(32000)
+        assert code == 503
+        assert px3.healthcheck.probe(12345) == (404, {})
+
+
+class TestConntrackCleanup:
+    def test_stale_udp_flows_deleted_on_endpoint_removal(self):
+        store = ObjectStore()
+        store.create("services", mksvc(
+            ports=[api.ServicePort(name="dns", port=53, target_port=5353,
+                                   protocol="UDP")]))
+        store.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="svc"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.1"),
+                           api.EndpointAddress(ip="10.0.0.2")],
+                ports=[api.EndpointPort(name="dns", port=5353,
+                                        protocol="UDP")])]))
+        px = Proxier(store)
+        seen = set()
+        for i in range(4):
+            seen.add(px.resolve("default", "svc", "dns",
+                                client_ip=f"1.1.1.{i}"))
+        assert len(seen) == 2
+        # one endpoint goes away -> its UDP flows are purged
+        eps = store.get("endpoints", "default", "svc")
+        store.update("endpoints", api.Endpoints(
+            metadata=eps.metadata,
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.1")],
+                ports=[api.EndpointPort(name="dns", port=5353,
+                                        protocol="UDP")])]))
+        px.sync_proxy_rules()
+        assert px.stale_flows_deleted >= 1
+        assert px.health()["staleFlowsDeleted"] == px.stale_flows_deleted
+
+    def test_udp_flows_purged_on_service_deletion(self):
+        store = ObjectStore()
+        store.create("services", mksvc(
+            ports=[api.ServicePort(name="dns", port=53, target_port=5353,
+                                   protocol="UDP")]))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px = Proxier(store)
+        px.resolve("default", "svc", "dns", client_ip="1.1.1.1")
+        store.delete("services", "default", "svc")
+        px.sync_proxy_rules()
+        assert px.stale_flows_deleted >= 1
+
+    def test_idle_flows_and_affinity_expire(self):
+        store = ObjectStore()
+        store.create("services", mksvc(session_affinity="ClientIP",
+                                       session_affinity_timeout=50))
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        now = [1000.0]
+        px = Proxier(store, clock=lambda: now[0])
+        for i in range(8):
+            px.resolve("default", "svc", "http", client_ip=f"9.9.9.{i}")
+        assert len(px._conntrack) == 8 and len(px._affinity) == 8
+        now[0] += 400  # past flow_idle_timeout (300) and affinity (50)
+        store.update("services", store.get("services", "default", "svc"))
+        px.sync_proxy_rules()
+        assert px._conntrack == {} and px._affinity == {}
+
+    def test_generated_cluster_ip_not_a_routing_key(self):
+        store = ObjectStore()
+        svc = mksvc()
+        svc.spec.cluster_ip = ""  # no allocator ran
+        store.create("services", svc)
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px = Proxier(store)
+        rule = px.rules[("default", "svc", "http")]
+        assert rule.cluster_ip.startswith("172.16.")  # display fallback
+        assert px.resolve_vip(rule.cluster_ip, 80) is None  # not routable
+        assert px.resolve("default", "svc", "http") == ("10.0.0.1", 8080)
+
+    def test_tcp_flows_not_purged(self):
+        store = ObjectStore()
+        store.create("services", mksvc())
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1"),
+                                               ("10.0.0.2", "n2")]))
+        px = Proxier(store)
+        for i in range(4):
+            px.resolve("default", "svc", "http", client_ip=f"1.1.1.{i}")
+        eps = store.get("endpoints", "default", "svc")
+        store.update("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px.sync_proxy_rules()
+        assert px.stale_flows_deleted == 0
+
+
+class TestChangeTracker:
+    def test_event_driven_resync(self):
+        store = ObjectStore()
+        store.create("services", mksvc())
+        store.create("endpoints", mkeps(addrs=[("10.0.0.1", "n1")]))
+        px = Proxier(store).run(period=0.05)
+        try:
+            store.update("endpoints", mkeps(addrs=[("10.0.0.9", "n1")]))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if px.resolve("default", "svc", "http") == ("10.0.0.9", 8080):
+                    break
+                time.sleep(0.02)
+            assert px.resolve("default", "svc", "http") == ("10.0.0.9", 8080)
+        finally:
+            px.stop()
