@@ -297,6 +297,7 @@ COND_UNKNOWN = "Unknown"
 class NodeCondition:
     type: str
     status: str = COND_TRUE
+    reason: str = ""
 
 
 @dataclass
@@ -309,6 +310,14 @@ class ContainerImage:
 class NodeSpec:
     unschedulable: bool = False
     taints: List[Taint] = field(default_factory=list)
+    pod_cidr: str = ""  # allocated by the nodeipam controller
+    provider_id: str = ""  # cloud instance identity (<provider>://<id>)
+
+
+@dataclass
+class NodeAddress:
+    type: str = ""  # InternalIP | ExternalIP | Hostname
+    address: str = ""
 
 
 @dataclass
@@ -317,6 +326,7 @@ class NodeStatus:
     allocatable: Dict[str, int] = field(default_factory=dict)
     conditions: List[NodeCondition] = field(default_factory=list)
     images: List[ContainerImage] = field(default_factory=list)
+    addresses: List[NodeAddress] = field(default_factory=list)
     # attach/detach controller state (core/v1 NodeStatus.VolumesAttached /
     # VolumesInUse; maintained by controllers/attachdetach.py)
     volumes_attached: List[str] = field(default_factory=list)

@@ -1,0 +1,4 @@
+"""Cloud provider layer — pkg/cloudprovider analog."""
+
+from .provider import (CloudProvider, FakeCloud, Instances, LoadBalancer,
+                       Route, Routes, Zone, Zones)

@@ -1,0 +1,247 @@
+"""Cloud provider interface + fake implementation.
+
+Reference: pkg/cloudprovider/cloud.go — the Interface every provider
+(aws/azure/gce/...) implements, consumed by the service LB, route and
+cloud-node controllers. The reference ships 55k LoC of per-cloud
+implementations; here the surface is the interface plus the fake
+(pkg/cloudprovider/providers/fake/fake.go), which is what every
+reference controller test runs against too. Real TPU-pod deployments
+sit behind the same seam: a provider whose Instances are TPU VM workers
+and whose Routes program the pod network is a drop-in.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as api
+
+
+@dataclass
+class Route:
+    """One pod-network route (cloud.go Route): traffic for dest_cidr goes
+    to target_node."""
+
+    name: str
+    target_node: str
+    dest_cidr: str
+
+
+@dataclass
+class Zone:
+    failure_domain: str = ""
+    region: str = ""
+
+
+class LoadBalancer:
+    """cloud.go LoadBalancer interface."""
+
+    def get_load_balancer(self, cluster: str, service: api.Service
+                          ) -> Tuple[Optional[api.LoadBalancerStatus], bool]:
+        raise NotImplementedError
+
+    def ensure_load_balancer(self, cluster: str, service: api.Service,
+                             nodes: List[api.Node]) -> api.LoadBalancerStatus:
+        raise NotImplementedError
+
+    def update_load_balancer(self, cluster: str, service: api.Service,
+                             nodes: List[api.Node]) -> None:
+        raise NotImplementedError
+
+    def ensure_load_balancer_deleted(self, cluster: str,
+                                     service: api.Service) -> None:
+        raise NotImplementedError
+
+
+class Instances:
+    """cloud.go Instances interface."""
+
+    def node_addresses(self, name: str) -> List[api.NodeAddress]:
+        raise NotImplementedError
+
+    def instance_id(self, name: str) -> str:
+        raise NotImplementedError
+
+    def instance_type(self, name: str) -> str:
+        raise NotImplementedError
+
+    def instance_exists_by_provider_id(self, provider_id: str) -> bool:
+        raise NotImplementedError
+
+
+class Zones:
+    def get_zone_by_node_name(self, name: str) -> Zone:
+        raise NotImplementedError
+
+
+class Routes:
+    """cloud.go Routes interface."""
+
+    def list_routes(self, cluster: str) -> List[Route]:
+        raise NotImplementedError
+
+    def create_route(self, cluster: str, name_hint: str, route: Route) -> None:
+        raise NotImplementedError
+
+    def delete_route(self, cluster: str, route: Route) -> None:
+        raise NotImplementedError
+
+
+class CloudProvider:
+    """cloud.go Interface: each accessor returns the sub-interface or None
+    when the cloud doesn't support that capability (the Go (iface, bool)
+    pair)."""
+
+    provider_name = ""
+
+    def load_balancer(self) -> Optional[LoadBalancer]:
+        return None
+
+    def instances(self) -> Optional[Instances]:
+        return None
+
+    def zones(self) -> Optional[Zones]:
+        return None
+
+    def routes(self) -> Optional[Routes]:
+        return None
+
+
+# -- fake ----------------------------------------------------------------------
+
+
+@dataclass
+class FakeInstance:
+    addresses: List[api.NodeAddress] = field(default_factory=list)
+    instance_id: str = ""
+    instance_type: str = "fake.small"
+    zone: Zone = field(default_factory=Zone)
+
+
+class FakeCloud(CloudProvider, LoadBalancer, Instances, Zones, Routes):
+    """In-memory provider recording every mutation (fake.go FakeCloud),
+    used by controller tests and the kubemark-style local stack."""
+
+    provider_name = "fake"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.instances_by_name: Dict[str, FakeInstance] = {}
+        self.balancers: Dict[str, Tuple[api.LoadBalancerStatus, List[str]]] = {}
+        self.route_table: Dict[str, Route] = {}
+        self.calls: List[str] = []
+        self.next_ip = 1
+        self.fail_next: Dict[str, Exception] = {}  # call name -> error to raise
+
+    # test hooks
+    def add_instance(self, name: str, internal_ip: str = "",
+                     zone: str = "z0", region: str = "r0",
+                     instance_type: str = "fake.small"):
+        self.instances_by_name[name] = FakeInstance(
+            addresses=[api.NodeAddress("InternalIP", internal_ip or
+                                       f"10.1.0.{len(self.instances_by_name) + 1}"),
+                       api.NodeAddress("Hostname", name)],
+            instance_id=f"fake://{name}",
+            instance_type=instance_type,
+            zone=Zone(failure_domain=zone, region=region))
+
+    def _record(self, call: str):
+        self.calls.append(call)
+        err = self.fail_next.pop(call, None)
+        if err is not None:
+            raise err
+
+    # CloudProvider
+    def load_balancer(self):
+        return self
+
+    def instances(self):
+        return self
+
+    def zones(self):
+        return self
+
+    def routes(self):
+        return self
+
+    # LoadBalancer
+    @staticmethod
+    def _lb_name(service: api.Service) -> str:
+        return f"{service.metadata.namespace}/{service.metadata.name}"
+
+    def get_load_balancer(self, cluster, service):
+        with self._lock:
+            self._record("get-load-balancer")
+            hit = self.balancers.get(self._lb_name(service))
+            return (hit[0], True) if hit else (None, False)
+
+    def ensure_load_balancer(self, cluster, service, nodes):
+        with self._lock:
+            self._record("ensure-load-balancer")
+            name = self._lb_name(service)
+            if name in self.balancers:
+                status = self.balancers[name][0]
+            else:
+                ip = service.spec.load_balancer_ip or f"203.0.113.{self.next_ip}"
+                self.next_ip += 1
+                status = api.LoadBalancerStatus(
+                    ingress=[api.LoadBalancerIngress(ip=ip)])
+            self.balancers[name] = (status, sorted(n.name for n in nodes))
+            return status
+
+    def update_load_balancer(self, cluster, service, nodes):
+        with self._lock:
+            self._record("update-load-balancer")
+            name = self._lb_name(service)
+            if name in self.balancers:
+                self.balancers[name] = (self.balancers[name][0],
+                                        sorted(n.name for n in nodes))
+
+    def ensure_load_balancer_deleted(self, cluster, service):
+        with self._lock:
+            self._record("ensure-load-balancer-deleted")
+            self.balancers.pop(self._lb_name(service), None)
+
+    # Instances
+    def node_addresses(self, name):
+        self._record("node-addresses")
+        inst = self.instances_by_name.get(name)
+        if inst is None:
+            raise KeyError(f"instance {name} not found")
+        return list(inst.addresses)
+
+    def instance_id(self, name):
+        self._record("instance-id")
+        return self.instances_by_name[name].instance_id
+
+    def instance_type(self, name):
+        self._record("instance-type")
+        return self.instances_by_name[name].instance_type
+
+    def instance_exists_by_provider_id(self, provider_id):
+        self._record("instance-exists")
+        return any(i.instance_id == provider_id
+                   for i in self.instances_by_name.values())
+
+    # Zones
+    def get_zone_by_node_name(self, name):
+        self._record("get-zone")
+        return self.instances_by_name[name].zone
+
+    # Routes
+    def list_routes(self, cluster):
+        with self._lock:
+            self._record("list-routes")
+            return list(self.route_table.values())
+
+    def create_route(self, cluster, name_hint, route):
+        with self._lock:
+            self._record("create-route")
+            self.route_table[f"{route.target_node}:{route.dest_cidr}"] = route
+
+    def delete_route(self, cluster, route):
+        with self._lock:
+            self._record("delete-route")
+            self.route_table.pop(f"{route.target_node}:{route.dest_cidr}", None)

@@ -31,4 +31,8 @@ from .attachdetach import AttachDetachController
 from .podautoscaler import HorizontalPodAutoscalerController
 from .ttl import TTLController
 from .certificates import CSRApprovingController, CSRSigningController
+from .nodeipam import NodeIpamController
+from .route import RouteController
+from .service_lb import ServiceLBController
+from .cloud_node import CloudNodeController
 from .manager import ControllerManager

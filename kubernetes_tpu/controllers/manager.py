@@ -49,13 +49,30 @@ DEFAULT_CONTROLLERS = [
 class ControllerManager:
     def __init__(self, store, controllers: Optional[List[type]] = None,
                  identity: str = "controller-manager",
-                 leader_elect: bool = False):
+                 leader_elect: bool = False, cloud=None,
+                 cluster_cidr: str = ""):
         self.store = store
         self.controllers: Dict[str, Controller] = {}
         for cls in (controllers if controllers is not None
                     else DEFAULT_CONTROLLERS):
             c = cls(store)
             self.controllers[c.name] = c
+        # cloud-dependent loops start only when a provider is configured
+        # (controllermanager.go gates these on --cloud-provider)
+        if cluster_cidr:
+            from .nodeipam import NodeIpamController
+            c = NodeIpamController(store, cluster_cidr)
+            self.controllers[c.name] = c
+        if cloud is not None:
+            from .cloud_node import CloudNodeController
+            from .route import RouteController
+            from .service_lb import ServiceLBController
+            for c in (ServiceLBController(store, cloud),
+                      CloudNodeController(store, cloud)):
+                self.controllers[c.name] = c
+            if cloud.routes() is not None:
+                c = RouteController(store, cloud)
+                self.controllers[c.name] = c
         self.elector = LeaderElector(
             store, identity, lock_name="kube-controller-manager",
             on_started_leading=self._start_all) if leader_elect else None

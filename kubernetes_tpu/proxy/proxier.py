@@ -84,36 +84,6 @@ class ProxyRule:
         return [e for e in self.endpoints if e.ready and e.is_local]
 
 
-class _ChangeTracker:
-    """{previous, current} pending map applied at sync time.
-
-    Reference: pkg/proxy/service.go:113 / endpoints.go:77 — events don't
-    mutate the live map; they record the change, and update() merges all
-    pending changes under one lock so a sync sees a consistent snapshot
-    and can diff previous-vs-current for staleness.
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._pending: Dict[Tuple[str, str], List[object]] = {}
-
-    def record(self, key: Tuple[str, str], previous, current):
-        with self._lock:
-            if key in self._pending:
-                self._pending[key][1] = current  # collapse; keep oldest prev
-            else:
-                self._pending[key] = [previous, current]
-            # no-op change (add then delete before any sync): drop it
-            if self._pending[key][0] is None and self._pending[key][1] is None:
-                del self._pending[key]
-
-    def drain(self) -> Dict[Tuple[str, str], Tuple[object, object]]:
-        with self._lock:
-            out = {k: (v[0], v[1]) for k, v in self._pending.items()}
-            self._pending.clear()
-            return out
-
-
 class HealthCheckServer:
     """Per-service local-endpoint health state (pkg/proxy/healthcheck/
     healthcheck.go:117 server.SyncServices/SyncEndpoints).
@@ -169,29 +139,24 @@ class Proxier:
         self.flow_idle_timeout = 300.0
         self.stale_flows_deleted = 0
         self.healthcheck = HealthCheckServer()
-        self._svc_changes = _ChangeTracker()
-        self._ep_changes = _ChangeTracker()
         self._dirty = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.min_sync_period = min_sync_period
 
-        def key(o):
-            return (o.metadata.namespace, o.metadata.name)
-
-        SharedInformer(store, "services").add_event_handler(
-            on_add=lambda o: self._on_change(self._svc_changes, key(o), None, o),
-            on_update=lambda o, n: self._on_change(self._svc_changes, key(n), o, n),
-            on_delete=lambda o: self._on_change(self._svc_changes, key(o), o, None))
-        SharedInformer(store, "endpoints").add_event_handler(
-            on_add=lambda o: self._on_change(self._ep_changes, key(o), None, o),
-            on_update=lambda o, n: self._on_change(self._ep_changes, key(n), o, n),
-            on_delete=lambda o: self._on_change(self._ep_changes, key(o), o, None))
+        # informer events only mark the table dirty; the staleness diff is
+        # computed old-rules-vs-new-rules at sync. The reference's change
+        # trackers diff previous-vs-current *objects*, but this store hands
+        # informers live references that controllers mutate in place, so an
+        # object-level prev is unreliable — the rule table IS the durable
+        # previous state, and diffing it catches exactly the same removals
+        # (detectStaleConnections' output) without aliasing hazards.
+        for kind in ("services", "endpoints"):
+            SharedInformer(store, kind).add_event_handler(
+                on_add=lambda o: self._dirty.set(),
+                on_update=lambda o, n: self._dirty.set(),
+                on_delete=lambda o: self._dirty.set())
         self.sync_proxy_rules()
-
-    def _on_change(self, tracker: _ChangeTracker, key, prev, cur):
-        tracker.record(key, prev, cur)
-        self._dirty.set()
 
     # -- the hot loop (syncProxyRules) -----------------------------------------
 
@@ -203,8 +168,6 @@ class Proxier:
         # mid-sync re-arms it so the next wait() syncs again instead of
         # being lost (the reference's async runner has the same contract)
         self._dirty.clear()
-        ep_changes = self._ep_changes.drain()
-        svc_changes = self._svc_changes.drain()
         new_rules: Dict[ServicePortName, ProxyRule] = {}
         eps_by_key = {(e.metadata.namespace, e.metadata.name): e
                       for e in self.store.list("endpoints")}
@@ -263,58 +226,51 @@ class Proxier:
             if r.node_port:
                 by_np[(r.node_port, r.protocol)] = spn
         with self._lock:
-            self.rules = new_rules
+            old_rules, self.rules = self.rules, new_rules
             self._by_vip = by_vip
             self._by_node_port = by_np
             self.sync_count += 1
-            self._cleanup_stale_locked(ep_changes, svc_changes, new_rules)
+            self._cleanup_stale_locked(old_rules, new_rules)
         self.healthcheck.sync(new_rules)
 
-    @staticmethod
-    def _removed_backend_ips(ep_changes) -> Dict[Tuple[str, str], Set[str]]:
-        """Diff the tracker's {previous, current} pairs: backend IPs present
-        before this sync window but gone now, per service (the reference's
-        detectStaleConnections over EndpointChangeTracker output)."""
-
-        def ips(eps) -> Set[str]:
-            if eps is None:
-                return set()
-            return {a.ip for s in eps.subsets for a in s.addresses}
-
-        return {key: ips(prev) - ips(cur)
-                for key, (prev, cur) in ep_changes.items()}
-
-    def _cleanup_stale_locked(self, ep_changes, svc_changes, new_rules):
+    def _cleanup_stale_locked(self, old_rules, new_rules):
         """Delete UDP flows made stale by this sync: flows to backend IPs
-        the endpoint diff removed (proxier.go:654 deleteEndpointConnections)
+        that left the rule table (proxier.go:654 deleteEndpointConnections)
         and flows of service ports that no longer exist — deleted or
         type-changed services (deleteServiceConnections). TCP flows die on
         their own via RST; UDP conntrack entries would otherwise blackhole
-        the client until timeout. Also expires idle flows and aged
-        affinity entries so both tables stay bounded."""
-        removed = self._removed_backend_ips(ep_changes)
+        the client until timeout. Also drops affinity state of vanished
+        rules and expires idle flows/aged affinity entries so both tables
+        stay bounded."""
+        removed: Dict[ServicePortName, Set[str]] = {}
+        for spn, old in old_rules.items():
+            cur = new_rules.get(spn)
+            cur_ips = {e.ip for e in cur.endpoints} if cur else set()
+            gone = {e.ip for e in old.endpoints} - cur_ips
+            if gone:
+                removed[spn] = gone
         stale = []
-        for f, _ in self._conntrack.items():
+        for f in self._conntrack:
             proto, spn, _client, (ip, _port) = f
             if proto != "UDP":
                 continue
-            if spn not in new_rules and (svc_changes or ep_changes):
-                stale.append(f)
-            elif ip in removed.get((spn[0], spn[1]), ()):
+            if spn not in new_rules or ip in removed.get(spn, ()):
                 stale.append(f)
         for f in stale:
             del self._conntrack[f]
             self._affinity.pop((f[1], f[2]), None)
             self.stale_flows_deleted += 1
-        # idle expiry (kernel conntrack timeout / iptables `recent` analog)
         now = self.clock()
+        # affinity of vanished rules dies with the rule (any protocol);
+        # surviving entries expire by their rule's timeout
+        for k in [k for k, (_ep, last) in self._affinity.items()
+                  if k[0] not in new_rules
+                  or now - last > new_rules[k[0]].affinity_timeout]:
+            del self._affinity[k]
+        # idle expiry (kernel conntrack timeout analog)
         for f in [f for f, ts in self._conntrack.items()
                   if now - ts > self.flow_idle_timeout]:
             del self._conntrack[f]
-        for k in [k for k, (_ep, last) in self._affinity.items()
-                  if now - last > self.rules.get(
-                      k[0], ProxyRule("", "", "", "", 0, "")).affinity_timeout]:
-            del self._affinity[k]
 
     # -- dataplane lookups -----------------------------------------------------
 

@@ -181,6 +181,30 @@ class TestConntrackCleanup:
         assert px.stale_flows_deleted >= 1
         assert px.health()["staleFlowsDeleted"] == px.stale_flows_deleted
 
+    def test_stale_detection_survives_in_place_mutation(self):
+        """The endpoints controller mutates the stored object in place
+        before update() — the proxier's staleness diff must come from its
+        own rule table, not informer prev/cur objects (which alias)."""
+        store = ObjectStore()
+        store.create("services", mksvc(
+            ports=[api.ServicePort(name="dns", port=53, target_port=5353,
+                                   protocol="UDP")]))
+        store.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="svc"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.1"),
+                           api.EndpointAddress(ip="10.0.0.2")],
+                ports=[api.EndpointPort(name="dns", port=5353,
+                                        protocol="UDP")])]))
+        px = Proxier(store)
+        for i in range(4):
+            px.resolve("default", "svc", "dns", client_ip=f"1.1.1.{i}")
+        eps = store.get("endpoints", "default", "svc")
+        eps.subsets[0].addresses = [api.EndpointAddress(ip="10.0.0.1")]
+        store.update("endpoints", eps)  # old and new alias the same object
+        px.sync_proxy_rules()
+        assert px.stale_flows_deleted >= 1
+
     def test_udp_flows_purged_on_service_deletion(self):
         store = ObjectStore()
         store.create("services", mksvc(
