@@ -147,28 +147,48 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     build_cluster(store, nodes,
                   affinity_labels=10 if workload in ("affinity", "mixed") else 0)
 
-    # warm-up: compile the wave kernel with the same shapes on throwaway
-    # pods (first TPU compile is 10-40s and is not a throughput property).
-    # Affinity-heavy workloads compile the has_ipa=True kernel variant, so
-    # the warm-up must include anti-affinity pods to warm that variant too.
-    for i in range(warmup):
-        store.create("pods", _base_pod(api, f"warmup-{i}", "warmup"))
-    if has_ipa_load:
-        for i in range(min(warmup, 4)):
-            aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
-                required=[api.PodAffinityTerm(
-                    label_selector=LabelSelector(
-                        match_labels={"warm-anti": "w"}),
-                    topology_key="kubernetes.io/hostname")]))
-            store.create("pods", _base_pod(
-                api, f"warmup-anti-{i}", "warmup",
-                labels={"type": "warmup", "warm-anti": "w"}, affinity=aff))
-    sched.schedule_pending()
-    for i in range(warmup):
-        store.delete("pods", "default", f"warmup-{i}")
-    if has_ipa_load:
-        for i in range(min(warmup, 4)):
-            store.delete("pods", "default", f"warmup-anti-{i}")
+    # warm-up: compile the resident-pipeline kernel with the same shapes
+    # on throwaway pods (first TPU compile is 10-40s and is not a
+    # throughput property) — via warm_pipeline, which never fetches
+    # results: the first device->host fetch permanently degrades tunneled
+    # TPU runtimes' transfer path, so a warm-up that fetched would poison
+    # the measured run. The warm batch mirrors the real workload's
+    # has_ipa variant: any staged affinity term flips the whole pipeline
+    # to the has_ipa=True program.
+    from kubernetes_tpu.sched.scheduler import (PIPELINE_MAX_WAVES,
+                                                PIPELINE_MAX_WAVES_IPA)
+
+    cap = PIPELINE_MAX_WAVES_IPA if has_ipa_load else PIPELINE_MAX_WAVES
+    n_w = min(-(-pods // wave), cap)
+    warm_pods = []
+    # anti warm pods mirror the real workload's 50 anti-affinity groups:
+    # the featurizer's unique-program table (Caps.UI) buckets by the
+    # wave's distinct program count, and a warm-up with fewer groups
+    # would compile a smaller-UI program than the measured run uses
+    n_anti_warm = min(50, wave // 2) if has_ipa_load else 0
+    warm_n = max(wave - n_anti_warm, 0)
+    for i in range(warm_n):
+        p = _base_pod(api, f"warmup-{i}", "warmup")
+        store.create("pods", p)
+        warm_pods.append(p)
+    if workload == "mixed":
+        # mixed rounds before the anti-affinity block run the ipa-free
+        # program variant (at the ipa-capped bucket) — warm it first
+        sched.warm_pipeline(warm_pods, n_waves=n_w)
+    for i in range(n_anti_warm):
+        aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required=[api.PodAffinityTerm(
+                label_selector=LabelSelector(
+                    match_labels={"warm-anti": f"w{i % 50}"}),
+                topology_key="kubernetes.io/hostname")]))
+        p = _base_pod(api, f"warmup-anti-{i}", "warmup",
+                      labels={"type": "warmup", "warm-anti": f"w{i % 50}"},
+                      affinity=aff)
+        store.create("pods", p)
+        warm_pods.append(p)
+    sched.warm_pipeline(warm_pods, n_waves=n_w)
+    for p in warm_pods:
+        store.delete("pods", "default", p.metadata.name)
 
     sched.metrics = Metrics()  # drop warm-up/compile observations
     make_pods(store, pods, workload)
@@ -214,6 +234,8 @@ def main():
                              "antiaffinity", "mixed"])
     ap.add_argument("--suite", action="store_true",
                     help="run the 5-config BASELINE grid")
+    ap.add_argument("--name", default="",
+                    help="metric name override (suite subprocesses)")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     args = ap.parse_args()
 
@@ -226,15 +248,36 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     if args.suite:
+        # one subprocess per config: a run's end-of-round result fetch
+        # leaves the tunneled TPU runtime in its degraded transfer mode,
+        # which would taint every subsequent config in this process
+        import os
+        import subprocess
+
         for name, nodes, pods, workload in SUITE:
-            placed, dt, p99, path = run_config(nodes, pods, args.wave, workload)
-            emit(name, nodes, pods, placed, dt, p99, args.wave, path)
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--nodes", str(nodes), "--pods", str(pods),
+                   "--wave", str(args.wave), "--workload", workload,
+                   "--name", name]
+            if args.cpu:
+                cmd.append("--cpu")
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            sys.stdout.write(r.stdout)
+            sys.stdout.flush()
+            if r.returncode != 0:
+                # full child stderr: a crash's traceback is the only
+                # diagnostic there is
+                sys.stderr.write(r.stderr)
+                sys.exit(r.returncode)
+            for line in r.stderr.splitlines():
+                if line.startswith("#") or "FATAL" in line:
+                    print(line, file=sys.stderr)
         return
 
     placed, dt, p99, path = run_config(args.nodes, args.pods, args.wave,
                                        args.workload)
-    emit("density" if args.workload == "density" else args.workload,
-         args.nodes, args.pods, placed, dt, p99, args.wave, path)
+    emit(args.name or args.workload, args.nodes, args.pods, placed, dt, p99,
+         args.wave, path)
 
 
 if __name__ == "__main__":

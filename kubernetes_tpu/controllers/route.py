@@ -55,14 +55,27 @@ class RouteController(Controller):
         want = {(n.name, n.spec.pod_cidr) for n in nodes if n.spec.pod_cidr}
         have = {(r.target_node, r.dest_cidr): r
                 for r in self.routes.list_routes(self.cluster_name)}
+        # routed reflects what the CLOUD actually holds after this pass,
+        # not what we intended: a failed create must leave its node
+        # NetworkUnavailable=True so the scheduler's node predicates keep
+        # pods off it (ref updateNetworkingCondition on the create error
+        # path, route_controller.go:186)
+        routed = {t for t, c in want if (t, c) in have}
+        errors = 0
         for target, cidr in want - set(have):
-            self.routes.create_route(
-                self.cluster_name, f"{target}-{cidr}",
-                Route(name=f"{target}-{cidr}", target_node=target,
-                      dest_cidr=cidr))
+            try:
+                self.routes.create_route(
+                    self.cluster_name, f"{target}-{cidr}",
+                    Route(name=f"{target}-{cidr}", target_node=target,
+                          dest_cidr=cidr))
+                routed.add(target)
+            except Exception:
+                errors += 1
         for stale in set(have) - want:
-            self.routes.delete_route(self.cluster_name, have[stale])
-        routed = {t for t, _ in want}
+            try:
+                self.routes.delete_route(self.cluster_name, have[stale])
+            except Exception:
+                errors += 1
         for node in nodes:
             if not node.spec.pod_cidr:
                 continue  # ipam hasn't run; ref skips such nodes too
@@ -73,3 +86,5 @@ class RouteController(Controller):
                 reason="RouteCreated" if reachable else "NoRouteCreated")
             if changed:
                 self.store.update("nodes", node)
+        if errors:
+            raise RuntimeError(f"{errors} route operation(s) failed")

@@ -128,25 +128,26 @@ def incoming_statics(nt: NodeTensors, pm: PodMatrix, tt: TermTable,
     kind = tt.kind
     sym_blocked = _bool_matmul(em & (kind == enc.TERM_REQ_ANTI)[None, :], sd)
 
-    # --- incoming required affinity -------------------------------------
-    m_ids = jnp.arange(pm.labels.shape[0], dtype=jnp.int32)
-    aff_sel = _eval_programs(pm.labels, pb.ra_key, pb.ra_op, pb.ra_vals)  # [P, M]
-    aff_m = aff_sel & ns_match(pb.ra_ns, pm.ns) & pm.valid[None, :]
-    node_dom_ra = node_domains(nt, pb.ra_tk)  # [P, N]
-    dom_m_ra = jnp.take_along_axis(
-        node_dom_ra, jnp.broadcast_to(pm.node[None, :], aff_m.shape), axis=1)
-    hit_ra = _anchored_hit(aff_m, dom_m_ra, num_label_values)  # [P, LV]
-    ok_aff = jnp.take_along_axis(hit_ra, node_dom_ra, axis=1) & (node_dom_ra > 0)
-    any_aff = jnp.any(aff_m, axis=1)
+    # --- incoming required (anti)affinity, deduplicated ------------------
+    # The wave's unique required programs (pb.iu_*, row 0 = never-matches)
+    # are evaluated ONCE against the existing-pod matrix — [U, M] instead
+    # of [P, M]; per-pod views are gathers through ra_uid/rn_uid. Pods
+    # stamped from one controller share programs, so U << P in practice.
+    u_sel = _eval_programs(pm.labels, pb.iu_key, pb.iu_op, pb.iu_vals)  # [U, M]
+    u_m = u_sel & ns_match(pb.iu_ns, pm.ns) & pm.valid[None, :]
+    node_dom_u = node_domains(nt, pb.iu_tk)  # [U, N]
+    dom_m_u = jnp.take_along_axis(
+        node_dom_u, jnp.broadcast_to(pm.node[None, :], u_m.shape), axis=1)
+    hit_u = _anchored_hit(u_m, dom_m_u, num_label_values)  # [U, LV]
+    # "a matching pod exists in node n's domain" per unique program
+    ok_u = jnp.take_along_axis(hit_u, node_dom_u, axis=1) & (node_dom_u > 0)
+    any_u = jnp.any(u_m, axis=1)  # [U]
 
-    # --- incoming required anti-affinity --------------------------------
-    anti_sel = _eval_programs(pm.labels, pb.rn_key, pb.rn_op, pb.rn_vals)
-    anti_m = anti_sel & ns_match(pb.rn_ns, pm.ns) & pm.valid[None, :]
-    node_dom_rn = node_domains(nt, pb.rn_tk)
-    dom_m_rn = jnp.take_along_axis(
-        node_dom_rn, jnp.broadcast_to(pm.node[None, :], anti_m.shape), axis=1)
-    hit_rn = _anchored_hit(anti_m, dom_m_rn, num_label_values)
-    blocked_anti = jnp.take_along_axis(hit_rn, node_dom_rn, axis=1) & (node_dom_rn > 0)
+    ok_aff = ok_u[pb.ra_uid]  # [P, N]
+    any_aff = any_u[pb.ra_uid]
+    node_dom_ra = node_dom_u[pb.ra_uid]
+    blocked_anti = ok_u[pb.rn_uid]
+    node_dom_rn = node_dom_u[pb.rn_uid]
 
     # --- priority counts -------------------------------------------------
     # existing-pod side: hard symmetric weight for required affinity terms,
@@ -157,18 +158,19 @@ def incoming_statics(nt: NodeTensors, pm: PodMatrix, tt: TermTable,
         [jnp.full_like(tt.weight, hard_weight), tt.weight, -tt.weight],
         default=jnp.zeros_like(tt.weight))
     counts = (em.astype(jnp.float32) * we[None, :]) @ sd.astype(jnp.float32)
-    # incoming pod's preferred terms
+    # incoming pods' preferred terms: unique-table evaluation, then a
+    # per-slot gather + weight (weights stay per-pod in pa_w)
+    pu_sel = _eval_programs(pm.labels, pb.pu_key, pb.pu_op, pb.pu_vals)
+    pu_m = pu_sel & ns_match(pb.pu_ns, pm.ns) & pm.valid[None, :]  # [UP, M]
+    dom_pu = node_domains(nt, pb.pu_tk)  # [UP, N]
+    dom_m_pu = jnp.take_along_axis(
+        dom_pu, jnp.broadcast_to(pm.node[None, :], pu_m.shape), axis=1)
+    cnt_u = _anchored_hit(pu_m, dom_m_pu, num_label_values, count=True)
+    cnt_node_u = (jnp.take_along_axis(cnt_u, dom_pu, axis=1)
+                  * (dom_pu > 0))  # [UP, N]
     PA = pb.pa_w.shape[1]
     for t in range(PA):
-        sel_t = _eval_programs(pm.labels, pb.pa_key[:, t], pb.pa_op[:, t],
-                               pb.pa_vals[:, t])  # [P, M]
-        match_t = sel_t & ns_match(pb.pa_ns[:, t], pm.ns) & pm.valid[None, :]
-        dom_n_t = node_domains(nt, pb.pa_tk[:, t])  # [P, N]
-        dom_m_t = jnp.take_along_axis(
-            dom_n_t, jnp.broadcast_to(pm.node[None, :], match_t.shape), axis=1)
-        cnt_t = _anchored_hit(match_t, dom_m_t, num_label_values, count=True)
-        counts = counts + pb.pa_w[:, t, None] * (
-            jnp.take_along_axis(cnt_t, dom_n_t, axis=1) * (dom_n_t > 0))
+        counts = counts + pb.pa_w[:, t, None] * cnt_node_u[pb.pa_uid[:, t]]
     counts = counts * nt.valid[None, :]
 
     # --- wave-internal cross matrices ------------------------------------

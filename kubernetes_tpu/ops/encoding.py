@@ -140,6 +140,8 @@ class Caps:
     IV: int = 2  # values per combined-program expression
     PA: int = 2  # preferred pod-(anti)affinity terms per pending pod
     LV: int = 64  # label-value vocab bucket (segment count for domain anchoring)
+    UI: int = 8  # unique required (anti)affinity programs per wave (dedup table)
+    UP: int = 4  # unique preferred pod-affinity terms per wave (dedup table)
 
 
 class NodeTensors(NamedTuple):
@@ -264,6 +266,26 @@ class PodBatch(NamedTuple):
     img_id: np.ndarray  # i32 [P, PI]
     prio: np.ndarray  # i32 [P]  pod priority
     valid: np.ndarray  # bool [P]
+    # Dedup tables for the O(P x M) hot paths in ops/affinity.py: pods
+    # from the same controller share identical (anti)affinity programs,
+    # so the wave's REQUIRED programs are interned into one [UI, ...]
+    # table (row 0 = reserved never-matches row) evaluated once against
+    # the existing-pod matrix, and per-pod results are gathered via
+    # ra_uid/rn_uid. Preferred terms intern likewise into [UP, ...] /
+    # pa_uid. Replicated (not wave-sharded) under a device mesh.
+    ra_uid: np.ndarray  # i32 [P]  index into iu_* (0 = no program)
+    rn_uid: np.ndarray  # i32 [P]
+    pa_uid: np.ndarray  # i32 [P, PA]  index into pu_* (0 = no term)
+    iu_key: np.ndarray  # i32 [UI, IE]
+    iu_op: np.ndarray  # i32 [UI, IE]
+    iu_vals: np.ndarray  # i32 [UI, IE, IV]
+    iu_ns: np.ndarray  # i32 [UI, TNS]
+    iu_tk: np.ndarray  # i32 [UI]
+    pu_key: np.ndarray  # i32 [UP, TE]
+    pu_op: np.ndarray  # i32 [UP, TE]
+    pu_vals: np.ndarray  # i32 [UP, TE, TV]
+    pu_ns: np.ndarray  # i32 [UP, TNS]
+    pu_tk: np.ndarray  # i32 [UP]
 
 
 # Names + order of the device-evaluated predicates; the stacked mask output

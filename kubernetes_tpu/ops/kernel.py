@@ -93,29 +93,15 @@ def pallas_default() -> bool:
         return False
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
-    "pallas_interpret"))
-def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
-                  pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
-                  *, weights: Weights,
-                  num_zones: int, num_label_values: int = 64,
-                  has_ipa: bool = False, use_pallas: bool = False,
-                  pallas_interpret: bool = False) -> WaveResult:
-    """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
-    volume predicates) for the rare pods that need them; all-True rows for
-    everyone else. Appended to the mask stack as a final "HostPlugins"
-    pseudo-predicate for failure attribution.
-
-    extra_scores: optional f32 [P, N] — host-evaluated Score contributions
-    (policy host priorities, HTTP extender Prioritize), pre-multiplied by
-    their weights; added to the device weighted sum before argmax
-    (reference: generic_scheduler.go:650 folds extender priorities into
-    the same result list).
-
-    has_ipa (static): compiles the inter-pod affinity path in. When no
-    affinity terms exist anywhere (the common case), the False variant
-    keeps the program identical to the affinity-free kernel."""
+def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
+               pb: enc.PodBatch, extra_mask, rr_start, extra_scores,
+               weights: Weights, num_zones: int, num_label_values: int,
+               has_ipa: bool, use_pallas: bool, pallas_interpret: bool,
+               usage_in=None):
+    """Shared wave computation. usage_in: optional (requested, nonzero,
+    pod_count) overriding nt's usage columns — the device-resident carry
+    that lets consecutive waves chain without a host roundtrip. Returns
+    (WaveResult, usage_out)."""
     N = nt.valid.shape[0]
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
@@ -236,7 +222,9 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         out = (chosen, best, fits, jnp.sum(feasible.astype(jnp.int32)), ipa_ok)
         return (req_c, nz_c, cnt_c, rr, placed), out
 
-    carry0 = (nt.requested, nt.nonzero, nt.pod_count,
+    usage0 = usage_in if usage_in is not None else (
+        nt.requested, nt.nonzero, nt.pod_count)
+    carry0 = (usage0[0], usage0[1], usage0[2],
               jnp.asarray(rr_start, jnp.int32), jnp.full((P,), -1, jnp.int32))
     ii = jnp.arange(P, dtype=jnp.int32)
     if has_ipa:
@@ -250,7 +238,8 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     else:
         xs = (ii, pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw,
               spread_cnt, static_score, pb.valid)
-    (_, _, _, rr_end, _), (chosen, best, dyn_fits, feas_cnt, ipa_masks) = \
+    (req_end, nz_end, cnt_end, rr_end, _), \
+        (chosen, best, dyn_fits, feas_cnt, ipa_masks) = \
         lax.scan(step, carry0, xs)
 
     masks = masks.at[res_i].set(dyn_fits)
@@ -262,5 +251,126 @@ def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         [jnp.ones((1,) + masks.shape[1:], bool), prefix_ok[:-1]], axis=0)
     first_fail = ~masks & first & nt.valid[None, None, :]
     fail_counts = jnp.sum(first_fail.astype(jnp.int32), axis=-1)  # [Q, P]
-    return WaveResult(chosen=chosen, score=best, feasible_count=feas_cnt,
-                      fail_counts=fail_counts, masks=masks, rr_end=rr_end)
+    res = WaveResult(chosen=chosen, score=best, feasible_count=feas_cnt,
+                     fail_counts=fail_counts, masks=masks, rr_end=rr_end)
+    return res, (req_end, nz_end, cnt_end)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
+    "pallas_interpret"))
+def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
+                  pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
+                  *, weights: Weights,
+                  num_zones: int, num_label_values: int = 64,
+                  has_ipa: bool = False, use_pallas: bool = False,
+                  pallas_interpret: bool = False) -> WaveResult:
+    """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
+    volume predicates) for the rare pods that need them; all-True rows for
+    everyone else. Appended to the mask stack as a final "HostPlugins"
+    pseudo-predicate for failure attribution.
+
+    extra_scores: optional f32 [P, N] — host-evaluated Score contributions
+    (policy host priorities, HTTP extender Prioritize), pre-multiplied by
+    their weights; added to the device weighted sum before argmax
+    (reference: generic_scheduler.go:650 folds extender priorities into
+    the same result list).
+
+    has_ipa (static): compiles the inter-pod affinity path in. When no
+    affinity terms exist anywhere (the common case), the False variant
+    keeps the program identical to the affinity-free kernel."""
+    res, _ = _wave_body(nt, pm, tt, pb, extra_mask, rr_start, extra_scores,
+                        weights, num_zones, num_label_values, has_ipa,
+                        use_pallas, pallas_interpret)
+    return res
+
+
+def _stage_placements(pm: enc.PodMatrix, tt: enc.TermTable, chosen,
+                      pm_rows, term_rows):
+    """Flip this wave's placements into the pod matrix / term table ON
+    DEVICE so the next chained wave sees them (spreading counts read pm;
+    required (anti)affinity reads tt)."""
+    ok = (chosen >= 0) & (pm_rows >= 0)
+    safe_choice = jnp.clip(chosen, 0)
+    # pad/unplaced entries scatter to an out-of-bounds row and are
+    # DROPPED (mode="drop") — clipping them to row 0 would race real
+    # updates to row 0 under duplicate-index scatter ordering
+    M = pm.node.shape[0]
+    target = jnp.where(ok, pm_rows, M)
+    pm2 = pm._replace(
+        node=pm.node.at[target].set(safe_choice, mode="drop"),
+        valid=pm.valid.at[target].set(True, mode="drop"))
+    TPP = term_rows.shape[1]
+    E = tt.node.shape[0]
+    tok = ok[:, None] & (term_rows >= 0)
+    ttarget = jnp.where(tok, term_rows, E).ravel()
+    tchoice = jnp.repeat(safe_choice, TPP)
+    tt2 = tt._replace(
+        node=tt.node.at[ttarget].set(tchoice, mode="drop"),
+        valid=tt.valid.at[ttarget].set(True, mode="drop"))
+    return pm2, tt2
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
+    "pallas_interpret"))
+def schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
+                   tt: enc.TermTable, pbs: enc.PodBatch,
+                   usage, rr_start, pm_rows, term_rows, *,
+                   weights: Weights, num_zones: int,
+                   num_label_values: int = 64, has_ipa: bool = False,
+                   use_pallas: bool = False, pallas_interpret: bool = False):
+    """An ENTIRE scheduling round as one program: lax.scan over W waves,
+    each wave a full _wave_body pass whose placements are staged into the
+    pod matrix / term table carries before the next wave runs.
+
+    Two platform realities shape this design (measured on the tunneled
+    TPU runtime, see sched/scheduler.py _schedule_pipelined): (a) the
+    first device->host fetch permanently degrades the runtime's transfer
+    and dispatch paths ~10-900x, so a round must not fetch per wave; and
+    (b) each program EXECUTION carries a fixed ~50ms overhead while an
+    extra wave inside one program costs ~15ms, so W waves as W dispatches
+    is ~4x slower than W waves under one scan even before fetch effects.
+
+    pbs: a PodBatch whose fields are stacked [W, ...] (padded waves have
+    valid=False rows and schedule nothing). pm_rows [W, P] / term_rows
+    [W, P, TPP]: pre-staged row ids (-1 pads). Host-plugin masks and
+    extender scores are deliberately absent: waves needing them take the
+    per-wave path (scheduler falls back when any mask row is non-trivial).
+    Returns (chosen [W, P], fail_counts [W, Q, P], usage', rr_end)."""
+    P = pbs.req.shape[1]
+    N = nt.valid.shape[0]
+    ones = jnp.ones((P, N), bool)
+
+    Q = len(enc.MASK_STACK_NAMES)
+
+    def live_wave(carry, x):
+        pm_c, tt_c, usage_c, rr_c = carry
+        pb, rows, trows = x
+        res, usage_o = _wave_body(nt, pm_c, tt_c, pb, ones, rr_c, None,
+                                  weights, num_zones, num_label_values,
+                                  has_ipa, use_pallas, pallas_interpret,
+                                  usage_in=usage_c)
+        pm_o, tt_o = _stage_placements(pm_c, tt_c, res.chosen, rows, trows)
+        return (pm_o, tt_o, usage_o, res.rr_end), (res.chosen,
+                                                   res.fail_counts)
+
+    def padded_wave(carry, x):
+        # bucket-padding waves skip the whole body at RUNTIME (lax.cond
+        # executes one branch): without this, a padded ipa wave still
+        # pays the full O(P*M) precompute — 31 pad waves in a 1-wave
+        # warm round cost ~25s of device time for nothing
+        return carry, (jnp.full((P,), -1, jnp.int32),
+                       jnp.zeros((Q, P), jnp.int32))
+
+    def wave(carry, x):
+        active = x[3]
+        return lax.cond(active, live_wave, padded_wave, carry, x[:3])
+
+    active = jnp.any(pbs.valid, axis=1)  # [W]
+    carry0 = (pm, tt, usage, jnp.asarray(rr_start, jnp.int32))
+    (_, _, usage_end, rr_end), (chosen, fail_counts) = lax.scan(
+        wave, carry0, (pbs, pm_rows, term_rows, active))
+    return chosen, fail_counts, usage_end, rr_end
+
+

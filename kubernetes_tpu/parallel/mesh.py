@@ -94,7 +94,12 @@ def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
     nt_s = enc.NodeTensors(*[nodes0(a) for a in nt])
     pm_s = enc.PodMatrix(*[_put(a, repl) for a in pm])
     tt_s = enc.TermTable(*[_put(a, repl) for a in tt])
-    pb_s = enc.PodBatch(*[wave0(a) for a in pb])
+    # per-pod fields shard on the wave axis; the dedup program tables
+    # (iu_*/pu_*, leading dim = unique programs, not pods) are shared by
+    # every wave shard and must be replicated
+    pb_s = enc.PodBatch(**{
+        f: _put(a, repl) if f.startswith(("iu_", "pu_")) else wave0(a)
+        for f, a in zip(enc.PodBatch._fields, pb)})
     extra_s = shard_extra(mesh, extra_mask)
     return nt_s, pm_s, tt_s, pb_s, extra_s
 

@@ -52,6 +52,32 @@ from .preemption import get_lower_priority_nominated_pods, preempt
 from .queue import SchedulingQueue
 
 
+# Max chained waves per device-resident round; rounds compile per
+# power-of-two wave-count bucket (a fixed W would make small rounds pay
+# for 128 scan iterations). Longer backlogs run multiple rounds. The
+# inter-pod-affinity variant is capped lower: at full caps (M=32k,
+# E=8k, N=8k) a 128-iteration ipa scan crashes the TPU worker outright
+# (observed on v5e; W<=32 executes fine).
+PIPELINE_MAX_WAVES = 128
+PIPELINE_MAX_WAVES_IPA = 32
+
+
+def pipeline_bucket(n_waves: int, lo: int = 4,
+                    hi: int = PIPELINE_MAX_WAVES) -> int:
+    """Smallest power-of-two wave-count >= n_waves (ceiling at hi) — the
+    static W of the round program."""
+    b = lo
+    while b < n_waves and b < hi:
+        b *= 2
+    return b
+
+
+def _pod_has_ipa_terms(pod: api.Pod) -> bool:
+    aff = pod.spec.affinity
+    return aff is not None and (aff.pod_affinity is not None
+                                or aff.pod_anti_affinity is not None)
+
+
 class GroupLister:
     """Selectors of services/RCs/RSs/StatefulSets that select a pod
     (reference: priorities metadata getSelectors,
@@ -268,15 +294,32 @@ class Scheduler:
     def schedule_pending(self, max_waves: Optional[int] = None) -> int:
         """Run waves until the active queue drains, then drain in-flight
         binds so the store state is settled on return. Returns pods
-        placed (assumed + bind dispatched)."""
+        placed (assumed + bind dispatched).
+
+        Large backlogs take the device-resident pipeline first (see
+        _schedule_pipelined); stragglers and failures fall through to the
+        per-wave loop below."""
         placed = 0
         waves = 0
+        allow_pipeline = True
         while True:
             if self.queue.active_count() == 0:
                 # a failed async bind may requeue a pod: settle and recheck
                 self.wait_for_binds()
                 if self.queue.active_count() == 0:
                     break
+            if (allow_pipeline and max_waves is None and self.mesh is None
+                    and self.queue.active_count() >= 2 * self.wave_size):
+                n = self._schedule_pipelined()
+                placed += n
+                if n > 0:
+                    continue
+                # zero progress is systemic (host plugins/extenders in
+                # play, or an unplaceable backlog): disable the pipeline
+                # for the rest of this drain — re-attempting it before
+                # every per-wave step would re-pop and re-stage the whole
+                # remaining backlog each time, O(waves^2) work
+                allow_pipeline = False
             placed += self.run_once()
             waves += 1
             if max_waves is not None and waves >= max_waves:
@@ -295,6 +338,232 @@ class Scheduler:
             return 0
         with self._mu:
             return self._run_wave(pods)
+
+    def _schedule_pipelined(self) -> int:
+        """Device-resident scheduling round: chain every pending wave on
+        device and fetch results ONCE at the end.
+
+        Why: the per-wave loop reads `chosen` back after every wave, and
+        on tunneled TPU runtimes the first device->host transfer drops
+        the runtime into a degraded mode where each subsequent dispatch
+        costs ~100-1000x its pristine latency. Staging pending pods'
+        PodMatrix/TermTable rows up front (state/snapshot.py
+        stage_pending) and flipping them on device as waves place
+        (ops/kernel.py schedule_wave_resident) keeps inter-wave
+        visibility — resources via the usage carry, spreading via the
+        live pod matrix, inter-pod (anti)affinity via the live term
+        table — without any host roundtrip. The host then replays the
+        fetched placements through the exact int64 recheck + assume +
+        async bind path, identical to the per-wave flow.
+
+        Pods the device can't encode (multi-topology-key required
+        affinity) and pods that fail placement are handed back to the
+        per-wave path, which owns failure attribution and preemption."""
+        with self._mu:
+            self.cache.cleanup_expired()
+        all_pods: List[api.Pod] = []
+        while True:
+            batch = self.queue.pop_wave(self.wave_size, timeout=0.0)
+            if not batch:
+                break
+            all_pods.extend(batch)
+        if not all_pods:
+            return 0
+        with self._mu:
+            host_path = [p for p in all_pods
+                         if self.featurizer.needs_host_path(p)]
+            placed = 0
+            for p in host_path:
+                placed += self._schedule_host_path(p)
+            pods = [p for p in all_pods
+                    if not self.featurizer.needs_host_path(p)]
+            if not pods:
+                return placed
+            return placed + self._run_pipeline(pods)
+
+    def warm_pipeline(self, pods: List[api.Pod],
+                      n_waves: Optional[int] = None) -> None:
+        """Compile + execute the round program for this cluster's shapes
+        WITHOUT fetching results. A device->host fetch would drop
+        tunneled TPU runtimes into their degraded transfer mode (see
+        _schedule_pipelined) — so a warm-up that ended with a fetch would
+        poison the very run it warms. n_waves selects the wave-count
+        bucket to compile (default: one bucket covering len(pods)/wave).
+        The pods are left unscheduled; staged rows are released."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.kernel import schedule_round
+
+        with self._mu:
+            pods = [p for p in pods
+                    if not self.featurizer.needs_host_path(p)][:self.wave_size]
+            if not pods:
+                return
+            self.featurizer.featurize(pods)
+            pm_rows, term_rows = self.snapshot.stage_pending(pods)
+            pb = self.featurizer.featurize(pods)
+            P = pb.req.shape[0]
+            nt, pm, tt = self.snapshot.to_device()
+            usage = (nt.requested, nt.nonzero, nt.pod_count)
+            if self._use_pallas is None:
+                self._use_pallas = pallas_default()
+            has_ipa = bool(self.snapshot.has_affinity_terms
+                           or pb.ra_has.any() or pb.rn_has.any()
+                           or (pb.pa_w != 0).any())
+            wbucket = pipeline_bucket(
+                n_waves if n_waves is not None else 1,
+                hi=PIPELINE_MAX_WAVES_IPA if has_ipa else PIPELINE_MAX_WAVES)
+            tpp = term_rows.shape[1]
+            pbs_stacked = enc.PodBatch(
+                *[np.stack([a] + [np.zeros_like(a)] * (wbucket - 1))
+                  for a in pb])
+            rows = np.full((wbucket, P), -1, np.int32)
+            rows[0, :len(pods)] = pm_rows[:len(pods)]
+            trows = np.full((wbucket, P, tpp), -1, np.int32)
+            trows[0, :len(pods)] = term_rows[:len(pods)]
+            try:
+                out = schedule_round(
+                    nt, pm, tt, pbs_stacked, usage,
+                    jnp.asarray(0, jnp.int32), rows, trows,
+                    weights=self.profile.weights(),
+                    num_zones=self.snapshot.caps.Z,
+                    num_label_values=self.snapshot.num_label_values,
+                    has_ipa=has_ipa, use_pallas=False)
+                jax.block_until_ready(out[0])
+            finally:
+                for p in pods:
+                    self.snapshot.unstage(p)
+
+    def _run_pipeline(self, pods: List[api.Pod]) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.kernel import schedule_round
+
+        trace = Trace(f"pipeline of {len(pods)}", clock=self.clock)
+        start = self.clock()
+        W = self.wave_size
+        # ipa anywhere in the backlog (or already placed) caps the round
+        # at the ipa-safe wave count, even for ipa-free leading rounds
+        max_waves = (PIPELINE_MAX_WAVES_IPA
+                     if (self.snapshot.has_affinity_terms
+                         or any(_pod_has_ipa_terms(p) for p in pods))
+                     else PIPELINE_MAX_WAVES)
+        waves = [pods[i:i + W] for i in range(0, len(pods), W)]
+        if len(waves) > max_waves:
+            # bound the round (fixed program size); the leftover goes back
+            # to the queue and the next schedule_pending iteration runs
+            # another round
+            keep = max_waves * W
+            for p in pods[keep:]:
+                self.queue.add_if_not_present(p)
+            pods, waves = pods[:keep], waves[:max_waves]
+        # pass 1: grow every vocab/cap to its final size so pass 2 emits
+        # uniform shapes (one compiled program, not one per growth step)
+        for wv in waves:
+            self.featurizer.featurize(wv)
+        pbs = []
+        try:
+            for wv in waves:
+                pbs.append(self.featurizer.featurize(wv))
+                P = pbs[-1].req.shape[0]
+                extra = self._host_plugin_mask(wv, P)
+                if (not extra.all()
+                        or self._host_score_matrix(wv, P) is not None):
+                    # host plugin predicates / extender priorities are in
+                    # play: those need per-wave host evaluation against
+                    # fresh state — the per-wave loop owns that path
+                    for p in pods:
+                        self.queue.add_if_not_present(p)
+                    return 0
+        except ExtenderError:
+            for p in pods:
+                self._park_with_backoff(p)
+            return 0
+        pm_rows_all, term_rows_all = self.snapshot.stage_pending(pods)
+        tpp = term_rows_all.shape[1]
+        trace.step("featurized+staged")
+        nt, pm, tt = self.snapshot.to_device()
+        trace.step("uploaded")
+        usage = (nt.requested, nt.nonzero, nt.pod_count)
+        if self._rr is None:
+            self._rr = jnp.asarray(0, jnp.int32)
+        if self._use_pallas is None:
+            self._use_pallas = pallas_default()
+        has_ipa = bool(self.snapshot.has_affinity_terms
+                       or any(pb.ra_has.any() or pb.rn_has.any()
+                              or (pb.pa_w != 0).any() for pb in pbs))
+        P = pbs[0].req.shape[0]
+        nw = len(waves)
+        wbucket = pipeline_bucket(nw, hi=max_waves)
+        # pad to the bucket: zeroed batches have valid=False rows and
+        # schedule nothing; -1 row ids stage nothing
+        pad_pb = enc.PodBatch(*[np.zeros_like(a) for a in pbs[0]])
+        pbs_padded = pbs + [pad_pb] * (wbucket - nw)
+        pbs_stacked = enc.PodBatch(*[np.stack(arrs)
+                                     for arrs in zip(*pbs_padded)])
+        pm_rows = np.full((wbucket, P), -1, np.int32)
+        term_rows = np.full((wbucket, P, tpp), -1, np.int32)
+        cursor = 0
+        for wi, wv in enumerate(waves):
+            n = len(wv)
+            pm_rows[wi, :n] = pm_rows_all[cursor:cursor + n]
+            term_rows[wi, :n] = term_rows_all[cursor:cursor + n]
+            cursor += n
+        try:
+            chosen_d, fail_d, _usage_end, rr_end = schedule_round(
+                nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,
+                term_rows, weights=self.profile.weights(),
+                num_zones=self.snapshot.caps.Z,
+                num_label_values=self.snapshot.num_label_values,
+                # the fused pallas masks kernel faults under lax.scan on
+                # real TPU (Mosaic), and measures equal to the XLA
+                # formulation anyway — rounds always take the XLA path
+                has_ipa=has_ipa, use_pallas=False)
+            trace.step("dispatched")
+            # FINISH the round before the first fetch: block_until_ready
+            # does not poison the transfer path, the fetch does — and a
+            # fetch issued while waves are still queued waits them out in
+            # degraded mode
+            jax.block_until_ready(chosen_d)
+            trace.step("executed")
+            chosen_all = np.asarray(chosen_d)
+            trace.step("fetched")
+        except Exception as e:
+            import sys
+            import traceback
+
+            print(f"# pipeline round failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            for p in pods:
+                self.snapshot.unstage(p)
+                self.queue.add_if_not_present(p)
+            return 0
+        self._rr = rr_end
+        placed = 0
+        retry: List[api.Pod] = []
+        for wi, wv in enumerate(waves):
+            for i, pod in enumerate(wv):
+                self.metrics.schedule_attempts.inc()
+                node_idx = int(chosen_all[wi, i])
+                if node_idx >= 0:
+                    node_name = self.snapshot.node_names[node_idx]
+                    if self._commit(pod, node_name):
+                        placed += 1
+                        continue
+                # device placement rejected by the exact recheck, or the
+                # pod failed on device: the per-wave path owns failure
+                # attribution/preemption — hand it back
+                self.snapshot.unstage(pod)
+                retry.append(pod)
+        for pod in retry:
+            self.queue.add_if_not_present(pod)
+        trace.step("committed")
+        self.metrics.e2e_scheduling_latency.observe(self.clock() - start)
+        trace.log_if_long(0.5)
+        return placed
 
     def _run_wave(self, pods: List[api.Pod]) -> int:
         import jax

@@ -530,8 +530,75 @@ class PodFeaturizer:
             img_id=stack("img_id", (c.PI,), np.int32),
             prio=stack("prio", (), np.int32),
             valid=np.arange(P) < len(pods),
+            **self._dedup_tables(rows, P),
         )
         return batch
+
+    def _dedup_tables(self, rows, P: int) -> Dict[str, np.ndarray]:
+        """Intern the wave's required/preferred pod-affinity programs into
+        unique tables (PodBatch.iu_*/pu_* + uid indices). Pods stamped
+        from one controller template share programs, so the device side
+        evaluates U unique programs against the M existing pods instead
+        of P — the difference between O(P*M) and O(U*M) in
+        ops/affinity.py incoming_statics. Row 0 of each table is a
+        reserved never-matches program (OP_FALSE, tk 0)."""
+        c = self.snap.caps
+        ra_uid = np.zeros(P, np.int32)
+        rn_uid = np.zeros(P, np.int32)
+        pa_uid = np.zeros((P, c.PA), np.int32)
+        iu_rows: List[tuple] = []
+        iu_index: Dict[bytes, int] = {}
+        pu_rows: List[tuple] = []
+        pu_index: Dict[bytes, int] = {}
+
+        def intern(index, rows_list, parts) -> int:
+            key = b"|".join(p.tobytes() for p in parts)
+            j = index.get(key)
+            if j is None:
+                j = len(rows_list) + 1  # +1: row 0 reserved
+                index[key] = j
+                rows_list.append(parts)
+            return j
+
+        for i, d in enumerate(rows):
+            if d["ra_has"]:
+                ra_uid[i] = intern(iu_index, iu_rows, (
+                    d["ra_key"], d["ra_op"], d["ra_vals"], d["ra_ns"],
+                    d["ra_tk"]))
+            if d["rn_has"]:
+                rn_uid[i] = intern(iu_index, iu_rows, (
+                    d["rn_key"], d["rn_op"], d["rn_vals"], d["rn_ns"],
+                    d["rn_tk"]))
+            for t in range(c.PA):
+                if d["pa_w"][t] != 0:
+                    pa_uid[i, t] = intern(pu_index, pu_rows, (
+                        d["pa_key"][t], d["pa_op"][t], d["pa_vals"][t],
+                        d["pa_ns"][t], d["pa_tk"][t]))
+        if len(iu_rows) + 1 > c.UI:
+            self.snap.caps.UI = bucket_size(len(iu_rows) + 1, c.UI)
+        if len(pu_rows) + 1 > c.UP:
+            self.snap.caps.UP = bucket_size(len(pu_rows) + 1, c.UP)
+        c = self.snap.caps
+
+        def table(rows_list, n, e_dim, v_dim):
+            key = np.zeros((n, e_dim), np.int32)
+            op = np.full((n, e_dim), enc.OP_PAD, np.int32)
+            op[:, 0] = enc.OP_FALSE  # reserved/pad rows match nothing
+            vals = np.full((n, e_dim, v_dim), -1, np.int32)
+            ns = np.zeros((n, c.TNS), np.int32)
+            tk = np.zeros((n,), np.int32)
+            for j, (k_, o_, v_, n_, t_) in enumerate(rows_list, start=1):
+                key[j], op[j], vals[j], ns[j], tk[j] = k_, o_, v_, n_, t_
+            return key, op, vals, ns, tk
+
+        iu_key, iu_op, iu_vals, iu_ns, iu_tk = table(
+            iu_rows, c.UI, c.IE, c.IV)
+        pu_key, pu_op, pu_vals, pu_ns, pu_tk = table(
+            pu_rows, c.UP, c.TE, c.TV)
+        return dict(ra_uid=ra_uid, rn_uid=rn_uid, pa_uid=pa_uid,
+                    iu_key=iu_key, iu_op=iu_op, iu_vals=iu_vals,
+                    iu_ns=iu_ns, iu_tk=iu_tk, pu_key=pu_key, pu_op=pu_op,
+                    pu_vals=pu_vals, pu_ns=pu_ns, pu_tk=pu_tk)
 
     def _caps_match(self, d: Dict[str, np.ndarray]) -> bool:
         c = self.snap.caps

@@ -294,13 +294,8 @@ class Snapshot:
 
     # ---- existing-pod matrix ------------------------------------------------
 
-    def add_pod(self, pod: api.Pod):
-        """Add/refresh a scheduled pod's row in the PodMatrix."""
-        node_idx = self.node_index.get(pod.spec.node_name)
-        if node_idx is None:
-            return
-        v = self.vocabs
-        slot = self.pod_slot.get(pod.uid)
+    def _alloc_slot(self, uid: str) -> int:
+        slot = self.pod_slot.get(uid)
         if slot is None:
             if self._free_slots:
                 slot = self._free_slots.pop()
@@ -309,7 +304,12 @@ class Snapshot:
                 self._next_slot += 1
                 if slot >= self.caps.M:
                     self._grow(M=slot + 1)
-            self.pod_slot[pod.uid] = slot
+            self.pod_slot[uid] = slot
+        return slot
+
+    def _write_pod_row(self, pod: api.Pod, slot: int, node_idx: int,
+                       active: bool):
+        v = self.vocabs
         for key in pod.metadata.labels or {}:
             kid = v.pod_label_keys.intern(key)
             if kid >= self.caps.KP:
@@ -319,10 +319,52 @@ class Snapshot:
             self.ep_labels[slot, v.pod_label_keys.intern(key)] = v.label_values.intern(val)
         self.ep_ns[slot] = v.namespaces.intern(pod.namespace)
         self.ep_node[slot] = node_idx
-        self.ep_valid[slot] = True
-        self.ep_alive[slot] = pod.metadata.deletion_timestamp is None
+        self.ep_valid[slot] = active
+        self.ep_alive[slot] = (active
+                               and pod.metadata.deletion_timestamp is None)
+
+    def add_pod(self, pod: api.Pod):
+        """Add/refresh a scheduled pod's row in the PodMatrix."""
+        node_idx = self.node_index.get(pod.spec.node_name)
+        if node_idx is None:
+            return
+        slot = self._alloc_slot(pod.uid)
+        self._write_pod_row(pod, slot, node_idx, active=True)
         self._set_pod_terms(pod, slot, node_idx)
         self.dirty_pods = True
+
+    def stage_pending(self, pods) -> Tuple[np.ndarray, np.ndarray]:
+        """Pre-stage pending pods into the PodMatrix/TermTable with
+        valid=False rows: labels, namespaces, and term programs are
+        written now so the device-resident pipeline
+        (ops/kernel.py schedule_wave_resident) can flip validity and set
+        node indices on device as placements happen — no host roundtrip
+        between waves. Returns (pm_rows i32 [n], term_rows i32 [n, TPP],
+        -1 pads). Slots stay registered to the pod uid: the post-fetch
+        host commit's add_pod() reuses them; unstage() frees rows of
+        pods that didn't place."""
+        n = len(pods)
+        pm_rows = np.full(max(n, 1), -1, np.int32)
+        per_pod_terms: List[List[int]] = []
+        for i, pod in enumerate(pods):
+            slot = self._alloc_slot(pod.uid)
+            # staged alive=True: anti-affinity of later waves must see it
+            # once placed (the device only flips valid/node)
+            self._write_pod_row(pod, slot, node_idx=0, active=False)
+            self.ep_alive[slot] = pod.metadata.deletion_timestamp is None
+            pm_rows[i] = slot
+            self._set_pod_terms(pod, slot, node_idx=0, active=False)
+            per_pod_terms.append(list(self.term_rows.get(pod.uid, ())))
+        tpp = max([len(t) for t in per_pod_terms] + [1])
+        term_rows = np.full((max(n, 1), tpp), -1, np.int32)
+        for i, rows in enumerate(per_pod_terms):
+            term_rows[i, :len(rows)] = rows
+        self.dirty_pods = True
+        return pm_rows, term_rows
+
+    def unstage(self, pod: api.Pod):
+        """Free the staged rows of a pod the pipeline did not place."""
+        self.remove_pod(pod)
 
     def remove_pod(self, pod: api.Pod):
         slot = self.pod_slot.pop(pod.uid, None)
@@ -375,7 +417,8 @@ class Snapshot:
             for wt in aff.pod_anti_affinity.preferred:
                 yield enc.TERM_PREF_ANTI, float(wt.weight), wt.pod_affinity_term
 
-    def _set_pod_terms(self, pod: api.Pod, slot: int, node_idx: int):
+    def _set_pod_terms(self, pod: api.Pod, slot: int, node_idx: int,
+                       active: bool = True):
         self._clear_pod_terms(pod.uid)
         terms = list(self._iter_pod_terms(pod))
         if not terms:
@@ -420,7 +463,7 @@ class Snapshot:
                     self.t_key[row, i] = kid
                     self.t_op[row, i] = op
                     self.t_vals[row, i, : len(vals)] = vals
-            self.t_valid[row] = True
+            self.t_valid[row] = active
             rows.append(row)
         self.term_rows[pod.uid] = rows
 

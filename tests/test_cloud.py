@@ -172,6 +172,36 @@ class TestRouteController:
         assert cloud.route_table == {}
 
 
+class TestRouteFailure:
+    def test_failed_create_marks_node_unreachable(self):
+        store = ObjectStore()
+        cloud = FakeCloud()
+        n1 = mknode("n1")
+        n1.spec.pod_cidr = "10.244.0.0/24"
+        store.create("nodes", n1)
+        orig_create = cloud.create_route
+
+        def always_fail(*a, **k):
+            raise RuntimeError("cloud down")
+
+        cloud.create_route = always_fail
+        rc = RouteController(store, cloud)
+        rc.sync_all()
+        assert rc.sync_errors >= 1
+        node = store.get("nodes", "default", "n1")
+        cond = next(c for c in node.status.conditions
+                    if c.type == api.NODE_NETWORK_UNAVAILABLE)
+        assert cond.status == api.COND_TRUE  # scheduler must avoid it
+        cloud.create_route = orig_create
+        import time
+        time.sleep(0.3)
+        rc.sync_all()  # retry succeeds
+        node = store.get("nodes", "default", "n1")
+        cond = next(c for c in node.status.conditions
+                    if c.type == api.NODE_NETWORK_UNAVAILABLE)
+        assert cond.status == api.COND_FALSE
+
+
 class TestCloudNode:
     def test_initializes_tainted_node(self):
         store = ObjectStore()
