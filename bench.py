@@ -165,7 +165,10 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     # the featurizer's unique-program table (Caps.UI) buckets by the
     # wave's distinct program count, and a warm-up with fewer groups
     # would compile a smaller-UI program than the measured run uses
-    n_anti_warm = min(50, wave // 2) if has_ipa_load else 0
+    # mirror the real per-wave group count: a wave of W anti pods with
+    # groups i%50 holds min(W, 50) distinct programs, and a warm-up with
+    # fewer would compile a smaller Caps.UI bucket than the measured run
+    n_anti_warm = min(50, wave) if has_ipa_load else 0
     warm_n = max(wave - n_anti_warm, 0)
     for i in range(warm_n):
         p = _base_pod(api, f"warmup-{i}", "warmup")

@@ -225,6 +225,16 @@ class Volume:
     source_id: str = ""  # pd name / volume id / image spec
     read_only: bool = False
     pvc_name: str = ""  # non-empty for persistentVolumeClaim volumes
+    # local ephemeral / API-backed sources (core/v1 VolumeSource fields;
+    # consumed by the volume plugin layer, kubernetes_tpu/volume/)
+    empty_dir: bool = False
+    host_path: str = ""
+    config_map: str = ""  # ConfigMap name
+    secret: str = ""  # Secret name
+    downward_api: Dict[str, str] = field(default_factory=dict)  # path -> fieldRef
+    nfs_server: str = ""
+    nfs_path: str = ""
+    projected: List["Volume"] = field(default_factory=list)  # sub-sources
 
 
 @dataclass

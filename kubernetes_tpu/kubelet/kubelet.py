@@ -59,6 +59,9 @@ class Kubelet:
         self.clock = clock
         self.runtime = runtime or FakeRuntime()
         self.pleg = PLEG(self.runtime)
+        from ..volume.manager import VolumeManager
+
+        self.volume_manager = VolumeManager(store, node_name)
         if async_workers and not getattr(store, "async_bind_safe", False):
             # in-process ObjectStore dispatches watch events under its
             # lock: status writes from worker threads could deadlock
@@ -246,20 +249,21 @@ class Kubelet:
         self._update_pod_status(pod, now)
 
     def _volumes_ready(self, pod: api.Pod) -> bool:
-        """All PV-backed volumes attached to this node?"""
-        claims = [v.pvc_name for v in pod.spec.volumes if v.pvc_name]
-        if not claims:
+        """All of the pod's volumes mounted (volume manager gate:
+        volumemanager/volume_manager.go:371 WaitForAttachAndMount)?
+        Attachable volumes additionally wait for the attach/detach
+        controller's node.status.volumesAttached write. Unbound PVCs
+        keep the pod gated exactly like the pre-plugin-layer check."""
+        if not pod.spec.volumes:
             return True
-        node = self._iter_node or self._get_node()
-        attached = set(node.status.volumes_attached) if node else set()
+        claims = [v.pvc_name for v in pod.spec.volumes if v.pvc_name]
         for cname in claims:
             pvc = self.store.get("persistentvolumeclaims", pod.namespace,
                                  cname)
             if pvc is None or not pvc.spec.volume_name:
                 return False
-            if pvc.spec.volume_name not in attached:
-                return False
-        return True
+        node = self._iter_node or self._get_node()
+        return self.volume_manager.volumes_ready(pod, node)
 
     def _run_probes(self, pod: api.Pod, now: float):
         """prober/worker.go probe loop against the runtime's health bits."""
@@ -352,6 +356,10 @@ class Kubelet:
             self._known_pod_rvs.pop(uid, None)
             self._needs_retry.discard(uid)
             self.pod_workers.forget(uid)
+            # volume manager: drop desired state; the next reconcile
+            # unmounts the orphaned mounts (reconciler.go:166)
+            self.volume_manager.forget_pod(uid)
+        self.volume_manager.reconcile(self._iter_node or self._get_node())
         # eviction: under memory pressure, evict BestEffort pods first,
         # then highest-usage burstable (eviction/helpers.go rankMemoryPressure)
         if not self._memory_pressure():

@@ -78,6 +78,28 @@ def _pod_has_ipa_terms(pod: api.Pod) -> bool:
                                 or aff.pod_anti_affinity is not None)
 
 
+def assemble_round(pbs, waves, pm_rows_all, term_rows_all, wbucket, tpp):
+    """Stack per-wave PodBatches + staged row ids into the fixed-shape
+    inputs of ops.kernel.schedule_round: batches padded to the bucket
+    with zeroed (valid=False) waves, row ids padded with -1. ONE
+    assembly used by both warm_pipeline and _run_pipeline — the warm-up
+    must compile byte-identical program shapes to the measured run."""
+    P = pbs[0].req.shape[0]
+    pad_pb = enc.PodBatch(*[np.zeros_like(a) for a in pbs[0]])
+    pbs_padded = list(pbs) + [pad_pb] * (wbucket - len(pbs))
+    pbs_stacked = enc.PodBatch(*[np.stack(arrs)
+                                 for arrs in zip(*pbs_padded)])
+    pm_rows = np.full((wbucket, P), -1, np.int32)
+    term_rows = np.full((wbucket, P, tpp), -1, np.int32)
+    cursor = 0
+    for wi, wv in enumerate(waves):
+        n = len(wv)
+        pm_rows[wi, :n] = pm_rows_all[cursor:cursor + n]
+        term_rows[wi, :n] = term_rows_all[cursor:cursor + n]
+        cursor += n
+    return pbs_stacked, pm_rows, term_rows
+
+
 class GroupLister:
     """Selectors of services/RCs/RSs/StatefulSets that select a pod
     (reference: priorities metadata getSelectors,
@@ -415,13 +437,8 @@ class Scheduler:
                 n_waves if n_waves is not None else 1,
                 hi=PIPELINE_MAX_WAVES_IPA if has_ipa else PIPELINE_MAX_WAVES)
             tpp = term_rows.shape[1]
-            pbs_stacked = enc.PodBatch(
-                *[np.stack([a] + [np.zeros_like(a)] * (wbucket - 1))
-                  for a in pb])
-            rows = np.full((wbucket, P), -1, np.int32)
-            rows[0, :len(pods)] = pm_rows[:len(pods)]
-            trows = np.full((wbucket, P, tpp), -1, np.int32)
-            trows[0, :len(pods)] = term_rows[:len(pods)]
+            pbs_stacked, rows, trows = assemble_round(
+                [pb], [pods], pm_rows, term_rows, wbucket, tpp)
             try:
                 out = schedule_round(
                     nt, pm, tt, pbs_stacked, usage,
@@ -494,23 +511,10 @@ class Scheduler:
         has_ipa = bool(self.snapshot.has_affinity_terms
                        or any(pb.ra_has.any() or pb.rn_has.any()
                               or (pb.pa_w != 0).any() for pb in pbs))
-        P = pbs[0].req.shape[0]
         nw = len(waves)
         wbucket = pipeline_bucket(nw, hi=max_waves)
-        # pad to the bucket: zeroed batches have valid=False rows and
-        # schedule nothing; -1 row ids stage nothing
-        pad_pb = enc.PodBatch(*[np.zeros_like(a) for a in pbs[0]])
-        pbs_padded = pbs + [pad_pb] * (wbucket - nw)
-        pbs_stacked = enc.PodBatch(*[np.stack(arrs)
-                                     for arrs in zip(*pbs_padded)])
-        pm_rows = np.full((wbucket, P), -1, np.int32)
-        term_rows = np.full((wbucket, P, tpp), -1, np.int32)
-        cursor = 0
-        for wi, wv in enumerate(waves):
-            n = len(wv)
-            pm_rows[wi, :n] = pm_rows_all[cursor:cursor + n]
-            term_rows[wi, :n] = term_rows_all[cursor:cursor + n]
-            cursor += n
+        pbs_stacked, pm_rows, term_rows = assemble_round(
+            pbs, waves, pm_rows_all, term_rows_all, wbucket, tpp)
         try:
             chosen_d, fail_d, _usage_end, rr_end = schedule_round(
                 nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,

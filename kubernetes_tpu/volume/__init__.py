@@ -1,0 +1,6 @@
+"""Volume plugin layer — pkg/volume analog."""
+
+from .plugin import (Attacher, Detacher, Mounter, Spec, Unmounter,
+                     VolumePlugin, VolumePluginMgr, default_plugin_mgr)
+from .mount import InMemoryMount, MountPoint
+from .manager import VolumeManager
