@@ -124,6 +124,8 @@ register("Lease", "leases", api.LeaseRecord, "coordination.k8s.io/v1",
 register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
          api.HorizontalPodAutoscaler, "autoscaling/v1")
 register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
+register("APIService", "apiservices", api.APIService,
+         "apiregistration.k8s.io/v1", namespaced=False)
 register("LimitRange", "limitranges", api.LimitRange)
 register("CertificateSigningRequest", "certificatesigningrequests",
          api.CertificateSigningRequest, "certificates.k8s.io/v1beta1",

@@ -104,6 +104,8 @@ class Container:
     ports: List[ContainerPort] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    image_pull_policy: str = ""  # "" -> defaulted; Always|IfNotPresent|Never
+    privileged: bool = False  # securityContext.privileged, flattened
 
 
 # --- taints & tolerations ---------------------------------------------------
@@ -995,6 +997,41 @@ class Secret:
 class ConfigMap:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class APIServiceSpec:
+    """kube-aggregator apiregistration/v1 APIServiceSpec
+    (staging/src/k8s.io/kube-aggregator/pkg/apis/apiregistration/
+    types.go:28): which Service serves this API group/version. Empty
+    service_name = Local (served by this apiserver)."""
+
+    group: str = ""
+    version: str = ""
+    service_name: str = ""
+    service_namespace: str = "default"
+    service_port: int = 443
+    group_priority_minimum: int = 0
+    version_priority: int = 0
+
+
+@dataclass
+class APIServiceCondition:
+    type: str = "Available"
+    status: str = COND_FALSE
+    reason: str = ""
+
+
+@dataclass
+class APIServiceStatus:
+    conditions: List[APIServiceCondition] = field(default_factory=list)
+
+
+@dataclass
+class APIService:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: APIServiceSpec = field(default_factory=APIServiceSpec)
+    status: APIServiceStatus = field(default_factory=APIServiceStatus)
 
 
 @dataclass

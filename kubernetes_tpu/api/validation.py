@@ -1,0 +1,218 @@
+"""API object validation.
+
+Reference: pkg/apis/core/validation/validation.go (~6k LoC of per-kind
+rules over apimachinery's field.Path / field.ErrorList). The same
+shape is kept — path-addressed errors aggregated into a list so a bad
+object reports every problem at once — over this model's flattened
+types. The apiserver runs validation after admission mutators, exactly
+where the reference's registry strategies call Validate
+(registry/core/pod/strategy.go:79), and surfaces failures as 422.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from . import types as api
+
+# apimachinery/pkg/util/validation/validation.go:32 IsDNS1123Subdomain
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?"
+                      r"(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+_LABEL_VALUE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+_QUALIFIED_NAME = re.compile(
+    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*/)?"
+    r"[A-Za-z0-9][-A-Za-z0-9_.]{0,62}$")
+
+
+class ValidationError:
+    """One field.Error (apimachinery field/errors.go)."""
+
+    def __init__(self, field: str, value, detail: str):
+        self.field = field
+        self.value = value
+        self.detail = detail
+
+    def __repr__(self):
+        return f"{self.field}: {self.detail} (got {self.value!r})"
+
+
+class ErrorList(list):
+    def add(self, field: str, value, detail: str):
+        self.append(ValidationError(field, value, detail))
+
+    def message(self) -> str:
+        return "; ".join(repr(e) for e in self)
+
+
+def validate_object_meta(meta: api.ObjectMeta, path: str = "metadata",
+                         errs: Optional[ErrorList] = None) -> ErrorList:
+    errs = errs if errs is not None else ErrorList()
+    if not meta.name:
+        errs.add(f"{path}.name", meta.name, "name is required")
+    elif len(meta.name) > 253 or not _DNS1123.match(meta.name):
+        errs.add(f"{path}.name", meta.name,
+                 "must be a DNS-1123 subdomain")
+    if meta.namespace and not _DNS1123.match(meta.namespace):
+        errs.add(f"{path}.namespace", meta.namespace,
+                 "must be a DNS-1123 subdomain")
+    for k, v in (meta.labels or {}).items():
+        if not _QUALIFIED_NAME.match(k):
+            errs.add(f"{path}.labels", k, "invalid label key")
+        if not _LABEL_VALUE.match(v) or len(v) > 63:
+            errs.add(f"{path}.labels[{k}]", v, "invalid label value")
+    return errs
+
+
+def validate_pod(pod: api.Pod) -> ErrorList:
+    """validation.go:2990 ValidatePod (spec subset this model carries)."""
+    errs = validate_object_meta(pod.metadata)
+    spec, path = pod.spec, "spec"
+    if not spec.containers:
+        errs.add(f"{path}.containers", [], "at least one container required")
+    seen = set()
+    for i, c in enumerate(spec.containers):
+        cpath = f"{path}.containers[{i}]"
+        if not c.name:
+            errs.add(f"{cpath}.name", c.name, "name is required")
+        elif c.name in seen:
+            errs.add(f"{cpath}.name", c.name, "duplicate container name")
+        seen.add(c.name)
+        if c.image_pull_policy not in ("", "Always", "IfNotPresent", "Never"):
+            errs.add(f"{cpath}.imagePullPolicy", c.image_pull_policy,
+                     "must be Always, IfNotPresent or Never")
+        req, lim = c.resources.requests, c.resources.limits
+        for res, rv in (req or {}).items():
+            if rv < 0:
+                errs.add(f"{cpath}.resources.requests[{res}]", rv,
+                         "must be non-negative")
+            if lim and res in lim and rv > lim[res]:
+                errs.add(f"{cpath}.resources.requests[{res}]", rv,
+                         "must be <= limit")
+        for res, rv in (lim or {}).items():
+            if rv < 0:
+                errs.add(f"{cpath}.resources.limits[{res}]", rv,
+                         "must be non-negative")
+    if spec.restart_policy not in ("Always", "OnFailure", "Never"):
+        errs.add(f"{path}.restartPolicy", spec.restart_policy,
+                 "must be Always, OnFailure or Never")
+    vseen = set()
+    for i, v in enumerate(spec.volumes):
+        vpath = f"{path}.volumes[{i}]"
+        if not v.name:
+            errs.add(f"{vpath}.name", v.name, "name is required")
+        elif v.name in vseen:
+            errs.add(f"{vpath}.name", v.name, "duplicate volume name")
+        vseen.add(v.name)
+        sources = sum(bool(x) for x in (
+            v.empty_dir, v.host_path, v.config_map, v.secret,
+            v.downward_api, v.nfs_server, v.pvc_name, v.source_kind,
+            v.projected))
+        if sources > 1:
+            errs.add(vpath, v.name, "may not specify more than one source")
+    for i, t in enumerate(spec.tolerations):
+        if t.operator not in (api.TOLERATION_OP_EQUAL,
+                              api.TOLERATION_OP_EXISTS):
+            errs.add(f"{path}.tolerations[{i}].operator", t.operator,
+                     "must be Equal or Exists")
+        if t.operator == api.TOLERATION_OP_EXISTS and t.value:
+            errs.add(f"{path}.tolerations[{i}].value", t.value,
+                     "must be empty with operator Exists")
+    if spec.priority is not None and spec.priority > 2_000_000_000 \
+            and not spec.priority_class_name.startswith("system-"):
+        errs.add(f"{path}.priority", spec.priority,
+                 "only system priority classes may exceed 2000000000")
+    return errs
+
+
+def validate_pod_update(new: api.Pod, old: api.Pod) -> ErrorList:
+    """validation.go:3305 ValidatePodUpdate: spec is immutable except
+    image, activeDeadline, tolerations additions; nodeName only via
+    binding (transition from empty)."""
+    errs = ErrorList()
+    if old.spec.node_name and new.spec.node_name != old.spec.node_name:
+        errs.add("spec.nodeName", new.spec.node_name,
+                 "may not be changed once set")
+    if len(new.spec.containers) != len(old.spec.containers):
+        errs.add("spec.containers", len(new.spec.containers),
+                 "may not add or remove containers")
+    return errs
+
+
+def validate_node(node: api.Node) -> ErrorList:
+    errs = validate_object_meta(node.metadata)
+    for res, v in (node.status.allocatable or {}).items():
+        if v < 0:
+            errs.add(f"status.allocatable[{res}]", v, "must be non-negative")
+    for i, t in enumerate(node.spec.taints):
+        if t.effect not in (api.NO_SCHEDULE, api.PREFER_NO_SCHEDULE,
+                            api.NO_EXECUTE):
+            errs.add(f"spec.taints[{i}].effect", t.effect,
+                     "invalid taint effect")
+        if not t.key:
+            errs.add(f"spec.taints[{i}].key", t.key, "key is required")
+    return errs
+
+
+def validate_service(svc: api.Service) -> ErrorList:
+    errs = validate_object_meta(svc.metadata)
+    spec = svc.spec
+    if spec.type not in ("ClusterIP", "NodePort", "LoadBalancer",
+                         "ExternalName"):
+        errs.add("spec.type", spec.type, "invalid service type")
+    if spec.session_affinity not in ("None", "ClientIP"):
+        errs.add("spec.sessionAffinity", spec.session_affinity,
+                 "must be None or ClientIP")
+    names = set()
+    for i, p in enumerate(spec.ports):
+        ppath = f"spec.ports[{i}]"
+        if not (0 < p.port <= 65535):
+            errs.add(f"{ppath}.port", p.port, "must be 1-65535")
+        if p.node_port and not (0 < p.node_port <= 65535):
+            errs.add(f"{ppath}.nodePort", p.node_port, "must be 1-65535")
+        if p.protocol not in ("TCP", "UDP", "SCTP"):
+            errs.add(f"{ppath}.protocol", p.protocol, "invalid protocol")
+        if len(spec.ports) > 1 and not p.name:
+            errs.add(f"{ppath}.name", p.name,
+                     "required when multiple ports are present")
+        if p.name and p.name in names:
+            errs.add(f"{ppath}.name", p.name, "duplicate port name")
+        names.add(p.name)
+    if spec.type == "ExternalName" and not spec.external_name:
+        errs.add("spec.externalName", spec.external_name,
+                 "required for ExternalName services")
+    return errs
+
+
+def validate_pvc(pvc) -> ErrorList:
+    errs = validate_object_meta(pvc.metadata)
+    for res, v in (pvc.spec.requests or {}).items():
+        if v < 0:
+            errs.add(f"spec.resources.requests[{res}]", v,
+                     "must be non-negative")
+    return errs
+
+
+# kind plural -> validator; update validators get (new, old)
+VALIDATORS = {
+    "pods": validate_pod,
+    "nodes": validate_node,
+    "services": validate_service,
+    "persistentvolumeclaims": validate_pvc,
+}
+
+UPDATE_VALIDATORS = {
+    "pods": validate_pod_update,
+}
+
+
+def validate(kind: str, obj, old=None) -> ErrorList:
+    errs = ErrorList()
+    v = VALIDATORS.get(kind)
+    if v is not None:
+        errs.extend(v(obj))
+    if old is not None:
+        uv = UPDATE_VALIDATORS.get(kind)
+        if uv is not None:
+            errs.extend(uv(obj, old))
+    return errs

@@ -259,6 +259,135 @@ def cmd_version(client, args, out):
     out.write(f"Server Version: {v.get('gitVersion')}\n")
 
 
+# -- rollout (pkg/kubectl/cmd/rollout/) ---------------------------------------
+
+
+def _deployment_and_rss(client, args):
+    from ..controllers.deployment import REVISION_ANNOTATION  # noqa: F401
+
+    dep = client.get("deployments", args.namespace, args.name)
+    rss, _ = client.list("replicasets", args.namespace)
+    owned = [rs for rs in rss
+             if any(r.controller and r.kind == "Deployment"
+                    and r.name == dep.metadata.name
+                    for r in rs.metadata.owner_references)]
+    return dep, owned
+
+
+def cmd_rollout(client, args, out):
+    from ..controllers.deployment import (HASH_LABEL, REVISION_ANNOTATION,
+                                          template_hash)
+
+    if _resolve_kind(args.kind) != "deployments":
+        raise SystemExit("error: rollout supports deployments")
+    dep, owned = _deployment_and_rss(client, args)
+    name = dep.metadata.name
+    if args.action == "status":
+        # rollout_status.go Status: updated/total/available counts
+        want = dep.spec.replicas
+        st = dep.status
+        if st.updated_replicas < want:
+            out.write(f"Waiting for rollout to finish: {st.updated_replicas} "
+                      f"out of {want} new replicas have been updated...\n")
+        elif st.ready_replicas < want:
+            out.write(f"Waiting for rollout to finish: {st.ready_replicas} "
+                      f"of {want} updated replicas are available...\n")
+        else:
+            out.write(f'deployment "{name}" successfully rolled out\n')
+    elif args.action == "history":
+        out.write(f"deployment.apps/{name}\nREVISION\tREPLICASETS\n")
+        for rs in sorted(owned, key=lambda r: int(
+                r.metadata.annotations.get(REVISION_ANNOTATION, 0))):
+            rev = rs.metadata.annotations.get(REVISION_ANNOTATION, "?")
+            out.write(f"{rev}\t{rs.metadata.name}\n")
+    elif args.action == "undo":
+        # rollback.go: resolve the target revision's RS, copy its template
+        # (minus the hash label) into the deployment spec
+        target = None
+        if args.to_revision:
+            target = next(
+                (rs for rs in owned if rs.metadata.annotations.get(
+                    REVISION_ANNOTATION) == str(args.to_revision)), None)
+            if target is None:
+                raise SystemExit(
+                    f"error: revision {args.to_revision} not found")
+        else:
+            cur_hash = template_hash(dep.spec.template)
+            olds = [rs for rs in owned
+                    if (rs.metadata.labels or {}).get(HASH_LABEL) != cur_hash]
+            if not olds:
+                raise SystemExit("error: no rollout history found")
+            target = max(olds, key=lambda r: int(
+                r.metadata.annotations.get(REVISION_ANNOTATION, 0)))
+        import copy
+
+        tmpl = copy.deepcopy(target.spec.template)
+        tmpl.metadata.labels = {k: v for k, v in
+                                (tmpl.metadata.labels or {}).items()
+                                if k != HASH_LABEL}
+        dep.spec.template = tmpl
+        client.update("deployments", dep)
+        rev = target.metadata.annotations.get(REVISION_ANNOTATION, "?")
+        out.write(f"deployment.apps/{name} rolled back to revision {rev}\n")
+    elif args.action in ("pause", "resume"):
+        dep.spec.paused = (args.action == "pause")
+        client.update("deployments", dep)
+        out.write(f"deployment.apps/{name} {args.action}d\n")
+    else:
+        raise SystemExit(f"error: unknown rollout action {args.action!r}")
+
+
+def cmd_expose(client, args, out):
+    """expose.go: create a Service selecting the workload's pods."""
+    plural = _resolve_kind(args.kind)
+    obj = client.get(plural, args.namespace, args.name)
+    sel = obj.spec.selector
+    if sel is None:
+        raise SystemExit(f"error: {args.kind}/{args.name} has no selector")
+    if hasattr(sel, "match_labels"):  # LabelSelector -> plain dict
+        if sel.match_expressions:
+            raise SystemExit("error: cannot expose set-based selectors")
+        sel = dict(sel.match_labels)
+    svc = api.Service(
+        metadata=api.ObjectMeta(name=args.service_name or args.name,
+                                namespace=args.namespace),
+        spec=api.ServiceSpec(
+            selector=sel, type=args.type,
+            ports=[api.ServicePort(port=args.port,
+                                   target_port=args.target_port or args.port)]))
+    client.create("services", svc)
+    out.write(f"service/{svc.metadata.name} exposed\n")
+
+
+def cmd_explain(client, args, out):
+    """explain.go against the dataclass model instead of OpenAPI: field
+    names + types of the resource's Python type."""
+    import dataclasses
+    import typing
+
+    plural = _resolve_kind(args.kind.split(".")[0])
+    kind = scheme.kind_for_plural(plural)
+    typ = scheme.type_for_kind(kind)
+    path = args.kind.split(".")[1:]
+    for seg in path:
+        hints = typing.get_type_hints(typ)
+        if seg not in hints:
+            raise SystemExit(f"error: field {seg!r} not found in {kind}")
+        t = hints[seg]
+        origin = typing.get_origin(t)
+        if origin in (list, dict):
+            t = typing.get_args(t)[-1]
+        elif origin is typing.Union:  # Optional[X]
+            t = next(a for a in typing.get_args(t) if a is not type(None))
+        typ = t
+    out.write(f"KIND: {kind}\nFIELDS ({typ.__name__}):\n")
+    if dataclasses.is_dataclass(typ):
+        for f in dataclasses.fields(typ):
+            out.write(f"  {f.name}\t<{getattr(f.type, '__name__', f.type)}>\n")
+    else:
+        out.write(f"  <{typ.__name__}> (scalar)\n")
+
+
 # -- kind aliases (pkg/kubectl short names) -----------------------------------
 
 _ALIASES = {
@@ -331,6 +460,24 @@ def build_parser() -> argparse.ArgumentParser:
     lb.add_argument("name")
     lb.add_argument("labels", nargs="+")
 
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action",
+                    choices=["status", "history", "undo", "pause", "resume"])
+    ro.add_argument("kind")
+    ro.add_argument("name")
+    ro.add_argument("--to-revision", type=int, default=0)
+
+    ex = sub.add_parser("expose")
+    ex.add_argument("kind")
+    ex.add_argument("name")
+    ex.add_argument("--port", type=int, required=True)
+    ex.add_argument("--target-port", type=int, default=0)
+    ex.add_argument("--name", dest="service_name", default="")
+    ex.add_argument("--type", default="ClusterIP")
+
+    xp = sub.add_parser("explain")
+    xp.add_argument("kind")
+
     sub.add_parser("version")
     return ap
 
@@ -338,7 +485,8 @@ def build_parser() -> argparse.ArgumentParser:
 VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "apply": cmd_apply, "delete": cmd_delete, "scale": cmd_scale,
          "cordon": cmd_cordon, "uncordon": cmd_uncordon, "drain": cmd_drain,
-         "label": cmd_label, "version": cmd_version}
+         "label": cmd_label, "version": cmd_version, "rollout": cmd_rollout,
+         "expose": cmd_expose, "explain": cmd_explain}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

@@ -19,6 +19,11 @@ from ..runtime.store import Conflict
 from .base import Controller
 
 HASH_LABEL = "pod-template-hash"
+# rollout history bookkeeping (deployment/util/deployment_util.go:36
+# RevisionAnnotation): each RS keeps the revision it served; the
+# deployment carries the current one; `kubectl rollout undo` resolves a
+# revision back to its RS's template
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
 
 
 def template_hash(template: api.PodTemplateSpec) -> str:
@@ -111,6 +116,7 @@ class DeploymentController(Controller):
         new_rs, old_rss = self._new_and_old(dep)
         if new_rs is None:
             new_rs = self._create_new_rs(dep)
+        self._ensure_revision(dep, new_rs, old_rss)
         want = dep.spec.replicas
         if dep.spec.strategy.type == "Recreate":
             # scale olds to zero first; only then bring up the new RS
@@ -151,6 +157,28 @@ class DeploymentController(Controller):
         if any(rs.spec.replicas > 0 for rs in old_rss) or \
                 new_rs.spec.replicas != want:
             raise RuntimeError("rollout in progress")  # requeue to continue
+
+    def _ensure_revision(self, dep, new_rs, old_rss):
+        """deployment_util.go:180 SetNewReplicaSetAnnotations: the RS
+        serving the current template gets maxOldRevision+1 (an undo that
+        re-selects an old RS bumps it to the newest revision); the
+        deployment mirrors the current revision."""
+        max_old = max([int(rs.metadata.annotations.get(
+            REVISION_ANNOTATION, 0)) for rs in old_rss] + [0])
+        cur = int(new_rs.metadata.annotations.get(REVISION_ANNOTATION, 0))
+        if cur <= max_old:
+            new_rs.metadata.annotations[REVISION_ANNOTATION] = str(max_old + 1)
+            try:
+                self.store.update("replicasets", new_rs)
+            except (Conflict, KeyError):
+                return
+        rev = new_rs.metadata.annotations[REVISION_ANNOTATION]
+        if dep.metadata.annotations.get(REVISION_ANNOTATION) != rev:
+            dep.metadata.annotations[REVISION_ANNOTATION] = rev
+            try:
+                self.store.update("deployments", dep)
+            except (Conflict, KeyError):
+                pass
 
     def _update_status(self, dep, new_rs, old_rss):
         all_rs = [new_rs] + old_rss

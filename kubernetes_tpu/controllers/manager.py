@@ -46,6 +46,14 @@ DEFAULT_CONTROLLERS = [
 ]
 
 
+def default_controllers() -> List[type]:
+    """DEFAULT_CONTROLLERS + server-side loops whose modules import the
+    controller base (lazy to break the package import cycle)."""
+    from ..server.aggregator import APIServiceAvailabilityController
+
+    return DEFAULT_CONTROLLERS + [APIServiceAvailabilityController]
+
+
 class ControllerManager:
     def __init__(self, store, controllers: Optional[List[type]] = None,
                  identity: str = "controller-manager",
@@ -54,7 +62,7 @@ class ControllerManager:
         self.store = store
         self.controllers: Dict[str, Controller] = {}
         for cls in (controllers if controllers is not None
-                    else DEFAULT_CONTROLLERS):
+                    else default_controllers()):
             c = cls(store)
             self.controllers[c.name] = c
         # cloud-dependent loops start only when a provider is configured

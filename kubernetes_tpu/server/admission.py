@@ -261,6 +261,166 @@ class PodNodeSelector(AdmissionPlugin):
             obj.spec.node_selector[k] = v
 
 
+class AlwaysPullImages(AdmissionPlugin):
+    """Force imagePullPolicy=Always on every container so multi-tenant
+    nodes can't read a neighbor's cached private image
+    (plugin/pkg/admission/alwayspullimages/admission.go:48)."""
+
+    name = "AlwaysPullImages"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op not in ("create", "update"):
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            c.image_pull_policy = "Always"
+
+
+class SecurityContextDeny(AdmissionPlugin):
+    """Reject privileged containers
+    (plugin/pkg/admission/securitycontext/scdeny/admission.go:57; the
+    model carries the privileged bit only)."""
+
+    name = "SecurityContextDeny"
+
+    def admit(self, op, kind, obj, old, user, store):
+        # create AND update: an update could otherwise flip a container
+        # privileged after admission (ref scdeny handles both ops)
+        if kind != "pods" or op not in ("create", "update"):
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            if c.privileged:
+                raise AdmissionError(
+                    f"container {c.name!r}: privileged containers are "
+                    f"not allowed")
+
+
+class EventRateLimit(AdmissionPlugin):
+    """Token-bucket rate limit on Event writes, server-scoped
+    (plugin/pkg/admission/eventratelimit/admission.go:69; qps/burst per
+    the server limit type in its config API)."""
+
+    name = "EventRateLimit"
+
+    def __init__(self, qps: float = 50.0, burst: int = 100, clock=None):
+        import threading
+        import time as _time
+
+        self.qps = qps
+        self.burst = burst
+        self.clock = clock or _time.monotonic
+        self._tokens = float(burst)
+        self._last = self.clock()
+        # the apiserver is threaded: concurrent event creates must not
+        # interleave the read-modify-write of the bucket
+        self._mu = threading.Lock()
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "events" or op != "create":
+            return
+        with self._mu:
+            now = self.clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens < 1.0:
+                raise AdmissionError("event rate limit exceeded")
+            self._tokens -= 1.0
+
+
+class PodTolerationRestriction(AdmissionPlugin):
+    """Merge namespace default tolerations into pods and enforce the
+    namespace whitelist
+    (plugin/pkg/admission/podtolerationrestriction/admission.go:96).
+    Namespace annotations (JSON lists of {key,operator,value,effect}):
+      scheduler.alpha.kubernetes.io/defaultTolerations
+      scheduler.alpha.kubernetes.io/tolerationsWhitelist
+    """
+
+    name = "PodTolerationRestriction"
+
+    DEFAULTS_ANN = "scheduler.alpha.kubernetes.io/defaultTolerations"
+    WHITELIST_ANN = "scheduler.alpha.kubernetes.io/tolerationsWhitelist"
+
+    @staticmethod
+    def _parse(raw) -> List[api.Toleration]:
+        import json
+
+        return [api.Toleration(key=d.get("key", ""),
+                               operator=d.get("operator", "Equal"),
+                               value=d.get("value", ""),
+                               effect=d.get("effect", ""))
+                for d in json.loads(raw)]
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op != "create":
+            return
+        ns = store.get("namespaces", "", obj.metadata.namespace) or \
+            store.get("namespaces", "default", obj.metadata.namespace)
+        if ns is None:
+            return
+        ann = ns.metadata.annotations or {}
+        if self.DEFAULTS_ANN in ann:
+            existing = {(t.key, t.operator, t.value, t.effect)
+                        for t in obj.spec.tolerations}
+            for t in self._parse(ann[self.DEFAULTS_ANN]):
+                if (t.key, t.operator, t.value, t.effect) not in existing:
+                    obj.spec.tolerations.append(t)
+        if self.WHITELIST_ANN in ann:
+            allowed = {(t.key, t.operator, t.value, t.effect)
+                       for t in self._parse(ann[self.WHITELIST_ANN])}
+            for t in obj.spec.tolerations:
+                if (t.key, t.operator, t.value, t.effect) not in allowed:
+                    raise AdmissionError(
+                        f"toleration {t.key!r} not allowed by namespace "
+                        f"whitelist")
+
+
+class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
+    """Required pod anti-affinity may only use the hostname topology key
+    (plugin/pkg/admission/antiaffinity/admission.go:54 — unbounded
+    topology keys let one pod exclude whole zones/regions)."""
+
+    name = "LimitPodHardAntiAffinityTopology"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op not in ("create", "update"):
+            return
+        aff = obj.spec.affinity
+        if aff is None or aff.pod_anti_affinity is None:
+            return
+        for term in aff.pod_anti_affinity.required:
+            if term.topology_key != "kubernetes.io/hostname":
+                raise AdmissionError(
+                    f"required pod anti-affinity topology key must be "
+                    f"kubernetes.io/hostname, got {term.topology_key!r}")
+
+
+class ExtendedResourceToleration(AdmissionPlugin):
+    """Auto-tolerate taints named after extended resources the pod
+    requests (plugin/pkg/admission/extendedresourcetoleration/
+    admission.go:54): clusters taint accelerator nodes with the resource
+    name so only requesting pods land there."""
+
+    name = "ExtendedResourceToleration"
+
+    @staticmethod
+    def _extended(res_name: str) -> bool:
+        return "/" in res_name and not res_name.startswith("kubernetes.io/")
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op != "create":
+            return
+        wanted = set()
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for res_name in (c.resources.requests or {}):
+                if self._extended(str(res_name)):
+                    wanted.add(str(res_name))
+        have = {t.key for t in obj.spec.tolerations}
+        for res_name in sorted(wanted - have):
+            obj.spec.tolerations.append(api.Toleration(
+                key=res_name, operator=api.TOLERATION_OP_EXISTS))
+
+
 class AdmissionChain:
     """Ordered plugin chain (admission/chain.go chainAdmissionHandler)."""
 

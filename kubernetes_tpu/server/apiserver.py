@@ -34,6 +34,7 @@ from ..api import scheme
 from ..api import types as api
 from ..runtime.store import Conflict, ObjectStore
 from ..runtime.watch import Broadcaster, TooOld
+from ..api import validation
 from .admission import AdmissionChain, AdmissionError
 from .auth import RBACAuthorizer, TokenAuthenticator, UserInfo
 
@@ -235,6 +236,32 @@ class APIServer:
 
         route = self._route(parts)
         if route is None:
+            # aggregation (kube-aggregator): an APIService claiming this
+            # group/version proxies the request to its backing service
+            # (pkg/apiserver/handler_proxy.go). The aggregator sits
+            # BEHIND the standard filters: authz, flow control, and
+            # audit all apply before the proxy hop.
+            if len(parts) >= 3 and parts[0] == "apis" \
+                    and self._aggregated_backend(parts) is not None:
+                verb = _VERBS[h.command]
+                plural = parts[3] if len(parts) > 3 else parts[1]
+                if self.authorizer is not None and user is not None:
+                    if not self.authorizer.authorize(user, verb, plural):
+                        raise APIError(403, "Forbidden",
+                                       f"user {user.name} cannot {verb} "
+                                       f"{plural}")
+                sem = (self._readonly_sem if verb in ("get", "list")
+                       else self._mutating_sem)
+                if sem is not None and not sem.acquire(blocking=False):
+                    raise APIError(429, "TooManyRequests",
+                                   "server request limit reached, retry later")
+                try:
+                    self._audit(user, verb, plural,
+                                None, parts[4] if len(parts) > 4 else None)
+                    return self._serve_aggregated(h, parts, parsed)
+                finally:
+                    if sem is not None:
+                        sem.release()
             raise APIError(404, "NotFound", f"path {parsed.path!r} not found")
         plural, namespace, name, sub = route
         verb = _VERBS[h.command]
@@ -295,6 +322,59 @@ class APIServer:
         if verb == "delete":
             return self._serve_delete(h, plural, namespace, name, user)
         raise APIError(405, "MethodNotAllowed", f"{h.command} unsupported")
+
+    # -- aggregation (kube-aggregator) -----------------------------------------
+
+    def _aggregated_backend(self, parts):
+        """APIServiceSpec claiming /apis/<group>/<version>, or None."""
+        group, version = parts[1], parts[2]
+        for apisvc in self.store.list("apiservices"):
+            if (apisvc.spec.group == group
+                    and apisvc.spec.version == version
+                    and apisvc.spec.service_name):
+                return apisvc.spec
+        return None
+
+    def _serve_aggregated(self, h, parts, parsed):
+        """Proxy /apis/<group>/<version>/... to the APIService's backing
+        service endpoints (handler_proxy.go:109 ServeHTTP: resolve the
+        service, forward verbatim, relay the response)."""
+        svc_ref = self._aggregated_backend(parts)
+        group, version = parts[1], parts[2]
+        ep = self.store.get("endpoints", svc_ref.service_namespace,
+                            svc_ref.service_name)
+        backends = [(a.ip, (next((p.port for p in s.ports), None)
+                            or svc_ref.service_port))
+                    for s in (ep.subsets if ep else [])
+                    for a in s.addresses]
+        if not backends:
+            raise APIError(503, "ServiceUnavailable",
+                           f"no endpoints for aggregated API "
+                           f"{version}.{group}")
+        host, port = backends[0]
+        import http.client
+
+        body = b""
+        length = int(h.headers.get("Content-Length") or 0)
+        if length:
+            body = h.rfile.read(length)
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            url = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+            conn.request(h.command, url, body=body or None,
+                         headers={"Content-Type":
+                                  h.headers.get("Content-Type",
+                                                "application/json")})
+            resp = conn.getresponse()
+            data = resp.read()
+            h._send(resp.status, data,
+                    resp.getheader("Content-Type", "application/json"))
+            return True
+        except OSError as e:
+            raise APIError(503, "ServiceUnavailable",
+                           f"aggregated API backend unreachable: {e}")
+        finally:
+            conn.close()
 
     # -- routing ---------------------------------------------------------------
 
@@ -423,6 +503,12 @@ class APIServer:
             self.admission.admit("create", plural, obj, None, user, self.store)
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
+        # validation runs AFTER admission mutators, like the registry
+        # strategies' Validate (registry/core/pod/strategy.go:79); a bad
+        # object reports every field error at once as a 422
+        errs = validation.validate(plural, obj)
+        if errs:
+            raise APIError(422, "Invalid", errs.message())
         if plural == "customresourcedefinitions":
             msg = scheme.crd_conflict(obj)
             if msg is not None:
@@ -479,6 +565,10 @@ class APIServer:
             self.admission.admit("update", plural, obj, old, user, self.store)
         except AdmissionError as e:
             raise APIError(403, "Forbidden", str(e))
+        if sub not in ("status", "finalize"):
+            errs = validation.validate(plural, obj, old=old)
+            if errs:
+                raise APIError(422, "Invalid", errs.message())
         if plural == "customresourcedefinitions":
             # validate BEFORE touching the registry or the store: a
             # rejected rename must leave the old kind fully served

@@ -117,7 +117,8 @@ class VolumeManager:
                 if spec.pv.metadata.name not in attached:
                     still_waiting = True
                     continue  # waiting on the attach/detach controller
-            plugin.new_mounter(spec, pod, self.mount, self.store).set_up()
+            plugin.new_mounter(spec, pod, self.mount, self.store,
+                               mgr=self.plugins).set_up()
         if still_waiting:
             self._dirty = True  # retry next pass even if nothing changes
 

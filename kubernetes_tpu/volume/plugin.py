@@ -106,7 +106,11 @@ class VolumePlugin:
         raise NotImplementedError
 
     def new_mounter(self, spec: Spec, pod: api.Pod, mount_backend,
-                    store=None) -> Mounter:
+                    store=None, mgr: "Optional[VolumePluginMgr]" = None
+                    ) -> Mounter:
+        """mgr: the configured plugin manager, for plugins that resolve
+        sub-sources (projected) — they must consult the SAME roster the
+        volume manager was built with, not a fresh default."""
         return Mounter(self, spec, pod, mount_backend, store)
 
     def new_unmounter(self, volume_name: str, pod_uid: str,

@@ -32,7 +32,8 @@ class HostPathPlugin(VolumePlugin):
     def can_support(self, spec: Spec) -> bool:
         return spec.volume is not None and bool(spec.volume.host_path)
 
-    def new_mounter(self, spec, pod, mount_backend, store=None):
+    def new_mounter(self, spec, pod, mount_backend, store=None,
+                    mgr=None):
         class _M(Mounter):
             def payload(self):
                 return {"hostPath": self.spec.volume.host_path}
@@ -61,7 +62,8 @@ class ConfigMapPlugin(VolumePlugin):
     def can_support(self, spec: Spec) -> bool:
         return spec.volume is not None and bool(spec.volume.config_map)
 
-    def new_mounter(self, spec, pod, mount_backend, store=None):
+    def new_mounter(self, spec, pod, mount_backend, store=None,
+                    mgr=None):
         class _M(_APIBackedMounter):
             kind, field = "configmaps", "config_map"
 
@@ -74,7 +76,8 @@ class SecretPlugin(VolumePlugin):
     def can_support(self, spec: Spec) -> bool:
         return spec.volume is not None and bool(spec.volume.secret)
 
-    def new_mounter(self, spec, pod, mount_backend, store=None):
+    def new_mounter(self, spec, pod, mount_backend, store=None,
+                    mgr=None):
         class _M(_APIBackedMounter):
             kind, field = "secrets", "secret"
 
@@ -87,7 +90,8 @@ class DownwardAPIPlugin(VolumePlugin):
     def can_support(self, spec: Spec) -> bool:
         return spec.volume is not None and bool(spec.volume.downward_api)
 
-    def new_mounter(self, spec, pod, mount_backend, store=None):
+    def new_mounter(self, spec, pod, mount_backend, store=None,
+                    mgr=None):
         class _M(Mounter):
             def payload(self):
                 out = {}
@@ -109,18 +113,24 @@ class ProjectedPlugin(VolumePlugin):
     """projected/projected.go — one mount fed by several sub-sources."""
 
     name = "kubernetes.io/projected"
+    _default_mgr = None
 
     def can_support(self, spec: Spec) -> bool:
         return spec.volume is not None and bool(spec.volume.projected)
 
-    def new_mounter(self, spec, pod, mount_backend, store=None):
+    def new_mounter(self, spec, pod, mount_backend, store=None,
+                    mgr=None):
         outer = self
+        if mgr is None:
+            # fallback for direct plugin use; cached, not per-SetUp
+            from .plugin import default_plugin_mgr
+
+            if ProjectedPlugin._default_mgr is None:
+                ProjectedPlugin._default_mgr = default_plugin_mgr()
+            mgr = ProjectedPlugin._default_mgr
 
         class _M(Mounter):
             def payload(self):
-                from .plugin import default_plugin_mgr
-
-                mgr = default_plugin_mgr()
                 merged: Dict[str, str] = {}
                 for sub in self.spec.volume.projected:
                     sub_spec = Spec(volume=sub)
@@ -128,7 +138,7 @@ class ProjectedPlugin(VolumePlugin):
                     if p.name == outer.name:
                         continue  # no recursive projection
                     m = p.new_mounter(sub_spec, self.pod, self.mount,
-                                      self.store)
+                                      self.store, mgr=mgr)
                     merged.update(m.payload())
                 return merged
 
@@ -141,7 +151,8 @@ class NFSPlugin(VolumePlugin):
     def can_support(self, spec: Spec) -> bool:
         return spec.volume is not None and bool(spec.volume.nfs_server)
 
-    def new_mounter(self, spec, pod, mount_backend, store=None):
+    def new_mounter(self, spec, pod, mount_backend, store=None,
+                    mgr=None):
         class _M(Mounter):
             def payload(self):
                 v = self.spec.volume

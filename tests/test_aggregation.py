@@ -1,0 +1,157 @@
+"""API aggregation tests: APIService registration, request proxying to
+an extension apiserver, availability conditions.
+
+Reference test model: kube-aggregator's handler_proxy_test.go (proxy a
+request to a test backend through an APIService) and
+available_controller_test.go.
+"""
+
+import http.server
+import json
+import threading
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server import AdmissionChain, APIServer
+from kubernetes_tpu.server.aggregator import APIServiceAvailabilityController
+
+import pytest
+
+
+class _Extension(http.server.BaseHTTPRequestHandler):
+    """A tiny extension apiserver: echoes path + method as JSON."""
+
+    def _reply(self):
+        body = json.dumps({"servedBy": "extension", "path": self.path,
+                           "method": self.command}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = _reply
+
+    def log_message(self, *a):
+        pass
+
+
+def _start_extension():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Extension)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def _register_apiservice(store, port):
+    store.create("services", api.Service(
+        metadata=api.ObjectMeta(name="metrics-server", namespace="default"),
+        spec=api.ServiceSpec(ports=[api.ServicePort(port=port)])))
+    store.create("endpoints", api.Endpoints(
+        metadata=api.ObjectMeta(name="metrics-server", namespace="default"),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(ip="127.0.0.1")],
+            ports=[api.EndpointPort(port=port)])]))
+    store.create("apiservices", api.APIService(
+        metadata=api.ObjectMeta(name="v1alpha1.custom.metrics.io",
+                                namespace=""),
+        spec=api.APIServiceSpec(group="custom.metrics.io",
+                                version="v1alpha1",
+                                service_name="metrics-server",
+                                service_port=port)))
+
+
+class TestAggregation:
+    def test_proxy_to_extension_apiserver(self):
+        ext = _start_extension()
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            _register_apiservice(store, ext.server_address[1])
+            client = RESTClient(srv.url)
+            data = client.request(
+                "GET", "/apis/custom.metrics.io/v1alpha1/nodemetrics")
+            assert data["servedBy"] == "extension"
+            assert data["path"].endswith("/v1alpha1/nodemetrics")
+        finally:
+            srv.stop()
+            ext.shutdown()
+
+    def test_unclaimed_group_is_404_and_no_endpoints_503(self):
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            client = RESTClient(srv.url)
+            with pytest.raises(APIStatusError) as ei:
+                client.request("GET", "/apis/nobody.claimed.io/v1/things")
+            assert ei.value.code == 404
+            # claimed but no backing endpoints -> 503
+            store.create("apiservices", api.APIService(
+                metadata=api.ObjectMeta(name="v1.down.io", namespace=""),
+                spec=api.APIServiceSpec(group="down.io", version="v1",
+                                        service_name="gone")))
+            with pytest.raises(APIStatusError) as ei:
+                client.request("GET", "/apis/down.io/v1/things")
+            assert ei.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_proxy_respects_rbac(self):
+        """The aggregator sits behind authorization: a user without
+        grants must get 403 before the proxy hop, never a backend
+        response (real kube-aggregator authorizes pre-proxy)."""
+        from kubernetes_tpu.server import RBACAuthorizer, TokenAuthenticator
+        from kubernetes_tpu.server.auth import PolicyRule, RoleBinding, UserInfo
+
+        ext = _start_extension()
+        store = ObjectStore()
+        authn = TokenAuthenticator({
+            "admin-token": UserInfo("admin", groups=["system:masters"]),
+            "nobody-token": UserInfo("nobody", groups=[])})
+        authz = RBACAuthorizer([
+            RoleBinding("system:masters", [PolicyRule(["*"], ["*"])])])
+        srv = APIServer(store, authenticator=authn, authorizer=authz).start()
+        try:
+            _register_apiservice(store, ext.server_address[1])
+            admin = RESTClient(srv.url, token="admin-token")
+            data = admin.request(
+                "GET", "/apis/custom.metrics.io/v1alpha1/nodemetrics")
+            assert data["servedBy"] == "extension"
+            nobody = RESTClient(srv.url, token="nobody-token")
+            with pytest.raises(APIStatusError) as ei:
+                nobody.request(
+                    "GET", "/apis/custom.metrics.io/v1alpha1/nodemetrics")
+            assert ei.value.code == 403
+        finally:
+            srv.stop()
+            ext.shutdown()
+
+    def test_availability_controller(self):
+        store = ObjectStore()
+        ctrl = APIServiceAvailabilityController(store)
+        store.create("apiservices", api.APIService(
+            metadata=api.ObjectMeta(name="v1.ext.io", namespace=""),
+            spec=api.APIServiceSpec(group="ext.io", version="v1",
+                                    service_name="backend")))
+        store.create("apiservices", api.APIService(
+            metadata=api.ObjectMeta(name="v1.local.io", namespace=""),
+            spec=api.APIServiceSpec(group="local.io", version="v1")))
+        ctrl.sync_all()
+
+        def cond(name):
+            svc = store.get("apiservices", "", name)
+            return next(c for c in svc.status.conditions
+                        if c.type == "Available")
+
+        assert cond("v1.local.io").status == api.COND_TRUE
+        assert cond("v1.ext.io").status == api.COND_FALSE
+        assert cond("v1.ext.io").reason == "MissingEndpoints"
+        # endpoints appear -> flips Available
+        store.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="backend"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="10.0.0.1")],
+                ports=[api.EndpointPort(port=443)])]))
+        ctrl.sync_all()
+        assert cond("v1.ext.io").status == api.COND_TRUE

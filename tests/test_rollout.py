@@ -1,0 +1,150 @@
+"""kubectl rollout/expose/explain + deployment revision tests.
+
+Reference test model: pkg/kubectl/cmd/rollout tests +
+pkg/controller/deployment/deployment_controller_test.go revision
+bookkeeping.
+"""
+
+import io
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.cli.kubectl import main
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.controllers.deployment import (REVISION_ANNOTATION,
+                                                   DeploymentController)
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server import APIServer, AdmissionChain
+
+
+@pytest.fixture()
+def world():
+    store = ObjectStore()
+    srv = APIServer(store, admission=AdmissionChain()).start()
+    yield store, srv
+    srv.stop()
+
+
+def run(server, *argv):
+    out = io.StringIO()
+    rc = main(["--server", server.url, *argv], out=out)
+    return rc, out.getvalue()
+
+
+def mkdep(image="app:v1"):
+    return api.Deployment(
+        metadata=api.ObjectMeta(name="web"),
+        spec=api.DeploymentSpec(
+            replicas=2,
+            selector=LabelSelector(match_labels={"app": "web"}),
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels={"app": "web"}),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image=image)]))))
+
+
+def settle(store, ctrl, rounds=6):
+    for _ in range(rounds):
+        ctrl.sync_all()
+        # mark RS pods ready so rollouts can progress (fake kubelet)
+        for rs in store.list("replicasets"):
+            if rs.status.replicas != rs.spec.replicas or \
+                    rs.status.ready_replicas != rs.spec.replicas:
+                rs.status.replicas = rs.spec.replicas
+                rs.status.ready_replicas = rs.spec.replicas
+                store.update("replicasets", rs)
+        import time
+        time.sleep(0.05)  # let rate-limited requeues land
+
+
+class TestRevisions:
+    def test_revision_bumps_on_template_change(self, world):
+        store, _ = world
+        ctrl = DeploymentController(store)
+        store.create("deployments", mkdep("app:v1"))
+        settle(store, ctrl)
+        dep = store.get("deployments", "default", "web")
+        assert dep.metadata.annotations[REVISION_ANNOTATION] == "1"
+        dep.spec.template.spec.containers[0].image = "app:v2"
+        store.update("deployments", dep)
+        settle(store, ctrl)
+        dep = store.get("deployments", "default", "web")
+        assert dep.metadata.annotations[REVISION_ANNOTATION] == "2"
+        revs = sorted(int(rs.metadata.annotations.get(REVISION_ANNOTATION, 0))
+                      for rs in store.list("replicasets"))
+        assert revs == [1, 2]
+
+
+class TestRolloutCLI:
+    def test_status_history_undo(self, world):
+        store, srv = world
+        ctrl = DeploymentController(store)
+        c = RESTClient(srv.url)
+        c.create("deployments", mkdep("app:v1"))
+        settle(store, ctrl)
+        rc, out = run(srv, "rollout", "status", "deployment", "web")
+        assert rc == 0 and "successfully rolled out" in out
+        # roll to v2
+        dep = c.get("deployments", "default", "web")
+        dep.spec.template.spec.containers[0].image = "app:v2"
+        c.update("deployments", dep)
+        settle(store, ctrl)
+        rc, out = run(srv, "rollout", "history", "deployment", "web")
+        assert rc == 0 and "1\t" in out and "2\t" in out
+        # undo -> template back to v1, revision bumped to 3
+        rc, out = run(srv, "rollout", "undo", "deployment", "web")
+        assert rc == 0 and "rolled back to revision 1" in out
+        settle(store, ctrl)
+        dep = c.get("deployments", "default", "web")
+        assert dep.spec.template.spec.containers[0].image == "app:v1"
+        assert dep.metadata.annotations[REVISION_ANNOTATION] == "3"
+
+    def test_undo_to_revision(self, world):
+        store, srv = world
+        ctrl = DeploymentController(store)
+        c = RESTClient(srv.url)
+        c.create("deployments", mkdep("app:v1"))
+        settle(store, ctrl)
+        for img in ("app:v2", "app:v3"):
+            dep = c.get("deployments", "default", "web")
+            dep.spec.template.spec.containers[0].image = img
+            c.update("deployments", dep)
+            settle(store, ctrl)
+        rc, out = run(srv, "rollout", "undo", "deployment", "web",
+                      "--to-revision", "1")
+        assert rc == 0
+        settle(store, ctrl)
+        dep = c.get("deployments", "default", "web")
+        assert dep.spec.template.spec.containers[0].image == "app:v1"
+
+    def test_pause_resume(self, world):
+        store, srv = world
+        c = RESTClient(srv.url)
+        c.create("deployments", mkdep())
+        rc, out = run(srv, "rollout", "pause", "deployment", "web")
+        assert rc == 0
+        assert c.get("deployments", "default", "web").spec.paused
+        rc, out = run(srv, "rollout", "resume", "deployment", "web")
+        assert rc == 0
+        assert not c.get("deployments", "default", "web").spec.paused
+
+
+class TestExposeExplain:
+    def test_expose_deployment(self, world):
+        store, srv = world
+        c = RESTClient(srv.url)
+        c.create("deployments", mkdep())
+        rc, out = run(srv, "expose", "deployment", "web", "--port", "80")
+        assert rc == 0 and "service/web exposed" in out
+        svc = c.get("services", "default", "web")
+        assert svc.spec.selector == {"app": "web"}
+        assert svc.spec.ports[0].port == 80
+
+    def test_explain(self, world):
+        _, srv = world
+        rc, out = run(srv, "explain", "pods")
+        assert rc == 0 and "KIND: Pod" in out and "spec" in out
+        rc, out = run(srv, "explain", "pods.spec.containers")
+        assert rc == 0 and "image" in out and "resources" in out

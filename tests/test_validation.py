@@ -1,0 +1,177 @@
+"""Validation package + new admission plugin tests.
+
+Reference test model: pkg/apis/core/validation/validation_test.go
+(table-driven valid/invalid objects), plugin/pkg/admission/*/
+admission_test.go.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import validation
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server.admission import (AdmissionChain, AdmissionError,
+                                             AlwaysPullImages, EventRateLimit,
+                                             ExtendedResourceToleration,
+                                             LimitPodHardAntiAffinityTopology,
+                                             PodTolerationRestriction,
+                                             SecurityContextDeny)
+
+
+def okpod(name="p", **spec_kw):
+    return api.Pod(metadata=api.ObjectMeta(name=name),
+                   spec=api.PodSpec(containers=[api.Container(name="c")],
+                                    **spec_kw))
+
+
+class TestValidation:
+    def test_valid_pod_passes(self):
+        assert validation.validate("pods", okpod()) == []
+
+    def test_bad_name_and_labels(self):
+        pod = okpod(name="Bad_Name!")
+        pod.metadata.labels = {"-bad-key": "ok", "good": "bad value!"}
+        errs = validation.validate("pods", pod)
+        fields = {e.field for e in errs}
+        assert "metadata.name" in fields
+        assert any("labels" in f for f in fields)
+
+    def test_container_rules(self):
+        pod = api.Pod(metadata=api.ObjectMeta(name="p"), spec=api.PodSpec(
+            containers=[
+                api.Container(name="c", image_pull_policy="Sometimes",
+                              resources=api.ResourceRequirements(
+                                  requests={"cpu": 200}, limits={"cpu": 100})),
+                api.Container(name="c")]))
+        errs = validation.validate("pods", pod)
+        details = "; ".join(e.detail for e in errs)
+        assert "must be Always" in details
+        assert "must be <= limit" in details
+        assert "duplicate container name" in details
+
+    def test_pod_without_containers(self):
+        pod = api.Pod(metadata=api.ObjectMeta(name="p"))
+        errs = validation.validate("pods", pod)
+        assert any("at least one container" in e.detail for e in errs)
+
+    def test_volume_single_source(self):
+        pod = okpod(volumes=[api.Volume(name="v", config_map="a",
+                                        secret="b")])
+        errs = validation.validate("pods", pod)
+        assert any("more than one source" in e.detail for e in errs)
+
+    def test_pod_update_immutability(self):
+        old = okpod()
+        old.spec.node_name = "n1"
+        new = okpod()
+        new.spec.node_name = "n2"
+        errs = validation.validate("pods", new, old=old)
+        assert any("may not be changed" in e.detail for e in errs)
+
+    def test_service_rules(self):
+        svc = api.Service(metadata=api.ObjectMeta(name="s"),
+                          spec=api.ServiceSpec(
+                              type="Weird", session_affinity="Sticky",
+                              ports=[api.ServicePort(port=99999),
+                                     api.ServicePort(port=80)]))
+        errs = validation.validate("services", svc)
+        details = "; ".join(e.detail for e in errs)
+        assert "invalid service type" in details
+        assert "must be None or ClientIP" in details
+        assert "must be 1-65535" in details
+        assert "required when multiple ports" in details
+
+    def test_node_taint_rules(self):
+        node = api.Node(metadata=api.ObjectMeta(name="n"),
+                        spec=api.NodeSpec(taints=[
+                            api.Taint(key="", effect="Sometimes")]))
+        errs = validation.validate("nodes", node)
+        details = "; ".join(e.detail for e in errs)
+        assert "invalid taint effect" in details and "key is required" in details
+
+    def test_apiserver_returns_422(self):
+        from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+        from kubernetes_tpu.server import AdmissionChain, APIServer
+
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            client = RESTClient(srv.url)
+            bad = okpod(name="p")
+            bad.spec.restart_policy = "Sometimes"
+            with pytest.raises(APIStatusError) as ei:
+                client.create("pods", bad)
+            assert ei.value.code == 422
+            assert "restartPolicy" in str(ei.value)
+            client.create("pods", okpod(name="fine"))  # valid passes
+        finally:
+            srv.stop()
+
+
+class TestNewAdmissionPlugins:
+    def test_always_pull_images(self):
+        pod = okpod()
+        AlwaysPullImages().admit("create", "pods", pod, None, None, None)
+        assert pod.spec.containers[0].image_pull_policy == "Always"
+
+    def test_security_context_deny(self):
+        pod = okpod()
+        pod.spec.containers[0].privileged = True
+        with pytest.raises(AdmissionError):
+            SecurityContextDeny().admit("create", "pods", pod, None, None,
+                                        None)
+
+    def test_event_rate_limit(self):
+        now = [0.0]
+        plug = EventRateLimit(qps=1.0, burst=2, clock=lambda: now[0])
+        ev = api.EventObject(metadata=api.ObjectMeta(name="e"))
+        plug.admit("create", "events", ev, None, None, None)
+        plug.admit("create", "events", ev, None, None, None)
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "events", ev, None, None, None)
+        now[0] += 1.5  # refill
+        plug.admit("create", "events", ev, None, None, None)
+
+    def test_pod_toleration_restriction(self):
+        store = ObjectStore()
+        store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(
+                name="restricted", namespace="",
+                annotations={
+                    PodTolerationRestriction.DEFAULTS_ANN:
+                        '[{"key": "team", "operator": "Equal",'
+                        ' "value": "ml", "effect": "NoSchedule"}]',
+                    PodTolerationRestriction.WHITELIST_ANN:
+                        '[{"key": "team", "operator": "Equal",'
+                        ' "value": "ml", "effect": "NoSchedule"}]'})))
+        pod = okpod()
+        pod.metadata.namespace = "restricted"
+        plug = PodTolerationRestriction()
+        plug.admit("create", "pods", pod, None, None, store)
+        assert [(t.key, t.value) for t in pod.spec.tolerations] == [
+            ("team", "ml")]
+        bad = okpod(tolerations=[api.Toleration(key="other",
+                                                operator="Exists")])
+        bad.metadata.namespace = "restricted"
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "pods", bad, None, None, store)
+
+    def test_limit_hard_anti_affinity_topology(self):
+        aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required=[api.PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"a": "b"}),
+                topology_key="failure-domain.beta.kubernetes.io/zone")]))
+        pod = okpod(affinity=aff)
+        with pytest.raises(AdmissionError):
+            LimitPodHardAntiAffinityTopology().admit("create", "pods", pod,
+                                                     None, None, None)
+
+    def test_extended_resource_toleration(self):
+        pod = api.Pod(metadata=api.ObjectMeta(name="p"), spec=api.PodSpec(
+            containers=[api.Container(resources=api.ResourceRequirements(
+                requests={"example.com/tpu": 4}))]))
+        ExtendedResourceToleration().admit("create", "pods", pod, None,
+                                           None, None)
+        tols = [(t.key, t.operator) for t in pod.spec.tolerations]
+        assert tols == [("example.com/tpu", api.TOLERATION_OP_EXISTS)]
