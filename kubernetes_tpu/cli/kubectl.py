@@ -283,7 +283,16 @@ def cmd_rollout(client, args, out):
     dep, owned = _deployment_and_rss(client, args)
     name = dep.metadata.name
     if args.action == "status":
-        # rollout_status.go Status: updated/total/available counts
+        # rollout_status.go Status: updated/total/available counts. Gate
+        # on the controller having OBSERVED this template first
+        # (observedGeneration analog): status counts are stale until an
+        # RS for the current template hash exists
+        cur_hash = template_hash(dep.spec.template)
+        if not any((rs.metadata.labels or {}).get(HASH_LABEL) == cur_hash
+                   for rs in owned):
+            out.write("Waiting for deployment spec update to be "
+                      "observed...\n")
+            return
         want = dep.spec.replicas
         st = dep.status
         if st.updated_replicas < want:

@@ -19,7 +19,13 @@ from .auth import UserInfo
 
 class AdmissionError(Exception):
     """Admission denial -> HTTP 403 (reference: admission errors are
-    apierrors.NewForbidden)."""
+    apierrors.NewForbidden). Plugins may set a different status code —
+    rate limiters reject with 429 (errors.NewTooManyRequests) so clients
+    retry instead of treating the throttle as a permanent denial."""
+
+    def __init__(self, message: str, code: int = 403):
+        super().__init__(message)
+        self.code = code
 
 
 class AdmissionPlugin:
@@ -323,7 +329,7 @@ class EventRateLimit(AdmissionPlugin):
                                self._tokens + (now - self._last) * self.qps)
             self._last = now
             if self._tokens < 1.0:
-                raise AdmissionError("event rate limit exceeded")
+                raise AdmissionError("event rate limit exceeded", code=429)
             self._tokens -= 1.0
 
 
@@ -345,11 +351,20 @@ class PodTolerationRestriction(AdmissionPlugin):
     def _parse(raw) -> List[api.Toleration]:
         import json
 
-        return [api.Toleration(key=d.get("key", ""),
-                               operator=d.get("operator", "Equal"),
-                               value=d.get("value", ""),
-                               effect=d.get("effect", ""))
-                for d in json.loads(raw)]
+        try:
+            docs = json.loads(raw)
+            if not isinstance(docs, list):
+                raise ValueError("expected a JSON list")
+            return [api.Toleration(key=d.get("key", ""),
+                                   operator=d.get("operator", "Equal"),
+                                   value=d.get("value", ""),
+                                   effect=d.get("effect", ""))
+                    for d in docs]
+        except (ValueError, AttributeError, TypeError) as e:
+            # a bad namespace annotation must reject pods with a
+            # descriptive admission error, not 500 every create
+            raise AdmissionError(
+                f"invalid toleration annotation on namespace: {e}")
 
     def admit(self, op, kind, obj, old, user, store):
         if kind != "pods" or op != "create":

@@ -241,10 +241,21 @@ class APIServer:
             # (pkg/apiserver/handler_proxy.go). The aggregator sits
             # BEHIND the standard filters: authz, flow control, and
             # audit all apply before the proxy hop.
-            if len(parts) >= 3 and parts[0] == "apis" \
-                    and self._aggregated_backend(parts) is not None:
+            backend = (self._aggregated_backend(parts)
+                       if len(parts) >= 3 and parts[0] == "apis" else None)
+            if backend is not None:
+                # attribute extraction mirrors _route: the RBAC resource
+                # is the aggregated plural, not the 'namespaces' path
+                # segment, and a collection GET authorizes as 'list'
+                rest = parts[3:]
+                res_ns = None
+                if rest and rest[0] == "namespaces" and len(rest) >= 3:
+                    res_ns, rest = rest[1], rest[2:]
+                plural = rest[0] if rest else parts[1]
+                name = rest[1] if len(rest) > 1 else None
                 verb = _VERBS[h.command]
-                plural = parts[3] if len(parts) > 3 else parts[1]
+                if verb == "get" and name is None:
+                    verb = "list"
                 if self.authorizer is not None and user is not None:
                     if not self.authorizer.authorize(user, verb, plural):
                         raise APIError(403, "Forbidden",
@@ -256,9 +267,8 @@ class APIServer:
                     raise APIError(429, "TooManyRequests",
                                    "server request limit reached, retry later")
                 try:
-                    self._audit(user, verb, plural,
-                                None, parts[4] if len(parts) > 4 else None)
-                    return self._serve_aggregated(h, parts, parsed)
+                    self._audit(user, verb, plural, res_ns, name)
+                    return self._serve_aggregated(h, backend, parsed)
                 finally:
                     if sem is not None:
                         sem.release()
@@ -335,12 +345,13 @@ class APIServer:
                 return apisvc.spec
         return None
 
-    def _serve_aggregated(self, h, parts, parsed):
+    def _serve_aggregated(self, h, svc_ref, parsed):
         """Proxy /apis/<group>/<version>/... to the APIService's backing
         service endpoints (handler_proxy.go:109 ServeHTTP: resolve the
-        service, forward verbatim, relay the response)."""
-        svc_ref = self._aggregated_backend(parts)
-        group, version = parts[1], parts[2]
+        service, forward verbatim, relay the response). svc_ref is the
+        APIServiceSpec resolved by the caller — re-resolving here could
+        race a concurrent APIService deletion into a 500."""
+        group, version = svc_ref.group, svc_ref.version
         ep = self.store.get("endpoints", svc_ref.service_namespace,
                             svc_ref.service_name)
         backends = [(a.ip, (next((p.port for p in s.ports), None)
@@ -502,7 +513,10 @@ class APIServer:
         try:
             self.admission.admit("create", plural, obj, None, user, self.store)
         except AdmissionError as e:
-            raise APIError(403, "Forbidden", str(e))
+            code = getattr(e, "code", 403)
+            raise APIError(code,
+                           "TooManyRequests" if code == 429 else "Forbidden",
+                           str(e))
         # validation runs AFTER admission mutators, like the registry
         # strategies' Validate (registry/core/pod/strategy.go:79); a bad
         # object reports every field error at once as a 422
@@ -564,7 +578,10 @@ class APIServer:
         try:
             self.admission.admit("update", plural, obj, old, user, self.store)
         except AdmissionError as e:
-            raise APIError(403, "Forbidden", str(e))
+            code = getattr(e, "code", 403)
+            raise APIError(code,
+                           "TooManyRequests" if code == 429 else "Forbidden",
+                           str(e))
         if sub not in ("status", "finalize"):
             errs = validation.validate(plural, obj, old=old)
             if errs:
@@ -598,7 +615,10 @@ class APIServer:
         try:
             self.admission.admit("delete", plural, None, obj, user, self.store)
         except AdmissionError as e:
-            raise APIError(403, "Forbidden", str(e))
+            code = getattr(e, "code", 403)
+            raise APIError(code,
+                           "TooManyRequests" if code == 429 else "Forbidden",
+                           str(e))
         self.store.delete(plural, obj.metadata.namespace, obj.metadata.name)
         if plural == "customresourcedefinitions":
             scheme.unregister(obj.spec.names.kind)
