@@ -126,6 +126,12 @@ register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
 register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
 register("APIService", "apiservices", api.APIService,
          "apiregistration.k8s.io/v1", namespaced=False)
+register("MutatingWebhookConfiguration", "mutatingwebhookconfigurations",
+         api.MutatingWebhookConfiguration,
+         "admissionregistration.k8s.io/v1beta1", namespaced=False)
+register("ValidatingWebhookConfiguration", "validatingwebhookconfigurations",
+         api.ValidatingWebhookConfiguration,
+         "admissionregistration.k8s.io/v1beta1", namespaced=False)
 register("LimitRange", "limitranges", api.LimitRange)
 register("CertificateSigningRequest", "certificatesigningrequests",
          api.CertificateSigningRequest, "certificates.k8s.io/v1beta1",

@@ -1000,6 +1000,48 @@ class ConfigMap:
 
 
 @dataclass
+class WebhookRule:
+    """admissionregistration/v1beta1 RuleWithOperations (types.go:52)."""
+
+    operations: List[str] = field(default_factory=lambda: ["*"])
+    resources: List[str] = field(default_factory=lambda: ["*"])
+
+
+@dataclass
+class Webhook:
+    """One webhook in a configuration (types.go:133 Webhook). The
+    reference addresses service refs or URLs; this model uses URLs (a
+    service ref resolves through the same endpoints the aggregator
+    uses)."""
+
+    name: str = ""
+    url: str = ""
+    rules: List[WebhookRule] = field(default_factory=list)
+    failure_policy: str = "Ignore"  # Ignore | Fail (default per 1.11)
+    timeout_seconds: int = 10
+
+
+@dataclass
+class WebhookConfiguration:
+    """Base for the two webhook configuration kinds. They must be
+    DISTINCT types: the scheme maps python type -> kind, and sharing one
+    class would serve every configuration as the first-registered kind."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+
+
+@dataclass
+class MutatingWebhookConfiguration(WebhookConfiguration):
+    pass
+
+
+@dataclass
+class ValidatingWebhookConfiguration(WebhookConfiguration):
+    pass
+
+
+@dataclass
 class APIServiceSpec:
     """kube-aggregator apiregistration/v1 APIServiceSpec
     (staging/src/k8s.io/kube-aggregator/pkg/apis/apiregistration/

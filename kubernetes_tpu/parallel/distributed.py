@@ -1,0 +1,71 @@
+"""Multi-host distributed initialization + global mesh construction.
+
+Reference: the NCCL/MPI communication backend the reference scales on
+(SURVEY.md §2.2). The jax equivalent: every host process calls
+jax.distributed.initialize against a shared coordinator, after which
+jax.devices() spans ALL hosts and one global Mesh lays the (wave,
+nodes) axes over the fleet — GSPMD then routes per-axis collectives
+over ICI within a slice and DCN across slices/hosts, which is the
+framework's entire explicit comm surface (no hand-written sends).
+
+Single-process use is a no-op: the local mesh path in mesh.py already
+covers one host. The driver's dryrun exercises the sharding on a
+virtual device fleet; this module is the production entry for real
+multi-host pods (e.g. a v5e-256 spanning 64 hosts).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """jax.distributed.initialize with env fallbacks
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, the
+    same contract TPU pod launchers export). Returns True if a
+    multi-process runtime was initialized, False for the single-process
+    no-op — callers can branch for logging, nothing else changes."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if not coordinator_address:
+        return False  # single-host/local mode
+    # pass ONLY what's known: jax auto-detects the rest on TPU pods, and
+    # defaulting process_id to 0 here would make every host claim slot 0
+    kwargs = {"coordinator_address": coordinator_address}
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
+def global_mesh(wave_parallel: int = 1) -> Mesh:
+    """(wave, nodes) Mesh over every device of every initialized host.
+
+    Axis placement matters for the interconnect: devices are laid out in
+    jax.devices() order, which groups devices of one host/slice
+    contiguously — keeping "nodes" (the big, collective-heavy axis) as
+    the fastest-varying dimension puts its psum/all-gather traffic on
+    ICI neighbors, while the outer "wave" axis (data-parallel-ish, one
+    all-gather of per-pod rows per step) is the one that crosses DCN
+    when the fleet spans slices. This mirrors the scaling-book recipe:
+    put the bandwidth-hungry axis on the fast interconnect."""
+    devices = jax.devices()
+    n = len(devices)
+    if n % wave_parallel != 0:
+        raise ValueError(
+            f"{n} devices not divisible by wave_parallel={wave_parallel}")
+    arr = np.array(devices).reshape(wave_parallel, n // wave_parallel)
+    return Mesh(arr, ("wave", "nodes"))

@@ -1,0 +1,169 @@
+"""Admission webhooks: call out to external admission servers.
+
+Reference: plugin/pkg/admission/webhook/{mutating,validating} (the
+1.11-era GenericAdmissionWebhook) + apiserver/pkg/admission/plugin/
+webhook/request: for each matching webhook in the registered
+configurations, POST an AdmissionReview carrying the object; a
+validating webhook answers allowed/denied, a mutating webhook may also
+return a JSON patch (RFC 6902) the apiserver applies before storage.
+failurePolicy decides whether an unreachable webhook fails open
+(Ignore) or closed (Fail).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from ..api import scheme
+from ..api import types as api
+from .admission import AdmissionError, AdmissionPlugin
+
+
+def apply_json_patch(doc: dict, patch: List[dict]) -> dict:
+    """RFC 6902 subset: add / replace / remove over /-separated paths
+    (apimachinery's jsonpatch usage in mutating webhook dispatch)."""
+    import copy
+
+    out = copy.deepcopy(doc)
+    for op in patch:
+        path = [p.replace("~1", "/").replace("~0", "~")
+                for p in op["path"].lstrip("/").split("/")]
+        parent = out
+        for seg in path[:-1]:
+            parent = (parent[int(seg)] if isinstance(parent, list)
+                      else parent.setdefault(seg, {}))
+        leaf = path[-1]
+        kind = op["op"]
+        if isinstance(parent, list):
+            idx = len(parent) if leaf == "-" else int(leaf)
+            if kind == "add":
+                parent.insert(idx, op["value"])
+            elif kind == "replace":
+                parent[idx] = op["value"]
+            elif kind == "remove":
+                del parent[idx]
+        else:
+            if kind in ("add", "replace"):
+                parent[leaf] = op["value"]
+            elif kind == "remove":
+                parent.pop(leaf, None)
+    return out
+
+
+class _WebhookAdmission(AdmissionPlugin):
+    """Shared dispatch; subclasses pick the configuration kind and
+    whether patches apply."""
+
+    config_plural = ""
+    mutating = False
+
+    def _matching(self, store, op: str, kind: str) -> List[api.Webhook]:
+        out = []
+        for cfg in store.list(self.config_plural):
+            for wh in cfg.webhooks:
+                for rule in (wh.rules or [api.WebhookRule()]):
+                    ops = [o.lower() for o in rule.operations]
+                    if ("*" in ops or op in ops) and \
+                            ("*" in rule.resources or kind in rule.resources):
+                        out.append(wh)
+                        break
+        return out
+
+    def _call(self, wh: api.Webhook, review: dict) -> Optional[dict]:
+        req = urllib.request.Request(
+            wh.url, data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=wh.timeout_seconds) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if wh.failure_policy == "Fail":
+                raise AdmissionError(
+                    f"webhook {wh.name!r} unreachable and "
+                    f"failurePolicy=Fail: {e}")
+            return None  # Ignore: fail open
+
+    def admit(self, op, kind, obj, old, user, store):
+        if obj is None and old is None:
+            return
+        if kind in ("mutatingwebhookconfigurations",
+                    "validatingwebhookconfigurations"):
+            # never intercept webhook registration itself: a broken
+            # wildcard webhook must stay deletable (the reference exempts
+            # admissionregistration resources for the same reason)
+            return
+        hooks = self._matching(store, op, kind)
+        if not hooks:
+            return
+        subject = obj if obj is not None else old
+        review = {
+            "kind": "AdmissionReview",
+            "apiVersion": "admission.k8s.io/v1beta1",
+            "request": {
+                "uid": subject.metadata.uid,
+                "resource": kind,
+                "operation": op.upper(),
+                "userInfo": {"username": user.name if user else ""},
+                "object": (scheme.encode_object(obj)
+                           if obj is not None else None),
+                "oldObject": (scheme.encode_object(old)
+                              if old is not None else None),
+            },
+        }
+        for wh in hooks:
+            body = self._call(wh, review)
+            if body is None:
+                continue
+            resp = body.get("response")
+            if not isinstance(resp, dict) or "allowed" not in resp:
+                # a 200 without a valid AdmissionReview envelope is a
+                # BROKEN webhook, not a denial: failurePolicy governs,
+                # same as the unreachable case
+                if wh.failure_policy == "Fail":
+                    raise AdmissionError(
+                        f"webhook {wh.name!r} returned an invalid "
+                        f"AdmissionReview response")
+                continue
+            if not resp.get("allowed", False):
+                msg = resp.get("status", {}).get("message",
+                                                 f"denied by {wh.name}")
+                raise AdmissionError(msg)
+            patch = resp.get("patch")
+            if self.mutating and patch and obj is not None:
+                try:
+                    if isinstance(patch, str):  # base64, per the reference
+                        import base64
+
+                        patch = json.loads(base64.b64decode(patch))
+                    patched = apply_json_patch(scheme.encode_object(obj),
+                                               patch)
+                    new_obj = scheme.decode_object(patched)
+                except Exception as e:
+                    # webhook-controlled input must never 500 the request
+                    # path; a malformed patch is a webhook failure under
+                    # failurePolicy
+                    if wh.failure_policy == "Fail":
+                        raise AdmissionError(
+                            f"webhook {wh.name!r} returned an unappliable "
+                            f"patch: {e}")
+                    continue
+                # mutate the caller's object in place (admission contract)
+                for f in obj.__dataclass_fields__:
+                    setattr(obj, f, getattr(new_obj, f))
+                review["request"]["object"] = scheme.encode_object(obj)
+
+
+class MutatingAdmissionWebhook(_WebhookAdmission):
+    name = "MutatingAdmissionWebhook"
+    config_plural = "mutatingwebhookconfigurations"
+    mutating = True
+
+
+class ValidatingAdmissionWebhook(_WebhookAdmission):
+    name = "ValidatingAdmissionWebhook"
+    config_plural = "validatingwebhookconfigurations"
+    mutating = False

@@ -1,0 +1,205 @@
+"""Admission webhook tests: mutating patches, validating denials,
+failure policies, rule matching.
+
+Reference test model: apiserver/pkg/admission/plugin/webhook tests
+(dispatch against a local test server).
+"""
+
+import base64
+import http.server
+import json
+import threading
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server.admission import AdmissionChain, AdmissionError
+from kubernetes_tpu.server.webhook import (MutatingAdmissionWebhook,
+                                           ValidatingAdmissionWebhook,
+                                           apply_json_patch)
+
+
+class _Hook(http.server.BaseHTTPRequestHandler):
+    mode = "allow"
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        review = json.loads(self.rfile.read(n))
+        uid = review["request"]["uid"]
+        resp = {"uid": uid, "allowed": True}
+        if self.server.mode == "deny":
+            resp = {"uid": uid, "allowed": False,
+                    "status": {"message": "pods must be labeled"}}
+        elif self.server.mode == "mutate":
+            patch = [{"op": "add", "path": "/metadata/labels/injected",
+                      "value": "yes"}]
+            resp["patch"] = base64.b64encode(
+                json.dumps(patch).encode()).decode()
+        body = json.dumps({"response": resp}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def start_hook(mode):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    srv.mode = mode
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}/admit"
+
+
+def mkpod(name="p"):
+    return api.Pod(metadata=api.ObjectMeta(name=name),
+                   spec=api.PodSpec(containers=[api.Container(name="c")]))
+
+
+class TestJSONPatch:
+    def test_ops(self):
+        doc = {"metadata": {"labels": {"a": "1"}}, "spec": {"xs": [1, 2]}}
+        out = apply_json_patch(doc, [
+            {"op": "add", "path": "/metadata/labels/b", "value": "2"},
+            {"op": "replace", "path": "/metadata/labels/a", "value": "9"},
+            {"op": "remove", "path": "/spec/xs/0"},
+            {"op": "add", "path": "/spec/xs/-", "value": 7}])
+        assert out["metadata"]["labels"] == {"a": "9", "b": "2"}
+        assert out["spec"]["xs"] == [2, 7]
+        assert doc["metadata"]["labels"] == {"a": "1"}  # input untouched
+
+
+class TestWebhooks:
+    def test_validating_denial(self):
+        srv, url = start_hook("deny")
+        store = ObjectStore()
+        store.create("validatingwebhookconfigurations",
+                     api.ValidatingWebhookConfiguration(
+                         metadata=api.ObjectMeta(name="vw", namespace=""),
+                         webhooks=[api.Webhook(
+                             name="deny.example.io", url=url,
+                             rules=[api.WebhookRule(operations=["create"],
+                                                    resources=["pods"])])]))
+        plug = ValidatingAdmissionWebhook()
+        with pytest.raises(AdmissionError) as ei:
+            plug.admit("create", "pods", mkpod(), None, None, store)
+        assert "must be labeled" in str(ei.value)
+        # non-matching resource passes
+        plug.admit("create", "services", api.Service(
+            metadata=api.ObjectMeta(name="s")), None, None, store)
+        srv.shutdown()
+
+    def test_mutating_patch_applied(self):
+        srv, url = start_hook("mutate")
+        store = ObjectStore()
+        store.create("mutatingwebhookconfigurations",
+                     api.MutatingWebhookConfiguration(
+                         metadata=api.ObjectMeta(name="mw", namespace=""),
+                         webhooks=[api.Webhook(name="inject.example.io",
+                                               url=url)]))
+        pod = mkpod()
+        MutatingAdmissionWebhook().admit("create", "pods", pod, None, None,
+                                         store)
+        assert pod.metadata.labels.get("injected") == "yes"
+        srv.shutdown()
+
+    def test_failure_policies(self):
+        store = ObjectStore()
+        dead = "http://127.0.0.1:9/admit"  # nothing listens
+        store.create("validatingwebhookconfigurations",
+                     api.ValidatingWebhookConfiguration(
+                         metadata=api.ObjectMeta(name="vw", namespace=""),
+                         webhooks=[api.Webhook(name="soft.example.io",
+                                               url=dead, timeout_seconds=1,
+                                               failure_policy="Ignore")]))
+        plug = ValidatingAdmissionWebhook()
+        plug.admit("create", "pods", mkpod(), None, None, store)  # fail open
+        cfg = store.list("validatingwebhookconfigurations")[0]
+        cfg.webhooks[0].failure_policy = "Fail"
+        store.update("validatingwebhookconfigurations", cfg)
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "pods", mkpod(), None, None, store)
+
+    def test_kind_round_trip_distinct(self):
+        """Validating and mutating configurations must round-trip as
+        their OWN kinds through the wire codec."""
+        from kubernetes_tpu.api import scheme
+
+        v = api.ValidatingWebhookConfiguration(
+            metadata=api.ObjectMeta(name="v", namespace=""))
+        m = api.MutatingWebhookConfiguration(
+            metadata=api.ObjectMeta(name="m", namespace=""))
+        assert scheme.encode_object(v)["kind"] == \
+            "ValidatingWebhookConfiguration"
+        assert scheme.encode_object(m)["kind"] == \
+            "MutatingWebhookConfiguration"
+
+    def test_invalid_response_and_bad_patch_follow_failure_policy(self):
+        class _Broken(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if self.server.mode == "no-envelope":
+                    body = b"{}"
+                else:  # bad patch
+                    body = json.dumps({"response": {
+                        "allowed": True,
+                        "patch": [{"op": "add",
+                                   "path": "/spec/containers/9/image",
+                                   "value": "x"}]}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        for mode in ("no-envelope", "bad-patch"):
+            srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Broken)
+            srv.mode = mode
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            url = f"http://127.0.0.1:{srv.server_address[1]}/admit"
+            store = ObjectStore()
+            store.create("mutatingwebhookconfigurations",
+                         api.MutatingWebhookConfiguration(
+                             metadata=api.ObjectMeta(name="mw", namespace=""),
+                             webhooks=[api.Webhook(
+                                 name="broken.e.io", url=url,
+                                 failure_policy="Ignore")]))
+            pod = mkpod()
+            # Ignore: broken webhook fails open, request survives
+            MutatingAdmissionWebhook().admit("create", "pods", pod, None,
+                                             None, store)
+            cfg = store.list("mutatingwebhookconfigurations")[0]
+            cfg.webhooks[0].failure_policy = "Fail"
+            store.update("mutatingwebhookconfigurations", cfg)
+            with pytest.raises(AdmissionError):
+                MutatingAdmissionWebhook().admit("create", "pods", mkpod(),
+                                                 None, None, store)
+            srv.shutdown()
+
+    def test_end_to_end_through_apiserver(self):
+        from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+        from kubernetes_tpu.server import APIServer
+
+        mut_srv, mut_url = start_hook("mutate")
+        store = ObjectStore()
+        chain = AdmissionChain([MutatingAdmissionWebhook(),
+                                ValidatingAdmissionWebhook()])
+        srv = APIServer(store, admission=chain).start()
+        try:
+            client = RESTClient(srv.url)
+            client.create("mutatingwebhookconfigurations",
+                          api.MutatingWebhookConfiguration(
+                              metadata=api.ObjectMeta(name="mw",
+                                                      namespace=""),
+                              webhooks=[api.Webhook(name="inject.e.io",
+                                                    url=mut_url)]))
+            created = client.create("pods", mkpod("webhooked"))
+            assert created.metadata.labels.get("injected") == "yes"
+        finally:
+            srv.stop()
+            mut_srv.shutdown()
