@@ -174,10 +174,7 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
         p = _base_pod(api, f"warmup-{i}", "warmup")
         store.create("pods", p)
         warm_pods.append(p)
-    if workload == "mixed":
-        # mixed rounds before the anti-affinity block run the ipa-free
-        # program variant (at the ipa-capped bucket) — warm it first
-        sched.warm_pipeline(warm_pods, n_waves=n_w)
+    density_warm = list(warm_pods)
     for i in range(n_anti_warm):
         aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
             required=[api.PodAffinityTerm(
@@ -189,7 +186,16 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
                       affinity=aff)
         store.create("pods", p)
         warm_pods.append(p)
+    # the anti-inclusive warm runs FIRST: interning its 50 unique programs
+    # grows Caps.UI to the run's final bucket, so the ipa-free variant
+    # warmed next compiles with the same UI dim the measured rounds use
+    # (warming it before the growth would compile a UI=8 program the run
+    # never calls, leaving a 7-20s recompile inside the window)
     sched.warm_pipeline(warm_pods, n_waves=n_w)
+    if workload == "mixed":
+        # mixed rounds before the anti-affinity block run the ipa-free
+        # program variant at the ipa-capped bucket — warm it too
+        sched.warm_pipeline(density_warm, n_waves=n_w)
     for p in warm_pods:
         store.delete("pods", "default", p.metadata.name)
 

@@ -57,9 +57,9 @@ from .queue import SchedulingQueue
 # for 128 scan iterations). Longer backlogs run multiple rounds. The
 # inter-pod-affinity variant is capped lower: at full caps (M=32k,
 # E=8k, N=8k) a 128-iteration ipa scan crashes the TPU worker outright
-# (observed on v5e; W<=32 executes fine).
+# (observed on v5e; W<=64 executes fine).
 PIPELINE_MAX_WAVES = 128
-PIPELINE_MAX_WAVES_IPA = 32
+PIPELINE_MAX_WAVES_IPA = 64
 
 
 def pipeline_bucket(n_waves: int, lo: int = 4,

@@ -37,18 +37,22 @@ def apply_json_patch(doc: dict, patch: List[dict]) -> dict:
                       else parent.setdefault(seg, {}))
         leaf = path[-1]
         kind = op["op"]
+        if kind not in ("add", "replace", "remove"):
+            # never silently half-apply: an unsupported op (test/move/
+            # copy) raises so admit() routes it through failurePolicy
+            raise ValueError(f"unsupported JSON patch op {kind!r}")
         if isinstance(parent, list):
             idx = len(parent) if leaf == "-" else int(leaf)
             if kind == "add":
                 parent.insert(idx, op["value"])
             elif kind == "replace":
                 parent[idx] = op["value"]
-            elif kind == "remove":
+            else:
                 del parent[idx]
         else:
             if kind in ("add", "replace"):
                 parent[leaf] = op["value"]
-            elif kind == "remove":
+            else:
                 parent.pop(leaf, None)
     return out
 
@@ -129,8 +133,9 @@ class _WebhookAdmission(AdmissionPlugin):
                         f"AdmissionReview response")
                 continue
             if not resp.get("allowed", False):
-                msg = resp.get("status", {}).get("message",
-                                                 f"denied by {wh.name}")
+                status = resp.get("status")
+                msg = (status.get("message") if isinstance(status, dict)
+                       else None) or f"denied by {wh.name}"
                 raise AdmissionError(msg)
             patch = resp.get("patch")
             if self.mutating and patch and obj is not None:

@@ -93,5 +93,9 @@ class LockOrderWatcher:
 
 
 def instrument(watcher: LockOrderWatcher, obj, attr: str, name: str):
-    """Replace obj.<attr> (a lock) with a watched proxy."""
+    """Replace obj.<attr> (a lock) with a watched proxy.
+
+    Must run BEFORE any concurrency touches the object: a thread that
+    captured the original lock object would not contend with threads
+    acquiring the proxy, silently breaking mutual exclusion."""
     setattr(obj, attr, watcher.wrap(name, getattr(obj, attr)))

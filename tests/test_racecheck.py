@@ -90,6 +90,9 @@ class TestLockOrderWatcher:
 
         w = LockOrderWatcher()
         backing = ObjectStore()
+        # instrument BEFORE the server starts: swapping a lock under live
+        # threads would break mutual exclusion (see racecheck.instrument)
+        instrument(w, backing, "_lock", "backing-store")
         srv = APIServer(backing, admission=AdmissionChain()).start()
         self._srv = srv
 
@@ -103,7 +106,6 @@ class TestLockOrderWatcher:
         sched = Scheduler(store, wave_size=8)
         instrument(w, sched, "_mu", "scheduler")
         instrument(w, sched.queue, "_lock", "queue")
-        instrument(w, backing, "_lock", "backing-store")
         epc = EndpointsController(remote())
         store.create("services", api.Service(
             metadata=api.ObjectMeta(name="svc"),
