@@ -79,6 +79,9 @@ class SchedulingQueue:
         # nominated pods: node name -> {uid: pod} (reference :464
         # WaitingPodsForNode; used by preemption + two-pass filtering)
         self._nominated: Dict[str, Dict[str, api.Pod]] = {}
+        # uid -> first time the pod entered the active queue (consumed by
+        # the scheduler's per-pod e2e latency metric at commit)
+        self.added_at: Dict[str, float] = {}
         self._closed = False
 
     # -- add / pop -----------------------------------------------------------
@@ -94,6 +97,9 @@ class SchedulingQueue:
             self._unschedulable.pop(pod.uid, None)
             self._backoff.pop(pod.uid, None)
             self._items[pod.uid] = pod
+            # first enqueue time survives requeues: per-pod e2e scheduling
+            # latency measures from when the pod first became schedulable
+            self.added_at.setdefault(pod.uid, self.clock())
             heapq.heappush(self._heap, self._key(pod))
             if pod.status.nominated_node_name:
                 self._nominated.setdefault(
@@ -276,6 +282,7 @@ class SchedulingQueue:
             self._unschedulable.pop(pod.uid, None)
             self._backoff.pop(pod.uid, None)
             self._backoff_until.pop(pod.uid, None)
+            self.added_at.pop(pod.uid, None)
             nom = self._nominated.get(pod.status.nominated_node_name)
             if nom:
                 nom.pop(pod.uid, None)

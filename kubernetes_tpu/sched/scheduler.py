@@ -857,6 +857,12 @@ class Scheduler:
         with self._mu:
             self.cache.finish_binding(bound)
         self.metrics.binding_latency.observe(self.clock() - t0)
+        # per-pod e2e: first enqueue -> bind POST landed. Observed (and
+        # the timestamp consumed) only HERE so a failed bind's requeue
+        # keeps the original enqueue time and the pod counts once
+        added = self.queue.added_at.pop(pod.uid, None)
+        if added is not None:
+            self.metrics.pod_scheduling_latency.observe(self.clock() - added)
         self.metrics.pods_scheduled.inc()
         self.backoff.clear(pod.uid)
         self.queue.clear_backoff(pod.uid)

@@ -70,6 +70,10 @@ class Metrics:
 
     def __init__(self):
         self.e2e_scheduling_latency = Histogram("e2e_scheduling_latency")
+        # per-POD latency from first enqueue to assume+bind-dispatch (the
+        # BASELINE target tracks p99 schedule latency alongside
+        # throughput; e2e_scheduling_latency spans whole waves/rounds)
+        self.pod_scheduling_latency = Histogram("pod_scheduling_latency")
         self.scheduling_algorithm_latency = Histogram("scheduling_algorithm_latency")
         self.predicate_evaluation = Histogram("scheduling_algorithm_predicate_evaluation")
         self.priority_evaluation = Histogram("scheduling_algorithm_priority_evaluation")
