@@ -1,0 +1,116 @@
+"""kube-proxy binary.
+
+Analog of cmd/kube-proxy/app/server.go: connect to the apiserver,
+mirror services + endpoints, run the Proxier's event-driven rule-sync
+loop, and serve /healthz (last sync stats, healthcheck probes for
+externalTrafficPolicy=Local services) + /metrics on the metrics port
+(server.go:540 serveHealthz / :552 serveMetrics).
+
+Run: python -m kubernetes_tpu.cli.kube_proxy --server http://... \\
+        --hostname-override n1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..client import RESTClient, RemoteStore
+from ..proxy import Proxier
+
+
+class ProxyHealthServer:
+    def __init__(self, proxier: Proxier, host="127.0.0.1", port=0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = json.dumps(outer.proxier.health()).encode()
+                    code = 200
+                elif self.path.startswith("/healthz/service/"):
+                    # cloud-LB probe path for healthCheckNodePorts
+                    try:
+                        port_q = int(self.path.rsplit("/", 1)[-1])
+                    except ValueError:
+                        port_q = -1
+                    code, payload = outer.proxier.healthcheck.probe(port_q)
+                    body = json.dumps(payload).encode()
+                elif self.path == "/metrics":
+                    h = outer.proxier.health()
+                    body = (
+                        f"# TYPE kubeproxy_sync_proxy_rules_total counter\n"
+                        f"kubeproxy_sync_proxy_rules_total {h['syncs']}\n"
+                        f"# TYPE kubeproxy_rules gauge\n"
+                        f"kubeproxy_rules {h['rules']}\n").encode()
+                    code = 200
+                else:
+                    code, body = 404, b""
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.proxier = proxier
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                         name="kube-proxy-health").start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kube-proxy")
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--hostname-override", default="")
+    ap.add_argument("--healthz-port", type=int, default=0)
+    ap.add_argument("--min-sync-period", type=float, default=0.0)
+    ap.add_argument("--sync-loop-period", type=float, default=1.0)
+    ap.add_argument("--one-shot", action="store_true",
+                    help="sync once and exit (tests/CI)")
+    args = ap.parse_args(argv)
+
+    client = RESTClient(args.server, token=args.token)
+    store = RemoteStore(client)
+    store.mirror("services")
+    store.mirror("endpoints")
+    # the reflector's initial LIST is async; syncing against empty
+    # mirrors would install zero rules (and --one-shot would exit 0
+    # having programmed nothing)
+    store.wait_for_sync()
+    proxier = Proxier(store, node_name=args.hostname_override,
+                      min_sync_period=args.min_sync_period)
+    health = ProxyHealthServer(proxier, port=args.healthz_port).start()
+    print(f"kube-proxy: healthz on :{health.port}", file=sys.stderr)
+    if args.one_shot:
+        proxier.sync_proxy_rules()
+        health.stop()
+        return 0
+    proxier.run(period=args.sync_loop_period)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    proxier.stop()
+    health.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

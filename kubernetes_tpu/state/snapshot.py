@@ -51,6 +51,9 @@ class Snapshot:
         self._alloc_pods()
         # inter-pod affinity term table
         self.term_rows: Dict[str, List[int]] = {}  # pod uid -> row indices
+        # uid -> (node_idx, alive, labels) of the last written row; lets
+        # add_pod skip the bind-confirmation echo (see add_pod)
+        self._pod_sig: Dict[str, tuple] = {}
         self._free_terms: List[int] = []
         self._next_term = 0
         self._alloc_terms()
@@ -267,6 +270,11 @@ class Snapshot:
                 for uid, slot in list(self.pod_slot.items()):
                     if stale[slot]:
                         del self.pod_slot[uid]
+                        # sig must die with the row: a node flap that
+                        # reuses this node index would otherwise make
+                        # add_pod's echo-skip treat the re-delivered pod
+                        # as already written and drop it forever
+                        self._pod_sig.pop(uid, None)
                         self._free_slots.append(slot)
                         self._clear_pod_terms(uid)
                 self.dirty_pods = True
@@ -328,9 +336,19 @@ class Snapshot:
         node_idx = self.node_index.get(pod.spec.node_name)
         if node_idx is None:
             return
+        # bind-confirmation echo: the informer re-delivers the pod the
+        # commit just wrote. Labels and placement unchanged -> the row
+        # (and term rows — pod affinity is spec-immutable in the API) is
+        # already exact; skipping avoids rewriting every row twice per
+        # bind and re-marking the device mirror dirty
+        sig = (node_idx, pod.metadata.deletion_timestamp is None,
+               tuple(sorted((pod.metadata.labels or {}).items())))
+        if self._pod_sig.get(pod.uid) == sig:
+            return
         slot = self._alloc_slot(pod.uid)
         self._write_pod_row(pod, slot, node_idx, active=True)
         self._set_pod_terms(pod, slot, node_idx)
+        self._pod_sig[pod.uid] = sig
         self.dirty_pods = True
 
     def stage_pending(self, pods) -> Tuple[np.ndarray, np.ndarray]:
@@ -368,6 +386,7 @@ class Snapshot:
 
     def remove_pod(self, pod: api.Pod):
         slot = self.pod_slot.pop(pod.uid, None)
+        self._pod_sig.pop(pod.uid, None)
         if slot is not None:
             self.ep_valid[slot] = False
             self.ep_alive[slot] = False

@@ -184,6 +184,28 @@ class TestStaging:
         rows2, _ = snap.stage_pending([mkpod("c")])
         assert rows2[0] in (rows[0], rows[1])
 
+    def test_node_flap_rewrites_pod_row(self):
+        """A node deletion evicts its pods' rows; when the node re-adds
+        (reusing its index) and the pod re-delivers, add_pod must WRITE
+        the row again — the bind-echo signature died with the row."""
+        from kubernetes_tpu.state.cache import SchedulerCache
+        from kubernetes_tpu.state.snapshot import Snapshot
+
+        cache, snap = SchedulerCache(), Snapshot()
+        n = mknode(0)
+        cache.add_node(n)
+        snap.set_node(cache.node_infos["n0"])
+        pod = api.with_node_name(mkpod("a", labels={"app": "x"}), "n0")
+        snap.add_pod(pod)
+        slot = snap.pod_slot[pod.uid]
+        assert snap.ep_valid[slot]
+        snap.remove_node("n0")
+        assert not snap.ep_valid[slot]
+        snap.set_node(cache.node_infos["n0"])  # node back, same index
+        snap.add_pod(pod)  # informer re-delivery
+        slot2 = snap.pod_slot.get(pod.uid)
+        assert slot2 is not None and snap.ep_valid[slot2]
+
     def test_commit_after_stage_reuses_slot(self):
         from kubernetes_tpu.state.cache import SchedulerCache
         from kubernetes_tpu.state.snapshot import Snapshot
