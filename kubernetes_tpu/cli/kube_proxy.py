@@ -53,8 +53,10 @@ class ProxyHealthServer:
                     code = 200
                 else:
                     code, body = 404, b""
+                ctype = ("text/plain; version=0.0.4"  # Prometheus text
+                         if self.path == "/metrics" else "application/json")
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -93,7 +95,10 @@ def main(argv=None) -> int:
     # the reflector's initial LIST is async; syncing against empty
     # mirrors would install zero rules (and --one-shot would exit 0
     # having programmed nothing)
-    store.wait_for_sync()
+    if not store.wait_for_sync():
+        print("kube-proxy: apiserver mirrors failed to sync",
+              file=sys.stderr)
+        return 1
     proxier = Proxier(store, node_name=args.hostname_override,
                       min_sync_period=args.min_sync_period)
     health = ProxyHealthServer(proxier, port=args.healthz_port).start()

@@ -368,6 +368,56 @@ def cmd_expose(client, args, out):
     out.write(f"service/{svc.metadata.name} exposed\n")
 
 
+def cmd_top(client, args, out):
+    """top.go: resource usage from the metrics API (metrics-server's
+    PodMetrics objects; node usage aggregates its pods')."""
+    from ..api import resources as res
+
+    what = _resolve_kind(args.kind)
+
+    def cpu_mem(m):
+        return (m.usage.get(res.CPU, 0), m.usage.get(res.MEMORY, 0))
+
+    def table(rows):
+        headers = ["NAME", "CPU(m)", "MEMORY(Mi)"]
+        widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+                  for i, h in enumerate(headers)]
+        out.write("  ".join(h.ljust(w) for h, w in
+                            zip(headers, widths)).rstrip() + "\n")
+        for r in rows:
+            out.write("  ".join(c.ljust(w) for c, w in
+                                zip(r, widths)).rstrip() + "\n")
+
+    if what == "pods":
+        # namespace-scoped, like the real kubectl top pods
+        metrics, _ = client.list("podmetrics", args.namespace)
+        table([[m.metadata.name, str(cpu_mem(m)[0]),
+                str(cpu_mem(m)[1] // (1 << 20))]
+               for m in sorted(metrics, key=lambda m: m.metadata.name)])
+    elif what == "nodes":
+        metrics, _ = client.list("podmetrics", None)
+        pods, _ = client.list("pods", None)
+        # key by (namespace, name): same-named pods in different
+        # namespaces must not collide
+        node_of = {(p.metadata.namespace, p.metadata.name): p.spec.node_name
+                   for p in pods}
+        agg = {}
+        for m in metrics:
+            node = node_of.get((m.metadata.namespace, m.metadata.name), "")
+            if node:
+                cpu0, mem0 = agg.get(node, (0, 0))
+                cpu, mem = cpu_mem(m)
+                agg[node] = (cpu0 + cpu, mem0 + mem)
+        rows = []
+        for node in sorted(n.metadata.name for n in
+                           client.list("nodes", None)[0]):
+            cpu, mem = agg.get(node, (0, 0))
+            rows.append([node, str(cpu), str(mem // (1 << 20))])
+        table(rows)
+    else:
+        raise SystemExit("error: top supports pods or nodes")
+
+
 def cmd_explain(client, args, out):
     """explain.go against the dataclass model instead of OpenAPI: field
     names + types of the resource's Python type."""
@@ -487,6 +537,9 @@ def build_parser() -> argparse.ArgumentParser:
     xp = sub.add_parser("explain")
     xp.add_argument("kind")
 
+    tp = sub.add_parser("top")
+    tp.add_argument("kind")
+
     sub.add_parser("version")
     return ap
 
@@ -495,7 +548,7 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "apply": cmd_apply, "delete": cmd_delete, "scale": cmd_scale,
          "cordon": cmd_cordon, "uncordon": cmd_uncordon, "drain": cmd_drain,
          "label": cmd_label, "version": cmd_version, "rollout": cmd_rollout,
-         "expose": cmd_expose, "explain": cmd_explain}
+         "expose": cmd_expose, "explain": cmd_explain, "top": cmd_top}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

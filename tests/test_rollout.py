@@ -148,3 +148,27 @@ class TestExposeExplain:
         assert rc == 0 and "KIND: Pod" in out and "spec" in out
         rc, out = run(srv, "explain", "pods.spec.containers")
         assert rc == 0 and "image" in out and "resources" in out
+
+
+class TestTop:
+    def test_top_pods_and_nodes(self, world):
+        from kubernetes_tpu.api import resources as res
+
+        store, srv = world
+        c = RESTClient(srv.url)
+        store.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+        p = api.Pod(metadata=api.ObjectMeta(name="p1"),
+                    spec=api.PodSpec(node_name="n1",
+                                     containers=[api.Container()]))
+        store.create("pods", p)
+        store.create("podmetrics", api.PodMetrics(
+            metadata=api.ObjectMeta(name="p1"),
+            usage={res.CPU: 250, res.MEMORY: 64 << 20}))
+        rc, out = run(srv, "top", "pods")
+        assert rc == 0
+        row = next(l for l in out.splitlines() if l.startswith("p1"))
+        assert row.split() == ["p1", "250", "64"]
+        rc, out = run(srv, "top", "nodes")
+        assert rc == 0
+        row = next(l for l in out.splitlines() if l.startswith("n1"))
+        assert row.split() == ["n1", "250", "64"]
