@@ -126,6 +126,8 @@ register("HorizontalPodAutoscaler", "horizontalpodautoscalers",
 register("PodMetrics", "podmetrics", api.PodMetrics, "metrics.k8s.io/v1beta1")
 register("APIService", "apiservices", api.APIService,
          "apiregistration.k8s.io/v1", namespaced=False)
+register("PodSecurityPolicy", "podsecuritypolicies", api.PodSecurityPolicy,
+         "policy/v1beta1", namespaced=False)
 register("MutatingWebhookConfiguration", "mutatingwebhookconfigurations",
          api.MutatingWebhookConfiguration,
          "admissionregistration.k8s.io/v1beta1", namespaced=False)

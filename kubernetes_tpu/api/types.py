@@ -1000,6 +1000,26 @@ class ConfigMap:
 
 
 @dataclass
+class PodSecurityPolicySpec:
+    """policy/v1beta1 PodSecurityPolicySpec over this model's flattened
+    security surface (reference pkg/apis/policy/types.go:150)."""
+
+    privileged: bool = False  # allow privileged containers
+    # volume source kinds a pod may use; ["*"] allows all. Names follow
+    # the Volume fields: emptyDir, hostPath, configMap, secret,
+    # downwardAPI, nfs, persistentVolumeClaim, projected + PD kinds
+    volumes: List[str] = field(default_factory=lambda: ["*"])
+    allowed_host_paths: List[str] = field(default_factory=list)  # prefixes
+    host_ports: List[Tuple[int, int]] = field(default_factory=list)  # ranges
+
+
+@dataclass
+class PodSecurityPolicy:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSecurityPolicySpec = field(default_factory=PodSecurityPolicySpec)
+
+
+@dataclass
 class WebhookRule:
     """admissionregistration/v1beta1 RuleWithOperations (types.go:52)."""
 

@@ -436,6 +436,63 @@ class ExtendedResourceToleration(AdmissionPlugin):
                 key=res_name, operator=api.TOLERATION_OP_EXISTS))
 
 
+class PodSecurityPolicyAdmission(AdmissionPlugin):
+    """Validate pods against the registered PodSecurityPolicies: a pod
+    is admitted if ANY policy allows every aspect of it
+    (plugin/pkg/admission/security/podsecuritypolicy/admission.go:171;
+    the reference additionally filters policies by RBAC `use` authority,
+    which this model folds into policy existence)."""
+
+    name = "PodSecurityPolicy"
+
+    VOLUME_FIELDS = (
+        ("empty_dir", "emptyDir"), ("host_path", "hostPath"),
+        ("config_map", "configMap"), ("secret", "secret"),
+        ("downward_api", "downwardAPI"), ("nfs_server", "nfs"),
+        ("pvc_name", "persistentVolumeClaim"), ("projected", "projected"),
+        ("source_kind", None))  # PD-family kinds use the kind name itself
+
+    @classmethod
+    def _volume_kind(cls, v: api.Volume) -> str:
+        for attr, name in cls.VOLUME_FIELDS:
+            if getattr(v, attr):
+                return name if name is not None else v.source_kind
+        return "unknown"
+
+    def _allows(self, psp: api.PodSecurityPolicy, pod: api.Pod) -> bool:
+        spec = psp.spec
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            if c.privileged and not spec.privileged:
+                return False
+            for p in c.ports:
+                hp = getattr(p, "host_port", 0)
+                # default-DENY: a host port needs an explicit allowing
+                # range (ref PSP hostPorts semantics; unlike
+                # allowedHostPaths, where empty means unrestricted)
+                if hp and not any(lo <= hp <= hi
+                                  for lo, hi in spec.host_ports):
+                    return False
+        for v in pod.spec.volumes:
+            kind = self._volume_kind(v)
+            if "*" not in spec.volumes and kind not in spec.volumes:
+                return False
+            if kind == "hostPath" and spec.allowed_host_paths and not any(
+                    v.host_path.startswith(pref)
+                    for pref in spec.allowed_host_paths):
+                return False
+        return True
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind != "pods" or op != "create":
+            return
+        policies = store.list("podsecuritypolicies")
+        if not policies:
+            return  # no PSPs registered: admission is a no-op (ref same)
+        if not any(self._allows(psp, obj) for psp in policies):
+            raise AdmissionError(
+                "unable to validate against any pod security policy")
+
+
 class AdmissionChain:
     """Ordered plugin chain (admission/chain.go chainAdmissionHandler)."""
 

@@ -56,6 +56,106 @@ _VERBS = {"GET": "get", "POST": "create", "PUT": "update",
           "PATCH": "patch", "DELETE": "delete"}
 
 
+def _openapi_type(t) -> dict:
+    """Python type annotation -> OpenAPI v2 schema fragment."""
+    import dataclasses
+    import typing
+
+    origin = typing.get_origin(t)
+    if origin is list:
+        return {"type": "array",
+                "items": _openapi_type(typing.get_args(t)[0])}
+    if origin is dict:
+        return {"type": "object",
+                "additionalProperties": _openapi_type(typing.get_args(t)[1])}
+    if origin is typing.Union:  # Optional[X]
+        inner = [a for a in typing.get_args(t) if a is not type(None)]
+        return _openapi_type(inner[0]) if inner else {}
+    if t is str:
+        return {"type": "string"}
+    if t is int:
+        return {"type": "integer"}
+    if t is float:
+        return {"type": "number"}
+    if t is bool:
+        return {"type": "boolean"}
+    if dataclasses.is_dataclass(t):
+        return {"$ref": f"#/definitions/{t.__name__}"}
+    return {}
+
+
+_openapi_cache: Dict[frozenset, dict] = {}
+
+
+def _openapi_spec() -> dict:
+    """Swagger 2.0 document over every registered kind (definitions from
+    dataclass reflection; paths list the CRUD routes the REST mapper
+    serves). Cached per registered-kind set — the reflection walk is
+    dozens of types deep and kinds only change on CRD (de)registration."""
+    import dataclasses
+    import typing
+
+    cache_key = frozenset(scheme.all_kinds())
+    hit = _openapi_cache.get(cache_key)
+    if hit is not None:
+        return hit
+
+    definitions: Dict[str, dict] = {}
+
+    def add_def(t):
+        name = t.__name__
+        if name in definitions or not dataclasses.is_dataclass(t):
+            return
+        definitions[name] = {"type": "object", "properties": {}}
+        try:
+            hints = typing.get_type_hints(t)
+        except Exception:
+            hints = {f.name: f.type for f in dataclasses.fields(t)}
+        for f in dataclasses.fields(t):
+            ft = hints.get(f.name, f.type)
+            definitions[name]["properties"][f.name] = _openapi_type(ft)
+            for sub in _walk_types(ft):
+                add_def(sub)
+
+    def _walk_types(t):
+        origin = typing.get_origin(t)
+        if origin in (list, dict):
+            for a in typing.get_args(t):
+                yield from _walk_types(a)
+        elif origin is typing.Union:
+            for a in typing.get_args(t):
+                if a is not type(None):
+                    yield from _walk_types(a)
+        elif dataclasses.is_dataclass(t):
+            yield t
+        return
+
+    paths = {}
+    for kind in sorted(scheme.all_kinds()):
+        typ = scheme.type_for_kind(kind)
+        add_def(typ)
+        plural = scheme.plural_for_kind(kind)
+        gv = scheme.api_version_for(kind)
+        prefix = (f"/api/{gv}" if "/" not in gv else f"/apis/{gv}")
+        base = (f"{prefix}/namespaces/{{namespace}}/{plural}"
+                if scheme.is_namespaced(kind) else f"{prefix}/{plural}")
+        ref = {"$ref": f"#/definitions/{typ.__name__}"}
+        paths[base] = {"get": {"responses": {"200": {}}},
+                       "post": {"parameters": [{"in": "body",
+                                               "schema": ref}],
+                                "responses": {"201": {}}}}
+        paths[base + "/{name}"] = {
+            "get": {"responses": {"200": {"schema": ref}}},
+            "put": {"responses": {"200": {}}},
+            "delete": {"responses": {"200": {}}}}
+    spec = {"swagger": "2.0",
+            "info": {"title": "kubernetes_tpu", "version": "v1.11-tpu"},
+            "paths": paths, "definitions": definitions}
+    _openapi_cache.clear()  # one live entry: kind-set changes are rare
+    _openapi_cache[cache_key] = spec
+    return spec
+
+
 class APIServer:
     def __init__(self, store: ObjectStore,
                  authenticator: Optional[TokenAuthenticator] = None,
@@ -216,6 +316,11 @@ class APIServer:
                              if "/" in scheme.api_version_for(k)})
             return h._send(200, json.dumps({"kind": "APIGroupList",
                                             "groups": groups}).encode())
+        if parts == ["openapi", "v2"]:
+            # OpenAPI v2 spec generated from the dataclass model
+            # (apiserver's /openapi/v2, k8s.io/kube-openapi; consumed by
+            # kubectl explain/validation in the reference)
+            return h._send(200, json.dumps(_openapi_spec()).encode())
         # per-group resource discovery (endpoints/installer.go's
         # APIResourceList; what a RESTMapper consumes)
         gv = None

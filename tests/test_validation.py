@@ -167,6 +167,68 @@ class TestNewAdmissionPlugins:
             LimitPodHardAntiAffinityTopology().admit("create", "pods", pod,
                                                      None, None, None)
 
+    def test_pod_security_policy(self):
+        from kubernetes_tpu.server.admission import PodSecurityPolicyAdmission
+
+        store = ObjectStore()
+        plug = PodSecurityPolicyAdmission()
+        # no policies registered: no-op
+        plug.admit("create", "pods", okpod(), None, None, store)
+        store.create("podsecuritypolicies", api.PodSecurityPolicy(
+            metadata=api.ObjectMeta(name="restricted", namespace=""),
+            spec=api.PodSecurityPolicySpec(
+                privileged=False,
+                volumes=["emptyDir", "configMap", "hostPath"],
+                allowed_host_paths=["/var/log"])))
+        plug.admit("create", "pods", okpod(), None, None, store)
+        # privileged denied
+        priv = okpod()
+        priv.spec.containers[0].privileged = True
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "pods", priv, None, None, store)
+        # volume kind outside the whitelist denied
+        nfs = okpod(volumes=[api.Volume(name="n", nfs_server="fs")])
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "pods", nfs, None, None, store)
+        # hostPath outside the allowed prefixes denied; inside allowed
+        bad_hp = okpod(volumes=[api.Volume(name="h", host_path="/etc")])
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "pods", bad_hp, None, None, store)
+        ok_hp = okpod(volumes=[api.Volume(name="h",
+                                          host_path="/var/log/app")])
+        plug.admit("create", "pods", ok_hp, None, None, store)
+        # host ports are default-DENY: need an explicit allowing range
+        hp_pod = okpod()
+        hp_pod.spec.containers[0].ports = [
+            api.ContainerPort(container_port=80, host_port=80)]
+        with pytest.raises(AdmissionError):
+            plug.admit("create", "pods", hp_pod, None, None, store)
+        # a second, permissive policy rescues the privileged pod
+        store.create("podsecuritypolicies", api.PodSecurityPolicy(
+            metadata=api.ObjectMeta(name="privileged", namespace=""),
+            spec=api.PodSecurityPolicySpec(privileged=True,
+                                           host_ports=[(1, 65535)])))
+        plug.admit("create", "pods", priv, None, None, store)
+        plug.admit("create", "pods", hp_pod, None, None, store)
+
+    def test_openapi_v2(self):
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.server import AdmissionChain, APIServer
+
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            spec = RESTClient(srv.url).request("GET", "/openapi/v2")
+            assert spec["swagger"] == "2.0"
+            assert "Pod" in spec["definitions"]
+            props = spec["definitions"]["Pod"]["properties"]
+            assert props["spec"] == {"$ref": "#/definitions/PodSpec"}
+            assert "/api/v1/namespaces/{namespace}/pods" in spec["paths"]
+            assert ("/apis/apps/v1/namespaces/{namespace}/deployments"
+                    in spec["paths"])
+        finally:
+            srv.stop()
+
     def test_extended_resource_toleration(self):
         pod = api.Pod(metadata=api.ObjectMeta(name="p"), spec=api.PodSpec(
             containers=[api.Container(resources=api.ResourceRequirements(
