@@ -245,8 +245,13 @@ class Proxier:
         removed: Dict[ServicePortName, Set[str]] = {}
         for spn, old in old_rules.items():
             cur = new_rules.get(spn)
-            cur_ips = {e.ip for e in cur.endpoints} if cur else set()
-            gone = {e.ip for e in old.endpoints} - cur_ips
+            # only ready endpoints count on EITHER side: the reference
+            # EndpointsMap holds ss.Addresses only, so a ready->notReady
+            # transition is stale (proxier.go detectStaleConnections) and
+            # a stays-notReady endpoint is absent from both snapshots
+            cur_ips = ({e.ip for e in cur.endpoints if e.ready}
+                       if cur else set())
+            gone = {e.ip for e in old.endpoints if e.ready} - cur_ips
             if gone:
                 removed[spn] = gone
         stale = []

@@ -356,12 +356,28 @@ class APIServer:
                 res_ns = None
                 if rest and rest[0] == "namespaces" and len(rest) >= 3:
                     res_ns, rest = rest[1], rest[2:]
-                plural = rest[0] if rest else parts[1]
+                plural = rest[0] if rest else None
                 name = rest[1] if len(rest) > 1 else None
                 verb = _VERBS[h.command]
                 if verb == "get" and name is None:
                     verb = "list"
-                if self.authorizer is not None and user is not None:
+                if plural is None:
+                    # group-root (/apis/<group>/<version>) is a
+                    # nonResourceURL in the reference, not a resource named
+                    # after the group. GET discovery is granted to every
+                    # subject (the system:discovery bootstrap binding covers
+                    # authenticated AND unauthenticated in 1.11); any other
+                    # verb must still be authorized, against the path
+                    plural = "/" + "/".join(parts)
+                    if (verb not in ("get", "list")
+                            and self.authorizer is not None
+                            and user is not None
+                            and not self.authorizer.authorize(
+                                user, verb, plural)):
+                        raise APIError(403, "Forbidden",
+                                       f"user {user.name} cannot {verb} "
+                                       f"{plural}")
+                elif self.authorizer is not None and user is not None:
                     if not self.authorizer.authorize(user, verb, plural):
                         raise APIError(403, "Forbidden",
                                        f"user {user.name} cannot {verb} "
@@ -459,8 +475,13 @@ class APIServer:
         group, version = svc_ref.group, svc_ref.version
         ep = self.store.get("endpoints", svc_ref.service_namespace,
                             svc_ref.service_name)
-        backends = [(a.ip, (next((p.port for p in s.ports), None)
-                            or svc_ref.service_port))
+        # pick the subset port matching the APIService's service_port
+        # (handler_proxy.go resolves the named/numbered service port, not
+        # blindly the first one); fall back to the declared port itself
+        backends = [(a.ip, next((p.port for p in s.ports
+                                 if p.port == svc_ref.service_port),
+                                next((p.port for p in s.ports),
+                                     svc_ref.service_port)))
                     for s in (ep.subsets if ep else [])
                     for a in s.addresses]
         if not backends:

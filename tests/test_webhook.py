@@ -97,13 +97,29 @@ class TestWebhooks:
         store.create("mutatingwebhookconfigurations",
                      api.MutatingWebhookConfiguration(
                          metadata=api.ObjectMeta(name="mw", namespace=""),
-                         webhooks=[api.Webhook(name="inject.example.io",
-                                               url=url)]))
+                         webhooks=[api.Webhook(
+                             name="inject.example.io", url=url,
+                             rules=[api.WebhookRule(operations=["*"],
+                                                    resources=["*"])])]))
         pod = mkpod()
         MutatingAdmissionWebhook().admit("create", "pods", pod, None, None,
                                          store)
         assert pod.metadata.labels.get("injected") == "yes"
         srv.shutdown()
+
+    def test_ruleless_webhook_matches_nothing(self):
+        """A webhook registered with no rules intercepts nothing (the
+        reference requires non-empty rules; a wildcard default would let
+        a misregistered hook intercept every request)."""
+        store = ObjectStore()
+        dead = "http://127.0.0.1:9/admit"  # would raise if ever called
+        store.create("validatingwebhookconfigurations",
+                     api.ValidatingWebhookConfiguration(
+                         metadata=api.ObjectMeta(name="vw", namespace=""),
+                         webhooks=[api.Webhook(name="noop.e.io", url=dead,
+                                               failure_policy="Fail")]))
+        ValidatingAdmissionWebhook().admit("create", "pods", mkpod(), None,
+                                           None, store)
 
     def test_failure_policies(self):
         store = ObjectStore()
@@ -111,9 +127,11 @@ class TestWebhooks:
         store.create("validatingwebhookconfigurations",
                      api.ValidatingWebhookConfiguration(
                          metadata=api.ObjectMeta(name="vw", namespace=""),
-                         webhooks=[api.Webhook(name="soft.example.io",
-                                               url=dead, timeout_seconds=1,
-                                               failure_policy="Ignore")]))
+                         webhooks=[api.Webhook(
+                             name="soft.example.io", url=dead,
+                             timeout_seconds=1, failure_policy="Ignore",
+                             rules=[api.WebhookRule(operations=["*"],
+                                                    resources=["*"])])]))
         plug = ValidatingAdmissionWebhook()
         plug.admit("create", "pods", mkpod(), None, None, store)  # fail open
         cfg = store.list("validatingwebhookconfigurations")[0]
@@ -168,7 +186,10 @@ class TestWebhooks:
                              metadata=api.ObjectMeta(name="mw", namespace=""),
                              webhooks=[api.Webhook(
                                  name="broken.e.io", url=url,
-                                 failure_policy="Ignore")]))
+                                 failure_policy="Ignore",
+                                 rules=[api.WebhookRule(
+                                     operations=["*"],
+                                     resources=["*"])])]))
             pod = mkpod()
             # Ignore: broken webhook fails open, request survives
             MutatingAdmissionWebhook().admit("create", "pods", pod, None,
@@ -196,8 +217,11 @@ class TestWebhooks:
                           api.MutatingWebhookConfiguration(
                               metadata=api.ObjectMeta(name="mw",
                                                       namespace=""),
-                              webhooks=[api.Webhook(name="inject.e.io",
-                                                    url=mut_url)]))
+                              webhooks=[api.Webhook(
+                                  name="inject.e.io", url=mut_url,
+                                  rules=[api.WebhookRule(
+                                      operations=["create"],
+                                      resources=["pods"])])]))
             created = client.create("pods", mkpod("webhooked"))
             assert created.metadata.labels.get("injected") == "yes"
         finally:
