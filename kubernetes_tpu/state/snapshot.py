@@ -331,6 +331,14 @@ class Snapshot:
         self.ep_alive[slot] = (active
                                and pod.metadata.deletion_timestamp is None)
 
+    def _row_sig(self, pod: api.Pod, node_idx):
+        """Row-content signature for bind-echo/staged-row detection.
+        node_idx is an int placement or the sentinel "staged"; both the
+        staging and commit sites MUST build sigs through this helper or
+        the staged fast path silently stops matching."""
+        return (node_idx, pod.metadata.deletion_timestamp is None,
+                tuple(sorted((pod.metadata.labels or {}).items())))
+
     def add_pod(self, pod: api.Pod):
         """Add/refresh a scheduled pod's row in the PodMatrix."""
         node_idx = self.node_index.get(pod.spec.node_name)
@@ -341,9 +349,24 @@ class Snapshot:
         # (and term rows — pod affinity is spec-immutable in the API) is
         # already exact; skipping avoids rewriting every row twice per
         # bind and re-marking the device mirror dirty
-        sig = (node_idx, pod.metadata.deletion_timestamp is None,
-               tuple(sorted((pod.metadata.labels or {}).items())))
-        if self._pod_sig.get(pod.uid) == sig:
+        sig = self._row_sig(pod, node_idx)
+        prev = self._pod_sig.get(pod.uid)
+        if prev == sig:
+            return
+        if prev == self._row_sig(pod, "staged"):
+            # pipeline-staged row being activated at commit: labels and
+            # term programs were already written at stage time (affinity
+            # is spec-immutable), only placement/validity change — skip
+            # re-interning labels and recompiling term selectors
+            slot = self.pod_slot[pod.uid]
+            self.ep_node[slot] = node_idx
+            self.ep_valid[slot] = True
+            self.ep_alive[slot] = sig[1]
+            for row in self.term_rows.get(pod.uid, ()):
+                self.t_node[row] = node_idx
+                self.t_valid[row] = True
+            self._pod_sig[pod.uid] = sig
+            self.dirty_pods = True
             return
         slot = self._alloc_slot(pod.uid)
         self._write_pod_row(pod, slot, node_idx, active=True)
@@ -373,6 +396,9 @@ class Snapshot:
             pm_rows[i] = slot
             self._set_pod_terms(pod, slot, node_idx=0, active=False)
             per_pod_terms.append(list(self.term_rows.get(pod.uid, ())))
+            # mark the row as staged so the commit-time add_pod can take
+            # the fast activate path instead of rewriting it
+            self._pod_sig[pod.uid] = self._row_sig(pod, "staged")
         tpp = max([len(t) for t in per_pod_terms] + [1])
         term_rows = np.full((max(n, 1), tpp), -1, np.int32)
         for i, rows in enumerate(per_pod_terms):

@@ -76,7 +76,9 @@ class TestBenchWorkloads:
 
     def test_bench_small_end_to_end(self):
         import bench
-        placed, dt, p99, path = bench.run_config(nodes=8, pods=24, wave=16,
-                                                 workload="mixed", warmup=4)
+        placed, dt, p99, p99_round, path = bench.run_config(
+            nodes=8, pods=24, wave=16, workload="mixed", warmup=4)
         assert path in ("pallas", "xla")
         assert placed == 24
+        import math
+        assert math.isfinite(p99) and math.isfinite(p99_round)
