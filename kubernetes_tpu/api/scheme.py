@@ -9,10 +9,12 @@ conventions — camelCase field names, top-level ``kind``/``apiVersion``
 tags — so objects round-trip through the HTTP apiserver, kubectl, and
 YAML manifests.
 
-Unlike the reference there is no internal/external version split: the
-dataclasses are both the internal types and the wire schema (resource
-quantities stay canonical int64s — milli-CPU, bytes — as in
-schedulercache's Resource, node_info.go:131).
+The dataclasses are the HUB schema — simultaneously the internal types
+and the storage wire schema (resource quantities stay canonical int64s —
+milli-CPU, bytes — as in schedulercache's Resource, node_info.go:131).
+Additional served versions convert to/from the hub at the wire level
+(api/conversion.py, the converter.go:40 analog): encode_object(obj,
+version=...) emits any served version, decode_request() accepts any.
 """
 
 from __future__ import annotations
@@ -22,6 +24,7 @@ import json
 from typing import (Any, Dict, List, Mapping, Optional, Tuple, Union,
                     get_args, get_origin, get_type_hints)
 
+from . import conversion
 from . import labels as lbl
 from . import types as api
 
@@ -81,6 +84,15 @@ def register_dynamic(crd: "api.CustomResourceDefinition",
     register(names.kind, names.plural, api.CustomObject,
              f"{crd.spec.group}/{crd.spec.version}",
              namespaced=crd.spec.scope == "Namespaced")
+    # multi-version serving (apiextensions 1.11 spec.versions): every
+    # listed version is served; non-storage versions convert by tag
+    # rewrite only (CRDs have no conversion webhooks in 1.11 — all
+    # versions share the schema, customresource_handler.go). Replaced
+    # as one atomic swap so a concurrent list/watch at an extra version
+    # never observes the kind momentarily unserved.
+    conversion.set_versions(names.kind, {
+        f"{crd.spec.group}/{v}": (None, None)
+        for v in (crd.spec.versions or ()) if v != crd.spec.version})
 
 
 def unregister(kind: str):
@@ -91,6 +103,7 @@ def unregister(kind: str):
         return
     del _REGISTRY[kind]
     _BY_PLURAL.pop(entry[0], None)
+    conversion.unregister_kind(kind)
     if _BY_TYPE.get(entry[1]) == kind:
         _BY_TYPE.pop(entry[1], None)
 
@@ -141,6 +154,7 @@ register("CertificateSigningRequest", "certificatesigningrequests",
 register("CustomResourceDefinition", "customresourcedefinitions",
          api.CustomResourceDefinition, "apiextensions.k8s.io/v1beta1",
          namespaced=False)
+conversion.install_defaults()
 
 
 def kind_for_plural(plural: str) -> Optional[str]:
@@ -169,6 +183,35 @@ def is_namespaced(kind: str) -> bool:
 
 def all_kinds() -> List[str]:
     return list(_REGISTRY)
+
+
+# -- multi-version serving -----------------------------------------------------
+
+
+def served_versions(kind: str) -> List[str]:
+    """Every apiVersion this kind is served at, hub (storage) first."""
+    return [api_version_for(kind)] + conversion.extra_versions(kind)
+
+
+def serves(kind: str, gv: str) -> bool:
+    return conversion.serves(kind, gv, api_version_for(kind))
+
+
+def convert_wire(kind: str, data: Dict[str, Any], to_version: str
+                 ) -> Dict[str, Any]:
+    """Hub wire dict -> `to_version` wire dict."""
+    return conversion.from_hub(kind, data, to_version, api_version_for(kind))
+
+
+def decode_request(kind: str, data: Mapping):
+    """Wire dict at ANY served version -> hub object. The body's
+    apiVersion tag picks the conversion; absent or hub-tagged bodies
+    decode directly."""
+    ver = data.get("apiVersion")
+    hub = api_version_for(kind)
+    if ver and ver != hub:
+        data = conversion.to_hub(kind, dict(data), ver, hub)
+    return decode(kind, data)
 
 
 # -- field-name conversion -----------------------------------------------------
@@ -219,16 +262,22 @@ def encode(value) -> Any:
     return value
 
 
-def encode_object(obj) -> Dict[str, Any]:
+def encode_object(obj, version: Optional[str] = None) -> Dict[str, Any]:
     """Top-level object -> dict with kind/apiVersion tags. Custom
-    objects carry their own tags (all CRD kinds share one Python type)."""
+    objects carry their own tags (all CRD kinds share one Python type).
+    version requests a specific served version; the hub wire form is
+    converted through api/conversion.py."""
     kind = getattr(obj, "kind", None) or kind_of(obj)
     if kind and kind in _REGISTRY:
-        version = api_version_for(kind)
+        hub = api_version_for(kind)
     else:
-        version = getattr(obj, "api_version", None) or "v1"
-    out = {"kind": kind, "apiVersion": version}
+        hub = getattr(obj, "api_version", None) or "v1"
+    out = {"kind": kind, "apiVersion": hub}
     out.update(encode(obj))
+    if version is not None and version != hub and kind:
+        # owned=True: `out` was built fresh above, the converter may
+        # mutate it instead of deep-copying every list/watch item
+        out = conversion.from_hub(kind, out, version, hub, owned=True)
     return out
 
 

@@ -729,7 +729,10 @@ class CustomResourceNames:
 @dataclass
 class CustomResourceDefinitionSpec:
     group: str = ""
-    version: str = "v1"
+    version: str = "v1"  # the storage version
+    # additional served versions (apiextensions v1beta1 spec.versions,
+    # added in the 1.11 cycle); all share one schema, tag-only conversion
+    versions: List[str] = field(default_factory=list)
     scope: str = "Namespaced"  # or "Cluster"
     names: CustomResourceNames = field(default_factory=CustomResourceNames)
 

@@ -311,9 +311,10 @@ class APIServer:
             return h._send(200, json.dumps({"kind": "APIVersions",
                                             "versions": ["v1"]}).encode())
         if parts == ["apis"]:
-            groups = sorted({scheme.api_version_for(k).split("/")[0]
+            groups = sorted({gv.split("/")[0]
                              for k in scheme.all_kinds()
-                             if "/" in scheme.api_version_for(k)})
+                             for gv in scheme.served_versions(k)
+                             if "/" in gv})
             return h._send(200, json.dumps({"kind": "APIGroupList",
                                             "groups": groups}).encode())
         if parts == ["openapi", "v2"]:
@@ -333,7 +334,7 @@ class APIServer:
                 {"name": scheme.plural_for_kind(k), "kind": k,
                  "namespaced": scheme.is_namespaced(k)}
                 for k in sorted(scheme.all_kinds())
-                if scheme.api_version_for(k) == gv]
+                if scheme.serves(k, gv)]
             if resources:
                 return h._send(200, json.dumps(
                     {"kind": "APIResourceList", "groupVersion": gv,
@@ -394,7 +395,7 @@ class APIServer:
                     if sem is not None:
                         sem.release()
             raise APIError(404, "NotFound", f"path {parsed.path!r} not found")
-        plural, namespace, name, sub = route
+        plural, namespace, name, sub, gv = route
         verb = _VERBS[h.command]
         if verb == "get" and query.get("watch", ["false"])[0] == "true":
             verb = "watch"
@@ -415,13 +416,13 @@ class APIServer:
                            "server request limit reached, retry later")
         try:
             return self._serve_authorized(h, query, user, plural, namespace,
-                                          name, sub, verb)
+                                          name, sub, verb, gv)
         finally:
             if sem is not None:
                 sem.release()
 
     def _serve_authorized(self, h, query, user, plural, namespace, name,
-                          sub, verb):
+                          sub, verb, gv=None):
 
         # authz (filters/authorization.go)
         if self.authorizer is not None and user is not None:
@@ -436,20 +437,20 @@ class APIServer:
         self._audit(user, verb, plural, namespace, name)
 
         if verb == "watch":
-            return self._serve_watch(h, plural, query)
+            return self._serve_watch(h, plural, query, gv)
         if verb == "list":
-            return self._serve_list(h, plural, namespace, query)
+            return self._serve_list(h, plural, namespace, query, gv)
         if verb == "get":
-            return self._serve_get(h, plural, namespace, name)
+            return self._serve_get(h, plural, namespace, name, gv)
         if verb == "create":
             if sub == "binding":
                 return self._serve_binding(h, namespace, name)
             if sub == "eviction":
                 return self._serve_eviction(h, user, namespace, name)
-            return self._serve_create(h, plural, namespace, user)
+            return self._serve_create(h, plural, namespace, user, gv)
         if verb in ("update", "patch"):
             return self._serve_update(h, plural, namespace, name, sub, user,
-                                      patch=(verb == "patch"))
+                                      patch=(verb == "patch"), gv=gv)
         if verb == "delete":
             return self._serve_delete(h, plural, namespace, name, user)
         raise APIError(405, "MethodNotAllowed", f"{h.command} unsupported")
@@ -515,31 +516,30 @@ class APIServer:
 
     # -- routing ---------------------------------------------------------------
 
-    def _route(self, parts: List[str]
-               ) -> Optional[Tuple[str, Optional[str], Optional[str], Optional[str]]]:
-        """path segments -> (plural, namespace, name, subresource)."""
+    def _route(self, parts: List[str]):
+        """path segments -> (plural, namespace, name, subresource,
+        requested groupVersion). A plural addressed under a groupVersion
+        its kind is not served at does not route (404 — the reference's
+        installer only registers served versions)."""
         if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
-            rest = parts[2:]
+            rest, gv = parts[2:], "v1"
         elif len(parts) >= 3 and parts[0] == "apis":
-            rest = parts[3:]
+            rest, gv = parts[3:], f"{parts[1]}/{parts[2]}"
         else:
             return None
         if not rest:
             return None
         if rest[0] == "namespaces" and len(rest) >= 3:
             ns, rest2 = rest[1], rest[2:]
-            plural = rest2[0]
-            if scheme.kind_for_plural(plural) is None:
-                return None
-            name = rest2[1] if len(rest2) > 1 else None
-            sub = rest2[2] if len(rest2) > 2 else None
-            return plural, ns, name, sub
-        plural = rest[0]
-        if scheme.kind_for_plural(plural) is None:
+        else:
+            ns, rest2 = None, rest
+        plural = rest2[0]
+        kind = scheme.kind_for_plural(plural)
+        if kind is None or not scheme.serves(kind, gv):
             return None
-        name = rest[1] if len(rest) > 1 else None
-        sub = rest[2] if len(rest) > 2 else None
-        return plural, None, name, sub
+        name = rest2[1] if len(rest2) > 1 else None
+        sub = rest2[2] if len(rest2) > 2 else None
+        return plural, ns, name, sub, gv
 
     def _find(self, plural: str, namespace: Optional[str], name: str):
         kind = scheme.kind_for_plural(plural)
@@ -557,7 +557,7 @@ class APIServer:
 
     # -- verbs -----------------------------------------------------------------
 
-    def _serve_list(self, h, plural, namespace, query):
+    def _serve_list(self, h, plural, namespace, query, gv=None):
         objs = self.store.list(plural, namespace)
         sel = query.get("labelSelector", [None])[0]
         if sel:
@@ -586,7 +586,7 @@ class APIServer:
                     raise APIError(400, "BadRequest",
                                    f"unsupported fieldSelector {k!r}")
         kind = scheme.kind_for_plural(plural)
-        if self._wants_binary(h):
+        if self._wants_binary(h) and self._binary_ok(kind, gv):
             from ..api import binary
 
             h._send(200, binary.dumps_list(
@@ -594,9 +594,11 @@ class APIServer:
                 content_type=binary.CONTENT_TYPE)
             return
         body = json.dumps({
-            "kind": kind + "List", "apiVersion": scheme.api_version_for(kind),
+            "kind": kind + "List",
+            "apiVersion": gv or scheme.api_version_for(kind),
             "metadata": {"resourceVersion": str(self.store.latest_resource_version)},
-            "items": [scheme.encode_object(o) for o in objs]}).encode()
+            "items": [scheme.encode_object(o, version=gv)
+                      for o in objs]}).encode()
         h._send(200, body)
 
     @staticmethod
@@ -607,16 +609,24 @@ class APIServer:
 
         return binary.CONTENT_TYPE in (h.headers.get("Accept") or "")
 
-    def _serve_get(self, h, plural, namespace, name):
+    @staticmethod
+    def _binary_ok(kind, gv) -> bool:
+        """The binary codec writes hub-form objects only; a request at a
+        converted version must get JSON (silently serving hub-tagged
+        bytes would flip the served version on the Accept header)."""
+        return gv is None or gv == scheme.api_version_for(kind)
+
+    def _serve_get(self, h, plural, namespace, name, gv=None):
         obj = self._find(plural, namespace, name)
         if obj is None:
             raise APIError(404, "NotFound", f"{plural} {name!r} not found")
-        if self._wants_binary(h):
+        if self._wants_binary(h) and \
+                self._binary_ok(scheme.kind_for_plural(plural), gv):
             from ..api import binary
 
             h._send(200, binary.dumps(obj), content_type=binary.CONTENT_TYPE)
             return
-        h._send(200, scheme.to_json(obj).encode())
+        h._send(200, json.dumps(scheme.encode_object(obj, version=gv)).encode())
 
     def _read_body(self, h) -> dict:
         length = int(h.headers.get("Content-Length", 0))
@@ -626,12 +636,17 @@ class APIServer:
         except json.JSONDecodeError as e:
             raise APIError(400, "BadRequest", f"invalid JSON: {e}")
 
-    def _serve_create(self, h, plural, namespace, user):
+    def _serve_create(self, h, plural, namespace, user, gv=None):
         kind = scheme.kind_for_plural(plural)
         data = self._read_body(h)
         data.setdefault("kind", kind)
+        if gv is not None:
+            # the path's groupVersion governs decoding; an untagged body
+            # posted to a versioned path is that version (create.go
+            # decodes with the request-scope kind)
+            data.setdefault("apiVersion", gv)
         try:
-            obj = scheme.decode(kind, data)
+            obj = scheme.decode_request(kind, data)
         except Exception as e:
             raise APIError(400, "BadRequest", f"cannot decode {kind}: {e}")
         if namespace is not None and scheme.is_namespaced(kind):
@@ -664,16 +679,32 @@ class APIServer:
             # register_dynamic is idempotent so the informer's later
             # delivery is harmless
             scheme.register_dynamic(obj)
-        h._send(201, scheme.to_json(obj).encode())
+        h._send(201, json.dumps(scheme.encode_object(obj, version=gv)).encode())
 
-    def _serve_update(self, h, plural, namespace, name, sub, user, patch):
+    def _serve_update(self, h, plural, namespace, name, sub, user, patch,
+                      gv=None):
         kind = scheme.kind_for_plural(plural)
         old = self._find(plural, namespace, name)
         if old is None:
             raise APIError(404, "NotFound", f"{plural} {name!r} not found")
         data = self._read_body(h)
+        if gv is not None and not patch and sub in ("status", "finalize"):
+            # subresource graft happens in HUB form below; a body sent at
+            # a non-hub version must convert first or version-specific
+            # fields would silently vanish into unknown hub keys
+            kind_hub = scheme.api_version_for(kind)
+            if gv != kind_hub:
+                from ..api import conversion as _conv
+
+                if not ({"status", "spec", "kind"} & set(data)):
+                    data = {"status": data}  # bare-status body
+                data.setdefault("apiVersion", gv)
+                data = _conv.to_hub(kind, data, gv, kind_hub)
         if patch:
-            merged = scheme.encode_object(old)
+            # the patch applies against the object AS SERVED at the
+            # request's version (patch.go works on versioned bytes), and
+            # the merged result converts back through the hub
+            merged = scheme.encode_object(old, version=gv)
             _merge_patch(merged, data)
             data = merged
         elif sub == "status":
@@ -687,8 +718,10 @@ class APIServer:
             if "spec" in data:
                 full["spec"] = data["spec"]
             data = full
+        if gv is not None:
+            data.setdefault("apiVersion", gv)
         try:
-            obj = scheme.decode(kind, data)
+            obj = scheme.decode_request(kind, data)
         except Exception as e:
             raise APIError(400, "BadRequest", f"cannot decode {kind}: {e}")
         # optimistic concurrency: a nonzero stale resourceVersion is a 409
@@ -732,7 +765,7 @@ class APIServer:
             if obj.spec.names.kind != old.spec.names.kind:
                 scheme.unregister(old.spec.names.kind)
             scheme.register_dynamic(obj, replacing=old.spec.names.kind)
-        h._send(200, scheme.to_json(obj).encode())
+        h._send(200, json.dumps(scheme.encode_object(obj, version=gv)).encode())
 
     def _serve_delete(self, h, plural, namespace, name, user):
         obj = self._find(plural, namespace, name)
@@ -784,7 +817,7 @@ class APIServer:
 
     # -- watch -----------------------------------------------------------------
 
-    def _serve_watch(self, h, plural, query):
+    def _serve_watch(self, h, plural, query, gv=None):
         rv = query.get("resourceVersion", [None])[0]
         since = int(rv) if rv not in (None, "", "0") else None
         timeout = float(query.get("timeoutSeconds", ["30"])[0])
@@ -811,7 +844,8 @@ class APIServer:
             h.end_headers()
             for obj in initial:
                 line = (json.dumps(
-                    {"type": "ADDED", "object": scheme.encode_object(obj)})
+                    {"type": "ADDED",
+                     "object": scheme.encode_object(obj, version=gv)})
                     + "\n").encode()
                 h.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
             if initial:
@@ -827,7 +861,8 @@ class APIServer:
                         break
                     continue
                 line = (json.dumps(
-                    {"type": ev.type, "object": scheme.encode_object(ev.obj)})
+                    {"type": ev.type,
+                     "object": scheme.encode_object(ev.obj, version=gv)})
                     + "\n").encode()
                 h.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
                 h.wfile.flush()

@@ -1,0 +1,203 @@
+"""Multi-version API serving + conversion (api/conversion.py).
+
+Reference behavior being reproduced: the same stored object is readable
+at every served version, with field-level conversion through the hub
+(apimachinery pkg/conversion/converter.go:40; pkg/apis/apps/v1beta1/,
+pkg/apis/autoscaling/v1/conversion.go), and CRDs can serve multiple
+versions of one schema (apiextensions spec.versions, 1.11)."""
+
+import json
+
+from kubernetes_tpu.api import conversion, scheme
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server import APIServer
+
+
+def mkdeploy(name="d"):
+    return api.Deployment(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.DeploymentSpec(
+            replicas=2,
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels={"app": name}),
+                spec=api.PodSpec(containers=[api.Container(name="c")]))))
+
+
+class TestWireConversion:
+    def test_served_versions(self):
+        assert scheme.served_versions("Deployment") == \
+            ["apps/v1", "apps/v1beta1"]
+        assert scheme.serves("HorizontalPodAutoscaler", "autoscaling/v2beta1")
+        assert not scheme.serves("Pod", "apps/v1")
+
+    def test_deployment_v1beta1_round_trip(self):
+        d = mkdeploy()
+        d.metadata.annotations[conversion.ROLLBACK_ANNOTATION] = "3"
+        wire = scheme.encode_object(d, version="apps/v1beta1")
+        assert wire["apiVersion"] == "apps/v1beta1"
+        assert wire["spec"]["rollbackTo"] == {"revision": 3}
+        # and back: rollbackTo returns to the annotation, selector
+        # defaults from template labels (v1beta1 defaulting)
+        wire["spec"].pop("selector", None)
+        back = scheme.decode_request("Deployment", wire)
+        assert back.metadata.annotations[conversion.ROLLBACK_ANNOTATION] == "3"
+        assert back.spec.selector.match_labels == {"app": "d"}
+
+    def test_hpa_v2beta1_metrics_mapping(self):
+        hpa = api.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name="h"),
+            spec=api.HorizontalPodAutoscalerSpec(
+                target_cpu_utilization_percentage=70))
+        wire = scheme.encode_object(hpa, version="autoscaling/v2beta1")
+        assert wire["spec"]["metrics"] == [{
+            "type": "Resource",
+            "resource": {"name": "cpu", "targetAverageUtilization": 70}}]
+        assert "targetCPUUtilizationPercentage" not in wire["spec"]
+        back = scheme.decode_request("HorizontalPodAutoscaler", wire)
+        assert back.spec.target_cpu_utilization_percentage == 70
+
+    def test_hpa_non_cpu_metrics_preserved(self):
+        """Metrics the v1 hub can't express survive round trips through
+        the alpha annotation (pkg/apis/autoscaling/v1/conversion.go:37),
+        and no fabricated cpu metric appears on the way back out."""
+        wire = {
+            "kind": "HorizontalPodAutoscaler",
+            "apiVersion": "autoscaling/v2beta1",
+            "metadata": {"name": "h"},
+            "spec": {"maxReplicas": 4, "metrics": [
+                {"type": "Resource",
+                 "resource": {"name": "memory",
+                              "targetAverageUtilization": 60}}]}}
+        hub = conversion.to_hub("HorizontalPodAutoscaler", wire,
+                                "autoscaling/v2beta1", "autoscaling/v1")
+        assert conversion.METRICS_ANNOTATION in hub["metadata"]["annotations"]
+        assert "targetCpuUtilizationPercentage" not in hub["spec"]
+        back = conversion.from_hub("HorizontalPodAutoscaler", hub,
+                                   "autoscaling/v2beta1", "autoscaling/v1")
+        mem = [m for m in back["spec"]["metrics"]
+               if m["resource"]["name"] == "memory"]
+        assert mem and mem[0]["resource"]["targetAverageUtilization"] == 60
+        assert conversion.METRICS_ANNOTATION not in \
+            back["metadata"]["annotations"]
+
+    def test_hpa_status_current_metrics(self):
+        hpa = api.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name="h"),
+            status=api.HorizontalPodAutoscalerStatus(
+                current_cpu_utilization_percentage=42))
+        wire = scheme.encode_object(hpa, version="autoscaling/v2beta1")
+        assert wire["status"]["currentMetrics"][0]["resource"][
+            "currentAverageUtilization"] == 42
+        back = scheme.decode_request("HorizontalPodAutoscaler", wire)
+        assert back.status.current_cpu_utilization_percentage == 42
+
+    def test_tag_only_version(self):
+        cj = api.CronJob(metadata=api.ObjectMeta(name="c"))
+        wire = scheme.encode_object(cj, version="batch/v2alpha1")
+        assert wire["apiVersion"] == "batch/v2alpha1"
+        assert scheme.decode_request(
+            "CronJob", wire).metadata.name == "c"
+
+
+class TestServedThroughAPIServer:
+    def setup_method(self):
+        self.store = ObjectStore()
+        self.srv = APIServer(self.store).start()
+        self.client = RESTClient(self.srv.url)
+
+    def teardown_method(self):
+        self.srv.stop()
+
+    def _get(self, path):
+        body, _ = self.client.request_bytes("GET", path)
+        return json.loads(body)
+
+    def test_create_old_version_read_both(self):
+        """A client posts apps/v1beta1 (no selector, rollbackTo set);
+        another reads apps/v1 and sees the converted hub object."""
+        body = {
+            "kind": "Deployment",
+            "metadata": {"name": "web"},
+            "spec": {"replicas": 2,
+                     "rollbackTo": {"revision": 5},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}}
+        resp, _ = self.client.request_bytes(
+            "POST", "/apis/apps/v1beta1/namespaces/default/deployments",
+            body=body)
+        created = json.loads(resp)
+        assert created["apiVersion"] == "apps/v1beta1"
+        assert created["spec"]["rollbackTo"] == {"revision": 5}
+
+        at_v1 = self._get("/apis/apps/v1/namespaces/default/deployments/web")
+        assert at_v1["apiVersion"] == "apps/v1"
+        assert "rollbackTo" not in at_v1["spec"]
+        assert at_v1["metadata"]["annotations"][
+            conversion.ROLLBACK_ANNOTATION] == "5"
+        assert at_v1["spec"]["selector"]["matchLabels"] == {"app": "web"}
+
+        back = self._get(
+            "/apis/apps/v1beta1/namespaces/default/deployments/web")
+        assert back["apiVersion"] == "apps/v1beta1"
+        assert back["spec"]["rollbackTo"] == {"revision": 5}
+
+    def test_stored_hub_object_served_converted(self):
+        """An object stored at the hub version is served converted at the
+        old version — the API-evolution contract."""
+        self.store.create("horizontalpodautoscalers", api.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name="h"),
+            spec=api.HorizontalPodAutoscalerSpec(
+                target_cpu_utilization_percentage=55)))
+        old = self._get("/apis/autoscaling/v2beta1/namespaces/default/"
+                        "horizontalpodautoscalers/h")
+        assert old["spec"]["metrics"][0]["resource"][
+            "targetAverageUtilization"] == 55
+        lst = self._get(
+            "/apis/autoscaling/v2beta1/namespaces/default/"
+            "horizontalpodautoscalers")
+        assert lst["apiVersion"] == "autoscaling/v2beta1"
+        assert lst["items"][0]["spec"]["metrics"]
+
+    def test_unserved_version_404(self):
+        try:
+            self._get("/apis/apps/v9/namespaces/default/deployments")
+            raise AssertionError("expected 404")
+        except APIStatusError as e:
+            assert e.code == 404
+
+    def test_discovery_lists_both_versions(self):
+        v1 = self._get("/apis/apps/v1")
+        v1b1 = self._get("/apis/apps/v1beta1")
+        names = {r["name"] for r in v1b1["resources"]}
+        assert "deployments" in names
+        assert {r["name"] for r in v1["resources"]} >= names
+        groups = self._get("/apis")["groups"]
+        assert "autoscaling" in groups
+
+    def test_crd_multi_version(self):
+        crd = api.CustomResourceDefinition(
+            metadata=api.ObjectMeta(name="widgets.example.io", namespace=""),
+            spec=api.CustomResourceDefinitionSpec(
+                group="example.io", version="v1",
+                versions=["v1", "v1alpha1"],
+                names=api.CustomResourceNames(kind="Widget",
+                                              plural="widgets")))
+        self.client.create("customresourcedefinitions", crd)
+        resp, _ = self.client.request_bytes(
+            "POST", "/apis/example.io/v1alpha1/namespaces/default/widgets",
+            body={"kind": "Widget", "metadata": {"name": "w1"},
+                  "spec": {"size": 3}})
+        created = json.loads(resp)
+        assert created["apiVersion"] == "example.io/v1alpha1"
+        stored = self._get(
+            "/apis/example.io/v1/namespaces/default/widgets/w1")
+        assert stored["apiVersion"] == "example.io/v1"
+        assert stored["spec"]["size"] == 3
+        old = self._get(
+            "/apis/example.io/v1alpha1/namespaces/default/widgets/w1")
+        assert old["apiVersion"] == "example.io/v1alpha1"
+        # cleanup: unregister the dynamic kind for other tests
+        self.client.delete("customresourcedefinitions", "",
+                           "widgets.example.io")
