@@ -133,7 +133,10 @@ def _deployment_v1beta1_to_hub(data):
 
 def _deployment_v1beta1_from_hub(data):
     ann = ((data.get("metadata") or {}).get("annotations") or {})
-    rev = ann.get(ROLLBACK_ANNOTATION)
+    # pop, not get: the annotation IS the v1beta1 field in hub form —
+    # leaving it behind would resurrect a rollbackTo the client deleted
+    # on the next round trip
+    rev = ann.pop(ROLLBACK_ANNOTATION, None)
     if rev is not None:
         spec = data.setdefault("spec", {})
         try:

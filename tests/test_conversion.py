@@ -58,6 +58,20 @@ class TestWireConversion:
         back = scheme.decode_request("HorizontalPodAutoscaler", wire)
         assert back.spec.target_cpu_utilization_percentage == 70
 
+    def test_rollback_cleared_by_v1beta1_client(self):
+        """A v1beta1 client removing spec.rollbackTo must actually clear
+        it — the annotation is popped on the way out so it cannot
+        resurrect the field on the next round trip."""
+        d = mkdeploy()
+        d.metadata.annotations[conversion.ROLLBACK_ANNOTATION] = "5"
+        wire = scheme.encode_object(d, version="apps/v1beta1")
+        assert wire["spec"]["rollbackTo"] == {"revision": 5}
+        assert conversion.ROLLBACK_ANNOTATION not in \
+            wire["metadata"].get("annotations", {})
+        wire["spec"].pop("rollbackTo")
+        back = scheme.decode_request("Deployment", wire)
+        assert conversion.ROLLBACK_ANNOTATION not in back.metadata.annotations
+
     def test_hpa_non_cpu_metrics_preserved(self):
         """Metrics the v1 hub can't express survive round trips through
         the alpha annotation (pkg/apis/autoscaling/v1/conversion.go:37),
