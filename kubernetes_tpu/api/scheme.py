@@ -151,6 +151,13 @@ register("LimitRange", "limitranges", api.LimitRange)
 register("CertificateSigningRequest", "certificatesigningrequests",
          api.CertificateSigningRequest, "certificates.k8s.io/v1beta1",
          namespaced=False)
+register("Role", "roles", api.Role, "rbac.authorization.k8s.io/v1")
+register("ClusterRole", "clusterroles", api.ClusterRole,
+         "rbac.authorization.k8s.io/v1", namespaced=False)
+register("RoleBinding", "rolebindings", api.RoleBinding,
+         "rbac.authorization.k8s.io/v1")
+register("ClusterRoleBinding", "clusterrolebindings", api.ClusterRoleBinding,
+         "rbac.authorization.k8s.io/v1", namespaced=False)
 register("CustomResourceDefinition", "customresourcedefinitions",
          api.CustomResourceDefinition, "apiextensions.k8s.io/v1beta1",
          namespaced=False)

@@ -343,6 +343,9 @@ class NodeStatus:
     # VolumesInUse; maintained by controllers/attachdetach.py)
     volumes_attached: List[str] = field(default_factory=list)
     volumes_in_use: List[str] = field(default_factory=list)
+    # NodeDaemonEndpoints.KubeletEndpoint.Port (core/v1 types.go): where
+    # this node's kubelet serves logs/exec; 0 = no server
+    kubelet_port: int = 0
 
 
 @dataclass
@@ -1105,6 +1108,72 @@ class PriorityClass:
     value: int = 0
     global_default: bool = False
     description: str = ""
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+
+# --- RBAC (rbac.authorization.k8s.io/v1) -------------------------------------
+# Reference: staging/src/k8s.io/api/rbac/v1/types.go; evaluated per
+# request by plugin/pkg/auth/authorizer/rbac/rbac.go:74.
+
+
+@dataclass
+class RBACPolicyRule:
+    """rbac/v1 PolicyRule: verbs x apiGroups x resources, optionally
+    narrowed to resourceNames; OR nonResourceURLs for path requests."""
+
+    verbs: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    non_resource_urls: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RBACSubject:
+    kind: str = "User"  # User | Group | ServiceAccount
+    name: str = ""
+    namespace: str = ""  # ServiceAccount subjects only
+
+
+@dataclass
+class RoleRef:
+    kind: str = "ClusterRole"  # Role | ClusterRole
+    name: str = ""
+
+
+@dataclass
+class Role:
+    """Namespaced rules (rbac/v1 Role)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[RBACPolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRole:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[RBACPolicyRule] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+
+@dataclass
+class RoleBinding:
+    """Grants a Role (or ClusterRole) within the binding's namespace."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RBACSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+
+@dataclass
+class ClusterRoleBinding:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RBACSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
 
     def __post_init__(self):
         self.metadata.namespace = ""  # cluster-scoped

@@ -31,15 +31,46 @@ class Cluster:
     """A running control plane (the object form of `kubeadm init`)."""
 
     def __init__(self, data_dir: Optional[str] = None, port: int = 0,
-                 hollow_nodes: int = 0, reconcile_endpoints: bool = True):
+                 hollow_nodes: int = 0, reconcile_endpoints: bool = True,
+                 secure: bool = False):
         if data_dir:
             from ..runtime.nativestore import NativeObjectStore
 
             self.store = NativeObjectStore(path=data_dir)
         else:
             self.store = ObjectStore()
+        authenticator = authorizer = None
+        self.admin_token = self.bootstrap_token = None
+        if secure:
+            # init.go's certs + bootstrap-token + RBAC phases: cluster
+            # CA, admin + join credentials, RBAC evaluated from served
+            # API objects (runtime-reconfigurable)
+            import secrets as _secrets
+
+            from ..server import pki
+            from ..server.auth import (AuthenticatorChain, RBACAuthorizer,
+                                       UserInfo, cluster_admin_bindings)
+
+            ca = pki.ensure_cluster_ca(self.store)
+            self.admin_token = f"admin-{_secrets.token_hex(8)}"
+            self.bootstrap_token = f"bootstrap-{_secrets.token_hex(8)}"
+            authenticator = AuthenticatorChain(
+                tokens={
+                    self.admin_token: UserInfo(
+                        "kubernetes-admin", ("system:masters",
+                                             "system:authenticated")),
+                    self.bootstrap_token: UserInfo(
+                        "system:bootstrap:kubeadm",
+                        ("system:bootstrappers", "system:authenticated")),
+                },
+                store=self.store, ca=ca)
+            authorizer = RBACAuthorizer(
+                bindings=cluster_admin_bindings(["system:masters"]),
+                store=self.store)
+            self._seed_rbac()
         self.apiserver = APIServer(
             self.store, admission=AdmissionChain.default(), port=port,
+            authenticator=authenticator, authorizer=authorizer,
             reconcile_endpoints=reconcile_endpoints)
         self.manager = ControllerManager(self.store)
         self.scheduler = Scheduler(self.store)
@@ -47,6 +78,33 @@ class Cluster:
         self._hollow_nodes = hollow_nodes
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
+
+    def _seed_rbac(self):
+        """Bootstrap RBAC objects (cmd/kubeadm/app/phases/bootstraptoken/
+        clusterinfo + the reference's bootstrap policy): joiners may
+        create and read CSRs, nothing else; node identity then comes
+        from the signed cert + the node authorizer."""
+        from ..runtime.store import Conflict
+
+        try:
+            self.store.create("clusterroles", api.ClusterRole(
+                metadata=api.ObjectMeta(name="system:node-bootstrapper"),
+                rules=[api.RBACPolicyRule(
+                    # create + named get only: a joiner polls its OWN
+                    # CSR; list/watch would let any bootstrap-token
+                    # holder enumerate other nodes' signed certs
+                    verbs=["create", "get"],
+                    api_groups=["certificates.k8s.io"],
+                    resources=["certificatesigningrequests"])]))
+            self.store.create("clusterrolebindings", api.ClusterRoleBinding(
+                metadata=api.ObjectMeta(
+                    name="kubeadm:kubelet-bootstrap"),
+                subjects=[api.RBACSubject(kind="Group",
+                                          name="system:bootstrappers")],
+                role_ref=api.RoleRef(kind="ClusterRole",
+                                     name="system:node-bootstrapper")))
+        except Conflict:
+            pass
 
     @property
     def url(self) -> str:
@@ -114,7 +172,8 @@ def ensure_bootstrap_objects(store):
 
 def cmd_init(args) -> int:
     cluster = Cluster(data_dir=args.data_dir, port=args.port,
-                      hollow_nodes=args.hollow_nodes)
+                      hollow_nodes=args.hollow_nodes,
+                      secure=getattr(args, "secure", False))
     ensure_bootstrap_objects(cluster.store)
     cluster.start()
     if not cluster.wait_ready():
@@ -123,6 +182,9 @@ def cmd_init(args) -> int:
         cluster.stop()
         return 1
     print(f"control plane ready at {cluster.url}")
+    if cluster.admin_token:
+        print(f"  admin token:     {cluster.admin_token}")
+        print(f"  bootstrap token: {cluster.bootstrap_token}")
     print(f"  export KUBECTL_SERVER={cluster.url}")
     print(f"  python -m kubernetes_tpu.cli.kubectl get nodes")
     if args.once:
@@ -136,12 +198,56 @@ def cmd_init(args) -> int:
     return 0
 
 
+def join_with_csr(server: str, node_name: str, bootstrap_token: str,
+                  timeout: float = 15.0):
+    """kubeadm join's TLS-bootstrap phase: using only the bootstrap
+    token, generate a key + CSR for system:node:<name>, submit it, wait
+    for the approver+signer controllers, and return (key_pem, cert_pem)
+    — the kubelet credential every later request authenticates with.
+    Reference: cmd/kubeadm/app/phases/kubelet (bootstrap kubeconfig) +
+    pkg/controller/certificates/."""
+    import secrets as _secrets
+
+    from ..client.rest import RESTClient
+    from ..server import pki
+
+    boot = RESTClient(server, token=bootstrap_token)
+    key_pem, csr_pem = pki.make_csr(f"system:node:{node_name}",
+                                    ("system:nodes",))
+    # random suffix, like real kubeadm's node-csr-<rand>: a re-join
+    # (restart, retry) must not 409 on the old object — and the old
+    # cert would not match the freshly generated key anyway
+    csr_name = f"node-csr-{node_name}-{_secrets.token_hex(4)}"
+    csr = api.CertificateSigningRequest(
+        metadata=api.ObjectMeta(name=csr_name, namespace=""),
+        spec=api.CertificateSigningRequestSpec(
+            request=csr_pem,
+            usages=["digital signature", "key encipherment",
+                    "client auth"]))
+    boot.create("certificatesigningrequests", csr)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = boot.get("certificatesigningrequests", "", csr_name)
+        if got.status.certificate:
+            return key_pem, got.status.certificate
+        time.sleep(0.05)
+    raise TimeoutError(f"CSR for {node_name} was not signed "
+                       f"within {timeout}s")
+
+
 def cmd_join(args) -> int:
     from ..client.reflector import RemoteStore
     from ..client.rest import RESTClient
     from ..kubemark.hollow import HollowNode
 
-    store = RemoteStore(RESTClient(args.server))
+    cert_pem = key_pem = None
+    if args.bootstrap_token:
+        key_pem, cert_pem = join_with_csr(args.server, args.node_name,
+                                          args.bootstrap_token)
+        print(f"obtained kubelet client cert for "
+              f"system:node:{args.node_name} via CSR")
+    store = RemoteStore(RESTClient(args.server, client_cert_pem=cert_pem,
+                                   client_key_pem=key_pem))
     for kind in ("pods", "nodes"):
         store.mirror(kind)
     store.wait_for_sync()
@@ -171,9 +277,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_init.add_argument("--hollow-nodes", type=int, default=0)
     p_init.add_argument("--once", action="store_true",
                         help="start, verify, and exit (smoke test)")
+    p_init.add_argument("--secure", action="store_true",
+                        help="enable authn (x509/SA-token/static) + "
+                             "RBAC-from-API-objects")
     p_join = sub.add_parser("join", help="join a hollow node")
     p_join.add_argument("server")
     p_join.add_argument("--node-name", default="hollow-0")
+    p_join.add_argument("--bootstrap-token", default=None,
+                        help="TLS-bootstrap: obtain a kubelet client "
+                             "cert via CSR before joining")
     p_join.add_argument("--once", action="store_true")
     return ap
 

@@ -148,6 +148,35 @@ def cmd_get(client, args, out):
         _print_table(plural, objs, out)
 
 
+def cmd_logs(client, args, out):
+    """kubectl logs <pod> [-c container] [--tail N] — the apiserver's
+    pods/<name>/log subresource proxies to the kubelet
+    (pkg/kubectl/cmd/logs.go -> registry/core/pod/rest/log.go)."""
+    q = []
+    if args.container:
+        q.append(f"container={args.container}")
+    if args.tail is not None:
+        q.append(f"tailLines={args.tail}")
+    path = client._path("pods", args.namespace, args.name, "log")
+    raw, _ = client.request_bytes("GET", path, query="&".join(q))
+    out.write(raw.decode())
+
+
+def cmd_exec(client, args, out):
+    """kubectl exec <pod> [-c container] -- cmd... — one-shot exec via
+    the pods/<name>/exec subresource (pkg/kubectl/cmd/exec.go)."""
+    path = client._path("pods", args.namespace, args.name, "exec")
+    body = {"command": args.command}
+    if args.container:
+        body["container"] = args.container
+    resp = client.request("POST", path, body=body)
+    out.write(resp.get("output", "") + "\n")
+    rc = int(resp.get("exitCode", 0))
+    if rc != 0:
+        raise APIStatusError(rc, "ExecFailed",
+                             f"command exited with code {rc}")
+
+
 def cmd_describe(client, args, out):
     plural = _resolve_kind(args.kind)
     obj = client.get(plural, args.namespace, args.name)
@@ -534,6 +563,17 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--name", dest="service_name", default="")
     ex.add_argument("--type", default="ClusterIP")
 
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    lg.add_argument("--container", "-c", default="")
+    lg.add_argument("--tail", type=int, default=None)
+
+    ec = sub.add_parser("exec")
+    ec.add_argument("name")
+    ec.add_argument("--container", "-c", default="")
+    ec.add_argument("command", nargs="+",
+                    help="command to run (after --)")
+
     xp = sub.add_parser("explain")
     xp.add_argument("kind")
 
@@ -548,7 +588,8 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "apply": cmd_apply, "delete": cmd_delete, "scale": cmd_scale,
          "cordon": cmd_cordon, "uncordon": cmd_uncordon, "drain": cmd_drain,
          "label": cmd_label, "version": cmd_version, "rollout": cmd_rollout,
-         "expose": cmd_expose, "explain": cmd_explain, "top": cmd_top}
+         "expose": cmd_expose, "explain": cmd_explain, "top": cmd_top,
+         "logs": cmd_logs, "exec": cmd_exec}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

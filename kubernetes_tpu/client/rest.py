@@ -28,14 +28,30 @@ class APIStatusError(Exception):
 class RESTClient:
     def __init__(self, base_url: str, token: Optional[str] = None,
                  user_agent: str = "kubernetes-tpu-client",
-                 binary: bool = False):
+                 binary: bool = False,
+                 client_cert_pem: Optional[str] = None,
+                 client_key_pem: Optional[str] = None):
         """binary=True negotiates the compact binary wire codec for GETs
         (api/binary.py — the reference's
-        application/vnd.kubernetes.protobuf role)."""
+        application/vnd.kubernetes.protobuf role). client_cert_pem +
+        client_key_pem form an x509 client credential issued by the
+        cluster CA (kubeadm join / CSR flow): the cert rides base64 in
+        X-Client-Cert and the key signs a possession proof header — the
+        plain-HTTP stand-in for TLS client auth."""
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.user_agent = user_agent
         self.binary = binary
+        self._cert_b64 = self._cert_proof = None
+        if client_cert_pem:
+            import base64 as _b64
+
+            self._cert_b64 = _b64.b64encode(client_cert_pem.encode()).decode()
+            if client_key_pem:
+                from ..server import pki
+
+                self._cert_proof = pki.sign_proof(client_key_pem,
+                                                  client_cert_pem)
 
     # -- plumbing --------------------------------------------------------------
 
@@ -78,6 +94,10 @@ class RESTClient:
             req.add_header("Accept", accept)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        if self._cert_b64:
+            req.add_header("X-Client-Cert", self._cert_b64)
+            if self._cert_proof:
+                req.add_header("X-Client-Cert-Proof", self._cert_proof)
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 return resp.read(), resp.headers.get("Content-Type", "")
@@ -191,6 +211,10 @@ class RESTClient:
         req.add_header("User-Agent", self.user_agent)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        if self._cert_b64:
+            req.add_header("X-Client-Cert", self._cert_b64)
+            if self._cert_proof:
+                req.add_header("X-Client-Cert-Proof", self._cert_proof)
         kind = scheme.kind_for_plural(plural)
         try:
             resp = urllib.request.urlopen(req, timeout=timeout_seconds + 10)

@@ -3,11 +3,12 @@
 Reference: pkg/controller/certificates/ — the approver
 (approver/sarapprove.go) auto-approves kubelet CSRs whose requestor is
 the node itself (self-node client certs), and the signer
-(signer/signer.go) issues the certificate for approved CSRs. Real x509
-is out of scope for the framework (the reference shells out to a CA
-keypair); the control-loop contract — request -> approve/deny ->
-signed status.certificate consumable by the requester — is what this
-reproduces, with an opaque token standing in for the PEM blob.
+(signer/signer.go) issues the certificate for approved CSRs. The signer
+is REAL x509: a PEM CSR in spec.request is signed by the cluster CA
+(server/pki.py) and the resulting cert is accepted by the apiserver's
+x509 authn path — kubeadm join bootstraps kubelet identity through it.
+Non-PEM requests (legacy opaque payloads) still get the digest-token
+certificate so old callers keep working.
 """
 
 from __future__ import annotations
@@ -30,6 +31,39 @@ def is_self_node_csr(csr: api.CertificateSigningRequest) -> bool:
     return set(csr.spec.usages) == KUBELET_USAGES
 
 
+def _pem_subject(csr_pem: str):
+    """(CN, [O...]) of a PEM CSR, or None if unparseable."""
+    try:
+        from cryptography import x509
+        from cryptography.x509.oid import NameOID
+
+        req = x509.load_pem_x509_csr(csr_pem.encode())
+        cn = req.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+        orgs = req.subject.get_attributes_for_oid(
+            NameOID.ORGANIZATION_NAME)
+        if not cn:
+            return None
+        return cn[0].value, [o.value for o in orgs]
+    except Exception:
+        return None
+
+
+def is_node_bootstrap_csr(csr: api.CertificateSigningRequest) -> bool:
+    """approver sarapprove.go isNodeClientCert for the kubeadm join
+    flow: a system:bootstrappers requestor asking for a node client
+    identity (subject CN system:node:<x>, O [system:nodes]) with the
+    kubelet usages."""
+    if "system:bootstrappers" not in csr.spec.groups:
+        return False
+    if set(csr.spec.usages) != KUBELET_USAGES:
+        return False
+    subj = _pem_subject(csr.spec.request)
+    if subj is None:
+        return False
+    cn, orgs = subj
+    return cn.startswith("system:node:") and orgs == ["system:nodes"]
+
+
 class CSRApprovingController(Controller):
     name = "csrapproving"
 
@@ -47,6 +81,10 @@ class CSRApprovingController(Controller):
             csr.status.conditions.append(
                 ("Approved", "AutoApproved self node client cert"))
             self.store.update("certificatesigningrequests", csr)
+        elif is_node_bootstrap_csr(csr):
+            csr.status.conditions.append(
+                ("Approved", "AutoApproved node bootstrap client cert"))
+            self.store.update("certificatesigningrequests", csr)
 
 
 class CSRSigningController(Controller):
@@ -55,7 +93,15 @@ class CSRSigningController(Controller):
     def __init__(self, store, ca_name: str = "kubernetes-tpu-ca"):
         super().__init__(store)
         self.ca_name = ca_name
+        self._ca = None
         self.informer("certificatesigningrequests")
+
+    def _cluster_ca(self):
+        if self._ca is None:
+            from ..server import pki
+
+            self._ca = pki.ensure_cluster_ca(self.store)
+        return self._ca
 
     def sync(self, key: str):
         name = key.split("/", 1)[-1]
@@ -63,8 +109,13 @@ class CSRSigningController(Controller):
             or self.store.get("certificatesigningrequests", "", name)
         if csr is None or not csr.approved or csr.status.certificate:
             return
-        digest = hashlib.sha256(
-            f"{self.ca_name}/{csr.spec.username}/{csr.spec.request}"
-            .encode()).hexdigest()
-        csr.status.certificate = f"cert:{csr.spec.username}:{digest[:32]}"
+        if "BEGIN CERTIFICATE REQUEST" in csr.spec.request:
+            # real x509 path (signer.go Sign): issue from the cluster CA
+            csr.status.certificate = self._cluster_ca().sign_csr(
+                csr.spec.request)
+        else:
+            digest = hashlib.sha256(
+                f"{self.ca_name}/{csr.spec.username}/{csr.spec.request}"
+                .encode()).hexdigest()
+            csr.status.certificate = f"cert:{csr.spec.username}:{digest[:32]}"
         self.store.update("certificatesigningrequests", csr)

@@ -111,6 +111,20 @@ class GarbageCollector(Controller):
         except KeyError:
             return None
 
+    @staticmethod
+    def _safe_namespaced(plural_or_kind: str, by_plural: bool) -> bool:
+        """is_namespaced that tolerates unregistered kinds (a CRD may be
+        deleted while its leftover instances still emit events)."""
+        try:
+            kind = (scheme.kind_for_plural(plural_or_kind)
+                    if by_plural else plural_or_kind)
+            if not kind:
+                return True
+            return scheme.is_namespaced(kind)
+        except KeyError:
+            return True
+
+
     def _observe(self, plural: str, obj):
         uid = obj.metadata.uid
         verify: List[str] = []
@@ -133,9 +147,15 @@ class GarbageCollector(Controller):
             for ref in n.owners:
                 if not ref.uid:
                     # uid-less reference: link by identity so the owner's
-                    # eventual delete still enqueues this dependent
+                    # eventual delete still enqueues this dependent;
+                    # cluster-scoped owners file under "" so the delete
+                    # event's lookup matches whatever namespace the
+                    # dependent lives in
+                    ref_ns = (obj.metadata.namespace
+                              if self._safe_namespaced(ref.kind, False)
+                              else "")
                     key = (self._plural_for(ref.kind) or ref.kind,
-                           obj.metadata.namespace, ref.name)
+                           ref_ns, ref.name)
                     new_idents.add(key)
                     self._ident_deps.setdefault(key, set()).add(uid)
                     continue
@@ -185,15 +205,13 @@ class GarbageCollector(Controller):
                             del self._ident_deps[key]
             # dependents that referenced this owner by bare identity:
             # kept registered (a recreated same-name owner satisfies a
-            # uid-less ref), just re-verified now
+            # uid-less ref), just re-verified now. Cluster-scoped kinds
+            # are filed (and looked up) under "" regardless of the
+            # namespace strings either object carries.
+            owner_ns = (obj.metadata.namespace
+                        if self._safe_namespaced(plural, True) else "")
             deps |= self._ident_deps.get(
-                (plural, obj.metadata.namespace, obj.metadata.name), set())
-            if not scheme.is_namespaced(scheme.kind_for_plural(plural)
-                                        or ""):
-                deps |= self._ident_deps.get((plural, "", obj.metadata.name),
-                                             set())
-                deps |= self._ident_deps.get(
-                    (plural, "default", obj.metadata.name), set())
+                (plural, owner_ns, obj.metadata.name), set())
         for dep in sorted(deps):
             self.queue.add(f"orphan:{dep}:{uid}" if orphan
                            else f"attempt:{dep}")

@@ -89,9 +89,28 @@ class Kubelet:
         self._last_heartbeat = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.server = None  # KubeletServer once serve() is called
         self.register_node()
 
     # -- node registration + heartbeat (kubelet_node_status.go) ----------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the kubelet HTTP server (pkg/kubelet/server/server.go)
+        and publish its port on the Node's status
+        (NodeDaemonEndpoints.KubeletEndpoint) so the apiserver's
+        pods/<name>/log and /exec proxies can reach it."""
+        from .server import KubeletServer
+
+        self.server = KubeletServer(self, host=host, port=port).start()
+        self.register_node()
+        node = self._get_node()
+        if node is not None and node.status.kubelet_port != self.server.port:
+            node.status.kubelet_port = self.server.port
+            try:
+                self.store.update("nodes", node)
+            except Conflict:
+                pass
+        return self.server
 
     def register_node(self):
         node = self._get_node()
@@ -399,4 +418,6 @@ class Kubelet:
 
     def stop(self):
         self._stop.set()
+        if self.server is not None:
+            self.server.stop()
         self.pod_workers.stop()

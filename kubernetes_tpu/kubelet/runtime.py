@@ -27,6 +27,7 @@ class ContainerState:
     restart_count: int = 0
     healthy: bool = True  # liveness handler result
     ready: bool = True    # readiness handler result
+    logs: List[str] = field(default_factory=list)  # stdout/stderr record
 
 
 class FakeRuntime:
@@ -52,6 +53,7 @@ class FakeRuntime:
                     self._pending_start.setdefault(key, now + self.start_latency)
                 else:
                     st.state = RUNNING
+                    st.logs.append(f"container {name} started")
 
     def tick(self, now: float) -> List[Tuple[str, str, str]]:
         """Advance pending starts; returns lifecycle events
@@ -64,6 +66,7 @@ class FakeRuntime:
                     st = self.containers.get(key)
                     if st is not None and st.state != RUNNING:
                         st.state = RUNNING
+                        st.logs.append(f"container {key[1]} started")
                         events.append((key[0], key[1], "ContainerStarted"))
                     self._pending_start.pop(key, None)
         return events
@@ -90,6 +93,48 @@ class FakeRuntime:
             return [st for (uid, _), st in self.containers.items()
                     if uid == pod_uid]
 
+    # -- logs + exec (the kubelet server's debug surface) ----------------------
+
+    def append_log(self, pod_uid: str, name: str, line: str):
+        """Record a stdout line (what a real runtime's log file collects)."""
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is not None:
+                st.logs.append(line)
+
+    def container_logs(self, pod_uid: str, name: str,
+                       tail: Optional[int] = None) -> Optional[List[str]]:
+        """The runtime's log records (CRI ContainerLog / docker logs
+        analog); None if the container does not exist."""
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is None:
+                return None
+            lines = list(st.logs)
+        if tail is None or tail < 0:
+            return lines
+        # explicit slice end: lines[-0:] would be the WHOLE list
+        return lines[len(lines) - min(tail, len(lines)):]
+
+    def exec_in_container(self, pod_uid: str, name: str,
+                          cmd: List[str]) -> Tuple[int, str]:
+        """Canned command runner (the reference streams a real exec over
+        CRI, kuberuntime ExecSync): echo reproduces its args, everything
+        else reports what ran. Non-running containers fail like a real
+        exec would."""
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is None or st.state != RUNNING:
+                return 126, f"container {name} is not running"
+        if cmd and cmd[0] == "echo":
+            out = " ".join(cmd[1:])
+        elif cmd and cmd[0] == "hostname":
+            out = pod_uid
+        else:
+            out = f"executed: {' '.join(cmd)}"
+        self.append_log(pod_uid, name, f"exec: {' '.join(cmd)}")
+        return 0, out
+
     # -- fault injection (tests / chaos harness) -------------------------------
 
     def crash_container(self, pod_uid: str, name: str, exit_code: int = 1):
@@ -98,6 +143,7 @@ class FakeRuntime:
             if st is not None:
                 st.state = EXITED
                 st.exit_code = exit_code
+                st.logs.append(f"container {name} exited rc={exit_code}")
 
     def set_healthy(self, pod_uid: str, name: str, healthy: bool):
         with self._lock:

@@ -1,0 +1,121 @@
+"""The kubelet's HTTP serving surface: logs, exec, pods, healthz.
+
+Reference: pkg/kubelet/server/server.go — the kubelet runs an HTTPS
+server the apiserver proxies into for the debugging plane:
+  GET  /containerLogs/<ns>/<pod>/<container>   (server.go getContainerLogs)
+  POST /exec/<ns>/<pod>/<container>            (server.go:325 getExec)
+  GET  /pods                                   (server.go getPods)
+  GET  /healthz
+
+Divergences, deliberate: plain HTTP (the cluster's header-borne x509
+model, see server/auth.py), and exec is a one-shot JSON request/response
+against the fake runtime's canned runner instead of a SPDY/websocket
+stream — the control flow (apiserver proxy -> kubelet -> runtime) is
+the part being reproduced.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api import scheme
+
+
+class KubeletServer:
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0):
+        self.kubelet = kubelet
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                outer._handle(self, "GET")
+
+            def do_POST(self):
+                outer._handle(self, "POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "KubeletServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"kubelet-server-{self.kubelet.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing ----------------------------------------------------------------
+
+    def _find_pod(self, namespace: str, pod_name: str):
+        pod = self.kubelet.store.get("pods", namespace, pod_name)
+        if pod is None or pod.spec.node_name != self.kubelet.node_name:
+            return None  # only pods bound to THIS node are served
+        return pod
+
+    def _handle(self, h, method: str):
+        parsed = urlparse(h.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = parse_qs(parsed.query)
+        if parts == ["healthz"]:
+            return h._send(200, b"ok", "text/plain")
+        if parts == ["pods"] and method == "GET":
+            pods = [p for p in self.kubelet.store.list("pods")
+                    if p.spec.node_name == self.kubelet.node_name]
+            return h._send(200, json.dumps(
+                {"kind": "PodList",
+                 "items": [scheme.encode_object(p) for p in pods]}).encode())
+        if len(parts) == 4 and parts[0] == "containerLogs" \
+                and method == "GET":
+            _, ns, pod_name, container = parts
+            pod = self._find_pod(ns, pod_name)
+            if pod is None:
+                return h._send(404, b"pod not found", "text/plain")
+            tail = query.get("tailLines", [None])[0]
+            lines = self.kubelet.runtime.container_logs(
+                pod.metadata.uid, container,
+                tail=int(tail) if tail else None)
+            if lines is None:
+                return h._send(404, f"container {container!r} not found"
+                               .encode(), "text/plain")
+            return h._send(200, ("\n".join(lines) + "\n").encode()
+                           if lines else b"", "text/plain")
+        if len(parts) == 4 and parts[0] == "exec" and method == "POST":
+            _, ns, pod_name, container = parts
+            pod = self._find_pod(ns, pod_name)
+            if pod is None:
+                return h._send(404, b"pod not found", "text/plain")
+            length = int(h.headers.get("Content-Length") or 0)
+            try:
+                body = json.loads(h.rfile.read(length) or b"{}")
+                cmd = list(body.get("command") or [])
+            except (ValueError, TypeError):
+                return h._send(400, b"bad exec body", "text/plain")
+            if not cmd:
+                return h._send(400, b"no command", "text/plain")
+            rc, out = self.kubelet.runtime.exec_in_container(
+                pod.metadata.uid, container, cmd)
+            return h._send(200, json.dumps(
+                {"exitCode": rc, "output": out}).encode())
+        h._send(404, b"not found", "text/plain")

@@ -296,7 +296,11 @@ class APIServer:
         # always-allowed /healthz delegating authorizer path)
         user = None
         if self.authenticator is not None and parts != ["healthz"]:
-            user = self.authenticator.authenticate(h.headers.get("Authorization"))
+            auth_req = getattr(self.authenticator, "authenticate_request",
+                               None)
+            user = (auth_req(h.headers) if auth_req is not None else
+                    self.authenticator.authenticate(
+                        h.headers.get("Authorization")))
             if user is None:
                 raise APIError(401, "Unauthorized", "authentication failed")
         if parts == ["healthz"]:
@@ -374,12 +378,15 @@ class APIServer:
                             and self.authorizer is not None
                             and user is not None
                             and not self.authorizer.authorize(
-                                user, verb, plural)):
+                                user, verb, plural, namespace=res_ns,
+                                name=name)):
                         raise APIError(403, "Forbidden",
                                        f"user {user.name} cannot {verb} "
                                        f"{plural}")
                 elif self.authorizer is not None and user is not None:
-                    if not self.authorizer.authorize(user, verb, plural):
+                    if not self.authorizer.authorize(user, verb, plural,
+                                                     namespace=res_ns,
+                                                     name=name):
                         raise APIError(403, "Forbidden",
                                        f"user {user.name} cannot {verb} "
                                        f"{plural}")
@@ -424,11 +431,16 @@ class APIServer:
     def _serve_authorized(self, h, query, user, plural, namespace, name,
                           sub, verb, gv=None):
 
-        # authz (filters/authorization.go)
+        # authz (filters/authorization.go) — namespace/name make
+        # namespaced Roles and resourceNames evaluable; subresources
+        # authorize as their own attribute ("pods/exec", "pods/status")
+        # so a create-pods grant does NOT imply exec into pods
+        attr = f"{plural}/{sub}" if sub else plural
         if self.authorizer is not None and user is not None:
-            if not self.authorizer.authorize(user, verb, plural):
+            if not self.authorizer.authorize(user, verb, attr,
+                                             namespace=namespace, name=name):
                 raise APIError(403, "Forbidden",
-                               f"user {user.name} cannot {verb} {plural}")
+                               f"user {user.name} cannot {verb} {attr}")
 
         with self._count_lock:
             key = f"{verb}:{plural}"
@@ -441,12 +453,16 @@ class APIServer:
         if verb == "list":
             return self._serve_list(h, plural, namespace, query, gv)
         if verb == "get":
+            if sub == "log" and plural == "pods":
+                return self._serve_pod_log(h, namespace, name, query)
             return self._serve_get(h, plural, namespace, name, gv)
         if verb == "create":
             if sub == "binding":
                 return self._serve_binding(h, namespace, name)
             if sub == "eviction":
                 return self._serve_eviction(h, user, namespace, name)
+            if sub == "exec" and plural == "pods":
+                return self._serve_pod_exec(h, namespace, name)
             return self._serve_create(h, plural, namespace, user, gv)
         if verb in ("update", "patch"):
             return self._serve_update(h, plural, namespace, name, sub, user,
@@ -454,6 +470,72 @@ class APIServer:
         if verb == "delete":
             return self._serve_delete(h, plural, namespace, name, user)
         raise APIError(405, "MethodNotAllowed", f"{h.command} unsupported")
+
+    # -- kubelet proxy subresources (pods/<name>/log, /exec) -------------------
+
+    def _kubelet_target(self, namespace, name):
+        """Resolve a pod's kubelet serving endpoint through its Node's
+        daemon endpoint (registry/core/pod/rest/log.go LogLocation ->
+        pod.Spec.NodeName -> NodeDaemonEndpoints)."""
+        pod = self._find("pods", namespace, name)
+        if pod is None:
+            raise APIError(404, "NotFound", f"pod {name!r} not found")
+        if not pod.spec.node_name:
+            raise APIError(400, "BadRequest",
+                           f"pod {name!r} is not scheduled to a node")
+        node = (self.store.get("nodes", "", pod.spec.node_name)
+                or self.store.get("nodes", "default", pod.spec.node_name))
+        if node is None or not node.status.kubelet_port:
+            raise APIError(400, "BadRequest",
+                           f"node {pod.spec.node_name!r} does not expose "
+                           f"a kubelet endpoint")
+        host = next((a.address for a in node.status.addresses if a.address),
+                    "127.0.0.1")
+        container = (pod.spec.containers[0].name
+                     if pod.spec.containers else "")
+        return pod, host, node.status.kubelet_port, container
+
+    def _kubelet_proxy(self, h, method, host, port, path, body=None):
+        import http.client
+
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            h._send(resp.status, data,
+                    resp.getheader("Content-Type", "text/plain"))
+            return True
+        except OSError as e:
+            raise APIError(503, "ServiceUnavailable",
+                           f"kubelet unreachable: {e}")
+        finally:
+            conn.close()
+
+    def _serve_pod_log(self, h, namespace, name, query):
+        """GET pods/<name>/log — proxied to the kubelet's
+        /containerLogs/<ns>/<pod>/<container> (pod/rest/log.go)."""
+        pod, host, port, default_c = self._kubelet_target(namespace, name)
+        container = query.get("container", [default_c])[0]
+        tail = query.get("tailLines", [None])[0]
+        path = (f"/containerLogs/{pod.metadata.namespace}/"
+                f"{pod.metadata.name}/{container}")
+        if tail:
+            path += f"?tailLines={tail}"
+        return self._kubelet_proxy(h, "GET", host, port, path)
+
+    def _serve_pod_exec(self, h, namespace, name):
+        """POST pods/<name>/exec — proxied to the kubelet's /exec
+        (server.go:325 getExec; one-shot JSON here, not SPDY)."""
+        pod, host, port, default_c = self._kubelet_target(namespace, name)
+        data = self._read_body(h)
+        container = data.get("container") or default_c
+        path = (f"/exec/{pod.metadata.namespace}/"
+                f"{pod.metadata.name}/{container}")
+        return self._kubelet_proxy(h, "POST", host, port, path,
+                                   body=json.dumps(
+                                       {"command": data.get("command")}))
 
     # -- aggregation (kube-aggregator) -----------------------------------------
 
@@ -651,6 +733,14 @@ class APIServer:
             raise APIError(400, "BadRequest", f"cannot decode {kind}: {e}")
         if namespace is not None and scheme.is_namespaced(kind):
             obj.metadata.namespace = namespace
+        if plural == "certificatesigningrequests" and user is not None:
+            # the requestor identity is SERVER-stamped from the request
+            # context, never client-claimed — INCLUDING anonymous: an
+            # anonymous CSR carrying forged system:bootstrappers groups
+            # must not reach the auto-approver (pkg/registry/certificates/
+            # certificates/strategy.go PrepareForCreate)
+            obj.spec.username = user.name
+            obj.spec.groups = list(user.groups)
         try:
             self.admission.admit("create", plural, obj, None, user, self.store)
         except AdmissionError as e:

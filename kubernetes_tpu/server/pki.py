@@ -1,0 +1,194 @@
+"""Cluster PKI: a self-signed CA, CSR issuance, and cert verification.
+
+Reference: the kubeadm certs phase (cmd/kubeadm/app/phases/certs) creates
+a self-signed cluster CA; the CSR signer (pkg/controller/certificates/
+signer/signer.go) issues client certs from it; x509 request authn
+(staging/src/k8s.io/apiserver/pkg/authentication/request/x509/x509.go:76)
+maps a verified client cert to a user via CommonName (user) and
+Organization (groups) — CommonNameUserConversion.
+
+EC P-256 keys throughout (small, fast). The CA material lives in a
+kube-system Secret so every component — apiserver authn, the CSR
+signer, kubeadm join — shares one trust root through the store, and a
+durable store carries it across restarts (the reference's equivalent is
+the /etc/kubernetes/pki directory).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from ..api import types as api
+
+CA_SECRET_NAMESPACE = "kube-system"
+CA_SECRET_NAME = "cluster-ca"
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _name(common_name: str, organizations: Tuple[str, ...] = ()) -> x509.Name:
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    attrs += [x509.NameAttribute(NameOID.ORGANIZATION_NAME, o)
+              for o in organizations]
+    return x509.Name(attrs)
+
+
+@dataclass
+class ClusterCA:
+    """The cluster trust root + the service-account signing secret."""
+
+    ca_cert_pem: str
+    ca_key_pem: str
+    sa_signing_key: str  # HMAC secret for SA JWTs (jwt.go's key analog)
+
+    @property
+    def ca_cert(self) -> x509.Certificate:
+        return x509.load_pem_x509_certificate(self.ca_cert_pem.encode())
+
+    def _ca_key(self):
+        return serialization.load_pem_private_key(
+            self.ca_key_pem.encode(), password=None)
+
+    def sign_csr(self, csr_pem: str, days: int = 365) -> str:
+        """signer.go Sign: issue a client cert for a PEM CSR, preserving
+        its subject (CN = user, O = groups)."""
+        csr = x509.load_pem_x509_csr(csr_pem.encode())
+        if not csr.is_signature_valid:
+            raise ValueError("CSR signature invalid")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(csr.subject)
+                .issuer_name(self.ca_cert.subject)
+                .public_key(csr.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _ONE_DAY)
+                .not_valid_after(now + days * _ONE_DAY)
+                .add_extension(x509.ExtendedKeyUsage(
+                    [x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]),
+                    critical=False)
+                .sign(self._ca_key(), hashes.SHA256()))
+        return cert.public_bytes(serialization.Encoding.PEM).decode()
+
+    def verify_client_cert(self, cert_pem: str
+                           ) -> Optional[Tuple[str, List[str]]]:
+        """x509.go:76 CommonNameUserConversion: validate the cert chains
+        to this CA and is in its validity window; return (CN, [O...]),
+        or None if untrusted/expired."""
+        try:
+            cert = x509.load_pem_x509_certificate(cert_pem.encode())
+            cert.verify_directly_issued_by(self.ca_cert)
+        except Exception:
+            return None
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if not (cert.not_valid_before_utc <= now <= cert.not_valid_after_utc):
+            return None
+        cn = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+        orgs = cert.subject.get_attributes_for_oid(NameOID.ORGANIZATION_NAME)
+        if not cn:
+            return None
+        return cn[0].value, [o.value for o in orgs]
+
+
+def new_cluster_ca(name: str = "kubernetes-tpu-ca") -> ClusterCA:
+    """kubeadm certs phase: generate the self-signed CA."""
+    import secrets
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    subject = _name(name)
+    cert = (x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + 3650 * _ONE_DAY)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    return ClusterCA(
+        ca_cert_pem=cert.public_bytes(serialization.Encoding.PEM).decode(),
+        ca_key_pem=key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()).decode(),
+        sa_signing_key=secrets.token_hex(32))
+
+
+def make_csr(common_name: str, organizations: Tuple[str, ...] = ()
+             ) -> Tuple[str, str]:
+    """Client-side key + CSR (kubeadm join's kubelet-client flow).
+    Returns (private_key_pem, csr_pem)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    csr = (x509.CertificateSigningRequestBuilder()
+           .subject_name(_name(common_name, tuple(organizations)))
+           .sign(key, hashes.SHA256()))
+    return (key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()).decode(),
+            csr.public_bytes(serialization.Encoding.PEM).decode())
+
+
+def sign_proof(key_pem: str, cert_pem: str) -> str:
+    """Proof of key possession for header-borne client certs: an ECDSA
+    signature by the cert's private key OVER the cert itself (base64
+    DER). TLS proves possession in the handshake; plain HTTP cannot, so
+    without this the PEM in X-Client-Cert would be a bearer credential
+    anyone who read the signed CSR status could replay."""
+    import base64
+
+    key = serialization.load_pem_private_key(key_pem.encode(),
+                                             password=None)
+    sig = key.sign(cert_pem.encode(), ec.ECDSA(hashes.SHA256()))
+    return base64.b64encode(sig).decode()
+
+
+def verify_proof(cert_pem: str, proof_b64: str) -> bool:
+    """Does the proof demonstrate possession of the cert's key?"""
+    import base64
+
+    try:
+        cert = x509.load_pem_x509_certificate(cert_pem.encode())
+        cert.public_key().verify(base64.b64decode(proof_b64),
+                                 cert_pem.encode(),
+                                 ec.ECDSA(hashes.SHA256()))
+        return True
+    except Exception:
+        return False
+
+
+def ensure_cluster_ca(store) -> ClusterCA:
+    """Load the CA Secret, creating it (and kube-system) on first call —
+    every component resolves the same trust root through the store."""
+    from ..runtime.store import Conflict
+
+    sec = store.get("secrets", CA_SECRET_NAMESPACE, CA_SECRET_NAME)
+    if sec is None:
+        ca = new_cluster_ca()
+        try:
+            store.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name=CA_SECRET_NAMESPACE),
+                status=api.NamespaceStatus(phase="Active")))
+        except Conflict:
+            pass
+        try:
+            store.create("secrets", api.Secret(
+                metadata=api.ObjectMeta(name=CA_SECRET_NAME,
+                                        namespace=CA_SECRET_NAMESPACE),
+                type="kubernetes.io/cluster-ca",
+                data={"ca.crt": ca.ca_cert_pem, "ca.key": ca.ca_key_pem,
+                      "sa.key": ca.sa_signing_key}))
+        except Conflict:
+            sec = store.get("secrets", CA_SECRET_NAMESPACE, CA_SECRET_NAME)
+        else:
+            return ca
+    return ClusterCA(ca_cert_pem=sec.data["ca.crt"],
+                     ca_key_pem=sec.data["ca.key"],
+                     sa_signing_key=sec.data["sa.key"])

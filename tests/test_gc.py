@@ -149,3 +149,19 @@ class TestGraphGC:
         assert gc.sweep() == 0
         store.delete("nodes", "", "n1")
         assert gc.sweep() == 1
+
+    def test_uidless_ref_to_cluster_scoped_owner_nondefault_ns(self):
+        """A uid-less reference from a pod in a non-default namespace to
+        a cluster-scoped owner still collects when the owner dies."""
+        store = ObjectStore()
+        gc = GarbageCollector(store)
+        node = api.Node(metadata=api.ObjectMeta(name="n1", namespace=""))
+        store.create("nodes", node)
+        store.create("pods", api.Pod(metadata=api.ObjectMeta(
+            name="mirror", namespace="prod",
+            owner_references=[api.OwnerReference(kind="Node", name="n1",
+                                                 controller=True)])))
+        assert gc.sweep() == 0
+        store.delete("nodes", "", "n1")
+        assert gc.sweep() == 1
+        assert store.get("pods", "prod", "mirror") is None
