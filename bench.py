@@ -213,6 +213,80 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     return placed, dt, p99, p99_round, sched.wave_path()
 
 
+def run_preempt_config(nodes, pods, wave, device=True):
+    """Preemption-heavy drain: every node saturated by low-priority
+    hogs, then a high-priority backlog that can only place by evicting
+    them. device=False forces the host per-wave preemption path — the
+    comparison baseline for the batched device what-if
+    (ops/preempt.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import (PREEMPT_LEVELS, Scheduler)
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import Metrics
+    from kubernetes_tpu.utils.backoff import PodBackoff
+
+    store = ObjectStore()
+    caps = Caps(M=bucket_size(2 * nodes + pods + 64), P=wave,
+                LV=bucket_size(nodes + 256, 64))
+    sched = Scheduler(store, wave_size=wave, caps=caps)
+    sched.device_preemption = device
+    # a near-zero initial backoff so the measurement is work, not the
+    # reference's 1s parking window (identical for both paths)
+    sched.backoff = PodBackoff(initial=0.001)
+    build_cluster(store, nodes)
+    # two hogs fill each node's 16 cpu
+    for i in range(2 * nodes):
+        p = _base_pod(api, f"hog-{i}", "hog")
+        p.spec.containers[0].resources.requests["cpu"] = 8000
+        p.spec.priority = 1
+        store.create("pods", p)
+    placed = sched.schedule_pending()
+    assert placed == 2 * nodes, f"fill placed {placed}"
+    # warm the round + preemption programs outside the window
+    warm = []
+    for i in range(wave):
+        p = _base_pod(api, f"warmup-{i}", "warmup")
+        store.create("pods", p)
+        warm.append(p)
+    sched.warm_pipeline(warm, n_waves=min(-(-pods // wave), 128))
+    from kubernetes_tpu.ops.preempt import preemption_stats
+
+    pb = sched.featurizer.featurize(warm[:1])
+    nt, pm, tt = sched.snapshot.to_device()
+    out = preemption_stats(nt, pm, pb,
+                           jnp.asarray([2] * PREEMPT_LEVELS, jnp.int32),
+                           num_levels=PREEMPT_LEVELS)
+    jax.block_until_ready(out[0])
+    for p in warm:
+        store.delete("pods", "default", p.metadata.name)
+
+    sched.metrics = Metrics()
+    for i in range(pods):
+        p = _base_pod(api, f"vip-{i}", "vip")
+        p.spec.containers[0].resources.requests["cpu"] = 8000
+        p.spec.priority = 100
+        store.create("pods", p)
+    t0 = time.time()
+    done = sched.schedule_pending()
+    while done < pods:
+        time.sleep(0.002)
+        done += sched.schedule_pending()
+    dt = time.time() - t0
+    evicted = int(sched.metrics.pod_preemption_victims.value)
+    p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
+    p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    print(f"# preempt[{'device' if device else 'host'}]: placed={done} "
+          f"evicted={evicted} pipeline={sched.pipeline_preemptions} "
+          f"preempt_eval={sched.metrics.preemption_evaluation.sum:.2f}s",
+          file=sys.stderr)
+    return done, dt, p99, p99_round, sched.wave_path()
+
+
 def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
     if placed != pods:
         print(f"FATAL: {name}: placed {placed}/{pods}", file=sys.stderr)
@@ -281,7 +355,10 @@ def main():
     ap.add_argument("--wave", type=int, default=256)
     ap.add_argument("--workload", default=None,
                     choices=["density", "affinity", "spreading",
-                             "antiaffinity", "mixed"])
+                             "antiaffinity", "mixed", "preempt"])
+    ap.add_argument("--host-preempt", action="store_true",
+                    help="preempt workload: force the host per-wave "
+                         "preemption path (baseline)")
     ap.add_argument("--suite", action="store_true",
                     help="run the 5-config BASELINE grid")
     ap.add_argument("--name", default="",
@@ -316,8 +393,13 @@ def main():
         run_subprocess_suite(DRIVER_SUITE, args.wave, args.cpu)
         return
 
-    placed, dt, p99, p99_round, path = run_config(
-        args.nodes, args.pods, args.wave, args.workload)
+    if args.workload == "preempt":
+        placed, dt, p99, p99_round, path = run_preempt_config(
+            args.nodes, args.pods, args.wave,
+            device=not args.host_preempt)
+    else:
+        placed, dt, p99, p99_round, path = run_config(
+            args.nodes, args.pods, args.wave, args.workload)
     emit(args.name or args.workload, args.nodes, args.pods, placed, dt, p99,
          p99_round, args.wave, path)
 

@@ -86,6 +86,8 @@ class Cluster:
         from the signed cert + the node authorizer."""
         from ..runtime.store import Conflict
 
+        # each create gets its OWN conflict guard: a crash between the
+        # two must not leave the seed half-applied forever on re-init
         try:
             self.store.create("clusterroles", api.ClusterRole(
                 metadata=api.ObjectMeta(name="system:node-bootstrapper"),
@@ -96,6 +98,9 @@ class Cluster:
                     verbs=["create", "get"],
                     api_groups=["certificates.k8s.io"],
                     resources=["certificatesigningrequests"])]))
+        except Conflict:
+            pass
+        try:
             self.store.create("clusterrolebindings", api.ClusterRoleBinding(
                 metadata=api.ObjectMeta(
                     name="kubeadm:kubelet-bootstrap"),

@@ -171,10 +171,10 @@ def cmd_exec(client, args, out):
         body["container"] = args.container
     resp = client.request("POST", path, body=body)
     out.write(resp.get("output", "") + "\n")
-    rc = int(resp.get("exitCode", 0))
-    if rc != 0:
-        raise APIStatusError(rc, "ExecFailed",
-                             f"command exited with code {rc}")
+    # the exec API call succeeded; the COMMAND's exit code propagates as
+    # the process exit code, like real kubectl exec — not as a fake
+    # server error
+    return int(resp.get("exitCode", 0))
 
 
 def cmd_describe(client, args, out):
@@ -611,8 +611,10 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     except Exception:
         pass  # pre-CRD servers: discovery is best-effort
     try:
-        VERBS[args.verb](client, args, out)
-        return 0
+        # a verb may return a process exit code (kubectl exec relays the
+        # remote command's); None means success
+        rc = VERBS[args.verb](client, args, out)
+        return int(rc or 0)
     except APIStatusError as e:
         print(f"Error from server: {e}", file=sys.stderr)
         return 1

@@ -103,14 +103,24 @@ class Kubelet:
 
         self.server = KubeletServer(self, host=host, port=port).start()
         self.register_node()
-        node = self._get_node()
-        if node is not None and node.status.kubelet_port != self.server.port:
+        self._publish_kubelet_port()
+        return self.server
+
+    def _publish_kubelet_port(self):
+        """Idempotent port publication; heartbeat() re-asserts it so a
+        lost update race can't leave the endpoint unpublished."""
+        if self.server is None:
+            return
+        for _ in range(3):
+            node = self._get_node()
+            if node is None or node.status.kubelet_port == self.server.port:
+                return
             node.status.kubelet_port = self.server.port
             try:
                 self.store.update("nodes", node)
+                return
             except Conflict:
-                pass
-        return self.server
+                continue  # re-read and retry against the fresh version
 
     def register_node(self):
         node = self._get_node()
@@ -152,6 +162,10 @@ class Kubelet:
                 api.NODE_MEMORY_PRESSURE,
                 api.COND_TRUE if memory_pressure else api.COND_FALSE)
         node.status.conditions = list(conds.values())
+        if self.server is not None:
+            # re-assert the serving port: a raced-away serve()-time
+            # update would otherwise leave logs/exec unreachable forever
+            node.status.kubelet_port = self.server.port
         try:
             self.store.update("nodes", node)
         except (Conflict, KeyError):

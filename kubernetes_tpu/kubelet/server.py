@@ -93,9 +93,14 @@ class KubeletServer:
             if pod is None:
                 return h._send(404, b"pod not found", "text/plain")
             tail = query.get("tailLines", [None])[0]
+            if tail is not None:
+                try:
+                    tail = int(tail)
+                except ValueError:
+                    return h._send(400, b"tailLines must be an integer",
+                                   "text/plain")
             lines = self.kubelet.runtime.container_logs(
-                pod.metadata.uid, container,
-                tail=int(tail) if tail else None)
+                pod.metadata.uid, container, tail=tail)
             if lines is None:
                 return h._send(404, f"container {container!r} not found"
                                .encode(), "text/plain")

@@ -175,6 +175,8 @@ class PodMatrix(NamedTuple):
     node: np.ndarray  # i32 [M]   node index
     valid: np.ndarray  # bool [M]
     alive: np.ndarray  # bool [M]  deletionTimestamp unset
+    req: np.ndarray  # f32 [M, R]  resource requests (preemption what-if)
+    prio: np.ndarray  # i32 [M]   pod priority
 
 
 class TermTable(NamedTuple):

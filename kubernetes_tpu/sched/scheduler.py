@@ -48,7 +48,10 @@ from ..utils.feature_gates import FeatureGates
 from .equivalence import EquivalenceCache, equivalence_class
 from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
 from .extender import ExtenderError
-from .preemption import get_lower_priority_nominated_pods, preempt
+from .preemption import (PreemptionResult, get_lower_priority_nominated_pods,
+                         pick_one_node, pod_eligible_to_preempt_others,
+                         preempt, process_preemption_with_extenders,
+                         select_victims_on_node)
 from .queue import SchedulingQueue
 
 
@@ -60,6 +63,11 @@ from .queue import SchedulingQueue
 # (observed on v5e; W<=64 executes fine).
 PIPELINE_MAX_WAVES = 128
 PIPELINE_MAX_WAVES_IPA = 64
+# device-side preemption (ops/preempt.py): priority-threshold levels per
+# what-if program, and how many device-ranked candidate nodes get the
+# exact host validation (selectVictimsOnNode) per failed pod
+PREEMPT_LEVELS = 8
+PREEMPT_HOST_CANDIDATES = 8
 
 
 def pipeline_bucket(n_waves: int, lo: int = 4,
@@ -176,6 +184,12 @@ class Scheduler:
         # verdict caught the driver bench labeled "pallas" for rounds
         # that hard-code the XLA formulation)
         self._last_path: Optional[str] = None
+        # preemptions performed by the batched pipeline path (tests +
+        # bench assert the pipeline handled them, not per-wave fallback);
+        # device_preemption=False forces round failures back through the
+        # per-wave host path (the bench's comparison baseline)
+        self.pipeline_preemptions = 0
+        self.device_preemption = True
         self.ecache = (EquivalenceCache()
                        if self.features.enabled("EnableEquivalenceClassCache")
                        else None)
@@ -337,9 +351,13 @@ class Scheduler:
                     break
             if (allow_pipeline and max_waves is None and self.mesh is None
                     and self.queue.active_count() >= 2 * self.wave_size):
+                pre = self.pipeline_preemptions
                 n = self._schedule_pipelined()
                 placed += n
-                if n > 0:
+                if n > 0 or self.pipeline_preemptions > pre:
+                    # preemptions are progress too: victims were evicted,
+                    # the preemptors return after their backoff — keep
+                    # the pipeline on for the follow-up rounds
                     continue
                 # zero progress is systemic (host plugins/extenders in
                 # play, or an unplaceable backlog): disable the pipeline
@@ -583,16 +601,139 @@ class Scheduler:
                         placed += 1
                         continue
                 # device placement rejected by the exact recheck, or the
-                # pod failed on device: the per-wave path owns failure
-                # attribution/preemption — hand it back
+                # pod failed on device: batched device preemption handles
+                # resource-starved failures below; everything else goes
+                # back through the per-wave path for exact attribution
                 self.snapshot.unstage(pod)
                 retry.append(pod)
+        handled = self._pipeline_preempt(retry) if retry else set()
         for pod in retry:
-            self.queue.add_if_not_present(pod)
+            if pod.uid not in handled:
+                self.queue.add_if_not_present(pod)
         trace.step("committed")
         self.metrics.e2e_scheduling_latency.observe(self.clock() - start)
         trace.log_if_long(0.5)
         return placed
+
+    def _pipeline_preempt(self, pods: List[api.Pod]) -> set:
+        """Batched device-side preemption for round failures (SURVEY §7
+        step 6; VERDICT r3 item 3). One XLA program computes the what-if
+        stats for EVERY failed pod x node (ops/preempt.py); the host then
+        runs the exact selectVictimsOnNode + pickOneNodeForPreemption
+        tie-breaks only on the few device-ranked candidates. Returns the
+        uids handled (nominated + parked); the rest fall back to the
+        per-wave path for failure attribution."""
+        if not (self.device_preemption
+                and self.features.enabled("PodPriority")
+                and not self.profile.disable_preemption):
+            return set()
+        cands = [p for p in pods
+                 if pod_eligible_to_preempt_others(p, self.cache)]
+        if not cands:
+            return set()
+        # chunk at wave_size: growing the P bucket would retrace the
+        # round program itself, and later chunks then see earlier
+        # chunks' evictions through the refreshed snapshot; the claimed
+        # map spans chunks so freed capacity is never double-counted
+        handled: set = set()
+        claimed: Dict[str, List[api.Pod]] = {}
+        for i in range(0, len(cands), self.wave_size):
+            handled |= self._preempt_chunk(cands[i:i + self.wave_size],
+                                           claimed)
+        return handled
+
+    def _preempt_chunk(self, cands: List[api.Pod],
+                       claimed: Dict[str, List[api.Pod]]) -> set:
+        import jax.numpy as jnp
+
+        from ..ops.preempt import preemption_stats
+
+        t0 = self.clock()
+        trace = Trace(f"preempt chunk of {len(cands)}", clock=self.clock)
+        pb = self.featurizer.featurize(cands)
+        nt, pm, tt = self.snapshot.to_device()
+        trace.step("featurized+uploaded")
+        # candidate thresholds: distinct priorities of live existing pods
+        # (+1 so "< level" removes that class); always keep the HIGHEST
+        # so the remove-all-lower option survives the level cap
+        live = self.snapshot.ep_valid & self.snapshot.ep_alive
+        prios = sorted({int(x) + 1 for x in self.snapshot.ep_prio[live]})
+        if len(prios) > PREEMPT_LEVELS:
+            prios = prios[:PREEMPT_LEVELS - 1] + [prios[-1]]
+        if not prios:
+            return set()
+        levels = prios + [prios[-1]] * (PREEMPT_LEVELS - len(prios))
+        ok_d, victims_d, psum_d, pmax_d = preemption_stats(
+            nt, pm, pb, jnp.asarray(levels, jnp.int32),
+            num_levels=PREEMPT_LEVELS)
+        trace.step("dispatched")
+        ok = np.asarray(ok_d)
+        victims_n = np.asarray(victims_d)
+        psum = np.asarray(psum_d)
+        pmax = np.asarray(pmax_d)
+        trace.step("fetched")
+        pdbs = self._pdbs()
+        handled: set = set()
+        # `claimed` = capacity claimed by earlier pods in this batch (the
+        # host analog of the reference's nominated-pod accounting in
+        # podFitsOnNode's two-pass logic): without it, one freed node
+        # would absorb every later candidate's validation and the batch
+        # would degenerate to one eviction per round
+        for i, pod in enumerate(cands):
+            cand_nodes = np.nonzero(ok[i])[0]
+            if cand_nodes.size == 0:
+                continue
+            self.metrics.total_preemption_attempts.inc()
+            # device ranking approximates the reference's tie-breaks to
+            # pick the TOP-K; the exact criteria (incl. PDB violations)
+            # re-rank the validated candidates below
+            order = sorted(
+                cand_nodes.tolist(),
+                key=lambda n: (int(pmax[i, n]), float(psum[i, n]),
+                               int(victims_n[i, n])))
+            aff = pod.spec.affinity
+            with_aff = bool(self.snapshot.has_affinity_terms
+                            or (aff is not None
+                                and (aff.pod_affinity is not None
+                                     or aff.pod_anti_affinity is not None)))
+            node_infos = self.cache.node_infos if with_aff else None
+            validated = {}
+            for n in order[:PREEMPT_HOST_CANDIDATES]:
+                name = self.snapshot.node_names[n]
+                ni = self.cache.node_infos.get(name)
+                if ni is None or ni.node is None:
+                    continue
+                if claimed.get(name):
+                    ni = ni.clone()
+                    for cp in claimed[name]:
+                        ni.add_pod(cp)
+                sel = select_victims_on_node(pod, ni, pdbs, node_infos,
+                                             self._host_extra_fit)
+                if sel is not None:
+                    validated[name] = sel
+            if self.profile.extenders:
+                validated = process_preemption_with_extenders(
+                    pod, validated, self.profile.extenders, pdbs)
+            chosen = pick_one_node(validated)
+            if chosen is None:
+                continue
+            victims, nviol = validated[chosen]
+            claimed.setdefault(chosen, []).append(pod)
+            if not victims:
+                # an earlier eviction already freed this node: the pod
+                # fits WITHOUT preempting — requeue and let the next
+                # round place it (the claim above stops later batch
+                # members from also counting on this capacity)
+                continue
+            self._perform_preemption(
+                pod, PreemptionResult(chosen, victims, nviol))
+            self._park_with_backoff(pod)
+            self.pipeline_preemptions += 1
+            handled.add(pod.uid)
+        trace.step("validated+performed")
+        trace.log_if_long(0.5)
+        self.metrics.preemption_evaluation.observe(self.clock() - t0)
+        return handled
 
     def _run_wave(self, pods: List[api.Pod]) -> int:
         import jax

@@ -519,6 +519,12 @@ class APIServer:
         pod, host, port, default_c = self._kubelet_target(namespace, name)
         container = query.get("container", [default_c])[0]
         tail = query.get("tailLines", [None])[0]
+        if tail is not None:
+            try:
+                int(tail)
+            except ValueError:
+                raise APIError(400, "BadRequest",
+                               f"tailLines {tail!r} is not an integer")
         path = (f"/containerLogs/{pod.metadata.namespace}/"
                 f"{pod.metadata.name}/{container}")
         if tail:

@@ -91,6 +91,12 @@ class Snapshot:
         self.ep_node = np.zeros((c.M,), np.int32)
         self.ep_valid = np.zeros((c.M,), bool)
         self.ep_alive = np.zeros((c.M,), bool)
+        # per-pod resource requests + priority: the device-side
+        # preemption what-if subtracts victim rows from node usage
+        # (ops/preempt.py; reference selectVictimsOnNode removes pods
+        # from the cloned NodeInfo, generic_scheduler.go:898)
+        self.ep_req = np.zeros((c.M, c.R), np.float32)
+        self.ep_prio = np.zeros((c.M,), np.int32)
 
     def _alloc_terms(self):
         c = self.caps
@@ -139,6 +145,8 @@ class Snapshot:
         self.ep_node = pad(self.ep_node, (c.M,))
         self.ep_valid = pad(self.ep_valid, (c.M,))
         self.ep_alive = pad(self.ep_alive, (c.M,))
+        self.ep_req = pad(self.ep_req, (c.M, c.R))
+        self.ep_prio = pad(self.ep_prio, (c.M,))
         self.t_kind = pad(self.t_kind, (c.E,))
         self.t_owner = pad(self.t_owner, (c.E,))
         self.t_node = pad(self.t_node, (c.E,))
@@ -328,6 +336,11 @@ class Snapshot:
         self.ep_ns[slot] = v.namespaces.intern(pod.namespace)
         self.ep_node[slot] = node_idx
         self.ep_valid[slot] = active
+        from .node_info import Resource
+
+        self.ep_req[slot, :] = self._res_vec(
+            Resource.from_map(api.get_resource_request(pod)))
+        self.ep_prio[slot] = api.pod_priority(pod)
         self.ep_alive[slot] = (active
                                and pod.metadata.deletion_timestamp is None)
 
@@ -584,7 +597,8 @@ class Snapshot:
             self.dirty_topology = False
         if self.dirty_pods or "pods" not in cache:
             cache["pods"] = jax.device_put(
-                (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid, self.ep_alive),
+                (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid,
+                 self.ep_alive, self.ep_req, self.ep_prio),
                 device,
             )
             cache["terms"] = jax.device_put(
@@ -597,7 +611,8 @@ class Snapshot:
         requested, nonzero, pod_count, ports = cache["res"]
         (alloc, allowed_pods, labels, label_nums, taint_key, taint_val,
          taint_effect, cond, zone_id, img_id, img_size, avoid, valid) = cache["topo"]
-        ep_labels, ep_ns, ep_node, ep_valid, ep_alive = cache["pods"]
+        (ep_labels, ep_ns, ep_node, ep_valid, ep_alive, ep_req,
+         ep_prio) = cache["pods"]
         (t_kind, t_owner, t_node, t_tk, t_weight, t_ns, t_key, t_op, t_vals,
          t_valid) = cache["terms"]
         nt = enc.NodeTensors(
@@ -608,7 +623,8 @@ class Snapshot:
             img_id=img_id, img_size=img_size, avoid=avoid, valid=valid,
         )
         pm = enc.PodMatrix(labels=ep_labels, ns=ep_ns, node=ep_node,
-                           valid=ep_valid, alive=ep_alive)
+                           valid=ep_valid, alive=ep_alive, req=ep_req,
+                           prio=ep_prio)
         tt = enc.TermTable(kind=t_kind, owner=t_owner, node=t_node, tk=t_tk,
                            weight=t_weight, ns=t_ns, key=t_key, op=t_op,
                            vals=t_vals, valid=t_valid)
