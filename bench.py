@@ -16,9 +16,28 @@ measured against the reference's 100 pods/s "healthy" warning level
   antiaffinity  required pod anti-affinity on hostname (the quadratic
                 scheduler_bench_test.go:56 case)
   mixed         25/25/25/25 mix of the above
+  trickle       steady-state regime: pods arrive in sub-wave chunks
+                (default 64) and each chunk is drained before the next
+                lands — the anti-saturation workload; measures the
+                repeated-small-backlog rate, not a big-drain rate
+  preempt       preemption drain: saturated nodes + a high-priority
+                backlog that only places by evicting. Default flags run
+                the batched device what-if (ops/preempt.py) through the
+                pipeline; --host-preempt routes round failures through
+                the host per-pod what-if instead (the comparison
+                baseline), everything else identical, so the pair
+                isolates the preemption component. The driver's host
+                entry runs --wave 16 — the host path's best measured
+                configuration; at the default wave its what-if cascade
+                needs many more scheduling cycles and loses by more.
+  paced         non-saturated latency SLO: pods offered at a fixed rate
+                (--rate, default 200/s) in chunks; reports the per-pod
+                p99 enqueue->bind latency against the reference's 5s
+                pod-startup SLO (test/e2e/scalability/density.go:55).
+                vs_baseline is SLO headroom (5s / p99).
 
---suite runs the 5 BASELINE configs and prints one JSON line each
-(config 5 = 5000 nodes x 30000 pods mixed density).
+--suite runs the BASELINE config grid and prints one JSON line each;
+a bare `python bench.py` (the driver's command) runs DRIVER_SUITE.
 """
 
 import argparse
@@ -192,10 +211,18 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     # (warming it before the growth would compile a UI=8 program the run
     # never calls, leaving a 7-20s recompile inside the window)
     sched.warm_pipeline(warm_pods, n_waves=n_w)
+    if n_w > 1:
+        # tail rounds: stragglers requeued after the big round (exact-
+        # recheck losses, post-preemption retries) re-enter the pipeline
+        # at the smallest wave bucket — warm it too or a tail of 3 pods
+        # pays a full round-program compile inside the measured window
+        sched.warm_pipeline(warm_pods, n_waves=1)
     if workload == "mixed":
         # mixed rounds before the anti-affinity block run the ipa-free
         # program variant at the ipa-capped bucket — warm it too
         sched.warm_pipeline(density_warm, n_waves=n_w)
+        if n_w > 1:
+            sched.warm_pipeline(density_warm, n_waves=1)
     for p in warm_pods:
         store.delete("pods", "default", p.metadata.name)
 
@@ -211,6 +238,102 @@ def run_config(nodes, pods, wave, workload="density", warmup=32):
     p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
     p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
     return placed, dt, p99, p99_round, sched.wave_path()
+
+
+def _warmed_scheduler(nodes, wave, extra_pods=0):
+    """Cluster + scheduler with the 1-wave round program compiled and the
+    degraded-transfer-mode transition absorbed — shared setup for the
+    small-backlog configs (trickle/paced), whose rounds never exceed one
+    wave per chunk."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import Metrics
+
+    store = ObjectStore()
+    caps = Caps(M=bucket_size(extra_pods + 64), P=wave,
+                LV=bucket_size(nodes + 256, 64))
+    sched = Scheduler(store, wave_size=wave, caps=caps)
+    build_cluster(store, nodes)
+    warm = []
+    for i in range(min(wave, 64)):
+        p = _base_pod(api, f"warmup-{i}", "warmup")
+        store.create("pods", p)
+        warm.append(p)
+    sched.warm_pipeline(warm, n_waves=1)
+    for p in warm:
+        store.delete("pods", "default", p.metadata.name)
+    sched.metrics = Metrics()
+    return store, sched, api
+
+
+def run_trickle_config(nodes, pods, wave, chunk=64):
+    """Steady-state regime (round-4 verdict weak #1): the backlog is
+    never more than one sub-wave chunk — the scheduler sees `chunk`
+    pods, drains them, then the next chunk lands. Total wall time spans
+    every drain, so per-round overhead (program dispatch + the single
+    end-of-round fetch) is what this measures. The reference's analog is
+    its one-pod-at-a-time loop at low queue depth
+    (pkg/scheduler/scheduler.go:438)."""
+    store, sched, api = _warmed_scheduler(nodes, wave, extra_pods=pods)
+    made = 0
+    t0 = time.time()
+    placed = 0
+    while made < pods:
+        n = min(chunk, pods - made)
+        for i in range(n):
+            pod = _base_pod(api, f"trickle-pod-{made + i}", "trickle-pod")
+            store.create("pods", pod)
+        made += n
+        placed += sched.schedule_pending()
+    dt = time.time() - t0
+    p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
+    p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    return placed, dt, p99, p99_round, sched.wave_path()
+
+
+def run_paced_config(nodes, pods, wave, rate=200.0, chunk=100):
+    """Non-saturated latency SLO (round-4 verdict item 8): offer pods at
+    a fixed rate and measure per-pod p99 enqueue->bind latency. The
+    reference's load test paces at 10 pods/s (test/e2e/scalability/
+    load.go:124-137) with a 5s pod-startup SLO (density.go:55); this
+    runs >=10x that offered load and reports the p99 against the 5s
+    SLO. Falling behind the offered rate is *measured, not masked*: a
+    chunk that drains slower than its interval delays every later
+    chunk's enqueue->bind clock."""
+    store, sched, api = _warmed_scheduler(nodes, wave, extra_pods=pods)
+    interval = chunk / rate
+    made = 0
+    placed = 0
+    t0 = time.time()
+    next_tick = t0
+    while made < pods:
+        now = time.time()
+        if now < next_tick:
+            time.sleep(next_tick - now)
+        n = min(chunk, pods - made)
+        for i in range(n):
+            pod = _base_pod(api, f"paced-pod-{made + i}", "paced-pod")
+            store.create("pods", pod)
+        made += n
+        next_tick += interval
+        placed += sched.schedule_pending()
+    stalled = 0
+    while placed < pods:
+        time.sleep(0.002)
+        n = sched.schedule_pending()
+        placed += n
+        # an unplaceable remainder makes zero progress forever; bail to
+        # the placed!=pods FATAL instead of spinning
+        stalled = stalled + 1 if n == 0 else 0
+        if stalled > 2000:
+            break
+    dt = time.time() - t0
+    p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
+    offered = pods / dt
+    return placed, dt, p99, offered, sched.wave_path()
 
 
 def run_preempt_config(nodes, pods, wave, device=True):
@@ -234,6 +357,11 @@ def run_preempt_config(nodes, pods, wave, device=True):
     caps = Caps(M=bucket_size(2 * nodes + pods + 64), P=wave,
                 LV=bucket_size(nodes + 256, 64))
     sched = Scheduler(store, wave_size=wave, caps=caps)
+    # the ONLY knob that differs between the two measured paths:
+    # device=False sends round failures through the host per-pod what-if
+    # (sched/preemption.py preempt) instead of the batched device stats
+    # (ops/preempt.py); placement stays pipelined in both so the
+    # comparison isolates the preemption component
     sched.device_preemption = device
     # a near-zero initial backoff so the measurement is work, not the
     # reference's 1s parking window (identical for both paths)
@@ -261,7 +389,7 @@ def run_preempt_config(nodes, pods, wave, device=True):
     out = preemption_stats(nt, pm, pb,
                            jnp.asarray([2] * PREEMPT_LEVELS, jnp.int32),
                            num_levels=PREEMPT_LEVELS)
-    jax.block_until_ready(out[0])
+    jax.block_until_ready(out)
     for p in warm:
         store.delete("pods", "default", p.metadata.name)
 
@@ -273,9 +401,16 @@ def run_preempt_config(nodes, pods, wave, device=True):
         store.create("pods", p)
     t0 = time.time()
     done = sched.schedule_pending()
+    stalled = 0
     while done < pods:
         time.sleep(0.002)
-        done += sched.schedule_pending()
+        n = sched.schedule_pending()
+        done += n
+        # an unplaceable remainder makes zero progress forever; bail to
+        # the placed!=pods FATAL instead of hanging the driver suite
+        stalled = stalled + 1 if n == 0 else 0
+        if stalled > 2000:
+            break
     dt = time.time() - t0
     evicted = int(sched.metrics.pod_preemption_victims.value)
     p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
@@ -303,21 +438,36 @@ def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
           f"p99_round_latency={p99_round*1e3:.0f}ms", file=sys.stderr)
 
 
-# BASELINE.md config grid (target table: 5 configs)
+# BASELINE.md config grid + the preempt/trickle regimes; entries are
+# (name, nodes, pods, workload, extra_flags)
 SUITE = [
-    ("basic", 500, 1000, "density"),
-    ("affinity", 100, 3000, "affinity"),
-    ("spreading", 500, 3000, "spreading"),
-    ("antiaffinity", 500, 2500, "antiaffinity"),
-    ("mixed5k", 5000, 30000, "mixed"),
+    ("basic", 500, 1000, "density", []),
+    ("affinity", 100, 3000, "affinity", []),
+    ("spreading", 500, 3000, "spreading", []),
+    ("antiaffinity", 500, 2500, "antiaffinity", []),
+    ("trickle", 500, 2048, "trickle", []),
+    ("preempt", 50, 100, "preempt", []),
+    ("mixed5k", 5000, 30000, "mixed", []),
 ]
 
 # what a bare `python bench.py` (the driver's fixed command) runs: the
-# reference's density shape AND the 5k/30k north-star config, so every
-# round's driver artifact captures the number that matters
+# reference's density shape, the steady-state regimes (trickle, preempt
+# at DEFAULT flags — the round-4 verdict's 0.3 pods/s cliff, now
+# guarded), the device-vs-host preemption pair (host at wave=16, its
+# best measured configuration), the paced latency SLO, and the 5k/30k
+# north-star config LAST so the parsed headline stays the number that
+# matters
 DRIVER_SUITE = [
-    ("density", 100, 3000, "density"),
-    ("mixed5k", 5000, 30000, "mixed"),
+    ("density", 100, 3000, "density", []),
+    ("trickle", 500, 2048, "trickle", []),
+    ("preempt", 50, 100, "preempt", []),
+    # host baseline at wave=16, its best measured configuration (at the
+    # default wave the host what-if cascade needs many more scheduling
+    # cycles and runs minutes longer while losing by more)
+    ("preempt_host", 50, 100, "preempt", ["--host-preempt",
+                                          "--wave", "16"]),
+    ("paced", 5000, 4000, "paced", []),
+    ("mixed5k", 5000, 30000, "mixed", []),
 ]
 
 
@@ -328,11 +478,13 @@ def run_subprocess_suite(suite, wave, cpu):
     import os
     import subprocess
 
-    for name, nodes, pods, workload in suite:
+    for name, nodes, pods, workload, extra in suite:
         cmd = [sys.executable, os.path.abspath(__file__),
                "--nodes", str(nodes), "--pods", str(pods),
-               "--wave", str(wave), "--workload", workload,
-               "--name", name]
+               "--workload", workload, "--name", name]
+        if "--wave" not in extra:
+            cmd += ["--wave", str(wave)]
+        cmd += extra
         if cpu:
             cmd.append("--cpu")
         r = subprocess.run(cmd, capture_output=True, text=True)
@@ -355,12 +507,20 @@ def main():
     ap.add_argument("--wave", type=int, default=256)
     ap.add_argument("--workload", default=None,
                     choices=["density", "affinity", "spreading",
-                             "antiaffinity", "mixed", "preempt"])
+                             "antiaffinity", "mixed", "preempt",
+                             "trickle", "paced"])
     ap.add_argument("--host-preempt", action="store_true",
-                    help="preempt workload: force the host per-wave "
-                         "preemption path (baseline)")
+                    help="preempt workload: pin the scheduler to the "
+                         "per-wave host path (the comparison baseline; "
+                         "fastest at --wave 16)")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="paced workload: offered load in pods/s")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="trickle/paced: pods per arrival chunk "
+                         "(default: trickle 64, paced 100)")
     ap.add_argument("--suite", action="store_true",
-                    help="run the 5-config BASELINE grid")
+                    help="run the BASELINE config grid plus the "
+                         "trickle/preempt regimes (7 configs)")
     ap.add_argument("--name", default="",
                     help="metric name override (suite subprocesses)")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
@@ -397,6 +557,32 @@ def main():
         placed, dt, p99, p99_round, path = run_preempt_config(
             args.nodes, args.pods, args.wave,
             device=not args.host_preempt)
+    elif args.workload == "trickle":
+        placed, dt, p99, p99_round, path = run_trickle_config(
+            args.nodes, args.pods, args.wave, chunk=args.chunk or 64)
+    elif args.workload == "paced":
+        placed, dt, p99, offered, path = run_paced_config(
+            args.nodes, args.pods, args.wave, rate=args.rate,
+            chunk=args.chunk or 100)
+        if placed != args.pods:
+            print(f"FATAL: paced: placed {placed}/{args.pods}",
+                  file=sys.stderr)
+            sys.exit(1)
+        name = args.name or "paced"
+        print(json.dumps({
+            "metric": f"scheduler_{name}_p99_ms_{args.nodes}n_"
+                      f"{int(args.rate)}pps",
+            "value": round(p99 * 1e3, 1),
+            "unit": "ms",
+            # headroom under the reference's 5s pod-startup SLO at
+            # >=10x its 10 pods/s offered load (load.go:124, density.go:55)
+            "vs_baseline": round(5.0 / p99, 2) if p99 > 0 else 0.0,
+        }), flush=True)
+        print(f"# {name}: placed={placed} wall={dt:.2f}s "
+              f"offered={offered:.0f}pods/s (target {args.rate:.0f}) "
+              f"wave={args.wave} path={path} p99_pod_latency={p99*1e3:.0f}ms",
+              file=sys.stderr)
+        return
     else:
         placed, dt, p99, p99_round, path = run_config(
             args.nodes, args.pods, args.wave, args.workload)

@@ -31,6 +31,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import encoding as enc
 from .filters import static_predicate_masks
@@ -39,13 +40,22 @@ NEG = jnp.int32(-(2**31) + 1)
 
 
 class PreemptStats:
-    """Host-side container for the fetched [P, N] stat planes."""
+    """Host view over ONE fetched [4, P, N] i32 plane stack. Packing the
+    four stat planes into a single array matters on tunneled TPU
+    runtimes: each separate device->host fetch pays a flat ~65ms in the
+    degraded transfer mode, so four fetches per preemption chunk would
+    triple the chunk's device cost. Planes 0-2 (ok, victim count,
+    priority max) are native i32 — exact for the full int32 priority
+    range (Kubernetes permits ~2e9); plane 3 is the f32 priority SUM
+    bitcast to i32 for the ride and viewed back here."""
 
     __slots__ = ("ok", "victims", "prio_sum", "prio_max")
 
-    def __init__(self, ok, victims, prio_sum, prio_max):
-        self.ok, self.victims = ok, victims
-        self.prio_sum, self.prio_max = prio_sum, prio_max
+    def __init__(self, packed):
+        self.ok = packed[0] != 0            # [P, N] bool
+        self.victims = packed[1]            # [P, N] i32
+        self.prio_max = packed[2]           # [P, N] i32 (NEG sentinel)
+        self.prio_sum = np.ascontiguousarray(packed[3]).view(np.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_levels",))
@@ -55,10 +65,11 @@ def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
     (pad with INT32_MAX). Victim class at level l for failed pod p =
     alive existing pods with priority < min(levels[l], prio_p).
 
-    Returns (ok [P,N] bool, victims [P,N] i32, prio_sum [P,N] f32,
-    prio_max [P,N] i32) — stats of the lowest feasible level; prio_max
-    is NEG where victims == 0 (a no-victim placement is ranked best by
-    the host, matching pickOneNodeForPreemption's early return)."""
+    Returns ONE packed i32 [4, P, N] array (see PreemptStats): plane 0
+    ok, 1 victim count, 2 priority max, 3 f32 priority sum bitcast to
+    i32 — stats of the lowest feasible level; prio_max is NEG where
+    victims == 0 (a no-victim placement is ranked best by the host,
+    matching pickOneNodeForPreemption's early return)."""
     P = pb.req.shape[0]
     N = nt.valid.shape[0]
     R = nt.alloc.shape[1]
@@ -121,4 +132,7 @@ def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
     # a node where the pod fits with ZERO victims is not a preemption
     # candidate at all (it would have been placed) — unless usage raced;
     # keep it, the host recheck resolves
-    return ok, victims, prio_sum, prio_max
+    return jnp.stack([ok.astype(jnp.int32),
+                      victims,
+                      prio_max,
+                      jax.lax.bitcast_convert_type(prio_sum, jnp.int32)])

@@ -337,9 +337,16 @@ class Scheduler:
         binds so the store state is settled on return. Returns pods
         placed (assumed + bind dispatched).
 
-        Large backlogs take the device-resident pipeline first (see
-        _schedule_pipelined); stragglers and failures fall through to the
-        per-wave loop below."""
+        EVERY backlog — one pod or thirty thousand — takes the
+        device-resident pipeline first (see _schedule_pipelined):
+        on tunneled TPU runtimes the per-wave loop pays a degraded
+        device->host fetch per wave, which turns a 100-pod trickle into
+        minutes (round-4 verdict measured 0.3 pods/s at 50n/100p). The
+        round program buckets its wave count down to the backlog
+        (pipeline_bucket), so a sub-wave backlog runs a 4-iteration
+        program with one fetch. Stragglers and failures fall through to
+        the per-wave loop below, which owns failure attribution,
+        extenders, and mesh sharding."""
         placed = 0
         waves = 0
         allow_pipeline = True
@@ -349,8 +356,12 @@ class Scheduler:
                 self.wait_for_binds()
                 if self.queue.active_count() == 0:
                     break
+            # extenders / policy host priorities force per-wave host
+            # evaluation anyway — attempting the pipeline first would
+            # double every extender webhook call just to bail out
             if (allow_pipeline and max_waves is None and self.mesh is None
-                    and self.queue.active_count() >= 2 * self.wave_size):
+                    and not self.profile.extenders
+                    and not self.profile.host_scores):
                 pre = self.pipeline_preemptions
                 n = self._schedule_pipelined()
                 placed += n
@@ -637,16 +648,18 @@ class Scheduler:
         # map spans chunks so freed capacity is never double-counted
         handled: set = set()
         claimed: Dict[str, List[api.Pod]] = {}
+        exhausted: Dict[str, int] = {}
         for i in range(0, len(cands), self.wave_size):
             handled |= self._preempt_chunk(cands[i:i + self.wave_size],
-                                           claimed)
+                                           claimed, exhausted)
         return handled
 
     def _preempt_chunk(self, cands: List[api.Pod],
-                       claimed: Dict[str, List[api.Pod]]) -> set:
+                       claimed: Dict[str, List[api.Pod]],
+                       exhausted: Dict[str, int]) -> set:
         import jax.numpy as jnp
 
-        from ..ops.preempt import preemption_stats
+        from ..ops.preempt import PreemptStats, preemption_stats
 
         t0 = self.clock()
         trace = Trace(f"preempt chunk of {len(cands)}", clock=self.clock)
@@ -663,14 +676,13 @@ class Scheduler:
         if not prios:
             return set()
         levels = prios + [prios[-1]] * (PREEMPT_LEVELS - len(prios))
-        ok_d, victims_d, psum_d, pmax_d = preemption_stats(
+        packed = preemption_stats(
             nt, pm, pb, jnp.asarray(levels, jnp.int32),
             num_levels=PREEMPT_LEVELS)
         trace.step("dispatched")
-        ok = np.asarray(ok_d)
-        victims_n = np.asarray(victims_d)
-        psum = np.asarray(psum_d)
-        pmax = np.asarray(pmax_d)
+        st = PreemptStats(np.asarray(packed))  # ONE fetch for all planes
+        ok, victims_n = st.ok, st.victims
+        psum, pmax = st.prio_sum, st.prio_max
         trace.step("fetched")
         pdbs = self._pdbs()
         handled: set = set()
@@ -689,8 +701,8 @@ class Scheduler:
             # re-rank the validated candidates below
             order = sorted(
                 cand_nodes.tolist(),
-                key=lambda n: (int(pmax[i, n]), float(psum[i, n]),
-                               int(victims_n[i, n])))
+                key=lambda n: (float(pmax[i, n]), float(psum[i, n]),
+                               float(victims_n[i, n])))
             aff = pod.spec.affinity
             with_aff = bool(self.snapshot.has_affinity_terms
                             or (aff is not None
@@ -698,11 +710,29 @@ class Scheduler:
                                      or aff.pod_anti_affinity is not None)))
             node_infos = self.cache.node_infos if with_aff else None
             validated = {}
-            for n in order[:PREEMPT_HOST_CANDIDATES]:
+            tried = 0
+            for n in order:
+                if tried >= PREEMPT_HOST_CANDIDATES:
+                    break
                 name = self.snapshot.node_names[n]
                 ni = self.cache.node_infos.get(name)
                 if ni is None or ni.node is None:
                     continue
+                # a node that already FAILED validation at (or below)
+                # its current claim count can't absorb another preemptor
+                # — skip it WITHOUT spending a validation slot. Identical
+                # failed pods all rank the same few nodes first; without
+                # this the batch exhausts its top-K on claimed nodes and
+                # the round degenerates to one preemption chunk per
+                # device round-trip. Marking on observed failure (not a
+                # predicted victim count) keeps both directions honest:
+                # a claimed node that can evict FURTHER victims, or
+                # whose earlier eviction freed surplus capacity, still
+                # gets validated once before being written off.
+                if (name in exhausted
+                        and len(claimed.get(name, ())) >= exhausted[name]):
+                    continue
+                tried += 1
                 if claimed.get(name):
                     ni = ni.clone()
                     for cp in claimed[name]:
@@ -711,6 +741,10 @@ class Scheduler:
                                              self._host_extra_fit)
                 if sel is not None:
                     validated[name] = sel
+                elif claimed.get(name):
+                    # validation failures on an UNclaimed node are pod-
+                    # specific (PDB, affinity) — don't block other pods
+                    exhausted[name] = len(claimed[name])
             if self.profile.extenders:
                 validated = process_preemption_with_extenders(
                     pod, validated, self.profile.extenders, pdbs)

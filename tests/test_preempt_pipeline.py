@@ -48,11 +48,12 @@ class TestPreemptionStatsKernel:
         vip = make_pod("vip", cpu="2", priority=100)
         pb = sched.featurizer.featurize([vip])
         nt, pm, tt = sched.snapshot.to_device()
-        ok, victims, psum, pmax = preemption_stats(
+        from kubernetes_tpu.ops.preempt import PreemptStats
+
+        st = PreemptStats(np.asarray(preemption_stats(
             nt, pm, pb, jnp.asarray([2, 2, 2, 2, 2, 2, 2, 2], jnp.int32),
-            num_levels=8)
-        ok = np.asarray(ok)
-        victims = np.asarray(victims)
+            num_levels=8)))
+        ok, victims = st.ok, st.victims
         i0 = sched.snapshot.node_index["n0"]
         i1 = sched.snapshot.node_index["n1"]
         assert ok[0, i0]
@@ -74,13 +75,15 @@ class TestPreemptionStatsKernel:
         vip = make_pod("vip", cpu="1", priority=100)
         pb = sched.featurizer.featurize([vip])
         nt, pm, tt = sched.snapshot.to_device()
-        ok, victims, psum, pmax = preemption_stats(
+        from kubernetes_tpu.ops.preempt import PreemptStats
+
+        st = PreemptStats(np.asarray(preemption_stats(
             nt, pm, pb, jnp.asarray([2, 51, 51, 51, 51, 51, 51, 51],
-                                    jnp.int32), num_levels=8)
+                                    jnp.int32), num_levels=8)))
         i0 = sched.snapshot.node_index["n0"]
-        assert np.asarray(ok)[0, i0]
-        assert np.asarray(victims)[0, i0] == 1
-        assert np.asarray(pmax)[0, i0] == 1  # the cheap pod's priority
+        assert st.ok[0, i0]
+        assert st.victims[0, i0] == 1
+        assert st.prio_max[0, i0] == 1  # the cheap pod's priority
 
 
 class TestPipelinePreemption:
