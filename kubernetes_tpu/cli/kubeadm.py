@@ -41,17 +41,20 @@ class Cluster:
             self.store = ObjectStore()
         authenticator = authorizer = None
         self.admin_token = self.bootstrap_token = None
+        self.ca = None
         if secure:
             # init.go's certs + bootstrap-token + RBAC phases: cluster
-            # CA, admin + join credentials, RBAC evaluated from served
-            # API objects (runtime-reconfigurable)
+            # CA, an HTTPS serving cert from it, admin + join
+            # credentials, RBAC evaluated from served API objects
+            # (runtime-reconfigurable). x509 identity comes from the TLS
+            # handshake's verified peer chain.
             import secrets as _secrets
 
             from ..server import pki
             from ..server.auth import (AuthenticatorChain, RBACAuthorizer,
                                        UserInfo, cluster_admin_bindings)
 
-            ca = pki.ensure_cluster_ca(self.store)
+            self.ca = ca = pki.ensure_cluster_ca(self.store)
             self.admin_token = f"admin-{_secrets.token_hex(8)}"
             self.bootstrap_token = f"bootstrap-{_secrets.token_hex(8)}"
             authenticator = AuthenticatorChain(
@@ -68,12 +71,28 @@ class Cluster:
                 bindings=cluster_admin_bindings(["system:masters"]),
                 store=self.store)
             self._seed_rbac()
+            self._publish_cluster_info()
         self.apiserver = APIServer(
             self.store, admission=AdmissionChain.default(), port=port,
             authenticator=authenticator, authorizer=authorizer,
-            reconcile_endpoints=reconcile_endpoints)
+            reconcile_endpoints=reconcile_endpoints, tls=self.ca)
         self.manager = ControllerManager(self.store)
-        self.scheduler = Scheduler(self.store)
+        # the scheduler runs as an API CLIENT over a loopback watch
+        # mirror — the reference's deployment shape (kube-scheduler
+        # connects via client-go, cmd/kube-scheduler). Running it on the
+        # raw shared store would invert Scheduler._mu against the store
+        # lock: an apiserver handler thread mutating the store dispatches
+        # informer events UNDER the store lock into scheduler handlers
+        # that take _mu, while a scheduling wave holds _mu and writes the
+        # store (observed deadlock under kubelet heartbeat load).
+        from ..client.reflector import RemoteStore
+        from ..client.rest import RESTClient
+
+        self._sched_client = RESTClient(
+            self.apiserver.url, token=self.admin_token,
+            ca_cert_pem=self.ca.ca_cert_pem if self.ca else None)
+        self._sched_store = RemoteStore(self._sched_client)
+        self.scheduler = Scheduler(self._sched_store)
         self.hollow = None
         self._hollow_nodes = hollow_nodes
         self._stop = threading.Event()
@@ -111,6 +130,46 @@ class Cluster:
         except Conflict:
             pass
 
+    def _publish_cluster_info(self):
+        """The cluster-info ConfigMap in kube-public, readable
+        anonymously — how a joiner learns the CA bundle before it can
+        authenticate (reference: clusterinfo phase publishes a
+        kubeconfig with the CA; BootstrapSigner makes it verifiable.
+        Here the joiner fetches it trust-on-first-use over TLS — a
+        documented simplification of the JWS-hash check)."""
+        from ..runtime.store import Conflict
+
+        for obj_kind, obj in (
+            ("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="kube-public"),
+                status=api.NamespaceStatus(phase="Active"))),
+            ("configmaps", api.ConfigMap(
+                metadata=api.ObjectMeta(name="cluster-info",
+                                        namespace="kube-public"),
+                data={"ca.crt": self.ca.ca_cert_pem})),
+            ("roles", api.Role(
+                metadata=api.ObjectMeta(name="kubeadm:bootstrap-signer",
+                                        namespace="kube-public"),
+                rules=[api.RBACPolicyRule(
+                    verbs=["get"], api_groups=[""],
+                    resources=["configmaps"],
+                    resource_names=["cluster-info"])])),
+            ("rolebindings", api.RoleBinding(
+                metadata=api.ObjectMeta(name="kubeadm:cluster-info",
+                                        namespace="kube-public"),
+                subjects=[
+                    api.RBACSubject(kind="Group",
+                                    name="system:unauthenticated"),
+                    api.RBACSubject(kind="Group",
+                                    name="system:authenticated")],
+                role_ref=api.RoleRef(kind="Role",
+                                     name="kubeadm:bootstrap-signer"))),
+        ):
+            try:
+                self.store.create(obj_kind, obj)
+            except Conflict:
+                pass
+
     @property
     def url(self) -> str:
         return self.apiserver.url
@@ -142,6 +201,7 @@ class Cluster:
             self._sched_thread.join(timeout=5)
         if self.hollow is not None:
             self.hollow.stop()
+        self._sched_store.stop()
         self.manager.stop()
         self.apiserver.stop()
         close = getattr(self.store, "close", None)
@@ -203,20 +263,36 @@ def cmd_init(args) -> int:
     return 0
 
 
+def fetch_cluster_ca(server: str) -> str:
+    """Trust-on-first-use CA discovery: read the anonymous cluster-info
+    ConfigMap (kube-public) over an UNVERIFIED TLS connection and return
+    its CA bundle; every later connection verifies against it.
+    Reference: the discovery phase's cluster-info fetch; the JWS
+    token-signature check is simplified to TOFU (documented)."""
+    from ..client.rest import RESTClient
+
+    tofu = RESTClient(server, insecure_skip_verify=True)
+    info = tofu.get("configmaps", "kube-public", "cluster-info")
+    return info.data["ca.crt"]
+
+
 def join_with_csr(server: str, node_name: str, bootstrap_token: str,
-                  timeout: float = 15.0):
+                  timeout: float = 15.0, ca_cert_pem: Optional[str] = None):
     """kubeadm join's TLS-bootstrap phase: using only the bootstrap
     token, generate a key + CSR for system:node:<name>, submit it, wait
-    for the approver+signer controllers, and return (key_pem, cert_pem)
-    — the kubelet credential every later request authenticates with.
-    Reference: cmd/kubeadm/app/phases/kubelet (bootstrap kubeconfig) +
-    pkg/controller/certificates/."""
+    for the approver+signer controllers, and return (key_pem, cert_pem,
+    ca_cert_pem) — the kubelet mTLS credential + trust bundle every
+    later request uses. Reference: cmd/kubeadm/app/phases/kubelet
+    (bootstrap kubeconfig) + pkg/controller/certificates/."""
     import secrets as _secrets
 
     from ..client.rest import RESTClient
     from ..server import pki
 
-    boot = RESTClient(server, token=bootstrap_token)
+    if ca_cert_pem is None and server.startswith("https"):
+        ca_cert_pem = fetch_cluster_ca(server)
+    boot = RESTClient(server, token=bootstrap_token,
+                      ca_cert_pem=ca_cert_pem)
     key_pem, csr_pem = pki.make_csr(f"system:node:{node_name}",
                                     ("system:nodes",))
     # random suffix, like real kubeadm's node-csr-<rand>: a re-join
@@ -234,7 +310,7 @@ def join_with_csr(server: str, node_name: str, bootstrap_token: str,
     while time.monotonic() < deadline:
         got = boot.get("certificatesigningrequests", "", csr_name)
         if got.status.certificate:
-            return key_pem, got.status.certificate
+            return key_pem, got.status.certificate, ca_cert_pem
         time.sleep(0.05)
     raise TimeoutError(f"CSR for {node_name} was not signed "
                        f"within {timeout}s")
@@ -245,14 +321,19 @@ def cmd_join(args) -> int:
     from ..client.rest import RESTClient
     from ..kubemark.hollow import HollowNode
 
-    cert_pem = key_pem = None
+    cert_pem = key_pem = ca_pem = None
     if args.bootstrap_token:
-        key_pem, cert_pem = join_with_csr(args.server, args.node_name,
-                                          args.bootstrap_token)
+        key_pem, cert_pem, ca_pem = join_with_csr(
+            args.server, args.node_name, args.bootstrap_token)
         print(f"obtained kubelet client cert for "
-              f"system:node:{args.node_name} via CSR")
+              f"system:node:{args.node_name} via CSR (mTLS)")
+    elif args.server.startswith("https"):
+        # tokenless join against a secure server still needs the CA
+        # bundle to talk TLS at all (anonymous-readable cluster-info)
+        ca_pem = fetch_cluster_ca(args.server)
     store = RemoteStore(RESTClient(args.server, client_cert_pem=cert_pem,
-                                   client_key_pem=key_pem))
+                                   client_key_pem=key_pem,
+                                   ca_cert_pem=ca_pem))
     for kind in ("pods", "nodes"):
         store.mirror(kind)
     store.wait_for_sync()

@@ -513,6 +513,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="API server URL (default $KUBECTL_SERVER)")
     ap.add_argument("--token", default=None)
     ap.add_argument("--namespace", "-n", default="default")
+    # kubeconfig's certificate-authority / client-certificate analogs
+    # (TLS clusters; PEM data inline or @/path/to/file)
+    ap.add_argument("--ca-cert-data", default=None,
+                    help="cluster CA bundle PEM (or @file) for https "
+                         "servers")
+    ap.add_argument("--client-cert-data", default=None,
+                    help="x509 client cert PEM (or @file) for mTLS")
+    ap.add_argument("--client-key-data", default=None,
+                    help="x509 client key PEM (or @file) for mTLS")
     sub = ap.add_subparsers(dest="verb", required=True)
 
     g = sub.add_parser("get")
@@ -600,7 +609,16 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     if not server:
         print("error: --server or $KUBECTL_SERVER required", file=sys.stderr)
         return 1
-    client = RESTClient(server, token=args.token)
+    def _pem(v):
+        if v and v.startswith("@"):
+            with open(v[1:]) as f:
+                return f.read()
+        return v
+
+    client = RESTClient(server, token=args.token,
+                        ca_cert_pem=_pem(args.ca_cert_data),
+                        client_cert_pem=_pem(args.client_cert_data),
+                        client_key_pem=_pem(args.client_key_data))
     try:
         # discovery: register served CRDs so custom kinds resolve in
         # _resolve_kind / decode (the reference kubectl's RESTMapper

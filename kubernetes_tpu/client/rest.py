@@ -30,28 +30,47 @@ class RESTClient:
                  user_agent: str = "kubernetes-tpu-client",
                  binary: bool = False,
                  client_cert_pem: Optional[str] = None,
-                 client_key_pem: Optional[str] = None):
+                 client_key_pem: Optional[str] = None,
+                 ca_cert_pem: Optional[str] = None,
+                 insecure_skip_verify: bool = False):
         """binary=True negotiates the compact binary wire codec for GETs
         (api/binary.py — the reference's
-        application/vnd.kubernetes.protobuf role). client_cert_pem +
-        client_key_pem form an x509 client credential issued by the
-        cluster CA (kubeadm join / CSR flow): the cert rides base64 in
-        X-Client-Cert and the key signs a possession proof header — the
-        plain-HTTP stand-in for TLS client auth."""
+        application/vnd.kubernetes.protobuf role).
+
+        TLS (https base_url): ca_cert_pem is the kubeconfig
+        certificate-authority-data analog — the server's chain must
+        verify against it. client_cert_pem + client_key_pem form an
+        x509 client credential issued by the cluster CA (kubeadm join /
+        CSR flow), presented in the TLS handshake (mTLS); the server
+        reads the identity from the verified peer chain.
+        insecure_skip_verify skips server verification — used only by
+        kubeadm join's trust-on-first-use cluster-info fetch."""
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.user_agent = user_agent
         self.binary = binary
-        self._cert_b64 = self._cert_proof = None
-        if client_cert_pem:
-            import base64 as _b64
+        self._ssl_ctx = None
+        if self.base_url.startswith("https"):
+            if insecure_skip_verify:
+                import ssl
 
-            self._cert_b64 = _b64.b64encode(client_cert_pem.encode()).decode()
-            if client_key_pem:
+                self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                self._ssl_ctx.check_hostname = False
+                self._ssl_ctx.verify_mode = ssl.CERT_NONE
+                if client_cert_pem and client_key_pem:
+                    from ..server.pki import _load_cert_chain
+
+                    _load_cert_chain(self._ssl_ctx, client_cert_pem,
+                                     client_key_pem)
+            elif ca_cert_pem:
                 from ..server import pki
 
-                self._cert_proof = pki.sign_proof(client_key_pem,
-                                                  client_cert_pem)
+                self._ssl_ctx = pki.client_ssl_context(
+                    ca_cert_pem, client_cert_pem, client_key_pem)
+            else:
+                raise ValueError(
+                    "https server requires ca_cert_pem (or, for the "
+                    "bootstrap cluster-info fetch, insecure_skip_verify)")
 
     # -- plumbing --------------------------------------------------------------
 
@@ -94,12 +113,9 @@ class RESTClient:
             req.add_header("Accept", accept)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        if self._cert_b64:
-            req.add_header("X-Client-Cert", self._cert_b64)
-            if self._cert_proof:
-                req.add_header("X-Client-Cert-Proof", self._cert_proof)
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=self._ssl_ctx) as resp:
                 return resp.read(), resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             try:
@@ -211,13 +227,10 @@ class RESTClient:
         req.add_header("User-Agent", self.user_agent)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        if self._cert_b64:
-            req.add_header("X-Client-Cert", self._cert_b64)
-            if self._cert_proof:
-                req.add_header("X-Client-Cert-Proof", self._cert_proof)
         kind = scheme.kind_for_plural(plural)
         try:
-            resp = urllib.request.urlopen(req, timeout=timeout_seconds + 10)
+            resp = urllib.request.urlopen(req, timeout=timeout_seconds + 10,
+                                          context=self._ssl_ctx)
         except urllib.error.HTTPError as e:
             try:
                 status = json.loads(e.read())

@@ -94,14 +94,17 @@ class Kubelet:
 
     # -- node registration + heartbeat (kubelet_node_status.go) ----------------
 
-    def serve(self, host: str = "127.0.0.1", port: int = 0):
-        """Start the kubelet HTTP server (pkg/kubelet/server/server.go)
-        and publish its port on the Node's status
+    def serve(self, host: str = "127.0.0.1", port: int = 0, tls=None):
+        """Start the kubelet HTTP(S) server (pkg/kubelet/server/
+        server.go) and publish its port on the Node's status
         (NodeDaemonEndpoints.KubeletEndpoint) so the apiserver's
-        pods/<name>/log and /exec proxies can reach it."""
+        pods/<name>/log and /exec proxies can reach it. tls: the
+        cluster's pki.ClusterCA — serves mTLS and gates exec/logs to
+        apiserver/admin identities (kubelet/server.py)."""
         from .server import KubeletServer
 
-        self.server = KubeletServer(self, host=host, port=port).start()
+        self.server = KubeletServer(self, host=host, port=port,
+                                    tls=tls).start()
         self.register_node()
         self._publish_kubelet_port()
         return self.server

@@ -7,11 +7,20 @@ server the apiserver proxies into for the debugging plane:
   GET  /pods                                   (server.go getPods)
   GET  /healthz
 
-Divergences, deliberate: plain HTTP (the cluster's header-borne x509
-model, see server/auth.py), and exec is a one-shot JSON request/response
-against the fake runtime's canned runner instead of a SPDY/websocket
-stream — the control flow (apiserver proxy -> kubelet -> runtime) is
-the part being reproduced.
+Security: with `tls` set (a pki.ClusterCA) the server speaks HTTPS with
+a CA-issued serving cert and REQUIRES a CA-issued client cert in the
+handshake; exec/containerLogs additionally demand the caller be the
+apiserver's kubelet-client identity or a system:masters holder — so the
+apiserver's RBAC check on pods/exec cannot be bypassed by connecting to
+the kubelet port directly (the reference delegates kubelet authz to the
+apiserver via SubjectAccessReview; the cert-identity gate is this
+framework's collapsed form). Without `tls` (embedded/test clusters) the
+server is plain HTTP and open — matching the in-process store's trust
+model where every component already shares memory.
+
+Divergence, deliberate: exec is a one-shot JSON request/response against
+the fake runtime instead of a SPDY/websocket stream — the control flow
+(apiserver proxy -> kubelet -> runtime) is the part being reproduced.
 """
 
 from __future__ import annotations
@@ -26,8 +35,10 @@ from ..api import scheme
 
 
 class KubeletServer:
-    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
         self.kubelet = kubelet
+        self._tls = tls
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -49,6 +60,15 @@ class KubeletServer:
                 outer._handle(self, "POST")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        if tls is not None:
+            from ..server import pki
+
+            key_pem, cert_pem = pki.issue_server_cert(
+                tls, f"system:node:{kubelet.node_name}")
+            pki.wrap_http_server(self._httpd, pki.server_ssl_context(
+                tls.ca_cert_pem, cert_pem, key_pem,
+                require_client_cert=True))
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -74,12 +94,29 @@ class KubeletServer:
             return None  # only pods bound to THIS node are served
         return pod
 
+    def _authorized(self, h) -> bool:
+        """Exec/log callers must hold the apiserver's kubelet-client
+        identity or system:masters (see module docstring). Plain-HTTP
+        servers (tls=None) don't gate — in-process trust model."""
+        if self._tls is None:
+            return True
+        from ..server import pki
+
+        peer = pki.peer_identity(h.connection)
+        if peer is None:
+            return False
+        cn, orgs = peer
+        return cn == "kube-apiserver" or "system:masters" in orgs
+
     def _handle(self, h, method: str):
         parsed = urlparse(h.path)
         parts = [p for p in parsed.path.split("/") if p]
         query = parse_qs(parsed.query)
         if parts == ["healthz"]:
             return h._send(200, b"ok", "text/plain")
+        if parts and parts[0] in ("containerLogs", "exec", "attach",
+                                  "portForward") and not self._authorized(h):
+            return h._send(403, b"forbidden", "text/plain")
         if parts == ["pods"] and method == "GET":
             pods = [p for p in self.kubelet.store.list("pods")
                     if p.spec.node_name == self.kubelet.node_name]

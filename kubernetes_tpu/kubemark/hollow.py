@@ -26,16 +26,17 @@ class HollowNode:
                  with_proxy: bool = False,
                  start_latency: float = 0.0,
                  heartbeat_period: float = 10.0,
-                 serve: bool = False):
-        """serve=True starts the kubelet HTTP server (logs/exec plane) —
-        what `kubectl logs` reaches through the apiserver proxy."""
+                 serve: bool = False, tls=None):
+        """serve=True starts the kubelet HTTP(S) server (logs/exec
+        plane) — what `kubectl logs` reaches through the apiserver
+        proxy; tls (a pki.ClusterCA) makes it mTLS-only."""
         self.name = name
         self.runtime = FakeRuntime(start_latency=start_latency)
         self.kubelet = Kubelet(store, name, allocatable=allocatable,
                                labels=labels, runtime=self.runtime,
                                heartbeat_period=heartbeat_period)
         if serve:
-            self.kubelet.serve()
+            self.kubelet.serve(tls=tls)
         self.proxy = Proxier(store, node_name=name) if with_proxy else None
 
     def run(self, period: float = 1.0) -> "HollowNode":

@@ -28,7 +28,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, quote, urlparse
 
 from ..api import scheme
 from ..api import types as api
@@ -166,7 +166,7 @@ class APIServer:
                  host: str = "127.0.0.1", port: int = 0,
                  reconcile_endpoints: bool = False,
                  max_in_flight: int = 0, max_mutating_in_flight: int = 0,
-                 audit_policy: str = "Metadata"):
+                 audit_policy: str = "Metadata", tls=None):
         self.store = store
         self.broadcaster = Broadcaster(store)
         self.authenticator = authenticator
@@ -249,7 +249,33 @@ class APIServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
-        self.url = f"http://{host}:{self.port}"
+        # tls: a pki.ClusterCA. Serve HTTPS with a CA-issued serving
+        # cert; client certs are verified by the handshake against the
+        # same CA and their subject becomes the request's x509 identity
+        # (authentication/request/x509/x509.go:76 reads the verified
+        # peer chain from the TLS layer — the real thing, not a header).
+        self._tls = tls
+        self._kubelet_client_ctx = None
+        if tls is not None:
+            from . import pki
+
+            key_pem, cert_pem = pki.issue_server_cert(
+                tls, "kube-apiserver",
+                dns_sans=("localhost", "kubernetes", "kubernetes.default",
+                          "kubernetes.default.svc"),
+                ip_sans=("127.0.0.1",))
+            pki.wrap_http_server(self.httpd, pki.server_ssl_context(
+                tls.ca_cert_pem, cert_pem, key_pem))
+            # the apiserver is itself a TLS CLIENT toward kubelets (the
+            # exec/log proxy); kubelet servers demand a CA-issued client
+            # cert, so mint the kubelet-client identity the reference
+            # keeps in apiserver-kubelet-client.crt
+            ck_pem, ccsr = pki.make_csr("kube-apiserver",
+                                        ("system:masters",))
+            self._kubelet_client_ctx = pki.client_ssl_context(
+                tls.ca_cert_pem, tls.sign_csr(ccsr), ck_pem)
+        scheme_str = "https" if tls is not None else "http"
+        self.url = f"{scheme_str}://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle -------------------------------------------------------------
@@ -296,9 +322,14 @@ class APIServer:
         # always-allowed /healthz delegating authorizer path)
         user = None
         if self.authenticator is not None and parts != ["healthz"]:
+            peer = None
+            if self._tls is not None:
+                from . import pki
+
+                peer = pki.peer_identity(h.connection)
             auth_req = getattr(self.authenticator, "authenticate_request",
                                None)
-            user = (auth_req(h.headers) if auth_req is not None else
+            user = (auth_req(h.headers, peer) if auth_req is not None else
                     self.authenticator.authenticate(
                         h.headers.get("Authorization")))
             if user is None:
@@ -498,7 +529,11 @@ class APIServer:
     def _kubelet_proxy(self, h, method, host, port, path, body=None):
         import http.client
 
-        conn = http.client.HTTPConnection(host, port, timeout=10)
+        if self._kubelet_client_ctx is not None:
+            conn = http.client.HTTPSConnection(
+                host, port, timeout=10, context=self._kubelet_client_ctx)
+        else:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
         try:
             conn.request(method, path, body=body,
                          headers={"Content-Type": "application/json"})
@@ -508,8 +543,15 @@ class APIServer:
                     resp.getheader("Content-Type", "text/plain"))
             return True
         except OSError as e:
+            hint = ""
+            if self._kubelet_client_ctx is not None:
+                # a TLS cluster requires TLS kubelets (the reference's
+                # kubelet always serves HTTPS); a plain-HTTP kubelet
+                # registered in a secure cluster fails the handshake here
+                hint = (" (secure cluster: the kubelet must serve TLS — "
+                        "Kubelet.serve(tls=cluster_ca))")
             raise APIError(503, "ServiceUnavailable",
-                           f"kubelet unreachable: {e}")
+                           f"kubelet unreachable: {e}{hint}")
         finally:
             conn.close()
 
@@ -525,8 +567,11 @@ class APIServer:
             except ValueError:
                 raise APIError(400, "BadRequest",
                                f"tailLines {tail!r} is not an integer")
-        path = (f"/containerLogs/{pod.metadata.namespace}/"
-                f"{pod.metadata.name}/{container}")
+        # quote: the container name is client-controlled — unescaped
+        # '/', '?', '#' would rewrite the proxied kubelet path
+        path = (f"/containerLogs/{quote(pod.metadata.namespace, safe='')}/"
+                f"{quote(pod.metadata.name, safe='')}/"
+                f"{quote(container, safe='')}")
         if tail:
             path += f"?tailLines={tail}"
         return self._kubelet_proxy(h, "GET", host, port, path)
@@ -537,8 +582,9 @@ class APIServer:
         pod, host, port, default_c = self._kubelet_target(namespace, name)
         data = self._read_body(h)
         container = data.get("container") or default_c
-        path = (f"/exec/{pod.metadata.namespace}/"
-                f"{pod.metadata.name}/{container}")
+        path = (f"/exec/{quote(pod.metadata.namespace, safe='')}/"
+                f"{quote(pod.metadata.name, safe='')}/"
+                f"{quote(str(container), safe='')}")
         return self._kubelet_proxy(h, "POST", host, port, path,
                                    body=json.dumps(
                                        {"command": data.get("command")}))

@@ -5,10 +5,12 @@ Authentication (request chain, apiserver/pkg/authentication/):
   * service-account JWTs (pkg/serviceaccount/jwt.go) — signature plus
     liveness of the SA and its Secret
   * x509 client certs (authentication/request/x509/x509.go:76
-    CommonNameUserConversion) — CN=user, O=groups, chained to the
-    cluster CA (server/pki.py). The server speaks plain HTTP, so the
-    PEM rides base64 in the X-Client-Cert header instead of the TLS
-    handshake; verification is identical.
+    CommonNameUserConversion) — CN=user, O=groups, verified against the
+    cluster CA by the TLS handshake itself (server/pki.py
+    server_ssl_context); the server hands the verified peer subject to
+    the chain. There is no header-borne cert path: a cert only
+    authenticates over a connection whose handshake proved possession
+    of its key.
 
 Authorization:
   * RBAC over SERVED API objects (plugin/pkg/auth/authorizer/rbac/
@@ -25,7 +27,6 @@ Authorization:
 
 from __future__ import annotations
 
-import base64
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -38,9 +39,6 @@ class UserInfo:
 
 
 ANONYMOUS = UserInfo("system:anonymous", ("system:unauthenticated",))
-
-CLIENT_CERT_HEADER = "X-Client-Cert"
-CLIENT_CERT_PROOF_HEADER = "X-Client-Cert-Proof"
 
 
 class TokenAuthenticator:
@@ -63,8 +61,8 @@ class TokenAuthenticator:
 
 
 class AuthenticatorChain:
-    """union.New analog: token file -> SA JWT -> x509 header; the first
-    authenticator that positively identifies the request wins, any
+    """union.New analog: token file -> SA JWT -> TLS peer cert; the
+    first authenticator that positively identifies the request wins, any
     presented-but-invalid credential is a 401."""
 
     def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None,
@@ -78,13 +76,12 @@ class AuthenticatorChain:
         """Bearer-only entry point (back compat with TokenAuthenticator)."""
         return self._authenticate(authorization_header, None)
 
-    def authenticate_request(self, headers) -> Optional[UserInfo]:
-        return self._authenticate(headers.get("Authorization"),
-                                  headers.get(CLIENT_CERT_HEADER),
-                                  headers.get(CLIENT_CERT_PROOF_HEADER))
+    def authenticate_request(self, headers, peer=None) -> Optional[UserInfo]:
+        """peer: (CN, [O...]) read from the VERIFIED TLS peer chain by
+        the serving socket (pki.peer_identity) — never from a header."""
+        return self._authenticate(headers.get("Authorization"), peer)
 
-    def _authenticate(self, auth_header, cert_b64=None,
-                      proof_b64=None) -> Optional[UserInfo]:
+    def _authenticate(self, auth_header, peer=None) -> Optional[UserInfo]:
         if auth_header and auth_header.startswith("Bearer "):
             tok = auth_header[len("Bearer "):].strip()
             user = self.tokens.get(tok)
@@ -99,23 +96,8 @@ class AuthenticatorChain:
                     return UserInfo(name, ("system:authenticated",
                                            *groups))
             return None  # presented token matched nothing: 401
-        if cert_b64 and self.ca is not None:
-            try:
-                pem = base64.b64decode(cert_b64).decode()
-            except Exception:
-                return None
-            got = self.ca.verify_client_cert(pem)
-            if got is None:
-                return None  # untrusted/expired cert: 401
-            # proof of key possession: the cert PEM alone is public (it
-            # sits in the served CSR status) — require a signature by
-            # its private key (pki.sign_proof), the plain-HTTP stand-in
-            # for the TLS handshake's possession proof
-            from . import pki
-
-            if not proof_b64 or not pki.verify_proof(pem, proof_b64):
-                return None
-            cn, orgs = got
+        if peer is not None:
+            cn, orgs = peer
             return UserInfo(cn, ("system:authenticated", *orgs))
         return ANONYMOUS if self.allow_anonymous else None
 
@@ -195,7 +177,15 @@ NODE_READ_RESOURCES = frozenset({
 # never in kube-system, whose Secrets hold the cluster CA + SA signing
 # keys (a kubelet reading those would be a cluster-admin escalation)
 NODE_GET_ONLY_RESOURCES = frozenset({"secrets", "configmaps"})
-NODE_WRITE_RESOURCES = frozenset({"nodes", "pods", "events"})
+# writes are whitelisted as EXACT (resource, subresource) attributes —
+# the reference node authorizer never grants pods/exec, pods/attach,
+# pods/portforward, pods/log or any proxy subresource to node
+# identities (node_authorizer.go enumerates the rules explicitly);
+# matching on the base resource would hand every kubelet an exec
+# capability on every pod (round-4 advisor finding)
+NODE_WRITE_RESOURCES = frozenset({
+    "nodes", "nodes/status", "pods", "pods/status", "pods/eviction",
+    "events"})
 
 
 def _node_authorize(user: UserInfo, verb: str, resource: str,
@@ -208,15 +198,15 @@ def _node_authorize(user: UserInfo, verb: str, resource: str,
     if "system:nodes" not in user.groups or \
             not user.name.startswith("system:node:"):
         return False
-    base = resource.split("/")[0]  # status/eviction subresources included
     if verb in ("get", "list", "watch"):
-        if base in NODE_READ_RESOURCES:
+        if resource in NODE_READ_RESOURCES:  # plain resources only —
+            # no read subresource (pods/log, nodes/proxy) is granted
             return True
-        if base in NODE_GET_ONLY_RESOURCES:
+        if resource in NODE_GET_ONLY_RESOURCES:
             return (verb == "get" and name is not None
                     and namespace != "kube-system")
         return False
-    return base in NODE_WRITE_RESOURCES
+    return resource in NODE_WRITE_RESOURCES
 
 
 class RBACAuthorizer:
@@ -296,11 +286,14 @@ class RBACAuthorizer:
         resources = rule.resources or []
         if "*" not in resources and resource not in resources:
             return False
-        # apiGroups scope the rule (rbac.go APIGroupMatches); an empty
-        # list is tolerated as "any group" for hand-built objects, the
-        # reference's strict form lists groups explicitly
+        # apiGroups scope the rule (rbac.go APIGroupMatches): an empty
+        # list matches NOTHING, exactly like the reference — a rule must
+        # name its groups ([""] for core). Treating empty as "any" would
+        # make a hand-built Role grant strictly more here than the
+        # identical object grants in the reference (round-4 advisor
+        # finding).
         groups = rule.api_groups or []
-        if groups and "*" not in groups and _group_of(resource) not in groups:
+        if "*" not in groups and _group_of(resource) not in groups:
             return False
         if rule.resource_names:
             return name is not None and name in rule.resource_names

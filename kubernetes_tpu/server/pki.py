@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import datetime
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from cryptography import x509
 from cryptography.hazmat.primitives import hashes, serialization
@@ -136,32 +136,140 @@ def make_csr(common_name: str, organizations: Tuple[str, ...] = ()
             csr.public_bytes(serialization.Encoding.PEM).decode())
 
 
-def sign_proof(key_pem: str, cert_pem: str) -> str:
-    """Proof of key possession for header-borne client certs: an ECDSA
-    signature by the cert's private key OVER the cert itself (base64
-    DER). TLS proves possession in the handshake; plain HTTP cannot, so
-    without this the PEM in X-Client-Cert would be a bearer credential
-    anyone who read the signed CSR status could replay."""
-    import base64
+def issue_server_cert(ca: ClusterCA, common_name: str,
+                      dns_sans: Sequence[str] = ("localhost",),
+                      ip_sans: Sequence[str] = ("127.0.0.1",),
+                      days: int = 365) -> Tuple[str, str]:
+    """Serving certificate signed by the cluster CA (kubeadm certs
+    phase's apiserver.crt / the kubelet serving cert). Returns
+    (key_pem, cert_pem)."""
+    import ipaddress
 
-    key = serialization.load_pem_private_key(key_pem.encode(),
-                                             password=None)
-    sig = key.sign(cert_pem.encode(), ec.ECDSA(hashes.SHA256()))
-    return base64.b64encode(sig).decode()
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    san = x509.SubjectAlternativeName(
+        [x509.DNSName(d) for d in dns_sans]
+        + [x509.IPAddress(ipaddress.ip_address(i)) for i in ip_sans])
+    cert = (x509.CertificateBuilder()
+            .subject_name(_name(common_name))
+            .issuer_name(ca.ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + days * _ONE_DAY)
+            .add_extension(san, critical=False)
+            .add_extension(x509.ExtendedKeyUsage(
+                [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
+                critical=False)
+            .sign(ca._ca_key(), hashes.SHA256()))
+    return (key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()).decode(),
+            cert.public_bytes(serialization.Encoding.PEM).decode())
 
 
-def verify_proof(cert_pem: str, proof_b64: str) -> bool:
-    """Does the proof demonstrate possession of the cert's key?"""
-    import base64
+def _load_cert_chain(ctx, cert_pem: str, key_pem: str) -> None:
+    """ssl.SSLContext.load_cert_chain only reads files; stage the PEMs
+    in a private tmpdir for the duration of the load."""
+    import os
+    import tempfile
 
+    with tempfile.TemporaryDirectory() as d:
+        cert_path = os.path.join(d, "tls.crt")
+        key_path = os.path.join(d, "tls.key")
+        with open(cert_path, "w") as f:
+            f.write(cert_pem)
+        fd = os.open(key_path, os.O_WRONLY | os.O_CREAT, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(key_pem)
+        ctx.load_cert_chain(cert_path, key_path)
+
+
+def server_ssl_context(ca_cert_pem: str, cert_pem: str, key_pem: str,
+                       require_client_cert: bool = False):
+    """TLS serving context trusting the cluster CA for client certs.
+    CERT_OPTIONAL by default: bearer-token clients connect without a
+    client cert, x509 clients are verified in the handshake (the real
+    form of x509.go:76's 'verified peer chain'). The kubelet server
+    uses require_client_cert=True — its only legitimate clients are
+    cluster components holding CA-issued certs."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    _load_cert_chain(ctx, cert_pem, key_pem)
+    ctx.load_verify_locations(cadata=ca_cert_pem)
+    ctx.verify_mode = (ssl.CERT_REQUIRED if require_client_cert
+                       else ssl.CERT_OPTIONAL)
+    return ctx
+
+
+def wrap_http_server(httpd, ctx, handshake_timeout: float = 10.0) -> None:
+    """Serve `httpd` (a ThreadingHTTPServer) over TLS with the handshake
+    performed in the PER-CONNECTION handler thread, not the accept loop.
+    Wrapping the listener naively makes accept() run the blocking
+    handshake inside serve_forever — one idle TCP connection (a port
+    scan, a TCP health probe) would hang the whole server for every
+    client. A handshake that stalls past handshake_timeout or fails is
+    closed without touching the accept loop."""
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True,
+                                   do_handshake_on_connect=False)
+    orig_finish = httpd.finish_request
+
+    def finish_request(request, client_address):
+        try:
+            request.settimeout(handshake_timeout)
+            request.do_handshake()
+            request.settimeout(None)
+        except Exception:
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        orig_finish(request, client_address)
+
+    httpd.finish_request = finish_request
+
+
+def client_ssl_context(ca_cert_pem: str,
+                       client_cert_pem: Optional[str] = None,
+                       client_key_pem: Optional[str] = None):
+    """TLS client context: verify the server against the cluster CA
+    bundle (the kubeconfig certificate-authority-data analog); present
+    an x509 client credential when given (mTLS)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(cadata=ca_cert_pem)
+    ctx.check_hostname = False  # identity = the CA-verified chain; nodes
+    # serve on ephemeral host:port pairs the cert's SANs can't enumerate
+    if client_cert_pem and client_key_pem:
+        _load_cert_chain(ctx, client_cert_pem, client_key_pem)
+    return ctx
+
+
+def peer_identity(ssl_socket) -> Optional[Tuple[str, List[str]]]:
+    """(CN, [O...]) of the VERIFIED TLS peer certificate, or None when
+    the client sent none. The chain/validity checks already happened in
+    the handshake against the context's CA — this only reads the
+    subject (CommonNameUserConversion, x509.go:76)."""
     try:
-        cert = x509.load_pem_x509_certificate(cert_pem.encode())
-        cert.public_key().verify(base64.b64decode(proof_b64),
-                                 cert_pem.encode(),
-                                 ec.ECDSA(hashes.SHA256()))
-        return True
-    except Exception:
-        return False
+        peer = ssl_socket.getpeercert()
+    except (ValueError, AttributeError):
+        return None
+    if not peer:
+        return None
+    cn, orgs = None, []
+    for rdn in peer.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName" and cn is None:
+                cn = value
+            elif key == "organizationName":
+                orgs.append(value)
+    if cn is None:
+        return None
+    return cn, orgs
 
 
 def ensure_cluster_ca(store) -> ClusterCA:
