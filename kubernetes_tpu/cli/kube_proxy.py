@@ -80,6 +80,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kube-proxy")
     ap.add_argument("--server", required=True)
     ap.add_argument("--token", default=None)
+    ap.add_argument("--ca-cert-data", default=None,
+                    help="cluster CA bundle PEM (or @file) for https "
+                         "servers")
+    ap.add_argument("--client-cert-data", default=None,
+                    help="x509 client cert PEM (or @file) for mTLS")
+    ap.add_argument("--client-key-data", default=None,
+                    help="x509 client key PEM (or @file) for mTLS")
     ap.add_argument("--hostname-override", default="")
     ap.add_argument("--healthz-port", type=int, default=0)
     ap.add_argument("--min-sync-period", type=float, default=0.0)
@@ -88,7 +95,12 @@ def main(argv=None) -> int:
                     help="sync once and exit (tests/CI)")
     args = ap.parse_args(argv)
 
-    client = RESTClient(args.server, token=args.token)
+    from ..client.rest import pem_arg
+
+    client = RESTClient(args.server, token=args.token,
+                        ca_cert_pem=pem_arg(args.ca_cert_data),
+                        client_cert_pem=pem_arg(args.client_cert_data),
+                        client_key_pem=pem_arg(args.client_key_data))
     store = RemoteStore(client)
     store.mirror("services")
     store.mirror("endpoints")

@@ -101,9 +101,13 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store) -> Scheduler:
 
 def run(cfg: KubeSchedulerConfiguration, server_url: str,
         token: Optional[str] = None, stop: Optional[threading.Event] = None,
-        once: bool = False) -> int:
+        once: bool = False, ca_cert_pem: Optional[str] = None,
+        client_cert_pem: Optional[str] = None,
+        client_key_pem: Optional[str] = None) -> int:
     stop = stop or threading.Event()
-    client = RESTClient(server_url, token=token)
+    client = RESTClient(server_url, token=token, ca_cert_pem=ca_cert_pem,
+                        client_cert_pem=client_cert_pem,
+                        client_key_pem=client_key_pem)
     store = RemoteStore(client)
     for kind in ("pods", "nodes", "services", "replicationcontrollers",
                  "replicasets", "statefulsets", "poddisruptionbudgets",
@@ -149,6 +153,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kube-scheduler")
     ap.add_argument("--server", required=True, help="apiserver URL")
     ap.add_argument("--token", default=None)
+    ap.add_argument("--ca-cert-data", default=None,
+                    help="cluster CA bundle PEM (or @file) for https "
+                         "servers")
+    ap.add_argument("--client-cert-data", default=None,
+                    help="x509 client cert PEM (or @file) for mTLS")
+    ap.add_argument("--client-key-data", default=None,
+                    help="x509 client key PEM (or @file) for mTLS")
     ap.add_argument("--config", default=None,
                     help="KubeSchedulerConfiguration file (YAML/JSON)")
     ap.add_argument("--policy-config-file", default=None)
@@ -185,7 +196,12 @@ def main(argv=None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
-    return run(cfg, args.server, token=args.token, stop=stop, once=args.once)
+    from ..client.rest import pem_arg
+
+    return run(cfg, args.server, token=args.token, stop=stop,
+               once=args.once, ca_cert_pem=pem_arg(args.ca_cert_data),
+               client_cert_pem=pem_arg(args.client_cert_data),
+               client_key_pem=pem_arg(args.client_key_data))
 
 
 if __name__ == "__main__":

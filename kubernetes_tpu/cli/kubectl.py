@@ -609,16 +609,16 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     if not server:
         print("error: --server or $KUBECTL_SERVER required", file=sys.stderr)
         return 1
-    def _pem(v):
-        if v and v.startswith("@"):
-            with open(v[1:]) as f:
-                return f.read()
-        return v
+    from ..client.rest import pem_arg
 
-    client = RESTClient(server, token=args.token,
-                        ca_cert_pem=_pem(args.ca_cert_data),
-                        client_cert_pem=_pem(args.client_cert_data),
-                        client_key_pem=_pem(args.client_key_data))
+    try:
+        client = RESTClient(server, token=args.token,
+                            ca_cert_pem=pem_arg(args.ca_cert_data),
+                            client_cert_pem=pem_arg(args.client_cert_data),
+                            client_key_pem=pem_arg(args.client_key_data))
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     try:
         # discovery: register served CRDs so custom kinds resolve in
         # _resolve_kind / decode (the reference kubectl's RESTMapper

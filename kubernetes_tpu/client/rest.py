@@ -19,6 +19,14 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..api import scheme
 
 
+def pem_arg(v):
+    """CLI PEM argument: literal PEM text, or @/path/to/file."""
+    if v and v.startswith("@"):
+        with open(v[1:]) as f:
+            return f.read()
+    return v
+
+
 class APIStatusError(Exception):
     def __init__(self, code: int, reason: str, message: str):
         super().__init__(f"{code} {reason}: {message}")
@@ -71,6 +79,13 @@ class RESTClient:
                 raise ValueError(
                     "https server requires ca_cert_pem (or, for the "
                     "bootstrap cluster-info fetch, insecure_skip_verify)")
+        elif client_cert_pem or client_key_pem:
+            # an x509 credential only authenticates through a TLS
+            # handshake; silently dropping it over plain http would turn
+            # this client into system:anonymous with no indication
+            raise ValueError(
+                "client_cert_pem/client_key_pem require an https server "
+                "(x509 identity comes from the TLS handshake)")
 
     # -- plumbing --------------------------------------------------------------
 

@@ -26,15 +26,16 @@ class HollowNode:
                  with_proxy: bool = False,
                  start_latency: float = 0.0,
                  heartbeat_period: float = 10.0,
-                 serve: bool = False, tls=None):
+                 serve: bool = False, tls=None, clock=None):
         """serve=True starts the kubelet HTTP(S) server (logs/exec
         plane) — what `kubectl logs` reaches through the apiserver
         proxy; tls (a pki.ClusterCA) makes it mTLS-only."""
         self.name = name
         self.runtime = FakeRuntime(start_latency=start_latency)
+        kw = {"clock": clock} if clock is not None else {}
         self.kubelet = Kubelet(store, name, allocatable=allocatable,
                                labels=labels, runtime=self.runtime,
-                               heartbeat_period=heartbeat_period)
+                               heartbeat_period=heartbeat_period, **kw)
         if serve:
             self.kubelet.serve(tls=tls)
         self.proxy = Proxier(store, node_name=name) if with_proxy else None
@@ -63,7 +64,7 @@ class HollowCluster:
                  zones: int = 3,
                  allocatable: Optional[Dict[str, int]] = None,
                  with_proxy: bool = False,
-                 heartbeat_period: float = 10.0):
+                 heartbeat_period: float = 10.0, clock=None):
         self.store = store
         alloc = allocatable or api.resource_list(cpu="16", memory="32Gi",
                                                  pods=110,
@@ -77,7 +78,7 @@ class HollowCluster:
             self.nodes.append(HollowNode(
                 store, f"hollow-{i}", allocatable=dict(alloc), labels=labels,
                 with_proxy=with_proxy and i == 0,
-                heartbeat_period=heartbeat_period))
+                heartbeat_period=heartbeat_period, clock=clock))
         self._stop = threading.Event()
 
     def run(self, period: float = 1.0) -> "HollowCluster":

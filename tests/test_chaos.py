@@ -1,0 +1,288 @@
+"""Chaos / fault-injection tier: kill control-plane components mid-churn
+and assert the cluster converges.
+
+Reference: test/e2e/chaosmonkey/chaosmonkey.go:34 (Do: run tests around a
+disruption) and the upgrade suite test/e2e/upgrades/. The reference's
+recovery story is structural — every component is a stateless cache over
+etcd, so crash = restart + informer relist (SURVEY.md §5 failure
+detection). These tests kill each component once under load and assert
+exactly that story:
+
+  * apiserver crash: clients see connection errors, the store ("etcd")
+    keeps the state; a replacement server on the same port serves it and
+    reflectors relist with NO lost or duplicated pods.
+  * scheduler crash: a scheduler dies with pods assumed-but-unbound; a
+    fresh scheduler rebuilds its cache from the store and places
+    everything exactly once (the 30s assume TTL never leaks capacity
+    because the cache died with its process).
+  * kubelet crash: heartbeats stop mid-churn; nodelifecycle tains/evicts
+    (the NoExecute path) and the scheduler re-places the evicted pods on
+    surviving nodes.
+  * leader crash: the lease holder dies WITHOUT releasing; the standby
+    acquires after lease expiry (leaderelection.go renew/acquire).
+  * GC crash: the collector dies between the owner's deletion and its
+    sweep; a fresh collector rebuilds the uid-keyed graph from a relist
+    and still collects the orphaned dependents.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.reflector import RemoteStore
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowNode
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.server import APIServer
+
+from helpers import make_node, make_pod
+
+
+def _mkpod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, labels={"type": "chaos"}),
+        spec=api.PodSpec(containers=[api.Container(
+            resources=api.ResourceRequirements(
+                requests=api.resource_list(cpu="100m", memory="128Mi")))]))
+
+
+class TestApiserverCrash:
+    def test_restart_mid_churn_relists_no_lost_pods(self):
+        """Kill the apiserver while a remote scheduler and hollow nodes
+        churn through it; restart on the same port; every created pod
+        must end up bound exactly once and mirrors must converge to the
+        store (the reflector relist path)."""
+        store = ObjectStore()  # the "etcd": outlives the apiserver
+        srv = APIServer(store).start()
+        port = srv.port
+
+        # control plane AND nodes connect as clients, like a real cluster
+        sched_store = RemoteStore(RESTClient(srv.url))
+        sched = Scheduler(sched_store)
+        nodes = [HollowNode(sched_store, f"c-n{i}",
+                            allocatable=api.resource_list(
+                                cpu="8", memory="16Gi", pods=50))
+                 for i in range(3)]
+
+        stop = threading.Event()
+
+        def sched_loop():
+            while not stop.is_set():
+                if sched.run_once(timeout=0.05) == 0:
+                    stop.wait(0.01)
+
+        t = threading.Thread(target=sched_loop, daemon=True)
+        t.start()
+        for n in nodes:
+            n.run(period=0.05)
+
+        created = 0
+        for i in range(20):
+            store.create("pods", _mkpod(f"pre-{i}"))
+            created += 1
+        # let some scheduling happen, then CRASH the server abruptly
+        time.sleep(0.3)
+        srv.httpd.shutdown()
+        srv.httpd.server_close()
+
+        # while the apiserver is down the store keeps accepting writes
+        # (other replicas would, in an HA setup); clients just error
+        for i in range(20):
+            store.create("pods", _mkpod(f"down-{i}"))
+            created += 1
+        time.sleep(0.3)
+
+        # replacement replica on the SAME port over the same store
+        srv2 = APIServer(store, port=port).start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                bound = [p for p in store.list("pods")
+                         if p.spec.node_name]
+                if len(bound) == created:
+                    break
+                time.sleep(0.1)
+            bound = [p for p in store.list("pods") if p.spec.node_name]
+            assert len(bound) == created, \
+                f"lost pods after apiserver crash: {len(bound)}/{created}"
+            # no duplicate placements: uids unique, store never saw a
+            # conflicting second bind (store.bind raises on rebind)
+            assert len({p.uid for p in bound}) == created
+            # the reflector mirror converged to the relisted state
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len([p for p in sched_store.list("pods")
+                        if p.spec.node_name]) == created:
+                    break
+                time.sleep(0.05)
+            assert len([p for p in sched_store.list("pods")
+                        if p.spec.node_name]) == created
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            for n in nodes:
+                n.stop()
+            sched.close()
+            sched_store.stop()
+            srv2.stop()
+
+
+class _CrashyStore(ObjectStore):
+    """Store whose bind fails N times — models a scheduler dying between
+    assume and bind (the bind RPC never lands)."""
+
+    def __init__(self, fail_binds: int):
+        super().__init__()
+        self.fail_binds = fail_binds
+
+    def bind(self, pod, node_name):
+        if self.fail_binds > 0:
+            self.fail_binds -= 1
+            raise ConnectionError("scheduler crashed before bind landed")
+        return super().bind(pod, node_name)
+
+
+class TestSchedulerCrash:
+    def test_fresh_scheduler_rebuilds_and_places_exactly_once(self):
+        store = _CrashyStore(fail_binds=4)
+        for i in range(4):
+            store.create("nodes", make_node(f"n{i}", cpu="4"))
+        for i in range(8):
+            store.create("pods", make_pod(f"p{i}", cpu="1"))
+        sched_a = Scheduler(store)
+        placed_a = sched_a.schedule_pending()
+        # the first binds "crashed": those pods were assumed by A then
+        # rolled back/requeued; A dies here (no close, no drain — crash)
+        del sched_a
+
+        # B starts from nothing: informer relist rebuilds cache+snapshot
+        sched_b = Scheduler(store)
+        placed_b = sched_b.schedule_pending()
+        bound = [p for p in store.list("pods") if p.spec.node_name]
+        assert len(bound) == 8, (placed_a, placed_b, len(bound))
+        # capacity respected after the rebuild: 4 nodes x 4 cpu, 8x1cpu
+        per_node = {}
+        for p in bound:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 4 for v in per_node.values()), per_node
+        sched_b.close()
+
+
+class TestKubeletCrash:
+    def test_node_death_mid_churn_reschedules(self):
+        """Kubelet stops heartbeating under churn; nodelifecycle taints
+        NoExecute and evicts; the scheduler re-places evicted pods on
+        surviving nodes."""
+        from kubernetes_tpu.controllers.nodelifecycle import \
+            NodeLifecycleController
+
+        store = ObjectStore()
+        now = [1000.0]
+        clock = lambda: now[0]  # noqa: E731
+        hc = HollowCluster(store, n_nodes=4, heartbeat_period=1.0,
+                           clock=clock)
+        sched = Scheduler(store, clock=clock)
+        ctrl = NodeLifecycleController(store, clock=clock,
+                                       grace_period=3.0,
+                                       eviction_wait=1.0)
+        hc.sync_once()
+        hc.create_pods(12, prefix="churn-a")
+        assert sched.schedule_pending() == 12
+        hc.sync_once()
+
+        # node hollow-0 dies (stop syncing/heartbeating it); the rest
+        # keep heartbeating while churn continues
+        dead = hc.nodes[0]
+        victims = [p.metadata.name for p in store.list("pods")
+                   if p.spec.node_name == "hollow-0"]
+        assert victims, "no pods landed on the doomed node"
+        rng = random.Random(7)
+        for step in range(8):
+            now[0] += 1.0
+            for n in hc.nodes[1:]:
+                n.kubelet.heartbeat(now[0])
+                n.sync_once(now[0])
+            ctrl.monitor(now[0])
+            if step == 2:
+                hc.churn(2, rng)          # deletions mid-disruption
+                hc.create_pods(4, prefix="churn-b")
+            sched.schedule_pending()
+
+        node0 = store.get("nodes", "", "hollow-0") or \
+            store.get("nodes", "default", "hollow-0")
+        assert any(t.key == "node.kubernetes.io/unreachable"
+                   for t in (node0.spec.taints or [])), \
+            "dead node was never tainted"
+        # every surviving pod is bound to a LIVE node; the dead node's
+        # pods were evicted and replaced elsewhere
+        for p in store.list("pods"):
+            assert p.spec.node_name, f"{p.metadata.name} never re-placed"
+            assert p.spec.node_name != "hollow-0", \
+                f"{p.metadata.name} still on the dead node"
+        sched.close()
+        hc.stop()
+        assert dead is hc.nodes[0]
+
+
+class TestLeaderCrash:
+    def test_standby_takes_over_after_lease_expiry(self):
+        from kubernetes_tpu.client.leaderelection import LeaderElector
+
+        store = ObjectStore()
+        now = [0.0]
+        clock = lambda: now[0]  # noqa: E731
+        events = []
+        a = LeaderElector(store, "sched-a", lease_duration=10.0,
+                          clock=clock,
+                          on_started_leading=lambda: events.append("a-up"))
+        b = LeaderElector(store, "sched-b", lease_duration=10.0,
+                          clock=clock,
+                          on_started_leading=lambda: events.append("b-up"))
+        assert a._try_acquire_or_renew(), "initial acquisition failed"
+        assert not b._try_acquire_or_renew()
+        # a CRASHES: no release, the lease just stops being renewed
+        now[0] += 5.0
+        assert not b._try_acquire_or_renew(), "lease stolen before expiry"
+        now[0] += 6.0  # renew_time + lease_duration passed
+        assert b._try_acquire_or_renew(), "standby failed to take over"
+        rec = store.get("leases", "default", "kube-scheduler")
+        assert rec.holder_identity == "sched-b"
+
+
+class TestGCCrash:
+    def test_fresh_collector_rebuilds_graph_and_collects(self):
+        from kubernetes_tpu.controllers.garbagecollector import \
+            GarbageCollector
+
+        store = ObjectStore()
+        owner = api.ReplicaSet(
+            metadata=api.ObjectMeta(name="rs-1"),
+            selector=api.LabelSelector(match_labels={"app": "x"}))
+        store.create("replicasets", owner)
+        for i in range(3):
+            pod = make_pod(f"dep-{i}")
+            pod.metadata.labels = {"app": "x"}
+            pod.metadata.owner_references = [api.OwnerReference(
+                kind="ReplicaSet", name="rs-1", uid=owner.metadata.uid,
+                controller=True)]
+            store.create("pods", pod)
+        gc_a = GarbageCollector(store)
+        gc_a.sync_monitors()
+        gc_a.sweep()
+        assert store.count("pods") == 3  # owner alive: nothing collected
+        # owner deleted, then the collector CRASHES before sweeping
+        store.delete("replicasets", "default", "rs-1")
+        del gc_a
+
+        gc_b = GarbageCollector(store)
+        gc_b.sync_monitors()  # rebuild the uid-keyed graph from relist
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and store.count("pods"):
+            gc_b.sweep()
+            time.sleep(0.01)
+        assert store.count("pods") == 0, \
+            "orphaned dependents survived the GC restart"
