@@ -106,6 +106,8 @@ class Container:
     readiness_probe: Optional[Probe] = None
     image_pull_policy: str = ""  # "" -> defaulted; Always|IfNotPresent|Never
     privileged: bool = False  # securityContext.privileged, flattened
+    # EnvVar list collapsed to a name->value map (no valueFrom sources)
+    env: Dict[str, str] = field(default_factory=dict)
 
 
 # --- taints & tolerations ---------------------------------------------------

@@ -97,10 +97,14 @@ def main(argv=None) -> int:
 
     from ..client.rest import pem_arg
 
-    client = RESTClient(args.server, token=args.token,
-                        ca_cert_pem=pem_arg(args.ca_cert_data),
-                        client_cert_pem=pem_arg(args.client_cert_data),
-                        client_key_pem=pem_arg(args.client_key_data))
+    try:
+        client = RESTClient(args.server, token=args.token,
+                            ca_cert_pem=pem_arg(args.ca_cert_data),
+                            client_cert_pem=pem_arg(args.client_cert_data),
+                            client_key_pem=pem_arg(args.client_key_data))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     store = RemoteStore(client)
     store.mirror("services")
     store.mirror("endpoints")

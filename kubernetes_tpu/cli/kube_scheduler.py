@@ -198,10 +198,14 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     from ..client.rest import pem_arg
 
-    return run(cfg, args.server, token=args.token, stop=stop,
-               once=args.once, ca_cert_pem=pem_arg(args.ca_cert_data),
-               client_cert_pem=pem_arg(args.client_cert_data),
-               client_key_pem=pem_arg(args.client_key_data))
+    try:
+        return run(cfg, args.server, token=args.token, stop=stop,
+                   once=args.once, ca_cert_pem=pem_arg(args.ca_cert_data),
+                   client_cert_pem=pem_arg(args.client_cert_data),
+                   client_key_pem=pem_arg(args.client_key_data))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -177,6 +177,187 @@ def cmd_exec(client, args, out):
     return int(resp.get("exitCode", 0))
 
 
+def cmd_attach(client, args, out):
+    """kubectl attach <pod> [-c container] [--follow-rounds N] — follow
+    the container's live output via the pods/<name>/attach long-poll
+    (pkg/kubectl/cmd/attach.go; SPDY collapsed to re-armed polls)."""
+    since = 0
+    rounds = max(1, args.follow_rounds)
+    path = client._path("pods", args.namespace, args.name, "attach")
+    for _ in range(rounds):
+        q = [f"since={since}", f"waitSeconds={args.wait:g}"]
+        if args.container:
+            q.append(f"container={args.container}")
+        resp = client.request("GET", path, query="&".join(q))
+        for line in resp.get("lines", []):
+            out.write(line + "\n")
+        since = int(resp.get("next", since))
+    return 0
+
+
+def cmd_port_forward(client, args, out):
+    """kubectl port-forward <pod> <local:remote> — opens a LOCAL
+    listener relaying TCP to the kubelet's relay for the pod's port
+    (pkg/kubectl/cmd/portforward.go). Bytes flow
+    local->kubelet->pod-backend for real. Prints the local port. With
+    --once the listener serves exactly one connection in the background
+    and the command returns immediately (in-process/CI callers connect
+    after it prints); without it the command blocks serving the
+    connection, like real kubectl."""
+    import socket
+    import threading as _threading
+
+    from ..utils.net import relay_once
+
+    local, _, remote = args.ports.partition(":")
+    if not remote:
+        local, remote = "0", local
+    try:
+        remote_port = int(remote)
+        local_port = int(local)
+    except ValueError:
+        print(f"error: ports must be LOCAL:REMOTE integers, "
+              f"got {args.ports!r}", file=sys.stderr)
+        return 1
+    path = client._path("pods", args.namespace, args.name, "portforward")
+    resp = client.request("POST", path, body={"port": remote_port})
+    relay = (resp["host"], int(resp["port"]))
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", local_port))
+    lsock.listen(1)
+    lport = lsock.getsockname()[1]
+    out.write(f"Forwarding from 127.0.0.1:{lport} -> {remote}\n")
+    out.flush()
+    if args.once:
+        _threading.Thread(target=relay_once, args=(lsock, relay),
+                          kwargs={"accept_timeout": args.wait},
+                          daemon=True).start()
+    else:
+        relay_once(lsock, relay)
+    return 0
+
+
+def cmd_patch(client, args, out):
+    """kubectl patch <kind> <name> -p '<json>' — strategic-merge-lite:
+    the server's merge-patch (pkg/kubectl/cmd/patch.go ->
+    endpoints/handlers PatchResource)."""
+    plural = _resolve_kind(args.kind)
+    try:
+        patch = json.loads(args.patch)
+    except json.JSONDecodeError as e:
+        print(f"error: invalid patch JSON: {e}", file=sys.stderr)
+        return 1
+    ns = args.namespace if scheme.is_namespaced(
+        scheme.kind_for_plural(plural)) else ""
+    obj = client.patch(plural, ns, args.name, patch)
+    out.write(f"{plural}/{obj.metadata.name} patched\n")
+    return 0
+
+
+def cmd_annotate(client, args, out):
+    """kubectl annotate <kind> <name> k=v ... k- — merge-patch on
+    metadata.annotations; trailing '-' removes (cmd/annotate.go)."""
+    plural = _resolve_kind(args.kind)
+    ann = {}
+    for kv in args.annotations:
+        if kv.endswith("-") and "=" not in kv:
+            ann[kv[:-1]] = None  # JSON merge-patch null deletes the key
+        else:
+            k, _, v = kv.partition("=")
+            ann[k] = v
+    ns = args.namespace if scheme.is_namespaced(
+        scheme.kind_for_plural(plural)) else ""
+    client.patch(plural, ns, args.name,
+                 {"metadata": {"annotations": ann}})
+    out.write(f"{plural}/{args.name} annotated\n")
+    return 0
+
+
+def cmd_edit(client, args, out):
+    """kubectl edit <kind> <name> — dump to a temp file, run
+    $KUBE_EDITOR/$EDITOR, apply the result as an update
+    (cmd/editor/editoptions.go)."""
+    import os
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    plural = _resolve_kind(args.kind)
+    ns = args.namespace if scheme.is_namespaced(
+        scheme.kind_for_plural(plural)) else ""
+    obj = client.get(plural, ns, args.name)
+    before = yaml.safe_dump(scheme.encode_object(obj), sort_keys=False)
+    editor = os.environ.get("KUBE_EDITOR") or os.environ.get("EDITOR")
+    if not editor:
+        print("error: set KUBE_EDITOR or EDITOR to edit", file=sys.stderr)
+        return 1
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        f.write(before)
+        tmp = f.name
+    try:
+        rc = subprocess.call(f"{editor} {tmp}", shell=True)
+        if rc != 0:
+            print(f"error: editor exited {rc}; changes not applied",
+                  file=sys.stderr)
+            return 1
+        after = open(tmp).read()
+    finally:
+        os.unlink(tmp)
+    if after == before:
+        out.write("Edit cancelled, no changes made.\n")
+        return 0
+    edited = scheme.decode_object(yaml.safe_load(after))
+    client.update(plural, edited)
+    out.write(f"{plural}/{args.name} edited\n")
+    return 0
+
+
+def cmd_cp(client, args, out):
+    """kubectl cp <pod>:<path> <localpath> (download) or
+    <localpath> <pod>:<path> (upload) — over the exec subresource's
+    cat / `sh -c 'cat > path'` with stdin (cmd/cp.go's tar pipe,
+    collapsed to single files)."""
+    def parse(spec):
+        if ":" in spec and not spec.startswith("/") and "/" not in \
+                spec.split(":", 1)[0]:
+            pod, _, path = spec.partition(":")
+            return pod, path
+        return None, spec
+
+    src_pod, src_path = parse(args.src)
+    dst_pod, dst_path = parse(args.dst)
+    if (src_pod is None) == (dst_pod is None):
+        print("error: exactly one of src/dst must be pod:path",
+              file=sys.stderr)
+        return 1
+    exec_path = client._path("pods", args.namespace,
+                             src_pod or dst_pod, "exec")
+    if src_pod is not None:  # download
+        body = {"command": ["cat", src_path]}
+        if args.container:
+            body["container"] = args.container
+        resp = client.request("POST", exec_path, body=body)
+        if int(resp.get("exitCode", 1)) != 0:
+            print(f"error: {resp.get('output')}", file=sys.stderr)
+            return 1
+        with open(args.dst, "w") as f:
+            f.write(resp.get("output", ""))
+    else:  # upload
+        content = open(args.src).read()
+        body = {"command": ["sh", "-c", f"cat > {dst_path}"],
+                "stdin": content}
+        if args.container:
+            body["container"] = args.container
+        resp = client.request("POST", exec_path, body=body)
+        if int(resp.get("exitCode", 1)) != 0:
+            print(f"error: {resp.get('output')}", file=sys.stderr)
+            return 1
+    return 0
+
+
 def cmd_describe(client, args, out):
     plural = _resolve_kind(args.kind)
     obj = client.get(plural, args.namespace, args.name)
@@ -583,6 +764,43 @@ def build_parser() -> argparse.ArgumentParser:
     ec.add_argument("command", nargs="+",
                     help="command to run (after --)")
 
+    at = sub.add_parser("attach")
+    at.add_argument("name")
+    at.add_argument("--container", "-c", default="")
+    at.add_argument("--follow-rounds", type=int, default=1,
+                    help="long-poll rounds to follow (SPDY stream analog)")
+    at.add_argument("--wait", type=float, default=2.0,
+                    help="seconds each poll waits for new output")
+
+    pf = sub.add_parser("port-forward")
+    pf.add_argument("name")
+    pf.add_argument("ports", help="LOCAL:REMOTE (or just REMOTE)")
+    pf.add_argument("--once", action="store_true",
+                    help="serve exactly one connection then exit")
+    pf.add_argument("--wait", type=float, default=10.0,
+                    help="--once: seconds to wait for the connection")
+
+    pa = sub.add_parser("patch")
+    pa.add_argument("kind")
+    pa.add_argument("name")
+    pa.add_argument("--patch", "-p", required=True,
+                    help="JSON merge patch")
+
+    an = sub.add_parser("annotate")
+    an.add_argument("kind")
+    an.add_argument("name")
+    an.add_argument("annotations", nargs="+",
+                    help="k=v to set, k- to remove")
+
+    ed = sub.add_parser("edit")
+    ed.add_argument("kind")
+    ed.add_argument("name")
+
+    cp = sub.add_parser("cp")
+    cp.add_argument("src", help="pod:path or local path")
+    cp.add_argument("dst", help="local path or pod:path")
+    cp.add_argument("--container", "-c", default="")
+
     xp = sub.add_parser("explain")
     xp.add_argument("kind")
 
@@ -598,7 +816,9 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "cordon": cmd_cordon, "uncordon": cmd_uncordon, "drain": cmd_drain,
          "label": cmd_label, "version": cmd_version, "rollout": cmd_rollout,
          "expose": cmd_expose, "explain": cmd_explain, "top": cmd_top,
-         "logs": cmd_logs, "exec": cmd_exec}
+         "logs": cmd_logs, "exec": cmd_exec, "attach": cmd_attach,
+         "port-forward": cmd_port_forward, "patch": cmd_patch,
+         "annotate": cmd_annotate, "edit": cmd_edit, "cp": cmd_cp}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:
@@ -635,6 +855,11 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         return int(rc or 0)
     except APIStatusError as e:
         print(f"Error from server: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        # local-side failures (cp source missing, destination is a
+        # directory, port in use): CLI error, not a traceback
+        print(f"error: {e}", file=sys.stderr)
         return 1
 
 

@@ -280,7 +280,8 @@ class Kubelet:
                             and st.exit_code == 0):
                         continue
                     st.restart_count += 1
-                self.runtime.start_container(uid, c.name, now)
+                self.runtime.start_container(uid, c.name, now,
+                                             env=dict(c.env or {}))
         self._run_probes(pod, now)
         self._update_pod_status(pod, now)
 

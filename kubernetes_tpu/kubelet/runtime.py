@@ -28,6 +28,10 @@ class ContainerState:
     healthy: bool = True  # liveness handler result
     ready: bool = True    # readiness handler result
     logs: List[str] = field(default_factory=list)  # stdout/stderr record
+    # the container's "filesystem" and environment — what exec/cp
+    # actually operate on (path -> contents)
+    files: Dict[str, str] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
 
 
 class FakeRuntime:
@@ -38,16 +42,21 @@ class FakeRuntime:
         self.containers: Dict[Tuple[str, str], ContainerState] = {}
         self.start_latency = start_latency  # simulated image pull/start time
         self._pending_start: Dict[Tuple[str, str], float] = {}
+        # (pod_uid, port) -> (host, backend_port): pod TCP listeners
+        self._pod_servers: Dict[Tuple[str, int], Tuple[str, int]] = {}
 
     # -- CRI-ish surface -------------------------------------------------------
 
-    def start_container(self, pod_uid: str, name: str, now: float):
+    def start_container(self, pod_uid: str, name: str, now: float,
+                        env: Optional[Dict[str, str]] = None):
         with self._lock:
             key = (pod_uid, name)
             st = self.containers.get(key)
             if st is None:
                 st = ContainerState(name)
                 self.containers[key] = st
+            if env:
+                st.env = dict(env)
             if st.state != RUNNING:
                 if self.start_latency > 0:
                     self._pending_start.setdefault(key, now + self.start_latency)
@@ -116,24 +125,117 @@ class FakeRuntime:
         # explicit slice end: lines[-0:] would be the WHOLE list
         return lines[len(lines) - min(tail, len(lines)):]
 
-    def exec_in_container(self, pod_uid: str, name: str,
-                          cmd: List[str]) -> Tuple[int, str]:
-        """Canned command runner (the reference streams a real exec over
-        CRI, kuberuntime ExecSync): echo reproduces its args, everything
-        else reports what ran. Non-running containers fail like a real
-        exec would."""
+    def exec_in_container(self, pod_uid: str, name: str, cmd: List[str],
+                          stdin: Optional[str] = None) -> Tuple[int, str]:
+        """Execute a command against the container's ACTUAL state — its
+        files, env, and log stream — via a small shell-like interpreter
+        (the reference streams a real exec over CRI, kuberuntime
+        ExecSync; this is the hollow runtime's honest equivalent: the
+        command's effects are observable through every other runtime
+        surface). Non-running containers fail like a real exec would.
+        stdin feeds `cat > path` / `tee path` — the upload half of
+        `kubectl cp`."""
         with self._lock:
             st = self.containers.get((pod_uid, name))
             if st is None or st.state != RUNNING:
                 return 126, f"container {name} is not running"
-        if cmd and cmd[0] == "echo":
-            out = " ".join(cmd[1:])
-        elif cmd and cmd[0] == "hostname":
-            out = pod_uid
-        else:
-            out = f"executed: {' '.join(cmd)}"
-        self.append_log(pod_uid, name, f"exec: {' '.join(cmd)}")
-        return 0, out
+        rc, out = self._interpret(st, pod_uid, cmd, stdin)
+        self.append_log(pod_uid, name, f"exec: {' '.join(cmd)} rc={rc}")
+        return rc, out
+
+    def _interpret(self, st: ContainerState, pod_uid: str,
+                   cmd: List[str], stdin: Optional[str]) -> Tuple[int, str]:
+        if not cmd:
+            return 127, "no command"
+        prog, args = cmd[0], cmd[1:]
+        if prog == "sh" and len(args) >= 2 and args[0] == "-c":
+            # one level of `sh -c "..."` with redirection into the
+            # container fs: `cmd > path` / `cat > path`. Tokenize FIRST
+            # so a quoted '>' is data, not redirection.
+            import shlex
+
+            try:
+                tokens = shlex.split(args[1])
+            except ValueError as e:
+                return 2, f"sh: syntax error: {e}"
+            if ">" in tokens:
+                i = len(tokens) - 1 - tokens[::-1].index(">")
+                inner, rest = tokens[:i], tokens[i + 1:]
+                if len(rest) != 1:
+                    return 2, "sh: syntax error near '>'"
+                target = rest[0]
+                if inner == ["cat"] or not inner:
+                    content = stdin or ""
+                    rc = 0
+                else:
+                    rc, content = self._interpret(st, pod_uid, inner, stdin)
+                if rc == 0:
+                    with self._lock:
+                        st.files[target] = content
+                    return 0, ""
+                return rc, content
+            return self._interpret(st, pod_uid, tokens, stdin)
+        if prog == "echo":
+            return 0, " ".join(args)
+        if prog == "hostname":
+            return 0, pod_uid
+        if prog == "env":
+            with self._lock:
+                env = dict(st.env)
+            return 0, "\n".join(f"{k}={v}" for k, v in sorted(env.items()))
+        if prog == "cat":
+            if not args:
+                return 0, stdin or ""
+            with self._lock:
+                missing = [a for a in args if a not in st.files]
+                if missing:
+                    return 1, f"cat: {missing[0]}: No such file or directory"
+                return 0, "".join(st.files[a] for a in args)
+        if prog == "ls":
+            prefix = (args[0].rstrip("/") + "/") if args else "/"
+            with self._lock:
+                if args and args[0] in st.files:
+                    return 0, args[0]  # ls of a file echoes its path
+                names = sorted({f[len(prefix):].split("/")[0]
+                                for f in st.files
+                                if f.startswith(prefix)})
+            if not names and args:
+                return 1, f"ls: {args[0]}: No such file or directory"
+            return 0, "\n".join(names)
+        if prog == "rm":
+            with self._lock:
+                for a in args:
+                    if a not in st.files:
+                        return 1, f"rm: {a}: No such file or directory"
+                for a in args:
+                    st.files.pop(a)
+            return 0, ""
+        if prog == "tee":
+            content = stdin or ""
+            if args:
+                with self._lock:
+                    st.files[args[0]] = content
+            return 0, content
+        if prog in ("true", "sleep"):
+            return 0, ""
+        if prog == "false":
+            return 1, ""
+        return 127, f"sh: {prog}: command not found"
+
+    # -- pod TCP backends (port-forward's other end) ---------------------------
+
+    def register_pod_server(self, pod_uid: str, port: int,
+                            backend_port: int, host: str = "127.0.0.1"):
+        """Declare that the pod listens on `port`, backed by a real local
+        TCP server at (host, backend_port) — the hollow analog of a
+        container process binding a port. kubelet portForward pipes
+        bytes here."""
+        with self._lock:
+            self._pod_servers[(pod_uid, port)] = (host, backend_port)
+
+    def pod_server(self, pod_uid: str, port: int):
+        with self._lock:
+            return self._pod_servers.get((pod_uid, port))
 
     # -- fault injection (tests / chaos harness) -------------------------------
 

@@ -152,12 +152,84 @@ class KubeletServer:
             try:
                 body = json.loads(h.rfile.read(length) or b"{}")
                 cmd = list(body.get("command") or [])
+                stdin = body.get("stdin")
             except (ValueError, TypeError):
                 return h._send(400, b"bad exec body", "text/plain")
             if not cmd:
                 return h._send(400, b"no command", "text/plain")
             rc, out = self.kubelet.runtime.exec_in_container(
-                pod.metadata.uid, container, cmd)
+                pod.metadata.uid, container, cmd, stdin=stdin)
             return h._send(200, json.dumps(
                 {"exitCode": rc, "output": out}).encode())
+        if len(parts) == 4 and parts[0] == "attach" and method == "GET":
+            # server.go:640 getAttach. SPDY streaming collapses to a
+            # long-poll over the container's live log stream: return the
+            # lines appended at/after ?since=<index> (waiting up to
+            # ?waitSeconds for new output), plus the next cursor — the
+            # client re-arms to follow the stream.
+            _, ns, pod_name, container = parts
+            pod = self._find_pod(ns, pod_name)
+            if pod is None:
+                return h._send(404, b"pod not found", "text/plain")
+            try:
+                since = int(query.get("since", ["0"])[0])
+                wait = min(float(query.get("waitSeconds", ["2"])[0]), 30.0)
+            except ValueError:
+                return h._send(400, b"bad attach query", "text/plain")
+            import time as _time
+
+            deadline = _time.monotonic() + wait
+            while True:
+                lines = self.kubelet.runtime.container_logs(
+                    pod.metadata.uid, container)
+                if lines is None:
+                    return h._send(404, f"container {container!r} not "
+                                   f"found".encode(), "text/plain")
+                if len(lines) > since or _time.monotonic() >= deadline:
+                    break
+                _time.sleep(0.02)
+            return h._send(200, json.dumps(
+                {"lines": lines[since:], "next": len(lines)}).encode())
+        if len(parts) == 3 and parts[0] == "portForward" and method == "POST":
+            # server.go:751 getPortForward. The SPDY data channel becomes
+            # a real TCP relay: the kubelet opens an ephemeral listener
+            # and pipes every accepted connection to the pod's declared
+            # backend (FakeRuntime.register_pod_server — the hollow
+            # analog of the container process's socket). Returns the
+            # relay address; bytes then flow client->kubelet->pod.
+            _, ns, pod_name = parts
+            pod = self._find_pod(ns, pod_name)
+            if pod is None:
+                return h._send(404, b"pod not found", "text/plain")
+            length = int(h.headers.get("Content-Length") or 0)
+            try:
+                body = json.loads(h.rfile.read(length) or b"{}")
+                port = int(body.get("port"))
+            except (ValueError, TypeError):
+                return h._send(400, b"bad portForward body", "text/plain")
+            backend = self.kubelet.runtime.pod_server(pod.metadata.uid,
+                                                      port)
+            if backend is None:
+                return h._send(400, f"pod {pod_name!r} has no listener "
+                               f"on port {port}".encode(), "text/plain")
+            relay_port = self._start_relay(backend)
+            return h._send(200, json.dumps(
+                {"host": "127.0.0.1", "port": relay_port}).encode())
         h._send(404, b"not found", "text/plain")
+
+    def _start_relay(self, backend) -> int:
+        """One-connection TCP relay to the pod backend; closes after the
+        first session ends (enough for the port-forward contract: a
+        fresh POST opens a fresh relay)."""
+        import socket
+
+        from ..utils.net import relay_once
+
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+        threading.Thread(target=relay_once, args=(lsock, backend),
+                         kwargs={"accept_timeout": 30}, daemon=True,
+                         name="kubelet-portforward").start()
+        return port

@@ -486,6 +486,8 @@ class APIServer:
         if verb == "get":
             if sub == "log" and plural == "pods":
                 return self._serve_pod_log(h, namespace, name, query)
+            if sub == "attach" and plural == "pods":
+                return self._serve_pod_attach(h, namespace, name, query)
             return self._serve_get(h, plural, namespace, name, gv)
         if verb == "create":
             if sub == "binding":
@@ -494,6 +496,8 @@ class APIServer:
                 return self._serve_eviction(h, user, namespace, name)
             if sub == "exec" and plural == "pods":
                 return self._serve_pod_exec(h, namespace, name)
+            if sub == "portforward" and plural == "pods":
+                return self._serve_pod_portforward(h, namespace, name)
             return self._serve_create(h, plural, namespace, user, gv)
         if verb in ("update", "patch"):
             return self._serve_update(h, plural, namespace, name, sub, user,
@@ -526,14 +530,16 @@ class APIServer:
                      if pod.spec.containers else "")
         return pod, host, node.status.kubelet_port, container
 
-    def _kubelet_proxy(self, h, method, host, port, path, body=None):
+    def _kubelet_proxy(self, h, method, host, port, path, body=None,
+                       timeout: float = 10.0):
         import http.client
 
         if self._kubelet_client_ctx is not None:
             conn = http.client.HTTPSConnection(
-                host, port, timeout=10, context=self._kubelet_client_ctx)
+                host, port, timeout=timeout,
+                context=self._kubelet_client_ctx)
         else:
-            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
         try:
             conn.request(method, path, body=body,
                          headers={"Content-Type": "application/json"})
@@ -587,7 +593,49 @@ class APIServer:
                 f"{quote(str(container), safe='')}")
         return self._kubelet_proxy(h, "POST", host, port, path,
                                    body=json.dumps(
-                                       {"command": data.get("command")}))
+                                       {"command": data.get("command"),
+                                        "stdin": data.get("stdin")}))
+
+    def _serve_pod_attach(self, h, namespace, name, query):
+        """GET pods/<name>/attach — proxied to the kubelet's /attach
+        long-poll (server.go:640 getAttach; SPDY collapsed to follow-mode
+        polling, see kubelet/server.py)."""
+        pod, host, port, default_c = self._kubelet_target(namespace, name)
+        container = query.get("container", [default_c])[0]
+        q = []
+        wait = 2.0
+        for key in ("since", "waitSeconds"):
+            v = query.get(key, [None])[0]
+            if v is not None:
+                if key == "waitSeconds":
+                    try:
+                        wait = min(float(v), 30.0)
+                    except ValueError:
+                        raise APIError(400, "BadRequest",
+                                       f"waitSeconds {v!r} is not a number")
+                q.append(f"{key}={quote(v, safe='')}")
+        path = (f"/attach/{quote(pod.metadata.namespace, safe='')}/"
+                f"{quote(pod.metadata.name, safe='')}/"
+                f"{quote(container, safe='')}")
+        if q:
+            path += "?" + "&".join(q)
+        # the proxy must outlive the kubelet's long-poll window or an
+        # idle container turns into a bogus 503 at waitSeconds > 10
+        return self._kubelet_proxy(h, "GET", host, port, path,
+                                   timeout=wait + 10.0)
+
+    def _serve_pod_portforward(self, h, namespace, name):
+        """POST pods/<name>/portforward — proxied to the kubelet, which
+        opens a TCP relay to the pod's listener and returns its address
+        (server.go:751 getPortForward; the SPDY data channel is a real
+        TCP relay here, so bytes genuinely flow end to end)."""
+        pod, host, port, _c = self._kubelet_target(namespace, name)
+        data = self._read_body(h)
+        path = (f"/portForward/{quote(pod.metadata.namespace, safe='')}/"
+                f"{quote(pod.metadata.name, safe='')}")
+        return self._kubelet_proxy(h, "POST", host, port, path,
+                                   body=json.dumps(
+                                       {"port": data.get("port")}))
 
     # -- aggregation (kube-aggregator) -----------------------------------------
 

@@ -1,0 +1,56 @@
+"""TCP relay primitive shared by the port-forward data path.
+
+Both ends of `kubectl port-forward` are the same machine here — the
+kubectl local listener and the kubelet's relay to the pod backend
+(kubelet/server.py, cli/kubectl.py) — so the one-connection
+accept → connect → bidirectional-pump structure lives once, in this
+module, instead of drifting apart in two copies."""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+
+def pump(src: socket.socket, dst: socket.socket) -> None:
+    """Copy bytes src -> dst until EOF, then half-close dst so the far
+    end observes the EOF too."""
+    try:
+        while True:
+            data = src.recv(4096)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        try:
+            dst.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+
+def relay_once(lsock: socket.socket, backend, accept_timeout=None) -> None:
+    """Accept ONE connection on `lsock`, connect to `backend`
+    (host, port), and pump both directions until either side closes.
+    Closes the listener after (or on) the accept — a fresh relay needs a
+    fresh listener, which is the port-forward contract here."""
+    if accept_timeout is not None:
+        lsock.settimeout(accept_timeout)
+    try:
+        conn, _ = lsock.accept()
+    except OSError:
+        lsock.close()
+        return
+    lsock.close()
+    try:
+        up = socket.create_connection(backend, timeout=10)
+    except OSError:
+        conn.close()
+        return
+    t = threading.Thread(target=pump, args=(conn, up), daemon=True)
+    t.start()
+    pump(up, conn)
+    t.join(timeout=10)
+    conn.close()
+    up.close()
