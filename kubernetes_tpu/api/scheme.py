@@ -109,6 +109,8 @@ def unregister(kind: str):
 
 
 register("Pod", "pods", api.Pod)
+register("CSIDriver", "csidrivers", api.CSIDriver,
+         "storage.k8s.io/v1beta1", namespaced=False)
 register("Node", "nodes", api.Node, namespaced=False)
 register("Service", "services", api.Service)
 register("ReplicationController", "replicationcontrollers", api.ReplicationController)

@@ -380,7 +380,8 @@ class PersistentVolumeSpec:
     # Volume source (same convention as Volume.source_kind/source_id):
     # "AWSElasticBlockStore" | "GCEPersistentDisk" | "AzureDisk" | ...
     source_kind: str = ""
-    source_id: str = ""
+    source_id: str = ""  # for CSI: the driver's volume handle
+    csi_driver: str = ""  # CSI only: which registered driver owns it
     capacity: Dict[str, int] = field(default_factory=dict)
     storage_class_name: str = ""
     # Volume topology constraint (reference: 1.11-era PVs carry zone/region
@@ -1179,6 +1180,17 @@ class ClusterRoleBinding:
 
     def __post_init__(self):
         self.metadata.namespace = ""  # cluster-scoped
+
+
+@dataclass
+class CSIDriver:
+    """Out-of-process CSI driver registration (the CSIDriver object of
+    later Kubernetes + the kubelet plugin-socket watcher, collapsed:
+    name = driver name, endpoint = the driver's protocol URL;
+    volume/csi.py)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    endpoint: str = ""
 
 
 @dataclass

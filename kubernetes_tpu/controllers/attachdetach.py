@@ -11,13 +11,17 @@ Reference: pkg/controller/volume/attachdetach/attach_detach_controller.go:95
                    volumes that are attached but no longer desired
                    (reconciler/reconciler.go:141)
 
-The reference invokes cloud-provider attach/detach plugins; this
-framework's "attach operation" is the control-plane state transition
-itself — writing node.status.volumes_attached / volumes_in_use through
-the store — the part the scheduler, kubelet volume manager, and
-multi-attach protection consume. A volume attached elsewhere is not
-attached again until detached (multi-attach guard for RWO volumes,
-reconciler.go:184).
+For in-tree volumes the "attach operation" is the control-plane state
+transition itself — writing node.status.volumes_attached /
+volumes_in_use through the store — the part the scheduler, kubelet
+volume manager, and multi-attach protection consume (the reference's
+cloud-provider calls live behind the cloud seam). For CSI-backed PVs
+the controller additionally crosses the process boundary: the driver's
+ControllerPublishVolume runs BEFORE the attachment is recorded and
+ControllerUnpublishVolume before it is dropped
+(attach_detach_controller.go + csi_attacher.go). A volume attached
+elsewhere is not attached again until detached (multi-attach guard for
+RWO volumes, reconciler.go:184).
 """
 
 from __future__ import annotations
@@ -37,6 +41,40 @@ class AttachDetachController(Controller):
         self.informer("nodes")
         self.informer("persistentvolumeclaims",
                       enqueue_fn=lambda o=None, n=None: self._all_nodes())
+        from ..volume.csi import CSIPlugin
+
+        self._csi = CSIPlugin(store)
+
+    def _pv(self, name: str):
+        return (self.store.get("persistentvolumes", "", name)
+                or self.store.get("persistentvolumes", "default", name))
+
+    def _publish(self, pv_name: str, node_name: str) -> bool:
+        """Out-of-process attach for CSI PVs; in-tree PVs attach by
+        state transition alone. False = driver refused/unreachable
+        (leave unattached; the queue retries with backoff)."""
+        pv = self._pv(pv_name)
+        if pv is None or pv.spec.source_kind != "CSI":
+            return True
+        from ..volume.csi import CSIError
+        from ..volume.plugin import Spec
+
+        try:
+            self._csi.new_attacher().attach(Spec(pv=pv), node_name)
+            return True
+        except CSIError:
+            return False
+
+    def _unpublish(self, pv_name: str, node_name: str) -> None:
+        pv = self._pv(pv_name)
+        if pv is None or pv.spec.source_kind != "CSI":
+            return
+        from ..volume.csi import CSIError
+
+        try:
+            self._csi.new_detacher().detach_pv(pv, node_name)
+        except CSIError:
+            pass  # unpublish is idempotent; a dead driver can't block detach
 
     def _enqueue_pod_node(self, pod, new=None):
         pod = new if new is not None else pod
@@ -82,6 +120,7 @@ class AttachDetachController(Controller):
         # detach first: frees RWO volumes for their new node
         for pv in list(attached):
             if pv not in desired:
+                self._unpublish(pv, name)
                 attached.remove(pv)
                 changed = True
         blocked = None
@@ -94,6 +133,9 @@ class AttachDetachController(Controller):
                 # two nodes each waiting on the other's stale attachment
                 # would livelock (requeued with backoff by the error path)
                 blocked = pv
+                continue
+            if not self._publish(pv, name):
+                blocked = pv  # driver refused: retry with backoff
                 continue
             attached.append(pv)
             changed = True

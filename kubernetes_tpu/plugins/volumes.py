@@ -63,7 +63,9 @@ class VolumeLister:
         return self.store.get("persistentvolumeclaims", namespace, name)
 
     def pv(self, name: str) -> Optional[api.PersistentVolume]:
-        return self.store.get("persistentvolumes", "default", name)
+        # PVs are cluster-scoped; writers vary between "" and "default"
+        return (self.store.get("persistentvolumes", "default", name)
+                or self.store.get("persistentvolumes", "", name))
 
     def pvs(self) -> List[api.PersistentVolume]:
         return list(self.store.list("persistentvolumes"))

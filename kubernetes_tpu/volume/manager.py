@@ -25,7 +25,7 @@ class VolumeManager:
                  mount_backend: Optional[InMemoryMount] = None):
         self.store = store
         self.node_name = node_name
-        self.plugins = plugin_mgr or default_plugin_mgr()
+        self.plugins = plugin_mgr or default_plugin_mgr(store)
         self.mount = mount_backend or InMemoryMount()
         self._lock = threading.Lock()
         # desired: (pod uid, volume name) -> (pod, Spec)
@@ -106,8 +106,24 @@ class VolumeManager:
         mounted: Set[Tuple[str, str]] = {
             (m.pod_uid, m.volume_name) for m in self.mount.list()}
         for pod_uid, vname in mounted - set(desired):
-            # orphaned mount: the pod is gone (reconciler.go:166)
-            self.mount.unmount(pod_uid, vname)
+            # orphaned mount: the pod is gone (reconciler.go:166).
+            # Tear down through the owning plugin — out-of-process
+            # plugins (CSI NodeUnpublish) must observe the unmount, not
+            # just the mount table
+            rec = self.mount.get(pod_uid, vname)
+            plugin = (self.plugins.find_plugin_by_name(rec.kind)
+                      if rec is not None else None)
+            if plugin is not None:
+                try:
+                    plugin.new_unmounter(vname, pod_uid,
+                                         self.mount).tear_down()
+                except Exception:
+                    # the mount record survives a failed out-of-process
+                    # teardown so the next pass retries NodeUnpublish —
+                    # dropping it would leak the driver's publish state
+                    self._dirty = True
+            else:
+                self.mount.unmount(pod_uid, vname)
         still_waiting = False
         for (pod_uid, vname), (pod, spec) in desired.items():
             if (pod_uid, vname) in mounted:
@@ -117,8 +133,20 @@ class VolumeManager:
                 if spec.pv.metadata.name not in attached:
                     still_waiting = True
                     continue  # waiting on the attach/detach controller
-            plugin.new_mounter(spec, pod, self.mount, self.store,
-                               mgr=self.plugins).set_up()
+            try:
+                plugin.new_mounter(spec, pod, self.mount, self.store,
+                                   mgr=self.plugins).set_up()
+            except Exception as e:
+                # an out-of-process mount (CSI NodePublish) can fail or
+                # time out; the pod stays gated and the mount retries
+                # next pass — a raise here would take down the whole
+                # kubelet sync loop and (worse) leave _dirty cleared,
+                # wedging the manager permanently
+                import sys
+
+                print(f"# volume mount {vname!r} for pod {pod_uid} "
+                      f"failed: {e}", file=sys.stderr)
+                still_waiting = True
         if still_waiting:
             self._dirty = True  # retry next pass even if nothing changes
 

@@ -158,11 +158,19 @@ class VolumePluginMgr:
             return None
         return p if p.attachable else None
 
+    def find_plugin_by_name(self, name: str) -> Optional[VolumePlugin]:
+        """Resolve a plugin from a mount record's kind — the teardown
+        direction (the reference resolves the same way from the mount
+        dir's vol_data.json)."""
+        return next((p for p in self.plugins if p.name == name), None)
 
-def default_plugin_mgr() -> VolumePluginMgr:
+
+def default_plugin_mgr(store=None) -> VolumePluginMgr:
     """ProbeVolumePlugins analog (cmd/kube-controller-manager/app/
-    plugins.go:56 + pkg/kubelet/volume_host.go): the in-tree roster."""
+    plugins.go:56 + pkg/kubelet/volume_host.go): the in-tree roster plus
+    the CSI shim (which needs the store to resolve driver endpoints)."""
     from . import plugins as pl
+    from .csi import CSIPlugin
 
     return VolumePluginMgr([
         pl.EmptyDirPlugin(), pl.HostPathPlugin(), pl.ConfigMapPlugin(),
@@ -171,4 +179,5 @@ def default_plugin_mgr() -> VolumePluginMgr:
         pl.PDPlugin("GCEPersistentDisk"),
         pl.PDPlugin("AWSElasticBlockStore"),
         pl.PDPlugin("AzureDisk"), pl.PDPlugin("RBD"), pl.PDPlugin("ISCSI"),
+        CSIPlugin(store),
     ])

@@ -1,0 +1,269 @@
+"""CSI: the out-of-process volume driver seam, end to end.
+
+Reference: pkg/volume/csi/csi_plugin.go:45 (the in-tree shim),
+external-provisioner/external-attacher sidecars. Round-4 verdict item
+5's 'done' bar: a pod using a CSI-provisioned volume schedules,
+attaches, mounts (table-level), tears down — with every step crossing
+the wire protocol to the driver, which here is either an in-process
+HTTP server (unit flow) or a genuinely separate OS process
+(test_out_of_process_driver)."""
+
+import subprocess
+import sys
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers.attachdetach import AttachDetachController
+from kubernetes_tpu.controllers.volumebinding import \
+    PersistentVolumeController
+from kubernetes_tpu.kubelet import Kubelet
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.volume import csi
+
+from helpers import make_node
+
+
+def _claimed_pod(name, pvc):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.PodSpec(
+            containers=[api.Container(resources=api.ResourceRequirements(
+                requests=api.resource_list(cpu="100m", memory="64Mi")))],
+            volumes=[api.Volume(name="data", pvc_name=pvc)]))
+
+
+def _annotated_pvc(name, driver, storage="1Gi"):
+    return api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(
+            name=name,
+            annotations={csi.PROVISIONER_ANNOTATION: driver}),
+        spec=api.PersistentVolumeClaimSpec(
+            requests=api.resource_list(storage=storage)))
+
+
+class TestCSILifecycle:
+    def setup_method(self):
+        self.store = ObjectStore()
+        self.driver = csi.MockCSIDriver()
+        self.server = csi.CSIDriverServer(self.driver).start()
+        csi.register_driver(self.store, self.driver.name, self.server.url)
+        self.store.create("nodes", make_node("n1", cpu="4"))
+        self.prov = csi.CSIProvisioner(self.store, self.driver.name)
+        self.pvctrl = PersistentVolumeController(self.store)
+        self.adctrl = AttachDetachController(self.store)
+
+    def teardown_method(self):
+        self.server.stop()
+
+    def _settle(self, rounds=3):
+        for _ in range(rounds):
+            self.prov.sync()
+            self.pvctrl.sync_all()
+            self.adctrl.sync_all()
+
+    def test_provision_schedule_attach_mount_teardown(self):
+        # 1. dynamic provisioning: annotated claim -> CreateVolume -> PV
+        self.store.create("persistentvolumeclaims",
+                          _annotated_pvc("data-claim", self.driver.name))
+        self._settle()
+        pvc = self.store.get("persistentvolumeclaims", "default",
+                             "data-claim")
+        assert pvc.spec.volume_name, "claim never bound to provisioned PV"
+        pv = self.store.get("persistentvolumes", "", pvc.spec.volume_name)
+        assert pv.spec.source_kind == "CSI"
+        assert pv.spec.source_id in self.driver.volumes  # driver made it
+
+        # 2. the pod schedules (bound claim passes CheckVolumeBinding)
+        sched = Scheduler(self.store)
+        self.store.create("pods", _claimed_pod("app", "data-claim"))
+        assert sched.schedule_pending() == 1
+        pod = self.store.get("pods", "default", "app")
+        assert pod.spec.node_name == "n1"
+
+        # 3. attach: the controller calls ControllerPublishVolume BEFORE
+        # recording the attachment
+        self._settle()
+        assert self.driver.published[pv.spec.source_id] == "n1"
+        node = self.store.get("nodes", "default", "n1")
+        assert pv.metadata.name in node.status.volumes_attached
+
+        # 4. mount: the kubelet volume manager gates on the attachment,
+        # then NodePublishVolume materializes the mount
+        kl = Kubelet(self.store, "n1")
+        kl.sync_once()
+        assert self.store.get("pods", "default", "app").status.phase == \
+            "Running"
+        m = kl.volume_manager.mount.get(pod.metadata.uid, "data")
+        assert m is not None and m.kind == "kubernetes.io/csi"
+        assert m.payload["csi/device"] == f"/dev/csi/{pv.spec.source_id}"
+        assert (pv.spec.source_id,
+                f"{pod.metadata.uid}/data") in self.driver.node_published
+
+        # 5. teardown: pod deleted -> NodeUnpublish (kubelet) ->
+        # ControllerUnpublish (controller) -> claim deleted ->
+        # DeleteVolume (provisioner reclaim)
+        self.store.delete("pods", "default", "app")
+        kl.sync_once()
+        kl.volume_manager.reconcile(node)
+        assert kl.volume_manager.mount.get(pod.metadata.uid, "data") is None
+        assert not self.driver.node_published
+        self._settle()
+        assert pv.spec.source_id not in self.driver.published
+        node = self.store.get("nodes", "default", "n1")
+        assert pv.metadata.name not in node.status.volumes_attached
+        self.store.delete("persistentvolumeclaims", "default", "data-claim")
+        self._settle()
+        assert pv.spec.source_id not in self.driver.volumes
+        assert self.store.get("persistentvolumes", "",
+                              pv.metadata.name) is None
+        sched.close()
+
+    def test_multi_attach_guard_spans_the_driver(self):
+        """The driver itself also refuses double-publish — the control
+        plane's RWO guard and the driver's are independent defenses."""
+        self.store.create("persistentvolumeclaims",
+                          _annotated_pvc("c2", self.driver.name))
+        self._settle()
+        pvc = self.store.get("persistentvolumeclaims", "default", "c2")
+        pv = self.store.get("persistentvolumes", "", pvc.spec.volume_name)
+        att = csi.CSIPlugin(self.store).new_attacher()
+        from kubernetes_tpu.volume.plugin import Spec
+
+        att.attach(Spec(pv=pv), "n1")
+        try:
+            att.attach(Spec(pv=pv), "n2")
+            raise AssertionError("double publish was accepted")
+        except csi.CSIError:
+            pass
+
+    def test_unregistered_driver_blocks_attach_not_control_plane(self):
+        """A PV naming an unregistered driver: the controller keeps the
+        volume unattached (and retries) without recording a lie in
+        node.status."""
+        self.store.create("persistentvolumes", api.PersistentVolume(
+            metadata=api.ObjectMeta(name="ghost-pv", namespace=""),
+            spec=api.PersistentVolumeSpec(
+                source_kind="CSI", source_id="vol-x",
+                csi_driver="ghost.csi.example",
+                capacity=api.resource_list(storage="1Gi"))))
+        self.store.create("persistentvolumeclaims",
+                          api.PersistentVolumeClaim(
+                              metadata=api.ObjectMeta(name="ghost-claim"),
+                              spec=api.PersistentVolumeClaimSpec(
+                                  requests=api.resource_list(storage="1Gi"))))
+        self._settle()
+        self.store.create("pods", _claimed_pod("ghost-pod", "ghost-claim"))
+        sched = Scheduler(self.store)
+        sched.schedule_pending()
+        self._settle()
+        node = self.store.get("nodes", "default", "n1")
+        assert "ghost-pv" not in node.status.volumes_attached
+        sched.close()
+
+
+class TestOutOfProcessDriver:
+    def test_subprocess_driver_serves_the_full_flow(self):
+        """The driver runs as a REAL separate OS process
+        (python -m kubernetes_tpu.volume.csi) — nothing shared but the
+        wire protocol."""
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.volume.csi"],
+            stdout=subprocess.PIPE, text=True, cwd="/root/repo")
+        try:
+            url = proc.stdout.readline().strip()
+            assert url.startswith("http://"), url
+            store = ObjectStore()
+            csi.register_driver(store, "mock.csi.k8s.io", url)
+            client = csi._client_for(store, "mock.csi.k8s.io")
+            ident = client.call("GET", "/identity")
+            assert ident["name"] == "mock.csi.k8s.io"
+            store.create("nodes", make_node("n1", cpu="2"))
+            store.create("persistentvolumeclaims",
+                         _annotated_pvc("sub-claim", "mock.csi.k8s.io"))
+            prov = csi.CSIProvisioner(store, "mock.csi.k8s.io")
+            pvctrl = PersistentVolumeController(store)
+            adctrl = AttachDetachController(store)
+            for _ in range(3):
+                prov.sync()
+                pvctrl.sync_all()
+                adctrl.sync_all()
+            pvc = store.get("persistentvolumeclaims", "default",
+                            "sub-claim")
+            assert pvc.spec.volume_name
+            store.create("pods", _claimed_pod("sub-app", "sub-claim"))
+            sched = Scheduler(store)
+            assert sched.schedule_pending() == 1
+            for _ in range(3):
+                adctrl.sync_all()
+            kl = Kubelet(store, "n1")
+            kl.sync_once()
+            pod = store.get("pods", "default", "sub-app")
+            assert pod.status.phase == "Running"
+            m = kl.volume_manager.mount.get(pod.metadata.uid, "data")
+            assert m is not None and m.payload["csi/driver"] == \
+                "mock.csi.k8s.io"
+            sched.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+class TestCSIFailureModes:
+    def setup_method(self):
+        self.store = ObjectStore()
+        self.driver = csi.MockCSIDriver()
+        self.server = csi.CSIDriverServer(self.driver).start()
+        csi.register_driver(self.store, self.driver.name, self.server.url)
+        self.store.create("nodes", make_node("n1", cpu="4"))
+
+    def teardown_method(self):
+        self.server.stop()
+
+    def test_provisioner_double_sync_does_not_reclaim_unbound_pv(self):
+        """Provision, then sync AGAIN before the binder runs: the PV
+        (and its backing volume) must survive — reclaiming a
+        pending-bind PV would flip-flop provision/destroy."""
+        self.store.create("persistentvolumeclaims",
+                          _annotated_pvc("slow-claim", self.driver.name))
+        prov = csi.CSIProvisioner(self.store, self.driver.name)
+        prov.sync()
+        pvc = self.store.get("persistentvolumeclaims", "default",
+                             "slow-claim")
+        pv_name = f"pvc-{pvc.metadata.uid}"
+        assert self.store.get("persistentvolumes", "", pv_name) is not None
+        prov.sync()  # binder has NOT run: volume_name still empty
+        prov.sync()
+        pv = self.store.get("persistentvolumes", "", pv_name)
+        assert pv is not None, "pending-bind PV was reclaimed"
+        assert pv.spec.source_id in self.driver.volumes
+
+    def test_driver_outage_does_not_wedge_kubelet(self):
+        """NodePublish failing (driver down) keeps the pod gated and the
+        sync loop alive; the mount lands once the driver returns."""
+        self.store.create("persistentvolumeclaims",
+                          _annotated_pvc("c3", self.driver.name))
+        prov = csi.CSIProvisioner(self.store, self.driver.name)
+        pvctrl = PersistentVolumeController(self.store)
+        adctrl = AttachDetachController(self.store)
+        for _ in range(2):
+            prov.sync()
+            pvctrl.sync_all()
+        self.store.create("pods", _claimed_pod("app3", "c3"))
+        sched = Scheduler(self.store)
+        assert sched.schedule_pending() == 1
+        adctrl.sync_all()
+        # driver dies BEFORE the kubelet mounts
+        self.server.stop()
+        kl = Kubelet(self.store, "n1")
+        kl.sync_once()  # must not raise; pod stays gated
+        pod = self.store.get("pods", "default", "app3")
+        assert pod.status.phase != "Running"
+        # driver returns at the SAME registered endpoint
+        self.server = csi.CSIDriverServer(self.driver,
+                                          port=self.server.port).start()
+        kl.sync_once()
+        kl.sync_once()
+        assert self.store.get("pods", "default",
+                              "app3").status.phase == "Running"
+        sched.close()
