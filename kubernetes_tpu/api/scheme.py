@@ -111,6 +111,10 @@ def unregister(kind: str):
 register("Pod", "pods", api.Pod)
 register("CSIDriver", "csidrivers", api.CSIDriver,
          "storage.k8s.io/v1beta1", namespaced=False)
+register("PodPreset", "podpresets", api.PodPreset,
+         "settings.k8s.io/v1alpha1")
+register("StorageClass", "storageclasses", api.StorageClass,
+         "storage.k8s.io/v1", namespaced=False)
 register("Node", "nodes", api.Node, namespaced=False)
 register("Service", "services", api.Service)
 register("ReplicationController", "replicationcontrollers", api.ReplicationController)

@@ -45,6 +45,9 @@ class OwnerReference:
     name: str = ""
     uid: str = ""
     controller: bool = False
+    # foreground-deletion blocker; setting it requires update permission
+    # on the owner's finalizers (admission gc plugin)
+    block_owner_deletion: bool = False
 
 
 # --- resources --------------------------------------------------------------
@@ -255,6 +258,7 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
     service_account_name: str = ""
+    host_network: bool = False  # host-namespace flag (exec-deny, PSP)
 
 
 @dataclass
@@ -1180,6 +1184,28 @@ class ClusterRoleBinding:
 
     def __post_init__(self):
         self.metadata.namespace = ""  # cluster-scoped
+
+
+@dataclass
+class PodPreset:
+    """settings.k8s.io/v1alpha1 PodPreset: env/volumes injected into
+    selector-matching pods at admission (plugin/pkg/admission/podpreset)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None  # None -> every pod in ns
+    env: Dict[str, str] = field(default_factory=dict)
+    volumes: List[Volume] = field(default_factory=list)
+
+
+@dataclass
+class StorageClass:
+    """storage.k8s.io/v1 StorageClass (flattened: the
+    is-default-class annotation becomes a field)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    is_default: bool = False
+    volume_binding_mode: str = "Immediate"
 
 
 @dataclass

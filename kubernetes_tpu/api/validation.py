@@ -11,6 +11,7 @@ where the reference's registry strategies call Validate
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import List, Optional
 
@@ -193,16 +194,228 @@ def validate_pvc(pvc) -> ErrorList:
     return errs
 
 
-# kind plural -> validator; update validators get (new, old)
+def _validate_workload(obj, selector_required: bool = True) -> ErrorList:
+    """Shared apps-workload shape: replicas >= 0; apps/v1 selector
+    required and matching the template labels
+    (pkg/apis/apps/validation ValidateDeployment/ReplicaSet...)."""
+    errs = validate_object_meta(obj.metadata)
+    replicas = getattr(obj.spec, "replicas", None)
+    if replicas is not None and replicas < 0:
+        errs.add("spec.replicas", replicas, "must be non-negative")
+    sel = getattr(obj, "selector", None) or getattr(obj.spec, "selector",
+                                                    None)
+    template = getattr(obj.spec, "template", None) or \
+        getattr(obj, "template", None)
+    if selector_required and sel is None:
+        errs.add("spec.selector", None, "selector is required")
+    if sel is not None and template is not None:
+        tlabels = getattr(getattr(template, "metadata", None), "labels",
+                          None) or {}
+        s = sel.to_selector() if hasattr(sel, "to_selector") else None
+        if s is not None and s.requirements and not s.matches(tlabels):
+            errs.add("spec.template.metadata.labels", tlabels,
+                     "must match spec.selector")
+    return errs
+
+
+def validate_namespace(ns) -> ErrorList:
+    errs = ErrorList()
+    # namespace names are DNS-1123 LABELS (no dots)
+    if not ns.metadata.name or "." in ns.metadata.name \
+            or not _DNS1123.match(ns.metadata.name):
+        errs.add("metadata.name", ns.metadata.name,
+                 "must be a DNS-1123 label")
+    return errs
+
+
+def validate_pv(pv) -> ErrorList:
+    errs = validate_object_meta(pv.metadata)
+    for res_, v in (pv.spec.capacity or {}).items():
+        if v < 0:
+            errs.add(f"spec.capacity[{res_}]", v, "must be non-negative")
+    if pv.spec.source_kind == "CSI" and not pv.spec.csi_driver:
+        errs.add("spec.csi.driver", "", "driver name is required")
+    return errs
+
+
+def validate_pv_update(new, old) -> ErrorList:
+    """PV source is immutable (validation.go ValidatePersistentVolumeUpdate)."""
+    errs = ErrorList()
+    if (new.spec.source_kind, new.spec.source_id) != \
+            (old.spec.source_kind, old.spec.source_id):
+        errs.add("spec.source", new.spec.source_kind,
+                 "volume source is immutable")
+    return errs
+
+
+def validate_pvc_update(new, old) -> ErrorList:
+    """Claim spec immutable after bind except the binder's own
+    volumeName transition (ValidatePersistentVolumeClaimUpdate)."""
+    errs = ErrorList()
+    if old.spec.volume_name and \
+            new.spec.volume_name != old.spec.volume_name:
+        errs.add("spec.volumeName", new.spec.volume_name,
+                 "is immutable after binding")
+    if old.spec.volume_name and \
+            new.spec.storage_class_name != old.spec.storage_class_name:
+        errs.add("spec.storageClassName", new.spec.storage_class_name,
+                 "is immutable after binding")
+    return errs
+
+
+def validate_service_update(new, old) -> ErrorList:
+    """clusterIP immutable once allocated (ValidateServiceUpdate)."""
+    errs = ErrorList()
+    if old.spec.cluster_ip and new.spec.cluster_ip != old.spec.cluster_ip:
+        errs.add("spec.clusterIP", new.spec.cluster_ip,
+                 "may not change once set")
+    return errs
+
+
+def validate_rbac_role(role) -> ErrorList:
+    """ValidatePolicyRule (rbac/validation): every rule names verbs and
+    either resources WITH apiGroups, or nonResourceURLs. Requiring
+    apiGroups here is what makes the authorizer's strict
+    empty-matches-nothing semantics (server/auth.py) unsurprising."""
+    errs = validate_object_meta(role.metadata)
+    for i, r in enumerate(role.rules or []):
+        rp = f"rules[{i}]"
+        if not r.verbs:
+            errs.add(f"{rp}.verbs", [], "at least one verb is required")
+        has_res = bool(r.resources)
+        has_nonres = bool(r.non_resource_urls)
+        if not has_res and not has_nonres:
+            errs.add(f"{rp}.resources", [],
+                     "resources or nonResourceURLs is required")
+        if has_res and not r.api_groups:
+            errs.add(f"{rp}.apiGroups", [],
+                     "apiGroups is required for resource rules "
+                     '([""] selects the core group)')
+    return errs
+
+
+def validate_rbac_binding(b) -> ErrorList:
+    errs = validate_object_meta(b.metadata)
+    if not getattr(b.role_ref, "name", ""):
+        errs.add("roleRef.name", "", "roleRef is required")
+    for i, s in enumerate(b.subjects or []):
+        if s.kind not in ("User", "Group", "ServiceAccount"):
+            errs.add(f"subjects[{i}].kind", s.kind, "invalid subject kind")
+        if not s.name:
+            errs.add(f"subjects[{i}].name", s.name, "name is required")
+    return errs
+
+
+def validate_rbac_binding_update(new, old) -> ErrorList:
+    """roleRef is immutable (rbac/validation ValidateRoleBindingUpdate)."""
+    errs = ErrorList()
+    if (new.role_ref.kind, new.role_ref.name) != \
+            (old.role_ref.kind, old.role_ref.name):
+        errs.add("roleRef", new.role_ref.name, "roleRef is immutable")
+    return errs
+
+
+def validate_hpa(hpa) -> ErrorList:
+    errs = validate_object_meta(hpa.metadata)
+    if hpa.spec.max_replicas <= 0:
+        errs.add("spec.maxReplicas", hpa.spec.max_replicas,
+                 "must be greater than 0")
+    if hpa.spec.min_replicas is not None and \
+            hpa.spec.min_replicas > hpa.spec.max_replicas:
+        errs.add("spec.minReplicas", hpa.spec.min_replicas,
+                 "must not exceed maxReplicas")
+    return errs
+
+
+def validate_pdb(pdb) -> ErrorList:
+    errs = validate_object_meta(pdb.metadata)
+    if pdb.spec.min_available is not None and \
+            getattr(pdb.spec, "max_unavailable", None) is not None:
+        errs.add("spec", None,
+                 "minAvailable and maxUnavailable are mutually exclusive")
+    return errs
+
+
+def validate_resource_quota(q) -> ErrorList:
+    errs = validate_object_meta(q.metadata)
+    for k, v in (q.spec.hard or {}).items():
+        if v < 0:
+            errs.add(f"spec.hard[{k}]", v, "must be non-negative")
+    return errs
+
+
+def validate_configmap(cm) -> ErrorList:
+    errs = validate_object_meta(cm.metadata)
+    for k in (cm.data or {}):
+        if not re.match(r"^[-._a-zA-Z0-9]+$", k):
+            errs.add(f"data[{k}]", k, "invalid key name")
+    return errs
+
+
+def validate_cronjob(cj) -> ErrorList:
+    errs = validate_object_meta(cj.metadata)
+    schedule = getattr(cj.spec, "schedule", "")
+    if not schedule or len(schedule.split()) != 5:
+        errs.add("spec.schedule", schedule,
+                 "must be a 5-field cron expression")
+    return errs
+
+
+def validate_priority_class(pc) -> ErrorList:
+    errs = validate_object_meta(pc.metadata)
+    if pc.value > 1_000_000_000 and not pc.metadata.name.startswith(
+            "system-"):
+        errs.add("value", pc.value,
+                 "only system classes may exceed 1000000000")
+    return errs
+
+
+def validate_job(job) -> ErrorList:
+    errs = validate_object_meta(job.metadata)
+    for fname in ("completions", "parallelism", "backoff_limit"):
+        v = getattr(job.spec, fname, None)
+        if v is not None and v < 0:
+            errs.add(f"spec.{fname}", v, "must be non-negative")
+    return errs
+
+
+# kind plural -> validator; update validators get (new, old).
+# Kinds without a specific entry still get validate_object_meta via
+# validate() — every served built-in kind reports field-addressed 422s.
 VALIDATORS = {
     "pods": validate_pod,
     "nodes": validate_node,
     "services": validate_service,
     "persistentvolumeclaims": validate_pvc,
+    "persistentvolumes": validate_pv,
+    "namespaces": validate_namespace,
+    "deployments": _validate_workload,
+    "replicasets": _validate_workload,
+    "statefulsets": _validate_workload,
+    "daemonsets": _validate_workload,
+    "replicationcontrollers": functools.partial(
+        _validate_workload, selector_required=False),
+    "jobs": validate_job,
+    "cronjobs": validate_cronjob,
+    "configmaps": validate_configmap,
+    "secrets": validate_configmap,
+    "roles": validate_rbac_role,
+    "clusterroles": validate_rbac_role,
+    "rolebindings": validate_rbac_binding,
+    "clusterrolebindings": validate_rbac_binding,
+    "horizontalpodautoscalers": validate_hpa,
+    "poddisruptionbudgets": validate_pdb,
+    "resourcequotas": validate_resource_quota,
+    "priorityclasses": validate_priority_class,
 }
 
 UPDATE_VALIDATORS = {
     "pods": validate_pod_update,
+    "services": validate_service_update,
+    "persistentvolumes": validate_pv_update,
+    "persistentvolumeclaims": validate_pvc_update,
+    "rolebindings": validate_rbac_binding_update,
+    "clusterrolebindings": validate_rbac_binding_update,
 }
 
 
@@ -211,6 +424,10 @@ def validate(kind: str, obj, old=None) -> ErrorList:
     v = VALIDATORS.get(kind)
     if v is not None:
         errs.extend(v(obj))
+    elif hasattr(obj, "metadata"):
+        # no kind-specific rules yet: metadata is still validated for
+        # EVERY served kind (name/namespace/labels shape)
+        errs.extend(validate_object_meta(obj.metadata))
     if old is not None:
         uv = UPDATE_VALIDATORS.get(kind)
         if uv is not None:

@@ -65,16 +65,21 @@ class AttachDetachController(Controller):
         except CSIError:
             return False
 
-    def _unpublish(self, pv_name: str, node_name: str) -> None:
+    def _unpublish(self, pv_name: str, node_name: str) -> bool:
+        """False = the driver never received the unpublish — the
+        attachment must STAY recorded so a later sync retries; dropping
+        it would leak the driver's publish entry and permanently block
+        the volume's next attach elsewhere."""
         pv = self._pv(pv_name)
         if pv is None or pv.spec.source_kind != "CSI":
-            return
+            return True
         from ..volume.csi import CSIError
 
         try:
             self._csi.new_detacher().detach_pv(pv, node_name)
+            return True
         except CSIError:
-            pass  # unpublish is idempotent; a dead driver can't block detach
+            return False
 
     def _enqueue_pod_node(self, pod, new=None):
         pod = new if new is not None else pod
@@ -118,12 +123,14 @@ class AttachDetachController(Controller):
         attached: List[str] = list(node.status.volumes_attached)
         changed = False
         # detach first: frees RWO volumes for their new node
+        blocked = None
         for pv in list(attached):
             if pv not in desired:
-                self._unpublish(pv, name)
+                if not self._unpublish(pv, name):
+                    blocked = pv  # driver unreachable: retry the detach
+                    continue
                 attached.remove(pv)
                 changed = True
-        blocked = None
         for pv in desired:
             if pv in attached:
                 continue

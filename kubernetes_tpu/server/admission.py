@@ -108,42 +108,86 @@ class DefaultTolerationSeconds(AdmissionPlugin):
                                        toleration_seconds=300))
 
 
+# quota evaluator registry (pkg/quota/evaluator/core): per-kind usage
+# contributions. A kind's evaluator returns {quota key -> delta} for one
+# object; object COUNTS are served under both the legacy core key
+# ("pods", "services", ...) and the generic count/<resource> form.
+def _pod_usage(pod):
+    req = api.get_resource_request(pod)
+    return {"pods": 1, "count/pods": 1,
+            "requests.cpu": req.get("cpu", 0), "cpu": req.get("cpu", 0),
+            "requests.memory": req.get("memory", 0),
+            "memory": req.get("memory", 0)}
+
+
+def _service_usage(svc):
+    out = {"services": 1, "count/services": 1}
+    if svc.spec.type == "NodePort":
+        out["services.nodeports"] = 1
+    elif svc.spec.type == "LoadBalancer":
+        out["services.loadbalancers"] = 1
+    return out
+
+
+def _pvc_usage(pvc):
+    return {"persistentvolumeclaims": 1,
+            "count/persistentvolumeclaims": 1,
+            "requests.storage": (pvc.spec.requests or {}).get("storage", 0)}
+
+
+QUOTA_EVALUATORS = {
+    "pods": _pod_usage,
+    "services": _service_usage,
+    "persistentvolumeclaims": _pvc_usage,
+    "configmaps": lambda o: {"configmaps": 1, "count/configmaps": 1},
+    "secrets": lambda o: {"secrets": 1, "count/secrets": 1},
+    "replicationcontrollers": lambda o: {
+        "replicationcontrollers": 1, "count/replicationcontrollers": 1},
+}
+
+
+def _quota_live(kind: str, obj) -> bool:
+    """Does this object currently consume quota? (pods: active only —
+    the same predicate the controller's recompute uses)."""
+    return kind != "pods" or api.is_pod_active(obj)
+
+
 class ResourceQuotaAdmission(AdmissionPlugin):
-    """Enforce hard pod-count and cpu/memory request quotas per namespace
-    (plugin/pkg/admission/resourcequota + pkg/quota evaluators,
-    simplified to the core-resource evaluator)."""
+    """Enforce hard quotas per namespace across the evaluator set —
+    pod counts + compute requests, service counts (incl. nodeports/
+    loadbalancers), PVC counts + storage requests, and generic object
+    counts (plugin/pkg/admission/resourcequota +
+    pkg/quota/evaluator/core)."""
 
     name = "ResourceQuota"
 
     def admit(self, op, kind, obj, old, user, store):
-        if op != "create" or kind != "pods":
+        if op != "create" or kind not in QUOTA_EVALUATORS:
             return
         ns = obj.metadata.namespace
         quotas = [q for q in store.list("resourcequotas", ns)]
         if not quotas:
             return
-        req = api.get_resource_request(obj)
-        # only active pods consume quota — same predicate the controller's
-        # recompute uses (api.is_pod_active)
-        pods_in_ns = [p for p in store.list("pods", ns)
-                      if api.is_pod_active(p)]
+        evaluator = QUOTA_EVALUATORS[kind]
+        delta = evaluator(obj)
+        relevant = {k for q in quotas for k in q.spec.hard if k in delta}
+        if not relevant:
+            return
+        used: dict = {}
+        for existing in store.list(kind, ns):
+            if not _quota_live(kind, existing):
+                continue
+            for k, v in evaluator(existing).items():
+                used[k] = used.get(k, 0) + v
         for q in quotas:
-            hard = q.spec.hard
-            if "pods" in hard and len(pods_in_ns) + 1 > hard["pods"]:
-                raise AdmissionError(
-                    f"exceeded quota {q.metadata.name}: pods "
-                    f"{len(pods_in_ns) + 1} > {hard['pods']}")
-            for rname, label in (("cpu", "requests.cpu"),
-                                 ("memory", "requests.memory")):
-                limit = hard.get(label, hard.get(rname))
-                if limit is None:
+            for key, limit in q.spec.hard.items():
+                if key not in delta:
                     continue
-                used = sum(api.get_resource_request(p).get(rname, 0)
-                           for p in pods_in_ns)
-                if used + req.get(rname, 0) > limit:
+                total = used.get(key, 0) + delta[key]
+                if total > limit:
                     raise AdmissionError(
-                        f"exceeded quota {q.metadata.name}: {label} "
-                        f"{used + req.get(rname, 0)} > {limit}")
+                        f"exceeded quota {q.metadata.name}: {key} "
+                        f"{total} > {limit}")
 
 
 class NodeRestriction(AdmissionPlugin):
@@ -493,6 +537,194 @@ class PodSecurityPolicyAdmission(AdmissionPlugin):
                 "unable to validate against any pod security policy")
 
 
+class PodPresetAdmission(AdmissionPlugin):
+    """Inject env/volumes from matching PodPresets at pod creation
+    (plugin/pkg/admission/podpreset/admission.go): every PodPreset in
+    the pod's namespace whose selector matches the pod's labels merges
+    its env into every container and appends its volumes; applied
+    presets are recorded in annotations. A conflict (same env key,
+    different value) skips the preset entirely, as in the reference."""
+
+    name = "PodPreset"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "pods":
+            return
+        for preset in store.list("podpresets", obj.metadata.namespace):
+            sel = (preset.selector.to_selector()
+                   if preset.selector is not None else None)
+            if sel is not None and sel.requirements and \
+                    not sel.matches(obj.metadata.labels or {}):
+                continue
+            conflict = any(
+                c.env.get(k) not in (None, v)
+                for c in obj.spec.containers
+                for k, v in preset.env.items())
+            if conflict:
+                continue
+            for c in obj.spec.containers:
+                merged = dict(preset.env)
+                merged.update(c.env or {})
+                c.env = merged
+            existing = {v.name for v in obj.spec.volumes}
+            obj.spec.volumes.extend(v for v in preset.volumes
+                                    if v.name not in existing)
+            obj.metadata.annotations = dict(obj.metadata.annotations or {})
+            obj.metadata.annotations[
+                f"podpreset.admission.kubernetes.io/podpreset-"
+                f"{preset.metadata.name}"] = \
+                str(preset.metadata.resource_version)
+
+
+class ImagePolicyWebhook(AdmissionPlugin):
+    """POST an ImageReview to a backend webhook; deny pods whose images
+    the backend rejects (plugin/pkg/admission/imagepolicy/admission.go).
+    default_allow governs backend failure (the kubeconfig's
+    defaultAllow)."""
+
+    name = "ImagePolicyWebhook"
+
+    def __init__(self, backend_url: str, default_allow: bool = False,
+                 timeout: float = 5.0):
+        self.backend_url = backend_url
+        self.default_allow = default_allow
+        self.timeout = timeout
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "pods":
+            return
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        review = {"apiVersion": "imagepolicy.k8s.io/v1alpha1",
+                  "kind": "ImageReview",
+                  "spec": {"containers": [{"image": c.image}
+                                          for c in obj.spec.containers],
+                           "namespace": obj.metadata.namespace}}
+        req = urllib.request.Request(
+            self.backend_url, data=_json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                resp = _json.loads(r.read() or b"{}")
+        except (urllib.error.URLError, OSError, ValueError):
+            if self.default_allow:
+                return
+            raise AdmissionError(
+                "image policy webhook unreachable (defaultAllow=false)")
+        status = resp.get("status", {})
+        if not status.get("allowed", False):
+            raise AdmissionError(
+                f"image policy denied: "
+                f"{status.get('reason', 'unspecified')}")
+
+
+class OwnerReferencesPermissionEnforcement(AdmissionPlugin):
+    """Setting blockOwnerDeletion on an owner reference requires update
+    permission on the owner's finalizers subresource
+    (plugin/pkg/admission/gc/gc_admission.go) — otherwise any creator
+    could block foreground deletion of objects it cannot touch."""
+
+    name = "OwnerReferencesPermissionEnforcement"
+
+    def __init__(self, authorizer=None):
+        self.authorizer = authorizer
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op not in ("create", "update") or self.authorizer is None \
+                or user is None:
+            return
+        refs = getattr(obj.metadata, "owner_references", None) or []
+        old_blocking = {r.uid for r in
+                        (getattr(old.metadata, "owner_references", None)
+                         or [])
+                        if r.block_owner_deletion} if old is not None else set()
+        for ref in refs:
+            if not ref.block_owner_deletion or ref.uid in old_blocking:
+                continue
+            from ..api import scheme
+
+            plural = scheme.plural_for_kind(ref.kind) or ref.kind.lower()
+            if not self.authorizer.authorize(
+                    user, "update", f"{plural}/finalizers",
+                    namespace=obj.metadata.namespace, name=ref.name):
+                raise AdmissionError(
+                    f"user {user.name} cannot set blockOwnerDeletion on "
+                    f"{ref.kind}/{ref.name}: no update permission on "
+                    f"{plural}/finalizers")
+
+
+class DenyEscalatingExec(AdmissionPlugin):
+    """Deny exec/attach into privileged or host-namespace pods
+    (plugin/pkg/admission/exec/admission.go DenyEscalatingExec) — an
+    exec into a privileged container is a node escalation."""
+
+    name = "DenyEscalatingExec"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind not in ("pods/exec", "pods/attach"):
+            return
+        if any(c.privileged for c in obj.spec.containers) \
+                or obj.spec.host_network:
+            raise AdmissionError(
+                f"cannot exec into or attach to a privileged or "
+                f"host-namespace pod {obj.metadata.name}")
+
+
+class DefaultStorageClass(AdmissionPlugin):
+    """Claims without a storage class get the cluster default
+    (plugin/pkg/admission/storageclass/setdefault/admission.go);
+    ambiguous defaults (two marked) reject, as in the reference."""
+
+    name = "DefaultStorageClass"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "persistentvolumeclaims":
+            return
+        if obj.spec.storage_class_name:
+            return
+        defaults = [sc for sc in store.list("storageclasses")
+                    if sc.is_default]
+        if not defaults:
+            return
+        if len(defaults) > 1:
+            raise AdmissionError(
+                f"{len(defaults)} default StorageClasses were found")
+        sc = defaults[0]
+        obj.spec.storage_class_name = sc.metadata.name
+        if sc.provisioner:
+            obj.metadata.annotations = dict(obj.metadata.annotations or {})
+            obj.metadata.annotations.setdefault(
+                "volume.beta.kubernetes.io/storage-provisioner",
+                sc.provisioner)
+
+
+class NamespaceAutoProvision(AdmissionPlugin):
+    """Create the namespace on first use instead of rejecting
+    (plugin/pkg/admission/namespace/autoprovision) — the
+    NamespaceLifecycle alternative for soft-multitenancy clusters."""
+
+    name = "NamespaceAutoProvision"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind == "namespaces":
+            return
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns:
+            return
+        if store.get("namespaces", "", ns) is None and \
+                store.get("namespaces", "default", ns) is None:
+            from ..runtime.store import Conflict
+
+            try:
+                store.create("namespaces", api.Namespace(
+                    metadata=api.ObjectMeta(name=ns),
+                    status=api.NamespaceStatus(phase="Active")))
+            except Conflict:
+                pass
+
+
 class AdmissionChain:
     """Ordered plugin chain (admission/chain.go chainAdmissionHandler)."""
 
@@ -502,12 +734,18 @@ class AdmissionChain:
     @staticmethod
     def default() -> "AdmissionChain":
         """The reference's recommended order (kubeapiserver/options/
-        plugins.go): mutators before validators, quota last."""
-        return AdmissionChain([NamespaceLifecycle(), LimitRanger(),
+        plugins.go): mutators before validators, quota last.
+        Config-requiring plugins (ImagePolicyWebhook needs a backend,
+        OwnerReferencesPermissionEnforcement an authorizer,
+        NamespaceAutoProvision replaces NamespaceLifecycle) are
+        constructed explicitly by operators, as in the reference's
+        --enable-admission-plugins."""
+        return AdmissionChain([NamespaceLifecycle(), PodPresetAdmission(),
+                               LimitRanger(), DefaultStorageClass(),
                                ServiceAccountAdmission(), PodNodeSelector(),
                                PriorityAdmission(),
                                DefaultTolerationSeconds(),
-                               NodeRestriction(),
+                               NodeRestriction(), DenyEscalatingExec(),
                                ResourceQuotaAdmission()])
 
     def admit(self, op: str, kind: str, obj, old, user: Optional[UserInfo],

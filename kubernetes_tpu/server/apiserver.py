@@ -584,8 +584,15 @@ class APIServer:
 
     def _serve_pod_exec(self, h, namespace, name):
         """POST pods/<name>/exec — proxied to the kubelet's /exec
-        (server.go:325 getExec; one-shot JSON here, not SPDY)."""
+        (server.go:325 getExec; one-shot JSON here, not SPDY). Admission
+        runs on the subresource attribute (DenyEscalatingExec gates
+        privileged pods, plugin/pkg/admission/exec)."""
         pod, host, port, default_c = self._kubelet_target(namespace, name)
+        try:
+            self.admission.admit("create", "pods/exec", pod, None, None,
+                                 self.store)
+        except AdmissionError as e:
+            raise APIError(getattr(e, "code", 403), "Forbidden", str(e))
         data = self._read_body(h)
         container = data.get("container") or default_c
         path = (f"/exec/{quote(pod.metadata.namespace, safe='')}/"
@@ -601,6 +608,11 @@ class APIServer:
         long-poll (server.go:640 getAttach; SPDY collapsed to follow-mode
         polling, see kubelet/server.py)."""
         pod, host, port, default_c = self._kubelet_target(namespace, name)
+        try:
+            self.admission.admit("create", "pods/attach", pod, None, None,
+                                 self.store)
+        except AdmissionError as e:
+            raise APIError(getattr(e, "code", 403), "Forbidden", str(e))
         container = query.get("container", [default_c])[0]
         q = []
         wait = 2.0

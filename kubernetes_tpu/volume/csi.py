@@ -346,7 +346,7 @@ class CSIProvisioner:
         # destroy flip-flop and could delete the backing volume out from
         # under a concurrent bind
         claimed |= {f"pvc-{pvc.metadata.uid}" for pvc in claims}
-        for pvc in self.store.list("persistentvolumeclaims"):
+        for pvc in claims:
             ann = (pvc.metadata.annotations or {}).get(
                 PROVISIONER_ANNOTATION)
             if ann != self.driver_name or pvc.spec.volume_name:
