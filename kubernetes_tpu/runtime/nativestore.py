@@ -257,6 +257,13 @@ class NativeObjectStore:
         with self._lock:
             self._watchers.append((kind, fn))
 
+    def unwatch(self, fn: Callable[[Event], None]):
+        with self._lock:
+            # equality, not identity: bound methods are recreated per
+            # attribute access and only compare equal
+            self._watchers = [(k, f) for k, f in self._watchers
+                              if f != fn]
+
     def create(self, kind: str, obj) -> object:
         err = ctypes.c_int(0)
         if not obj.metadata.uid:

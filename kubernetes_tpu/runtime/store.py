@@ -76,6 +76,16 @@ class ObjectStore:
         with self._lock:
             self._watchers.append((kind, fn))
 
+    def unwatch(self, fn: WatchFn):
+        """Deregister a watcher by handler identity — a stopped
+        component (e.g. a replaced apiserver's broadcaster) must not
+        keep receiving every future event forever."""
+        with self._lock:
+            # equality, not identity: bound methods are recreated per
+            # attribute access and only compare equal
+            self._watchers = [(k, f) for k, f in self._watchers
+                              if f != fn]
+
     # -- CRUD (reference: registry/generic/registry/store.go) -----------------
 
     def create(self, kind: str, obj) -> object:

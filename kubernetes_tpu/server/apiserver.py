@@ -303,6 +303,13 @@ class APIServer:
             self.endpoint_reconciler = None
         self.httpd.shutdown()
         self.httpd.server_close()
+        # deregister this server's store watchers: a replaced apiserver
+        # (kubeadm upgrade) must not keep consuming every event through
+        # its dead broadcaster/CRD informer forever
+        unwatch = getattr(self.store, "unwatch", None)
+        if unwatch is not None:
+            unwatch(self.broadcaster._on_event)
+            unwatch(self._crd_informer._handle)
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,167 @@
+"""kubeadm phases architecture, preflight, and upgrade.
+
+Reference: cmd/kubeadm/app/phases/ (init decomposed into re-runnable
+phases), cmd/kubeadm/app/preflight/checks.go, cmd/kubeadm/app/cmd/
+upgrade/. Round-4 verdict item 10's 'done' bar: kubeadm upgrade on a
+running hollow cluster preserves all objects and the scheduler keeps
+placing."""
+
+import socket
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.cli import kubeadm
+
+from helpers import make_node
+
+
+class TestPhases:
+    def test_phase_list(self, capsys):
+        rc = kubeadm.main(["phase", "list"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for name in ("preflight", "certs", "bootstrap-objects",
+                     "upload-config"):
+            assert name in out
+
+    def test_single_phase_idempotent_on_durable_store(self, tmp_path):
+        d = str(tmp_path / "kv")
+        assert kubeadm.main(["phase", "certs", "--data-dir", d]) == 0
+        from kubernetes_tpu.runtime.nativestore import NativeObjectStore
+        from kubernetes_tpu.server import pki
+
+        st = NativeObjectStore(path=d)
+        ca1 = pki.ensure_cluster_ca(st).ca_cert_pem
+        st.close()
+        # re-running the phase must be a no-op, not a CA rotation
+        assert kubeadm.main(["phase", "certs", "--data-dir", d]) == 0
+        st = NativeObjectStore(path=d)
+        assert pki.ensure_cluster_ca(st).ca_cert_pem == ca1
+        st.close()
+
+    def test_unknown_phase_errors(self):
+        assert kubeadm.main(["phase", "frobnicate"]) == 1
+
+
+class TestPreflight:
+    def test_occupied_port_fails(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        try:
+            errors = kubeadm.phase_preflight(port=port)
+            assert any("port" in e for e in errors)
+        finally:
+            s.close()
+        assert kubeadm.phase_preflight(port=0) == []
+
+    def test_unwritable_data_dir_fails(self):
+        errors = kubeadm.phase_preflight(data_dir="/proc/nope/kv")
+        assert any("writable" in e for e in errors)
+
+    def test_init_gates_on_preflight(self, capsys):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        try:
+            rc = kubeadm.main(["init", "--port", str(port), "--once"])
+        finally:
+            s.close()
+        assert rc == 1
+        assert "preflight" in capsys.readouterr().err
+
+
+class TestUpgrade:
+    def test_live_upgrade_preserves_objects_and_scheduling(self):
+        """The 'done' bar: upgrade a RUNNING secure cluster (apiserver
+        restart at a new version over the same store+port); every object
+        survives, joined clients reconnect, and the scheduler keeps
+        placing new pods afterward."""
+        cluster = kubeadm.Cluster(secure=True, reconcile_endpoints=False)
+        kubeadm.ensure_bootstrap_objects(cluster.store)
+        kubeadm.phase_upload_config(cluster.store)
+        cluster.start()
+        try:
+            from kubernetes_tpu.client.reflector import RemoteStore
+            from kubernetes_tpu.client.rest import RESTClient
+            from kubernetes_tpu.kubemark.hollow import HollowNode
+
+            key, cert, ca_pem = kubeadm.join_with_csr(
+                cluster.url, "up-n1", cluster.bootstrap_token)
+            rstore = RemoteStore(RESTClient(
+                cluster.url, client_cert_pem=cert, client_key_pem=key,
+                ca_cert_pem=ca_pem))
+            for kind in ("pods", "nodes"):
+                rstore.mirror(kind)
+            rstore.wait_for_sync()
+            hollow = HollowNode(rstore, "up-n1",
+                                allocatable=api.resource_list(
+                                    cpu="8", memory="16Gi",
+                                    pods=20)).run(period=0.1)
+            admin = RESTClient(cluster.url, token=cluster.admin_token,
+                               ca_cert_pem=ca_pem)
+
+            def mkpod(name):
+                return api.Pod(
+                    metadata=api.ObjectMeta(name=name),
+                    spec=api.PodSpec(containers=[api.Container(
+                        resources=api.ResourceRequirements(
+                            requests=api.resource_list(
+                                cpu="100m", memory="64Mi")))]))
+
+            admin.create("pods", mkpod("pre-upgrade"))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if admin.get("pods", "default",
+                             "pre-upgrade").spec.node_name:
+                    break
+                time.sleep(0.1)
+            assert admin.get("pods", "default",
+                             "pre-upgrade").spec.node_name == "up-n1"
+
+            kubeadm.upgrade_cluster(cluster, "v1.12-tpu.0")
+
+            cm = cluster.store.get("configmaps", "kube-system",
+                                   kubeadm.CLUSTER_CONFIG_NAME)
+            assert cm.data["clusterVersion"] == "v1.12-tpu.0"
+            # objects preserved, served by the NEW apiserver
+            assert admin.get("pods", "default",
+                             "pre-upgrade").spec.node_name == "up-n1"
+            assert admin.get("nodes", "", "up-n1") is not None
+            # the scheduler (an API client) keeps placing
+            admin.create("pods", mkpod("post-upgrade"))
+            deadline = time.time() + 30
+            placed = ""
+            while time.time() < deadline and not placed:
+                placed = admin.get("pods", "default",
+                                   "post-upgrade").spec.node_name
+                time.sleep(0.1)
+            assert placed == "up-n1", "scheduler stopped placing after upgrade"
+            hollow.stop()
+            rstore.stop()
+        finally:
+            cluster.stop()
+
+    def test_offline_upgrade_round_trips_conversion(self, tmp_path,
+                                                    capsys):
+        d = str(tmp_path / "kv")
+        from kubernetes_tpu.runtime.nativestore import NativeObjectStore
+
+        st = NativeObjectStore(path=d)
+        st.create("nodes", make_node("n1", cpu="2"))
+        # a multi-version kind: Deployment serves apps/v1beta1 through
+        # the hub — the round-trip the upgrade verifies
+        st.create("deployments", api.Deployment(
+            metadata=api.ObjectMeta(name="web"),
+            spec=api.DeploymentSpec(replicas=3)))
+        st.close()
+        rc = kubeadm.main(["upgrade", "--data-dir", d,
+                           "--to-version", "v1.12-tpu.0"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "round-trips verified" in out
+        st = NativeObjectStore(path=d)
+        cm = st.get("configmaps", "kube-system",
+                    kubeadm.CLUSTER_CONFIG_NAME)
+        assert cm.data["clusterVersion"] == "v1.12-tpu.0"
+        assert st.get("deployments", "default", "web").spec.replicas == 3
+        st.close()
