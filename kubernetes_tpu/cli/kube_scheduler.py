@@ -44,6 +44,15 @@ class HealthServer:
                 elif self.path == "/metrics":
                     body = outer.metrics_text().encode()
                     ctype = "text/plain"
+                elif self.path.startswith("/debug/profile"):
+                    # pprof debug=1 analog (server.go:229 EnableProfiling)
+                    from ..utils import profiling
+
+                    prof = profiling.active()
+                    body = (prof.report() if prof is not None
+                            else "profiling disabled (run with "
+                                 "--profiling)\n").encode()
+                    ctype = "text/plain"
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
@@ -103,8 +112,14 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
         token: Optional[str] = None, stop: Optional[threading.Event] = None,
         once: bool = False, ca_cert_pem: Optional[str] = None,
         client_cert_pem: Optional[str] = None,
-        client_key_pem: Optional[str] = None) -> int:
+        client_key_pem: Optional[str] = None,
+        profiling_enabled: bool = False,
+        contention_profiling: bool = False) -> int:
     stop = stop or threading.Event()
+    if profiling_enabled or contention_profiling:
+        from ..utils import profiling
+
+        profiling.enable()
     client = RESTClient(server_url, token=token, ca_cert_pem=ca_cert_pem,
                         client_cert_pem=client_cert_pem,
                         client_key_pem=client_key_pem)
@@ -120,6 +135,10 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
 
     def scheduling_loop():
         sched = build_scheduler(cfg, store)
+        if contention_profiling:
+            from ..utils import profiling
+
+            profiling.instrument_lock(sched, "_mu", "scheduler._mu")
         sched_holder[0] = sched
         while not stop.is_set():
             placed = sched.run_once(timeout=0.2)
@@ -171,6 +190,12 @@ def main(argv=None) -> int:
                     help="-1 disables; 0 picks a free port")
     ap.add_argument("--feature-gates", default="",
                     help="comma-separated key=bool pairs")
+    ap.add_argument("--profiling", action="store_true",
+                    help="step profiling served at /debug/profile "
+                         "(EnableProfiling analog)")
+    ap.add_argument("--contention-profiling", action="store_true",
+                    help="also record lock wait times "
+                         "(EnableContentionProfiling analog)")
     ap.add_argument("--once", action="store_true",
                     help="exit when the queue drains (batch mode)")
     args = ap.parse_args(argv)
@@ -202,7 +227,9 @@ def main(argv=None) -> int:
         return run(cfg, args.server, token=args.token, stop=stop,
                    once=args.once, ca_cert_pem=pem_arg(args.ca_cert_data),
                    client_cert_pem=pem_arg(args.client_cert_data),
-                   client_key_pem=pem_arg(args.client_key_data))
+                   client_key_pem=pem_arg(args.client_key_data),
+                   profiling_enabled=args.profiling,
+                   contention_profiling=args.contention_profiling)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

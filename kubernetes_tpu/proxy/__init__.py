@@ -1,3 +1,4 @@
 """Service dataplane — pkg/proxy analog."""
 
 from .proxier import Endpoint, HealthCheckServer, ProxyRule, Proxier
+from .userspace import UserspaceProxier

@@ -30,19 +30,9 @@ def pump(src: socket.socket, dst: socket.socket) -> None:
             pass
 
 
-def relay_once(lsock: socket.socket, backend, accept_timeout=None) -> None:
-    """Accept ONE connection on `lsock`, connect to `backend`
-    (host, port), and pump both directions until either side closes.
-    Closes the listener after (or on) the accept — a fresh relay needs a
-    fresh listener, which is the port-forward contract here."""
-    if accept_timeout is not None:
-        lsock.settimeout(accept_timeout)
-    try:
-        conn, _ = lsock.accept()
-    except OSError:
-        lsock.close()
-        return
-    lsock.close()
+def relay(conn: socket.socket, backend) -> None:
+    """Connect to `backend` (host, port) and pump both directions of
+    `conn` until either side closes; closes both sockets."""
     try:
         up = socket.create_connection(backend, timeout=10)
     except OSError:
@@ -54,3 +44,18 @@ def relay_once(lsock: socket.socket, backend, accept_timeout=None) -> None:
     t.join(timeout=10)
     conn.close()
     up.close()
+
+
+def relay_once(lsock: socket.socket, backend, accept_timeout=None) -> None:
+    """Accept ONE connection on `lsock` and relay it to `backend`.
+    Closes the listener after (or on) the accept — a fresh relay needs a
+    fresh listener, which is the port-forward contract here."""
+    if accept_timeout is not None:
+        lsock.settimeout(accept_timeout)
+    try:
+        conn, _ = lsock.accept()
+    except OSError:
+        lsock.close()
+        return
+    lsock.close()
+    relay(conn, backend)

@@ -20,7 +20,17 @@ class Trace:
         self.steps: List[Tuple[float, str]] = []
 
     def step(self, msg: str):
-        self.steps.append((self.clock(), msg))
+        now = self.clock()
+        self.steps.append((now, msg))
+        # feed the step profiler when enabled (utils/profiling.py): the
+        # traces the scheduler already emits become the pprof-style
+        # where-did-the-time-go breakdown with no extra instrumentation
+        from . import profiling
+
+        prof = profiling.active()
+        if prof is not None:
+            last = self.steps[-2][0] if len(self.steps) > 1 else self.start
+            prof.record_step(self.name, msg, now - last)
 
     def total(self) -> float:
         return self.clock() - self.start
