@@ -1,0 +1,50 @@
+"""hyperkube: every binary in one entry point.
+
+Reference: cmd/hyperkube — one fat binary that dispatches to
+kube-apiserver/kube-scheduler/kube-proxy/kubectl/kubelet by its first
+argument (or by the name it was invoked as). Here:
+
+    python -m kubernetes_tpu.cli.hyperkube <component> [args...]
+
+with components kubectl, kube-scheduler, kube-proxy, kubeadm,
+csi-mock-driver (the standalone mock CSI driver process).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def _load(name):
+        if name in ("kubectl",):
+            from . import kubectl as m
+        elif name in ("kube-scheduler", "scheduler"):
+            from . import kube_scheduler as m
+        elif name in ("kube-proxy", "proxy"):
+            from . import kube_proxy as m
+        elif name == "kubeadm":
+            from . import kubeadm as m
+        elif name == "csi-mock-driver":
+            from ..volume import csi as m
+        else:
+            return None
+        return m
+
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print("usage: hyperkube <component> [args...]\n"
+              "components: kubectl kube-scheduler kube-proxy kubeadm "
+              "csi-mock-driver", file=sys.stderr)
+        return 0 if argv and argv[0] in ("-h", "--help", "help") else 1
+    mod = _load(argv[0])
+    if mod is None:
+        print(f"error: unknown component {argv[0]!r}", file=sys.stderr)
+        return 1
+    return mod.main(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

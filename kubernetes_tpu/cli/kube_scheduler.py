@@ -116,7 +116,8 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
         profiling_enabled: bool = False,
         contention_profiling: bool = False) -> int:
     stop = stop or threading.Event()
-    if profiling_enabled or contention_profiling:
+    prof_on = profiling_enabled or contention_profiling
+    if prof_on:
         from ..utils import profiling
 
         profiling.enable()
@@ -165,6 +166,10 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
     if health is not None:
         health.stop()
     store.stop()
+    if prof_on:
+        from ..utils import profiling
+
+        profiling.disable()  # process-global: don't leak into later runs
     return 0
 
 

@@ -358,6 +358,69 @@ def cmd_cp(client, args, out):
     return 0
 
 
+def cmd_diff(client, args, out):
+    """kubectl diff -f manifest — unified diff of live objects vs the
+    manifest's desired state (pkg/kubectl/cmd/diff.go; server-side
+    dry-run collapsed to a local object diff). Exit 1 when differences
+    exist, like the reference."""
+    import difflib
+
+    import yaml
+
+    changed = False
+    for doc in load_manifests(args.filename):
+        obj, kind = _decode_doc(doc)
+        plural = scheme.plural_for_kind(kind)
+        # namespace resolution matches create/apply: a manifest-declared
+        # metadata.namespace wins, else -n (comparing against a different
+        # namespace than create writes would fabricate drift)
+        if scheme.is_namespaced(kind):
+            if not doc.get("metadata", {}).get("namespace"):
+                obj.metadata.namespace = args.namespace
+            ns = obj.metadata.namespace
+        else:
+            ns = ""
+        try:
+            live = client.get(plural, ns, obj.metadata.name)
+        except APIStatusError as e:
+            if e.code != 404:
+                raise
+            out.write(f"--- (none)\n+++ {plural}/{obj.metadata.name} "
+                      f"(created)\n")
+            changed = True
+            continue
+        live_doc = scheme.encode_object(live)
+        want_doc = scheme.encode_object(obj)
+
+        # server-owned identity fields never diff — including in NESTED
+        # metadata (pod templates get fresh uids on every decode)
+        def scrub(node):
+            if isinstance(node, dict):
+                for k in ("resourceVersion", "uid"):
+                    node.pop(k, None)
+                for v in node.values():
+                    scrub(v)
+            elif isinstance(node, list):
+                for v in node:
+                    scrub(v)
+
+        # controller-owned status never diffs against a manifest's
+        # desired state (the reference diffs only the spec'd object)
+        live_doc.pop("status", None)
+        want_doc.pop("status", None)
+        scrub(live_doc)
+        scrub(want_doc)
+        a = yaml.safe_dump(live_doc, sort_keys=True).splitlines(True)
+        b = yaml.safe_dump(want_doc, sort_keys=True).splitlines(True)
+        delta = list(difflib.unified_diff(
+            a, b, fromfile=f"live/{plural}/{obj.metadata.name}",
+            tofile=f"manifest/{plural}/{obj.metadata.name}"))
+        if delta:
+            out.writelines(delta)
+            changed = True
+    return 1 if changed else 0
+
+
 def cmd_describe(client, args, out):
     plural = _resolve_kind(args.kind)
     obj = client.get(plural, args.namespace, args.name)
@@ -801,6 +864,9 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("dst", help="local path or pod:path")
     cp.add_argument("--container", "-c", default="")
 
+    df = sub.add_parser("diff")
+    df.add_argument("--filename", "-f", required=True)
+
     xp = sub.add_parser("explain")
     xp.add_argument("kind")
 
@@ -818,7 +884,8 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "expose": cmd_expose, "explain": cmd_explain, "top": cmd_top,
          "logs": cmd_logs, "exec": cmd_exec, "attach": cmd_attach,
          "port-forward": cmd_port_forward, "patch": cmd_patch,
-         "annotate": cmd_annotate, "edit": cmd_edit, "cp": cmd_cp}
+         "annotate": cmd_annotate, "edit": cmd_edit, "cp": cmd_cp,
+         "diff": cmd_diff}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

@@ -146,3 +146,91 @@ spec:
         assert rc == 0 and "v1.11.0-tpu" in out
         with pytest.raises(SystemExit):
             run(server, "get", "wibbles")
+
+
+class TestDiffAndHyperkube:
+
+    def test_diff_reports_and_exits_nonzero_on_change(self, tmp_path):
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.runtime.store import ObjectStore
+        import io
+
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            manifest = tmp_path / "dep.yaml"
+            manifest.write_text(
+                "apiVersion: apps/v1\nkind: Deployment\n"
+                "metadata:\n  name: web\n"
+                "spec:\n  replicas: 3\n"
+                "  selector:\n    matchLabels:\n      app: web\n"
+                "  template:\n    metadata:\n      name: web\n"
+                "      labels:\n        app: web\n")
+            out = io.StringIO()
+            # object absent: diff reports creation, exit 1
+            rc = main(["--server", srv.url, "diff", "-f",
+                       str(manifest)], out=out)
+            assert rc == 1 and "(created)" in out.getvalue()
+            rc = main(["--server", srv.url, "create", "-f",
+                       str(manifest)], out=io.StringIO())
+            assert rc == 0
+            # live == manifest: no diff, exit 0
+            out = io.StringIO()
+            rc = main(["--server", srv.url, "diff", "-f",
+                       str(manifest)], out=out)
+            assert rc == 0 and out.getvalue() == ""
+            # drift: replicas changed live
+            manifest.write_text(
+                "apiVersion: apps/v1\nkind: Deployment\n"
+                "metadata:\n  name: web\n"
+                "spec:\n  replicas: 5\n"
+                "  selector:\n    matchLabels:\n      app: web\n"
+                "  template:\n    metadata:\n      name: web\n"
+                "      labels:\n        app: web\n")
+            out = io.StringIO()
+            rc = main(["--server", srv.url, "diff", "-f",
+                       str(manifest)], out=out)
+            assert rc == 1
+            assert "-  replicas: 3" in out.getvalue()
+            assert "+  replicas: 5" in out.getvalue()
+        finally:
+            srv.stop()
+
+    def test_hyperkube_dispatches(self, capsys):
+        from kubernetes_tpu.cli import hyperkube
+
+        assert hyperkube.main(["help"]) == 0
+        assert hyperkube.main(["no-such-component"]) == 1
+        # a real dispatch: kubeadm phase list through hyperkube
+        assert hyperkube.main(["kubeadm", "phase", "list"]) == 0
+        assert "certs" in capsys.readouterr().out
+
+    def test_diff_ignores_status_and_respects_namespace(self, tmp_path):
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.runtime.store import ObjectStore
+
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            manifest = tmp_path / "dep.yaml"
+            manifest.write_text(
+                "apiVersion: apps/v1\nkind: Deployment\n"
+                "metadata:\n  name: api\n  namespace: prod\n"
+                "spec:\n  replicas: 2\n"
+                "  selector:\n    matchLabels:\n      app: api\n"
+                "  template:\n    metadata:\n      name: api\n"
+                "      labels:\n        app: api\n")
+            rc = main(["--server", srv.url, "create", "-f",
+                       str(manifest)], out=io.StringIO())
+            assert rc == 0
+            # controller writes status: still in sync
+            live = store.get("deployments", "prod", "api")
+            live.status.replicas = 2
+            live.status.ready_replicas = 2
+            store.update("deployments", live)
+            out = io.StringIO()
+            rc = main(["--server", srv.url, "diff", "-f",
+                       str(manifest)], out=out)
+            assert rc == 0, out.getvalue()
+        finally:
+            srv.stop()
