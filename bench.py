@@ -500,6 +500,30 @@ def run_subprocess_suite(suite, wave, cpu):
                 print(line, file=sys.stderr)
 
 
+def tpu_backend_alive(timeout: float = 180.0) -> bool:
+    """Probe device discovery in a THROWAWAY subprocess with a hard
+    timeout. The axon TPU tunnel can wedge machine-wide (observed: every
+    new process hangs in jax.devices() indefinitely, for hours); a bench
+    that hangs records nothing, so on a dead tunnel we fall back to CPU
+    and say so, which beats an empty artifact."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True)
+    except subprocess.TimeoutExpired:
+        print(f"# TPU probe: device discovery HUNG >{timeout:.0f}s "
+              f"(wedged tunnel)", file=sys.stderr)
+        return False
+    if r.returncode != 0:
+        tail = (r.stderr or b"").decode(errors="replace").strip()
+        print(f"# TPU probe: device discovery FAILED rc={r.returncode}: "
+              f"{tail[-300:]}", file=sys.stderr)
+        return False
+    return True
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=None)
@@ -537,6 +561,16 @@ def main():
         args.pods = 3000
     if args.workload is None:
         args.workload = "density"
+
+    if (args.suite or not explicit) and not args.cpu:
+        # top-level (suite-spawning) invocations probe the device
+        # backend before fanning out; each child would otherwise hang
+        # forever on a wedged tunnel
+        if not tpu_backend_alive():
+            print("# WARNING: TPU backend unreachable (probe details "
+                  "above) — falling back to CPU; values below are "
+                  "backend=cpu, NOT TPU numbers", file=sys.stderr)
+            args.cpu = True
 
     if args.cpu:
         import os

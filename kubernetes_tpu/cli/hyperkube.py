@@ -34,11 +34,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             return None
         return m
 
-    if not argv or argv[0] in ("-h", "--help", "help"):
-        print("usage: hyperkube <component> [args...]\n"
-              "components: kubectl kube-scheduler kube-proxy kubeadm "
-              "csi-mock-driver", file=sys.stderr)
-        return 0 if argv and argv[0] in ("-h", "--help", "help") else 1
+    usage = ("usage: hyperkube <component> [args...]\n"
+             "components: kubectl kube-scheduler kube-proxy kubeadm "
+             "csi-mock-driver")
+    if argv and argv[0] in ("-h", "--help", "help"):
+        print(usage)  # requested help: stdout, success
+        return 0
+    if not argv:
+        print(usage, file=sys.stderr)  # usage error
+        return 1
     mod = _load(argv[0])
     if mod is None:
         print(f"error: unknown component {argv[0]!r}", file=sys.stderr)

@@ -121,6 +121,19 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
         from ..utils import profiling
 
         profiling.enable()
+    try:
+        return _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
+                          client_cert_pem, client_key_pem,
+                          contention_profiling)
+    finally:
+        if prof_on:
+            from ..utils import profiling
+
+            profiling.disable()  # process-global: never leak, even on error
+
+
+def _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
+               client_cert_pem, client_key_pem, contention_profiling):
     client = RESTClient(server_url, token=token, ca_cert_pem=ca_cert_pem,
                         client_cert_pem=client_cert_pem,
                         client_key_pem=client_key_pem)
@@ -166,10 +179,6 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
     if health is not None:
         health.stop()
     store.stop()
-    if prof_on:
-        from ..utils import profiling
-
-        profiling.disable()  # process-global: don't leak into later runs
     return 0
 
 

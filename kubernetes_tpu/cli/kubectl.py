@@ -371,11 +371,11 @@ def cmd_diff(client, args, out):
     for doc in load_manifests(args.filename):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
-        # namespace resolution matches create/apply: a manifest-declared
-        # metadata.namespace wins, else -n (comparing against a different
-        # namespace than create writes would fabricate drift)
+        # namespace resolution MATCHES create/apply exactly (a non-
+        # default -n overrides the manifest; comparing against a
+        # namespace create never writes to would fabricate drift)
         if scheme.is_namespaced(kind):
-            if not doc.get("metadata", {}).get("namespace"):
+            if args.namespace != "default":
                 obj.metadata.namespace = args.namespace
             ns = obj.metadata.namespace
         else:
@@ -395,9 +395,14 @@ def cmd_diff(client, args, out):
         # server-owned identity fields never diff — including in NESTED
         # metadata (pod templates get fresh uids on every decode)
         def scrub(node):
+            # only METADATA dicts lose their server-owned identity
+            # fields — a user label/annotation/data key happening to be
+            # named "uid" is real content and must keep diffing
             if isinstance(node, dict):
-                for k in ("resourceVersion", "uid"):
-                    node.pop(k, None)
+                meta = node.get("metadata")
+                if isinstance(meta, dict):
+                    for k in ("resourceVersion", "uid"):
+                        meta.pop(k, None)
                 for v in node.values():
                     scrub(v)
             elif isinstance(node, list):
