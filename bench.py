@@ -485,6 +485,7 @@ def run_subprocess_suite(suite, wave, cpu):
         if "--wave" not in extra:
             cmd += ["--wave", str(wave)]
         cmd += extra
+        cmd.append("--skip-backend-probe")  # the parent already probed
         if cpu:
             cmd.append("--cpu")
         r = subprocess.run(cmd, capture_output=True, text=True)
@@ -548,6 +549,8 @@ def main():
     ap.add_argument("--name", default="",
                     help="metric name override (suite subprocesses)")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--skip-backend-probe", action="store_true",
+                    help=argparse.SUPPRESS)  # suite children: parent probed
     args = ap.parse_args()
     # a bare invocation (no config selection) runs the driver pair
     # (density + north star); judged on PARSED values so abbreviated
@@ -562,10 +565,11 @@ def main():
     if args.workload is None:
         args.workload = "density"
 
-    if (args.suite or not explicit) and not args.cpu:
-        # top-level (suite-spawning) invocations probe the device
-        # backend before fanning out; each child would otherwise hang
-        # forever on a wedged tunnel
+    if not args.cpu and not args.skip_backend_probe:
+        # EVERY non-cpu invocation probes the device backend first —
+        # explicit single-config runs would otherwise hang forever on a
+        # wedged tunnel exactly like the suite would. Suite children
+        # skip it (the parent probed).
         if not tpu_backend_alive():
             print("# WARNING: TPU backend unreachable (probe details "
                   "above) — falling back to CPU; values below are "

@@ -1162,6 +1162,10 @@ class Role:
 class ClusterRole:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     rules: List[RBACPolicyRule] = field(default_factory=list)
+    # aggregationRule.clusterRoleSelectors: this role's rules are the
+    # UNION of rules from ClusterRoles matching any selector, maintained
+    # by the clusterroleaggregation controller
+    aggregation_selectors: List[LabelSelector] = field(default_factory=list)
 
     def __post_init__(self):
         self.metadata.namespace = ""  # cluster-scoped

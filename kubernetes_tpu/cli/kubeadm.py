@@ -54,17 +54,33 @@ class Cluster:
             from ..server.auth import (AuthenticatorChain, RBACAuthorizer,
                                        UserInfo, cluster_admin_bindings)
 
+            from ..controllers.bootstrap import (make_token_secret,
+                                                 new_bootstrap_token)
+            from ..runtime.store import Conflict
+
             self.ca = ca = pki.ensure_cluster_ca(self.store)
             self.admin_token = f"admin-{_secrets.token_hex(8)}"
-            self.bootstrap_token = f"bootstrap-{_secrets.token_hex(8)}"
+            # bootstrap token lives as a kube-system Secret (id.secret
+            # wire form): expiry/deletion revokes it live, and the
+            # BootstrapSigner keys cluster-info signatures off it
+            tid, tsec, self.bootstrap_token = new_bootstrap_token()
+            tok_secret = make_token_secret(tid, tsec, ttl_seconds=86400.0)
+            try:
+                self.store.create("secrets", tok_secret)
+            except Conflict:
+                # re-init over a durable store that already holds a
+                # token with this id: REPLACE it — keeping the old
+                # Secret would make the token this init prints dead
+                old = self.store.get("secrets",
+                                     tok_secret.metadata.namespace,
+                                     tok_secret.metadata.name)
+                tok_secret.metadata.resource_version =                     old.metadata.resource_version
+                self.store.update("secrets", tok_secret)
             authenticator = AuthenticatorChain(
                 tokens={
                     self.admin_token: UserInfo(
                         "kubernetes-admin", ("system:masters",
                                              "system:authenticated")),
-                    self.bootstrap_token: UserInfo(
-                        "system:bootstrap:kubeadm",
-                        ("system:bootstrappers", "system:authenticated")),
                 },
                 store=self.store, ca=ca)
             authorizer = RBACAuthorizer(
@@ -130,23 +146,34 @@ class Cluster:
         except Conflict:
             pass
 
+    def _signed_cluster_info(self) -> api.ConfigMap:
+        """cluster-info pre-signed for every live bootstrap token, so a
+        join racing the controller's first pass still verifies; the
+        BootstrapSigner controller maintains the signatures thereafter
+        (token rotation/expiry)."""
+        from ..controllers.bootstrap import compute_signatures
+
+        data = {"ca.crt": self.ca.ca_cert_pem}
+        data.update(compute_signatures(self.store, self.ca.ca_cert_pem))
+        return api.ConfigMap(
+            metadata=api.ObjectMeta(name="cluster-info",
+                                    namespace="kube-public"),
+            data=data)
+
     def _publish_cluster_info(self):
         """The cluster-info ConfigMap in kube-public, readable
         anonymously — how a joiner learns the CA bundle before it can
-        authenticate (reference: clusterinfo phase publishes a
-        kubeconfig with the CA; BootstrapSigner makes it verifiable.
-        Here the joiner fetches it trust-on-first-use over TLS — a
-        documented simplification of the JWS-hash check)."""
+        authenticate (reference: clusterinfo phase). The BootstrapSigner
+        machinery signs it per bootstrap token, so a token-holding
+        joiner VERIFIES the CA instead of trusting first use; tokenless
+        discovery remains TOFU."""
         from ..runtime.store import Conflict
 
         for obj_kind, obj in (
             ("namespaces", api.Namespace(
                 metadata=api.ObjectMeta(name="kube-public"),
                 status=api.NamespaceStatus(phase="Active"))),
-            ("configmaps", api.ConfigMap(
-                metadata=api.ObjectMeta(name="cluster-info",
-                                        namespace="kube-public"),
-                data={"ca.crt": self.ca.ca_cert_pem})),
+            ("configmaps", self._signed_cluster_info()),
             ("roles", api.Role(
                 metadata=api.ObjectMeta(name="kubeadm:bootstrap-signer",
                                         namespace="kube-public"),
@@ -439,16 +466,29 @@ def cmd_init(args) -> int:
     return 0
 
 
-def fetch_cluster_ca(server: str) -> str:
-    """Trust-on-first-use CA discovery: read the anonymous cluster-info
-    ConfigMap (kube-public) over an UNVERIFIED TLS connection and return
-    its CA bundle; every later connection verifies against it.
-    Reference: the discovery phase's cluster-info fetch; the JWS
-    token-signature check is simplified to TOFU (documented)."""
+def fetch_cluster_ca(server: str, token: Optional[str] = None) -> str:
+    """CA discovery from the anonymous cluster-info ConfigMap
+    (kube-public), fetched over an unverified TLS connection. With a
+    bootstrap token the BootstrapSigner's signature for that token is
+    VERIFIED (HMAC keyed by the token secret) — the reference discovery
+    phase's JWS check, so a man-in-the-middle cannot substitute a CA
+    without holding the token. Without a token this is trust-on-first-
+    use (the reference's --discovery-token-unsafe-skip-ca-verification
+    posture)."""
     from ..client.rest import RESTClient
 
     tofu = RESTClient(server, insecure_skip_verify=True)
     info = tofu.get("configmaps", "kube-public", "cluster-info")
+    if token is not None:
+        from ..controllers.bootstrap import verify_cluster_info
+
+        ca = verify_cluster_info(info, token)
+        if ca is None:
+            raise RuntimeError(
+                "cluster-info signature verification FAILED for this "
+                "bootstrap token — possible man-in-the-middle, or the "
+                "token expired")
+        return ca
     return info.data["ca.crt"]
 
 
@@ -466,7 +506,8 @@ def join_with_csr(server: str, node_name: str, bootstrap_token: str,
     from ..server import pki
 
     if ca_cert_pem is None and server.startswith("https"):
-        ca_cert_pem = fetch_cluster_ca(server)
+        # token in hand: discovery is VERIFIED, not TOFU
+        ca_cert_pem = fetch_cluster_ca(server, token=bootstrap_token)
     boot = RESTClient(server, token=bootstrap_token,
                       ca_cert_pem=ca_cert_pem)
     key_pem, csr_pem = pki.make_csr(f"system:node:{node_name}",

@@ -33,6 +33,8 @@ from .podautoscaler import HorizontalPodAutoscalerController
 from .statefulset import StatefulSetController
 from .ttl import TTLController
 from .volumebinding import PersistentVolumeController
+from .bootstrap import BootstrapSignerController, TokenCleanerController
+from .clusterroleaggregation import ClusterRoleAggregationController
 
 DEFAULT_CONTROLLERS = [
     ReplicaSetController, ReplicationControllerController,
@@ -43,6 +45,8 @@ DEFAULT_CONTROLLERS = [
     ServiceAccountController, PersistentVolumeController,
     AttachDetachController, HorizontalPodAutoscalerController,
     TTLController, CSRApprovingController, CSRSigningController,
+    BootstrapSignerController, TokenCleanerController,
+    ClusterRoleAggregationController,
 ]
 
 

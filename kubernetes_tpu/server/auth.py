@@ -95,6 +95,18 @@ class AuthenticatorChain:
                     name, groups, _ns = got
                     return UserInfo(name, ("system:authenticated",
                                            *groups))
+            if self.store is not None and tok.count(".") == 1:
+                # bootstrap tokens (id.secret) resolve through their
+                # kube-system Secret — expiry/deletion revokes live
+                # (authenticator/token/bootstrap/bootstrap.go)
+                from ..controllers.bootstrap import lookup_token
+
+                sec = lookup_token(self.store, tok)
+                if sec is not None:
+                    tid = tok.partition(".")[0]
+                    return UserInfo(f"system:bootstrap:{tid}",
+                                    ("system:bootstrappers",
+                                     "system:authenticated"))
             return None  # presented token matched nothing: 401
         if peer is not None:
             cn, orgs = peer

@@ -1,0 +1,147 @@
+"""Bootstrap-token machinery + ClusterRole aggregation.
+
+Reference: pkg/controller/bootstrap/ (BootstrapSigner, TokenCleaner),
+plugin/pkg/auth/authenticator/token/bootstrap/, and
+pkg/controller/clusterroleaggregation/. The headline property: a joiner
+holding a bootstrap token VERIFIES the CA bundle it discovers (signed
+cluster-info) instead of trusting first use."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.controllers import bootstrap as bt
+from kubernetes_tpu.controllers.clusterroleaggregation import \
+    ClusterRoleAggregationController
+from kubernetes_tpu.runtime.store import ObjectStore
+
+
+class TestBootstrapTokens:
+    def test_lookup_validates_and_expires(self):
+        store = ObjectStore()
+        tid, tsec, wire = bt.new_bootstrap_token()
+        store.create("secrets", bt.make_token_secret(tid, tsec,
+                                                     ttl_seconds=3600))
+        assert bt.lookup_token(store, wire) is not None
+        assert bt.lookup_token(store, f"{tid}.WRONG") is None
+        assert bt.lookup_token(store, "garbage") is None
+        # expired token is dead even before the cleaner removes it
+        tid2, tsec2, wire2 = bt.new_bootstrap_token()
+        sec2 = bt.make_token_secret(tid2, tsec2)
+        sec2.data["expiration"] = str(time.time() - 1)
+        store.create("secrets", sec2)
+        assert bt.lookup_token(store, wire2) is None
+
+    def test_authenticator_resolves_bootstrap_secret(self):
+        from kubernetes_tpu.server import pki
+        from kubernetes_tpu.server.auth import AuthenticatorChain
+
+        store = ObjectStore()
+        ca = pki.ensure_cluster_ca(store)
+        tid, tsec, wire = bt.new_bootstrap_token()
+        store.create("secrets", bt.make_token_secret(tid, tsec))
+        chain = AuthenticatorChain(store=store, ca=ca)
+        user = chain.authenticate(f"Bearer {wire}")
+        assert user is not None
+        assert user.name == f"system:bootstrap:{tid}"
+        assert "system:bootstrappers" in user.groups
+        # deleting the Secret revokes the token live
+        store.delete("secrets", bt.TOKEN_NAMESPACE,
+                     bt.TOKEN_SECRET_PREFIX + tid)
+        assert chain.authenticate(f"Bearer {wire}") is None
+
+    def test_token_cleaner_removes_expired(self):
+        store = ObjectStore()
+        now = [1000.0]
+        cleaner = bt.TokenCleanerController(store, clock=lambda: now[0])
+        tid, tsec, _ = bt.new_bootstrap_token()
+        sec = bt.make_token_secret(tid, tsec)
+        sec.data["expiration"] = str(1500.0)
+        store.create("secrets", sec)
+        cleaner.resync()
+        cleaner.sync_all()
+        assert store.get("secrets", bt.TOKEN_NAMESPACE,
+                         bt.TOKEN_SECRET_PREFIX + tid) is not None
+        now[0] = 2000.0
+        cleaner.resync()
+        cleaner.sync_all()
+        assert store.get("secrets", bt.TOKEN_NAMESPACE,
+                         bt.TOKEN_SECRET_PREFIX + tid) is None
+
+
+class TestBootstrapSigner:
+    def test_signatures_track_tokens(self):
+        store = ObjectStore()
+        store.create("configmaps", api.ConfigMap(
+            metadata=api.ObjectMeta(name="cluster-info",
+                                    namespace="kube-public"),
+            data={"ca.crt": "PEM-BYTES"}))
+        tid, tsec, wire = bt.new_bootstrap_token()
+        store.create("secrets", bt.make_token_secret(tid, tsec))
+        signer = bt.BootstrapSignerController(store)
+        signer.resync()
+        signer.sync_all()
+        info = store.get("configmaps", "kube-public", "cluster-info")
+        assert bt.verify_cluster_info(info, wire) == "PEM-BYTES"
+        # a different token cannot verify
+        _, _, other = bt.new_bootstrap_token()
+        assert bt.verify_cluster_info(info, other) is None
+        # token deleted -> signature dropped on the next pass
+        store.delete("secrets", bt.TOKEN_NAMESPACE,
+                     bt.TOKEN_SECRET_PREFIX + tid)
+        signer.resync()
+        signer.sync_all()
+        info = store.get("configmaps", "kube-public", "cluster-info")
+        assert bt.verify_cluster_info(info, wire) is None
+
+    def test_join_verifies_discovery_and_rejects_forgery(self):
+        """End to end: kubeadm join discovers + VERIFIES the CA through
+        its bootstrap token; a tampered cluster-info is rejected."""
+        from kubernetes_tpu.cli import kubeadm
+
+        cluster = kubeadm.Cluster(secure=True, reconcile_endpoints=False)
+        kubeadm.ensure_bootstrap_objects(cluster.store)
+        cluster.start()
+        try:
+            ca = kubeadm.fetch_cluster_ca(cluster.url,
+                                          token=cluster.bootstrap_token)
+            assert ca == cluster.ca.ca_cert_pem
+            # an attacker WITHOUT the token secret cannot produce a
+            # verifying cluster-info: a forged/unknown token fails
+            # loudly instead of falling back to trust-on-first-use
+            # (the wire-level MITM case is the pure-function test
+            # above — a store write already implies RBAC was bypassed,
+            # and the signer correctly re-signs legitimate CA rotations)
+            with pytest.raises(RuntimeError, match="verification FAILED"):
+                kubeadm.fetch_cluster_ca(cluster.url,
+                                         token="aaaaaa.0123456789abcdef")
+        finally:
+            cluster.stop()
+
+
+class TestClusterRoleAggregation:
+    def test_union_maintained(self):
+        store = ObjectStore()
+        ctrl = ClusterRoleAggregationController(store)
+        store.create("clusterroles", api.ClusterRole(
+            metadata=api.ObjectMeta(name="admin"),
+            aggregation_selectors=[LabelSelector(
+                match_labels={"rbac.example.com/aggregate-to-admin":
+                              "true"})]))
+        store.create("clusterroles", api.ClusterRole(
+            metadata=api.ObjectMeta(
+                name="crd-frag",
+                labels={"rbac.example.com/aggregate-to-admin": "true"}),
+            rules=[api.RBACPolicyRule(verbs=["get"], api_groups=[""],
+                                      resources=["widgets"])]))
+        ctrl.sync_all()
+        admin = store.get("clusterroles", "", "admin")
+        assert any("widgets" in (r.resources or [])
+                   for r in admin.rules), admin.rules
+        # fragment removed -> rules shrink back
+        store.delete("clusterroles", "", "crd-frag")
+        ctrl.sync_all()
+        admin = store.get("clusterroles", "", "admin")
+        assert admin.rules == []
