@@ -70,12 +70,13 @@ class Cluster:
             except Conflict:
                 # re-init over a durable store that already holds a
                 # token with this id: REPLACE it — keeping the old
-                # Secret would make the token this init prints dead
-                old = self.store.get("secrets",
-                                     tok_secret.metadata.namespace,
-                                     tok_secret.metadata.name)
-                tok_secret.metadata.resource_version =                     old.metadata.resource_version
-                self.store.update("secrets", tok_secret)
+                # Secret would make the token this init prints dead.
+                # (update is last-writer-wins here; if the old Secret
+                # vanished in between, fall back to create)
+                try:
+                    self.store.update("secrets", tok_secret)
+                except KeyError:
+                    self.store.create("secrets", tok_secret)
             authenticator = AuthenticatorChain(
                 tokens={
                     self.admin_token: UserInfo(

@@ -91,7 +91,9 @@ def lookup_token(store, token: str) -> Optional[api.Secret]:
     sec = store.get("secrets", TOKEN_NAMESPACE, TOKEN_SECRET_PREFIX + tid)
     if sec is None or sec.type != TOKEN_SECRET_TYPE:
         return None
-    if not hmac.compare_digest(sec.data.get("token-secret", ""), tsec):
+    if not hmac.compare_digest(
+            sec.data.get("token-secret", "").encode(),
+            tsec.encode()):  # bytes: non-ASCII input must 401, not 500
         return None
     if sec.data.get("token-id") != tid:
         # reference bootstrap.go validates token-id against the secret
@@ -140,7 +142,8 @@ def verify_cluster_info(info: api.ConfigMap, token: str) -> Optional[str]:
     sig = info.data.get(JWS_KEY_PREFIX + tid)
     if not ca or not sig:
         return None
-    if not hmac.compare_digest(sig, sign_payload(ca, tsec)):
+    if not hmac.compare_digest(sig.encode(),
+                               sign_payload(ca, tsec).encode()):
         return None
     return ca
 

@@ -29,6 +29,12 @@ from .pleg import PLEG
 from .pod_workers import PodWorkers
 from .runtime import EXITED, RUNNING, FakeRuntime
 
+# crash-loop restart backoff (kuberuntime_manager.go backOff: base 10s
+# doubling to 5min; forgiven after 10min of stable running)
+CRASH_BACKOFF_BASE = 10.0
+CRASH_BACKOFF_MAX = 300.0
+CRASH_BACKOFF_RESET = 600.0
+
 
 class _ProbeState:
     __slots__ = ("failures", "successes", "last_run")
@@ -77,6 +83,11 @@ class Kubelet:
         # visible, volumes not attached): re-dispatched next iteration
         # even without an event/rv change
         self._needs_retry: set = set()
+        # (pod uid, container) -> current crash-backoff delay / deadline /
+        # last start time (CrashLoopBackOff machinery)
+        self._crash_backoff: Dict[tuple, float] = {}
+        self._crash_backoff_until: Dict[tuple, float] = {}
+        self._last_container_start: Dict[tuple, float] = {}
         self.heartbeat_period = heartbeat_period
         self.memory_pressure_threshold = memory_pressure_threshold
         self.allocatable = allocatable or api.resource_list(
@@ -279,7 +290,33 @@ class Kubelet:
                             pod.spec.restart_policy == "OnFailure"
                             and st.exit_code == 0):
                         continue
+                    # crash-loop backoff (kuberuntime_manager.go
+                    # doBackOff over the shared image/crash backoff:
+                    # 10s doubling to 5min): a crashing container waits
+                    # out its window instead of hot-looping restarts;
+                    # the window resets after a stable run
+                    key = (uid, c.name)
+                    until = self._crash_backoff_until.get(key, 0.0)
+                    if now < until:
+                        self._needs_retry.add(uid)
+                        continue
+                    delay = self._crash_backoff.get(key, 0.0)
+                    started = self._last_container_start.get(key)
+                    # forgiveness keys off the RUN duration (start ->
+                    # exit), not wall time since start: minutes spent
+                    # sitting exited in a backoff window are not
+                    # stability
+                    ended = (st.finished_at if st.finished_at is not None
+                             else now)
+                    if started is not None and \
+                            ended - started > CRASH_BACKOFF_RESET:
+                        delay = 0.0  # ran stably: forgive history
+                    delay = min(max(delay * 2, CRASH_BACKOFF_BASE),
+                                CRASH_BACKOFF_MAX)
+                    self._crash_backoff[key] = delay
+                    self._crash_backoff_until[key] = now + delay
                     st.restart_count += 1
+                self._last_container_start[(uid, c.name)] = now
                 self.runtime.start_container(uid, c.name, now,
                                              env=dict(c.env or {}))
         self._run_probes(pod, now)
@@ -393,6 +430,12 @@ class Kubelet:
             self._known_pod_rvs.pop(uid, None)
             self._needs_retry.discard(uid)
             self.pod_workers.forget(uid)
+            # crash-backoff state dies with the pod (fresh uids from
+            # churn would otherwise grow these maps without bound)
+            for d in (self._crash_backoff, self._crash_backoff_until,
+                      self._last_container_start):
+                for key in [k for k in d if k[0] == uid]:
+                    d.pop(key, None)
             # volume manager: drop desired state; the next reconcile
             # unmounts the orphaned mounts (reconciler.go:166)
             self.volume_manager.forget_pod(uid)

@@ -32,6 +32,7 @@ class ContainerState:
     # actually operate on (path -> contents)
     files: Dict[str, str] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
+    finished_at: Optional[float] = None  # when it last exited (if known)
 
 
 class FakeRuntime:
@@ -239,12 +240,14 @@ class FakeRuntime:
 
     # -- fault injection (tests / chaos harness) -------------------------------
 
-    def crash_container(self, pod_uid: str, name: str, exit_code: int = 1):
+    def crash_container(self, pod_uid: str, name: str, exit_code: int = 1,
+                        now: Optional[float] = None):
         with self._lock:
             st = self.containers.get((pod_uid, name))
             if st is not None:
                 st.state = EXITED
                 st.exit_code = exit_code
+                st.finished_at = now  # crash-backoff forgiveness input
                 st.logs.append(f"container {name} exited rc={exit_code}")
 
     def set_healthy(self, pod_uid: str, name: str, healthy: bool):

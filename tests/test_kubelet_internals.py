@@ -222,3 +222,73 @@ class TestVolumeManagerGate:
         kl.sync_once()
         st = kl.runtime.get(uid, "c")
         assert st is not None  # started once the volume attached
+
+
+class TestCrashLoopBackoff:
+    """kuberuntime_manager.go doBackOff: a crashing container restarts
+    immediately the first time, then waits an exponentially growing
+    window (10s..5min); a stable run forgives the history."""
+
+    def _world(self):
+        from kubernetes_tpu.kubelet import Kubelet
+        from kubernetes_tpu.runtime.store import ObjectStore
+        from kubernetes_tpu.api import types as api
+
+        store = ObjectStore()
+        now = [1000.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0])
+        pod = api.Pod(metadata=api.ObjectMeta(name="crashy"),
+                      spec=api.PodSpec(node_name="n1",
+                                       containers=[api.Container(
+                                           name="main")]))
+        store.create("pods", pod)
+        kl.sync_once()
+        return store, kl, pod, now
+
+    def test_backoff_gates_restarts(self):
+        store, kl, pod, now = self._world()
+        uid = pod.metadata.uid
+        st = kl.runtime.get(uid, "main")
+        assert st.state == "running"
+        # crash 1: restart happens on the next sync (fresh backoff)
+        kl.runtime.crash_container(uid, "main")
+        now[0] += 1
+        kl.sync_once()
+        assert kl.runtime.get(uid, "main").state == "running"
+        assert kl.runtime.get(uid, "main").restart_count == 1
+        # crash 2 immediately: now inside the 10s window — NO restart
+        kl.runtime.crash_container(uid, "main")
+        now[0] += 1
+        kl.sync_once()
+        assert kl.runtime.get(uid, "main").state == "exited"
+        # window passes: restart proceeds, window doubles
+        now[0] += 15
+        kl.sync_once()
+        assert kl.runtime.get(uid, "main").state == "running"
+        assert kl.runtime.get(uid, "main").restart_count == 2
+        # crash 3: 20s window now; 15s is not enough
+        kl.runtime.crash_container(uid, "main")
+        now[0] += 15
+        kl.sync_once()
+        assert kl.runtime.get(uid, "main").state == "exited"
+        now[0] += 10
+        kl.sync_once()
+        assert kl.runtime.get(uid, "main").state == "running"
+
+    def test_stable_run_forgives_history(self):
+        store, kl, pod, now = self._world()
+        uid = pod.metadata.uid
+        kl.runtime.crash_container(uid, "main")
+        now[0] += 1
+        kl.sync_once()  # restart 1, backoff 10s recorded
+        # runs STABLY for >10min, then crashes again
+        now[0] += 700
+        kl.sync_once()
+        kl.runtime.crash_container(uid, "main")
+        now[0] += 1
+        kl.sync_once()
+        # forgiven: restarted with the BASE window, not a doubled one
+        assert kl.runtime.get(uid, "main").state == "running"
+        from kubernetes_tpu.kubelet.kubelet import CRASH_BACKOFF_BASE
+
+        assert kl._crash_backoff[(uid, "main")] == CRASH_BACKOFF_BASE
