@@ -450,27 +450,96 @@ def cmd_create(client, args, out):
         out.write(f"{plural}/{obj.metadata.name} created\n")
 
 
+LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
+
+
+def _mp_changes(live, new):
+    """Adds/changes (NO deletion markers) taking `live` toward `new` —
+    the three-way apply's 'revert drift on declared fields' half
+    (reference CreateThreeWayJSONMergePatch diffs modified vs CURRENT).
+    Lists replace wholesale; strategic merge keys are out of scope."""
+    patch = {}
+    for k, v in new.items():
+        lv = live.get(k)
+        if isinstance(v, dict) and isinstance(lv, dict):
+            sub = _mp_changes(lv, v)
+            if sub:
+                patch[k] = sub
+        elif lv != v:
+            patch[k] = v
+    return patch
+
+
+def _mp_deletions(last, new):
+    """Null markers for keys the PREVIOUS apply declared and this
+    manifest dropped — the only deletions apply may make."""
+    patch = {}
+    for k, lv in last.items():
+        if k not in new:
+            patch[k] = None
+        elif isinstance(lv, dict) and isinstance(new.get(k), dict):
+            sub = _mp_deletions(lv, new[k])
+            if sub:
+                patch[k] = sub
+    return patch
+
+
+def _merge_dicts(a, b):
+    for k, v in b.items():
+        if isinstance(v, dict) and isinstance(a.get(k), dict):
+            _merge_dicts(a[k], v)
+        else:
+            a[k] = v
+    return a
+
+
 def cmd_apply(client, args, out):
-    """Create-or-update (the reference's three-way apply reduced to
-    server-side upsert via PUT)."""
+    """Three-way apply (pkg/kubectl/cmd/apply.go): merge what the
+    MANIFEST declares into the live object, delete only the fields the
+    PREVIOUS apply declared and this one dropped (the
+    last-applied-configuration annotation), and leave every field other
+    actors own — status, scheduler/controller writes, out-of-band
+    labels — untouched."""
     for doc in load_manifests(args.filename):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
             obj.metadata.namespace = args.namespace
+            doc.setdefault("metadata", {})["namespace"] = args.namespace
         try:
-            cur = client.get(plural, obj.metadata.namespace, obj.metadata.name)
-            obj.metadata.resource_version = cur.metadata.resource_version
-            obj.metadata.uid = cur.metadata.uid
-            client.update(plural, obj)
-            out.write(f"{plural}/{obj.metadata.name} configured\n")
+            cur = client.get(plural, obj.metadata.namespace,
+                             obj.metadata.name)
         except APIStatusError as e:
             if e.code != 404:
                 raise
+            obj.metadata.annotations = dict(obj.metadata.annotations or {})
+            obj.metadata.annotations[LAST_APPLIED_ANNOTATION] = \
+                json.dumps(doc, sort_keys=True)
             client.create(plural, obj)
             out.write(f"{plural}/{obj.metadata.name} created\n")
+            if isinstance(obj, api.CustomResourceDefinition):
+                scheme.register_dynamic(obj)  # later docs may use the kind
+            continue
+        live_doc = scheme.encode_object(cur)
+        try:
+            last = json.loads((cur.metadata.annotations or {}).get(
+                LAST_APPLIED_ANNOTATION, "{}"))
+        except json.JSONDecodeError:
+            last = {}
+        # three-way patch: deletions from (last -> manifest), adds/
+        # changes from (LIVE -> manifest) so out-of-band drift on
+        # declared fields is reverted; sent through the server's PATCH
+        # so the merge happens atomically under the server's lock (and
+        # the null-stripping lives in ONE place, the server)
+        patch = _merge_dicts(_mp_deletions(last, doc),
+                             _mp_changes(live_doc, doc))
+        _merge_dicts(patch, {"metadata": {"annotations": {
+            LAST_APPLIED_ANNOTATION: json.dumps(doc, sort_keys=True)}}})
+        client.patch(plural, obj.metadata.namespace, obj.metadata.name,
+                     patch)
+        out.write(f"{plural}/{obj.metadata.name} configured\n")
         if isinstance(obj, api.CustomResourceDefinition):
-            scheme.register_dynamic(obj)  # later docs may use the kind
+            scheme.register_dynamic(obj)
 
 
 def cmd_delete(client, args, out):

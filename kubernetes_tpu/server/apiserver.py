@@ -1106,11 +1106,16 @@ class APIServer:
 
 def _merge_patch(target: dict, patch: dict):
     """RFC 7386 merge patch (the reference default is strategic merge;
-    merge patch covers the framework's PATCH uses)."""
+    merge patch covers the framework's PATCH uses). When the target key
+    is absent or non-dict, a dict-valued patch recurses into a FRESH
+    dict so nested null deletion markers are stripped instead of leaking
+    into the stored object as literal nulls (RFC 7386 §2)."""
     for k, v in patch.items():
         if v is None:
             target.pop(k, None)
-        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+        elif isinstance(v, dict):
+            if not isinstance(target.get(k), dict):
+                target[k] = {}
             _merge_patch(target[k], v)
         else:
             target[k] = v

@@ -234,3 +234,84 @@ class TestDiffAndHyperkube:
             assert rc == 0, out.getvalue()
         finally:
             srv.stop()
+
+
+class TestThreeWayApply:
+    """pkg/kubectl/cmd/apply.go: the manifest owns only what it
+    declares; fields dropped since the last apply are removed; fields
+    other actors wrote survive re-apply."""
+
+    def _manifest(self, tmp_path, labels, extra_spec=""):
+        lines = "".join(f"    {k}: '{v}'\n" for k, v in labels.items())
+        m = tmp_path / "dep.yaml"
+        m.write_text(
+            "apiVersion: apps/v1\nkind: Deployment\n"
+            "metadata:\n  name: site\n  labels:\n" + lines +
+            "spec:\n  replicas: 2\n" + extra_spec +
+            "  selector:\n    matchLabels:\n      app: site\n"
+            "  template:\n    metadata:\n      name: site\n"
+            "      labels:\n        app: site\n")
+        return m
+
+    def test_removed_fields_deleted_foreign_fields_kept(self, tmp_path):
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.runtime.store import ObjectStore
+
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            m = self._manifest(tmp_path, {"team": "web", "tier": "fe"})
+            rc = main(["--server", srv.url, "apply", "-f", str(m)],
+                      out=io.StringIO())
+            assert rc == 0
+            # another actor (a controller, a human) writes fields the
+            # manifest does not declare
+            live = store.get("deployments", "default", "site")
+            live.metadata.labels["injected"] = "by-other-actor"
+            live.status.replicas = 2
+            store.update("deployments", live)
+            # re-apply with 'tier' dropped and replicas changed
+            m = self._manifest(tmp_path, {"team": "web"},
+                               extra_spec="  paused: true\n")
+            rc = main(["--server", srv.url, "apply", "-f", str(m)],
+                      out=io.StringIO())
+            assert rc == 0
+            live = store.get("deployments", "default", "site")
+            assert "tier" not in live.metadata.labels  # dropped: removed
+            assert live.metadata.labels["team"] == "web"
+            assert live.metadata.labels["injected"] == \
+                "by-other-actor"  # foreign: preserved
+            assert live.status.replicas == 2  # status untouched
+            assert live.spec.paused is True
+            # third apply dropping paused removes it (back to default)
+            m = self._manifest(tmp_path, {"team": "web"})
+            rc = main(["--server", srv.url, "apply", "-f", str(m)],
+                      out=io.StringIO())
+            assert rc == 0
+            assert store.get("deployments", "default",
+                             "site").spec.paused is False
+        finally:
+            srv.stop()
+
+    def test_reapply_reverts_out_of_band_drift(self, tmp_path):
+        """Declared fields drifted out-of-band come BACK on re-apply
+        (CreateThreeWayJSONMergePatch diffs modified vs current)."""
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.runtime.store import ObjectStore
+
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            m = self._manifest(tmp_path, {"team": "web"})
+            assert main(["--server", srv.url, "apply", "-f", str(m)],
+                        out=io.StringIO()) == 0
+            live = store.get("deployments", "default", "site")
+            live.spec.replicas = 9  # kubectl scale / manual drift
+            store.update("deployments", live)
+            # identical manifest re-applied: declared replicas=2 wins
+            assert main(["--server", srv.url, "apply", "-f", str(m)],
+                        out=io.StringIO()) == 0
+            assert store.get("deployments", "default",
+                             "site").spec.replicas == 2
+        finally:
+            srv.stop()
