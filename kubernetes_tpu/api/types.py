@@ -32,6 +32,11 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
     deletion_timestamp: Optional[float] = None
+    # deletion gates (apimachinery ObjectMeta.Finalizers): a DELETE with
+    # finalizers present only marks deletion_timestamp; the object goes
+    # away when the last finalizer is removed (apiserver delete/update
+    # paths + the protection controllers)
+    finalizers: List[str] = field(default_factory=list)
     owner_references: List["OwnerReference"] = field(default_factory=list)
 
     def __post_init__(self):

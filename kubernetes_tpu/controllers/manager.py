@@ -35,6 +35,8 @@ from .ttl import TTLController
 from .volumebinding import PersistentVolumeController
 from .bootstrap import BootstrapSignerController, TokenCleanerController
 from .clusterroleaggregation import ClusterRoleAggregationController
+from .storageprotection import (PVCProtectionController,
+                                PVProtectionController)
 
 DEFAULT_CONTROLLERS = [
     ReplicaSetController, ReplicationControllerController,
@@ -46,7 +48,8 @@ DEFAULT_CONTROLLERS = [
     AttachDetachController, HorizontalPodAutoscalerController,
     TTLController, CSRApprovingController, CSRSigningController,
     BootstrapSignerController, TokenCleanerController,
-    ClusterRoleAggregationController,
+    ClusterRoleAggregationController, PVCProtectionController,
+    PVProtectionController,
 ]
 
 

@@ -960,20 +960,40 @@ class APIServer:
             msg = scheme.crd_conflict(obj, replacing=old.spec.names.kind)
             if msg is not None:
                 raise APIError(409, "Conflict", msg)
+        # deletionTimestamp is SERVER-owned in both directions: a PUT
+        # can neither clear a pending deletion nor SET one (a client-
+        # supplied mark would delete through the update verb, bypassing
+        # delete admission, or falsely Terminate a live object)
+        obj.metadata.deletion_timestamp = old.metadata.deletion_timestamp
         try:
             self.store.update(plural, obj)
         except Conflict as e:
             raise APIError(409, "Conflict", str(e))
+        completed = False
+        if obj.metadata.deletion_timestamp is not None and \
+                not obj.metadata.finalizers:
+            # the last finalizer was just removed from an object marked
+            # for deletion: complete it (store.go
+            # deleteWithoutFinalizers)
+            completed = True
+            try:
+                self.store.delete(plural, obj.metadata.namespace,
+                                  obj.metadata.name)
+            except KeyError:
+                pass
         if plural == "customresourcedefinitions":
-            # with the in-process store the CRD informer already applied
-            # this synchronously inside store.update; this inline pass is
-            # for stores with async watch dispatch (NativeObjectStore),
-            # where the informer may run after the 200 is sent. Both
-            # paths are idempotent registry ops, so double execution is
-            # harmless.
-            if obj.spec.names.kind != old.spec.names.kind:
-                scheme.unregister(old.spec.names.kind)
-            scheme.register_dynamic(obj, replacing=old.spec.names.kind)
+            if completed:
+                # the CRD just ceased to exist: the kind must stop being
+                # served, not get re-registered
+                scheme.unregister(obj.spec.names.kind)
+            else:
+                # with the in-process store the CRD informer already
+                # applied this synchronously inside store.update; this
+                # inline pass is for stores with async watch dispatch
+                # (NativeObjectStore). Both paths are idempotent.
+                if obj.spec.names.kind != old.spec.names.kind:
+                    scheme.unregister(old.spec.names.kind)
+                scheme.register_dynamic(obj, replacing=old.spec.names.kind)
         h._send(200, json.dumps(scheme.encode_object(obj, version=gv)).encode())
 
     def _serve_delete(self, h, plural, namespace, name, user):
@@ -987,11 +1007,27 @@ class APIServer:
             raise APIError(code,
                            "TooManyRequests" if code == 429 else "Forbidden",
                            str(e))
-        self.store.delete(plural, obj.metadata.namespace, obj.metadata.name)
-        if plural == "customresourcedefinitions":
-            scheme.unregister(obj.spec.names.kind)
+        self._delete_or_mark(plural, obj)
         h._send(200, _status_body(200, "Success", f"{name} deleted",
                                   status="Success"))
+
+    def _delete_or_mark(self, plural, obj) -> bool:
+        """Finalizer-gated deletion (registry/generic/registry/store.go
+        Delete -> updateForGracefulDeletionAndFinalizers): with
+        finalizers present, only mark deletion_timestamp — the object
+        disappears when the last finalizer clears (see _serve_update).
+        EVERY server-side delete (DELETE verb, eviction) goes through
+        here. Returns True when the object was actually removed."""
+        if getattr(obj.metadata, "finalizers", None):
+            if obj.metadata.deletion_timestamp is None:
+                obj.metadata.deletion_timestamp = time.time()
+                self.store.update(plural, obj)
+            return False
+        self.store.delete(plural, obj.metadata.namespace,
+                          obj.metadata.name)
+        if plural == "customresourcedefinitions":
+            scheme.unregister(obj.spec.names.kind)
+        return True
 
     def _serve_binding(self, h, namespace, name):
         """POST pods/<name>/binding (BindingREST.Create,
@@ -1021,7 +1057,9 @@ class APIServer:
                     and pdb.disruptions_allowed <= 0:
                 raise APIError(429, "TooManyRequests",
                                f"pdb {pdb.metadata.name} disallows eviction")
-        self.store.delete("pods", pod.metadata.namespace, pod.metadata.name)
+        # finalizer-gated like every server-side delete (the reference's
+        # eviction goes through the registry Delete and respects them)
+        self._delete_or_mark("pods", pod)
         h._send(201, _status_body(201, "Success", "evicted", status="Success"))
 
     # -- watch -----------------------------------------------------------------

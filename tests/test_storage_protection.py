@@ -1,0 +1,157 @@
+"""Storage-object-in-use protection (pkg/controller/volume/
+pvcprotection + pvprotection) over the finalizer machinery
+(metadata.finalizers + deletion_timestamp through the apiserver's
+delete/update paths)."""
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.controllers.storageprotection import (
+    PVC_PROTECTION_FINALIZER, PVCProtectionController,
+    PVProtectionController)
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server import APIServer
+
+from helpers import make_pod
+
+
+def _pvc(name="claim"):
+    return api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.PersistentVolumeClaimSpec(
+            requests=api.resource_list(storage="1Gi")))
+
+
+class TestPVCProtection:
+    def test_in_use_claim_survives_delete_until_pod_gone(self):
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        ctrl = PVCProtectionController(store)
+        try:
+            c = RESTClient(srv.url)
+            c.create("persistentvolumeclaims", _pvc())
+            ctrl.sync_all()  # finalizer added
+            pvc = store.get("persistentvolumeclaims", "default", "claim")
+            assert PVC_PROTECTION_FINALIZER in pvc.metadata.finalizers
+            pod = make_pod("user-pod", node_name="n1")
+            pod.spec.volumes = [api.Volume(name="data",
+                                           pvc_name="claim")]
+            store.create("pods", pod)
+            # DELETE while in use: marked Terminating, NOT removed
+            c.delete("persistentvolumeclaims", "default", "claim")
+            ctrl.sync_all()
+            pvc = store.get("persistentvolumeclaims", "default", "claim")
+            assert pvc is not None, "in-use claim was yanked"
+            assert pvc.metadata.deletion_timestamp is not None
+            # pod goes away -> controller releases -> claim disappears
+            store.delete("pods", "default", "user-pod")
+            ctrl.sync_all()
+            assert store.get("persistentvolumeclaims", "default",
+                             "claim") is None
+        finally:
+            srv.stop()
+
+    def test_unused_claim_deletes_after_release(self):
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        ctrl = PVCProtectionController(store)
+        try:
+            c = RESTClient(srv.url)
+            c.create("persistentvolumeclaims", _pvc("free"))
+            ctrl.sync_all()
+            c.delete("persistentvolumeclaims", "default", "free")
+            ctrl.sync_all()  # nothing uses it: released immediately
+            assert store.get("persistentvolumeclaims", "default",
+                             "free") is None
+        finally:
+            srv.stop()
+
+
+class TestPVProtection:
+    def test_bound_pv_survives_delete_until_unbound(self):
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        ctrl = PVProtectionController(store)
+        try:
+            c = RESTClient(srv.url)
+            store.create("persistentvolumes", api.PersistentVolume(
+                metadata=api.ObjectMeta(name="vol", namespace=""),
+                spec=api.PersistentVolumeSpec(
+                    capacity=api.resource_list(storage="1Gi"))))
+            pvc = _pvc("binder")
+            pvc.spec.volume_name = "vol"
+            store.create("persistentvolumeclaims", pvc)
+            ctrl.sync_all()
+            c.delete("persistentvolumes", "", "vol")
+            ctrl.sync_all()
+            pv = store.get("persistentvolumes", "", "vol")
+            assert pv is not None and \
+                pv.metadata.deletion_timestamp is not None
+            store.delete("persistentvolumeclaims", "default", "binder")
+            ctrl.sync_all()
+            assert store.get("persistentvolumes", "", "vol") is None
+        finally:
+            srv.stop()
+
+
+class TestFinalizerAPIMachinery:
+    """The server-side half: deletionTimestamp is server-owned in both
+    directions, and removing the last finalizer through the API
+    completes a pending deletion."""
+
+    def test_put_cannot_set_or_clear_deletion_timestamp(self):
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            c = RESTClient(srv.url)
+            cm = api.ConfigMap(metadata=api.ObjectMeta(name="cm"),
+                               data={"k": "v"})
+            c.create("configmaps", cm)
+            # a PUT smuggling deletionTimestamp (no finalizers) must NOT
+            # delete through the update verb
+            live = c.get("configmaps", "default", "cm")
+            live.metadata.deletion_timestamp = 1.0
+            c.update("configmaps", live)
+            got = store.get("configmaps", "default", "cm")
+            assert got is not None
+            assert got.metadata.deletion_timestamp is None
+        finally:
+            srv.stop()
+
+    def test_last_finalizer_removal_via_api_completes_deletion(self):
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            c = RESTClient(srv.url)
+            cm = api.ConfigMap(metadata=api.ObjectMeta(
+                name="gated", finalizers=["example.com/hold"]),
+                data={})
+            c.create("configmaps", cm)
+            c.delete("configmaps", "default", "gated")
+            live = c.get("configmaps", "default", "gated")
+            assert live.metadata.deletion_timestamp is not None
+            # clearing a pending deletion via PUT is ignored
+            live.metadata.deletion_timestamp = None
+            c.update("configmaps", live)
+            live = c.get("configmaps", "default", "gated")
+            assert live.metadata.deletion_timestamp is not None
+            # removing the last finalizer THROUGH THE API completes it
+            live.metadata.finalizers = []
+            c.update("configmaps", live)
+            assert store.get("configmaps", "default", "gated") is None
+        finally:
+            srv.stop()
+
+    def test_eviction_respects_finalizers(self):
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        try:
+            c = RESTClient(srv.url)
+            pod = make_pod("held", node_name="n1")
+            pod.metadata.finalizers = ["example.com/hold"]
+            store.create("pods", pod)
+            c.evict("default", "held")
+            got = store.get("pods", "default", "held")
+            assert got is not None, "finalized pod was yanked by eviction"
+            assert got.metadata.deletion_timestamp is not None
+        finally:
+            srv.stop()
