@@ -1,0 +1,33 @@
+# Repo-level build/test/bench surface (reference: top-level Makefile +
+# hack/make-rules — `make`, `make test`, `make test-integration`,
+# `make bench`).  Native components build under native/; everything else
+# is Python and needs no build step.
+
+PYTHON ?= python
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+# Unit + integration + chaos tiers (tests/ runs on a virtual 8-device
+# CPU mesh; see tests/conftest.py).
+test: native
+	$(PYTHON) -m pytest tests/ -x -q
+
+# Fast smoke: the kernel/parity core only.
+test-unit: native
+	$(PYTHON) -m pytest tests/test_kernel_smoke.py tests/test_parity.py -x -q
+
+# The driver's benchmark surface (real TPU when available; CPU otherwise).
+bench:
+	$(PYTHON) bench.py
+
+# Full benchmark grid (all BASELINE.md configs).
+bench-all:
+	$(PYTHON) bench.py --suite
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native test test-unit bench bench-all clean
