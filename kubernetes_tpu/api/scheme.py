@@ -157,6 +157,9 @@ register("LimitRange", "limitranges", api.LimitRange)
 register("CertificateSigningRequest", "certificatesigningrequests",
          api.CertificateSigningRequest, "certificates.k8s.io/v1beta1",
          namespaced=False)
+register("SelfSubjectAccessReview", "selfsubjectaccessreviews",
+         api.SelfSubjectAccessReview, "authorization.k8s.io/v1",
+         namespaced=False)
 register("Role", "roles", api.Role, "rbac.authorization.k8s.io/v1")
 register("ClusterRole", "clusterroles", api.ClusterRole,
          "rbac.authorization.k8s.io/v1", namespaced=False)

@@ -970,6 +970,46 @@ class ServiceAccount:
 
 
 @dataclass
+class ResourceAttributes:
+    """authorization/v1 ResourceAttributes (reference:
+    pkg/apis/authorization/types.go). `resource` may carry a
+    subresource as 'pods/exec', matching the authorizer's attribute
+    form."""
+
+    verb: str = ""
+    resource: str = ""
+    namespace: Optional[str] = None
+    name: Optional[str] = None
+
+
+@dataclass
+class SelfSubjectAccessReviewSpec:
+    resource_attributes: ResourceAttributes = field(
+        default_factory=ResourceAttributes)
+
+
+@dataclass
+class SubjectAccessReviewStatus:
+    allowed: bool = False
+    reason: str = ""
+
+
+@dataclass
+class SelfSubjectAccessReview:
+    """Virtual (non-stored) review resource: POSTing one asks the server
+    'can I, the requesting identity, do this?' (reference:
+    pkg/registry/authorization/selfsubjectaccessreview/rest.go:48 —
+    evaluated against the live authorizer, never persisted). Drives
+    `kubectl auth can-i`."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: SelfSubjectAccessReviewSpec = field(
+        default_factory=SelfSubjectAccessReviewSpec)
+    status: SubjectAccessReviewStatus = field(
+        default_factory=SubjectAccessReviewStatus)
+
+
+@dataclass
 class CertificateSigningRequestSpec:
     """certificates/v1beta1 (reference: pkg/apis/certificates/types.go;
     controllers pkg/controller/certificates/)."""

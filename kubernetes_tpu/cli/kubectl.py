@@ -83,11 +83,7 @@ _COLUMNS = {
 }
 
 
-def _print_table(plural: str, objs: List[object], out):
-    headers, row_fn = _COLUMNS.get(
-        plural, (["NAME", "AGE"],
-                 lambda o: [o.metadata.name, _age(o)]))
-    rows = [row_fn(o) for o in objs]
+def _write_table(headers: List[str], rows: List[List[str]], out):
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
     out.write("  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()
@@ -95,6 +91,13 @@ def _print_table(plural: str, objs: List[object], out):
     for r in rows:
         out.write("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
                   + "\n")
+
+
+def _print_table(plural: str, objs: List[object], out):
+    headers, row_fn = _COLUMNS.get(
+        plural, (["NAME", "AGE"],
+                 lambda o: [o.metadata.name, _age(o)]))
+    _write_table(headers, [row_fn(o) for o in objs], out)
 
 
 def _dump(obj, fmt: str, out):
@@ -726,14 +729,7 @@ def cmd_top(client, args, out):
         return (m.usage.get(res.CPU, 0), m.usage.get(res.MEMORY, 0))
 
     def table(rows):
-        headers = ["NAME", "CPU(m)", "MEMORY(Mi)"]
-        widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
-                  for i, h in enumerate(headers)]
-        out.write("  ".join(h.ljust(w) for h, w in
-                            zip(headers, widths)).rstrip() + "\n")
-        for r in rows:
-            out.write("  ".join(c.ljust(w) for c, w in
-                                zip(r, widths)).rstrip() + "\n")
+        _write_table(["NAME", "CPU(m)", "MEMORY(Mi)"], rows, out)
 
     if what == "pods":
         # namespace-scoped, like the real kubectl top pods
@@ -792,6 +788,375 @@ def cmd_explain(client, args, out):
             out.write(f"  {f.name}\t<{getattr(f.type, '__name__', f.type)}>\n")
     else:
         out.write(f"  <{typ.__name__}> (scalar)\n")
+
+
+def cmd_taint(client, args, out):
+    """taint.go: `kubectl taint nodes <name> key=value:Effect` adds (or
+    updates) a taint; a trailing '-' (key:Effect- or key-) removes."""
+    if _resolve_kind(args.kind) != "nodes":
+        raise SystemExit("error: taint supports nodes")
+    node = client.get("nodes", None, args.name)
+    taints = list(node.spec.taints)
+    for spec in args.taints:
+        if spec.endswith("-"):
+            body = spec[:-1]
+            key, _, effect = body.partition(":")
+            key, _, _ = key.partition("=")
+            before = len(taints)
+            taints = [t for t in taints
+                      if not (t.key == key
+                              and (not effect or t.effect == effect))]
+            if len(taints) == before:
+                raise SystemExit(f"error: taint {key!r} not found")
+        else:
+            kv, sep, effect = spec.rpartition(":")
+            if not sep or not effect or ":" in effect or "=" in effect:
+                raise SystemExit(
+                    f"error: taint {spec!r} must be key[=value]:Effect")
+            key, _, value = kv.partition("=")
+            # replace an existing taint with the same key+effect
+            # (reference updates in place rather than duplicating)
+            taints = [t for t in taints
+                      if not (t.key == key and t.effect == effect)]
+            taints.append(api.Taint(key=key, value=value, effect=effect))
+    node.spec.taints = taints
+    client.update("nodes", node)
+    out.write(f"node/{args.name} tainted\n")
+
+
+def cmd_run(client, args, out):
+    """run.go (1.11 semantics): --restart=Always -> Deployment (the
+    deprecated-but-default generator), OnFailure -> Job, Never -> Pod."""
+    labels = {"run": args.name}
+    tmpl = api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(restart_policy=args.restart,
+                         containers=[api.Container(name=args.name,
+                                                   image=args.image)]))
+    meta = api.ObjectMeta(name=args.name, namespace=args.namespace,
+                          labels=dict(labels))
+    if args.restart == "Always":
+        obj = api.Deployment(metadata=meta, spec=api.DeploymentSpec(
+            replicas=args.replicas,
+            selector=api.LabelSelector(match_labels=dict(labels)),
+            template=tmpl))
+        client.create("deployments", obj)
+        out.write(f"deployment.apps/{args.name} created\n")
+    elif args.restart == "OnFailure":
+        obj = api.Job(metadata=meta, spec=api.JobSpec(
+            selector=api.LabelSelector(match_labels=dict(labels)),
+            template=tmpl))
+        client.create("jobs", obj)
+        out.write(f"job.batch/{args.name} created\n")
+    else:  # Never
+        pod = api.Pod(metadata=meta,
+                      spec=api.PodSpec(restart_policy="Never",
+                                       containers=[api.Container(
+                                           name=args.name,
+                                           image=args.image)]))
+        client.create("pods", pod)
+        out.write(f"pod/{args.name} created\n")
+
+
+def cmd_replace(client, args, out):
+    """replace.go: full update from the manifest (PUT semantics; the
+    live resourceVersion is carried over so the write is a plain update,
+    not a CAS failure)."""
+    for doc in load_manifests(args.filename):
+        obj, kind = _decode_doc(doc)
+        plural = scheme.plural_for_kind(kind)
+        if scheme.is_namespaced(kind) and args.namespace != "default":
+            obj.metadata.namespace = args.namespace
+        live = client.get(plural, obj.metadata.namespace, obj.metadata.name)
+        obj.metadata.resource_version = live.metadata.resource_version
+        obj.metadata.uid = live.metadata.uid
+        client.update(plural, obj)
+        out.write(f"{plural}/{obj.metadata.name} replaced\n")
+
+
+def cmd_autoscale(client, args, out):
+    """autoscale.go: create an HPA targeting the workload."""
+    plural = _resolve_kind(args.kind)
+    obj = client.get(plural, args.namespace, args.name)  # must exist
+    kind = scheme.kind_for_plural(plural)
+    hpa = api.HorizontalPodAutoscaler(
+        metadata=api.ObjectMeta(name=args.name, namespace=args.namespace),
+        spec=api.HorizontalPodAutoscalerSpec(
+            scale_target_ref=api.CrossVersionObjectReference(
+                kind=kind, name=obj.metadata.name),
+            min_replicas=args.min, max_replicas=args.max,
+            target_cpu_utilization_percentage=args.cpu_percent))
+    client.create("horizontalpodautoscalers", hpa)
+    out.write(f"horizontalpodautoscaler.autoscaling/{args.name} "
+              f"autoscaled\n")
+
+
+def cmd_certificate(client, args, out):
+    """certificates.go: approve/deny a CSR by appending the condition
+    the signing controller consumes (status subresource write)."""
+    csr = client.get("certificatesigningrequests", None, args.name)
+    cond = ("Approved", "KubectlApprove") if args.action == "approve" \
+        else ("Denied", "KubectlDeny")
+    # approve and deny are mutually exclusive: the signer gates on
+    # csr.approved only, so a stale Approved alongside a new Denied
+    # would still get the CSR signed
+    drop = "Denied" if args.action == "approve" else "Approved"
+    csr.status.conditions = [c for c in csr.status.conditions
+                             if c[0] != drop]
+    if cond not in csr.status.conditions:
+        csr.status.conditions.append(cond)
+    client.update("certificatesigningrequests", csr, sub="status")
+    out.write(f"certificatesigningrequest.certificates.k8s.io/{args.name} "
+              f"{args.action}d\n")
+
+
+def cmd_auth(client, args, out):
+    """auth/cani.go: POST a SelfSubjectAccessReview and report. Exit
+    code 0 = allowed, 1 = denied (like the reference with --quiet off
+    it prints yes/no; the exit code contract comes from cani.go
+    RunAccessCheck)."""
+    if args.action != "can-i":
+        raise SystemExit("error: auth supports can-i")
+    resource = args.resource
+    if args.subresource:
+        resource = f"{resource}/{args.subresource}"
+    base, _, sub = resource.partition("/")
+    plural = _resolve_kind(base)
+    # cluster-scoped resources authorize with no namespace (the server's
+    # dispatch only sets a namespace from a /namespaces/ path segment) —
+    # stamping 'default' here would let a namespaced RoleBinding answer
+    # 'yes' for a request that will actually be evaluated cluster-wide
+    ns = (args.namespace
+          if scheme.is_namespaced(scheme.kind_for_plural(plural)) else None)
+    review = api.SelfSubjectAccessReview(
+        spec=api.SelfSubjectAccessReviewSpec(
+            resource_attributes=api.ResourceAttributes(
+                verb=args.auth_verb,
+                resource=plural + (f"/{sub}" if sub else ""),
+                namespace=ns, name=args.resource_name or None)))
+    created = client.create("selfsubjectaccessreviews", review)
+    allowed = bool(created.status.allowed)
+    out.write("yes\n" if allowed else "no\n")
+    return 0 if allowed else 1
+
+
+def _served_discovery(client):
+    """[(groupVersion, APIResourceList doc)] for every groupVersion the
+    server actually serves — the RESTMapper discovery walk both
+    apiversions.go and apiresources.go perform. Candidate gvs come from
+    the shared scheme; each is CONFIRMED over the wire."""
+    gvs = ["v1"]
+    for k in scheme.all_kinds():
+        for gv in scheme.served_versions(k):
+            if gv not in gvs:
+                gvs.append(gv)
+    served = []
+    for gv in sorted(gvs):
+        path = f"/api/{gv}" if "/" not in gv else f"/apis/{gv}"
+        try:
+            doc = client.request("GET", path)
+        except APIStatusError:
+            continue
+        if doc.get("resources"):
+            served.append((gv, doc))
+    return served
+
+
+def cmd_api_versions(client, args, out):
+    """apiversions.go: every served groupVersion, one per line."""
+    for gv, _ in _served_discovery(client):
+        out.write(gv + "\n")
+
+
+def cmd_api_resources(client, args, out):
+    """apiresources.go: flatten the discovery docs into a table."""
+    rows, seen = [], set()
+    for gv, doc in _served_discovery(client):
+        for r in doc.get("resources", []):
+            if r["name"] in seen:
+                continue
+            seen.add(r["name"])
+            rows.append([r["name"], gv, str(r["namespaced"]), r["kind"]])
+    rows.sort()
+    _write_table(["NAME", "APIVERSION", "NAMESPACED", "KIND"], rows, out)
+
+
+def cmd_cluster_info(client, args, out):
+    """clusterinfo.go: the master URL + cluster-service Services."""
+    out.write(f"Kubernetes master is running at {client.base_url}\n")
+    svcs, _ = client.list("services", "kube-system")
+    for s in svcs:
+        if (s.metadata.labels or {}).get(
+                "kubernetes.io/cluster-service") == "true":
+            out.write(f"{s.metadata.name} is running at "
+                      f"{client.base_url}/api/v1/namespaces/kube-system/"
+                      f"services/{s.metadata.name}/proxy\n")
+
+
+def cmd_convert(client, args, out):
+    """convert.go: re-render manifests at --output-version through the
+    SERVER-SIDE conversion hubs (api/conversion.py) — the same wire
+    converters multi-version serving uses, run locally."""
+    from ..api import conversion
+
+    for doc in load_manifests(args.filename):
+        kind = doc.get("kind")
+        if kind is None:
+            raise SystemExit("error: manifest document missing kind")
+        try:
+            hub = scheme.api_version_for(kind)
+        except KeyError:
+            raise SystemExit(f"error: unknown kind {kind!r}")
+        src = doc.get("apiVersion", hub)
+        try:
+            hub_doc = conversion.to_hub(kind, doc, src, hub)
+            out_doc = conversion.from_hub(kind, hub_doc,
+                                          args.output_version, hub)
+        except KeyError as e:
+            raise SystemExit(f"error: {e.args[0]}")
+        if args.output == "json":
+            out.write(json.dumps(out_doc, indent=2) + "\n")
+        else:
+            import yaml
+            out.write(yaml.safe_dump(out_doc, sort_keys=False) + "---\n")
+
+
+def cmd_set(client, args, out):
+    """set/set_image.go: `kubectl set image deploy/name c=img ...`
+    patches pod-template container images (triggering a rollout)."""
+    if args.action != "image":
+        raise SystemExit("error: set supports image")
+    kind_name = args.target
+    if "/" not in kind_name:
+        raise SystemExit("error: set image needs KIND/NAME")
+    kind, _, name = kind_name.partition("/")
+    plural = _resolve_kind(kind)
+    obj = client.get(plural, args.namespace, name)
+    tmpl = (None if plural == "pods"
+            else getattr(obj.spec, "template", None))
+    if tmpl is None and plural != "pods":
+        raise SystemExit(f"error: {kind}/{name} has no pod template")
+    containers = (tmpl.spec.containers if tmpl is not None
+                  else obj.spec.containers)
+    if any("=" not in kv for kv in args.images):
+        raise SystemExit("error: image updates must be container=image")
+    updates = dict(kv.split("=", 1) for kv in args.images)
+    changed = False
+    for c in containers:
+        if c.name in updates or "*" in updates:
+            c.image = updates.get(c.name, updates.get("*"))
+            changed = True
+    if not changed:
+        raise SystemExit("error: no container matched")
+    client.update(plural, obj)
+    out.write(f"{plural}/{name} image updated\n")
+
+
+def cmd_wait(client, args, out):
+    """wait.go (new in the reference's 1.11 cycle): block until
+    --for=delete or --for=condition=<Type>[=<Status>] holds."""
+    plural = _resolve_kind(args.kind)
+    want = args.wait_for
+    if want != "delete" and not want.startswith("condition="):
+        raise SystemExit(
+            f"error: --for must be 'delete' or 'condition=<Type>"
+            f"[=<Status>]', got {want!r}")
+    deadline = time.time() + args.timeout
+    while True:
+        try:
+            obj = client.get(plural, args.namespace, args.name)
+        except APIStatusError as e:
+            if e.code == 404:
+                if want == "delete":
+                    out.write(f"{plural}/{args.name} condition met\n")
+                    return 0
+                raise
+            raise
+        if want != "delete" and want.startswith("condition="):
+            spec = want[len("condition="):]
+            ctype, _, cstatus = spec.partition("=")
+            cstatus = cstatus or "True"
+            conds = getattr(obj.status, "conditions", [])
+            for c in conds:
+                t = getattr(c, "type", None)
+                s = getattr(c, "status", None)
+                if t is None and isinstance(c, tuple):
+                    t, s = c[0], c[1]
+                if t == ctype and str(s).startswith(cstatus):
+                    out.write(f"{plural}/{args.name} condition met\n")
+                    return 0
+        if time.time() >= deadline:
+            print(f"error: timed out waiting for {want} on "
+                  f"{plural}/{args.name}", file=sys.stderr)
+            return 1
+        time.sleep(min(0.1, args.timeout / 10))
+
+
+def cmd_proxy(client, args, out):
+    """proxy.go: a localhost HTTP server forwarding every request to
+    the apiserver with this client's credentials attached — gives
+    unauthenticated local tools an authenticated API path. --once
+    serves a single request in the background and returns (CI mode)."""
+    import http.server
+    import threading as _threading
+
+    target = client
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _forward(self):
+            body = None
+            n = int(self.headers.get("Content-Length") or 0)
+            if n:
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    raw = json.dumps({"kind": "Status", "code": 400,
+                                      "reason": "BadRequest",
+                                      "message": "body is not JSON"}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                    return
+            try:
+                raw, ctype = target.request_bytes(
+                    self.command, self.path.split("?", 1)[0],
+                    body=body,
+                    query=(self.path.split("?", 1)[1]
+                           if "?" in self.path else ""))
+                code = 200
+            except APIStatusError as e:
+                raw = json.dumps({"kind": "Status", "code": e.code,
+                                  "reason": e.reason,
+                                  "message": e.message}).encode()
+                ctype, code = "application/json", e.code
+            self.send_response(code)
+            self.send_header("Content-Type", ctype or "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _forward
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", args.port), Handler)
+    out.write(f"Starting to serve on 127.0.0.1:{httpd.server_address[1]}\n")
+    out.flush()
+    if args.once:
+        # NON-daemon: from a real shell the process must stay alive
+        # until the one promised request is served (a daemon thread
+        # would die with sys.exit before the client connects);
+        # in-process callers get control back immediately either way
+        _threading.Thread(target=httpd.handle_request).start()
+    else:
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    return 0
 
 
 # -- kind aliases (pkg/kubectl short names) -----------------------------------
@@ -947,6 +1312,67 @@ def build_parser() -> argparse.ArgumentParser:
     tp = sub.add_parser("top")
     tp.add_argument("kind")
 
+    tn = sub.add_parser("taint")
+    tn.add_argument("kind")
+    tn.add_argument("name")
+    tn.add_argument("taints", nargs="+",
+                    help="key[=value]:Effect to add, key[:Effect]- to remove")
+
+    rn = sub.add_parser("run")
+    rn.add_argument("name")
+    rn.add_argument("--image", required=True)
+    rn.add_argument("--replicas", type=int, default=1)
+    rn.add_argument("--restart", choices=["Always", "OnFailure", "Never"],
+                    default="Always")
+
+    rp = sub.add_parser("replace")
+    rp.add_argument("--filename", "-f", required=True)
+
+    au = sub.add_parser("autoscale")
+    au.add_argument("kind")
+    au.add_argument("name")
+    au.add_argument("--min", type=int, default=1)
+    au.add_argument("--max", type=int, required=True)
+    au.add_argument("--cpu-percent", type=int, default=80)
+
+    ce = sub.add_parser("certificate")
+    ce.add_argument("action", choices=["approve", "deny"])
+    ce.add_argument("name")
+
+    at2 = sub.add_parser("auth")
+    at2.add_argument("action", choices=["can-i"])
+    at2.add_argument("auth_verb", metavar="verb")
+    at2.add_argument("resource")
+    at2.add_argument("resource_name", nargs="?", default="")
+    at2.add_argument("--subresource", default="")
+
+    sub.add_parser("api-versions")
+    sub.add_parser("api-resources")
+    sub.add_parser("cluster-info")
+
+    cv = sub.add_parser("convert")
+    cv.add_argument("--filename", "-f", required=True)
+    cv.add_argument("--output-version", required=True)
+    cv.add_argument("--output", "-o", choices=["yaml", "json"],
+                    default="yaml")
+
+    se = sub.add_parser("set")
+    se.add_argument("action", choices=["image"])
+    se.add_argument("target", help="KIND/NAME")
+    se.add_argument("images", nargs="+", help="container=image ('*' for all)")
+
+    wt = sub.add_parser("wait")
+    wt.add_argument("kind")
+    wt.add_argument("name")
+    wt.add_argument("--for", dest="wait_for", required=True,
+                    help="delete | condition=<Type>[=<Status>]")
+    wt.add_argument("--timeout", type=float, default=30.0)
+
+    px = sub.add_parser("proxy")
+    px.add_argument("--port", type=int, default=0)
+    px.add_argument("--once", action="store_true",
+                    help="serve exactly one request then exit")
+
     sub.add_parser("version")
     return ap
 
@@ -959,7 +1385,12 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "logs": cmd_logs, "exec": cmd_exec, "attach": cmd_attach,
          "port-forward": cmd_port_forward, "patch": cmd_patch,
          "annotate": cmd_annotate, "edit": cmd_edit, "cp": cmd_cp,
-         "diff": cmd_diff}
+         "diff": cmd_diff, "taint": cmd_taint, "run": cmd_run,
+         "replace": cmd_replace, "autoscale": cmd_autoscale,
+         "certificate": cmd_certificate, "auth": cmd_auth,
+         "api-versions": cmd_api_versions, "api-resources": cmd_api_resources,
+         "cluster-info": cmd_cluster_info, "convert": cmd_convert,
+         "set": cmd_set, "wait": cmd_wait, "proxy": cmd_proxy}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

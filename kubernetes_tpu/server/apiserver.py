@@ -852,6 +852,21 @@ class APIServer:
             raise APIError(400, "BadRequest", f"cannot decode {kind}: {e}")
         if namespace is not None and scheme.is_namespaced(kind):
             obj.metadata.namespace = namespace
+        if plural == "selfsubjectaccessreviews":
+            # virtual resource: evaluated against the live authorizer,
+            # never stored (registry/authorization/selfsubjectaccessreview/
+            # rest.go:48). With no authorizer configured every request is
+            # allowed, matching this server's open-by-default posture.
+            ra = obj.spec.resource_attributes
+            if self.authorizer is None or user is None:
+                obj.status.allowed = True
+                obj.status.reason = "no authorizer configured"
+            else:
+                obj.status.allowed = self.authorizer.authorize(
+                    user, ra.verb, ra.resource, namespace=ra.namespace,
+                    name=ra.name)
+            return h._send(201, json.dumps(
+                scheme.encode_object(obj, version=gv)).encode())
         if plural == "certificatesigningrequests" and user is not None:
             # the requestor identity is SERVER-stamped from the request
             # context, never client-claimed — INCLUDING anonymous: an

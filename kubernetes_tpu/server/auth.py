@@ -316,6 +316,13 @@ class RBACAuthorizer:
     def authorize(self, user: UserInfo, verb: str, resource: str,
                   namespace: Optional[str] = None,
                   name: Optional[str] = None) -> bool:
+        # system:basic-user bootstrap grant: every authenticated subject
+        # may ask about its OWN permissions (the review evaluates as the
+        # requestor, so this grants no transitive access;
+        # plugin/pkg/auth/authorizer/rbac/bootstrappolicy/policy.go
+        # "system:basic-user" -> create selfsubjectaccessreviews)
+        if resource == "selfsubjectaccessreviews" and verb == "create":
+            return True
         if self.node_authorizer and _node_authorize(user, verb, resource,
                                                     namespace, name):
             return True

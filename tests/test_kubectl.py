@@ -315,3 +315,195 @@ class TestThreeWayApply:
                              "site").spec.replicas == 2
         finally:
             srv.stop()
+
+
+class TestRound5Verbs:
+    """taint/run/replace/autoscale/certificate/auth/can-i/discovery/
+    convert/set/wait/proxy (reference: pkg/kubectl/cmd/{taint,run,
+    replace,autoscale,certificates,auth,apiversions,apiresources,
+    clusterinfo,convert}.go, cmd/set/set_image.go, cmd/wait/)."""
+
+    def test_taint_add_and_remove(self, server, seeded):
+        rc, out = run(server, "taint", "nodes", "n1",
+                      "dedicated=gpu:NoSchedule")
+        assert rc == 0
+        node = seeded.get("nodes", None, "n1")
+        assert any(t.key == "dedicated" and t.value == "gpu"
+                   and t.effect == "NoSchedule" for t in node.spec.taints)
+        # same key+effect replaces, not duplicates
+        rc, _ = run(server, "taint", "nodes", "n1",
+                    "dedicated=tpu:NoSchedule")
+        assert rc == 0
+        node = seeded.get("nodes", None, "n1")
+        assert [t.value for t in node.spec.taints
+                if t.key == "dedicated"] == ["tpu"]
+        rc, _ = run(server, "taint", "nodes", "n1", "dedicated:NoSchedule-")
+        assert rc == 0
+        node = seeded.get("nodes", None, "n1")
+        assert not any(t.key == "dedicated" for t in node.spec.taints)
+
+    def test_taint_remove_missing_fails(self, server, seeded):
+        with pytest.raises(SystemExit):
+            run(server, "taint", "nodes", "n1", "nosuch-")
+
+    def test_run_deployment_and_pod(self, server, seeded):
+        rc, out = run(server, "run", "web", "--image", "nginx",
+                      "--replicas", "3")
+        assert rc == 0 and "deployment.apps/web created" in out
+        dep = seeded.get("deployments", "default", "web")
+        assert dep.spec.replicas == 3
+        assert dep.spec.template.spec.containers[0].image == "nginx"
+        assert dep.spec.selector.match_labels == {"run": "web"}
+        rc, out = run(server, "run", "one-off", "--image", "busybox",
+                      "--restart", "Never")
+        assert rc == 0 and "pod/one-off created" in out
+        pod = seeded.get("pods", "default", "one-off")
+        # a run-once pod must not restart-loop in the kubelet
+        assert pod.spec.restart_policy == "Never"
+
+    def test_taint_missing_effect_is_client_error(self, server, seeded):
+        with pytest.raises(SystemExit):
+            run(server, "taint", "nodes", "n1", "dedicated=gpu")
+
+    def test_replace(self, server, seeded, tmp_path):
+        rc, _ = run(server, "run", "web", "--image", "nginx")
+        assert rc == 0
+        import yaml
+
+        from kubernetes_tpu.api import scheme as sch
+        dep = seeded.get("deployments", "default", "web")
+        doc = sch.encode_object(dep)
+        doc["spec"]["replicas"] = 7
+        p = tmp_path / "dep.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        rc, out = run(server, "replace", "-f", str(p))
+        assert rc == 0 and "replaced" in out
+        assert seeded.get("deployments", "default", "web").spec.replicas == 7
+
+    def test_autoscale(self, server, seeded):
+        rc, _ = run(server, "run", "web", "--image", "nginx")
+        assert rc == 0
+        rc, out = run(server, "autoscale", "deployment", "web",
+                      "--min", "2", "--max", "10", "--cpu-percent", "70")
+        assert rc == 0
+        hpa = seeded.get("horizontalpodautoscalers", "default", "web")
+        assert hpa.spec.min_replicas == 2
+        assert hpa.spec.max_replicas == 10
+        assert hpa.spec.target_cpu_utilization_percentage == 70
+        assert hpa.spec.scale_target_ref.kind == "Deployment"
+
+    def test_certificate_approve_deny(self, server, seeded):
+        csr = api.CertificateSigningRequest(
+            metadata=api.ObjectMeta(name="node-csr"))
+        seeded.create("certificatesigningrequests", csr)
+        rc, out = run(server, "certificate", "approve", "node-csr")
+        assert rc == 0
+        got = seeded.get("certificatesigningrequests", None, "node-csr")
+        assert got.approved
+        rc, _ = run(server, "certificate", "deny", "node-csr")
+        got = seeded.get("certificatesigningrequests", None, "node-csr")
+        assert any(t == "Denied" for t, _ in got.status.conditions)
+
+    def test_auth_can_i_open_server(self, server, seeded):
+        # no authorizer configured -> everything allowed
+        rc, out = run(server, "auth", "can-i", "create", "pods")
+        assert rc == 0 and out.strip() == "yes"
+
+    def test_auth_can_i_rbac(self):
+        """can-i answers from the live authorizer: reader token may get
+        pods but not create them; exit code carries the verdict
+        (cani.go RunAccessCheck)."""
+        from kubernetes_tpu.server import APIServer, AdmissionChain
+        from kubernetes_tpu.server.auth import (AuthenticatorChain,
+                                                PolicyRule, RBACAuthorizer,
+                                                RoleBinding, UserInfo)
+        from kubernetes_tpu.runtime.store import ObjectStore
+
+        authn = AuthenticatorChain(tokens={"rtok": UserInfo("reader")})
+        authz = RBACAuthorizer(bindings=[RoleBinding("reader", [
+            PolicyRule(["get", "list"], ["pods"])])])
+        srv = APIServer(ObjectStore(), admission=AdmissionChain(),
+                        authenticator=authn, authorizer=authz).start()
+        try:
+            out = io.StringIO()
+            rc = main(["--server", srv.url, "--token", "rtok",
+                       "auth", "can-i", "list", "pods"], out=out)
+            assert rc == 0 and out.getvalue().strip() == "yes"
+            out = io.StringIO()
+            rc = main(["--server", srv.url, "--token", "rtok",
+                       "auth", "can-i", "create", "pods"], out=out)
+            assert rc == 1 and out.getvalue().strip() == "no"
+        finally:
+            srv.stop()
+
+    def test_api_versions_and_resources(self, server, seeded):
+        rc, out = run(server, "api-versions")
+        assert rc == 0
+        lines = out.strip().splitlines()
+        assert "v1" in lines and "apps/v1" in lines
+        rc, out = run(server, "api-resources")
+        assert rc == 0
+        assert "pods" in out and "deployments" in out
+        # namespaced column present
+        assert "False" in out and "True" in out
+
+    def test_cluster_info(self, server, seeded):
+        svc = api.Service(metadata=api.ObjectMeta(
+            name="kube-dns", namespace="kube-system",
+            labels={"kubernetes.io/cluster-service": "true"}))
+        seeded.create("services", svc, namespace="kube-system")
+        rc, out = run(server, "cluster-info")
+        assert rc == 0
+        assert "Kubernetes master is running at" in out
+        assert "kube-dns is running at" in out
+
+    def test_convert_deployment_to_v1beta1(self, server, tmp_path):
+        import yaml
+        doc = {"apiVersion": "apps/v1", "kind": "Deployment",
+               "metadata": {"name": "site"},
+               "spec": {"replicas": 2,
+                        "selector": {"matchLabels": {"app": "site"}},
+                        "template": {"metadata": {
+                            "labels": {"app": "site"}}}}}
+        p = tmp_path / "dep.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        rc, out = run(server, "convert", "-f", str(p),
+                      "--output-version", "apps/v1beta1")
+        assert rc == 0
+        got = yaml.safe_load(out.split("---")[0])
+        assert got["apiVersion"] == "apps/v1beta1"
+
+    def test_set_image(self, server, seeded):
+        rc, _ = run(server, "run", "web", "--image", "nginx:1.0")
+        assert rc == 0
+        rc, out = run(server, "set", "image", "deployment/web",
+                      "web=nginx:2.0")
+        assert rc == 0
+        dep = seeded.get("deployments", "default", "web")
+        assert dep.spec.template.spec.containers[0].image == "nginx:2.0"
+
+    def test_wait_for_condition_and_delete(self, server, seeded):
+        rc, out = run(server, "wait", "pods", "p1",
+                      "--for", "condition=Ready", "--timeout", "2")
+        assert rc == 0 and "condition met" in out
+        rc, out = run(server, "wait", "pods", "p1",
+                      "--for", "condition=Bogus", "--timeout", "0.3")
+        assert rc == 1
+        seeded.delete("pods", "default", "p1")
+        rc, out = run(server, "wait", "pods", "p1",
+                      "--for", "delete", "--timeout", "2")
+        assert rc == 0
+
+    def test_proxy_once(self, server, seeded):
+        import json as _json
+        import re
+        import urllib.request
+        out = io.StringIO()
+        rc = main(["--server", server.url, "proxy", "--once"], out=out)
+        assert rc == 0
+        m = re.search(r"127\.0\.0\.1:(\d+)", out.getvalue())
+        assert m
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{m.group(1)}/api/v1/pods") as resp:
+            body = _json.loads(resp.read())
+        assert any(i["metadata"]["name"] == "p1" for i in body["items"])
