@@ -810,9 +810,12 @@ def cmd_taint(client, args, out):
                 raise SystemExit(f"error: taint {key!r} not found")
         else:
             kv, sep, effect = spec.rpartition(":")
-            if not sep or not effect or ":" in effect or "=" in effect:
+            if not sep or effect not in (api.NO_SCHEDULE,
+                                         api.PREFER_NO_SCHEDULE,
+                                         api.NO_EXECUTE):
                 raise SystemExit(
-                    f"error: taint {spec!r} must be key[=value]:Effect")
+                    f"error: taint {spec!r} must be key[=value]:Effect "
+                    f"(NoSchedule|PreferNoSchedule|NoExecute)")
             key, _, value = kv.partition("=")
             # replace an existing taint with the same key+effect
             # (reference updates in place rather than duplicating)
@@ -848,12 +851,8 @@ def cmd_run(client, args, out):
             template=tmpl))
         client.create("jobs", obj)
         out.write(f"job.batch/{args.name} created\n")
-    else:  # Never
-        pod = api.Pod(metadata=meta,
-                      spec=api.PodSpec(restart_policy="Never",
-                                       containers=[api.Container(
-                                           name=args.name,
-                                           image=args.image)]))
+    else:  # Never — same template, just not wrapped in a controller
+        pod = api.Pod(metadata=meta, spec=tmpl.spec)
         client.create("pods", pod)
         out.write(f"pod/{args.name} created\n")
 

@@ -30,7 +30,7 @@ def pem_arg(v):
 class APIStatusError(Exception):
     def __init__(self, code: int, reason: str, message: str):
         super().__init__(f"{code} {reason}: {message}")
-        self.code, self.reason = code, reason
+        self.code, self.reason, self.message = code, reason, message
 
 
 class RESTClient:

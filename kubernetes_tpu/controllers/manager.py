@@ -65,12 +65,24 @@ class ControllerManager:
     def __init__(self, store, controllers: Optional[List[type]] = None,
                  identity: str = "controller-manager",
                  leader_elect: bool = False, cloud=None,
-                 cluster_cidr: str = ""):
+                 cluster_cidr: str = "", metrics_scraper: bool = False,
+                 kubelet_client_ctx=None):
         self.store = store
         self.controllers: Dict[str, Controller] = {}
         for cls in (controllers if controllers is not None
                     else default_controllers()):
             c = cls(store)
+            self.controllers[c.name] = c
+        if metrics_scraper:
+            # the metrics-server runs OUTSIDE kube-controller-manager in
+            # the reference (a separate deployment scraping
+            # /stats/summary); opt-in here so embedded clusters can get
+            # the full kubelet-stats -> PodMetrics -> HPA/top pipeline
+            # from one constructor. TLS kubelets need the apiserver's
+            # kubelet-client credential as kubelet_client_ctx.
+            from .metricsserver import MetricsServerController
+            c = MetricsServerController(store,
+                                        ssl_context=kubelet_client_ctx)
             self.controllers[c.name] = c
         # cloud-dependent loops start only when a provider is configured
         # (controllermanager.go gates these on --cloud-provider)

@@ -1,0 +1,103 @@
+"""Metrics server: kubelet /stats/summary -> PodMetrics API objects.
+
+Reference: the metrics pipeline the 1.11 tree consumes — kubelets
+aggregate cgroup stats into the Summary API (pkg/kubelet/server/stats/
+summary.go, apis/stats/v1alpha1) and the out-of-tree metrics-server
+scrapes every node's /stats/summary, publishing PodMetrics under
+metrics.k8s.io for the HPA's REST metrics client
+(pkg/controller/podautoscaler/metrics/) and kubectl top. This
+controller is that scraper: per node key, GET the kubelet's summary
+and upsert one PodMetrics per pod (usage: cpu millicores, memory
+bytes — the units podautoscaler.py and cli/kubectl.py cmd_top read).
+
+Nodes without a published daemon endpoint (no kubelet server) are
+skipped. TLS clusters pass the scraper a client SSL context holding
+the apiserver's kubelet-client identity, the same credential the
+apiserver's exec/log proxy presents.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..api import resources as res
+from ..api import types as api
+from .base import Controller
+
+
+class MetricsServerController(Controller):
+    name = "metrics-server"
+
+    def __init__(self, store, ssl_context=None, timeout: float = 5.0):
+        super().__init__(store)
+        self.ssl_context = ssl_context
+        self.timeout = timeout
+        self.informer("nodes")
+        # metrics follow their pod's lifetime: a deleted pod's
+        # PodMetrics goes with it (the GC skips podmetrics — they have
+        # no ownerReferences — so this controller owns the cleanup).
+        # Event handlers only enqueue; the mutation happens in sync()
+        # like every other controller (no store writes during dispatch).
+        self.informer("pods", on_add=lambda o: None,
+                      on_update=lambda o, n: None,
+                      on_delete=lambda p: self.enqueue(
+                          f"pod-deleted:{p.metadata.namespace}"
+                          f"/{p.metadata.name}"))
+
+    def resync(self):
+        for node in self.store.list("nodes"):
+            self.enqueue(node)
+
+    def _scrape(self, host: str, port: int) -> dict:
+        scheme_ = "https" if self.ssl_context is not None else "http"
+        url = f"{scheme_}://{host}:{port}/stats/summary"
+        with urllib.request.urlopen(url, timeout=self.timeout,
+                                    context=self.ssl_context) as resp:
+            return json.loads(resp.read())
+
+    def sync(self, key: str):
+        if key.startswith("pod-deleted:"):
+            ns, pod_name = key[len("pod-deleted:"):].split("/", 1)
+            if self.store.get("podmetrics", ns, pod_name) is not None:
+                self.store.delete("podmetrics", ns, pod_name)
+            return
+        _, name = key.split("/", 1)
+        node = (self.store.get("nodes", "default", name)
+                or self.store.get("nodes", "", name))
+        if node is None or not node.status.kubelet_port:
+            return
+        host = next((a.address for a in node.status.addresses if a.address),
+                    "127.0.0.1")
+        summary = self._scrape(host, node.status.kubelet_port)
+        scraped = set()
+        for pod_doc in summary.get("pods", []):
+            ref = pod_doc.get("podRef", {})
+            ns, pod_name = ref.get("namespace", "default"), ref.get("name")
+            if not pod_name:
+                continue
+            scraped.add((ns, pod_name))
+            usage = {
+                res.CPU: int(pod_doc.get("cpu", {})
+                             .get("usageNanoCores", 0)) // 1_000_000,
+                res.MEMORY: int(pod_doc.get("memory", {})
+                                .get("workingSetBytes", 0)),
+            }
+            cur = self.store.get("podmetrics", ns, pod_name)
+            if cur is None:
+                self.store.create("podmetrics", api.PodMetrics(
+                    metadata=api.ObjectMeta(name=pod_name, namespace=ns),
+                    usage=usage))
+            elif cur.usage != usage:
+                cur.usage = usage
+                self.store.update("podmetrics", cur)
+        # stale sweep: metrics whose pod is gone, or whose pod is bound
+        # to THIS node but absent from this scrape, are dropped (the
+        # reference metrics-server reports only currently-scraped pods)
+        for pm in self.store.list("podmetrics"):
+            ns, pm_name = pm.metadata.namespace, pm.metadata.name
+            if (ns, pm_name) in scraped:
+                continue
+            pod = self.store.get("pods", ns, pm_name)
+            if pod is None or pod.spec.node_name == name:
+                self.store.delete("podmetrics", ns, pm_name)

@@ -33,6 +33,11 @@ class ContainerState:
     files: Dict[str, str] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
     finished_at: Optional[float] = None  # when it last exited (if known)
+    # measured usage — what cadvisor reads from cgroups in the reference
+    # (pkg/kubelet/cadvisor); here a seam stamped by set_usage (hollow
+    # nodes / tests simulate load with it)
+    cpu_millicores: int = 0
+    memory_bytes: int = 0
 
 
 class FakeRuntime:
@@ -237,6 +242,25 @@ class FakeRuntime:
     def pod_server(self, pod_uid: str, port: int):
         with self._lock:
             return self._pod_servers.get((pod_uid, port))
+
+    # -- stats (the cadvisor seam) ---------------------------------------------
+
+    def set_usage(self, pod_uid: str, name: str, cpu_millicores: int,
+                  memory_bytes: int):
+        """Stamp measured usage for a container — the hollow analog of
+        cgroup accounting (reference pkg/kubelet/cadvisor reads real
+        cgroups; kubemark's hollow kubelet returns canned stats)."""
+        with self._lock:
+            st = self.containers.get((pod_uid, name))
+            if st is not None:
+                st.cpu_millicores = int(cpu_millicores)
+                st.memory_bytes = int(memory_bytes)
+
+    def container_stats(self, pod_uid: str) -> List["ContainerState"]:
+        """RUNNING containers of a pod, for the /stats/summary builder."""
+        with self._lock:
+            return [st for (uid, _), st in self.containers.items()
+                    if uid == pod_uid and st.state == RUNNING]
 
     # -- fault injection (tests / chaos harness) -------------------------------
 

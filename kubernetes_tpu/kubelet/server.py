@@ -117,6 +117,11 @@ class KubeletServer:
         if parts and parts[0] in ("containerLogs", "exec", "attach",
                                   "portForward") and not self._authorized(h):
             return h._send(403, b"forbidden", "text/plain")
+        if parts == ["stats", "summary"] and method == "GET":
+            # server_stats.go + apis/stats/v1alpha1 Summary: node-level
+            # aggregates plus per-pod, per-container cpu/memory. Usage
+            # comes from the runtime's cadvisor seam (set_usage).
+            return h._send(200, json.dumps(self._summary()).encode())
         if parts == ["pods"] and method == "GET":
             pods = [p for p in self.kubelet.store.list("pods")
                     if p.spec.node_name == self.kubelet.node_name]
@@ -216,6 +221,40 @@ class KubeletServer:
             return h._send(200, json.dumps(
                 {"host": "127.0.0.1", "port": relay_port}).encode())
         h._send(404, b"not found", "text/plain")
+
+    def _summary(self) -> dict:
+        """Summary API document (apis/stats/v1alpha1/types.go shapes:
+        usageNanoCores / workingSetBytes; podRef name/namespace/uid)."""
+        pods = [p for p in self.kubelet.store.list("pods")
+                if p.spec.node_name == self.kubelet.node_name]
+        pod_docs = []
+        node_cpu_nanos = 0
+        node_mem = 0
+        for p in pods:
+            containers = []
+            cpu_nanos = 0
+            mem = 0
+            for st in self.kubelet.runtime.container_stats(p.metadata.uid):
+                c_nanos = st.cpu_millicores * 1_000_000
+                containers.append({
+                    "name": st.name,
+                    "cpu": {"usageNanoCores": c_nanos},
+                    "memory": {"workingSetBytes": st.memory_bytes}})
+                cpu_nanos += c_nanos
+                mem += st.memory_bytes
+            pod_docs.append({
+                "podRef": {"name": p.metadata.name,
+                           "namespace": p.metadata.namespace,
+                           "uid": p.metadata.uid},
+                "cpu": {"usageNanoCores": cpu_nanos},
+                "memory": {"workingSetBytes": mem},
+                "containers": containers})
+            node_cpu_nanos += cpu_nanos
+            node_mem += mem
+        return {"node": {"nodeName": self.kubelet.node_name,
+                         "cpu": {"usageNanoCores": node_cpu_nanos},
+                         "memory": {"workingSetBytes": node_mem}},
+                "pods": pod_docs}
 
     def _start_relay(self, backend) -> int:
         """One-connection TCP relay to the pod backend; closes after the

@@ -199,3 +199,87 @@ class TestKubeletServerTLS:
         finally:
             node.stop()
             srv.stop()
+
+
+class TestStatsSummaryAndMetricsServer:
+    """The metrics pipeline: runtime usage (cadvisor seam) ->
+    /stats/summary (server/stats/summary.go, apis/stats/v1alpha1) ->
+    metrics-server scrape -> PodMetrics -> kubectl top / HPA."""
+
+    def setup_method(self):
+        self.store = ObjectStore()
+        self.node = HollowNode(self.store, "n1", serve=True)
+        self.base = f"http://127.0.0.1:{self.node.kubelet.server.port}"
+        self.pod = make_pod("m1", cpu="100m", node_name="n1")
+        self.store.create("pods", self.pod)
+        self.node.kubelet.sync_once()
+
+    def teardown_method(self):
+        self.node.stop()
+
+    def _stamp_usage(self, cpu_m=250, mem=64 << 20):
+        cname = self.pod.spec.containers[0].name
+        self.node.runtime.set_usage(self.pod.metadata.uid, cname,
+                                    cpu_m, mem)
+
+    def test_stats_summary_document(self):
+        self._stamp_usage()
+        code, body = _get(f"{self.base}/stats/summary")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["node"]["nodeName"] == "n1"
+        assert doc["node"]["cpu"]["usageNanoCores"] == 250 * 1_000_000
+        (p,) = doc["pods"]
+        assert p["podRef"]["name"] == "m1"
+        assert p["memory"]["workingSetBytes"] == 64 << 20
+        assert p["containers"][0]["cpu"]["usageNanoCores"] == 250_000_000
+
+    def test_metrics_server_publishes_podmetrics(self):
+        from kubernetes_tpu.api import resources as res
+        from kubernetes_tpu.controllers.metricsserver import \
+            MetricsServerController
+
+        self._stamp_usage(cpu_m=300, mem=128 << 20)
+        ms = MetricsServerController(self.store)
+        ms.resync()
+        ms.sync_all()
+        pm = self.store.get("podmetrics", "default", "m1")
+        assert pm is not None
+        assert pm.usage[res.CPU] == 300
+        assert pm.usage[res.MEMORY] == 128 << 20
+        # usage changes flow through on re-scrape (update path)
+        self._stamp_usage(cpu_m=700, mem=128 << 20)
+        ms.resync()
+        ms.sync_all()
+        assert self.store.get("podmetrics", "default",
+                              "m1").usage[res.CPU] == 700
+        # metrics follow the pod's lifetime: delete pod -> metric gone
+        self.store.delete("pods", "default", "m1")
+        ms.sync_all()
+        assert self.store.get("podmetrics", "default", "m1") is None
+
+    def test_kubectl_top_reads_scraped_metrics(self):
+        import io
+
+        from kubernetes_tpu.controllers.metricsserver import \
+            MetricsServerController
+
+        self._stamp_usage(cpu_m=450, mem=32 << 20)
+        ms = MetricsServerController(self.store)
+        ms.resync()
+        ms.sync_all()
+        srv = APIServer(self.store).start()
+        try:
+            out = io.StringIO()
+            rc = kubectl.main(["--server", srv.url, "top", "pods"], out=out)
+            assert rc == 0
+            line = next(ln for ln in out.getvalue().splitlines()
+                        if ln.startswith("m1"))
+            assert "450" in line and "32" in line
+            out = io.StringIO()
+            rc = kubectl.main(["--server", srv.url, "top", "nodes"], out=out)
+            assert rc == 0
+            assert any(ln.startswith("n1") and "450" in ln
+                       for ln in out.getvalue().splitlines())
+        finally:
+            srv.stop()
