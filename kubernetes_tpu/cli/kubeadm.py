@@ -14,6 +14,7 @@ Run as: python -m kubernetes_tpu.cli.kubeadm init [--data-dir D]
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import threading
 import time
@@ -603,6 +604,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="TLS-bootstrap: obtain a kubelet client "
                              "cert via CSR before joining")
     p_join.add_argument("--once", action="store_true")
+    p_tok = sub.add_parser("token",
+                           help="manage bootstrap tokens on a running "
+                                "cluster")
+    p_tok.add_argument("action", choices=["create", "list", "delete"])
+    p_tok.add_argument("target", nargs="?", default="",
+                       help="delete: token or token-id")
+    p_tok.add_argument("--server", required=True)
+    p_tok.add_argument("--token", default=None,
+                       help="admin credential for the API")
+    p_tok.add_argument("--ttl", type=float, default=86400.0,
+                       help="seconds until expiry (0 = never)")
+    p_reset = sub.add_parser("reset",
+                             help="wipe a cluster data-dir")
+    p_reset.add_argument("--data-dir", required=True)
+    p_reset.add_argument("--force", action="store_true")
+    sub.add_parser("version")
     return ap
 
 
@@ -645,10 +662,94 @@ def cmd_upgrade(args) -> int:
             close()
 
 
+def cmd_token(args) -> int:
+    """kubeadm token create/list/delete (cmd/kubeadm/app/cmd/token.go)
+    against a RUNNING cluster's API — bootstrap tokens are kube-system
+    Secrets (phases/bootstraptoken/node/token.go), so every subcommand
+    is ordinary Secret CRUD the BootstrapSigner/TokenCleaner observe."""
+    from ..client.rest import APIStatusError, RESTClient
+    from ..controllers import bootstrap as bt
+
+    client = RESTClient(args.server, token=args.token)
+    try:
+        if args.action == "create":
+            tid, tsec, wire = bt.new_bootstrap_token()
+            sec = bt.make_token_secret(
+                tid, tsec, ttl_seconds=args.ttl if args.ttl > 0 else None)
+            client.create("secrets", sec, namespace=bt.TOKEN_NAMESPACE)
+            print(wire)
+            return 0
+        if args.action == "list":
+            secs, _ = client.list("secrets", bt.TOKEN_NAMESPACE)
+            now = time.time()
+            print("TOKEN\t\t\tTTL\tUSAGES")
+            for s in secs:
+                if s.type != bt.TOKEN_SECRET_TYPE:
+                    continue
+                tid = s.data.get("token-id", "?")
+                exp = bt.parse_expiration(s.data.get("expiration"))
+                ttl = ("<forever>" if exp is None else
+                       f"{max(0, int(exp - now))}s")
+                usages = ",".join(sorted(
+                    k[len("usage-bootstrap-"):] for k, v in s.data.items()
+                    if k.startswith("usage-bootstrap-") and v == "true"))
+                print(f"{tid}.{'*' * 16}\t{ttl}\t{usages}")
+            return 0
+        # delete
+        if not args.target:
+            print("error: token delete needs a token or token-id",
+                  file=sys.stderr)
+            return 1
+        tid = args.target.split(".")[0]
+        name = (tid if tid.startswith(bt.TOKEN_SECRET_PREFIX)
+                else bt.TOKEN_SECRET_PREFIX + tid)
+        client.delete("secrets", bt.TOKEN_NAMESPACE, name)
+        print(f"bootstrap token {tid!r} deleted")
+        return 0
+    except APIStatusError as e:
+        if e.code == 404:
+            print(f"error: token {args.target!r} not found",
+                  file=sys.stderr)
+        else:
+            print(f"error from server: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_reset(args) -> int:
+    """kubeadm reset (cmd/kubeadm/app/cmd/reset.go): wipe the local
+    control-plane state this binary created — here, the durable
+    data-dir (WAL + snapshots). Refuses without --force, like the
+    reference's interactive confirmation."""
+    import shutil
+
+    if not os.path.isdir(args.data_dir):
+        print(f"error: {args.data_dir!r} is not a directory",
+              file=sys.stderr)
+        return 1
+    marker = [f for f in os.listdir(args.data_dir)
+              if f.startswith(("wal", "snapshot"))]
+    if not marker:
+        print(f"error: {args.data_dir!r} does not look like a cluster "
+              f"data-dir (no wal/snapshot files); not removing",
+              file=sys.stderr)
+        return 1
+    if not args.force:
+        print("error: pass --force to wipe the cluster state",
+              file=sys.stderr)
+        return 1
+    shutil.rmtree(args.data_dir)
+    print(f"cluster state at {args.data_dir} removed")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == "version":
+        print(f"kubeadm version: {CLUSTER_VERSION}")
+        return 0
     return {"init": cmd_init, "join": cmd_join, "phase": cmd_phase,
-            "upgrade": cmd_upgrade}[args.cmd](args)
+            "upgrade": cmd_upgrade, "token": cmd_token,
+            "reset": cmd_reset}[args.cmd](args)
 
 
 if __name__ == "__main__":

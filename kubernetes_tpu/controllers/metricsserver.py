@@ -48,6 +48,14 @@ class MetricsServerController(Controller):
     def resync(self):
         for node in self.store.list("nodes"):
             self.enqueue(node)
+        # one GLOBAL orphan sweep per resync period (not per node):
+        # metrics whose pod vanished while this controller wasn't
+        # watching (restart, missed event) go through the same
+        # pod-deleted sync path the informer uses
+        for pm in self.store.list("podmetrics"):
+            ns, pm_name = pm.metadata.namespace, pm.metadata.name
+            if self.store.get("pods", ns, pm_name) is None:
+                self.enqueue(f"pod-deleted:{ns}/{pm_name}")
 
     def _scrape(self, host: str, port: int) -> dict:
         scheme_ = "https" if self.ssl_context is not None else "http"
@@ -62,14 +70,13 @@ class MetricsServerController(Controller):
             if self.store.get("podmetrics", ns, pod_name) is not None:
                 self.store.delete("podmetrics", ns, pod_name)
             return
+        from ..utils.net import node_daemon_endpoint
+
         _, name = key.split("/", 1)
-        node = (self.store.get("nodes", "default", name)
-                or self.store.get("nodes", "", name))
-        if node is None or not node.status.kubelet_port:
+        ep = node_daemon_endpoint(self.store, name)
+        if ep is None:
             return
-        host = next((a.address for a in node.status.addresses if a.address),
-                    "127.0.0.1")
-        summary = self._scrape(host, node.status.kubelet_port)
+        summary = self._scrape(*ep)
         scraped = set()
         for pod_doc in summary.get("pods", []):
             ref = pod_doc.get("podRef", {})
@@ -91,13 +98,10 @@ class MetricsServerController(Controller):
             elif cur.usage != usage:
                 cur.usage = usage
                 self.store.update("podmetrics", cur)
-        # stale sweep: metrics whose pod is gone, or whose pod is bound
-        # to THIS node but absent from this scrape, are dropped (the
-        # reference metrics-server reports only currently-scraped pods)
-        for pm in self.store.list("podmetrics"):
-            ns, pm_name = pm.metadata.namespace, pm.metadata.name
-            if (ns, pm_name) in scraped:
-                continue
-            pod = self.store.get("pods", ns, pm_name)
-            if pod is None or pod.spec.node_name == name:
-                self.store.delete("podmetrics", ns, pm_name)
+        # No per-node stale sweep: the summary reports EVERY pod bound
+        # to the node (stopped containers scrape as zero usage), so
+        # `scraped` covers this node's pods; deleted pods are cleaned by
+        # the pod-delete informer and the resync orphan sweep. Scanning
+        # cluster-wide podmetrics here would cost O(nodes x podmetrics)
+        # store reads per resync round at kubemark scale.
+        del scraped

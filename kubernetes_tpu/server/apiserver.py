@@ -525,17 +525,16 @@ class APIServer:
         if not pod.spec.node_name:
             raise APIError(400, "BadRequest",
                            f"pod {name!r} is not scheduled to a node")
-        node = (self.store.get("nodes", "", pod.spec.node_name)
-                or self.store.get("nodes", "default", pod.spec.node_name))
-        if node is None or not node.status.kubelet_port:
+        from ..utils.net import node_daemon_endpoint
+
+        ep = node_daemon_endpoint(self.store, pod.spec.node_name)
+        if ep is None:
             raise APIError(400, "BadRequest",
                            f"node {pod.spec.node_name!r} does not expose "
                            f"a kubelet endpoint")
-        host = next((a.address for a in node.status.addresses if a.address),
-                    "127.0.0.1")
         container = (pod.spec.containers[0].name
                      if pod.spec.containers else "")
-        return pod, host, node.status.kubelet_port, container
+        return pod, ep[0], ep[1], container
 
     def _kubelet_proxy(self, h, method, host, port, path, body=None,
                        timeout: float = 10.0):

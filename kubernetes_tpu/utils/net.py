@@ -59,3 +59,18 @@ def relay_once(lsock: socket.socket, backend, accept_timeout=None) -> None:
         return
     lsock.close()
     relay(conn, backend)
+
+
+def node_daemon_endpoint(store, name):
+    """(host, kubelet_port) for a Node's serving endpoint, or None if
+    the node is absent or publishes no daemon endpoint — ONE resolution
+    idiom shared by the apiserver's exec/log proxy and the
+    metrics-server scraper (the reference reads
+    node.Status.DaemonEndpoints.KubeletEndpoint)."""
+    node = (store.get("nodes", "", name)
+            or store.get("nodes", "default", name))
+    if node is None or not node.status.kubelet_port:
+        return None
+    host = next((a.address for a in node.status.addresses if a.address),
+                "127.0.0.1")
+    return host, node.status.kubelet_port

@@ -145,3 +145,85 @@ class TestClusterRoleAggregation:
         ctrl.sync_all()
         admin = store.get("clusterroles", "", "admin")
         assert admin.rules == []
+
+
+class TestKubeadmTokenCLI:
+    """kubeadm token create/list/delete + reset + version
+    (cmd/kubeadm/app/cmd/token.go, reset.go)."""
+
+    def _cluster(self):
+        from kubernetes_tpu.server import APIServer
+
+        store = ObjectStore()
+        srv = APIServer(store).start()
+        return store, srv
+
+    def _kubeadm(self, *argv):
+        import contextlib
+        import io
+
+        from kubernetes_tpu.cli.kubeadm import main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(list(argv))
+        return rc, buf.getvalue()
+
+    def test_token_create_list_delete(self):
+        store, srv = self._cluster()
+        try:
+            rc, out = self._kubeadm("token", "create", "--server", srv.url)
+            assert rc == 0
+            wire = out.strip()
+            tid, _, tsec = wire.partition(".")
+            assert len(tid) == 6 and len(tsec) == 16
+            # the created secret is a real bootstrap token the
+            # authenticator resolves
+            assert bt.lookup_token(store, wire) is not None
+            rc, out = self._kubeadm("token", "list", "--server", srv.url)
+            assert rc == 0 and tid in out and "authentication" in out
+            # the secret itself never leaks through list
+            assert tsec not in out
+            rc, out = self._kubeadm("token", "delete", wire,
+                                    "--server", srv.url)
+            assert rc == 0
+            assert bt.lookup_token(store, wire) is None
+        finally:
+            srv.stop()
+
+    def test_token_create_respects_ttl_zero(self):
+        store, srv = self._cluster()
+        try:
+            rc, out = self._kubeadm("token", "create", "--server", srv.url,
+                                    "--ttl", "0")
+            assert rc == 0
+            sec = store.get("secrets", bt.TOKEN_NAMESPACE,
+                            bt.TOKEN_SECRET_PREFIX
+                            + out.strip().split(".")[0])
+            assert "expiration" not in sec.data  # never expires
+        finally:
+            srv.stop()
+
+    def test_reset_wipes_data_dir(self, tmp_path):
+        d = tmp_path / "cluster"
+        d.mkdir()
+        (d / "wal").write_bytes(b"x")
+        (d / "snapshot").write_bytes(b"y")
+        rc, _ = self._kubeadm("reset", "--data-dir", str(d))
+        assert rc == 1  # refuses without --force
+        assert d.exists()
+        rc, out = self._kubeadm("reset", "--data-dir", str(d), "--force")
+        assert rc == 0 and not d.exists()
+
+    def test_reset_refuses_non_cluster_dir(self, tmp_path):
+        d = tmp_path / "home"
+        d.mkdir()
+        (d / "precious.txt").write_text("do not delete")
+        rc, _ = self._kubeadm("reset", "--data-dir", str(d), "--force")
+        assert rc == 1 and (d / "precious.txt").exists()
+
+    def test_version(self):
+        from kubernetes_tpu.cli.kubeadm import CLUSTER_VERSION
+
+        rc, out = self._kubeadm("version")
+        assert rc == 0 and CLUSTER_VERSION in out
