@@ -272,6 +272,9 @@ class PodStatus:
     nominated_node_name: str = ""
     conditions: List[Tuple[str, str]] = field(default_factory=list)
     start_time: Optional[float] = None
+    # stamped by the kubelet from pod_qos_class (reference: qos.go via
+    # kubelet status manager; PodStatus.QOSClass)
+    qos_class: str = ""
 
 
 @dataclass
@@ -1322,13 +1325,46 @@ def is_pod_active(pod: Pod) -> bool:
             and pod.metadata.deletion_timestamp is None)
 
 
+QOS_GUARANTEED = "Guaranteed"
+QOS_BURSTABLE = "Burstable"
+QOS_BEST_EFFORT = "BestEffort"
+
+
+def pod_qos_class(pod: Pod) -> str:
+    """The pod's QoS class (pkg/apis/core/v1/helper/qos/qos.go
+    GetPodQOS): Guaranteed iff every container sets cpu+memory limits
+    with requests either absent or equal to the limits (absent requests
+    default to limits); BestEffort iff nothing sets any request or
+    limit; Burstable otherwise. Drives eviction ranking and the
+    kubelet's cgroup-tier analog."""
+    requests: Dict[str, int] = {}
+    limits: Dict[str, int] = {}
+    guaranteed = True
+    # qos.go iterates init and regular containers alike
+    for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+        for k, v in c.resources.requests.items():
+            if k in (res.CPU, res.MEMORY) and v:
+                requests[k] = requests.get(k, 0) + v
+        lim_set = set()
+        for k, v in c.resources.limits.items():
+            if k in (res.CPU, res.MEMORY) and v:
+                limits[k] = limits.get(k, 0) + v
+                lim_set.add(k)
+        if lim_set != {res.CPU, res.MEMORY}:
+            guaranteed = False
+        for k in (res.CPU, res.MEMORY):
+            req = c.resources.requests.get(k)
+            if req and c.resources.limits.get(k) != req:
+                guaranteed = False
+    if not requests and not limits:
+        return QOS_BEST_EFFORT
+    return QOS_GUARANTEED if guaranteed else QOS_BURSTABLE
+
+
 def is_best_effort(pod: Pod) -> bool:
     """QoS == BestEffort: no container has any requests or limits
     (reference: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS)."""
-    for c in pod.spec.containers:
-        if c.resources.requests or c.resources.limits:
-            return False
-    return True
+    return pod_qos_class(pod) == QOS_BEST_EFFORT
 
 
 def get_container_ports(*pods: Pod) -> List[ContainerPort]:

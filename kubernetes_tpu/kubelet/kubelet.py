@@ -391,9 +391,12 @@ class Kubelet:
         ready = ready and readiness_gate
         new_conds = [("PodScheduled", "True"),
                      ("Ready", "True" if ready else "False")]
-        if phase != pod.status.phase or new_conds != pod.status.conditions:
+        qos = api.pod_qos_class(pod)
+        if (phase != pod.status.phase or new_conds != pod.status.conditions
+                or qos != pod.status.qos_class):
             pod.status.phase = phase
             pod.status.conditions = new_conds
+            pod.status.qos_class = qos
             if pod.status.start_time is None:
                 pod.status.start_time = self._pod_start.get(uid, now)
             self._update_status(pod)
@@ -440,14 +443,17 @@ class Kubelet:
             # unmounts the orphaned mounts (reconciler.go:166)
             self.volume_manager.forget_pod(uid)
         self.volume_manager.reconcile(self._iter_node or self._get_node())
-        # eviction: under memory pressure, evict BestEffort pods first,
-        # then highest-usage burstable (eviction/helpers.go rankMemoryPressure)
+        # eviction: under memory pressure, rank by QoS class (BestEffort
+        # -> Burstable -> Guaranteed), then priority, then memory
+        # footprint (eviction/helpers.go rankMemoryPressure)
         if not self._memory_pressure():
             return
+        qos_rank = {api.QOS_BEST_EFFORT: 0, api.QOS_BURSTABLE: 1,
+                    api.QOS_GUARANTEED: 2}
         candidates = sorted(
             (p for p in self._my_pods()
              if p.status.phase in ("Pending", "Running")),
-            key=lambda p: (not api.is_best_effort(p),
+            key=lambda p: (qos_rank[api.pod_qos_class(p)],
                            api.pod_priority(p),
                            -api.get_resource_request(p).get(res.MEMORY, 0)))
         for victim in candidates:

@@ -292,3 +292,95 @@ class TestCrashLoopBackoff:
         from kubernetes_tpu.kubelet.kubelet import CRASH_BACKOFF_BASE
 
         assert kl._crash_backoff[(uid, "main")] == CRASH_BACKOFF_BASE
+
+
+class TestQoSClasses:
+    """pod_qos_class parity with qos.go GetPodQOS + the kubelet's
+    status stamping and QoS-ranked eviction."""
+
+    def _pod(self, requests=None, limits=None, extra_container=None):
+        c = api.Container(resources=api.ResourceRequirements(
+            requests=requests or {}, limits=limits or {}))
+        containers = [c] + ([extra_container] if extra_container else [])
+        return api.Pod(spec=api.PodSpec(containers=containers))
+
+    def test_best_effort(self):
+        assert api.pod_qos_class(self._pod()) == api.QOS_BEST_EFFORT
+
+    def test_guaranteed_requires_cpu_and_memory_limits(self):
+        rl = api.resource_list(cpu="1", memory="1Gi")
+        p = self._pod(requests=dict(rl), limits=dict(rl))
+        assert api.pod_qos_class(p) == api.QOS_GUARANTEED
+        # limits-only: requests default to limits -> still Guaranteed
+        p = self._pod(limits=dict(rl))
+        assert api.pod_qos_class(p) == api.QOS_GUARANTEED
+        # memory limit missing -> Burstable
+        p = self._pod(limits=api.resource_list(cpu="1"))
+        assert api.pod_qos_class(p) == api.QOS_BURSTABLE
+        # requests != limits -> Burstable
+        p = self._pod(requests=api.resource_list(cpu="500m", memory="1Gi"),
+                      limits=dict(rl))
+        assert api.pod_qos_class(p) == api.QOS_BURSTABLE
+
+    def test_init_containers_participate(self):
+        # qos.go iterates init containers too: a resourceless main
+        # container + a requesting init container is Burstable
+        init = api.Container(name="init", resources=api.ResourceRequirements(
+            requests=api.resource_list(cpu="1")))
+        p = api.Pod(spec=api.PodSpec(containers=[api.Container()],
+                                     init_containers=[init]))
+        assert api.pod_qos_class(p) == api.QOS_BURSTABLE
+
+    def test_any_container_without_full_limits_degrades(self):
+        rl = api.resource_list(cpu="1", memory="1Gi")
+        other = api.Container(name="sidecar")
+        p = self._pod(requests=dict(rl), limits=dict(rl),
+                      extra_container=other)
+        assert api.pod_qos_class(p) == api.QOS_BURSTABLE
+
+    def test_kubelet_stamps_qos_class_in_status(self):
+        from kubernetes_tpu.kubemark.hollow import HollowNode
+        from kubernetes_tpu.runtime.store import ObjectStore
+
+        store = ObjectStore()
+        node = HollowNode(store, "n1")
+        try:
+            pod = make_pod("q1", cpu="100m", node_name="n1")
+            store.create("pods", pod)
+            node.kubelet.sync_once()
+            got = store.get("pods", "default", "q1")
+            assert got.status.qos_class == api.QOS_BURSTABLE
+        finally:
+            node.stop()
+
+    def test_eviction_prefers_best_effort_then_burstable(self):
+        from kubernetes_tpu.kubemark.hollow import HollowNode
+        from kubernetes_tpu.runtime.store import ObjectStore
+
+        store = ObjectStore()
+        node = HollowNode(store, "n1",
+                          allocatable=api.resource_list(
+                              cpu="4", memory="1Gi", pods=110))
+        try:
+            rl = api.resource_list(cpu="100m", memory="512Mi")
+            guaranteed = api.Pod(
+                metadata=api.ObjectMeta(name="guaranteed"),
+                spec=api.PodSpec(node_name="n1", containers=[api.Container(
+                    resources=api.ResourceRequirements(
+                        requests=dict(rl), limits=dict(rl)))]))
+            burstable = make_pod("burstable", memory="512Mi",
+                                 node_name="n1")
+            best_effort = make_pod("besteffort", node_name="n1")
+            for p in (guaranteed, burstable, best_effort):
+                store.create("pods", p)
+            node.kubelet.sync_once()
+            # force pressure and run housekeeping: beyond-threshold usage
+            # must evict the BestEffort pod FIRST
+            node.kubelet.memory_pressure_threshold = 0.5
+            node.kubelet._housekeeping(0.0)
+            assert store.get("pods", "default",
+                             "besteffort").status.phase == "Failed"
+            assert store.get("pods", "default",
+                             "guaranteed").status.phase != "Failed"
+        finally:
+            node.stop()
