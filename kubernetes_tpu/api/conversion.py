@@ -112,14 +112,11 @@ ROLLBACK_ANNOTATION = "deprecated.deployment.rollback.to"
 
 
 def _deployment_v1beta1_to_hub(data):
-    spec = data.get("spec") or {}
     # v1beta1 defaulting: nil selector defaults from template labels
-    # (pkg/apis/apps/v1beta1/defaults.go SetDefaults_DeploymentSpec)
-    if not spec.get("selector"):
-        tlabels = (((spec.get("template") or {}).get("metadata") or {})
-                   .get("labels") or {})
-        if tlabels:
-            spec["selector"] = {"matchLabels": dict(tlabels)}
+    # (pkg/apis/apps/v1beta1/defaults.go SetDefaults_DeploymentSpec —
+    # shared with the other legacy workload kinds)
+    data = _selector_default_to_hub(data)
+    spec = data.get("spec") or {}
     # spec.rollbackTo exists only in v1beta1; the hub schema has no
     # field for it, so it survives as the deprecated annotation
     rb = spec.pop("rollbackTo", None)
@@ -219,10 +216,43 @@ def _hpa_v2beta1_from_hub(data):
     return data
 
 
+def _selector_default_to_hub(data):
+    """Shared legacy-workload defaulting: a nil selector defaults from
+    the template labels (pkg/apis/extensions/v1beta1/defaults.go
+    SetDefaults_ReplicaSet / SetDefaults_DaemonSet — removed in
+    apps/v1beta2+, where selector is required and immutable)."""
+    spec = data.get("spec") or {}
+    if not spec.get("selector"):
+        tlabels = (((spec.get("template") or {}).get("metadata") or {})
+                   .get("labels") or {})
+        if tlabels:
+            spec["selector"] = {"matchLabels": dict(tlabels)}
+            data["spec"] = spec
+    return data
+
+
 def install_defaults():
-    """Register the built-in multi-version pairs."""
+    """Register the built-in multi-version pairs. The 1.11 reference
+    serves the workload kinds at apps/v1 (hub here), apps/v1beta1,
+    apps/v1beta2, and extensions/v1beta1 simultaneously
+    (pkg/master/master.go InstallAPIs; pkg/apis/extensions)."""
     register_version("Deployment", "apps/v1beta1",
                      _deployment_v1beta1_to_hub, _deployment_v1beta1_from_hub)
+    # extensions/v1beta1 Deployment carries the same legacy fields as
+    # apps/v1beta1 (nil-selector defaulting + spec.rollbackTo)
+    register_version("Deployment", "extensions/v1beta1",
+                     _deployment_v1beta1_to_hub, _deployment_v1beta1_from_hub)
+    # apps/v1beta2 dropped the legacy defaulting — wire shape == hub
+    register_version("Deployment", "apps/v1beta2")
+    register_version("ReplicaSet", "extensions/v1beta1",
+                     _selector_default_to_hub)
+    register_version("ReplicaSet", "apps/v1beta2")
+    register_version("DaemonSet", "extensions/v1beta1",
+                     _selector_default_to_hub)
+    register_version("DaemonSet", "apps/v1beta2")
+    register_version("StatefulSet", "apps/v1beta1",
+                     _selector_default_to_hub)
+    register_version("StatefulSet", "apps/v1beta2")
     register_version("HorizontalPodAutoscaler", "autoscaling/v2beta1",
                      _hpa_v2beta1_to_hub, _hpa_v2beta1_from_hub)
     register_version("CronJob", "batch/v2alpha1")

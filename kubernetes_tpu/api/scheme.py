@@ -177,6 +177,10 @@ def kind_for_plural(plural: str) -> Optional[str]:
     return _BY_PLURAL.get(plural)
 
 
+def is_registered(kind: str) -> bool:
+    return kind in _REGISTRY
+
+
 def plural_for_kind(kind: str) -> str:
     return _REGISTRY[kind][0]
 

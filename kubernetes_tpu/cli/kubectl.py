@@ -128,8 +128,26 @@ def load_manifests(path: str) -> List[dict]:
 
 
 def _decode_doc(doc: dict):
-    obj = scheme.decode_object(doc)
-    kind = getattr(obj, "kind", None) or scheme.kind_of(obj)
+    """Manifest doc -> (hub object, kind). A non-hub apiVersion (an
+    extensions/v1beta1 Deployment, say) decodes THROUGH the conversion
+    hub so legacy defaulting (nil-selector etc.) applies — the
+    reference client's universal decoder converts to the internal
+    version the same way. MUTATES `doc` to its hub wire form so
+    callers' three-way merges compare like with like."""
+    kind = doc.get("kind")
+    if not kind or not scheme.is_registered(kind):
+        raise ValueError(f"unknown kind {kind!r}")
+    ver = doc.get("apiVersion")
+    hub = scheme.api_version_for(kind)
+    if ver and ver != hub:
+        if not scheme.serves(kind, ver):
+            raise ValueError(f"{kind} is not served at {ver!r}")
+        from ..api import conversion
+
+        converted = conversion.to_hub(kind, doc, ver, hub)
+        doc.clear()
+        doc.update(converted)
+    obj = scheme.decode_request(kind, doc)
     return obj, kind
 
 
@@ -1426,6 +1444,11 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         return int(rc or 0)
     except APIStatusError as e:
         print(f"Error from server: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        # manifest problems (unknown kind, unserved apiVersion): CLI
+        # error with exit code 1, matching real kubectl
+        print(f"error: {e}", file=sys.stderr)
         return 1
     except OSError as e:
         # local-side failures (cp source missing, destination is a

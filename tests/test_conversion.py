@@ -27,8 +27,9 @@ def mkdeploy(name="d"):
 
 class TestWireConversion:
     def test_served_versions(self):
-        assert scheme.served_versions("Deployment") == \
-            ["apps/v1", "apps/v1beta1"]
+        assert sorted(scheme.served_versions("Deployment")) == \
+            ["apps/v1", "apps/v1beta1", "apps/v1beta2",
+             "extensions/v1beta1"]
         assert scheme.serves("HorizontalPodAutoscaler", "autoscaling/v2beta1")
         assert not scheme.serves("Pod", "apps/v1")
 
@@ -215,3 +216,79 @@ class TestServedThroughAPIServer:
         # cleanup: unregister the dynamic kind for other tests
         self.client.delete("customresourcedefinitions", "",
                            "widgets.example.io")
+
+
+class TestLegacyWorkloadGroupVersions:
+    """The 1.11 reference serves workloads at apps/v1beta2 and
+    extensions/v1beta1 simultaneously (pkg/master/master.go InstallAPIs,
+    pkg/apis/extensions) — round-trip + serving checks for the added
+    group-versions."""
+
+    def _server(self):
+        from kubernetes_tpu.runtime.store import ObjectStore
+        from kubernetes_tpu.server import APIServer
+
+        return APIServer(ObjectStore()).start()
+
+    def test_extensions_deployment_round_trip(self):
+        srv = self._server()
+        try:
+            from kubernetes_tpu.client.rest import RESTClient
+
+            c = RESTClient(srv.url)
+            # create at extensions/v1beta1 with NO selector: legacy
+            # defaulting fills it from template labels
+            doc = {"apiVersion": "extensions/v1beta1", "kind": "Deployment",
+                   "metadata": {"name": "web", "namespace": "default"},
+                   "spec": {"replicas": 2, "template": {"metadata": {
+                       "labels": {"app": "web"}}}}}
+            c.request("POST",
+                      "/apis/extensions/v1beta1/namespaces/default"
+                      "/deployments", body=doc)
+            # hub read sees the defaulted selector
+            hub = c.request("GET", "/apis/apps/v1/namespaces/default"
+                                   "/deployments/web")
+            assert hub["spec"]["selector"]["matchLabels"] == {"app": "web"}
+            # extensions read keeps the extensions tag
+            ext = c.request("GET",
+                            "/apis/extensions/v1beta1/namespaces/default"
+                            "/deployments/web")
+            assert ext["apiVersion"] == "extensions/v1beta1"
+        finally:
+            srv.stop()
+
+    def test_v1beta2_replicaset_and_daemonset_served(self):
+        srv = self._server()
+        try:
+            from kubernetes_tpu.client.rest import RESTClient
+
+            c = RESTClient(srv.url)
+            for gv in ("apps/v1beta2", "extensions/v1beta1"):
+                doc = c.request("GET", f"/apis/{gv}")
+                names = {r["name"] for r in doc["resources"]}
+                assert {"deployments", "replicasets",
+                        "daemonsets"} <= names, (gv, names)
+            rs = {"apiVersion": "apps/v1beta2", "kind": "ReplicaSet",
+                  "metadata": {"name": "rs1", "namespace": "default"},
+                  "spec": {"replicas": 1,
+                           "selector": {"matchLabels": {"a": "b"}},
+                           "template": {"metadata": {
+                               "labels": {"a": "b"}}}}}
+            created = c.request(
+                "POST",
+                "/apis/apps/v1beta2/namespaces/default/replicasets",
+                body=rs)
+            assert created["apiVersion"] == "apps/v1beta2"
+        finally:
+            srv.stop()
+
+    def test_statefulset_v1beta1_selector_defaulting(self):
+        from kubernetes_tpu.api import conversion
+
+        doc = {"apiVersion": "apps/v1beta1", "kind": "StatefulSet",
+               "metadata": {"name": "db"},
+               "spec": {"template": {"metadata": {
+                   "labels": {"db": "x"}}}}}
+        hub = conversion.to_hub("StatefulSet", doc, "apps/v1beta1",
+                                "apps/v1")
+        assert hub["spec"]["selector"]["matchLabels"] == {"db": "x"}
