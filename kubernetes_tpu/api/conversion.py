@@ -222,7 +222,10 @@ def _selector_default_to_hub(data):
     SetDefaults_ReplicaSet / SetDefaults_DaemonSet — removed in
     apps/v1beta2+, where selector is required and immutable)."""
     spec = data.get("spec") or {}
-    if not spec.get("selector"):
+    # nil-only defaulting: an EXPLICIT empty selector ({}) is a valid
+    # match-everything selector in the legacy versions and must survive
+    # the round-trip (the reference defaults only `Selector == nil`)
+    if spec.get("selector") is None:
         tlabels = (((spec.get("template") or {}).get("metadata") or {})
                    .get("labels") or {})
         if tlabels:

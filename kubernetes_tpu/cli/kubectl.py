@@ -127,6 +127,12 @@ def load_manifests(path: str) -> List[dict]:
     return docs
 
 
+class ManifestError(ValueError):
+    """A user-manifest problem (unknown kind, unserved apiVersion) —
+    reported as a clean CLI error; internal ValueErrors keep their
+    traceback."""
+
+
 def _decode_doc(doc: dict):
     """Manifest doc -> (hub object, kind). A non-hub apiVersion (an
     extensions/v1beta1 Deployment, say) decodes THROUGH the conversion
@@ -136,12 +142,12 @@ def _decode_doc(doc: dict):
     callers' three-way merges compare like with like."""
     kind = doc.get("kind")
     if not kind or not scheme.is_registered(kind):
-        raise ValueError(f"unknown kind {kind!r}")
+        raise ManifestError(f"unknown kind {kind!r}")
     ver = doc.get("apiVersion")
     hub = scheme.api_version_for(kind)
     if ver and ver != hub:
         if not scheme.serves(kind, ver):
-            raise ValueError(f"{kind} is not served at {ver!r}")
+            raise ManifestError(f"{kind} is not served at {ver!r}")
         from ..api import conversion
 
         converted = conversion.to_hub(kind, doc, ver, hub)
@@ -1445,9 +1451,10 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     except APIStatusError as e:
         print(f"Error from server: {e}", file=sys.stderr)
         return 1
-    except ValueError as e:
+    except ManifestError as e:
         # manifest problems (unknown kind, unserved apiVersion): CLI
-        # error with exit code 1, matching real kubectl
+        # error with exit code 1, matching real kubectl; other
+        # ValueErrors are internal bugs and keep their traceback
         print(f"error: {e}", file=sys.stderr)
         return 1
     except OSError as e:

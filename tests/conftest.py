@@ -17,3 +17,17 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Deregister the axon PJRT factory entirely: jax's backends() initializes
+# EVERY registered factory on first use regardless of jax_platforms, and
+# a wedged axon tunnel (observed: SIGKILLed TPU runs wedge the relay
+# machine-wide for hours) then hangs make_c_api_client inside the first
+# jax.devices() of a CPU-only test run. Tests never want the axon
+# backend; dropping its factory before any backend init makes the suite
+# immune to tunnel state.
+try:  # noqa: SIM105
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass  # jax internals moved: lazy-init ordering still usually works

@@ -292,3 +292,18 @@ class TestLegacyWorkloadGroupVersions:
         hub = conversion.to_hub("StatefulSet", doc, "apps/v1beta1",
                                 "apps/v1")
         assert hub["spec"]["selector"]["matchLabels"] == {"db": "x"}
+
+    def test_explicit_empty_selector_not_defaulted(self):
+        # nil-ONLY defaulting (SetDefaults_ReplicaSet): an explicit {}
+        # selector is a valid match-everything selector in the legacy
+        # versions and must NOT be overwritten by template labels
+        from kubernetes_tpu.api import conversion
+
+        doc = {"apiVersion": "extensions/v1beta1", "kind": "ReplicaSet",
+               "metadata": {"name": "all"},
+               "spec": {"selector": {},
+                        "template": {"metadata": {
+                            "labels": {"app": "web"}}}}}
+        hub = conversion.to_hub("ReplicaSet", doc, "extensions/v1beta1",
+                                "apps/v1")
+        assert hub["spec"]["selector"] == {}
