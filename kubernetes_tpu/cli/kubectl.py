@@ -119,11 +119,15 @@ def load_manifests(path: str) -> List[dict]:
     decode (the reference kubectl's sequential server-side discovery)."""
     text = sys.stdin.read() if path == "-" else open(path).read()
     docs: List[dict] = []
-    if text.lstrip().startswith("{"):
-        docs = [json.loads(text)]
-    else:
-        import yaml
-        docs = [d for d in yaml.safe_load_all(text) if d]
+    try:
+        if text.lstrip().startswith("{"):
+            docs = [json.loads(text)]
+        else:
+            import yaml
+            docs = [d for d in yaml.safe_load_all(text) if d]
+    except Exception as e:
+        # a parse failure is a user-manifest problem, not an internal bug
+        raise ManifestError(f"cannot parse {path}: {e}") from e
     return docs
 
 
@@ -336,7 +340,12 @@ def cmd_edit(client, args, out):
     if after == before:
         out.write("Edit cancelled, no changes made.\n")
         return 0
-    edited = scheme.decode_object(yaml.safe_load(after))
+    try:
+        edited = scheme.decode_object(yaml.safe_load(after))
+    except Exception as e:
+        # a broken edited buffer (bad YAML, kind changed to something
+        # unregistered) is a user error, not an internal traceback
+        raise ManifestError(f"edited object is invalid: {e}") from e
     client.update(plural, edited)
     out.write(f"{plural}/{args.name} edited\n")
     return 0
@@ -526,7 +535,39 @@ def cmd_apply(client, args, out):
     PREVIOUS apply declared and this one dropped (the
     last-applied-configuration annotation), and leave every field other
     actors own — status, scheduler/controller writes, out-of-band
-    labels — untouched."""
+    labels — untouched.
+
+    Subcommands (pkg/kubectl/cmd/apply_view_last_applied.go /
+    apply_set_last_applied.go): view-last-applied prints the stored
+    annotation; set-last-applied rewrites it from a manifest WITHOUT
+    touching the live spec (the migration tool for adopting objects
+    into apply management)."""
+    action = getattr(args, "action", None)
+    if action == "view-last-applied":
+        plural = _resolve_kind(args.kind)
+        cur = client.get(plural, args.namespace, args.name)
+        last = (cur.metadata.annotations or {}).get(LAST_APPLIED_ANNOTATION)
+        if not last:
+            raise ManifestError(
+                f"no last-applied-configuration annotation found on "
+                f"{plural}/{args.name}")
+        out.write(json.dumps(json.loads(last), indent=2) + "\n")
+        return
+    if action == "set-last-applied":
+        if not args.filename:
+            raise ManifestError("apply set-last-applied requires -f")
+        for doc in load_manifests(args.filename):
+            obj, kind = _decode_doc(doc)
+            plural = scheme.plural_for_kind(kind)
+            client.patch(plural, obj.metadata.namespace or args.namespace,
+                         obj.metadata.name,
+                         {"metadata": {"annotations": {
+                             LAST_APPLIED_ANNOTATION:
+                                 json.dumps(doc, sort_keys=True)}}})
+            out.write(f"{plural}/{obj.metadata.name} configured\n")
+        return
+    if not args.filename:
+        raise ManifestError("apply requires -f FILENAME")
     for doc in load_manifests(args.filename):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
@@ -1005,7 +1046,12 @@ def cmd_api_resources(client, args, out):
 
 
 def cmd_cluster_info(client, args, out):
-    """clusterinfo.go: the master URL + cluster-service Services."""
+    """clusterinfo.go: the master URL + cluster-service Services;
+    `cluster-info dump` (clusterinfo_dump.go) writes the debugging
+    corpus — nodes, events, and per-namespace workload state — as JSON
+    to stdout or one file per list under --output-directory."""
+    if getattr(args, "action", None) == "dump":
+        return _cluster_info_dump(client, args, out)
     out.write(f"Kubernetes master is running at {client.base_url}\n")
     svcs, _ = client.list("services", "kube-system")
     for s in svcs:
@@ -1014,6 +1060,232 @@ def cmd_cluster_info(client, args, out):
             out.write(f"{s.metadata.name} is running at "
                       f"{client.base_url}/api/v1/namespaces/kube-system/"
                       f"services/{s.metadata.name}/proxy\n")
+
+
+def _cluster_info_dump(client, args, out):
+    import os
+
+    def emit(name: str, objs):
+        doc = {"kind": "List",
+               "items": [scheme.encode_object(o) for o in objs]}
+        if args.output_directory:
+            os.makedirs(args.output_directory, exist_ok=True)
+            with open(os.path.join(args.output_directory,
+                                   name.replace("/", "_") + ".json"),
+                      "w") as f:
+                json.dump(doc, f, indent=2)
+        else:
+            out.write(f"==== {name} ====\n")
+            out.write(json.dumps(doc, indent=2) + "\n")
+
+    emit("nodes", client.list("nodes")[0])
+    namespaces = ([n.metadata.name for n in client.list("namespaces")[0]]
+                  if args.all_namespaces else [args.namespace])
+    for ns in namespaces:
+        for plural in ("pods", "services", "replicationcontrollers",
+                       "replicasets", "deployments", "daemonsets",
+                       "events"):
+            try:
+                objs, _ = client.list(plural, ns)
+            except APIStatusError:
+                continue
+            emit(f"{ns}/{plural}", objs)
+
+
+def cmd_completion(client, args, out):
+    """Emit a shell completion script (pkg/kubectl/cmd/completion.go).
+    Completes verbs and common resource kinds; bash and zsh (zsh wraps
+    the bash script via bashcompinit, like the reference)."""
+    verbs = " ".join(sorted(VERBS))
+    kinds = ("pods nodes services deployments replicasets "
+             "replicationcontrollers jobs cronjobs daemonsets "
+             "statefulsets namespaces events secrets configmaps")
+    bash = f"""# kubectl bash completion
+_kubectl_complete() {{
+    local cur=${{COMP_WORDS[COMP_CWORD]}}
+    if [ $COMP_CWORD -eq 1 ]; then
+        COMPREPLY=( $(compgen -W "{verbs}" -- "$cur") )
+    else
+        COMPREPLY=( $(compgen -W "{kinds}" -- "$cur") )
+    fi
+}}
+complete -F _kubectl_complete kubectl
+"""
+    if args.shell == "zsh":
+        out.write("autoload -Uz bashcompinit && bashcompinit\n" + bash)
+    else:
+        out.write(bash)
+
+
+def cmd_options(client, args, out):
+    """List the global flags every verb accepts
+    (pkg/kubectl/cmd/options.go)."""
+    out.write("The following options can be passed to any command:\n\n")
+    for flag, descr in [
+            ("--server, -s", "API server URL (default $KUBECTL_SERVER)"),
+            ("--token", "bearer token for authentication"),
+            ("--namespace, -n", "object namespace (default 'default')"),
+            ("--ca-cert-data", "cluster CA bundle PEM (or @file)"),
+            ("--client-cert-data", "x509 client cert PEM (or @file)"),
+            ("--client-key-data", "x509 client key PEM (or @file)")]:
+        out.write(f"  {flag}: {descr}\n")
+
+
+DESIRED_REPLICAS_ANNOTATION = "kubectl.kubernetes.io/desired-replicas"
+
+
+def cmd_rolling_update(client, args, out):
+    """kubectl rolling-update OLD (--image=IMG | -f new-rc.yaml)
+    (pkg/kubectl/rolling_updater.go Update + cmd/rollingupdate.go):
+    create the next RC, then step replicas one at a time — scale next
+    up, wait for its pods to be Ready, scale old down — so capacity
+    never drops below the old desired count. Cleanup deletes the old
+    RC; the --image path then renames next back to OLD (orphaning the
+    pods across the delete/create so they are re-adopted)."""
+    import hashlib
+    import time as _time
+
+    old = client.get("replicationcontrollers", args.namespace, args.name)
+    if args.filename:
+        docs = load_manifests(args.filename)
+        if len(docs) != 1:
+            raise ManifestError("rolling-update takes exactly one "
+                                "ReplicationController manifest")
+        new, kind = _decode_doc(docs[0])
+        if kind != "ReplicationController":
+            raise ManifestError(f"rolling-update needs a "
+                                f"ReplicationController, got {kind}")
+        if new.metadata.name == old.metadata.name:
+            raise ManifestError(
+                "the new RC must have a different name "
+                "(rollingupdate.go validates name != old name)")
+        if new.spec.selector == old.spec.selector:
+            raise ManifestError(
+                "the new RC must have a different selector")
+        rename_to = None
+    elif args.image:
+        # cmd/rollingupdate.go image path: clone the old RC, retag the
+        # first container, key both selectors on a deployment hash so
+        # old and new pods are distinguishable
+        import copy
+
+        new = copy.deepcopy(old)
+        new.spec.template.spec.containers[0].image = args.image
+        tmpl_hash = hashlib.sha1(
+            json.dumps(scheme.encode_object(new)["spec"]["template"],
+                       sort_keys=True).encode()).hexdigest()[:10]
+        new.metadata = api.ObjectMeta(
+            name=f"{old.metadata.name}-{tmpl_hash}",
+            namespace=old.metadata.namespace)
+        new.spec.selector = dict(old.spec.selector,
+                                 deployment=tmpl_hash)
+        new.spec.template.metadata.labels = dict(
+            new.spec.template.metadata.labels or {},
+            deployment=tmpl_hash)
+        rename_to = old.metadata.name
+    else:
+        raise ManifestError("rolling-update needs --image or -f")
+
+    desired = new.spec.replicas or old.spec.replicas
+    deadline = _time.monotonic() + args.timeout
+
+    def scale(name: str, replicas: int):
+        # retry-on-conflict (rolling_updater.go scaleAndWaitWithScaler's
+        # RetryParams): the controller's status writes race ours
+        while True:
+            rc = client.get("replicationcontrollers", args.namespace, name)
+            rc.spec.replicas = replicas
+            try:
+                client.update("replicationcontrollers", rc)
+                return
+            except APIStatusError as e:
+                if e.code != 409 or _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(args.poll_interval)
+
+    def wait_ready(name: str, want: int):
+        while _time.monotonic() < deadline:
+            rc = client.get("replicationcontrollers", args.namespace, name)
+            if rc.status.ready_replicas >= want:
+                return
+            _time.sleep(args.poll_interval)
+        raise SystemExit(f"error: timed out waiting for {name} to have "
+                         f"{want} ready replicas")
+
+    try:
+        new_live = client.get("replicationcontrollers", args.namespace,
+                              new.metadata.name)
+        scaled_up = new_live.spec.replicas  # resume an interrupted update
+        # the annotation preserves the ORIGINAL desired count across
+        # interruption: on resume, old has already been partially
+        # drained, so its current spec.replicas undercounts
+        stamped = (new_live.metadata.annotations or {}).get(
+            DESIRED_REPLICAS_ANNOTATION)
+        if stamped:
+            desired = int(stamped)
+    except APIStatusError as e:
+        if e.code != 404:
+            raise
+        new.spec.replicas = 0
+        new.metadata.annotations = dict(new.metadata.annotations or {})
+        new.metadata.annotations[DESIRED_REPLICAS_ANNOTATION] = str(desired)
+        client.create("replicationcontrollers", new)
+        scaled_up = 0
+    out.write(f"Created {new.metadata.name}\n")
+    out.write(f"Scaling up {new.metadata.name} from {scaled_up} to "
+              f"{desired}, scaling down {old.metadata.name} from "
+              f"{old.spec.replicas} to 0\n")
+    remaining_old = old.spec.replicas
+    while scaled_up < desired or remaining_old > 0:
+        if scaled_up < desired:
+            scaled_up += 1
+            scale(new.metadata.name, scaled_up)
+            wait_ready(new.metadata.name, scaled_up)
+        if remaining_old > 0:
+            remaining_old -= 1
+            scale(old.metadata.name, remaining_old)
+    # scaleAndWait: the old RC's pods must actually be GONE before the
+    # RC object is deleted — a bare delete would orphan the stragglers
+    # on clusters where cascading GC lags (or isn't running)
+    while True:
+        rc = client.get("replicationcontrollers", args.namespace,
+                        old.metadata.name)
+        if rc.status.replicas == 0:
+            break
+        if _time.monotonic() >= deadline:
+            raise SystemExit(
+                f"error: timed out waiting for {old.metadata.name}'s "
+                f"pods to terminate; NOT deleting it (rerun to resume)")
+        _time.sleep(args.poll_interval)
+    client.delete("replicationcontrollers", args.namespace,
+                  old.metadata.name)
+    if rename_to:
+        # Rename (rolling_updater.go:504): orphan-delete next, recreate
+        # under the old name with the SAME selector — the pods survive
+        # and the controller re-adopts them
+        while True:
+            rc = client.get("replicationcontrollers", args.namespace,
+                            new.metadata.name)
+            rc.metadata.annotations = dict(rc.metadata.annotations or {})
+            rc.metadata.annotations["kubernetes.io/orphan-dependents"] = \
+                "true"
+            try:
+                client.update("replicationcontrollers", rc)
+                break
+            except APIStatusError as e:
+                if e.code != 409:
+                    raise
+                _time.sleep(args.poll_interval)
+        client.delete("replicationcontrollers", args.namespace,
+                      rc.metadata.name)
+        renamed = api.ReplicationController(
+            metadata=api.ObjectMeta(name=rename_to,
+                                    namespace=args.namespace),
+            spec=rc.spec)
+        client.create("replicationcontrollers", renamed)
+        out.write(f"Renamed {rc.metadata.name} to {rename_to}\n")
+    out.write(f"replicationcontroller/{rename_to or new.metadata.name} "
+              f"rolling updated\n")
 
 
 def cmd_convert(client, args, out):
@@ -1241,9 +1513,16 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("kind")
     d.add_argument("name")
 
-    for verb in ("create", "apply"):
-        c = sub.add_parser(verb)
-        c.add_argument("--filename", "-f", required=True)
+    c = sub.add_parser("create")
+    c.add_argument("--filename", "-f", required=True)
+
+    ap_apply = sub.add_parser("apply")
+    ap_apply.add_argument(
+        "action", nargs="?", default=None,
+        choices=["view-last-applied", "set-last-applied"])
+    ap_apply.add_argument("kind", nargs="?")
+    ap_apply.add_argument("name", nargs="?")
+    ap_apply.add_argument("--filename", "-f", default=None)
 
     dl = sub.add_parser("delete")
     dl.add_argument("kind")
@@ -1371,7 +1650,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("api-versions")
     sub.add_parser("api-resources")
-    sub.add_parser("cluster-info")
+    ci = sub.add_parser("cluster-info")
+    ci.add_argument("action", nargs="?", default=None, choices=["dump"])
+    ci.add_argument("--output-directory", default=None)
+    ci.add_argument("--all-namespaces", "-A", action="store_true")
+
+    ru = sub.add_parser("rolling-update")
+    ru.add_argument("name")
+    ru.add_argument("--image", default=None)
+    ru.add_argument("--filename", "-f", default=None)
+    ru.add_argument("--timeout", type=float, default=60.0)
+    ru.add_argument("--poll-interval", type=float, default=0.05)
+
+    cp = sub.add_parser("completion")
+    cp.add_argument("shell", choices=["bash", "zsh"])
+
+    sub.add_parser("options")
 
     cv = sub.add_parser("convert")
     cv.add_argument("--filename", "-f", required=True)
@@ -1413,7 +1707,9 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "certificate": cmd_certificate, "auth": cmd_auth,
          "api-versions": cmd_api_versions, "api-resources": cmd_api_resources,
          "cluster-info": cmd_cluster_info, "convert": cmd_convert,
-         "set": cmd_set, "wait": cmd_wait, "proxy": cmd_proxy}
+         "set": cmd_set, "wait": cmd_wait, "proxy": cmd_proxy,
+         "rolling-update": cmd_rolling_update,
+         "completion": cmd_completion, "options": cmd_options}
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

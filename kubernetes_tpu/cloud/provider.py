@@ -75,6 +75,12 @@ class Zones:
     def get_zone_by_node_name(self, name: str) -> Zone:
         raise NotImplementedError
 
+    def get_zone(self) -> Zone:
+        """The zone the caller's resources land in by default
+        (cloud.go Zones.GetZone — consumed by the PersistentVolumeLabel
+        admission plugin)."""
+        raise NotImplementedError
+
 
 class Routes:
     """cloud.go Routes interface."""
@@ -128,6 +134,7 @@ class FakeCloud(CloudProvider, LoadBalancer, Instances, Zones, Routes):
 
     def __init__(self):
         self._lock = threading.Lock()
+        self.default_zone = Zone(failure_domain="z0", region="r0")
         self.instances_by_name: Dict[str, FakeInstance] = {}
         self.balancers: Dict[str, Tuple[api.LoadBalancerStatus, List[str]]] = {}
         self.route_table: Dict[str, Route] = {}
@@ -229,6 +236,10 @@ class FakeCloud(CloudProvider, LoadBalancer, Instances, Zones, Routes):
     def get_zone_by_node_name(self, name):
         self._record("get-zone")
         return self.instances_by_name[name].zone
+
+    def get_zone(self):
+        self._record("get-zone")
+        return self.default_zone
 
     # Routes
     def list_routes(self, cluster):

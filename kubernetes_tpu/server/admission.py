@@ -725,6 +725,98 @@ class NamespaceAutoProvision(AdmissionPlugin):
                 pass
 
 
+class AlwaysAdmit(AdmissionPlugin):
+    """Accept everything (plugin/pkg/admission/admit) — the no-op
+    plugin kept for explicit configuration parity; deprecated in the
+    reference the same way."""
+
+    name = "AlwaysAdmit"
+
+    def admit(self, op, kind, obj, old, user, store):
+        return
+
+
+class AlwaysDeny(AdmissionPlugin):
+    """Reject everything (plugin/pkg/admission/deny) — used in tests
+    and to fence a server off during maintenance; never in the default
+    chain."""
+
+    name = "AlwaysDeny"
+
+    def admit(self, op, kind, obj, old, user, store):
+        raise AdmissionError("admission plugin AlwaysDeny denied the request")
+
+
+class NamespaceExists(AdmissionPlugin):
+    """Reject objects created in namespaces that don't exist
+    (plugin/pkg/admission/namespace/exists) — the standalone
+    existence check; NamespaceLifecycle subsumes it in the default
+    chain but operators can still select it alone."""
+
+    name = "NamespaceExists"
+    immortal = ("default", "kube-system", "kube-public")
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind == "namespaces":
+            return
+        from ..api import scheme
+
+        k = scheme.kind_for_plural(kind.split("/")[0])
+        if k is not None and not scheme.is_namespaced(k):
+            return  # cluster-scoped: GetNamespace() is empty in the ref
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns or ns in self.immortal:
+            return
+        if store.get("namespaces", "", ns) is None and \
+                store.get("namespaces", "default", ns) is None:
+            raise AdmissionError(f"namespace {ns} does not exist", code=404)
+
+
+class DenyExecOnPrivileged(AdmissionPlugin):
+    """Deny exec/attach into pods with privileged containers
+    (plugin/pkg/admission/exec/admission.go DenyExecOnPrivileged — the
+    deprecated narrower sibling of DenyEscalatingExec: privileged
+    containers only, host namespaces allowed)."""
+
+    name = "DenyExecOnPrivileged"
+
+    def admit(self, op, kind, obj, old, user, store):
+        if kind not in ("pods/exec", "pods/attach"):
+            return
+        if any(c.privileged for c in obj.spec.containers):
+            raise AdmissionError(
+                f"cannot exec into or attach to a privileged container "
+                f"in pod {obj.metadata.name}")
+
+
+class PersistentVolumeLabel(AdmissionPlugin):
+    """Stamp cloud zone/region failure-domain labels onto new
+    PersistentVolumes (plugin/pkg/admission/storage/persistentvolume/
+    label) so NoVolumeZoneConflict can fence pods to the volume's
+    zone. Operator-constructed with the cluster's cloud provider, like
+    the reference's admission config."""
+
+    name = "PersistentVolumeLabel"
+    ZONE_LABEL = "failure-domain.beta.kubernetes.io/zone"
+    REGION_LABEL = "failure-domain.beta.kubernetes.io/region"
+
+    def __init__(self, cloud=None):
+        self.cloud = cloud
+
+    def admit(self, op, kind, obj, old, user, store):
+        if op != "create" or kind != "persistentvolumes" \
+                or self.cloud is None:
+            return
+        zones = self.cloud.zones()
+        if zones is None:
+            return
+        zone = zones.get_zone()
+        labels = dict(obj.metadata.labels or {})
+        labels.setdefault(self.ZONE_LABEL, zone.failure_domain)
+        labels.setdefault(self.REGION_LABEL, zone.region)
+        obj.metadata.labels = labels
+
+
 class AdmissionChain:
     """Ordered plugin chain (admission/chain.go chainAdmissionHandler)."""
 

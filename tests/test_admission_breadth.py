@@ -180,6 +180,66 @@ class TestDenyEscalatingExec:
             srv.stop()
 
 
+class TestAdmitDenyExists:
+    def test_always_admit_and_deny(self):
+        _admit(adm.AlwaysAdmit(), "create", "pods", make_pod("p"))
+        with pytest.raises(adm.AdmissionError):
+            _admit(adm.AlwaysDeny(), "get", "pods", make_pod("p"))
+
+    def test_namespace_exists(self):
+        store = ObjectStore()
+        pod = make_pod("p")
+        pod.metadata.namespace = "nowhere"
+        with pytest.raises(adm.AdmissionError) as ei:
+            _admit(adm.NamespaceExists(), "create", "pods", pod, store=store)
+        assert ei.value.code == 404
+        store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="nowhere")))
+        _admit(adm.NamespaceExists(), "create", "pods", pod, store=store)
+        # namespace objects themselves are exempt
+        _admit(adm.NamespaceExists(), "create", "namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="new")), store=store)
+
+
+class TestDenyExecOnPrivileged:
+    def test_privileged_only(self):
+        plugin = adm.DenyExecOnPrivileged()
+        priv = make_pod("priv")
+        priv.spec.containers[0].privileged = True
+        with pytest.raises(adm.AdmissionError):
+            _admit(plugin, "create", "pods/exec", priv)
+        # host namespaces alone pass — the deprecated plugin is
+        # narrower than DenyEscalatingExec
+        hostnet = make_pod("hn")
+        hostnet.spec.host_network = True
+        _admit(plugin, "create", "pods/exec", hostnet)
+
+
+class TestPersistentVolumeLabel:
+    def test_zone_labels_stamped_on_create(self):
+        from kubernetes_tpu.cloud.provider import FakeCloud, Zone
+
+        cloud = FakeCloud()
+        cloud.default_zone = Zone(failure_domain="us-x1-a", region="us-x1")
+        plugin = adm.PersistentVolumeLabel(cloud=cloud)
+        pv = api.PersistentVolume(metadata=api.ObjectMeta(name="pv1"))
+        _admit(plugin, "create", "persistentvolumes", pv)
+        assert pv.metadata.labels[adm.PersistentVolumeLabel.ZONE_LABEL] \
+            == "us-x1-a"
+        assert pv.metadata.labels[adm.PersistentVolumeLabel.REGION_LABEL] \
+            == "us-x1"
+        # user-set labels win (setdefault semantics)
+        pv2 = api.PersistentVolume(metadata=api.ObjectMeta(
+            name="pv2", labels={adm.PersistentVolumeLabel.ZONE_LABEL: "z9"}))
+        _admit(plugin, "create", "persistentvolumes", pv2)
+        assert pv2.metadata.labels[adm.PersistentVolumeLabel.ZONE_LABEL] \
+            == "z9"
+        # updates and cloudless servers are untouched
+        _admit(adm.PersistentVolumeLabel(), "create",
+               "persistentvolumes", api.PersistentVolume(
+                   metadata=api.ObjectMeta(name="pv3")))
+
+
 class TestDefaultStorageClass:
     def test_default_class_applied(self):
         store = ObjectStore()

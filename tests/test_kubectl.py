@@ -507,3 +507,133 @@ class TestRound5Verbs:
                 f"http://127.0.0.1:{m.group(1)}/api/v1/pods") as resp:
             body = _json.loads(resp.read())
         assert any(i["metadata"]["name"] == "p1" for i in body["items"])
+
+
+class TestRollingUpdate:
+    def test_image_rolling_update_with_rename(self, server, seeded):
+        """rolling_updater.go Update + Rename: stepwise surge/drain,
+        then the next RC is renamed back over the old name with pods
+        orphaned across the delete/create."""
+        import threading
+        import time as _time
+
+        from kubernetes_tpu.controllers.replicaset import (
+            ReplicationControllerController)
+
+        store = server.store
+        rc_obj = api.ReplicationController(
+            metadata=api.ObjectMeta(name="web"),
+            spec=api.ReplicationControllerSpec(
+                replicas=2, selector={"app": "web"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "web"}),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name="c", image="nginx:1.0")]))))
+        seeded.create("replicationcontrollers", rc_obj)
+        ctrl = ReplicationControllerController(store)
+        stop = threading.Event()
+
+        def reconcile():
+            # controller loop + instant kubelet: every controller-made
+            # pod becomes Ready so the updater's readiness gate opens
+            while not stop.is_set():
+                ctrl.sync_all()
+                for p in store.list("pods"):
+                    if p.status.phase != "Running":
+                        p.status.phase = "Running"
+                        p.status.conditions = [("Ready", "True")]
+                        store.update("pods", p)
+                ctrl.sync_all()
+                _time.sleep(0.02)
+
+        t = threading.Thread(target=reconcile, daemon=True)
+        t.start()
+        try:
+            rc, out = run(server, "rolling-update", "web",
+                          "--image", "nginx:2.0", "--timeout", "20")
+        finally:
+            stop.set()
+            t.join()
+        assert rc == 0, out
+        final = seeded.get("replicationcontrollers", "default", "web")
+        assert final.spec.replicas == 2
+        assert final.spec.template.spec.containers[0].image == "nginx:2.0"
+        assert "deployment" in final.spec.selector
+        # exactly the new pods remain, all on the new image
+        pods = [p for p in store.list("pods")
+                if (p.metadata.labels or {}).get("app") == "web"]
+        assert len(pods) == 2
+        assert all(p.spec.containers[0].image == "nginx:2.0" for p in pods)
+
+    def test_validates_manifest_shape(self, server, seeded, tmp_path):
+        seeded.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="web"),
+            spec=api.ReplicationControllerSpec(
+                replicas=1, selector={"app": "web"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "web"}),
+                    spec=api.PodSpec(containers=[api.Container()])))))
+        # same name must be rejected (rollingupdate.go validation)
+        m = tmp_path / "rc.json"
+        m.write_text(json.dumps({
+            "apiVersion": "v1", "kind": "ReplicationController",
+            "metadata": {"name": "web"},
+            "spec": {"replicas": 1, "selector": {"app": "web"},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{}]}}}}))
+        rc, _ = run(server, "rolling-update", "web", "-f", str(m))
+        assert rc == 1
+        rc, _ = run(server, "rolling-update", "web")
+        assert rc == 1  # needs --image or -f
+
+
+class TestApplyLastApplied:
+    def test_view_and_set_last_applied(self, server, seeded, tmp_path):
+        m = tmp_path / "cm.json"
+        doc = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cfg", "namespace": "default"},
+               "data": {"k": "1"}}
+        m.write_text(json.dumps(doc))
+        rc, _ = run(server, "apply", "-f", str(m))
+        assert rc == 0
+        rc, out = run(server, "apply", "view-last-applied",
+                      "configmaps", "cfg")
+        assert rc == 0 and json.loads(out)["data"] == {"k": "1"}
+        # set-last-applied rewrites the annotation WITHOUT touching data
+        doc2 = dict(doc, data={"k": "2"})
+        m.write_text(json.dumps(doc2))
+        rc, _ = run(server, "apply", "set-last-applied", "-f", str(m))
+        assert rc == 0
+        live = seeded.get("configmaps", "default", "cfg")
+        assert live.data == {"k": "1"}  # live object untouched
+        rc, out = run(server, "apply", "view-last-applied",
+                      "configmaps", "cfg")
+        assert json.loads(out)["data"] == {"k": "2"}
+        # an object never applied has no annotation to show
+        seeded.create("configmaps", api.ConfigMap(
+            metadata=api.ObjectMeta(name="raw"), data={}))
+        rc, _ = run(server, "apply", "view-last-applied",
+                    "configmaps", "raw")
+        assert rc == 1
+
+
+class TestClusterInfoDumpCompletionOptions:
+    def test_cluster_info_dump(self, server, seeded, tmp_path):
+        rc, out = run(server, "cluster-info", "dump")
+        assert rc == 0 and '"kind": "List"' in out and "p1" in out
+        d = tmp_path / "dump"
+        rc, _ = run(server, "cluster-info", "dump",
+                    "--output-directory", str(d))
+        assert rc == 0
+        assert (d / "nodes.json").exists()
+        names = [i["metadata"]["name"] for i in json.loads(
+            (d / "default_pods.json").read_text())["items"]]
+        assert "p1" in names
+
+    def test_completion_and_options(self, server, seeded):
+        rc, out = run(server, "completion", "bash")
+        assert rc == 0 and "rolling-update" in out and "compgen" in out
+        rc, out = run(server, "completion", "zsh")
+        assert rc == 0 and "bashcompinit" in out
+        rc, out = run(server, "options")
+        assert rc == 0 and "--namespace" in out
