@@ -559,8 +559,13 @@ def cmd_apply(client, args, out):
         for doc in load_manifests(args.filename):
             obj, kind = _decode_doc(doc)
             plural = scheme.plural_for_kind(kind)
-            client.patch(plural, obj.metadata.namespace or args.namespace,
-                         obj.metadata.name,
+            # namespace resolution must MATCH plain apply's (-n wins
+            # over the manifest) or the annotation lands on a different
+            # object than the one apply manages
+            ns = obj.metadata.namespace
+            if scheme.is_namespaced(kind) and args.namespace != "default":
+                ns = args.namespace
+            client.patch(plural, ns, obj.metadata.name,
                          {"metadata": {"annotations": {
                              LAST_APPLIED_ANNOTATION:
                                  json.dumps(doc, sort_keys=True)}}})
@@ -1231,7 +1236,7 @@ def cmd_rolling_update(client, args, out):
         new.metadata.annotations[DESIRED_REPLICAS_ANNOTATION] = str(desired)
         client.create("replicationcontrollers", new)
         scaled_up = 0
-    out.write(f"Created {new.metadata.name}\n")
+        out.write(f"Created {new.metadata.name}\n")
     out.write(f"Scaling up {new.metadata.name} from {scaled_up} to "
               f"{desired}, scaling down {old.metadata.name} from "
               f"{old.spec.replicas} to 0\n")
@@ -1278,6 +1283,23 @@ def cmd_rolling_update(client, args, out):
                 _time.sleep(args.poll_interval)
         client.delete("replicationcontrollers", args.namespace,
                       rc.metadata.name)
+        # The reference's orphan finalizer strips dependents' owner
+        # references BEFORE the owner object disappears; our annotation
+        # route does it asynchronously in the GC, so do it here
+        # synchronously — otherwise the recreated RC sees pods still
+        # owned by the dead hash-RC, refuses to adopt them, and spawns
+        # duplicates (controller_ref adoption requires ref-less pods)
+        pods, _ = client.list("pods", args.namespace)
+        for p in pods:
+            refs = [r for r in (p.metadata.owner_references or [])
+                    if not (r.kind == "ReplicationController"
+                            and r.name == rc.metadata.name)]
+            if len(refs) != len(p.metadata.owner_references or []):
+                p.metadata.owner_references = refs
+                try:
+                    client.update("pods", p)
+                except APIStatusError:
+                    pass  # deleted/conflicted mid-strip: GC's problem
         renamed = api.ReplicationController(
             metadata=api.ObjectMeta(name=rename_to,
                                     namespace=args.namespace),

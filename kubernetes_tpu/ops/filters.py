@@ -148,7 +148,8 @@ def resource_fit(alloc, allowed_pods, requested, pod_count, req, is_core):
 
 def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core,
                            use_pallas: bool = False,
-                           pallas_interpret: bool = False) -> jnp.ndarray:
+                           pallas_interpret: bool = False,
+                           taint_ports=None) -> jnp.ndarray:
     """Stack of per-predicate masks [Q, P, N] in enc.DEVICE_PREDICATES
     order. Resource fit here uses wave-start usage; the scan in
     ops/kernel.py re-applies it with live usage.
@@ -156,7 +157,13 @@ def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core,
     use_pallas: route taint-toleration + host-port matching through the
     fused VMEM-tile kernel (ops/pallas_kernels.py) instead of the XLA
     broadcast formulation; pallas_interpret runs that kernel in interpret
-    mode (CPU parity tests)."""
+    mode (CPU parity tests).
+
+    taint_ports: optional precomputed (taints_ok, ports_ok) [P, N]
+    pair — the device-resident round path computes these with ONE
+    Pallas call over every wave BEFORE its lax.scan (pallas_call
+    faults under scan on Mosaic; hoisting it also amortizes the
+    kernel launch across the whole round)."""
     P = pb.req.shape[0]
     N = nt.valid.shape[0]
     ones = jnp.ones((P, N), bool)
@@ -166,7 +173,9 @@ def static_predicate_masks(nt: NodeTensors, pb: PodBatch, is_core,
                        pb.req, is_core)
     host = host_name(nt, pb)
     sel = match_node_selector(nt, pb)
-    if use_pallas:
+    if taint_ports is not None:
+        taints, ports = taint_ports
+    elif use_pallas:
         from .pallas_kernels import taint_ports_masks
         taints, ports = taint_ports_masks(
             nt, pb, effects=(enc.EFFECT_NO_SCHEDULE, enc.EFFECT_NO_EXECUTE),

@@ -97,17 +97,19 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                pb: enc.PodBatch, extra_mask, rr_start, extra_scores,
                weights: Weights, num_zones: int, num_label_values: int,
                has_ipa: bool, use_pallas: bool, pallas_interpret: bool,
-               usage_in=None):
+               usage_in=None, taint_ports=None):
     """Shared wave computation. usage_in: optional (requested, nonzero,
     pod_count) overriding nt's usage columns — the device-resident carry
-    that lets consecutive waves chain without a host roundtrip. Returns
-    (WaveResult, usage_out)."""
+    that lets consecutive waves chain without a host roundtrip.
+    taint_ports: precomputed (taints_ok, ports_ok) [P, N] from the
+    round path's hoisted Pallas pass. Returns (WaveResult, usage_out)."""
     N = nt.valid.shape[0]
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
     is_core = jnp.arange(R) < enc.RES_FIXED
     masks = static_predicate_masks(nt, pb, is_core, use_pallas,
-                                   pallas_interpret)  # [Q-1, P, N]
+                                   pallas_interpret,
+                                   taint_ports)  # [Q-1, P, N]
     ipa_placeholder = jnp.ones((1, P, N), bool)  # filled post-scan
     masks = jnp.concatenate([masks, ipa_placeholder, extra_mask[None]], axis=0)
     res_i = enc.PRED_IDX["PodFitsResources"]
@@ -337,7 +339,13 @@ def schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
     [W, P, TPP]: pre-staged row ids (-1 pads). Host-plugin masks and
     extender scores are deliberately absent: waves needing them take the
     per-wave path (scheduler falls back when any mask row is non-trivial).
+
+    use_pallas: the taint/port masks for EVERY wave are computed by one
+    hoisted Pallas pass before the scan (the fused kernel faults under
+    lax.scan on Mosaic; hoisting sidesteps that and amortizes the
+    launch), then threaded through the scan as per-wave xs slices.
     Returns (chosen [W, P], fail_counts [W, Q, P], usage', rr_end)."""
+    W = pbs.req.shape[0]
     P = pbs.req.shape[1]
     N = nt.valid.shape[0]
     ones = jnp.ones((P, N), bool)
@@ -346,11 +354,11 @@ def schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
 
     def live_wave(carry, x):
         pm_c, tt_c, usage_c, rr_c = carry
-        pb, rows, trows = x
+        pb, rows, trows, tp = x
         res, usage_o = _wave_body(nt, pm_c, tt_c, pb, ones, rr_c, None,
                                   weights, num_zones, num_label_values,
-                                  has_ipa, use_pallas, pallas_interpret,
-                                  usage_in=usage_c)
+                                  has_ipa, False, pallas_interpret,
+                                  usage_in=usage_c, taint_ports=tp)
         pm_o, tt_o = _stage_placements(pm_c, tt_c, res.chosen, rows, trows)
         return (pm_o, tt_o, usage_o, res.rr_end), (res.chosen,
                                                    res.fail_counts)
@@ -363,14 +371,51 @@ def schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
         return carry, (jnp.full((P,), -1, jnp.int32),
                        jnp.zeros((Q, P), jnp.int32))
 
-    def wave(carry, x):
-        active = x[3]
-        return lax.cond(active, live_wave, padded_wave, carry, x[:3])
-
     active = jnp.any(pbs.valid, axis=1)  # [W]
+    if use_pallas:
+        from .pallas_kernels import taint_ports_masks
+
+        # one flattened [W*P] pod batch per chunk. The chunk is bounded
+        # to 256 pod rows — the per-wave kernel's hardware-proven
+        # configuration: its VMEM working set is ~6 live [Pp, n_block]
+        # i32 tiles (guide: ~16MB VMEM/core; 256x512x4B = 512KB/tile),
+        # so larger flat batches risk VMEM exhaustion for zero gain
+        # (the launches all live inside this one compiled program)
+        waves_per_chunk = max(1, 256 // P)
+        t_parts, p_parts = [], []
+        for s in range(0, W, waves_per_chunk):
+            e = min(W, s + waves_per_chunk)
+            flat = pbs._replace(
+                req=pbs.req[s:e].reshape((e - s) * P, -1),
+                tol_key=pbs.tol_key[s:e].reshape((e - s) * P, -1),
+                tol_val=pbs.tol_val[s:e].reshape((e - s) * P, -1),
+                tol_op=pbs.tol_op[s:e].reshape((e - s) * P, -1),
+                tol_effect=pbs.tol_effect[s:e].reshape((e - s) * P, -1),
+                ports=pbs.ports[s:e].reshape((e - s) * P, -1))
+            t, po = taint_ports_masks(nt, flat,
+                                      interpret=pallas_interpret)
+            t_parts.append(t.reshape(e - s, P, N))
+            p_parts.append(po.reshape(e - s, P, N))
+        taints_all = jnp.concatenate(t_parts, axis=0)
+        ports_all = jnp.concatenate(p_parts, axis=0)
+
+        def wave(carry, x):
+            pb, rows, trows, act, ta, po = x
+            return lax.cond(act, live_wave, padded_wave, carry,
+                            (pb, rows, trows, (ta, po)))
+
+        xs = (pbs, pm_rows, term_rows, active, taints_all, ports_all)
+    else:
+        def wave(carry, x):
+            pb, rows, trows, act = x
+            return lax.cond(act, live_wave, padded_wave, carry,
+                            (pb, rows, trows, None))
+
+        xs = (pbs, pm_rows, term_rows, active)
+
     carry0 = (pm, tt, usage, jnp.asarray(rr_start, jnp.int32))
     (_, _, usage_end, rr_end), (chosen, fail_counts) = lax.scan(
-        wave, carry0, (pbs, pm_rows, term_rows, active))
+        wave, carry0, xs)
     return chosen, fail_counts, usage_end, rr_end
 
 

@@ -184,6 +184,11 @@ class Scheduler:
         # verdict caught the driver bench labeled "pallas" for rounds
         # that hard-code the XLA formulation)
         self._last_path: Optional[str] = None
+        # round-program formulation: None = resolve on first round to
+        # pallas_default(); demoted to False permanently if the hoisted
+        # pallas round fails on this backend (separate from _use_pallas:
+        # the per-wave and round programs fail independently)
+        self._round_pallas: Optional[bool] = None
         # preemptions performed by the batched pipeline path (tests +
         # bench assert the pipeline handled them, not per-wave fallback);
         # device_preemption=False forces round failures back through the
@@ -473,23 +478,39 @@ class Scheduler:
             tpp = term_rows.shape[1]
             pbs_stacked, rows, trows = assemble_round(
                 [pb], [pods], pm_rows, term_rows, wbucket, tpp)
-            try:
+            if self._round_pallas is None:
+                self._round_pallas = pallas_default()
+
+            def _warm(use_p: bool):
                 out = schedule_round(
                     nt, pm, tt, pbs_stacked, usage,
                     jnp.asarray(0, jnp.int32), rows, trows,
                     weights=self.profile.weights(),
                     num_zones=self.snapshot.caps.Z,
                     num_label_values=self.snapshot.num_label_values,
-                    has_ipa=has_ipa, use_pallas=False)
+                    has_ipa=has_ipa, use_pallas=use_p)
                 jax.block_until_ready(out[0])
                 # sacrificial fetch: force the warm execution to actually
                 # run (block_until_ready does not truly wait on tunneled
-                # runtimes) and absorb the one-time degraded-transfer-mode
-                # transition NOW, outside any measured window. Real rounds
-                # then run in the (stable) degraded mode from a clean
-                # start instead of paying a 1-2.5s transition on their
-                # first result fetch.
+                # runtimes, so an execution fault also only surfaces
+                # here) and absorb the one-time degraded-transfer-mode
+                # transition NOW, outside any measured window. Real
+                # rounds then run in the (stable) degraded mode from a
+                # clean start instead of paying a 1-2.5s transition on
+                # their first result fetch.
                 np.asarray(out[3])
+
+            try:
+                try:
+                    _warm(self._round_pallas)
+                except Exception:
+                    # a faulting pallas warm must demote the round path
+                    # HERE so the measured run compiles the same (XLA)
+                    # program the warm fell back to
+                    if not self._round_pallas:
+                        raise
+                    self._round_pallas = False
+                    _warm(False)
             finally:
                 for p in pods:
                     self.snapshot.unstage(p)
@@ -566,18 +587,22 @@ class Scheduler:
         wbucket = pipeline_bucket(nw, hi=max_waves)
         pbs_stacked, pm_rows, term_rows = assemble_round(
             pbs, waves, pm_rows_all, term_rows_all, wbucket, tpp)
-        # the fused pallas masks kernel faults under lax.scan on real TPU
-        # (Mosaic), and measures equal to the XLA formulation anyway —
-        # rounds run the XLA formulation, and wave_path() reports exactly
-        # this flag, never the per-wave fallback's choice
-        round_pallas = False
-        try:
+        # the Pallas taint/port kernel is HOISTED out of the round's
+        # lax.scan (ops/kernel.py schedule_round: one call covering all
+        # waves) — under the scan it faults on Mosaic. A pallas round
+        # that still fails falls back to the XLA formulation once and
+        # demotes the round path permanently; wave_path() reports what
+        # actually executed, never a prediction.
+        if self._round_pallas is None:
+            self._round_pallas = pallas_default()
+
+        def _attempt(use_p: bool):
             chosen_d, fail_d, _usage_end, rr_end = schedule_round(
                 nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,
                 term_rows, weights=self.profile.weights(),
                 num_zones=self.snapshot.caps.Z,
                 num_label_values=self.snapshot.num_label_values,
-                has_ipa=has_ipa, use_pallas=round_pallas)
+                has_ipa=has_ipa, use_pallas=use_p)
             trace.step("dispatched")
             # FINISH the round before the first fetch: block_until_ready
             # does not poison the transfer path, the fetch does — and a
@@ -585,8 +610,24 @@ class Scheduler:
             # degraded mode
             jax.block_until_ready(chosen_d)
             trace.step("executed")
-            chosen_all = np.asarray(chosen_d)
+            chosen = np.asarray(chosen_d)
             trace.step("fetched")
+            return chosen, rr_end
+
+        round_pallas = self._round_pallas
+        try:
+            try:
+                chosen_all, rr_end = _attempt(round_pallas)
+            except Exception as e:
+                if not round_pallas:
+                    raise
+                import sys
+
+                print(f"# pallas round failed, retrying on the pure-XLA "
+                      f"formulation: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                self._round_pallas = round_pallas = False
+                chosen_all, rr_end = _attempt(False)
             self._last_path = "pallas" if round_pallas else "xla"
         except Exception as e:
             import sys

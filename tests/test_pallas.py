@@ -110,3 +110,58 @@ class TestPallasParity:
         assert pallas_default() is False
         monkeypatch.setenv("KTPU_PALLAS", "auto")
         assert pallas_default() is False  # tests run on cpu
+
+    def test_round_with_hoisted_pallas_matches(self):
+        """schedule_round with use_pallas (the hoisted pre-scan Pallas
+        pass, interpret mode) == stock round on a taint/port-rich world:
+        placements AND fail counts, across multiple chained waves."""
+        import jax.numpy as jnp
+        from kubernetes_tpu.ops.kernel import Weights, schedule_round
+        from kubernetes_tpu.sched.scheduler import assemble_round
+
+        rng = np.random.default_rng(11)
+        snap, _ = build_world(rng, n_nodes=16, n_pods=0)
+        feat = PodFeaturizer(snap, group_selectors=lambda p: [])
+        pods_all = []
+        effects = [api.NO_SCHEDULE, api.NO_EXECUTE]
+        for i in range(18):
+            tols = ([api.Toleration(key=f"k{i % 4}",
+                                    operator=api.TOLERATION_OP_EXISTS,
+                                    effect=effects[i % 2])]
+                    if i % 3 else [])
+            port = [api.ContainerPort(container_port=8000 + i % 4,
+                                      host_port=8000 + i % 4)] \
+                if i % 2 else []
+            pods_all.append(api.Pod(
+                metadata=api.ObjectMeta(name=f"w{i}"),
+                spec=api.PodSpec(
+                    tolerations=tols,
+                    containers=[api.Container(
+                        ports=port,
+                        resources=api.ResourceRequirements(
+                            requests=api.resource_list(cpu="100m")))])))
+        W = 6
+        waves = [pods_all[i:i + W] for i in range(0, len(pods_all), W)]
+        # featurize twice: pass 1 grows the toleration/port vocabs, pass
+        # 2 re-emits every wave at the final (uniform) shapes
+        [feat.featurize(wv) for wv in waves]
+        pbs = [feat.featurize(wv) for wv in waves]
+        pm_rows, term_rows = snap.stage_pending(pods_all)
+        nt, pm, tt = snap.to_device()
+        usage = (nt.requested, nt.nonzero, nt.pod_count)
+        pbs_stacked, rows, trows = assemble_round(
+            pbs, waves, pm_rows, term_rows, 4, term_rows.shape[1])
+        kw = dict(weights=Weights(), num_zones=snap.caps.Z,
+                  num_label_values=snap.num_label_values, has_ipa=False)
+        base = schedule_round(nt, pm, tt, pbs_stacked, usage,
+                              jnp.asarray(0, jnp.int32), rows, trows, **kw)
+        pal = schedule_round(nt, pm, tt, pbs_stacked, usage,
+                             jnp.asarray(0, jnp.int32), rows, trows,
+                             use_pallas=True, pallas_interpret=True, **kw)
+        np.testing.assert_array_equal(np.asarray(base[0]),
+                                      np.asarray(pal[0]))  # chosen
+        np.testing.assert_array_equal(np.asarray(base[1]),
+                                      np.asarray(pal[1]))  # fail_counts
+        # sanity: the world actually exercises the kernels (some pod
+        # failed or some taint exists)
+        assert int(np.asarray(nt.taint_key).max()) > 0
