@@ -90,6 +90,53 @@ class Selector:
     def from_requirements(reqs: Sequence[Requirement]) -> "Selector":
         return Selector(tuple(reqs))
 
+    @staticmethod
+    def parse(text: str) -> "Selector":
+        """Parse the set-based selector STRING syntax
+        (apimachinery/pkg/labels/selector.go Parse):
+        comma-separated requirements of the forms
+        `k=v` / `k==v` / `k!=v` / `k in (v1,v2)` / `k notin (v1,v2)` /
+        `k` (exists) / `!k` (does not exist). Raises ValueError on
+        malformed input."""
+        import re
+
+        reqs: List[Requirement] = []
+        rest = text.strip()
+        while rest:
+            # `\s+` before in/notin is load-bearing: without it the
+            # greedy key backtracks so "admin (a,b)" parses as
+            # key="adm" op=in — a requirement on a key the user never
+            # wrote (the reference lexer tokenizes on whitespace)
+            m = re.match(
+                r"\s*(!?)([A-Za-z0-9._/-]+)"
+                r"(?:\s*(==|=|!=)\s*([A-Za-z0-9._-]*)"
+                r"|\s+(in|notin)\s*\(([^)]*)\))?\s*(?:,|$)", rest)
+            if not m or not m.group(0).strip():
+                raise ValueError(f"unparseable selector {text!r}")
+            neg, key, eqop, eqval, setop, setvals = m.groups()
+            if eqop:
+                if neg:
+                    raise ValueError(f"unparseable selector {text!r}")
+                reqs.append(Requirement(
+                    key, NOT_IN if eqop == "!=" else IN, (eqval,)))
+            elif setop:
+                if neg:
+                    raise ValueError(f"unparseable selector {text!r}")
+                vals = tuple(v.strip() for v in setvals.split(",")
+                             if v.strip())
+                if not vals:
+                    # an empty set would make NotIn match EVERYTHING
+                    # (and In nothing) — the reference parser rejects it
+                    raise ValueError(
+                        f"empty value set in selector {text!r}")
+                reqs.append(Requirement(
+                    key, IN if setop == "in" else NOT_IN, vals))
+            else:
+                reqs.append(Requirement(
+                    key, DOES_NOT_EXIST if neg else EXISTS))
+            rest = rest[m.end():]
+        return Selector(tuple(reqs))
+
 
 @dataclass(frozen=True)
 class LabelSelector:

@@ -93,11 +93,6 @@ def _write_table(headers: List[str], rows: List[List[str]], out):
                   + "\n")
 
 
-def _print_table(plural: str, objs: List[object], out):
-    headers, row_fn = _COLUMNS.get(
-        plural, (["NAME", "AGE"],
-                 lambda o: [o.metadata.name, _age(o)]))
-    _write_table(headers, [row_fn(o) for o in objs], out)
 
 
 def _dump(obj, fmt: str, out):
@@ -164,19 +159,140 @@ def _decode_doc(doc: dict):
 # -- verbs --------------------------------------------------------------------
 
 
+def _jsonpath_get(doc, path: str) -> list:
+    """Evaluate a dotted jsonpath (`.a.b[*].c`, `[N]`) against JSON-ish
+    data; wildcards fan out, so the result is a LIST of matches
+    (client-go util/jsonpath's core subset)."""
+    import re
+
+    cur = [doc]
+    for seg in re.findall(r"[^.\[\]]+|\[\*\]|\[\d+\]",
+                          path.strip().lstrip(".")):
+        nxt = []
+        for c in cur:
+            if seg == "[*]":
+                if isinstance(c, list):
+                    nxt.extend(c)
+                elif isinstance(c, dict):
+                    nxt.extend(c.values())
+            elif seg.startswith("["):
+                i = int(seg[1:-1])
+                if isinstance(c, list) and i < len(c):
+                    nxt.append(c[i])
+            elif isinstance(c, dict) and seg in c:
+                nxt.append(c[seg])
+        cur = nxt
+    return cur
+
+
+def _jp_fmt(v) -> str:
+    """One value -> text, shared by jsonpath and custom-columns output:
+    composites as JSON, booleans lowercase (kubectl's conventions)."""
+    return (json.dumps(v) if isinstance(v, (dict, list))
+            else str(v).lower() if isinstance(v, bool) else str(v))
+
+
+def _render_jsonpath(tmpl: str, doc) -> str:
+    """Render a jsonpath TEMPLATE — literals, {PATH}, {"quoted"}, and
+    {range PATH}...{end} blocks — against one document."""
+    import re
+
+    toks = [t for t in re.split(r"(\{[^}]*\})", tmpl) if t]
+    fmt = _jp_fmt
+
+    def render_seq(toks, doc):
+        res = []
+        i = 0
+        while i < len(toks):
+            t = toks[i]
+            if t.startswith("{") and t.endswith("}"):
+                inner = t[1:-1].strip()
+                if inner.startswith("range "):
+                    j, depth = i + 1, 1
+                    while j < len(toks):
+                        tj = toks[j].strip()
+                        if tj.startswith("{") and tj.endswith("}"):
+                            tji = tj[1:-1].strip()
+                            if tji.startswith("range "):
+                                depth += 1
+                            elif tji == "end":
+                                depth -= 1
+                                if depth == 0:
+                                    break
+                        j += 1
+                    body = toks[i + 1:j]
+                    for item in _jsonpath_get(doc, inner[6:]):
+                        res.append(render_seq(body, item))
+                    i = j + 1
+                    continue
+                if inner.startswith('"'):
+                    res.append(inner[1:-1].encode().decode("unicode_escape"))
+                elif inner != "end":
+                    res.append(" ".join(fmt(v)
+                                        for v in _jsonpath_get(doc, inner)))
+            else:
+                res.append(t)
+            i += 1
+        return "".join(res)
+
+    return render_seq(toks, doc)
+
+
+def _parse_selector_flags(args):
+    sel = getattr(args, "selector", None)
+    fsel = getattr(args, "field_selector", None)
+    return sel or None, fsel or None
+
+
 def cmd_get(client, args, out):
     plural = _resolve_kind(args.kind)
+    sel, fsel = _parse_selector_flags(args)
     if args.name:
         obj = client.get(plural, args.namespace, args.name)
         objs = [obj]
     else:
         ns = None if args.all_namespaces else args.namespace
-        objs, _ = client.list(plural, ns)
-    if args.output in ("yaml", "json"):
+        objs, _ = client.list(plural, ns, label_selector=sel,
+                              field_selector=fsel)
+    fmt = args.output
+    if fmt in ("yaml", "json"):
         for o in objs:
-            _dump(o, args.output, out)
+            _dump(o, fmt, out)
+    elif fmt.startswith("jsonpath="):
+        tmpl = fmt[len("jsonpath="):].strip("'")
+        doc = ({"kind": "List",
+                "items": [scheme.encode_object(o) for o in objs]}
+               if not args.name else scheme.encode_object(objs[0]))
+        out.write(_render_jsonpath(tmpl, doc))
+        out.write("\n")
+    elif fmt.startswith("custom-columns="):
+        cols = [c.partition(":") for c in
+                fmt[len("custom-columns="):].split(",")]
+        headers = [c[0] for c in cols]
+        rows = []
+        for o in objs:
+            doc = scheme.encode_object(o)
+            rows.append([" ".join(_jp_fmt(v) for v in
+                                  _jsonpath_get(doc, c[2])) or "<none>"
+                         for c in cols])
+        _write_table(headers, rows, out)
+    elif fmt in ("table", "wide"):
+        headers, row_fn = _COLUMNS.get(
+            plural, (["NAME", "AGE"], lambda o: [o.metadata.name, _age(o)]))
+        headers = list(headers)
+        rows = [list(row_fn(o)) for o in objs]
+        if fmt == "wide" and plural == "pods":
+            headers.append("NOMINATED NODE")
+            for r, o in zip(rows, objs):
+                r.append(o.status.nominated_node_name or "<none>")
+        if args.show_labels:
+            headers.append("LABELS")
+            for r, o in zip(rows, objs):
+                r.append(",".join(f"{k}={v}" for k, v in sorted(
+                    (o.metadata.labels or {}).items())) or "<none>")
+        _write_table(headers, rows, out)
     else:
-        _print_table(plural, objs, out)
+        raise ManifestError(f"unknown output format {fmt!r}")
 
 
 def cmd_logs(client, args, out):
@@ -474,7 +590,179 @@ def cmd_describe(client, args, out):
             out.write(f"  {e.type}\t{e.reason}\tx{e.count}\t{e.message}\n")
 
 
+def _kv_pairs(items, what):
+    out = {}
+    for kv in items or []:
+        k, eq, v = kv.partition("=")
+        if not eq:
+            raise ManifestError(f"{what} needs KEY=VALUE, got {kv!r}")
+        out[k] = v
+    return out
+
+
+def _file_pairs(items):
+    import os
+
+    out = {}
+    for spec in items or []:
+        key, eq, path = spec.partition("=")
+        if not eq:
+            key, path = os.path.basename(spec), spec
+        try:
+            with open(path) as f:
+                out[key] = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            raise ManifestError(f"--from-file {path}: {e}") from e
+    return out
+
+
+def _create_generated(client, args, out):
+    """`kubectl create <kind> NAME ...` generators
+    (pkg/kubectl/cmd/create_*.go): build the object from flags instead
+    of a manifest. Secret/ConfigMap values stay plain strings — this
+    API's Secret.data convention (see controllers/bootstrap.py)."""
+    gen, name, ns = args.gen, args.name, args.namespace
+    if gen == "secret":
+        # `kubectl create secret generic NAME`: the subtype word sits
+        # between (create_secret.go); only the generic generator exists
+        # here — tls/docker-registry need cert/registry machinery
+        if name == "generic":
+            name = args.extra_name
+        elif name in ("tls", "docker-registry"):
+            raise ManifestError(f"create secret {name} is not supported; "
+                                f"use 'generic' or a manifest")
+    if not name:
+        raise ManifestError(f"create {gen} needs a NAME")
+    data = dict(_kv_pairs(args.from_literal, "--from-literal"),
+                **_file_pairs(args.from_file))
+    meta = api.ObjectMeta(name=name, namespace=ns)
+    if gen == "configmap":
+        obj, plural = api.ConfigMap(metadata=meta, data=data), "configmaps"
+    elif gen == "secret":
+        obj, plural = api.Secret(metadata=meta, data=data,
+                                 type=args.type), "secrets"
+    elif gen == "namespace":
+        obj, plural = api.Namespace(
+            metadata=api.ObjectMeta(name=name)), "namespaces"
+    elif gen == "serviceaccount":
+        obj, plural = api.ServiceAccount(metadata=meta), "serviceaccounts"
+    elif gen == "quota":
+        from ..api.resources import parse_quantity
+
+        hard = {}
+        for kv in args.hard.split(",") if args.hard else []:
+            k, eq, v = kv.partition("=")
+            if not eq:
+                raise ManifestError(f"--hard needs KEY=VALUE, got {kv!r}")
+            hard[k] = parse_quantity(v)
+        obj = api.ResourceQuota(metadata=meta,
+                                spec=api.ResourceQuotaSpec(hard=hard))
+        plural = "resourcequotas"
+    elif gen == "priorityclass":
+        obj = api.PriorityClass(metadata=api.ObjectMeta(name=name),
+                                value=args.value,
+                                global_default=args.global_default,
+                                description=args.description)
+        plural = "priorityclasses"
+    elif gen == "deployment":
+        if not args.image:
+            raise ManifestError("create deployment needs --image")
+        obj = api.Deployment(
+            metadata=meta,
+            spec=api.DeploymentSpec(
+                replicas=args.replicas,
+                selector=api.LabelSelector(match_labels={"app": name}),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": name}),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name=name, image=args.image)]))))
+        plural = "deployments"
+    elif gen == "job":
+        if not args.image:
+            raise ManifestError("create job needs --image")
+        obj = api.Job(
+            metadata=meta,
+            spec=api.JobSpec(template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels={"job-name": name}),
+                spec=api.PodSpec(restart_policy="Never", containers=[
+                    api.Container(name=name, image=args.image)]))))
+        plural = "jobs"
+    elif gen == "service":
+        # create service clusterip|nodeport NAME --tcp=port[:target]
+        if name in ("clusterip", "nodeport"):
+            svc_type = {"clusterip": "ClusterIP",
+                        "nodeport": "NodePort"}[name]
+            name = args.extra_name
+            if not name:
+                raise ManifestError("create service needs a NAME")
+        else:
+            svc_type = "ClusterIP"
+        ports = []
+        for spec in args.tcp or []:
+            port, _, target = spec.partition(":")
+            ports.append(api.ServicePort(
+                port=int(port), target_port=int(target or port),
+                protocol="TCP"))
+        obj = api.Service(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            spec=api.ServiceSpec(selector={"app": name}, ports=ports,
+                                 type=svc_type))
+        plural = "services"
+    elif gen in ("role", "clusterrole"):
+        rule = api.RBACPolicyRule(
+            verbs=args.rbac_verbs or [], resources=args.resource or [],
+            api_groups=[""])
+        if gen == "role":
+            obj = api.Role(metadata=meta, rules=[rule])
+        else:
+            obj = api.ClusterRole(metadata=api.ObjectMeta(name=name),
+                                  rules=[rule])
+        plural = gen + "s"
+    elif gen in ("rolebinding", "clusterrolebinding"):
+        subjects = [api.RBACSubject(kind="User", name=u)
+                    for u in args.user or []]
+        for sa in args.serviceaccount or []:
+            sns, colon, sname = sa.partition(":")
+            if not colon or not sns or not sname:
+                raise ManifestError(
+                    f"--serviceaccount needs NAMESPACE:NAME, got {sa!r}")
+            subjects.append(api.RBACSubject(
+                kind="ServiceAccount", name=sname, namespace=sns))
+        ref_kind = "ClusterRole" if args.clusterrole else "Role"
+        ref_name = args.clusterrole or args.role
+        if not ref_name:
+            raise ManifestError(f"create {gen} needs --role/--clusterrole")
+        if gen == "rolebinding":
+            obj = api.RoleBinding(
+                metadata=meta, subjects=subjects,
+                role_ref=api.RoleRef(kind=ref_kind, name=ref_name))
+        else:
+            obj = api.ClusterRoleBinding(
+                metadata=api.ObjectMeta(name=name), subjects=subjects,
+                role_ref=api.RoleRef(kind="ClusterRole", name=ref_name))
+        plural = gen + "s"
+    elif gen == "poddisruptionbudget":
+        obj = api.PodDisruptionBudget(
+            metadata=meta,
+            spec=api.PodDisruptionBudgetSpec(
+                min_available=args.min_available,
+                selector=api.LabelSelector(
+                    match_labels=_kv_pairs(
+                        (args.selector or "").split(",") if args.selector
+                        else [], "--selector"))))
+        plural = "poddisruptionbudgets"
+    else:
+        raise ManifestError(f"unknown create generator {gen!r}")
+    client.create(plural, obj)
+    out.write(f"{plural}/{obj.metadata.name} created\n")
+
+
 def cmd_create(client, args, out):
+    if getattr(args, "gen", None):
+        return _create_generated(client, args, out)
+    if not args.filename:
+        raise ManifestError("create requires -f FILENAME or a generator "
+                            "(configmap, secret, namespace, ...)")
     for doc in load_manifests(args.filename):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
@@ -617,8 +905,19 @@ def cmd_apply(client, args, out):
 
 def cmd_delete(client, args, out):
     plural = _resolve_kind(args.kind)
-    client.delete(plural, args.namespace, args.name)
-    out.write(f"{plural}/{args.name} deleted\n")
+    if args.name:
+        client.delete(plural, args.namespace, args.name)
+        out.write(f"{plural}/{args.name} deleted\n")
+        return
+    sel, fsel = _parse_selector_flags(args)
+    if not sel and not fsel:
+        raise ManifestError("delete needs a name or -l/--field-selector")
+    objs, _ = client.list(plural, args.namespace, label_selector=sel,
+                          field_selector=fsel)
+    for o in objs:
+        client.delete(plural, o.metadata.namespace or args.namespace,
+                      o.metadata.name)
+        out.write(f"{plural}/{o.metadata.name} deleted\n")
 
 
 def cmd_scale(client, args, out):
@@ -1527,16 +1826,49 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("get")
     g.add_argument("kind")
     g.add_argument("name", nargs="?")
-    g.add_argument("--output", "-o", choices=["table", "yaml", "json"],
-                   default="table")
+    g.add_argument("--output", "-o", default="table",
+                   help="table|wide|yaml|json|jsonpath=...|"
+                        "custom-columns=...")
     g.add_argument("--all-namespaces", "-A", action="store_true")
+    g.add_argument("--selector", "-l", default=None)
+    g.add_argument("--field-selector", default=None)
+    g.add_argument("--show-labels", action="store_true")
 
     d = sub.add_parser("describe")
     d.add_argument("kind")
     d.add_argument("name")
 
     c = sub.add_parser("create")
-    c.add_argument("--filename", "-f", required=True)
+    c.add_argument("gen", nargs="?", default=None,
+                   help="generator kind (configmap, secret, namespace, "
+                        "serviceaccount, quota, priorityclass, "
+                        "deployment, job, service, role, clusterrole, "
+                        "rolebinding, clusterrolebinding, "
+                        "poddisruptionbudget) — or use -f")
+    c.add_argument("name", nargs="?")
+    c.add_argument("extra_name", nargs="?")
+    c.add_argument("--filename", "-f", default=None)
+    c.add_argument("--from-literal", action="append")
+    c.add_argument("--from-file", action="append")
+    c.add_argument("--type", default="Opaque")
+    c.add_argument("--image", default=None)
+    c.add_argument("--replicas", type=int, default=1)
+    c.add_argument("--value", type=int, default=0)
+    c.add_argument("--global-default", action="store_true")
+    c.add_argument("--description", default="")
+    c.add_argument("--hard", default=None)
+    c.add_argument("--tcp", action="append")
+    # dest must NOT be "verb" — that is the subparsers' dest, and
+    # argparse would overwrite the selected verb with this flag's
+    # default (None), breaking every create invocation
+    c.add_argument("--verb", dest="rbac_verbs", action="append")
+    c.add_argument("--resource", action="append")
+    c.add_argument("--role", default=None)
+    c.add_argument("--clusterrole", default=None)
+    c.add_argument("--serviceaccount", action="append")
+    c.add_argument("--user", action="append")
+    c.add_argument("--min-available", type=int, default=None)
+    c.add_argument("--selector", default=None)
 
     ap_apply = sub.add_parser("apply")
     ap_apply.add_argument(
@@ -1548,7 +1880,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     dl = sub.add_parser("delete")
     dl.add_argument("kind")
-    dl.add_argument("name")
+    dl.add_argument("name", nargs="?")
+    dl.add_argument("--selector", "-l", default=None)
+    dl.add_argument("--field-selector", default=None)
 
     sc = sub.add_parser("scale")
     sc.add_argument("kind")

@@ -148,17 +148,25 @@ class RESTClient:
     # -- verbs -----------------------------------------------------------------
 
     def list(self, plural: str, namespace: Optional[str] = None,
-             label_selector: Optional[Dict[str, str]] = None,
-             field_selector: Optional[Dict[str, str]] = None
+             label_selector=None, field_selector=None
              ) -> Tuple[List[object], int]:
-        """Returns (items, list resourceVersion)."""
+        """Returns (items, list resourceVersion). Selectors may be
+        {key: value} dicts or raw selector STRINGS (set-based
+        expressions like "tier in (a,b)" pass through verbatim to the
+        server's parser)."""
+        from urllib.parse import quote
+
+        def enc(sel):
+            if isinstance(sel, str):
+                return quote(sel, safe="=,!()")
+            return quote(",".join(f"{k}={v}" for k, v in sel.items()),
+                         safe="=,")
+
         q = []
         if label_selector:
-            q.append("labelSelector=" + ",".join(
-                f"{k}={v}" for k, v in label_selector.items()))
+            q.append("labelSelector=" + enc(label_selector))
         if field_selector:
-            q.append("fieldSelector=" + ",".join(
-                f"{k}={v}" for k, v in field_selector.items()))
+            q.append("fieldSelector=" + enc(field_selector))
         path = self._path(plural, namespace, None)
         if self.binary:
             from ..api import binary

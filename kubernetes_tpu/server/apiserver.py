@@ -761,15 +761,17 @@ class APIServer:
         objs = self.store.list(plural, namespace)
         sel = query.get("labelSelector", [None])[0]
         if sel:
-            # malformed selectors are client errors, not 500s
+            from ..api.labels import Selector
+
+            # full set-based syntax (labels.Parse: =, !=, in, notin,
+            # exists, !key); malformed selectors are client errors
             try:
-                pairs = dict(kv.split("=", 1) for kv in sel.split(","))
+                parsed = Selector.parse(sel)
             except ValueError:
                 raise APIError(400, "BadRequest",
                                f"unparseable labelSelector {sel!r}")
             objs = [o for o in objs
-                    if all((o.metadata.labels or {}).get(k) == v
-                           for k, v in pairs.items())]
+                    if parsed.matches(o.metadata.labels or {})]
         fsel = query.get("fieldSelector", [None])[0]
         if fsel:
             for kv in fsel.split(","):
@@ -782,6 +784,13 @@ class APIServer:
                                        "node_name", None) == v]
                 elif k == "metadata.name":
                     objs = [o for o in objs if o.metadata.name == v]
+                elif k == "metadata.namespace":
+                    objs = [o for o in objs
+                            if o.metadata.namespace == v]
+                elif k == "status.phase":
+                    objs = [o for o in objs
+                            if getattr(getattr(o, "status", None),
+                                       "phase", None) == v]
                 else:
                     raise APIError(400, "BadRequest",
                                    f"unsupported fieldSelector {k!r}")

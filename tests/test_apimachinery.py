@@ -167,3 +167,28 @@ class TestBroadcaster:
         w.stop()
         store.create("pods", api.Pod(metadata=api.ObjectMeta(name="p1")))
         assert w.next(timeout=0.01) is None
+
+
+class TestSelectorParse:
+    """labels.Parse string syntax (apimachinery/pkg/labels/selector.go)."""
+
+    def test_forms(self):
+        from kubernetes_tpu.api.labels import Selector
+
+        s = Selector.parse("a=1, b!=2, c in (x, y), d notin (z), e, !f")
+        assert s.matches({"a": "1", "c": "y", "e": "ok"})
+        assert not s.matches({"a": "1", "c": "y"})  # e missing
+        assert not s.matches({"a": "1", "c": "y", "e": "ok", "f": "no"})
+        assert not s.matches({"a": "1", "c": "q", "e": "ok"})
+        assert not s.matches({"a": "1", "b": "2", "c": "x", "e": "ok"})
+        assert Selector.parse("").matches({"anything": "at-all"})
+        assert Selector.parse("k==v").matches({"k": "v"})
+
+    def test_malformed(self):
+        import pytest
+
+        from kubernetes_tpu.api.labels import Selector
+
+        for bad in ("k in (", "!k=v", "=v", "a=1,,b=2"):
+            with pytest.raises(ValueError):
+                Selector.parse(bad)

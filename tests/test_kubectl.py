@@ -637,3 +637,124 @@ class TestClusterInfoDumpCompletionOptions:
         assert rc == 0 and "bashcompinit" in out
         rc, out = run(server, "options")
         assert rc == 0 and "--namespace" in out
+
+
+class TestSelectorsAndOutput:
+    def test_get_with_label_selector(self, server, seeded):
+        p2 = api.Pod(metadata=api.ObjectMeta(name="p2",
+                                             labels={"app": "db",
+                                                     "tier": "backend"}),
+                     spec=api.PodSpec(containers=[api.Container()]))
+        seeded.create("pods", p2)
+        rc, out = run(server, "get", "pods", "-l", "app=w")
+        assert rc == 0 and "p1" in out and "p2" not in out
+        # set-based syntax reaches the server parser verbatim
+        rc, out = run(server, "get", "pods", "-l", "app in (db,api)")
+        assert rc == 0 and "p2" in out and "p1" not in out
+        rc, out = run(server, "get", "pods", "-l", "!tier")
+        assert rc == 0 and "p1" in out and "p2" not in out
+        rc, out = run(server, "get", "pods", "--field-selector",
+                      "spec.nodeName=n1")
+        assert rc == 0 and "p1" in out and "p2" not in out
+
+    def test_jsonpath_and_custom_columns(self, server, seeded):
+        rc, out = run(server, "get", "pods", "-o",
+                      "jsonpath={.items[*].metadata.name}")
+        assert rc == 0 and out.strip() == "p1"
+        rc, out = run(
+            server, "get", "pods", "-o",
+            'jsonpath={range .items[*]}{.metadata.name}:{.spec.nodeName}'
+            '{"\\n"}{end}')
+        assert rc == 0 and "p1:n1" in out
+        rc, out = run(server, "get", "pods", "p1", "-o",
+                      "jsonpath={.metadata.name}")
+        assert rc == 0 and out.strip() == "p1"
+        rc, out = run(server, "get", "pods", "-o",
+                      "custom-columns=NAME:.metadata.name,"
+                      "NODE:.spec.nodeName,MISSING:.spec.bogus")
+        assert rc == 0
+        lines = out.strip().splitlines()
+        assert lines[0].split() == ["NAME", "NODE", "MISSING"]
+        assert lines[1].split() == ["p1", "n1", "<none>"]
+
+    def test_wide_and_show_labels(self, server, seeded):
+        rc, out = run(server, "get", "pods", "-o", "wide")
+        assert rc == 0 and "NOMINATED NODE" in out
+        rc, out = run(server, "get", "pods", "--show-labels")
+        assert rc == 0 and "app=w" in out
+
+    def test_delete_by_selector(self, server, seeded):
+        for n in ("d1", "d2"):
+            seeded.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name=n, labels={"doomed": "y"}),
+                spec=api.PodSpec(containers=[api.Container()])))
+        rc, out = run(server, "delete", "pods", "-l", "doomed=y")
+        assert rc == 0 and "d1" in out and "d2" in out
+        assert {p.metadata.name for p in server.store.list("pods")} == {"p1"}
+        rc, _ = run(server, "delete", "pods")
+        assert rc == 1  # no name, no selector
+
+
+class TestCreateGenerators:
+    def test_configmap_and_secret(self, server, seeded, tmp_path):
+        f = tmp_path / "app.conf"
+        f.write_text("x=1\n")
+        rc, out = run(server, "create", "configmap", "cfg",
+                      "--from-literal", "a=1", "--from-file", str(f))
+        assert rc == 0
+        cm = seeded.get("configmaps", "default", "cfg")
+        assert cm.data == {"a": "1", "app.conf": "x=1\n"}
+        rc, _ = run(server, "create", "secret", "generic", "sec",
+                    "--from-literal", "pw=hunter2")
+        assert rc == 0
+        assert seeded.get("secrets", "default", "sec").data["pw"] == "hunter2"
+        rc, _ = run(server, "create", "secret", "tls", "t")
+        assert rc == 1  # unsupported subtype is a clean CLI error
+
+    def test_namespace_sa_quota_priorityclass(self, server, seeded):
+        rc, _ = run(server, "create", "namespace", "prod")
+        assert rc == 0 and seeded.get("namespaces", "", "prod") is not None
+        rc, _ = run(server, "create", "serviceaccount", "bot")
+        assert rc == 0
+        rc, _ = run(server, "create", "quota", "q1",
+                    "--hard", "pods=10,requests.cpu=4")
+        assert rc == 0
+        q = seeded.get("resourcequotas", "default", "q1")
+        assert q.spec.hard["pods"] == 10 and q.spec.hard["requests.cpu"] == 4
+        rc, _ = run(server, "create", "priorityclass", "critical",
+                    "--value", "1000000", "--global-default")
+        assert rc == 0
+        pc = seeded.get("priorityclasses", None, "critical")
+        assert pc.value == 1000000 and pc.global_default
+
+    def test_deployment_service_rbac(self, server, seeded):
+        rc, _ = run(server, "create", "deployment", "web",
+                    "--image", "nginx:1", "--replicas", "2")
+        assert rc == 0
+        dep = seeded.get("deployments", "default", "web")
+        assert dep.spec.replicas == 2
+        assert dep.spec.template.spec.containers[0].image == "nginx:1"
+        rc, _ = run(server, "create", "service", "clusterip", "websvc",
+                    "--tcp", "80:8080")
+        assert rc == 0
+        svc = seeded.get("services", "default", "websvc")
+        assert svc.spec.ports[0].port == 80
+        assert svc.spec.ports[0].target_port == 8080
+        rc, _ = run(server, "create", "role", "reader",
+                    "--verb", "get", "--verb", "list",
+                    "--resource", "pods")
+        assert rc == 0
+        role = seeded.get("roles", "default", "reader")
+        assert role.rules[0].verbs == ["get", "list"]
+        rc, _ = run(server, "create", "rolebinding", "rb",
+                    "--role", "reader",
+                    "--serviceaccount", "default:bot")
+        assert rc == 0
+        rb = seeded.get("rolebindings", "default", "rb")
+        assert rb.role_ref.name == "reader"
+        assert rb.subjects[0].kind == "ServiceAccount"
+        rc, _ = run(server, "create", "poddisruptionbudget", "pdb1",
+                    "--selector", "app=web", "--min-available", "1")
+        assert rc == 0
+        pdb = seeded.get("poddisruptionbudgets", "default", "pdb1")
+        assert pdb.spec.min_available == 1
