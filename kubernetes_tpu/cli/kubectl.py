@@ -244,7 +244,33 @@ def _parse_selector_flags(args):
     return sel or None, fsel or None
 
 
+GET_ALL_KINDS = ("pods", "replicationcontrollers", "services",
+                 "daemonsets", "deployments", "replicasets",
+                 "statefulsets", "jobs", "cronjobs")
+
+
 def cmd_get(client, args, out):
+    if args.kind == "all":
+        # `kubectl get all` — the category expansion (pkg/kubectl
+        # categories.go legacyUserResources)
+        sel, fsel = _parse_selector_flags(args)
+        first = True
+        for plural in GET_ALL_KINDS:
+            ns = None if args.all_namespaces else args.namespace
+            objs, _ = client.list(plural, ns, label_selector=sel,
+                                  field_selector=fsel)
+            if not objs:
+                continue
+            if not first:
+                out.write("\n")
+            first = False
+            headers, row_fn = _COLUMNS.get(
+                plural, (["NAME", "AGE"],
+                         lambda o: [o.metadata.name, _age(o)]))
+            _write_table(headers,
+                         [[f"{plural}/{r[0]}"] + r[1:]
+                          for r in (row_fn(o) for o in objs)], out)
+        return
     plural = _resolve_kind(args.kind)
     sel, fsel = _parse_selector_flags(args)
     if args.name:

@@ -167,6 +167,30 @@ class RESTClient:
             q.append("labelSelector=" + enc(label_selector))
         if field_selector:
             q.append("fieldSelector=" + enc(field_selector))
+        return self._list_once(plural, namespace, q)
+
+    def list_paged(self, plural: str, namespace: Optional[str] = None,
+                   page_size: int = 500) -> Tuple[List[object], int]:
+        """Chunked list (client-go tools/pager ListPager): walk
+        ?limit=N/?continue pages until the server stops returning a
+        continue token. Same result as list(), bounded peak payload."""
+        kind = scheme.kind_for_plural(plural)
+        items: List[object] = []
+        cont = None
+        while True:
+            q = [f"limit={page_size}"]
+            if cont:
+                q.append(f"continue={cont}")
+            path = self._path(plural, namespace, None)
+            data = self.request("GET", path, query="&".join(q))
+            items.extend(scheme.decode(kind, d)
+                         for d in data.get("items", []))
+            rv = int(data.get("metadata", {}).get("resourceVersion", "0"))
+            cont = data.get("metadata", {}).get("continue")
+            if not cont:
+                return items, rv
+
+    def _list_once(self, plural, namespace, q):
         path = self._path(plural, namespace, None)
         if self.binary:
             from ..api import binary

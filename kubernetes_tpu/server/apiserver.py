@@ -795,17 +795,57 @@ class APIServer:
                     raise APIError(400, "BadRequest",
                                    f"unsupported fieldSelector {k!r}")
         kind = scheme.kind_for_plural(plural)
-        if self._wants_binary(h) and self._binary_ok(kind, gv):
+        # APIListChunking (1.11 beta; apiserver/pkg/storage continue
+        # tokens): ?limit=N pages a deterministic (namespace, name)
+        # ordering, ?continue resumes strictly after the token's last
+        # key — the same key-range resumption etcd pagination gives the
+        # reference (objects created mid-walk before the cursor are
+        # skipped, after it are included; no duplicates either way).
+        cont_out = None
+        limit = query.get("limit", [None])[0]
+        cont_in = query.get("continue", [None])[0]
+        if limit is not None or cont_in:
+            import base64
+
+            objs = sorted(objs, key=lambda o: (o.metadata.namespace or "",
+                                               o.metadata.name))
+            if cont_in:
+                try:
+                    last_ns, _, last_name = base64.urlsafe_b64decode(
+                        cont_in.encode()).decode().partition("/")
+                except Exception:
+                    raise APIError(400, "BadRequest",
+                                   "malformed continue token")
+                objs = [o for o in objs
+                        if ((o.metadata.namespace or ""), o.metadata.name)
+                        > (last_ns, last_name)]
+            if limit is not None:
+                try:
+                    n = int(limit)
+                except ValueError:
+                    raise APIError(400, "BadRequest",
+                                   f"invalid limit {limit!r}")
+                if 0 < n < len(objs):
+                    last = objs[n - 1]
+                    cont_out = base64.urlsafe_b64encode(
+                        f"{last.metadata.namespace or ''}/"
+                        f"{last.metadata.name}".encode()).decode()
+                    objs = objs[:n]
+        if self._wants_binary(h) and self._binary_ok(kind, gv) \
+                and cont_out is None:
             from ..api import binary
 
             h._send(200, binary.dumps_list(
                 kind, objs, self.store.latest_resource_version),
                 content_type=binary.CONTENT_TYPE)
             return
+        meta = {"resourceVersion": str(self.store.latest_resource_version)}
+        if cont_out:
+            meta["continue"] = cont_out
         body = json.dumps({
             "kind": kind + "List",
             "apiVersion": gv or scheme.api_version_for(kind),
-            "metadata": {"resourceVersion": str(self.store.latest_resource_version)},
+            "metadata": meta,
             "items": [scheme.encode_object(o, version=gv)
                       for o in objs]}).encode()
         h._send(200, body)

@@ -527,3 +527,40 @@ class TestSchedulerOverHTTP:
         assert sched.bind_overlap_hwm > 1
         assert wall < 16 * 0.05 + 0.5, f"binds serialized: {wall:.2f}s"
         store.stop()
+
+
+class TestListChunking:
+    """APIListChunking (?limit/?continue, 1.11 beta): deterministic
+    pages, strict-after resumption, pager reassembly."""
+
+    def test_pages_and_continue(self, server, client):
+        for i in range(7):
+            client.create("configmaps", api.ConfigMap(
+                metadata=api.ObjectMeta(name=f"cm{i:02d}"), data={}))
+        page1 = client.request("GET", "/api/v1/namespaces/default/configmaps",
+                               query="limit=3")
+        assert len(page1["items"]) == 3
+        cont = page1["metadata"]["continue"]
+        assert cont
+        page2 = client.request("GET", "/api/v1/namespaces/default/configmaps",
+                               query=f"limit=3&continue={cont}")
+        names = [i["metadata"]["name"] for i in page1["items"] + page2["items"]]
+        assert names == [f"cm{i:02d}" for i in range(6)]
+        # last page has no continue
+        cont2 = page2["metadata"]["continue"]
+        page3 = client.request("GET", "/api/v1/namespaces/default/configmaps",
+                               query=f"limit=3&continue={cont2}")
+        assert len(page3["items"]) == 1
+        assert "continue" not in page3["metadata"]
+
+    def test_pager_reassembles_and_bad_token_400(self, server, client):
+        for i in range(5):
+            client.create("configmaps", api.ConfigMap(
+                metadata=api.ObjectMeta(name=f"p{i}"), data={}))
+        items, rv = client.list_paged("configmaps", "default", page_size=2)
+        assert [o.metadata.name for o in items] == [f"p{i}" for i in range(5)]
+        assert rv > 0
+        with pytest.raises(APIStatusError) as ei:
+            client.request("GET", "/api/v1/namespaces/default/configmaps",
+                           query="limit=2&continue=%25%25not-b64")
+        assert ei.value.code == 400

@@ -758,3 +758,16 @@ class TestCreateGenerators:
         assert rc == 0
         pdb = seeded.get("poddisruptionbudgets", "default", "pdb1")
         assert pdb.spec.min_available == 1
+
+
+class TestGetAll:
+    def test_get_all_expands_categories(self, server, seeded):
+        seeded.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc1"),
+            spec=api.ServiceSpec(selector={"app": "w"},
+                                 ports=[api.ServicePort(port=80)])))
+        rc, out = run(server, "get", "all")
+        assert rc == 0
+        assert "pods/p1" in out and "services/svc1" in out
+        # empty kinds are omitted entirely
+        assert "deployments/" not in out
