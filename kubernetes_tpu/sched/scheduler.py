@@ -189,6 +189,13 @@ class Scheduler:
         # pallas round fails on this backend (separate from _use_pallas:
         # the per-wave and round programs fail independently)
         self._round_pallas: Optional[bool] = None
+        # first-pallas-round self-check pending? The Mosaic lowering is
+        # parity-tested in interpret mode on CPU, but the first REAL
+        # pallas round in each process is additionally compared against
+        # the XLA formulation on-device (warm_pipeline, or the first
+        # _run_pipeline if unwarmed) — a mismatch demotes to XLA rather
+        # than silently degrading placement quality
+        self._round_pallas_checked = False
         # preemptions performed by the batched pipeline path (tests +
         # bench assert the pipeline handled them, not per-wave fallback);
         # device_preemption=False forces round failures back through the
@@ -497,12 +504,28 @@ class Scheduler:
                 # transition NOW, outside any measured window. Real
                 # rounds then run in the (stable) degraded mode from a
                 # clean start instead of paying a 1-2.5s transition on
-                # their first result fetch.
+                # their first result fetch. Returning the placements
+                # also serves the first-pallas-round self-check below.
+                chosen = np.asarray(out[0])
                 np.asarray(out[3])
+                return chosen
 
             try:
                 try:
-                    _warm(self._round_pallas)
+                    got = _warm(self._round_pallas)
+                    if self._round_pallas and not self._round_pallas_checked:
+                        # on-device cross-check against the XLA
+                        # formulation (compile cost lands in the warm-up
+                        # window, never in a measured run)
+                        want = _warm(False)
+                        if not np.array_equal(got, want):
+                            import sys
+
+                            print("# pallas round MISMATCHES the XLA "
+                                  "formulation on this backend; "
+                                  "demoting to XLA", file=sys.stderr)
+                            self._round_pallas = False
+                        self._round_pallas_checked = True
                 except Exception:
                     # a faulting pallas warm must demote the round path
                     # HERE so the measured run compiles the same (XLA)
@@ -618,6 +641,19 @@ class Scheduler:
         try:
             try:
                 chosen_all, rr_end = _attempt(round_pallas)
+                if round_pallas and not self._round_pallas_checked:
+                    # unwarmed process: first-round on-device cross-check
+                    # (see warm_pipeline; one-time compile+exec cost)
+                    want, want_rr = _attempt(False)
+                    if not np.array_equal(chosen_all, want):
+                        import sys
+
+                        print("# pallas round MISMATCHES the XLA "
+                              "formulation on this backend; demoting "
+                              "to XLA", file=sys.stderr)
+                        self._round_pallas = round_pallas = False
+                        chosen_all, rr_end = want, want_rr
+                    self._round_pallas_checked = True
             except Exception as e:
                 if not round_pallas:
                     raise
