@@ -322,9 +322,24 @@ def cmd_get(client, args, out):
 
 
 def cmd_logs(client, args, out):
-    """kubectl logs <pod> [-c container] [--tail N] — the apiserver's
-    pods/<name>/log subresource proxies to the kubelet
-    (pkg/kubectl/cmd/logs.go -> registry/core/pod/rest/log.go)."""
+    """kubectl logs <pod> [-c container] [--tail N] [-f] — the
+    apiserver's pods/<name>/log subresource proxies to the kubelet
+    (pkg/kubectl/cmd/logs.go -> registry/core/pod/rest/log.go).
+    --follow re-arms the pods/<name>/attach long-poll over the same
+    container stream (SPDY streaming collapsed to cursor polls, like
+    kubectl attach) for --follow-rounds rounds."""
+    if args.follow:
+        since = 0
+        path = client._path("pods", args.namespace, args.name, "attach")
+        for _ in range(max(1, args.follow_rounds)):
+            q = [f"since={since}", f"waitSeconds={args.wait:g}"]
+            if args.container:
+                q.append(f"container={args.container}")
+            resp = client.request("GET", path, query="&".join(q))
+            for line in resp.get("lines", []):
+                out.write(line + "\n")
+            since = int(resp.get("next", since))
+        return 0
     q = []
     if args.container:
         q.append(f"container={args.container}")
@@ -1943,6 +1958,10 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("name")
     lg.add_argument("--container", "-c", default="")
     lg.add_argument("--tail", type=int, default=None)
+    lg.add_argument("--follow", "-f", action="store_true")
+    lg.add_argument("--follow-rounds", type=int, default=1,
+                    help="long-poll rounds to follow (SPDY stream analog)")
+    lg.add_argument("--wait", type=float, default=2.0)
 
     ec = sub.add_parser("exec")
     ec.add_argument("name")

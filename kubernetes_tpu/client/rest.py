@@ -246,6 +246,25 @@ class RESTClient:
     def delete(self, plural: str, namespace: Optional[str], name: str):
         self.request("DELETE", self._path(plural, namespace, name))
 
+    def delete_collection(self, plural: str,
+                          namespace: Optional[str] = None,
+                          label_selector=None, field_selector=None):
+        """Server-side deletecollection (one request deletes every
+        match; its own RBAC verb). Selectors as in list()."""
+        from urllib.parse import quote
+
+        q = []
+        if label_selector:
+            s = (label_selector if isinstance(label_selector, str) else
+                 ",".join(f"{k}={v}" for k, v in label_selector.items()))
+            q.append("labelSelector=" + quote(s, safe="=,!()"))
+        if field_selector:
+            s = (field_selector if isinstance(field_selector, str) else
+                 ",".join(f"{k}={v}" for k, v in field_selector.items()))
+            q.append("fieldSelector=" + quote(s, safe="=,"))
+        self.request("DELETE", self._path(plural, namespace, None),
+                     query="&".join(q))
+
     def bind(self, namespace: str, pod_name: str, node_name: str):
         """POST pods/<name>/binding (scheduler.go:409 Bind)."""
         self.request("POST", self._path("pods", namespace, pod_name, "binding"),

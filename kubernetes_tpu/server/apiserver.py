@@ -446,6 +446,10 @@ class APIServer:
             verb = "watch"
         if verb == "get" and name is None:
             verb = "list"
+        if verb == "delete" and name is None:
+            # DELETE on a collection URL (installer.go maps it to the
+            # "deletecollection" verb — its own RBAC attribute)
+            verb = "deletecollection"
 
         # flow control: watches are long-lived and exempt (the reference
         # exempts them too, maxinflight.go:49)
@@ -511,6 +515,9 @@ class APIServer:
                                       patch=(verb == "patch"), gv=gv)
         if verb == "delete":
             return self._serve_delete(h, plural, namespace, name, user)
+        if verb == "deletecollection":
+            return self._serve_delete_collection(h, plural, namespace,
+                                                 query, user)
         raise APIError(405, "MethodNotAllowed", f"{h.command} unsupported")
 
     # -- kubelet proxy subresources (pods/<name>/log, /exec) -------------------
@@ -1073,6 +1080,44 @@ class APIServer:
         self._delete_or_mark(plural, obj)
         h._send(200, _status_body(200, "Success", f"{name} deleted",
                                   status="Success"))
+
+    def _serve_delete_collection(self, h, plural, namespace, query, user):
+        """DELETE on a collection URL (registry Store.DeleteCollection):
+        every object the label/field selectors match is deleted through
+        the same admission + finalizer gate as a single delete."""
+        objs = self.store.list(plural, namespace)
+        sel = query.get("labelSelector", [None])[0]
+        if sel:
+            from ..api.labels import Selector
+
+            try:
+                parsed = Selector.parse(sel)
+            except ValueError:
+                raise APIError(400, "BadRequest",
+                               f"unparseable labelSelector {sel!r}")
+            objs = [o for o in objs
+                    if parsed.matches(o.metadata.labels or {})]
+        fsel = query.get("fieldSelector", [None])[0]
+        if fsel:
+            for kv in fsel.split(","):
+                k, _, v = kv.partition("=")
+                if k == "metadata.name":
+                    objs = [o for o in objs if o.metadata.name == v]
+                else:
+                    raise APIError(400, "BadRequest",
+                                   f"unsupported fieldSelector {k!r}")
+        deleted = 0
+        for obj in objs:
+            try:
+                self.admission.admit("delete", plural, None, obj, user,
+                                     self.store)
+            except AdmissionError:
+                continue  # per-object admission veto skips, not aborts
+            self._delete_or_mark(plural, obj)
+            deleted += 1
+        h._send(200, _status_body(
+            200, "Success", f"{deleted} {plural} deleted",
+            status="Success"))
 
     def _delete_or_mark(self, plural, obj) -> bool:
         """Finalizer-gated deletion (registry/generic/registry/store.go

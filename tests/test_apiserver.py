@@ -564,3 +564,47 @@ class TestListChunking:
             client.request("GET", "/api/v1/namespaces/default/configmaps",
                            query="limit=2&continue=%25%25not-b64")
         assert ei.value.code == 400
+
+
+class TestDeleteCollection:
+    def test_selector_scoped_server_side_delete(self, server, client):
+        for i, app in enumerate(["a", "a", "b"]):
+            p = mkpod(f"p{i}")
+            p.metadata.labels = {"app": app}
+            client.create("pods", p)
+        client.delete_collection("pods", "default", label_selector="app=a")
+        left = [p.metadata.name for p in server.store.list("pods")]
+        assert left == ["p2"]
+        # no selector = everything in the namespace
+        client.delete_collection("pods", "default")
+        assert server.store.list("pods") == []
+
+    def test_deletecollection_is_its_own_rbac_verb(self):
+        store = ObjectStore()
+        authn = TokenAuthenticator({
+            "t": UserInfo("bob", ())}, allow_anonymous=False)
+        # bob may delete single objects but NOT deletecollection
+        authz = RBACAuthorizer([
+            RoleBinding("bob", [PolicyRule(["get", "list", "delete",
+                                            "create"], ["*"])])])
+        srv = APIServer(store, authenticator=authn, authorizer=authz,
+                        admission=AdmissionChain()).start()
+        try:
+            c = RESTClient(srv.url, token="t")
+            c.create("pods", mkpod("p1"))
+            with pytest.raises(APIStatusError) as ei:
+                c.delete_collection("pods", "default")
+            assert ei.value.code == 403
+            c.delete("pods", "default", "p1")  # single delete still fine
+        finally:
+            srv.stop()
+
+    def test_finalizers_still_gate(self, server, client):
+        p = mkpod("fin")
+        p.metadata.finalizers = ["example.com/protect"]
+        client.create("pods", p)
+        client.delete_collection("pods", "default")
+        # marked, not removed: deletion waits on the finalizer
+        left = server.store.get("pods", "default", "fin")
+        assert left is not None
+        assert left.metadata.deletion_timestamp is not None

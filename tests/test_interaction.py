@@ -95,6 +95,23 @@ class TestAttach(_Fixture):
         for i in range(3):
             assert f"tick-{i}" in out, out
 
+    def test_logs_follow_rides_the_same_stream(self):
+        uid = self.pod.metadata.uid
+        self.node.runtime.append_log(uid, self.cname, "before")
+
+        def writer():
+            time.sleep(0.15)
+            self.node.runtime.append_log(uid, self.cname, "after")
+
+        t = threading.Thread(target=writer)
+        t.start()
+        rc, out = self.kubectl("logs", "web", "-f",
+                               "--follow-rounds", "3", "--wait", "1")
+        t.join()
+        assert rc == 0
+        # history AND the line appended after the follow armed
+        assert "before" in out and "after" in out, out
+
 
 class TestPortForward(_Fixture):
     def test_tcp_echo_through_the_full_chain(self):
