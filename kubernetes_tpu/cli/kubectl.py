@@ -329,17 +329,7 @@ def cmd_logs(client, args, out):
     container stream (SPDY streaming collapsed to cursor polls, like
     kubectl attach) for --follow-rounds rounds."""
     if args.follow:
-        since = 0
-        path = client._path("pods", args.namespace, args.name, "attach")
-        for _ in range(max(1, args.follow_rounds)):
-            q = [f"since={since}", f"waitSeconds={args.wait:g}"]
-            if args.container:
-                q.append(f"container={args.container}")
-            resp = client.request("GET", path, query="&".join(q))
-            for line in resp.get("lines", []):
-                out.write(line + "\n")
-            since = int(resp.get("next", since))
-        return 0
+        return _follow_stream(client, args, out, tail=args.tail)
     q = []
     if args.container:
         q.append(f"container={args.container}")
@@ -365,22 +355,37 @@ def cmd_exec(client, args, out):
     return int(resp.get("exitCode", 0))
 
 
-def cmd_attach(client, args, out):
-    """kubectl attach <pod> [-c container] [--follow-rounds N] — follow
-    the container's live output via the pods/<name>/attach long-poll
-    (pkg/kubectl/cmd/attach.go; SPDY collapsed to re-armed polls)."""
-    since = 0
-    rounds = max(1, args.follow_rounds)
+def _follow_stream(client, args, out, tail=None) -> int:
+    """Re-armed long-poll over pods/<name>/attach — the shared follow
+    loop behind `kubectl attach` and `kubectl logs -f`. tail=N starts
+    the cursor N lines before the current end instead of replaying the
+    whole history (logs --tail semantics under -f)."""
     path = client._path("pods", args.namespace, args.name, "attach")
-    for _ in range(rounds):
-        q = [f"since={since}", f"waitSeconds={args.wait:g}"]
+
+    def poll(since: int, wait: float):
+        q = [f"since={since}", f"waitSeconds={wait:g}"]
         if args.container:
             q.append(f"container={args.container}")
-        resp = client.request("GET", path, query="&".join(q))
+        return client.request("GET", path, query="&".join(q))
+
+    since = 0
+    if tail is not None:
+        # learn the current end without waiting, then back the cursor up
+        resp = poll(0, 0.0)
+        since = max(0, int(resp.get("next", 0)) - max(0, tail))
+    for _ in range(max(1, args.follow_rounds)):
+        resp = poll(since, args.wait)
         for line in resp.get("lines", []):
             out.write(line + "\n")
         since = int(resp.get("next", since))
     return 0
+
+
+def cmd_attach(client, args, out):
+    """kubectl attach <pod> [-c container] [--follow-rounds N] — follow
+    the container's live output via the pods/<name>/attach long-poll
+    (pkg/kubectl/cmd/attach.go; SPDY collapsed to re-armed polls)."""
+    return _follow_stream(client, args, out)
 
 
 def cmd_port_forward(client, args, out):
@@ -902,12 +907,14 @@ def cmd_apply(client, args, out):
         return
     if not args.filename:
         raise ManifestError("apply requires -f FILENAME")
+    applied: set = set()
     for doc in load_manifests(args.filename):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
             obj.metadata.namespace = args.namespace
             doc.setdefault("metadata", {})["namespace"] = args.namespace
+        applied.add((plural, obj.metadata.namespace, obj.metadata.name))
         try:
             cur = client.get(plural, obj.metadata.namespace,
                              obj.metadata.name)
@@ -942,6 +949,41 @@ def cmd_apply(client, args, out):
         out.write(f"{plural}/{obj.metadata.name} configured\n")
         if isinstance(obj, api.CustomResourceDefinition):
             scheme.register_dynamic(obj)
+    if args.prune:
+        _apply_prune(client, args, applied, out)
+
+
+# the reference's default prune whitelist (apply.go prune.go
+# pruneResources): the workload + config kinds apply typically manages
+PRUNE_WHITELIST = ("configmaps", "secrets", "services", "endpoints",
+                   "persistentvolumeclaims", "pods",
+                   "replicationcontrollers", "deployments", "replicasets",
+                   "statefulsets", "daemonsets", "jobs", "cronjobs")
+
+
+def _apply_prune(client, args, applied: set, out):
+    """apply --prune -l SELECTOR (pkg/kubectl/cmd/apply.go prune):
+    delete objects that (a) match the selector, (b) carry the
+    last-applied annotation (so only apply-managed objects are ever
+    pruned), and (c) are absent from this apply's manifest set."""
+    if not args.selector:
+        raise ManifestError("--prune requires -l (a label selector "
+                            "scoping what this apply owns)")
+    for plural in PRUNE_WHITELIST:
+        try:
+            objs, _ = client.list(plural, args.namespace,
+                                  label_selector=args.selector)
+        except APIStatusError:
+            continue
+        for o in objs:
+            key = (plural, o.metadata.namespace, o.metadata.name)
+            if key in applied:
+                continue
+            if LAST_APPLIED_ANNOTATION not in (o.metadata.annotations
+                                               or {}):
+                continue
+            client.delete(plural, o.metadata.namespace, o.metadata.name)
+            out.write(f"{plural}/{o.metadata.name} pruned\n")
 
 
 def cmd_delete(client, args, out):
@@ -1918,6 +1960,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap_apply.add_argument("kind", nargs="?")
     ap_apply.add_argument("name", nargs="?")
     ap_apply.add_argument("--filename", "-f", default=None)
+    ap_apply.add_argument("--prune", action="store_true")
+    ap_apply.add_argument("--selector", "-l", default=None)
 
     dl = sub.add_parser("delete")
     dl.add_argument("kind")

@@ -19,6 +19,26 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..api import scheme
 
 
+def _selector_query(label_selector=None, field_selector=None) -> List[str]:
+    """Selector args (dict or raw string) -> query fragments. One
+    encoder for list() and delete_collection() — the safe-char set
+    keeps set-based syntax (`in (a,b)`, `!key`) readable server-side."""
+    from urllib.parse import quote
+
+    def enc(sel):
+        if isinstance(sel, str):
+            return quote(sel, safe="=,!()")
+        return quote(",".join(f"{k}={v}" for k, v in sel.items()),
+                     safe="=,")
+
+    q = []
+    if label_selector:
+        q.append("labelSelector=" + enc(label_selector))
+    if field_selector:
+        q.append("fieldSelector=" + enc(field_selector))
+    return q
+
+
 def pem_arg(v):
     """CLI PEM argument: literal PEM text, or @/path/to/file."""
     if v and v.startswith("@"):
@@ -154,20 +174,9 @@ class RESTClient:
         {key: value} dicts or raw selector STRINGS (set-based
         expressions like "tier in (a,b)" pass through verbatim to the
         server's parser)."""
-        from urllib.parse import quote
-
-        def enc(sel):
-            if isinstance(sel, str):
-                return quote(sel, safe="=,!()")
-            return quote(",".join(f"{k}={v}" for k, v in sel.items()),
-                         safe="=,")
-
-        q = []
-        if label_selector:
-            q.append("labelSelector=" + enc(label_selector))
-        if field_selector:
-            q.append("fieldSelector=" + enc(field_selector))
-        return self._list_once(plural, namespace, q)
+        return self._list_once(plural, namespace,
+                               _selector_query(label_selector,
+                                               field_selector))
 
     def list_paged(self, plural: str, namespace: Optional[str] = None,
                    page_size: int = 500) -> Tuple[List[object], int]:
@@ -251,19 +260,9 @@ class RESTClient:
                           label_selector=None, field_selector=None):
         """Server-side deletecollection (one request deletes every
         match; its own RBAC verb). Selectors as in list()."""
-        from urllib.parse import quote
-
-        q = []
-        if label_selector:
-            s = (label_selector if isinstance(label_selector, str) else
-                 ",".join(f"{k}={v}" for k, v in label_selector.items()))
-            q.append("labelSelector=" + quote(s, safe="=,!()"))
-        if field_selector:
-            s = (field_selector if isinstance(field_selector, str) else
-                 ",".join(f"{k}={v}" for k, v in field_selector.items()))
-            q.append("fieldSelector=" + quote(s, safe="=,"))
         self.request("DELETE", self._path(plural, namespace, None),
-                     query="&".join(q))
+                     query="&".join(_selector_query(label_selector,
+                                                    field_selector)))
 
     def bind(self, namespace: str, pod_name: str, node_name: str):
         """POST pods/<name>/binding (scheduler.go:409 Bind)."""

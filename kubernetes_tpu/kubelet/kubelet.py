@@ -45,6 +45,10 @@ class _ProbeState:
         self.last_run = 0.0
 
 
+MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+CONFIG_SOURCE_ANNOTATION = "kubernetes.io/config.source"
+
+
 class Kubelet:
     def __init__(self, store, node_name: str,
                  allocatable: Optional[Dict[str, int]] = None,
@@ -55,7 +59,8 @@ class Kubelet:
                  heartbeat_period: float = 10.0,
                  memory_pressure_threshold: float = 0.9,
                  resync_interval: float = 0.0,
-                 async_workers: bool = False):
+                 async_workers: bool = False,
+                 manifest_dir: Optional[str] = None):
         """resync_interval=0 fully resyncs every pod each iteration (the
         deterministic test mode); >0 switches to event-driven syncs —
         only pods with config changes or PLEG events sync between full
@@ -101,6 +106,11 @@ class Kubelet:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.server = None  # KubeletServer once serve() is called
+        # static pods (pkg/kubelet/config/file.go): --pod-manifest-path
+        # directory of pod manifests run independently of the apiserver,
+        # surfaced there as MIRROR pods (pkg/kubelet/pod/mirror_client.go)
+        self.manifest_dir = manifest_dir
+        self._static_by_uid: Dict[str, api.Pod] = {}
         self.register_node()
 
     # -- node registration + heartbeat (kubelet_node_status.go) ----------------
@@ -189,8 +199,103 @@ class Kubelet:
     # -- pod views -------------------------------------------------------------
 
     def _my_pods(self) -> List[api.Pod]:
+        # mirror pods are the apiserver's VIEW of static pods, never a
+        # sync source (pod_manager.go: mirror pods map back to their
+        # static pod; syncing one directly would double-run it)
         return [p for p in self.store.list("pods")
-                if p.spec.node_name == self.node_name]
+                if p.spec.node_name == self.node_name
+                and MIRROR_ANNOTATION not in (p.metadata.annotations or {})]
+
+    # -- static pods + mirror pods (config/file.go, pod/mirror_client.go) ------
+
+    def _read_static_pods(self) -> Dict[str, api.Pod]:
+        """Manifest dir -> {uid: static pod}. Name gets the -<node>
+        suffix, uid derives from the file content hash — a changed file
+        IS a different static pod (the reference restarts it the same
+        way)."""
+        import hashlib
+        import os
+
+        from ..api import scheme
+
+        out: Dict[str, api.Pod] = {}
+        if not self.manifest_dir or not os.path.isdir(self.manifest_dir):
+            return out
+        for fname in sorted(os.listdir(self.manifest_dir)):
+            if not fname.endswith((".json", ".yaml", ".yml")):
+                continue
+            path = os.path.join(self.manifest_dir, fname)
+            try:
+                text = open(path).read()
+                if text.lstrip().startswith("{"):
+                    import json as _json
+
+                    doc = _json.loads(text)
+                else:
+                    import yaml
+
+                    doc = yaml.safe_load(text)
+                if not doc or doc.get("kind") != "Pod":
+                    continue
+                pod = scheme.decode_object(doc)
+            except Exception:
+                continue  # a broken manifest must not kill the kubelet
+            uid = "static-" + hashlib.sha1(
+                (fname + text).encode()).hexdigest()[:12]
+            pod.metadata.name = f"{pod.metadata.name}-{self.node_name}"
+            pod.metadata.uid = uid
+            pod.metadata.annotations = dict(pod.metadata.annotations or {})
+            pod.metadata.annotations[CONFIG_SOURCE_ANNOTATION] = \
+                f"file:{path}"
+            pod.spec.node_name = self.node_name
+            out[uid] = pod
+        return out
+
+    def _is_static(self, pod: api.Pod) -> bool:
+        return (pod.metadata.annotations or {}).get(
+            CONFIG_SOURCE_ANNOTATION, "").startswith("file:")
+
+    def _sync_static_pods(self) -> List[api.Pod]:
+        """Reconcile the manifest dir: kill containers of removed/changed
+        static pods, delete their mirrors, and (re)create a mirror pod
+        for each live static pod. Returns the static pods to sync."""
+        current = self._read_static_pods()
+        for uid, old in list(self._static_by_uid.items()):
+            if uid not in current:
+                self.runtime.kill_pod(uid)
+                try:
+                    self.store.delete("pods", old.metadata.namespace,
+                                      old.metadata.name)
+                except KeyError:
+                    pass
+                self._pod_start.pop(uid, None)
+        self._static_by_uid = current
+        for uid, pod in current.items():
+            mirror = self.store.get("pods", pod.metadata.namespace,
+                                    pod.metadata.name)
+            want_ann = uid
+            if mirror is not None and (mirror.metadata.annotations or {})\
+                    .get(MIRROR_ANNOTATION) != want_ann:
+                # stale mirror for an older file version (or an impostor
+                # object squatting the name): replace it
+                try:
+                    self.store.delete("pods", mirror.metadata.namespace,
+                                      mirror.metadata.name)
+                except KeyError:
+                    pass
+                mirror = None
+            if mirror is None:
+                import copy
+
+                m = copy.deepcopy(pod)
+                m.metadata.uid = ""  # store assigns its own
+                m.metadata.resource_version = 0
+                m.metadata.annotations[MIRROR_ANNOTATION] = want_ann
+                try:
+                    self.store.create("pods", m)
+                except Exception:
+                    pass  # racing another component: next sync retries
+        return list(current.values())
 
     # -- admission (lifecycle/predicate.go canAdmitPod) ------------------------
 
@@ -219,6 +324,8 @@ class Kubelet:
         self.runtime.tick(now)
         self._iter_node = self._get_node()  # one node fetch per iteration
         pods = self._my_pods()
+        if self.manifest_dir:
+            pods = self._sync_static_pods() + pods
         active = [p for p in pods
                   if p.status.phase in ("", "Pending", "Running")]
         pleg_events = self.pleg.relist()
@@ -402,7 +509,21 @@ class Kubelet:
             self._update_status(pod)
 
     def _update_status(self, pod: api.Pod):
-        """status/status_manager.go syncPod: PATCH status to the apiserver."""
+        """status/status_manager.go syncPod: PATCH status to the
+        apiserver. A static pod's status lands on its MIRROR pod — the
+        apiserver-visible stand-in (status_manager.go syncPod resolves
+        the mirror uid the same way)."""
+        if self._is_static(pod):
+            mirror = self.store.get("pods", pod.metadata.namespace,
+                                    pod.metadata.name)
+            if mirror is not None and (mirror.metadata.annotations or {})\
+                    .get(MIRROR_ANNOTATION) == pod.metadata.uid:
+                mirror.status = pod.status
+                try:
+                    self.store.update("pods", mirror)
+                except (Conflict, KeyError):
+                    pass
+            return
         try:
             self.store.update("pods", pod)
         except (Conflict, KeyError):
@@ -423,8 +544,13 @@ class Kubelet:
             self._memory_requested() > self.memory_pressure_threshold * alloc
 
     def _housekeeping(self, now: float):
-        # clean up runtime state for pods that vanished from the apiserver
-        live_uids = {p.metadata.uid for p in self._my_pods()}
+        # clean up runtime state for pods that vanished from the
+        # apiserver — static pods live under their FILE-derived uid,
+        # which never appears in the store (only their mirror does), so
+        # they must be counted as live here or housekeeping would kill
+        # every static pod one iteration after it starts
+        live_uids = ({p.metadata.uid for p in self._my_pods()}
+                     | set(self._static_by_uid))
         # snapshot first: async pod workers may insert into _pod_start
         # concurrently (plain membership iteration would RuntimeError)
         for uid in [u for u in list(self._pod_start) if u not in live_uids]:

@@ -94,6 +94,18 @@ class KubeletServer:
             return None  # only pods bound to THIS node are served
         return pod
 
+    @staticmethod
+    def _runtime_uid(pod) -> str:
+        """The uid the RUNTIME knows the pod by. A static pod's
+        apiserver object is its mirror, whose containers run under the
+        file-derived static uid recorded in the mirror annotation
+        (pod/mirror_client.go TranslatePodUID) — without this
+        translation logs/exec/attach/stats against static pods 404."""
+        from .kubelet import MIRROR_ANNOTATION
+
+        return ((pod.metadata.annotations or {}).get(MIRROR_ANNOTATION)
+                or pod.metadata.uid)
+
     def _authorized(self, h) -> bool:
         """Exec/log callers must hold the apiserver's kubelet-client
         identity or system:masters (see module docstring). Plain-HTTP
@@ -142,7 +154,7 @@ class KubeletServer:
                     return h._send(400, b"tailLines must be an integer",
                                    "text/plain")
             lines = self.kubelet.runtime.container_logs(
-                pod.metadata.uid, container, tail=tail)
+                self._runtime_uid(pod), container, tail=tail)
             if lines is None:
                 return h._send(404, f"container {container!r} not found"
                                .encode(), "text/plain")
@@ -163,7 +175,7 @@ class KubeletServer:
             if not cmd:
                 return h._send(400, b"no command", "text/plain")
             rc, out = self.kubelet.runtime.exec_in_container(
-                pod.metadata.uid, container, cmd, stdin=stdin)
+                self._runtime_uid(pod), container, cmd, stdin=stdin)
             return h._send(200, json.dumps(
                 {"exitCode": rc, "output": out}).encode())
         if len(parts) == 4 and parts[0] == "attach" and method == "GET":
@@ -186,7 +198,7 @@ class KubeletServer:
             deadline = _time.monotonic() + wait
             while True:
                 lines = self.kubelet.runtime.container_logs(
-                    pod.metadata.uid, container)
+                    self._runtime_uid(pod), container)
                 if lines is None:
                     return h._send(404, f"container {container!r} not "
                                    f"found".encode(), "text/plain")
@@ -212,7 +224,7 @@ class KubeletServer:
                 port = int(body.get("port"))
             except (ValueError, TypeError):
                 return h._send(400, b"bad portForward body", "text/plain")
-            backend = self.kubelet.runtime.pod_server(pod.metadata.uid,
+            backend = self.kubelet.runtime.pod_server(self._runtime_uid(pod),
                                                       port)
             if backend is None:
                 return h._send(400, f"pod {pod_name!r} has no listener "
@@ -234,7 +246,7 @@ class KubeletServer:
             containers = []
             cpu_nanos = 0
             mem = 0
-            for st in self.kubelet.runtime.container_stats(p.metadata.uid):
+            for st in self.kubelet.runtime.container_stats(self._runtime_uid(p)):
                 c_nanos = st.cpu_millicores * 1_000_000
                 containers.append({
                     "name": st.name,

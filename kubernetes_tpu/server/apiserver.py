@@ -764,8 +764,11 @@ class APIServer:
 
     # -- verbs -----------------------------------------------------------------
 
-    def _serve_list(self, h, plural, namespace, query, gv=None):
-        objs = self.store.list(plural, namespace)
+    @staticmethod
+    def _filter_by_selectors(objs, query):
+        """?labelSelector / ?fieldSelector filtering, shared by list and
+        deletecollection (the reference routes both through the same
+        storage predicate)."""
         sel = query.get("labelSelector", [None])[0]
         if sel:
             from ..api.labels import Selector
@@ -801,6 +804,11 @@ class APIServer:
                 else:
                     raise APIError(400, "BadRequest",
                                    f"unsupported fieldSelector {k!r}")
+        return objs
+
+    def _serve_list(self, h, plural, namespace, query, gv=None):
+        objs = self._filter_by_selectors(self.store.list(plural, namespace),
+                                         query)
         kind = scheme.kind_for_plural(plural)
         # APIListChunking (1.11 beta; apiserver/pkg/storage continue
         # tokens): ?limit=N pages a deterministic (namespace, name)
@@ -1085,27 +1093,8 @@ class APIServer:
         """DELETE on a collection URL (registry Store.DeleteCollection):
         every object the label/field selectors match is deleted through
         the same admission + finalizer gate as a single delete."""
-        objs = self.store.list(plural, namespace)
-        sel = query.get("labelSelector", [None])[0]
-        if sel:
-            from ..api.labels import Selector
-
-            try:
-                parsed = Selector.parse(sel)
-            except ValueError:
-                raise APIError(400, "BadRequest",
-                               f"unparseable labelSelector {sel!r}")
-            objs = [o for o in objs
-                    if parsed.matches(o.metadata.labels or {})]
-        fsel = query.get("fieldSelector", [None])[0]
-        if fsel:
-            for kv in fsel.split(","):
-                k, _, v = kv.partition("=")
-                if k == "metadata.name":
-                    objs = [o for o in objs if o.metadata.name == v]
-                else:
-                    raise APIError(400, "BadRequest",
-                                   f"unsupported fieldSelector {k!r}")
+        objs = self._filter_by_selectors(self.store.list(plural, namespace),
+                                         query)
         deleted = 0
         for obj in objs:
             try:

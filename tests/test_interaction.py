@@ -201,3 +201,37 @@ class TestKubectlPatchAnnotateEditCp(_Fixture):
         rc, _ = self.kubectl("cp", "web:/app/config.ini", str(dst))
         assert rc == 0
         assert dst.read_text() == "mode=fast\n"
+
+
+class TestStaticPodInteraction(_Fixture):
+    def test_logs_and_exec_reach_static_pods(self, tmp_path):
+        """The mirror pod's runtime uid translation: logs/exec against
+        a static pod must hit the containers running under the
+        file-derived static uid (pod/mirror_client.go TranslatePodUID)."""
+        (tmp_path / "etcd.yaml").write_text("""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: etcd
+spec:
+  containers:
+  - name: etcd
+    image: etcd:3.2
+""")
+        self.node.kubelet.manifest_dir = str(tmp_path)
+        self.node.kubelet.sync_once()
+        uid = list(self.node.kubelet._static_by_uid)[0]
+        self.node.runtime.append_log(uid, "etcd", "serving on 2379")
+        rc, out = self.kubectl("logs", "etcd-n1")
+        assert rc == 0 and "serving on 2379" in out, out
+        rc, out = self.kubectl("exec", "etcd-n1", "env")
+        assert rc == 0
+
+    def test_logs_tail_with_follow(self):
+        uid = self.pod.metadata.uid
+        for i in range(10):
+            self.node.runtime.append_log(uid, self.cname, f"old-{i}")
+        rc, out = self.kubectl("logs", "web", "-f", "--tail", "2",
+                               "--wait", "0.1")
+        assert rc == 0
+        assert "old-9" in out and "old-8" in out and "old-0" not in out, out

@@ -771,3 +771,35 @@ class TestGetAll:
         assert "pods/p1" in out and "services/svc1" in out
         # empty kinds are omitted entirely
         assert "deployments/" not in out
+
+
+class TestApplyPrune:
+    def test_prune_deletes_dropped_applied_objects(self, server, seeded,
+                                                   tmp_path):
+        import yaml
+
+        def manifest(names):
+            return "\n---\n".join(yaml.safe_dump({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": n, "namespace": "default",
+                             "labels": {"managed": "app1"}},
+                "data": {"v": "1"}}) for n in names)
+
+        m = tmp_path / "set.yaml"
+        m.write_text(manifest(["a", "b", "c"]))
+        rc, _ = run(server, "apply", "-f", str(m))
+        assert rc == 0
+        # an unmanaged object matching the selector must SURVIVE prune
+        seeded.create("configmaps", api.ConfigMap(
+            metadata=api.ObjectMeta(name="byhand",
+                                    labels={"managed": "app1"}), data={}))
+        m.write_text(manifest(["a", "c"]))
+        rc, out = run(server, "apply", "-f", str(m), "--prune",
+                      "-l", "managed=app1")
+        assert rc == 0 and "configmaps/b pruned" in out
+        names = {c.metadata.name
+                 for c in server.store.list("configmaps")}
+        assert names == {"a", "c", "byhand"}
+        # --prune without a selector is refused
+        rc, _ = run(server, "apply", "-f", str(m), "--prune")
+        assert rc == 1

@@ -384,3 +384,91 @@ class TestQoSClasses:
                              "guaranteed").status.phase != "Failed"
         finally:
             node.stop()
+
+
+class TestStaticPods:
+    """--pod-manifest-path static pods + mirror pods
+    (pkg/kubelet/config/file.go, pkg/kubelet/pod/mirror_client.go)."""
+
+    MANIFEST = """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: etcd
+  namespace: kube-system
+spec:
+  containers:
+  - name: etcd
+    image: etcd:3.2
+"""
+
+    def test_static_pod_runs_and_mirrors(self, tmp_path):
+        from kubernetes_tpu.kubelet.kubelet import (MIRROR_ANNOTATION,
+                                                    Kubelet)
+
+        (tmp_path / "etcd.yaml").write_text(self.MANIFEST)
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0],
+                     manifest_dir=str(tmp_path))
+        kl.sync_once()
+        # the mirror pod is apiserver-visible under <name>-<node>
+        mirror = store.get("pods", "kube-system", "etcd-n1")
+        assert mirror is not None
+        assert MIRROR_ANNOTATION in mirror.metadata.annotations
+        # container actually started in the runtime under the STATIC uid
+        static_uid = mirror.metadata.annotations[MIRROR_ANNOTATION]
+        assert kl.runtime.get(static_uid, "etcd") is not None
+        now[0] += 1
+        kl.sync_once()
+        mirror = store.get("pods", "kube-system", "etcd-n1")
+        assert mirror.status.phase == "Running"
+
+    def test_manifest_removal_kills_and_unmirrors(self, tmp_path):
+        from kubernetes_tpu.kubelet.kubelet import Kubelet
+
+        f = tmp_path / "etcd.yaml"
+        f.write_text(self.MANIFEST)
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0],
+                     manifest_dir=str(tmp_path))
+        kl.sync_once()
+        uid = list(kl._static_by_uid)[0]
+        f.unlink()
+        now[0] += 1
+        kl.sync_once()
+        assert store.get("pods", "kube-system", "etcd-n1") is None
+        assert kl.runtime.pod_containers(uid) == []
+
+    def test_changed_manifest_replaces_mirror(self, tmp_path):
+        from kubernetes_tpu.kubelet.kubelet import (MIRROR_ANNOTATION,
+                                                    Kubelet)
+
+        f = tmp_path / "etcd.yaml"
+        f.write_text(self.MANIFEST)
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0],
+                     manifest_dir=str(tmp_path))
+        kl.sync_once()
+        old_uid = store.get("pods", "kube-system", "etcd-n1") \
+            .metadata.annotations[MIRROR_ANNOTATION]
+        f.write_text(self.MANIFEST.replace("etcd:3.2", "etcd:3.3"))
+        now[0] += 1
+        kl.sync_once()
+        mirror = store.get("pods", "kube-system", "etcd-n1")
+        new_uid = mirror.metadata.annotations[MIRROR_ANNOTATION]
+        assert new_uid != old_uid
+        assert mirror.spec.containers[0].image == "etcd:3.3"
+
+    def test_mirror_pod_recreated_if_deleted(self, tmp_path):
+        from kubernetes_tpu.kubelet.kubelet import Kubelet
+
+        (tmp_path / "etcd.yaml").write_text(self.MANIFEST)
+        store = ObjectStore()
+        kl = Kubelet(store, "n1", manifest_dir=str(tmp_path))
+        kl.sync_once()
+        store.delete("pods", "kube-system", "etcd-n1")
+        kl.sync_once()
+        assert store.get("pods", "kube-system", "etcd-n1") is not None
