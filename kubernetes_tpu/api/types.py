@@ -116,6 +116,9 @@ class Container:
     privileged: bool = False  # securityContext.privileged, flattened
     # EnvVar list collapsed to a name->value map (no valueFrom sources)
     env: Dict[str, str] = field(default_factory=dict)
+    # v1 Container.Command (entrypoint); init containers run it to
+    # completion through the fake runtime's exec interpreter
+    command: List[str] = field(default_factory=list)
 
 
 # --- taints & tolerations ---------------------------------------------------

@@ -969,21 +969,27 @@ def _apply_prune(client, args, applied: set, out):
     if not args.selector:
         raise ManifestError("--prune requires -l (a label selector "
                             "scoping what this apply owns)")
+    # prune everywhere this apply touched, not just -n: a manifest may
+    # declare its own metadata.namespace (the reference prunes across
+    # every namespace the apply visited)
+    namespaces = {args.namespace} | {ns for _, ns, _ in applied if ns}
     for plural in PRUNE_WHITELIST:
-        try:
-            objs, _ = client.list(plural, args.namespace,
-                                  label_selector=args.selector)
-        except APIStatusError:
-            continue
-        for o in objs:
-            key = (plural, o.metadata.namespace, o.metadata.name)
-            if key in applied:
+        for ns in sorted(namespaces):
+            try:
+                objs, _ = client.list(plural, ns,
+                                      label_selector=args.selector)
+            except APIStatusError:
                 continue
-            if LAST_APPLIED_ANNOTATION not in (o.metadata.annotations
-                                               or {}):
-                continue
-            client.delete(plural, o.metadata.namespace, o.metadata.name)
-            out.write(f"{plural}/{o.metadata.name} pruned\n")
+            for o in objs:
+                key = (plural, o.metadata.namespace, o.metadata.name)
+                if key in applied:
+                    continue
+                if LAST_APPLIED_ANNOTATION not in (o.metadata.annotations
+                                                   or {}):
+                    continue
+                client.delete(plural, o.metadata.namespace,
+                              o.metadata.name)
+                out.write(f"{plural}/{o.metadata.name} pruned\n")
 
 
 def cmd_delete(client, args, out):

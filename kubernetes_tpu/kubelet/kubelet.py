@@ -268,7 +268,16 @@ class Kubelet:
                                       old.metadata.name)
                 except KeyError:
                     pass
-                self._pod_start.pop(uid, None)
+                # per-uid map cleanup is housekeeping's job: it keys off
+                # _pod_start entries whose uid is no longer live, so the
+                # entry must SURVIVE until that sweep or the other maps
+                # (_known_pod_rvs, _crash_backoff, ...) leak forever
+            elif old.status.phase:
+                # re-decoded manifests start with an empty status; carry
+                # the accumulated one over or every full resync sees a
+                # phase "change" and rewrites the mirror (spurious
+                # MODIFIED events for watchers)
+                current[uid].status = old.status
         self._static_by_uid = current
         for uid, pod in current.items():
             mirror = self.store.get("pods", pod.metadata.namespace,
@@ -388,6 +397,8 @@ class Kubelet:
             # this node; retried on later syncs
             self._needs_retry.add(uid)
             return
+        if not self._init_containers_done(pod, now):
+            return
         for c in pod.spec.containers:
             st = self.runtime.get(uid, c.name)
             if st is None or st.state not in (RUNNING,):
@@ -472,6 +483,67 @@ class Kubelet:
                     self.runtime.crash_container(uid, c.name, exit_code=137)
                     ps.failures = 0
 
+    def _init_containers_done(self, pod: api.Pod, now: float) -> bool:
+        """Run init containers SEQUENTIALLY to completion before any app
+        container starts (kuberuntime computePodActions: the next init
+        starts only after the previous exited 0; a failure restarts per
+        policy with the shared crash backoff, or fails the pod under
+        restartPolicy Never). Returns True when all inits have
+        succeeded."""
+        inits = pod.spec.init_containers
+        if not inits:
+            return True
+        uid = pod.metadata.uid
+        done = 0
+        for c in inits:
+            st = self.runtime.get(uid, c.name)
+            if st is not None and st.state == EXITED and st.exit_code == 0:
+                done += 1
+                continue
+            if st is None or st.state == EXITED:
+                if st is not None and st.state == EXITED:
+                    # failed init: restartPolicy Never fails the pod
+                    # outright (kuberuntime: init failure is terminal
+                    # under Never); otherwise crash-backoff then rerun
+                    if pod.spec.restart_policy == "Never":
+                        pod.status.phase = "Failed"
+                        pod.status.conditions = [
+                            ("PodScheduled", "True"),
+                            ("Initialized",
+                             f"False:Init:Error:{c.name}"),
+                            ("Ready", "False")]
+                        self._update_status(pod)
+                        return False
+                    key = (uid, c.name)
+                    until = self._crash_backoff_until.get(key, 0.0)
+                    if now < until:
+                        self._needs_retry.add(uid)
+                        break
+                    delay = min(max(
+                        self._crash_backoff.get(key, 0.0) * 2,
+                        CRASH_BACKOFF_BASE), CRASH_BACKOFF_MAX)
+                    self._crash_backoff[key] = delay
+                    self._crash_backoff_until[key] = now + delay
+                    st.restart_count += 1
+                self._last_container_start[(uid, c.name)] = now
+                self.runtime.start_container(
+                    uid, c.name, now, env=dict(c.env or {}),
+                    run_to_completion=True,
+                    command=list(c.command or []))
+            # running (or just started): wait for it — next tick exits it
+            self._needs_retry.add(uid)
+            break
+        if done == len(inits):
+            return True
+        pod.status.phase = "Pending"
+        conds = [("PodScheduled", "True"),
+                 ("Initialized", f"False:Init:{done}/{len(inits)}"),
+                 ("Ready", "False")]
+        if conds != pod.status.conditions:
+            pod.status.conditions = conds
+            self._update_status(pod)
+        return False
+
     def _update_pod_status(self, pod: api.Pod, now: float):
         uid = pod.metadata.uid
         states = [self.runtime.get(uid, c.name) for c in pod.spec.containers]
@@ -497,6 +569,7 @@ class Kubelet:
             and self.runtime.get(uid, c.name) is not None)
         ready = ready and readiness_gate
         new_conds = [("PodScheduled", "True"),
+                     ("Initialized", "True"),  # app syncs run post-init
                      ("Ready", "True" if ready else "False")]
         qos = api.pod_qos_class(pod)
         if (phase != pod.status.phase or new_conds != pod.status.conditions
@@ -532,8 +605,11 @@ class Kubelet:
     # -- eviction manager (pkg/kubelet/eviction/) ------------------------------
 
     def _memory_requested(self) -> int:
+        # static pods count too (they're absent from the store and their
+        # mirrors are filtered): admission and pressure accounting must
+        # see the same pod set
         total = 0
-        for p in self._my_pods():
+        for p in list(self._my_pods()) + list(self._static_by_uid.values()):
             if p.status.phase in ("", "Pending", "Running"):
                 total += api.get_resource_request(p).get(res.MEMORY, 0)
         return total
@@ -577,7 +653,8 @@ class Kubelet:
         qos_rank = {api.QOS_BEST_EFFORT: 0, api.QOS_BURSTABLE: 1,
                     api.QOS_GUARANTEED: 2}
         candidates = sorted(
-            (p for p in self._my_pods()
+            (p for p in (list(self._my_pods())
+                         + list(self._static_by_uid.values()))
              if p.status.phase in ("Pending", "Running")),
             key=lambda p: (qos_rank[api.pod_qos_class(p)],
                            api.pod_priority(p),

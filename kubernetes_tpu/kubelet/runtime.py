@@ -48,13 +48,23 @@ class FakeRuntime:
         self.containers: Dict[Tuple[str, str], ContainerState] = {}
         self.start_latency = start_latency  # simulated image pull/start time
         self._pending_start: Dict[Tuple[str, str], float] = {}
+        # (pod_uid, name) -> command: run-to-completion containers
+        # (inits) executed + exited on the tick after they start
+        self._pending_exit: Dict[Tuple[str, str], List[str]] = {}
         # (pod_uid, port) -> (host, backend_port): pod TCP listeners
         self._pod_servers: Dict[Tuple[str, int], Tuple[str, int]] = {}
 
     # -- CRI-ish surface -------------------------------------------------------
 
     def start_container(self, pod_uid: str, name: str, now: float,
-                        env: Optional[Dict[str, str]] = None):
+                        env: Optional[Dict[str, str]] = None,
+                        run_to_completion: bool = False,
+                        command: Optional[List[str]] = None):
+        """run_to_completion (init containers): the container starts
+        RUNNING, then on the NEXT tick executes its command through the
+        exec interpreter and EXITS with its code (0 when commandless) —
+        one observable Running->Exited transition per init, like a real
+        short-lived container."""
         with self._lock:
             key = (pod_uid, name)
             st = self.containers.get(key)
@@ -64,6 +74,8 @@ class FakeRuntime:
             if env:
                 st.env = dict(env)
             if st.state != RUNNING:
+                if run_to_completion:
+                    self._pending_exit[key] = list(command or [])
                 if self.start_latency > 0:
                     self._pending_start.setdefault(key, now + self.start_latency)
                 else:
@@ -84,6 +96,22 @@ class FakeRuntime:
                         st.logs.append(f"container {key[1]} started")
                         events.append((key[0], key[1], "ContainerStarted"))
                     self._pending_start.pop(key, None)
+            exiting = [(k, cmd) for k, cmd in self._pending_exit.items()
+                       if k not in self._pending_start
+                       and (st := self.containers.get(k)) is not None
+                       and st.state == RUNNING]
+        for key, cmd in exiting:
+            st = self.containers[key]
+            rc, out = (self._interpret(st, key[0], cmd, None) if cmd
+                       else (0, ""))
+            with self._lock:
+                if out:
+                    st.logs.append(out)
+                st.state = EXITED
+                st.exit_code = rc
+                st.finished_at = now
+                self._pending_exit.pop(key, None)
+            events.append((key[0], key[1], "ContainerDied"))
         return events
 
     def kill_pod(self, pod_uid: str):
@@ -91,6 +119,7 @@ class FakeRuntime:
             for key in [k for k in self.containers if k[0] == pod_uid]:
                 self.containers.pop(key, None)
                 self._pending_start.pop(key, None)
+                self._pending_exit.pop(key, None)
 
     def snapshot(self):
         """Consistent {(pod_uid, name): (state, restart_count)} view —

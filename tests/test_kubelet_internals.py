@@ -472,3 +472,88 @@ spec:
         store.delete("pods", "kube-system", "etcd-n1")
         kl.sync_once()
         assert store.get("pods", "kube-system", "etcd-n1") is not None
+
+
+class TestInitContainers:
+    """Sequential init-container execution (kuberuntime
+    computePodActions; predicates.go GetResourceRequest already takes
+    max(initContainers) on the scheduler side)."""
+
+    def _pod(self, restart="Always", fail_init=False):
+        p = make_pod("ip", cpu="100m", node_name="n1")
+        p.spec.restart_policy = restart
+        p.spec.init_containers = [
+            api.Container(name="init-a",
+                          command=["sh", "-c", "echo seeded > /init.flag"]),
+            api.Container(name="init-b",
+                          command=(["cat", "/definitely/missing"]
+                                   if fail_init else [])),
+        ]
+        return p
+
+    def test_sequential_then_app_starts(self):
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0])
+        store.create("pods", self._pod())
+        pod = store.get("pods", "default", "ip")
+        uid = pod.metadata.uid
+        kl.sync_once()  # starts init-a
+        assert kl.runtime.get(uid, "init-a") is not None
+        assert kl.runtime.get(uid, "init-b") is None  # strictly sequential
+        assert kl.runtime.get(uid, "c") is None
+        cond = dict(store.get("pods", "default", "ip").status.conditions)
+        assert cond["Initialized"].startswith("False:Init:0/2")
+        now[0] += 1
+        kl.sync_once()  # init-a exits 0 -> init-b starts
+        now[0] += 1
+        kl.sync_once()  # init-b exits 0 -> app container starts
+        st = kl.runtime.get(uid, "c")
+        assert st is not None
+        # init-a's command really ran against the pod's state
+        assert kl.runtime.get(uid, "init-a").exit_code == 0
+        now[0] += 1
+        kl.sync_once()
+        pod = store.get("pods", "default", "ip")
+        assert pod.status.phase == "Running"
+        assert dict(pod.status.conditions)["Initialized"] == "True"
+
+    def test_failing_init_never_fails_pod(self):
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0])
+        store.create("pods", self._pod(restart="Never", fail_init=True))
+        for _ in range(4):
+            kl.sync_once()
+            now[0] += 1
+        pod = store.get("pods", "default", "ip")
+        assert pod.status.phase == "Failed"
+        assert "Init:Error:init-b" in dict(pod.status.conditions)["Initialized"]
+        uid = pod.metadata.uid
+        assert kl.runtime.get(uid, "c") is None  # app never started
+
+    def test_failing_init_backs_off_and_recovers(self):
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0])
+        store.create("pods", self._pod(fail_init=True))
+        pod = store.get("pods", "default", "ip")
+        uid = pod.metadata.uid
+        for _ in range(4):
+            kl.sync_once()
+            now[0] += 1
+        st = kl.runtime.get(uid, "init-b")
+        assert st is not None and st.exit_code != 0
+        # inside the backoff window: no restart churn
+        restarts = st.restart_count
+        kl.sync_once()
+        assert kl.runtime.get(uid, "init-b").restart_count == restarts
+        # after the window, it retries; make the retry succeed
+        kl.runtime.containers[(uid, "init-b")].files["/definitely/missing"] = "x"
+        now[0] += 15.0
+        kl.sync_once()   # restart init-b
+        now[0] += 1
+        kl.sync_once()   # exits 0
+        now[0] += 1
+        kl.sync_once()   # app starts
+        assert kl.runtime.get(uid, "c") is not None
