@@ -267,6 +267,9 @@ class PodSpec:
     restart_policy: str = "Always"
     service_account_name: str = ""
     host_network: bool = False  # host-namespace flag (exec-deny, PSP)
+    # pod-level wall-clock bound enforced by the kubelet
+    # (kubelet/active_deadline.go): None = unbounded
+    active_deadline_seconds: Optional[int] = None
 
 
 @dataclass
@@ -671,6 +674,9 @@ class JobSpec:
     parallelism: int = 1
     completions: int = 1
     backoff_limit: int = 6
+    # job-level wall-clock bound (job_controller.go pastActiveDeadline):
+    # None = unbounded
+    active_deadline_seconds: Optional[int] = None
     selector: Optional[LabelSelector] = None
     template: Optional[PodTemplateSpec] = None
 
@@ -680,6 +686,7 @@ class JobStatus:
     active: int = 0
     succeeded: int = 0
     failed: int = 0
+    start_time: Optional[float] = None
     completion_time: Optional[float] = None
     conditions: List[Tuple[str, str]] = field(default_factory=list)
 

@@ -55,6 +55,24 @@ class JobController(Controller):
         changed = (st.active, st.succeeded, st.failed) != \
             (len(active), succeeded, failed)
         st.active, st.succeeded, st.failed = len(active), succeeded, failed
+        if st.start_time is None:
+            st.start_time = self.clock()
+            changed = True
+        # job_controller.go pastActiveDeadline: a wall-clock bound on
+        # the whole job, failure reason DeadlineExceeded
+        if job.spec.active_deadline_seconds is not None:
+            remaining = (st.start_time + job.spec.active_deadline_seconds
+                         - self.clock())
+            if remaining <= 0:
+                st.conditions = [("Failed", "True:DeadlineExceeded")]
+                for p in active:
+                    self._delete(p)
+                st.active = 0
+                self._update(job)
+                return
+            # re-enqueue at the deadline (job_controller.go AddAfter):
+            # nothing else wakes the sync when the clock runs out
+            self.queue.add_after(key, remaining)
         if failed > job.spec.backoff_limit:
             st.conditions = [("Failed", "True:BackoffLimitExceeded")]
             for p in active:

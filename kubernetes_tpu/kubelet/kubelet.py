@@ -397,6 +397,18 @@ class Kubelet:
             # this node; retried on later syncs
             self._needs_retry.add(uid)
             return
+        if (pod.spec.active_deadline_seconds is not None
+                and uid in self._pod_start
+                and now - self._pod_start[uid]
+                >= pod.spec.active_deadline_seconds):
+            # kubelet/active_deadline.go: the pod's wall-clock budget is
+            # spent — kill it and mark Failed/DeadlineExceeded
+            self.runtime.kill_pod(uid)
+            pod.status.phase = "Failed"
+            pod.status.conditions = [("PodScheduled", "True"),
+                                     ("Ready", "False:DeadlineExceeded")]
+            self._update_status(pod)
+            return
         if not self._init_containers_done(pod, now):
             return
         for c in pod.spec.containers:

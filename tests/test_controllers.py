@@ -516,3 +516,31 @@ class TestManagerCascade:
         mgr.sync_all(rounds=4)
         assert store.list("replicasets") == []
         assert store.list("pods") == []
+
+
+class TestJobActiveDeadline:
+    def test_job_fails_past_deadline(self):
+        from kubernetes_tpu.controllers.job import JobController
+
+        store = ObjectStore()
+        now = [0.0]
+        ctrl = JobController(store, clock=lambda: now[0])
+        store.create("jobs", api.Job(
+            metadata=api.ObjectMeta(name="slow"),
+            spec=api.JobSpec(parallelism=2, completions=4,
+                             active_deadline_seconds=60, template=TMPL)))
+        ctrl.sync_all()
+        assert len(store.list("pods")) == 2
+        job = store.get("jobs", "default", "slow")
+        assert job.status.start_time == 0.0
+        now[0] = 61.0
+        # production re-wakes via queue.add_after(real clock); the fake
+        # clock test enqueues the wake itself
+        ctrl.enqueue(job)
+        ctrl.sync_all()
+        job = store.get("jobs", "default", "slow")
+        assert ("Failed", "True:DeadlineExceeded") in job.status.conditions
+        assert job.status.active == 0 and store.list("pods") == []
+        # terminal: nothing recreated after
+        ctrl.sync_all()
+        assert store.list("pods") == []

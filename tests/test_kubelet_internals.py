@@ -557,3 +557,22 @@ class TestInitContainers:
         now[0] += 1
         kl.sync_once()   # app starts
         assert kl.runtime.get(uid, "c") is not None
+
+
+class TestActiveDeadline:
+    def test_pod_deadline_exceeded(self):
+        store = ObjectStore()
+        now = [0.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0])
+        p = make_pod("bounded", cpu="100m", node_name="n1")
+        p.spec.active_deadline_seconds = 30
+        store.create("pods", p)
+        kl.sync_once()
+        assert store.get("pods", "default", "bounded").status.phase \
+            != "Failed"
+        now[0] = 31.0
+        kl.sync_once()
+        got = store.get("pods", "default", "bounded")
+        assert got.status.phase == "Failed"
+        assert "DeadlineExceeded" in dict(got.status.conditions)["Ready"]
+        assert kl.runtime.pod_containers(got.metadata.uid) == []
