@@ -116,6 +116,17 @@ class Cluster:
         self._stop = threading.Event()
         self._sched_thread: Optional[threading.Thread] = None
 
+    def write_admin_kubeconfig(self, path: str) -> None:
+        """kubeadm's admin.conf (cmd/kubeadm/app/phases/kubeconfig):
+        cluster CA bundle + the admin credential, ready for
+        `kubectl --kubeconfig path` (or ~/.kube/config)."""
+        from . import kubeconfig as kc
+
+        kc.save(path, kc.new(
+            cluster="kubernetes", server=self.apiserver.url,
+            ca_pem=self.ca.ca_cert_pem if self.ca else None,
+            user="kubernetes-admin", token=self.admin_token))
+
     def _seed_rbac(self):
         """Bootstrap RBAC objects (cmd/kubeadm/app/phases/bootstraptoken/
         clusterinfo + the reference's bootstrap policy): joiners may

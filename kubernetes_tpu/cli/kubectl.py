@@ -1910,7 +1910,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="x509 client cert PEM (or @file) for mTLS")
     ap.add_argument("--client-key-data", default=None,
                     help="x509 client key PEM (or @file) for mTLS")
+    ap.add_argument("--kubeconfig", default=None,
+                    help="path to the kubeconfig file "
+                         "(default $KUBECONFIG or ~/.kube/config)")
+    ap.add_argument("--context", default=None,
+                    help="kubeconfig context to use")
     sub = ap.add_subparsers(dest="verb", required=True)
+
+    cfgp = sub.add_parser("config")
+    cfgp.add_argument("action",
+                      choices=["view", "current-context", "use-context",
+                               "get-contexts", "set-cluster",
+                               "set-credentials", "set-context",
+                               "delete-context"])
+    cfgp.add_argument("name", nargs="?")
+    cfgp.add_argument("--raw", action="store_true")
+    cfgp.add_argument("--server", dest="config_server", default=None)
+    cfgp.add_argument("--certificate-authority-data", default=None)
+    cfgp.add_argument("--token", dest="config_token", default=None)
+    cfgp.add_argument("--cluster", default=None)
+    cfgp.add_argument("--user", default=None)
+    cfgp.add_argument("--namespace", dest="ctx_namespace", default=None)
 
     g = sub.add_parser("get")
     g.add_argument("kind")
@@ -2161,26 +2181,153 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "set": cmd_set, "wait": cmd_wait, "proxy": cmd_proxy,
          "rolling-update": cmd_rolling_update,
          "completion": cmd_completion, "options": cmd_options}
+# "config" is registered below its (later) definition — it is
+# dispatched pre-connect in main(), the VERBS entry only feeds
+# completion/help
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     import os
     out = out or sys.stdout
     args = build_parser().parse_args(argv)
-    server = args.server or os.environ.get("KUBECTL_SERVER")
-    if not server:
-        print("error: --server or $KUBECTL_SERVER required", file=sys.stderr)
-        return 1
+    if args.verb == "config":
+        # config verbs edit the kubeconfig FILE — no server connection
+        return cmd_config(None, args, out)
     from ..client.rest import pem_arg
 
+    server = args.server or os.environ.get("KUBECTL_SERVER")
+    creds = {"token": args.token,
+             "ca_cert_pem": pem_arg(args.ca_cert_data),
+             "client_cert_pem": pem_arg(args.client_cert_data),
+             "client_key_pem": pem_arg(args.client_key_data)}
+    if not server:
+        # clientcmd precedence: flags > env > kubeconfig file
+        from . import kubeconfig as kc
+
+        path = args.kubeconfig or kc.default_path()
+        if os.path.exists(path):
+            try:
+                r = kc.resolve(kc.load(path), context=args.context)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            server = r["server"]
+            creds = {"token": creds["token"] or r["token"],
+                     "ca_cert_pem": creds["ca_cert_pem"] or r["ca_pem"],
+                     "client_cert_pem": (creds["client_cert_pem"]
+                                         or r["client_cert_pem"]),
+                     "client_key_pem": (creds["client_key_pem"]
+                                        or r["client_key_pem"])}
+            if r["namespace"] and args.namespace == "default":
+                args.namespace = r["namespace"]
+    if not server:
+        print("error: --server, $KUBECTL_SERVER, or a kubeconfig "
+              "required", file=sys.stderr)
+        return 1
     try:
-        client = RESTClient(server, token=args.token,
-                            ca_cert_pem=pem_arg(args.ca_cert_data),
-                            client_cert_pem=pem_arg(args.client_cert_data),
-                            client_key_pem=pem_arg(args.client_key_data))
+        client = RESTClient(server, **creds)
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    return _dispatch(client, args, out)
+
+
+def cmd_config(client, args, out):
+    """kubectl config view / current-context / use-context /
+    get-contexts / set-cluster / set-credentials / set-context /
+    delete-context — file edits over the kubeconfig
+    (pkg/kubectl/cmd/config/)."""
+    import os
+
+    from . import kubeconfig as kc
+
+    path = args.kubeconfig or kc.default_path()
+    cfg = (kc.load(path) if os.path.exists(path)
+           else {"apiVersion": "v1", "kind": "Config", "clusters": [],
+                 "users": [], "contexts": [], "current-context": ""})
+    action = args.action
+
+    def upsert(entries, name, key, value):
+        e = next((x for x in entries if x.get("name") == name), None)
+        if e is None:
+            entries.append({"name": name, key: value})
+        else:
+            e.setdefault(key, {}).update(value)
+
+    if action == "view":
+        import yaml
+
+        shown = json.loads(json.dumps(cfg))  # deep copy
+        if not args.raw:
+            for u in shown.get("users", []):
+                for k in list(u.get("user", {})):
+                    u["user"][k] = "REDACTED"
+        out.write(yaml.safe_dump(shown, sort_keys=False))
+        return 0
+    if action == "current-context":
+        cur = cfg.get("current-context")
+        if not cur:
+            print("error: current-context is not set", file=sys.stderr)
+            return 1
+        out.write(cur + "\n")
+        return 0
+    if action == "get-contexts":
+        out.write("CURRENT  NAME  CLUSTER  USER  NAMESPACE\n")
+        for c in cfg.get("contexts", []):
+            mark = "*" if c["name"] == cfg.get("current-context") else ""
+            cc = c.get("context", {})
+            out.write(f"{mark}  {c['name']}  {cc.get('cluster', '')}  "
+                      f"{cc.get('user', '')}  "
+                      f"{cc.get('namespace', '')}\n".lstrip())
+        return 0
+    if action == "use-context":
+        if not any(c.get("name") == args.name
+                   for c in cfg.get("contexts", [])):
+            print(f"error: no context exists with the name: "
+                  f"{args.name!r}", file=sys.stderr)
+            return 1
+        cfg["current-context"] = args.name
+    elif action == "set-cluster":
+        cluster = {}
+        if args.config_server:
+            cluster["server"] = args.config_server
+        if args.certificate_authority_data:
+            from ..client.rest import pem_arg
+            import base64
+
+            cluster["certificate-authority-data"] = base64.b64encode(
+                pem_arg(args.certificate_authority_data).encode()).decode()
+        upsert(cfg["clusters"], args.name, "cluster", cluster)
+    elif action == "set-credentials":
+        user = {}
+        if args.config_token:
+            user["token"] = args.config_token
+        upsert(cfg["users"], args.name, "user", user)
+    elif action == "set-context":
+        ctx = {}
+        if args.cluster:
+            ctx["cluster"] = args.cluster
+        if args.user:
+            ctx["user"] = args.user
+        if args.ctx_namespace:
+            ctx["namespace"] = args.ctx_namespace
+        upsert(cfg["contexts"], args.name, "context", ctx)
+    elif action == "delete-context":
+        cfg["contexts"] = [c for c in cfg.get("contexts", [])
+                           if c.get("name") != args.name]
+        if cfg.get("current-context") == args.name:
+            cfg["current-context"] = ""
+    else:
+        print(f"error: unknown config action {action!r}", file=sys.stderr)
+        return 1
+    kc.save(path, cfg)
+    return 0
+
+
+VERBS["config"] = cmd_config
+
+
+def _dispatch(client, args, out) -> int:
     try:
         # discovery: register served CRDs so custom kinds resolve in
         # _resolve_kind / decode (the reference kubectl's RESTMapper

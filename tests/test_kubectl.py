@@ -803,3 +803,68 @@ class TestApplyPrune:
         # --prune without a selector is refused
         rc, _ = run(server, "apply", "-f", str(m), "--prune")
         assert rc == 1
+
+
+class TestKubeconfig:
+    """clientcmd analog: kubeconfig loading precedence, config verbs,
+    kubeadm admin.conf round-trip into a secure cluster."""
+
+    def test_config_verbs_build_a_working_file(self, server, seeded,
+                                               tmp_path, monkeypatch):
+        cfgp = str(tmp_path / "config")
+        monkeypatch.setenv("KUBECONFIG", cfgp)
+        monkeypatch.delenv("KUBECTL_SERVER", raising=False)
+        rc, _ = run_noserver("config", "set-cluster", "local",
+                             "--server", server.url)
+        assert rc == 0
+        rc, _ = run_noserver("config", "set-credentials", "me")
+        assert rc == 0
+        rc, _ = run_noserver("config", "set-context", "me@local",
+                             "--cluster", "local", "--user", "me")
+        assert rc == 0
+        rc, _ = run_noserver("config", "use-context", "me@local")
+        assert rc == 0
+        rc, out = run_noserver("config", "current-context")
+        assert rc == 0 and out.strip() == "me@local"
+        # now a server verb with NO --server resolves via the file
+        rc, out = run_noserver("get", "pods")
+        assert rc == 0 and "p1" in out
+        rc, out = run_noserver("config", "get-contexts")
+        assert "* " in out or "*  me@local" in out
+        rc, _ = run_noserver("config", "use-context", "ghost")
+        assert rc == 1
+
+    def test_view_redacts_credentials(self, tmp_path, monkeypatch):
+        from kubernetes_tpu.cli import kubeconfig as kc
+
+        cfgp = str(tmp_path / "config")
+        kc.save(cfgp, kc.new("c1", "http://x", token="sekrit"))
+        monkeypatch.setenv("KUBECONFIG", cfgp)
+        rc, out = run_noserver("config", "view")
+        assert rc == 0 and "sekrit" not in out and "REDACTED" in out
+        rc, out = run_noserver("config", "view", "--raw")
+        assert "sekrit" in out
+
+    def test_kubeadm_admin_conf_secure_round_trip(self, tmp_path,
+                                                  monkeypatch):
+        from kubernetes_tpu.cli.kubeadm import Cluster
+
+        cluster = Cluster(secure=True).start()
+        try:
+            cfgp = str(tmp_path / "admin.conf")
+            cluster.write_admin_kubeconfig(cfgp)
+            monkeypatch.setenv("KUBECONFIG", cfgp)
+            monkeypatch.delenv("KUBECTL_SERVER", raising=False)
+            # https + CA bundle + admin token all come from the file
+            rc, out = run_noserver("get", "nodes")
+            assert rc == 0
+            rc, out = run_noserver("auth", "can-i", "delete", "pods")
+            assert rc == 0 and "yes" in out
+        finally:
+            cluster.stop()
+
+
+def run_noserver(*argv):
+    out = io.StringIO()
+    rc = main(list(argv), out=out)
+    return rc, out.getvalue()
