@@ -585,6 +585,10 @@ class StatefulSetSpec:
     template: Optional[PodTemplateSpec] = None
     service_name: str = ""
     pod_management_policy: str = "OrderedReady"
+    # per-ordinal PVCs minted as <template>-<set>-<ordinal>; retained on
+    # scale-down (apps/v1 StatefulSetSpec.VolumeClaimTemplates)
+    volume_claim_templates: List[PersistentVolumeClaim] = field(
+        default_factory=list)
 
 
 @dataclass
@@ -648,9 +652,21 @@ class Deployment:
 
 
 @dataclass
+class DaemonSetUpdateStrategy:
+    """apps/v1 DaemonSetUpdateStrategy: RollingUpdate replaces stale
+    pods bounded by maxUnavailable; OnDelete waits for manual
+    deletion."""
+
+    type: str = "RollingUpdate"  # RollingUpdate | OnDelete
+    max_unavailable: int = 1
+
+
+@dataclass
 class DaemonSetSpec:
     selector: Optional[LabelSelector] = None
     template: Optional[PodTemplateSpec] = None
+    update_strategy: DaemonSetUpdateStrategy = field(
+        default_factory=DaemonSetUpdateStrategy)
 
 
 @dataclass
@@ -659,6 +675,7 @@ class DaemonSetStatus:
     desired_number_scheduled: int = 0
     number_ready: int = 0
     number_misscheduled: int = 0
+    updated_number_scheduled: int = 0
     observed_generation: int = 0
 
 

@@ -215,6 +215,17 @@ def _validate_workload(obj, selector_required: bool = True) -> ErrorList:
         if s is not None and s.requirements and not s.matches(tlabels):
             errs.add("spec.template.metadata.labels", tlabels,
                      "must match spec.selector")
+    strategy = getattr(obj.spec, "update_strategy", None)
+    if strategy is not None:
+        # apps/validation ValidateDaemonSetUpdateStrategy: the type is
+        # an enum and a RollingUpdate budget of 0 could never progress
+        if strategy.type not in ("RollingUpdate", "OnDelete"):
+            errs.add("spec.updateStrategy.type", strategy.type,
+                     'must be "RollingUpdate" or "OnDelete"')
+        elif strategy.type == "RollingUpdate" \
+                and strategy.max_unavailable < 1:
+            errs.add("spec.updateStrategy.rollingUpdate.maxUnavailable",
+                     strategy.max_unavailable, "must be at least 1")
     return errs
 
 

@@ -14,8 +14,8 @@ from ..api import types as api
 from ..runtime.store import Conflict
 from ..plugins import golden
 from ..state.node_info import NodeInfo
-from .base import (Controller, is_pod_active, make_pod_from_template,
-                   pod_owned_by)
+from .base import (Controller, is_pod_active, is_pod_ready,
+                   make_pod_from_template, pod_owned_by)
 
 
 class DaemonSetController(Controller):
@@ -64,10 +64,13 @@ class DaemonSetController(Controller):
         return ok
 
     def sync(self, key: str):
+        from .deployment import HASH_LABEL, template_hash
+
         ns, name = key.split("/", 1)
         ds = self.store.get("daemonsets", ns, name)
         if ds is None:
             return
+        cur_hash = template_hash(ds.spec.template)
         nodes = self.store.list("nodes")
         owned: List[api.Pod] = [
             p for p in self.store.list("pods", ns)
@@ -79,6 +82,9 @@ class DaemonSetController(Controller):
         desired = 0
         scheduled = 0
         misscheduled = 0
+        updated = 0
+        unavailable = 0
+        stale_ready: List[api.Pod] = []
         for node in nodes:
             should = self._should_run(ds, node)
             have = [p for p in by_node.pop(node.metadata.name, [])
@@ -87,13 +93,35 @@ class DaemonSetController(Controller):
                 desired += 1
                 if have:
                     scheduled += 1
-                    for extra in have[1:]:  # dedupe
+                    # dedupe keeps a CURRENT-hash pod when one exists —
+                    # deleting the fresh replacement instead of the
+                    # stale duplicate would churn an extra round
+                    have.sort(key=lambda p: (p.metadata.labels or {})
+                              .get(HASH_LABEL) != cur_hash)
+                    for extra in have[1:]:
                         self._delete(extra)
+                    p = have[0]
+                    p_hash = (p.metadata.labels or {}).get(HASH_LABEL)
+                    if p_hash == cur_hash:
+                        updated += 1
+                        if not is_pod_ready(p):
+                            unavailable += 1
+                    elif not is_pod_ready(p):
+                        # a stale not-ready pod costs nothing to replace
+                        # (update.go rollingUpdate deletes these first)
+                        unavailable += 1
+                        if ds.spec.update_strategy.type != "OnDelete":
+                            self._delete(p)
+                    else:
+                        stale_ready.append(p)
                 else:
+                    unavailable += 1
                     pod = make_pod_from_template(
                         ds.spec.template, "DaemonSet", ds,
                         f"{name}-{node.metadata.name}")
                     pod.spec.node_name = node.metadata.name
+                    pod.metadata.labels = dict(pod.metadata.labels or {},
+                                               **{HASH_LABEL: cur_hash})
                     try:
                         self.store.create("pods", pod)
                     except Conflict:
@@ -102,10 +130,18 @@ class DaemonSetController(Controller):
                 for p in have:
                     misscheduled += 1
                     self._delete(p)
+        # RollingUpdate (daemon/update.go): replace READY stale pods
+        # only within the maxUnavailable budget; the manage pass above
+        # recreates them at the new hash on the next sync
+        if ds.spec.update_strategy.type != "OnDelete":
+            budget = max(
+                0, ds.spec.update_strategy.max_unavailable - unavailable)
+            for p in stale_ready[:budget]:
+                self._delete(p)
         for orphans in by_node.values():  # pods on deleted nodes
             for p in orphans:
                 self._delete(p)
-        self._update_status(ds, desired, scheduled, misscheduled)
+        self._update_status(ds, desired, scheduled, misscheduled, updated)
 
     def _delete(self, pod):
         try:
@@ -113,23 +149,25 @@ class DaemonSetController(Controller):
         except KeyError:
             pass
 
-    def _update_status(self, ds, desired, scheduled, misscheduled):
+    def _update_status(self, ds, desired, scheduled, misscheduled,
+                       updated=0):
         st = ds.status
         ready = 0
-        from .base import is_pod_ready
         for p in self.store.list("pods", ds.metadata.namespace):
             if any(r.controller and r.kind == "DaemonSet"
                    and r.name == ds.metadata.name
                    for r in p.metadata.owner_references) and is_pod_ready(p):
                 ready += 1
         if (st.desired_number_scheduled, st.current_number_scheduled,
-                st.number_misscheduled, st.number_ready) == \
-                (desired, scheduled, misscheduled, ready):
+                st.number_misscheduled, st.number_ready,
+                st.updated_number_scheduled) == \
+                (desired, scheduled, misscheduled, ready, updated):
             return
         st.desired_number_scheduled = desired
         st.current_number_scheduled = scheduled
         st.number_misscheduled = misscheduled
         st.number_ready = ready
+        st.updated_number_scheduled = updated
         try:
             self.store.update("daemonsets", ds)
         except (Conflict, KeyError):

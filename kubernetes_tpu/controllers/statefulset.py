@@ -64,6 +64,7 @@ class StatefulSetController(Controller):
                                              ss, f"{name}-{i}")
                 new.metadata.labels["statefulset.kubernetes.io/pod-name"] = \
                     new.metadata.name
+                self._ensure_claims(ss, new, i)
                 try:
                     self.store.create("pods", new)
                 except Conflict:
@@ -84,6 +85,35 @@ class StatefulSetController(Controller):
             if ordered:
                 raise RuntimeError(f"scaling down ordinal {i}")
         self._update_status(ss, pods)
+
+    def _ensure_claims(self, ss, pod: api.Pod, ordinal: int):
+        """volumeClaimTemplates (stateful_set_utils.go updateStorage +
+        stateful_pod_control.go createPersistentVolumeClaims): mint the
+        per-ordinal PVC `<template>-<set>-<ordinal>` if absent and mount
+        it into the pod under the template's name. Claims survive
+        scale-down/delete (the reference never reaps them)."""
+        import copy
+
+        for tmpl in ss.spec.volume_claim_templates:
+            claim_name = f"{tmpl.metadata.name}-{ss.metadata.name}-{ordinal}"
+            if self.store.get("persistentvolumeclaims",
+                              ss.metadata.namespace, claim_name) is None:
+                pvc = api.PersistentVolumeClaim(
+                    metadata=api.ObjectMeta(
+                        name=claim_name,
+                        namespace=ss.metadata.namespace,
+                        labels=dict(pod.metadata.labels or {})),
+                    spec=copy.deepcopy(tmpl.spec))
+                try:
+                    self.store.create("persistentvolumeclaims", pvc)
+                except Conflict:
+                    pass
+            # updateStorage semantics: a template volume of the SAME
+            # name is REPLACED by the claim mount (not duplicated —
+            # duplicate names fail pod validation)
+            pod.spec.volumes = [v for v in pod.spec.volumes
+                                if v.name != tmpl.metadata.name] + [
+                api.Volume(name=tmpl.metadata.name, pvc_name=claim_name)]
 
     def _update_status(self, ss, pods):
         live = [p for p in pods.values() if is_pod_active(p)]

@@ -544,3 +544,122 @@ class TestJobActiveDeadline:
         # terminal: nothing recreated after
         ctrl.sync_all()
         assert store.list("pods") == []
+
+
+class TestDaemonSetRollingUpdate:
+    def _world(self, strategy="RollingUpdate", max_unavailable=1):
+        import copy
+
+        from kubernetes_tpu.controllers.daemonset import DaemonSetController
+
+        store = ObjectStore()
+        for n in ("n1", "n2", "n3"):
+            store.create("nodes", mknode(n))
+        ds = api.DaemonSet(
+            metadata=api.ObjectMeta(name="agent"),
+            spec=api.DaemonSetSpec(
+                selector=SEL, template=copy.deepcopy(TMPL),
+                update_strategy=api.DaemonSetUpdateStrategy(
+                    type=strategy, max_unavailable=max_unavailable)))
+        store.create("daemonsets", ds)
+        ctrl = DaemonSetController(store)
+        ctrl.sync_all()
+        for p in store.list("pods"):
+            mark_running(store, p)
+        ctrl.sync_all()
+        return store, ctrl
+
+    def _retag(self, store, image):
+        ds = store.get("daemonsets", "default", "agent")
+        ds.spec.template.spec.containers[0].image = image
+        store.update("daemonsets", ds)
+
+    def test_rolling_update_respects_max_unavailable(self):
+        from kubernetes_tpu.controllers.deployment import (HASH_LABEL,
+                                                           template_hash)
+
+        store, ctrl = self._world(max_unavailable=1)
+        assert len(store.list("pods")) == 3
+        self._retag(store, "agent:v2")
+        ds = store.get("daemonsets", "default", "agent")
+        new_hash = template_hash(ds.spec.template)
+        ctrl.sync_all()
+        # only ONE ready stale pod was replaced this round
+        pods = store.list("pods")
+        stale = [p for p in pods
+                 if (p.metadata.labels or {}).get(HASH_LABEL) != new_hash]
+        assert len(stale) == 2, [p.metadata.name for p in pods]
+        # as replacements go Ready, the rollout advances to completion
+        for _ in range(4):
+            for p in store.list("pods"):
+                mark_running(store, p)
+            ctrl._all_dirty()
+            ctrl.sync_all()
+        pods = store.list("pods")
+        assert len(pods) == 3
+        assert all((p.metadata.labels or {}).get(HASH_LABEL) == new_hash
+                   for p in pods)
+        ds = store.get("daemonsets", "default", "agent")
+        assert ds.status.updated_number_scheduled == 3
+
+    def test_on_delete_waits_for_manual_deletion(self):
+        from kubernetes_tpu.controllers.deployment import (HASH_LABEL,
+                                                           template_hash)
+
+        store, ctrl = self._world(strategy="OnDelete")
+        self._retag(store, "agent:v2")
+        ctrl.sync_all()
+        ds = store.get("daemonsets", "default", "agent")
+        new_hash = template_hash(ds.spec.template)
+        stale = [p for p in store.list("pods")
+                 if (p.metadata.labels or {}).get(HASH_LABEL) != new_hash]
+        assert len(stale) == 3  # nothing auto-replaced
+        store.delete("pods", "default", stale[0].metadata.name)
+        ctrl.sync_all()
+        pods = store.list("pods")
+        assert len(pods) == 3
+        fresh = [p for p in pods
+                 if (p.metadata.labels or {}).get(HASH_LABEL) == new_hash]
+        assert len(fresh) == 1  # only the manually-deleted slot
+
+
+class TestStatefulSetClaims:
+    def test_volume_claim_templates_minted_and_retained(self):
+        from kubernetes_tpu.controllers.statefulset import (
+            StatefulSetController)
+
+        store = ObjectStore()
+        ss = api.StatefulSet(
+            metadata=api.ObjectMeta(name="db"),
+            spec=api.StatefulSetSpec(
+                replicas=2, selector=SEL, template=TMPL,
+                pod_management_policy="Parallel",
+                volume_claim_templates=[api.PersistentVolumeClaim(
+                    metadata=api.ObjectMeta(name="data"),
+                    spec=api.PersistentVolumeClaimSpec(
+                        requests={"storage": 1 << 30}))]))
+        store.create("statefulsets", ss)
+        ctrl = StatefulSetController(store)
+        ctrl.sync_all()
+        pods = sorted(store.list("pods"), key=lambda p: p.metadata.name)
+        assert [p.metadata.name for p in pods] == ["db-0", "db-1"]
+        for i, p in enumerate(pods):
+            assert p.spec.volumes[-1].pvc_name == f"data-db-{i}"
+        claims = {c.metadata.name
+                  for c in store.list("persistentvolumeclaims")}
+        assert claims == {"data-db-0", "data-db-1"}
+        # scale down: pod goes, claim STAYS
+        ss = store.get("statefulsets", "default", "db")
+        ss.spec.replicas = 1
+        store.update("statefulsets", ss)
+        ctrl.sync_all()
+        assert len(store.list("pods")) == 1
+        assert {c.metadata.name
+                for c in store.list("persistentvolumeclaims")} == claims
+        # scale back up: db-1 reattaches to the SAME claim
+        ss = store.get("statefulsets", "default", "db")
+        ss.spec.replicas = 2
+        store.update("statefulsets", ss)
+        ctrl.sync_all()
+        p1 = store.get("pods", "default", "db-1")
+        assert p1.spec.volumes[-1].pvc_name == "data-db-1"
