@@ -951,6 +951,8 @@ class APIServer:
         errs = validation.validate(plural, obj)
         if errs:
             raise APIError(422, "Invalid", errs.message())
+        if plural == "services":
+            self._allocate_service(obj)
         if plural == "customresourcedefinitions":
             msg = scheme.crd_conflict(obj)
             if msg is not None:
@@ -967,6 +969,62 @@ class APIServer:
             # delivery is harmless
             scheme.register_dynamic(obj)
         h._send(201, json.dumps(scheme.encode_object(obj, version=gv)).encode())
+
+    # service-cluster-ip-range / --service-node-port-range defaults
+    # (cmd/kube-apiserver/app/options: 10.0.0.0/24, 30000-32767)
+    SERVICE_IP_PREFIX = "10.0.0."
+    NODE_PORT_RANGE = (30000, 32767)
+
+    def _allocate_service(self, svc):
+        """Service REST allocation (registry/core/service/rest.go +
+        ipallocator/portallocator): assign a free clusterIP unless
+        headless ("None") or ExternalName; assign free NodePorts for
+        NodePort/LoadBalancer ports. User-supplied values that collide
+        with an existing allocation are 422s, like the reference's
+        ErrAllocated path."""
+        existing = [s for s in self.store.list("services")
+                    if s.metadata.uid != svc.metadata.uid]
+        used_ips = {s.spec.cluster_ip for s in existing
+                    if s.spec.cluster_ip not in ("", "None")}
+        used_ports = {p.node_port for s in existing
+                      for p in s.spec.ports if p.node_port}
+        if svc.spec.type != "ExternalName" \
+                and svc.spec.cluster_ip not in ("None",):
+            if svc.spec.cluster_ip:
+                if svc.spec.cluster_ip in used_ips:
+                    raise APIError(
+                        422, "Invalid",
+                        f"spec.clusterIP: {svc.spec.cluster_ip} "
+                        f"is already allocated")
+            else:
+                for i in range(1, 255):
+                    ip = f"{self.SERVICE_IP_PREFIX}{i}"
+                    if ip not in used_ips:
+                        svc.spec.cluster_ip = ip
+                        break
+                else:
+                    raise APIError(500, "InternalError",
+                                   "service IP range exhausted")
+        if svc.spec.type in ("NodePort", "LoadBalancer"):
+            lo, hi = self.NODE_PORT_RANGE
+            for p in svc.spec.ports:
+                if p.node_port:
+                    if p.node_port in used_ports:
+                        raise APIError(
+                            422, "Invalid",
+                            f"spec.ports: nodePort {p.node_port} "
+                            f"is already allocated")
+                    used_ports.add(p.node_port)
+            for p in svc.spec.ports:
+                if not p.node_port:
+                    for cand in range(lo, hi + 1):
+                        if cand not in used_ports:
+                            p.node_port = cand
+                            used_ports.add(cand)
+                            break
+                    else:
+                        raise APIError(500, "InternalError",
+                                       "node port range exhausted")
 
     def _serve_update(self, h, plural, namespace, name, sub, user, patch,
                       gv=None):
@@ -1032,6 +1090,11 @@ class APIServer:
             errs = validation.validate(plural, obj, old=old)
             if errs:
                 raise APIError(422, "Invalid", errs.message())
+        if plural == "services" and not sub:
+            # updates can add NodePort ports / switch type — allocate
+            # the same way creates do (clusterIP immutability is already
+            # enforced by validation above)
+            self._allocate_service(obj)
         if plural == "customresourcedefinitions":
             # validate BEFORE touching the registry or the store: a
             # rejected rename must leave the old kind fully served

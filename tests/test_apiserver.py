@@ -608,3 +608,55 @@ class TestDeleteCollection:
         left = server.store.get("pods", "default", "fin")
         assert left is not None
         assert left.metadata.deletion_timestamp is not None
+
+
+class TestServiceAllocation:
+    """Service REST allocators (ipallocator/portallocator analogs)."""
+
+    def mksvc(self, name, type="ClusterIP", cluster_ip="", node_port=0):
+        return api.Service(
+            metadata=api.ObjectMeta(name=name),
+            spec=api.ServiceSpec(
+                selector={"app": name}, type=type, cluster_ip=cluster_ip,
+                ports=[api.ServicePort(port=80, node_port=node_port)]))
+
+    def test_cluster_ip_assigned_and_unique(self, client):
+        client.create("services", self.mksvc("a"))
+        client.create("services", self.mksvc("b"))
+        a = client.get("services", "default", "a")
+        b = client.get("services", "default", "b")
+        assert a.spec.cluster_ip.startswith("10.0.0.")
+        assert b.spec.cluster_ip.startswith("10.0.0.")
+        assert a.spec.cluster_ip != b.spec.cluster_ip
+        # explicit collision is a 422 (ErrAllocated)
+        with pytest.raises(APIStatusError) as ei:
+            client.create("services", self.mksvc(
+                "c", cluster_ip=a.spec.cluster_ip))
+        assert ei.value.code == 422
+        # headless stays None; ExternalName gets nothing
+        client.create("services", self.mksvc("hl", cluster_ip="None"))
+        assert client.get("services", "default",
+                          "hl").spec.cluster_ip == "None"
+        ext = self.mksvc("ext", type="ExternalName")
+        ext.spec.external_name = "db.example.com"
+        client.create("services", ext)
+        assert client.get("services", "default",
+                          "ext").spec.cluster_ip == ""
+
+    def test_node_ports_assigned_and_unique(self, client):
+        client.create("services", self.mksvc("np1", type="NodePort"))
+        np1 = client.get("services", "default", "np1")
+        port = np1.spec.ports[0].node_port
+        assert 30000 <= port <= 32767
+        with pytest.raises(APIStatusError) as ei:
+            client.create("services", self.mksvc("np2", type="NodePort",
+                                                 node_port=port))
+        assert ei.value.code == 422
+        # update switching type to NodePort allocates too
+        client.create("services", self.mksvc("later"))
+        svc = client.get("services", "default", "later")
+        svc.spec.type = "NodePort"
+        client.update("services", svc)
+        got = client.get("services", "default", "later")
+        assert got.spec.ports[0].node_port >= 30000
+        assert got.spec.ports[0].node_port != port
