@@ -997,6 +997,8 @@ class ResourceQuota:
 class ServiceAccount:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     secrets: List[str] = field(default_factory=list)
+    # v1 ServiceAccount.AutomountServiceAccountToken: None = mount
+    automount_service_account_token: Optional[bool] = None
 
 
 @dataclass

@@ -260,10 +260,14 @@ class LimitRanger(AdmissionPlugin):
 
 class ServiceAccountAdmission(AdmissionPlugin):
     """plugin/pkg/admission/serviceaccount: default
-    spec.serviceAccountName to 'default' and require the account to
-    exist (admission.go DefaultServiceAccountName + fetch check)."""
+    spec.serviceAccountName to 'default', require the account to exist
+    (admission.go DefaultServiceAccountName + fetch check), and
+    automount the SA's token Secret as a volume at the well-known path
+    unless the pod or SA opts out (admission.go mountServiceAccountToken
+    + Volumes injection)."""
 
     name = "ServiceAccount"
+    TOKEN_MOUNT = "/var/run/secrets/kubernetes.io/serviceaccount"
 
     def admit(self, op, kind, obj, old, user, store):
         if kind != "pods" or op != "create":
@@ -276,6 +280,15 @@ class ServiceAccountAdmission(AdmissionPlugin):
             raise AdmissionError(
                 f"service account {obj.namespace}/"
                 f"{obj.spec.service_account_name} not found")
+        if getattr(sa, "automount_service_account_token", True) is False:
+            return
+        token_secret = f"{sa.metadata.name}-token"
+        vol_name = f"{sa.metadata.name}-token"
+        if store.get("secrets", obj.namespace, token_secret) is None:
+            return  # tokens controller hasn't minted it yet
+        if not any(v.name == vol_name for v in obj.spec.volumes):
+            obj.spec.volumes = list(obj.spec.volumes) + [
+                api.Volume(name=vol_name, secret=token_secret)]
 
 
 POD_NODE_SELECTOR_ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"

@@ -411,3 +411,38 @@ class TestValidationBreadth:
             plural = scheme.plural_for_kind(kind)
             errs = validation.validate(plural, obj)
             assert errs, f"{kind}: invalid name accepted"
+
+
+class TestServiceAccountAutomount:
+    def _store_with_sa(self, automount=None):
+        from kubernetes_tpu.controllers.serviceaccount import (
+            ServiceAccountController)
+
+        store = ObjectStore()
+        sa = api.ServiceAccount(metadata=api.ObjectMeta(name="default"))
+        sa.automount_service_account_token = automount
+        store.create("serviceaccounts", sa)
+        ServiceAccountController(store).sync_all()  # mints default-token
+        return store
+
+    def test_token_volume_injected(self):
+        store = self._store_with_sa()
+        assert store.get("secrets", "default", "default-token") is not None
+        pod = make_pod("p")
+        _admit(adm.ServiceAccountAdmission(), "create", "pods", pod,
+               store=store)
+        vols = {v.name: v for v in pod.spec.volumes}
+        assert vols["default-token"].secret == "default-token"
+        # idempotent: an existing volume of the name is left alone
+        _admit(adm.ServiceAccountAdmission(), "create", "pods", pod,
+               store=store)
+        assert sum(1 for v in pod.spec.volumes
+                   if v.name == "default-token") == 1
+
+    def test_opt_out_respected(self):
+        store = self._store_with_sa(automount=False)
+        pod = make_pod("p")
+        _admit(adm.ServiceAccountAdmission(), "create", "pods", pod,
+               store=store)
+        assert not any(v.name == "default-token"
+                       for v in pod.spec.volumes)
