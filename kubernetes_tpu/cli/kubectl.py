@@ -624,10 +624,121 @@ def cmd_diff(client, args, out):
     return 1 if changed else 0
 
 
+def _describe_pod(client, pod, out):
+    out.write(f"Name:         {pod.metadata.name}\n")
+    out.write(f"Namespace:    {pod.metadata.namespace}\n")
+    out.write(f"Node:         {pod.spec.node_name or '<none>'}\n")
+    out.write(f"Status:       {pod.status.phase or 'Pending'}\n")
+    if pod.status.nominated_node_name:
+        out.write(f"NominatedNodeName:  "
+                  f"{pod.status.nominated_node_name}\n")
+    if pod.metadata.labels:
+        out.write("Labels:       " + ",".join(
+            f"{k}={v}" for k, v in sorted(pod.metadata.labels.items()))
+            + "\n")
+    if pod.status.qos_class:
+        out.write(f"QoS Class:    {pod.status.qos_class}\n")
+    out.write("Containers:\n")
+    for c in pod.spec.containers:
+        out.write(f"  {c.name}:\n")
+        out.write(f"    Image:  {c.image or '<none>'}\n")
+        req = c.resources.requests
+        if req:
+            out.write("    Requests:  " + ", ".join(
+                f"{k}={v}" for k, v in sorted(req.items())) + "\n")
+    if pod.spec.volumes:
+        out.write("Volumes:\n")
+        for v in pod.spec.volumes:
+            src = (f"PVC {v.pvc_name}" if v.pvc_name
+                   else f"Secret {v.secret}" if v.secret
+                   else f"ConfigMap {v.config_map}" if v.config_map
+                   else "EmptyDir" if v.empty_dir else v.source_kind
+                   or "other")
+            out.write(f"  {v.name}: {src}\n")
+    if pod.status.conditions:
+        out.write("Conditions:\n")
+        for t, s in pod.status.conditions:
+            out.write(f"  {t}\t{s}\n")
+    if pod.spec.tolerations:
+        out.write("Tolerations:  " + "; ".join(
+            f"{t.key or '<all>'}:{t.effect or '<all>'}"
+            for t in pod.spec.tolerations) + "\n")
+
+
+def _describe_node(client, node, out):
+    """describe.go describeNode: conditions, capacity, and the
+    allocated-resources table summed over non-terminated pods."""
+    out.write(f"Name:         {node.metadata.name}\n")
+    if node.metadata.labels:
+        out.write("Labels:       " + ",".join(
+            f"{k}={v}" for k, v in sorted(node.metadata.labels.items()))
+            + "\n")
+    out.write(f"Unschedulable: {node.spec.unschedulable}\n")
+    if node.spec.taints:
+        out.write("Taints:       " + "; ".join(
+            f"{t.key}={t.value}:{t.effect}" for t in node.spec.taints)
+            + "\n")
+    out.write("Conditions:\n")
+    for c in node.status.conditions:
+        out.write(f"  {c.type}\t{c.status}\n")
+    alloc = node.status.allocatable or {}
+    if alloc:
+        out.write("Allocatable:\n")
+        for k, v in sorted(alloc.items()):
+            out.write(f"  {k}: {v}\n")
+    pods, _ = client.list("pods", None)
+    mine = [p for p in pods if p.spec.node_name == node.metadata.name
+            and p.status.phase not in ("Succeeded", "Failed")]
+    out.write(f"Non-terminated Pods:  ({len(mine)} in total)\n")
+    used: dict = {}
+    for p in mine:
+        out.write(f"  {p.metadata.namespace}/{p.metadata.name}\n")
+        for k, v in api.get_resource_request(p).items():
+            used[k] = used.get(k, 0) + v
+    if used:
+        out.write("Allocated resources:\n")
+        for k, v in sorted(used.items()):
+            pct = f" ({100 * v // alloc[k]}%)" if alloc.get(k) else ""
+            out.write(f"  {k}: {v}{pct}\n")
+
+
+def _describe_service(client, svc, out):
+    out.write(f"Name:         {svc.metadata.name}\n")
+    out.write(f"Type:         {svc.spec.type}\n")
+    out.write(f"IP:           {svc.spec.cluster_ip or '<none>'}\n")
+    if svc.spec.selector:
+        out.write("Selector:     " + ",".join(
+            f"{k}={v}" for k, v in sorted(svc.spec.selector.items()))
+            + "\n")
+    for p in svc.spec.ports:
+        np = f"  NodePort: {p.node_port}" if p.node_port else ""
+        out.write(f"Port:         {p.port}/{p.protocol} -> "
+                  f"{p.target_port or p.port}{np}\n")
+    try:
+        ep = client.get("endpoints", svc.metadata.namespace,
+                        svc.metadata.name)
+        addrs = [f"{a.ip}" for ss in ep.subsets for a in ss.addresses]
+        out.write(f"Endpoints:    {','.join(addrs) or '<none>'}\n")
+    except APIStatusError:
+        out.write("Endpoints:    <none>\n")
+
+
+_DESCRIBERS = {"pods": _describe_pod, "nodes": _describe_node,
+               "services": _describe_service}
+
+
 def cmd_describe(client, args, out):
+    """Per-kind describers for the big three (pkg/printers/
+    internalversion/describe.go describePod/describeNode/
+    describeService); every other kind dumps YAML. Events always
+    trail."""
     plural = _resolve_kind(args.kind)
     obj = client.get(plural, args.namespace, args.name)
-    _dump(obj, "yaml", out)
+    describer = _DESCRIBERS.get(plural)
+    if describer is not None:
+        describer(client, obj, out)
+    else:
+        _dump(obj, "yaml", out)
     evs, _ = client.list("events", args.namespace)
     related = [e for e in evs if e.involved_name == args.name]
     if related:

@@ -868,3 +868,25 @@ def run_noserver(*argv):
     out = io.StringIO()
     rc = main(list(argv), out=out)
     return rc, out.getvalue()
+
+
+class TestDescribers:
+    def test_describe_pod_node_service(self, server, seeded):
+        rc, out = run(server, "describe", "pods", "p1")
+        assert rc == 0 and "Node:         n1" in out \
+            and "Containers:" in out
+        rc, out = run(server, "describe", "nodes", "n1")
+        assert rc == 0 and "Non-terminated Pods:  (1 in total)" in out \
+            and "Allocatable:" in out and "default/p1" in out
+        seeded.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc1"),
+            spec=api.ServiceSpec(selector={"app": "w"},
+                                 ports=[api.ServicePort(port=80)])))
+        rc, out = run(server, "describe", "services", "svc1")
+        assert rc == 0 and "IP:           10.0.0." in out \
+            and "Port:         80/TCP" in out
+        # non-special kinds still dump yaml
+        seeded.create("configmaps", api.ConfigMap(
+            metadata=api.ObjectMeta(name="cm"), data={"a": "1"}))
+        rc, out = run(server, "describe", "configmaps", "cm")
+        assert rc == 0 and "kind: ConfigMap" in out
