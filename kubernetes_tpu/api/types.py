@@ -978,6 +978,10 @@ class EventObject:
 @dataclass
 class ResourceQuotaSpec:
     hard: Dict[str, int] = field(default_factory=dict)
+    # quota scopes (pkg/quota scopes.go): the quota only counts objects
+    # the scope set matches — BestEffort/NotBestEffort (pod QoS),
+    # Terminating/NotTerminating (pod activeDeadlineSeconds set/unset)
+    scopes: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -107,11 +107,33 @@ def _dump(obj, fmt: str, out):
 # -- manifest loading ---------------------------------------------------------
 
 
-def load_manifests(path: str) -> List[dict]:
+def load_manifests(path: str, recursive: bool = False) -> List[dict]:
     """YAML (multi-doc) or JSON manifest -> raw doc dicts. Decoding is
     deferred to per-doc apply time: a CustomResourceDefinition earlier in
     the file must register its kind before later docs of that kind can
-    decode (the reference kubectl's sequential server-side discovery)."""
+    decode (the reference kubectl's sequential server-side discovery).
+
+    A DIRECTORY path loads every *.yaml/*.yml/*.json inside it in sorted
+    order (resource.Builder's FilenameParam); recursive descends
+    subdirectories (-R)."""
+    import os
+
+    if path != "-" and os.path.isdir(path):
+        docs: List[dict] = []
+        if recursive:
+            walker = sorted(
+                os.path.join(r, f)
+                for r, _, files in os.walk(path) for f in files)
+        else:
+            walker = sorted(os.path.join(path, f)
+                            for f in os.listdir(path))
+        for fp in walker:
+            if fp.endswith((".yaml", ".yml", ".json")) \
+                    and os.path.isfile(fp):
+                docs.extend(load_manifests(fp))
+        if not docs:
+            raise ManifestError(f"no manifests found in {path}")
+        return docs
     text = sys.stdin.read() if path == "-" else open(path).read()
     docs: List[dict] = []
     try:
@@ -920,7 +942,8 @@ def cmd_create(client, args, out):
     if not args.filename:
         raise ManifestError("create requires -f FILENAME or a generator "
                             "(configmap, secret, namespace, ...)")
-    for doc in load_manifests(args.filename):
+    for doc in load_manifests(args.filename,
+                              recursive=getattr(args, "recursive", False)):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
@@ -1019,7 +1042,8 @@ def cmd_apply(client, args, out):
     if not args.filename:
         raise ManifestError("apply requires -f FILENAME")
     applied: set = set()
-    for doc in load_manifests(args.filename):
+    for doc in load_manifests(args.filename,
+                              recursive=getattr(args, "recursive", False)):
         obj, kind = _decode_doc(doc)
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
@@ -2068,6 +2092,7 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("name", nargs="?")
     c.add_argument("extra_name", nargs="?")
     c.add_argument("--filename", "-f", default=None)
+    c.add_argument("--recursive", "-R", action="store_true")
     c.add_argument("--from-literal", action="append")
     c.add_argument("--from-file", action="append")
     c.add_argument("--type", default="Opaque")
@@ -2097,6 +2122,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap_apply.add_argument("kind", nargs="?")
     ap_apply.add_argument("name", nargs="?")
     ap_apply.add_argument("--filename", "-f", default=None)
+    ap_apply.add_argument("--recursive", "-R", action="store_true")
     ap_apply.add_argument("--prune", action="store_true")
     ap_apply.add_argument("--selector", "-l", default=None)
 

@@ -161,6 +161,28 @@ class ResourceQuotaAdmission(AdmissionPlugin):
 
     name = "ResourceQuota"
 
+    @staticmethod
+    def _scopes_match(scopes, kind, obj) -> bool:
+        """pkg/quota scopes.go matchesScope: a scoped quota only counts
+        objects every scope selects. Non-pod kinds never match a scoped
+        quota (scopes are pod properties)."""
+        if not scopes:
+            return True
+        if kind != "pods":
+            return False
+        for scope in scopes:
+            qos = api.pod_qos_class(obj)
+            terminating = obj.spec.active_deadline_seconds is not None
+            if scope == "BestEffort" and qos != api.QOS_BEST_EFFORT:
+                return False
+            if scope == "NotBestEffort" and qos == api.QOS_BEST_EFFORT:
+                return False
+            if scope == "Terminating" and not terminating:
+                return False
+            if scope == "NotTerminating" and terminating:
+                return False
+        return True
+
     def admit(self, op, kind, obj, old, user, store):
         if op != "create" or kind not in QUOTA_EVALUATORS:
             return
@@ -170,16 +192,26 @@ class ResourceQuotaAdmission(AdmissionPlugin):
             return
         evaluator = QUOTA_EVALUATORS[kind]
         delta = evaluator(obj)
-        relevant = {k for q in quotas for k in q.spec.hard if k in delta}
-        if not relevant:
-            return
-        used: dict = {}
-        for existing in store.list(kind, ns):
-            if not _quota_live(kind, existing):
-                continue
-            for k, v in evaluator(existing).items():
-                used[k] = used.get(k, 0) + v
+        used_by_quota: dict = {}
         for q in quotas:
+            scopes = q.spec.scopes
+            if not self._scopes_match(scopes, kind, obj):
+                continue  # this quota doesn't govern the new object
+            relevant = {k for k in q.spec.hard if k in delta}
+            if not relevant:
+                continue
+            key_s = tuple(sorted(scopes))
+            if key_s not in used_by_quota:
+                used: dict = {}
+                for existing in store.list(kind, ns):
+                    if not _quota_live(kind, existing):
+                        continue
+                    if not self._scopes_match(scopes, kind, existing):
+                        continue
+                    for k, v in evaluator(existing).items():
+                        used[k] = used.get(k, 0) + v
+                used_by_quota[key_s] = used
+            used = used_by_quota[key_s]
             for key, limit in q.spec.hard.items():
                 if key not in delta:
                     continue
@@ -225,8 +257,26 @@ class LimitRanger(AdmissionPlugin):
     def admit(self, op, kind, obj, old, user, store):
         if kind != "pods" or op != "create":
             return
-        items = [it for lr in store.list("limitranges", obj.namespace)
-                 for it in lr.spec.limits if it.type == "Container"]
+        all_items = [it for lr in store.list("limitranges", obj.namespace)
+                     for it in lr.spec.limits]
+        items = [it for it in all_items if it.type == "Container"]
+        # Pod-type limits bound the POD AGGREGATE (sum of container
+        # requests) — limitranger/admission.go PodLimitFunc's Pod branch
+        for it in (i for i in all_items if i.type == "Pod"):
+            totals: dict = {}
+            for c in obj.spec.containers:
+                for r, v in c.resources.requests.items():
+                    totals[r] = totals.get(r, 0) + v
+            for r, lo in it.min.items():
+                if totals.get(r, 0) < lo:
+                    raise AdmissionError(
+                        f"minimum {r} usage per Pod is {lo}; pod "
+                        f"{obj.metadata.name!r} requests {totals.get(r, 0)}")
+            for r, hi in it.max.items():
+                if totals.get(r, 0) > hi:
+                    raise AdmissionError(
+                        f"maximum {r} usage per Pod is {hi}; pod "
+                        f"{obj.metadata.name!r} requests {totals.get(r)}")
         if not items:
             return
         for c in obj.spec.containers:

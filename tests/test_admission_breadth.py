@@ -446,3 +446,80 @@ class TestServiceAccountAutomount:
                store=store)
         assert not any(v.name == "default-token"
                        for v in pod.spec.volumes)
+
+
+class TestQuotaScopes:
+    def _pod(self, name, cpu=None, deadline=None):
+        c = api.Container()
+        if cpu:
+            c.resources = api.ResourceRequirements(
+                requests=api.resource_list(cpu=cpu, memory="64Mi"))
+        p = api.Pod(metadata=api.ObjectMeta(name=name),
+                    spec=api.PodSpec(containers=[c]))
+        p.spec.active_deadline_seconds = deadline
+        return p
+
+    def test_besteffort_scope_only_counts_besteffort(self):
+        store = ObjectStore()
+        store.create("resourcequotas", api.ResourceQuota(
+            metadata=api.ObjectMeta(name="be"),
+            spec=api.ResourceQuotaSpec(hard={"pods": 1},
+                                       scopes=["BestEffort"])))
+        q = adm.ResourceQuotaAdmission()
+        # a burstable pod is OUTSIDE the scope: unlimited
+        _admit(q, "create", "pods", self._pod("b1", cpu="100m"),
+               store=store)
+        store.create("pods", self._pod("be1"))
+        with pytest.raises(adm.AdmissionError):
+            _admit(q, "create", "pods", self._pod("be2"), store=store)
+
+    def test_terminating_scope(self):
+        store = ObjectStore()
+        store.create("resourcequotas", api.ResourceQuota(
+            metadata=api.ObjectMeta(name="term"),
+            spec=api.ResourceQuotaSpec(hard={"pods": 1},
+                                       scopes=["Terminating"])))
+        q = adm.ResourceQuotaAdmission()
+        _admit(q, "create", "pods", self._pod("forever"), store=store)
+        store.create("pods", self._pod("bounded1", deadline=60))
+        with pytest.raises(adm.AdmissionError):
+            _admit(q, "create", "pods", self._pod("bounded2", deadline=30),
+                   store=store)
+        # scoped quotas never govern non-pod kinds
+        _admit(q, "create", "services", api.Service(
+            metadata=api.ObjectMeta(name="s"),
+            spec=api.ServiceSpec(ports=[api.ServicePort(port=80)])),
+            store=store)
+
+
+class TestLimitRangePodType:
+    def test_pod_aggregate_bounds(self):
+        store = ObjectStore()
+        store.create("limitranges", api.LimitRange(
+            metadata=api.ObjectMeta(name="lr"),
+            spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+                type="Pod",
+                max=api.resource_list(cpu="1"),
+                min=api.resource_list(cpu="200m"))])))
+        lr = adm.LimitRanger()
+        ok = api.Pod(metadata=api.ObjectMeta(name="ok"),
+                     spec=api.PodSpec(containers=[
+                         api.Container(name="a", resources=api.ResourceRequirements(
+                             requests=api.resource_list(cpu="300m"))),
+                         api.Container(name="b", resources=api.ResourceRequirements(
+                             requests=api.resource_list(cpu="300m")))]))
+        _admit(lr, "create", "pods", ok, store=store)
+        big = api.Pod(metadata=api.ObjectMeta(name="big"),
+                      spec=api.PodSpec(containers=[
+                          api.Container(name="a", resources=api.ResourceRequirements(
+                              requests=api.resource_list(cpu="600m"))),
+                          api.Container(name="b", resources=api.ResourceRequirements(
+                              requests=api.resource_list(cpu="600m")))]))
+        with pytest.raises(adm.AdmissionError):
+            _admit(lr, "create", "pods", big, store=store)
+        small = api.Pod(metadata=api.ObjectMeta(name="small"),
+                        spec=api.PodSpec(containers=[
+                            api.Container(name="a", resources=api.ResourceRequirements(
+                                requests=api.resource_list(cpu="100m")))]))
+        with pytest.raises(adm.AdmissionError):
+            _admit(lr, "create", "pods", small, store=store)

@@ -890,3 +890,22 @@ class TestDescribers:
             metadata=api.ObjectMeta(name="cm"), data={"a": "1"}))
         rc, out = run(server, "describe", "configmaps", "cm")
         assert rc == 0 and "kind: ConfigMap" in out
+
+
+class TestDirectoryApply:
+    def test_apply_directory_and_recursive(self, server, seeded, tmp_path):
+        import yaml
+
+        (tmp_path / "sub").mkdir()
+        for rel, name in (("a.yaml", "cm-a"), ("sub/b.yaml", "cm-b")):
+            (tmp_path / rel).write_text(yaml.safe_dump({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name}, "data": {}}))
+        (tmp_path / "notes.txt").write_text("ignored")
+        rc, out = run(server, "apply", "-f", str(tmp_path))
+        assert rc == 0 and "cm-a" in out and "cm-b" not in out
+        rc, out = run(server, "apply", "-f", str(tmp_path), "-R")
+        assert rc == 0 and "cm-b" in out
+        assert server.store.get("configmaps", "default", "cm-b") is not None
+        rc, _ = run(server, "apply", "-f", str(tmp_path / "sub" / "sub2"))
+        assert rc == 1  # missing dir is a clean error
