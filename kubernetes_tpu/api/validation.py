@@ -347,11 +347,22 @@ def validate_pdb(pdb) -> ErrorList:
     return errs
 
 
+QUOTA_SCOPES = ("BestEffort", "NotBestEffort", "Terminating",
+                "NotTerminating")
+
+
 def validate_resource_quota(q) -> ErrorList:
     errs = validate_object_meta(q.metadata)
     for k, v in (q.spec.hard or {}).items():
         if v < 0:
             errs.add(f"spec.hard[{k}]", v, "must be non-negative")
+    for s in getattr(q.spec, "scopes", None) or []:
+        # unknown scopes must be 422s (ValidateResourceQuotaSpec): a
+        # typo'd scope silently matching everything would turn a scoped
+        # quota into an unscoped one
+        if s not in QUOTA_SCOPES:
+            errs.add("spec.scopes", s,
+                     f"must be one of {', '.join(QUOTA_SCOPES)}")
     return errs
 
 

@@ -260,23 +260,34 @@ class LimitRanger(AdmissionPlugin):
         all_items = [it for lr in store.list("limitranges", obj.namespace)
                      for it in lr.spec.limits]
         items = [it for it in all_items if it.type == "Container"]
-        # Pod-type limits bound the POD AGGREGATE (sum of container
-        # requests) — limitranger/admission.go PodLimitFunc's Pod branch
-        for it in (i for i in all_items if i.type == "Pod"):
-            totals: dict = {}
+        # Pod-type limits bound the POD AGGREGATE — min against summed
+        # requests, max against summed LIMITS (falling back to the
+        # request when a container sets no limit), matching
+        # limitranger/admission.go PodLimitFunc's Pod branch
+        pod_items = [i for i in all_items if i.type == "Pod"]
+        if pod_items:
+            req_totals: dict = {}
+            lim_totals: dict = {}
             for c in obj.spec.containers:
                 for r, v in c.resources.requests.items():
-                    totals[r] = totals.get(r, 0) + v
-            for r, lo in it.min.items():
-                if totals.get(r, 0) < lo:
-                    raise AdmissionError(
-                        f"minimum {r} usage per Pod is {lo}; pod "
-                        f"{obj.metadata.name!r} requests {totals.get(r, 0)}")
-            for r, hi in it.max.items():
-                if totals.get(r, 0) > hi:
-                    raise AdmissionError(
-                        f"maximum {r} usage per Pod is {hi}; pod "
-                        f"{obj.metadata.name!r} requests {totals.get(r)}")
+                    req_totals[r] = req_totals.get(r, 0) + v
+                for r in set(c.resources.requests) | set(c.resources.limits):
+                    v = c.resources.limits.get(
+                        r, c.resources.requests.get(r, 0))
+                    lim_totals[r] = lim_totals.get(r, 0) + v
+            for it in pod_items:
+                for r, lo in it.min.items():
+                    if req_totals.get(r, 0) < lo:
+                        raise AdmissionError(
+                            f"minimum {r} usage per Pod is {lo}; pod "
+                            f"{obj.metadata.name!r} requests "
+                            f"{req_totals.get(r, 0)}")
+                for r, hi in it.max.items():
+                    if lim_totals.get(r, 0) > hi:
+                        raise AdmissionError(
+                            f"maximum {r} usage per Pod is {hi}; pod "
+                            f"{obj.metadata.name!r} limits "
+                            f"{lim_totals.get(r)}")
         if not items:
             return
         for c in obj.spec.containers:
@@ -311,13 +322,13 @@ class LimitRanger(AdmissionPlugin):
 class ServiceAccountAdmission(AdmissionPlugin):
     """plugin/pkg/admission/serviceaccount: default
     spec.serviceAccountName to 'default', require the account to exist
-    (admission.go DefaultServiceAccountName + fetch check), and
-    automount the SA's token Secret as a volume at the well-known path
-    unless the pod or SA opts out (admission.go mountServiceAccountToken
-    + Volumes injection)."""
+    (admission.go DefaultServiceAccountName + fetch check), and inject
+    the SA's token Secret as a pod VOLUME unless the SA opts out via
+    automountServiceAccountToken=false (admission.go
+    mountServiceAccountToken, collapsed to volume injection — this pod
+    model carries no per-container mount paths)."""
 
     name = "ServiceAccount"
-    TOKEN_MOUNT = "/var/run/secrets/kubernetes.io/serviceaccount"
 
     def admit(self, op, kind, obj, old, user, store):
         if kind != "pods" or op != "create":

@@ -982,12 +982,22 @@ class APIServer:
         NodePort/LoadBalancer ports. User-supplied values that collide
         with an existing allocation are 422s, like the reference's
         ErrAllocated path."""
+        # exclusion is by IDENTITY (namespace, name) — never by uid: a
+        # created manifest may carry a copied uid from `get -o yaml` of
+        # another service, which must still collide
+        me = (svc.metadata.namespace, svc.metadata.name)
         existing = [s for s in self.store.list("services")
-                    if s.metadata.uid != svc.metadata.uid]
+                    if (s.metadata.namespace, s.metadata.name) != me]
         used_ips = {s.spec.cluster_ip for s in existing
                     if s.spec.cluster_ip not in ("", "None")}
         used_ports = {p.node_port for s in existing
                       for p in s.spec.ports if p.node_port}
+        if svc.spec.type not in ("NodePort", "LoadBalancer"):
+            # releasing a type change: stale nodePorts would otherwise
+            # stay allocated forever (the reference clears them when the
+            # type stops needing them)
+            for p in svc.spec.ports:
+                p.node_port = 0
         if svc.spec.type != "ExternalName" \
                 and svc.spec.cluster_ip not in ("None",):
             if svc.spec.cluster_ip:

@@ -523,3 +523,30 @@ class TestLimitRangePodType:
                                 requests=api.resource_list(cpu="100m")))]))
         with pytest.raises(adm.AdmissionError):
             _admit(lr, "create", "pods", small, store=store)
+
+
+class TestQuotaScopeValidation:
+    def test_unknown_scope_is_422(self):
+        q = api.ResourceQuota(
+            metadata=api.ObjectMeta(name="q"),
+            spec=api.ResourceQuotaSpec(hard={"pods": 1},
+                                       scopes=["Terminatin"]))
+        errs = validation.validate("resourcequotas", q)
+        assert errs and "spec.scopes" in errs.message()
+
+    def test_pod_max_bounds_limits_not_requests(self):
+        store = ObjectStore()
+        store.create("limitranges", api.LimitRange(
+            metadata=api.ObjectMeta(name="lr"),
+            spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+                type="Pod", max=api.resource_list(memory="1Gi"))])))
+        lr = adm.LimitRanger()
+        sneaky = api.Pod(
+            metadata=api.ObjectMeta(name="sneaky"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="a",
+                resources=api.ResourceRequirements(
+                    requests=api.resource_list(memory="256Mi"),
+                    limits=api.resource_list(memory="2Gi")))]))
+        with pytest.raises(adm.AdmissionError):
+            _admit(lr, "create", "pods", sneaky, store=store)

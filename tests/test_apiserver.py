@@ -660,3 +660,41 @@ class TestServiceAllocation:
         got = client.get("services", "default", "later")
         assert got.spec.ports[0].node_port >= 30000
         assert got.spec.ports[0].node_port != port
+
+
+class TestServiceTypeChangeReleasesNodePort:
+    def test_nodeport_cleared_on_clusterip_downgrade(self, client):
+        svc = api.Service(
+            metadata=api.ObjectMeta(name="np"),
+            spec=api.ServiceSpec(selector={"a": "b"}, type="NodePort",
+                                 ports=[api.ServicePort(port=80)]))
+        client.create("services", svc)
+        got = client.get("services", "default", "np")
+        port = got.spec.ports[0].node_port
+        assert port >= 30000
+        got.spec.type = "ClusterIP"
+        client.update("services", got)
+        got = client.get("services", "default", "np")
+        assert got.spec.ports[0].node_port == 0
+        # the released port is immediately reusable
+        other = api.Service(
+            metadata=api.ObjectMeta(name="np2"),
+            spec=api.ServiceSpec(selector={"c": "d"}, type="NodePort",
+                                 ports=[api.ServicePort(port=81,
+                                                        node_port=port)]))
+        client.create("services", other)
+
+    def test_copied_uid_still_collides(self, client):
+        a = api.Service(metadata=api.ObjectMeta(name="a"),
+                        spec=api.ServiceSpec(selector={"x": "y"},
+                                             ports=[api.ServicePort(port=80)]))
+        client.create("services", a)
+        live = client.get("services", "default", "a")
+        clone = api.Service(
+            metadata=api.ObjectMeta(name="b", uid=live.metadata.uid),
+            spec=api.ServiceSpec(selector={"x": "y"},
+                                 cluster_ip=live.spec.cluster_ip,
+                                 ports=[api.ServicePort(port=80)]))
+        with pytest.raises(APIStatusError) as ei:
+            client.create("services", clone)
+        assert ei.value.code == 422
