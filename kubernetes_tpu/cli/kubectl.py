@@ -1862,13 +1862,14 @@ def cmd_convert(client, args, out):
 
 
 def cmd_set(client, args, out):
-    """set/set_image.go: `kubectl set image deploy/name c=img ...`
-    patches pod-template container images (triggering a rollout)."""
-    if args.action != "image":
-        raise SystemExit("error: set supports image")
+    """pkg/kubectl/cmd/set/: `set image KIND/NAME c=img...` (rollout via
+    template change), `set env KIND/NAME K=V... K-` (set_env.go), and
+    `set resources KIND/NAME --requests/--limits` (set_resources.go) —
+    all patch the pod template's containers, selected by -c (default
+    all)."""
     kind_name = args.target
     if "/" not in kind_name:
-        raise SystemExit("error: set image needs KIND/NAME")
+        raise SystemExit(f"error: set {args.action} needs KIND/NAME")
     kind, _, name = kind_name.partition("/")
     plural = _resolve_kind(kind)
     obj = client.get(plural, args.namespace, name)
@@ -1878,18 +1879,68 @@ def cmd_set(client, args, out):
         raise SystemExit(f"error: {kind}/{name} has no pod template")
     containers = (tmpl.spec.containers if tmpl is not None
                   else obj.spec.containers)
-    if any("=" not in kv for kv in args.images):
-        raise SystemExit("error: image updates must be container=image")
-    updates = dict(kv.split("=", 1) for kv in args.images)
-    changed = False
-    for c in containers:
-        if c.name in updates or "*" in updates:
-            c.image = updates.get(c.name, updates.get("*"))
-            changed = True
-    if not changed:
+    selected = [c for c in containers
+                if not args.container or c.name == args.container]
+    if not selected:
         raise SystemExit("error: no container matched")
-    client.update(plural, obj)
-    out.write(f"{plural}/{name} image updated\n")
+    if args.action == "image":
+        if any("=" not in kv for kv in args.images):
+            raise SystemExit("error: image updates must be container=image")
+        updates = dict(kv.split("=", 1) for kv in args.images)
+        changed = False
+        for c in containers:
+            if c.name in updates or "*" in updates:
+                c.image = updates.get(c.name, updates.get("*"))
+                changed = True
+        if not changed:
+            raise SystemExit("error: no container matched")
+        client.update(plural, obj)
+        out.write(f"{plural}/{name} image updated\n")
+        return
+    if args.action == "env":
+        for kv in args.images:  # positional K=V / K- items
+            if kv.endswith("-"):
+                for c in selected:
+                    c.env.pop(kv[:-1], None)
+            elif "=" in kv:
+                k, _, v = kv.partition("=")
+                for c in selected:
+                    c.env = dict(c.env or {}, **{k: v})
+            else:
+                raise SystemExit(f"error: env needs KEY=VALUE or KEY-, "
+                                 f"got {kv!r}")
+        client.update(plural, obj)
+        out.write(f"{plural}/{name} env updated\n")
+        return
+    if args.action == "resources":
+        from ..api import resources as resq
+
+        def parse_rl(text):
+            # canonical container-resource units (api.resource_list):
+            # cpu in millicores, everything else in base units/bytes
+            outd = {}
+            for kv in (text or "").split(","):
+                if not kv:
+                    continue
+                k, eq, v = kv.partition("=")
+                if not eq:
+                    raise SystemExit(f"error: --requests/--limits need "
+                                     f"KEY=VALUE, got {kv!r}")
+                outd[k] = (resq.milli(v) if k == resq.CPU
+                           else resq.value(v))
+            return outd
+
+        reqs, lims = parse_rl(args.requests), parse_rl(args.limits)
+        if not reqs and not lims:
+            raise SystemExit("error: set resources needs --requests "
+                             "and/or --limits")
+        for c in selected:
+            c.resources.requests.update(reqs)
+            c.resources.limits.update(lims)
+        client.update(plural, obj)
+        out.write(f"{plural}/{name} resource requirements updated\n")
+        return
+    raise SystemExit(f"error: unknown set action {args.action!r}")
 
 
 def cmd_wait(client, args, out):
@@ -2282,9 +2333,14 @@ def build_parser() -> argparse.ArgumentParser:
                     default="yaml")
 
     se = sub.add_parser("set")
-    se.add_argument("action", choices=["image"])
+    se.add_argument("action", choices=["image", "env", "resources"])
     se.add_argument("target", help="KIND/NAME")
-    se.add_argument("images", nargs="+", help="container=image ('*' for all)")
+    se.add_argument("images", nargs="*",
+                    help="image: container=image ('*' for all); "
+                         "env: K=V or K-")
+    se.add_argument("--container", "-c", default="")
+    se.add_argument("--requests", default="")
+    se.add_argument("--limits", default="")
 
     wt = sub.add_parser("wait")
     wt.add_argument("kind")

@@ -909,3 +909,34 @@ class TestDirectoryApply:
         assert server.store.get("configmaps", "default", "cm-b") is not None
         rc, _ = run(server, "apply", "-f", str(tmp_path / "sub" / "sub2"))
         assert rc == 1  # missing dir is a clean error
+
+
+class TestSetEnvResources:
+    def test_set_env_add_and_remove(self, server, seeded):
+        rc, _ = run(server, "create", "deployment", "web",
+                    "--image", "nginx:1")
+        assert rc == 0
+        rc, _ = run(server, "set", "env", "deployment/web",
+                    "MODE=fast", "DEBUG=1")
+        assert rc == 0
+        dep = seeded.get("deployments", "default", "web")
+        env = dep.spec.template.spec.containers[0].env
+        assert env == {"MODE": "fast", "DEBUG": "1"}
+        rc, _ = run(server, "set", "env", "deployment/web", "DEBUG-")
+        assert rc == 0
+        dep = seeded.get("deployments", "default", "web")
+        assert dep.spec.template.spec.containers[0].env == {"MODE": "fast"}
+
+    def test_set_resources(self, server, seeded):
+        rc, _ = run(server, "create", "deployment", "web",
+                    "--image", "nginx:1")
+        assert rc == 0
+        rc, _ = run(server, "set", "resources", "deployment/web",
+                    "--requests", "cpu=250m,memory=128Mi",
+                    "--limits", "cpu=1")
+        assert rc == 0
+        res = seeded.get("deployments", "default", "web") \
+            .spec.template.spec.containers[0].resources
+        assert res.requests["cpu"] == 250 and res.limits["cpu"] == 1000
+        with pytest.raises(SystemExit):  # needs --requests/--limits
+            run(server, "set", "resources", "deployment/web")
