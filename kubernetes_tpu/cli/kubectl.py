@@ -1888,7 +1888,7 @@ def cmd_set(client, args, out):
             raise SystemExit("error: image updates must be container=image")
         updates = dict(kv.split("=", 1) for kv in args.images)
         changed = False
-        for c in containers:
+        for c in selected:
             if c.name in updates or "*" in updates:
                 c.image = updates.get(c.name, updates.get("*"))
                 changed = True
@@ -1898,8 +1898,11 @@ def cmd_set(client, args, out):
         out.write(f"{plural}/{name} image updated\n")
         return
     if args.action == "env":
+        if not args.images:
+            raise SystemExit("error: set env needs at least one "
+                             "KEY=VALUE or KEY-")
         for kv in args.images:  # positional K=V / K- items
-            if kv.endswith("-"):
+            if kv.endswith("-") and "=" not in kv:
                 for c in selected:
                     c.env.pop(kv[:-1], None)
             elif "=" in kv:
@@ -1926,8 +1929,11 @@ def cmd_set(client, args, out):
                 if not eq:
                     raise SystemExit(f"error: --requests/--limits need "
                                      f"KEY=VALUE, got {kv!r}")
-                outd[k] = (resq.milli(v) if k == resq.CPU
-                           else resq.value(v))
+                try:
+                    outd[k] = (resq.milli(v) if k == resq.CPU
+                               else resq.value(v))
+                except ValueError as e:
+                    raise SystemExit(f"error: {e}") from e
             return outd
 
         reqs, lims = parse_rl(args.requests), parse_rl(args.limits)

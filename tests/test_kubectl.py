@@ -940,3 +940,36 @@ class TestSetEnvResources:
         assert res.requests["cpu"] == 250 and res.limits["cpu"] == 1000
         with pytest.raises(SystemExit):  # needs --requests/--limits
             run(server, "set", "resources", "deployment/web")
+
+
+class TestSetEdgeCases:
+    def test_value_ending_in_dash_is_assignment(self, server, seeded):
+        run(server, "create", "deployment", "web", "--image", "n:1")
+        rc, _ = run(server, "set", "env", "deployment/web", "MODE=fast-")
+        assert rc == 0
+        env = seeded.get("deployments", "default", "web") \
+            .spec.template.spec.containers[0].env
+        assert env == {"MODE": "fast-"}
+        with pytest.raises(SystemExit):
+            run(server, "set", "env", "deployment/web")
+
+    def test_set_image_honors_container_selector(self, server, seeded):
+        import json as _json
+        run(server, "create", "deployment", "web", "--image", "n:1")
+        dep = seeded.get("deployments", "default", "web")
+        dep.spec.template.spec.containers.append(
+            api.Container(name="sidecar", image="s:1"))
+        seeded.update("deployments", dep)
+        rc, _ = run(server, "set", "image", "deployment/web",
+                    "-c", "sidecar", "*=s:2")
+        assert rc == 0
+        imgs = {c.name: c.image for c in
+                seeded.get("deployments", "default", "web")
+                .spec.template.spec.containers}
+        assert imgs == {"web": "n:1", "sidecar": "s:2"}
+
+    def test_bad_quantity_is_clean_error(self, server, seeded):
+        run(server, "create", "deployment", "web", "--image", "n:1")
+        with pytest.raises(SystemExit):
+            run(server, "set", "resources", "deployment/web",
+                "--requests", "cpu=fast")
