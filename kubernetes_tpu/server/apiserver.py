@@ -1238,6 +1238,20 @@ class APIServer:
         rv = query.get("resourceVersion", [None])[0]
         since = int(rv) if rv not in (None, "", "0") else None
         timeout = float(query.get("timeoutSeconds", ["30"])[0])
+        sel = query.get("labelSelector", [None])[0]
+        parsed_sel = None
+        if sel:
+            from ..api.labels import Selector
+
+            try:
+                parsed_sel = Selector.parse(sel)
+            except ValueError:
+                raise APIError(400, "BadRequest",
+                               f"unparseable labelSelector {sel!r}")
+
+        def _matches(o) -> bool:
+            return parsed_sel is None or \
+                parsed_sel.matches(o.metadata.labels or {})
         # resourceVersion=0: deliver current state as synthetic ADDED events
         # then go live (cacher's GetAllEventsSince for zero version,
         # storage/watch_cache.go) — must snapshot state and open the live
@@ -1260,6 +1274,8 @@ class APIServer:
             h.send_header("Transfer-Encoding", "chunked")
             h.end_headers()
             for obj in initial:
+                if not _matches(obj):
+                    continue
                 line = (json.dumps(
                     {"type": "ADDED",
                      "object": scheme.encode_object(obj, version=gv)})
@@ -1277,8 +1293,25 @@ class APIServer:
                     if watcher.stopped:
                         break
                     continue
+                etype = ev.type
+                if parsed_sel is not None:
+                    # cacher watch filtering incl. TRANSITIONS
+                    # (storage/cacher.go watchFilterFunc over prevObject):
+                    # entering the selector surfaces as ADDED, leaving
+                    # as DELETED, outside-only events are dropped
+                    cur_m = _matches(ev.obj)
+                    old_m = ev.old is not None and _matches(ev.old)
+                    if etype == "MODIFIED":
+                        if cur_m and not old_m:
+                            etype = "ADDED"
+                        elif old_m and not cur_m:
+                            etype = "DELETED"
+                        elif not cur_m:
+                            continue
+                    elif not cur_m:
+                        continue
                 line = (json.dumps(
-                    {"type": ev.type,
+                    {"type": etype,
                      "object": scheme.encode_object(ev.obj, version=gv)})
                     + "\n").encode()
                 h.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))

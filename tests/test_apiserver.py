@@ -698,3 +698,45 @@ class TestServiceTypeChangeReleasesNodePort:
         with pytest.raises(APIStatusError) as ei:
             client.create("services", clone)
         assert ei.value.code == 422
+
+
+class TestWatchSelector:
+    def test_watch_with_selector_translates_transitions(self, server,
+                                                        client):
+        seen = []
+        done = threading.Event()
+
+        def watch():
+            import urllib.request
+            url = (server.url + "/api/v1/pods?watch=true"
+                   "&labelSelector=tier%3Dgold&timeoutSeconds=6"
+                   "&resourceVersion=0")
+            import json as _json
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    ev = _json.loads(line)
+                    seen.append((ev["type"],
+                                 ev["object"]["metadata"]["name"]))
+                    if len(seen) >= 3:
+                        done.set()
+                        return
+
+        gold = mkpod("gold")
+        gold.metadata.labels = {"tier": "gold"}
+        client.create("pods", gold)  # matches: initial ADDED
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        client.create("pods", mkpod("plain"))  # non-matching: dropped
+        live = client.get("pods", "default", "plain")
+        live.metadata.labels = {"tier": "gold"}
+        client.update("pods", live)  # enters selector -> ADDED
+        live = client.get("pods", "default", "plain")
+        live.metadata.labels = {}
+        client.update("pods", live)  # leaves selector -> DELETED
+        assert done.wait(8), seen
+        assert seen == [("ADDED", "gold"), ("ADDED", "plain"),
+                        ("DELETED", "plain")]
