@@ -295,13 +295,28 @@ def cmd_get(client, args, out):
         return
     plural = _resolve_kind(args.kind)
     sel, fsel = _parse_selector_flags(args)
+    list_rv = None
     if args.name:
         obj = client.get(plural, args.namespace, args.name)
         objs = [obj]
     else:
         ns = None if args.all_namespaces else args.namespace
-        objs, _ = client.list(plural, ns, label_selector=sel,
-                              field_selector=fsel)
+        objs, list_rv = client.list(plural, ns, label_selector=sel,
+                                    field_selector=fsel)
+    if args.watch:
+        # get -w (resource_printer + watch): print current rows, then
+        # one row per event from the LIST's resourceVersion on (no
+        # duplicated synthetic ADDEDs) until --watch-timeout expires
+        headers, row_fn = _COLUMNS.get(
+            plural, (["NAME", "AGE"], lambda o: [o.metadata.name, _age(o)]))
+        _write_table(headers, [list(row_fn(o)) for o in objs], out)
+        for etype, obj in client.watch(
+                plural, resource_version=list_rv,
+                timeout_seconds=args.watch_timeout,
+                label_selector=sel):
+            out.write("  ".join([etype] + [str(c) for c in row_fn(obj)])
+                      + "\n")
+        return
     fmt = args.output
     if fmt in ("yaml", "json"):
         for o in objs:
@@ -2134,6 +2149,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--selector", "-l", default=None)
     g.add_argument("--field-selector", default=None)
     g.add_argument("--show-labels", action="store_true")
+    g.add_argument("--watch", "-w", action="store_true")
+    g.add_argument("--watch-timeout", type=float, default=5.0,
+                   help="seconds to stream events before returning "
+                        "(real kubectl streams forever)")
 
     d = sub.add_parser("describe")
     d.add_argument("kind")

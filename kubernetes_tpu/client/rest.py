@@ -279,14 +279,19 @@ class RESTClient:
 
     def watch(self, plural: str, resource_version: Optional[int] = None,
               timeout_seconds: float = 30.0,
-              stop: Optional[threading.Event] = None
+              stop: Optional[threading.Event] = None,
+              label_selector=None
               ) -> Iterator[Tuple[str, object]]:
         """Yields (event_type, object). Returns when the server closes the
         stream (timeout) or `stop` is set. Raises APIStatusError(410) when
-        the resourceVersion is too old — caller relists (reflector.go)."""
+        the resourceVersion is too old — caller relists (reflector.go).
+        label_selector filters server-side (transitions translate to
+        ADDED/DELETED like the cacher)."""
         q = f"watch=true&timeoutSeconds={timeout_seconds:g}"
         if resource_version is not None:
             q += f"&resourceVersion={resource_version}"
+        for frag in _selector_query(label_selector, None):
+            q += "&" + frag
         url = self.base_url + self._path(plural, None, None) + "?" + q
         req = urllib.request.Request(url)
         req.add_header("User-Agent", self.user_agent)

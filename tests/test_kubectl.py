@@ -973,3 +973,33 @@ class TestSetEdgeCases:
         with pytest.raises(SystemExit):
             run(server, "set", "resources", "deployment/web",
                 "--requests", "cpu=fast")
+
+
+class TestGetWatch:
+    def test_get_watch_streams_events(self, server, seeded):
+        import threading as _th
+        import time as _time
+
+        result = {}
+
+        def go():
+            result["out"] = run(server, "get", "pods", "-w",
+                                "--watch-timeout", "3",
+                                "-l", "tier=gold")
+
+        t = _th.Thread(target=go, daemon=True)
+        t.start()
+        _time.sleep(0.4)
+        gold = api.Pod(metadata=api.ObjectMeta(name="g1",
+                                               labels={"tier": "gold"}),
+                       spec=api.PodSpec(containers=[api.Container()]))
+        seeded.create("pods", gold)
+        seeded.create("pods", api.Pod(  # filtered out
+            metadata=api.ObjectMeta(name="plain2"),
+            spec=api.PodSpec(containers=[api.Container()])))
+        seeded.delete("pods", "default", "g1")
+        t.join(8)
+        rc, out = result["out"]
+        assert rc == 0
+        assert "ADDED  g1" in out and "DELETED  g1" in out, out
+        assert "plain2" not in out
