@@ -947,6 +947,9 @@ def _create_generated(client, args, out):
         plural = "poddisruptionbudgets"
     else:
         raise ManifestError(f"unknown create generator {gen!r}")
+    if args.dry_run:
+        out.write(f"{plural}/{obj.metadata.name} created (dry run)\n")
+        return
     client.create(plural, obj)
     out.write(f"{plural}/{obj.metadata.name} created\n")
 
@@ -963,6 +966,11 @@ def cmd_create(client, args, out):
         plural = scheme.plural_for_kind(kind)
         if scheme.is_namespaced(kind) and args.namespace != "default":
             obj.metadata.namespace = args.namespace
+        if args.dry_run:
+            # client-side --dry-run (1.11 kubectl): decode + print, no
+            # write; decoding already surfaced manifest errors
+            out.write(f"{plural}/{obj.metadata.name} created (dry run)\n")
+            continue
         client.create(plural, obj)
         if isinstance(obj, api.CustomResourceDefinition):
             scheme.register_dynamic(obj)  # later docs may use the kind
@@ -1071,6 +1079,10 @@ def cmd_apply(client, args, out):
         except APIStatusError as e:
             if e.code != 404:
                 raise
+            if args.dry_run:
+                out.write(f"{plural}/{obj.metadata.name} created "
+                          f"(dry run)\n")
+                continue
             obj.metadata.annotations = dict(obj.metadata.annotations or {})
             obj.metadata.annotations[LAST_APPLIED_ANNOTATION] = \
                 json.dumps(doc, sort_keys=True)
@@ -1094,6 +1106,10 @@ def cmd_apply(client, args, out):
                              _mp_changes(live_doc, doc))
         _merge_dicts(patch, {"metadata": {"annotations": {
             LAST_APPLIED_ANNOTATION: json.dumps(doc, sort_keys=True)}}})
+        if args.dry_run:
+            out.write(f"{plural}/{obj.metadata.name} configured "
+                      f"(dry run)\n")
+            continue
         client.patch(plural, obj.metadata.namespace, obj.metadata.name,
                      patch)
         out.write(f"{plural}/{obj.metadata.name} configured\n")
@@ -1136,6 +1152,10 @@ def _apply_prune(client, args, applied: set, out):
                     continue
                 if LAST_APPLIED_ANNOTATION not in (o.metadata.annotations
                                                    or {}):
+                    continue
+                if args.dry_run:
+                    out.write(f"{plural}/{o.metadata.name} pruned "
+                              f"(dry run)\n")
                     continue
                 client.delete(plural, o.metadata.namespace,
                               o.metadata.name)
@@ -2169,6 +2189,7 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("extra_name", nargs="?")
     c.add_argument("--filename", "-f", default=None)
     c.add_argument("--recursive", "-R", action="store_true")
+    c.add_argument("--dry-run", action="store_true")
     c.add_argument("--from-literal", action="append")
     c.add_argument("--from-file", action="append")
     c.add_argument("--type", default="Opaque")
@@ -2199,6 +2220,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap_apply.add_argument("name", nargs="?")
     ap_apply.add_argument("--filename", "-f", default=None)
     ap_apply.add_argument("--recursive", "-R", action="store_true")
+    ap_apply.add_argument("--dry-run", action="store_true")
     ap_apply.add_argument("--prune", action="store_true")
     ap_apply.add_argument("--selector", "-l", default=None)
 

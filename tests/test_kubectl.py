@@ -1003,3 +1003,31 @@ class TestGetWatch:
         assert rc == 0
         assert "ADDED  g1" in out and "DELETED  g1" in out, out
         assert "plain2" not in out
+
+
+class TestDryRun:
+    def test_create_apply_dry_run_write_nothing(self, server, seeded,
+                                                tmp_path):
+        import yaml
+        m = tmp_path / "cm.yaml"
+        m.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "dry"}, "data": {"k": "1"}}))
+        rc, out = run(server, "create", "-f", str(m), "--dry-run")
+        assert rc == 0 and "(dry run)" in out
+        assert server.store.get("configmaps", "default", "dry") is None
+        rc, out = run(server, "apply", "-f", str(m), "--dry-run")
+        assert rc == 0 and "(dry run)" in out
+        assert server.store.get("configmaps", "default", "dry") is None
+        rc, out = run(server, "create", "configmap", "gen-dry",
+                      "--from-literal", "a=1", "--dry-run")
+        assert rc == 0 and "(dry run)" in out
+        assert server.store.get("configmaps", "default", "gen-dry") is None
+        # live apply then dry-run apply of a CHANGE leaves live untouched
+        rc, _ = run(server, "apply", "-f", str(m))
+        assert rc == 0
+        m.write_text(m.read_text().replace("'1'", "'2'"))
+        rc, out = run(server, "apply", "-f", str(m), "--dry-run")
+        assert rc == 0 and "configured (dry run)" in out
+        assert server.store.get("configmaps", "default",
+                                "dry").data == {"k": "1"}
