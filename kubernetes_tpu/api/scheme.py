@@ -122,6 +122,8 @@ register("ReplicaSet", "replicasets", api.ReplicaSet, "apps/v1")
 register("StatefulSet", "statefulsets", api.StatefulSet, "apps/v1")
 register("Deployment", "deployments", api.Deployment, "apps/v1")
 register("DaemonSet", "daemonsets", api.DaemonSet, "apps/v1")
+register("ControllerRevision", "controllerrevisions", api.ControllerRevision,
+         "apps/v1")
 register("Job", "jobs", api.Job, "batch/v1")
 register("CronJob", "cronjobs", api.CronJob, "batch/v1beta1")
 register("PodDisruptionBudget", "poddisruptionbudgets", api.PodDisruptionBudget,
@@ -280,6 +282,18 @@ def encode(value) -> Any:
     if isinstance(value, (list, tuple)):
         return [encode(v) for v in value]
     return value
+
+
+def stable_hash(value, length: int = 40) -> str:
+    """sha1 of the canonical sorted-JSON wire form (util/hash
+    ComputeHash analog) — THE one content-hash idiom; template hashing,
+    ControllerRevision identity, and generation fingerprints all go
+    through here so canonicalization fixes land everywhere at once."""
+    import hashlib
+    enc = value if isinstance(value, (dict, list)) else encode(value)
+    return hashlib.sha1(
+        json.dumps(enc, sort_keys=True, default=str).encode()
+    ).hexdigest()[:length]
 
 
 def encode_object(obj, version: Optional[str] = None) -> Dict[str, Any]:

@@ -31,6 +31,10 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
+    # spec-change counter (apimachinery ObjectMeta.Generation): bumped
+    # by the store when a workload kind's spec fingerprint changes;
+    # controllers echo it into status.observedGeneration
+    generation: int = 0
     deletion_timestamp: Optional[float] = None
     # deletion gates (apimachinery ObjectMeta.Finalizers): a DELETE with
     # finalizers present only marks deletion_timestamp; the object goes
@@ -579,12 +583,25 @@ class ReplicaSet:
 
 
 @dataclass
+class StatefulSetUpdateStrategy:
+    """apps/v1 StatefulSetUpdateStrategy: RollingUpdate replaces stale
+    pods in reverse ordinal order down to (but not including)
+    `partition`; OnDelete waits for manual deletion."""
+
+    type: str = "RollingUpdate"  # RollingUpdate | OnDelete
+    partition: int = 0
+
+
+@dataclass
 class StatefulSetSpec:
     replicas: int = 1
     selector: Optional[LabelSelector] = None
     template: Optional[PodTemplateSpec] = None
     service_name: str = ""
     pod_management_policy: str = "OrderedReady"
+    update_strategy: StatefulSetUpdateStrategy = field(
+        default_factory=StatefulSetUpdateStrategy)
+    revision_history_limit: int = 10
     # per-ordinal PVCs minted as <template>-<set>-<ordinal>; retained on
     # scale-down (apps/v1 StatefulSetSpec.VolumeClaimTemplates)
     volume_claim_templates: List[PersistentVolumeClaim] = field(
@@ -596,6 +613,11 @@ class StatefulSetStatus:
     replicas: int = 0
     ready_replicas: int = 0
     current_replicas: int = 0
+    updated_replicas: int = 0
+    # names of the ControllerRevisions serving current/target identity
+    # (apps/v1 StatefulSetStatus.CurrentRevision/UpdateRevision)
+    current_revision: str = ""
+    update_revision: str = ""
     observed_generation: int = 0
 
 
@@ -667,6 +689,7 @@ class DaemonSetSpec:
     template: Optional[PodTemplateSpec] = None
     update_strategy: DaemonSetUpdateStrategy = field(
         default_factory=DaemonSetUpdateStrategy)
+    revision_history_limit: int = 10
 
 
 @dataclass
@@ -684,6 +707,21 @@ class DaemonSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
     status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+
+@dataclass
+class ControllerRevision:
+    """apps/v1 ControllerRevision: an immutable, numbered snapshot of a
+    workload's pod template, owned by its DaemonSet/StatefulSet and used
+    for rollout history/undo. Reference: pkg/apis/apps/v1/types.go
+    (ControllerRevision), managed through
+    pkg/controller/history/controller_history.go."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # wire-form snapshot (the encoded pod template under {"spec":
+    # {"template": ...}}, matching the reference's raw patch payload)
+    data: Dict = field(default_factory=dict)
+    revision: int = 0
 
 
 @dataclass

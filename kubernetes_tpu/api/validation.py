@@ -223,9 +223,14 @@ def _validate_workload(obj, selector_required: bool = True) -> ErrorList:
             errs.add("spec.updateStrategy.type", strategy.type,
                      'must be "RollingUpdate" or "OnDelete"')
         elif strategy.type == "RollingUpdate" \
-                and strategy.max_unavailable < 1:
+                and getattr(strategy, "max_unavailable", 1) < 1:
             errs.add("spec.updateStrategy.rollingUpdate.maxUnavailable",
                      strategy.max_unavailable, "must be at least 1")
+        # StatefulSet strategies carry a partition instead of a budget
+        # (apps/validation ValidateStatefulSetUpdateStrategy)
+        if getattr(strategy, "partition", 0) < 0:
+            errs.add("spec.updateStrategy.rollingUpdate.partition",
+                     strategy.partition, "must be non-negative")
     return errs
 
 

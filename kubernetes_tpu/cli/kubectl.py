@@ -636,7 +636,7 @@ def cmd_diff(client, args, out):
             if isinstance(node, dict):
                 meta = node.get("metadata")
                 if isinstance(meta, dict):
-                    for k in ("resourceVersion", "uid"):
+                    for k in ("resourceVersion", "uid", "generation"):
                         meta.pop(k, None)
                 for v in node.values():
                     scrub(v)
@@ -1252,12 +1252,112 @@ def _deployment_and_rss(client, args):
     return dep, owned
 
 
+def _rollout_revisioned(client, args, out, plural):
+    """rollout history/undo/status for ControllerRevision-backed kinds
+    (pkg/kubectl/history.go DaemonSetHistoryViewer:154 /
+    StatefulSetHistoryViewer:205, rollback.go DaemonSetRollbacker:198):
+    history lists the owned ControllerRevisions; undo splices the target
+    revision's template snapshot back into the workload spec."""
+    from ..api import scheme as _scheme
+    from ..api import types as _api
+
+    kind = "daemonset" if plural == "daemonsets" else "statefulset"
+    obj = client.get(plural, args.namespace, args.name)
+    if obj is None:
+        raise SystemExit(f"error: {kind} {args.name!r} not found")
+    revs, _ = client.list("controllerrevisions", args.namespace)
+    owned = sorted(
+        (r for r in revs
+         if any(o.controller and o.uid == obj.metadata.uid
+                for o in r.metadata.owner_references)),
+        key=lambda r: r.revision)
+    name = obj.metadata.name
+    if args.action == "history":
+        out.write(f"{kind}.apps/{name}\nREVISION\n")
+        for r in owned:
+            out.write(f"{r.revision}\n")
+    elif args.action == "undo":
+        if args.to_revision:
+            target = next((r for r in owned
+                           if r.revision == int(args.to_revision)), None)
+            if target is None:
+                raise SystemExit(
+                    f"error: revision {args.to_revision} not found")
+        else:
+            if len(owned) < 2:
+                raise SystemExit("error: no rollout history found")
+            target = owned[-2]
+        tmpl = _scheme.decode(_api.PodTemplateSpec,
+                              target.data["spec"]["template"])
+        obj.spec.template = tmpl
+        client.update(plural, obj)
+        out.write(f"{kind}.apps/{name} rolled back to revision "
+                  f"{target.revision}\n")
+    elif args.action == "status":
+        st = obj.status
+        # rollout_status.go: progress is only defined for RollingUpdate
+        if obj.spec.update_strategy.type != "RollingUpdate":
+            raise SystemExit(
+                "error: rollout status is only available for RollingUpdate "
+                "strategy type")
+        # rollout_status.go gates on status.observedGeneration >=
+        # metadata.generation — status counts are stale until the
+        # controller has synced the current spec
+        if st.observed_generation < obj.metadata.generation:
+            out.write(f"Waiting for {kind} spec update to be observed...\n")
+            return
+        if plural == "daemonsets":
+            want = st.desired_number_scheduled
+            if st.updated_number_scheduled < want:
+                out.write(f"Waiting for daemon set \"{name}\" rollout to "
+                          f"finish: {st.updated_number_scheduled} out of "
+                          f"{want} new pods have been updated...\n")
+            elif st.number_ready < want:
+                out.write(f"Waiting for daemon set \"{name}\" rollout to "
+                          f"finish: {st.number_ready} of {want} updated "
+                          f"pods are available...\n")
+            else:
+                out.write(f'daemon set "{name}" successfully rolled out\n')
+        else:
+            want = obj.spec.replicas
+            partition = obj.spec.update_strategy.partition
+            if partition > 0:
+                # rollout_status.go StatefulSetStatusViewer: a
+                # partitioned rollout is complete once every ordinal at
+                # or above the partition serves the update revision
+                if st.updated_replicas < want - partition:
+                    out.write(f"Waiting for partitioned roll out to "
+                              f"finish: {st.updated_replicas} out of "
+                              f"{want - partition} new pods have been "
+                              f"updated...\n")
+                else:
+                    out.write(f"partitioned roll out complete: "
+                              f"{st.updated_replicas} new pods have been "
+                              f"updated...\n")
+            elif st.updated_replicas < want or \
+                    st.current_revision != st.update_revision:
+                out.write(f"Waiting for statefulset rolling update to "
+                          f"complete {st.updated_replicas} pods at revision "
+                          f"{st.update_revision}...\n")
+            else:
+                out.write(f"statefulset rolling update complete "
+                          f"{st.updated_replicas} pods at revision "
+                          f"{st.update_revision}...\n")
+    else:
+        raise SystemExit(
+            f"error: rollout {args.action!r} not supported for {kind}")
+
+
 def cmd_rollout(client, args, out):
     from ..controllers.deployment import (HASH_LABEL, REVISION_ANNOTATION,
                                           template_hash)
 
-    if _resolve_kind(args.kind) != "deployments":
-        raise SystemExit("error: rollout supports deployments")
+    plural = _resolve_kind(args.kind)
+    if plural in ("daemonsets", "statefulsets"):
+        return _rollout_revisioned(client, args, out, plural)
+    if plural != "deployments":
+        raise SystemExit(
+            "error: rollout supports deployments, daemonsets, statefulsets")
     dep, owned = _deployment_and_rss(client, args)
     name = dep.metadata.name
     if args.action == "status":

@@ -16,6 +16,7 @@ from ..plugins import golden
 from ..state.node_info import NodeInfo
 from .base import (Controller, is_pod_active, is_pod_ready,
                    make_pod_from_template, pod_owned_by)
+from .history import REV_LABEL
 
 
 class DaemonSetController(Controller):
@@ -64,18 +65,32 @@ class DaemonSetController(Controller):
         return ok
 
     def sync(self, key: str):
-        from .deployment import HASH_LABEL, template_hash
+        from . import history
 
         ns, name = key.split("/", 1)
         ds = self.store.get("daemonsets", ns, name)
         if ds is None:
             return
-        cur_hash = template_hash(ds.spec.template)
+        # rollout history (daemon/update.go constructHistory): snapshot
+        # the current template as a ControllerRevision and reap history
+        # beyond the limit; live revisions (a pod still wears the hash)
+        # are never reaped. The revision hash is ALSO the staleness
+        # label — one content hash drives update decisions and history,
+        # like the reference's controller-revision-hash
+        rev = history.sync_revision(self.store, ds, "DaemonSet",
+                                    ds.spec.template)
+        cur_hash = (rev.metadata.labels or {}).get(REV_LABEL, "")
         nodes = self.store.list("nodes")
         owned: List[api.Pod] = [
             p for p in self.store.list("pods", ns)
             if any(r.controller and r.kind == "DaemonSet" and r.name == name
                    for r in p.metadata.owner_references)]
+        history.truncate_history(
+            self.store, ds, "DaemonSet",
+            live_hashes={(p.metadata.labels or {}).get(REV_LABEL)
+                for p in owned if
+                is_pod_active(p)},
+            keep_names={rev.metadata.name})
         by_node = {}
         for p in owned:
             by_node.setdefault(p.spec.node_name, []).append(p)
@@ -97,11 +112,11 @@ class DaemonSetController(Controller):
                     # deleting the fresh replacement instead of the
                     # stale duplicate would churn an extra round
                     have.sort(key=lambda p: (p.metadata.labels or {})
-                              .get(HASH_LABEL) != cur_hash)
+                              .get(REV_LABEL) != cur_hash)
                     for extra in have[1:]:
                         self._delete(extra)
                     p = have[0]
-                    p_hash = (p.metadata.labels or {}).get(HASH_LABEL)
+                    p_hash = (p.metadata.labels or {}).get(REV_LABEL)
                     if p_hash == cur_hash:
                         updated += 1
                         if not is_pod_ready(p):
@@ -120,8 +135,9 @@ class DaemonSetController(Controller):
                         ds.spec.template, "DaemonSet", ds,
                         f"{name}-{node.metadata.name}")
                     pod.spec.node_name = node.metadata.name
-                    pod.metadata.labels = dict(pod.metadata.labels or {},
-                                               **{HASH_LABEL: cur_hash})
+                    pod.metadata.labels = dict(
+                        pod.metadata.labels or {},
+                        **{REV_LABEL: cur_hash})
                     try:
                         self.store.create("pods", pod)
                     except Conflict:
@@ -158,16 +174,18 @@ class DaemonSetController(Controller):
                    and r.name == ds.metadata.name
                    for r in p.metadata.owner_references) and is_pod_ready(p):
                 ready += 1
+        gen = ds.metadata.generation
         if (st.desired_number_scheduled, st.current_number_scheduled,
                 st.number_misscheduled, st.number_ready,
-                st.updated_number_scheduled) == \
-                (desired, scheduled, misscheduled, ready, updated):
+                st.updated_number_scheduled, st.observed_generation) == \
+                (desired, scheduled, misscheduled, ready, updated, gen):
             return
         st.desired_number_scheduled = desired
         st.current_number_scheduled = scheduled
         st.number_misscheduled = misscheduled
         st.number_ready = ready
         st.updated_number_scheduled = updated
+        st.observed_generation = gen
         try:
             self.store.update("daemonsets", ds)
         except (Conflict, KeyError):

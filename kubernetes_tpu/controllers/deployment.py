@@ -9,7 +9,6 @@ olds to zero before creating the new RS (recreate.go).
 
 from __future__ import annotations
 
-import hashlib
 from typing import List, Optional, Tuple
 
 from ..api import scheme
@@ -30,8 +29,7 @@ def template_hash(template: api.PodTemplateSpec) -> str:
     """Stable hash of the pod template (util/hash ComputeHash analog)."""
     enc = scheme.encode(template)
     enc.get("metadata", {}).pop("uid", None)
-    import json
-    return hashlib.sha1(json.dumps(enc, sort_keys=True).encode()).hexdigest()[:10]
+    return scheme.stable_hash(enc, 10)
 
 
 class DeploymentController(Controller):
@@ -190,9 +188,12 @@ class DeploymentController(Controller):
             available_replicas=sum(r.status.ready_replicas for r in all_rs),
             unavailable_replicas=max(
                 0, dep.spec.replicas - sum(r.status.ready_replicas
-                                           for r in all_rs)))
-        if (st.replicas, st.updated_replicas, st.ready_replicas) == \
-                (new_st.replicas, new_st.updated_replicas, new_st.ready_replicas):
+                                           for r in all_rs)),
+            observed_generation=dep.metadata.generation)
+        if (st.replicas, st.updated_replicas, st.ready_replicas,
+                st.observed_generation) == \
+                (new_st.replicas, new_st.updated_replicas,
+                 new_st.ready_replicas, new_st.observed_generation):
             return
         dep.status = new_st
         try:

@@ -62,12 +62,15 @@ def _openapi_type(t) -> dict:
     import typing
 
     origin = typing.get_origin(t)
+    args = typing.get_args(t)
     if origin is list:
         return {"type": "array",
-                "items": _openapi_type(typing.get_args(t)[0])}
-    if origin is dict:
+                "items": _openapi_type(args[0]) if args else {}}
+    if origin is dict or t in (dict, typing.Dict):
+        # bare Dict/dict (e.g. ControllerRevision.data): untyped object
         return {"type": "object",
-                "additionalProperties": _openapi_type(typing.get_args(t)[1])}
+                "additionalProperties":
+                    _openapi_type(args[1]) if len(args) == 2 else {}}
     if origin is typing.Union:  # Optional[X]
         inner = [a for a in typing.get_args(t) if a is not type(None)]
         return _openapi_type(inner[0]) if inner else {}

@@ -575,19 +575,20 @@ class TestDaemonSetRollingUpdate:
         store.update("daemonsets", ds)
 
     def test_rolling_update_respects_max_unavailable(self):
-        from kubernetes_tpu.controllers.deployment import (HASH_LABEL,
-                                                           template_hash)
+        from kubernetes_tpu.controllers.history import (REV_LABEL,
+                                                        revision_data,
+                                                        revision_hash)
 
         store, ctrl = self._world(max_unavailable=1)
         assert len(store.list("pods")) == 3
         self._retag(store, "agent:v2")
         ds = store.get("daemonsets", "default", "agent")
-        new_hash = template_hash(ds.spec.template)
+        new_hash = revision_hash(revision_data(ds.spec.template))
         ctrl.sync_all()
         # only ONE ready stale pod was replaced this round
         pods = store.list("pods")
         stale = [p for p in pods
-                 if (p.metadata.labels or {}).get(HASH_LABEL) != new_hash]
+                 if (p.metadata.labels or {}).get(REV_LABEL) != new_hash]
         assert len(stale) == 2, [p.metadata.name for p in pods]
         # as replacements go Ready, the rollout advances to completion
         for _ in range(4):
@@ -597,29 +598,30 @@ class TestDaemonSetRollingUpdate:
             ctrl.sync_all()
         pods = store.list("pods")
         assert len(pods) == 3
-        assert all((p.metadata.labels or {}).get(HASH_LABEL) == new_hash
+        assert all((p.metadata.labels or {}).get(REV_LABEL) == new_hash
                    for p in pods)
         ds = store.get("daemonsets", "default", "agent")
         assert ds.status.updated_number_scheduled == 3
 
     def test_on_delete_waits_for_manual_deletion(self):
-        from kubernetes_tpu.controllers.deployment import (HASH_LABEL,
-                                                           template_hash)
+        from kubernetes_tpu.controllers.history import (REV_LABEL,
+                                                        revision_data,
+                                                        revision_hash)
 
         store, ctrl = self._world(strategy="OnDelete")
         self._retag(store, "agent:v2")
         ctrl.sync_all()
         ds = store.get("daemonsets", "default", "agent")
-        new_hash = template_hash(ds.spec.template)
+        new_hash = revision_hash(revision_data(ds.spec.template))
         stale = [p for p in store.list("pods")
-                 if (p.metadata.labels or {}).get(HASH_LABEL) != new_hash]
+                 if (p.metadata.labels or {}).get(REV_LABEL) != new_hash]
         assert len(stale) == 3  # nothing auto-replaced
         store.delete("pods", "default", stale[0].metadata.name)
         ctrl.sync_all()
         pods = store.list("pods")
         assert len(pods) == 3
         fresh = [p for p in pods
-                 if (p.metadata.labels or {}).get(HASH_LABEL) == new_hash]
+                 if (p.metadata.labels or {}).get(REV_LABEL) == new_hash]
         assert len(fresh) == 1  # only the manually-deleted slot
 
 

@@ -1,0 +1,350 @@
+"""ControllerRevision history: DaemonSet/StatefulSet rollout tracking.
+
+Reference test model: pkg/controller/history/controller_history_test.go
+(create/find/trim), pkg/controller/statefulset/stateful_set_control_test.go
+(RollingUpdate partition + monotonic ordinal order),
+pkg/kubectl/history.go viewers via the CLI surface.
+"""
+
+import io
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.cli.kubectl import main
+from kubernetes_tpu.controllers import history
+from kubernetes_tpu.controllers.daemonset import DaemonSetController
+from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server import APIServer, AdmissionChain
+
+SEL = LabelSelector(match_labels={"app": "w"})
+
+
+def tmpl(image="app:v1"):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels={"app": "w"}),
+        spec=api.PodSpec(containers=[api.Container(name="c", image=image)]))
+
+
+def mknode(name):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(
+            allocatable=api.resource_list(cpu="8", memory="16Gi", pods=110),
+            conditions=[api.NodeCondition(api.NODE_READY, api.COND_TRUE)]))
+
+
+def mark_ready(store, pod):
+    pod.status.phase = "Running"
+    pod.status.conditions = [c for c in pod.status.conditions
+                             if c[0] != "Ready"] + [("Ready", "True")]
+    store.update("pods", pod)
+
+
+def settle(store, ctrl, rounds=10):
+    import time
+    for _ in range(rounds):
+        ctrl.sync_all()
+        for p in store.list("pods"):
+            if p.status.phase != "Running":
+                mark_ready(store, p)
+        time.sleep(0.02)
+
+
+class TestHistoryManager:
+    def test_sync_creates_numbered_revisions(self):
+        store = ObjectStore()
+        ds = api.DaemonSet(metadata=api.ObjectMeta(name="d", uid="u1"),
+                           spec=api.DaemonSetSpec(selector=SEL,
+                                                  template=tmpl("v1")))
+        store.create("daemonsets", ds)
+        r1 = history.sync_revision(store, ds, "DaemonSet", ds.spec.template)
+        assert r1.revision == 1
+        # same template: no new revision
+        again = history.sync_revision(store, ds, "DaemonSet", ds.spec.template)
+        assert again.metadata.name == r1.metadata.name
+        assert len(store.list("controllerrevisions")) == 1
+        ds.spec.template = tmpl("v2")
+        r2 = history.sync_revision(store, ds, "DaemonSet", ds.spec.template)
+        assert r2.revision == 2 and r2.metadata.name != r1.metadata.name
+
+    def test_rollback_reuses_snapshot_at_head(self):
+        store = ObjectStore()
+        ds = api.DaemonSet(metadata=api.ObjectMeta(name="d", uid="u1"),
+                           spec=api.DaemonSetSpec(selector=SEL,
+                                                  template=tmpl("v1")))
+        store.create("daemonsets", ds)
+        r1 = history.sync_revision(store, ds, "DaemonSet", tmpl("v1"))
+        history.sync_revision(store, ds, "DaemonSet", tmpl("v2"))
+        # roll back to v1: the v1 revision advances to revision 3
+        r1b = history.sync_revision(store, ds, "DaemonSet", tmpl("v1"))
+        assert r1b.metadata.name == r1.metadata.name
+        assert r1b.revision == 3
+        assert len(store.list("controllerrevisions")) == 2
+
+    def test_truncate_respects_limit_and_live(self):
+        store = ObjectStore()
+        ds = api.DaemonSet(
+            metadata=api.ObjectMeta(name="d", uid="u1"),
+            spec=api.DaemonSetSpec(selector=SEL, template=tmpl("v1"),
+                                   revision_history_limit=2))
+        store.create("daemonsets", ds)
+        hashes = []
+        for i in range(5):
+            r = history.sync_revision(store, ds, "DaemonSet",
+                                      tmpl(f"v{i}"))
+            hashes.append(r.metadata.labels["controller-revision-hash"])
+        history.truncate_history(store, ds, "DaemonSet",
+                                 live_hashes={hashes[0]})
+        kept = {(r.metadata.labels or {}).get("controller-revision-hash")
+                for r in store.list("controllerrevisions")}
+        # live hash survives regardless of age; newest survives; total
+        # non-live trimmed to the limit
+        assert hashes[0] in kept and hashes[4] in kept
+        assert len(kept) == 3  # live + limit(2) newest non-live
+
+    def test_foreign_owner_uid_not_adopted(self):
+        store = ObjectStore()
+        ds = api.DaemonSet(metadata=api.ObjectMeta(name="d", uid="u1"),
+                           spec=api.DaemonSetSpec(selector=SEL,
+                                                  template=tmpl("v1")))
+        store.create("daemonsets", ds)
+        history.sync_revision(store, ds, "DaemonSet", tmpl("v1"))
+        # recreated same-name owner with a new uid sees no history
+        ds2 = api.DaemonSet(metadata=api.ObjectMeta(name="d", uid="u2"),
+                            spec=api.DaemonSetSpec(selector=SEL,
+                                                   template=tmpl("v1")))
+        assert history.list_revisions(store, ds2, "DaemonSet") == []
+
+
+class TestDaemonSetHistory:
+    def test_sync_snapshots_and_stamps_pods(self):
+        store = ObjectStore()
+        for i in range(2):
+            store.create("nodes", mknode(f"n{i}"))
+        ctrl = DaemonSetController(store)
+        ds = api.DaemonSet(metadata=api.ObjectMeta(name="d"),
+                           spec=api.DaemonSetSpec(selector=SEL,
+                                                  template=tmpl("v1")))
+        store.create("daemonsets", ds)
+        settle(store, ctrl)
+        revs = store.list("controllerrevisions")
+        assert len(revs) == 1 and revs[0].revision == 1
+        h = revs[0].metadata.labels["controller-revision-hash"]
+        pods = [p for p in store.list("pods")]
+        assert len(pods) == 2
+        assert all(p.metadata.labels.get("controller-revision-hash") == h
+                   for p in pods)
+        # template change: second revision appears, pods roll to it
+        ds = store.get("daemonsets", "default", "d")
+        ds.spec.template = tmpl("v2")
+        store.update("daemonsets", ds)
+        settle(store, ctrl)
+        revs = sorted(store.list("controllerrevisions"),
+                      key=lambda r: r.revision)
+        assert [r.revision for r in revs] == [1, 2]
+        h2 = revs[1].metadata.labels["controller-revision-hash"]
+        assert all(p.metadata.labels.get("controller-revision-hash") == h2
+                   for p in store.list("pods"))
+
+
+class TestStatefulSetRollingUpdate:
+    def mksts(self, replicas=3, partition=0, image="app:v1"):
+        return api.StatefulSet(
+            metadata=api.ObjectMeta(name="db"),
+            spec=api.StatefulSetSpec(
+                replicas=replicas, selector=SEL, template=tmpl(image),
+                update_strategy=api.StatefulSetUpdateStrategy(
+                    partition=partition)))
+
+    def test_revision_status_and_rolling_update(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        store.create("statefulsets", self.mksts())
+        settle(store, ctrl)
+        ss = store.get("statefulsets", "default", "db")
+        assert ss.status.current_revision == ss.status.update_revision != ""
+        assert ss.status.updated_replicas == 3
+        first_rev = ss.status.update_revision
+        ss.spec.template = tmpl("v2")
+        store.update("statefulsets", ss)
+        settle(store, ctrl, rounds=14)
+        ss = store.get("statefulsets", "default", "db")
+        assert ss.status.update_revision != first_rev
+        assert ss.status.current_revision == ss.status.update_revision
+        assert ss.status.updated_replicas == 3
+        h2 = None
+        for r in store.list("controllerrevisions"):
+            if r.metadata.name == ss.status.update_revision:
+                h2 = r.metadata.labels["controller-revision-hash"]
+        pods = store.list("pods")
+        assert len(pods) == 3
+        assert all(p.metadata.labels["controller-revision-hash"] == h2
+                   for p in pods)
+
+    def test_partition_pins_low_ordinals(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        store.create("statefulsets", self.mksts(partition=2))
+        settle(store, ctrl)
+        old_hash = store.get("pods", "default", "db-0") \
+            .metadata.labels["controller-revision-hash"]
+        ss = store.get("statefulsets", "default", "db")
+        ss.spec.template = tmpl("v2")
+        store.update("statefulsets", ss)
+        settle(store, ctrl, rounds=14)
+        labels = {i: store.get("pods", "default", f"db-{i}")
+                  .metadata.labels["controller-revision-hash"]
+                  for i in range(3)}
+        # ordinals below the partition stay at the old revision
+        assert labels[0] == labels[1] == old_hash
+        assert labels[2] != old_hash
+        ss = store.get("statefulsets", "default", "db")
+        assert ss.status.updated_replicas == 1
+        # rollout is NOT complete: currentRevision must trail
+        assert ss.status.current_revision != ss.status.update_revision
+
+    def test_pinned_ordinal_restarts_at_current_revision(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        store.create("statefulsets", self.mksts(partition=2))
+        settle(store, ctrl)
+        old_hash = store.get("pods", "default", "db-0") \
+            .metadata.labels["controller-revision-hash"]
+        ss = store.get("statefulsets", "default", "db")
+        ss.spec.template = tmpl("v2")
+        store.update("statefulsets", ss)
+        settle(store, ctrl, rounds=14)
+        # db-0 is pinned below the partition; kill it — the controller
+        # must rebuild it from the CURRENT revision's snapshot, not v2
+        store.delete("pods", "default", "db-0")
+        settle(store, ctrl, rounds=14)
+        p0 = store.get("pods", "default", "db-0")
+        assert p0.metadata.labels["controller-revision-hash"] == old_hash
+        assert p0.spec.containers[0].image == "app:v1"
+        ss = store.get("statefulsets", "default", "db")
+        assert ss.status.updated_replicas == 1
+        assert ss.status.current_revision != ss.status.update_revision
+
+    def test_ondelete_waits_for_manual_delete(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        sts = self.mksts()
+        sts.spec.update_strategy = api.StatefulSetUpdateStrategy(
+            type="OnDelete")
+        store.create("statefulsets", sts)
+        settle(store, ctrl)
+        old_hash = store.get("pods", "default", "db-0") \
+            .metadata.labels["controller-revision-hash"]
+        ss = store.get("statefulsets", "default", "db")
+        ss.spec.template = tmpl("v2")
+        store.update("statefulsets", ss)
+        settle(store, ctrl)
+        # no automatic roll
+        assert store.get("pods", "default", "db-2") \
+            .metadata.labels["controller-revision-hash"] == old_hash
+        # manual delete: recreated at the update revision
+        store.delete("pods", "default", "db-2")
+        settle(store, ctrl)
+        assert store.get("pods", "default", "db-2") \
+            .metadata.labels["controller-revision-hash"] != old_hash
+
+
+class TestGeneration:
+    def test_spec_change_bumps_generation_status_write_does_not(self):
+        store = ObjectStore()
+        ds = api.DaemonSet(metadata=api.ObjectMeta(name="d"),
+                           spec=api.DaemonSetSpec(selector=SEL,
+                                                  template=tmpl("v1")))
+        store.create("daemonsets", ds)
+        assert ds.metadata.generation == 1
+        # status-only write: generation holds
+        ds.status.number_ready = 1
+        store.update("daemonsets", ds)
+        assert ds.metadata.generation == 1
+        # spec change (in-place mutation of the stored object): bump
+        ds.spec.template = tmpl("v2")
+        store.update("daemonsets", ds)
+        assert ds.metadata.generation == 2
+
+    def test_controller_reports_observed_generation(self):
+        store = ObjectStore()
+        store.create("nodes", mknode("n0"))
+        ctrl = DaemonSetController(store)
+        ds = api.DaemonSet(metadata=api.ObjectMeta(name="d"),
+                           spec=api.DaemonSetSpec(selector=SEL,
+                                                  template=tmpl("v1")))
+        store.create("daemonsets", ds)
+        settle(store, ctrl)
+        ds = store.get("daemonsets", "default", "d")
+        assert ds.status.observed_generation == ds.metadata.generation == 1
+        ds.spec.template = tmpl("v2")
+        store.update("daemonsets", ds)
+        assert ds.metadata.generation == 2
+        settle(store, ctrl)
+        assert store.get("daemonsets", "default", "d") \
+            .status.observed_generation == 2
+
+
+class TestRolloutCLIRevisioned:
+    def run(self, server, *argv):
+        out = io.StringIO()
+        rc = main(["--server", server.url, *argv], out=out)
+        return rc, out.getvalue()
+
+    def test_daemonset_history_and_undo(self):
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            store.create("nodes", mknode("n0"))
+            ctrl = DaemonSetController(store)
+            ds = api.DaemonSet(metadata=api.ObjectMeta(name="d"),
+                               spec=api.DaemonSetSpec(selector=SEL,
+                                                      template=tmpl("v1")))
+            store.create("daemonsets", ds)
+            settle(store, ctrl)
+            ds = store.get("daemonsets", "default", "d")
+            ds.spec.template = tmpl("v2")
+            store.update("daemonsets", ds)
+            settle(store, ctrl)
+            rc, txt = self.run(srv, "rollout", "history", "daemonset", "d")
+            assert rc == 0 and "1" in txt and "2" in txt
+            rc, txt = self.run(srv, "rollout", "undo", "daemonset", "d")
+            assert rc == 0 and "rolled back to revision 1" in txt
+            ds = store.get("daemonsets", "default", "d")
+            assert ds.spec.template.spec.containers[0].image == "v1"
+            settle(store, ctrl)
+            # rollback reuses the old snapshot at a new head revision
+            revs = sorted(r.revision
+                          for r in store.list("controllerrevisions"))
+            assert revs == [2, 3]
+            rc, txt = self.run(srv, "rollout", "status", "daemonset", "d")
+            assert "successfully rolled out" in txt
+        finally:
+            srv.stop()
+
+    def test_statefulset_undo_to_revision(self):
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            ctrl = StatefulSetController(store)
+            sts = api.StatefulSet(
+                metadata=api.ObjectMeta(name="db"),
+                spec=api.StatefulSetSpec(replicas=2, selector=SEL,
+                                         template=tmpl("v1")))
+            store.create("statefulsets", sts)
+            settle(store, ctrl)
+            ss = store.get("statefulsets", "default", "db")
+            ss.spec.template = tmpl("v2")
+            store.update("statefulsets", ss)
+            settle(store, ctrl, rounds=14)
+            rc, txt = self.run(srv, "rollout", "undo", "statefulset", "db",
+                               "--to-revision", "1")
+            assert rc == 0 and "rolled back to revision 1" in txt
+            ss = store.get("statefulsets", "default", "db")
+            assert ss.spec.template.spec.containers[0].image == "v1"
+            settle(store, ctrl, rounds=14)
+            rc, txt = self.run(srv, "rollout", "status", "statefulset", "db")
+            assert "rolling update complete" in txt
+        finally:
+            srv.stop()
