@@ -109,6 +109,24 @@ class Probe:
 
 
 @dataclass
+class LifecycleHandler:
+    """core/v1 Handler collapsed to its exec form — the runtime's
+    interpreter executes the command against container state."""
+
+    command: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Lifecycle:
+    """core/v1 Lifecycle: postStart runs right after the container
+    starts (failure kills it — FailedPostStartHook); preStop runs
+    before the kubelet stops it."""
+
+    post_start: Optional[LifecycleHandler] = None
+    pre_stop: Optional[LifecycleHandler] = None
+
+
+@dataclass
 class Container:
     name: str = "c"
     image: str = ""
@@ -116,6 +134,7 @@ class Container:
     ports: List[ContainerPort] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    lifecycle: Optional[Lifecycle] = None
     image_pull_policy: str = ""  # "" -> defaulted; Always|IfNotPresent|Never
     privileged: bool = False  # securityContext.privileged, flattened
     # EnvVar list collapsed to a name->value map (no valueFrom sources)

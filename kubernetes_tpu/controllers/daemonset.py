@@ -77,8 +77,9 @@ class DaemonSetController(Controller):
         # are never reaped. The revision hash is ALSO the staleness
         # label — one content hash drives update decisions and history,
         # like the reference's controller-revision-hash
+        revisions = history.list_revisions(self.store, ds, "DaemonSet")
         rev = history.sync_revision(self.store, ds, "DaemonSet",
-                                    ds.spec.template)
+                                    ds.spec.template, revisions=revisions)
         cur_hash = (rev.metadata.labels or {}).get(REV_LABEL, "")
         nodes = self.store.list("nodes")
         owned: List[api.Pod] = [
@@ -88,9 +89,9 @@ class DaemonSetController(Controller):
         history.truncate_history(
             self.store, ds, "DaemonSet",
             live_hashes={(p.metadata.labels or {}).get(REV_LABEL)
-                for p in owned if
-                is_pod_active(p)},
-            keep_names={rev.metadata.name})
+                         for p in owned if is_pod_active(p)},
+            keep_names={rev.metadata.name},
+            revisions=revisions)
         by_node = {}
         for p in owned:
             by_node.setdefault(p.spec.node_name, []).append(p)

@@ -73,15 +73,18 @@ def list_revisions(store, owner, owner_kind: str) -> List[api.ControllerRevision
 
 
 def sync_revision(store, owner, owner_kind: str,
-                  template) -> api.ControllerRevision:
+                  template,
+                  revisions: Optional[List] = None) -> api.ControllerRevision:
     """Find-or-create the revision for the workload's CURRENT template
     (constructHistory in daemon/update.go:152 / getStatefulSetRevisions
     in stateful_set_control.go:315): an existing revision with equal
     data is bumped to the head revision number if it fell behind
     (rollback reuses the old snapshot); otherwise a fresh revision is
-    created at max+1."""
+    created at max+1. Pass `revisions` (from list_revisions) to reuse a
+    scan the caller already paid for."""
     data = revision_data(template)
-    revisions = list_revisions(store, owner, owner_kind)
+    if revisions is None:
+        revisions = list_revisions(store, owner, owner_kind)
     head = revisions[-1].revision if revisions else 0
     equal = [r for r in revisions if r.data == data]
     if equal:
@@ -124,14 +127,17 @@ def sync_revision(store, owner, owner_kind: str,
 
 def truncate_history(store, owner, owner_kind: str,
                      live_hashes: Optional[set] = None,
-                     keep_names: Optional[set] = None) -> int:
+                     keep_names: Optional[set] = None,
+                     revisions: Optional[List] = None) -> int:
     """Delete the oldest non-live revisions beyond
     spec.revisionHistoryLimit (truncateHistory). A revision is live if
     any current pod still carries its hash label, or it is one of the
     current/update revisions (`keep_names`) — live revisions are never
-    reaped regardless of age, even at revisionHistoryLimit=0."""
+    reaped regardless of age, even at revisionHistoryLimit=0. Pass
+    `revisions` to reuse the caller's list_revisions scan."""
     limit = getattr(owner.spec, "revision_history_limit", 10)
-    revisions = list_revisions(store, owner, owner_kind)
+    if revisions is None:
+        revisions = list_revisions(store, owner, owner_kind)
     live = live_hashes or set()
     keep = keep_names or set()
     candidates = [

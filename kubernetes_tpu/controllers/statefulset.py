@@ -58,8 +58,9 @@ class StatefulSetController(Controller):
         # getStatefulSetRevisions (stateful_set_control.go:315): the
         # update revision snapshots the current template; currentRevision
         # trails it until the rollout completes
+        revisions = history.list_revisions(self.store, ss, "StatefulSet")
         rev = history.sync_revision(self.store, ss, "StatefulSet",
-                                    ss.spec.template)
+                                    ss.spec.template, revisions=revisions)
         rev_hash = (rev.metadata.labels or {}).get(
             REV_LABEL, "")
         pods = self._pods_by_ordinal(ss)
@@ -108,7 +109,8 @@ class StatefulSetController(Controller):
             live_hashes={(p.metadata.labels or {}).get(
                 REV_LABEL) for p in pods.values()
                 if is_pod_active(p)},
-            keep_names={rev.metadata.name, ss.status.current_revision})
+            keep_names={rev.metadata.name, ss.status.current_revision},
+            revisions=revisions)
 
     def _template_for_ordinal(self, ss, ordinal, rev_hash):
         """Template + revision hash a missing ordinal should be rebuilt
@@ -197,9 +199,11 @@ class StatefulSetController(Controller):
         st = ss.status
         update_rev = rev.metadata.name if rev else st.update_revision
         # completeRollingUpdate: currentRevision catches up once every
-        # replica serves the update revision
+        # replica serves the update revision AND is Ready — a rolled-
+        # but-broken replica keeps the rollout in progress
+        # (stateful_set_control.go completeRollingUpdate)
         current_rev = st.current_revision or update_rev
-        if updated == len(live) and len(live) == ss.spec.replicas:
+        if updated == len(live) == ready == ss.spec.replicas:
             current_rev = update_rev
         # currentReplicas counts pods at the CURRENT revision (apps/v1
         # semantics) — it shrinks as the rolling update advances

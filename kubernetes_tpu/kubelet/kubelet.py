@@ -97,10 +97,29 @@ class Kubelet:
         self.memory_pressure_threshold = memory_pressure_threshold
         self.allocatable = allocatable or api.resource_list(
             cpu="8", memory="16Gi", pods=110, ephemeral_storage="100Gi")
+        # resource-management layer (pkg/kubelet/cm + images): cgroup
+        # tree capped at allocatable, per-pod cgroups, image cache with
+        # GC thresholds, dead-container GC, device plugins
+        from .cm import ContainerManager, CPUManager
+        from .devicemanager import DeviceManager
+        from .images import (ContainerGC, ImageGCManager, ImageManager,
+                             ImageStore)
+        self.container_manager = ContainerManager(
+            capacity=dict(self.allocatable))
+        self.cpu_manager = CPUManager(
+            num_cpus=self.allocatable.get(res.CPU, 0) // 1000)
+        self.image_store = ImageStore()
+        self.image_manager = ImageManager(self.image_store)
+        self.image_gc = ImageGCManager(self.image_store, self.runtime)
+        self.container_gc = ContainerGC(self.runtime)
+        self.device_manager = DeviceManager()
         self.labels = {api.LABEL_HOSTNAME: node_name, **(labels or {})}
         self.taints = list(taints or [])
         self._probe_state: Dict[tuple, _ProbeState] = {}
         self._pod_start: Dict[str, float] = {}
+        self._pod_specs: Dict[str, api.Pod] = {}  # teardown (preStop) view
+        # postStart hooks waiting for their container to reach RUNNING
+        self._pending_poststart: Dict[tuple, List[str]] = {}
         self._iter_node: Optional[api.Node] = None
         self._last_heartbeat = 0.0
         self._stop = threading.Event()
@@ -179,6 +198,17 @@ class Kubelet:
             return
         node.metadata.annotations = dict(node.metadata.annotations or {})
         node.metadata.annotations[HEARTBEAT_ANNOTATION] = str(now)
+        # device-plugin resources ride the heartbeat into node status
+        # (devicemanager GetCapacity merged in kubelet_node_status.go):
+        # unhealthy devices stay in capacity but leave allocatable, so
+        # the scheduler stops fitting against them
+        dev_cap = self.device_manager.capacity()
+        if dev_cap:
+            node.status.capacity = dict(node.status.capacity or {},
+                                        **dev_cap)
+            node.status.allocatable = dict(
+                node.status.allocatable or {},
+                **self.device_manager.allocatable())
         conds = {c.type: c for c in node.status.conditions}
         conds[api.NODE_READY] = api.NodeCondition(api.NODE_READY, api.COND_TRUE)
         if memory_pressure is not None:
@@ -262,7 +292,7 @@ class Kubelet:
         current = self._read_static_pods()
         for uid, old in list(self._static_by_uid.items()):
             if uid not in current:
-                self.runtime.kill_pod(uid)
+                self._kill_pod_with_hooks(uid, old)
                 try:
                     self.store.delete("pods", old.metadata.namespace,
                                       old.metadata.name)
@@ -389,6 +419,19 @@ class Kubelet:
                                          ("Ready", f"False:{reason}")]
                 self._update_status(pod)
                 return
+            # device admission (cm/devicemanager): pin concrete device
+            # IDs for extended-resource requests; devices gone unhealthy
+            # since the scheduler counted them fail the pod here, like
+            # the reference's UnexpectedAdmissionError
+            try:
+                self.device_manager.allocate(pod)
+            except RuntimeError:
+                pod.status.phase = "Failed"
+                pod.status.conditions = [
+                    ("PodScheduled", "True"),
+                    ("Ready", "False:UnexpectedAdmissionError")]
+                self._update_status(pod)
+                return
             self._pod_start[uid] = now
         if not self._volumes_ready(pod):
             # volume manager (pkg/kubelet/volumemanager/):
@@ -402,8 +445,9 @@ class Kubelet:
                 and now - self._pod_start[uid]
                 >= pod.spec.active_deadline_seconds):
             # kubelet/active_deadline.go: the pod's wall-clock budget is
-            # spent — kill it and mark Failed/DeadlineExceeded
-            self.runtime.kill_pod(uid)
+            # spent — kill it (preStop runs first) and mark
+            # Failed/DeadlineExceeded
+            self._kill_pod_with_hooks(uid, pod)
             pod.status.phase = "Failed"
             pod.status.conditions = [("PodScheduled", "True"),
                                      ("Ready", "False:DeadlineExceeded")]
@@ -411,6 +455,12 @@ class Kubelet:
             return
         if not self._init_containers_done(pod, now):
             return
+        # remembered for teardown: preStop hooks need the spec after the
+        # pod object left the apiserver
+        self._pod_specs[uid] = pod
+        # per-pod cgroup under the QoS tier (pod_container_manager
+        # EnsureExists) — created before any container starts
+        self.container_manager.ensure_pod_cgroup(pod)
         for c in pod.spec.containers:
             st = self.runtime.get(uid, c.name)
             if st is None or st.state not in (RUNNING,):
@@ -446,11 +496,62 @@ class Kubelet:
                     self._crash_backoff[key] = delay
                     self._crash_backoff_until[key] = now + delay
                     st.restart_count += 1
+                # image pull policy (images/image_manager.go
+                # EnsureImageExists): Never + absent keeps the
+                # container waiting (ErrImageNeverPull), retried in
+                # case the image appears (side-loaded) later
+                pulled, _msg = self.image_manager.ensure_image_exists(
+                    c, now)
+                if not pulled:
+                    self._needs_retry.add(uid)
+                    continue
                 self._last_container_start[(uid, c.name)] = now
+                env = dict(c.env or {})
+                # assigned device IDs reach the workload as env
+                # (devicemanager GetDeviceRunContainerOptions)
+                env.update(self.device_manager.container_env(uid, c.name))
+                # cpumanager static policy: whole-core Guaranteed
+                # containers get exclusive CPUs "written to the cpuset
+                # cgroup" (the container state here)
+                try:
+                    cpus = self.cpu_manager.add_container(pod, c)
+                except RuntimeError:
+                    self._needs_retry.add(uid)
+                    continue
                 self.runtime.start_container(uid, c.name, now,
-                                             env=dict(c.env or {}))
+                                             env=env, image=c.image)
+                st2 = self.runtime.get(uid, c.name)
+                if st2 is not None and cpus is not None:
+                    st2.cpuset = cpus
+                # postStart hook (kuberuntime_container.go:165): fires
+                # once the container is actually RUNNING — with start
+                # latency that transition lands on a LATER sync, so the
+                # hook is queued and run by _fire_post_start
+                if c.lifecycle and c.lifecycle.post_start:
+                    self._pending_poststart[(uid, c.name)] = \
+                        c.lifecycle.post_start.command
+                self._fire_post_start(uid, c.name, now)
+            else:
+                self._fire_post_start(uid, c.name, now)
         self._run_probes(pod, now)
         self._update_pod_status(pod, now)
+
+    def _fire_post_start(self, uid: str, cname: str, now: float):
+        """Run a queued postStart hook once its container reached
+        RUNNING; failure kills the container (FailedPostStartHook) and
+        the restart policy takes it from there."""
+        key = (uid, cname)
+        cmd = self._pending_poststart.get(key)
+        if cmd is None:
+            return
+        st = self.runtime.get(uid, cname)
+        if st is None or st.state != RUNNING:
+            return  # still starting: retry on a later sync
+        del self._pending_poststart[key]
+        rc, _out = self.runtime.exec_in_container(uid, cname, cmd)
+        if rc != 0:
+            self.runtime.crash_container(uid, cname, exit_code=rc, now=now)
+            self.runtime.append_log(uid, cname, "FailedPostStartHook")
 
     def _volumes_ready(self, pod: api.Pod) -> bool:
         """All of the pod's volumes mounted (volume manager gate:
@@ -631,6 +732,22 @@ class Kubelet:
         return alloc > 0 and \
             self._memory_requested() > self.memory_pressure_threshold * alloc
 
+    def _kill_pod_with_hooks(self, uid: str,
+                             pod: Optional[api.Pod] = None):
+        """Every kubelet-initiated kill path (teardown, eviction,
+        activeDeadline) runs preStop hooks against the still-running
+        containers first (kuberuntime killContainersWithSyncResult ->
+        executePreStopHook), then kills the pod."""
+        spec_pod = pod or self._pod_specs.get(uid)
+        self._pod_specs.pop(uid, None)
+        if spec_pod is not None:
+            for c in spec_pod.spec.containers:
+                if c.lifecycle and c.lifecycle.pre_stop:
+                    self.runtime.exec_in_container(
+                        uid, c.name, c.lifecycle.pre_stop.command)
+                self._pending_poststart.pop((uid, c.name), None)
+        self.runtime.kill_pod(uid)
+
     def _housekeeping(self, now: float):
         # clean up runtime state for pods that vanished from the
         # apiserver — static pods live under their FILE-derived uid,
@@ -642,7 +759,8 @@ class Kubelet:
         # snapshot first: async pod workers may insert into _pod_start
         # concurrently (plain membership iteration would RuntimeError)
         for uid in [u for u in list(self._pod_start) if u not in live_uids]:
-            self.runtime.kill_pod(uid)
+            self._kill_pod_with_hooks(uid)
+            self.cpu_manager.remove_pod(uid)
             self._pod_start.pop(uid, None)
             self._known_pod_rvs.pop(uid, None)
             self._needs_retry.discard(uid)
@@ -656,7 +774,20 @@ class Kubelet:
             # volume manager: drop desired state; the next reconcile
             # unmounts the orphaned mounts (reconciler.go:166)
             self.volume_manager.forget_pod(uid)
+            # devices return to the pool with the pod
+            self.device_manager.deallocate(uid)
         self.volume_manager.reconcile(self._iter_node or self._get_node())
+        # resource-management housekeeping: reap dead containers beyond
+        # the GC policy, reclaim image disk past the high threshold,
+        # sweep pod cgroups whose pod is gone, retune the Burstable tier
+        self.container_gc.garbage_collect(now)
+        self.image_gc.garbage_collect()
+        for uid in self.container_manager.cleanup_orphans(live_uids):
+            self.device_manager.deallocate(uid)
+        self.container_manager.update_qos_cgroups(
+            [p for p in (list(self._my_pods())
+                         + list(self._static_by_uid.values()))
+             if p.status.phase in ("Pending", "Running")])
         # eviction: under memory pressure, rank by QoS class (BestEffort
         # -> Burstable -> Guaranteed), then priority, then memory
         # footprint (eviction/helpers.go rankMemoryPressure)
@@ -677,7 +808,7 @@ class Kubelet:
             victim.status.phase = "Failed"
             victim.status.conditions = [("Ready", "False:Evicted")]
             self._update_status(victim)
-            self.runtime.kill_pod(victim.metadata.uid)
+            self._kill_pod_with_hooks(victim.metadata.uid, victim)
         self.heartbeat(now, memory_pressure=self._memory_pressure())
 
     # -- background mode -------------------------------------------------------

@@ -32,6 +32,10 @@ class ContainerState:
     # actually operate on (path -> contents)
     files: Dict[str, str] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
+    image: str = ""  # what the container runs — image GC's in-use set
+    # exclusively pinned cpu ids (cpumanager static policy); empty =
+    # shared pool. The "cpuset cgroup write" surface of the fake runtime
+    cpuset: List[int] = field(default_factory=list)
     finished_at: Optional[float] = None  # when it last exited (if known)
     # measured usage — what cadvisor reads from cgroups in the reference
     # (pkg/kubelet/cadvisor); here a seam stamped by set_usage (hollow
@@ -59,7 +63,8 @@ class FakeRuntime:
     def start_container(self, pod_uid: str, name: str, now: float,
                         env: Optional[Dict[str, str]] = None,
                         run_to_completion: bool = False,
-                        command: Optional[List[str]] = None):
+                        command: Optional[List[str]] = None,
+                        image: str = ""):
         """run_to_completion (init containers): the container starts
         RUNNING, then on the NEXT tick executes its command through the
         exec interpreter and EXITS with its code (0 when commandless) —
@@ -73,6 +78,8 @@ class FakeRuntime:
                 self.containers[key] = st
             if env:
                 st.env = dict(env)
+            if image:
+                st.image = image
             if st.state != RUNNING:
                 if run_to_completion:
                     self._pending_exit[key] = list(command or [])
@@ -284,6 +291,21 @@ class FakeRuntime:
             if st is not None:
                 st.cpu_millicores = int(cpu_millicores)
                 st.memory_bytes = int(memory_bytes)
+
+    def snapshot_containers(self) -> List[Tuple[Tuple[str, str],
+                                                "ContainerState"]]:
+        """Consistent (key, state) snapshot for GC scans — pod workers
+        mutate the dict concurrently in background mode."""
+        with self._lock:
+            return list(self.containers.items())
+
+    def remove_container(self, pod_uid: str, name: str):
+        """Delete a (dead) container record — the ContainerGC eviction
+        primitive (kuberuntime_gc.go removeContainer)."""
+        with self._lock:
+            self.containers.pop((pod_uid, name), None)
+            self._pending_start.pop((pod_uid, name), None)
+            self._pending_exit.pop((pod_uid, name), None)
 
     def container_stats(self, pod_uid: str) -> List["ContainerState"]:
         """RUNNING containers of a pod, for the /stats/summary builder."""

@@ -226,6 +226,29 @@ class TestStatefulSetRollingUpdate:
         assert ss.status.updated_replicas == 1
         assert ss.status.current_revision != ss.status.update_revision
 
+    def test_rollout_not_complete_until_ready(self):
+        store = ObjectStore()
+        ctrl = StatefulSetController(store)
+        store.create("statefulsets", self.mksts(replicas=2))
+        settle(store, ctrl)
+        ss = store.get("statefulsets", "default", "db")
+        ss.spec.template = tmpl("v2")
+        store.update("statefulsets", ss)
+        # roll, but db-1 never becomes Ready (crash-looping image):
+        # currentRevision must NOT catch up to updateRevision
+        import time
+        for _ in range(14):
+            ctrl.sync_all()
+            for p in store.list("pods"):
+                if p.status.phase != "Running":
+                    p.status.phase = "Running"
+                    ready = "False" if p.metadata.name == "db-1" else "True"
+                    p.status.conditions = [("Ready", ready)]
+                    store.update("pods", p)
+            time.sleep(0.02)
+        ss = store.get("statefulsets", "default", "db")
+        assert ss.status.current_revision != ss.status.update_revision
+
     def test_ondelete_waits_for_manual_delete(self):
         store = ObjectStore()
         ctrl = StatefulSetController(store)
