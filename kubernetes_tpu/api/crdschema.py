@@ -1,0 +1,96 @@
+"""OpenAPI v3 schema validation for custom resources.
+
+The enforcement half of CustomResourceValidation (reference:
+apiextensions-apiserver pkg/apiserver/validation/validation.go, which
+delegates to go-openapi's SpecValidator). This is a self-contained
+structural validator covering the keywords CRD authors actually use:
+type, properties, required, items, enum, pattern, minimum/maximum,
+minLength/maxLength, minItems/maxItems, additionalProperties, nullable.
+Errors come back field-addressed, feeding the same 422 machinery as
+built-in kinds.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+_TYPE_CHECKS = {
+    "object": lambda v: isinstance(v, dict),
+    "array": lambda v: isinstance(v, list),
+    "string": lambda v: isinstance(v, str),
+    # bool is an int subclass in Python: exclude it from numerics
+    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "number": lambda v: isinstance(v, (int, float))
+    and not isinstance(v, bool),
+    "boolean": lambda v: isinstance(v, bool),
+}
+
+
+def validate_schema(value: Any, schema: dict,
+                    path: str = "") -> List[Tuple[str, str]]:
+    """value vs schema -> [(field_path, message)]; empty = valid."""
+    errs: List[Tuple[str, str]] = []
+    _walk(value, schema or {}, path or "<root>", errs)
+    return errs
+
+
+def _walk(value, schema, path, errs):
+    if value is None:
+        if schema.get("nullable"):
+            return
+        # absent vs null is the caller's concern (required handles
+        # absence); an explicit null against a typed schema fails
+        if "type" in schema:
+            errs.append((path, "must not be null"))
+        return
+    t = schema.get("type")
+    if t is not None:
+        check = _TYPE_CHECKS.get(t)
+        if check is None:
+            errs.append((path, f"unknown schema type {t!r}"))
+            return
+        if not check(value):
+            errs.append((path, f"must be of type {t}"))
+            return
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append((path, f"must be one of {schema['enum']!r}"))
+    if isinstance(value, str):
+        pat = schema.get("pattern")
+        if pat is not None and re.search(pat, value) is None:
+            errs.append((path, f"must match pattern {pat!r}"))
+        if "minLength" in schema and len(value) < schema["minLength"]:
+            errs.append((path,
+                         f"length must be >= {schema['minLength']}"))
+        if "maxLength" in schema and len(value) > schema["maxLength"]:
+            errs.append((path,
+                         f"length must be <= {schema['maxLength']}"))
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        if "minimum" in schema and value < schema["minimum"]:
+            errs.append((path, f"must be >= {schema['minimum']}"))
+        if "maximum" in schema and value > schema["maximum"]:
+            errs.append((path, f"must be <= {schema['maximum']}"))
+    if isinstance(value, list):
+        if "minItems" in schema and len(value) < schema["minItems"]:
+            errs.append((path, f"must have >= {schema['minItems']} items"))
+        if "maxItems" in schema and len(value) > schema["maxItems"]:
+            errs.append((path, f"must have <= {schema['maxItems']} items"))
+        items = schema.get("items")
+        if isinstance(items, dict):
+            for i, v in enumerate(value):
+                _walk(v, items, f"{path}[{i}]", errs)
+    if isinstance(value, dict):
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            if req not in value:
+                errs.append((f"{path}.{req}", "required value missing"))
+        addl = schema.get("additionalProperties")
+        for k, v in value.items():
+            sub = props.get(k)
+            if sub is not None:
+                _walk(v, sub, f"{path}.{k}", errs)
+            elif addl is False:
+                errs.append((f"{path}.{k}",
+                             "additional properties are not allowed"))
+            elif isinstance(addl, dict):
+                _walk(v, addl, f"{path}.{k}", errs)

@@ -239,7 +239,17 @@ def decode_request(kind: str, data: Mapping):
 # -- field-name conversion -----------------------------------------------------
 
 
+# wire names that break the mechanical snake->camel rule (initialisms
+# the reference capitalizes wholesale)
+_CAMEL_OVERRIDES = {
+    "open_api_v3_schema": "openAPIV3Schema",
+}
+
+
 def _camel(name: str) -> str:
+    special = _CAMEL_OVERRIDES.get(name)
+    if special is not None:
+        return special
     parts = name.split("_")
     return parts[0] + "".join(p.title() for p in parts[1:])
 

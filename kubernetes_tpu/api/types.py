@@ -829,6 +829,34 @@ class CustomResourceNames:
 
 
 @dataclass
+class CustomResourceValidation:
+    """apiextensions CustomResourceValidation: an OpenAPI v3 schema the
+    apiserver enforces on every create/update of the custom kind
+    (apiextensions-apiserver pkg/apiserver/validation/validation.go)."""
+
+    open_api_v3_schema: Dict = field(default_factory=dict)
+
+
+@dataclass
+class CustomResourceSubresourceScale:
+    """Dotted JSON paths mapping the custom kind onto the Scale shape
+    (apiextensions CustomResourceSubresourceScale)."""
+
+    spec_replicas_path: str = ".spec.replicas"
+    status_replicas_path: str = ".status.replicas"
+    label_selector_path: str = ""
+
+
+@dataclass
+class CustomResourceSubresources:
+    """apiextensions CustomResourceSubresources (1.11): opting a custom
+    kind into /status (spec-status write isolation) and /scale."""
+
+    status: bool = False
+    scale: Optional[CustomResourceSubresourceScale] = None
+
+
+@dataclass
 class CustomResourceDefinitionSpec:
     group: str = ""
     version: str = "v1"  # the storage version
@@ -837,6 +865,8 @@ class CustomResourceDefinitionSpec:
     versions: List[str] = field(default_factory=list)
     scope: str = "Namespaced"  # or "Cluster"
     names: CustomResourceNames = field(default_factory=CustomResourceNames)
+    validation: Optional[CustomResourceValidation] = None
+    subresources: Optional[CustomResourceSubresources] = None
 
 
 @dataclass

@@ -1180,10 +1180,24 @@ def cmd_delete(client, args, out):
 
 
 def cmd_scale(client, args, out):
+    """scale.go: go through the polymorphic /scale subresource when the
+    kind serves one (incl. CRDs declaring subresources.scale); fall back
+    to a spec.replicas update for kinds without it (jobs)."""
     plural = _resolve_kind(args.kind)
-    obj = client.get(plural, args.namespace, args.name)
-    obj.spec.replicas = args.replicas
-    client.update(plural, obj)
+    try:
+        client.update_scale(plural, args.namespace, args.name,
+                            args.replicas)
+    except APIStatusError as e:
+        if e.code != 404:
+            raise
+        obj = client.get(plural, args.namespace, args.name)
+        if plural == "jobs":
+            # ScalePrecondition for jobs targets spec.parallelism
+            # (kubectl scale.go JobPsuedoScaler)
+            obj.spec.parallelism = args.replicas
+        else:
+            obj.spec.replicas = args.replicas
+        client.update(plural, obj)
     out.write(f"{plural}/{args.name} scaled to {args.replicas}\n")
 
 

@@ -264,6 +264,20 @@ class RESTClient:
                      query="&".join(_selector_query(label_selector,
                                                     field_selector)))
 
+    def get_scale(self, plural: str, namespace: Optional[str],
+                  name: str) -> dict:
+        """GET the polymorphic Scale subresource (scale client
+        scaleclient.ScalesGetter analog)."""
+        return self.request("GET", self._path(plural, namespace, name,
+                                              sub="scale"))
+
+    def update_scale(self, plural: str, namespace: Optional[str], name: str,
+                     replicas: int) -> dict:
+        return self.request(
+            "PUT", self._path(plural, namespace, name, sub="scale"),
+            body={"kind": "Scale", "apiVersion": "autoscaling/v1",
+                  "spec": {"replicas": replicas}})
+
     def bind(self, namespace: str, pod_name: str, node_name: str):
         """POST pods/<name>/binding (scheduler.go:409 Bind)."""
         self.request("POST", self._path("pods", namespace, pod_name, "binding"),

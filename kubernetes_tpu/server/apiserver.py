@@ -502,6 +502,9 @@ class APIServer:
                 return self._serve_pod_log(h, namespace, name, query)
             if sub == "attach" and plural == "pods":
                 return self._serve_pod_attach(h, namespace, name, query)
+            if sub == "scale":
+                return self._serve_scale(h, plural, namespace, name, user,
+                                         write=False)
             return self._serve_get(h, plural, namespace, name, gv)
         if verb == "create":
             if sub == "binding":
@@ -514,6 +517,9 @@ class APIServer:
                 return self._serve_pod_portforward(h, namespace, name)
             return self._serve_create(h, plural, namespace, user, gv)
         if verb in ("update", "patch"):
+            if sub == "scale":
+                return self._serve_scale(h, plural, namespace, name, user,
+                                         write=True)
             return self._serve_update(h, plural, namespace, name, sub, user,
                                       patch=(verb == "patch"), gv=gv)
         if verb == "delete":
@@ -765,6 +771,162 @@ class APIServer:
                     return obj
         return None
 
+    # -- custom resource validation/subresources -------------------------------
+
+    def _crd_for_kind(self, kind: str):
+        for crd in self.store.list("customresourcedefinitions"):
+            if crd.spec.names.kind == kind:
+                return crd
+        return None
+
+    def _validate_custom(self, obj, crd):
+        """CustomResourceValidation enforcement: the whole wire object
+        is checked against the CRD's openAPIV3Schema; failures are
+        field-addressed 422s like built-in kinds
+        (apiextensions-apiserver pkg/apiserver/validation)."""
+        if crd is None or crd.spec.validation is None:
+            return
+        from ..api.crdschema import validate_schema
+
+        wire = scheme.encode_object(obj)
+        errors = validate_schema(
+            wire, crd.spec.validation.open_api_v3_schema)
+        if errors:
+            msg = "; ".join(f"{p}: {m}" for p, m in errors)
+            raise APIError(422, "Invalid",
+                           f"{obj.kind} {obj.metadata.name!r} is invalid: "
+                           f"{msg}")
+
+    # -- scale subresource -----------------------------------------------------
+
+    # kinds with a native Scale mapping (the reference's registry wires
+    # autoscaling/v1 Scale REST for these: registry/apps/deployment/
+    # storage/storage.go ScaleREST etc.)
+    _SCALE_PLURALS = frozenset({
+        "deployments", "replicasets", "replicationcontrollers",
+        "statefulsets"})
+
+    @staticmethod
+    def _dotted_get(wire: dict, path: str, default=None):
+        cur = wire
+        for part in [p for p in path.split(".") if p]:
+            if not isinstance(cur, dict) or part not in cur:
+                return default
+            cur = cur[part]
+        return cur
+
+    @staticmethod
+    def _dotted_set(wire: dict, path: str, value):
+        parts = [p for p in path.split(".") if p]
+        cur = wire
+        for part in parts[:-1]:
+            cur = cur.setdefault(part, {})
+        cur[parts[-1]] = value
+
+    def _scale_mapping(self, plural, obj):
+        """-> (spec_path, status_path, selector_str) or None when the
+        kind has no scale subresource."""
+        if plural in self._SCALE_PLURALS:
+            sel = ""
+            s = getattr(obj.spec, "selector", None)
+            if s is not None and getattr(s, "match_labels", None):
+                sel = ",".join(f"{k}={v}"
+                               for k, v in sorted(s.match_labels.items()))
+            elif plural == "replicationcontrollers" and obj.spec.selector:
+                sel = ",".join(f"{k}={v}"
+                               for k, v in sorted(obj.spec.selector.items()))
+            return ".spec.replicas", ".status.replicas", sel
+        if isinstance(obj, api.CustomObject):
+            crd = self._crd_for_kind(obj.kind)
+            if crd is not None and crd.spec.subresources is not None and \
+                    crd.spec.subresources.scale is not None:
+                sc = crd.spec.subresources.scale
+                wire = scheme.encode_object(obj)
+                sel = ""
+                if sc.label_selector_path:
+                    sel = self._dotted_get(wire, sc.label_selector_path,
+                                           "") or ""
+                return sc.spec_replicas_path, sc.status_replicas_path, sel
+        return None
+
+    def _scale_wire(self, obj, plural, mapping):
+        spec_path, status_path, sel = mapping
+        wire = scheme.encode_object(obj)
+        status = {"replicas": self._dotted_get(wire, status_path, 0) or 0}
+        if sel:
+            status["selector"] = sel
+        return {
+            "kind": "Scale", "apiVersion": "autoscaling/v1",
+            "metadata": {"name": obj.metadata.name,
+                         "namespace": obj.metadata.namespace,
+                         "resourceVersion":
+                             obj.metadata.resource_version},
+            "spec": {"replicas": self._dotted_get(wire, spec_path, 0) or 0},
+            "status": status,
+        }
+
+    def _serve_scale(self, h, plural, namespace, name, user, write):
+        """GET/PUT <plural>/<name>/scale: the polymorphic Scale
+        subresource every scalable kind serves
+        (registry ScaleREST Get/Update)."""
+        obj = self._find(plural, namespace, name)
+        if obj is None:
+            raise APIError(404, "NotFound", f"{plural} {name!r} not found")
+        mapping = self._scale_mapping(plural, obj)
+        if mapping is None:
+            raise APIError(
+                404, "NotFound",
+                f"the server could not find the requested resource "
+                f"({plural}/{name}/scale)")
+        if write:
+            import copy
+
+            body = self._read_body(h)
+            want = body.get("spec", {}).get("replicas")
+            if not isinstance(want, int) or want < 0:
+                raise APIError(422, "Invalid",
+                               "spec.replicas must be a non-negative "
+                               "integer")
+            rv = body.get("metadata", {}).get("resourceVersion")
+            if rv and int(rv) != obj.metadata.resource_version:
+                raise APIError(409, "Conflict",
+                               f"resourceVersion {rv} != "
+                               f"{obj.metadata.resource_version}")
+            # mutate a CLONE: the stored object must not change until
+            # admission + validation admit the write (a rejected scale
+            # must leave the store untouched, like every other verb)
+            new = copy.deepcopy(obj)
+            spec_path = mapping[0]
+            if isinstance(new, api.CustomObject):
+                self._dotted_set(
+                    {"spec": new.spec, "status": new.status},
+                    spec_path, want)
+            else:
+                new.spec.replicas = want
+            try:
+                self.admission.admit("update", plural, new, obj, user,
+                                     self.store)
+            except AdmissionError as e:
+                raise APIError(getattr(e, "code", 403), "Forbidden", str(e))
+            # the scale path enforces the SAME rules as a direct update:
+            # schema caps on CRs, field validation on built-ins
+            if isinstance(new, api.CustomObject):
+                self._validate_custom(new, self._crd_for_kind(new.kind))
+            else:
+                errs = validation.validate(plural, new, old=obj)
+                if errs:
+                    raise APIError(422, "Invalid", errs.message())
+            try:
+                self.store.update(plural, new)
+            except Conflict as e:
+                raise APIError(409, "Conflict", str(e))
+            except KeyError:
+                raise APIError(404, "NotFound",
+                               f"{plural} {name!r} not found")
+            obj = new
+        return h._send(200, json.dumps(
+            self._scale_wire(obj, plural, mapping)).encode())
+
     # -- verbs -----------------------------------------------------------------
 
     @staticmethod
@@ -954,6 +1116,15 @@ class APIServer:
         errs = validation.validate(plural, obj)
         if errs:
             raise APIError(422, "Invalid", errs.message())
+        if isinstance(obj, api.CustomObject):
+            crd = self._crd_for_kind(obj.kind)
+            self._validate_custom(obj, crd)
+            if crd is not None and crd.spec.subresources is not None and \
+                    crd.spec.subresources.status:
+                # status subresource enabled: the main resource never
+                # accepts client status (apiextensions strategy
+                # PrepareForCreate drops it)
+                obj.status = {}
         if plural == "services":
             self._allocate_service(obj)
         if plural == "customresourcedefinitions":
@@ -1099,6 +1270,26 @@ class APIServer:
             raise APIError(code,
                            "TooManyRequests" if code == 429 else "Forbidden",
                            str(e))
+        if isinstance(obj, api.CustomObject):
+            crd = self._crd_for_kind(obj.kind)
+            subres = crd.spec.subresources if crd is not None else None
+            if sub == "status" and (subres is None or not subres.status):
+                # /status is only served once the CRD opts in
+                # (apiextensions customresource_handler.go serveStatus)
+                raise APIError(404, "NotFound",
+                               f"{plural}/status not enabled")
+            if subres is not None and subres.status:
+                if sub == "status":
+                    # status writes never touch spec
+                    obj.spec = old.spec
+                else:
+                    # spec writes never touch status (strategy
+                    # PrepareForUpdate with status subresource on)
+                    obj.status = old.status
+            # the WHOLE object validates on every write path — status
+            # updates included (the reference's status strategy runs the
+            # same schema, so a typed status stays typed)
+            self._validate_custom(obj, crd)
         if sub not in ("status", "finalize"):
             errs = validation.validate(plural, obj, old=old)
             if errs:

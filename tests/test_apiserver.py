@@ -740,3 +740,62 @@ class TestWatchSelector:
         assert done.wait(8), seen
         assert seen == [("ADDED", "gold"), ("ADDED", "plain"),
                         ("DELETED", "plain")]
+
+
+class TestScaleSubresource:
+    def _server(self):
+        from kubernetes_tpu.server.admission import AdmissionChain
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        return store, srv
+
+    def test_deployment_scale_get_put(self):
+        from kubernetes_tpu.api.labels import LabelSelector
+        store, srv = self._server()
+        try:
+            client = RESTClient(srv.url)
+            dep = api.Deployment(
+                metadata=api.ObjectMeta(name="web"),
+                spec=api.DeploymentSpec(
+                    replicas=3,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "web"}),
+                        spec=api.PodSpec(containers=[api.Container()]))))
+            client.create("deployments", dep)
+            sc = client.get_scale("deployments", "default", "web")
+            assert sc["kind"] == "Scale"
+            assert sc["spec"]["replicas"] == 3
+            assert sc["status"]["selector"] == "app=web"
+            client.update_scale("deployments", "default", "web", 5)
+            got = client.get("deployments", "default", "web")
+            assert got.spec.replicas == 5
+            # kind without a scale mapping: 404, not a crash
+            store.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="p"),
+                spec=api.PodSpec(containers=[api.Container()])))
+            with pytest.raises(APIStatusError) as ei:
+                client.get_scale("pods", "default", "p")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_scale_validates_replicas(self):
+        from kubernetes_tpu.api.labels import LabelSelector
+        store, srv = self._server()
+        try:
+            client = RESTClient(srv.url)
+            rs = api.ReplicaSet(
+                metadata=api.ObjectMeta(name="rs"),
+                spec=api.ReplicaSetSpec(
+                    replicas=1,
+                    selector=LabelSelector(match_labels={"a": "b"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"a": "b"}),
+                        spec=api.PodSpec(containers=[api.Container()]))))
+            client.create("replicasets", rs)
+            with pytest.raises(APIStatusError) as ei:
+                client.update_scale("replicasets", "default", "rs", -2)
+            assert ei.value.code == 422
+        finally:
+            srv.stop()

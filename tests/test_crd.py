@@ -257,3 +257,188 @@ class TestCustomResourceController:
         store.update("widgets", w)
         ctrl.sync_all()
         assert len(store.list("pods")) == 1
+
+
+def schema_crd():
+    """Widget CRD with an openAPIV3Schema + status/scale subresources."""
+    return api.CustomResourceDefinition(
+        metadata=api.ObjectMeta(name="widgets.example.com"),
+        spec=api.CustomResourceDefinitionSpec(
+            group="example.com", version="v1",
+            names=api.CustomResourceNames(kind="Widget", plural="widgets",
+                                          singular="widget"),
+            validation=api.CustomResourceValidation(
+                open_api_v3_schema={
+                    "type": "object",
+                    "properties": {
+                        "spec": {
+                            "type": "object",
+                            "required": ["replicas"],
+                            "properties": {
+                                "replicas": {"type": "integer",
+                                             "minimum": 0,
+                                             "maximum": 100},
+                                "color": {"type": "string",
+                                          "enum": ["blue", "red"]},
+                                "host": {"type": "string",
+                                         "pattern": "^[a-z0-9.-]+$"},
+                            },
+                        },
+                    },
+                }),
+            subresources=api.CustomResourceSubresources(
+                status=True,
+                scale=api.CustomResourceSubresourceScale(
+                    spec_replicas_path=".spec.replicas",
+                    status_replicas_path=".status.readyReplicas"))))
+
+
+class TestCRDValidation:
+    def test_schema_enforced_on_create_and_update(self, server, client):
+        client.create("customresourcedefinitions", schema_crd())
+        # missing required spec.replicas
+        bad = api.CustomObject(kind="Widget", api_version="example.com/v1",
+                               metadata=api.ObjectMeta(name="w"),
+                               spec={"color": "blue"})
+        with pytest.raises(APIStatusError) as ei:
+            client.create("widgets", bad)
+        assert ei.value.code == 422 and "spec.replicas" in ei.value.message
+        # wrong enum member + out-of-range + bad pattern, all reported
+        bad2 = api.CustomObject(kind="Widget", api_version="example.com/v1",
+                                metadata=api.ObjectMeta(name="w"),
+                                spec={"replicas": 500, "color": "green",
+                                      "host": "NOT VALID"})
+        with pytest.raises(APIStatusError) as ei:
+            client.create("widgets", bad2)
+        msg = ei.value.message
+        assert "must be <= 100" in msg and "must be one of" in msg \
+            and "pattern" in msg
+        # valid object passes
+        client.create("widgets", widget("w", replicas=3))
+        got = client.get("widgets", "default", "w")
+        got.spec["replicas"] = -1
+        with pytest.raises(APIStatusError) as ei:
+            client.update("widgets", got)
+        assert ei.value.code == 422
+
+    def test_type_errors(self, server, client):
+        client.create("customresourcedefinitions", schema_crd())
+        bad = api.CustomObject(kind="Widget", api_version="example.com/v1",
+                               metadata=api.ObjectMeta(name="w"),
+                               spec={"replicas": "three"})
+        with pytest.raises(APIStatusError) as ei:
+            client.create("widgets", bad)
+        assert "must be of type integer" in ei.value.message
+
+
+class TestCRDSubresources:
+    def test_status_isolation(self, server, client):
+        client.create("customresourcedefinitions", schema_crd())
+        w = widget("w", replicas=3)
+        w.status = {"readyReplicas": 99}  # client status dropped at create
+        client.create("widgets", w)
+        got = client.get("widgets", "default", "w")
+        assert got.status == {}
+        # status write never touches spec
+        got.status = {"readyReplicas": 2}
+        got.spec["replicas"] = 50  # smuggled spec change
+        client.update_status("widgets", got)
+        got = client.get("widgets", "default", "w")
+        assert got.status == {"readyReplicas": 2}
+        assert got.spec["replicas"] == 3
+        # spec write never touches status
+        got.spec["replicas"] = 5
+        got.status = {}  # smuggled status wipe
+        client.update("widgets", got)
+        got = client.get("widgets", "default", "w")
+        assert got.spec["replicas"] == 5
+        assert got.status == {"readyReplicas": 2}
+
+    def test_status_404_without_optin(self, server, client):
+        client.create("customresourcedefinitions", widget_crd())
+        client.create("widgets", widget("w"))
+        got = client.get("widgets", "default", "w")
+        got.status = {"readyReplicas": 1}
+        with pytest.raises(APIStatusError) as ei:
+            client.update_status("widgets", got)
+        assert ei.value.code == 404
+
+    def test_scale_subresource(self, server, client):
+        client.create("customresourcedefinitions", schema_crd())
+        client.create("widgets", widget("w", replicas=3))
+        got = client.get("widgets", "default", "w")
+        got.status = {"readyReplicas": 2}
+        client.update_status("widgets", got)
+        sc = client.get_scale("widgets", "default", "w")
+        assert sc["kind"] == "Scale"
+        assert sc["spec"]["replicas"] == 3
+        assert sc["status"]["replicas"] == 2
+        client.update_scale("widgets", "default", "w", 7)
+        assert client.get("widgets", "default", "w").spec["replicas"] == 7
+
+    def test_scale_404_without_optin(self, server, client):
+        client.create("customresourcedefinitions", widget_crd())
+        client.create("widgets", widget("w"))
+        with pytest.raises(APIStatusError) as ei:
+            client.get_scale("widgets", "default", "w")
+        assert ei.value.code == 404
+
+
+class TestScaleRespectsRules:
+    def test_scale_cannot_bypass_schema(self, server, client):
+        client.create("customresourcedefinitions", schema_crd())
+        client.create("widgets", widget("w", replicas=3))
+        # schema caps replicas at 100: the scale path must honor it
+        with pytest.raises(APIStatusError) as ei:
+            client.update_scale("widgets", "default", "w", 500)
+        assert ei.value.code == 422
+        # rejected write left the store untouched
+        assert client.get("widgets", "default", "w").spec["replicas"] == 3
+
+    def test_rejected_scale_leaves_store_untouched(self, clean_scheme):
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.server.admission import (AdmissionChain,
+                                                     AdmissionError,
+                                                     AdmissionPlugin)
+
+        class DenyScale(AdmissionPlugin):
+            name = "DenyScale"
+
+            def admit(self, op, kind, obj, old, user, store):
+                if op == "update" and kind == "deployments":
+                    raise AdmissionError("no scaling today")
+
+        store = ObjectStore()
+        srv = APIServer(store,
+                        admission=AdmissionChain([DenyScale()])).start()
+        try:
+            client = RESTClient(srv.url)
+            dep = api.Deployment(
+                metadata=api.ObjectMeta(name="web"),
+                spec=api.DeploymentSpec(
+                    replicas=3,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "web"}),
+                        spec=api.PodSpec(containers=[api.Container()]))))
+            store.create("deployments", dep)
+            with pytest.raises(APIStatusError) as ei:
+                client.update_scale("deployments", "default", "web", 99)
+            assert ei.value.code == 403
+            assert store.get("deployments", "default",
+                             "web").spec.replicas == 3
+        finally:
+            srv.stop()
+
+    def test_status_subresource_validated(self, server, client):
+        crd = schema_crd()
+        crd.spec.validation.open_api_v3_schema["properties"]["status"] = {
+            "type": "object",
+            "properties": {"readyReplicas": {"type": "integer"}}}
+        client.create("customresourcedefinitions", crd)
+        client.create("widgets", widget("w", replicas=1))
+        got = client.get("widgets", "default", "w")
+        got.status = {"readyReplicas": "lots"}
+        with pytest.raises(APIStatusError) as ei:
+            client.update_status("widgets", got)
+        assert ei.value.code == 422
