@@ -57,8 +57,18 @@ def _walk(value, schema, path, errs):
         errs.append((path, f"must be one of {schema['enum']!r}"))
     if isinstance(value, str):
         pat = schema.get("pattern")
-        if pat is not None and re.search(pat, value) is None:
-            errs.append((path, f"must match pattern {pat!r}"))
+        if pat is not None:
+            try:
+                matched = re.search(pat, value) is not None
+            except re.error:
+                # a broken pattern in the CRD is a schema-author error,
+                # reported as a field error rather than a 500 on every
+                # write (the reference rejects it at CRD create)
+                errs.append((path, f"schema pattern {pat!r} is not a "
+                                   f"valid regular expression"))
+                matched = True
+            if not matched:
+                errs.append((path, f"must match pattern {pat!r}"))
         if "minLength" in schema and len(value) < schema["minLength"]:
             errs.append((path,
                          f"length must be >= {schema['minLength']}"))

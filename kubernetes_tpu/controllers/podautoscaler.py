@@ -38,13 +38,9 @@ TOLERANCE = 0.1  # horizontal.go:62 defaultTolerance
 UPSCALE_FORBIDDEN_WINDOW = 3 * 60.0  # horizontal.go upscaleForbiddenWindow
 DOWNSCALE_FORBIDDEN_WINDOW = 5 * 60.0
 
-# scalable target kinds -> store plural (Scale subresource analog)
-SCALE_KINDS = {
-    "Deployment": "deployments",
-    "ReplicaSet": "replicasets",
-    "ReplicationController": "replicationcontrollers",
-    "StatefulSet": "statefulsets",
-}
+# scalable target kinds -> store plural: THE scale mapping, shared with
+# the apiserver's /scale subresource (api/scale.py)
+from ..api.scale import BUILTIN_SCALE_KINDS as SCALE_KINDS  # noqa: E402
 
 
 class HorizontalPodAutoscalerController(Controller):
@@ -90,13 +86,45 @@ class HorizontalPodAutoscalerController(Controller):
     # -- target plumbing --------------------------------------------------------
 
     def _get_target(self, hpa: api.HorizontalPodAutoscaler):
+        """Resolve scaleTargetRef through the shared scale mapping —
+        built-in workloads AND custom kinds whose CRD declares
+        subresources.scale (the reference HPA goes through the
+        polymorphic scale client for exactly this reason,
+        horizontal.go scaleForResourceMappings). Returns
+        (plural, target, mapping)."""
+        from ..api import scale as scaleapi
+
         ref = hpa.spec.scale_target_ref
         plural = SCALE_KINDS.get(ref.kind)
         if plural is None:
-            return None, None
-        return plural, self.store.get(plural, hpa.metadata.namespace, ref.name)
+            crd = scaleapi.crd_for_kind(self.store, ref.kind)
+            if crd is None or crd.spec.subresources is None or \
+                    crd.spec.subresources.scale is None:
+                return None, None, None
+            plural = crd.spec.names.plural
+        target = self.store.get(plural, hpa.metadata.namespace, ref.name)
+        if target is None:
+            return plural, None, None
+        return plural, target, scaleapi.mapping_for(self.store, plural,
+                                                    target)
 
-    def _selected_pods(self, target) -> List[api.Pod]:
+    def _selected_pods(self, target, mapping=None) -> List[api.Pod]:
+        if isinstance(target, api.CustomObject):
+            # custom targets select pods through the Scale selector
+            # string (status.selector from labelSelectorPath)
+            from ..api.labels import Selector
+
+            sel_str = (mapping[2] if mapping else "") or ""
+            if not sel_str:
+                return []
+            try:
+                s = Selector.parse(sel_str)
+            except ValueError:
+                return []
+            return [p for p in self.store.list("pods",
+                                               target.metadata.namespace)
+                    if api.is_pod_active(p)
+                    and s.matches(p.metadata.labels or {})]
         sel = target.spec.selector
         if sel is None:
             match = target.spec.template.metadata.labels \
@@ -120,11 +148,13 @@ class HorizontalPodAutoscalerController(Controller):
         hpa = self.store.get("horizontalpodautoscalers", ns, name)
         if hpa is None:
             return
-        plural, target = self._get_target(hpa)
-        if target is None:
+        from ..api import scale as scaleapi
+
+        plural, target, mapping = self._get_target(hpa)
+        if target is None or mapping is None:
             return
-        pods = self._selected_pods(target)
-        current = target.spec.replicas
+        pods = self._selected_pods(target, mapping)
+        current = scaleapi.get_spec_replicas(target, mapping[0])
         desired, utilization = self._desired_replicas(hpa, pods, current)
         before = (hpa.status.current_replicas,
                   hpa.status.current_cpu_utilization_percentage,
@@ -134,7 +164,7 @@ class HorizontalPodAutoscalerController(Controller):
         scaled = False
         if desired is not None and desired != current \
                 and self._scale_allowed(hpa, desired > current):
-            target.spec.replicas = desired
+            scaleapi.set_spec_replicas(target, mapping[0], desired)
             self.store.update(plural, target)
             hpa.status.desired_replicas = desired
             hpa.status.last_scale_time = self.clock()

@@ -246,6 +246,24 @@ class CPUManager:
         with self._lock:
             return sorted(self._shared)
 
+    def state(self) -> dict:
+        """Checkpointable assignments (cpumanager state_checkpoint)."""
+        with self._lock:
+            return {f"{uid}/{c}": list(cpus)
+                    for (uid, c), cpus in self._assignments.items()}
+
+    def restore(self, state: dict):
+        """Rebuild assignments + the shared pool from a checkpoint —
+        a restarted kubelet must not re-pin a running pod's cores."""
+        with self._lock:
+            self._assignments.clear()
+            self._shared = set(self.all_cpus)
+            for key, cpus in (state or {}).items():
+                uid, _, cname = key.partition("/")
+                taken = [c for c in cpus if c in self._shared]
+                self._assignments[(uid, cname)] = taken
+                self._shared.difference_update(taken)
+
 
 class ContainerManager:
     """container_manager_linux.go + qos_container_manager_linux.go +

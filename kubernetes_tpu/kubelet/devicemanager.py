@@ -155,6 +155,25 @@ class DeviceManager:
                     env[_visible_env(resource, ambiguous)] = ",".join(ids)
             return env
 
+    def state(self) -> dict:
+        """Checkpointable allocation state (podDevices.toCheckpointData
+        analog) — device health/registration is NOT persisted; plugins
+        re-register on restart."""
+        with self._lock:
+            return {r: {uid: {c: list(ids) for c, ids in per.items()}
+                        for uid, per in pods.items()}
+                    for r, pods in self._allocated.items()}
+
+    def restore(self, state: dict):
+        """Adopt checkpointed allocations (manager.go readCheckpoint):
+        restored entries win over the empty post-restart state, so a
+        running pod keeps its exact device IDs."""
+        with self._lock:
+            for resource, pods in (state or {}).items():
+                self._allocated[resource] = {
+                    uid: {c: list(ids) for c, ids in per.items()}
+                    for uid, per in pods.items()}
+
     def pod_devices(self, pod_uid: str) -> Dict[str, Dict[str, List[str]]]:
         with self._lock:
             out: Dict[str, Dict[str, List[str]]] = {}

@@ -60,7 +60,8 @@ class Kubelet:
                  memory_pressure_threshold: float = 0.9,
                  resync_interval: float = 0.0,
                  async_workers: bool = False,
-                 manifest_dir: Optional[str] = None):
+                 manifest_dir: Optional[str] = None,
+                 checkpoint_dir: Optional[str] = None):
         """resync_interval=0 fully resyncs every pod each iteration (the
         deterministic test mode); >0 switches to event-driven syncs —
         only pods with config changes or PLEG events sync between full
@@ -113,6 +114,26 @@ class Kubelet:
         self.image_gc = ImageGCManager(self.image_store, self.runtime)
         self.container_gc = ContainerGC(self.runtime)
         self.device_manager = DeviceManager()
+        # checkpointing (pkg/kubelet/checkpointmanager): device/cpu
+        # assignments survive a kubelet restart so running pods keep
+        # their exact accelerator IDs and core pins
+        self.checkpoints = None
+        self._last_checkpoint: Dict[str, dict] = {}
+        if checkpoint_dir:
+            from .checkpoint import CheckpointManager, CorruptCheckpoint
+            self.checkpoints = CheckpointManager(checkpoint_dir)
+            for name, mgr in (("device_manager_state",
+                               self.device_manager),
+                              ("cpu_manager_state", self.cpu_manager)):
+                try:
+                    state = self.checkpoints.load(name)
+                except CorruptCheckpoint:
+                    # bad state is worse than none: start fresh, like
+                    # the reference's corrupt-checkpoint recovery
+                    self.checkpoints.remove(name)
+                    state = None
+                if state:
+                    mgr.restore(state)
         self.labels = {api.LABEL_HOSTNAME: node_name, **(labels or {})}
         self.taints = list(taints or [])
         self._probe_state: Dict[tuple, _ProbeState] = {}
@@ -784,10 +805,31 @@ class Kubelet:
         self.image_gc.garbage_collect()
         for uid in self.container_manager.cleanup_orphans(live_uids):
             self.device_manager.deallocate(uid)
+        # stale-state reconcile (devicemanager RemoveStaleState): a pod
+        # deleted while the kubelet was down leaves checkpoint-restored
+        # device/CPU allocations with no live pod — release them, or the
+        # accelerators leak forever
+        for uid in {u for r in self.device_manager.state().values()
+                    for u in r} - live_uids:
+            self.device_manager.deallocate(uid)
+        for uid in {k.split("/", 1)[0]
+                    for k in self.cpu_manager.state()} - live_uids:
+            self.cpu_manager.remove_pod(uid)
         self.container_manager.update_qos_cgroups(
             [p for p in (list(self._my_pods())
                          + list(self._static_by_uid.values()))
              if p.status.phase in ("Pending", "Running")])
+        if self.checkpoints is not None:
+            # write only on change — steady-state housekeeping must not
+            # rewrite identical checkpoint files every iteration
+            dev_state = self.device_manager.state()
+            cpu_state = self.cpu_manager.state()
+            if dev_state != self._last_checkpoint.get("device"):
+                self.checkpoints.save("device_manager_state", dev_state)
+                self._last_checkpoint["device"] = dev_state
+            if cpu_state != self._last_checkpoint.get("cpu"):
+                self.checkpoints.save("cpu_manager_state", cpu_state)
+                self._last_checkpoint["cpu"] = cpu_state
         # eviction: under memory pressure, rank by QoS class (BestEffort
         # -> Burstable -> Guaranteed), then priority, then memory
         # footprint (eviction/helpers.go rankMemoryPressure)

@@ -774,10 +774,9 @@ class APIServer:
     # -- custom resource validation/subresources -------------------------------
 
     def _crd_for_kind(self, kind: str):
-        for crd in self.store.list("customresourcedefinitions"):
-            if crd.spec.names.kind == kind:
-                return crd
-        return None
+        from ..api import scale as scaleapi
+
+        return scaleapi.crd_for_kind(self.store, kind)
 
     def _validate_custom(self, obj, crd):
         """CustomResourceValidation enforcement: the whole wire object
@@ -799,60 +798,19 @@ class APIServer:
 
     # -- scale subresource -----------------------------------------------------
 
-    # kinds with a native Scale mapping (the reference's registry wires
-    # autoscaling/v1 Scale REST for these: registry/apps/deployment/
-    # storage/storage.go ScaleREST etc.)
-    _SCALE_PLURALS = frozenset({
-        "deployments", "replicasets", "replicationcontrollers",
-        "statefulsets"})
-
-    @staticmethod
-    def _dotted_get(wire: dict, path: str, default=None):
-        cur = wire
-        for part in [p for p in path.split(".") if p]:
-            if not isinstance(cur, dict) or part not in cur:
-                return default
-            cur = cur[part]
-        return cur
-
-    @staticmethod
-    def _dotted_set(wire: dict, path: str, value):
-        parts = [p for p in path.split(".") if p]
-        cur = wire
-        for part in parts[:-1]:
-            cur = cur.setdefault(part, {})
-        cur[parts[-1]] = value
-
     def _scale_mapping(self, plural, obj):
         """-> (spec_path, status_path, selector_str) or None when the
-        kind has no scale subresource."""
-        if plural in self._SCALE_PLURALS:
-            sel = ""
-            s = getattr(obj.spec, "selector", None)
-            if s is not None and getattr(s, "match_labels", None):
-                sel = ",".join(f"{k}={v}"
-                               for k, v in sorted(s.match_labels.items()))
-            elif plural == "replicationcontrollers" and obj.spec.selector:
-                sel = ",".join(f"{k}={v}"
-                               for k, v in sorted(obj.spec.selector.items()))
-            return ".spec.replicas", ".status.replicas", sel
-        if isinstance(obj, api.CustomObject):
-            crd = self._crd_for_kind(obj.kind)
-            if crd is not None and crd.spec.subresources is not None and \
-                    crd.spec.subresources.scale is not None:
-                sc = crd.spec.subresources.scale
-                wire = scheme.encode_object(obj)
-                sel = ""
-                if sc.label_selector_path:
-                    sel = self._dotted_get(wire, sc.label_selector_path,
-                                           "") or ""
-                return sc.spec_replicas_path, sc.status_replicas_path, sel
-        return None
+        kind has no scale subresource (shared mapping: api/scale.py)."""
+        from ..api import scale as scaleapi
+
+        return scaleapi.mapping_for(self.store, plural, obj)
 
     def _scale_wire(self, obj, plural, mapping):
+        from ..api import scale as scaleapi
+
         spec_path, status_path, sel = mapping
         wire = scheme.encode_object(obj)
-        status = {"replicas": self._dotted_get(wire, status_path, 0) or 0}
+        status = {"replicas": scaleapi.dotted_get(wire, status_path, 0) or 0}
         if sel:
             status["selector"] = sel
         return {
@@ -861,7 +819,8 @@ class APIServer:
                          "namespace": obj.metadata.namespace,
                          "resourceVersion":
                              obj.metadata.resource_version},
-            "spec": {"replicas": self._dotted_get(wire, spec_path, 0) or 0},
+            "spec": {"replicas":
+                     scaleapi.dotted_get(wire, spec_path, 0) or 0},
             "status": status,
         }
 
@@ -888,21 +847,17 @@ class APIServer:
                                "spec.replicas must be a non-negative "
                                "integer")
             rv = body.get("metadata", {}).get("resourceVersion")
-            if rv and int(rv) != obj.metadata.resource_version:
+            if rv and str(rv) != str(obj.metadata.resource_version):
                 raise APIError(409, "Conflict",
                                f"resourceVersion {rv} != "
                                f"{obj.metadata.resource_version}")
             # mutate a CLONE: the stored object must not change until
             # admission + validation admit the write (a rejected scale
             # must leave the store untouched, like every other verb)
+            from ..api import scale as scaleapi
+
             new = copy.deepcopy(obj)
-            spec_path = mapping[0]
-            if isinstance(new, api.CustomObject):
-                self._dotted_set(
-                    {"spec": new.spec, "status": new.status},
-                    spec_path, want)
-            else:
-                new.spec.replicas = want
+            scaleapi.set_spec_replicas(new, mapping[0], want)
             try:
                 self.admission.admit("update", plural, new, obj, user,
                                      self.store)
@@ -1118,13 +1073,14 @@ class APIServer:
             raise APIError(422, "Invalid", errs.message())
         if isinstance(obj, api.CustomObject):
             crd = self._crd_for_kind(obj.kind)
-            self._validate_custom(obj, crd)
             if crd is not None and crd.spec.subresources is not None and \
                     crd.spec.subresources.status:
                 # status subresource enabled: the main resource never
                 # accepts client status (apiextensions strategy
-                # PrepareForCreate drops it)
+                # PrepareForCreate drops it) — BEFORE validation, so a
+                # discarded status can't fail the create
                 obj.status = {}
+            self._validate_custom(obj, crd)
         if plural == "services":
             self._allocate_service(obj)
         if plural == "customresourcedefinitions":

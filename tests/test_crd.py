@@ -442,3 +442,33 @@ class TestScaleRespectsRules:
         with pytest.raises(APIStatusError) as ei:
             client.update_status("widgets", got)
         assert ei.value.code == 422
+
+
+class TestCreateStatusDrop:
+    def test_discarded_status_cannot_fail_create(self, server, client):
+        crd = schema_crd()
+        crd.spec.validation.open_api_v3_schema["properties"]["status"] = {
+            "type": "object",
+            "properties": {"readyReplicas": {"type": "integer"}}}
+        client.create("customresourcedefinitions", crd)
+        w = widget("w", replicas=1)
+        # ill-typed status (e.g. replayed from another cluster's get):
+        # the status subresource drops it at create, so it must not 422
+        w.status = {"readyReplicas": "lots"}
+        client.create("widgets", w)
+        assert client.get("widgets", "default", "w").status == {}
+
+    def test_bad_schema_pattern_is_a_422_not_500(self, server, client):
+        crd = widget_crd()
+        crd.spec.validation = api.CustomResourceValidation(
+            open_api_v3_schema={
+                "type": "object",
+                "properties": {"spec": {
+                    "type": "object",
+                    "properties": {"color": {"type": "string",
+                                             "pattern": "["}}}}})
+        client.create("customresourcedefinitions", crd)
+        with pytest.raises(APIStatusError) as ei:
+            client.create("widgets", widget("w"))
+        assert ei.value.code == 422
+        assert "not a valid regular expression" in ei.value.message

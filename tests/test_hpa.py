@@ -177,3 +177,58 @@ def test_in_manager_roster():
     from kubernetes_tpu.controllers.manager import DEFAULT_CONTROLLERS
 
     assert HorizontalPodAutoscalerController in DEFAULT_CONTROLLERS
+
+
+def test_hpa_scales_custom_resource():
+    """An HPA targeting a CRD kind that declares subresources.scale:
+    replicas are read/written through the CRD's dotted paths and pods
+    are selected via the labelSelectorPath selector string (the
+    reference HPA's polymorphic scale-client path)."""
+    from kubernetes_tpu.api import scheme
+
+    store = ObjectStore()
+    crd = api.CustomResourceDefinition(
+        metadata=api.ObjectMeta(name="tpujobs.ml.example.com"),
+        spec=api.CustomResourceDefinitionSpec(
+            group="ml.example.com", version="v1",
+            names=api.CustomResourceNames(kind="TPUJob", plural="tpujobs",
+                                          singular="tpujob"),
+            subresources=api.CustomResourceSubresources(
+                status=True,
+                scale=api.CustomResourceSubresourceScale(
+                    spec_replicas_path=".spec.replicas",
+                    status_replicas_path=".status.readyReplicas",
+                    label_selector_path=".spec.selector"))))
+    store.create("customresourcedefinitions", crd)
+    scheme.register_dynamic(crd)
+    try:
+        now = [1000.0]
+        hpa_ctrl = HorizontalPodAutoscalerController(store,
+                                                     clock=lambda: now[0])
+        store.create("tpujobs", api.CustomObject(
+            kind="TPUJob", api_version="ml.example.com/v1",
+            metadata=api.ObjectMeta(name="train"),
+            spec={"replicas": 2, "selector": "app=train"}))
+        # the "operator" runs 2 worker pods wearing the selector labels
+        for i in range(2):
+            store.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name=f"train-{i}",
+                                        labels={"app": "train"}),
+                spec=api.PodSpec(containers=[api.Container(
+                    resources=api.ResourceRequirements(
+                        requests=api.resource_list(cpu="100m")))]),
+                status=api.PodStatus(phase="Running",
+                                     conditions=[("Ready", "True")])))
+            set_metrics(store, f"train-{i}", 100)  # 100% of request
+        hpa = mkhpa(target="train", cpu=50)
+        hpa.spec.scale_target_ref = api.CrossVersionObjectReference(
+            kind="TPUJob", name="train")
+        store.create("horizontalpodautoscalers", hpa)
+        hpa_ctrl.sync_all()
+        job = store.get("tpujobs", "default", "train")
+        # 100% util vs 50% target -> double
+        assert job.spec["replicas"] == 4
+        got = store.get("horizontalpodautoscalers", "default", "hpa")
+        assert got.status.desired_replicas == 4
+    finally:
+        scheme.unregister("TPUJob")

@@ -422,3 +422,95 @@ class TestKubeletIntegration:
         kl.sync_once(3.0)
         kl.sync_once(4.0)
         assert kl.runtime.get("u-a", "c").state == RUNNING
+
+
+class TestCheckpointing:
+    def test_restart_preserves_device_and_cpu_pins(self, tmp_path):
+        cp = str(tmp_path / "checkpoints")
+        store = ObjectStore()
+        rt = FakeRuntime()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0, runtime=rt,
+                     checkpoint_dir=cp)
+        kl.device_manager.register(
+            DevicePlugin("google.com/tpu", ["tpu0", "tpu1"]))
+        kl.heartbeat(0.0)
+        pod = mkpod("a", "u-a", cpu_req="2", cpu_lim="2", mem_req="1Gi",
+                    mem_lim="1Gi", device=("google.com/tpu", 1))
+        pod.spec.node_name = "n1"
+        store.create("pods", pod)
+        kl.sync_once(1.0)  # allocates + checkpoints in housekeeping
+        st = kl.runtime.get("u-a", "c")
+        assert st.env["TPU_VISIBLE_DEVICES"] == "tpu0"
+        assert st.cpuset == [0, 1]
+        # "restart": a fresh kubelet over the same runtime + checkpoint
+        kl2 = Kubelet(store, "n1", heartbeat_period=0.0, runtime=rt,
+                      checkpoint_dir=cp)
+        kl2.device_manager.register(
+            DevicePlugin("google.com/tpu", ["tpu0", "tpu1"]))
+        # restored state: the running pod keeps tpu0; a new pod must
+        # get tpu1, never a double-allocation of tpu0
+        assert kl2.device_manager.pod_devices("u-a") == {
+            "c": {"google.com/tpu": ["tpu0"]}}
+        assert kl2.cpu_manager.shared_pool() == list(range(2, 8))
+        p2 = mkpod("b", "u-b", device=("google.com/tpu", 1))
+        p2.spec.node_name = "n1"
+        store.create("pods", p2)
+        kl2.sync_once(2.0)
+        assert kl2.runtime.get("u-b", "c").env[
+            "TPU_VISIBLE_DEVICES"] == "tpu1"
+
+    def test_corrupt_checkpoint_starts_fresh(self, tmp_path):
+        from kubernetes_tpu.kubelet.checkpoint import (CheckpointManager,
+                                                       CorruptCheckpoint)
+        cp = str(tmp_path / "checkpoints")
+        mgr = CheckpointManager(cp)
+        mgr.save("device_manager_state", {"google.com/tpu": {}})
+        # tamper
+        import json
+        path = tmp_path / "checkpoints" / "device_manager_state"
+        doc = json.loads(path.read_text())
+        doc["data"] = doc["data"].replace("tpu", "gpu")
+        path.write_text(json.dumps(doc))
+        try:
+            mgr.load("device_manager_state")
+            assert False, "expected CorruptCheckpoint"
+        except CorruptCheckpoint:
+            pass
+        # a kubelet over the corrupt dir starts fresh instead of dying
+        kl = Kubelet(ObjectStore(), "n1", heartbeat_period=0.0,
+                     checkpoint_dir=cp)
+        assert kl.device_manager.state() == {}
+
+
+class TestStaleStateReconcile:
+    def test_restored_allocations_for_deleted_pods_are_released(
+            self, tmp_path):
+        cp = str(tmp_path / "checkpoints")
+        store = ObjectStore()
+        rt = FakeRuntime()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0, runtime=rt,
+                     checkpoint_dir=cp)
+        kl.device_manager.register(DevicePlugin("google.com/tpu", ["tpu0"]))
+        kl.heartbeat(0.0)
+        pod = mkpod("a", "u-a", cpu_req="2", cpu_lim="2", mem_req="1Gi",
+                    mem_lim="1Gi", device=("google.com/tpu", 1))
+        pod.spec.node_name = "n1"
+        store.create("pods", pod)
+        kl.sync_once(1.0)
+        # pod deleted WHILE the kubelet is down
+        store.delete("pods", "default", "a")
+        kl2 = Kubelet(store, "n1", heartbeat_period=0.0, runtime=rt,
+                      checkpoint_dir=cp)
+        kl2.device_manager.register(DevicePlugin("google.com/tpu",
+                                                 ["tpu0"]))
+        assert kl2.device_manager.pod_devices("u-a")  # restored...
+        kl2.sync_once(2.0)  # ...and reconciled away: pod is gone
+        assert not kl2.device_manager.pod_devices("u-a")
+        assert kl2.cpu_manager.shared_pool() == list(range(8))
+        # the freed device is allocatable again
+        p2 = mkpod("b", "u-b", device=("google.com/tpu", 1))
+        p2.spec.node_name = "n1"
+        store.create("pods", p2)
+        kl2.sync_once(3.0)
+        assert kl2.runtime.get("u-b", "c").env[
+            "TPU_VISIBLE_DEVICES"] == "tpu0"
