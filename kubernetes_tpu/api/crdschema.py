@@ -35,6 +35,39 @@ def validate_schema(value: Any, schema: dict,
     return errs
 
 
+def schema_errors(schema: dict,
+                  path: str = "openAPIV3Schema") -> List[Tuple[str, str]]:
+    """Structural validation of the SCHEMA itself, run at CRD
+    registration (apiextensions validation.go ValidateCustomResource
+    Definition): a broken pattern or unknown type is the schema
+    author's 422, not a fate inflicted on every future resource
+    author."""
+    errs: List[Tuple[str, str]] = []
+    if not isinstance(schema, dict):
+        errs.append((path, "schema must be an object"))
+        return errs
+    t = schema.get("type")
+    if t is not None and t not in _TYPE_CHECKS:
+        errs.append((f"{path}.type", f"unknown schema type {t!r}"))
+    pat = schema.get("pattern")
+    if pat is not None:
+        try:
+            re.compile(pat)
+        except re.error as e:
+            errs.append((f"{path}.pattern",
+                         f"invalid regular expression {pat!r}: {e}"))
+    for key in ("properties",):
+        for name, sub in (schema.get(key) or {}).items():
+            errs.extend(schema_errors(sub, f"{path}.{key}[{name}]"))
+    items = schema.get("items")
+    if isinstance(items, dict):
+        errs.extend(schema_errors(items, f"{path}.items"))
+    addl = schema.get("additionalProperties")
+    if isinstance(addl, dict):
+        errs.extend(schema_errors(addl, f"{path}.additionalProperties"))
+    return errs
+
+
 def _walk(value, schema, path, errs):
     if value is None:
         if schema.get("nullable"):

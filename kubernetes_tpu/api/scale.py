@@ -74,7 +74,11 @@ def mapping_for(store, plural: str,
             sel = ""
             if sc.label_selector_path:
                 wire = scheme.encode_object(obj)
-                sel = dotted_get(wire, sc.label_selector_path, "") or ""
+                got = dotted_get(wire, sc.label_selector_path, "")
+                # the Scale selector is a STRING field; a map-shaped
+                # value at the path degrades to no selector rather than
+                # crashing every consumer (HPA retry-loops otherwise)
+                sel = got if isinstance(got, str) else ""
             return sc.spec_replicas_path, sc.status_replicas_path, sel
     return None
 

@@ -760,8 +760,86 @@ def _describe_service(client, svc, out):
         out.write("Endpoints:    <none>\n")
 
 
+def _describe_deployment(client, dep, out):
+    """describe.go DeploymentDescriber: replica rollup, strategy, the
+    per-revision ReplicaSet table."""
+    from ..controllers.deployment import REVISION_ANNOTATION
+
+    st = dep.status
+    out.write(f"Name:               {dep.metadata.name}\n")
+    out.write(f"Replicas:           {dep.spec.replicas} desired | "
+              f"{st.updated_replicas} updated | {st.replicas} total | "
+              f"{st.ready_replicas} available | "
+              f"{st.unavailable_replicas} unavailable\n")
+    out.write(f"StrategyType:       {dep.spec.strategy.type}\n")
+    if dep.spec.strategy.type == "RollingUpdate":
+        out.write(f"RollingUpdateStrategy:  "
+                  f"{dep.spec.strategy.max_unavailable} max unavailable, "
+                  f"{dep.spec.strategy.max_surge} max surge\n")
+    owned = sorted(_owned_replicasets(client, dep.metadata.namespace,
+                                      dep.metadata.name),
+                   key=lambda r: int(r.metadata.annotations.get(
+                       REVISION_ANNOTATION, 0)))
+    if owned:
+        out.write("ReplicaSets:\n")
+        for rs in owned:
+            rev = rs.metadata.annotations.get(REVISION_ANNOTATION, "?")
+            out.write(f"  {rs.metadata.name}\trevision={rev}\t"
+                      f"{rs.status.ready_replicas}/{rs.spec.replicas} "
+                      f"ready\n")
+
+
+def _describe_revisioned(kind_label):
+    """describe.go DaemonSetDescriber/StatefulSetDescriber: status
+    rollup + the ControllerRevision history."""
+
+    def describe(client, obj, out):
+        st = obj.status
+        out.write(f"Name:            {obj.metadata.name}\n")
+        if kind_label == "DaemonSet":
+            out.write(f"Desired Number of Nodes Scheduled: "
+                      f"{st.desired_number_scheduled}\n")
+            out.write(f"Current Number of Nodes Scheduled: "
+                      f"{st.current_number_scheduled}\n")
+            out.write(f"Number of Nodes Scheduled with Up-to-date Pods: "
+                      f"{st.updated_number_scheduled}\n")
+            out.write(f"Number of Nodes Misscheduled: "
+                      f"{st.number_misscheduled}\n")
+            out.write(f"Pods Status:  {st.number_ready} ready\n")
+        else:
+            out.write(f"Replicas:        {st.replicas} current / "
+                      f"{obj.spec.replicas} desired\n")
+            out.write(f"Update Strategy: "
+                      f"{obj.spec.update_strategy.type}\n")
+            if obj.spec.update_strategy.type == "RollingUpdate" and \
+                    obj.spec.update_strategy.partition:
+                out.write(f"  Partition:     "
+                          f"{obj.spec.update_strategy.partition}\n")
+            out.write(f"Pods Status:     {st.ready_replicas} ready / "
+                      f"{st.updated_replicas} updated\n")
+            if st.current_revision:
+                out.write(f"Current Revision: {st.current_revision}\n")
+            if st.update_revision and \
+                    st.update_revision != st.current_revision:
+                out.write(f"Update Revision:  {st.update_revision}\n")
+        revs, _ = client.list("controllerrevisions",
+                              obj.metadata.namespace)
+        owned = sorted((r for r in revs
+                        if any(o.controller and o.uid == obj.metadata.uid
+                               for o in r.metadata.owner_references)),
+                       key=lambda r: r.revision)
+        if owned:
+            out.write("Revisions:\n")
+            for r in owned:
+                out.write(f"  {r.revision}\t{r.metadata.name}\n")
+    return describe
+
+
 _DESCRIBERS = {"pods": _describe_pod, "nodes": _describe_node,
-               "services": _describe_service}
+               "services": _describe_service,
+               "deployments": _describe_deployment,
+               "daemonsets": _describe_revisioned("DaemonSet"),
+               "statefulsets": _describe_revisioned("StatefulSet")}
 
 
 def cmd_describe(client, args, out):
@@ -1254,16 +1332,36 @@ def cmd_version(client, args, out):
 # -- rollout (pkg/kubectl/cmd/rollout/) ---------------------------------------
 
 
-def _deployment_and_rss(client, args):
-    from ..controllers.deployment import REVISION_ANNOTATION  # noqa: F401
+def _owned_replicasets(client, namespace, dep_name):
+    """The ReplicaSets a Deployment controller-owns — THE ownership
+    predicate, shared by rollout and describe."""
+    rss, _ = client.list("replicasets", namespace)
+    return [rs for rs in rss
+            if any(r.controller and r.kind == "Deployment"
+                   and r.name == dep_name
+                   for r in rs.metadata.owner_references)]
 
+
+def _deployment_and_rss(client, args):
     dep = client.get("deployments", args.namespace, args.name)
-    rss, _ = client.list("replicasets", args.namespace)
-    owned = [rs for rs in rss
-             if any(r.controller and r.kind == "Deployment"
-                    and r.name == dep.metadata.name
-                    for r in rs.metadata.owner_references)]
-    return dep, owned
+    return dep, _owned_replicasets(client, args.namespace,
+                                   dep.metadata.name)
+
+
+def _print_template(tmpl_wire: dict, out):
+    """history.go printTemplate: labels + per-container image/ports."""
+    labels = (tmpl_wire.get("metadata") or {}).get("labels") or {}
+    if labels:
+        out.write("  Labels:\t" + ",".join(
+            f"{k}={v}" for k, v in sorted(labels.items())) + "\n")
+    out.write("  Containers:\n")
+    for c in (tmpl_wire.get("spec") or {}).get("containers") or []:
+        out.write(f"   {c.get('name', '?')}:\n")
+        out.write(f"    Image:\t{c.get('image', '<none>')}\n")
+        ports = [str(p.get("containerPort"))
+                 for p in c.get("ports") or []]
+        if ports:
+            out.write(f"    Ports:\t{','.join(ports)}\n")
 
 
 def _rollout_revisioned(client, args, out, plural):
@@ -1287,6 +1385,18 @@ def _rollout_revisioned(client, args, out, plural):
         key=lambda r: r.revision)
     name = obj.metadata.name
     if args.action == "history":
+        if getattr(args, "revision", 0):
+            # history --revision=N: the revision's template detail
+            # (history.go printTemplate via the HistoryViewer)
+            target = next((r for r in owned
+                           if r.revision == args.revision), None)
+            if target is None:
+                raise SystemExit(
+                    f"error: revision {args.revision} not found")
+            out.write(f"{kind}.apps/{name} with revision "
+                      f"#{args.revision}\nPod Template:\n")
+            _print_template(target.data["spec"]["template"], out)
+            return
         out.write(f"{kind}.apps/{name}\nREVISION\n")
         for r in owned:
             out.write(f"{r.revision}\n")
@@ -1396,6 +1506,18 @@ def cmd_rollout(client, args, out):
         else:
             out.write(f'deployment "{name}" successfully rolled out\n')
     elif args.action == "history":
+        if getattr(args, "revision", 0):
+            target = next(
+                (rs for rs in owned if rs.metadata.annotations.get(
+                    REVISION_ANNOTATION) == str(args.revision)), None)
+            if target is None:
+                raise SystemExit(
+                    f"error: revision {args.revision} not found")
+            from ..api import scheme as _scheme
+            out.write(f"deployment.apps/{name} with revision "
+                      f"#{args.revision}\nPod Template:\n")
+            _print_template(_scheme.encode(target.spec.template), out)
+            return
         out.write(f"deployment.apps/{name}\nREVISION\tREPLICASETS\n")
         for rs in sorted(owned, key=lambda r: int(
                 r.metadata.annotations.get(REVISION_ANNOTATION, 0))):
@@ -2364,6 +2486,8 @@ def build_parser() -> argparse.ArgumentParser:
     ro.add_argument("kind")
     ro.add_argument("name")
     ro.add_argument("--to-revision", type=int, default=0)
+    # history --revision=N: print that revision's pod template detail
+    ro.add_argument("--revision", type=int, default=0)
 
     ex = sub.add_parser("expose")
     ex.add_argument("kind")

@@ -40,6 +40,7 @@ DOWNSCALE_FORBIDDEN_WINDOW = 5 * 60.0
 
 # scalable target kinds -> store plural: THE scale mapping, shared with
 # the apiserver's /scale subresource (api/scale.py)
+from ..api import scale as scaleapi  # noqa: E402
 from ..api.scale import BUILTIN_SCALE_KINDS as SCALE_KINDS  # noqa: E402
 
 
@@ -92,7 +93,6 @@ class HorizontalPodAutoscalerController(Controller):
         polymorphic scale client for exactly this reason,
         horizontal.go scaleForResourceMappings). Returns
         (plural, target, mapping)."""
-        from ..api import scale as scaleapi
 
         ref = hpa.spec.scale_target_ref
         plural = SCALE_KINDS.get(ref.kind)
@@ -148,7 +148,6 @@ class HorizontalPodAutoscalerController(Controller):
         hpa = self.store.get("horizontalpodautoscalers", ns, name)
         if hpa is None:
             return
-        from ..api import scale as scaleapi
 
         plural, target, mapping = self._get_target(hpa)
         if target is None or mapping is None:

@@ -1087,6 +1087,15 @@ class APIServer:
             msg = scheme.crd_conflict(obj)
             if msg is not None:
                 raise APIError(409, "AlreadyExists", msg)
+            if obj.spec.validation is not None:
+                from ..api.crdschema import schema_errors
+
+                serrs = schema_errors(
+                    obj.spec.validation.open_api_v3_schema)
+                if serrs:
+                    raise APIError(
+                        422, "Invalid",
+                        "; ".join(f"{p}: {m}" for p, m in serrs))
         try:
             self.store.create(plural, obj)
         except Conflict as e:

@@ -458,7 +458,8 @@ class TestCreateStatusDrop:
         client.create("widgets", w)
         assert client.get("widgets", "default", "w").status == {}
 
-    def test_bad_schema_pattern_is_a_422_not_500(self, server, client):
+    def test_bad_schema_pattern_rejected_at_crd_create(self, server,
+                                                       client):
         crd = widget_crd()
         crd.spec.validation = api.CustomResourceValidation(
             open_api_v3_schema={
@@ -467,8 +468,16 @@ class TestCreateStatusDrop:
                     "type": "object",
                     "properties": {"color": {"type": "string",
                                              "pattern": "["}}}}})
-        client.create("customresourcedefinitions", crd)
+        # the schema author gets the 422, at registration time —
+        # resource authors are never collateral damage
         with pytest.raises(APIStatusError) as ei:
-            client.create("widgets", widget("w"))
+            client.create("customresourcedefinitions", crd)
         assert ei.value.code == 422
-        assert "not a valid regular expression" in ei.value.message
+        assert "invalid regular expression" in ei.value.message
+        # a schema that bypassed create-time checks (direct store
+        # write) still degrades to a field 422 on writes, never a 500
+        from kubernetes_tpu.api.crdschema import validate_schema
+        errs = validate_schema({"spec": {"color": "x"}},
+                               crd.spec.validation.open_api_v3_schema)
+        assert any("not a valid regular expression" in m
+                   for _p, m in errs)

@@ -371,3 +371,55 @@ class TestRolloutCLIRevisioned:
             assert "rolling update complete" in txt
         finally:
             srv.stop()
+
+
+class TestHistoryDetailAndDescribe:
+    def run(self, server, *argv):
+        out = io.StringIO()
+        rc = main(["--server", server.url, *argv], out=out)
+        return rc, out.getvalue()
+
+    def test_history_revision_detail_and_describe(self):
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            store.create("nodes", mknode("n0"))
+            ctrl = DaemonSetController(store)
+            ds = api.DaemonSet(metadata=api.ObjectMeta(name="d"),
+                               spec=api.DaemonSetSpec(selector=SEL,
+                                                      template=tmpl("v1")))
+            store.create("daemonsets", ds)
+            settle(store, ctrl)
+            ds = store.get("daemonsets", "default", "d")
+            ds.spec.template = tmpl("v2")
+            store.update("daemonsets", ds)
+            settle(store, ctrl)
+            rc, txt = self.run(srv, "rollout", "history", "daemonset", "d",
+                               "--revision", "1")
+            assert rc == 0 and "revision #1" in txt and "v1" in txt
+            rc, txt = self.run(srv, "rollout", "history", "daemonset", "d",
+                               "--revision", "2")
+            assert "v2" in txt
+            rc, txt = self.run(srv, "describe", "daemonset", "d")
+            assert rc == 0
+            assert "Desired Number of Nodes Scheduled: 1" in txt
+            assert "Revisions:" in txt
+        finally:
+            srv.stop()
+
+    def test_describe_statefulset_shows_revisions(self):
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        try:
+            ctrl = StatefulSetController(store)
+            store.create("statefulsets", api.StatefulSet(
+                metadata=api.ObjectMeta(name="db"),
+                spec=api.StatefulSetSpec(replicas=2, selector=SEL,
+                                         template=tmpl("v1"))))
+            settle(store, ctrl)
+            rc, txt = self.run(srv, "describe", "statefulset", "db")
+            assert rc == 0
+            assert "Replicas:        2 current / 2 desired" in txt
+            assert "Current Revision: db-" in txt
+        finally:
+            srv.stop()
