@@ -56,9 +56,8 @@ def schema_errors(schema: dict,
         except re.error as e:
             errs.append((f"{path}.pattern",
                          f"invalid regular expression {pat!r}: {e}"))
-    for key in ("properties",):
-        for name, sub in (schema.get(key) or {}).items():
-            errs.extend(schema_errors(sub, f"{path}.{key}[{name}]"))
+    for name, sub in (schema.get("properties") or {}).items():
+        errs.extend(schema_errors(sub, f"{path}.properties[{name}]"))
     items = schema.get("items")
     if isinstance(items, dict):
         errs.extend(schema_errors(items, f"{path}.items"))

@@ -243,6 +243,10 @@ def decode_request(kind: str, data: Mapping):
 # the reference capitalizes wholesale)
 _CAMEL_OVERRIDES = {
     "open_api_v3_schema": "openAPIV3Schema",
+    "pod_ip": "podIP",
+    "host_ip": "hostIP",
+    "cluster_ip": "clusterIP",
+    "pod_cidr": "podCIDR",
 }
 
 

@@ -99,13 +99,18 @@ class ContainerPort:
 
 @dataclass
 class Probe:
-    """Liveness/readiness probe config (reference: core/v1 Probe; the
-    handler itself is delegated to the container runtime here)."""
+    """Liveness/readiness probe config (reference: core/v1 Probe +
+    pkg/probe handlers). Handler precedence: exec command (runs through
+    the runtime's interpreter, rc==0 healthy), then tcpSocket (checks a
+    listener on the pod port), else the runtime's health bit — the seam
+    tests/kubemark flip directly."""
 
     initial_delay_seconds: float = 0.0
     period_seconds: float = 10.0
     failure_threshold: int = 3
     success_threshold: int = 1
+    exec_command: List[str] = field(default_factory=list)
+    tcp_port: int = 0
 
 
 @dataclass
@@ -301,6 +306,8 @@ class PodStatus:
     nominated_node_name: str = ""
     conditions: List[Tuple[str, str]] = field(default_factory=list)
     start_time: Optional[float] = None
+    # CNI-assigned address (kubelet network plugin, kubelet/network.py)
+    pod_ip: str = ""
     # stamped by the kubelet from pod_qos_class (reference: qos.go via
     # kubelet status manager; PodStatus.QOSClass)
     qos_class: str = ""

@@ -15,10 +15,15 @@ from .base import Controller, is_pod_active, is_pod_ready
 
 
 def _pod_ip(pod: api.Pod) -> str:
-    """Synthetic pod IP: hash of the pod UID in 10.x.y.z (the fake-runtime
-    analog of the CNI-assigned address)."""
-    h = abs(hash(pod.metadata.uid))
-    return f"10.{(h >> 16) % 256}.{(h >> 8) % 256}.{h % 254 + 1}"
+    """The pod's address: status.podIP once the kubelet's network
+    plugin assigned one (endpoints_controller.go reads exactly this);
+    uid-hash fallback for pods no kubelet serves (pure control-plane
+    tests)."""
+    if pod.status.pod_ip:
+        return pod.status.pod_ip
+    from ..kubelet.network import HashIPPlugin
+
+    return HashIPPlugin().setup_pod(pod.metadata.uid)
 
 
 class EndpointsController(Controller):

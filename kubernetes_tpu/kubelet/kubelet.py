@@ -49,6 +49,14 @@ MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
 CONFIG_SOURCE_ANNOTATION = "kubernetes.io/config.source"
 
 
+def ipaddress_contains(network, ip: str) -> bool:
+    import ipaddress
+    try:
+        return ipaddress.ip_address(ip) in network
+    except ValueError:
+        return False
+
+
 class Kubelet:
     def __init__(self, store, node_name: str,
                  allocatable: Optional[Dict[str, int]] = None,
@@ -61,7 +69,8 @@ class Kubelet:
                  resync_interval: float = 0.0,
                  async_workers: bool = False,
                  manifest_dir: Optional[str] = None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 network_plugin=None):
         """resync_interval=0 fully resyncs every pod each iteration (the
         deterministic test mode); >0 switches to event-driven syncs —
         only pods with config changes or PLEG events sync between full
@@ -117,6 +126,10 @@ class Kubelet:
         # checkpointing (pkg/kubelet/checkpointmanager): device/cpu
         # assignments survive a kubelet restart so running pods keep
         # their exact accelerator IDs and core pins
+        # network plugin (kubelet/network.py): explicit, or resolved on
+        # first use from the node's podCIDR (host-local IPAM once the
+        # nodeipam controller assigned one, uid-hash addressing before)
+        self.network_plugin = network_plugin
         self.checkpoints = None
         self._last_checkpoint: Dict[str, dict] = {}
         if checkpoint_dir:
@@ -359,6 +372,16 @@ class Kubelet:
 
     # -- admission (lifecycle/predicate.go canAdmitPod) ------------------------
 
+    # critical pods: the annotation (pre-priority marker) or a priority
+    # at/above system-cluster-critical (kubelet/types/pod_update.go
+    # IsCriticalPod)
+    CRITICAL_ANNOTATION = "scheduler.alpha.kubernetes.io/critical-pod"
+    CRITICAL_PRIORITY = 2_000_000_000
+
+    def _is_critical(self, pod: api.Pod) -> bool:
+        return (self.CRITICAL_ANNOTATION in (pod.metadata.annotations or {})
+                or api.pod_priority(pod) >= self.CRITICAL_PRIORITY)
+
     def _admit(self, pod: api.Pod, active: List[api.Pod]) -> (bool, str):
         node = self._iter_node or self._get_node()
         if node is None:
@@ -370,7 +393,60 @@ class Kubelet:
             if other.metadata.uid != pod.metadata.uid:
                 ni.add_pod(other)
         ok, reasons = golden.general_predicates(pod, ni)
+        if not ok and self._is_critical(pod):
+            # CriticalPodAdmissionHandler (kubelet/preemption/
+            # preemption.go HandleAdmissionFailure): evict enough
+            # lower-priority pods for the critical pod to fit, lowest
+            # priority + cheapest QoS first; retry admission next sync
+            if self._evict_for_critical(pod, active):
+                return False, "WaitingForPreemption"
         return ok, (reasons[0] if reasons else "")
+
+    def _evict_for_critical(self, pod: api.Pod,
+                            active: List[api.Pod]) -> bool:
+        """Evict the minimal prefix of non-critical victims (sorted by
+        priority, then QoS) that lets the critical pod pass admission.
+        Returns True when evictions were made (caller retries)."""
+        node = self._iter_node or self._get_node()
+        qos_rank = {api.QOS_BEST_EFFORT: 0, api.QOS_BURSTABLE: 1,
+                    api.QOS_GUARANTEED: 2}
+        victims = sorted(
+            (p for p in active
+             if p.metadata.uid != pod.metadata.uid
+             and not self._is_critical(p)),
+            key=lambda p: (api.pod_priority(p),
+                           qos_rank[api.pod_qos_class(p)]))
+        def fits_without(excluded_uids) -> bool:
+            ni = NodeInfo(node)
+            for other in active:
+                if other.metadata.uid != pod.metadata.uid and \
+                        other.metadata.uid not in excluded_uids:
+                    ni.add_pod(other)
+            ok, _ = golden.general_predicates(pod, ni)
+            return ok
+
+        evicted = []
+        for victim in victims:
+            evicted.append(victim)
+            if fits_without({v.metadata.uid for v in evicted}):
+                break
+        else:
+            return False  # even evicting everything would not fit
+        # minimal-victim pruning (getPodsToPreempt): drop any victim
+        # whose eviction is not actually needed — e.g. low-priority
+        # pods swept up before the one holding the conflicting hostPort
+        for v in sorted(evicted, key=lambda p: -api.pod_priority(p)):
+            rest = {x.metadata.uid for x in evicted
+                    if x.metadata.uid != v.metadata.uid}
+            if fits_without(rest):
+                evicted = [x for x in evicted
+                           if x.metadata.uid != v.metadata.uid]
+        for v in evicted:
+            v.status.phase = "Failed"
+            v.status.conditions = [("Ready", "False:Preempted")]
+            self._update_status(v)
+            self._kill_pod_with_hooks(v.metadata.uid, v)
+        return True
 
     # -- the sync loop ---------------------------------------------------------
 
@@ -430,8 +506,11 @@ class Kubelet:
         self._needs_retry.discard(uid)
         if uid not in self._pod_start:
             ok, reason = self._admit(pod, active)
-            if not ok and reason == "NodeNotVisible":
+            if not ok and reason in ("NodeNotVisible",
+                                     "WaitingForPreemption"):
                 # transient: retry next sync without failing the pod
+                # (WaitingForPreemption: victims were just evicted for
+                # this critical pod; next sync admits it)
                 self._needs_retry.add(uid)
                 return
             if not ok:
@@ -479,6 +558,16 @@ class Kubelet:
         # remembered for teardown: preStop hooks need the spec after the
         # pod object left the apiserver
         self._pod_specs[uid] = pod
+        # pod networking (network/plugins.go SetUpPod): the CNI-style
+        # plugin hands the pod its address, surfaced as status.podIP
+        if not pod.status.pod_ip:
+            try:
+                pod.status.pod_ip = self._net_plugin().setup_pod(uid)
+            except RuntimeError:
+                # CIDR exhausted: pod stays Pending without an address,
+                # retried as addresses free up
+                self._needs_retry.add(uid)
+                return
         # per-pod cgroup under the QoS tier (pod_container_manager
         # EnsureExists) — created before any container starts
         self.container_manager.ensure_pod_cgroup(pod)
@@ -544,6 +633,12 @@ class Kubelet:
                 st2 = self.runtime.get(uid, c.name)
                 if st2 is not None and cpus is not None:
                     st2.cpuset = cpus
+                rp = c.readiness_probe
+                if st2 is not None and rp is not None and \
+                        (rp.exec_command or rp.tcp_port):
+                    # a probed container starts NOT ready until its
+                    # handler passes (prober: initial result failure)
+                    st2.ready = False
                 # postStart hook (kuberuntime_container.go:165): fires
                 # once the container is actually RUNNING — with start
                 # latency that transition lands on a LATER sync, so the
@@ -591,14 +686,51 @@ class Kubelet:
         node = self._iter_node or self._get_node()
         return self.volume_manager.volumes_ready(pod, node)
 
+    def _probe_result(self, uid: str, c: api.Container, st,
+                      probe: api.Probe) -> bool:
+        """One probe execution (pkg/probe handler precedence): exec
+        command through the runtime's interpreter, tcpSocket against
+        the pod's listeners, else the runtime's injectable health bit."""
+        if probe.exec_command:
+            rc, _out = self.runtime.exec_in_container(
+                uid, c.name, probe.exec_command)
+            return rc == 0
+        if probe.tcp_port:
+            return self.runtime.pod_server(uid, probe.tcp_port) is not None
+        return st.healthy
+
     def _run_probes(self, pod: api.Pod, now: float):
-        """prober/worker.go probe loop against the runtime's health bits."""
+        """prober/worker.go probe loop: liveness kills on sustained
+        failure; readiness flips the runtime ready bit that feeds the
+        Ready condition and endpoints."""
         uid = pod.metadata.uid
         started = self._pod_start.get(uid, now)
         for c in pod.spec.containers:
             st = self.runtime.get(uid, c.name)
             if st is None or st.state != RUNNING:
                 continue
+            rprobe = c.readiness_probe
+            if rprobe is not None and (rprobe.exec_command
+                                       or rprobe.tcp_port):
+                # readiness honors the same cadence/threshold contract
+                # as liveness (prober/worker.go): period-gated, and
+                # only failureThreshold consecutive failures (resp.
+                # successThreshold successes) flip the bit
+                rs = self._probe_state.setdefault(
+                    (uid, c.name, "readiness"), _ProbeState())
+                if now - started >= rprobe.initial_delay_seconds and \
+                        now - rs.last_run >= rprobe.period_seconds:
+                    rs.last_run = now
+                    if self._probe_result(uid, c, st, rprobe):
+                        rs.failures = 0
+                        rs.successes += 1
+                        if rs.successes >= rprobe.success_threshold:
+                            st.ready = True
+                    else:
+                        rs.successes = 0
+                        rs.failures += 1
+                        if rs.failures >= rprobe.failure_threshold:
+                            st.ready = False
             probe = c.liveness_probe
             if probe is None:
                 continue
@@ -608,7 +740,7 @@ class Kubelet:
             if now - ps.last_run < probe.period_seconds:
                 continue
             ps.last_run = now
-            if st.healthy:
+            if self._probe_result(uid, c, st, probe):
                 ps.failures = 0
             else:
                 ps.failures += 1
@@ -753,6 +885,31 @@ class Kubelet:
         return alloc > 0 and \
             self._memory_requested() > self.memory_pressure_threshold * alloc
 
+    def _net_plugin(self):
+        """Resolve the network plugin: host-local IPAM over the node's
+        podCIDR when the nodeipam controller assigned one, uid-hash
+        addressing before it arrives (the hash fallback UPGRADES to
+        host-local once the CIDR lands — a startup race must not pin the
+        node to unmanaged addressing forever). On construction the IPAM
+        re-reserves every live pod's status.podIP, so a kubelet restart
+        never double-assigns a running pod's address."""
+        from .network import HashIPPlugin, HostLocalIPAM
+
+        if self.network_plugin is None or \
+                isinstance(self.network_plugin, HashIPPlugin):
+            node = self._iter_node or self._get_node()
+            cidr = node.spec.pod_cidr if node is not None else ""
+            if cidr:
+                ipam = HostLocalIPAM(cidr)
+                for p in self._my_pods():
+                    ip = p.status.pod_ip
+                    if ip and ipaddress_contains(ipam.network, ip):
+                        ipam.reserve(p.metadata.uid, ip)
+                self.network_plugin = ipam
+            elif self.network_plugin is None:
+                self.network_plugin = HashIPPlugin()
+        return self.network_plugin
+
     def _kill_pod_with_hooks(self, uid: str,
                              pod: Optional[api.Pod] = None):
         """Every kubelet-initiated kill path (teardown, eviction,
@@ -768,6 +925,7 @@ class Kubelet:
                         uid, c.name, c.lifecycle.pre_stop.command)
                 self._pending_poststart.pop((uid, c.name), None)
         self.runtime.kill_pod(uid)
+        self._net_plugin().teardown_pod(uid)
 
     def _housekeeping(self, now: float):
         # clean up runtime state for pods that vanished from the

@@ -1267,6 +1267,18 @@ class APIServer:
         if plural == "customresourcedefinitions":
             # validate BEFORE touching the registry or the store: a
             # rejected rename must leave the old kind fully served
+            if obj.spec.validation is not None:
+                # schema structural checks hold on UPDATE too — a
+                # replace must not smuggle in the broken pattern that
+                # create would have 422'd
+                from ..api.crdschema import schema_errors
+
+                serrs = schema_errors(
+                    obj.spec.validation.open_api_v3_schema)
+                if serrs:
+                    raise APIError(
+                        422, "Invalid",
+                        "; ".join(f"{p}: {m}" for p, m in serrs))
             msg = scheme.crd_conflict(obj, replacing=old.spec.names.kind)
             if msg is not None:
                 raise APIError(409, "Conflict", msg)

@@ -514,3 +514,156 @@ class TestStaleStateReconcile:
         kl2.sync_once(3.0)
         assert kl2.runtime.get("u-b", "c").env[
             "TPU_VISIBLE_DEVICES"] == "tpu0"
+
+
+class TestNetworkPlugin:
+    def test_host_local_ipam_from_pod_cidr(self):
+        from kubernetes_tpu.kubelet.network import HostLocalIPAM
+        # /29: 8 addresses minus network/gateway/broadcast = 5 usable
+        ipam = HostLocalIPAM("10.244.1.0/29")
+        a = ipam.setup_pod("u-a")
+        b = ipam.setup_pod("u-b")
+        assert a == "10.244.1.2" and b == "10.244.1.3"
+        assert ipam.setup_pod("u-a") == a  # idempotent
+        ipam.teardown_pod("u-a")
+        assert ipam.setup_pod("u-c") == "10.244.1.2"  # freed, reused
+        # fill the remaining 3; the broadcast .7 is never handed out
+        got = {ipam.setup_pod(f"u-x{i}") for i in range(3)}
+        assert got == {"10.244.1.4", "10.244.1.5", "10.244.1.6"}
+        try:
+            ipam.setup_pod("u-overflow")
+            assert False
+        except RuntimeError:
+            pass
+
+    def test_pod_ip_flows_to_status_and_endpoints(self):
+        from kubernetes_tpu.controllers.endpoints import EndpointsController
+        store = ObjectStore()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        node = store.get("nodes", "default", "n1")
+        node.spec.pod_cidr = "10.244.7.0/24"  # nodeipam's assignment
+        store.update("nodes", node)
+        ep_ctrl = EndpointsController(store)
+        store.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc"),
+            spec=api.ServiceSpec(selector={"app": "w"},
+                                 ports=[api.ServicePort(port=80)])))
+        pod = mkpod("a", "u-a")
+        pod.spec.node_name = "n1"
+        pod.metadata.labels = {"app": "w"}
+        store.create("pods", pod)
+        kl.sync_once(1.0)
+        kl.sync_once(2.0)
+        got = store.get("pods", "default", "a")
+        assert got.status.pod_ip == "10.244.7.2"
+        ep_ctrl.sync_all()
+        ep = store.get("endpoints", "default", "svc")
+        addrs = [a.ip for ss in ep.subsets for a in ss.addresses]
+        assert addrs == ["10.244.7.2"]
+        # teardown releases the address for the next pod
+        store.delete("pods", "default", "a")
+        kl.sync_once(3.0)
+        p2 = mkpod("b", "u-b")
+        p2.spec.node_name = "n1"
+        store.create("pods", p2)
+        kl.sync_once(4.0)
+        assert store.get("pods", "default",
+                         "b").status.pod_ip == "10.244.7.2"
+
+
+class TestProbeHandlers:
+    def test_exec_liveness_probe_kills_on_failure(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        pod = mkpod("a", "u-a")
+        pod.spec.node_name = "n1"
+        pod.spec.restart_policy = "Never"
+        pod.spec.containers[0].liveness_probe = api.Probe(
+            period_seconds=1.0, failure_threshold=2,
+            exec_command=["cat", "/healthy"])
+        store.create("pods", pod)
+        kl.sync_once(1.0)
+        st = kl.runtime.get("u-a", "c")
+        assert st.state == RUNNING
+        # make the probe pass: the file exists
+        st.files["/healthy"] = "ok"
+        kl.sync_once(2.5)
+        assert kl.runtime.get("u-a", "c").state == RUNNING
+        # probe target vanishes: two failures -> liveness kill
+        del st.files["/healthy"]
+        kl.sync_once(4.0)
+        kl.sync_once(5.5)
+        assert kl.runtime.get("u-a", "c").state == EXITED
+
+    def test_tcp_readiness_probe_gates_ready(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        pod = mkpod("a", "u-a")
+        pod.spec.node_name = "n1"
+        pod.spec.containers[0].readiness_probe = api.Probe(
+            tcp_port=8080, period_seconds=0.5, failure_threshold=2)
+        store.create("pods", pod)
+        kl.sync_once(1.0)
+        kl.sync_once(2.0)
+        got = store.get("pods", "default", "a")
+        assert any(c == ("Ready", "False") for c in got.status.conditions)
+        # the pod starts listening: readiness flips
+        kl.runtime.register_pod_server("u-a", 8080, "127.0.0.1", 9999)
+        kl.sync_once(3.0)
+        got = store.get("pods", "default", "a")
+        assert any(c == ("Ready", "True") for c in got.status.conditions)
+        # one transient failure does NOT yank readiness
+        # (failure_threshold=2 demands consecutive failures)
+        kl.runtime._pod_servers.clear()
+        kl.sync_once(4.0)
+        got = store.get("pods", "default", "a")
+        assert any(c == ("Ready", "True") for c in got.status.conditions)
+        kl.sync_once(5.0)  # second consecutive failure: now not ready
+        got = store.get("pods", "default", "a")
+        assert any(c == ("Ready", "False") for c in got.status.conditions)
+
+
+class TestCriticalPodPreemption:
+    def test_critical_pod_evicts_lower_priority(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1",
+                     allocatable=api.resource_list(cpu="2", memory="4Gi",
+                                                   pods=10),
+                     heartbeat_period=0.0)
+        filler = mkpod("filler", "u-f", cpu_req="1500m")
+        filler.spec.node_name = "n1"
+        filler.spec.priority = 100
+        store.create("pods", filler)
+        kl.sync_once(1.0)
+        assert kl.runtime.get("u-f", "c").state == RUNNING
+        # a critical pod arrives that cannot fit alongside the filler
+        crit = mkpod("crit", "u-c", cpu_req="1")
+        crit.spec.node_name = "n1"
+        crit.spec.priority = 2_000_001_000  # system-node-critical
+        store.create("pods", crit)
+        kl.sync_once(2.0)  # evicts the filler (WaitingForPreemption)
+        kl.sync_once(3.0)  # admits + starts the critical pod
+        assert store.get("pods", "default",
+                         "filler").status.phase == "Failed"
+        assert kl.runtime.get("u-c", "c").state == RUNNING
+
+    def test_non_critical_pod_never_preempts(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1",
+                     allocatable=api.resource_list(cpu="2", memory="4Gi",
+                                                   pods=10),
+                     heartbeat_period=0.0)
+        filler = mkpod("filler", "u-f", cpu_req="1500m")
+        filler.spec.node_name = "n1"
+        store.create("pods", filler)
+        kl.sync_once(1.0)
+        plain = mkpod("plain", "u-p", cpu_req="1")
+        plain.spec.node_name = "n1"
+        plain.spec.priority = 1000  # high but not critical
+        store.create("pods", plain)
+        kl.sync_once(2.0)
+        kl.sync_once(3.0)
+        assert store.get("pods", "default",
+                         "filler").status.phase == "Running"
+        assert store.get("pods", "default",
+                         "plain").status.phase == "Failed"
