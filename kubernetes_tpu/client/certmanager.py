@@ -1,0 +1,159 @@
+"""Rotating client certificates.
+
+Reference: client-go util/certificate/certificate_manager.go (used by
+the kubelet through pkg/kubelet/certificate/kubelet.go): the manager
+owns the current key+cert, computes a rotation deadline inside the
+cert's validity window, and — once past it — generates a fresh key,
+submits a CSR under the CURRENT credential, waits for the signed cert,
+and atomically swaps. A kubelet that never rotated would fall off the
+cluster the moment its bootstrap cert expired.
+"""
+
+from __future__ import annotations
+
+import datetime
+import secrets
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class CertificateManager:
+    """Owns a client identity and rotates it through the cluster's CSR
+    flow. `submit` is the transport seam: (csr_name, csr_pem,
+    current_identity) -> signed cert PEM (blocking until the
+    approver+signer controllers act), over REST or an in-process
+    store."""
+
+    def __init__(self, common_name: str,
+                 organizations: Tuple[str, ...],
+                 key_pem: str, cert_pem: str,
+                 submit: Callable[[str, str, Tuple[str, str]], str],
+                 rotation_fraction: float = 0.8,
+                 clock: Callable[[], float] = time.time):
+        self.common_name = common_name
+        self.organizations = tuple(organizations)
+        self._key_pem = key_pem
+        self._cert_pem = cert_pem
+        self._submit = submit
+        self.rotation_fraction = rotation_fraction
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._on_rotate: List[Callable[[str, str], None]] = []
+        self.rotations = 0
+        self._rotating = threading.Event()
+
+    # -- identity --------------------------------------------------------------
+
+    def current(self) -> Tuple[str, str]:
+        with self._lock:
+            return self._key_pem, self._cert_pem
+
+    def on_rotate(self, fn: Callable[[str, str], None]):
+        """Register a (key_pem, cert_pem) callback — consumers rebuild
+        their TLS contexts here (the reference's connection-dropping
+        CertCallback analog)."""
+        self._on_rotate.append(fn)
+
+    # -- rotation decision (certificate_manager.go nextRotationDeadline) -------
+
+    def _validity(self) -> Tuple[float, float]:
+        from cryptography import x509
+
+        cert = x509.load_pem_x509_certificate(self._cert_pem.encode())
+        nb = cert.not_valid_before_utc.timestamp()
+        na = cert.not_valid_after_utc.timestamp()
+        return nb, na
+
+    def rotation_deadline(self) -> float:
+        """notBefore + fraction * lifetime — past this point every
+        maybe_rotate attempts renewal."""
+        nb, na = self._validity()
+        return nb + self.rotation_fraction * (na - nb)
+
+    def should_rotate(self, now: Optional[float] = None) -> bool:
+        now = self.clock() if now is None else now
+        return now >= self.rotation_deadline()
+
+    # -- the rotation ----------------------------------------------------------
+
+    def maybe_rotate(self, now: Optional[float] = None) -> bool:
+        """Rotate when due. Returns True when a NEW cert was installed;
+        a failed submission leaves the current identity untouched (the
+        manager retries on the next call, like the reference's
+        wait/retry loop)."""
+        if not self.should_rotate(now):
+            return False
+        from ..server import pki
+
+        new_key, csr_pem = pki.make_csr(self.common_name,
+                                        self.organizations)
+        csr_name = (f"{self.common_name.replace(':', '-')}"
+                    f"-rotate-{secrets.token_hex(4)}")
+        try:
+            new_cert = self._submit(csr_name, csr_pem, self.current())
+        except Exception:
+            return False
+        if not new_cert:
+            return False
+        with self._lock:
+            self._key_pem, self._cert_pem = new_key, new_cert
+            self.rotations += 1
+        for fn in list(self._on_rotate):
+            fn(new_key, new_cert)
+        return True
+
+    def rotate_in_background(self, now: Optional[float] = None) -> bool:
+        """Heartbeat-safe entry point: when rotation is due, run it on
+        a daemon thread so a slow approver/signer can never stall the
+        node heartbeat into NotReady (the reference rotates in its own
+        goroutine). At most one rotation attempt runs at a time.
+        Returns True when an attempt was started."""
+        if not self.should_rotate(now) or self._rotating.is_set():
+            return False
+        self._rotating.set()
+
+        def attempt():
+            try:
+                self.maybe_rotate(now)
+            finally:
+                self._rotating.clear()
+
+        threading.Thread(target=attempt, daemon=True,
+                         name="cert-rotation").start()
+        return True
+
+
+def rest_submitter(url: str, ca_cert_pem: str, timeout: float = 15.0):
+    """The REST transport for CertificateManager.submit: create the CSR
+    under the CURRENT mTLS identity (a live kubelet renews with its own
+    cert — no bootstrap token needed, pkg/kubelet/certificate) and poll
+    for the signed certificate."""
+    from .rest import RESTClient
+    from ..api import types as api
+
+    def submit(csr_name: str, csr_pem: str,
+               identity: Tuple[str, str]) -> str:
+        key_pem, cert_pem = identity
+        client = RESTClient(url, client_cert_pem=cert_pem,
+                            client_key_pem=key_pem,
+                            ca_cert_pem=ca_cert_pem)
+        client.create("certificatesigningrequests",
+                      api.CertificateSigningRequest(
+                          metadata=api.ObjectMeta(name=csr_name,
+                                                  namespace=""),
+                          spec=api.CertificateSigningRequestSpec(
+                              request=csr_pem,
+                              usages=["digital signature",
+                                      "key encipherment",
+                                      "client auth"])))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = client.get("certificatesigningrequests", "", csr_name)
+            if got.status.certificate:
+                return got.status.certificate
+            time.sleep(0.05)
+        raise TimeoutError(f"CSR {csr_name} was not signed "
+                           f"within {timeout}s")
+
+    return submit

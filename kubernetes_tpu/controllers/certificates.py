@@ -23,12 +23,23 @@ KUBELET_USAGES = {"digital signature", "key encipherment", "client auth"}
 
 def is_self_node_csr(csr: api.CertificateSigningRequest) -> bool:
     """approver/sarapprove.go isSelfNodeClientCert: requested by a node
-    for its own identity, with exactly the kubelet client usages."""
+    for ITS OWN identity, with exactly the kubelet client usages. The
+    CSR subject must name the requestor (x509cr.Subject.CommonName ==
+    csr.Spec.Username) — without that check any node could mint another
+    node's certificate through auto-approval."""
     if not csr.spec.username.startswith("system:node:"):
         return False
     if "system:nodes" not in csr.spec.groups:
         return False
-    return set(csr.spec.usages) == KUBELET_USAGES
+    if set(csr.spec.usages) != KUBELET_USAGES:
+        return False
+    subj = _pem_subject(csr.spec.request)
+    if subj is None:
+        # legacy opaque (non-PEM) payloads carry no subject to verify;
+        # their digest-token certs never impersonate an x509 identity
+        return True
+    cn, orgs = subj
+    return cn == csr.spec.username and orgs == ["system:nodes"]
 
 
 def _pem_subject(csr_pem: str):

@@ -70,7 +70,8 @@ class Kubelet:
                  async_workers: bool = False,
                  manifest_dir: Optional[str] = None,
                  checkpoint_dir: Optional[str] = None,
-                 network_plugin=None):
+                 network_plugin=None,
+                 cert_manager=None):
         """resync_interval=0 fully resyncs every pod each iteration (the
         deterministic test mode); >0 switches to event-driven syncs —
         only pods with config changes or PLEG events sync between full
@@ -130,6 +131,9 @@ class Kubelet:
         # first use from the node's podCIDR (host-local IPAM once the
         # nodeipam controller assigned one, uid-hash addressing before)
         self.network_plugin = network_plugin
+        # rotating client identity (client/certmanager.py): checked on
+        # the heartbeat cadence like pkg/kubelet/certificate
+        self.cert_manager = cert_manager
         self.checkpoints = None
         self._last_checkpoint: Dict[str, dict] = {}
         if checkpoint_dir:
@@ -226,6 +230,10 @@ class Kubelet:
         """Update node status: heartbeat annotation + Ready (+ pressure)
         conditions (tryUpdateNodeStatus)."""
         now = now if now is not None else self.clock()
+        if self.cert_manager is not None:
+            # background: a slow signer must never stall the heartbeat
+            # into NotReady
+            self.cert_manager.rotate_in_background(now)
         node = self._get_node()
         if node is None:
             self.register_node()
@@ -944,10 +952,10 @@ class Kubelet:
             self._known_pod_rvs.pop(uid, None)
             self._needs_retry.discard(uid)
             self.pod_workers.forget(uid)
-            # crash-backoff state dies with the pod (fresh uids from
-            # churn would otherwise grow these maps without bound)
+            # crash-backoff + probe state dies with the pod (fresh uids
+            # from churn would otherwise grow these maps without bound)
             for d in (self._crash_backoff, self._crash_backoff_until,
-                      self._last_container_start):
+                      self._last_container_start, self._probe_state):
                 for key in [k for k in d if k[0] == uid]:
                     d.pop(key, None)
             # volume manager: drop desired state; the next reconcile

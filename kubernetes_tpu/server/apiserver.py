@@ -778,6 +778,20 @@ class APIServer:
 
         return scaleapi.crd_for_kind(self.store, kind)
 
+    @staticmethod
+    def _check_crd_schema(crd):
+        """Structural 422 for a CRD's openAPIV3Schema — one gate for
+        create AND update (a replace must not smuggle in the broken
+        pattern create would have refused)."""
+        if crd.spec.validation is None:
+            return
+        from ..api.crdschema import schema_errors
+
+        serrs = schema_errors(crd.spec.validation.open_api_v3_schema)
+        if serrs:
+            raise APIError(422, "Invalid",
+                           "; ".join(f"{p}: {m}" for p, m in serrs))
+
     def _validate_custom(self, obj, crd):
         """CustomResourceValidation enforcement: the whole wire object
         is checked against the CRD's openAPIV3Schema; failures are
@@ -1087,15 +1101,7 @@ class APIServer:
             msg = scheme.crd_conflict(obj)
             if msg is not None:
                 raise APIError(409, "AlreadyExists", msg)
-            if obj.spec.validation is not None:
-                from ..api.crdschema import schema_errors
-
-                serrs = schema_errors(
-                    obj.spec.validation.open_api_v3_schema)
-                if serrs:
-                    raise APIError(
-                        422, "Invalid",
-                        "; ".join(f"{p}: {m}" for p, m in serrs))
+            self._check_crd_schema(obj)
         try:
             self.store.create(plural, obj)
         except Conflict as e:
@@ -1228,6 +1234,13 @@ class APIServer:
         obj.metadata.namespace = old.metadata.namespace
         obj.metadata.name = old.metadata.name
         obj.metadata.uid = old.metadata.uid
+        if plural == "certificatesigningrequests":
+            # the requestor identity is SERVER-owned on update too
+            # (strategy PrepareForUpdate copies it) — rewriting
+            # spec.username would otherwise re-aim the self-node
+            # approval check at someone else's identity
+            obj.spec.username = old.spec.username
+            obj.spec.groups = list(old.spec.groups)
         try:
             self.admission.admit("update", plural, obj, old, user, self.store)
         except AdmissionError as e:
@@ -1267,18 +1280,7 @@ class APIServer:
         if plural == "customresourcedefinitions":
             # validate BEFORE touching the registry or the store: a
             # rejected rename must leave the old kind fully served
-            if obj.spec.validation is not None:
-                # schema structural checks hold on UPDATE too — a
-                # replace must not smuggle in the broken pattern that
-                # create would have 422'd
-                from ..api.crdschema import schema_errors
-
-                serrs = schema_errors(
-                    obj.spec.validation.open_api_v3_schema)
-                if serrs:
-                    raise APIError(
-                        422, "Invalid",
-                        "; ".join(f"{p}: {m}" for p, m in serrs))
+            self._check_crd_schema(obj)
             msg = scheme.crd_conflict(obj, replacing=old.spec.names.kind)
             if msg is not None:
                 raise APIError(409, "Conflict", msg)

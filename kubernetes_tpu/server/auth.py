@@ -188,7 +188,10 @@ NODE_READ_RESOURCES = frozenset({
 # here, so the fence is: named gets only (no list/watch sweeps), and
 # never in kube-system, whose Secrets hold the cluster CA + SA signing
 # keys (a kubelet reading those would be a cluster-admin escalation)
-NODE_GET_ONLY_RESOURCES = frozenset({"secrets", "configmaps"})
+NODE_GET_ONLY_RESOURCES = frozenset({
+    "secrets", "configmaps",
+    # named-get for polling its own rotation CSR's signed certificate
+    "certificatesigningrequests"})
 # writes are whitelisted as EXACT (resource, subresource) attributes —
 # the reference node authorizer never grants pods/exec, pods/attach,
 # pods/portforward, pods/log or any proxy subresource to node
@@ -218,6 +221,12 @@ def _node_authorize(user: UserInfo, verb: str, resource: str,
             return (verb == "get" and name is not None
                     and namespace != "kube-system")
         return False
+    if resource == "certificatesigningrequests":
+        # certificate rotation (selfnodeclient ClusterRole): CREATE
+        # only — update/patch would let a node write its own Approved
+        # condition and self-sign arbitrary identities (the approval
+        # decision belongs to the approver controller alone)
+        return verb == "create"
     return resource in NODE_WRITE_RESOURCES
 
 

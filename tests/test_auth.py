@@ -8,6 +8,8 @@ Reference behaviors covered: x509 CommonNameUserConversion
 NodeRestriction, CSR signer issuing real certs
 (pkg/controller/certificates/signer/)."""
 
+import time
+
 import pytest
 
 from kubernetes_tpu.api import types as api
@@ -391,5 +393,129 @@ class TestKubeadmSecureJoin:
                 boot.create("nodes", api.Node(
                     metadata=api.ObjectMeta(name="n3", namespace="")))
             assert ei.value.code == 403
+        finally:
+            cluster.stop()
+
+
+class TestCertRotation:
+    def test_kubelet_rotates_before_expiry(self):
+        """client-go util/certificate analog: past the rotation
+        deadline the manager submits a fresh CSR under its CURRENT
+        identity, the approver+signer issue a new cert, and the swapped
+        credential keeps working over mTLS."""
+        from kubernetes_tpu.cli.kubeadm import Cluster, join_with_csr
+        from kubernetes_tpu.client.certmanager import (CertificateManager,
+                                                       rest_submitter)
+
+        cluster = Cluster(secure=True)
+        cluster.store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default"),
+            status=api.NamespaceStatus(phase="Active")))
+        cluster.start()
+        try:
+            key, cert, ca_pem = join_with_csr(cluster.url, "n1",
+                                              cluster.bootstrap_token)
+            now = [time.time()]
+            mgr = CertificateManager(
+                "system:node:n1", ("system:nodes",), key, cert,
+                submit=rest_submitter(cluster.url, ca_pem),
+                clock=lambda: now[0])
+            swapped = []
+            mgr.on_rotate(lambda k, c: swapped.append(c))
+            # inside the validity window: no rotation
+            assert mgr.maybe_rotate() is False
+            assert mgr.rotations == 0
+            # jump past 80% of the cert's lifetime
+            now[0] = mgr.rotation_deadline() + 1
+            assert mgr.maybe_rotate() is True
+            assert mgr.rotations == 1 and len(swapped) == 1
+            new_key, new_cert = mgr.current()
+            assert new_cert != cert and new_key != key
+            # the ROTATED identity authenticates and still passes
+            # NodeRestriction as system:node:n1
+            kubelet = RESTClient(cluster.url, client_cert_pem=new_cert,
+                                 client_key_pem=new_key,
+                                 ca_cert_pem=ca_pem)
+            kubelet.create("nodes", api.Node(
+                metadata=api.ObjectMeta(name="n1", namespace="")))
+            assert kubelet.get("nodes", "", "n1").metadata.name == "n1"
+        finally:
+            cluster.stop()
+
+    def test_node_cannot_mint_another_nodes_cert(self):
+        """sarapprove isSelfNodeClientCert: the CSR subject must name
+        the REQUESTOR — n1 asking for system:node:n2 is never
+        auto-approved."""
+        from kubernetes_tpu.cli.kubeadm import Cluster, join_with_csr
+        from kubernetes_tpu.server import pki
+
+        cluster = Cluster(secure=True)
+        cluster.store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default"),
+            status=api.NamespaceStatus(phase="Active")))
+        cluster.start()
+        try:
+            key, cert, ca_pem = join_with_csr(cluster.url, "n1",
+                                              cluster.bootstrap_token)
+            n1 = RESTClient(cluster.url, client_cert_pem=cert,
+                            client_key_pem=key, ca_cert_pem=ca_pem)
+            _key2, csr_pem = pki.make_csr("system:node:n2",
+                                          ("system:nodes",))
+            n1.create("certificatesigningrequests",
+                      api.CertificateSigningRequest(
+                          metadata=api.ObjectMeta(name="evil-csr",
+                                                  namespace=""),
+                          spec=api.CertificateSigningRequestSpec(
+                              request=csr_pem,
+                              usages=["digital signature",
+                                      "key encipherment",
+                                      "client auth"])))
+            deadline = time.time() + 2.0
+            while time.time() < deadline:
+                got = n1.get("certificatesigningrequests", "", "evil-csr")
+                assert not got.status.certificate, \
+                    "impersonation CSR was signed!"
+                assert not got.approved
+                time.sleep(0.1)
+        finally:
+            cluster.stop()
+
+    def test_node_cannot_self_approve_csr(self):
+        """The rotation grant is CREATE-only: a node writing its own
+        Approved condition (or rewriting spec.username) must be 403'd —
+        update rights on CSRs would let any kubelet mint arbitrary
+        identities through the signer."""
+        from kubernetes_tpu.cli.kubeadm import Cluster, join_with_csr
+        from kubernetes_tpu.server import pki
+
+        cluster = Cluster(secure=True)
+        cluster.store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default"),
+            status=api.NamespaceStatus(phase="Active")))
+        cluster.start()
+        try:
+            key, cert, ca_pem = join_with_csr(cluster.url, "n1",
+                                              cluster.bootstrap_token)
+            n1 = RESTClient(cluster.url, client_cert_pem=cert,
+                            client_key_pem=key, ca_cert_pem=ca_pem)
+            _k, csr_pem = pki.make_csr("admin", ("system:masters",))
+            n1.create("certificatesigningrequests",
+                      api.CertificateSigningRequest(
+                          metadata=api.ObjectMeta(name="esc-csr",
+                                                  namespace=""),
+                          spec=api.CertificateSigningRequestSpec(
+                              request=csr_pem,
+                              usages=["digital signature",
+                                      "key encipherment",
+                                      "client auth"])))
+            got = n1.get("certificatesigningrequests", "", "esc-csr")
+            got.status.conditions = [("Approved", "self-approved!")]
+            with pytest.raises(APIStatusError) as ei:
+                n1.update("certificatesigningrequests", got)
+            assert ei.value.code == 403
+            # and the approver never signs a masters subject
+            time.sleep(0.5)
+            got = n1.get("certificatesigningrequests", "", "esc-csr")
+            assert not got.approved and not got.status.certificate
         finally:
             cluster.stop()
