@@ -36,6 +36,9 @@ class ObjectMeta:
     # controllers echo it into status.observedGeneration
     generation: int = 0
     deletion_timestamp: Optional[float] = None
+    # seconds the kubelet has to stop containers once deletionTimestamp
+    # is set (apimachinery ObjectMeta.DeletionGracePeriodSeconds)
+    deletion_grace_period_seconds: Optional[int] = None
     # deletion gates (apimachinery ObjectMeta.Finalizers): a DELETE with
     # finalizers present only marks deletion_timestamp; the object goes
     # away when the last finalizer is removed (apiserver delete/update
@@ -293,6 +296,9 @@ class PodSpec:
     priority_class_name: str = ""
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
+    # graceful-termination budget (core/v1 default 30s); used when a
+    # DELETE asks for the spec default (gracePeriodSeconds=-1)
+    termination_grace_period_seconds: int = 30
     service_account_name: str = ""
     host_network: bool = False  # host-namespace flag (exec-deny, PSP)
     # pod-level wall-clock bound enforced by the kubelet

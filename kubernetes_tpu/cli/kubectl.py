@@ -372,6 +372,8 @@ def cmd_logs(client, args, out):
         q.append(f"container={args.container}")
     if args.tail is not None:
         q.append(f"tailLines={args.tail}")
+    if getattr(args, "previous", False):
+        q.append("previous=true")
     path = client._path("pods", args.namespace, args.name, "log")
     raw, _ = client.request_bytes("GET", path, query="&".join(q))
     out.write(raw.decode())
@@ -1242,8 +1244,16 @@ def _apply_prune(client, args, applied: set, out):
 
 def cmd_delete(client, args, out):
     plural = _resolve_kind(args.kind)
+    # delete.go grace handling: --now = 1s, --force = 0 (immediate),
+    # --grace-period=N explicit; sent only when the user asked
+    grace = getattr(args, "grace_period", None)
+    if getattr(args, "force", False):
+        grace = 0
+    elif getattr(args, "now", False):
+        grace = 1
     if args.name:
-        client.delete(plural, args.namespace, args.name)
+        client.delete(plural, args.namespace, args.name,
+                      grace_period_seconds=grace)
         out.write(f"{plural}/{args.name} deleted\n")
         return
     sel, fsel = _parse_selector_flags(args)
@@ -1253,7 +1263,7 @@ def cmd_delete(client, args, out):
                           field_selector=fsel)
     for o in objs:
         client.delete(plural, o.metadata.namespace or args.namespace,
-                      o.metadata.name)
+                      o.metadata.name, grace_period_seconds=grace)
         out.write(f"{plural}/{o.metadata.name} deleted\n")
 
 
@@ -2465,6 +2475,10 @@ def build_parser() -> argparse.ArgumentParser:
     dl.add_argument("name", nargs="?")
     dl.add_argument("--selector", "-l", default=None)
     dl.add_argument("--field-selector", default=None)
+    dl.add_argument("--grace-period", type=int, default=None,
+                    dest="grace_period")
+    dl.add_argument("--force", action="store_true")
+    dl.add_argument("--now", action="store_true")
 
     sc = sub.add_parser("scale")
     sc.add_argument("kind")
@@ -2505,6 +2519,7 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--follow-rounds", type=int, default=1,
                     help="long-poll rounds to follow (SPDY stream analog)")
     lg.add_argument("--wait", type=float, default=2.0)
+    lg.add_argument("--previous", "-p", action="store_true")
 
     ec = sub.add_parser("exec")
     ec.add_argument("name")

@@ -41,6 +41,10 @@ class CertificateManager:
         self._lock = threading.Lock()
         self._on_rotate: List[Callable[[str, str], None]] = []
         self.rotations = 0
+        # failure observability: a signer outage must be visible BEFORE
+        # the cert expires and the kubelet falls off the cluster
+        self.failed_rotations = 0
+        self.last_error: Optional[str] = None
         self._rotating = threading.Event()
 
     # -- identity --------------------------------------------------------------
@@ -92,13 +96,22 @@ class CertificateManager:
                     f"-rotate-{secrets.token_hex(4)}")
         try:
             new_cert = self._submit(csr_name, csr_pem, self.current())
-        except Exception:
+        except Exception as e:
+            self.failed_rotations += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            import logging
+            logging.getLogger(__name__).warning(
+                "certificate rotation for %s failed (attempt %d): %s",
+                self.common_name, self.failed_rotations, self.last_error)
             return False
         if not new_cert:
+            self.failed_rotations += 1
+            self.last_error = "signer returned no certificate"
             return False
         with self._lock:
             self._key_pem, self._cert_pem = new_key, new_cert
             self.rotations += 1
+            self.last_error = None
         for fn in list(self._on_rotate):
             fn(new_key, new_cert)
         return True

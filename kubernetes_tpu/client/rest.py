@@ -252,8 +252,12 @@ class RESTClient:
                             body=patch)
         return scheme.decode(scheme.kind_for_plural(plural), data)
 
-    def delete(self, plural: str, namespace: Optional[str], name: str):
-        self.request("DELETE", self._path(plural, namespace, name))
+    def delete(self, plural: str, namespace: Optional[str], name: str,
+               grace_period_seconds: Optional[int] = None):
+        q = (f"gracePeriodSeconds={grace_period_seconds}"
+             if grace_period_seconds is not None else "")
+        self.request("DELETE", self._path(plural, namespace, name),
+                     query=q)
 
     def delete_collection(self, plural: str,
                           namespace: Optional[str] = None,

@@ -508,9 +508,27 @@ class Kubelet:
     def _sync_pod(self, pod: api.Pod, now: float, active: List[api.Pod]):
         """syncPod (kubelet.go:1389): admit, start containers, compute
         phase/readiness from runtime state, apply restart policy."""
+        uid = pod.metadata.uid
+        # the terminating branch runs BEFORE the terminal-phase return:
+        # a marked pod that turned Failed (eviction, deadline) must
+        # still be reaped or the delete never completes
+        if pod.metadata.deletion_timestamp is not None and \
+                not self._is_static(pod):
+            # graceful termination (kubelet.go syncPod's terminating
+            # branch): preStop hooks run, containers stop, then the
+            # kubelet confirms by removing the API object (the
+            # status-manager force-delete). Finalizer-bearing pods are
+            # left to the finalizer machinery.
+            self._kill_pod_with_hooks(uid, pod)
+            if not pod.metadata.finalizers:
+                try:
+                    self.store.delete("pods", pod.metadata.namespace,
+                                      pod.metadata.name)
+                except KeyError:
+                    pass
+            return
         if pod.status.phase in ("Succeeded", "Failed"):
             return
-        uid = pod.metadata.uid
         self._needs_retry.discard(uid)
         if uid not in self._pod_start:
             ok, reason = self._admit(pod, active)

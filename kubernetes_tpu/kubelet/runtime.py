@@ -28,6 +28,10 @@ class ContainerState:
     healthy: bool = True  # liveness handler result
     ready: bool = True    # readiness handler result
     logs: List[str] = field(default_factory=list)  # stdout/stderr record
+    # the PREVIOUS instance's log stream, snapshotted at restart —
+    # what `kubectl logs --previous` reads (kuberuntime keeps the
+    # last terminated container's logs)
+    previous_logs: List[str] = field(default_factory=list)
     # the container's "filesystem" and environment — what exec/cp
     # actually operate on (path -> contents)
     files: Dict[str, str] = field(default_factory=dict)
@@ -81,6 +85,11 @@ class FakeRuntime:
             if image:
                 st.image = image
             if st.state != RUNNING:
+                if st.state == EXITED:
+                    # restart: the dead instance's stream becomes the
+                    # --previous view; the new instance starts fresh
+                    st.previous_logs = list(st.logs)
+                    st.logs = []
                 if run_to_completion:
                     self._pending_exit[key] = list(command or [])
                 if self.start_latency > 0:
@@ -154,14 +163,17 @@ class FakeRuntime:
                 st.logs.append(line)
 
     def container_logs(self, pod_uid: str, name: str,
-                       tail: Optional[int] = None) -> Optional[List[str]]:
+                       tail: Optional[int] = None,
+                       previous: bool = False) -> Optional[List[str]]:
         """The runtime's log records (CRI ContainerLog / docker logs
-        analog); None if the container does not exist."""
+        analog); None if the container does not exist. previous=True
+        reads the last terminated instance's stream (`kubectl logs
+        --previous`)."""
         with self._lock:
             st = self.containers.get((pod_uid, name))
             if st is None:
                 return None
-            lines = list(st.logs)
+            lines = list(st.previous_logs if previous else st.logs)
         if tail is None or tail < 0:
             return lines
         # explicit slice end: lines[-0:] would be the WHOLE list

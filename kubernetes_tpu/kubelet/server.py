@@ -153,8 +153,10 @@ class KubeletServer:
                 except ValueError:
                     return h._send(400, b"tailLines must be an integer",
                                    "text/plain")
+            previous = query.get("previous", ["false"])[0] == "true"
             lines = self.kubelet.runtime.container_logs(
-                self._runtime_uid(pod), container, tail=tail)
+                self._runtime_uid(pod), container, tail=tail,
+                previous=previous)
             if lines is None:
                 return h._send(404, f"container {container!r} not found"
                                .encode(), "text/plain")

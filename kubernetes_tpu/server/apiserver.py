@@ -523,7 +523,8 @@ class APIServer:
             return self._serve_update(h, plural, namespace, name, sub, user,
                                       patch=(verb == "patch"), gv=gv)
         if verb == "delete":
-            return self._serve_delete(h, plural, namespace, name, user)
+            return self._serve_delete(h, plural, namespace, name, user,
+                                      query=query)
         if verb == "deletecollection":
             return self._serve_delete_collection(h, plural, namespace,
                                                  query, user)
@@ -600,8 +601,13 @@ class APIServer:
         path = (f"/containerLogs/{quote(pod.metadata.namespace, safe='')}/"
                 f"{quote(pod.metadata.name, safe='')}/"
                 f"{quote(container, safe='')}")
+        params = []
         if tail:
-            path += f"?tailLines={tail}"
+            params.append(f"tailLines={tail}")
+        if query.get("previous", ["false"])[0] == "true":
+            params.append("previous=true")
+        if params:
+            path += "?" + "&".join(params)
         return self._kubelet_proxy(h, "GET", host, port, path)
 
     def _serve_pod_exec(self, h, namespace, name):
@@ -1320,7 +1326,7 @@ class APIServer:
                 scheme.register_dynamic(obj, replacing=old.spec.names.kind)
         h._send(200, json.dumps(scheme.encode_object(obj, version=gv)).encode())
 
-    def _serve_delete(self, h, plural, namespace, name, user):
+    def _serve_delete(self, h, plural, namespace, name, user, query=None):
         obj = self._find(plural, namespace, name)
         if obj is None:
             raise APIError(404, "NotFound", f"{plural} {name!r} not found")
@@ -1331,6 +1337,44 @@ class APIServer:
             raise APIError(code,
                            "TooManyRequests" if code == 429 else "Forbidden",
                            str(e))
+        # graceful pod deletion (registry/core/pod/strategy.go
+        # CheckGracefulDelete + store.go updateForGracefulDeletion):
+        # an EXPLICIT ?gracePeriodSeconds on a running, node-bound pod
+        # only MARKS the object; the pod's kubelet runs preStop/stops
+        # containers and then force-deletes. -1 asks for the spec's
+        # terminationGracePeriodSeconds; 0 is an immediate force delete.
+        # (Divergence, documented: with no query at all the delete is
+        # immediate — the in-process controllers and tests drive the
+        # store directly and never wait on a kubelet.)
+        raw = (query or {}).get("gracePeriodSeconds", [None])[0]
+        if raw is not None and plural == "pods":
+            try:
+                grace = int(raw)
+            except ValueError:
+                raise APIError(400, "BadRequest",
+                               f"invalid gracePeriodSeconds {raw!r}")
+            if grace == -1:
+                grace = obj.spec.termination_grace_period_seconds
+            elif grace < 0:
+                # only -1 is a sentinel; any other negative is a typo
+                # that must NOT silently force-delete
+                raise APIError(422, "Invalid",
+                               f"gracePeriodSeconds must be >= 0 "
+                               f"(or -1 for the spec default), "
+                               f"got {grace}")
+            is_mirror = "kubernetes.io/config.mirror" in (
+                obj.metadata.annotations or {})
+            if grace > 0 and obj.spec.node_name and not is_mirror and \
+                    obj.status.phase in ("", "Pending", "Running"):
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = time.time()
+                obj.metadata.deletion_grace_period_seconds = grace
+                self.store.update(plural, obj)
+                h._send(200, _status_body(
+                    200, "Success",
+                    f"{name} marked for graceful deletion "
+                    f"(grace {grace}s)", status="Success"))
+                return
         self._delete_or_mark(plural, obj)
         h._send(200, _status_body(200, "Success", f"{name} deleted",
                                   status="Success"))

@@ -667,3 +667,143 @@ class TestCriticalPodPreemption:
                          "filler").status.phase == "Running"
         assert store.get("pods", "default",
                          "plain").status.phase == "Failed"
+
+
+class TestGracefulDeletion:
+    def _world(self):
+        from kubernetes_tpu.server import APIServer, AdmissionChain
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        return store, srv, kl
+
+    def test_graceful_delete_runs_prestop_then_removes(self):
+        from kubernetes_tpu.client.rest import RESTClient
+        store, srv, kl = self._world()
+        try:
+            client = RESTClient(srv.url)
+            pod = mkpod("a", "u-a")
+            pod.spec.node_name = "n1"
+            pod.spec.containers[0].lifecycle = api.Lifecycle(
+                pre_stop=api.LifecycleHandler(command=["echo", "bye"]))
+            store.create("pods", pod)
+            kl.sync_once(1.0)
+            assert kl.runtime.get("u-a", "c").state == RUNNING
+            calls = []
+            real = kl.runtime.exec_in_container
+
+            def spy(uid, name, cmd, stdin=None):
+                calls.append(tuple(cmd))
+                return real(uid, name, cmd, stdin)
+
+            kl.runtime.exec_in_container = spy
+            client.delete("pods", "default", "a", grace_period_seconds=30)
+            # marked, not gone: the kubelet owns the termination
+            got = store.get("pods", "default", "a")
+            assert got is not None
+            assert got.metadata.deletion_timestamp is not None
+            assert got.metadata.deletion_grace_period_seconds == 30
+            kl.sync_once(2.0)
+            assert ("echo", "bye") in calls  # preStop ran
+            assert store.get("pods", "default", "a") is None  # reaped
+            assert kl.runtime.get("u-a", "c") is None
+        finally:
+            srv.stop()
+
+    def test_force_delete_is_immediate(self):
+        from kubernetes_tpu.client.rest import RESTClient
+        store, srv, kl = self._world()
+        try:
+            client = RESTClient(srv.url)
+            pod = mkpod("a", "u-a")
+            pod.spec.node_name = "n1"
+            store.create("pods", pod)
+            kl.sync_once(1.0)
+            client.delete("pods", "default", "a", grace_period_seconds=0)
+            assert store.get("pods", "default", "a") is None
+        finally:
+            srv.stop()
+
+    def test_grace_minus_one_uses_spec_default(self):
+        from kubernetes_tpu.client.rest import RESTClient
+        store, srv, kl = self._world()
+        try:
+            client = RESTClient(srv.url)
+            pod = mkpod("a", "u-a")
+            pod.spec.node_name = "n1"
+            pod.spec.termination_grace_period_seconds = 7
+            store.create("pods", pod)
+            kl.sync_once(1.0)
+            client.delete("pods", "default", "a", grace_period_seconds=-1)
+            got = store.get("pods", "default", "a")
+            assert got.metadata.deletion_grace_period_seconds == 7
+        finally:
+            srv.stop()
+
+
+class TestPreviousLogs:
+    def test_previous_logs_after_restart(self):
+        store = ObjectStore()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        pod = mkpod("a", "u-a")
+        pod.spec.node_name = "n1"
+        store.create("pods", pod)
+        kl.sync_once(1.0)
+        kl.runtime.append_log("u-a", "c", "first life output")
+        kl.runtime.crash_container("u-a", "c", now=2.0)
+        # restart happens past the crash backoff window
+        kl.sync_once(20.0)
+        st = kl.runtime.get("u-a", "c")
+        assert st.state == RUNNING
+        cur = kl.runtime.container_logs("u-a", "c")
+        prev = kl.runtime.container_logs("u-a", "c", previous=True)
+        assert "first life output" in prev
+        assert "first life output" not in cur
+
+
+class TestGracefulDeletionEdgeCases:
+    def _world(self):
+        from kubernetes_tpu.server import APIServer, AdmissionChain
+        store = ObjectStore()
+        srv = APIServer(store, admission=AdmissionChain()).start()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        return store, srv, kl
+
+    def test_marked_pod_that_turned_failed_is_still_reaped(self):
+        from kubernetes_tpu.client.rest import RESTClient
+        store, srv, kl = self._world()
+        try:
+            client = RESTClient(srv.url)
+            pod = mkpod("a", "u-a")
+            pod.spec.node_name = "n1"
+            store.create("pods", pod)
+            kl.sync_once(1.0)
+            client.delete("pods", "default", "a", grace_period_seconds=30)
+            # the pod turns terminal BEFORE the termination sync (e.g.
+            # an eviction raced the delete): reaping must still happen
+            got = store.get("pods", "default", "a")
+            got.status.phase = "Failed"
+            store.update("pods", got)
+            kl.sync_once(2.0)
+            assert store.get("pods", "default", "a") is None
+        finally:
+            srv.stop()
+
+    def test_negative_grace_other_than_sentinel_is_422(self):
+        from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+        store, srv, kl = self._world()
+        try:
+            client = RESTClient(srv.url)
+            pod = mkpod("a", "u-a")
+            pod.spec.node_name = "n1"
+            store.create("pods", pod)
+            kl.sync_once(1.0)
+            try:
+                client.delete("pods", "default", "a",
+                              grace_period_seconds=-5)
+                assert False, "expected 422"
+            except APIStatusError as e:
+                assert e.code == 422
+            assert store.get("pods", "default", "a") is not None
+        finally:
+            srv.stop()
