@@ -16,6 +16,7 @@ import re
 CPU = "cpu"
 MEMORY = "memory"
 EPHEMERAL_STORAGE = "ephemeral-storage"
+STORAGE = "storage"  # PV/PVC capacity key
 PODS = "pods"
 
 _BINARY_SUFFIX = {

@@ -469,9 +469,21 @@ class PersistentVolumeClaimSpec:
 
 
 @dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = ""  # Pending | Bound | Lost
+    # the GRANTED size, which trails spec.requests during an expansion
+    capacity: Dict[str, int] = field(default_factory=dict)
+    # (type, status) pairs; expansion uses Resizing /
+    # FileSystemResizePending (core/v1 PersistentVolumeClaimCondition)
+    conditions: List[Tuple[str, str]] = field(default_factory=list)
+
+
+@dataclass
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus)
 
     @property
     def name(self):
@@ -1391,6 +1403,10 @@ class StorageClass:
     provisioner: str = ""
     is_default: bool = False
     volume_binding_mode: str = "Immediate"
+    # gates PVC growth (StorageClass.AllowVolumeExpansion, 1.11's
+    # ExpandPersistentVolumes feature + PersistentVolumeClaimResize
+    # admission)
+    allow_volume_expansion: bool = False
 
 
 @dataclass

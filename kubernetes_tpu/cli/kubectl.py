@@ -1245,11 +1245,21 @@ def _apply_prune(client, args, applied: set, out):
 def cmd_delete(client, args, out):
     plural = _resolve_kind(args.kind)
     # delete.go grace handling: --now = 1s, --force = 0 (immediate),
-    # --grace-period=N explicit; sent only when the user asked
+    # --grace-period=N explicit; conflicting combinations are ERRORS
+    # (delete.go: "--force and --grace-period > 0 cannot be specified
+    # together"), never silent overrides
     grace = getattr(args, "grace_period", None)
-    if getattr(args, "force", False):
+    force = getattr(args, "force", False)
+    now_flag = getattr(args, "now", False)
+    if force and grace is not None and grace > 0:
+        raise SystemExit("error: --force and --grace-period > 0 cannot "
+                         "be specified together")
+    if now_flag and grace is not None:
+        raise SystemExit("error: --now and --grace-period cannot be "
+                         "specified together")
+    if force:
         grace = 0
-    elif getattr(args, "now", False):
+    elif now_flag:
         grace = 1
     if args.name:
         client.delete(plural, args.namespace, args.name,

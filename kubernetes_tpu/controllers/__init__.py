@@ -26,6 +26,7 @@ from .podgc import PodGCController
 from .garbagecollector import GarbageCollector
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
+from .expand import ExpandController
 from .volumebinding import PersistentVolumeController
 from .attachdetach import AttachDetachController
 from .podautoscaler import HorizontalPodAutoscalerController

@@ -32,6 +32,7 @@ from .certificates import CSRApprovingController, CSRSigningController
 from .podautoscaler import HorizontalPodAutoscalerController
 from .statefulset import StatefulSetController
 from .ttl import TTLController
+from .expand import ExpandController
 from .volumebinding import PersistentVolumeController
 from .bootstrap import BootstrapSignerController, TokenCleanerController
 from .clusterroleaggregation import ClusterRoleAggregationController
@@ -49,7 +50,7 @@ DEFAULT_CONTROLLERS = [
     TTLController, CSRApprovingController, CSRSigningController,
     BootstrapSignerController, TokenCleanerController,
     ClusterRoleAggregationController, PVCProtectionController,
-    PVProtectionController,
+    PVProtectionController, ExpandController,
 ]
 
 

@@ -982,6 +982,24 @@ class Kubelet:
             # devices return to the pool with the pod
             self.device_manager.deallocate(uid)
         self.volume_manager.reconcile(self._iter_node or self._get_node())
+        # node-side filesystem resize (operation_executor
+        # MarkVolumeAsResized): claims mounted by this node's pods that
+        # carry FileSystemResizePending get their new size granted here
+        from ..controllers.expand import FS_RESIZE_PENDING, finish_resize
+        for p in self._my_pods():
+            if p.status.phase not in ("Pending", "Running"):
+                continue
+            for v in p.spec.volumes:
+                pvc_name = getattr(v, "pvc_name", "")
+                if not pvc_name:
+                    continue
+                pvc = self.store.get("persistentvolumeclaims",
+                                     p.metadata.namespace, pvc_name)
+                if pvc is not None and any(
+                        c[0] == FS_RESIZE_PENDING and
+                        c[1].startswith("True")
+                        for c in pvc.status.conditions):
+                    finish_resize(self.store, pvc)
         # resource-management housekeeping: reap dead containers beyond
         # the GC policy, reclaim image disk past the high threshold,
         # sweep pod cgroups whose pod is gone, retune the Burstable tier

@@ -863,6 +863,39 @@ class DenyExecOnPrivileged(AdmissionPlugin):
                 f"in pod {obj.metadata.name}")
 
 
+class PersistentVolumeClaimResize(AdmissionPlugin):
+    """plugin/pkg/admission/storage/persistentvolume/resize: shrinking a
+    claim is always forbidden, and growing one requires a bound claim
+    whose StorageClass sets allowVolumeExpansion."""
+
+    name = "PersistentVolumeClaimResize"
+
+    def admit(self, op, kind, obj, old, user, store):
+        from ..api import resources as res
+
+        if op != "update" or kind != "persistentvolumeclaims" or \
+                old is None:
+            return
+        new_req = obj.spec.requests.get(res.STORAGE, 0)
+        old_req = old.spec.requests.get(res.STORAGE, 0)
+        if new_req == old_req:
+            return
+        if new_req < old_req:
+            raise AdmissionError(
+                "persistent volume claims cannot be shrunk: requested "
+                f"{new_req} < current {old_req}", code=422)
+        if not old.spec.volume_name:
+            raise AdmissionError(
+                "only bound claims can be expanded", code=422)
+        sc_name = old.spec.storage_class_name
+        sc = store.get("storageclasses", "", sc_name) or \
+            store.get("storageclasses", "default", sc_name)
+        if sc is None or not sc.allow_volume_expansion:
+            raise AdmissionError(
+                "only claims whose StorageClass sets "
+                "allowVolumeExpansion can be expanded", code=403)
+
+
 class PersistentVolumeLabel(AdmissionPlugin):
     """Stamp cloud zone/region failure-domain labels onto new
     PersistentVolumes (plugin/pkg/admission/storage/persistentvolume/
@@ -908,6 +941,7 @@ class AdmissionChain:
         --enable-admission-plugins."""
         return AdmissionChain([NamespaceLifecycle(), PodPresetAdmission(),
                                LimitRanger(), DefaultStorageClass(),
+                               PersistentVolumeClaimResize(),
                                ServiceAccountAdmission(), PodNodeSelector(),
                                PriorityAdmission(),
                                DefaultTolerationSeconds(),

@@ -1,0 +1,130 @@
+"""Volume expansion: PVC resize admission + expand controller +
+node-side filesystem-resize completion.
+
+Reference test model: pkg/controller/volume/expand tests +
+plugin/pkg/admission/storage/persistentvolume/resize/admission_test.go.
+"""
+
+from kubernetes_tpu.api import resources as res
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers.expand import (FS_RESIZE_PENDING,
+                                               ExpandController)
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.server.admission import (AdmissionChain,
+                                             AdmissionError)
+
+
+def world(expandable=True):
+    store = ObjectStore()
+    store.create("storageclasses", api.StorageClass(
+        metadata=api.ObjectMeta(name="fast", namespace=""),
+        provisioner="kubernetes.io/fake",
+        allow_volume_expansion=expandable))
+    store.create("persistentvolumes", api.PersistentVolume(
+        metadata=api.ObjectMeta(name="pv1", namespace=""),
+        spec=api.PersistentVolumeSpec(
+            capacity={res.STORAGE: 10 << 30})))
+    store.create("persistentvolumeclaims", api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name="data"),
+        spec=api.PersistentVolumeClaimSpec(
+            storage_class_name="fast", volume_name="pv1",
+            requests={res.STORAGE: 10 << 30})))
+    return store, ExpandController(store)
+
+
+class TestResizeAdmission:
+    def _admit(self, store, new, old):
+        AdmissionChain.default().admit(
+            "update", "persistentvolumeclaims", new, old, None, store)
+
+    def test_shrink_always_rejected(self):
+        store, _ = world()
+        old = store.get("persistentvolumeclaims", "default", "data")
+        import copy
+        new = copy.deepcopy(old)
+        new.spec.requests[res.STORAGE] = 5 << 30
+        try:
+            self._admit(store, new, old)
+            assert False, "shrink admitted"
+        except AdmissionError as e:
+            assert "shrunk" in str(e)
+
+    def test_grow_requires_expandable_class(self):
+        store, _ = world(expandable=False)
+        old = store.get("persistentvolumeclaims", "default", "data")
+        import copy
+        new = copy.deepcopy(old)
+        new.spec.requests[res.STORAGE] = 20 << 30
+        try:
+            self._admit(store, new, old)
+            assert False, "grow admitted without allowVolumeExpansion"
+        except AdmissionError as e:
+            assert "allowVolumeExpansion" in str(e)
+        # with expansion allowed, the same grow passes
+        store2, _ = world(expandable=True)
+        old2 = store2.get("persistentvolumeclaims", "default", "data")
+        new2 = copy.deepcopy(old2)
+        new2.spec.requests[res.STORAGE] = 20 << 30
+        self._admit(store2, new2, old2)
+
+
+class TestExpandController:
+    def test_offline_expand_completes_immediately(self):
+        store, ctrl = world()
+        ctrl.sync_all()  # records granted capacity
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert pvc.status.capacity[res.STORAGE] == 10 << 30
+        pvc.spec.requests[res.STORAGE] = 20 << 30
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()
+        pv = store.get("persistentvolumes", "", "pv1")
+        assert pv.spec.capacity[res.STORAGE] == 20 << 30
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert pvc.status.capacity[res.STORAGE] == 20 << 30
+        assert pvc.status.conditions == []
+
+    def test_online_expand_waits_for_kubelet(self):
+        store, ctrl = world()
+        ctrl.sync_all()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="db", uid="u-db"),
+            spec=api.PodSpec(node_name="n1", containers=[
+                api.Container(name="c")],
+                volumes=[api.Volume(name="data", pvc_name="data")]))
+        store.create("pods", pod)
+        kl.sync_once(1.0)
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        pvc.spec.requests[res.STORAGE] = 20 << 30
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        # controller half done: PV grown, fs resize owed to the node
+        assert store.get("persistentvolumes", "", "pv1") \
+            .spec.capacity[res.STORAGE] == 20 << 30
+        assert any(c[0] == FS_RESIZE_PENDING
+                   for c in pvc.status.conditions)
+        assert pvc.status.capacity[res.STORAGE] == 10 << 30
+        # the claim's kubelet finishes the resize in housekeeping
+        kl.sync_once(2.0)
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert pvc.status.capacity[res.STORAGE] == 20 << 30
+        assert pvc.status.conditions == []
+
+    def test_replace_wiped_status_does_not_fake_completion(self):
+        """A full PUT (kubectl replace) arrives with empty status; the
+        controller must re-baseline from the PV's real capacity and run
+        the expansion, not stamp the grown request as already granted."""
+        store, ctrl = world()
+        ctrl.sync_all()
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        # simulate replace: grown spec + wiped status in one write
+        pvc.spec.requests[res.STORAGE] = 20 << 30
+        pvc.status = api.PersistentVolumeClaimStatus()
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()
+        pv = store.get("persistentvolumes", "", "pv1")
+        assert pv.spec.capacity[res.STORAGE] == 20 << 30  # really grown
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert pvc.status.capacity[res.STORAGE] == 20 << 30
