@@ -1251,16 +1251,16 @@ def cmd_delete(client, args, out):
     grace = getattr(args, "grace_period", None)
     force = getattr(args, "force", False)
     now_flag = getattr(args, "now", False)
-    if force and grace is not None and grace > 0:
-        raise SystemExit("error: --force and --grace-period > 0 cannot "
-                         "be specified together")
     if now_flag and grace is not None:
         raise SystemExit("error: --now and --grace-period cannot be "
                          "specified together")
+    if now_flag:
+        grace = 1  # resolved first, like delete.go, so --force errors
+    if force and grace is not None and grace > 0:
+        raise SystemExit("error: --force and --grace-period > 0 cannot "
+                         "be specified together")
     if force:
         grace = 0
-    elif now_flag:
-        grace = 1
     if args.name:
         client.delete(plural, args.namespace, args.name,
                       grace_period_seconds=grace)

@@ -80,7 +80,20 @@ class ExpandController(Controller):
             # status): the granted baseline is what the PV actually
             # provides — stamping spec.requests here would silently
             # complete an expansion that never ran
-            have = min(want, pv.spec.capacity.get(res.STORAGE, want))
+            pv_cap = pv.spec.capacity.get(res.STORAGE, want)
+            if pv_cap >= want and claim_in_use(self.store, pvc):
+                # a wiped status can't tell GRANTED from OWED when the
+                # PV already holds the new size mid-online-expand: have
+                # the node confirm (finish_resize is idempotent) rather
+                # than fake completion
+                _cond_set(pvc, FS_RESIZE_PENDING)
+                pvc.status.phase = "Bound"
+                try:
+                    self.store.update("persistentvolumeclaims", pvc)
+                except (Conflict, KeyError):
+                    pass
+                return
+            have = min(want, pv_cap)
             pvc.status.capacity[res.STORAGE] = have
             pvc.status.phase = "Bound"
             try:
@@ -89,6 +102,13 @@ class ExpandController(Controller):
                 return
             # fall through: a growth observed in the same sync proceeds
         if want <= have:
+            return
+        # controller-side phase is visible on the claim while it runs
+        # (expand_controller MarkAsResizing)
+        _cond_set(pvc, RESIZING)
+        try:
+            self.store.update("persistentvolumeclaims", pvc)
+        except (Conflict, KeyError):
             return
         # controller-side expand: grow the PV capacity
         # (sync_volume_resize.go ExpandVolume -> UpdatePVSize)

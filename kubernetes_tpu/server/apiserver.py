@@ -284,6 +284,7 @@ class APIServer:
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> "APIServer":
+        self._bootstrap_priority_classes()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="apiserver", daemon=True)
         self._thread.start()
@@ -776,6 +777,23 @@ class APIServer:
                 if obj is not None:
                     return obj
         return None
+
+    def _bootstrap_priority_classes(self):
+        """The built-in system PriorityClasses every cluster serves
+        (registry/scheduling/rest/storage_scheduling.go
+        PostStartHook: system-node-critical 2000001000,
+        system-cluster-critical 2000000000) — control-plane pods name
+        them and the kubelet's critical-pod preemption keys off their
+        values."""
+        for name, value in (("system-node-critical", 2_000_001_000),
+                            ("system-cluster-critical", 2_000_000_000)):
+            try:
+                self.store.create("priorityclasses", api.PriorityClass(
+                    metadata=api.ObjectMeta(name=name, namespace=""),
+                    value=value,
+                    description="Built-in system priority class"))
+            except Conflict:
+                pass  # already bootstrapped (durable store restart)
 
     # -- custom resource validation/subresources -------------------------------
 

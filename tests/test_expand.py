@@ -128,3 +128,69 @@ class TestExpandController:
         assert pv.spec.capacity[res.STORAGE] == 20 << 30  # really grown
         pvc = store.get("persistentvolumeclaims", "default", "data")
         assert pvc.status.capacity[res.STORAGE] == 20 << 30
+
+
+class TestSystemPriorityClasses:
+    def test_bootstrap_and_resolution(self):
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.server.admission import AdmissionChain
+
+        store = ObjectStore()
+        store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default"),
+            status=api.NamespaceStatus(phase="Active")))
+        store.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default")))
+        srv = APIServer(store, admission=AdmissionChain.default()).start()
+        try:
+            client = RESTClient(srv.url)
+            pcs = {p.metadata.name: p.value
+                   for p, in zip(store.list("priorityclasses"))}
+            assert pcs["system-node-critical"] == 2_000_001_000
+            assert pcs["system-cluster-critical"] == 2_000_000_000
+            # a pod naming the class gets the resolved priority — which
+            # makes it critical for kubelet preemption
+            client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="cp"),
+                spec=api.PodSpec(
+                    priority_class_name="system-node-critical",
+                    containers=[api.Container(name="c")])))
+            got = store.get("pods", "default", "cp")
+            assert got.spec.priority == 2_000_001_000
+            kl = Kubelet(store, "n1", heartbeat_period=0.0)
+            assert kl._is_critical(got)
+        finally:
+            srv.stop()
+
+    def test_status_wipe_mid_online_expand_waits_for_node(self):
+        """Status wiped AFTER the PV was already grown for an online
+        expand: the controller must re-mark FileSystemResizePending —
+        not fake completion — and the kubelet confirms."""
+        store, ctrl = world()
+        ctrl.sync_all()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        store.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="db", uid="u-db"),
+            spec=api.PodSpec(node_name="n1",
+                             containers=[api.Container(name="c")],
+                             volumes=[api.Volume(name="data",
+                                                 pvc_name="data")])))
+        kl.sync_once(1.0)
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        pvc.spec.requests[res.STORAGE] = 20 << 30
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()  # PV grown, FS pending set
+        # replace wipes status mid-flight
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        pvc.status = api.PersistentVolumeClaimStatus()
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert any(c[0] == FS_RESIZE_PENDING
+                   for c in pvc.status.conditions)
+        assert pvc.status.capacity.get(res.STORAGE) is None
+        kl.sync_once(2.0)  # the node confirms
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert pvc.status.capacity[res.STORAGE] == 20 << 30
+        assert pvc.status.conditions == []
