@@ -1277,6 +1277,87 @@ def cmd_delete(client, args, out):
         out.write(f"{plural}/{o.metadata.name} deleted\n")
 
 
+def _plugin_dirs():
+    import os
+
+    env = os.environ.get("KUBECTL_PLUGINS_PATH", "")
+    if env:
+        return [d for d in env.split(os.pathsep) if d]
+    return [os.path.expanduser("~/.kube/plugins")]
+
+
+def _load_plugins():
+    """pkg/kubectl/plugins/loader.go: every subdirectory of the plugin
+    path carrying a plugin.yaml descriptor (name, shortDesc, command)
+    is a runnable plugin."""
+    import os
+
+    import yaml
+
+    found = {}
+    for root in _plugin_dirs():
+        if not os.path.isdir(root):
+            continue
+        for entry in sorted(os.listdir(root)):
+            desc_path = os.path.join(root, entry, "plugin.yaml")
+            if not os.path.isfile(desc_path):
+                continue
+            try:
+                with open(desc_path) as f:
+                    desc = yaml.safe_load(f) or {}
+            except (OSError, yaml.YAMLError):
+                continue
+            name = desc.get("name") or entry
+            if name not in found and desc.get("command"):
+                desc["_dir"] = os.path.join(root, entry)
+                found[name] = desc
+    return found
+
+
+def cmd_plugin(client, args, out):
+    """kubectl plugin [NAME [args...]] — the 1.11 plugin mechanism
+    (pkg/kubectl/plugins/runner.go): the descriptor's command runs with
+    the KUBECTL_PLUGINS_* environment describing the caller, current
+    namespace, and the plugin's own descriptor."""
+    import os
+    import subprocess
+    import sys
+
+    plugins = _load_plugins()
+    if not args.plugin_name:
+        if not plugins:
+            out.write("No plugins installed.\n")
+            return
+        out.write("Available plugins:\n")
+        for name, desc in sorted(plugins.items()):
+            out.write(f"  {name}\t{desc.get('shortDesc', '')}\n")
+        return
+    desc = plugins.get(args.plugin_name)
+    if desc is None:
+        raise SystemExit(f"error: plugin {args.plugin_name!r} not found "
+                         f"in {os.pathsep.join(_plugin_dirs())}")
+    import shlex
+
+    env = dict(os.environ)
+    env.update({
+        "KUBECTL_PLUGINS_CALLER": sys.argv[0],
+        "KUBECTL_PLUGINS_CURRENT_NAMESPACE": args.namespace,
+        "KUBECTL_PLUGINS_DESCRIPTOR_NAME": desc.get("name", ""),
+        "KUBECTL_PLUGINS_DESCRIPTOR_SHORT_DESC": desc.get("shortDesc", ""),
+        "KUBECTL_PLUGINS_DESCRIPTOR_COMMAND": desc.get("command", ""),
+    })
+    # shlex: a quoted path or argument with spaces survives
+    # (divergence, noted: output is captured, not streamed — an
+    # interactive plugin prompting on stdout won't show its prompt)
+    proc = subprocess.run(
+        shlex.split(desc["command"]) + list(args.plugin_args or []),
+        cwd=desc["_dir"], env=env, capture_output=True, text=True)
+    out.write(proc.stdout)
+    if proc.stderr:
+        out.write(proc.stderr)  # warnings survive success too
+    return proc.returncode
+
+
 def cmd_scale(client, args, out):
     """scale.go: go through the polymorphic /scale subresource when the
     kind serves one (incl. CRDs declaring subresources.scale); fall back
@@ -2531,6 +2612,11 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--wait", type=float, default=2.0)
     lg.add_argument("--previous", "-p", action="store_true")
 
+    pl = sub.add_parser("plugin")
+    pl.add_argument("plugin_name", nargs="?")
+    # REMAINDER: flag-like tokens (--verbose) belong to the PLUGIN
+    pl.add_argument("plugin_args", nargs=argparse.REMAINDER)
+
     ec = sub.add_parser("exec")
     ec.add_argument("name")
     ec.add_argument("--container", "-c", default="")
@@ -2683,7 +2769,8 @@ VERBS = {"get": cmd_get, "describe": cmd_describe, "create": cmd_create,
          "cluster-info": cmd_cluster_info, "convert": cmd_convert,
          "set": cmd_set, "wait": cmd_wait, "proxy": cmd_proxy,
          "rolling-update": cmd_rolling_update,
-         "completion": cmd_completion, "options": cmd_options}
+         "completion": cmd_completion, "options": cmd_options,
+         "plugin": cmd_plugin}
 # "config" is registered below its (later) definition — it is
 # dispatched pre-connect in main(), the VERBS entry only feeds
 # completion/help
@@ -2696,6 +2783,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     if args.verb == "config":
         # config verbs edit the kubeconfig FILE — no server connection
         return cmd_config(None, args, out)
+    if args.verb == "plugin":
+        # purely local: discovery + subprocess, never the apiserver
+        try:
+            return cmd_plugin(None, args, out) or 0
+        except SystemExit as e:
+            print(e, file=sys.stderr)
+            return 1
     from ..client.rest import pem_arg
 
     server = args.server or os.environ.get("KUBECTL_SERVER")

@@ -1031,3 +1031,48 @@ class TestDryRun:
         assert rc == 0 and "configured (dry run)" in out
         assert server.store.get("configmaps", "default",
                                 "dry").data == {"k": "1"}
+
+
+class TestPluginMechanism:
+    def test_discover_and_run(self, tmp_path, monkeypatch):
+        """pkg/kubectl/plugins: plugin.yaml descriptors under
+        KUBECTL_PLUGINS_PATH are listed and runnable with the
+        KUBECTL_PLUGINS_* environment."""
+        import io
+
+        from kubernetes_tpu.cli.kubectl import main
+
+        pdir = tmp_path / "plugins" / "hello"
+        pdir.mkdir(parents=True)
+        (pdir / "plugin.yaml").write_text(
+            "name: hello\nshortDesc: Say hello\n"
+            "command: python hello.py\n")
+        (pdir / "hello.py").write_text(
+            "import os, sys\n"
+            "print('hello from', os.environ['"
+            "KUBECTL_PLUGINS_DESCRIPTOR_NAME'],\n"
+            "      'ns', os.environ['KUBECTL_PLUGINS_CURRENT_NAMESPACE'],"
+            "\n      'args', sys.argv[1:])\n")
+        monkeypatch.setenv("KUBECTL_PLUGINS_PATH",
+                           str(tmp_path / "plugins"))
+        out = io.StringIO()
+        rc = main(["--server", "http://127.0.0.1:1", "plugin"], out=out)
+        assert rc == 0 and "hello\tSay hello" in out.getvalue()
+        out = io.StringIO()
+        rc = main(["--server", "http://127.0.0.1:1", "plugin", "hello",
+                   "world"], out=out)
+        assert rc == 0, out.getvalue()
+        assert "hello from hello ns default args ['world']" \
+            in out.getvalue()
+
+    def test_unknown_plugin_errors(self, tmp_path, monkeypatch):
+        import io
+
+        from kubernetes_tpu.cli.kubectl import main
+
+        monkeypatch.setenv("KUBECTL_PLUGINS_PATH", str(tmp_path))
+        assert main(["plugin", "nope"], out=io.StringIO()) == 1
+        # plugin is local: no server needed to list
+        out = io.StringIO()
+        assert main(["plugin"], out=out) == 0
+        assert "No plugins installed" in out.getvalue()
