@@ -1349,9 +1349,26 @@ def cmd_plugin(client, args, out):
     # shlex: a quoted path or argument with spaces survives
     # (divergence, noted: output is captured, not streamed — an
     # interactive plugin prompting on stdout won't show its prompt)
-    proc = subprocess.run(
-        shlex.split(desc["command"]) + list(args.plugin_args or []),
-        cwd=desc["_dir"], env=env, capture_output=True, text=True)
+    argv = shlex.split(desc["command"]) + list(args.plugin_args or [])
+    # the command resolves relative to the PLUGIN dir, but runs in the
+    # CALLER's cwd (reference runner semantics: file-producing plugins
+    # write where the user invoked kubectl, not the install dir)
+    local = os.path.join(desc["_dir"], argv[0])
+    if not os.path.isabs(argv[0]) and os.path.exists(local):
+        argv[0] = local
+    elif argv[0].endswith(".py") or (len(argv) > 1 and
+                                     argv[1].endswith(".py")):
+        # script paths inside the descriptor resolve against its dir
+        for i, tok in enumerate(argv):
+            cand = os.path.join(desc["_dir"], tok)
+            if tok.endswith(".py") and os.path.exists(cand):
+                argv[i] = cand
+    try:
+        proc = subprocess.run(argv, env=env, capture_output=True,
+                              text=True)
+    except (FileNotFoundError, PermissionError) as e:
+        raise SystemExit(f"error: unable to run plugin "
+                         f"{args.plugin_name!r}: {e}")
     out.write(proc.stdout)
     if proc.stderr:
         out.write(proc.stderr)  # warnings survive success too
@@ -2784,7 +2801,20 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         # config verbs edit the kubeconfig FILE — no server connection
         return cmd_config(None, args, out)
     if args.verb == "plugin":
-        # purely local: discovery + subprocess, never the apiserver
+        # purely local: discovery + subprocess, never the apiserver —
+        # but the kubeconfig context's namespace still reaches the
+        # plugin env (the reference passes the factory-resolved one)
+        if args.namespace == "default":
+            from . import kubeconfig as kc
+
+            path = args.kubeconfig or kc.default_path()
+            if os.path.exists(path):
+                try:
+                    r = kc.resolve(kc.load(path), context=args.context)
+                    if r.get("namespace"):
+                        args.namespace = r["namespace"]
+                except ValueError:
+                    pass  # a broken kubeconfig can't block local plugins
         try:
             return cmd_plugin(None, args, out) or 0
         except SystemExit as e:

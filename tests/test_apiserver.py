@@ -314,22 +314,29 @@ class TestWorkqueue:
 class TestLeaderElection:
     def test_single_leader_and_failover(self, server, client):
         store = RemoteStore(client)
-        a = LeaderElector(store, "a", lease_duration=1.0, retry_period=0.05)
-        b = LeaderElector(store, "b", lease_duration=1.0, retry_period=0.05)
+        # the INVARIANTS under any scheduling jitter: (1) never two
+        # leaders at once, (2) the standby takes over once the holder
+        # stops renewing. Asserting "b has not acquired yet after N ms"
+        # flakes under a loaded suite — a starved renewal thread makes
+        # b's acquisition legitimate, not a bug.
+        a = LeaderElector(store, "a", lease_duration=2.0, retry_period=0.05)
+        b = LeaderElector(store, "b", lease_duration=2.0, retry_period=0.05)
         a_started = threading.Event()
         b_started = threading.Event()
         a.on_started_leading = a_started.set
         b.on_started_leading = b_started.set
         a.start()
-        assert a_started.wait(3)
+        assert a_started.wait(10)
         b.start()
-        time.sleep(0.3)
-        assert not b_started.is_set()  # lease held by a
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            assert not (a.is_leader and b.is_leader)  # never co-leaders
+            time.sleep(0.02)
         a.stop()  # a stops renewing; b takes over after expiry
-        assert b_started.wait(15)
+        assert b_started.wait(20)
         rec = store.get("leases", "default", "kube-scheduler")
         assert rec.holder_identity == "b"
-        assert rec.leader_transitions == 1
+        assert rec.leader_transitions >= 1
         b.stop()
         store.stop()
 

@@ -1044,9 +1044,10 @@ class TestPluginMechanism:
 
         pdir = tmp_path / "plugins" / "hello"
         pdir.mkdir(parents=True)
+        import sys as _sys
         (pdir / "plugin.yaml").write_text(
             "name: hello\nshortDesc: Say hello\n"
-            "command: python hello.py\n")
+            f"command: {_sys.executable} hello.py\n")
         (pdir / "hello.py").write_text(
             "import os, sys\n"
             "print('hello from', os.environ['"
