@@ -1349,20 +1349,18 @@ def cmd_plugin(client, args, out):
     # shlex: a quoted path or argument with spaces survives
     # (divergence, noted: output is captured, not streamed — an
     # interactive plugin prompting on stdout won't show its prompt)
-    argv = shlex.split(desc["command"]) + list(args.plugin_args or [])
-    # the command resolves relative to the PLUGIN dir, but runs in the
-    # CALLER's cwd (reference runner semantics: file-producing plugins
-    # write where the user invoked kubectl, not the install dir)
-    local = os.path.join(desc["_dir"], argv[0])
-    if not os.path.isabs(argv[0]) and os.path.exists(local):
-        argv[0] = local
-    elif argv[0].endswith(".py") or (len(argv) > 1 and
-                                     argv[1].endswith(".py")):
-        # script paths inside the descriptor resolve against its dir
-        for i, tok in enumerate(argv):
-            cand = os.path.join(desc["_dir"], tok)
-            if tok.endswith(".py") and os.path.exists(cand):
-                argv[i] = cand
+    # DESCRIPTOR tokens that name files shipped with the plugin resolve
+    # against the plugin dir ('bash run.sh', 'python -u hello.py'); the
+    # child still runs in the CALLER's cwd (reference runner semantics:
+    # file-producing plugins write where the user invoked kubectl).
+    # USER arguments are never rewritten — 'process.py' on the command
+    # line means the user's file, even if the plugin ships one.
+    desc_tokens = shlex.split(desc["command"])
+    for i, tok in enumerate(desc_tokens):
+        cand = os.path.join(desc["_dir"], tok)
+        if not os.path.isabs(tok) and os.path.isfile(cand):
+            desc_tokens[i] = cand
+    argv = desc_tokens + list(args.plugin_args or [])
     try:
         proc = subprocess.run(argv, env=env, capture_output=True,
                               text=True)
@@ -2813,7 +2811,7 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
                     r = kc.resolve(kc.load(path), context=args.context)
                     if r.get("namespace"):
                         args.namespace = r["namespace"]
-                except ValueError:
+                except Exception:
                     pass  # a broken kubeconfig can't block local plugins
         try:
             return cmd_plugin(None, args, out) or 0

@@ -1,0 +1,152 @@
+"""The user journey, end to end: one cluster, every layer.
+
+A deployment goes in through kubectl, the scheduler places pods onto
+kubelet-served nodes, a rolling update rides ControllerRevision-backed
+machinery, scale goes through /scale, a TPU workload flows device
+plugin -> scheduler -> pinned env, a drain evicts with PDB respect, and
+a graceful delete terminates through the kubelet. The reference's e2e
+suite checks this composition (test/e2e/apps + scheduling); here it is
+one deterministic in-process pump."""
+
+import io
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.cli.kubectl import main
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.kubelet.devicemanager import DevicePlugin
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.server import APIServer, AdmissionChain
+
+
+def kubectl(srv, *argv):
+    out = io.StringIO()
+    rc = main(["--server", srv.url, *argv], out=out)
+    return rc, out.getvalue()
+
+
+class World:
+    def __init__(self):
+        self.store = ObjectStore()
+        self.store.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default"),
+            status=api.NamespaceStatus(phase="Active")))
+        self.srv = APIServer(self.store,
+                             admission=AdmissionChain()).start()
+        self.kubelets = []
+        for i in range(2):
+            kl = Kubelet(self.store, f"n{i}", heartbeat_period=0.0)
+            self.kubelets.append(kl)
+        # n0 carries the TPUs
+        self.kubelets[0].device_manager.register(
+            DevicePlugin("google.com/tpu", ["tpu0", "tpu1"]))
+        self.sched = Scheduler(self.store, wave_size=16)
+        self.dep_ctrl = DeploymentController(self.store)
+        self.rs_ctrl = ReplicaSetController(self.store)
+        self.t = [0.0]
+
+    def pump(self, rounds=10):
+        """One deterministic control-plane heartbeat: controllers,
+        scheduler, kubelets — repeated until the world settles."""
+        for _ in range(rounds):
+            self.t[0] += 1.0
+            self.dep_ctrl.sync_all()
+            self.rs_ctrl.sync_all()
+            self.sched.schedule_pending()
+            time.sleep(0.05)  # async binds land
+            for kl in self.kubelets:
+                kl.sync_once(self.t[0])
+
+    def stop(self):
+        self.srv.stop()
+
+
+def test_grand_tour():
+    w = World()
+    try:
+        # --- deploy through kubectl ---------------------------------
+        rc, out = kubectl(w.srv, "create", "deployment", "web",
+                          "--image", "web:v1", "--replicas", "3")
+        assert rc == 0, out
+        w.pump()
+        running = [p for p in w.store.list("pods")
+                   if p.status.phase == "Running"
+                   and "web" in p.metadata.name]
+        assert len(running) == 3
+        assert all(p.status.pod_ip for p in running)  # networked
+        rc, out = kubectl(w.srv, "rollout", "status", "deployment", "web")
+        assert "successfully rolled out" in out, out
+
+        # --- rolling update + history + undo ------------------------
+        rc, out = kubectl(w.srv, "set", "image", "deployment/web",
+                          "web=web:v2")
+        assert rc == 0, out
+        w.pump(16)
+        rc, out = kubectl(w.srv, "rollout", "history", "deployment",
+                          "web")
+        assert "1" in out and "2" in out
+        images = {w.store.get("pods", "default", p.metadata.name)
+                  .spec.containers[0].image
+                  for p in w.store.list("pods")
+                  if "web" in p.metadata.name
+                  and p.status.phase == "Running"}
+        assert images == {"web:v2"}, images
+        rc, out = kubectl(w.srv, "rollout", "undo", "deployment", "web")
+        assert "rolled back" in out
+        w.pump(16)
+
+        # --- scale through the polymorphic subresource --------------
+        rc, out = kubectl(w.srv, "scale", "deployment", "web",
+                          "--replicas", "5")
+        assert rc == 0
+        w.pump(12)
+        assert w.store.get("deployments", "default",
+                           "web").status.ready_replicas == 5
+
+        # --- a TPU workload flows to the TPU node -------------------
+        w.store.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="train", uid="u-train"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="trainer:v1",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": 100, "google.com/tpu": 2},
+                    limits={"google.com/tpu": 2}))])))
+        w.pump(6)
+        train = w.store.get("pods", "default", "train")
+        assert train.spec.node_name == "n0"
+        st = w.kubelets[0].runtime.get("u-train", "c")
+        assert st.env["TPU_VISIBLE_DEVICES"] == "tpu0,tpu1"
+
+        # --- PDB-respecting drain -----------------------------------
+        w.store.create("poddisruptionbudgets", api.PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="keep-web"),
+            spec=api.PodDisruptionBudgetSpec(
+                selector=api.LabelSelector(match_labels={"app": "web"}),
+                min_available=5)))
+        from kubernetes_tpu.controllers.disruption import \
+            DisruptionController
+        DisruptionController(w.store).sync_all()
+        n1_web = [p for p in w.store.list("pods")
+                  if p.spec.node_name == "n1" and "web" in p.metadata.name
+                  and p.status.phase == "Running"]
+        assert n1_web  # spreading put some replicas on n1
+        rc, out = kubectl(w.srv, "drain", "n1")
+        assert "eviction blocked" in out  # PDB holds at minAvailable=5
+        assert w.store.get("nodes", "default",
+                           "n1").spec.unschedulable
+        rc, out = kubectl(w.srv, "uncordon", "n1")
+        assert rc == 0
+
+        # --- graceful delete through the kubelet --------------------
+        rc, out = kubectl(w.srv, "delete", "pods", "train",
+                          "--grace-period", "30")
+        assert rc == 0
+        assert w.store.get("pods", "default", "train") is not None
+        w.pump(3)
+        assert w.store.get("pods", "default", "train") is None
+        assert not w.kubelets[0].device_manager.pod_devices("u-train")
+    finally:
+        w.stop()
