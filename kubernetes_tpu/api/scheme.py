@@ -128,6 +128,8 @@ register("Job", "jobs", api.Job, "batch/v1")
 register("CronJob", "cronjobs", api.CronJob, "batch/v1beta1")
 register("PodDisruptionBudget", "poddisruptionbudgets", api.PodDisruptionBudget,
          "policy/v1beta1")
+register("PodGroup", "podgroups", api.PodGroup,
+         "scheduling.sigs.k8s.io/v1alpha1")
 register("PersistentVolume", "persistentvolumes", api.PersistentVolume,
          namespaced=False)
 register("PersistentVolumeClaim", "persistentvolumeclaims", api.PersistentVolumeClaim)

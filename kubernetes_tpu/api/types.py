@@ -978,6 +978,64 @@ class PodMetrics:
     usage: Dict[str, int] = field(default_factory=dict)
 
 
+# --- gang scheduling (coscheduling PodGroup) ---------------------------------
+# Forward-port: the 1.11 reference has no gang scheduling; the API shape
+# follows the coscheduling ecosystem (kube-batch / the scheduler-plugins
+# PodGroup CRD) — plain pods opt in via the pod-group annotations, and a
+# PodGroup object may carry the authoritative minMember.
+
+POD_GROUP_NAME_ANNOTATION = "pod-group.scheduling.k8s.io/name"
+POD_GROUP_MIN_AVAILABLE_ANNOTATION = "pod-group.scheduling.k8s.io/min-available"
+
+
+@dataclass
+class PodGroupSpec:
+    # minimum number of member pods that must be placeable SIMULTANEOUSLY
+    # before any member is bound (all-or-nothing admission)
+    min_member: int = 1
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = "Pending"  # Pending | Running | Unschedulable
+    scheduled: int = 0
+
+
+@dataclass
+class PodGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+
+def pod_group_name(pod: "Pod") -> Optional[str]:
+    """The pod's gang name, or None for ordinary pods. ONE dict lookup —
+    this sits on the queue-admission hot path for every pod."""
+    ann = pod.metadata.annotations
+    if not ann:
+        return None
+    return ann.get(POD_GROUP_NAME_ANNOTATION) or None
+
+
+def pod_group_min_available(pod: "Pod") -> Optional[int]:
+    """minMember from the pod's own annotation (used when no PodGroup
+    object exists); None when absent or unparseable."""
+    ann = pod.metadata.annotations
+    if not ann:
+        return None
+    raw = ann.get(POD_GROUP_MIN_AVAILABLE_ANNOTATION)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
 @dataclass
 class PodDisruptionBudgetSpec:
     selector: Optional[LabelSelector] = None

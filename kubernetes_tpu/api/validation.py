@@ -397,6 +397,15 @@ def validate_priority_class(pc) -> ErrorList:
     return errs
 
 
+def validate_podgroup(pg) -> ErrorList:
+    """Gang admission gates on minMember; a non-positive value would
+    either release gangs instantly (0) or wedge them forever (<0)."""
+    errs = validate_object_meta(pg.metadata)
+    if pg.spec.min_member < 1:
+        errs.add("spec.minMember", pg.spec.min_member, "must be at least 1")
+    return errs
+
+
 def validate_job(job) -> ErrorList:
     errs = validate_object_meta(job.metadata)
     for fname in ("completions", "parallelism", "backoff_limit"):
@@ -432,6 +441,7 @@ VALIDATORS = {
     "clusterrolebindings": validate_rbac_binding,
     "horizontalpodautoscalers": validate_hpa,
     "poddisruptionbudgets": validate_pdb,
+    "podgroups": validate_podgroup,
     "resourcequotas": validate_resource_quota,
     "priorityclasses": validate_priority_class,
 }

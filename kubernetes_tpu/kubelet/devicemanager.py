@@ -67,6 +67,15 @@ class DeviceManager:
             self._plugins[plugin.resource] = plugin
             self._allocated.setdefault(plugin.resource, {})
 
+    def unregister(self, resource: str):
+        """Plugin endpoint gone (manager.go markResourceUnhealthy +
+        GetCapacity's deletedResources): the resource stops being
+        advertised — the kubelet heartbeat zeroes it from node status.
+        In-flight allocations stay recorded so a plugin that comes back
+        finds running pods still pinned to their exact device IDs."""
+        with self._lock:
+            self._plugins.pop(resource, None)
+
     def resources(self) -> List[str]:
         with self._lock:
             return sorted(self._plugins)

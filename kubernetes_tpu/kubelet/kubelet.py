@@ -124,6 +124,10 @@ class Kubelet:
         self.image_gc = ImageGCManager(self.image_store, self.runtime)
         self.container_gc = ContainerGC(self.runtime)
         self.device_manager = DeviceManager()
+        # every device-plugin resource this kubelet has EVER published
+        # into node status: a plugin that unregisters must have its
+        # resource zeroed on the next heartbeat, not merged-in forever
+        self._published_device_resources: set = set()
         # checkpointing (pkg/kubelet/checkpointmanager): device/cpu
         # assignments survive a kubelet restart so running pods keep
         # their exact accelerator IDs and core pins
@@ -243,14 +247,34 @@ class Kubelet:
         # device-plugin resources ride the heartbeat into node status
         # (devicemanager GetCapacity merged in kubelet_node_status.go):
         # unhealthy devices stay in capacity but leave allocatable, so
-        # the scheduler stops fitting against them
+        # the scheduler stops fitting against them. Resources whose
+        # plugin UNREGISTERED are zeroed — the reference's
+        # GetCapacity returns them in deletedResources and
+        # kubelet_node_status.go zeroes capacity/allocatable; merging
+        # additively forever would let the scheduler fit pods against
+        # devices that no longer exist (shrunk sets overwrite via the
+        # merge itself)
         dev_cap = self.device_manager.capacity()
-        if dev_cap:
-            node.status.capacity = dict(node.status.capacity or {},
-                                        **dev_cap)
-            node.status.allocatable = dict(
-                node.status.allocatable or {},
-                **self.device_manager.allocatable())
+        # restart seeding: a fresh kubelet process starts with an empty
+        # published set, but the STORED node may still advertise device
+        # resources a dead plugin merged in before the restart — adopt
+        # every slash-qualified resource the node carries beyond this
+        # kubelet's static allocatable as previously-published, so an
+        # unregistered plugin's capacity is zeroed instead of resurrected
+        self._published_device_resources |= {
+            r for r in (node.status.capacity or {})
+            if "/" in r and r not in self.allocatable}
+        gone = self._published_device_resources - set(dev_cap)
+        self._published_device_resources |= set(dev_cap)
+        if dev_cap or gone:
+            cap = dict(node.status.capacity or {}, **dev_cap)
+            alloc = dict(node.status.allocatable or {},
+                         **self.device_manager.allocatable())
+            for r in gone:
+                cap[r] = 0
+                alloc[r] = 0
+            node.status.capacity = cap
+            node.status.allocatable = alloc
         conds = {c.type: c for c in node.status.conditions}
         conds[api.NODE_READY] = api.NodeCondition(api.NODE_READY, api.COND_TRUE)
         if memory_pressure is not None:

@@ -1,0 +1,83 @@
+"""Batched gang (coscheduling) joint-assignment kernel.
+
+A gang — the pods of one PodGroup — is useless until minMember of its
+pods hold capacity SIMULTANEOUSLY (a pjit/multi-chip training job can't
+start on half its workers), so per-pod placement deadlocks two
+half-placed gangs against each other. The reference's per-pod
+`genericScheduler` cannot ask "does this entire gang fit at once"; the
+batched wave formulation can, in one device pass:
+
+  * `_wave_body` (ops/kernel.py) already evaluates every member's
+    feasible-node mask and scores as a [G, N] batch and commits members
+    greedily under SHARED capacity — each member's resource fit sees the
+    usage carried from earlier members' in-scan placements, exactly the
+    joint-assignment semantics a gang needs;
+  * this wrapper turns that scan all-or-nothing: unless the scan placed
+    at least `need` members (minMember minus members already bound from
+    earlier rounds), EVERY placement is discarded on device (chosen :=
+    -1, round-robin counter rewound), so the host never observes a
+    partial gang — the carried usage dies with the program and nothing
+    was staged host-side yet.
+
+The host (sched/scheduler.py _schedule_one_gang) then replays the full
+placement through the exact int64 recheck with group-wide rollback: the
+gang either fully assumes + binds, or nothing does.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import encoding as enc
+from .kernel import Weights, _wave_body
+
+
+class GangResult(NamedTuple):
+    ok: jnp.ndarray  # bool []  placed >= need (gang admits)
+    chosen: jnp.ndarray  # i32 [G]  node index per member, all -1 unless ok
+    placed: jnp.ndarray  # i32 []  members the scan could place
+    fail_counts: jnp.ndarray  # i32 [Q, G]  first-fail per predicate
+    masks: jnp.ndarray  # bool [Q, G, N]  per-predicate pass masks
+    rr_end: jnp.ndarray  # i32  round-robin counter (rr_start unless ok)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
+    "pallas_interpret"))
+def schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
+                  tt: enc.TermTable, pb: enc.PodBatch, extra_mask,
+                  rr_start, extra_scores, need, *, weights: Weights,
+                  num_zones: int, num_label_values: int = 64,
+                  has_ipa: bool = False, use_pallas: bool = False,
+                  pallas_interpret: bool = False) -> GangResult:
+    """Joint placement of one gang's members under shared capacity.
+
+    `need`: traced i32 — how many members must place for the gang to
+    admit (minMember minus already-bound members; traced so gangs of
+    different minMember share one compiled program per G bucket).
+    `extra_mask`/`extra_scores` are the host-plugin inputs of
+    schedule_wave, applied per member identically.
+
+    Members the scan could not place keep chosen == -1 even when the
+    gang admits (minMember < gang size: the surplus parks individually);
+    when it does not admit, ALL members report -1 and the usage the scan
+    accumulated is discarded with the program state — no partial
+    placement can escape to the host.
+    """
+    res, _usage = _wave_body(nt, pm, tt, pb, extra_mask, rr_start,
+                             extra_scores, weights, num_zones,
+                             num_label_values, has_ipa, use_pallas,
+                             pallas_interpret)
+    placed = jnp.sum((res.chosen >= 0).astype(jnp.int32))
+    ok = placed >= jnp.asarray(need, jnp.int32)
+    chosen = jnp.where(ok, res.chosen, -1)
+    # a failed gang consumed no capacity, so it must not advance the
+    # selectHost round-robin either — replays stay deterministic
+    rr_end = jnp.where(ok, res.rr_end, jnp.asarray(rr_start, jnp.int32))
+    return GangResult(ok=ok, chosen=chosen, placed=placed,
+                      fail_counts=res.fail_counts, masks=res.masks,
+                      rr_end=rr_end)

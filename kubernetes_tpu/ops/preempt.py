@@ -40,36 +40,49 @@ NEG = jnp.int32(-(2**31) + 1)
 
 
 class PreemptStats:
-    """Host view over ONE fetched [4, P, N] i32 plane stack. Packing the
-    four stat planes into a single array matters on tunneled TPU
-    runtimes: each separate device->host fetch pays a flat ~65ms in the
-    degraded transfer mode, so four fetches per preemption chunk would
-    triple the chunk's device cost. Planes 0-2 (ok, victim count,
-    priority max) are native i32 — exact for the full int32 priority
-    range (Kubernetes permits ~2e9); plane 3 is the f32 priority SUM
-    bitcast to i32 for the ride and viewed back here."""
+    """Host view over ONE fetched [5, P, N] i32 plane stack. Packing the
+    stat planes into a single array matters on tunneled TPU runtimes:
+    each separate device->host fetch pays a flat ~65ms in the degraded
+    transfer mode, so five fetches per preemption chunk would multiply
+    the chunk's device cost. Planes 0-2 (ok, victim count, priority max)
+    are native i32 — exact for the full int32 priority range (Kubernetes
+    permits ~2e9); planes 3 (priority SUM) and 4 (gang-disruption
+    weight: how much the class's eviction breaks victim gangs below
+    minMember, see preemption_stats' gang_w) are f32 bitcast to i32 for
+    the ride and viewed back here."""
 
-    __slots__ = ("ok", "victims", "prio_sum", "prio_max")
+    __slots__ = ("ok", "victims", "prio_sum", "prio_max", "gang_viol")
 
     def __init__(self, packed):
         self.ok = packed[0] != 0            # [P, N] bool
         self.victims = packed[1]            # [P, N] i32
         self.prio_max = packed[2]           # [P, N] i32 (NEG sentinel)
         self.prio_sum = np.ascontiguousarray(packed[3]).view(np.float32)
+        self.gang_viol = np.ascontiguousarray(packed[4]).view(np.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_levels",))
 def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
-                     pb: enc.PodBatch, levels, *, num_levels: int):
+                     pb: enc.PodBatch, levels, *, num_levels: int,
+                     gang_w=None):
     """levels: i32 [num_levels] ascending candidate priority thresholds
     (pad with INT32_MAX). Victim class at level l for failed pod p =
     alive existing pods with priority < min(levels[l], prio_p).
 
-    Returns ONE packed i32 [4, P, N] array (see PreemptStats): plane 0
+    gang_w: optional f32 [M] per-existing-pod gang-disruption weight
+    (host-computed: 1.0 for pods whose gang has no slack above
+    minMember, 0 elsewhere; None compiles the gang-free variant). The
+    per-class segment sum ranks candidate nodes by how badly the
+    eviction breaks victim gangs — the device analog of the host
+    GangGuard, consumed as the FIRST ranking criterion so exact
+    validation slots go to gang-sparing nodes first.
+
+    Returns ONE packed i32 [5, P, N] array (see PreemptStats): plane 0
     ok, 1 victim count, 2 priority max, 3 f32 priority sum bitcast to
-    i32 — stats of the lowest feasible level; prio_max is NEG where
-    victims == 0 (a no-victim placement is ranked best by the host,
-    matching pickOneNodeForPreemption's early return)."""
+    i32, 4 f32 gang-disruption sum bitcast to i32 — stats of the lowest
+    feasible level; prio_max is NEG where victims == 0 (a no-victim
+    placement is ranked best by the host, matching
+    pickOneNodeForPreemption's early return)."""
     P = pb.req.shape[0]
     N = nt.valid.shape[0]
     R = nt.alloc.shape[1]
@@ -97,6 +110,7 @@ def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
     victims = jnp.zeros((P, N), jnp.int32)
     prio_sum = jnp.zeros((P, N), jnp.float32)
     prio_max = jnp.full((P, N), NEG)
+    gang_viol = jnp.zeros((P, N), jnp.float32)
 
     for l in range(num_levels):
         thresh = jnp.minimum(levels[l], pb.prio)  # [P]
@@ -110,9 +124,11 @@ def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
             rem_pmax = jax.ops.segment_max(
                 jnp.where(w_row > 0, pm.prio, NEG), node_ids,
                 num_segments=N)
-            return rem_req, rem_cnt, rem_psum, rem_pmax
+            rem_gang = (seg_sum(w_row * gang_w) if gang_w is not None
+                        else jnp.zeros((N,), jnp.float32))
+            return rem_req, rem_cnt, rem_psum, rem_pmax, rem_gang
 
-        rem_req, rem_cnt, rem_psum, rem_pmax = jax.vmap(per_pod)(w)
+        rem_req, rem_cnt, rem_psum, rem_pmax, rem_gang = jax.vmap(per_pod)(w)
         # resource fit with the class removed (exact recheck is host-side
         # int64; f32 here only ranks candidates). Column semantics follow
         # filters.resource_fit: core columns always checked, extended
@@ -129,10 +145,12 @@ def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
         victims = jnp.where(take, rem_cnt.astype(jnp.int32), victims)
         prio_sum = jnp.where(take, rem_psum, prio_sum)
         prio_max = jnp.where(take, rem_pmax, prio_max)
+        gang_viol = jnp.where(take, rem_gang, gang_viol)
     # a node where the pod fits with ZERO victims is not a preemption
     # candidate at all (it would have been placed) — unless usage raced;
     # keep it, the host recheck resolves
     return jnp.stack([ok.astype(jnp.int32),
                       victims,
                       prio_max,
-                      jax.lax.bitcast_convert_type(prio_sum, jnp.int32)])
+                      jax.lax.bitcast_convert_type(prio_sum, jnp.int32),
+                      jax.lax.bitcast_convert_type(gang_viol, jnp.int32)])

@@ -29,6 +29,7 @@ from typing import Callable, List, Optional, Tuple
 
 from ..api import scheme
 from ..api import types as api
+from .generation import GenerationTracker, tracks_generation
 from .store import ADDED, DELETED, MODIFIED, Conflict, Event
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
@@ -137,6 +138,11 @@ class NativeObjectStore:
         # engine revisions out of order (a DELETE overtaken by an older
         # MODIFIED would resurrect the object in informer caches)
         self._dispatch_mu = threading.Lock()
+        # spec-fingerprint generation bumps (runtime/generation.py) — the
+        # same rollout-status gating ObjectStore provides; on a reopened
+        # durable store the tracker seeds lazily from the decoded stored
+        # object, so generations survive restarts without a WAL replay
+        self._generation = GenerationTracker()
 
     def __del__(self):
         self.close()
@@ -268,6 +274,10 @@ class NativeObjectStore:
         err = ctypes.c_int(0)
         if not obj.metadata.uid:
             obj.metadata.uid = f"uid-native-{self._lib.kv_rev(self._h)+1}"
+        # generation must be stamped BEFORE encoding (part of the
+        # persisted wire form) but cached only AFTER the write lands —
+        # a duplicate-create failure must not pollute the fingerprint
+        gen_token = self._generation.prepare_create(kind, obj)
         rev = self._lib.kv_put(self._h, self._obj_key(kind, obj),
                                self._encode(obj), 0, ctypes.byref(err))
         if err.value == KV_CONFLICT:
@@ -275,6 +285,7 @@ class NativeObjectStore:
                            f"{obj.metadata.name} already exists")
         if err.value == KV_IO:
             raise OSError(f"{kind}: storage I/O error (WAL append failed)")
+        self._generation.commit(gen_token)
         obj.metadata.resource_version = rev
         self._drain()
         return obj
@@ -282,6 +293,21 @@ class NativeObjectStore:
     def update(self, kind: str, obj, expect_rv: Optional[int] = None) -> object:
         key = self._obj_key(kind, obj)
         err = ctypes.c_int(0)
+        gen_token = None
+        if tracks_generation(kind):
+            # seed the tracker from the decoded stored object ONLY when
+            # it has never seen this key (fresh process over durable
+            # data — unlike ObjectStore, callers here never hold an
+            # alias of the stored bytes, so the decoded old is a true
+            # prior snapshot); once cached, skip the kv_get + decode.
+            # The fingerprint commits only after the write lands so a
+            # CAS conflict can't swallow the retried bump.
+            old = None
+            if not self._generation.knows(kind, obj.metadata.namespace,
+                                          obj.metadata.name):
+                old = self.get(kind, obj.metadata.namespace,
+                               obj.metadata.name)
+            gen_token = self._generation.prepare_update(kind, obj, old)
         if expect_rv is None:
             # last-writer-wins but must exist (ObjectStore.update raises
             # KeyError on missing objects — an unconditional upsert would
@@ -313,6 +339,7 @@ class NativeObjectStore:
                 raise KeyError(f"{kind} {obj.metadata.name} not found")
             if err.value == KV_IO:
                 raise OSError(f"{kind}: storage I/O error")
+        self._generation.commit(gen_token)
         obj.metadata.resource_version = rev
         self._drain()
         return obj
@@ -320,6 +347,7 @@ class NativeObjectStore:
     def delete(self, kind: str, namespace: str, name: str) -> object:
         old = self.get(kind, namespace, name)
         err = ctypes.c_int(0)
+        self._generation.on_delete(kind, namespace, name)
         self._lib.kv_delete(self._h, self._key(kind, namespace, name),
                             ctypes.byref(err))
         if err.value == KV_NOT_FOUND or old is None:

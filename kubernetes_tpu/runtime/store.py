@@ -41,23 +41,10 @@ class Event:
 WatchFn = Callable[[Event], None]
 
 
-# kinds whose metadata.generation tracks spec changes (the reference
-# bumps Generation in each registry strategy's PrepareForUpdate; here
-# only the kinds whose controllers echo status.observedGeneration pay
-# the fingerprint cost — pods/nodes and the frequently status-written
-# replicasets stay off the hot path)
-_GENERATION_KINDS = frozenset({
-    "deployments", "daemonsets", "statefulsets",
-})
-
-
-def _spec_fingerprint(obj) -> str:
-    """Stable hash of the object's wire-form spec."""
-    from ..api import scheme
-    spec = getattr(obj, "spec", None)
-    if spec is None:
-        return ""
-    return scheme.stable_hash(spec)
+# generation maintenance is shared with NativeObjectStore (persistent
+# clusters need identical rollout-status gating): runtime/generation.py
+from .generation import GENERATION_KINDS as _GENERATION_KINDS
+from .generation import GenerationTracker
 
 
 class Conflict(Exception):
@@ -78,10 +65,10 @@ class ObjectStore:
         self._objects: Dict[str, Dict[str, object]] = {}
         self._rv = 0
         self._watchers: List[Tuple[Optional[str], WatchFn]] = []
-        # last stored spec fingerprint per generation-tracked object —
+        # spec-fingerprint/generation bookkeeping (shared helper —
         # callers mutate stored objects in place, so spec changes can
-        # only be detected against an independent snapshot
-        self._spec_fp: Dict[str, Dict[str, str]] = {}
+        # only be detected against an independent snapshot)
+        self._generation = GenerationTracker()
 
     @staticmethod
     def _key(obj) -> str:
@@ -119,9 +106,7 @@ class ObjectStore:
                 raise Conflict(f"{kind} {key} already exists")
             self._rv += 1
             obj.metadata.resource_version = self._rv
-            if kind in _GENERATION_KINDS:
-                obj.metadata.generation = obj.metadata.generation or 1
-                self._spec_fp.setdefault(kind, {})[key] = _spec_fingerprint(obj)
+            self._generation.on_create(kind, obj)
             objs[key] = obj
             ev = Event(ADDED, kind, obj, resource_version=self._rv)
             self._notify(ev)
@@ -139,21 +124,10 @@ class ObjectStore:
                     f"{kind} {key}: rv {old.metadata.resource_version} != {expect_rv}")
             self._rv += 1
             obj.metadata.resource_version = self._rv
-            if kind in _GENERATION_KINDS:
-                # registry PrepareForUpdate analog: generation advances
-                # only on SPEC change (status-only writes leave it).
-                # Fingerprints are compared against the last stored wire
-                # form because callers routinely mutate the stored object
-                # in place before calling update
-                fp = _spec_fingerprint(obj)
-                fps = self._spec_fp.setdefault(kind, {})
-                prior = max(obj.metadata.generation,
-                            getattr(old.metadata, "generation", 0), 1)
-                if fps.get(key) != fp:
-                    obj.metadata.generation = prior + 1
-                    fps[key] = fp
-                else:
-                    obj.metadata.generation = prior
+            # NOTE: `old` is usually the same in-place-mutated object the
+            # caller passed; the tracker compares against its stored
+            # fingerprint, never against `old`'s current state
+            self._generation.on_update(kind, obj, old)
             objs[key] = obj
             self._notify(Event(MODIFIED, kind, obj, old=old, resource_version=self._rv))
             return obj
@@ -165,7 +139,7 @@ class ObjectStore:
             old = objs.pop(key, None)
             if old is None:
                 raise KeyError(f"{kind} {key} not found")
-            self._spec_fp.get(kind, {}).pop(key, None)
+            self._generation.on_delete(kind, namespace, name)
             self._rv += 1
             # stamp the deletion revision (etcd delete ModRevision analog) so
             # watch clients advance past this event instead of replaying it

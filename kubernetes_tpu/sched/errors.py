@@ -33,6 +33,10 @@ REASONS = {
     "NodeUnknownCondition": "node(s) had unknown conditions",
     "VolumeNodeAffinityConflict": "node(s) had volume node affinity conflict",
     "VolumeBindingNoMatch": "node(s) didn't find available persistent volumes to bind",
+    # gang scheduling (forward-port, sched/gang.py): the joint-assignment
+    # scan could not place minMember pods simultaneously. Deliberately
+    # NOT in UNRESOLVABLE — evicting victims can free gang capacity.
+    "Gang": "pod group could not be placed in full",
 }
 
 # Failure reasons preemption cannot resolve by evicting pods — EXACTLY the

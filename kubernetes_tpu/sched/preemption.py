@@ -61,6 +61,39 @@ def nodes_where_preemption_might_help(
     return out
 
 
+class GangGuard:
+    """Victim-gang integrity, PDB-style (gang forward-port): evicting a
+    member that would drop its gang below minMember is a disruption
+    violation. Like a PDB's disruptionsAllowed, each gang carries a
+    slack budget (placed - minMember); victims beyond it land in the
+    violating list, so the reprieve loop preferentially spares them and
+    pick_one_node's first criterion steers preemption toward nodes where
+    only slack members (or whole gangs) die."""
+
+    def __init__(self, key_fn: Callable[[api.Pod], Optional[str]],
+                 slack: Dict[str, int]):
+        self.key_fn = key_fn
+        self._slack = dict(slack)
+
+    def split(self, pods: Sequence[api.Pod]):
+        """-> (violating, ok), consuming slack in the given order (the
+        caller passes highest-priority-first, matching PDB counting)."""
+        remaining = dict(self._slack)
+        violating, ok = [], []
+        for p in pods:
+            key = self.key_fn(p)
+            if key is None:
+                ok.append(p)
+                continue
+            r = remaining.get(key, 0)
+            if r > 0:
+                remaining[key] = r - 1
+                ok.append(p)
+            else:
+                violating.append(p)
+        return violating, ok
+
+
 def _pods_violating_pdb(pods: Sequence[api.Pod],
                         pdbs: Sequence[api.PodDisruptionBudget]):
     """Reference :862 filterPodsWithPDBViolation. A pod violates if it
@@ -87,6 +120,7 @@ def select_victims_on_node(
         pdbs: Sequence[api.PodDisruptionBudget],
         node_infos: Optional[Dict[str, NodeInfo]] = None,
         extra_fit: Optional[Callable[[api.Pod, NodeInfo], bool]] = None,
+        gang_guard: Optional[GangGuard] = None,
         ) -> Optional[Tuple[List[api.Pod], int]]:
     """Reference :898. Returns (victims, numPDBViolations) or None.
     node_infos enables inter-pod affinity in the what-if (the cloned
@@ -94,7 +128,9 @@ def select_victims_on_node(
     shared metadata consistent, metadata.go:141). extra_fit folds the
     scheduler's host plugins (volume predicates etc.) into the what-if —
     victim removal can resolve NoDiskConflict/MaxVolumeCount, and nodes
-    failing unresolvable host predicates must not produce victims."""
+    failing unresolvable host predicates must not produce victims.
+    gang_guard treats victim-gang minMember as a disruption budget (see
+    GangGuard) — gang-breaking evictions count into numPDBViolations."""
     copy = ni.clone()
     view = (golden.ClusterView(node_infos, override=copy)
             if node_infos is not None else None)
@@ -113,6 +149,9 @@ def select_victims_on_node(
     victims: List[api.Pod] = []
     num_violating = 0
     violating, non_violating = _pods_violating_pdb(potential, pdbs)
+    if gang_guard is not None:
+        gang_violating, non_violating = gang_guard.split(non_violating)
+        violating = violating + gang_violating
 
     def reprieve(p: api.Pod) -> bool:
         copy.add_pod(p)
@@ -186,7 +225,9 @@ def preempt(pod: api.Pod, cache: SchedulerCache,
             failed_predicates: Dict[str, List[str]],
             pdbs: Sequence[api.PodDisruptionBudget],
             with_affinity: bool = False,
-            extenders=(), extra_fit=None) -> Optional[PreemptionResult]:
+            extenders=(), extra_fit=None,
+            gang_guard: Optional[GangGuard] = None
+            ) -> Optional[PreemptionResult]:
     """Reference :200 Preempt. Returns None when preemption can't help.
     with_affinity: evaluate MatchInterPodAffinity in the what-if (pass
     when any affinity terms exist in the cluster)."""
@@ -198,7 +239,8 @@ def preempt(pod: api.Pod, cache: SchedulerCache,
         ni = cache.node_infos.get(node_name)
         if ni is None or ni.node is None:
             continue
-        sel = select_victims_on_node(pod, ni, pdbs, node_infos, extra_fit)
+        sel = select_victims_on_node(pod, ni, pdbs, node_infos, extra_fit,
+                                     gang_guard)
         if sel is not None:
             candidates[node_name] = sel
     if extenders:

@@ -27,6 +27,14 @@ wavefront of pods in one call — the device schedules them in a single
 fused kernel invocation while preserving priority order inside the wave
 (the scan commits in pop order, so higher-priority pods still claim
 capacity first, matching one-at-a-time placement semantics).
+
+Gang admission (coscheduling, sched/gang.py): pods carrying a pod-group
+annotation park in a gang waiting area — NOT the active heap — until
+minMember members exist; the whole gang then releases at once, and
+pop_wave never splits a gang across waves (members travel together so
+the joint-assignment kernel sees the entire gang in one batch). The
+`gang_lookup` hook is wired by the scheduler; when it is None (every
+non-gang deployment) none of this code runs.
 """
 
 from __future__ import annotations
@@ -82,6 +90,15 @@ class SchedulingQueue:
         # uid -> first time the pod entered the active queue (consumed by
         # the scheduler's per-pod e2e latency metric at commit)
         self.added_at: Dict[str, float] = {}
+        # gang admission: pods of an incomplete gang wait here instead of
+        # the active heap. gang_lookup(pod) -> (key, minMember) | None;
+        # on_gang_released(key, waited_s) feeds the gang_wait metric.
+        self.gang_lookup: Optional[Callable] = None
+        self.on_gang_released: Optional[Callable[[str, float], None]] = None
+        self._gang_waiting: Dict[str, Dict[str, api.Pod]] = {}
+        self._gang_members: Dict[str, set] = {}  # pending+placed uids
+        self._gang_of: Dict[str, str] = {}  # uid -> gang key
+        self._gang_wait_start: Dict[str, float] = {}
         self._closed = False
 
     # -- add / pop -----------------------------------------------------------
@@ -91,11 +108,29 @@ class SchedulingQueue:
         return (prio, next(self._seq), pod.uid)
 
     def add(self, pod: api.Pod):
+        released = None
         with self._lock:
             if pod.uid in self._items:
                 return
             self._unschedulable.pop(pod.uid, None)
             self._backoff.pop(pod.uid, None)
+            info = (self.gang_lookup(pod) if self.gang_lookup is not None
+                    else None)
+            if info is not None:
+                key, min_member = info
+                self._gang_of[pod.uid] = key
+                members = self._gang_members.setdefault(key, set())
+                members.add(pod.uid)
+                if len(members) < min_member:
+                    # incomplete gang: park — a half-formed gang entering
+                    # the wave would either deadlock capacity against
+                    # another half-formed gang or fail every round
+                    self._gang_waiting.setdefault(key, {})[pod.uid] = pod
+                    self._gang_wait_start.setdefault(key, self.clock())
+                    return
+                # minMember reached: this pod AND every parked member
+                # enter the active heap together
+                released = self._release_gang_locked(key)
             self._items[pod.uid] = pod
             # first enqueue time survives requeues: per-pod e2e scheduling
             # latency measures from when the pod first became schedulable
@@ -105,11 +140,79 @@ class SchedulingQueue:
                 self._nominated.setdefault(
                     pod.status.nominated_node_name, {})[pod.uid] = pod
             self._lock.notify()
+        if released is not None and self.on_gang_released is not None:
+            self.on_gang_released(*released)
+
+    def _gang_waiting_has_locked(self, uid: str) -> bool:
+        key = self._gang_of.get(uid)
+        return key is not None and uid in self._gang_waiting.get(key, ())
+
+    def _release_gang_locked(self, key: str):
+        """Move every parked member of `key` to the active heap. Returns
+        (key, waited_seconds) when a wait window closes, else None."""
+        waiting = self._gang_waiting.pop(key, None)
+        started = self._gang_wait_start.pop(key, None)
+        if waiting:
+            for uid, p in waiting.items():
+                self._items[uid] = p
+                self.added_at.setdefault(uid, self.clock())
+                heapq.heappush(self._heap, self._key(p))
+            self._lock.notify_all()
+        if started is None:
+            return None
+        return key, self.clock() - started
+
+    def gang_reevaluate(self):
+        """Re-check waiting gangs against current minMember — called when
+        a PodGroup object appears or changes (a PodGroup created AFTER
+        its pods may lower the bar below the member count)."""
+        released = []
+        with self._lock:
+            if self.gang_lookup is None:
+                return
+            for key in list(self._gang_waiting):
+                waiting = self._gang_waiting.get(key)
+                if not waiting:
+                    continue
+                sample = next(iter(waiting.values()))
+                info = self.gang_lookup(sample)
+                min_member = info[1] if info is not None else 1
+                if len(self._gang_members.get(key, ())) >= min_member:
+                    r = self._release_gang_locked(key)
+                    if r is not None:
+                        released.append(r)
+        if self.on_gang_released is not None:
+            for r in released:
+                self.on_gang_released(*r)
+
+    def gang_forget(self, pod: api.Pod):
+        """Drop a pod from gang accounting without touching the queues —
+        for members that left the cluster while BOUND (the queue never
+        saw their deletion through delete())."""
+        with self._lock:
+            self._gang_cleanup_locked(pod.uid)
+
+    def _gang_cleanup_locked(self, uid: str):
+        key = self._gang_of.pop(uid, None)
+        if key is None:
+            return
+        members = self._gang_members.get(key)
+        if members is not None:
+            members.discard(uid)
+            if not members:
+                del self._gang_members[key]
+        waiting = self._gang_waiting.get(key)
+        if waiting is not None:
+            waiting.pop(uid, None)
+            if not waiting:
+                del self._gang_waiting[key]
+                self._gang_wait_start.pop(key, None)
 
     def add_if_not_present(self, pod: api.Pod):
         with self._lock:
             if (pod.uid in self._items or pod.uid in self._unschedulable
-                    or pod.uid in self._backoff):
+                    or pod.uid in self._backoff
+                    or self._gang_waiting_has_locked(pod.uid)):
                 return
         self.add(pod)
 
@@ -132,7 +235,8 @@ class SchedulingQueue:
         schedulable again); the backoff gate still applies."""
         with self._lock:
             if (pod.uid in self._items or pod.uid in self._unschedulable
-                    or pod.uid in self._backoff):
+                    or pod.uid in self._backoff
+                    or self._gang_waiting_has_locked(pod.uid)):
                 return
             cycle = self._cycle.pop(pod.uid, self._current_cycle)
             if self._move_request_cycle >= cycle:
@@ -201,19 +305,51 @@ class SchedulingQueue:
                 return pod
         return None
 
-    def pop_wave(self, max_n: int, timeout: Optional[float] = None) -> List[api.Pod]:
-        """Drain up to max_n pods in priority order (blocks for the first)."""
+    def _pop_gangmates_locked(self, pod: api.Pod) -> List[api.Pod]:
+        """Pop every ACTIVE gangmate of `pod` (their heap entries go
+        stale and are skipped by _pop_locked later). The gang travels as
+        one unit into the wave so the joint-assignment kernel sees the
+        whole group; mates parked in backoff/unschedulable are not
+        touched — gang failure parks them together anyway."""
+        key = self._gang_of.get(pod.uid)
+        if key is None:
+            return []
         out = []
+        for uid in list(self._gang_members.get(key, ())):
+            mate = self._items.pop(uid, None)
+            if mate is not None:
+                self._current_cycle += 1
+                self._cycle[uid] = self._current_cycle
+                out.append(mate)
+        return out
+
+    def pop_wave(self, max_n: int, timeout: Optional[float] = None) -> List[api.Pod]:
+        """Drain up to max_n pods in priority order (blocks for the
+        first). Gangs are never split across the max_n boundary: a gang
+        that doesn't fit in the remaining budget is pushed back whole for
+        the next wave; a gang leading the wave may exceed max_n (it MUST
+        be evaluated in one batch to fail or place atomically)."""
+        out: List[api.Pod] = []
         first = self.pop(timeout)
         if first is None:
             return out
         out.append(first)
         with self._lock:
+            out.extend(self._pop_gangmates_locked(first))
             while len(out) < max_n:
                 pod = self._pop_locked()
                 if pod is None:
                     break
+                mates = self._pop_gangmates_locked(pod)
+                if len(out) + 1 + len(mates) > max_n:
+                    # would split the gang across waves: requeue it whole
+                    # (priority preserved; FIFO position resets)
+                    for p in [pod] + mates:
+                        self._items[p.uid] = p
+                        heapq.heappush(self._heap, self._key(p))
+                    break
                 out.append(pod)
+                out.extend(mates)
         return out
 
     # -- event-driven moves ---------------------------------------------------
@@ -265,6 +401,9 @@ class SchedulingQueue:
             if new.uid in self._backoff:
                 self._backoff[new.uid] = new
                 return
+            if self._gang_waiting_has_locked(new.uid):
+                self._gang_waiting[self._gang_of[new.uid]][new.uid] = new
+                return
             if new.uid in self._unschedulable:
                 if old is not None and not self._is_pod_updated(old, new):
                     self._unschedulable[new.uid] = new  # status-only change
@@ -283,6 +422,11 @@ class SchedulingQueue:
             self._backoff.pop(pod.uid, None)
             self._backoff_until.pop(pod.uid, None)
             self.added_at.pop(pod.uid, None)
+            # gang accounting must shrink with the member, or a stale uid
+            # would open the gate early and place a sub-minMember gang;
+            # the survivors stay parked until a replacement completes the
+            # gang again (gang_reevaluate / the next member add)
+            self._gang_cleanup_locked(pod.uid)
             nom = self._nominated.get(pod.status.nominated_node_name)
             if nom:
                 nom.pop(pod.uid, None)
@@ -305,7 +449,12 @@ class SchedulingQueue:
     def pending_count(self) -> int:
         with self._lock:
             return (len(self._items) + len(self._unschedulable)
-                    + len(self._backoff))
+                    + len(self._backoff)
+                    + sum(len(w) for w in self._gang_waiting.values()))
+
+    def gang_waiting_count(self) -> int:
+        with self._lock:
+            return sum(len(w) for w in self._gang_waiting.values())
 
     def active_count(self) -> int:
         with self._lock:

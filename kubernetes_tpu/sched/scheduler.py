@@ -48,9 +48,11 @@ from ..utils.feature_gates import FeatureGates
 from .equivalence import EquivalenceCache, equivalence_class
 from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
 from .extender import ExtenderError
-from .preemption import (PreemptionResult, get_lower_priority_nominated_pods,
-                         pick_one_node, pod_eligible_to_preempt_others,
-                         preempt, process_preemption_with_extenders,
+from .gang import GangDirectory
+from .preemption import (GangGuard, PreemptionResult,
+                         get_lower_priority_nominated_pods, pick_one_node,
+                         pod_eligible_to_preempt_others, preempt,
+                         process_preemption_with_extenders,
                          select_victims_on_node)
 from .queue import SchedulingQueue
 
@@ -168,6 +170,14 @@ class Scheduler:
             pod_priority_enabled=self.features.enabled("PodPriority"),
             clock=clock)
         self.metrics = Metrics()
+        # gang (PodGroup) coscheduling: the queue parks incomplete gangs
+        # and the wave path routes complete ones through the
+        # joint-assignment kernel (ops/gang.py). Costs non-gang pods one
+        # annotation lookup at enqueue and one per wave partition.
+        self.gangs = GangDirectory(store)
+        self.queue.gang_lookup = self.gangs.lookup
+        self.queue.on_gang_released = (
+            lambda key, waited: self.metrics.gang_wait_seconds.observe(waited))
         self.backoff = PodBackoff(clock=clock)
         from .volume_binder import VolumeBinder
 
@@ -245,6 +255,11 @@ class Scheduler:
                 on_add=lambda o: self._invalidate_features(),
                 on_update=lambda o, n: self._invalidate_features(),
                 on_delete=lambda o: self._invalidate_features())
+        # a PodGroup created/updated AFTER its pods may complete a gang
+        # that was parked against a higher annotation-derived minMember
+        SharedInformer(self.store, "podgroups").add_event_handler(
+            on_add=lambda o: self.queue.gang_reevaluate(),
+            on_update=lambda o, n: self.queue.gang_reevaluate())
         if self.ecache is not None:
             # targeted ecache invalidation (factory.go:191-295 wiring).
             # Must serialize with _run_wave under _mu like the pod/node
@@ -311,6 +326,10 @@ class Scheduler:
                 if ni is not None:
                     self.snapshot.refresh_node_resources(ni)
                 self.snapshot.remove_pod(pod)
+                # a BOUND gang member leaving must shrink its gang's
+                # member count, or a stale uid would open the admission
+                # gate for a sub-minMember gang
+                self.queue.gang_forget(pod)
                 self.queue.move_all_to_active()
             else:
                 self.queue.delete(pod)
@@ -438,9 +457,19 @@ class Scheduler:
         if not all_pods:
             return 0
         with self._mu:
+            placed = 0
+            # gangs bypass the device-resident round: their placements
+            # must be all-or-nothing per group, which the round's
+            # staged-commit carry can't express — the joint-assignment
+            # kernel (ops/gang.py) owns them. One annotation lookup per
+            # pod; zero extra work when no gang pods exist.
+            gang_pods = [p for p in all_pods if self.gangs.key(p) is not None]
+            if gang_pods:
+                all_pods = [p for p in all_pods
+                            if self.gangs.key(p) is None]
+                placed += self._schedule_gangs(gang_pods)
             host_path = [p for p in all_pods
                          if self.featurizer.needs_host_path(p)]
-            placed = 0
             for p in host_path:
                 placed += self._schedule_host_path(p)
             pods = [p for p in all_pods
@@ -753,13 +782,30 @@ class Scheduler:
         if not prios:
             return set()
         levels = prios + [prios[-1]] * (PREEMPT_LEVELS - len(prios))
+        # victim-gang awareness: weight 1 for placed members of gangs
+        # with no slack above minMember (any eviction breaks them); the
+        # per-class segment sum ranks gang-sparing nodes first. None for
+        # gang-free clusters — same compiled program as before.
+        gang_w = None
+        guard, placed_gangs, gang_mins = self._gang_state()
+        if guard is not None:
+            w = np.zeros((self.snapshot.caps.M,), np.float32)
+            for gkey, gmembers in placed_gangs.items():
+                if len(gmembers) <= gang_mins[gkey]:
+                    for gp in gmembers:
+                        slot = self.snapshot.pod_slot.get(gp.uid)
+                        if slot is not None:
+                            w[slot] = 1.0
+            if w.any():
+                gang_w = jnp.asarray(w)
         packed = preemption_stats(
             nt, pm, pb, jnp.asarray(levels, jnp.int32),
-            num_levels=PREEMPT_LEVELS)
+            num_levels=PREEMPT_LEVELS, gang_w=gang_w)
         trace.step("dispatched")
         st = PreemptStats(np.asarray(packed))  # ONE fetch for all planes
         ok, victims_n = st.ok, st.victims
         psum, pmax = st.prio_sum, st.prio_max
+        gviol = st.gang_viol
         trace.step("fetched")
         pdbs = self._pdbs()
         handled: set = set()
@@ -778,8 +824,8 @@ class Scheduler:
             # re-rank the validated candidates below
             order = sorted(
                 cand_nodes.tolist(),
-                key=lambda n: (float(pmax[i, n]), float(psum[i, n]),
-                               float(victims_n[i, n])))
+                key=lambda n: (float(gviol[i, n]), float(pmax[i, n]),
+                               float(psum[i, n]), float(victims_n[i, n])))
             aff = pod.spec.affinity
             with_aff = bool(self.snapshot.has_affinity_terms
                             or (aff is not None
@@ -815,7 +861,7 @@ class Scheduler:
                     for cp in claimed[name]:
                         ni.add_pod(cp)
                 sel = select_victims_on_node(pod, ni, pdbs, node_infos,
-                                             self._host_extra_fit)
+                                             self._host_extra_fit, guard)
                 if sel is not None:
                     validated[name] = sel
                 elif claimed.get(name):
@@ -850,10 +896,20 @@ class Scheduler:
         import jax
         import jax.numpy as jnp
 
+        # gang members place through the all-or-nothing joint-assignment
+        # path; pop_wave delivers gangs whole, so this partition never
+        # sees a fragment of a released gang
+        placed_gang = 0
+        gang_pods = [p for p in pods if self.gangs.key(p) is not None]
+        if gang_pods:
+            pods = [p for p in pods if self.gangs.key(p) is None]
+            placed_gang = self._schedule_gangs(gang_pods)
+            if not pods:
+                return placed_gang
         # pods whose required pod-(anti)affinity spans >1 topology key take
         # the exact host path (ops/affinity.py single-anchor limitation)
         host_path = [p for p in pods if self.featurizer.needs_host_path(p)]
-        placed_host = 0
+        placed_host = placed_gang
         if host_path:
             pods = [p for p in pods if not self.featurizer.needs_host_path(p)]
             for p in host_path:
@@ -1003,7 +1059,8 @@ class Scheduler:
                       for n, rs in failed.items()}
                 pr = preempt(pod, self.cache, fp, self._pdbs(), with_affinity=True,
                              extenders=self.profile.extenders,
-                             extra_fit=self._host_extra_fit)
+                             extra_fit=self._host_extra_fit,
+                             gang_guard=self._gang_guard())
                 if pr is not None:
                     self._perform_preemption(pod, pr)
             self._park_with_backoff(pod)
@@ -1038,6 +1095,240 @@ class Scheduler:
             return 1
         self.queue.add_if_not_present(pod)
         return 0
+
+    # -- gang (PodGroup) scheduling --------------------------------------------
+
+    def _schedule_gangs(self, pods: List[api.Pod]) -> int:
+        """All-or-nothing placement for the wave's gang pods, grouped by
+        PodGroup. Gangs are committed one group at a time so the second
+        gang's device pass sees the first gang's assumed usage (the
+        snapshot re-uploads its dirty resource group) — two gangs
+        contending for the same nodes can therefore never interleave
+        partial placements: the loser fails whole."""
+        groups: Dict[str, List[api.Pod]] = {}
+        for p in pods:
+            groups.setdefault(self.gangs.key(p), []).append(p)
+        placed = 0
+        for key, members in groups.items():
+            placed += self._schedule_one_gang(key, members)
+        return placed
+
+    def _schedule_one_gang(self, key: str, members: List[api.Pod]) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.gang import schedule_gang
+
+        self.metrics.gang_schedule_attempts.inc()
+        for _p in members:
+            self.metrics.schedule_attempts.inc()
+        min_member = self.gangs.min_member(members[0])
+        bound = self.gangs.bound_count(self.cache, key,
+                                       exclude={p.uid for p in members})
+        # members already holding capacity (earlier rounds, or a bind
+        # retry straggler) count toward minMember: the wave only needs
+        # to place the remainder
+        need = max(min_member - bound, 0)
+        placed = 0
+        host_path = [p for p in members if self.featurizer.needs_host_path(p)]
+        if host_path:
+            # multi-topology-key required affinity can't be device-
+            # encoded; such members take the exact host path one at a
+            # time — atomicity is not offered for this combination
+            for p in host_path:
+                placed += self._schedule_host_path(p)
+            members = [p for p in members
+                       if not self.featurizer.needs_host_path(p)]
+            if not members:
+                return placed
+        pb = self.featurizer.featurize(members)
+        P = pb.req.shape[0]
+        try:
+            extra = self._host_plugin_mask(members, P)
+            extra_scores = self._host_score_matrix(members, P)
+        except ExtenderError:
+            for p in members:
+                self._park_with_backoff(p)
+            return placed
+        nt, pm, tt = self.snapshot.to_device()
+        if self._rr is None:
+            self._rr = jnp.asarray(0, jnp.int32)
+        if self._use_pallas is None:
+            self._use_pallas = pallas_default()
+        has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
+                       or pb.rn_has.any() or (pb.pa_w != 0).any())
+        kw = dict(weights=self.profile.weights(),
+                  num_zones=self.snapshot.caps.Z,
+                  num_label_values=self.snapshot.num_label_values,
+                  has_ipa=has_ipa)
+        try:
+            res = schedule_gang(nt, pm, tt, pb, extra, self._rr,
+                                extra_scores, jnp.asarray(need, jnp.int32),
+                                use_pallas=self._use_pallas, **kw)
+            jax.block_until_ready(res)
+        except Exception as e:
+            if not self._use_pallas:
+                raise
+            import sys
+
+            print(f"# gang wave failed with pallas enabled, retrying on "
+                  f"the pure-XLA path: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            self._use_pallas = False
+            try:
+                res = schedule_gang(nt, pm, tt, pb, extra, self._rr,
+                                    extra_scores,
+                                    jnp.asarray(need, jnp.int32),
+                                    use_pallas=False, **kw)
+                jax.block_until_ready(res)
+            except Exception:
+                self._use_pallas = True
+                raise
+        self._last_path = "pallas" if self._use_pallas else "xla"
+        chosen = np.asarray(res.chosen)
+        if not bool(np.asarray(res.ok)):
+            self._fail_gang(key, members, need, res)
+            return placed
+        self._rr = res.rr_end
+        pairs: List = []
+        leftover: List = []
+        for i, pod in enumerate(members):
+            n = int(chosen[i])
+            if n >= 0:
+                pairs.append((pod, self.snapshot.node_names[n]))
+            else:
+                leftover.append((i, pod))
+        if not self._commit_gang(pairs):
+            # exact int64 recheck lost a race with device f32 arithmetic:
+            # retry the whole gang next wave, not unschedulable
+            for pod in members:
+                self.queue.add_if_not_present(pod)
+            return placed
+        self.backoff.clear("gang:" + key)
+        # surplus members beyond minMember that didn't fit park
+        # individually with normal per-pod attribution
+        if leftover:
+            fail_counts = np.asarray(res.fail_counts)
+            for i, pod in leftover:
+                self._handle_failure(pod, i, fail_counts, res)
+        return placed + len(pairs)
+
+    def _fail_gang(self, key: str, members: List[api.Pod], need: int, res):
+        """minMember pods can't hold capacity simultaneously: no member
+        commits (the device already discarded the scan's placements),
+        every member parks with ONE shared backoff deadline — the gang
+        fails, waits, and retries as a unit — and gang-aware preemption
+        runs so a higher-priority gang can evict its way in."""
+        n_nodes = int(np.sum(self.snapshot.valid))
+        short = max(need - int(np.asarray(res.placed)), 1)
+        err = FitError(key, n_nodes, {REASONS["Gang"]: short})
+        # park FIRST: the preemption below emits store events (nominated-
+        # node writes, victim deletes) whose queue.update would re-add a
+        # not-yet-parked member to the ACTIVE heap — the gang would then
+        # retry as shrinking subsets instead of waiting out its backoff
+        until = self.clock() + self.backoff.get_backoff("gang:" + key)
+        for pod in members:
+            self.metrics.pods_failed.inc()
+            self.queue.set_backoff(pod.uid, until)
+            self.queue.add_unschedulable_if_not_present(pod)
+            self.store.set_pod_condition(
+                pod, ("PodScheduled", "False:" + err.message()))
+        if (self.features.enabled("PodPriority")
+                and not self.profile.disable_preemption):
+            t0 = self.clock()
+            guard = self._gang_guard()
+            # claimed: nodes earlier members already nominated — each
+            # member must free a DIFFERENT node or the gang re-fails with
+            # one slot freed (the host analog of _preempt_chunk's claim
+            # accounting, scoped to this gang)
+            claimed: set = set()
+            for i, pod in enumerate(members):
+                self.metrics.total_preemption_attempts.inc()
+                fp = {n: preds for n, preds in
+                      self._failed_predicates_by_node(res, i).items()
+                      if n not in claimed}
+                pr = preempt(pod, self.cache, fp, self._pdbs(),
+                             with_affinity=self.snapshot.has_affinity_terms
+                             or _pod_has_ipa_terms(pod),
+                             extenders=self.profile.extenders,
+                             extra_fit=self._host_extra_fit,
+                             gang_guard=guard)
+                if pr is not None:
+                    claimed.add(pr.node_name)
+                    self._perform_preemption(pod, pr)
+            self.metrics.preemption_evaluation.observe(self.clock() - t0)
+
+    def _commit_gang(self, pairs) -> bool:
+        """Group-wide exact commit: EVERY member passes the int64
+        recheck and assumes before any bind dispatches; one failure
+        rolls the entire group back (forget + snapshot restore + volume
+        rollback) so a partially-bound gang can never reach the store.
+        Per-member mechanics mirror _commit."""
+        assumed: List = []  # (pod, bound, node_name, vol_rollback)
+        ok = True
+        for pod, node_name in pairs:
+            ni = self.cache.node_infos.get(node_name)
+            if ni is None or not ni.fits_exactly(pod):
+                ok = False
+                break
+            vol_rollback = None
+            if (self.features.enabled("VolumeScheduling")
+                    and self.volume_binder.pod_has_claims(pod)):
+                got, vol_rollback = self.volume_binder.bind_pod_volumes(
+                    pod, ni.node)
+                if not got:
+                    ok = False
+                    break
+            bound = api.with_node_name(pod, node_name)
+            self.cache.assume_pod(bound)
+            self.snapshot.refresh_node_resources(
+                self.cache.node_infos[node_name])
+            self.snapshot.add_pod(bound)
+            assumed.append((pod, bound, node_name, vol_rollback))
+        if not ok:
+            for pod, bound, node_name, vol_rollback in reversed(assumed):
+                try:
+                    self.cache.forget_pod(bound)
+                except KeyError:
+                    pass
+                ni = self.cache.node_infos.get(node_name)
+                if ni is not None:
+                    self.snapshot.refresh_node_resources(ni)
+                self.snapshot.remove_pod(bound)
+                if vol_rollback is not None:
+                    vol_rollback()
+            return False
+        for pod, bound, node_name, vol_rollback in assumed:
+            if self._bind_pool is None:
+                self._bind_and_finish(pod, bound, node_name, vol_rollback)
+                continue
+            fut = self._bind_pool.submit(self._bind_and_finish, pod, bound,
+                                         node_name, vol_rollback)
+            with self._inflight_mu:
+                self._inflight.add(fut)
+                self.bind_overlap_hwm = max(self.bind_overlap_hwm,
+                                            len(self._inflight))
+            fut.add_done_callback(self._bind_done)
+        return True
+
+    def _gang_state(self):
+        """(GangGuard, placed-members map, minMember map) from ONE cache
+        scan, or (None, {}, {}) when the cluster has never seen a gang
+        pod — the flag check keeps gang-free preemption paths at zero
+        added cost."""
+        if not self.gangs.active:
+            return None, {}, {}
+        placed = self.gangs.placed_by_gang(self.cache)
+        if not placed:
+            return None, {}, {}
+        mins = {key: self.gangs.min_member_by_key(key, sample=members[0])
+                for key, members in placed.items()}
+        slack = {key: max(len(members) - mins[key], 0)
+                 for key, members in placed.items()}
+        return GangGuard(self.gangs.key, slack), placed, mins
+
+    def _gang_guard(self) -> Optional[GangGuard]:
+        return self._gang_state()[0]
 
     # -- commit path -----------------------------------------------------------
 
@@ -1243,7 +1534,8 @@ class Scheduler:
                          self._pdbs(),
                          with_affinity=self.snapshot.has_affinity_terms or pod_has_ipa,
                          extenders=self.profile.extenders,
-                         extra_fit=self._host_extra_fit)
+                         extra_fit=self._host_extra_fit,
+                         gang_guard=self._gang_guard())
             self.metrics.preemption_evaluation.observe(self.clock() - t0)
             if pr is not None:
                 self._perform_preemption(pod, pr)
@@ -1265,16 +1557,41 @@ class Scheduler:
 
     def _perform_preemption(self, pod: api.Pod, pr):
         """Reference: scheduler.go:233-256 — nominate, evict victims, clear
-        lower nominations."""
+        lower nominations. Gang extension: when the evictions drop a
+        victim gang below its minMember, the gang's REMAINING members are
+        evicted too (cluster-wide) — a sub-minMember gang holds capacity
+        while doing no useful work, the exact deadlock gang scheduling
+        exists to prevent; its controller recreates the pods and the gang
+        re-forms through the waiting area."""
         pod.status.nominated_node_name = pr.node_name
         self.store.set_nominated_node(pod, pr.node_name)
         self.queue.update_nominated_pod(pod, pr.node_name)
+        victim_gangs = set()
         for victim in pr.victims:
+            if self.gangs.active:
+                k = self.gangs.key(victim)
+                if k is not None:
+                    victim_gangs.add(k)
             self.metrics.pod_preemption_victims.inc()
             try:
                 self.store.delete("pods", victim.namespace, victim.metadata.name)
             except KeyError:
                 pass
+        victim_uids = {v.uid for v in pr.victims}
+        for gkey in victim_gangs:
+            remaining = [p for p in self.gangs.placed_members(self.cache, gkey)
+                         if p.uid not in victim_uids]
+            if not remaining:
+                continue
+            m = self.gangs.min_member_by_key(gkey, sample=remaining[0])
+            if len(remaining) >= m:
+                continue
+            for p in remaining:
+                self.metrics.pod_preemption_victims.inc()
+                try:
+                    self.store.delete("pods", p.namespace, p.metadata.name)
+                except KeyError:
+                    pass
         for lower in get_lower_priority_nominated_pods(pod, pr.node_name, self.queue):
             lower.status.nominated_node_name = ""
             self.queue.update_nominated_pod(lower, "")

@@ -804,9 +804,33 @@ class APIServer:
 
     @staticmethod
     def _check_crd_schema(crd):
-        """Structural 422 for a CRD's openAPIV3Schema — one gate for
-        create AND update (a replace must not smuggle in the broken
-        pattern create would have refused)."""
+        """Structural 422 for a CRD's openAPIV3Schema and subresource
+        declarations — one gate for create AND update (a replace must
+        not smuggle in the broken pattern create would have refused)."""
+        sub = crd.spec.subresources
+        if sub is not None and sub.scale is not None:
+            # apiextensions validation.go ValidateCustomResourceDefinition
+            # Subresources: the dotted replica paths must live under
+            # .spec/.status — anything else would make every /scale write
+            # a silent no-op (dotted_set grafts into a dead branch) while
+            # the HPA retry-loops against it
+            sc = sub.scale
+
+            def _under(path, root):
+                # dot-boundary check: '.specSelector.n' must NOT pass as
+                # being under '.spec'
+                return path == root or (path or "").startswith(root + ".")
+
+            if not _under(sc.spec_replicas_path, ".spec"):
+                raise APIError(
+                    422, "Invalid",
+                    f"spec.subresources.scale.specReplicasPath: "
+                    f"{sc.spec_replicas_path!r} must begin with .spec")
+            if not _under(sc.status_replicas_path, ".status"):
+                raise APIError(
+                    422, "Invalid",
+                    f"spec.subresources.scale.statusReplicasPath: "
+                    f"{sc.status_replicas_path!r} must begin with .status")
         if crd.spec.validation is None:
             return
         from ..api.crdschema import schema_errors

@@ -101,6 +101,11 @@ class Metrics:
         self.pod_preemption_victims = Counter("pod_preemption_victims")
         self.total_preemption_attempts = Counter("total_preemption_attempts")
         self.schedule_attempts = Counter("schedule_attempts_total")
+        # gang (coscheduling) series: attempts counts whole-gang placement
+        # tries; wait_seconds spans first-member-parked -> gang released
+        # into the active queue (minMember reached)
+        self.gang_schedule_attempts = Counter("gang_schedule_attempts_total")
+        self.gang_wait_seconds = Histogram("gang_wait_seconds")
         self.pods_scheduled = Counter("pods_scheduled_total")
         self.pods_failed = Counter("pods_failed_total")
 

@@ -481,3 +481,39 @@ class TestCreateStatusDrop:
                                crd.spec.validation.open_api_v3_schema)
         assert any("not a valid regular expression" in m
                    for _p, m in errs)
+
+    def test_bad_scale_paths_rejected_at_crd_create(self, server, client):
+        """Scale subresource paths outside .spec/.status would make
+        every /scale write a silent no-op (dotted_set grafts into a dead
+        branch); the CRD author gets a 422 at registration instead."""
+        crd = widget_crd()
+        crd.spec.subresources = api.CustomResourceSubresources(
+            scale=api.CustomResourceSubresourceScale(
+                spec_replicas_path=".data.replicas",
+                status_replicas_path=".status.readyReplicas"))
+        with pytest.raises(APIStatusError) as ei:
+            client.create("customresourcedefinitions", crd)
+        assert ei.value.code == 422
+        assert "specReplicasPath" in ei.value.message
+        crd2 = widget_crd()
+        crd2.spec.subresources = api.CustomResourceSubresources(
+            scale=api.CustomResourceSubresourceScale(
+                spec_replicas_path=".spec.replicas",
+                status_replicas_path="replicas"))
+        with pytest.raises(APIStatusError) as ei:
+            client.create("customresourcedefinitions", crd2)
+        assert ei.value.code == 422
+        assert "statusReplicasPath" in ei.value.message
+        # an UPDATE must not smuggle the broken path in either
+        good = widget_crd()
+        good.spec.subresources = api.CustomResourceSubresources(
+            scale=api.CustomResourceSubresourceScale(
+                spec_replicas_path=".spec.replicas",
+                status_replicas_path=".status.readyReplicas"))
+        client.create("customresourcedefinitions", good)
+        stored = client.get("customresourcedefinitions", "",
+                            "widgets.example.com")
+        stored.spec.subresources.scale.spec_replicas_path = ".meta.n"
+        with pytest.raises(APIStatusError) as ei:
+            client.update("customresourcedefinitions", stored)
+        assert ei.value.code == 422

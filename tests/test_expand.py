@@ -129,6 +129,38 @@ class TestExpandController:
         pvc = store.get("persistentvolumeclaims", "default", "data")
         assert pvc.status.capacity[res.STORAGE] == 20 << 30
 
+    def test_status_wipe_mid_online_expand_waits_for_node(self):
+        """Status wiped AFTER the PV was already grown for an online
+        expand: the controller must re-mark FileSystemResizePending —
+        not fake completion — and the kubelet confirms."""
+        store, ctrl = world()
+        ctrl.sync_all()
+        kl = Kubelet(store, "n1", heartbeat_period=0.0)
+        store.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="db", uid="u-db"),
+            spec=api.PodSpec(node_name="n1",
+                             containers=[api.Container(name="c")],
+                             volumes=[api.Volume(name="data",
+                                                 pvc_name="data")])))
+        kl.sync_once(1.0)
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        pvc.spec.requests[res.STORAGE] = 20 << 30
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()  # PV grown, FS pending set
+        # replace wipes status mid-flight
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        pvc.status = api.PersistentVolumeClaimStatus()
+        store.update("persistentvolumeclaims", pvc)
+        ctrl.sync_all()
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert any(c[0] == FS_RESIZE_PENDING
+                   for c in pvc.status.conditions)
+        assert pvc.status.capacity.get(res.STORAGE) is None
+        kl.sync_once(2.0)  # the node confirms
+        pvc = store.get("persistentvolumeclaims", "default", "data")
+        assert pvc.status.capacity[res.STORAGE] == 20 << 30
+        assert pvc.status.conditions == []
+
 
 class TestSystemPriorityClasses:
     def test_bootstrap_and_resolution(self):
@@ -162,35 +194,3 @@ class TestSystemPriorityClasses:
             assert kl._is_critical(got)
         finally:
             srv.stop()
-
-    def test_status_wipe_mid_online_expand_waits_for_node(self):
-        """Status wiped AFTER the PV was already grown for an online
-        expand: the controller must re-mark FileSystemResizePending —
-        not fake completion — and the kubelet confirms."""
-        store, ctrl = world()
-        ctrl.sync_all()
-        kl = Kubelet(store, "n1", heartbeat_period=0.0)
-        store.create("pods", api.Pod(
-            metadata=api.ObjectMeta(name="db", uid="u-db"),
-            spec=api.PodSpec(node_name="n1",
-                             containers=[api.Container(name="c")],
-                             volumes=[api.Volume(name="data",
-                                                 pvc_name="data")])))
-        kl.sync_once(1.0)
-        pvc = store.get("persistentvolumeclaims", "default", "data")
-        pvc.spec.requests[res.STORAGE] = 20 << 30
-        store.update("persistentvolumeclaims", pvc)
-        ctrl.sync_all()  # PV grown, FS pending set
-        # replace wipes status mid-flight
-        pvc = store.get("persistentvolumeclaims", "default", "data")
-        pvc.status = api.PersistentVolumeClaimStatus()
-        store.update("persistentvolumeclaims", pvc)
-        ctrl.sync_all()
-        pvc = store.get("persistentvolumeclaims", "default", "data")
-        assert any(c[0] == FS_RESIZE_PENDING
-                   for c in pvc.status.conditions)
-        assert pvc.status.capacity.get(res.STORAGE) is None
-        kl.sync_once(2.0)  # the node confirms
-        pvc = store.get("persistentvolumeclaims", "default", "data")
-        assert pvc.status.capacity[res.STORAGE] == 20 << 30
-        assert pvc.status.conditions == []

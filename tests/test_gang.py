@@ -1,0 +1,328 @@
+"""Gang scheduling (PodGroup coscheduling): all-or-nothing placement.
+
+Forward-port (no 1.11 reference equivalent): pods annotated with
+pod-group.scheduling.k8s.io/name park in the queue's gang waiting area
+until minMember members exist, then place atomically through the
+joint-assignment kernel (ops/gang.py) — a gang either fully holds
+capacity or holds none, and a failed gang backs off as a unit.
+"""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.validation import validate
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+
+from helpers import make_node, make_pod
+from test_scheduler_e2e import FakeClock
+
+
+def gang_pod(name, gang, min_avail=None, **kw):
+    p = make_pod(name, **kw)
+    p.metadata.annotations[api.POD_GROUP_NAME_ANNOTATION] = gang
+    if min_avail is not None:
+        p.metadata.annotations[api.POD_GROUP_MIN_AVAILABLE_ANNOTATION] = \
+            str(min_avail)
+    return p
+
+
+def make_world(n_nodes=4, clock=None, wave=16, **node_kw):
+    store = ObjectStore()
+    kw = dict(clock=clock) if clock is not None else {}
+    sched = Scheduler(store, wave_size=wave, **kw)
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", **node_kw))
+    return store, sched
+
+
+def bound_count(store, gang):
+    return sum(1 for p in store.list("pods")
+               if api.pod_group_name(p) == gang and p.spec.node_name)
+
+
+class TestGangAdmission:
+    def test_incomplete_gang_waits_then_releases(self):
+        """Members below minMember never reach the active queue; the
+        arrival of the minMember-th pod releases the whole gang, which
+        then places atomically — the smoke test for the fast tier."""
+        store, sched = make_world(4)
+        store.create("pods", gang_pod("a0", "ga", 3, cpu="1"))
+        store.create("pods", gang_pod("a1", "ga", 3, cpu="1"))
+        assert sched.schedule_pending() == 0
+        assert sched.queue.active_count() == 0
+        assert sched.queue.gang_waiting_count() == 2
+        assert sched.queue.pending_count() == 2
+        store.create("pods", gang_pod("a2", "ga", 3, cpu="1"))
+        assert sched.schedule_pending() == 3
+        for n in ("a0", "a1", "a2"):
+            assert store.get("pods", "default", n).spec.node_name, n
+        assert sched.metrics.gang_schedule_attempts.value >= 1
+        assert sched.metrics.gang_wait_seconds.total == 1
+
+    def test_min_member_from_podgroup_object(self):
+        """A PodGroup API object is the authoritative minMember source;
+        members need only the name annotation."""
+        store, sched = make_world(4)
+        store.create("podgroups", api.PodGroup(
+            metadata=api.ObjectMeta(name="gb"),
+            spec=api.PodGroupSpec(min_member=2)))
+        store.create("pods", gang_pod("b0", "gb", cpu="1"))
+        assert sched.schedule_pending() == 0
+        assert sched.queue.gang_waiting_count() == 1
+        store.create("pods", gang_pod("b1", "gb", cpu="1"))
+        assert sched.schedule_pending() == 2
+
+    def test_podgroup_created_after_pods_releases_gang(self):
+        """A PodGroup arriving late (lowering the annotation-derived
+        minMember) re-evaluates parked gangs."""
+        store, sched = make_world(4)
+        store.create("pods", gang_pod("c0", "gc", 5, cpu="1"))
+        store.create("pods", gang_pod("c1", "gc", 5, cpu="1"))
+        assert sched.schedule_pending() == 0
+        assert sched.queue.gang_waiting_count() == 2
+        store.create("podgroups", api.PodGroup(
+            metadata=api.ObjectMeta(name="gc"),
+            spec=api.PodGroupSpec(min_member=2)))
+        assert sched.schedule_pending() == 2
+
+    def test_member_deleted_while_waiting(self):
+        """Deleting a parked member shrinks the gang's member count —
+        the gate must NOT open on stale uids (which would place a
+        sub-minMember gang); a replacement member then releases the
+        survivors."""
+        store, sched = make_world(4)
+        store.create("pods", gang_pod("d0", "gd", 3, cpu="1"))
+        store.create("pods", gang_pod("d1", "gd", 3, cpu="1"))
+        assert sched.schedule_pending() == 0
+        store.delete("pods", "default", "d1")
+        # two live members would be needed again: d2 alone must not open
+        # the gate (d1's uid is gone from the member set)
+        store.create("pods", gang_pod("d2", "gd", 3, cpu="1"))
+        assert sched.schedule_pending() == 0
+        assert bound_count(store, "gd") == 0
+        store.create("pods", gang_pod("d3", "gd", 3, cpu="1"))
+        assert sched.schedule_pending() == 3
+        assert bound_count(store, "gd") == 3
+
+    def test_non_gang_pods_unaffected(self):
+        """Ordinary pods bypass every gang gate."""
+        store, sched = make_world(2)
+        store.create("pods", make_pod("plain", cpu="1"))
+        assert sched.schedule_pending() == 1
+        assert sched.queue.gang_waiting_count() == 0
+        assert sched.metrics.gang_schedule_attempts.value == 0
+
+
+class TestGangAtomicity:
+    def test_gang_larger_than_cluster_fails_with_zero_commits(self):
+        """The whole gang is infeasible: NOTHING binds, every member is
+        parked with a Gang fit error and one shared backoff deadline."""
+        clock = FakeClock()
+        store, sched = make_world(2, clock=clock, cpu="2")
+        for i in range(4):
+            store.create("pods", gang_pod(f"e{i}", "ge", 4, cpu="2"))
+        assert sched.schedule_pending() == 0
+        assert bound_count(store, "ge") == 0
+        assert sched.cache.pod_count() == 0  # zero assumes leaked
+        for i in range(4):
+            pod = store.get("pods", "default", f"e{i}")
+            assert pod.spec.node_name == ""
+            assert any("pod group could not be placed in full" in c[1]
+                       for c in pod.status.conditions), pod.status.conditions
+        # unit backoff: all four parked, none active until the window ends
+        assert sched.queue.active_count() == 0
+        store.create("nodes", make_node("late", cpu="2"))
+        assert sched.queue.active_count() == 0  # still inside the window
+        clock.advance(1.1)
+        # capacity is still short (3 nodes < 4 pods): fails atomically again
+        assert sched.schedule_pending() == 0
+        assert bound_count(store, "ge") == 0
+        store.create("nodes", make_node("late2", cpu="2"))
+        clock.advance(2.2)  # second failure doubled the gang's window
+        assert sched.schedule_pending() == 4
+        assert bound_count(store, "ge") == 4
+
+    def test_two_gangs_contending_never_interleave(self):
+        """Node-contention stress (the acceptance invariant): two gangs
+        that cannot both fit fight over the same nodes across many
+        rounds — after EVERY round, each gang's bound count is 0 or >=
+        minMember, never in between."""
+        clock = FakeClock()
+        store, sched = make_world(4, clock=clock, cpu="2")
+        # each gang needs 3 of the 4 single-slot nodes: only one can win
+        for i in range(3):
+            store.create("pods", gang_pod(f"ga{i}", "g-left", 3, cpu="2"))
+            store.create("pods", gang_pod(f"gb{i}", "g-right", 3, cpu="2"))
+
+        def check_invariant():
+            for gang in ("g-left", "g-right"):
+                n = bound_count(store, gang)
+                assert n == 0 or n >= 3, \
+                    f"gang {gang} partially bound: {n}/3"
+
+        for round_i in range(8):
+            sched.schedule_pending()
+            check_invariant()
+            clock.advance(2.0 ** min(round_i, 6) + 0.1)
+        winners = sorted(bound_count(store, g)
+                         for g in ("g-left", "g-right"))
+        assert winners == [0, 3]  # exactly one gang holds capacity
+
+    def test_loser_gang_places_after_capacity_frees(self):
+        """The losing gang stays whole and places as soon as the winner
+        leaves — no deadlock from half-held capacity."""
+        clock = FakeClock()
+        store, sched = make_world(3, clock=clock, cpu="2")
+        for i in range(3):
+            store.create("pods", gang_pod(f"wa{i}", "g-win", 3, cpu="2"))
+        assert sched.schedule_pending() == 3
+        for i in range(3):
+            store.create("pods", gang_pod(f"wb{i}", "g-lose", 3, cpu="2"))
+        assert sched.schedule_pending() == 0
+        assert bound_count(store, "g-lose") == 0
+        for i in range(3):
+            store.delete("pods", "default", f"wa{i}")
+        clock.advance(1.1)
+        assert sched.schedule_pending() == 3
+        assert bound_count(store, "g-lose") == 3
+
+    def test_partial_gang_beyond_min_member_parks_surplus(self):
+        """minMember < gang size: the gang admits once minMember place;
+        surplus members that did not fit park individually."""
+        store, sched = make_world(2, cpu="2")
+        for i in range(3):
+            store.create("pods", gang_pod(f"s{i}", "gs", 2, cpu="2"))
+        assert sched.schedule_pending() == 2
+        assert bound_count(store, "gs") == 2
+
+    def test_wave_boundary_never_splits_a_gang(self):
+        """pop_wave either takes a gang whole or defers it whole; a gang
+        wider than the wave still travels as one batch."""
+        store, sched = make_world(8, wave=4, cpu="4")
+        for i in range(6):
+            store.create("pods", gang_pod(f"w{i}", "gw", 6, cpu="1"))
+        # one extra plain pod shares the backlog
+        store.create("pods", make_pod("filler", cpu="1"))
+        assert sched.schedule_pending() == 7
+        assert bound_count(store, "gw") == 6
+
+
+class TestGangPreemption:
+    def test_high_priority_gang_evicts_whole_victim_gang(self):
+        """A higher-priority gang preempts; victim-gang members are
+        never left below minMember — the survivors are evicted with the
+        direct victims (whole-gang eviction)."""
+        clock = FakeClock()
+        store, sched = make_world(3, clock=clock, cpu="2")
+        for i in range(3):
+            p = gang_pod(f"low{i}", "g-low", 3, cpu="2")
+            p.spec.priority = 1
+            store.create("pods", p)
+        assert sched.schedule_pending() == 3
+        for i in range(3):
+            p = gang_pod(f"high{i}", "g-high", 3, cpu="2")
+            p.spec.priority = 100
+            store.create("pods", p)
+        sched.schedule_pending()
+        # victims evicted — and NEVER a sub-minMember remnant left behind
+        n_low = bound_count(store, "g-low")
+        assert n_low == 0, f"victim gang left at {n_low}/3"
+        for _ in range(4):
+            clock.advance(2.0)
+            sched.schedule_pending()
+            if bound_count(store, "g-high") == 3:
+                break
+        assert bound_count(store, "g-high") == 3
+
+    def test_single_pod_preemptor_spares_gang_with_slack(self):
+        """PDB-style gang guard: when one node hosts a no-slack gang
+        member and another hosts a plain pod, the preemptor picks the
+        plain victim (gang disruption ranks as a violation)."""
+        clock = FakeClock()
+        store, sched = make_world(2, clock=clock, cpu="2")
+        gm = gang_pod("gm0", "g-guard", 1, cpu="2")
+        gm.spec.priority = 1
+        store.create("pods", gm)
+        # the gang annotation resolves min via PodGroup: min=1 means NO
+        # slack (evicting its only member breaks it)
+        store.create("podgroups", api.PodGroup(
+            metadata=api.ObjectMeta(name="g-guard"),
+            spec=api.PodGroupSpec(min_member=1)))
+        plain = make_pod("plain", cpu="2", priority=1)
+        store.create("pods", plain)
+        assert sched.schedule_pending() == 2
+        vip = make_pod("vip", cpu="2", priority=100)
+        store.create("pods", vip)
+        sched.schedule_pending()
+        clock.advance(2.0)
+        sched.schedule_pending()
+        assert store.get("pods", "default", "vip").spec.node_name
+        # the guard steered the eviction to the plain pod
+        assert store.get("pods", "default", "plain") is None
+        assert store.get("pods", "default", "gm0") is not None
+
+
+class TestPodGroupAPI:
+    def test_validation(self):
+        good = api.PodGroup(metadata=api.ObjectMeta(name="pg"),
+                            spec=api.PodGroupSpec(min_member=2))
+        assert not validate("podgroups", good)
+        bad = api.PodGroup(metadata=api.ObjectMeta(name="pg"),
+                           spec=api.PodGroupSpec(min_member=0))
+        errs = validate("podgroups", bad)
+        assert errs and "minMember" in errs[0].field
+
+    def test_scheme_roundtrip(self):
+        from kubernetes_tpu.api import scheme
+
+        pg = api.PodGroup(metadata=api.ObjectMeta(name="pg"),
+                          spec=api.PodGroupSpec(min_member=4))
+        wire = scheme.encode_object(pg)
+        assert wire["kind"] == "PodGroup"
+        assert wire["apiVersion"] == "scheduling.sigs.k8s.io/v1alpha1"
+        back = scheme.decode_object(wire)
+        assert back.spec.min_member == 4
+
+    def test_annotation_helpers(self):
+        p = gang_pod("x", "gx", 7)
+        assert api.pod_group_name(p) == "gx"
+        assert api.pod_group_min_available(p) == 7
+        assert api.pod_group_name(make_pod("y")) is None
+        p2 = gang_pod("z", "gz")
+        p2.metadata.annotations[
+            api.POD_GROUP_MIN_AVAILABLE_ANNOTATION] = "junk"
+        assert api.pod_group_min_available(p2) is None
+
+
+class TestGangKernel:
+    def test_all_or_nothing_on_device(self):
+        """The kernel itself discards placements when need is unmet —
+        the host never sees a partial assignment."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.gang import schedule_gang
+
+        store, sched = make_world(2, cpu="2")
+        pods = [gang_pod(f"k{i}", "gk", 4, cpu="2") for i in range(4)]
+        pb = sched.featurizer.featurize(pods)
+        nt, pm, tt = sched.snapshot.to_device()
+        P, N = pb.req.shape[0], nt.valid.shape[0]
+        ones = np.ones((P, N), bool)
+        kw = dict(weights=sched.profile.weights(),
+                  num_zones=sched.snapshot.caps.Z,
+                  num_label_values=sched.snapshot.num_label_values)
+        res = schedule_gang(nt, pm, tt, pb, ones,
+                            jnp.asarray(0, jnp.int32), None,
+                            jnp.asarray(4, jnp.int32), **kw)
+        assert not bool(np.asarray(res.ok))
+        assert int(np.asarray(res.placed)) == 2  # the scan COULD place 2
+        assert (np.asarray(res.chosen) == -1).all()  # ...but discarded all
+        assert int(np.asarray(res.rr_end)) == 0  # rr rewound
+        # with need lowered to what fits, the same batch admits
+        res2 = schedule_gang(nt, pm, tt, pb, ones,
+                             jnp.asarray(0, jnp.int32), None,
+                             jnp.asarray(2, jnp.int32), **kw)
+        assert bool(np.asarray(res2.ok))
+        chosen = np.asarray(res2.chosen)
+        assert (chosen >= 0).sum() == 2

@@ -367,6 +367,54 @@ class TestKubeletIntegration:
         assert kl.container_manager.cgroups.exists(
             pod_cgroup_name(pod))
 
+    def test_unregistered_plugin_resource_zeroed_on_heartbeat(self):
+        """A plugin that unregisters (socket gone) must have its
+        resource ZEROED in node status on the next heartbeat — merging
+        additively forever would let the scheduler keep fitting pods
+        against devices that no longer exist; a shrunk device set
+        likewise shrinks the advertised counts."""
+        plugin = DevicePlugin("google.com/tpu", ["tpu0", "tpu1"])
+        store, kl = self._world(plugin)
+        kl.heartbeat(0.0)
+        node = store.get("nodes", "default", "n1")
+        assert node.status.capacity["google.com/tpu"] == 2
+        # shrink: a re-registered plugin with fewer devices overwrites
+        kl.device_manager.register(
+            DevicePlugin("google.com/tpu", ["tpu0"]))
+        kl.heartbeat(1.0)
+        node = store.get("nodes", "default", "n1")
+        assert node.status.capacity["google.com/tpu"] == 1
+        assert node.status.allocatable["google.com/tpu"] == 1
+        # unregister: the resource goes to ZERO, not stale-forever
+        kl.device_manager.unregister("google.com/tpu")
+        kl.heartbeat(2.0)
+        node = store.get("nodes", "default", "n1")
+        assert node.status.capacity["google.com/tpu"] == 0
+        assert node.status.allocatable["google.com/tpu"] == 0
+        # a returning plugin re-advertises on the next heartbeat
+        kl.device_manager.register(
+            DevicePlugin("google.com/tpu", ["tpu0", "tpu1"]))
+        kl.heartbeat(3.0)
+        node = store.get("nodes", "default", "n1")
+        assert node.status.allocatable["google.com/tpu"] == 2
+
+    def test_restart_still_zeroes_dead_plugin_resource(self):
+        """A kubelet restart must not resurrect the stale-capacity bug:
+        the fresh process seeds its published-resource set from the
+        STORED node status, so a plugin that died across the restart
+        gets zeroed on the first heartbeat."""
+        store, kl = self._world(
+            DevicePlugin("google.com/tpu", ["tpu0", "tpu1"]))
+        kl.heartbeat(0.0)
+        assert store.get("nodes", "default",
+                         "n1").status.capacity["google.com/tpu"] == 2
+        # new process, same store: plugin never re-registers
+        kl2 = Kubelet(store, "n1", heartbeat_period=0.0)
+        kl2.heartbeat(1.0)
+        node = store.get("nodes", "default", "n1")
+        assert node.status.capacity["google.com/tpu"] == 0
+        assert node.status.allocatable["google.com/tpu"] == 0
+
     def test_device_unhealthy_after_scheduling_fails_pod(self):
         plugin = DevicePlugin("google.com/tpu", ["tpu0", "tpu1"])
         store, kl = self._world(plugin)

@@ -176,6 +176,65 @@ class TestDurability:
         assert re.latest_resource_version == rev + 1
         re.close()
 
+    def test_generation_gating_on_persistent_store(self, tmp_path):
+        """The hoisted generation tracker (runtime/generation.py) runs
+        on the native store too: spec changes bump metadata.generation,
+        status-only writes don't — including across a restart, where the
+        tracker's fingerprint cache starts empty and must seed from the
+        stored object instead of spuriously bumping (rollout-status
+        gating on --data-dir clusters)."""
+        d = str(tmp_path / "kv")
+        store = NativeObjectStore(path=d)
+        dep = api.Deployment(
+            metadata=api.ObjectMeta(name="web"),
+            spec=api.DeploymentSpec(replicas=2))
+        store.create("deployments", dep)
+        assert store.get("deployments", "default",
+                         "web").metadata.generation == 1
+        got = store.get("deployments", "default", "web")
+        got.status.ready_replicas = 2  # status-only: no bump
+        store.update("deployments", got)
+        assert store.get("deployments", "default",
+                         "web").metadata.generation == 1
+        got = store.get("deployments", "default", "web")
+        got.spec.replicas = 5  # spec change: bump
+        store.update("deployments", got)
+        assert store.get("deployments", "default",
+                         "web").metadata.generation == 2
+        store.close()
+
+        re = NativeObjectStore(path=d)  # fresh process, empty cache
+        got = re.get("deployments", "default", "web")
+        assert got.metadata.generation == 2  # persisted
+        got.status.ready_replicas = 5
+        re.update("deployments", got)  # status-only after restart
+        assert re.get("deployments", "default",
+                      "web").metadata.generation == 2
+        got = re.get("deployments", "default", "web")
+        got.spec.replicas = 7
+        re.update("deployments", got)
+        assert re.get("deployments", "default",
+                      "web").metadata.generation == 3
+        # a FAILED write must not pollute the fingerprint cache: a CAS
+        # conflict followed by a successful retry of the SAME spec
+        # change still bumps (the rollout gate would otherwise declare
+        # the rollout done before it ran)
+        stale = re.get("deployments", "default", "web")
+        cur = re.get("deployments", "default", "web")
+        cur.status.ready_replicas = 7
+        re.update("deployments", cur)  # advances rv past `stale`
+        stale.spec.replicas = 9
+        with pytest.raises(Conflict):
+            re.update("deployments", stale,
+                      expect_rv=stale.metadata.resource_version - 1)
+        fresh = re.get("deployments", "default", "web")
+        assert fresh.metadata.generation == 3  # conflict changed nothing
+        fresh.spec.replicas = 9
+        re.update("deployments", fresh)
+        assert re.get("deployments", "default",
+                      "web").metadata.generation == 4
+        re.close()
+
     def test_kill_dash_nine_recovers(self, tmp_path):
         """Hard-kill a writer process mid-run; reopen must recover every
         acknowledged write (WAL is fflush()ed per record, so kernel page
