@@ -19,6 +19,12 @@ test: native
 test-unit: native
 	$(PYTHON) -m pytest tests/test_kernel_smoke.py tests/test_parity.py -x -q
 
+# Chaos tier: component-crash suite + the fault-injection suite
+# (`faults` marker: scrubber, device-path breaker, fault points).
+chaos: native
+	$(PYTHON) -m pytest tests/test_chaos.py -q
+	$(PYTHON) -m pytest tests/ -q -m faults
+
 # The driver's benchmark surface (real TPU when available; CPU otherwise).
 bench:
 	$(PYTHON) bench.py
@@ -30,4 +36,4 @@ bench-all:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-unit bench bench-all clean
+.PHONY: all native test test-unit chaos bench bench-all clean

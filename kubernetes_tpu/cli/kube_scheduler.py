@@ -76,13 +76,20 @@ class HealthServer:
         if sched is None:
             return ""
         lines = []
+        typed = set()
         for series in sched.metrics.all_series().values():
             if hasattr(series, "counts"):  # histogram
                 lines.append(f"# TYPE {series.name} histogram")
                 lines.append(f"{series.name}_sum {series.sum}")
                 lines.append(f"{series.name}_count {series.total}")
             else:
-                lines.append(f"# TYPE {series.name} counter")
+                # labelled children share one family: the TYPE line must
+                # name the bare family (label syntax there fails the
+                # Prometheus text parser, discarding the whole scrape)
+                family = series.name.partition("{")[0]
+                if family not in typed:
+                    typed.add(family)
+                    lines.append(f"# TYPE {family} counter")
                 lines.append(f"{series.name} {series.value}")
         return "\n".join(lines) + "\n"
 
@@ -105,7 +112,10 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store) -> Scheduler:
     for k, v in (cfg.feature_gates or {}).items():
         features.set(k, bool(v))
     return Scheduler(store, profile=profile, wave_size=cfg.wave_size,
-                     features=features)
+                     features=features,
+                     scrub_interval=cfg.scrub_interval or None,
+                     breaker_threshold=cfg.breaker_threshold,
+                     breaker_cooldown=cfg.breaker_cooldown)
 
 
 def run(cfg: KubeSchedulerConfiguration, server_url: str,
@@ -146,6 +156,20 @@ def _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
     sched_holder = [None]
     health = HealthServer(lambda: sched_holder[0], port=cfg.healthz_port) \
         if cfg.healthz_port >= 0 else None
+    # SIGUSR2 -> audit the HBM snapshot against the host cache
+    # (factory/cache_comparer.go's trigger). Installed HERE, before any
+    # leader election: under --leader-elect the scheduling loop runs in
+    # a worker thread where signal.signal() is illegal — installing from
+    # there would silently leave SIGUSR2 at its default disposition
+    # (terminate) and an operator's audit kill -USR2 would kill the
+    # leader. The handler routes through the holder so it survives the
+    # scheduler being built later (or never, on a standby).
+    if hasattr(signal, "SIGUSR2") and \
+            threading.current_thread() is threading.main_thread():
+        signal.signal(
+            signal.SIGUSR2,
+            lambda *_: (sched_holder[0] is not None
+                        and sched_holder[0].scrubber.request()))
 
     def scheduling_loop():
         sched = build_scheduler(cfg, store)
@@ -200,6 +224,9 @@ def main(argv=None) -> int:
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--disable-preemption", action="store_true")
     ap.add_argument("--wave-size", type=int, default=None)
+    ap.add_argument("--scrub-interval", type=float, default=None,
+                    help="seconds between periodic snapshot scrubs "
+                         "(0 disables the cadence; SIGUSR2 always works)")
     ap.add_argument("--healthz-port", type=int, default=None,
                     help="-1 disables; 0 picks a free port")
     ap.add_argument("--feature-gates", default="",
@@ -226,6 +253,8 @@ def main(argv=None) -> int:
         cfg.disable_preemption = True
     if args.wave_size is not None:
         cfg.wave_size = args.wave_size
+    if args.scrub_interval is not None:
+        cfg.scrub_interval = args.scrub_interval
     if args.healthz_port is not None:
         cfg.healthz_port = args.healthz_port
     for kv in filter(None, args.feature_gates.split(",")):

@@ -45,10 +45,19 @@ class GangResult(NamedTuple):
     rr_end: jnp.ndarray  # i32  round-robin counter (rr_start unless ok)
 
 
+def schedule_gang(*args, **kw):
+    """Entry point for the joint-assignment kernel; the fault point
+    fires outside the jit boundary (see ops/kernel.py schedule_round)."""
+    from ..utils import faultpoints
+
+    faultpoints.fire("kernel.gang")
+    return _schedule_gang(*args, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
     "pallas_interpret"))
-def schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
+def _schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
                   tt: enc.TermTable, pb: enc.PodBatch, extra_mask,
                   rr_start, extra_scores, need, *, weights: Weights,
                   num_zones: int, num_label_values: int = 64,

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import encoding as enc
+from ..utils import faultpoints
 from .affinity import incoming_statics
 from .filters import resource_fit, static_predicate_masks
 from .scores import (
@@ -258,15 +259,24 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     return res, (req_end, nz_end, cnt_end)
 
 
+def schedule_wave(*args, **kw):
+    """Entry point for the per-wave program. The fault point fires HERE,
+    outside the jit boundary — inside `_schedule_wave` it would only run
+    at trace time, so once the compile cache warms an injected fault
+    would silently stop firing."""
+    faultpoints.fire("kernel.wave")
+    return _schedule_wave(*args, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
     "pallas_interpret"))
-def schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
-                  pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
-                  *, weights: Weights,
-                  num_zones: int, num_label_values: int = 64,
-                  has_ipa: bool = False, use_pallas: bool = False,
-                  pallas_interpret: bool = False) -> WaveResult:
+def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
+                   pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
+                   *, weights: Weights,
+                   num_zones: int, num_label_values: int = 64,
+                   has_ipa: bool = False, use_pallas: bool = False,
+                   pallas_interpret: bool = False) -> WaveResult:
     """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
     volume predicates) for the rare pods that need them; all-True rows for
     everyone else. Appended to the mask stack as a final "HostPlugins"
@@ -313,12 +323,21 @@ def _stage_placements(pm: enc.PodMatrix, tt: enc.TermTable, chosen,
     return pm2, tt2
 
 
+def schedule_round(*args, **kw):
+    """Entry point for the device-resident round. The fault point fires
+    HERE, outside the jit boundary — inside `_schedule_round` it would
+    only run on a trace-cache miss, making injected faults vanish after
+    the first compile."""
+    faultpoints.fire("kernel.round")
+    return _schedule_round(*args, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
     "pallas_interpret"))
-def schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
-                   tt: enc.TermTable, pbs: enc.PodBatch,
-                   usage, rr_start, pm_rows, term_rows, *,
+def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
+                    tt: enc.TermTable, pbs: enc.PodBatch,
+                    usage, rr_start, pm_rows, term_rows, *,
                    weights: Weights, num_zones: int,
                    num_label_values: int = 64, has_ipa: bool = False,
                    use_pallas: bool = False, pallas_interpret: bool = False):

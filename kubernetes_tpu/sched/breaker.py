@@ -1,0 +1,78 @@
+"""Device-path circuit breaker.
+
+The wave pipeline's device step can fail persistently, not just
+transiently: a wedged XLA runtime, a kernel OOM at this cluster's
+shapes, a tunneled TPU backend that dropped. The per-call fallbacks in
+the scheduler (pallas -> XLA retry, round -> per-wave) handle one
+failure; a PERSISTENT fault would otherwise pay a doomed device attempt
+— compile time, dispatch, the exception unwind — on every single wave,
+forever. The breaker is the standard remedy (the same shape as
+client-go's backoff-on-connection-storms, applied to an accelerator):
+
+  closed     normal operation; consecutive-failure count resets on any
+             device success.
+  open       `threshold` consecutive device failures trip it; every
+             wave routes through the exact host path
+             (`_schedule_host_path`) — scheduling NEVER stops, it
+             degrades — until `cooldown` elapses.
+  half-open  after the cooldown one probe wave is re-admitted to the
+             device path. Success closes the breaker (firing
+             `on_recover`, which the scheduler uses to force a full
+             snapshot rebuild — nothing incremental is trusted across a
+             device fault); failure re-opens with a fresh cooldown.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class DevicePathBreaker:
+    def __init__(self, threshold: int = 3, cooldown: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_recover: Optional[Callable[[], None]] = None,
+                 on_trip: Optional[Callable[[], None]] = None):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown = cooldown
+        self.clock = clock
+        self.on_recover = on_recover
+        self.on_trip = on_trip
+        self.state = CLOSED
+        self.failures = 0  # consecutive, since the last success
+        self.trips = 0
+        self.opened_at = 0.0
+
+    def allow(self) -> bool:
+        """May this wave take the device path? Open + cooldown elapsed
+        transitions to half-open and admits the probe."""
+        if self.state == OPEN:
+            if self.clock() - self.opened_at >= self.cooldown:
+                self.state = HALF_OPEN
+                return True
+            return False
+        return True  # closed, or half-open (the probe itself)
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == HALF_OPEN or (
+                self.state == CLOSED and self.failures >= self.threshold):
+            self._trip()
+
+    def record_success(self) -> None:
+        self.failures = 0
+        if self.state != CLOSED:
+            self.state = CLOSED
+            if self.on_recover is not None:
+                self.on_recover()
+
+    def _trip(self) -> None:
+        self.state = OPEN
+        self.opened_at = self.clock()
+        self.trips += 1
+        if self.on_trip is not None:
+            self.on_trip()

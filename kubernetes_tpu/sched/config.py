@@ -36,6 +36,13 @@ class KubeSchedulerConfiguration:
     # TPU-wave specifics (no reference analog: the wave replaces the
     # one-pod cycle)
     wave_size: int = 128
+    # robustness layer: periodic snapshot-scrub cadence in seconds
+    # (0 disables the cadence; SIGUSR2 always triggers one, the
+    # cache_comparer.go analog) and the device-path circuit breaker's
+    # consecutive-failure threshold / open-state cooldown
+    scrub_interval: float = 0.0
+    breaker_threshold: int = 3
+    breaker_cooldown: float = 30.0
     # informer kinds mirrored before scheduling starts
     feature_gates: dict = field(default_factory=dict)
 

@@ -26,6 +26,7 @@ the wave batch in later rounds.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -42,9 +43,11 @@ from ..runtime.informer import SharedInformer
 from ..runtime.store import ObjectStore
 from ..state.cache import SchedulerCache
 from ..state.featurize import PodFeaturizer
+from ..state.scrubber import SnapshotScrubber
 from ..state.snapshot import Snapshot
-from ..utils import Metrics, PodBackoff, Trace
+from ..utils import Metrics, PodBackoff, Trace, faultpoints
 from ..utils.feature_gates import FeatureGates
+from .breaker import DevicePathBreaker
 from .equivalence import EquivalenceCache, equivalence_class
 from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
 from .extender import ExtenderError
@@ -140,11 +143,17 @@ class GroupLister:
 
 
 class Scheduler:
+    # idle backoff entries are swept on this cadence (2x the backoff
+    # ceiling matches the reference Gc()'s retention window)
+    BACKOFF_GC_PERIOD = 120.0
+
     def __init__(self, store: ObjectStore, profile: Optional[Profile] = None,
                  wave_size: int = 128, features: Optional[FeatureGates] = None,
                  clock: Callable[[], float] = time.monotonic,
                  assume_ttl: float = 30.0, caps=None, mesh=None,
-                 bind_workers: int = 4):
+                 bind_workers: int = 4,
+                 scrub_interval: Optional[float] = None,
+                 breaker_threshold: int = 3, breaker_cooldown: float = 30.0):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -179,6 +188,22 @@ class Scheduler:
         self.queue.on_gang_released = (
             lambda key, waited: self.metrics.gang_wait_seconds.observe(waited))
         self.backoff = PodBackoff(clock=clock)
+        self._next_backoff_gc = 0.0
+        # snapshot scrubber (state/scrubber.py): audits the HBM mirror
+        # against the host cache on SIGUSR2 / the periodic cadence and
+        # repairs divergent rows in place. Shares _mu so a scrub can
+        # never interleave with a wave's upload.
+        self.scrubber = SnapshotScrubber(
+            self.cache, self.snapshot, metrics=self.metrics, clock=clock,
+            period=scrub_interval, lock=self._mu)
+        # device-path circuit breaker: consecutive device failures route
+        # whole waves through the exact host path until a half-open
+        # probe succeeds; recovery forces a full snapshot rebuild
+        # (nothing incremental is trusted across a device fault)
+        self.breaker = DevicePathBreaker(
+            threshold=breaker_threshold, cooldown=breaker_cooldown,
+            clock=clock, on_recover=self.scrubber.rebuild,
+            on_trip=self.metrics.device_path_trips.inc)
         from .volume_binder import VolumeBinder
 
         self.volume_binder = VolumeBinder(store)
@@ -414,12 +439,25 @@ class Scheduler:
         self.wait_for_binds()
         return placed
 
+    def _housekeep(self) -> None:
+        """Per-cycle maintenance: expire assumed pods, sweep idle
+        backoff entries (PodBackoff.gc, reference backoff_utils.go Gc —
+        previously never invoked, so every pod that EVER failed held an
+        entry forever), and run the snapshot scrubber if its signal or
+        cadence fired."""
+        with self._mu:
+            self.cache.cleanup_expired()
+        now = self.clock()
+        if now >= self._next_backoff_gc:
+            self._next_backoff_gc = now + self.BACKOFF_GC_PERIOD
+            self.backoff.gc()
+        self.scrubber.maybe_scrub()
+
     def run_once(self, timeout: float = 0.0) -> int:
         """Schedule one wave. Returns the number of pods assumed with a
         bind dispatched (a failed async bind requeues its pod, which then
         counts again on the successful retry)."""
-        with self._mu:
-            self.cache.cleanup_expired()
+        self._housekeep()
         pods = self.queue.pop_wave(self.wave_size, timeout=timeout)
         if not pods:
             return 0
@@ -446,8 +484,7 @@ class Scheduler:
         Pods the device can't encode (multi-topology-key required
         affinity) and pods that fail placement are handed back to the
         per-wave path, which owns failure attribution and preemption."""
-        with self._mu:
-            self.cache.cleanup_expired()
+        self._housekeep()
         all_pods: List[api.Pod] = []
         while True:
             batch = self.queue.pop_wave(self.wave_size, timeout=0.0)
@@ -457,6 +494,10 @@ class Scheduler:
         if not all_pods:
             return 0
         with self._mu:
+            if not self.breaker.allow():
+                # breaker open: the whole backlog takes the exact host
+                # path — degraded but never stopped
+                return self._schedule_degraded(all_pods)
             placed = 0
             # gangs bypass the device-resident round: their placements
             # must be all-or-nothing per group, which the round's
@@ -619,6 +660,7 @@ class Scheduler:
                         self.queue.add_if_not_present(p)
                     return 0
         except ExtenderError:
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
             for p in pods:
                 self._park_with_backoff(p)
             return 0
@@ -695,16 +737,16 @@ class Scheduler:
                 chosen_all, rr_end = _attempt(False)
             self._last_path = "pallas" if round_pallas else "xla"
         except Exception as e:
-            import sys
-            import traceback
-
-            print(f"# pipeline round failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-            traceback.print_exc(file=sys.stderr)
+            # round failed on every formulation: breaker accounting,
+            # then hand the backlog back — schedule_pending's per-wave
+            # iteration (or, once tripped, the degraded host path)
+            # carries on
+            self._device_failure(e)
             for p in pods:
                 self.snapshot.unstage(p)
                 self.queue.add_if_not_present(p)
             return 0
+        self.breaker.record_success()
         self._rr = rr_end
         placed = 0
         retry: List[api.Pod] = []
@@ -892,10 +934,35 @@ class Scheduler:
         self.metrics.preemption_evaluation.observe(self.clock() - t0)
         return handled
 
+    def _schedule_degraded(self, pods: List[api.Pod]) -> int:
+        """Breaker-open degraded mode: every pod of the wave takes the
+        exact host path one at a time. Slower, but placements keep
+        landing while the device path is tripped. Gang pods place
+        individually here — all-or-nothing atomicity is suspended in
+        degraded mode (the joint-assignment kernel IS the device path)."""
+        placed = 0
+        for p in pods:
+            placed += self._schedule_host_path(p)
+        return placed
+
+    def _device_failure(self, exc: BaseException) -> None:
+        """Account one device-path failure: the labelled error series,
+        the breaker's consecutive-failure count, and the log (with
+        traceback — the old bare stderr prints were invisible to both
+        dashboards and capture fixtures)."""
+        self.metrics.scheduling_errors.labels(stage="wave").inc()
+        self.breaker.record_failure()
+        logging.getLogger(__name__).error(
+            "device wave failed (%s consecutive, breaker %s): %s: %s",
+            self.breaker.failures, self.breaker.state,
+            type(exc).__name__, exc, exc_info=exc)
+
     def _run_wave(self, pods: List[api.Pod]) -> int:
         import jax
         import jax.numpy as jnp
 
+        if not self.breaker.allow():
+            return self._schedule_degraded(pods)
         # gang members place through the all-or-nothing joint-assignment
         # path; pop_wave delivers gangs whole, so this partition never
         # sees a fragment of a released gang
@@ -927,6 +994,7 @@ class Scheduler:
             # attempt — park the wave for retry on the next cluster event /
             # flush, don't crash the loop (reference: scheduleOne records
             # the error and MakeDefaultErrorFunc requeues with backoff)
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
             for p in pods:
                 self._park_with_backoff(p)
             return placed_host
@@ -956,32 +1024,41 @@ class Scheduler:
                   num_label_values=self.snapshot.num_label_values,
                   has_ipa=bool(has_ipa))
         try:
-            res = schedule_wave(nt, pm, tt, pb, extra, self._rr, extra_scores,
-                                use_pallas=self._use_pallas, **kw)
-            # dispatch is async: a kernel that compiles but faults at
-            # execution raises only when results are consumed, so force
-            # materialization here — inside the try — or the fallback
-            # below could never catch it
-            jax.block_until_ready(res)
-        except Exception as e:
-            if not self._use_pallas:
-                raise
-            import sys
-
-            print(f"# wave failed with pallas enabled, retrying on the "
-                  f"pure-XLA path: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-            self._use_pallas = False
             try:
                 res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
-                                    extra_scores, use_pallas=False, **kw)
+                                    extra_scores,
+                                    use_pallas=self._use_pallas, **kw)
+                # dispatch is async: a kernel that compiles but faults at
+                # execution raises only when results are consumed, so force
+                # materialization here — inside the try — or the fallback
+                # below could never catch it
                 jax.block_until_ready(res)
-            except Exception:
-                # the XLA path failed too: the error was never
-                # pallas-specific (bad shapes, transient device OOM), so
-                # don't permanently demote the fast path on its account
-                self._use_pallas = True
-                raise
+            except Exception as e:
+                if not self._use_pallas:
+                    raise
+                import sys
+
+                print(f"# wave failed with pallas enabled, retrying on the "
+                      f"pure-XLA path: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                self._use_pallas = False
+                try:
+                    res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
+                                        extra_scores, use_pallas=False, **kw)
+                    jax.block_until_ready(res)
+                except Exception:
+                    # the XLA path failed too: the error was never
+                    # pallas-specific (bad shapes, transient device OOM), so
+                    # don't permanently demote the fast path on its account
+                    self._use_pallas = True
+                    raise
+        except Exception as e:
+            # every formulation failed: count it against the breaker
+            # and degrade THIS wave to the exact host path — a device
+            # fault must cost a slower wave, never a stopped scheduler
+            self._device_failure(e)
+            return placed_host + self._schedule_degraded(pods)
+        self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"
         self._rr = res.rr_end
         chosen = np.asarray(res.chosen)
@@ -1046,6 +1123,7 @@ class Scheduler:
                         reasons[r] = reasons.get(r, 0) + 1
                         failed[n] = ["ExtenderFilter"]
         except ExtenderError:
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
             self._park_with_backoff(pod)
             return 0
         if not feasible:
@@ -1080,6 +1158,7 @@ class Scheduler:
                 for node, s in ext.prioritize(pod, feasible).items():
                     host_scores[node] = host_scores.get(node, 0.0) + s
         except ExtenderError:
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
             self._park_with_backoff(pod)
             return 0
         best_name, best_score = None, None
@@ -1147,6 +1226,7 @@ class Scheduler:
             extra = self._host_plugin_mask(members, P)
             extra_scores = self._host_score_matrix(members, P)
         except ExtenderError:
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
             for p in members:
                 self._park_with_backoff(p)
             return placed
@@ -1162,28 +1242,40 @@ class Scheduler:
                   num_label_values=self.snapshot.num_label_values,
                   has_ipa=has_ipa)
         try:
-            res = schedule_gang(nt, pm, tt, pb, extra, self._rr,
-                                extra_scores, jnp.asarray(need, jnp.int32),
-                                use_pallas=self._use_pallas, **kw)
-            jax.block_until_ready(res)
-        except Exception as e:
-            if not self._use_pallas:
-                raise
-            import sys
-
-            print(f"# gang wave failed with pallas enabled, retrying on "
-                  f"the pure-XLA path: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-            self._use_pallas = False
             try:
                 res = schedule_gang(nt, pm, tt, pb, extra, self._rr,
                                     extra_scores,
                                     jnp.asarray(need, jnp.int32),
-                                    use_pallas=False, **kw)
+                                    use_pallas=self._use_pallas, **kw)
                 jax.block_until_ready(res)
-            except Exception:
-                self._use_pallas = True
-                raise
+            except Exception as e:
+                if not self._use_pallas:
+                    raise
+                import sys
+
+                print(f"# gang wave failed with pallas enabled, retrying on "
+                      f"the pure-XLA path: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                self._use_pallas = False
+                try:
+                    res = schedule_gang(nt, pm, tt, pb, extra, self._rr,
+                                        extra_scores,
+                                        jnp.asarray(need, jnp.int32),
+                                        use_pallas=False, **kw)
+                    jax.block_until_ready(res)
+                except Exception:
+                    self._use_pallas = True
+                    raise
+        except Exception as e:
+            # the joint-assignment kernel IS the device path: park the
+            # gang for retry (atomicity is preserved — nothing placed)
+            # and let the breaker route future waves host-side once it
+            # trips
+            self._device_failure(e)
+            for p in members:
+                self._park_with_backoff(p)
+            return placed
+        self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"
         chosen = np.asarray(res.chosen)
         if not bool(np.asarray(res.ok)):
@@ -1226,7 +1318,7 @@ class Scheduler:
         # node writes, victim deletes) whose queue.update would re-add a
         # not-yet-parked member to the ACTIVE heap — the gang would then
         # retry as shrinking subsets instead of waiting out its backoff
-        until = self.clock() + self.backoff.get_backoff("gang:" + key)
+        until = self.clock() + self.backoff.bump("gang:" + key)
         for pod in members:
             self.metrics.pods_failed.inc()
             self.queue.set_backoff(pod.uid, until)
@@ -1371,14 +1463,12 @@ class Scheduler:
             self._inflight.discard(fut)
         exc = fut.exception()
         if exc is not None:
-            # nothing awaits these futures for a value; without this an
-            # exception escaping _bind_and_finish would vanish silently
-            import sys
-            import traceback
-
-            print("# bind worker raised:", file=sys.stderr)
-            traceback.print_exception(type(exc), exc, exc.__traceback__,
-                                      file=sys.stderr)
+            # nothing awaits these futures for a value; without the
+            # counter an exception escaping _bind_and_finish would only
+            # ever reach stderr — invisible to /metrics and dashboards
+            self.metrics.scheduling_errors.labels(stage="bind").inc()
+            logging.getLogger(__name__).error(
+                "bind worker raised", exc_info=exc)
 
     def _bind_and_finish(self, pod: api.Pod, bound: api.Pod,
                          node_name: str, vol_rollback=None) -> bool:
@@ -1388,6 +1478,9 @@ class Scheduler:
         scheduler.go:409-432)."""
         t0 = self.clock()
         try:
+            # chaos seam: a raise here exercises the full rollback path
+            # (forget + snapshot restore + volume rollback + requeue)
+            faultpoints.fire("bind.post", payload=pod)
             # reference scheduler.go:409 GetBinder: an extender with a bind
             # verb performs the binding; the in-process store is then updated
             # so informers observe the placement either way
@@ -1548,7 +1641,7 @@ class Scheduler:
         active heap until the deadline even if cluster events move it
         (reference: util/backoff_utils.go:97-112, enforced by the factory
         error func's delayed requeue)."""
-        d = self.backoff.get_backoff(pod.uid)
+        d = self.backoff.bump(pod.uid)
         self.queue.set_backoff(pod.uid, self.clock() + d)
         self.queue.add_unschedulable_if_not_present(pod)
 

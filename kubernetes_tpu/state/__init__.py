@@ -1,3 +1,4 @@
 from .vocab import Interner, VocabSet  # noqa: F401
 from .node_info import NodeInfo  # noqa: F401
 from .cache import SchedulerCache  # noqa: F401
+from .scrubber import SnapshotScrubber, ScrubReport, Divergence  # noqa: F401

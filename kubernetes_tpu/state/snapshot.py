@@ -22,6 +22,7 @@ import numpy as np
 
 from ..api import types as api
 from ..ops import encoding as enc
+from ..utils import faultpoints
 from .node_info import NodeInfo
 from .vocab import Interner, VocabSet, bucket_size
 
@@ -307,6 +308,10 @@ class Snapshot:
         for i, (proto, _ip, port) in enumerate(up):
             self.ports[idx, i] = self.vocabs.port_id(proto, port)
         self.dirty_resources = True
+        # chaos seam: fires AFTER the row write so a `corrupt`-mode
+        # fault leaves a silently-divergent row for the scrubber to
+        # catch; one dict check when no faults are armed
+        faultpoints.fire("snapshot.write", payload=(self, idx))
 
     # ---- existing-pod matrix ------------------------------------------------
 
@@ -424,13 +429,18 @@ class Snapshot:
         self.remove_pod(pod)
 
     def remove_pod(self, pod: api.Pod):
-        slot = self.pod_slot.pop(pod.uid, None)
-        self._pod_sig.pop(pod.uid, None)
+        self.remove_pod_by_uid(pod.uid)
+
+    def remove_pod_by_uid(self, uid: str):
+        """Row removal keyed by uid alone — the scrubber drops ghost
+        rows whose pod object the host cache no longer holds."""
+        slot = self.pod_slot.pop(uid, None)
+        self._pod_sig.pop(uid, None)
         if slot is not None:
             self.ep_valid[slot] = False
             self.ep_alive[slot] = False
             self._free_slots.append(slot)
-            self._clear_pod_terms(pod.uid)
+            self._clear_pod_terms(uid)
             self.dirty_pods = True
 
     # ---- inter-pod affinity term table --------------------------------------

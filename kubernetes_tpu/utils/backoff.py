@@ -26,9 +26,19 @@ class PodBackoff:
         self._entries: Dict[str, _Entry] = {}
         self._lock = threading.Lock()
 
-    def get_backoff(self, pod_id: str) -> float:
-        """Current duration, doubling it for next time (reference
-        getBackoffTime + BackoffPod)."""
+    def get(self, pod_id: str) -> float:
+        """Peek the current duration WITHOUT inflating it. Observing a
+        pod's backoff (metrics, debug endpoints, a would-this-wait
+        check) must not double it — the old single `get_backoff` entry
+        point bumped on every read, so two observers could push a pod
+        from 1s to 4s without a single failure."""
+        with self._lock:
+            e = self._entries.get(pod_id)
+            return e.duration if e is not None else self.initial
+
+    def bump(self, pod_id: str) -> float:
+        """Record a failure: return the current duration and double it
+        for next time (reference getBackoffTime + BackoffPod)."""
         now = self.clock()
         with self._lock:
             e = self._entries.get(pod_id)
@@ -41,7 +51,7 @@ class PodBackoff:
             return d
 
     def try_wait(self, pod_id: str) -> float:
-        return self.get_backoff(pod_id)
+        return self.bump(pod_id)
 
     def clear(self, pod_id: str):
         with self._lock:

@@ -1,0 +1,210 @@
+"""Named fault points for deterministic chaos testing.
+
+The reference exercises failure paths structurally (test/e2e/chaosmonkey
+kills whole components); this framework additionally has *internal*
+surfaces that can fail independently of any process — the device kernel
+call (XLA error, kernel OOM), the bind POST, watch delivery, and the
+incremental snapshot writes that keep the HBM mirror honest. Each of
+those is wired with a named fault point (the etcd `gofail`
+pattern): chaos tests activate a point by name and the production code
+path fails exactly there, deterministically.
+
+Wired points (grep for `faultpoints.fire`):
+
+  kernel.wave      ops/kernel.py schedule_wave entry (per-wave program)
+  kernel.round     ops/kernel.py schedule_round entry (device-resident round)
+  kernel.gang      ops/gang.py schedule_gang entry (joint-assignment)
+  bind.post        sched/scheduler.py _bind_and_finish, before the POST
+  watch.deliver    runtime/store.py _notify, before fan-out
+  snapshot.write   state/snapshot.py refresh_node_resources, AFTER the
+                   row write (payload: (snapshot, node_idx) — the
+                   `corrupt` mode's target)
+
+Modes:
+
+  raise    raise FaultInjected (or a caller-supplied exception factory)
+  latency  time.sleep(arg seconds), then continue
+  drop     fire() returns True — the call site skips the guarded action
+           (models a lost watch event / lost incremental update)
+  corrupt  invoke the fault's fn(payload) — or the default snapshot-row
+           corruption (alloc[idx, CPU] += 4 cores, a silently wrong
+           capacity the scrubber must catch) — then continue
+
+Inactive cost: `fire()` is one module-global dict check (`if not
+_active: return False`) — nothing on the tier-1 / bench hot paths pays
+for the harness. Activation is programmatic (activate / injected
+context manager) or via the environment:
+
+  KTPU_FAULTPOINTS="kernel.wave=raise,bind.post=latency:0.05:3"
+                    name=mode[:arg[:times]]  (comma-separated)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+
+class FaultInjected(RuntimeError):
+    """The error raised by a `raise`-mode fault point."""
+
+    def __init__(self, point: str):
+        super().__init__(f"fault injected at {point!r}")
+        self.point = point
+
+
+class _Fault:
+    __slots__ = ("name", "mode", "arg", "times", "fn", "exc", "hits")
+
+    def __init__(self, name: str, mode: str, arg: float = 0.0,
+                 times: Optional[int] = None,
+                 fn: Optional[Callable] = None,
+                 exc: Optional[Callable[[], BaseException]] = None):
+        if mode not in ("raise", "latency", "drop", "corrupt"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.name = name
+        self.mode = mode
+        self.arg = arg
+        self.times = times  # None = unlimited
+        self.fn = fn
+        self.exc = exc
+        self.hits = 0
+
+
+_active: Dict[str, _Fault] = {}
+_hits: Dict[str, int] = {}  # survives deactivate, for post-hoc asserts
+_lock = threading.Lock()
+_suppress = threading.local()  # per-thread: observers opt out of chaos
+
+
+def _default_corrupt(payload) -> None:
+    """The canonical silent-divergence corruption: inflate a snapshot
+    node row's allocatable CPU by 4 cores. Allocatable is a topology
+    field — no bind-path refresh overwrites it, so the corruption
+    persists until a node event or a scrub, exactly the hazard the
+    snapshot scrubber exists to catch."""
+    try:
+        snap, idx = payload
+        snap.alloc[idx, 0] += 4000.0  # RES_CPU column, milli-cpu
+    except (TypeError, ValueError, AttributeError, IndexError):
+        pass  # payload isn't a (snapshot, idx) pair: nothing to corrupt
+
+
+def fire(name: str, payload=None) -> bool:
+    """Hot-path hook. Returns True when a `drop`-mode fault is active
+    (the caller must skip the guarded action); False otherwise. With no
+    active faults this is a single dict check."""
+    if not _active:
+        return False
+    if getattr(_suppress, "on", False):
+        return False
+    f = _active.get(name)
+    if f is None:
+        return False
+    with _lock:
+        if f.times is not None:
+            if f.times <= 0:
+                return False
+            f.times -= 1
+        f.hits += 1
+        _hits[name] = _hits.get(name, 0) + 1
+    if f.mode == "latency":
+        time.sleep(f.arg)
+        return False
+    if f.mode == "drop":
+        return True
+    if f.mode == "corrupt":
+        (f.fn or _default_corrupt)(payload)
+        return False
+    raise (f.exc() if f.exc is not None else FaultInjected(name))
+
+
+def activate(name: str, mode: str = "raise", arg: float = 0.0,
+             times: Optional[int] = None, fn: Optional[Callable] = None,
+             exc: Optional[Callable[[], BaseException]] = None) -> None:
+    """Arm a fault point. `times` bounds how many fires apply (None =
+    every call); `fn` overrides the corrupt action; `exc` overrides the
+    raised exception factory."""
+    with _lock:
+        _active[name] = _Fault(name, mode, arg=arg, times=times, fn=fn,
+                               exc=exc)
+
+
+def deactivate(name: str) -> None:
+    with _lock:
+        _active.pop(name, None)
+
+
+def reset() -> None:
+    """Disarm everything and forget hit counts (test teardown)."""
+    with _lock:
+        _active.clear()
+        _hits.clear()
+
+
+def active() -> bool:
+    return bool(_active)
+
+
+def hits(name: str) -> int:
+    """Times the point actually applied (cumulative until reset())."""
+    with _lock:
+        return _hits.get(name, 0)
+
+
+@contextmanager
+def injected(name: str, mode: str = "raise", **kw):
+    """Scope a fault to a `with` block."""
+    activate(name, mode, **kw)
+    try:
+        yield
+    finally:
+        deactivate(name)
+
+
+@contextmanager
+def suppressed():
+    """Disarm every fault point for the current thread inside the block.
+    For OBSERVERS of faulty state — the snapshot scrubber's golden-row
+    build and repair writes go through the very code paths the
+    `snapshot.write` point instruments; without suppression an unbounded
+    corrupt fault would corrupt the golden rows identically (scrub
+    reports clean while both sides diverge from host truth) and
+    re-corrupt each row the instant it is repaired."""
+    prev = getattr(_suppress, "on", False)
+    _suppress.on = True
+    try:
+        yield
+    finally:
+        _suppress.on = prev
+
+
+def _parse_env(spec: str) -> None:
+    """KTPU_FAULTPOINTS="name=mode[:arg[:times]],..." — activation from
+    the environment so a running binary can be chaos-tested without
+    code changes."""
+    for item in spec.split(","):
+        item = item.strip()
+        if not item or "=" not in item:
+            continue
+        name, rest = item.split("=", 1)
+        name = name.strip()
+        parts = rest.split(":")
+        mode = parts[0] or "raise"
+        try:
+            arg = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
+            times = int(parts[2]) if len(parts) > 2 and parts[2] else None
+            if name:
+                activate(name, mode, arg=arg, times=times)
+        except ValueError:
+            # env config must never crash the process at import; a
+            # malformed entry is simply not armed
+            continue
+
+
+_env = os.environ.get("KTPU_FAULTPOINTS", "")
+if _env:
+    _parse_env(_env)

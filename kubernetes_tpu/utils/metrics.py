@@ -28,6 +28,46 @@ class Counter:
             self.value += delta
 
 
+class LabeledCounter:
+    """Counter family over a fixed label set; children render in
+    Prometheus exposition form (`name{stage="bind"} 3`). The reference
+    registers scheduling error series with a stage label
+    (metrics.go `scheduling_errors`-style vectors); this is the minimal
+    analog the registry + /metrics endpoint can serve."""
+
+    def __init__(self, name: str, labelnames=("stage",), help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[tuple, Counter] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kw) -> Counter:
+        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                rendered = ",".join(
+                    f'{ln}="{v}"' for ln, v in zip(self.labelnames, key))
+                c = Counter(f"{self.name}{{{rendered}}}")
+                self._children[key] = c
+            return c
+
+    def value(self, **kw) -> float:
+        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        with self._lock:
+            c = self._children.get(key)
+            return c.value if c is not None else 0.0
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+    def children(self) -> List[Counter]:
+        with self._lock:
+            return list(self._children.values())
+
+
 class Histogram:
     """Fixed-bucket histogram (reference uses exponential buckets starting
     at 1ms: prometheus.ExponentialBuckets(1000, 2, 15) in microseconds).
@@ -108,9 +148,25 @@ class Metrics:
         self.gang_wait_seconds = Histogram("gang_wait_seconds")
         self.pods_scheduled = Counter("pods_scheduled_total")
         self.pods_failed = Counter("pods_failed_total")
+        # robustness layer: per-stage error attribution (bind worker /
+        # device wave / extender webhook), snapshot scrubber audit
+        # series, and device-path circuit-breaker trips
+        self.scheduling_errors = LabeledCounter("scheduling_errors_total",
+                                                ("stage",))
+        self.snapshot_scrub_runs = Counter("snapshot_scrub_runs_total")
+        self.snapshot_scrub_divergences = Counter(
+            "snapshot_scrub_divergences_total")
+        self.snapshot_scrub_repairs = Counter("snapshot_scrub_repairs_total")
+        self.snapshot_scrub_duration = Histogram(
+            "snapshot_scrub_duration_seconds")
+        self.device_path_trips = Counter("device_path_breaker_trips_total")
 
     def all_series(self):
-        return {
-            k: v for k, v in vars(self).items()
-            if isinstance(v, (Counter, Histogram))
-        }
+        out = {}
+        for k, v in vars(self).items():
+            if isinstance(v, (Counter, Histogram)):
+                out[k] = v
+            elif isinstance(v, LabeledCounter):
+                for c in v.children():
+                    out[c.name] = c
+        return out

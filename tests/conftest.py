@@ -31,3 +31,24 @@ try:  # noqa: SIM105
     _xb._backend_factories.pop("axon", None)
 except Exception:
     pass  # jax internals moved: lazy-init ordering still usually works
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tier-2 tests (tier-1 runs -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection / robustness suite (make chaos)")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_faultpoints():
+    """Fault points are process-global; never let one test's armed
+    faults leak into the next."""
+    from kubernetes_tpu.utils import faultpoints
+
+    faultpoints.reset()
+    yield
+    faultpoints.reset()

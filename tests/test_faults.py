@@ -1,0 +1,241 @@
+"""Fault-injection tier (`make chaos`, marker `faults`): named fault
+points drive the robustness layer deterministically — the device-path
+circuit breaker keeps placements flowing through the exact host path
+under persistent kernel failures, and the snapshot scrubber catches the
+silent row corruption a faulting device path can leave behind.
+
+Acceptance bar: with fault points injecting persistent device-kernel
+failures, `schedule_pending` still places all feasible pods (via host
+path) and resumes the device path after faults clear.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.runtime.informer import SharedInformer
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                          DevicePathBreaker)
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import faultpoints
+from kubernetes_tpu.utils.faultpoints import FaultInjected
+
+from helpers import make_node, make_pod
+
+pytestmark = pytest.mark.faults
+
+
+class TestFaultPoints:
+    def test_inactive_is_noop(self):
+        assert not faultpoints.active()
+        assert faultpoints.fire("anything") is False
+        assert faultpoints.hits("anything") == 0
+
+    def test_raise_mode_and_times(self):
+        faultpoints.activate("pt", "raise", times=2)
+        with pytest.raises(FaultInjected):
+            faultpoints.fire("pt")
+        with pytest.raises(FaultInjected):
+            faultpoints.fire("pt")
+        assert faultpoints.fire("pt") is False  # exhausted
+        assert faultpoints.hits("pt") == 2
+
+    def test_custom_exception_factory(self):
+        faultpoints.activate("pt", "raise", exc=lambda: ConnectionError("x"))
+        with pytest.raises(ConnectionError):
+            faultpoints.fire("pt")
+
+    def test_latency_mode(self):
+        faultpoints.activate("pt", "latency", arg=0.02)
+        t0 = time.monotonic()
+        assert faultpoints.fire("pt") is False
+        assert time.monotonic() - t0 >= 0.015
+
+    def test_drop_mode_returns_true(self):
+        faultpoints.activate("pt", "drop", times=1)
+        assert faultpoints.fire("pt") is True
+        assert faultpoints.fire("pt") is False
+
+    def test_context_manager_disarms(self):
+        with faultpoints.injected("pt", "drop"):
+            assert faultpoints.fire("pt") is True
+        assert faultpoints.fire("pt") is False
+        assert faultpoints.hits("pt") == 1  # hit history survives
+
+    def test_env_spec_parsing(self):
+        faultpoints._parse_env("a=raise, b=latency:0.5, c=drop::3, =bad,")
+        try:
+            assert faultpoints._active["a"].mode == "raise"
+            assert faultpoints._active["b"].mode == "latency"
+            assert faultpoints._active["b"].arg == 0.5
+            assert faultpoints._active["c"].times == 3
+        finally:
+            faultpoints.reset()
+
+    def test_watch_delivery_drop_loses_event_until_relist(self):
+        """The lost-watch-event scenario: a dropped delivery leaves
+        every mirror stale; a relisting informer converges."""
+        store = ObjectStore()
+        inf = SharedInformer(store, "pods")
+        with faultpoints.injected("watch.deliver", "drop", times=1):
+            store.create("pods", make_pod("px"))
+        assert inf.get("default", "px") is None  # mirror missed it
+        assert store.get("pods", "default", "px") is not None
+        inf2 = SharedInformer(store, "pods")  # list+watch relist
+        assert inf2.get("default", "px") is not None
+
+
+class TestBreakerStateMachine:
+    def test_trip_cooldown_probe_recover(self):
+        now = [0.0]
+        recovered = []
+        b = DevicePathBreaker(threshold=2, cooldown=10.0,
+                              clock=lambda: now[0],
+                              on_recover=lambda: recovered.append(1))
+        assert b.allow() and b.state == CLOSED
+        b.record_failure()
+        assert b.state == CLOSED  # below threshold
+        b.record_failure()
+        assert b.state == OPEN and b.trips == 1
+        assert not b.allow()
+        now[0] += 9.9
+        assert not b.allow()  # cooldown not elapsed
+        now[0] += 0.2
+        assert b.allow() and b.state == HALF_OPEN  # the probe
+        b.record_success()
+        assert b.state == CLOSED and recovered == [1]
+
+    def test_half_open_failure_reopens(self):
+        now = [0.0]
+        b = DevicePathBreaker(threshold=1, cooldown=5.0,
+                              clock=lambda: now[0])
+        b.record_failure()
+        assert b.state == OPEN
+        now[0] += 6.0
+        assert b.allow() and b.state == HALF_OPEN
+        b.record_failure()
+        assert b.state == OPEN and b.trips == 2
+        assert not b.allow()  # fresh cooldown
+
+    def test_success_resets_consecutive_count(self):
+        b = DevicePathBreaker(threshold=2, clock=lambda: 0.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == CLOSED  # never two CONSECUTIVE failures
+
+
+def _faulted_cluster(n_nodes=3, breaker_threshold=2):
+    now = [1000.0]
+    store = ObjectStore()
+    sched = Scheduler(store, clock=lambda: now[0],
+                      breaker_threshold=breaker_threshold,
+                      breaker_cooldown=30.0)
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", cpu="4"))
+    return store, sched, now
+
+
+class TestDevicePathBreakerEndToEnd:
+    def test_persistent_kernel_faults_never_stop_placement(self):
+        store, sched, now = _faulted_cluster()
+        faultpoints.activate("kernel.round", "raise")
+        faultpoints.activate("kernel.wave", "raise")
+        for i in range(6):
+            store.create("pods", make_pod(f"p{i}", cpu="1"))
+        placed = sched.schedule_pending()
+        assert placed == 6  # every feasible pod landed via host path
+        assert sched.breaker.state == OPEN
+        assert sched.breaker.trips == 1
+        assert sched.metrics.device_path_trips.value == 1
+        assert sched.metrics.scheduling_errors.value(stage="wave") >= 2
+        bound = [p for p in store.list("pods") if p.spec.node_name]
+        assert len(bound) == 6
+        per_node = {}
+        for p in bound:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 4 for v in per_node.values()), per_node
+
+        # while open: no device attempt is even made, host path carries
+        hits0 = faultpoints.hits("kernel.round") + faultpoints.hits("kernel.wave")
+        for i in range(3):
+            store.create("pods", make_pod(f"q{i}", cpu="1"))
+        assert sched.schedule_pending() == 3
+        assert sched.breaker.state == OPEN
+        assert faultpoints.hits("kernel.round") \
+            + faultpoints.hits("kernel.wave") == hits0
+
+    def test_half_open_probe_recovers_device_path(self):
+        store, sched, now = _faulted_cluster()
+        faultpoints.activate("kernel.round", "raise")
+        faultpoints.activate("kernel.wave", "raise")
+        for i in range(4):
+            store.create("pods", make_pod(f"p{i}", cpu="1"))
+        assert sched.schedule_pending() == 4
+        assert sched.breaker.state == OPEN
+
+        # faults clear; cooldown elapses; the probe wave re-admits the
+        # device path and recovery forces a full snapshot rebuild
+        faultpoints.reset()
+        now[0] += 31.0
+        for i in range(4):
+            store.create("pods", make_pod(f"q{i}", cpu="1"))
+        assert sched.schedule_pending() == 4
+        assert sched.breaker.state == CLOSED
+        assert sched.wave_path() in ("pallas", "xla")  # device executed
+        # the rebuilt snapshot is exactly host truth
+        assert sched.scrubber.scrub().clean
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        store, sched, now = _faulted_cluster(breaker_threshold=1)
+        faultpoints.activate("kernel.round", "raise")
+        faultpoints.activate("kernel.wave", "raise")
+        store.create("pods", make_pod("p0", cpu="1"))
+        assert sched.schedule_pending() == 1
+        assert sched.breaker.state == OPEN
+        now[0] += 31.0  # cooldown over, but the fault persists
+        store.create("pods", make_pod("p1", cpu="1"))
+        assert sched.schedule_pending() == 1  # probe fails, host path lands it
+        assert sched.breaker.state == OPEN
+        assert sched.breaker.trips == 2
+
+
+class TestFaultDrivenScrub:
+    def test_corrupt_row_fault_caught_by_scrub(self):
+        """The full loop: a corrupt-mode fault silently inflates a node's
+        allocatable after a bind's snapshot refresh; the scrub detects
+        exactly that row, repairs it, and scheduling proceeds correctly."""
+        store, sched, _ = _faulted_cluster(n_nodes=3)
+        faultpoints.activate("snapshot.write", "corrupt", times=1)
+        store.create("pods", make_pod("p0", cpu="1"))
+        assert sched.schedule_pending() == 1
+        assert faultpoints.hits("snapshot.write") == 1
+        rep = sched.scrubber.scrub()
+        assert len(rep.divergences) == 1, rep.summary()
+        assert rep.divergences[0].fields == ["alloc"]
+        assert rep.divergences[0].repaired
+        assert sched.scrubber.scrub().clean
+        # post-repair waves place within REAL capacity
+        for i in range(11):
+            store.create("pods", make_pod(f"q{i}", cpu="1"))
+        assert sched.schedule_pending() == 11  # 3x4cpu, 12x1cpu total
+        per_node = {}
+        for p in store.list("pods"):
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 4 for v in per_node.values()), per_node
+
+    def test_bind_post_fault_rolls_back_and_retries(self):
+        store, sched, _ = _faulted_cluster(n_nodes=2)
+        faultpoints.activate("bind.post", "raise", times=2,
+                             exc=lambda: ConnectionError("bind lost"))
+        for i in range(4):
+            store.create("pods", make_pod(f"p{i}", cpu="1"))
+        placed = sched.schedule_pending()
+        assert faultpoints.hits("bind.post") == 2
+        bound = [p for p in store.list("pods") if p.spec.node_name]
+        assert len(bound) == 4, (placed, len(bound))
+        assert len({p.uid for p in bound}) == 4  # exactly once each
+        # the failed binds rolled their assumes back: capacity honest
+        rep = sched.scrubber.scrub()
+        assert rep.clean, rep.summary()

@@ -143,3 +143,51 @@ class TestProfiling:
             assert "1.250" in body
         finally:
             hs.stop()
+
+
+class TestLabeledCounter:
+    def test_children_render_prometheus_style(self):
+        from kubernetes_tpu.utils.metrics import LabeledCounter
+
+        lc = LabeledCounter("scheduling_errors_total", ("stage",))
+        lc.labels(stage="bind").inc()
+        lc.labels(stage="bind").inc()
+        lc.labels(stage="wave").inc()
+        assert lc.value(stage="bind") == 2
+        assert lc.value(stage="wave") == 1
+        assert lc.value(stage="extender") == 0
+        assert lc.total() == 3
+        names = {c.name for c in lc.children()}
+        assert 'scheduling_errors_total{stage="bind"}' in names
+
+    def test_registry_expands_labeled_children(self):
+        m = Metrics()
+        m.scheduling_errors.labels(stage="bind").inc()
+        series = m.all_series()
+        assert 'scheduling_errors_total{stage="bind"}' in series
+        assert "snapshot_scrub_runs" in series
+        assert "device_path_trips" in series
+
+    def test_metrics_endpoint_serves_labeled_series(self):
+        """The /metrics text exposition must carry the per-stage error
+        series so bind-worker failures are dashboard-visible."""
+        import urllib.request
+
+        from kubernetes_tpu.cli.kube_scheduler import HealthServer
+
+        class _FakeSched:
+            metrics = Metrics()
+
+        _FakeSched.metrics.scheduling_errors.labels(stage="bind").inc(3)
+        hs = HealthServer(lambda: _FakeSched)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hs.port}/metrics", timeout=5) as r:
+                body = r.read().decode()
+            assert 'scheduling_errors_total{stage="bind"} 3' in body
+            # TYPE lines must name the bare family — label syntax there
+            # fails the Prometheus text parser and voids the scrape
+            assert "# TYPE scheduling_errors_total counter" in body
+            assert '# TYPE scheduling_errors_total{' not in body
+        finally:
+            hs.stop()

@@ -1,0 +1,261 @@
+"""Snapshot scrubber tier: the HBM mirror must be auditable against —
+and repairable from — host-cache truth (the cache_comparer.go analog,
+upgraded from compare-and-log to compare-and-repair).
+
+The acceptance bar: a scrub over a snapshot with one corrupted node row
+reports exactly that divergence and repairs it so a subsequent wave
+matches a from-scratch rebuild placement-for-placement.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.state.scrubber import SnapshotScrubber
+from kubernetes_tpu.utils.backoff import PodBackoff
+
+from helpers import make_node, make_pod
+
+pytestmark = pytest.mark.faults
+
+
+def _cluster(n_nodes=4, n_pods=8, cpu="4", **kw):
+    store = ObjectStore()
+    sched = Scheduler(store, **kw)
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", cpu=cpu))
+    for i in range(n_pods):
+        store.create("pods", make_pod(f"p{i}", cpu="1"))
+    assert sched.schedule_pending() == n_pods
+    return store, sched
+
+
+class TestScrubClean:
+    def test_settled_cluster_reports_zero_divergence(self):
+        _, sched = _cluster()
+        rep = sched.scrubber.scrub()
+        assert rep.clean, rep.summary()
+        assert rep.nodes_checked == 4
+        assert rep.pods_checked == 8
+        assert rep.repaired == 0
+
+    def test_scrub_metrics(self):
+        _, sched = _cluster(n_nodes=2, n_pods=2)
+        sched.scrubber.scrub()
+        m = sched.metrics
+        assert m.snapshot_scrub_runs.value == 1
+        assert m.snapshot_scrub_divergences.value == 0
+        idx = sched.snapshot.node_index["n0"]
+        sched.snapshot.requested[idx, 0] += 512.0
+        sched.scrubber.scrub()
+        assert m.snapshot_scrub_runs.value == 2
+        assert m.snapshot_scrub_divergences.value == 1
+        assert m.snapshot_scrub_repairs.value == 1
+
+
+class TestScrubDetectAndRepair:
+    def test_single_corrupt_row_detected_and_repaired_in_one_cycle(self):
+        _, sched = _cluster()
+        idx = sched.snapshot.node_index["n2"]
+        sched.snapshot.requested[idx, 0] += 1000.0  # phantom 1-cpu usage
+        rep = sched.scrubber.scrub()
+        assert len(rep.divergences) == 1, rep.summary()
+        d = rep.divergences[0]
+        assert d.node == "n2" and d.fields == ["requested"] and d.repaired
+        # one cycle sufficed: the next scrub is clean
+        assert sched.scrubber.scrub().clean
+
+    def test_corrupt_topology_row(self):
+        _, sched = _cluster()
+        idx = sched.snapshot.node_index["n1"]
+        sched.snapshot.alloc[idx, 0] += 4000.0  # phantom capacity
+        sched.snapshot.cond[idx, 0] = True      # phantom NotReady
+        rep = sched.scrubber.scrub()
+        assert len(rep.divergences) == 1
+        assert set(rep.divergences[0].fields) == {"alloc", "cond"}
+        assert sched.scrubber.scrub().clean
+
+    def test_repaired_snapshot_matches_from_scratch_rebuild(self):
+        """After corrupt -> scrub, every node row equals what a fresh
+        scheduler builds from the same store via informer relist — so a
+        subsequent wave computes over identical tensors and places
+        identically."""
+        store, sched = _cluster()
+        idx = sched.snapshot.node_index["n0"]
+        sched.snapshot.alloc[idx, 0] += 4000.0
+        sched.snapshot.pod_count[idx] += 3
+        rep = sched.scrubber.scrub()
+        assert not rep.clean and rep.repaired >= 1
+        fresh = Scheduler(store)
+        a, b = sched.snapshot, fresh.snapshot
+        for name in a.node_index:
+            ia, ib = a.node_index[name], b.node_index[name]
+            for f in ("alloc", "requested", "nonzero", "pod_count",
+                      "allowed_pods", "labels", "taint_key", "cond",
+                      "zone_id", "avoid"):
+                assert np.array_equal(
+                    np.atleast_1d(getattr(a, f)[ia]),
+                    np.atleast_1d(getattr(b, f)[ib])), (name, f)
+        # and the subsequent wave places everything a rebuild would:
+        # both schedulers see 4x4cpu with 8x1cpu bound -> 8 more fit
+        for i in range(8):
+            store.create("pods", make_pod(f"x{i}", cpu="1"))
+        assert sched.schedule_pending() == 8
+        per_node = {}
+        for p in store.list("pods"):
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 4 for v in per_node.values()), per_node
+        fresh.close()
+
+    def test_pod_row_divergence(self):
+        store, sched = _cluster(n_nodes=2, n_pods=2)
+        pod = next(p for p in store.list("pods") if p.spec.node_name)
+        slot = sched.snapshot.pod_slot[pod.uid]
+        right = sched.snapshot.node_index[pod.spec.node_name]
+        sched.snapshot.ep_node[slot] = (right + 1) % 2  # wrong placement
+        rep = sched.scrubber.scrub()
+        assert any("pod-node" in d.fields for d in rep.divergences), \
+            rep.summary()
+        assert sched.scrubber.scrub().clean
+        assert int(sched.snapshot.ep_node[slot]) == right
+
+    def test_stale_pod_request_row(self):
+        """ep_req rows feed the device preemption what-if; a stale row
+        silently skews victim accounting."""
+        store, sched = _cluster(n_nodes=2, n_pods=2)
+        pod = next(p for p in store.list("pods") if p.spec.node_name)
+        slot = sched.snapshot.pod_slot[pod.uid]
+        sched.snapshot.ep_req[slot, 0] *= 7
+        rep = sched.scrubber.scrub()
+        assert any("pod-req" in d.fields for d in rep.divergences)
+        assert sched.scrubber.scrub().clean
+
+    def test_ghost_pod_row_removed(self):
+        _, sched = _cluster(n_nodes=2, n_pods=2)
+        slot = sched.snapshot._alloc_slot("ghost-uid")
+        sched.snapshot.ep_valid[slot] = True
+        sched.snapshot.ep_alive[slot] = True
+        sched.snapshot.ep_node[slot] = 0
+        rep = sched.scrubber.scrub()
+        assert any(d.fields == ["ghost-pod"] for d in rep.divergences)
+        assert "ghost-uid" not in sched.snapshot.pod_slot
+        assert sched.scrubber.scrub().clean
+
+    def test_ghost_node_row_removed(self):
+        _, sched = _cluster(n_nodes=3, n_pods=0)
+        # host cache forgets n2 without the snapshot hearing about it
+        ni = sched.cache.node_infos.pop("n2")
+        assert ni is not None
+        rep = sched.scrubber.scrub()
+        assert any(d.fields == ["ghost-node"] for d in rep.divergences)
+        assert "n2" not in sched.snapshot.node_index
+        assert sched.scrubber.scrub().clean
+
+    def test_missing_node_row_restored(self):
+        _, sched = _cluster(n_nodes=3, n_pods=0)
+        sched.snapshot.remove_node("n1")  # mirror lost a node
+        rep = sched.scrubber.scrub()
+        assert any("missing-node" in d.fields for d in rep.divergences)
+        assert sched.snapshot.valid[sched.snapshot.node_index["n1"]]
+        assert sched.scrubber.scrub().clean
+
+    def test_scrub_is_immune_to_unbounded_corrupt_fault(self):
+        """The scrubber's golden-row build and repair writes traverse
+        the instrumented snapshot paths; an UNBOUNDED corrupt fault must
+        not blind the compare (corrupting golden rows identically) or
+        re-corrupt rows as they are repaired."""
+        from kubernetes_tpu.utils import faultpoints
+
+        _, sched = _cluster(n_nodes=2, n_pods=2)
+        idx = sched.snapshot.node_index["n0"]
+        sched.snapshot.alloc[idx, 0] += 4000.0
+        faultpoints.activate("snapshot.write", "corrupt")  # no times bound
+        rep = sched.scrubber.scrub()
+        assert len(rep.divergences) == 1 and rep.repaired == 1
+        assert sched.scrubber.scrub().clean  # repair actually took
+
+    def test_audit_only_mode_repairs_nothing(self):
+        _, sched = _cluster(n_nodes=2, n_pods=0)
+        idx = sched.snapshot.node_index["n0"]
+        sched.snapshot.alloc[idx, 0] += 4000.0
+        rep = sched.scrubber.scrub(repair=False)
+        assert not rep.clean and rep.repaired == 0
+        # still divergent: nothing was touched
+        rep2 = sched.scrubber.scrub(repair=False)
+        assert not rep2.clean
+
+
+class TestScrubTriggers:
+    def test_periodic_cadence(self):
+        now = [100.0]
+        store = ObjectStore()
+        sched = Scheduler(store, clock=lambda: now[0], scrub_interval=60.0)
+        store.create("nodes", make_node("n0"))
+        assert sched.scrubber.maybe_scrub() is None  # not due yet
+        now[0] += 61.0
+        rep = sched.scrubber.maybe_scrub()
+        assert rep is not None and rep.nodes_checked == 1
+        assert sched.scrubber.maybe_scrub() is None  # cadence reset
+
+    def test_request_flag_drained_by_run_loop(self):
+        _, sched = _cluster(n_nodes=1, n_pods=0)
+        runs0 = sched.metrics.snapshot_scrub_runs.value
+        sched.scrubber.request()
+        sched.run_once()  # housekeeping drains the request
+        assert sched.metrics.snapshot_scrub_runs.value == runs0 + 1
+
+    @pytest.mark.skipif(not hasattr(signal, "SIGUSR2"),
+                        reason="no SIGUSR2 on this platform")
+    def test_sigusr2_requests_scrub(self):
+        _, sched = _cluster(n_nodes=1, n_pods=0)
+        if not sched.scrubber.install_signal():
+            pytest.skip("not on the main thread")
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            assert sched.scrubber.due()
+            rep = sched.scrubber.maybe_scrub()
+            assert rep is not None and rep.clean
+        finally:
+            signal.signal(signal.SIGUSR2, signal.SIG_DFL)
+
+    def test_rebuild_resets_device_cache_and_matrices(self):
+        store, sched = _cluster()
+        idx = sched.snapshot.node_index["n3"]
+        sched.snapshot.alloc[idx, :] = 0  # arbitrary trashing
+        sched.snapshot.requested[idx, :] = 99.0
+        sched.scrubber.rebuild()
+        assert sched.scrubber.scrub().clean
+        assert sched.snapshot.dirty_pods
+        # scheduling still works after the rebuild
+        store.create("pods", make_pod("post-rebuild", cpu="1"))
+        assert sched.schedule_pending() == 1
+
+
+class TestPodBackoffSplit:
+    def test_get_does_not_inflate(self):
+        b = PodBackoff(clock=lambda: 0.0)
+        assert b.bump("p") == 1.0
+        for _ in range(5):
+            assert b.get("p") == 2.0  # observation is free
+        assert b.bump("p") == 2.0
+        assert b.get("p") == 4.0
+
+    def test_get_unknown_pod_is_initial(self):
+        b = PodBackoff(clock=lambda: 0.0)
+        assert b.get("never-seen") == 1.0
+        assert "never-seen" not in b._entries  # peek doesn't create
+
+    def test_gc_wired_into_run_loop(self):
+        now = [1000.0]
+        store = ObjectStore()
+        sched = Scheduler(store, clock=lambda: now[0])
+        sched.backoff.bump("stale-pod")
+        assert "stale-pod" in sched.backoff._entries
+        # idle past 2*maximum and past the scheduler's gc cadence
+        now[0] += 2 * sched.backoff.maximum + sched.BACKOFF_GC_PERIOD + 1
+        sched.run_once()
+        assert "stale-pod" not in sched.backoff._entries
