@@ -20,10 +20,15 @@ test-unit: native
 	$(PYTHON) -m pytest tests/test_kernel_smoke.py tests/test_parity.py -x -q
 
 # Chaos tier: component-crash suite + the fault-injection suite
-# (`faults` marker: scrubber, device-path breaker, fault points).
+# (`faults`/`chaos` markers: scrubber, device-path breaker, fault
+# points, leader failover).  Unregistered-marker warnings are ERRORS
+# here so fault-point/marker drift is caught at test time.
 chaos: native
-	$(PYTHON) -m pytest tests/test_chaos.py -q
-	$(PYTHON) -m pytest tests/ -q -m faults
+	$(PYTHON) -m pytest tests/test_chaos.py -q \
+		-W error::pytest.PytestUnknownMarkWarning
+	$(PYTHON) -m pytest tests/ -q -m "faults or chaos" \
+		--continue-on-collection-errors \
+		-W error::pytest.PytestUnknownMarkWarning
 
 # The driver's benchmark surface (real TPU when available; CPU otherwise).
 bench:

@@ -25,6 +25,7 @@ from ..plugins.registry import default_profile, default_registry
 from ..sched.config import KubeSchedulerConfiguration
 from ..sched.scheduler import Scheduler
 from ..utils.feature_gates import FeatureGates
+from ..utils.metrics import Metrics
 
 
 class HealthServer:
@@ -98,7 +99,8 @@ class HealthServer:
         self.httpd.server_close()
 
 
-def build_scheduler(cfg: KubeSchedulerConfiguration, store) -> Scheduler:
+def build_scheduler(cfg: KubeSchedulerConfiguration, store,
+                    metrics: Optional[Metrics] = None) -> Scheduler:
     if cfg.policy_config_file:
         profile = default_registry.profile_from_policy(
             open(cfg.policy_config_file).read(), store=store)
@@ -115,7 +117,9 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store) -> Scheduler:
                      features=features,
                      scrub_interval=cfg.scrub_interval or None,
                      breaker_threshold=cfg.breaker_threshold,
-                     breaker_cooldown=cfg.breaker_cooldown)
+                     breaker_cooldown=cfg.breaker_cooldown,
+                     metrics=metrics,
+                     bind_max_attempts=cfg.bind_max_attempts)
 
 
 def run(cfg: KubeSchedulerConfiguration, server_url: str,
@@ -147,7 +151,11 @@ def _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
     client = RESTClient(server_url, token=token, ca_cert_pem=ca_cert_pem,
                         client_cert_pem=client_cert_pem,
                         client_key_pem=client_key_pem)
-    store = RemoteStore(client)
+    # ONE metrics registry shared by the store's reflectors and the
+    # scheduler: reflector_relists/watch_stale/stage=reflector errors
+    # are served from the same /metrics endpoint as scheduling series
+    metrics = Metrics()
+    store = RemoteStore(client, metrics=metrics)
     for kind in ("pods", "nodes", "services", "replicationcontrollers",
                  "replicasets", "statefulsets", "poddisruptionbudgets",
                  "persistentvolumes", "persistentvolumeclaims"):
@@ -171,14 +179,25 @@ def _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
             lambda *_: (sched_holder[0] is not None
                         and sched_holder[0].scrubber.request()))
 
-    def scheduling_loop():
-        sched = build_scheduler(cfg, store)
+    def scheduling_loop(elector: Optional[LeaderElector] = None):
+        sched = build_scheduler(cfg, store, metrics=metrics)
         if contention_profiling:
             from ..utils import profiling
 
             profiling.instrument_lock(sched, "_mu", "scheduler._mu")
         sched_holder[0] = sched
         while not stop.is_set():
+            if elector is not None and not elector.is_leader:
+                # demoted: drain binds once, then idle warm (informers
+                # keep the cache current for the recovery pass)
+                if not sched.dormant:
+                    sched.enter_dormant()
+                stop.wait(0.05)
+                continue
+            if sched.dormant:
+                # re-elected: reconcile assumed pods against API truth,
+                # rebuild the HBM snapshot, resume waves
+                sched.recover_leadership()
             placed = sched.run_once(timeout=0.2)
             if once and sched.queue.active_count() == 0:
                 stop.set()
@@ -188,13 +207,25 @@ def _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
 
     if cfg.leader_election.leader_elect:
         le = cfg.leader_election
+        loop_started = threading.Event()
+
+        def _on_started_leading():
+            # the loop thread is started ONCE and then survives
+            # leadership churn — warm restart, not process restart. The
+            # loop keys dormancy off elector.is_leader itself (caught
+            # within one iteration): enter_dormant's bind drain can
+            # block for seconds, and the elector thread must get back
+            # to candidate mode immediately, not run it
+            if not loop_started.is_set():
+                loop_started.set()
+                threading.Thread(target=scheduling_loop, args=(elector,),
+                                 daemon=True).start()
+
         elector = LeaderElector(
             store, identity=f"{cfg.scheduler_name}-{id(store):x}",
             lock_name=le.lock_name, lease_duration=le.lease_duration,
             renew_deadline=le.renew_deadline, retry_period=le.retry_period,
-            on_started_leading=lambda: threading.Thread(
-                target=scheduling_loop, daemon=True).start(),
-            on_stopped_leading=lambda: stop.set())
+            on_started_leading=_on_started_leading)
         elector.start()
         stop.wait()
         elector.stop()

@@ -8,10 +8,28 @@ component (Scheduler, controllers, kubelets) run unchanged against the
 HTTP apiserver: reads hit the local mirror (informer cache), writes go
 over REST, and watch callbacks fire from reflector threads (the
 sharedProcessor fan-out, shared_informer.go:375).
+
+Stream hardening (the reference's reflector.go backoffManager +
+timeoutSeconds jitter, grown here after PR 2's device-path work left
+this loop as the last silent failure path):
+
+  * relist errors back off exponentially with +/-50% jitter (the old
+    fixed 0.5s sleep hammered a flapping apiserver in lockstep with
+    every OTHER reflector in the fleet) and are LOGGED with traceback +
+    counted in scheduling_errors_total{stage=reflector} — a reflector
+    dying quietly starves the scheduler of events with no signal;
+  * a staleness watchdog forces a full relist when the watch stream has
+    produced nothing past `stale_after` — a wedged-but-open stream (half
+    -closed TCP, a proxy eating frames) otherwise looks identical to a
+    quiet cluster forever (`watch_stale_total`);
+  * every list+watch cycle is counted (`reflector_relists_total`) and
+    chaos-drivable via the `reflector.relist` fault point.
 """
 
 from __future__ import annotations
 
+import logging
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -19,21 +37,40 @@ from typing import Callable, Dict, List, Optional
 from ..api import scheme
 from ..api import types as api
 from ..runtime.store import ADDED, DELETED, MODIFIED, Conflict, Event
+from ..utils import faultpoints
 from .rest import APIStatusError, RESTClient
 
 
 class Reflector:
     def __init__(self, client: RESTClient, plural: str,
                  on_event: Callable[[Event], None],
-                 relist_backoff: float = 0.5):
+                 relist_backoff: float = 0.5,
+                 max_relist_backoff: float = 30.0,
+                 stale_after: float = 60.0,
+                 watch_timeout: float = 10.0,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 jitter: Callable[[], float] = random.random):
         self.client = client
         self.plural = plural
         self.on_event = on_event
         self.relist_backoff = relist_backoff
+        self.max_relist_backoff = max_relist_backoff
+        # watchdog deadline: a stream with no events for this long is
+        # declared stale and torn down for a relist. Must exceed the
+        # per-stream server timeout (watch_timeout) by a healthy margin
+        # or an idle cluster would relist every cycle.
+        self.stale_after = stale_after
+        self.watch_timeout = watch_timeout
+        self.metrics = metrics  # utils.metrics.Metrics (or None)
+        self.clock = clock
+        self.jitter = jitter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_sync_rv = 0
         self.synced = threading.Event()  # set after the first list completes
+        self.relists = 0       # list+watch cycles entered
+        self.stale_relists = 0  # of those, forced by the staleness watchdog
         self._known: Dict[str, object] = {}
 
     @staticmethod
@@ -49,17 +86,50 @@ class Reflector:
     def stop(self):
         self._stop.set()
 
+    def _record_error(self, exc: BaseException):
+        """A failed list+watch cycle is never silent: traceback to the
+        log, stage=reflector into the labelled error series (matching
+        the PR 2 bind/wave/extender attribution)."""
+        if self.metrics is not None:
+            self.metrics.scheduling_errors.labels(stage="reflector").inc()
+        logging.getLogger(__name__).error(
+            "reflector %s: list+watch failed: %s: %s", self.plural,
+            type(exc).__name__, exc, exc_info=exc)
+
+    def _backoff_wait(self, backoff: float) -> float:
+        """Sleep a jittered backoff (interruptible by stop()) and return
+        the next, doubled backoff. Jitter spans 0.5x-1.5x so a fleet of
+        reflectors knocked over by one apiserver flap doesn't relist in
+        lockstep forever after."""
+        self._stop.wait(backoff * (0.5 + self.jitter()))
+        return min(backoff * 2, self.max_relist_backoff)
+
     def run(self):
+        backoff = self.relist_backoff
         while not self._stop.is_set():
             try:
+                # chaos seam: a `raise` here fails the whole cycle before
+                # the list — the repeated-relist-failure scenario the
+                # exponential backoff exists for
+                faultpoints.fire("reflector.relist")
                 self._list_and_watch()
+                backoff = self.relist_backoff  # clean cycle: reset
             except APIStatusError as e:
-                if e.code != 410:
-                    time.sleep(self.relist_backoff)
-            except Exception:
-                time.sleep(self.relist_backoff)
+                if e.code == 410:
+                    # expected expiry: relist immediately, and a clean
+                    # list resets the backoff ladder
+                    backoff = self.relist_backoff
+                    continue
+                self._record_error(e)
+                backoff = self._backoff_wait(backoff)
+            except Exception as e:
+                self._record_error(e)
+                backoff = self._backoff_wait(backoff)
 
     def _list_and_watch(self):
+        self.relists += 1
+        if self.metrics is not None:
+            self.metrics.reflector_relists.inc()
         items, rv = self.client.list(self.plural)
         # delta replay against the known set (DeltaFIFO Replace semantics,
         # tools/cache/delta_fifo.go Replace: sync adds + implicit deletes)
@@ -78,11 +148,26 @@ class Reflector:
                 self.on_event(Event(DELETED, self.plural, self._known.pop(key)))
         self.last_sync_rv = rv
         self.synced.set()
+        last_progress = self.clock()
         while not self._stop.is_set():
+            if self.clock() - last_progress > self.stale_after:
+                # staleness watchdog: streams keep opening cleanly but
+                # deliver nothing — indistinguishable from an idle
+                # cluster except by relisting and comparing. Tear the
+                # cycle down; run() re-enters with a fresh list.
+                self.stale_relists += 1
+                if self.metrics is not None:
+                    self.metrics.watch_stale.inc()
+                logging.getLogger(__name__).warning(
+                    "reflector %s: watch quiet past %.1fs staleness "
+                    "deadline; forcing a relist", self.plural,
+                    self.stale_after)
+                return
             # the stream ends on server timeoutSeconds; re-arm from last rv
             for etype, obj in self.client.watch(
-                    self.plural, resource_version=rv, timeout_seconds=10.0,
-                    stop=self._stop):
+                    self.plural, resource_version=rv,
+                    timeout_seconds=self.watch_timeout, stop=self._stop):
+                last_progress = self.clock()
                 rv = max(rv, obj.metadata.resource_version)
                 self.last_sync_rv = rv
                 key = self._key(obj)
@@ -114,8 +199,19 @@ class RemoteStore:
     # worthwhile) to post binds from the scheduler's worker pool
     async_bind_safe = True
 
-    def __init__(self, client: RESTClient):
+    # per-attempt deadline on the bind POST: a hung bind must surface as
+    # a retryable error inside the reconciler's budget, not stall a
+    # binder thread for the full 30s default socket timeout
+    bind_timeout = 5.0
+
+    def __init__(self, client: RESTClient, metrics=None,
+                 stale_after: float = 60.0):
         self.client = client
+        # shared utils.metrics.Metrics registry: reflector relist/stale
+        # counters and stage=reflector errors land next to the
+        # scheduler's own series on the same /metrics endpoint
+        self.metrics = metrics
+        self.stale_after = stale_after
         self._lock = threading.RLock()
         self._mirrors: Dict[str, Dict[str, object]] = {}
         self._watchers: List[tuple] = []
@@ -128,7 +224,9 @@ class RemoteStore:
             if kind in self._reflectors:
                 return self
             self._mirrors[kind] = {}
-            refl = Reflector(self.client, kind, self._on_event)
+            refl = Reflector(self.client, kind, self._on_event,
+                             metrics=self.metrics,
+                             stale_after=self.stale_after)
             self._reflectors[kind] = refl
             refl.start()
         return self
@@ -257,7 +355,8 @@ class RemoteStore:
 
     def bind(self, pod: api.Pod, node_name: str):
         try:
-            self.client.bind(pod.metadata.namespace, pod.metadata.name, node_name)
+            self.client.bind(pod.metadata.namespace, pod.metadata.name,
+                             node_name, timeout=self.bind_timeout)
         except APIStatusError as e:
             if e.code == 409:
                 raise Conflict(str(e))

@@ -17,6 +17,7 @@ import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..api import scheme
+from ..utils import faultpoints
 
 
 def _selector_query(label_selector=None, field_selector=None) -> List[str]:
@@ -137,8 +138,18 @@ class RESTClient:
 
     def request_bytes(self, method: str, path: str,
                       body: Optional[dict] = None, query: str = "",
-                      accept: Optional[str] = None):
-        """Raw round trip -> (body bytes, response Content-Type)."""
+                      accept: Optional[str] = None,
+                      timeout: Optional[float] = None):
+        """Raw round trip -> (body bytes, response Content-Type).
+        `timeout` is the per-attempt socket deadline (default 30s) —
+        binds pass a tighter one so a hung POST turns into a retryable
+        error instead of stalling a binder thread for half a minute."""
+        # chaos seam: an armed `rest.request` fault fails (or delays)
+        # every control-plane round trip — the apiserver-flap scenario
+        # the reflector backoff and bind reconciler exist to absorb.
+        # `drop` models the request never reaching the wire.
+        if faultpoints.fire("rest.request", payload=(method, path)):
+            raise OSError(f"rest.request fault: {method} {path} dropped")
         url = self.base_url + path + (f"?{query}" if query else "")
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -149,7 +160,9 @@ class RESTClient:
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            with urllib.request.urlopen(req, timeout=30,
+            with urllib.request.urlopen(req,
+                                        timeout=30 if timeout is None
+                                        else timeout,
                                         context=self._ssl_ctx) as resp:
                 return resp.read(), resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
@@ -161,8 +174,9 @@ class RESTClient:
                                  status.get("message", ""))
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
-                query: str = "") -> dict:
-        raw, _ = self.request_bytes(method, path, body=body, query=query)
+                query: str = "", timeout: Optional[float] = None) -> dict:
+        raw, _ = self.request_bytes(method, path, body=body, query=query,
+                                    timeout=timeout)
         return json.loads(raw or b"{}")
 
     # -- verbs -----------------------------------------------------------------
@@ -217,18 +231,20 @@ class RESTClient:
         rv = int(data.get("metadata", {}).get("resourceVersion", "0"))
         return items, rv
 
-    def get(self, plural: str, namespace: Optional[str], name: str):
+    def get(self, plural: str, namespace: Optional[str], name: str,
+            timeout: Optional[float] = None):
         path = self._path(plural, namespace, name)
         if self.binary:
             from ..api import binary
 
             raw, ctype = self.request_bytes("GET", path,
-                                            accept=binary.CONTENT_TYPE)
+                                            accept=binary.CONTENT_TYPE,
+                                            timeout=timeout)
             if ctype.startswith(binary.CONTENT_TYPE):
                 return binary.loads(raw)
             return scheme.decode(scheme.kind_for_plural(plural),
                                  json.loads(raw or b"{}"))
-        data = self.request("GET", path)
+        data = self.request("GET", path, timeout=timeout)
         return scheme.decode(scheme.kind_for_plural(plural), data)
 
     def create(self, plural: str, obj, namespace: Optional[str] = None):
@@ -282,12 +298,16 @@ class RESTClient:
             body={"kind": "Scale", "apiVersion": "autoscaling/v1",
                   "spec": {"replicas": replicas}})
 
-    def bind(self, namespace: str, pod_name: str, node_name: str):
-        """POST pods/<name>/binding (scheduler.go:409 Bind)."""
+    def bind(self, namespace: str, pod_name: str, node_name: str,
+             timeout: Optional[float] = None):
+        """POST pods/<name>/binding (scheduler.go:409 Bind). `timeout`
+        bounds the single attempt; retry policy lives in the caller's
+        bind reconciler, not here."""
         self.request("POST", self._path("pods", namespace, pod_name, "binding"),
                      body={"kind": "Binding", "apiVersion": "v1",
                            "metadata": {"name": pod_name},
-                           "target": {"kind": "Node", "name": node_name}})
+                           "target": {"kind": "Node", "name": node_name}},
+                     timeout=timeout)
 
     def evict(self, namespace: str, pod_name: str):
         self.request("POST", self._path("pods", namespace, pod_name, "eviction"),
@@ -305,6 +325,11 @@ class RESTClient:
         the resourceVersion is too old — caller relists (reflector.go).
         label_selector filters server-side (transitions translate to
         ADDED/DELETED like the cacher)."""
+        # same chaos seam as request_bytes: watch-stream establishment is
+        # a REST round trip too (a faulting one exercises the reflector's
+        # jittered relist backoff)
+        if faultpoints.fire("rest.request", payload=("WATCH", plural)):
+            raise OSError(f"rest.request fault: watch {plural} dropped")
         q = f"watch=true&timeoutSeconds={timeout_seconds:g}"
         if resource_version is not None:
             q += f"&resourceVersion={resource_version}"

@@ -11,11 +11,22 @@ of the window).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Dict, List, Optional
 
 from .store import Event, ObjectStore
+
+# Slow-watcher overflow policy: a watcher whose queue fills is
+# TERMINATED — its stream ends and the client relists from current state
+# (the level-triggered recovery path every informer already has). The
+# alternatives are both worse: blocking the broadcaster stalls event
+# delivery for EVERY other watcher behind one slow consumer
+# (apimachinery's mux.go blocks, acceptable only in-process), and
+# silently dropping single events breaks the watch contract — the client
+# keeps consuming a stream that skipped history and never finds out.
+OVERFLOW_TERMINATE = "terminate"
 
 
 class TooOld(Exception):
@@ -45,11 +56,18 @@ class Watcher:
 
 
 class Broadcaster:
+    # the one overflow policy that preserves both liveness (never block
+    # the broadcaster) and the watch contract (never silently skip
+    # events); not configurable — any future alternative must rework
+    # the fan-out below, which hardcodes terminate semantics
+    overflow_policy = OVERFLOW_TERMINATE
+
     def __init__(self, store: ObjectStore, window: int = 4096,
                  queue_depth: int = 10000):
         self._lock = threading.Lock()
         self._window = window
         self._queue_depth = queue_depth
+        self.overflowed_total = 0  # watchers terminated for falling behind
         self._history: List[Event] = []
         self._watchers: List[Watcher] = []
         store.watch(None, self._on_event)
@@ -66,8 +84,13 @@ class Broadcaster:
                 try:
                     w._q.put_nowait(ev)
                 except queue.Full:
-                    dead.append(w)  # slow watcher: drop it; client relists
+                    dead.append(w)  # slow watcher: terminate; client relists
             for w in dead:
+                self.overflowed_total += 1
+                logging.getLogger(__name__).warning(
+                    "terminating slow watcher (kind=%s) at queue depth %d; "
+                    "its stream ends and the client must relist",
+                    w.kind, self._queue_depth)
                 self._drop(w)
 
     def _drop(self, w: Watcher):

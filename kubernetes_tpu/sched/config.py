@@ -43,6 +43,9 @@ class KubeSchedulerConfiguration:
     scrub_interval: float = 0.0
     breaker_threshold: int = 3
     breaker_cooldown: float = 30.0
+    # bind reconciler: POST attempts per bind before the GET-based
+    # succeeded-but-response-lost resolution kicks in
+    bind_max_attempts: int = 3
     # informer kinds mirrored before scheduling starts
     feature_gates: dict = field(default_factory=dict)
 

@@ -415,6 +415,19 @@ class SchedulingQueue:
                 return
         self.add(new)
 
+    def remove_if_pending(self, uid: str):
+        """Drop a pod from the pending structures WITHOUT touching gang
+        membership or nomination state — the lost-bind-confirmation
+        recovery path: the pod turned out to be BOUND (API truth), so it
+        must not be scheduled again, but as a live member it still
+        counts toward its gang. Stale heap keys are lazily skipped by
+        the pop path, as with delete()."""
+        with self._lock:
+            self._items.pop(uid, None)
+            self._unschedulable.pop(uid, None)
+            self._backoff.pop(uid, None)
+            self._backoff_until.pop(uid, None)
+
     def delete(self, pod: api.Pod):
         with self._lock:
             self._items.pop(pod.uid, None)

@@ -58,6 +58,7 @@ from .preemption import (GangGuard, PreemptionResult,
                          process_preemption_with_extenders,
                          select_victims_on_node)
 from .queue import SchedulingQueue
+from .reconciler import BOUND, CONFIRMED, GONE, ORPHANED, BindReconciler
 
 
 # Max chained waves per device-resident round; rounds compile per
@@ -153,7 +154,9 @@ class Scheduler:
                  assume_ttl: float = 30.0, caps=None, mesh=None,
                  bind_workers: int = 4,
                  scrub_interval: Optional[float] = None,
-                 breaker_threshold: int = 3, breaker_cooldown: float = 30.0):
+                 breaker_threshold: int = 3, breaker_cooldown: float = 30.0,
+                 metrics: Optional[Metrics] = None,
+                 bind_max_attempts: int = 3):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -178,7 +181,23 @@ class Scheduler:
         self.queue = SchedulingQueue(
             pod_priority_enabled=self.features.enabled("PodPriority"),
             clock=clock)
-        self.metrics = Metrics()
+        # metrics may be a SHARED registry (cli/kube_scheduler.py hands
+        # the same one to the RemoteStore's reflectors so control-plane
+        # series land on the same /metrics endpoint as scheduling ones)
+        self.metrics = metrics or Metrics()
+        # an assumed-pod expiry means a bind confirmation was lost —
+        # count it (cache logs the warning)
+        self.cache.on_expired = (
+            lambda pod: self.metrics.cache_assumed_expired.inc())
+        # bind reconciler: per-attempt-bounded jittered retries on the
+        # bind POST, then GET-against-API-truth resolution of the
+        # succeeded-but-response-lost ambiguity (sched/reconciler.py)
+        self.reconciler = BindReconciler(self._pod_truth,
+                                         metrics=self.metrics,
+                                         max_attempts=bind_max_attempts)
+        # dormant = leadership lost: waves stop, binds drained, informers
+        # stay warm; recover_leadership() reconciles + resumes
+        self._dormant = False
         # gang (PodGroup) coscheduling: the queue parks incomplete gangs
         # and the wave path routes complete ones through the
         # joint-assignment kernel (ops/gang.py). Costs non-gang pods one
@@ -406,7 +425,7 @@ class Scheduler:
         placed = 0
         waves = 0
         allow_pipeline = True
-        while True:
+        while not self._dormant:
             if self.queue.active_count() == 0:
                 # a failed async bind may requeue a pod: settle and recheck
                 self.wait_for_binds()
@@ -457,6 +476,8 @@ class Scheduler:
         """Schedule one wave. Returns the number of pods assumed with a
         bind dispatched (a failed async bind requeues its pod, which then
         counts again on the successful retry)."""
+        if self._dormant:
+            return 0  # not the leader: informers stay warm, waves don't run
         self._housekeep()
         pods = self.queue.pop_wave(self.wave_size, timeout=timeout)
         if not pods:
@@ -1472,14 +1493,18 @@ class Scheduler:
 
     def _bind_and_finish(self, pod: api.Pod, bound: api.Pod,
                          node_name: str, vol_rollback=None) -> bool:
-        """The bind POST + cache confirmation; runs outside _mu. Failure
-        rolls the assume back — including any PVC bindings made during
-        the commit — and requeues (forget-on-failure,
-        scheduler.go:409-432)."""
+        """The bind POST + cache confirmation; runs outside _mu. The
+        POST goes through the bind reconciler (sched/reconciler.py):
+        jittered retries first, then GET-against-API-truth resolution —
+        so a lost bind RESPONSE confirms the assumption while a lost
+        bind REQUEST rolls it back (forget + PVC rollback +
+        backoff-requeue; reference forget-on-failure, scheduler.go:
+        409-432, which tolerated the ambiguity this resolves)."""
         t0 = self.clock()
-        try:
-            # chaos seam: a raise here exercises the full rollback path
-            # (forget + snapshot restore + volume rollback + requeue)
+
+        def _attempt():
+            # chaos seam: a raise here exercises retry, then the full
+            # rollback/confirm resolution path
             faultpoints.fire("bind.post", payload=pod)
             # reference scheduler.go:409 GetBinder: an extender with a bind
             # verb performs the binding; the in-process store is then updated
@@ -1489,12 +1514,44 @@ class Scheduler:
             if binder is not None:
                 binder.bind(pod, node_name)
             self.store.bind(pod, node_name)
-        except Exception:
-            # the rollback itself must not raise into the pool: if the
-            # bind actually landed server-side (response lost) the watch
-            # confirmation may already have consumed the assume, making
-            # forget_pod a KeyError — in that case the pod IS bound and
-            # no rollback is wanted
+
+        outcome, truth = self.reconciler.reconcile(pod, node_name, _attempt)
+        if outcome == CONFIRMED:
+            # the bind landed server-side and only the response was
+            # lost: adopt API truth instead of rolling back. add_pod
+            # confirms the assumption (and moves it if truth names a
+            # different node); a duplicate informer confirmation later
+            # is a no-op by the cache's state machine.
+            with self._mu:
+                self.cache.add_pod(truth)
+                if truth.spec.node_name != node_name:
+                    # adopted onto a DIFFERENT node (another actor's bind
+                    # won): the snapshot row written at assume time still
+                    # charges the assumed node — move it, or that node
+                    # holds phantom capacity until the next scrub
+                    self.snapshot.remove_pod(bound)
+                    ni = self.cache.node_infos.get(node_name)
+                    if ni is not None:
+                        self.snapshot.refresh_node_resources(ni)
+                    nb = self.cache.node_infos.get(truth.spec.node_name)
+                    if nb is not None:
+                        self.snapshot.refresh_node_resources(nb)
+                        self.snapshot.add_pod(truth)
+                    if vol_rollback is not None and \
+                            not self.volume_binder.volumes_admit_node(
+                                pod, nb.node if nb is not None else None):
+                        # our PVC pre-binding chose PVs for the node WE
+                        # assumed; they cannot serve where the pod really
+                        # landed — free the claims so the winning
+                        # leader's commit / the PV controller rebinds
+                        vol_rollback()
+        elif outcome in (ORPHANED, GONE):
+            # never landed (or the pod was deleted): roll the assume
+            # back. The rollback itself must not raise into the pool: if
+            # an informer confirmation consumed the assume concurrently,
+            # forget_pod raises KeyError — the pod IS bound and no
+            # rollback is wanted.
+            self.metrics.scheduling_errors.labels(stage="bind").inc()
             with self._mu:
                 try:
                     self.cache.forget_pod(bound)
@@ -1506,7 +1563,10 @@ class Scheduler:
                 self.snapshot.remove_pod(bound)
             if vol_rollback is not None:
                 vol_rollback()
-            self.queue.add_if_not_present(pod)
+            if outcome == ORPHANED:
+                # backoff-requeue: a bind that just failed repeatedly
+                # should not re-enter the very next wave at full speed
+                self._park_with_backoff(truth if truth is not None else pod)
             return False
         with self._mu:
             self.cache.finish_binding(bound)
@@ -1542,6 +1602,130 @@ class Scheduler:
         if self._bind_pool is not None:
             self._bind_pool.shutdown(wait=True)
             self._bind_pool = None
+
+    # -- leadership lifecycle (warm restart) -----------------------------------
+
+    @property
+    def dormant(self) -> bool:
+        return self._dormant
+
+    def enter_dormant(self) -> None:
+        """Leadership lost: stop scheduling waves and DRAIN in-flight
+        binds — a demoted leader finishing a POST it already sent is
+        safe (the new leader sees the binding through its informers; the
+        server 409s any conflict), but dispatching NEW work is not.
+        Informers keep running so the cache stays warm for
+        recover_leadership(). Idempotent. Taking _mu to set the flag
+        orders dormancy AFTER any wave already executing on another
+        thread, so once this returns no further binds can be dispatched;
+        call it from the scheduling loop, not the elector callback — the
+        drain blocks for as long as in-flight binds take to settle."""
+        if self._dormant:
+            return
+        with self._mu:
+            self._dormant = True
+        self.wait_for_binds()
+        logging.getLogger(__name__).info(
+            "scheduler dormant: leadership lost; binds drained, %d assumed "
+            "pods held for reconciliation, informers stay warm",
+            len(self.cache.assumed_pods()))
+
+    def recover_leadership(self) -> Dict[str, int]:
+        """Leadership re-acquired after a dormant spell: reconcile every
+        assumed pod against API truth (adopt confirmed bindings, forget
+        orphans and release their capacity), force a full HBM snapshot
+        rebuild (nothing incremental is trusted across a leadership
+        gap — another leader may have scheduled through it), and resume
+        waves. Returns the reconciliation tally."""
+        self.wait_for_binds()
+        stats = {"confirmed": 0, "orphaned": 0, "unresolved": 0}
+
+        # phase 1, OUTSIDE _mu: one capped GET per assumed pod (truth,
+        # not the mirror) — informers must stay live while a flapping
+        # apiserver stretches these round trips. The binder pool (idle:
+        # binds just drained) fans the GETs out so a full wave of
+        # assumed pods resolves in ~one round trip, not wave_size of
+        # them serially.
+        def _fetch(pod):
+            try:
+                return (pod, self._pod_truth(pod), True)
+            except Exception as e:
+                # truth unreachable for THIS pod: keep the assumption —
+                # holding capacity briefly beats double-placing; the
+                # assume TTL (cleanup_expired) is the backstop
+                logging.getLogger(__name__).warning(
+                    "recovery: could not resolve assumed pod %s/%s "
+                    "against API truth (%s: %s); keeping the assumption",
+                    pod.namespace, pod.name, type(e).__name__, e)
+                return (pod, None, False)
+
+        assumed = self.cache.assumed_pods()
+        if self._bind_pool is not None and len(assumed) > 1:
+            resolved = list(self._bind_pool.map(_fetch, assumed))
+        else:
+            resolved = [_fetch(p) for p in assumed]
+        # phase 2, under _mu: apply, then rebuild the snapshot wholesale
+        # (so no per-pod snapshot surgery here — the rebuild is the
+        # recovery analog of the device-path breaker's on_recover)
+        with self._mu:
+            for pod, truth, ok in resolved:
+                if not self.cache.is_assumed(pod):
+                    continue  # an informer event settled it while we fetched
+                if not ok:
+                    stats["unresolved"] += 1
+                elif truth is not None and truth.spec.node_name:
+                    self.cache.add_pod(truth)  # adopt the confirmed binding
+                    # the informer events that would normally retire it
+                    # from the pending queue may be exactly what was lost
+                    self.queue.remove_if_pending(pod.uid)
+                    self.queue.assigned_pod_added(truth)
+                    stats["confirmed"] += 1
+                else:
+                    try:
+                        self.cache.forget_pod(pod)
+                    except KeyError:
+                        pass
+                    stats["orphaned"] += 1
+                    if truth is not None:
+                        # still pending in the API: schedule it fresh
+                        self.queue.add_if_not_present(truth)
+                    else:
+                        # deleted while we weren't looking (the DELETED
+                        # event may have been lost too)
+                        self.queue.delete(pod)
+            self.scrubber.rebuild()
+            self._dormant = False
+        # anything another leader failed to place may be schedulable
+        # now; give every parked pod a fresh look in the first wave
+        self.queue.move_all_to_active()
+        logging.getLogger(__name__).info(
+            "scheduler resumed leadership: %(confirmed)d assumed pods "
+            "confirmed, %(orphaned)d orphans forgotten+requeued, "
+            "%(unresolved)d unresolved (TTL backstop)", stats)
+        return stats
+
+    # per-attempt deadline on truth GETs: reconciliation runs on binder
+    # threads and (for the recovery pass) under _mu — a hung round trip
+    # must fail fast, like the bind POST's own bind_timeout
+    TRUTH_GET_TIMEOUT = 5.0
+
+    def _pod_truth(self, pod: api.Pod) -> Optional[api.Pod]:
+        """One pod from API truth. Goes through the REST client when the
+        store is a RemoteStore — its get() serves the reflector mirror,
+        whose staleness is exactly what bind reconciliation and the
+        recovery pass must not trust. None = deleted; raises when truth
+        is unreachable."""
+        client = getattr(self.store, "client", None)
+        if client is not None:
+            from ..client.rest import APIStatusError
+            try:
+                return client.get("pods", pod.namespace, pod.metadata.name,
+                                  timeout=self.TRUTH_GET_TIMEOUT)
+            except APIStatusError as e:
+                if e.code == 404:
+                    return None
+                raise
+        return self.store.get("pods", pod.namespace, pod.name)
 
     # -- failure path ----------------------------------------------------------
 

@@ -38,6 +38,29 @@ class VolumeBinder:
     def pod_has_claims(self, pod: api.Pod) -> bool:
         return any(v.pvc_name for v in pod.spec.volumes)
 
+    def volumes_admit_node(self, pod: api.Pod,
+                           node: Optional[api.Node]) -> bool:
+        """True when every BOUND claim of the pod names a PV admitting
+        `node`. Used by bind reconciliation: a pre-binding made for the
+        node WE chose must be rolled back when the pod actually landed
+        on a node those PVs cannot serve — but kept when it can (our
+        rollback would clobber a still-valid, possibly re-written,
+        binding)."""
+        if node is None:
+            return False
+        for v in pod.spec.volumes:
+            if not v.pvc_name:
+                continue
+            pvc = self.store.get("persistentvolumeclaims", pod.namespace,
+                                 v.pvc_name)
+            if pvc is None or not pvc.spec.volume_name:
+                continue
+            pv = self.store.get("persistentvolumes", "default",
+                                pvc.spec.volume_name)
+            if pv is None or not _pv_admits_node(pv, node):
+                return False
+        return True
+
     def bind_pod_volumes(self, pod: api.Pod, node: Optional[api.Node]
                          ) -> Tuple[bool, Optional[Callable[[], None]]]:
         """Bind the pod's unbound PVCs to PVs admitting `node`.

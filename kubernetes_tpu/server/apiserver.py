@@ -989,8 +989,17 @@ class APIServer:
         return objs
 
     def _serve_list(self, h, plural, namespace, query, gv=None):
-        objs = self._filter_by_selectors(self.store.list(plural, namespace),
-                                         query)
+        # items and resourceVersion must come from ONE store view: read
+        # separately, a write landing between them yields a list whose
+        # rv claims to cover objects it does not contain — a reflector
+        # then watches from that rv and the missed writes are invisible
+        # until a forced relist (the exact silent-wedge the watch-stream
+        # staleness watchdog exists to break, but the server must not
+        # manufacture it)
+        with self.store._lock:
+            listed = self.store.list(plural, namespace)
+            list_rv = self.store.latest_resource_version
+        objs = self._filter_by_selectors(listed, query)
         kind = scheme.kind_for_plural(plural)
         # APIListChunking (1.11 beta; apiserver/pkg/storage continue
         # tokens): ?limit=N pages a deterministic (namespace, name)
@@ -1032,11 +1041,10 @@ class APIServer:
                 and cont_out is None:
             from ..api import binary
 
-            h._send(200, binary.dumps_list(
-                kind, objs, self.store.latest_resource_version),
-                content_type=binary.CONTENT_TYPE)
+            h._send(200, binary.dumps_list(kind, objs, list_rv),
+                    content_type=binary.CONTENT_TYPE)
             return
-        meta = {"resourceVersion": str(self.store.latest_resource_version)}
+        meta = {"resourceVersion": str(list_rv)}
         if cont_out:
             meta["continue"] = cont_out
         body = json.dumps({

@@ -16,6 +16,7 @@ injectable clock so tests control time.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Dict, List, Optional, Set
 
@@ -40,6 +41,11 @@ class SchedulerCache:
         self.nodes: Dict[str, api.Node] = {}
         self._pod_states: Dict[str, _PodState] = {}
         self._assumed: Set[str] = set()
+        # invoked with the expiring pod whenever cleanup_expired drops an
+        # assumed pod — an expiry means a bind confirmation was LOST, so
+        # the owner (the scheduler) counts it in
+        # cache_assumed_expired_total; None = no accounting
+        self.on_expired: Optional[Callable[[api.Pod], None]] = None
 
     # -- assume / confirm / forget (reference: cache.go AssumePod:88,
     #    FinishBinding:110, ForgetPod:130, AddPod:171) ------------------------
@@ -70,6 +76,12 @@ class SchedulerCache:
 
     def is_assumed(self, pod: api.Pod) -> bool:
         return pod.uid in self._assumed
+
+    def assumed_pods(self) -> List[api.Pod]:
+        """The assumed (bound-copy) pods awaiting confirmation — the set
+        a leadership-recovery pass must reconcile against API truth."""
+        return [self._pod_states[uid].pod for uid in list(self._assumed)
+                if uid in self._pod_states]
 
     def add_pod(self, pod: api.Pod):
         """Informer-confirmed add (reference: cache.go:171). Confirms an
@@ -108,9 +120,23 @@ class SchedulerCache:
         for uid in list(self._assumed):
             st = self._pod_states[uid]
             if st.binding_finished and st.deadline is not None and now >= st.deadline:
+                # an expiry is never routine: the bind POST reported
+                # success but no informer confirmation arrived within the
+                # TTL — a lost watch event or a bind that silently never
+                # landed. Dropping it silently (the old behavior) hid
+                # exactly the capacity leaks the reconciler exists to
+                # resolve.
+                logging.getLogger(__name__).warning(
+                    "assumed pod %s/%s on %s expired after %.0fs without "
+                    "bind confirmation (lost confirmation or lost bind); "
+                    "releasing its capacity",
+                    st.pod.namespace, st.pod.name, st.pod.spec.node_name,
+                    self.ttl)
                 self._remove_pod_from_node(st.pod)
                 del self._pod_states[uid]
                 self._assumed.discard(uid)
+                if self.on_expired is not None:
+                    self.on_expired(st.pod)
 
     # -- nodes ---------------------------------------------------------------
 

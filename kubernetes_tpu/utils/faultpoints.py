@@ -14,11 +14,20 @@ Wired points (grep for `faultpoints.fire`):
   kernel.wave      ops/kernel.py schedule_wave entry (per-wave program)
   kernel.round     ops/kernel.py schedule_round entry (device-resident round)
   kernel.gang      ops/gang.py schedule_gang entry (joint-assignment)
-  bind.post        sched/scheduler.py _bind_and_finish, before the POST
+  bind.post        sched/scheduler.py _bind_and_finish, before each POST
+                   attempt (the bind reconciler retries through it)
   watch.deliver    runtime/store.py _notify, before fan-out
   snapshot.write   state/snapshot.py refresh_node_resources, AFTER the
                    row write (payload: (snapshot, node_idx) — the
                    `corrupt` mode's target)
+  rest.request     client/rest.py request_bytes + watch entry — every
+                   control-plane round trip (payload: (method, path);
+                   `drop` models the request never reaching the wire)
+  reflector.relist client/reflector.py run, before each list+watch
+                   cycle (exercises the jittered relist backoff)
+  lease.renew      client/leaderelection.py _try_acquire_or_renew entry
+                   (a `raise` fails renewals -> leadership loss after
+                   renew_deadline; `latency` eats the renew budget)
 
 Modes:
 

@@ -160,6 +160,15 @@ class Metrics:
         self.snapshot_scrub_duration = Histogram(
             "snapshot_scrub_duration_seconds")
         self.device_path_trips = Counter("device_path_breaker_trips_total")
+        # control-plane resilience layer: reflector relist cycles (every
+        # list+watch re-entry, error-driven or watchdog-forced), streams
+        # declared stale by the watchdog, bind POST retry attempts beyond
+        # the first, and assumed pods expired without bind confirmation
+        # (an expiry means a lost confirmation — never silent)
+        self.reflector_relists = Counter("reflector_relists_total")
+        self.watch_stale = Counter("watch_stale_total")
+        self.bind_retries = Counter("bind_retries_total")
+        self.cache_assumed_expired = Counter("cache_assumed_expired_total")
 
     def all_series(self):
         out = {}

@@ -38,6 +38,9 @@ def pytest_configure(config):
         "markers", "slow: long-running tier-2 tests (tier-1 runs -m 'not slow')")
     config.addinivalue_line(
         "markers", "faults: fault-injection / robustness suite (make chaos)")
+    config.addinivalue_line(
+        "markers", "chaos: component-kill / control-plane resilience suite "
+                   "(make chaos)")
 
 
 import pytest  # noqa: E402

@@ -83,12 +83,18 @@ def test_bind_failure_rolls_back_volume_bindings():
     store.bind = failing_bind
     store.create("pods", pvc_pod("p", "data"))
     sched.run_once()
-    assert calls["n"] == 1
+    # the bind reconciler retries the POST before resolving the failure
+    # against API truth (pod unbound -> forget + backoff-requeue)
+    assert calls["n"] == sched.reconciler.max_attempts
     # the PVC binding made during the commit was rolled back
     pvc = store.get("persistentvolumeclaims", "default", "data")
     assert pvc.spec.volume_name == ""
-    # recovery: bind works again -> claim rebinds and pod lands
+    # recovery: bind works again -> claim rebinds and pod lands. The
+    # orphaned bind parked the pod under backoff; fast-forward it and
+    # flush (the cluster-event path) for the retry.
     store.bind = orig_bind
+    sched.queue.set_backoff(store.get("pods", "default", "p").uid, 0.0)
+    sched.queue.move_all_to_active()
     assert sched.schedule_pending() >= 1
     assert store.get("pods", "default", "p").spec.node_name
     assert store.get("persistentvolumeclaims", "default",
