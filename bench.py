@@ -390,6 +390,130 @@ def run_paced_config(nodes, pods, wave, rate=200.0, chunk=100):
     return placed, dt, p99, offered, sched.wave_path()
 
 
+def run_autoscale_config(nodes, pods, wave, join_latency=0.25):
+    """Elastic-cluster drain (the cluster-autoscaler workload): start
+    UNDER-provisioned — `nodes` 16-cpu machines against `pods` one-core
+    pods — so full placement requires repeated scale-up rounds: the
+    autoscaler's on-device what-if (ops/simulate.py) picks a NodeGroup
+    expansion, booted instances join after a simulated `join_latency`,
+    and the flushed backlog places on the new capacity. Reported pods/s
+    spans the WHOLE loop including every join latency. Preemption is
+    disabled: elasticity, not eviction, is the remedy being measured."""
+    import time as _t
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.cloud.provider import FakeCloud, node_from_template
+    from kubernetes_tpu.controllers.clusterautoscaler import ClusterAutoscaler
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import Metrics
+    from kubernetes_tpu.utils.backoff import PodBackoff
+
+    store = ObjectStore()
+    # the drain ends near pods/16 extra standard nodes; pre-size N/LV to
+    # the final fleet so mid-run growth never recompiles the round
+    max_extra = -(-pods // 12)
+    caps = Caps(N=bucket_size(nodes + max_extra + 96),
+                M=bucket_size(pods + 64), P=wave,
+                LV=bucket_size(nodes + max_extra + 256, 64))
+    sched = Scheduler(store, wave_size=wave, caps=caps)
+    sched.profile.disable_preemption = True
+    # snappy retry after node joins (the reference 1s-doubling parking
+    # would dominate a workload that is ALL failure->retry cycles)
+    sched.backoff = PodBackoff(initial=0.01, maximum=0.1)
+    cloud = FakeCloud()
+    joins = []  # (ready_at, node): instances registering after latency
+    cloud.joiner = lambda g, name: joins.append(
+        (_t.time() + join_latency, node_from_template(g, name)))
+
+    def tmpl(name, cpu, mem):
+        return api.Node(
+            metadata=api.ObjectMeta(name=name),
+            status=api.NodeStatus(allocatable=api.resource_list(
+                cpu=cpu, memory=mem, pods=110, ephemeral_storage="200Gi")))
+
+    cloud.add_node_group("standard", tmpl("t-standard", "16", "32Gi"),
+                         max_size=nodes + max_extra, price=1.0)
+    cloud.add_node_group("large", tmpl("t-large", "32", "64Gi"),
+                         max_size=max_extra, price=2.1)
+    ca = ClusterAutoscaler(store, cloud, sched, scale_up_cooldown=0.0,
+                           max_virtual_per_group=32, max_pods_per_pass=wave)
+    # the initial (under-sized) fleet joins instantly
+    cloud.increase_size("standard", nodes)
+    for _, node in joins:
+        store.create("nodes", node)
+    joins.clear()
+
+    # warm outside the window: the round program per wave bucket, and
+    # the what-if program via pods NO template can host (the simulation
+    # runs full-shape but buys nothing)
+    warm = []
+    for i in range(wave):
+        p = _base_pod(api, f"warmup-{i}", "warmup")
+        store.create("pods", p)
+        warm.append(p)
+    sched.warm_pipeline(warm, n_waves=min(-(-pods // wave), 128))
+    sched.warm_pipeline(warm, n_waves=1)
+    for i in range(wave):
+        p = _base_pod(api, f"warmup-sim-{i}", "warmup-sim")
+        p.spec.containers[0].resources.requests["cpu"] = 500_000
+        store.create("pods", p)
+        warm.append(p)
+    sched.schedule_pending()  # parks the oversized pods unschedulable
+    # warm pass must neither buy nor REMOVE nodes (the barely-loaded
+    # warm fleet would otherwise scale down): no node is ever below a
+    # negative utilization threshold
+    threshold, ca.utilization_threshold = ca.utilization_threshold, -1.0
+    ca.run_once()  # compiles the scale-up what-if; resizes nothing
+    ca.utilization_threshold = threshold
+    assert ca.last_scale_up is None, "warm-up must not buy nodes"
+    assert ca.last_scale_down is None, "warm-up must not remove nodes"
+    for p in warm:
+        store.delete("pods", "default", p.metadata.name)
+    sched.metrics = Metrics()
+    ca.metrics = sched.metrics
+
+    for i in range(pods):
+        p = _base_pod(api, f"scale-pod-{i}", "scale-pod")
+        p.spec.containers[0].resources.requests["cpu"] = 1000
+        store.create("pods", p)
+    t0 = _t.time()
+    placed = 0
+    stalled = 0
+    while placed < pods and stalled < 200:
+        n = sched.schedule_pending()
+        placed += n
+        if placed >= pods:
+            break
+        now = _t.time()
+        due = [j for j in joins if j[0] <= now]
+        if due:
+            joins[:] = [j for j in joins if j[0] > now]
+            for _, node in due:
+                store.create("nodes", node)
+            stalled = 0
+            continue
+        if joins:
+            # nothing to do until the booted instances register — the
+            # join latency is PART of the measured wall clock
+            _t.sleep(max(min(r for r, _ in joins) - now, 0.0) + 1e-3)
+            continue
+        r = ca.run_once()
+        stalled = 0 if (n or r["scaled_up"] or r["scaled_down"]) \
+            else stalled + 1
+        if not r["scaled_up"]:
+            _t.sleep(0.005)  # let pod backoffs expire
+    dt = _t.time() - t0
+    p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
+    p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    print(f"# autoscale: final_nodes={store.count('nodes')} "
+          f"nodes_added={int(sched.metrics.autoscaler_scale_ups.value)} "
+          f"join_latency={join_latency}s", file=sys.stderr)
+    return placed, dt, p99, p99_round, sched.wave_path()
+
+
 def run_preempt_config(nodes, pods, wave, device=True):
     """Preemption-heavy drain: every node saturated by low-priority
     hogs, then a high-priority backlog that can only place by evicting
@@ -504,6 +628,10 @@ SUITE = [
     # gang coscheduling: 72 gangs cycling sizes 4/8/16 (28 pods/cycle),
     # each placed all-or-nothing through ops/gang.py
     ("gang", 500, 2016, "gang", []),
+    # elastic cluster: 50 nodes vs 2000 one-core pods across 2 node
+    # groups — pods/s to full placement including the autoscaler's
+    # on-device what-ifs and simulated node join latency
+    ("autoscale", 50, 2000, "autoscale", []),
     ("mixed5k", 5000, 30000, "mixed", []),
 ]
 
@@ -591,7 +719,7 @@ def main():
     ap.add_argument("--workload", default=None,
                     choices=["density", "affinity", "spreading",
                              "antiaffinity", "mixed", "gang", "preempt",
-                             "trickle", "paced"])
+                             "trickle", "paced", "autoscale"])
     ap.add_argument("--host-preempt", action="store_true",
                     help="preempt workload: pin the scheduler to the "
                          "per-wave host path (the comparison baseline; "
@@ -653,6 +781,9 @@ def main():
         placed, dt, p99, p99_round, path = run_preempt_config(
             args.nodes, args.pods, args.wave,
             device=not args.host_preempt)
+    elif args.workload == "autoscale":
+        placed, dt, p99, p99_round, path = run_autoscale_config(
+            args.nodes, args.pods, args.wave)
     elif args.workload == "trickle":
         placed, dt, p99, p99_round, path = run_trickle_config(
             args.nodes, args.pods, args.wave, chunk=args.chunk or 64)

@@ -90,7 +90,8 @@ class HealthServer:
                 family = series.name.partition("{")[0]
                 if family not in typed:
                     typed.add(family)
-                    lines.append(f"# TYPE {family} counter")
+                    kind = getattr(series, "kind", "counter")
+                    lines.append(f"# TYPE {family} {kind}")
                 lines.append(f"{series.name} {series.value}")
         return "\n".join(lines) + "\n"
 

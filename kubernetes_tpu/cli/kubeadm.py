@@ -33,7 +33,7 @@ class Cluster:
 
     def __init__(self, data_dir: Optional[str] = None, port: int = 0,
                  hollow_nodes: int = 0, reconcile_endpoints: bool = True,
-                 secure: bool = False):
+                 secure: bool = False, cluster_autoscaler: bool = False):
         if data_dir:
             from ..runtime.nativestore import NativeObjectStore
 
@@ -111,6 +111,32 @@ class Cluster:
             ca_cert_pem=self.ca.ca_cert_pem if self.ca else None)
         self._sched_store = RemoteStore(self._sched_client)
         self.scheduler = Scheduler(self._sched_store)
+        self.cloud = None
+        if cluster_autoscaler:
+            # elastic NodeGroups behind the fake cloud seam: the
+            # autoscaler controller watches the scheduler's
+            # unschedulable map and resizes these groups through
+            # on-device what-ifs (controllers/clusterautoscaler.py);
+            # booted instances register as hollow-style ready nodes
+            from ..cloud.provider import FakeCloud, node_from_template
+            from ..controllers.clusterautoscaler import ClusterAutoscaler
+
+            cloud = FakeCloud()
+            cloud.joiner = lambda g, name: self.store.create(
+                "nodes", node_from_template(g, name))
+            for gname, cpu, mem, price in (
+                    ("tpu-small", "16", "64Gi", 1.0),
+                    ("tpu-large", "32", "128Gi", 2.3)):
+                tmpl = api.Node(
+                    metadata=api.ObjectMeta(name=f"template-{gname}"),
+                    status=api.NodeStatus(allocatable=api.resource_list(
+                        cpu=cpu, memory=mem, pods=110,
+                        ephemeral_storage="200Gi")))
+                cloud.add_node_group(gname, tmpl, min_size=0, max_size=32,
+                                     price=price)
+            self.cloud = cloud
+            ca = ClusterAutoscaler(self.store, cloud, self.scheduler)
+            self.manager.controllers[ca.name] = ca
         self.hollow = None
         self._hollow_nodes = hollow_nodes
         self._stop = threading.Event()
@@ -453,7 +479,9 @@ def cmd_init(args) -> int:
             return 1
     cluster = Cluster(data_dir=args.data_dir, port=args.port,
                       hollow_nodes=args.hollow_nodes,
-                      secure=getattr(args, "secure", False))
+                      secure=getattr(args, "secure", False),
+                      cluster_autoscaler=getattr(args, "cluster_autoscaler",
+                                                 False))
     for _name, _desc, fn in PHASES:  # store-level phases, in order
         fn(cluster.store)
     cluster.start()
@@ -597,6 +625,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="enable authn (x509/SA-token/static) + "
                              "RBAC-from-API-objects")
     p_init.add_argument("--skip-preflight", action="store_true")
+    p_init.add_argument("--cluster-autoscaler", action="store_true",
+                        dest="cluster_autoscaler",
+                        help="run the cluster autoscaler against two "
+                             "fake-cloud NodeGroups (tpu-small/tpu-large): "
+                             "unschedulable pods trigger simulated "
+                             "scale-up, idle nodes drain and scale down")
     p_phase = sub.add_parser("phase",
                              help="run one init phase (or 'list')")
     p_phase.add_argument("phase")

@@ -1,4 +1,5 @@
 """Cloud provider layer — pkg/cloudprovider analog."""
 
 from .provider import (CloudProvider, FakeCloud, Instances, LoadBalancer,
-                       Route, Routes, Zone, Zones)
+                       NodeGroup, NodeGroups, Route, Routes, Zone, Zones,
+                       node_from_template)

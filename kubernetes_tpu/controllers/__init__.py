@@ -36,4 +36,5 @@ from .nodeipam import NodeIpamController
 from .route import RouteController
 from .service_lb import ServiceLBController
 from .cloud_node import CloudNodeController
+from .clusterautoscaler import ClusterAutoscaler
 from .manager import ControllerManager

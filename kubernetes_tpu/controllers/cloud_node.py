@@ -11,11 +11,10 @@ will use the node (:355).
 from __future__ import annotations
 
 from ..api import types as api
-from ..cloud.provider import CloudProvider
+from ..cloud.provider import LABEL_INSTANCE_TYPE, CloudProvider
 from .base import Controller
 
 CLOUD_TAINT = "node.cloudprovider.kubernetes.io/uninitialized"
-LABEL_INSTANCE_TYPE = "beta.kubernetes.io/instance-type"
 LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
 LABEL_REGION = "failure-domain.beta.kubernetes.io/region"
 

@@ -67,7 +67,7 @@ class ControllerManager:
                  identity: str = "controller-manager",
                  leader_elect: bool = False, cloud=None,
                  cluster_cidr: str = "", metrics_scraper: bool = False,
-                 kubelet_client_ctx=None):
+                 kubelet_client_ctx=None, scheduler=None):
         self.store = store
         self.controllers: Dict[str, Controller] = {}
         for cls in (controllers if controllers is not None
@@ -100,6 +100,15 @@ class ControllerManager:
                 self.controllers[c.name] = c
             if cloud.routes() is not None:
                 c = RouteController(store, cloud)
+                self.controllers[c.name] = c
+            # the cluster autoscaler needs BOTH a sizable cloud (node
+            # groups) and the scheduler's simulation hooks — it runs off
+            # the live snapshot/queue, so a bare store isn't enough
+            # (the reference ships it as a separate binary for the same
+            # reason: it is a scheduler-shaped consumer of cluster state)
+            if scheduler is not None and cloud.node_groups() is not None:
+                from .clusterautoscaler import ClusterAutoscaler
+                c = ClusterAutoscaler(store, cloud, scheduler)
                 self.controllers[c.name] = c
         self.elector = LeaderElector(
             store, identity, lock_name="kube-controller-manager",

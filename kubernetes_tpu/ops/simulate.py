@@ -1,0 +1,155 @@
+"""On-device cluster simulation plane (the cluster autoscaler's engine).
+
+The reference autoscaler's `simulator/` package answers two what-ifs by
+cloning NodeInfo maps host-side and re-running predicates pod by pod:
+
+  scale-up   "which/how many nodes of which template would make these
+             pending pods feasible?"
+  scale-down "can this node's residents be re-placed on the rest of the
+             cluster simultaneously?"
+
+Both are literally batched (pods x candidate-nodes) feasibility passes —
+the exact computation the HBM snapshot kernel already performs for real
+nodes — so here the simulation runs on the device path instead:
+
+  1. SHADOW SNAPSHOT — the host cache is re-featurized into a scratch
+     `Snapshot` that shares the live vocabularies (the scrubber's
+     golden-row trick, state/scrubber.py: interning is idempotent so ids
+     line up) but owns its caps, so what-if growth never resizes the
+     live mirror. Scale-up appends *virtual* rows featurized from
+     NodeGroup template nodes AFTER the real rows; scale-down omits the
+     candidate node (and its pods) instead.
+  2. DEVICE PASS — the existing batched kernels run unchanged over the
+     shadow tensors: `schedule_wave` for scale-up (its greedy commit
+     under shared capacity binpacks pods onto the virtual rows for
+     free), `schedule_gang` with need == len(residents) for scale-down
+     (the all-or-nothing plane IS the joint re-placement proof: either
+     every resident re-fits simultaneously or nothing reports placed).
+  3. VERDICT — placements plus the all-predicate feasibility matrix
+     come back in one fetch; rows >= n_real are expansion demand.
+
+Chaos seam: `autoscaler.simulate` fires before each device pass (the
+kernel's own `kernel.wave` / `kernel.gang` points fire inside too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Tuple
+
+import numpy as np
+
+from ..api import types as api
+from ..state.node_info import NodeInfo
+from ..state.snapshot import Snapshot
+from ..utils import faultpoints
+
+
+class SimulationVerdict(NamedTuple):
+    """One scale-up what-if: per-pod placement over real+virtual rows
+    plus the static all-predicate feasibility matrix."""
+
+    chosen: np.ndarray  # i32 [P]  row index (>= n_real: virtual), -1 = none
+    feasible: np.ndarray  # bool [P, N]  AND over the predicate mask stack
+    n_real: int  # rows below this index are real nodes
+
+
+def virtual_node_infos(group, count: int, prefix: str = "~ca") -> List[NodeInfo]:
+    """`count` NodeInfos featurized from a NodeGroup's template — the
+    virtual rows of the scale-up shadow. The "~" name prefix can never
+    collide with a registered node (DNS-1123 forbids it) and the names
+    exist only inside the scratch snapshot."""
+    from ..cloud.provider import node_from_template
+
+    return [NodeInfo(node_from_template(group, f"{prefix}/{group.name}/{i}"))
+            for i in range(count)]
+
+
+def shadow_snapshot(cache, live: Snapshot, exclude=(),
+                    virtual: List[NodeInfo] = ()) -> Tuple[Snapshot, int]:
+    """Scratch snapshot re-featurized from the host cache, sharing the
+    live vocabularies but owning copied caps (scrubber trick). Real
+    nodes (minus `exclude`) land first WITH their resident pods — the
+    what-if must see current usage, ports, and the live pod matrix for
+    anti-affinity — then `virtual` NodeInfos append after them.
+    Returns (snapshot, n_real)."""
+    scratch = Snapshot(vocabs=live.vocabs,
+                       caps=dataclasses.replace(live.caps))
+    for name, ni in cache.node_infos.items():
+        if ni.node is None or name in exclude:
+            continue
+        scratch.set_node(ni)
+        for pod in ni.pods:
+            scratch.add_pod(pod)
+    n_real = len(scratch.node_names)
+    for vni in virtual:
+        scratch.set_node(vni)
+    return scratch, n_real
+
+
+def simulate_placements(snapshot: Snapshot, pb, *, weights, num_zones: int,
+                        num_label_values: int, has_ipa: bool = False,
+                        use_pallas: bool = False) -> SimulationVerdict:
+    """Scale-up what-if: the batched wave kernel over (pending pods x
+    real+virtual rows). The scan's greedy commit carries usage across
+    the batch, so multiple pods packing onto one virtual node — and the
+    point where it fills and a second one is needed — fall out of the
+    existing kernel. n_real is filled in by the caller (the snapshot
+    doesn't know which rows are virtual)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .kernel import schedule_wave
+
+    faultpoints.fire("autoscaler.simulate")
+    nt, pm, tt = snapshot.to_device()
+    P = pb.req.shape[0]
+    extra = np.ones((P, snapshot.caps.N), bool)
+    res = schedule_wave(nt, pm, tt, pb, extra, jnp.asarray(0, jnp.int32),
+                        None, weights=weights, num_zones=num_zones,
+                        num_label_values=num_label_values,
+                        has_ipa=has_ipa, use_pallas=use_pallas)
+    jax.block_until_ready(res.chosen)
+    chosen = np.asarray(res.chosen)
+    feasible = np.asarray(res.masks).all(axis=0)  # [P, N]
+    return SimulationVerdict(chosen=chosen, feasible=feasible, n_real=-1)
+
+
+def simulate_refit(snapshot: Snapshot, pb, need: int, *, weights,
+                   num_zones: int, num_label_values: int,
+                   has_ipa: bool = False,
+                   use_pallas: bool = False) -> Tuple[bool, np.ndarray]:
+    """Scale-down what-if: joint re-placement of a drain candidate's
+    residents on the remaining cluster, through the gang all-or-nothing
+    plane (ops/gang.py) with need == number of residents — the verdict
+    is True only when EVERY resident holds capacity simultaneously in
+    one scan, i.e. the drain cannot strand a pod Pending. Returns
+    (ok, chosen rows)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .gang import schedule_gang
+
+    faultpoints.fire("autoscaler.simulate")
+    nt, pm, tt = snapshot.to_device()
+    P = pb.req.shape[0]
+    extra = np.ones((P, snapshot.caps.N), bool)
+    res = schedule_gang(nt, pm, tt, pb, extra, jnp.asarray(0, jnp.int32),
+                        None, jnp.asarray(need, jnp.int32), weights=weights,
+                        num_zones=num_zones,
+                        num_label_values=num_label_values,
+                        has_ipa=has_ipa, use_pallas=use_pallas)
+    jax.block_until_ready(res.chosen)
+    return bool(np.asarray(res.ok)), np.asarray(res.chosen)
+
+
+def strip_node_name(pod: api.Pod) -> api.Pod:
+    """Copy of a bound pod with its placement cleared — residents of a
+    drain candidate must featurize as if pending, or their host_idx
+    would pin them to the very row the shadow omitted (-2: matches no
+    node) and every refit proof would fail vacuously."""
+    import copy
+
+    out = copy.deepcopy(pod)
+    out.spec.node_name = ""
+    return out

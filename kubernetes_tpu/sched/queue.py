@@ -465,6 +465,17 @@ class SchedulingQueue:
                     + len(self._backoff)
                     + sum(len(w) for w in self._gang_waiting.values()))
 
+    def unschedulable_pods(self) -> List[api.Pod]:
+        """Snapshot of the unschedulable map — the cluster autoscaler's
+        feed: these are exactly the pods that failed on EVERY node and
+        are waiting for the cluster to change."""
+        with self._lock:
+            return list(self._unschedulable.values())
+
+    def unschedulable_count(self) -> int:
+        with self._lock:
+            return len(self._unschedulable)
+
     def gang_waiting_count(self) -> int:
         with self._lock:
             return sum(len(w) for w in self._gang_waiting.values())

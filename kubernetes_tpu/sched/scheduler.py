@@ -456,21 +456,35 @@ class Scheduler:
             if max_waves is not None and waves >= max_waves:
                 break
         self.wait_for_binds()
+        self.export_queue_gauges()
         return placed
 
     def _housekeep(self) -> None:
         """Per-cycle maintenance: expire assumed pods, sweep idle
         backoff entries (PodBackoff.gc, reference backoff_utils.go Gc —
         previously never invoked, so every pod that EVER failed held an
-        entry forever), and run the snapshot scrubber if its signal or
-        cadence fired."""
+        entry forever), refresh the queue-depth gauges, and run the
+        snapshot scrubber if its signal or cadence fired."""
         with self._mu:
             self.cache.cleanup_expired()
         now = self.clock()
         if now >= self._next_backoff_gc:
             self._next_backoff_gc = now + self.BACKOFF_GC_PERIOD
             self.backoff.gc()
+        self.export_queue_gauges()
         self.scrubber.maybe_scrub()
+
+    def export_queue_gauges(self) -> None:
+        """Refresh scheduler_pending_pods{queue=...} — queue depth was
+        invisible before this gauge; the cluster autoscaler's demand
+        signal and the operator's backlog dashboard both read it. Called
+        from housekeeping AND after a drain settles (the final parks of
+        a wave land after its housekeeping pass ran)."""
+        g = self.metrics.pending_pods
+        g.labels(queue="active").set(self.queue.active_count())
+        g.labels(queue="backoff").set(self.queue.backoff_count())
+        g.labels(queue="unschedulable").set(self.queue.unschedulable_count())
+        g.labels(queue="gang_waiting").set(self.queue.gang_waiting_count())
 
     def run_once(self, timeout: float = 0.0) -> int:
         """Schedule one wave. Returns the number of pods assumed with a
@@ -1602,6 +1616,23 @@ class Scheduler:
         if self._bind_pool is not None:
             self._bind_pool.shutdown(wait=True)
             self._bind_pool = None
+
+    # -- cluster-autoscaler hooks ----------------------------------------------
+
+    def pending_unschedulable(self) -> List[api.Pod]:
+        """Snapshot of the unschedulable map — the cluster autoscaler's
+        demand feed: pods that failed on every node and wait for the
+        cluster to change."""
+        return self.queue.unschedulable_pods()
+
+    def shadow_featurizer(self, snapshot: Snapshot) -> PodFeaturizer:
+        """Pending-pod featurization over a scratch snapshot (the
+        autoscaler's what-if hook, ops/simulate.py): shares the live
+        GroupLister so spreading selectors encode exactly as they would
+        on the live path. The scratch snapshot must share the live
+        vocabularies (shadow_snapshot guarantees it) so interned ids
+        line up."""
+        return PodFeaturizer(snapshot, self.featurizer.group_selectors)
 
     # -- leadership lifecycle (warm restart) -----------------------------------
 

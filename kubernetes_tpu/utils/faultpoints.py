@@ -28,6 +28,15 @@ Wired points (grep for `faultpoints.fire`):
   lease.renew      client/leaderelection.py _try_acquire_or_renew entry
                    (a `raise` fails renewals -> leadership loss after
                    renew_deadline; `latency` eats the renew budget)
+  autoscaler.simulate  ops/simulate.py simulate_placements /
+                   simulate_refit entry — the autoscaler's on-device
+                   what-if passes (a `raise` models a faulting device
+                   path: the pass is skipped, no resize happens)
+  cloud.resize     cloud/provider.py FakeCloud increase_size /
+                   delete_nodes, BEFORE any mutation (payload: (op,
+                   group, arg)) — a `raise` models a rejected cloud API
+                   call; group target/instances stay untouched and the
+                   autoscaler backs the group off
 
 Modes:
 

@@ -17,6 +17,8 @@ from typing import Dict, List, Optional
 
 
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_
@@ -26,6 +28,33 @@ class Counter:
     def inc(self, delta: float = 1.0):
         with self._lock:
             self.value += delta
+
+
+class Gauge:
+    """A value that can go down (prometheus Gauge) — queue depths,
+    in-flight counts, target sizes. Counters only ever accumulate, so
+    exporting a queue depth through one (the only pre-existing type)
+    would be a lie the first time the queue drains."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, delta: float = 1.0):
+        with self._lock:
+            self.value += delta
+
+    def dec(self, delta: float = 1.0):
+        with self._lock:
+            self.value -= delta
 
 
 class LabeledCounter:
@@ -64,6 +93,39 @@ class LabeledCounter:
             return sum(c.value for c in self._children.values())
 
     def children(self) -> List[Counter]:
+        with self._lock:
+            return list(self._children.values())
+
+
+class LabeledGauge:
+    """Gauge family over a fixed label set (mirrors LabeledCounter —
+    children render as `name{queue="active"} 3`)."""
+
+    def __init__(self, name: str, labelnames=("queue",), help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[tuple, Gauge] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kw) -> Gauge:
+        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        with self._lock:
+            g = self._children.get(key)
+            if g is None:
+                rendered = ",".join(
+                    f'{ln}="{v}"' for ln, v in zip(self.labelnames, key))
+                g = Gauge(f"{self.name}{{{rendered}}}")
+                self._children[key] = g
+            return g
+
+    def value(self, **kw) -> float:
+        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        with self._lock:
+            g = self._children.get(key)
+            return g.value if g is not None else 0.0
+
+    def children(self) -> List[Gauge]:
         with self._lock:
             return list(self._children.values())
 
@@ -169,13 +231,22 @@ class Metrics:
         self.watch_stale = Counter("watch_stale_total")
         self.bind_retries = Counter("bind_retries_total")
         self.cache_assumed_expired = Counter("cache_assumed_expired_total")
+        # queue depth per area, refreshed by the scheduler housekeeping
+        # step — the cluster autoscaler and operators both watch it
+        # (a Counter can't report a depth that drains)
+        self.pending_pods = LabeledGauge("scheduler_pending_pods", ("queue",))
+        # cluster-autoscaler series (autoscaler's scaled_up/down analogs)
+        self.autoscaler_scale_ups = Counter(
+            "cluster_autoscaler_scaled_up_nodes_total")
+        self.autoscaler_scale_downs = Counter(
+            "cluster_autoscaler_scaled_down_nodes_total")
 
     def all_series(self):
         out = {}
         for k, v in vars(self).items():
-            if isinstance(v, (Counter, Histogram)):
+            if isinstance(v, (Counter, Gauge, Histogram)):
                 out[k] = v
-            elif isinstance(v, LabeledCounter):
+            elif isinstance(v, (LabeledCounter, LabeledGauge)):
                 for c in v.children():
                     out[c.name] = c
         return out

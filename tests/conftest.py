@@ -41,6 +41,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: component-kill / control-plane resilience suite "
                    "(make chaos)")
+    config.addinivalue_line(
+        "markers", "autoscale: cluster-autoscaler suite (NodeGroup "
+                   "scale-up/scale-down what-ifs on the device path)")
 
 
 import pytest  # noqa: E402

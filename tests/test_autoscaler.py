@@ -1,0 +1,583 @@
+"""Cluster-autoscaler tests: NodeGroup scale-up/scale-down with the
+what-if computed on the device path (ops/simulate.py), min/max bounds +
+cooldowns, cloud.resize chaos consistency, and the node add/delete ->
+snapshot row lifecycle under snapshot.write faults.
+
+Reference test model: cluster-autoscaler's static_autoscaler_test.go /
+scale_test.go run RunOnce against a fake cloud provider with template
+node groups — same shape here, against FakeCloud's NodeGroups."""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.cloud.provider import (LABEL_INSTANCE_TYPE, FakeCloud,
+                                           NodeGroup, node_from_template)
+from kubernetes_tpu.controllers import (ClusterAutoscaler, ControllerManager,
+                                        ReplicaSetController)
+from kubernetes_tpu.controllers.clusterautoscaler import pick_expansion
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import faultpoints
+
+from helpers import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, d):
+        self.t += d
+
+
+def make_world(n_nodes=2, node_cpu="2", clock=None):
+    clock = clock or FakeClock()
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=16, clock=clock)
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", cpu=node_cpu))
+    cloud = FakeCloud()
+    cloud.joiner = lambda g, name: store.create(
+        "nodes", node_from_template(g, name))
+    return clock, store, sched, cloud
+
+
+RS_SEL = LabelSelector(match_labels={"app": "w"})
+
+
+def rs_template(cpu="1"):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels={"app": "w"}),
+        spec=api.PodSpec(containers=[api.Container(
+            resources=api.ResourceRequirements(
+                requests=api.resource_list(cpu=cpu, memory="64Mi")))]))
+
+
+class TestScaleUp:
+    def test_scale_up_e2e_device_verdict(self):
+        """Unschedulable pods -> simulated group pick (device path) ->
+        nodes added -> pods placed."""
+        clock, store, sched, cloud = make_world(2, node_cpu="2")
+        small = cloud.add_node_group("small", make_node("t-s", cpu="2"),
+                                     max_size=4, price=1.0)
+        big = cloud.add_node_group("big", make_node("t-b", cpu="8"),
+                                   max_size=4, price=3.0)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock)
+        for i in range(3):
+            store.create("pods", make_pod(f"p{i}", cpu="3"))
+        assert sched.schedule_pending() == 0
+        assert len(sched.pending_unschedulable()) == 3
+        r = ca.run_once()
+        # only the big group can host a 3-cpu pod (small's template is 2)
+        assert 1 <= r["scaled_up"] <= 3
+        assert big.target_size == r["scaled_up"]
+        assert small.target_size == 0
+        # the verdict came from the device feasibility kernel: every
+        # helped pod's chosen row is a VIRTUAL row (>= n_real) and no
+        # real row was statically feasible for it
+        v = ca.last_verdict
+        assert v is not None and v.n_real == 2
+        assert (v.chosen[:3] >= v.n_real).all()
+        assert not v.feasible[:3, :v.n_real].any()
+        # joined nodes carry the membership label the controller infers
+        joined = [n for n in store.list("nodes")
+                  if (n.metadata.labels or {}).get(LABEL_INSTANCE_TYPE) == "big"]
+        assert len(joined) == big.target_size
+        evs = [e for e in store.list("events")
+               if e.reason == "TriggeredScaleUp"]
+        assert len(evs) == 3  # one per helped pod
+        clock.advance(2.0)  # clear the pods' failure backoff
+        assert sched.schedule_pending() == 3
+        assert sched.queue.pending_count() == 0
+        bound = {p.spec.node_name for p in store.list("pods")}
+        assert all(n.startswith("big-") for n in bound)
+
+    def test_no_scale_up_when_pods_fit_nowhere(self):
+        """A pod no template can host buys no machines."""
+        clock, store, sched, cloud = make_world(1, node_cpu="1")
+        grp = cloud.add_node_group("small", make_node("t", cpu="2"),
+                                   max_size=4)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock)
+        store.create("pods", make_pod("huge", cpu="64"))
+        assert sched.schedule_pending() == 0
+        r = ca.run_once()
+        assert r["scaled_up"] == 0 and grp.target_size == 0
+
+    def test_no_scale_up_for_pod_with_a_real_home(self):
+        """A pod parked in the unschedulable map that a real node could
+        statically host (it is merely backing off) must not trigger an
+        expansion."""
+        clock, store, sched, cloud = make_world(1, node_cpu="4")
+        grp = cloud.add_node_group("g", make_node("t", cpu="4"), max_size=4)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock)
+        # fill the node, then fail a same-size pod (full != infeasible:
+        # the resource mask IS capacity-aware, so feasible stays False —
+        # use a pod that fits the empty template AND the real node shape
+        # once capacity frees: real node full -> not statically feasible
+        # -> this pod legitimately triggers scale-up. The no-trigger case
+        # needs a pod whose failure was transient: simulate by parking a
+        # pod that DOES fit the live node.
+        p = make_pod("fits", cpu="1")
+        sched.queue.add(p)
+        pod = sched.queue.pop_wave(16)[0]
+        sched._park_with_backoff(pod)  # parked, but a real node fits it
+        assert len(sched.pending_unschedulable()) == 1
+        r = ca.run_once()
+        assert r["scaled_up"] == 0 and grp.target_size == 0
+
+    def test_pick_expansion_prefers_helped_then_price(self):
+        a = NodeGroup("a", make_node("t"), price=5.0)
+        b = NodeGroup("b", make_node("t"), price=1.0)
+        # more pods helped wins regardless of price
+        g, n = pick_expansion([(a, 4, 2), (b, 2, 1)])
+        assert g.name == "a" and n == 2
+        # equal help: cheapest total price wins
+        g, n = pick_expansion([(a, 3, 1), (b, 3, 2)])
+        assert g.name == "b"  # 5.0*1 > 1.0*2
+        assert pick_expansion([(a, 0, 0)]) is None
+
+
+class TestBoundsAndCooldown:
+    def test_max_bound_clamps_and_cooldown_blocks(self):
+        clock, store, sched, cloud = make_world(1, node_cpu="1")
+        cloud.joiner = None  # instances boot but never register: pods
+        # stay pending, so a second pass WOULD re-trigger without the
+        # cooldown — exactly the double-scale-up hazard
+        grp = cloud.add_node_group("g", make_node("t", cpu="8"),
+                                   max_size=1)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               scale_up_cooldown=10.0)
+        for i in range(5):
+            store.create("pods", make_pod(f"p{i}", cpu="2"))
+        assert sched.schedule_pending() == 0
+        r = ca.run_once()
+        # headroom clamps the what-if to ONE virtual row (max_size 1),
+        # so the expansion is 1 even though 5 pods are pending
+        assert r["scaled_up"] == 1 and grp.target_size == 1
+        # immediately again: cooling down AND at max — no double buy
+        assert ca.run_once()["scaled_up"] == 0
+        assert grp.target_size == 1
+        clock.advance(11.0)  # cooldown passed; headroom still 0
+        assert ca.run_once()["scaled_up"] == 0
+        assert grp.target_size == 1  # never exceeds max_size
+
+    def test_min_bound_blocks_scale_down(self):
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("g", make_node("t", cpu="4"),
+                                   min_size=1, max_size=4)
+        cloud.increase_size("g", 1)  # one idle member at min_size
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.5)
+        clock.advance(100.0)  # far past any cooldown
+        r = ca.run_once()
+        assert r["scaled_down"] == 0 and grp.target_size == 1
+        assert len(store.list("nodes")) == 1
+        # lowering the floor releases it
+        grp.min_size = 0
+        r = ca.run_once()
+        assert r["scaled_down"] == 1 and grp.target_size == 0
+        assert store.list("nodes") == []
+
+
+class TestScaleDown:
+    def test_scale_down_e2e_refit_cordon_drain_delete(self):
+        """Underutilized node -> joint re-fit proof (gang plane) ->
+        cordon -> drain -> delete_nodes -> no pod left Pending."""
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("small", make_node("t", cpu="4"),
+                                   max_size=10)
+        cloud.increase_size("small", 3)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.6)
+        store.create("replicasets", api.ReplicaSet(
+            metadata=api.ObjectMeta(name="rs1"),
+            spec=api.ReplicaSetSpec(replicas=4, selector=RS_SEL,
+                                    template=rs_template(cpu="1"))))
+        rsc = ReplicaSetController(store)
+        rsc.sync_all()
+        assert sched.schedule_pending() == 4
+        r = ca.run_once()
+        assert r["scaled_down"] == 1
+        removed = ca.last_scale_down
+        assert removed is not None
+        assert grp.target_size == 2
+        assert removed not in cloud.instances_by_name
+        assert store.get("nodes", "default", removed) is None
+        assert [e.involved_name for e in store.list("events")
+                if e.reason == "ScaleDown"] == [removed]
+        # drained residents were deleted; the RS recreates, and the
+        # refit proof guaranteed the remaining two nodes host everything
+        rsc.sync_all()
+        clock.advance(2.0)
+        sched.schedule_pending()
+        pods = store.list("pods")
+        assert len(pods) == 4
+        assert all(p.spec.node_name for p in pods), "pod left Pending"
+        assert removed not in {p.spec.node_name for p in pods}
+        assert sched.scrubber.scrub().clean
+
+    def test_refit_failure_keeps_the_node(self):
+        """Residents that cannot jointly re-fit pin the node: 2 nodes
+        each half-full with pods that exactly fill one node — removing
+        either strands a pod, so neither may be removed."""
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("g", make_node("t", cpu="4"),
+                                   max_size=4)
+        cloud.increase_size("g", 2)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.9)
+        for i in range(2):
+            store.create("pods", make_pod(f"p{i}", cpu="3",
+                                          owner_uid="rs-x"))
+        assert sched.schedule_pending() == 2
+        r = ca.run_once()
+        assert r["scaled_down"] == 0
+        assert grp.target_size == 2 and len(store.list("nodes")) == 2
+        assert all(not n.spec.unschedulable for n in store.list("nodes"))
+
+    def test_bare_pod_pins_the_node(self):
+        """A resident without a controller owner would be destroyed by
+        the drain (nothing recreates it): the node is never a
+        candidate, however idle."""
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("g", make_node("t", cpu="8"),
+                                   max_size=4)
+        cloud.increase_size("g", 2)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.9)
+        store.create("pods", make_pod("bare", cpu="1"))  # no owner
+        assert sched.schedule_pending() == 1
+        clock.advance(100.0)
+        # only the EMPTY node may go; the bare pod's node never
+        for _ in range(3):
+            ca.run_once()
+            clock.advance(100.0)
+        held = store.get("pods", "default", "bare")
+        assert held is not None and held.spec.node_name
+        assert len(store.list("nodes")) == 1
+
+    def test_pdb_exhausted_pins_the_node(self):
+        """Residents whose PDB has no disruptions left block the drain
+        (the preemption path already honors PDBs; the drain must too)."""
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("g", make_node("t", cpu="8"),
+                                   max_size=4)
+        cloud.increase_size("g", 2)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.9)
+        store.create("pods", make_pod("guarded", cpu="1",
+                                      labels={"app": "w"},
+                                      owner_uid="rs-x"))
+        assert sched.schedule_pending() == 1
+        store.create("poddisruptionbudgets", api.PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="pdb"),
+            selector=RS_SEL, disruptions_allowed=0))
+        clock.advance(100.0)
+        for _ in range(3):
+            ca.run_once()
+            clock.advance(100.0)
+        guarded = store.get("pods", "default", "guarded")
+        assert guarded is not None and guarded.spec.node_name
+        assert len(store.list("nodes")) == 1  # only the empty node went
+
+    def test_late_binding_pod_aborts_the_drain(self):
+        """The refit proof runs before the cordon lands: a pod bound to
+        the candidate in that window was never proved to re-fit, so the
+        drain must abort (uncordon) rather than orphan it onto a
+        deleted node. The bind is injected exactly inside the window
+        via the autoscaler.simulate fault point."""
+        from kubernetes_tpu.controllers.clusterautoscaler import \
+            ANN_SCALE_DOWN
+        clock, store, sched, cloud = make_world(0)
+        cloud.add_node_group("g", make_node("t", cpu="8"), max_size=4)
+        cloud.increase_size("g", 1)
+        gnode = store.list("nodes")[0].name
+        # a big non-group node absorbs the refit so the proof passes
+        store.create("nodes", make_node("spare", cpu="16"))
+        store.create("pods", make_pod("resident", cpu="1",
+                                      owner_uid="rs-x"))
+        assert sched.schedule_pending() == 1
+        # the resident landed somewhere; pin the test to the group node
+        res = store.get("pods", "default", "resident")
+        if res.spec.node_name != gnode:
+            store.delete("pods", "default", "resident")
+            p = make_pod("resident", cpu="1", owner_uid="rs-x")
+            store.create("pods", p)
+            store.bind(p, gnode)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.9)
+        clock.advance(100.0)
+
+        def bind_late(_payload):
+            late = make_pod("latecomer", cpu="1", owner_uid="rs-y")
+            store.create("pods", late)
+            store.bind(late, gnode)
+
+        faultpoints.activate("autoscaler.simulate", "corrupt",
+                             fn=bind_late, times=1)
+        r = ca.run_once()
+        assert r["scaled_down"] == 0
+        node = store.get("nodes", "default", gnode)
+        assert node is not None, "node must not be deleted"
+        assert not node.spec.unschedulable, "drain aborted: uncordoned"
+        assert ANN_SCALE_DOWN not in (node.metadata.annotations or {})
+        assert store.get("pods", "default", "latecomer") is not None
+        assert store.get("pods", "default", "resident") is not None
+
+    def test_resumed_drain_aborts_when_refit_no_longer_holds(self):
+        """A drain interrupted mid-way resumes after restart; if the
+        cluster meanwhile lost the spare capacity the proof relied on,
+        the resume must UNCORDON instead of wedging the node cordoned
+        forever (and shadowing every other candidate)."""
+        from kubernetes_tpu.controllers.clusterautoscaler import \
+            ANN_SCALE_DOWN
+        clock, store, sched, cloud = make_world(0)
+        cloud.add_node_group("g", make_node("t", cpu="8"), max_size=4)
+        cloud.increase_size("g", 1)
+        name = store.list("nodes")[0].name
+        p = make_pod("resident", cpu="4", owner_uid="rs-x")
+        store.create("pods", p)
+        assert sched.schedule_pending() == 1
+        # simulate a crash mid-drain: cordon + intent landed, pods not
+        # yet deleted, and NO other node can host the resident now
+        node = store.get("nodes", "default", name)
+        node.spec.unschedulable = True
+        node.metadata.annotations[ANN_SCALE_DOWN] = "true"
+        store.update("nodes", node)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock)
+        clock.advance(100.0)
+        r = ca.run_once()
+        assert r["scaled_down"] == 0
+        node = store.get("nodes", "default", name)
+        assert not node.spec.unschedulable, "abort uncordons"
+        assert ANN_SCALE_DOWN not in (node.metadata.annotations or {})
+        assert store.get("pods", "default", "resident") is not None
+
+    def test_drain_intent_survives_restart(self):
+        """The scale-down-in-progress annotation makes an interrupted
+        drain resumable by a FRESH controller instance — a cordoned node
+        must never be orphaned behind the foreign-cordon rule."""
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("g", make_node("t", cpu="4"),
+                                   max_size=4)
+        cloud.increase_size("g", 2)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.5)
+        clock.advance(100.0)
+        with faultpoints.injected("cloud.resize", "raise", times=1):
+            assert ca.run_once()["scaled_down"] == 0
+        assert sum(n.spec.unschedulable for n in store.list("nodes")) == 1
+        # the process restarts: a new instance with empty in-memory state
+        ca2 = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                                utilization_threshold=0.5)
+        r = ca2.run_once()
+        assert r["scaled_down"] == 1
+        assert grp.target_size == 1 and len(store.list("nodes")) == 1
+        # a cordon the autoscaler did NOT place stays hands-off
+        survivor = store.list("nodes")[0]
+        survivor.spec.unschedulable = True
+        store.update("nodes", survivor)
+        clock.advance(100.0)
+        assert ca2.run_once()["scaled_down"] == 0
+        assert len(store.list("nodes")) == 1
+
+
+@pytest.mark.faults
+@pytest.mark.autoscale
+class TestResizeChaos:
+    def test_scale_up_fault_no_double_scale_up(self):
+        """A cloud.resize raise during increase_size mutates nothing;
+        the group backs off (no immediate double attempt) and the next
+        eligible pass performs the expansion exactly once; the snapshot
+        stays scrubber-clean throughout."""
+        clock, store, sched, cloud = make_world(2, node_cpu="2")
+        big = cloud.add_node_group("big", make_node("t", cpu="8"),
+                                   max_size=4)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock)
+        for i in range(3):
+            store.create("pods", make_pod(f"p{i}", cpu="3"))
+        assert sched.schedule_pending() == 0
+        with faultpoints.injected("cloud.resize", "raise"):
+            r = ca.run_once()
+        assert faultpoints.hits("cloud.resize") == 1
+        assert r["scaled_up"] == 0
+        assert big.target_size == 0 and not cloud.instances_by_name
+        assert len(store.list("nodes")) == 2
+        # fault cleared but the group is inside its failure backoff:
+        # no second resize attempt (the no-double-scale-up guarantee)
+        calls_before = len(cloud.calls)
+        assert ca.run_once()["scaled_up"] == 0
+        assert len(cloud.calls) == calls_before
+        clock.advance(1.1)  # past the 1s initial backoff
+        r = ca.run_once()
+        assert r["scaled_up"] >= 1
+        first_target = big.target_size
+        assert first_target == r["scaled_up"] <= 3
+        assert sched.scrubber.scrub().clean  # no orphan snapshot rows
+        clock.advance(2.0)
+        assert sched.schedule_pending() == 3
+        assert big.target_size == first_target  # placed, no extra buy
+
+    def test_scale_down_fault_leaves_cordoned_node_consistent(self):
+        """delete_nodes failing AFTER cordon+drain must not orphan
+        anything: the node object (and its snapshot row) survives,
+        cordoned, and the drain completes after the backoff."""
+        clock, store, sched, cloud = make_world(0)
+        grp = cloud.add_node_group("g", make_node("t", cpu="4"),
+                                   max_size=4)
+        cloud.increase_size("g", 2)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock,
+                               utilization_threshold=0.5)
+        clock.advance(100.0)
+        with faultpoints.injected("cloud.resize", "raise", times=1):
+            r = ca.run_once()
+        assert r["scaled_down"] == 0
+        assert grp.target_size == 2  # cloud mutated nothing
+        nodes = store.list("nodes")
+        assert len(nodes) == 2
+        cordoned = [n for n in nodes if n.spec.unschedulable]
+        assert len(cordoned) == 1  # mid-drain, resumable
+        assert sched.scrubber.scrub().clean  # row still backed by a Node
+        # within the group backoff: no retry
+        assert ca.run_once()["scaled_down"] == 0
+        assert len(store.list("nodes")) == 2
+        clock.advance(1.1)
+        r = ca.run_once()
+        assert r["scaled_down"] == 1 and grp.target_size == 1
+        assert len(store.list("nodes")) == 1
+        assert sched.scrubber.scrub().clean
+
+    def test_simulation_fault_skips_the_pass(self):
+        """A faulting device what-if must cost a skipped pass, never a
+        resize on garbage data."""
+        clock, store, sched, cloud = make_world(1, node_cpu="1")
+        grp = cloud.add_node_group("g", make_node("t", cpu="8"),
+                                   max_size=4)
+        ca = ClusterAutoscaler(store, cloud, sched, clock=clock)
+        store.create("pods", make_pod("p", cpu="2"))
+        assert sched.schedule_pending() == 0
+        with faultpoints.injected("autoscaler.simulate", "raise"):
+            r = ca.run_once()
+        assert r == {"scaled_up": 0, "scaled_down": 0}
+        assert grp.target_size == 0
+        r = ca.run_once()  # healthy pass proceeds
+        assert r["scaled_up"] == 1
+
+
+@pytest.mark.faults
+class TestNodeRowLifecycle:
+    def test_node_add_delete_rows_under_write_faults(self):
+        """Satellite: _on_node_add/_on_node_delete drive snapshot row
+        lifecycle under the snapshot.write fault point — the add flushes
+        unschedulable pods (move_all_to_active) even when the row write
+        was corrupted, the scrubber catches + repairs the divergence,
+        and a delete leaves no ghost rows behind."""
+        clock = FakeClock()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=16, clock=clock)
+        store.create("nodes", make_node("n0", cpu="1"))
+        store.create("pods", make_pod("big", cpu="2"))
+        assert sched.schedule_pending() == 0
+        assert sched.queue.unschedulable_count() == 1
+        with faultpoints.injected("snapshot.write", "corrupt"):
+            store.create("nodes", make_node("n-new", cpu="4"))
+        # move_all_to_active flushed the unschedulable map (the pod is
+        # inside its backoff window, so it parks in the backoff area)
+        assert sched.queue.unschedulable_count() == 0
+        assert sched.queue.backoff_count() == 1
+        # the corrupt write left a silently divergent row
+        rep = sched.scrubber.scrub()
+        assert not rep.clean
+        assert any("n-new" == d.node for d in rep.divergences)
+        assert rep.repaired == len(rep.divergences)
+        assert sched.scrubber.scrub().clean
+        clock.advance(1.1)
+        assert sched.schedule_pending() == 1
+        assert store.get("pods", "default", "big").spec.node_name == "n-new"
+        # delete the node its pod lives on: row, pod rows, and any term
+        # rows must die with it — scrubber-verified, no ghosts
+        store.delete("nodes", "default", "n-new")
+        assert "n-new" not in sched.snapshot.node_index
+        rep = sched.scrubber.scrub()
+        assert rep.clean, rep.summary()
+        host_uids = {p.uid for ni in sched.cache.node_infos.values()
+                     for p in ni.pods}
+        for uid, slot in sched.snapshot.pod_slot.items():
+            if sched.snapshot.ep_valid[slot]:
+                assert uid in host_uids
+
+
+class TestWiring:
+    def test_manager_registers_autoscaler(self):
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=16)
+        cloud = FakeCloud()
+        cloud.add_node_group("g", make_node("t", cpu="4"))
+        m = ControllerManager(store, controllers=[], cloud=cloud,
+                              scheduler=sched)
+        assert "cluster-autoscaler" in m.controllers
+        # without node groups (or a scheduler) the controller is absent
+        m2 = ControllerManager(store, controllers=[], cloud=FakeCloud(),
+                               scheduler=sched)
+        assert "cluster-autoscaler" not in m2.controllers
+        m3 = ControllerManager(store, controllers=[], cloud=cloud)
+        assert "cluster-autoscaler" not in m3.controllers
+
+    def test_pending_pods_gauge_exported(self):
+        """Satellite: scheduler_pending_pods{queue=...} tracks every
+        queue area from the housekeeping step."""
+        clock = FakeClock()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=16, clock=clock)
+        store.create("nodes", make_node("n0", cpu="1"))
+        for i in range(2):
+            store.create("pods", make_pod(f"big{i}", cpu="4"))
+        sched.schedule_pending()
+        g = sched.metrics.pending_pods
+        assert g.value(queue="unschedulable") == 2
+        assert g.value(queue="active") == 0
+        assert g.value(queue="backoff") == 0
+        assert g.value(queue="gang_waiting") == 0
+        # a node event moves them to the backoff area; the next
+        # housekeeping pass re-exports
+        store.create("nodes", make_node("n1", cpu="8"))
+        sched.schedule_pending()
+        assert g.value(queue="unschedulable") == 0
+        clock.advance(1.1)
+        sched.schedule_pending()
+        assert g.value(queue="backoff") == 0
+        assert g.value(queue="unschedulable") == 0
+        # the gauge registers in the exported series map
+        series = sched.metrics.all_series()
+        assert any(name.startswith("scheduler_pending_pods{")
+                   for name in series)
+        assert all(s.kind == "gauge" for name, s in series.items()
+                   if name.startswith("scheduler_pending_pods{"))
+
+    def test_fake_cloud_auto_ip_never_collides(self):
+        """Satellite: delete-then-add must not re-issue a live IP (the
+        old len+1 scheme did)."""
+        cloud = FakeCloud()
+        cloud.add_instance("a")
+        cloud.add_instance("b")
+        ip_b = cloud.instances_by_name["b"].addresses[0].address
+        del cloud.instances_by_name["a"]
+        cloud.add_instance("c")
+        ip_c = cloud.instances_by_name["c"].addresses[0].address
+        assert ip_c != ip_b
+        ips = [i.addresses[0].address
+               for i in cloud.instances_by_name.values()]
+        assert len(ips) == len(set(ips))
+
+    def test_kubectl_shows_cordoned_node(self):
+        """Satellite: kubectl get nodes renders cordon state as
+        Ready,SchedulingDisabled."""
+        from kubernetes_tpu.cli.kubectl import _node_row
+        node = make_node("n1")
+        assert _node_row(node)[1] == "Ready"
+        node.spec.unschedulable = True
+        assert _node_row(node)[1] == "Ready,SchedulingDisabled"
