@@ -35,6 +35,10 @@ measured against the reference's 100 pods/s "healthy" warning level
                 p99 enqueue->bind latency against the reference's 5s
                 pod-startup SLO (test/e2e/scalability/density.go:55).
                 vs_baseline is SLO headroom (5s / p99).
+  partition     zone disruption: one zone fully loaded, then 30% of its
+                nodes severed mid-run; measures the nodelifecycle
+                detect -> taint -> rate-limited evict -> recreate ->
+                re-place loop as pods/s over the severed residents
 
 --suite runs the BASELINE config grid and prints one JSON line each;
 a bare `python bench.py` (the driver's command) runs DRIVER_SUITE.
@@ -514,6 +518,102 @@ def run_autoscale_config(nodes, pods, wave, join_latency=0.25):
     return placed, dt, p99, p99_round, sched.wave_path()
 
 
+def run_partition_config(nodes, pods, wave, sever_fraction=0.3):
+    """Zone-disruption re-placement drain (the eviction storm-control
+    workload): a single-zone cluster fully loaded with `pods`, then 30%
+    of the zone's nodes are severed mid-run (heartbeats stop). The
+    nodelifecycle controller detects staleness, taints NoExecute, and
+    drains evictions through the zone's token bucket (a high configured
+    rate — the machinery, not the throttle, is what's measured); a
+    ReplicaSet stand-in recreates each evicted pod and the scheduler
+    re-places it on surviving capacity. Reported pods/s spans the whole
+    detect -> evict -> recreate -> re-place loop. 30% severed keeps the
+    zone below the 55% unhealthy threshold, so the zone stays Normal
+    and drains at the primary rate — the storm-control suspension paths
+    are covered by tests/test_partition.py, not timed here."""
+    import time as _t
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        HEARTBEAT_ANNOTATION, NodeLifecycleController, zone_display)
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import Metrics
+    from kubernetes_tpu.utils.backoff import PodBackoff
+
+    store = ObjectStore()
+    vclock = [1000.0]
+    caps = Caps(N=bucket_size(nodes + 8), M=bucket_size(2 * pods + 64),
+                P=wave, LV=bucket_size(nodes + 256, 64))
+    sched = Scheduler(store, wave_size=wave, caps=caps)
+    sched.backoff = PodBackoff(initial=0.01, maximum=0.1)
+    for i in range(nodes):
+        store.create("nodes", api.Node(
+            metadata=api.ObjectMeta(
+                name=f"node-{i}",
+                labels={api.LABEL_ZONE: "zone-0",
+                        api.LABEL_HOSTNAME: f"node-{i}"},
+                annotations={HEARTBEAT_ANNOTATION: str(vclock[0])}),
+            status=api.NodeStatus(
+                allocatable=api.resource_list(cpu="16", memory="32Gi",
+                                              pods=110,
+                                              ephemeral_storage="200Gi"),
+                conditions=[api.NodeCondition(api.NODE_READY,
+                                              api.COND_TRUE)])))
+    ctrl = NodeLifecycleController(
+        store, clock=lambda: vclock[0], grace_period=20.0,
+        eviction_rate_qps=500.0, eviction_burst=float(max(wave, 64)))
+    for i in range(pods):
+        store.create("pods", _base_pod(api, f"load-{i}", "load"))
+    placed = sched.schedule_pending()
+    stalled = 0
+    while placed < pods and stalled < 2000:
+        n = sched.schedule_pending()
+        placed += n
+        stalled = stalled + 1 if n == 0 else 0
+    assert placed == pods, f"pre-sever fill placed {placed}/{pods}"
+    ctrl.monitor()  # zone observed Normal before the cut
+
+    severed = {f"node-{i}" for i in range(int(nodes * sever_fraction))}
+    alive = [f"node-{i}" for i in range(nodes)
+             if f"node-{i}" not in severed]
+    target = sum(1 for p in store.list("pods")
+                 if p.spec.node_name in severed)
+    sched.metrics = Metrics()
+    t0 = _t.time()
+    vclock[0] += 30.0  # past grace: the severed 30% are now stale
+    replaced = 0
+    evicted_seen = ctrl.evictions
+    seq = 0
+    stalled = 0
+    while replaced < target and stalled < 2000:
+        for name in alive:  # surviving kubelets keep heartbeating
+            node = store.get("nodes", "default", name)
+            node.metadata.annotations[HEARTBEAT_ANNOTATION] = str(vclock[0])
+            store.update("nodes", node)
+        ctrl.monitor()
+        newly = ctrl.evictions - evicted_seen
+        evicted_seen = ctrl.evictions
+        for _ in range(newly):  # the ReplicaSet stand-in recreates
+            store.create("pods", _base_pod(api, f"re-{seq}", "re"))
+            seq += 1
+        n = sched.schedule_pending()
+        replaced += n
+        stalled = stalled + 1 if (n == 0 and newly == 0) else 0
+        vclock[0] += 1.0  # drives grace/toleration clocks + the bucket
+    dt = _t.time() - t0
+    p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
+    p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    print(f"# partition: severed={len(severed)}/{nodes} nodes "
+          f"evicted={ctrl.evictions} replaced={replaced}/{target} "
+          f"zone_states="
+          f"{ {zone_display(z): s for z, s in ctrl.zone_states.items()} }",
+          file=sys.stderr)
+    return replaced, dt, p99, p99_round, sched.wave_path(), target
+
+
 def run_preempt_config(nodes, pods, wave, device=True):
     """Preemption-heavy drain: every node saturated by low-priority
     hogs, then a high-priority backlog that can only place by evicting
@@ -632,6 +732,9 @@ SUITE = [
     # groups — pods/s to full placement including the autoscaler's
     # on-device what-ifs and simulated node join latency
     ("autoscale", 50, 2000, "autoscale", []),
+    # zone disruption: one zone, 30% of nodes severed mid-run — the
+    # detect -> taint -> rate-limited evict -> recreate -> re-place loop
+    ("partition", 200, 2000, "partition", []),
     ("mixed5k", 5000, 30000, "mixed", []),
 ]
 
@@ -719,7 +822,7 @@ def main():
     ap.add_argument("--workload", default=None,
                     choices=["density", "affinity", "spreading",
                              "antiaffinity", "mixed", "gang", "preempt",
-                             "trickle", "paced", "autoscale"])
+                             "trickle", "paced", "autoscale", "partition"])
     ap.add_argument("--host-preempt", action="store_true",
                     help="preempt workload: pin the scheduler to the "
                          "per-wave host path (the comparison baseline; "
@@ -784,6 +887,14 @@ def main():
     elif args.workload == "autoscale":
         placed, dt, p99, p99_round, path = run_autoscale_config(
             args.nodes, args.pods, args.wave)
+    elif args.workload == "partition":
+        replaced, dt, p99, p99_round, path, target = run_partition_config(
+            args.nodes, args.pods, args.wave)
+        # the "pods" of this workload are the severed zone's residents:
+        # each must be evicted, recreated, and re-placed
+        emit(args.name or "partition", args.nodes, target, replaced, dt,
+             p99, p99_round, args.wave, path)
+        return
     elif args.workload == "trickle":
         placed, dt, p99, p99_round, path = run_trickle_config(
             args.nodes, args.pods, args.wave, chunk=args.chunk or 64)

@@ -33,7 +33,11 @@ class Cluster:
 
     def __init__(self, data_dir: Optional[str] = None, port: int = 0,
                  hollow_nodes: int = 0, reconcile_endpoints: bool = True,
-                 secure: bool = False, cluster_autoscaler: bool = False):
+                 secure: bool = False, cluster_autoscaler: bool = False,
+                 node_eviction_rate: Optional[float] = None,
+                 secondary_node_eviction_rate: Optional[float] = None,
+                 large_cluster_size_threshold: Optional[int] = None,
+                 unhealthy_zone_threshold: Optional[float] = None):
         if data_dir:
             from ..runtime.nativestore import NativeObjectStore
 
@@ -94,7 +98,12 @@ class Cluster:
             self.store, admission=AdmissionChain.default(), port=port,
             authenticator=authenticator, authorizer=authorizer,
             reconcile_endpoints=reconcile_endpoints, tls=self.ca)
-        self.manager = ControllerManager(self.store)
+        self.manager = ControllerManager(
+            self.store,
+            node_eviction_rate=node_eviction_rate,
+            secondary_node_eviction_rate=secondary_node_eviction_rate,
+            large_cluster_size_threshold=large_cluster_size_threshold,
+            unhealthy_zone_threshold=unhealthy_zone_threshold)
         # the scheduler runs as an API CLIENT over a loopback watch
         # mirror — the reference's deployment shape (kube-scheduler
         # connects via client-go, cmd/kube-scheduler). Running it on the
@@ -481,7 +490,13 @@ def cmd_init(args) -> int:
                       hollow_nodes=args.hollow_nodes,
                       secure=getattr(args, "secure", False),
                       cluster_autoscaler=getattr(args, "cluster_autoscaler",
-                                                 False))
+                                                 False),
+                      node_eviction_rate=args.node_eviction_rate,
+                      secondary_node_eviction_rate=(
+                          args.secondary_node_eviction_rate),
+                      large_cluster_size_threshold=(
+                          args.large_cluster_size_threshold),
+                      unhealthy_zone_threshold=args.unhealthy_zone_threshold)
     for _name, _desc, fn in PHASES:  # store-level phases, in order
         fn(cluster.store)
     cluster.start()
@@ -631,6 +646,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "fake-cloud NodeGroups (tpu-small/tpu-large): "
                              "unschedulable pods trigger simulated "
                              "scale-up, idle nodes drain and scale down")
+    # eviction storm control (kube-controller-manager's node lifecycle
+    # flags): zone disruption states + per-zone rate-limited eviction
+    p_init.add_argument("--node-eviction-rate", type=float, default=None,
+                        help="pod evictions/s per zone when the zone is "
+                             "healthy (default 0.1)")
+    p_init.add_argument("--secondary-node-eviction-rate", type=float,
+                        default=None,
+                        help="evictions/s in a PartialDisruption zone "
+                             "larger than --large-cluster-size-threshold "
+                             "(default 0.01); smaller disrupted zones "
+                             "halt entirely")
+    p_init.add_argument("--large-cluster-size-threshold", type=int,
+                        default=None,
+                        help="zones above this node count keep evicting "
+                             "(at the secondary rate) under partial "
+                             "disruption (default 50)")
+    p_init.add_argument("--unhealthy-zone-threshold", type=float,
+                        default=None,
+                        help="fraction of a zone's nodes not-ready before "
+                             "it is PartialDisruption (default 0.55); a "
+                             "100%% not-ready zone is FullDisruption and "
+                             "suspends eviction until heartbeats resume")
     p_phase = sub.add_parser("phase",
                              help="run one init phase (or 'list')")
     p_phase.add_argument("phase")

@@ -17,6 +17,23 @@ from ..state.node_info import NodeInfo
 from .base import (Controller, is_pod_active, is_pod_ready,
                    make_pod_from_template, pod_owned_by)
 from .history import REV_LABEL
+from .nodelifecycle import TAINT_NOT_READY, TAINT_UNREACHABLE
+
+
+def add_daemon_tolerations(pod: api.Pod) -> api.Pod:
+    """Stamp the not-ready/unreachable NoExecute tolerations on a daemon
+    pod (1.11 daemon_controller.go util.AddOrUpdateDaemonPodTolerations):
+    a daemon pod exists BECAUSE of its node — evicting it off a failed
+    node just respawns it there in a loop, so it tolerates its own
+    node's failure taints forever (no tolerationSeconds). Existing
+    (key, effect)-matching tolerations are left alone."""
+    for key in (TAINT_NOT_READY, TAINT_UNREACHABLE):
+        if not any(t.key in ("", key) and t.effect in ("", api.NO_EXECUTE)
+                   for t in pod.spec.tolerations):
+            pod.spec.tolerations.append(api.Toleration(
+                key=key, operator=api.TOLERATION_OP_EXISTS,
+                effect=api.NO_EXECUTE))
+    return pod
 
 
 class DaemonSetController(Controller):
@@ -47,7 +64,8 @@ class DaemonSetController(Controller):
         1.11), schedulability (daemon_controller.go:1206)."""
         if node.spec.unschedulable:
             return False
-        pod = make_pod_from_template(ds.spec.template, "DaemonSet", ds, "sim")
+        pod = add_daemon_tolerations(make_pod_from_template(
+            ds.spec.template, "DaemonSet", ds, "sim"))
         pod.spec.node_name = node.metadata.name
         ni = NodeInfo(node)
         for existing in self.store.list("pods"):
@@ -132,9 +150,9 @@ class DaemonSetController(Controller):
                         stale_ready.append(p)
                 else:
                     unavailable += 1
-                    pod = make_pod_from_template(
+                    pod = add_daemon_tolerations(make_pod_from_template(
                         ds.spec.template, "DaemonSet", ds,
-                        f"{name}-{node.metadata.name}")
+                        f"{name}-{node.metadata.name}"))
                     pod.spec.node_name = node.metadata.name
                     pod.metadata.labels = dict(
                         pod.metadata.labels or {},

@@ -67,13 +67,27 @@ class ControllerManager:
                  identity: str = "controller-manager",
                  leader_elect: bool = False, cloud=None,
                  cluster_cidr: str = "", metrics_scraper: bool = False,
-                 kubelet_client_ctx=None, scheduler=None):
+                 kubelet_client_ctx=None, scheduler=None,
+                 node_eviction_rate: Optional[float] = None,
+                 secondary_node_eviction_rate: Optional[float] = None,
+                 large_cluster_size_threshold: Optional[int] = None,
+                 unhealthy_zone_threshold: Optional[float] = None):
         self.store = store
         self.controllers: Dict[str, Controller] = {}
         for cls in (controllers if controllers is not None
                     else default_controllers()):
             c = cls(store)
             self.controllers[c.name] = c
+        # eviction storm-control knobs (kube-controller-manager
+        # --node-eviction-rate / --secondary-node-eviction-rate /
+        # --large-cluster-size-threshold / --unhealthy-zone-threshold)
+        nlc = self.controllers.get("nodelifecycle")
+        if nlc is not None and hasattr(nlc, "configure"):
+            nlc.configure(
+                eviction_rate_qps=node_eviction_rate,
+                secondary_eviction_rate_qps=secondary_node_eviction_rate,
+                large_cluster_threshold=large_cluster_size_threshold,
+                unhealthy_zone_threshold=unhealthy_zone_threshold)
         if metrics_scraper:
             # the metrics-server runs OUTSIDE kube-controller-manager in
             # the reference (a separate deployment scraping

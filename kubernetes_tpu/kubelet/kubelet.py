@@ -157,6 +157,11 @@ class Kubelet:
                     mgr.restore(state)
         self.labels = {api.LABEL_HOSTNAME: node_name, **(labels or {})}
         self.taints = list(taints or [])
+        # network-partition switch (kubemark partition helper): a severed
+        # kubelet freezes — no heartbeats, no status writes — exactly
+        # what the nodelifecycle controller's zone disruption machinery
+        # must detect and NOT storm over
+        self.partitioned = False
         self._probe_state: Dict[tuple, _ProbeState] = {}
         self._pod_start: Dict[str, float] = {}
         self._pod_specs: Dict[str, api.Pod] = {}  # teardown (preStop) view
@@ -233,6 +238,15 @@ class Kubelet:
                   memory_pressure: Optional[bool] = None):
         """Update node status: heartbeat annotation + Ready (+ pressure)
         conditions (tryUpdateNodeStatus)."""
+        from ..utils import faultpoints
+
+        if self.partitioned or faultpoints.fire("heartbeat.deliver",
+                                                payload=self.node_name):
+            # severed from the control plane (partition helper) or the
+            # status update was dropped on the wire (fault point): the
+            # node goes stale from the controller's point of view;
+            # _last_heartbeat stays put so every sync retries
+            return
         now = now if now is not None else self.clock()
         if self.cert_manager is not None:
             # background: a slow signer must never stall the heartbeat
@@ -488,6 +502,9 @@ class Kubelet:
         (runtime state transitions), and the periodic full resync; then
         probes, eviction housekeeping, heartbeat. Pod syncs dispatch
         through the per-pod workers."""
+        if self.partitioned:
+            # fully severed: no API traffic of any kind until healed
+            return
         now = now if now is not None else self.clock()
         self.runtime.tick(now)
         self._iter_node = self._get_node()  # one node fetch per iteration

@@ -95,6 +95,38 @@ class HollowCluster:
         for n in self.nodes:
             n.stop()
 
+    # -- network partition (zone disruption chaos helper) ----------------------
+
+    def partition(self, zone: Optional[str] = None, fraction: float = 1.0,
+                  names: Optional[List[str]] = None) -> List[str]:
+        """Sever a fraction of a zone (or an explicit node list): the
+        chosen kubelets freeze entirely — no heartbeats, no status
+        writes — modeling a rack switch flap / network partition. The
+        nodelifecycle controller's zone disruption machinery is the
+        thing under test: 100% of a zone severed must SUSPEND eviction
+        (FullDisruption), a minority severed must drain at the
+        configured rate. Returns the severed node names (pass them to
+        heal())."""
+        if names is not None:
+            targets = [n for n in self.nodes if n.name in set(names)]
+        elif zone is not None:
+            targets = [n for n in self.nodes
+                       if n.kubelet.labels.get(api.LABEL_ZONE) == zone]
+        else:
+            targets = list(self.nodes)
+        k = min(len(targets), max(0, int(round(len(targets) * fraction))))
+        cut = targets[:k]  # deterministic prefix: tests know the victims
+        for n in cut:
+            n.kubelet.partitioned = True
+        return [n.name for n in cut]
+
+    def heal(self, names: Optional[List[str]] = None) -> None:
+        """Undo partition(): heartbeats resume on the next sync."""
+        wanted = None if names is None else set(names)
+        for n in self.nodes:
+            if wanted is None or n.name in wanted:
+                n.kubelet.partitioned = False
+
     # -- load generation (test/utils/runners.go strategies) --------------------
 
     def create_pods(self, n: int, prefix: str = "load",

@@ -1,0 +1,90 @@
+"""Per-zone node health tally as a batched reduction.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go
+ComputeZoneState — for every failure domain (the GetZoneKey string),
+count ready vs not-ready nodes and classify the zone Normal /
+PartialDisruption / FullDisruption. The reference walks a
+map[string][]*NodeCondition per pass; here the tally is ONE segment-sum
+over the same dense columns the scheduling snapshot already keeps
+(condition flags, NoExecute taint keys, interned zone ids), so a
+100k-node monitor pass costs two reductions instead of a Python loop —
+and the classification rides whichever compute path is healthy:
+
+  device  jit segment_sum (shapes bucketed so the program is compiled
+          once per cluster-size bucket, same trick as ops/kernel.py)
+  host    np.bincount — taken when the device-path circuit breaker
+          (sched/breaker.py) is open, or when the device call fails
+          (which also feeds the breaker). Zone health is the input to
+          eviction storm control; computing it can never be allowed to
+          fail just because an accelerator is wedged.
+
+The `nodelifecycle.tally` fault point fires at the device-path entry so
+chaos tests can wedge it deterministically and prove the host fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import faultpoints
+
+
+_jitted = None  # built on first device tally; jit cache lives here
+
+
+def _tally_device(zone_id: np.ndarray, bad: np.ndarray, valid: np.ndarray,
+                  num_zones: int) -> Tuple[np.ndarray, np.ndarray]:
+    global _jitted
+    if _jitted is None:
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def tally(zid, bad_, valid_, nz):
+            v = valid_.astype(jnp.int32)
+            totals = jax.ops.segment_sum(v, zid, num_segments=nz)
+            badc = jax.ops.segment_sum(v * bad_.astype(jnp.int32), zid,
+                                       num_segments=nz)
+            return totals, badc
+
+        _jitted = tally
+    t, b = _jitted(zone_id, bad, valid, num_zones)
+    return np.asarray(t), np.asarray(b)
+
+
+def zone_tally_host(zone_id: np.ndarray, bad: np.ndarray, valid: np.ndarray,
+                    num_zones: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact host formulation of the same reduction (np.bincount)."""
+    zid = np.asarray(zone_id, np.int64)
+    v = np.asarray(valid, bool)
+    b = np.asarray(bad, bool) & v
+    totals = np.bincount(zid[v], minlength=num_zones)
+    badc = np.bincount(zid[b], minlength=num_zones)
+    return totals.astype(np.int32), badc.astype(np.int32)
+
+
+def zone_tally(zone_id: np.ndarray, bad: np.ndarray, valid: np.ndarray,
+               num_zones: int, breaker=None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """(totals[Z], bad_counts[Z]) per interned zone id. Device path when
+    the breaker admits it, host fallback otherwise; device failures are
+    recorded to the breaker so persistent accelerator faults degrade the
+    monitor pass instead of killing it."""
+    if breaker is not None and not breaker.allow():
+        return zone_tally_host(zone_id, bad, valid, num_zones)
+    try:
+        faultpoints.fire("nodelifecycle.tally",
+                         payload=(zone_id, num_zones))
+        out = _tally_device(np.asarray(zone_id, np.int32),
+                            np.asarray(bad, bool),
+                            np.asarray(valid, bool), int(num_zones))
+        if breaker is not None:
+            breaker.record_success()
+        return out
+    except Exception:
+        if breaker is not None:
+            breaker.record_failure()
+        return zone_tally_host(zone_id, bad, valid, num_zones)

@@ -37,6 +37,19 @@ Wired points (grep for `faultpoints.fire`):
                    group, arg)) — a `raise` models a rejected cloud API
                    call; group target/instances stay untouched and the
                    autoscaler backs the group off
+  heartbeat.deliver  kubelet/kubelet.py heartbeat entry (payload: node
+                   name) — `drop` models the node status update never
+                   reaching the apiserver (a partitioned node); the
+                   nodelifecycle controller then sees a stale heartbeat
+  nodelifecycle.evict  controllers/nodelifecycle.py, AFTER the zone
+                   rate limiter admitted an eviction but BEFORE the pod
+                   delete (payload: (pod key, node)) — `drop` models a
+                   lost eviction call: the entry stays queued and
+                   retries next pass; `raise` fails the monitor pass
+  nodelifecycle.tally  ops/zonehealth.py device-path entry — a `raise`
+                   forces the per-zone health reduction onto the exact
+                   host fallback (and feeds the circuit breaker when
+                   one is wired)
 
 Modes:
 

@@ -235,6 +235,19 @@ class Metrics:
         # step — the cluster autoscaler and operators both watch it
         # (a Counter can't report a depth that drains)
         self.pending_pods = LabeledGauge("scheduler_pending_pods", ("queue",))
+        # node lifecycle / eviction storm control: per-zone health state
+        # (1 on the current state's child, 0 on the others), evictions
+        # actually executed per zone, evictions due-but-held by the
+        # rate limiter or a suspended zone, and zone-suspension entries
+        # (FullDisruption transitions)
+        self.zone_health = LabeledGauge("node_lifecycle_zone_health",
+                                        ("zone", "state"))
+        self.zone_evictions = LabeledCounter(
+            "node_lifecycle_evictions_total", ("zone",))
+        self.eviction_queue_depth = LabeledGauge(
+            "node_lifecycle_eviction_queue_depth", ("zone",))
+        self.eviction_suspensions = Counter(
+            "node_lifecycle_suspensions_total")
         # cluster-autoscaler series (autoscaler's scaled_up/down analogs)
         self.autoscaler_scale_ups = Counter(
             "cluster_autoscaler_scaled_up_nodes_total")

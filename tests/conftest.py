@@ -44,6 +44,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "autoscale: cluster-autoscaler suite (NodeGroup "
                    "scale-up/scale-down what-ifs on the device path)")
+    config.addinivalue_line(
+        "markers", "partition: zone disruption / eviction storm-control "
+                   "suite (mass node failure; make chaos)")
 
 
 import pytest  # noqa: E402

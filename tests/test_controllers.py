@@ -326,7 +326,18 @@ class TestNodeLifecycle:
         now = [1000.0]
         ctrl = NodeLifecycleController(store, clock=lambda: now[0],
                                        grace_period=40.0)
+
+        def keep_alive(name):
+            n = store.get("nodes", "default", name)
+            n.metadata.annotations[HEARTBEAT_ANNOTATION] = str(now[0])
+            store.update("nodes", n)
+
         store.create("nodes", mknode("n1", hb=now[0]))
+        # a healthy peer in the same failure domain: a zone whose EVERY
+        # node stops reporting is FullDisruption and suspends eviction
+        # (the storm-control contract, tested in test_partition.py); the
+        # toleration-seconds path needs a partially-healthy zone
+        store.create("nodes", mknode("n2", hb=now[0]))
         pod = api.Pod(metadata=api.ObjectMeta(name="p1"),
                       spec=api.PodSpec(node_name="n1", tolerations=[
                           api.Toleration(key=TAINT_UNREACHABLE,
@@ -337,8 +348,9 @@ class TestNodeLifecycle:
         ctrl.monitor()
         n = store.get("nodes", "default", "n1")
         assert not n.spec.taints  # healthy
-        # heartbeats stop
+        # n1's heartbeats stop; n2 keeps reporting
         now[0] += 100
+        keep_alive("n2")
         ctrl.monitor()
         n = store.get("nodes", "default", "n1")
         assert any(c.type == api.NODE_READY and c.status == api.COND_UNKNOWN
@@ -346,6 +358,7 @@ class TestNodeLifecycle:
         assert any(t.key == TAINT_UNREACHABLE for t in n.spec.taints)
         assert store.get("pods", "default", "p1") is not None  # tolerated
         now[0] += 31  # tolerationSeconds expired
+        keep_alive("n2")
         ctrl.monitor()
         assert store.get("pods", "default", "p1") is None  # evicted
 
@@ -374,6 +387,55 @@ class TestNodeLifecycle:
         ctrl.monitor()
         taints = store.get("nodes", "default", "n1").spec.taints
         assert [t.key for t in taints] == [TAINT_NOT_READY]
+
+    def test_swap_taints_preserves_other_effects(self):
+        """Taints are matched by (key, effect): a user taint sharing the
+        not-ready KEY under NoSchedule is neither dropped nor clobbered
+        by the controller's NoExecute swap."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(store, clock=lambda: now[0])
+        node = mknode("n1", ready=False, hb=now[0])
+        node.spec.taints = [
+            api.Taint(key=TAINT_NOT_READY, effect=api.NO_SCHEDULE),
+            api.Taint(key="user/custom", effect=api.NO_EXECUTE),
+        ]
+        store.create("nodes", node)
+        ctrl.monitor()
+        taints = store.get("nodes", "default", "n1").spec.taints
+        assert (TAINT_NOT_READY, api.NO_SCHEDULE) in [
+            (t.key, t.effect) for t in taints]
+        assert ("user/custom", api.NO_EXECUTE) in [
+            (t.key, t.effect) for t in taints]
+        assert (TAINT_NOT_READY, api.NO_EXECUTE) in [
+            (t.key, t.effect) for t in taints]
+        # recovery drops ONLY the controller's NoExecute pair
+        n = store.get("nodes", "default", "n1")
+        n.metadata.annotations[HEARTBEAT_ANNOTATION] = str(now[0])
+        n.status.conditions = [api.NodeCondition(api.NODE_READY,
+                                                 api.COND_TRUE)]
+        store.update("nodes", n)
+        ctrl.monitor()
+        taints = store.get("nodes", "default", "n1").spec.taints
+        assert sorted((t.key, t.effect) for t in taints) == sorted([
+            (TAINT_NOT_READY, api.NO_SCHEDULE),
+            ("user/custom", api.NO_EXECUTE)])
+
+    def test_swap_taints_effect_only_change_detected(self):
+        """An effect-only difference counts as a change (the old key-only
+        compare silently dropped it), and a steady state is idempotent —
+        no store write churn from re-ordering."""
+        node = mknode("n1")
+        node.spec.taints = [
+            api.Taint(key=TAINT_NOT_READY, effect=api.NO_SCHEDULE)]
+        assert NodeLifecycleController._swap_taints(
+            node, add=TAINT_NOT_READY, drop=TAINT_UNREACHABLE)
+        assert sorted((t.key, t.effect) for t in node.spec.taints) == sorted([
+            (TAINT_NOT_READY, api.NO_SCHEDULE),
+            (TAINT_NOT_READY, api.NO_EXECUTE)])
+        # second application: no change, regardless of list order
+        assert not NodeLifecycleController._swap_taints(
+            node, add=TAINT_NOT_READY, drop=TAINT_UNREACHABLE)
 
 
 class TestDisruption:

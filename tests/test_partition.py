@@ -1,0 +1,532 @@
+"""Zone disruption / eviction storm control under mass node failure.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go —
+ComputeZoneState + the per-zone RateLimitedTimedQueue (zonePodEvictor).
+A rack switch flap or control-plane partition makes EVERY node in a
+failure domain miss heartbeats at once; a naive detector would evict the
+whole zone's workload in one monitor pass. These tests pin the
+storm-control contract, clock-driven against the token bucket:
+
+  * 100% of a zone partitioned  -> FullDisruption: ZERO evictions while
+    disrupted, taints cleared + queue cancelled on heartbeat recovery.
+  * 40% partitioned             -> the zone stays Normal and evictions
+    drain at no more than the configured primary rate.
+  * >=55% of a LARGE zone       -> PartialDisruption: secondary rate.
+  * >=55% of a small zone       -> PartialDisruption: eviction halts.
+  * kubemark partition helper severs a fraction of a zone end-to-end
+    (kubelet freeze -> stale heartbeat -> zone state -> recovery).
+  * heartbeat.deliver / nodelifecycle.evict fault points.
+  * DefaultTolerationSeconds <-> taint-manager interplay: the admitted
+    300s not-ready toleration delays eviction exactly 300s and a
+    shorter blip never evicts.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers.nodelifecycle import (
+    HEARTBEAT_ANNOTATION, TAINT_NOT_READY, TAINT_UNREACHABLE, ZONE_FULL,
+    ZONE_NORMAL, ZONE_PARTIAL, NodeLifecycleController)
+from kubernetes_tpu.kubemark.hollow import HollowCluster
+from kubernetes_tpu.ops import zonehealth
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.utils import faultpoints
+
+from helpers import make_node, make_pod
+
+pytestmark = pytest.mark.partition
+
+
+def zone_node(name, zone, hb):
+    node = make_node(name, labels={api.LABEL_ZONE: zone})
+    node.metadata.annotations = {HEARTBEAT_ANNOTATION: str(hb)}
+    return node
+
+
+def refresh(store, names, now, ready=True):
+    """Emulate kubelet heartbeats: bump the annotation + Ready."""
+    for name in names:
+        n = store.get("nodes", "default", name)
+        n.metadata.annotations = dict(n.metadata.annotations or {})
+        n.metadata.annotations[HEARTBEAT_ANNOTATION] = str(now)
+        if ready:
+            n.status.conditions = [
+                c for c in n.status.conditions if c.type != api.NODE_READY
+            ] + [api.NodeCondition(api.NODE_READY, api.COND_TRUE)]
+        store.update("nodes", n)
+
+
+def alive_pods(store, prefix=""):
+    return [p for p in store.list("pods")
+            if p.metadata.name.startswith(prefix)]
+
+
+class TestFullZonePartition:
+    def test_full_disruption_suspends_then_recovers(self):
+        """100% of zone-a partitioned: zero evictions while the zone is
+        FullDisruption; on heartbeat recovery the taints clear, queued
+        evictions are cancelled, and the zone returns to Normal."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=100.0, eviction_burst=100.0)
+        a_nodes = [f"a{i}" for i in range(5)]
+        b_nodes = [f"b{i}" for i in range(5)]
+        for n in a_nodes:
+            store.create("nodes", zone_node(n, "zone-a", now[0]))
+        for n in b_nodes:
+            store.create("nodes", zone_node(n, "zone-b", now[0]))
+        for i, n in enumerate(a_nodes):
+            for j in range(2):
+                store.create("pods", make_pod(f"w-{i}-{j}", node_name=n))
+        ctrl.monitor()
+        assert ctrl.zone_states == {
+            ":\x00:zone-a": ZONE_NORMAL, ":\x00:zone-b": ZONE_NORMAL}
+        assert ctrl.metrics.zone_health.value(
+            zone="zone-a", state=ZONE_NORMAL) == 1.0
+
+        # the partition: zone-a stops reporting entirely; zone-b healthy
+        now[0] += 60
+        refresh(store, b_nodes, now[0])
+        ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_FULL
+        assert ctrl.zone_states[":\x00:zone-b"] == ZONE_NORMAL
+        assert ctrl.metrics.zone_health.value(
+            zone="zone-a", state=ZONE_FULL) == 1.0
+        assert ctrl.metrics.zone_health.value(
+            zone="zone-a", state=ZONE_NORMAL) == 0.0
+        assert ctrl.metrics.eviction_suspensions.value == 1
+        for n in a_nodes:
+            taints = store.get("nodes", "default", n).spec.taints
+            assert any(t.key == TAINT_UNREACHABLE and
+                       t.effect == api.NO_EXECUTE for t in taints)
+        # suspension event landed, against a Zone involvedObject
+        evs = [e for e in store.list("events")
+               if e.reason == "EvictionsSuspended"]
+        assert evs and evs[0].involved_kind == "Zone"
+
+        # 5 minutes of monitor passes: ZERO pods evicted (suspended)
+        for _ in range(30):
+            now[0] += 10
+            refresh(store, b_nodes, now[0])
+            ctrl.monitor()
+        assert len(alive_pods(store, "w-")) == 10
+        assert ctrl.metrics.zone_evictions.value(zone="zone-a") == 0
+        assert ctrl.queue_depth() == 10  # due but held
+        assert ctrl.metrics.eviction_queue_depth.value(zone="zone-a") == 10
+
+        # heartbeats resume: taints clear, queue cancels, zone -> Normal
+        now[0] += 10
+        refresh(store, a_nodes + b_nodes, now[0])
+        ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_NORMAL
+        assert ctrl.metrics.zone_health.value(
+            zone="zone-a", state=ZONE_FULL) == 0.0
+        for n in a_nodes:
+            assert store.get("nodes", "default", n).spec.taints == []
+        assert len(alive_pods(store, "w-")) == 10  # nothing was evicted
+        assert ctrl.queue_depth() == 0
+        assert any(e.reason == "ZoneDisruptionLeft"
+                   for e in store.list("events"))
+
+
+class TestPartialPartitionRate:
+    def test_minority_partition_drains_at_primary_rate(self):
+        """40% of one zone severed: the zone stays Normal (< 55%
+        unhealthy) and evictions drain at NO MORE than the configured
+        primary rate — asserted clock-driven against the token bucket."""
+        store = ObjectStore()
+        now = [1000.0]
+        qps = 0.5
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=qps, eviction_burst=1.0)
+        nodes = [f"n{i}" for i in range(10)]
+        for n in nodes:
+            store.create("nodes", zone_node(n, "zone-a", now[0]))
+        severed, alive = nodes[:4], nodes[4:]
+        for i, n in enumerate(severed):
+            for j in range(2):
+                store.create("pods", make_pod(f"v-{i}-{j}", node_name=n))
+        ctrl.monitor()
+
+        now[0] += 31  # past grace for the severed 40%
+        refresh(store, alive, now[0])
+        t_taint = now[0]
+        ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_NORMAL
+        evicted_so_far = 8 - len(alive_pods(store, "v-"))
+        assert evicted_so_far <= 1  # the burst is 1
+        # drain, one token per 1/qps seconds, never ahead of the bucket
+        while len(alive_pods(store, "v-")) > 0 and now[0] < t_taint + 60:
+            now[0] += 1
+            refresh(store, alive, now[0])
+            ctrl.monitor()
+            evicted = 8 - len(alive_pods(store, "v-"))
+            budget = 1.0 + (now[0] - t_taint) * qps  # burst + refill
+            assert evicted <= budget + 1e-9, (evicted, budget)
+        assert len(alive_pods(store, "v-")) == 0  # but it DOES drain
+        assert ctrl.metrics.zone_evictions.value(zone="zone-a") == 8
+        assert ctrl.metrics.eviction_queue_depth.value(zone="zone-a") == 0
+        # eviction events recorded per pod
+        assert sum(1 for e in store.list("events")
+                   if e.reason == "NodeControllerEviction") >= 1
+
+    def test_large_zone_partial_disruption_secondary_rate(self):
+        """>= 55% of a LARGE zone unhealthy: PartialDisruption, and the
+        bucket swaps to the (slower) secondary rate."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=100.0,  # primary would drain instantly
+            secondary_eviction_rate_qps=0.5, eviction_burst=1.0,
+            large_cluster_threshold=10)
+        nodes = [f"n{i}" for i in range(12)]
+        for n in nodes:
+            store.create("nodes", zone_node(n, "zone-a", now[0]))
+        severed, alive = nodes[:8], nodes[8:]  # 8/12 = 67% unhealthy
+        for i, n in enumerate(severed):
+            store.create("pods", make_pod(f"v-{i}", node_name=n))
+        ctrl.monitor()
+        now[0] += 31
+        refresh(store, alive, now[0])
+        t_taint = now[0]
+        ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_PARTIAL
+        assert ctrl.metrics.zone_health.value(
+            zone="zone-a", state=ZONE_PARTIAL) == 1.0
+        assert any(e.reason == "ZoneDisruptionEntered"
+                   for e in store.list("events"))
+        for _ in range(6):
+            now[0] += 1
+            refresh(store, alive, now[0])
+            ctrl.monitor()
+            evicted = 8 - len(alive_pods(store, "v-"))
+            assert evicted <= 1.0 + (now[0] - t_taint) * 0.5 + 1e-9
+        # 7s elapsed at 0.5/s + burst 1: at most 4 of 8 gone — the
+        # secondary rate, not the 100/s primary
+        assert 8 - len(alive_pods(store, "v-")) <= 4
+
+    def test_partial_zone_crossing_size_threshold_rerates(self):
+        """A PARTIAL zone whose node count crosses large_cluster_threshold
+        changes qps (halt <-> secondary) WITHOUT a state transition —
+        the bucket must re-rate on size alone, or a halted small zone
+        that grows stays wedged at 0 forever."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=100.0, secondary_eviction_rate_qps=2.0,
+            eviction_burst=1.0, large_cluster_threshold=6)
+        nodes = [f"n{i}" for i in range(6)]  # at the threshold: small
+        for n in nodes:
+            store.create("nodes", zone_node(n, "zone-a", now[0]))
+        severed, alive = nodes[:5], nodes[5:]  # 5/6 = 83%: PARTIAL
+        for i, n in enumerate(severed):
+            store.create("pods", make_pod(f"v-{i}", node_name=n))
+        ctrl.monitor()
+        now[0] += 31
+        refresh(store, alive, now[0])
+        ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_PARTIAL
+        for _ in range(5):  # small + partial: halted
+            now[0] += 5
+            refresh(store, alive, now[0])
+            ctrl.monitor()
+        assert len(alive_pods(store, "v-")) == 5
+        # the zone grows past the threshold; still 5/8 >= 55% = PARTIAL
+        for n in ("n6", "n7"):
+            store.create("nodes", zone_node(n, "zone-a", now[0]))
+        alive = alive + ["n6", "n7"]
+        for _ in range(10):
+            now[0] += 5
+            refresh(store, alive, now[0])
+            ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_PARTIAL
+        # large now: drains at the secondary rate despite no transition
+        assert len(alive_pods(store, "v-")) == 0
+
+    def test_small_zone_partial_disruption_halts(self):
+        """>= 55% of a SMALL zone (<= large_cluster_threshold nodes)
+        unhealthy: evictions stop entirely (ReducedQPSFunc -> 0) —
+        losing most of a small zone is indistinguishable from losing
+        our link to it."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=100.0, eviction_burst=100.0,
+            large_cluster_threshold=50)
+        nodes = [f"n{i}" for i in range(4)]
+        for n in nodes:
+            store.create("nodes", zone_node(n, "zone-a", now[0]))
+        severed, alive = nodes[:3], nodes[3:]  # 75%: partial, not full
+        for i, n in enumerate(severed):
+            store.create("pods", make_pod(f"v-{i}", node_name=n))
+        ctrl.monitor()
+        for _ in range(20):
+            now[0] += 10
+            refresh(store, alive, now[0])
+            ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_PARTIAL
+        assert len(alive_pods(store, "v-")) == 3  # nothing evicted
+        assert ctrl.queue_depth() == 3
+
+
+class TestKubemarkPartition:
+    def test_partition_helper_severs_fraction_and_heals(self):
+        """The hollow-node partition helper end to end: severed kubelets
+        stop heartbeating, the zone goes FullDisruption, heal() resumes
+        heartbeats and recovery clears the taints."""
+        store = ObjectStore()
+        now = [1000.0]
+        clock = lambda: now[0]  # noqa: E731
+        hc = HollowCluster(store, n_nodes=6, zones=3, clock=clock)
+        ctrl = NodeLifecycleController(
+            store, clock=clock, grace_period=10.0,
+            eviction_rate_qps=100.0, eviction_burst=100.0)
+        for node in ("hollow-0", "hollow-3"):  # zone-0's members
+            store.create("pods", make_pod(f"w-{node}", node_name=node))
+
+        cut = hc.partition(zone="zone-0", fraction=1.0)
+        assert sorted(cut) == ["hollow-0", "hollow-3"]
+        for _ in range(5):
+            now[0] += 5
+            for n in hc.nodes:
+                n.kubelet.heartbeat(now[0])  # severed ones no-op
+            ctrl.monitor()
+        zk = ":\x00:zone-0"
+        assert ctrl.zone_states[zk] == ZONE_FULL
+        assert all(ctrl.zone_states[z] == ZONE_NORMAL
+                   for z in ctrl.zone_states if z != zk)
+        assert len(alive_pods(store, "w-")) == 2  # suspended, not evicted
+
+        hc.heal(cut)
+        now[0] += 5
+        for n in hc.nodes:
+            n.kubelet.heartbeat(now[0])
+        ctrl.monitor()
+        assert ctrl.zone_states[zk] == ZONE_NORMAL
+        for node in ("hollow-0", "hollow-3"):
+            got = (store.get("nodes", "default", node)
+                   or store.get("nodes", "", node))
+            assert not any(t.key in (TAINT_NOT_READY, TAINT_UNREACHABLE)
+                           for t in got.spec.taints)
+        assert len(alive_pods(store, "w-")) == 2
+        hc.stop()
+
+    def test_partition_fraction_is_partial(self):
+        store = ObjectStore()
+        hc = HollowCluster(store, n_nodes=10, zones=1)
+        cut = hc.partition(zone="zone-0", fraction=0.4)
+        assert len(cut) == 4
+        assert sum(1 for n in hc.nodes if n.kubelet.partitioned) == 4
+        hc.heal()
+        assert not any(n.kubelet.partitioned for n in hc.nodes)
+        hc.stop()
+
+
+@pytest.mark.faults
+class TestFaultPoints:
+    def test_heartbeat_deliver_drop(self):
+        """A dropped heartbeat never reaches the store: the node's
+        annotation stays stale and the controller sees a dead node."""
+        from kubernetes_tpu.kubelet import Kubelet
+
+        store = ObjectStore()
+        now = [100.0]
+        kl = Kubelet(store, "n1", clock=lambda: now[0], heartbeat_period=1.0)
+        kl.heartbeat(now[0])
+        before = store.get("nodes", "default", "n1").metadata.annotations[
+            HEARTBEAT_ANNOTATION]
+        now[0] += 50
+        with faultpoints.injected("heartbeat.deliver", "drop"):
+            kl.heartbeat(now[0])
+        assert store.get("nodes", "default", "n1").metadata.annotations[
+            HEARTBEAT_ANNOTATION] == before
+        assert faultpoints.hits("heartbeat.deliver") == 1
+        kl.heartbeat(now[0])  # disarmed: delivers again
+        assert store.get("nodes", "default", "n1").metadata.annotations[
+            HEARTBEAT_ANNOTATION] == str(now[0])
+
+    def test_evict_drop_retries_next_pass(self):
+        """A lost eviction call (drop mode) leaves the entry queued; the
+        next pass retries and the pod goes."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=1000.0, eviction_burst=10.0)
+        store.create("nodes", zone_node("n1", "zone-a", now[0]))
+        store.create("nodes", zone_node("n2", "zone-a", now[0]))
+        store.create("pods", make_pod("v-0", node_name="n1"))
+        ctrl.monitor()
+        now[0] += 31
+        refresh(store, ["n2"], now[0])
+        with faultpoints.injected("nodelifecycle.evict", "drop"):
+            ctrl.monitor()
+            assert store.get("pods", "default", "v-0") is not None
+            assert faultpoints.hits("nodelifecycle.evict") == 1
+        now[0] += 1
+        refresh(store, ["n2"], now[0])
+        ctrl.monitor()
+        assert store.get("pods", "default", "v-0") is None
+
+    def test_tally_fault_forces_host_fallback(self):
+        """A wedged device tally degrades to the host path — zone health
+        is still computed and the breaker records the failure."""
+        from kubernetes_tpu.sched.breaker import DevicePathBreaker
+
+        store = ObjectStore()
+        now = [1000.0]
+        breaker = DevicePathBreaker(threshold=3, clock=lambda: now[0])
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0, breaker=breaker)
+        store.create("nodes", zone_node("n1", "zone-a", now[0]))
+        with faultpoints.injected("nodelifecycle.tally", "raise"):
+            ctrl.monitor()
+        assert ctrl.zone_states[":\x00:zone-a"] == ZONE_NORMAL
+        assert breaker.failures == 1
+        ctrl.monitor()  # disarmed: device path again, breaker resets
+        assert breaker.failures == 0
+
+
+class TestZoneTallyParity:
+    def test_device_matches_host(self):
+        rng = np.random.RandomState(7)
+        n, z = 64, 16
+        zone_id = rng.randint(1, z, size=n).astype(np.int32)
+        bad = rng.rand(n) < 0.3
+        valid = rng.rand(n) < 0.9
+        dt, db = zonehealth.zone_tally(zone_id, bad, valid, z)
+        ht, hb = zonehealth.zone_tally_host(zone_id, bad, valid, z)
+        assert np.array_equal(dt, ht)
+        assert np.array_equal(db, hb)
+
+
+class TestDefaultTolerationSecondsInterplay:
+    def _admitted_pod(self, store, name, node):
+        """A pod as the apiserver admits it: DefaultTolerationSeconds
+        stamps the 300s not-ready/unreachable NoExecute tolerations."""
+        from kubernetes_tpu.server.admission import DefaultTolerationSeconds
+
+        pod = make_pod(name, node_name=node)
+        DefaultTolerationSeconds().admit("create", "pods", pod, None, None,
+                                         store)
+        secs = {(t.key, t.toleration_seconds) for t in pod.spec.tolerations}
+        assert (TAINT_NOT_READY, 300) in secs
+        assert (TAINT_UNREACHABLE, 300) in secs
+        store.create("pods", pod)
+        return pod
+
+    def _controller(self, store, now):
+        return NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=1000.0, eviction_burst=10.0)
+
+    def test_evicted_only_after_300s_of_not_ready(self):
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = self._controller(store, now)
+        store.create("nodes", zone_node("n-bad", "zone-a", now[0]))
+        store.create("nodes", zone_node("n-ok", "zone-a", now[0]))
+        self._admitted_pod(store, "app", "n-bad")
+        ctrl.monitor()
+        # n-bad stops heartbeating; taint lands one grace period later
+        now[0] += 31
+        refresh(store, ["n-ok"], now[0])
+        ctrl.monitor()
+        t_taint = now[0]
+        assert any(t.key == TAINT_UNREACHABLE for t in store.get(
+            "nodes", "default", "n-bad").spec.taints)
+        # 299s into the toleration: still tolerated
+        for _ in range(4):
+            now[0] += 60
+            refresh(store, ["n-ok"], now[0])
+            ctrl.monitor()
+        now[0] = t_taint + 299
+        refresh(store, ["n-ok"], now[0])
+        ctrl.monitor()
+        assert store.get("pods", "default", "app") is not None
+        # 301s: tolerationSeconds spent — evicted
+        now[0] = t_taint + 301
+        refresh(store, ["n-ok"], now[0])
+        ctrl.monitor()
+        assert store.get("pods", "default", "app") is None
+
+    def test_short_blip_never_evicts(self):
+        """NotReady for less than the 300s default toleration: the taint
+        clears on recovery, the queued eviction cancels, and the pod is
+        still alive long after the original deadline."""
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = self._controller(store, now)
+        store.create("nodes", zone_node("n-bad", "zone-a", now[0]))
+        store.create("nodes", zone_node("n-ok", "zone-a", now[0]))
+        self._admitted_pod(store, "app", "n-bad")
+        ctrl.monitor()
+        now[0] += 31
+        refresh(store, ["n-ok"], now[0])
+        ctrl.monitor()
+        t_taint = now[0]
+        assert ctrl.queue_depth() == 0  # queued with a 300s deadline
+        # 100s blip, then the kubelet comes back
+        now[0] = t_taint + 100
+        refresh(store, ["n-ok", "n-bad"], now[0])
+        ctrl.monitor()
+        assert store.get("nodes", "default", "n-bad").spec.taints == []
+        # far past the would-have-been deadline: still alive
+        now[0] = t_taint + 600
+        refresh(store, ["n-ok", "n-bad"], now[0])
+        ctrl.monitor()
+        assert store.get("pods", "default", "app") is not None
+
+
+class TestDaemonSetTolerations:
+    def test_daemon_pods_tolerate_node_failure_taints(self):
+        """Satellite: daemon pods are stamped with not-ready/unreachable
+        NoExecute tolerations (1.11 behavior) — a daemon pod on a failed
+        node is NOT evicted into a respawn loop."""
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.controllers import DaemonSetController
+
+        store = ObjectStore()
+        now = [1000.0]
+        ctrl = NodeLifecycleController(
+            store, clock=lambda: now[0], grace_period=30.0,
+            eviction_rate_qps=1000.0, eviction_burst=10.0)
+        store.create("nodes", zone_node("n1", "zone-a", now[0]))
+        store.create("nodes", zone_node("n2", "zone-a", now[0]))
+        ds = api.DaemonSet(
+            metadata=api.ObjectMeta(name="agent"),
+            spec=api.DaemonSetSpec(
+                selector=LabelSelector(match_labels={"app": "agent"}),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "agent"}),
+                    spec=api.PodSpec(containers=[api.Container()]))))
+        store.create("daemonsets", ds)
+        dsc = DaemonSetController(store)
+        dsc.sync_all()
+        daemon_pods = [p for p in store.list("pods")
+                       if p.metadata.name.startswith("agent-")]
+        assert len(daemon_pods) == 2
+        for p in daemon_pods:
+            tols = {(t.key, t.effect, t.toleration_seconds)
+                    for t in p.spec.tolerations}
+            assert (TAINT_NOT_READY, api.NO_EXECUTE, None) in tols
+            assert (TAINT_UNREACHABLE, api.NO_EXECUTE, None) in tols
+        # a bystander pod without tolerations rides the same node
+        store.create("pods", make_pod("bystander", node_name="n1"))
+        ctrl.monitor()
+        # n1 dies; the zone stays partially healthy so eviction proceeds
+        now[0] += 31
+        refresh(store, ["n2"], now[0])
+        ctrl.monitor()
+        now[0] += 1
+        refresh(store, ["n2"], now[0])
+        ctrl.monitor()
+        assert store.get("pods", "default", "bystander") is None  # evicted
+        assert store.get("pods", "default", "agent-n1") is not None
