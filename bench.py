@@ -700,17 +700,34 @@ def run_preempt_config(nodes, pods, wave, device=True):
     return done, dt, p99, p99_round, sched.wave_path()
 
 
+def stage_breakdown(top=12):
+    """Per-stage wall-time totals from the step profiler (fed by every
+    Trace the scheduler emits) — the bench json carries WHERE the run's
+    seconds went, not just the throughput. Includes warm-up/fill phases:
+    this attributes the whole process's scheduling work."""
+    from kubernetes_tpu.utils import profiling
+
+    prof = profiling.active()
+    if prof is None:
+        return None
+    return {k: round(v, 3) for k, v in prof.step_totals(top=top).items()}
+
+
 def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
     if placed != pods:
         print(f"FATAL: {name}: placed {placed}/{pods}", file=sys.stderr)
         sys.exit(1)
     rate = placed / dt if dt > 0 else 0.0
-    print(json.dumps({
+    rec = {
         "metric": f"scheduler_{name}_pods_per_sec_{nodes}n_{pods}p",
         "value": round(rate, 1),
         "unit": "pods/s",
         "vs_baseline": round(rate / 100.0, 2),
-    }), flush=True)
+    }
+    stages = stage_breakdown()
+    if stages:
+        rec["stages"] = stages
+    print(json.dumps(rec), flush=True)
     print(f"# {name}: placed={placed} wall={dt:.2f}s wave={wave} "
           f"path={path} p99_pod_latency={p99*1e3:.0f}ms "
           f"p99_round_latency={p99_round*1e3:.0f}ms", file=sys.stderr)
@@ -760,7 +777,7 @@ DRIVER_SUITE = [
 ]
 
 
-def run_subprocess_suite(suite, wave, cpu):
+def run_subprocess_suite(suite, wave, cpu, tracing=False, trace_ledger=None):
     # one subprocess per config: a run's end-of-round result fetch
     # leaves the tunneled TPU runtime in its degraded transfer mode,
     # which would taint every subsequent config in this process
@@ -775,6 +792,13 @@ def run_subprocess_suite(suite, wave, cpu):
             cmd += ["--wave", str(wave)]
         cmd += extra
         cmd.append("--skip-backend-probe")  # the parent already probed
+        if tracing:
+            cmd.append("--tracing")
+        if trace_ledger:
+            # per-config ledgers: concurrent-process appends would
+            # interleave otherwise, and per-config files are what the
+            # offline scoring analysis wants anyway
+            cmd += ["--trace-ledger", f"{trace_ledger}.{name}"]
         if cpu:
             cmd.append("--cpu")
         r = subprocess.run(cmd, capture_output=True, text=True)
@@ -838,6 +862,12 @@ def main():
     ap.add_argument("--name", default="",
                     help="metric name override (suite subprocesses)")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--tracing", action="store_true",
+                    help="flight recorder on for the run (per-pod span "
+                         "tracing; ~no cost when off)")
+    ap.add_argument("--trace-ledger", default=None,
+                    help="append per-round JSONL ledger records here "
+                         "(implies --tracing)")
     ap.add_argument("--skip-backend-probe", action="store_true",
                     help=argparse.SUPPRESS)  # suite children: parent probed
     args = ap.parse_args()
@@ -874,11 +904,26 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     if args.suite:
-        run_subprocess_suite(SUITE, args.wave, args.cpu)
+        run_subprocess_suite(SUITE, args.wave, args.cpu,
+                             tracing=args.tracing,
+                             trace_ledger=args.trace_ledger)
         return
     if not explicit:
-        run_subprocess_suite(DRIVER_SUITE, args.wave, args.cpu)
+        run_subprocess_suite(DRIVER_SUITE, args.wave, args.cpu,
+                             tracing=args.tracing,
+                             trace_ledger=args.trace_ledger)
         return
+
+    # the measured child: the step profiler feeds the per-stage
+    # wall-time breakdown in the emitted json; the flight recorder is
+    # opt-in (its off-cost is one attribute read per site)
+    from kubernetes_tpu.utils import profiling
+
+    profiling.enable()
+    if args.tracing or args.trace_ledger:
+        from kubernetes_tpu.utils import tracing as _tracing
+
+        _tracing.enable(ledger_path=args.trace_ledger or None)
 
     if args.workload == "preempt":
         placed, dt, p99, p99_round, path = run_preempt_config(
@@ -907,7 +952,7 @@ def main():
                   file=sys.stderr)
             sys.exit(1)
         name = args.name or "paced"
-        print(json.dumps({
+        rec = {
             "metric": f"scheduler_{name}_p99_ms_{args.nodes}n_"
                       f"{int(args.rate)}pps",
             "value": round(p99 * 1e3, 1),
@@ -915,7 +960,11 @@ def main():
             # headroom under the reference's 5s pod-startup SLO at
             # >=10x its 10 pods/s offered load (load.go:124, density.go:55)
             "vs_baseline": round(5.0 / p99, 2) if p99 > 0 else 0.0,
-        }), flush=True)
+        }
+        stages = stage_breakdown()
+        if stages:
+            rec["stages"] = stages
+        print(json.dumps(rec), flush=True)
         print(f"# {name}: placed={placed} wall={dt:.2f}s "
               f"offered={offered:.0f}pods/s (target {args.rate:.0f}) "
               f"wave={args.wave} path={path} p99_pod_latency={p99*1e3:.0f}ms",

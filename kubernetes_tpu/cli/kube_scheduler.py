@@ -54,6 +54,28 @@ class HealthServer:
                             else "profiling disabled (run with "
                                  "--profiling)\n").encode()
                     ctype = "text/plain"
+                elif self.path.startswith("/debug/trace"):
+                    # flight recorder export: Chrome trace-event JSON
+                    # (Perfetto-loadable) by default; ?format=text for
+                    # the plain timeline, ?format=ledger for the round
+                    # ledger records the JSONL file would hold
+                    from ..utils import tracing
+
+                    rec = tracing.active()
+                    if rec is None:
+                        body = (b"tracing disabled (run with --tracing)\n")
+                        ctype = "text/plain"
+                    elif "format=text" in self.path:
+                        body = rec.text_timeline().encode()
+                        ctype = "text/plain"
+                    elif "format=ledger" in self.path:
+                        body = ("\n".join(json.dumps(r)
+                                          for r in rec.ledger_rows())
+                                + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        body = json.dumps(rec.chrome_trace()).encode()
+                        ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
@@ -80,7 +102,19 @@ class HealthServer:
         typed = set()
         for series in sched.metrics.all_series().values():
             if hasattr(series, "counts"):  # histogram
+                # full Prometheus histogram exposition: CUMULATIVE
+                # name_bucket{le="..."} lines ending at +Inf == _count —
+                # without the buckets, dashboards cannot compute
+                # histogram_quantile() and the old output failed strict
+                # text-format parsers
                 lines.append(f"# TYPE {series.name} histogram")
+                cum = 0
+                for bound, c in zip(series.buckets, series.counts):
+                    cum += c
+                    lines.append(
+                        f'{series.name}_bucket{{le="{bound:g}"}} {cum}')
+                cum += series.counts[-1]
+                lines.append(f'{series.name}_bucket{{le="+Inf"}} {cum}')
                 lines.append(f"{series.name}_sum {series.sum}")
                 lines.append(f"{series.name}_count {series.total}")
             else:
@@ -129,22 +163,39 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
         client_cert_pem: Optional[str] = None,
         client_key_pem: Optional[str] = None,
         profiling_enabled: bool = False,
-        contention_profiling: bool = False) -> int:
+        contention_profiling: bool = False,
+        tracing_enabled: bool = False) -> int:
     stop = stop or threading.Event()
     prof_on = profiling_enabled or contention_profiling
     if prof_on:
         from ..utils import profiling
 
         profiling.enable()
+    # a ledger path implies tracing (the recorder is what writes it);
+    # only tear down a recorder THIS call created — an embedding caller
+    # may have enabled tracing for its own purposes
+    trace_on = tracing_enabled or cfg.tracing or bool(cfg.round_ledger_path)
+    trace_owned = False
+    if trace_on:
+        from ..utils import tracing
+
+        trace_owned = tracing.active() is None
+        tracing.enable(max_rounds=cfg.trace_rounds,
+                       ledger_path=cfg.round_ledger_path or None)
     try:
         return _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
                           client_cert_pem, client_key_pem,
                           contention_profiling)
     finally:
+        # process-global instrumentation: never leak, even on error
         if prof_on:
             from ..utils import profiling
 
-            profiling.disable()  # process-global: never leak, even on error
+            profiling.disable()
+        if trace_owned:
+            from ..utils import tracing
+
+            tracing.disable()
 
 
 def _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
@@ -269,6 +320,17 @@ def main(argv=None) -> int:
     ap.add_argument("--contention-profiling", action="store_true",
                     help="also record lock wait times "
                          "(EnableContentionProfiling analog)")
+    ap.add_argument("--tracing", action="store_true",
+                    help="flight recorder: per-pod span tracing served at "
+                         "/debug/trace (Chrome trace-event JSON; "
+                         "?format=text for a timeline)")
+    ap.add_argument("--trace-rounds", type=int, default=None,
+                    help="rounds retained in the flight-recorder ring "
+                         "buffer (default 64)")
+    ap.add_argument("--round-ledger", default=None,
+                    help="append one structured JSONL record per "
+                         "scheduling round to this file (requires "
+                         "--tracing)")
     ap.add_argument("--once", action="store_true",
                     help="exit when the queue drains (batch mode)")
     args = ap.parse_args(argv)
@@ -289,6 +351,12 @@ def main(argv=None) -> int:
         cfg.scrub_interval = args.scrub_interval
     if args.healthz_port is not None:
         cfg.healthz_port = args.healthz_port
+    if args.tracing:
+        cfg.tracing = True
+    if args.trace_rounds is not None:
+        cfg.trace_rounds = args.trace_rounds
+    if args.round_ledger is not None:
+        cfg.round_ledger_path = args.round_ledger
     for kv in filter(None, args.feature_gates.split(",")):
         k, _, v = kv.partition("=")
         cfg.feature_gates[k] = v.lower() in ("true", "1", "")
@@ -304,7 +372,8 @@ def main(argv=None) -> int:
                    client_cert_pem=pem_arg(args.client_cert_data),
                    client_key_pem=pem_arg(args.client_key_data),
                    profiling_enabled=args.profiling,
-                   contention_profiling=args.contention_profiling)
+                   contention_profiling=args.contention_profiling,
+                   tracing_enabled=args.tracing)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

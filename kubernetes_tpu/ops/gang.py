@@ -49,9 +49,13 @@ def schedule_gang(*args, **kw):
     """Entry point for the joint-assignment kernel; the fault point
     fires outside the jit boundary (see ops/kernel.py schedule_round)."""
     from ..utils import faultpoints
+    from .kernel import dispatch_bucket, record_dispatch
 
     faultpoints.fire("kernel.gang")
-    return _schedule_gang(*args, **kw)
+    nt, pm, tt, pb = args[0], args[1], args[2], args[3]
+    bucket = dispatch_bucket(nt, pm, tt, kw, lead=(pb.req.shape[0],))
+    return record_dispatch("gang", bucket,
+                           lambda: _schedule_gang(*args, **kw))
 
 
 @functools.partial(jax.jit, static_argnames=(

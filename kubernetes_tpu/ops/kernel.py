@@ -25,6 +25,7 @@ consume.
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple
 
 import jax
@@ -76,6 +77,65 @@ class WaveResult(NamedTuple):
     fail_counts: jnp.ndarray  # i32 [Q, P]  first-fail per predicate
     masks: jnp.ndarray  # bool [Q, P, N]  per-predicate pass masks
     rr_end: jnp.ndarray  # i32  round-robin counter after the wave
+
+
+# -- device telemetry --------------------------------------------------------
+#
+# The scheduler registers its Metrics here (set_telemetry) so every
+# kernel dispatch can account jit program-cache hits/misses per shape
+# bucket and the compile seconds a miss costs — the "why did this round
+# take 8s" answer is usually "it recompiled". Process-global because the
+# jit compile cache itself is process-global; the last scheduler built
+# owns the series (one scheduler per process everywhere real).
+_TELEMETRY = None
+_COMPILED: set = set()
+
+
+def set_telemetry(metrics) -> None:
+    global _TELEMETRY
+    _TELEMETRY = metrics
+
+
+def dispatch_bucket(nt, pm, tt, kw, lead=()) -> tuple:
+    """The shape bucket a dispatch compiles under: every dimension that
+    participates in the jit cache key in practice — the caller's wave/pod
+    rows (`lead`), node rows, pod-matrix and term-table caps (vocab
+    growth retraces!), the static num_label_values/num_zones, and the
+    formulation statics. Weights are deliberately excluded
+    (profile-constant; a weight change would mint one mislabelled 'hit',
+    not a recurring lie)."""
+    return tuple(lead) + (
+        nt.valid.shape[0], pm.node.shape[0], tt.node.shape[0],
+        int(kw.get("num_label_values", 64)), int(kw.get("num_zones", 0)),
+        int(bool(kw.get("has_ipa", False))),
+        int(bool(kw.get("use_pallas", False))))
+
+
+def record_dispatch(program: str, bucket_key: tuple, fn):
+    """Run one kernel dispatch, classifying it as a program-cache hit or
+    miss by shape bucket and timing the miss (trace+lower+compile happen
+    synchronously inside the first call at a new shape). Costs one set
+    lookup when telemetry is registered, nothing when it isn't."""
+    tel = _TELEMETRY
+    if tel is None:
+        return fn()
+    key = (program,) + bucket_key
+    miss = key not in _COMPILED
+    t0 = time.monotonic()
+    out = fn()
+    _COMPILED.add(key)
+    bucket = "x".join(str(d) for d in bucket_key)
+    tel.device_jit_events.labels(
+        program=program, bucket=bucket,
+        event="miss" if miss else "hit").inc()
+    if miss:
+        dt = time.monotonic() - t0
+        tel.device_jit_compile_seconds.observe(dt)
+        from ..utils import tracing
+
+        tracing.event("jit_compile", program=program, bucket=bucket,
+                      seconds=round(dt, 3))
+    return out
 
 
 def pallas_default() -> bool:
@@ -265,7 +325,10 @@ def schedule_wave(*args, **kw):
     at trace time, so once the compile cache warms an injected fault
     would silently stop firing."""
     faultpoints.fire("kernel.wave")
-    return _schedule_wave(*args, **kw)
+    nt, pm, tt, pb = args[0], args[1], args[2], args[3]
+    bucket = dispatch_bucket(nt, pm, tt, kw, lead=(pb.req.shape[0],))
+    return record_dispatch("wave", bucket,
+                           lambda: _schedule_wave(*args, **kw))
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -329,7 +392,11 @@ def schedule_round(*args, **kw):
     only run on a trace-cache miss, making injected faults vanish after
     the first compile."""
     faultpoints.fire("kernel.round")
-    return _schedule_round(*args, **kw)
+    nt, pm, tt, pbs = args[0], args[1], args[2], args[3]
+    bucket = dispatch_bucket(nt, pm, tt, kw,
+                             lead=(pbs.req.shape[0], pbs.req.shape[1]))
+    return record_dispatch("round", bucket,
+                           lambda: _schedule_round(*args, **kw))
 
 
 @functools.partial(jax.jit, static_argnames=(

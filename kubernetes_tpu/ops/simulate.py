@@ -102,16 +102,20 @@ def simulate_placements(snapshot: Snapshot, pb, *, weights, num_zones: int,
     from .kernel import schedule_wave
 
     faultpoints.fire("autoscaler.simulate")
-    nt, pm, tt = snapshot.to_device()
-    P = pb.req.shape[0]
-    extra = np.ones((P, snapshot.caps.N), bool)
-    res = schedule_wave(nt, pm, tt, pb, extra, jnp.asarray(0, jnp.int32),
-                        None, weights=weights, num_zones=num_zones,
-                        num_label_values=num_label_values,
-                        has_ipa=has_ipa, use_pallas=use_pallas)
-    jax.block_until_ready(res.chosen)
-    chosen = np.asarray(res.chosen)
-    feasible = np.asarray(res.masks).all(axis=0)  # [P, N]
+    from ..utils import tracing
+
+    with tracing.span("autoscaler_simulate", cat="device",
+                      what="scale_up", pods=pb.req.shape[0]):
+        nt, pm, tt = snapshot.to_device()
+        P = pb.req.shape[0]
+        extra = np.ones((P, snapshot.caps.N), bool)
+        res = schedule_wave(nt, pm, tt, pb, extra, jnp.asarray(0, jnp.int32),
+                            None, weights=weights, num_zones=num_zones,
+                            num_label_values=num_label_values,
+                            has_ipa=has_ipa, use_pallas=use_pallas)
+        jax.block_until_ready(res.chosen)
+        chosen = np.asarray(res.chosen)
+        feasible = np.asarray(res.masks).all(axis=0)  # [P, N]
     return SimulationVerdict(chosen=chosen, feasible=feasible, n_real=-1)
 
 
@@ -131,15 +135,19 @@ def simulate_refit(snapshot: Snapshot, pb, need: int, *, weights,
     from .gang import schedule_gang
 
     faultpoints.fire("autoscaler.simulate")
-    nt, pm, tt = snapshot.to_device()
-    P = pb.req.shape[0]
-    extra = np.ones((P, snapshot.caps.N), bool)
-    res = schedule_gang(nt, pm, tt, pb, extra, jnp.asarray(0, jnp.int32),
-                        None, jnp.asarray(need, jnp.int32), weights=weights,
-                        num_zones=num_zones,
-                        num_label_values=num_label_values,
-                        has_ipa=has_ipa, use_pallas=use_pallas)
-    jax.block_until_ready(res.chosen)
+    from ..utils import tracing
+
+    with tracing.span("autoscaler_simulate", cat="device",
+                      what="scale_down", pods=pb.req.shape[0], need=need):
+        nt, pm, tt = snapshot.to_device()
+        P = pb.req.shape[0]
+        extra = np.ones((P, snapshot.caps.N), bool)
+        res = schedule_gang(nt, pm, tt, pb, extra, jnp.asarray(0, jnp.int32),
+                            None, jnp.asarray(need, jnp.int32),
+                            weights=weights, num_zones=num_zones,
+                            num_label_values=num_label_values,
+                            has_ipa=has_ipa, use_pallas=use_pallas)
+        jax.block_until_ready(res.chosen)
     return bool(np.asarray(res.ok)), np.asarray(res.chosen)
 
 

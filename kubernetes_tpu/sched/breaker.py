@@ -31,28 +31,42 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
 
+# gauge encoding for device_path_breaker_state (utils/metrics.py):
+# operators alert on >0 (scheduling currently degraded)
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
 
 class DevicePathBreaker:
     def __init__(self, threshold: int = 3, cooldown: float = 30.0,
                  clock: Callable[[], float] = time.monotonic,
                  on_recover: Optional[Callable[[], None]] = None,
-                 on_trip: Optional[Callable[[], None]] = None):
+                 on_trip: Optional[Callable[[], None]] = None,
+                 on_state: Optional[Callable[[str], None]] = None):
         self.threshold = max(int(threshold), 1)
         self.cooldown = cooldown
         self.clock = clock
         self.on_recover = on_recover
         self.on_trip = on_trip
+        # fired on EVERY transition (trip, half-open probe admission,
+        # recovery) with the new state — feeds the breaker-state gauge
+        # and the flight recorder's span events
+        self.on_state = on_state
         self.state = CLOSED
         self.failures = 0  # consecutive, since the last success
         self.trips = 0
         self.opened_at = 0.0
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        if self.on_state is not None:
+            self.on_state(state)
 
     def allow(self) -> bool:
         """May this wave take the device path? Open + cooldown elapsed
         transitions to half-open and admits the probe."""
         if self.state == OPEN:
             if self.clock() - self.opened_at >= self.cooldown:
-                self.state = HALF_OPEN
+                self._transition(HALF_OPEN)
                 return True
             return False
         return True  # closed, or half-open (the probe itself)
@@ -66,12 +80,12 @@ class DevicePathBreaker:
     def record_success(self) -> None:
         self.failures = 0
         if self.state != CLOSED:
-            self.state = CLOSED
+            self._transition(CLOSED)
             if self.on_recover is not None:
                 self.on_recover()
 
     def _trip(self) -> None:
-        self.state = OPEN
+        self._transition(OPEN)
         self.opened_at = self.clock()
         self.trips += 1
         if self.on_trip is not None:

@@ -46,6 +46,12 @@ class KubeSchedulerConfiguration:
     # bind reconciler: POST attempts per bind before the GET-based
     # succeeded-but-response-lost resolution kicks in
     bind_max_attempts: int = 3
+    # observability: flight recorder (per-pod span tracing served at
+    # /debug/trace, opt-in like --profiling), its round ring-buffer
+    # depth, and the optional per-round JSONL ledger path
+    tracing: bool = False
+    trace_rounds: int = 64
+    round_ledger_path: str = ""
     # informer kinds mirrored before scheduling starts
     feature_gates: dict = field(default_factory=dict)
 

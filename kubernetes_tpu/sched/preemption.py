@@ -25,6 +25,7 @@ from ..api import types as api
 from ..state.cache import SchedulerCache
 from ..state.node_info import NodeInfo
 from ..plugins import golden
+from ..utils import tracing
 from .errors import UNRESOLVABLE
 
 
@@ -247,6 +248,10 @@ def preempt(pod: api.Pod, cache: SchedulerCache,
         candidates = process_preemption_with_extenders(pod, candidates,
                                                        extenders, pdbs)
     chosen = pick_one_node(candidates)
+    # flight-recorder span event: the host per-pod what-if is exactly
+    # the path the preemption-cliff investigation needs attributed
+    tracing.event("preempt_whatif", pod=pod.uid, path="host",
+                  candidates=len(candidates), chosen=chosen or "")
     if chosen is None:
         return None
     victims, nviol = candidates[chosen]

@@ -37,6 +37,7 @@ import time
 from typing import Callable, Optional, Tuple
 
 from ..runtime.store import Conflict
+from ..utils import tracing
 
 log = logging.getLogger(__name__)
 
@@ -75,6 +76,12 @@ class BindReconciler:
             if i > 0:
                 if self.metrics is not None:
                     self.metrics.bind_retries.inc()
+                # span event so a pod's trace shows every extra POST it
+                # cost (flight recorder; no-op when tracing is off)
+                tracing.event("bind_retry", pod=f"{pod.namespace}/{pod.name}",
+                              attempt=i + 1,
+                              error=type(last_exc).__name__
+                              if last_exc is not None else "")
                 self.sleep(delay * (0.5 + self.jitter()))
                 delay = min(delay * 2, self.max_delay)
             try:

@@ -45,9 +45,9 @@ from ..state.cache import SchedulerCache
 from ..state.featurize import PodFeaturizer
 from ..state.scrubber import SnapshotScrubber
 from ..state.snapshot import Snapshot
-from ..utils import Metrics, PodBackoff, Trace, faultpoints
+from ..utils import Metrics, PodBackoff, Trace, faultpoints, tracing
 from ..utils.feature_gates import FeatureGates
-from .breaker import DevicePathBreaker
+from .breaker import STATE_CODES, DevicePathBreaker
 from .equivalence import EquivalenceCache, equivalence_class
 from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
 from .extender import ExtenderError
@@ -204,8 +204,7 @@ class Scheduler:
         # annotation lookup at enqueue and one per wave partition.
         self.gangs = GangDirectory(store)
         self.queue.gang_lookup = self.gangs.lookup
-        self.queue.on_gang_released = (
-            lambda key, waited: self.metrics.gang_wait_seconds.observe(waited))
+        self.queue.on_gang_released = self._gang_released
         self.backoff = PodBackoff(clock=clock)
         self._next_backoff_gc = 0.0
         # snapshot scrubber (state/scrubber.py): audits the HBM mirror
@@ -222,7 +221,16 @@ class Scheduler:
         self.breaker = DevicePathBreaker(
             threshold=breaker_threshold, cooldown=breaker_cooldown,
             clock=clock, on_recover=self.scrubber.rebuild,
-            on_trip=self.metrics.device_path_trips.inc)
+            on_trip=self.metrics.device_path_trips.inc,
+            on_state=self._breaker_state_changed)
+        self.metrics.breaker_state.set(STATE_CODES[self.breaker.state])
+        # device telemetry: kernel dispatches account jit cache events
+        # into this scheduler's registry; snapshot upload bytes are
+        # drained into counters by export_queue_gauges
+        from ..ops import kernel as _kernel
+
+        _kernel.set_telemetry(self.metrics)
+        self._upload_bytes_seen = 0
         from .volume_binder import VolumeBinder
 
         self.volume_binder = VolumeBinder(store)
@@ -397,6 +405,43 @@ class Scheduler:
         # group membership may have changed -> equivalence rows are stale
         self.featurizer._cache.clear()
 
+    # -- observability hooks ---------------------------------------------------
+
+    def _breaker_state_changed(self, state: str) -> None:
+        """Every breaker transition lands on the state gauge (0=closed,
+        1=half-open, 2=open) and, when tracing, as a span event — the
+        trips counter alone can't tell an operator whether scheduling is
+        degraded RIGHT NOW."""
+        self.metrics.breaker_state.set(STATE_CODES[state])
+        rec = tracing.active()
+        if rec is not None:
+            rec.event("breaker", state=state,
+                      failures=self.breaker.failures)
+
+    def _gang_released(self, key: str, waited: float) -> None:
+        self.metrics.gang_wait_seconds.observe(waited)
+        rec = tracing.active()
+        if rec is not None:
+            now = rec.now()
+            rec.add_span("gang_wait", now - waited, now, cat="gang",
+                         gang=key, waited_s=round(waited, 6))
+
+    def _trace_queue_waits(self, rt, pods: List[api.Pod]) -> None:
+        """Per-pod queue_wait spans (first enqueue -> popped into this
+        round), keyed by UID; added_at survives until bind so reading it
+        here consumes nothing."""
+        now = self.clock()
+        added_at = self.queue.added_at
+        for p in pods:
+            added = added_at.get(p.uid)
+            if added is not None:
+                rt.pod_span(p.uid, "queue_wait", now - added)
+
+    def _round_snapshot_shape(self) -> Dict[str, int]:
+        c = self.snapshot.caps
+        return {"nodes": int(np.sum(self.snapshot.valid)),
+                "N": c.N, "M": c.M, "E": c.E}
+
     def wave_path(self) -> str:
         """Which filter formulation the most recently executed program
         actually used: 'pallas', 'xla', or 'unresolved' before any wave
@@ -485,6 +530,14 @@ class Scheduler:
         g.labels(queue="backoff").set(self.queue.backoff_count())
         g.labels(queue="unschedulable").set(self.queue.unschedulable_count())
         g.labels(queue="gang_waiting").set(self.queue.gang_waiting_count())
+        # device telemetry: HBM footprint of the resident mirror and the
+        # upload bytes accrued since the last export (snapshot counts,
+        # the registry exposes)
+        self.metrics.snapshot_hbm_bytes.set(self.snapshot.hbm_bytes())
+        up = self.snapshot.upload_bytes_total
+        if up > self._upload_bytes_seen:
+            self.metrics.snapshot_upload_bytes.inc(up - self._upload_bytes_seen)
+            self._upload_bytes_seen = up
 
     def run_once(self, timeout: float = 0.0) -> int:
         """Schedule one wave. Returns the number of pods assumed with a
@@ -667,6 +720,15 @@ class Scheduler:
             for p in pods[keep:]:
                 self.queue.add_if_not_present(p)
             pods, waves = pods[:keep], waves[:max_waves]
+        # flight recorder (utils/tracing.py): one round trace whose marks
+        # tile the wall time — featurize / upload / device_wave / fetch /
+        # commit / preempt — plus per-pod queue_wait spans keyed by UID
+        rec = tracing.active()
+        rt = None
+        if rec is not None:
+            rt = rec.begin_round("pipeline", pending=len(pods),
+                                 waves=len(waves))
+            self._trace_queue_waits(rt, pods)
         # pass 1: grow every vocab/cap to its final size so pass 2 emits
         # uniform shapes (one compiled program, not one per growth step).
         # When nothing grew — the steady state once caps are pre-sized —
@@ -693,17 +755,27 @@ class Scheduler:
                     # fresh state — the per-wave loop owns that path
                     for p in pods:
                         self.queue.add_if_not_present(p)
+                    if rt is not None:
+                        rec.end_round(rt, outcome="host_fallback")
                     return 0
         except ExtenderError:
             self.metrics.scheduling_errors.labels(stage="extender").inc()
             for p in pods:
                 self._park_with_backoff(p)
+            if rt is not None:
+                rec.end_round(rt, outcome="extender_error")
             return 0
         pm_rows_all, term_rows_all = self.snapshot.stage_pending(pods)
         tpp = term_rows_all.shape[1]
         trace.step("featurized+staged")
+        if rt is not None:
+            rt.mark("featurize", pods=len(pods))
+            up0 = self.snapshot.upload_bytes_total
         nt, pm, tt = self.snapshot.to_device()
         trace.step("uploaded")
+        if rt is not None:
+            rt.mark("upload", cat="device",
+                    bytes=self.snapshot.upload_bytes_total - up0)
         usage = (nt.requested, nt.nonzero, nt.pod_count)
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
@@ -739,8 +811,14 @@ class Scheduler:
             # degraded mode
             jax.block_until_ready(chosen_d)
             trace.step("executed")
+            if rt is not None:
+                rt.mark("device_wave", cat="device", waves=nw,
+                        path="pallas" if use_p else "xla")
             chosen = np.asarray(chosen_d)
+            self.metrics.device_fetch_bytes.inc(chosen.nbytes)
             trace.step("fetched")
+            if rt is not None:
+                rt.mark("fetch", cat="device", bytes=int(chosen.nbytes))
             return chosen, rr_end
 
         round_pallas = self._round_pallas
@@ -780,6 +858,9 @@ class Scheduler:
             for p in pods:
                 self.snapshot.unstage(p)
                 self.queue.add_if_not_present(p)
+            if rt is not None:
+                rec.end_round(rt, outcome="device_failure",
+                              error=type(e).__name__)
             return 0
         self.breaker.record_success()
         self._rr = rr_end
@@ -800,12 +881,25 @@ class Scheduler:
                 # back through the per-wave path for exact attribution
                 self.snapshot.unstage(pod)
                 retry.append(pod)
+        if rt is not None:
+            rt.mark("commit", placed=placed)
         handled = self._pipeline_preempt(retry) if retry else set()
         for pod in retry:
             if pod.uid not in handled:
                 self.queue.add_if_not_present(pod)
         trace.step("committed")
         self.metrics.e2e_scheduling_latency.observe(self.clock() - start)
+        self.metrics.waves_total.labels(path="device").inc(len(waves))
+        if rt is not None:
+            if retry:
+                rt.mark("preempt", candidates=len(retry),
+                        handled=len(handled))
+            rec.end_round(
+                rt, outcome="ok", placed=placed, retried=len(retry),
+                preempted=len(handled),
+                path=self._last_path or "unresolved",
+                snapshot=self._round_snapshot_shape(),
+                breaker=self.breaker.state)
         trace.log_if_long(0.5)
         return placed
 
@@ -949,6 +1043,12 @@ class Scheduler:
                 validated = process_preemption_with_extenders(
                     pod, validated, self.profile.extenders, pdbs)
             chosen = pick_one_node(validated)
+            rec = tracing.active()
+            if rec is not None:
+                rec.event("preempt_whatif", pod=pod.uid,
+                          device_candidates=int(cand_nodes.size),
+                          validated=len(validated),
+                          chosen=chosen or "")
             if chosen is None:
                 continue
             victims, nviol = validated[chosen]
@@ -975,9 +1075,18 @@ class Scheduler:
         landing while the device path is tripped. Gang pods place
         individually here — all-or-nothing atomicity is suspended in
         degraded mode (the joint-assignment kernel IS the device path)."""
+        rec = tracing.active()
+        rt = None
+        if rec is not None:
+            rt = rec.begin_round("degraded", pending=len(pods))
+            self._trace_queue_waits(rt, pods)
         placed = 0
         for p in pods:
             placed += self._schedule_host_path(p)
+        if rt is not None:
+            rec.end_round(rt, outcome="ok", placed=placed, path="host",
+                          breaker=self.breaker.state,
+                          snapshot=self._round_snapshot_shape())
         return placed
 
     def _device_failure(self, exc: BaseException) -> None:
@@ -1020,6 +1129,11 @@ class Scheduler:
                 return placed_host
         trace = Trace(f"wave of {len(pods)}", clock=self.clock)
         start = self.clock()
+        rec = tracing.active()
+        rt = None
+        if rec is not None:
+            rt = rec.begin_round("wave", pending=len(pods))
+            self._trace_queue_waits(rt, pods)
         pb = self.featurizer.featurize(pods)
         try:
             extra = self._host_plugin_mask(pods, pb.req.shape[0])
@@ -1032,9 +1146,17 @@ class Scheduler:
             self.metrics.scheduling_errors.labels(stage="extender").inc()
             for p in pods:
                 self._park_with_backoff(p)
+            if rt is not None:
+                rec.end_round(rt, outcome="extender_error")
             return placed_host
         trace.step("featurized")
+        if rt is not None:
+            rt.mark("featurize", pods=len(pods))
+            up0 = self.snapshot.upload_bytes_total
         nt, pm, tt = self.snapshot.to_device()
+        if rt is not None:
+            rt.mark("upload", cat="device",
+                    bytes=self.snapshot.upload_bytes_total - up0)
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
@@ -1092,12 +1214,20 @@ class Scheduler:
             # and degrade THIS wave to the exact host path — a device
             # fault must cost a slower wave, never a stopped scheduler
             self._device_failure(e)
+            if rt is not None:
+                rec.end_round(rt, outcome="device_failure",
+                              error=type(e).__name__)
             return placed_host + self._schedule_degraded(pods)
         self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"
         self._rr = res.rr_end
+        if rt is not None:
+            rt.mark("device_wave", cat="device", path=self._last_path)
         chosen = np.asarray(res.chosen)
+        self.metrics.device_fetch_bytes.inc(chosen.nbytes)
         trace.step("device wave")
+        if rt is not None:
+            rt.mark("fetch", cat="device", bytes=int(chosen.nbytes))
         placed = 0
         fail_counts = None
         for i, pod in enumerate(pods):
@@ -1117,6 +1247,23 @@ class Scheduler:
             self._handle_failure(pod, i, fail_counts, res)
         trace.step("committed")
         self.metrics.e2e_scheduling_latency.observe(self.clock() - start)
+        self.metrics.waves_total.labels(path="device").inc()
+        if rt is not None:
+            rt.mark("commit", placed=placed)
+            # scores summary over the wave's placed pods: the round
+            # ledger's (state, placement, outcome) record carries it for
+            # offline scoring-weight analysis
+            sc = np.asarray(res.score)
+            won = sc[chosen >= 0]
+            scores = ({"min": round(float(won.min()), 4),
+                       "max": round(float(won.max()), 4),
+                       "mean": round(float(won.mean()), 4)}
+                      if won.size else None)
+            rec.end_round(
+                rt, outcome="ok", placed=placed,
+                failed=len(pods) - placed, path=self._last_path,
+                scores=scores, snapshot=self._round_snapshot_shape(),
+                breaker=self.breaker.state)
         trace.log_if_long(0.1)
         return placed + placed_host
 
@@ -1125,6 +1272,18 @@ class Scheduler:
         (multi-topology-key required pod affinity). Mirrors the reference's
         single-pod cycle over the golden predicates/priorities."""
         self.metrics.schedule_attempts.inc()
+        self.metrics.waves_total.labels(path="host").inc()
+        rec = tracing.active()
+        if rec is None:
+            return self._host_path_inner(pod)
+        t0 = rec.now()
+        try:
+            return self._host_path_inner(pod)
+        finally:
+            rec.add_span("host_wave", t0, rec.now(), cat="host",
+                         pod=pod.uid)
+
+    def _host_path_inner(self, pod: api.Pod) -> int:
         view = golden.ClusterView(self.cache.node_infos)
         feasible: List[str] = []
         reasons: Dict[str, int] = {}
@@ -1228,14 +1387,29 @@ class Scheduler:
         return placed
 
     def _schedule_one_gang(self, key: str, members: List[api.Pod]) -> int:
+        self.metrics.gang_schedule_attempts.inc()
+        for _p in members:
+            self.metrics.schedule_attempts.inc()
+        rec = tracing.active()
+        rt = None
+        if rec is not None:
+            rt = rec.begin_round("gang", pending=len(members), gang=key)
+            self._trace_queue_waits(rt, members)
+        try:
+            placed = self._schedule_one_gang_inner(key, members, rt)
+        finally:
+            if rt is not None and rt.t1 is None:
+                rec.end_round(rt, snapshot=self._round_snapshot_shape(),
+                              breaker=self.breaker.state)
+        return placed
+
+    def _schedule_one_gang_inner(self, key: str, members: List[api.Pod],
+                                 rt=None) -> int:
         import jax
         import jax.numpy as jnp
 
         from ..ops.gang import schedule_gang
 
-        self.metrics.gang_schedule_attempts.inc()
-        for _p in members:
-            self.metrics.schedule_attempts.inc()
         min_member = self.gangs.min_member(members[0])
         bound = self.gangs.bound_count(self.cache, key,
                                        exclude={p.uid for p in members})
@@ -1264,8 +1438,14 @@ class Scheduler:
             self.metrics.scheduling_errors.labels(stage="extender").inc()
             for p in members:
                 self._park_with_backoff(p)
+            if rt is not None:
+                rt.ledger["outcome"] = "extender_error"
             return placed
+        if rt is not None:
+            rt.mark("featurize", pods=len(members))
         nt, pm, tt = self.snapshot.to_device()
+        if rt is not None:
+            rt.mark("upload", cat="device")
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
         if self._use_pallas is None:
@@ -1309,11 +1489,21 @@ class Scheduler:
             self._device_failure(e)
             for p in members:
                 self._park_with_backoff(p)
+            if rt is not None:
+                rt.ledger.update(outcome="device_failure",
+                                 error=type(e).__name__)
             return placed
         self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"
+        self.metrics.waves_total.labels(path="device").inc()
+        if rt is not None:
+            rt.mark("device_wave", cat="device", path=self._last_path)
         chosen = np.asarray(res.chosen)
+        self.metrics.device_fetch_bytes.inc(chosen.nbytes)
         if not bool(np.asarray(res.ok)):
+            if rt is not None:
+                rt.ledger.update(outcome="gang_unplaceable",
+                                 path=self._last_path)
             self._fail_gang(key, members, need, res)
             return placed
         self._rr = res.rr_end
@@ -1330,8 +1520,14 @@ class Scheduler:
             # retry the whole gang next wave, not unschedulable
             for pod in members:
                 self.queue.add_if_not_present(pod)
+            if rt is not None:
+                rt.ledger["outcome"] = "recheck_race"
             return placed
         self.backoff.clear("gang:" + key)
+        if rt is not None:
+            rt.mark("commit", placed=len(pairs))
+            rt.ledger.update(outcome="ok", placed=len(pairs),
+                             path=self._last_path)
         # surplus members beyond minMember that didn't fit park
         # individually with normal per-pod attribution
         if leftover:
@@ -1348,6 +1544,7 @@ class Scheduler:
         runs so a higher-priority gang can evict its way in."""
         n_nodes = int(np.sum(self.snapshot.valid))
         short = max(need - int(np.asarray(res.placed)), 1)
+        tracing.event("gang_failed", gang=key, need=need, short=short)
         err = FitError(key, n_nodes, {REASONS["Gang"]: short})
         # park FIRST: the preemption below emits store events (nominated-
         # node writes, victim deletes) whose queue.update would re-add a
@@ -1530,6 +1727,18 @@ class Scheduler:
             self.store.bind(pod, node_name)
 
         outcome, truth = self.reconciler.reconcile(pod, node_name, _attempt)
+        rec = tracing.active()
+        if rec is not None:
+            # per-pod async bind span (UID-keyed); retries inside the
+            # reconciler already emitted bind_retry events
+            rec.pod_span(pod.uid, "bind", self.clock() - t0,
+                         node=node_name, outcome=outcome)
+            if outcome != BOUND:
+                # ambiguity resolution is exactly what a pod's trace
+                # must surface: the bind POST's fate was only resolved
+                # against API truth
+                rec.event("bind_resolution", pod=pod.uid, outcome=outcome,
+                          node=node_name)
         if outcome == CONFIRMED:
             # the bind landed server-side and only the response was
             # lost: adopt API truth instead of rolling back. add_pod
@@ -1871,6 +2080,9 @@ class Scheduler:
         while doing no useful work, the exact deadlock gang scheduling
         exists to prevent; its controller recreates the pods and the gang
         re-forms through the waiting area."""
+        tracing.event("preemption", pod=pod.uid, node=pr.node_name,
+                      victims=len(pr.victims),
+                      pdb_violations=pr.num_pdb_violations)
         pod.status.nominated_node_name = pr.node_name
         self.store.set_nominated_node(pod, pr.node_name)
         self.queue.update_nominated_pod(pod, pr.node_name)

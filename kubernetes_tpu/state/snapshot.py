@@ -62,6 +62,21 @@ class Snapshot:
         self.dirty_topology = True
         self.dirty_pods = True
         self._device_cache: Dict[str, object] = {}
+        # device telemetry: cumulative host->HBM upload bytes and the
+        # byte size of each resident group — the scheduler exports these
+        # as snapshot_upload_bytes_total / snapshot_hbm_bytes
+        self.upload_bytes_total = 0
+        self._group_bytes: Dict[str, int] = {}
+
+    def _account_upload(self, group: str, arrays) -> None:
+        nbytes = sum(int(a.nbytes) for a in arrays)
+        self.upload_bytes_total += nbytes
+        self._group_bytes[group] = nbytes
+
+    def hbm_bytes(self) -> int:
+        """Byte footprint of the device-resident mirror (the cached
+        groups' host sizes; device layouts match dtype-for-dtype)."""
+        return sum(self._group_bytes.values())
 
     # ---- allocation / growth ----------------------------------------------
 
@@ -590,33 +605,32 @@ class Snapshot:
                       self.caps.E, self.caps.TE, self.caps.TV, self.caps.TNS)
         if cache.get("shapes") != shapes_key:
             cache.clear()
+            self._group_bytes.clear()
             cache["shapes"] = shapes_key
             self.dirty_resources = self.dirty_topology = self.dirty_pods = True
         if self.dirty_resources or "res" not in cache:
-            cache["res"] = jax.device_put(
-                (self.requested, self.nonzero, self.pod_count, self.ports), device
-            )
+            host = (self.requested, self.nonzero, self.pod_count, self.ports)
+            self._account_upload("res", host)
+            cache["res"] = jax.device_put(host, device)
             self.dirty_resources = False
         if self.dirty_topology or "topo" not in cache:
-            cache["topo"] = jax.device_put(
-                (self.alloc, self.allowed_pods, self.labels, self.label_nums,
-                 self.taint_key, self.taint_val, self.taint_effect, self.cond,
-                 self.zone_id, self.img_id, self.img_size, self.avoid, self.valid),
-                device,
-            )
+            host = (self.alloc, self.allowed_pods, self.labels,
+                    self.label_nums, self.taint_key, self.taint_val,
+                    self.taint_effect, self.cond, self.zone_id, self.img_id,
+                    self.img_size, self.avoid, self.valid)
+            self._account_upload("topo", host)
+            cache["topo"] = jax.device_put(host, device)
             self.dirty_topology = False
         if self.dirty_pods or "pods" not in cache:
-            cache["pods"] = jax.device_put(
-                (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid,
-                 self.ep_alive, self.ep_req, self.ep_prio),
-                device,
-            )
-            cache["terms"] = jax.device_put(
-                (self.t_kind, self.t_owner, self.t_node, self.t_tk,
-                 self.t_weight, self.t_ns, self.t_key, self.t_op, self.t_vals,
-                 self.t_valid),
-                device,
-            )
+            host = (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid,
+                    self.ep_alive, self.ep_req, self.ep_prio)
+            terms = (self.t_kind, self.t_owner, self.t_node, self.t_tk,
+                     self.t_weight, self.t_ns, self.t_key, self.t_op,
+                     self.t_vals, self.t_valid)
+            self._account_upload("pods", host)
+            self._account_upload("terms", terms)
+            cache["pods"] = jax.device_put(host, device)
+            cache["terms"] = jax.device_put(terms, device)
             self.dirty_pods = False
         requested, nonzero, pod_count, ports = cache["res"]
         (alloc, allowed_pods, labels, label_nums, taint_key, taint_val,

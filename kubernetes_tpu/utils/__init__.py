@@ -4,3 +4,4 @@ from .metrics import (Metrics, Histogram, Counter, Gauge,  # noqa: F401
 from .backoff import PodBackoff  # noqa: F401
 from .feature_gates import FeatureGates, DEFAULT_FEATURES  # noqa: F401
 from . import faultpoints  # noqa: F401
+from . import tracing  # noqa: F401

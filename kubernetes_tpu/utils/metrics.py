@@ -150,12 +150,17 @@ class Histogram:
         self.total = 0
         self.max = 0.0
         self._samples: List[float] = []
+        # sorted-reservoir cache: bench reporting calls quantile() per
+        # percentile, and re-sorting up to 64k samples each time was
+        # O(quantiles * n log n); observe() invalidates
+        self._sorted: Optional[List[float]] = None
         # deterministic LCG for reservoir sampling — keeps tests seedless
         self._rng = 0x2545F4914F6CDD1D
         self._lock = threading.Lock()
 
     def observe(self, v: float):
         with self._lock:
+            self._sorted = None
             self.sum += v
             self.total += 1
             if v > self.max:
@@ -181,7 +186,9 @@ class Histogram:
         with self._lock:
             if self.total == 0:
                 return 0.0
-            s = sorted(self._samples)
+            if self._sorted is None:
+                self._sorted = sorted(self._samples)
+            s = self._sorted
             idx = min(int(math.ceil(q * len(s))) - 1, len(s) - 1)
             return s[max(idx, 0)]
 
@@ -222,6 +229,10 @@ class Metrics:
         self.snapshot_scrub_duration = Histogram(
             "snapshot_scrub_duration_seconds")
         self.device_path_trips = Counter("device_path_breaker_trips_total")
+        # live breaker state (0=closed, 1=half-open, 2=open), set on
+        # every transition — the trips counter says degradation HAS
+        # happened; this gauge says whether scheduling is degraded NOW
+        self.breaker_state = Gauge("device_path_breaker_state")
         # control-plane resilience layer: reflector relist cycles (every
         # list+watch re-entry, error-driven or watchdog-forced), streams
         # declared stale by the watchdog, bind POST retry attempts beyond
@@ -253,6 +264,19 @@ class Metrics:
             "cluster_autoscaler_scaled_up_nodes_total")
         self.autoscaler_scale_downs = Counter(
             "cluster_autoscaler_scaled_down_nodes_total")
+        # device telemetry (fed where ops/kernel.py dispatches): jit
+        # program-cache hits/misses per shape bucket, compile seconds on
+        # misses, snapshot HBM footprint + host->device upload bytes,
+        # device->host result-fetch bytes, and device-vs-host wave
+        # attribution (how much scheduling actually ran on device)
+        self.device_jit_events = LabeledCounter(
+            "device_jit_cache_events_total", ("program", "bucket", "event"))
+        self.device_jit_compile_seconds = Histogram(
+            "device_jit_compile_seconds")
+        self.snapshot_hbm_bytes = Gauge("snapshot_hbm_bytes")
+        self.snapshot_upload_bytes = Counter("snapshot_upload_bytes_total")
+        self.device_fetch_bytes = Counter("device_fetch_bytes_total")
+        self.waves_total = LabeledCounter("scheduler_waves_total", ("path",))
 
     def all_series(self):
         out = {}

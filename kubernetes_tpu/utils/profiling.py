@@ -68,6 +68,16 @@ class Profiler:
                 st = self._contention[lock_name] = StepStats()
             st.add(dt)
 
+    def step_totals(self, top: Optional[int] = None) -> Dict[str, float]:
+        """Cumulative seconds per 'phase/step', descending — the
+        structured form of report()'s step table (bench embeds it in
+        the BENCH json as the per-stage breakdown)."""
+        with self._lock:
+            items = sorted(self._steps.items(), key=lambda kv: -kv[1].total)
+        if top is not None:
+            items = items[:top]
+        return {f"{phase}/{step}": st.total for (phase, step), st in items}
+
     def report(self) -> str:
         """pprof debug=1 style text: cumulative step time, descending —
         'where the seconds went'."""

@@ -47,6 +47,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "partition: zone disruption / eviction storm-control "
                    "suite (mass node failure; make chaos)")
+    config.addinivalue_line(
+        "markers", "observability: flight-recorder / metrics-exposition "
+                   "suite (/debug/trace, /metrics, round ledger)")
 
 
 import pytest  # noqa: E402
