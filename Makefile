@@ -22,13 +22,14 @@ test-unit: native
 # Chaos tier: component-crash suite + the fault-injection suite
 # (`faults`/`chaos` markers: scrubber, device-path breaker, fault
 # points, leader failover) + the `partition` zone-disruption suite
-# (eviction storm control under mass node failure).  Unregistered-
-# marker warnings are ERRORS here so fault-point/marker drift is
-# caught at test time.
+# (eviction storm control under mass node failure) + the `hostpath`
+# numpy-twin suite (breaker-open degraded waves, device==host parity).
+# Unregistered-marker warnings are ERRORS here so fault-point/marker
+# drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
-	$(PYTHON) -m pytest tests/ -q -m "faults or chaos or partition" \
+	$(PYTHON) -m pytest tests/ -q -m "faults or chaos or partition or hostpath" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

@@ -30,6 +30,12 @@ measured against the reference's 100 pods/s "healthy" warning level
                 entry runs --wave 16 — the host path's best measured
                 configuration; at the default wave its what-if cascade
                 needs many more scheduling cycles and loses by more.
+  degraded      breaker-open drain: KTPU_FAULTPOINTS raises at every
+                device kernel entry, the circuit breaker trips, and the
+                backlog drains through the vectorized numpy host twin
+                (ops/hostwave.py) — full host waves + batched host
+                preemption, zero device dispatch. Regression-gates the
+                old 240x degraded-path cliff.
   paced         non-saturated latency SLO: pods offered at a fixed rate
                 (--rate, default 200/s) in chunks; reports the per-pod
                 p99 enqueue->bind latency against the reference's 5s
@@ -614,12 +620,75 @@ def run_partition_config(nodes, pods, wave, sever_fraction=0.3):
     return replaced, dt, p99, p99_round, sched.wave_path(), target
 
 
+def run_degraded_config(nodes, pods, wave):
+    """Breaker-open degraded drain (the ISSUE 7 regression gate):
+    KTPU_FAULTPOINTS arms a raise at every device kernel entry — exactly
+    how an operator would chaos-test a live binary — so the circuit
+    breaker trips within its threshold and the whole backlog drains
+    through the vectorized numpy host twin (ops/hostwave.py): full host
+    waves, batched host preemption, no device dispatch. Before the twin
+    this path ran the per-pod golden loop at ~3 orders of magnitude
+    under the device rate; the SUITE entry keeps it from regressing."""
+    import os
+
+    # the env var is the operator surface being exercised (and covers a
+    # not-yet-imported faultpoints module); the explicit activate calls
+    # cover the already-imported case through the public API
+    os.environ["KTPU_FAULTPOINTS"] = (
+        "kernel.round=raise,kernel.wave=raise,kernel.gang=raise")
+    from kubernetes_tpu.utils import faultpoints
+
+    for point in ("kernel.round", "kernel.wave", "kernel.gang"):
+        faultpoints.activate(point, "raise")
+
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+
+    store = ObjectStore()
+    caps = Caps(M=bucket_size(pods + 64), P=wave,
+                LV=bucket_size(nodes + 256, 64))
+    # no warm-up: device attempts die at the fault point before any
+    # compile, and the host twin has nothing to compile
+    sched = Scheduler(store, wave_size=wave, caps=caps)
+    build_cluster(store, nodes)
+    make_pods(store, pods, "density")
+    t0 = time.time()
+    placed = sched.schedule_pending()
+    stalled = 0
+    while placed < pods:
+        time.sleep(0.002)
+        n = sched.schedule_pending()
+        placed += n
+        stalled = stalled + 1 if n == 0 else 0
+        if stalled > 2000:
+            break
+    dt = time.time() - t0
+    from kubernetes_tpu.sched.breaker import OPEN
+
+    state = sched.breaker.state
+    print(f"# degraded: breaker={state} trips={sched.breaker.trips} "
+          f"host_waves={int(sched.metrics.waves_total.value(path='host'))}",
+          file=sys.stderr)
+    if state != OPEN and sched.breaker.trips == 0:
+        print("FATAL: degraded: breaker never tripped — the run measured "
+              "the device path", file=sys.stderr)
+        sys.exit(1)
+    p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
+    p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    return placed, dt, p99, p99_round, sched.wave_path()
+
+
 def run_preempt_config(nodes, pods, wave, device=True):
     """Preemption-heavy drain: every node saturated by low-priority
     hogs, then a high-priority backlog that can only place by evicting
-    them. device=False forces the host per-wave preemption path — the
-    comparison baseline for the batched device what-if
-    (ops/preempt.py)."""
+    them. device=False routes the batched what-if through the
+    vectorized numpy twin (ops/hostwave.py preemption_stats_host)
+    instead of the device kernel — everything else identical, so the
+    pair isolates the preemption backend. (Before ISSUE 7 this flag
+    meant the per-pod host what-if cascade: 0.8 pods/s at 50n/100p,
+    the BENCH_r05 cliff.)"""
     import jax
     import jax.numpy as jnp
 
@@ -742,6 +811,11 @@ SUITE = [
     ("antiaffinity", 500, 2500, "antiaffinity", []),
     ("trickle", 500, 2048, "trickle", []),
     ("preempt", 50, 100, "preempt", []),
+    # breaker-open degraded mode: KTPU_FAULTPOINTS kills every device
+    # kernel entry, the breaker trips, and the backlog drains through
+    # the vectorized numpy host twin — regression-gates the 240x
+    # host-path cliff (`make bench-all`)
+    ("degraded", 500, 2000, "degraded", []),
     # gang coscheduling: 72 gangs cycling sizes 4/8/16 (28 pods/cycle),
     # each placed all-or-nothing through ops/gang.py
     ("gang", 500, 2016, "gang", []),
@@ -766,9 +840,10 @@ DRIVER_SUITE = [
     ("density", 100, 3000, "density", []),
     ("trickle", 500, 2048, "trickle", []),
     ("preempt", 50, 100, "preempt", []),
-    # host baseline at wave=16, its best measured configuration (at the
-    # default wave the host what-if cascade needs many more scheduling
-    # cycles and runs minutes longer while losing by more)
+    # host preemption baseline (ISSUE 7 acceptance gate: >= 50 pods/s):
+    # the batched what-if on the numpy twin instead of the device
+    # kernel. Kept at wave=16 — the r05 host entry's configuration — so
+    # the series stays comparable across rounds
     ("preempt_host", 50, 100, "preempt", ["--host-preempt",
                                           "--wave", "16"]),
     ("gang", 500, 2016, "gang", []),
@@ -846,11 +921,12 @@ def main():
     ap.add_argument("--workload", default=None,
                     choices=["density", "affinity", "spreading",
                              "antiaffinity", "mixed", "gang", "preempt",
-                             "trickle", "paced", "autoscale", "partition"])
+                             "trickle", "paced", "autoscale", "partition",
+                             "degraded"])
     ap.add_argument("--host-preempt", action="store_true",
-                    help="preempt workload: pin the scheduler to the "
-                         "per-wave host path (the comparison baseline; "
-                         "fastest at --wave 16)")
+                    help="preempt workload: run the batched what-if on "
+                         "the vectorized numpy host twin instead of the "
+                         "device kernel (the host baseline)")
     ap.add_argument("--rate", type=float, default=200.0,
                     help="paced workload: offered load in pods/s")
     ap.add_argument("--chunk", type=int, default=None,
@@ -929,6 +1005,9 @@ def main():
         placed, dt, p99, p99_round, path = run_preempt_config(
             args.nodes, args.pods, args.wave,
             device=not args.host_preempt)
+    elif args.workload == "degraded":
+        placed, dt, p99, p99_round, path = run_degraded_config(
+            args.nodes, args.pods, args.wave)
     elif args.workload == "autoscale":
         placed, dt, p99, p99_round, path = run_autoscale_config(
             args.nodes, args.pods, args.wave)

@@ -154,6 +154,23 @@ class ClusterAutoscaler(Controller):
             out["scaled_down"] = self._scale_down(ng)
         return out
 
+    def _simulate_backend(self, has_ipa: bool) -> str:
+        """What-if execution backend: the numpy host twin while the
+        scheduler's device-path breaker is open (a tripped runtime must
+        not be dispatched to — the what-if would fail, log, and skip the
+        resize every pass), the device otherwise. The twin does not
+        carry inter-pod affinity, so has_ipa shadows still attempt the
+        device (matching the pre-twin behavior: failure is caught and
+        the pass skipped)."""
+        from ..sched.breaker import OPEN
+
+        sched = self.scheduler
+        if (not has_ipa and sched is not None
+                and getattr(sched, "breaker", None) is not None
+                and sched.breaker.state == OPEN):
+            return "host"
+        return "device"
+
     # -- scale-up --------------------------------------------------------------
 
     def _eligible_groups(self, ng, now: float) -> List[NodeGroup]:
@@ -204,7 +221,8 @@ class ClusterAutoscaler(Controller):
                 shadow, pb, weights=sched.profile.weights(),
                 num_zones=shadow.caps.Z,
                 num_label_values=shadow.num_label_values,
-                has_ipa=has_ipa)
+                has_ipa=has_ipa,
+                backend=self._simulate_backend(has_ipa))
         except Exception as e:
             if self.metrics is not None:
                 self.metrics.scheduling_errors.labels(
@@ -387,7 +405,8 @@ class ClusterAutoscaler(Controller):
                     weights=sched.profile.weights(),
                     num_zones=shadow.caps.Z,
                     num_label_values=shadow.num_label_values,
-                    has_ipa=has_ipa)
+                    has_ipa=has_ipa,
+                    backend=self._simulate_backend(has_ipa))
             except Exception as e:
                 if self.metrics is not None:
                     self.metrics.scheduling_errors.labels(

@@ -1,0 +1,618 @@
+"""Vectorized numpy host twin of the batched device kernels.
+
+When the device path is unavailable — breaker open (sched/breaker.py),
+device preemption disabled, or an autoscaler what-if while the runtime
+is tripped — the scheduler used to fall back to the per-pod golden loop
+(plugins/golden.py): exact, but three orders of magnitude slower
+(BENCH_r05: 194.8 pods/s device vs 0.8 pods/s host preemption). The
+paper's thesis is that Filter+Score is ONE batched (pods x nodes)
+mask+score computation; that property survives losing the accelerator.
+This module re-states the device kernels as dense numpy ops over the
+SAME Snapshot feature planes (state/snapshot.py host_tensors — no
+upload, no clone-per-node), with the same mask stack, score formulas,
+f32 arithmetic, and commit-scan semantics, so device==host is testable
+bit-for-bit (tests/test_hostwave.py) and degraded mode is merely
+slower, not stopped.
+
+Twinned programs:
+
+  schedule_wave_host       ops/kernel.py _wave_body (filters + scores +
+                           sequential greedy commit with usage carry)
+  schedule_gang_host       ops/gang.py all-or-nothing count feasibility
+  preemption_stats_host    ops/preempt.py batched what-if stat planes
+
+Deliberately NOT twinned: the inter-pod affinity plane (ops/affinity.py)
+— pods carrying (anti)affinity terms, and every pod while any existing
+pod holds a required term (symmetry), take the exact golden path, the
+same way multi-topology-key pods always have (needs_host_path). The
+golden oracle remains the semantic ground truth for both backends.
+
+dtype discipline: every float op stays in float32 in the device order of
+operations, so results match XLA's f32 elementwise arithmetic exactly.
+Segment sums accumulate in f64 (np.bincount) and round once to f32 —
+identical for the integer-valued counts/priorities these planes carry.
+The one knowingly-unmatched reduction is image_locality's f32 size sum
+(XLA reduce order is unspecified); it is weight-0 in the default
+profile and scores, not masks, so a placement can differ only on an
+exact score tie under a non-default profile.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from . import encoding as enc
+from .kernel import Weights, WaveResult
+
+F = np.float32
+MAX_PRIORITY = F(10.0)
+EPS = F(1e-5)
+NEG = np.int32(-(2 ** 31) + 1)
+INT32_MIN = np.int32(np.iinfo(np.int32).min)
+
+
+def floor_div(x):
+    """ops/scores.py floor_div: Go integer-division emulation, f32."""
+    return np.floor(x + EPS)
+
+
+# -- selector programs (ops/selectors.py twin) --------------------------------
+
+
+def eval_expr_batch(labels, label_nums, key, op, vals, num, entity_ids):
+    """Numpy twin of selectors.eval_expr_batch; same shapes/semantics.
+    Unlike the device formulation (where dead lanes are free), each
+    operand plane is computed only when some program in the batch uses
+    its op — pad-heavy batches skip the [B, X, V] broadcasts."""
+    K = labels.shape[1]
+    safe_key = np.clip(key, 0, K - 1)
+    row_vals = labels[:, safe_key].T  # [B, X]
+    has_key = row_vals != 0
+    opc = op[:, None]
+    zeros = np.zeros_like(has_key)
+    if np.any((op == enc.OP_IN) | (op == enc.OP_NOT_IN)):
+        in_set = np.any(row_vals[:, :, None] == vals[:, None, :], axis=-1)
+    else:
+        in_set = zeros
+    if np.any(op == enc.OP_NODE_NAME_IN):
+        name_in = np.any(entity_ids[None, :, None] == vals[:, None, :],
+                         axis=-1)
+    else:
+        name_in = zeros
+    if label_nums is not None and np.any((op == enc.OP_GT)
+                                         | (op == enc.OP_LT)):
+        row_nums = label_nums[:, safe_key].T
+        with np.errstate(invalid="ignore"):
+            gt = has_key & (row_nums > num[:, None])  # NaN -> False
+            lt = has_key & (row_nums < num[:, None])
+    else:
+        gt = lt = zeros
+    return np.select(
+        [
+            opc == enc.OP_IN,
+            opc == enc.OP_NOT_IN,
+            opc == enc.OP_EXISTS,
+            opc == enc.OP_DOES_NOT_EXIST,
+            opc == enc.OP_GT,
+            opc == enc.OP_LT,
+            opc == enc.OP_NODE_NAME_IN,
+            opc == enc.OP_FALSE,
+        ],
+        [
+            has_key & in_set,
+            ~(has_key & in_set),
+            has_key,
+            ~has_key,
+            gt,
+            lt,
+            name_in,
+            zeros,
+        ],
+        default=np.ones_like(has_key),  # OP_PAD
+    )
+
+
+def eval_and_program(labels, label_nums, key, op, vals, num, entity_ids):
+    """Numpy twin of selectors.eval_and_program (AND over last axis).
+    Expression slots that are OP_PAD across the whole batch evaluate to
+    all-True by definition and are skipped — programs are typically 1-2
+    expressions wide in an 8-slot cap."""
+    lead = key.shape[:-1]
+    E = key.shape[-1]
+    B = 1
+    for s in lead:
+        B *= s
+    k2 = key.reshape(B, E)
+    o2 = op.reshape(B, E)
+    v2 = vals.reshape(B, E, vals.shape[-1])
+    n2 = num.reshape(B, E)
+    X = labels.shape[0]
+    out = np.ones((B, X), bool)
+    for e in range(E):
+        if np.all(o2[:, e] == enc.OP_PAD):
+            continue
+        out &= eval_expr_batch(labels, label_nums, k2[:, e], o2[:, e],
+                               v2[:, e], n2[:, e], entity_ids)
+    return out.reshape(*lead, X)
+
+
+# -- filter predicates (ops/filters.py twin) ----------------------------------
+
+
+def check_node_condition(nt):
+    c = nt.cond
+    return ~(c[:, enc.COND_NOT_READY] | c[:, enc.COND_OUT_OF_DISK]
+             | c[:, enc.COND_NET_UNAVAIL])
+
+
+def check_node_unschedulable(nt):
+    return ~nt.cond[:, enc.COND_UNSCHEDULABLE]
+
+
+def host_name(nt, pb):
+    N = nt.valid.shape[0]
+    idx = np.arange(N, dtype=np.int32)
+    return (pb.host_idx[:, None] == -1) | (idx[None, :] == pb.host_idx[:, None])
+
+
+def host_ports(nt, pb):
+    P, PQ = pb.ports.shape
+    N = nt.ports.shape[0]
+    conflict = np.zeros((P, N), bool)
+    for q in range(PQ):
+        pq = pb.ports[:, q]
+        hit = np.any(pq[:, None, None] == nt.ports[None, :, :], axis=-1)
+        conflict |= (pq > 0)[:, None] & hit
+    return ~conflict
+
+
+def match_node_selector(nt, pb):
+    N = nt.labels.shape[0]
+    node_ids = np.arange(N, dtype=np.int32)
+    ok = np.ones((pb.ns_key.shape[0], N), bool)
+    K = nt.labels.shape[1]
+    for s in range(pb.ns_key.shape[1]):
+        key = pb.ns_key[:, s]
+        val = pb.ns_val[:, s]
+        safe = np.clip(key, 0, K - 1)
+        node_val = nt.labels[:, safe].T  # [P, N]
+        pair_ok = node_val == val[:, None]
+        ok &= np.where((key == 0)[:, None], True,
+                       np.where((key < 0)[:, None], False, pair_ok))
+    term_match = eval_and_program(nt.labels, nt.label_nums, pb.at_key,
+                                  pb.at_op, pb.at_vals, pb.at_num,
+                                  node_ids)  # [P, AT, N]
+    any_term = np.any(term_match & pb.at_valid[:, :, None], axis=1)
+    aff_ok = np.where(pb.has_aff[:, None], any_term, True)
+    return ok & aff_ok
+
+
+def _tolerated(nt, pb, t: int):
+    tk = nt.taint_key[:, t]
+    tv = nt.taint_val[:, t]
+    te = nt.taint_effect[:, t]
+    key_ok = (pb.tol_key == 0)[:, :, None] | (
+        pb.tol_key[:, :, None] == tk[None, None, :])
+    val_ok = (pb.tol_op == enc.TOL_EXISTS)[:, :, None] | (
+        pb.tol_val[:, :, None] == tv[None, None, :])
+    eff_ok = (pb.tol_effect == 0)[:, :, None] | (
+        pb.tol_effect[:, :, None] == te[None, None, :])
+    live = (pb.tol_op != enc.TOL_PAD)[:, :, None]
+    return np.any(live & key_ok & val_ok & eff_ok, axis=1)
+
+
+def tolerates_taints(nt, pb, effects):
+    P = pb.req.shape[0]
+    N = nt.taint_key.shape[0]
+    untol = np.zeros((P, N), bool)
+    T = nt.taint_key.shape[1]
+    for t in range(T):
+        te = nt.taint_effect[:, t]
+        relevant = np.zeros((N,), bool)
+        for e in effects:
+            relevant |= te == e
+        untol |= relevant[None, :] & ~_tolerated(nt, pb, t)
+    return ~untol
+
+
+def pressure_checks(nt, pb):
+    mem = ~(pb.best_effort[:, None] & nt.cond[None, :, enc.COND_MEM_PRESSURE])
+    disk = ~nt.cond[:, enc.COND_DISK_PRESSURE]
+    pid = ~nt.cond[:, enc.COND_PID_PRESSURE]
+    return mem, disk, pid
+
+
+def resource_fit(alloc, allowed_pods, requested, pod_count, req, is_core):
+    """ops/filters.py resource_fit, numpy. req: f32 [..., R]."""
+    reqb = req[..., None, :]
+    fits_col = requested[None, :, :] + reqb <= alloc[None, :, :]
+    check = is_core[None, :] | (reqb > 0)
+    dims_ok = np.all(fits_col | ~check, axis=-1)
+    empty = np.all(req == 0, axis=-1)[..., None]
+    pods_ok = pod_count + 1 <= allowed_pods
+    return (dims_ok | empty) & pods_ok[None, :]
+
+
+def static_predicate_masks(nt, pb, is_core):
+    """[Q, P, N] stack in enc.DEVICE_PREDICATES order (pure-XLA
+    formulation of ops/filters.py static_predicate_masks)."""
+    P = pb.req.shape[0]
+    N = nt.valid.shape[0]
+    ones = np.ones((P, N), bool)
+    cond = check_node_condition(nt)[None, :] & ones
+    unsched = check_node_unschedulable(nt)[None, :] & ones
+    res = resource_fit(nt.alloc, nt.allowed_pods, nt.requested, nt.pod_count,
+                       pb.req, is_core)
+    host = host_name(nt, pb)
+    sel = match_node_selector(nt, pb)
+    ports = host_ports(nt, pb)
+    taints = tolerates_taints(
+        nt, pb, (enc.EFFECT_NO_SCHEDULE, enc.EFFECT_NO_EXECUTE))
+    mem, disk, pid = pressure_checks(nt, pb)
+    disk = disk[None, :] & ones
+    pid = pid[None, :] & ones
+    return np.stack([cond, unsched, res, host, ports, sel, taints, mem,
+                     disk, pid])
+
+
+# -- score kernels (ops/scores.py twin) ---------------------------------------
+
+
+def least_requested(nz, alloc2, pod_nz):
+    r = nz + pod_nz[None, :]
+    per = floor_div((alloc2 - r) * MAX_PRIORITY / np.maximum(alloc2, F(1.0)))
+    per = np.where((alloc2 == 0) | (r > alloc2), F(0.0), per)
+    return floor_div((per[:, 0] + per[:, 1]) / F(2.0))
+
+
+def most_requested(nz, alloc2, pod_nz):
+    r = nz + pod_nz[None, :]
+    per = floor_div(r * MAX_PRIORITY / np.maximum(alloc2, F(1.0)))
+    per = np.where((alloc2 == 0) | (r > alloc2), F(0.0), per)
+    return floor_div((per[:, 0] + per[:, 1]) / F(2.0))
+
+
+def balanced_allocation(nz, alloc2, pod_nz):
+    r = nz + pod_nz[None, :]
+    frac = np.where(alloc2 == 0, F(1.0), r / np.maximum(alloc2, F(1.0)))
+    diff = np.abs(frac[:, 0] - frac[:, 1])
+    score = floor_div((F(1.0) - diff) * MAX_PRIORITY)
+    return np.where(np.any(frac >= 1.0, axis=1), F(0.0), score)
+
+
+def node_affinity_raw(nt, pb):
+    N = nt.labels.shape[0]
+    if not np.any(pb.pt_weight):
+        return np.zeros((pb.req.shape[0], N), np.float32)
+    node_ids = np.arange(N, dtype=np.int32)
+    term_match = eval_and_program(nt.labels, nt.label_nums, pb.pt_key,
+                                  pb.pt_op, pb.pt_vals, pb.pt_num, node_ids)
+    w = pb.pt_weight[:, :, None]
+    return np.sum(np.where(term_match, w, F(0.0)), axis=1,
+                  dtype=np.float64).astype(np.float32)
+
+
+def taint_intolerable_raw(nt, pb):
+    P = pb.req.shape[0]
+    N = nt.taint_key.shape[0]
+    eligible = (pb.tol_effect == 0) | (pb.tol_effect == enc.EFFECT_PREFER_NO_SCHEDULE)
+    eligible &= pb.tol_op != enc.TOL_PAD
+    count = np.zeros((P, N), np.float32)
+    for t in range(nt.taint_key.shape[1]):
+        tk = nt.taint_key[:, t]
+        tv = nt.taint_val[:, t]
+        te = nt.taint_effect[:, t]
+        relevant = te == enc.EFFECT_PREFER_NO_SCHEDULE
+        key_ok = (pb.tol_key == 0)[:, :, None] | (
+            pb.tol_key[:, :, None] == tk[None, None, :])
+        val_ok = (pb.tol_op == enc.TOL_EXISTS)[:, :, None] | (
+            pb.tol_val[:, :, None] == tv[None, None, :])
+        eff_ok = (pb.tol_effect == 0)[:, :, None] | (
+            pb.tol_effect[:, :, None] == te[None, None, :])
+        tol = np.any((eligible[:, :, None]) & key_ok & val_ok & eff_ok, axis=1)
+        count += (relevant[None, :] & ~tol).astype(np.float32)
+    return count
+
+
+def spread_counts(pm, pb, num_nodes: int):
+    if not np.any(pb.sg_valid):
+        # no spreading selectors anywhere in the batch: counts are all
+        # zero by the has_sel gate below — skip the [P, SG, M] evals
+        return np.zeros((pb.req.shape[0], num_nodes), np.int32)
+    M = pm.labels.shape[0]
+    ep_ids = np.arange(M, dtype=np.int32)
+    m = eval_and_program(pm.labels, None, pb.sg_key, pb.sg_op, pb.sg_vals,
+                         pb.sg_num, ep_ids)  # [P, SG, M]
+    any_sel = np.any(m & pb.sg_valid[:, :, None], axis=1)
+    has_sel = np.any(pb.sg_valid, axis=1)
+    eligible = pm.valid & pm.alive
+    same_ns = pm.ns[None, :] == pb.ns_id[:, None]
+    matched = any_sel & eligible[None, :] & same_ns & has_sel[:, None]
+    node = np.clip(pm.node, 0, None)
+    out = np.zeros((matched.shape[0], num_nodes), np.int32)
+    for p in range(matched.shape[0]):
+        out[p] = np.bincount(node, weights=matched[p],
+                             minlength=num_nodes)[:num_nodes].astype(np.int32)
+    return out
+
+
+def spread_reduce(cnt, feasible, zone_id, num_zones: int):
+    cntf = np.where(feasible, cnt, 0).astype(np.float32)
+    max_node = np.max(cntf)
+    zc = np.bincount(zone_id, weights=np.where(zone_id > 0, cntf, 0.0),
+                     minlength=num_zones)[:num_zones].astype(np.float32)
+    zc0 = zc.copy()
+    zc0[0] = 0.0
+    max_zone = np.max(zc0)
+    have_zones = np.any(feasible & (zone_id > 0))
+    f = np.where(max_node > 0,
+                 MAX_PRIORITY * (max_node - cntf) / np.maximum(max_node, F(1.0)),
+                 MAX_PRIORITY)
+    node_zc = zc[zone_id]
+    zscore = np.where(max_zone > 0,
+                      MAX_PRIORITY * (max_zone - node_zc) / np.maximum(max_zone, F(1.0)),
+                      MAX_PRIORITY)
+    f = np.where(have_zones & (zone_id > 0),
+                 f / F(3.0) + F(2.0 / 3.0) * zscore, f)
+    return floor_div(f)
+
+
+def image_locality(nt, pb):
+    P, PI = pb.img_id.shape
+    total = np.zeros((P, nt.img_id.shape[0]), np.float32)
+    for i in range(PI):
+        pid = pb.img_id[:, i]
+        hit = pid[:, None, None] == nt.img_id[None, :, :]
+        sz = np.sum(np.where(hit, nt.img_size[None, :, :], F(0.0)), axis=-1)
+        total += np.where((pid > 0)[:, None], sz, F(0.0))
+    mb = F(1024.0 * 1024.0)
+    min_img, max_img = F(23.0) * mb, F(1000.0) * mb
+    mid = floor_div(MAX_PRIORITY * (total - min_img) / (max_img - min_img)) + F(1.0)
+    return np.where(total < min_img, F(0.0),
+                    np.where(total >= max_img, MAX_PRIORITY, mid))
+
+
+def prefer_avoid(nt, pb):
+    avoid = nt.avoid[None, :] & pb.owned[:, None]
+    return np.where(avoid, F(0.0), MAX_PRIORITY)
+
+
+def normalize_reduce(raw, feasible, reverse: bool):
+    m = np.max(np.where(feasible, raw, F(0.0)))
+    score = floor_div(MAX_PRIORITY * raw / np.maximum(m, F(1.0)))
+    if reverse:
+        score = MAX_PRIORITY - score
+        return np.where(m > 0, score, MAX_PRIORITY)
+    return np.where(m > 0, score, F(0.0))
+
+
+# -- the wave (ops/kernel.py _wave_body twin) ---------------------------------
+
+
+def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
+                       extra_scores=None, *, weights: Weights,
+                       num_zones: int, num_label_values: int = 64,
+                       has_ipa: bool = False,
+                       usage_in=None) -> WaveResult:
+    """One batched host wave: masks + scores over (P x N), then the
+    sequential greedy commit with usage carry — the numpy statement of
+    _wave_body's lax.scan. Inter-pod affinity is NOT twinned: callers
+    route affinity-bearing waves to the golden path (see module doc).
+
+    usage_in: optional (requested, nonzero, pod_count) override (the
+    gang wrapper and chained degraded waves carry usage the same way
+    the device-resident round does). The input planes are never
+    mutated — carries are copies.
+    """
+    if has_ipa:
+        raise NotImplementedError(
+            "inter-pod affinity is not twinned; route through golden")
+    N = nt.valid.shape[0]
+    P = pb.req.shape[0]
+    R = nt.alloc.shape[1]
+    is_core = np.arange(R) < enc.RES_FIXED
+    masks = static_predicate_masks(nt, pb, is_core)  # [Q-2, P, N]
+    ipa_placeholder = np.ones((1, P, N), bool)
+    masks = np.concatenate([masks, ipa_placeholder,
+                            np.asarray(extra_mask, bool)[None]], axis=0)
+    res_i = enc.PRED_IDX["PodFitsResources"]
+    m2 = masks.copy()
+    m2[res_i] = True
+    static_nonres = np.all(m2, axis=0)  # [P, N]
+    alloc2 = nt.alloc[:, :2]
+
+    w = weights
+    aff_raw = node_affinity_raw(nt, pb) if w.node_affinity else np.zeros(
+        (P, N), np.float32)
+    taint_raw = taint_intolerable_raw(nt, pb) if w.taint_toleration else \
+        np.zeros((P, N), np.float32)
+    spread_cnt = (spread_counts(pm, pb, N) if w.selector_spread
+                  else np.zeros((P, N), np.int32))
+    static_score = np.zeros((P, N), np.float32)
+    if w.image_locality:
+        static_score += F(w.image_locality) * image_locality(nt, pb)
+    if w.prefer_avoid:
+        static_score += F(w.prefer_avoid) * prefer_avoid(nt, pb)
+    if extra_scores is not None:
+        static_score += np.asarray(extra_scores, np.float32)
+
+    usage0 = usage_in if usage_in is not None else (
+        nt.requested, nt.nonzero, nt.pod_count)
+    req_c = np.array(usage0[0], np.float32, copy=True)
+    nz_c = np.array(usage0[1], np.float32, copy=True)
+    cnt_c = np.array(usage0[2], np.int32, copy=True)
+    rr = int(rr_start)
+
+    chosen = np.full((P,), -1, np.int32)
+    best_s = np.full((P,), -1.0, np.float32)
+    feas_cnt = np.zeros((P,), np.int32)
+    dyn_fits = np.zeros((P, N), bool)
+
+    for i in range(P):
+        fits = resource_fit(nt.alloc, nt.allowed_pods, req_c, cnt_c,
+                            pb.req[i][None, :], is_core)[0]
+        dyn_fits[i] = fits
+        feasible = static_nonres[i] & fits & nt.valid & bool(pb.valid[i])
+        total = static_score[i]
+        if w.node_affinity:
+            total = total + F(w.node_affinity) * normalize_reduce(
+                aff_raw[i], feasible, False)
+        if w.taint_toleration:
+            total = total + F(w.taint_toleration) * normalize_reduce(
+                taint_raw[i], feasible, True)
+        if w.selector_spread:
+            total = total + F(w.selector_spread) * spread_reduce(
+                spread_cnt[i], feasible, nt.zone_id, num_zones)
+        if w.least_requested:
+            total = total + F(w.least_requested) * least_requested(
+                nz_c, alloc2, pb.nonzero[i])
+        if w.balanced:
+            total = total + F(w.balanced) * balanced_allocation(
+                nz_c, alloc2, pb.nonzero[i])
+        if w.most_requested:
+            total = total + F(w.most_requested) * most_requested(
+                nz_c, alloc2, pb.nonzero[i])
+        sm = np.where(feasible, total, F(-1.0))
+        best = np.max(sm) if N else F(-1.0)
+        best_s[i] = best
+        feas_cnt[i] = int(np.sum(feasible))
+        if best >= 0:
+            ties = feasible & (sm == best)
+            k = max(int(np.sum(ties)), 1)
+            rank = np.cumsum(ties.astype(np.int32)) - 1
+            c = int(np.argmax(ties & (rank == rr % k)))
+            chosen[i] = c
+            req_c[c] += pb.req[i]
+            nz_c[c] += pb.nonzero[i]
+            cnt_c[c] += 1
+            rr += 1
+
+    masks[res_i] = dyn_fits
+    prefix_ok = np.cumprod(masks.astype(np.int8), axis=0).astype(bool)
+    first = np.concatenate(
+        [np.ones((1,) + masks.shape[1:], bool), prefix_ok[:-1]], axis=0)
+    first_fail = ~masks & first & nt.valid[None, None, :]
+    fail_counts = np.sum(first_fail.astype(np.int32), axis=-1)
+    res = WaveResult(chosen=chosen, score=best_s, feasible_count=feas_cnt,
+                     fail_counts=fail_counts, masks=masks,
+                     rr_end=np.int32(rr))
+    return res, (req_c, nz_c, cnt_c)
+
+
+def schedule_gang_host(nt, pm, tt, pb, extra_mask, rr_start: int,
+                       extra_scores, need: int, *, weights: Weights,
+                       num_zones: int, num_label_values: int = 64,
+                       has_ipa: bool = False):
+    """All-or-nothing count feasibility: the ops/gang.py wrapper over the
+    host wave. Unless the greedy commit placed >= `need` members, every
+    placement is discarded and the round-robin counter rewinds — the
+    same no-partial-gang guarantee the device program gives, restored to
+    degraded mode."""
+    from .gang import GangResult
+
+    res, _usage = schedule_wave_host(
+        nt, pm, tt, pb, extra_mask, rr_start, extra_scores,
+        weights=weights, num_zones=num_zones,
+        num_label_values=num_label_values, has_ipa=has_ipa)
+    placed = int(np.sum(res.chosen >= 0))
+    ok = placed >= int(need)
+    chosen = res.chosen if ok else np.full_like(res.chosen, -1)
+    rr_end = res.rr_end if ok else np.int32(rr_start)
+    return GangResult(ok=np.bool_(ok), chosen=chosen,
+                      placed=np.int32(placed), fail_counts=res.fail_counts,
+                      masks=res.masks, rr_end=rr_end)
+
+
+# -- preemption what-if (ops/preempt.py twin) ---------------------------------
+
+
+def victim_levels(ep_prio, live, num_levels: int) -> Optional[List[int]]:
+    """Candidate priority thresholds from the live existing-pod rows —
+    the exact level list Scheduler._preempt_chunk builds for the device
+    program (distinct priorities + 1, highest always kept, padded)."""
+    prios = sorted({int(x) + 1 for x in np.asarray(ep_prio)[np.asarray(live)]})
+    if len(prios) > num_levels:
+        prios = prios[:num_levels - 1] + [prios[-1]]
+    if not prios:
+        return None
+    return prios + [prios[-1]] * (num_levels - len(prios))
+
+
+def preemption_stats_host(nt, pm, pb, levels, *, num_levels: int,
+                          gang_w=None) -> np.ndarray:
+    """Numpy twin of ops/preempt.py preemption_stats: one packed i32
+    [5, P, N] plane stack (ok, victim count, priority max, f32 priority
+    sum bitcast, f32 gang-disruption sum bitcast) — byte-compatible with
+    the device output, so ops.preempt.PreemptStats wraps either.
+
+    Classes are deduplicated by threshold value: pods stamped from one
+    controller share a priority, so each level computes its segment sums
+    once, not per pod."""
+    levels = np.asarray(levels, np.int32)
+    P = pb.req.shape[0]
+    N = nt.valid.shape[0]
+    R = nt.alloc.shape[1]
+    is_core = np.arange(R) < enc.RES_FIXED
+
+    masks = static_predicate_masks(nt, pb, is_core)
+    masks[enc.PRED_IDX["PodFitsResources"]] = True
+    masks[enc.PRED_IDX["PodFitsHostPorts"]] = True
+    static_ok = np.all(masks, axis=0)
+    static_ok = static_ok & nt.valid[None, :] & pb.valid[:, None]
+
+    live = pm.valid & pm.alive
+    node_ids = np.clip(pm.node, 0, None)
+    prio_f = pm.prio.astype(np.float64)
+
+    ok = np.zeros((P, N), bool)
+    victims = np.zeros((P, N), np.int32)
+    prio_sum = np.zeros((P, N), np.float32)
+    prio_max = np.full((P, N), NEG, np.int32)
+    gang_viol = np.zeros((P, N), np.float32)
+
+    def seg(weights):
+        return np.bincount(node_ids, weights=weights, minlength=N)[:N]
+
+    for l in range(num_levels):
+        thresh = np.minimum(levels[l], pb.prio)  # [P]
+        for t in np.unique(thresh):
+            sel = np.flatnonzero(thresh == t)
+            w_row = (live & (pm.prio < t)).astype(np.float64)
+            rem_cnt = seg(w_row)
+            rem_req = np.stack(
+                [seg(w_row * pm.req[:, r]) for r in range(R)],
+                axis=1).astype(np.float32)  # [N, R]
+            rem_psum = seg(w_row * prio_f).astype(np.float32)
+            rem_pmax = np.full((N,), INT32_MIN, np.int32)
+            np.maximum.at(rem_pmax, node_ids,
+                          np.where(w_row > 0, pm.prio, NEG).astype(np.int32))
+            if gang_w is not None:
+                rem_gang = seg(w_row * np.asarray(gang_w,
+                                                  np.float64)).astype(np.float32)
+            else:
+                rem_gang = np.zeros((N,), np.float32)
+            used = (nt.requested - rem_req)[None, :, :] + pb.req[sel][:, None, :]
+            col_ok = used <= nt.alloc[None]  # [S, N, R]
+            check = is_core[None, None, :] | (pb.req[sel][:, None, :] > 0)
+            fits = np.all(col_ok | ~check, axis=-1)
+            fits &= (nt.pod_count[None] - rem_cnt.astype(np.int32)[None] + 1
+                     <= nt.allowed_pods[None])
+            feasible = fits & static_ok[sel]
+            sub_ok = ok[sel]
+            take = feasible & ~sub_ok
+            ok[sel] = sub_ok | feasible
+            victims[sel] = np.where(take, rem_cnt.astype(np.int32)[None],
+                                    victims[sel])
+            prio_sum[sel] = np.where(take, rem_psum[None], prio_sum[sel])
+            prio_max[sel] = np.where(take, rem_pmax[None], prio_max[sel])
+            gang_viol[sel] = np.where(take, rem_gang[None], gang_viol[sel])
+
+    return np.stack([
+        ok.astype(np.int32),
+        victims,
+        prio_max,
+        np.ascontiguousarray(prio_sum).view(np.int32),
+        np.ascontiguousarray(gang_viol).view(np.int32),
+    ])

@@ -10,15 +10,23 @@ criteria (:702 pickOneNodeForPreemption):
   fewer PDB violations > lower max victim priority > lower priority sum
   > fewer victims > first.
 
-What-if simulation here runs host-side per candidate node (the candidate
-set is small: failed-but-resolvable nodes); the resource arithmetic
-reuses the exact int64 NodeInfo. Device-assisted batched simulation is a
-later-round optimization.
+The exact clone/reprieve loop runs only on a PRUNED, RANKED candidate
+set: when the caller passes the live snapshot + featurizer, one
+vectorized (1 x nodes) feasibility-after-victim-removal pass over the
+dense host planes (ops/hostwave.py preemption_stats_host — the numpy
+twin of the device what-if) drops every node that cannot fit the pod
+even with ALL lower-priority pods removed, ranks the survivors by the
+device path's tie-break approximation, and caps exact validation at
+PRUNE_HOST_CANDIDATES — the same top-K discipline the pipeline's device
+path applies (Scheduler._preempt_chunk). Without the snapshot the old
+validate-every-resolvable-node behavior is preserved.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..api import labels as lbl
 from ..api import types as api
@@ -27,6 +35,12 @@ from ..state.node_info import NodeInfo
 from ..plugins import golden
 from ..utils import tracing
 from .errors import UNRESOLVABLE
+
+# exact select_victims_on_node validations per preempt() call when the
+# vectorized prune ranked the candidates — mirrors the device pipeline's
+# PREEMPT_HOST_CANDIDATES (sched/scheduler.py)
+PRUNE_HOST_CANDIDATES = 8
+PRUNE_LEVELS = 8
 
 
 class PreemptionResult:
@@ -222,21 +236,77 @@ def process_preemption_with_extenders(
     return candidates
 
 
+def vector_candidate_order(pod: api.Pod, snapshot,
+                           featurizer) -> Optional[List[str]]:
+    """One vectorized (1 x nodes) feasibility-after-victim-removal pass
+    over the snapshot's host planes: the numpy twin of the device
+    what-if (ops/hostwave.py preemption_stats_host), computed for just
+    this pod. Returns candidate node names RANKED by the device path's
+    tie-break approximation (gang disruption, max victim priority,
+    priority sum, victim count), or None when the pod can't be encoded
+    (the caller then validates every resolvable node, as before)."""
+    from ..ops import hostwave
+    from ..ops.preempt import PreemptStats
+
+    aff = pod.spec.affinity
+    if (featurizer.needs_host_path(pod)
+            or snapshot.has_affinity_terms
+            or (aff is not None and (aff.pod_affinity is not None
+                                     or aff.pod_anti_affinity is not None))):
+        # the twin carries no inter-pod affinity plane: an affinity-
+        # blind top-K cut could drop the only affinity-feasible node
+        # before exact validation — such pods keep the full
+        # validate-every-resolvable-node loop
+        return None
+    live = snapshot.ep_valid & snapshot.ep_alive
+    levels = hostwave.victim_levels(snapshot.ep_prio, live, PRUNE_LEVELS)
+    if levels is None:
+        return []  # nothing evictable anywhere
+    pb = featurizer.featurize([pod])
+    # re-grab the planes AFTER featurize: interning may have grown caps,
+    # replacing the snapshot's arrays
+    nt, pm, tt = snapshot.host_tensors()
+    st = PreemptStats(hostwave.preemption_stats_host(
+        nt, pm, pb, np.asarray(levels, np.int32), num_levels=PRUNE_LEVELS))
+    cand = np.nonzero(st.ok[0])[0]
+    order = sorted(
+        cand.tolist(),
+        key=lambda n: (float(st.gang_viol[0, n]), float(st.prio_max[0, n]),
+                       float(st.prio_sum[0, n]), float(st.victims[0, n])))
+    return [snapshot.node_names[n] for n in order]
+
+
 def preempt(pod: api.Pod, cache: SchedulerCache,
             failed_predicates: Dict[str, List[str]],
             pdbs: Sequence[api.PodDisruptionBudget],
             with_affinity: bool = False,
             extenders=(), extra_fit=None,
-            gang_guard: Optional[GangGuard] = None
+            gang_guard: Optional[GangGuard] = None,
+            snapshot=None, featurizer=None
             ) -> Optional[PreemptionResult]:
     """Reference :200 Preempt. Returns None when preemption can't help.
     with_affinity: evaluate MatchInterPodAffinity in the what-if (pass
-    when any affinity terms exist in the cluster)."""
+    when any affinity terms exist in the cluster). snapshot+featurizer
+    enable the vectorized candidate prune (see module doc): the exact
+    clone/reprieve loop then runs only on the top
+    PRUNE_HOST_CANDIDATES ranked survivors instead of every resolvable
+    node — same semantics approximation as the device pipeline, which
+    also validates only its top-K device-ranked candidates."""
     if not pod_eligible_to_preempt_others(pod, cache):
         return None
     node_infos = cache.node_infos if with_affinity else None
+    helpful = nodes_where_preemption_might_help(failed_predicates)
+    node_order: List[str] = helpful
+    pruned = -1
+    if snapshot is not None and featurizer is not None:
+        order = vector_candidate_order(pod, snapshot, featurizer)
+        if order is not None:
+            hs = set(helpful)
+            ranked = [n for n in order if n in hs]
+            pruned = len(helpful) - len(ranked)
+            node_order = ranked[:PRUNE_HOST_CANDIDATES]
     candidates: Dict[str, Tuple[List[api.Pod], int]] = {}
-    for node_name in nodes_where_preemption_might_help(failed_predicates):
+    for node_name in node_order:
         ni = cache.node_infos.get(node_name)
         if ni is None or ni.node is None:
             continue
@@ -251,7 +321,9 @@ def preempt(pod: api.Pod, cache: SchedulerCache,
     # flight-recorder span event: the host per-pod what-if is exactly
     # the path the preemption-cliff investigation needs attributed
     tracing.event("preempt_whatif", pod=pod.uid, path="host",
-                  candidates=len(candidates), chosen=chosen or "")
+                  candidates=len(candidates), chosen=chosen or "",
+                  pruned=max(pruned, 0),
+                  backend="vector" if pruned >= 0 else "golden")
     if chosen is None:
         return None
     victims, nviol = candidates[chosen]

@@ -29,7 +29,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -235,6 +235,10 @@ class Scheduler:
 
         self.volume_binder = VolumeBinder(store)
         self._rr = None  # round-robin counter, device i32
+        # host-twin round-robin counter (degraded waves must never touch
+        # the device-resident _rr: fetching it dispatches to the very
+        # runtime the breaker just tripped)
+        self._host_rr = 0
         # None = not yet resolved; resolved on first wave to
         # pallas_default(), then demoted to False permanently if the fused
         # pallas kernel fails to compile on this backend (a wave must
@@ -260,8 +264,9 @@ class Scheduler:
         self._round_pallas_checked = False
         # preemptions performed by the batched pipeline path (tests +
         # bench assert the pipeline handled them, not per-wave fallback);
-        # device_preemption=False forces round failures back through the
-        # per-wave host path (the bench's comparison baseline)
+        # device_preemption=False routes the batched what-if through the
+        # vectorized numpy twin (ops/hostwave.py preemption_stats_host)
+        # instead of the device kernel — the bench's host baseline
         self.pipeline_preemptions = 0
         self.device_preemption = True
         self.ecache = (EquivalenceCache()
@@ -443,9 +448,10 @@ class Scheduler:
                 "N": c.N, "M": c.M, "E": c.E}
 
     def wave_path(self) -> str:
-        """Which filter formulation the most recently executed program
-        actually used: 'pallas', 'xla', or 'unresolved' before any wave
-        or round has run. This reports executions, not intent — the
+        """Which formulation the most recently executed program actually
+        used: 'pallas' or 'xla' on the device path, 'vector' for the
+        numpy host twin (degraded waves), or 'unresolved' before any
+        wave or round has run. This reports executions, not intent — the
         device-resident round path and the per-wave path resolve their
         formulation independently."""
         return self._last_path or "unresolved"
@@ -599,8 +605,7 @@ class Scheduler:
                 placed += self._schedule_gangs(gang_pods)
             host_path = [p for p in all_pods
                          if self.featurizer.needs_host_path(p)]
-            for p in host_path:
-                placed += self._schedule_host_path(p)
+            placed += self._schedule_host_batch(host_path)
             pods = [p for p in all_pods
                     if not self.featurizer.needs_host_path(p)]
             if not pods:
@@ -903,18 +908,25 @@ class Scheduler:
         trace.log_if_long(0.5)
         return placed
 
-    def _pipeline_preempt(self, pods: List[api.Pod]) -> set:
-        """Batched device-side preemption for round failures (SURVEY §7
-        step 6; VERDICT r3 item 3). One XLA program computes the what-if
-        stats for EVERY failed pod x node (ops/preempt.py); the host then
-        runs the exact selectVictimsOnNode + pickOneNodeForPreemption
-        tie-breaks only on the few device-ranked candidates. Returns the
-        uids handled (nominated + parked); the rest fall back to the
-        per-wave path for failure attribution."""
-        if not (self.device_preemption
-                and self.features.enabled("PodPriority")
+    def _pipeline_preempt(self, pods: List[api.Pod],
+                          host: bool = False) -> set:
+        """Batched preemption for round failures (SURVEY §7 step 6;
+        VERDICT r3 item 3). One program computes the what-if stats for
+        EVERY failed pod x node — the XLA kernel (ops/preempt.py) on the
+        device path, its numpy twin (ops/hostwave.py) when `host` is set
+        or device preemption is off — then the host runs the exact
+        selectVictimsOnNode + pickOneNodeForPreemption tie-breaks only
+        on the few ranked candidates. Returns the uids handled
+        (nominated + parked); the rest fall back to the per-wave path
+        for failure attribution."""
+        if not (self.features.enabled("PodPriority")
                 and not self.profile.disable_preemption):
             return set()
+        if not self.device_preemption:
+            # device what-ifs disabled: the numpy twin carries the same
+            # batched pipeline (this used to bail to the 0.8 pods/s
+            # per-pod host cascade — the BENCH_r05 cliff)
+            host = True
         cands = [p for p in pods
                  if pod_eligible_to_preempt_others(p, self.cache)]
         if not cands:
@@ -928,51 +940,66 @@ class Scheduler:
         exhausted: Dict[str, int] = {}
         for i in range(0, len(cands), self.wave_size):
             handled |= self._preempt_chunk(cands[i:i + self.wave_size],
-                                           claimed, exhausted)
+                                           claimed, exhausted, host=host)
         return handled
+
+    def _preempt_gang_weights(self):
+        """Victim-gang disruption weights for the what-if stats: 1 for
+        placed members of gangs with no slack above minMember (any
+        eviction breaks them). Returns (guard, f32 [M] weights or None)."""
+        guard, placed_gangs, gang_mins = self._gang_state()
+        if guard is None:
+            return None, None
+        w = np.zeros((self.snapshot.caps.M,), np.float32)
+        for gkey, gmembers in placed_gangs.items():
+            if len(gmembers) <= gang_mins[gkey]:
+                for gp in gmembers:
+                    slot = self.snapshot.pod_slot.get(gp.uid)
+                    if slot is not None:
+                        w[slot] = 1.0
+        return guard, (w if w.any() else None)
 
     def _preempt_chunk(self, cands: List[api.Pod],
                        claimed: Dict[str, List[api.Pod]],
-                       exhausted: Dict[str, int]) -> set:
-        import jax.numpy as jnp
-
-        from ..ops.preempt import PreemptStats, preemption_stats
+                       exhausted: Dict[str, int],
+                       host: bool = False) -> set:
+        from ..ops.hostwave import victim_levels
+        from ..ops.preempt import PreemptStats
 
         t0 = self.clock()
         trace = Trace(f"preempt chunk of {len(cands)}", clock=self.clock)
         pb = self.featurizer.featurize(cands)
-        nt, pm, tt = self.snapshot.to_device()
-        trace.step("featurized+uploaded")
         # candidate thresholds: distinct priorities of live existing pods
         # (+1 so "< level" removes that class); always keep the HIGHEST
         # so the remove-all-lower option survives the level cap
         live = self.snapshot.ep_valid & self.snapshot.ep_alive
-        prios = sorted({int(x) + 1 for x in self.snapshot.ep_prio[live]})
-        if len(prios) > PREEMPT_LEVELS:
-            prios = prios[:PREEMPT_LEVELS - 1] + [prios[-1]]
-        if not prios:
+        levels = victim_levels(self.snapshot.ep_prio, live, PREEMPT_LEVELS)
+        if levels is None:
             return set()
-        levels = prios + [prios[-1]] * (PREEMPT_LEVELS - len(prios))
-        # victim-gang awareness: weight 1 for placed members of gangs
-        # with no slack above minMember (any eviction breaks them); the
-        # per-class segment sum ranks gang-sparing nodes first. None for
-        # gang-free clusters — same compiled program as before.
-        gang_w = None
-        guard, placed_gangs, gang_mins = self._gang_state()
-        if guard is not None:
-            w = np.zeros((self.snapshot.caps.M,), np.float32)
-            for gkey, gmembers in placed_gangs.items():
-                if len(gmembers) <= gang_mins[gkey]:
-                    for gp in gmembers:
-                        slot = self.snapshot.pod_slot.get(gp.uid)
-                        if slot is not None:
-                            w[slot] = 1.0
-            if w.any():
-                gang_w = jnp.asarray(w)
-        packed = preemption_stats(
-            nt, pm, pb, jnp.asarray(levels, jnp.int32),
-            num_levels=PREEMPT_LEVELS, gang_w=gang_w)
-        trace.step("dispatched")
+        # victim-gang awareness: the per-class segment sum ranks
+        # gang-sparing nodes first. None for gang-free clusters — same
+        # compiled program as before.
+        guard, gang_w = self._preempt_gang_weights()
+        if host:
+            from ..ops.hostwave import preemption_stats_host
+
+            nt, pm, tt = self.snapshot.host_tensors()
+            packed = preemption_stats_host(
+                nt, pm, pb, np.asarray(levels, np.int32),
+                num_levels=PREEMPT_LEVELS, gang_w=gang_w)
+            trace.step("host what-if")
+        else:
+            import jax.numpy as jnp
+
+            from ..ops.preempt import preemption_stats
+
+            nt, pm, tt = self.snapshot.to_device()
+            trace.step("featurized+uploaded")
+            packed = preemption_stats(
+                nt, pm, pb, jnp.asarray(levels, jnp.int32),
+                num_levels=PREEMPT_LEVELS,
+                gang_w=None if gang_w is None else jnp.asarray(gang_w))
+            trace.step("dispatched")
         st = PreemptStats(np.asarray(packed))  # ONE fetch for all planes
         ok, victims_n = st.ok, st.victims
         psum, pmax = st.prio_sum, st.prio_max
@@ -1069,25 +1096,198 @@ class Scheduler:
         self.metrics.preemption_evaluation.observe(self.clock() - t0)
         return handled
 
+    def _needs_golden(self, pod: api.Pod) -> bool:
+        """Must this pod take the exact golden path instead of the
+        vectorized numpy host wave? True for the encodings the twin
+        deliberately does not carry: multi-topology-key required
+        affinity (needs_host_path, as on the device path) and ANY
+        inter-pod affinity involvement — the pod's own terms, or
+        existing pods' required terms (symmetry blocks every incoming
+        pod, so the whole wave goes golden while terms exist)."""
+        return (self.snapshot.has_affinity_terms
+                or _pod_has_ipa_terms(pod)
+                or self.featurizer.needs_host_path(pod))
+
     def _schedule_degraded(self, pods: List[api.Pod]) -> int:
-        """Breaker-open degraded mode: every pod of the wave takes the
-        exact host path one at a time. Slower, but placements keep
-        landing while the device path is tripped. Gang pods place
-        individually here — all-or-nothing atomicity is suspended in
-        degraded mode (the joint-assignment kernel IS the device path)."""
+        """Breaker-open degraded mode: the backlog drains through the
+        vectorized numpy host twin (ops/hostwave.py) — one batched
+        mask+score wave per wave_size chunk, batched host-twin
+        preemption for its failures, and all-or-nothing gang placement
+        through the twin's count-feasibility plane. Pods the twin can't
+        encode (inter-pod affinity, multi-topology keys) take the exact
+        per-pod golden path, as they do on the device path. Degraded
+        mode is merely slower than the device path, not three orders of
+        magnitude slower."""
         rec = tracing.active()
         rt = None
         if rec is not None:
             rt = rec.begin_round("degraded", pending=len(pods))
             self._trace_queue_waits(rt, pods)
         placed = 0
-        for p in pods:
-            placed += self._schedule_host_path(p)
+        # gangs stay atomic in degraded mode: the twin's count
+        # feasibility IS the joint-assignment proof (host twin). Gangs
+        # with golden-only members still place individually — atomicity
+        # is not offered for that combination on either backend.
+        gang_pods = [p for p in pods if self.gangs.key(p) is not None]
+        if gang_pods:
+            pods = [p for p in pods if self.gangs.key(p) is None]
+            groups: Dict[str, List[api.Pod]] = {}
+            for p in gang_pods:
+                groups.setdefault(self.gangs.key(p), []).append(p)
+            for key, members in groups.items():
+                placed += self._schedule_degraded_gang(key, members, rt)
+        golden_pods = [p for p in pods if self._needs_golden(p)]
+        if golden_pods:
+            pods = [p for p in pods if not self._needs_golden(p)]
+            placed += self._schedule_host_batch(golden_pods)
+        # chunk at wave_size: featurize buckets caps.P by batch length,
+        # and a 10k-pod degraded backlog must not balloon the P bucket
+        # every later DEVICE wave would recompile under
+        for i in range(0, len(pods), self.wave_size):
+            placed += self._host_wave(pods[i:i + self.wave_size], rt)
         if rt is not None:
             rec.end_round(rt, outcome="ok", placed=placed, path="host",
                           breaker=self.breaker.state,
                           snapshot=self._round_snapshot_shape())
         return placed
+
+    def _host_wave(self, pods: List[api.Pod], rt=None) -> int:
+        """One batched host-twin wave: numpy masks+scores+greedy commit
+        over the snapshot's host planes (no device touch — a wedged
+        runtime must not be dispatched to), then the same exact int64
+        recheck -> assume -> bind commit as the device path. Failures go
+        through ONE batched host-twin preemption pass (claimed-capacity
+        accounting included), then park with exact FitError attribution
+        from the twin's mask stack."""
+        from ..ops import hostwave
+
+        if not pods:
+            return 0
+        trace = Trace(f"host wave of {len(pods)}", clock=self.clock)
+        start = self.clock()
+        for _p in pods:
+            self.metrics.schedule_attempts.inc()
+        pb = self.featurizer.featurize(pods)
+        P = pb.req.shape[0]
+        try:
+            extra = self._host_plugin_mask(pods, P)
+            extra_scores = self._host_score_matrix(pods, P)
+        except ExtenderError:
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
+            for p in pods:
+                self._park_with_backoff(p)
+            return 0
+        trace.step("featurized")
+        if rt is not None:
+            rt.mark("featurize", pods=len(pods))
+        nt, pm, tt = self.snapshot.host_tensors()
+        res, _usage = hostwave.schedule_wave_host(
+            nt, pm, tt, pb, extra, self._host_rr, extra_scores,
+            weights=self.profile.weights(),
+            num_zones=self.snapshot.caps.Z,
+            num_label_values=self.snapshot.num_label_values)
+        self._host_rr = int(res.rr_end)
+        self._last_path = "vector"
+        trace.step("host wave")
+        if rt is not None:
+            rt.mark("host_wave", cat="host", backend="vector",
+                    pods=len(pods))
+        placed = 0
+        failed: List[Tuple[int, api.Pod]] = []
+        for i, pod in enumerate(pods):
+            node_idx = int(res.chosen[i])
+            if node_idx >= 0:
+                if self._commit(pod, self.snapshot.node_names[node_idx]):
+                    placed += 1
+                    continue
+                # exact recheck lost a race with f32 arithmetic: retry
+                self.queue.add_if_not_present(pod)
+                continue
+            failed.append((i, pod))
+        trace.step("committed")
+        if rt is not None:
+            rt.mark("commit", placed=placed)
+        handled: set = set()
+        if failed:
+            handled = self._pipeline_preempt([p for _, p in failed],
+                                             host=True)
+            for i, pod in failed:
+                self.metrics.pods_failed.inc()
+                err = self._fit_error(pod, i, res.fail_counts, res)
+                if pod.uid not in handled:
+                    self._park_with_backoff(pod)
+                self.store.set_pod_condition(
+                    pod, ("PodScheduled", "False:" + err.message()))
+            if rt is not None:
+                rt.mark("preempt", candidates=len(failed),
+                        handled=len(handled))
+        self.metrics.e2e_scheduling_latency.observe(self.clock() - start)
+        self.metrics.waves_total.labels(path="host").inc()
+        trace.log_if_long(0.5)
+        return placed
+
+    def _schedule_degraded_gang(self, key: str, members: List[api.Pod],
+                                rt=None) -> int:
+        """Degraded-mode gang placement through the host twin's
+        all-or-nothing count-feasibility plane (ops/hostwave.py
+        schedule_gang_host): either minMember members hold capacity
+        simultaneously or nothing commits — the atomicity PR 2 suspended
+        in degraded mode, restored. Gangs with golden-only members fall
+        back to individual placement (atomicity not offered, as on the
+        device path for multi-topology members)."""
+        from ..ops import hostwave
+
+        self.metrics.gang_schedule_attempts.inc()
+        for _p in members:
+            self.metrics.schedule_attempts.inc()
+        if any(self._needs_golden(p) for p in members):
+            return self._schedule_host_batch(members)
+        min_member = self.gangs.min_member(members[0])
+        bound = self.gangs.bound_count(self.cache, key,
+                                       exclude={p.uid for p in members})
+        need = max(min_member - bound, 0)
+        pb = self.featurizer.featurize(members)
+        P = pb.req.shape[0]
+        try:
+            extra = self._host_plugin_mask(members, P)
+            extra_scores = self._host_score_matrix(members, P)
+        except ExtenderError:
+            self.metrics.scheduling_errors.labels(stage="extender").inc()
+            for p in members:
+                self._park_with_backoff(p)
+            return 0
+        nt, pm, tt = self.snapshot.host_tensors()
+        res = hostwave.schedule_gang_host(
+            nt, pm, tt, pb, extra, self._host_rr, extra_scores, need,
+            weights=self.profile.weights(),
+            num_zones=self.snapshot.caps.Z,
+            num_label_values=self.snapshot.num_label_values)
+        self._last_path = "vector"
+        if rt is not None:
+            rt.mark("host_wave", cat="host", backend="vector", gang=key,
+                    pods=len(members))
+        if not bool(res.ok):
+            self._fail_gang(key, members, need, res)
+            return 0
+        self._host_rr = int(res.rr_end)
+        pairs: List = []
+        leftover: List = []
+        for i, pod in enumerate(members):
+            n = int(res.chosen[i])
+            if n >= 0:
+                pairs.append((pod, self.snapshot.node_names[n]))
+            else:
+                leftover.append((i, pod))
+        if not self._commit_gang(pairs):
+            for pod in members:
+                self.queue.add_if_not_present(pod)
+            return 0
+        self.backoff.clear("gang:" + key)
+        self.metrics.waves_total.labels(path="host").inc()
+        if leftover:
+            for i, pod in leftover:
+                self._handle_failure(pod, i, res.fail_counts, res)
+        return len(pairs)
 
     def _device_failure(self, exc: BaseException) -> None:
         """Account one device-path failure: the labelled error series,
@@ -1123,8 +1323,7 @@ class Scheduler:
         placed_host = placed_gang
         if host_path:
             pods = [p for p in pods if not self.featurizer.needs_host_path(p)]
-            for p in host_path:
-                placed_host += self._schedule_host_path(p)
+            placed_host += self._schedule_host_batch(host_path)
             if not pods:
                 return placed_host
         trace = Trace(f"wave of {len(pods)}", clock=self.clock)
@@ -1267,24 +1466,56 @@ class Scheduler:
         trace.log_if_long(0.1)
         return placed + placed_host
 
-    def _schedule_host_path(self, pod: api.Pod) -> int:
-        """Exact one-pod golden pass for pods the wave kernel can't encode
-        (multi-topology-key required pod affinity). Mirrors the reference's
-        single-pod cycle over the golden predicates/priorities."""
+    def _extender_node_labels(self) -> Optional[Dict[str, dict]]:
+        """Full node -> labels map for non-cache-capable filter
+        extenders, built ONCE per round/wave and passed down — the
+        per-pod golden path used to rebuild this dict per call."""
+        if not any(e.filter_verb and not e.node_cache_capable
+                   for e in self.profile.extenders):
+            return None
+        return {n: (ni.node.metadata.labels or {})
+                for n, ni in self.cache.node_infos.items()
+                if ni.node is not None}
+
+    def _schedule_host_batch(self, pods: List[api.Pod]) -> int:
+        """Golden path for a batch: the ClusterView and the extender
+        node-labels map are built ONCE for the round and shared across
+        every pod's pass (they read live cache state, so commits and
+        evictions inside the loop stay visible)."""
+        if not pods:
+            return 0
+        view = golden.ClusterView(self.cache.node_infos)
+        node_labels = self._extender_node_labels()
+        return sum(self._schedule_host_path(p, view=view,
+                                            node_labels=node_labels)
+                   for p in pods)
+
+    def _schedule_host_path(self, pod: api.Pod, view=None,
+                            node_labels=None) -> int:
+        """Exact one-pod golden pass for pods the wave kernel (and its
+        numpy twin) can't encode — inter-pod affinity and
+        multi-topology-key required affinity. Mirrors the reference's
+        single-pod cycle over the golden predicates/priorities. `view`
+        and `node_labels` are per-round shared state (see
+        _host_path_inner); omitted, they're built per call."""
         self.metrics.schedule_attempts.inc()
         self.metrics.waves_total.labels(path="host").inc()
         rec = tracing.active()
         if rec is None:
-            return self._host_path_inner(pod)
+            return self._host_path_inner(pod, view, node_labels)
         t0 = rec.now()
         try:
-            return self._host_path_inner(pod)
+            return self._host_path_inner(pod, view, node_labels)
         finally:
+            # backend attribution: Perfetto traces must distinguish the
+            # exact per-pod golden fallback from the vectorized twin
             rec.add_span("host_wave", t0, rec.now(), cat="host",
-                         pod=pod.uid)
+                         pod=pod.uid, backend="golden")
 
-    def _host_path_inner(self, pod: api.Pod) -> int:
-        view = golden.ClusterView(self.cache.node_infos)
+    def _host_path_inner(self, pod: api.Pod, view=None,
+                         node_labels=None) -> int:
+        if view is None:
+            view = golden.ClusterView(self.cache.node_infos)
         feasible: List[str] = []
         reasons: Dict[str, int] = {}
         failed: Dict[str, List[str]] = {}
@@ -1307,12 +1538,18 @@ class Scheduler:
         try:
             for ext in self.profile.extenders:
                 if ext.filter_verb and feasible:
-                    feasible, ext_failed = ext.filter(
-                        pod, feasible,
-                        node_labels=None if ext.node_cache_capable else {
+                    if ext.node_cache_capable:
+                        labels_arg = None
+                    elif node_labels is not None:
+                        labels_arg = {n: node_labels[n] for n in feasible
+                                      if n in node_labels}
+                    else:
+                        labels_arg = {
                             n: (self.cache.node_infos[n].node.metadata.labels or {})
                             for n in feasible
-                            if self.cache.node_infos[n].node is not None})
+                            if self.cache.node_infos[n].node is not None}
+                    feasible, ext_failed = ext.filter(
+                        pod, feasible, node_labels=labels_arg)
                     for n, r in ext_failed.items():
                         reasons[r] = reasons.get(r, 0) + 1
                         failed[n] = ["ExtenderFilter"]
@@ -1332,7 +1569,9 @@ class Scheduler:
                 pr = preempt(pod, self.cache, fp, self._pdbs(), with_affinity=True,
                              extenders=self.profile.extenders,
                              extra_fit=self._host_extra_fit,
-                             gang_guard=self._gang_guard())
+                             gang_guard=self._gang_guard(),
+                             snapshot=self.snapshot,
+                             featurizer=self.featurizer)
                 if pr is not None:
                     self._perform_preemption(pod, pr)
             self._park_with_backoff(pod)
@@ -1423,8 +1662,7 @@ class Scheduler:
             # multi-topology-key required affinity can't be device-
             # encoded; such members take the exact host path one at a
             # time — atomicity is not offered for this combination
-            for p in host_path:
-                placed += self._schedule_host_path(p)
+            placed += self._schedule_host_batch(host_path)
             members = [p for p in members
                        if not self.featurizer.needs_host_path(p)]
             if not members:
@@ -1576,7 +1814,9 @@ class Scheduler:
                              or _pod_has_ipa_terms(pod),
                              extenders=self.profile.extenders,
                              extra_fit=self._host_extra_fit,
-                             gang_guard=guard)
+                             gang_guard=guard,
+                             snapshot=self.snapshot,
+                             featurizer=self.featurizer)
                 if pr is not None:
                     claimed.add(pr.node_name)
                     self._perform_preemption(pod, pr)
@@ -2052,7 +2292,9 @@ class Scheduler:
                          with_affinity=self.snapshot.has_affinity_terms or pod_has_ipa,
                          extenders=self.profile.extenders,
                          extra_fit=self._host_extra_fit,
-                         gang_guard=self._gang_guard())
+                         gang_guard=self._gang_guard(),
+                         snapshot=self.snapshot,
+                         featurizer=self.featurizer)
             self.metrics.preemption_evaluation.observe(self.clock() - t0)
             if pr is not None:
                 self._perform_preemption(pod, pr)

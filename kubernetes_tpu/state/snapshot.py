@@ -585,8 +585,16 @@ class Snapshot:
     def pod_matrix(self) -> enc.PodMatrix:
         return enc.PodMatrix(
             labels=self.ep_labels, ns=self.ep_ns, node=self.ep_node,
-            valid=self.ep_valid, alive=self.ep_alive,
+            valid=self.ep_valid, alive=self.ep_alive, req=self.ep_req,
+            prio=self.ep_prio,
         )
+
+    def host_tensors(self) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.TermTable]:
+        """Host-side views for the vectorized numpy twin (ops/hostwave.py):
+        the SAME numpy planes the device upload reads, zero-copy — no
+        upload, no clone-per-node. Callers must treat them as read-only;
+        the twin copies its usage carries."""
+        return self.node_tensors(), self.pod_matrix(), self.term_table()
 
     def term_table(self) -> enc.TermTable:
         return enc.TermTable(

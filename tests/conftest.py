@@ -50,6 +50,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "observability: flight-recorder / metrics-exposition "
                    "suite (/debug/trace, /metrics, round ledger)")
+    config.addinivalue_line(
+        "markers", "hostpath: vectorized numpy host twin suite "
+                   "(device==host parity, breaker-open degraded waves; "
+                   "make chaos)")
 
 
 import pytest  # noqa: E402
