@@ -33,6 +33,18 @@ chaos: native
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
+# Multi-device tier: the mesh-sharded-parity suite (`mesh` marker) on 8
+# virtual CPU devices, so multi-chip coverage runs in tier-1
+# environments without TPUs. tests/conftest.py forces the same layout
+# for the whole suite; the explicit env here keeps the target honest if
+# that ever changes. Unregistered-marker warnings are errors so the
+# marker can't silently drift.
+multichip:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/ -q -m mesh \
+		--continue-on-collection-errors \
+		-W error::pytest.PytestUnknownMarkWarning
+
 # The driver's benchmark surface (real TPU when available; CPU otherwise).
 bench:
 	$(PYTHON) bench.py
@@ -44,4 +56,4 @@ bench-all:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-unit chaos bench bench-all clean
+.PHONY: all native test test-unit chaos multichip bench bench-all clean

@@ -148,8 +148,16 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
     features = FeatureGates()
     for k, v in (cfg.feature_gates or {}).items():
         features.set(k, bool(v))
+    mesh = None
+    if cfg.mesh_devices:
+        from ..parallel.mesh import mesh_for_devices
+
+        # clamps counts above the visible device total (with a warning)
+        # and resolves <= 1 device to no mesh at all — same semantics as
+        # bench.py --mesh
+        mesh = mesh_for_devices(cfg.mesh_devices)
     return Scheduler(store, profile=profile, wave_size=cfg.wave_size,
-                     features=features,
+                     features=features, mesh=mesh,
                      scrub_interval=cfg.scrub_interval or None,
                      breaker_threshold=cfg.breaker_threshold,
                      breaker_cooldown=cfg.breaker_cooldown,
@@ -307,6 +315,10 @@ def main(argv=None) -> int:
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--disable-preemption", action="store_true")
     ap.add_argument("--wave-size", type=int, default=None)
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="shard the scheduling plane's node axis across "
+                         "this many devices (0 = single device, -1 = all "
+                         "visible devices); placements stay bit-identical")
     ap.add_argument("--scrub-interval", type=float, default=None,
                     help="seconds between periodic snapshot scrubs "
                          "(0 disables the cadence; SIGUSR2 always works)")
@@ -347,6 +359,8 @@ def main(argv=None) -> int:
         cfg.disable_preemption = True
     if args.wave_size is not None:
         cfg.wave_size = args.wave_size
+    if args.mesh_devices is not None:
+        cfg.mesh_devices = args.mesh_devices
     if args.scrub_interval is not None:
         cfg.scrub_interval = args.scrub_interval
     if args.healthz_port is not None:

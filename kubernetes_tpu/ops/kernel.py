@@ -96,16 +96,32 @@ def set_telemetry(metrics) -> None:
     _TELEMETRY = metrics
 
 
+def _device_count(x) -> int:
+    """How many devices the input is committed across (1 for numpy /
+    single-device arrays): shardings participate in the jit cache key,
+    so a mesh-sharded dispatch must not be misclassified as a cache hit
+    of the single-device program (or vice versa)."""
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return 1
+    try:
+        return len(sharding.device_set)
+    except Exception:
+        return 1
+
+
 def dispatch_bucket(nt, pm, tt, kw, lead=()) -> tuple:
     """The shape bucket a dispatch compiles under: every dimension that
     participates in the jit cache key in practice — the caller's wave/pod
     rows (`lead`), node rows, pod-matrix and term-table caps (vocab
-    growth retraces!), the static num_label_values/num_zones, and the
-    formulation statics. Weights are deliberately excluded
+    growth retraces!), the static num_label_values/num_zones, the mesh
+    device count (sharded and unsharded dispatches compile separately),
+    and the formulation statics. Weights are deliberately excluded
     (profile-constant; a weight change would mint one mislabelled 'hit',
     not a recurring lie)."""
     return tuple(lead) + (
         nt.valid.shape[0], pm.node.shape[0], tt.node.shape[0],
+        _device_count(nt.valid),
         int(kw.get("num_label_values", 64)), int(kw.get("num_zones", 0)),
         int(bool(kw.get("has_ipa", False))),
         int(bool(kw.get("use_pallas", False))))

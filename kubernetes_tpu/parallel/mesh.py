@@ -49,6 +49,30 @@ def make_mesh(n_devices: Optional[int] = None, wave_parallel: int = 1) -> Mesh:
     return Mesh(arr, ("wave", "nodes"))
 
 
+def mesh_for_devices(n_devices: Optional[int] = None) -> Optional[Mesh]:
+    """Flag/config resolution shared by the scheduler binary
+    (--mesh-devices) and bench.py (--mesh): a device count -> Mesh or
+    None. None / negative = every visible device. A count above the
+    visible total clamps with a warning instead of make_mesh's silent
+    slice truncation (the operator asked for shards that don't exist);
+    a resolved count of <= 1 returns None — a 1-device mesh engages the
+    whole mesh path (per-round replicate() puts, sharded cache mode)
+    for pure dispatch overhead."""
+    import jax
+
+    avail = len(jax.devices())
+    want = avail if n_devices is None or n_devices < 0 else n_devices
+    if want > avail:
+        import sys
+
+        print(f"# mesh: {want} devices requested but only {avail} "
+              f"visible; sharding over {avail}", file=sys.stderr)
+        want = avail
+    if want <= 1:
+        return None
+    return make_mesh(want)
+
+
 def axis_sharding(mesh: Mesh, rank: int, axis_name: str,
                   axis_idx: int = 0) -> NamedSharding:
     spec = [None] * rank
@@ -59,6 +83,22 @@ def axis_sharding(mesh: Mesh, rank: int, axis_name: str,
 
 def node_sharding(mesh: Mesh, rank: int, node_axis: int = 0) -> NamedSharding:
     return axis_sharding(mesh, rank, "nodes", node_axis)
+
+
+def group_shardings(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding]:
+    """(node-axis sharding, full replication) for the snapshot's device
+    groups. Every node-group array leads with the N axis, so ONE
+    PartitionSpec("nodes") serves all ranks (trailing dims unsharded);
+    the pod matrix / term table replicate — M and E are modest and the
+    per-pod/term reductions run along them, not across devices."""
+    return NamedSharding(mesh, P("nodes")), NamedSharding(mesh, P())
+
+
+def replicate(mesh: Mesh, x):
+    """Commit an array (or pytree of arrays) to full replication over
+    the mesh. Arrays already committed to this sharding transfer
+    nothing; numpy inputs upload once and fan out."""
+    return jax.device_put(x, NamedSharding(mesh, P()))
 
 
 def _put(x, sharding):
@@ -112,3 +152,10 @@ def mesh_divides(mesh: Mesh, n_nodes: int, n_wave: int) -> bool:
     TPU slice shape) this is always True once N >= shards."""
     return (n_nodes % mesh.shape["nodes"] == 0
             and n_wave % mesh.shape["wave"] == 0)
+
+
+def nodes_divide(mesh: Mesh, n_nodes: int) -> bool:
+    """Node-axis-only divisibility: what Snapshot.to_device's mesh mode
+    needs (the pod axis is replicated on the round path, so only the N
+    bucket must line up with the "nodes" axis)."""
+    return n_nodes % mesh.shape["nodes"] == 0

@@ -36,6 +36,12 @@ class KubeSchedulerConfiguration:
     # TPU-wave specifics (no reference analog: the wave replaces the
     # one-pod cycle)
     wave_size: int = 128
+    # mesh-sharded scheduling plane: shard the snapshot's node axis
+    # across this many devices (parallel/mesh.py; 0 = single device,
+    # -1 = every visible device). Placements are bit-identical to
+    # single-device — GSPMD partitioning is an execution strategy, not
+    # a semantic change (tests/test_mesh.py asserts it).
+    mesh_devices: int = 0
     # robustness layer: periodic snapshot-scrub cadence in seconds
     # (0 disables the cadence; SIGUSR2 always triggers one, the
     # cache_comparer.go analog) and the device-path circuit breaker's

@@ -96,7 +96,12 @@ class SchedulingQueue:
         self.gang_lookup: Optional[Callable] = None
         self.on_gang_released: Optional[Callable[[str, float], None]] = None
         self._gang_waiting: Dict[str, Dict[str, api.Pod]] = {}
-        self._gang_members: Dict[str, set] = {}  # pending+placed uids
+        # pending+placed uids per gang. Dict-as-ordered-set, NOT a set:
+        # _pop_gangmates_locked iterates it to assemble the member batch,
+        # and set order follows the (random) uid hashes — scheduling
+        # would stop being a pure function of arrival order, breaking
+        # replay determinism and sharded==unsharded placement parity
+        self._gang_members: Dict[str, Dict[str, None]] = {}
         self._gang_of: Dict[str, str] = {}  # uid -> gang key
         self._gang_wait_start: Dict[str, float] = {}
         self._closed = False
@@ -119,8 +124,8 @@ class SchedulingQueue:
             if info is not None:
                 key, min_member = info
                 self._gang_of[pod.uid] = key
-                members = self._gang_members.setdefault(key, set())
-                members.add(pod.uid)
+                members = self._gang_members.setdefault(key, {})
+                members[pod.uid] = None
                 if len(members) < min_member:
                     # incomplete gang: park — a half-formed gang entering
                     # the wave would either deadlock capacity against
@@ -198,7 +203,7 @@ class SchedulingQueue:
             return
         members = self._gang_members.get(key)
         if members is not None:
-            members.discard(uid)
+            members.pop(uid, None)
             if not members:
                 del self._gang_members[key]
         waiting = self._gang_waiting.get(key)

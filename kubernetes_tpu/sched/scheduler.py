@@ -262,6 +262,15 @@ class Scheduler:
         # _run_pipeline if unwarmed) — a mismatch demotes to XLA rather
         # than silently degrading placement quality
         self._round_pallas_checked = False
+        if mesh is not None and mesh.devices.size > 1:
+            # the fused pallas kernels are single-device programs — GSPMD
+            # cannot shard a pallas_call — so under a multi-device mesh
+            # BOTH formulations resolve to partitionable XLA up front
+            self._use_pallas = False
+            self._round_pallas = False
+        # the mesh actually used by the last _to_device upload (None when
+        # caps.N doesn't divide the nodes axis — inputs ran unsharded)
+        self._active_mesh = None
         # preemptions performed by the batched pipeline path (tests +
         # bench assert the pipeline handled them, not per-wave fallback);
         # device_preemption=False routes the batched what-if through the
@@ -447,6 +456,25 @@ class Scheduler:
         return {"nodes": int(np.sum(self.snapshot.valid)),
                 "N": c.N, "M": c.M, "E": c.E}
 
+    def _to_device(self) -> Tuple[enc.NodeTensors, enc.PodMatrix,
+                                  enc.TermTable]:
+        """Snapshot upload honoring the scheduler's mesh: node tensors
+        sharded on the "nodes" axis, pod/term tables replicated — or
+        plain single-device when no mesh is configured / the N bucket
+        doesn't divide the nodes axis (capacity buckets are powers of
+        two, so with a power-of-two mesh this only happens while the
+        cluster is smaller than the mesh). Records the mesh actually
+        used in self._active_mesh so callers shard the remaining wave
+        inputs consistently."""
+        mesh = self.mesh
+        if mesh is not None:
+            from ..parallel.mesh import nodes_divide
+
+            if not nodes_divide(mesh, self.snapshot.caps.N):
+                mesh = None
+        self._active_mesh = mesh
+        return self.snapshot.to_device(mesh=mesh)
+
     def wave_path(self) -> str:
         """Which formulation the most recently executed program actually
         used: 'pallas' or 'xla' on the device path, 'vector' for the
@@ -484,8 +512,11 @@ class Scheduler:
                     break
             # extenders / policy host priorities force per-wave host
             # evaluation anyway — attempting the pipeline first would
-            # double every extender webhook call just to bail out
-            if (allow_pipeline and max_waves is None and self.mesh is None
+            # double every extender webhook call just to bail out.
+            # A configured mesh runs the pipeline too: the round program
+            # is partitionable XLA and _run_pipeline commits its inputs
+            # to the mesh shardings (GSPMD inserts the collectives).
+            if (allow_pipeline and max_waves is None
                     and not self.profile.extenders
                     and not self.profile.host_scores):
                 pre = self.pipeline_preemptions
@@ -536,10 +567,23 @@ class Scheduler:
         g.labels(queue="backoff").set(self.queue.backoff_count())
         g.labels(queue="unschedulable").set(self.queue.unschedulable_count())
         g.labels(queue="gang_waiting").set(self.queue.gang_waiting_count())
-        # device telemetry: HBM footprint of the resident mirror and the
-        # upload bytes accrued since the last export (snapshot counts,
-        # the registry exposes)
+        # device telemetry: HBM footprint of the resident mirror — the
+        # TRUE per-shard sum across devices (node groups tile the mesh's
+        # "nodes" axis, pod/term replicas cost full size per device) —
+        # plus a per-device gauge under sharding, and the upload bytes
+        # accrued since the last export (snapshot counts, the registry
+        # exposes)
         self.metrics.snapshot_hbm_bytes.set(self.snapshot.hbm_bytes())
+        per_dev = self.snapshot.hbm_bytes_per_device()
+        for dev, b in per_dev.items():
+            self.metrics.snapshot_hbm_device_bytes.labels(device=dev).set(b)
+        # falling back to unsharded (mesh no longer divides the grown N
+        # bucket) empties the map — zero the stale device children so
+        # per-device series keep summing to the unlabeled total instead
+        # of exporting their last sharded values forever
+        if not per_dev:
+            for child in self.metrics.snapshot_hbm_device_bytes.children():
+                child.set(0)
         up = self.snapshot.upload_bytes_total
         if up > self._upload_bytes_seen:
             self.metrics.snapshot_upload_bytes.inc(up - self._upload_bytes_seen)
@@ -635,7 +679,7 @@ class Scheduler:
             pm_rows, term_rows = self.snapshot.stage_pending(pods)
             pb = self.featurizer.featurize(pods)
             P = pb.req.shape[0]
-            nt, pm, tt = self.snapshot.to_device()
+            nt, pm, tt = self._to_device()
             usage = (nt.requested, nt.nonzero, nt.pod_count)
             if self._use_pallas is None:
                 self._use_pallas = pallas_default()
@@ -648,13 +692,28 @@ class Scheduler:
             tpp = term_rows.shape[1]
             pbs_stacked, rows, trows = assemble_round(
                 [pb], [pods], pm_rows, term_rows, wbucket, tpp)
+            rr0 = jnp.asarray(0, jnp.int32)
+            if self._active_mesh is not None:
+                from ..parallel.mesh import replicate
+
+                pbs_stacked = enc.PodBatch(
+                    *replicate(self._active_mesh, tuple(pbs_stacked)))
+                rows = replicate(self._active_mesh, rows)
+                trows = replicate(self._active_mesh, trows)
+                # the rr scalar must carry the same commitment as the
+                # measured rounds' (_run_pipeline replicates self._rr):
+                # shardings are part of the jit cache key, so an
+                # uncommitted rr here would warm a program the first
+                # measured round can never hit — recompiling inside the
+                # window this warm-up exists to protect
+                rr0 = replicate(self._active_mesh, rr0)
             if self._round_pallas is None:
                 self._round_pallas = pallas_default()
 
             def _warm(use_p: bool):
                 out = schedule_round(
                     nt, pm, tt, pbs_stacked, usage,
-                    jnp.asarray(0, jnp.int32), rows, trows,
+                    rr0, rows, trows,
                     weights=self.profile.weights(),
                     num_zones=self.snapshot.caps.Z,
                     num_label_values=self.snapshot.num_label_values,
@@ -776,11 +835,13 @@ class Scheduler:
         if rt is not None:
             rt.mark("featurize", pods=len(pods))
             up0 = self.snapshot.upload_bytes_total
-        nt, pm, tt = self.snapshot.to_device()
+        nt, pm, tt = self._to_device()
         trace.step("uploaded")
         if rt is not None:
             rt.mark("upload", cat="device",
-                    bytes=self.snapshot.upload_bytes_total - up0)
+                    bytes=self.snapshot.upload_bytes_total - up0,
+                    shards=(1 if self._active_mesh is None
+                            else int(self._active_mesh.shape["nodes"])))
         usage = (nt.requested, nt.nonzero, nt.pod_count)
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
@@ -793,6 +854,18 @@ class Scheduler:
         wbucket = pipeline_bucket(nw, hi=max_waves)
         pbs_stacked, pm_rows, term_rows = assemble_round(
             pbs, waves, pm_rows_all, term_rows_all, wbucket, tpp)
+        if self._active_mesh is not None:
+            # pod batches / staged row ids / the rr carry replicate over
+            # the mesh; the node tensors (and the usage carry derived
+            # from them) are already committed node-sharded, so GSPMD
+            # partitions the whole round along N with no program change
+            from ..parallel.mesh import replicate
+
+            pbs_stacked = enc.PodBatch(
+                *replicate(self._active_mesh, tuple(pbs_stacked)))
+            pm_rows = replicate(self._active_mesh, pm_rows)
+            term_rows = replicate(self._active_mesh, term_rows)
+            self._rr = replicate(self._active_mesh, self._rr)
         # the Pallas taint/port kernel is HOISTED out of the round's
         # lax.scan (ops/kernel.py schedule_round: one call covering all
         # waves) — under the scan it faults on Mosaic. A pallas round
@@ -993,7 +1066,13 @@ class Scheduler:
 
             from ..ops.preempt import preemption_stats
 
-            nt, pm, tt = self.snapshot.to_device()
+            nt, pm, tt = self._to_device()
+            if self._active_mesh is not None:
+                # what-if stats partition along the node axis like the
+                # wave kernels; the failed-pod batch replicates
+                from ..parallel.mesh import replicate
+
+                pb = enc.PodBatch(*replicate(self._active_mesh, tuple(pb)))
             trace.step("featurized+uploaded")
             packed = preemption_stats(
                 nt, pm, pb, jnp.asarray(levels, jnp.int32),
@@ -1108,6 +1187,24 @@ class Scheduler:
                 or _pod_has_ipa_terms(pod)
                 or self.featurizer.needs_host_path(pod))
 
+    def _count_degraded_golden(self, pods: List[api.Pod], rt=None) -> None:
+        """Degraded-mode visibility: pods the hostwave twin can't encode
+        drain through the exact per-pod golden path at a fraction of the
+        twin's rate — count them by reason
+        (scheduler_degraded_golden_pods_total{reason=affinity|multi_tk})
+        and tag the round-ledger entry, so the untwinned inter-pod
+        affinity plane shows up on dashboards instead of silently
+        dragging degraded throughput."""
+        counts: Dict[str, int] = {}
+        for p in pods:
+            r = self.featurizer.golden_reason(p)
+            counts[r] = counts.get(r, 0) + 1
+            self.metrics.degraded_golden_pods.labels(reason=r).inc()
+        if rt is not None:
+            g = rt.ledger.setdefault("degraded_golden", {})
+            for r, n in counts.items():
+                g[r] = g.get(r, 0) + n
+
     def _schedule_degraded(self, pods: List[api.Pod]) -> int:
         """Breaker-open degraded mode: the backlog drains through the
         vectorized numpy host twin (ops/hostwave.py) — one batched
@@ -1139,6 +1236,7 @@ class Scheduler:
         golden_pods = [p for p in pods if self._needs_golden(p)]
         if golden_pods:
             pods = [p for p in pods if not self._needs_golden(p)]
+            self._count_degraded_golden(golden_pods, rt)
             placed += self._schedule_host_batch(golden_pods)
         # chunk at wave_size: featurize buckets caps.P by batch length,
         # and a 10k-pod degraded backlog must not balloon the P bucket
@@ -1241,6 +1339,8 @@ class Scheduler:
         for _p in members:
             self.metrics.schedule_attempts.inc()
         if any(self._needs_golden(p) for p in members):
+            self._count_degraded_golden(
+                [p for p in members if self._needs_golden(p)], rt)
             return self._schedule_host_batch(members)
         min_member = self.gangs.min_member(members[0])
         bound = self.gangs.bound_count(self.cache, key,
@@ -1352,7 +1452,7 @@ class Scheduler:
         if rt is not None:
             rt.mark("featurize", pods=len(pods))
             up0 = self.snapshot.upload_bytes_total
-        nt, pm, tt = self.snapshot.to_device()
+        nt, pm, tt = self._to_device()
         if rt is not None:
             rt.mark("upload", cat="device",
                     bytes=self.snapshot.upload_bytes_total - up0)
@@ -1360,14 +1460,23 @@ class Scheduler:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
-        if self.mesh is not None:
-            from ..parallel.mesh import mesh_divides, shard_extra, shard_inputs
+        if self._active_mesh is not None:
+            from ..parallel.mesh import (mesh_divides, replicate, shard_extra,
+                                         shard_inputs)
 
-            if mesh_divides(self.mesh, nt.valid.shape[0], pb.req.shape[0]):
-                nt, pm, tt, pb, extra = shard_inputs(self.mesh, nt, pm, tt,
+            mesh = self._active_mesh
+            # the rr carry may still be committed to a single device by
+            # rounds run before the cluster grew to divide the mesh —
+            # mixing commitments in one jit is an error, so re-commit
+            self._rr = replicate(mesh, self._rr)
+            if mesh_divides(mesh, nt.valid.shape[0], pb.req.shape[0]):
+                # nt/pm/tt are already committed by _to_device; re-putting
+                # to the identical shardings transfers nothing — this
+                # call shards the pod batch / extra mask
+                nt, pm, tt, pb, extra = shard_inputs(mesh, nt, pm, tt,
                                                      pb, extra)
                 if extra_scores is not None:
-                    extra_scores = shard_extra(self.mesh, extra_scores)
+                    extra_scores = shard_extra(mesh, extra_scores)
         if self._use_pallas is None:
             self._use_pallas = pallas_default()
             if self.mesh is not None and self.mesh.devices.size > 1:
@@ -1681,7 +1790,7 @@ class Scheduler:
             return placed
         if rt is not None:
             rt.mark("featurize", pods=len(members))
-        nt, pm, tt = self.snapshot.to_device()
+        nt, pm, tt = self._to_device()
         if rt is not None:
             rt.mark("upload", cat="device")
         if self._rr is None:
@@ -1690,6 +1799,20 @@ class Scheduler:
             self._use_pallas = pallas_default()
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
+        if self._active_mesh is not None:
+            from ..parallel.mesh import (mesh_divides, replicate, shard_extra,
+                                         shard_inputs)
+
+            mesh = self._active_mesh
+            self._rr = replicate(mesh, self._rr)  # see _run_wave
+            if mesh_divides(mesh, nt.valid.shape[0], pb.req.shape[0]):
+                # joint-assignment runs under the mesh like a wave: node
+                # tensors stay sharded, the member batch shards on the
+                # wave axis (replicated at wave_parallel=1)
+                nt, pm, tt, pb, extra = shard_inputs(mesh, nt, pm, tt,
+                                                     pb, extra)
+                if extra_scores is not None:
+                    extra_scores = shard_extra(mesh, extra_scores)
         kw = dict(weights=self.profile.weights(),
                   num_zones=self.snapshot.caps.Z,
                   num_label_values=self.snapshot.num_label_values,

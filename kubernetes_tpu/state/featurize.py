@@ -298,6 +298,16 @@ class PodFeaturizer:
                 return True
         return False
 
+    def golden_reason(self, pod: api.Pod) -> str:
+        """Why a degraded-mode pod bypasses the vectorized numpy twin
+        for the exact per-pod golden path: 'multi_tk' — required
+        (anti)affinity spanning multiple topology keys, the same
+        encoding limit as the device path — vs 'affinity' — any other
+        inter-pod-affinity involvement (the plane the twin does not
+        carry). The label set of
+        scheduler_degraded_golden_pods_total{reason=...}."""
+        return "multi_tk" if self.needs_host_path(pod) else "affinity"
+
     def _ns_set(self, pod: api.Pod, terms) -> List[int]:
         """Intersection of the terms' namespace sets (each term: explicit
         list, or the pod's own namespace) as interned ids."""

@@ -11,6 +11,16 @@ Dirtiness is tracked in three groups with very different change rates:
   * resources  (requested/nonzero/pod_count)      — every bind
   * topology   (labels/taints/conds/ports/images) — node lifecycle only
   * pods       (the existing-pod matrix)          — every bind
+
+and, within each group, per ROW: a bind/evict/heartbeat re-uploads only
+the touched node/pod/term rows (gathered host rows + an index vector,
+applied with ONE jitted scatter per dirty group), so steady-state
+upload bytes scale with the churn, not the cluster. A whole-group flag
+(set by the scrubber, growth, or cache invalidation) or a dirty
+fraction past DELTA_MAX_FRACTION falls back to the full upload. With a mesh (to_device(mesh=...)) the node groups are committed
+to the "nodes"-axis NamedSharding and the pod/term groups replicated —
+parallel/mesh.py group_shardings — so the wave kernels run under GSPMD
+partitioning with no program change.
 """
 
 from __future__ import annotations
@@ -32,6 +42,38 @@ def _parse_label_num(v: str) -> float:
         return float(int(v))
     except (ValueError, TypeError):
         return math.nan
+
+
+# Delta-upload tuning: the dirty-row count buckets to a power of two
+# (>= DELTA_MIN_ROWS, padded with duplicate writes of the first row) so
+# the per-group scatter program compiles O(log N) variants, not one per
+# distinct churn size; a bucketed fraction past DELTA_MAX_FRACTION
+# falls back to the whole-group upload (at that point the row
+# bookkeeping buys nothing).
+DELTA_MAX_FRACTION = 0.5
+DELTA_MIN_ROWS = 16
+
+_ROW_UPDATE = None
+
+
+def _row_update():
+    """Lazily-jitted batched row scatter: one program application per
+    (group shapes, row-count bucket) writes the gathered host rows into
+    every array of a cached device group at the given indices. The host
+    row slices + the index vector are the ONLY host->device transfer.
+    Pad entries duplicate the first row's (index, content) pair, so
+    duplicate-index scatter order can't matter — every duplicate writes
+    identical bytes."""
+    global _ROW_UPDATE
+    if _ROW_UPDATE is None:
+        import jax
+
+        @jax.jit
+        def upd(devs, updates, idx):
+            return tuple(d.at[idx].set(u) for d, u in zip(devs, updates))
+
+        _ROW_UPDATE = upd
+    return _ROW_UPDATE
 
 
 class Snapshot:
@@ -58,15 +100,32 @@ class Snapshot:
         self._free_terms: List[int] = []
         self._next_term = 0
         self._alloc_terms()
+        # whole-group dirty flags: True forces a full re-upload of the
+        # group (set by growth, the scrubber's repairs, and external
+        # invalidation). Fine-grained churn goes through _mark_rows
+        # instead, so a steady-state bind re-uploads only touched rows.
         self.dirty_resources = True
         self.dirty_topology = True
         self.dirty_pods = True
+        # per-group dirty ROW indices ("res"/"topo" over N, "pods" over
+        # M, "terms" over E) — the delta-upload input
+        self._dirty_rows: Dict[str, set] = {
+            "res": set(), "topo": set(), "pods": set(), "terms": set()}
         self._device_cache: Dict[str, object] = {}
         # device telemetry: cumulative host->HBM upload bytes and the
         # byte size of each resident group — the scheduler exports these
         # as snapshot_upload_bytes_total / snapshot_hbm_bytes
         self.upload_bytes_total = 0
         self._group_bytes: Dict[str, int] = {}
+        # sharding bookkeeping for honest HBM accounting: which cached
+        # groups are node-sharded, the mesh's device list, and how many
+        # node shards it splits them into (1/None = unsharded)
+        self._group_sharded: Dict[str, bool] = {}
+        self._mesh_devices: List[str] = []
+        self._node_shards = 1
+
+    def _mark_rows(self, group: str, *rows: int) -> None:
+        self._dirty_rows[group].update(rows)
 
     def _account_upload(self, group: str, arrays) -> None:
         nbytes = sum(int(a.nbytes) for a in arrays)
@@ -74,9 +133,32 @@ class Snapshot:
         self._group_bytes[group] = nbytes
 
     def hbm_bytes(self) -> int:
-        """Byte footprint of the device-resident mirror (the cached
-        groups' host sizes; device layouts match dtype-for-dtype)."""
-        return sum(self._group_bytes.values())
+        """TRUE byte footprint of the device-resident mirror summed over
+        every device: node-sharded groups count once (the shards tile the
+        array), replicated groups once PER device. Unsharded, this is
+        exactly the cached groups' host sizes, as before."""
+        ndev = max(len(self._mesh_devices), 1)
+        if ndev == 1:
+            return sum(self._group_bytes.values())
+        total = 0
+        for g, b in self._group_bytes.items():
+            if self._group_sharded.get(g):
+                # sharded over "nodes", replicated across any "wave" axis
+                total += b * (ndev // self._node_shards)
+            else:
+                total += b * ndev
+        return total
+
+    def hbm_bytes_per_device(self) -> Dict[str, int]:
+        """Per-device HBM footprint under mesh sharding ({} when
+        unsharded): each device holds 1/node_shards of every node group
+        plus a full replica of the pod/term groups."""
+        if len(self._mesh_devices) <= 1:
+            return {}
+        per = 0
+        for g, b in self._group_bytes.items():
+            per += b // self._node_shards if self._group_sharded.get(g) else b
+        return {d: per for d in self._mesh_devices}
 
     # ---- allocation / growth ----------------------------------------------
 
@@ -173,7 +255,11 @@ class Snapshot:
         self.t_op = pad(self.t_op, (c.E, c.TE), enc.OP_PAD)
         self.t_vals = pad(self.t_vals, (c.E, c.TE, c.TV), -1)
         self.t_valid = pad(self.t_valid, (c.E,))
+        # realloc: every dirty row range is void (the cached device
+        # arrays have the old shapes) — whole-group flags take over
         self.dirty_resources = self.dirty_topology = self.dirty_pods = True
+        for rows in self._dirty_rows.values():
+            rows.clear()
 
     # ---- resource columns ---------------------------------------------------
 
@@ -278,7 +364,7 @@ class Snapshot:
         )
         self.valid[idx] = True
         self.refresh_node_resources(ni)
-        self.dirty_topology = True
+        self._mark_rows("topo", idx)
 
     def remove_node(self, name: str):
         idx = self.node_index.pop(name, None)
@@ -291,6 +377,7 @@ class Snapshot:
             if stale.any():
                 self.ep_valid[stale] = False
                 self.ep_alive[stale] = False
+                self._mark_rows("pods", *np.flatnonzero(stale).tolist())
                 for uid, slot in list(self.pod_slot.items()):
                     if stale[slot]:
                         del self.pod_slot[uid]
@@ -301,8 +388,7 @@ class Snapshot:
                         self._pod_sig.pop(uid, None)
                         self._free_slots.append(slot)
                         self._clear_pod_terms(uid)
-                self.dirty_pods = True
-            self.dirty_topology = True
+            self._mark_rows("topo", idx)
 
     def refresh_node_resources(self, ni: NodeInfo):
         """Fast path run on every (un)bind: just the resource aggregates."""
@@ -322,7 +408,7 @@ class Snapshot:
         self.ports[idx, :] = 0
         for i, (proto, _ip, port) in enumerate(up):
             self.ports[idx, i] = self.vocabs.port_id(proto, port)
-        self.dirty_resources = True
+        self._mark_rows("res", idx)
         # chaos seam: fires AFTER the row write so a `corrupt`-mode
         # fault leaves a silently-divergent row for the scrubber to
         # catch; one dict check when no faults are armed
@@ -395,17 +481,18 @@ class Snapshot:
             self.ep_node[slot] = node_idx
             self.ep_valid[slot] = True
             self.ep_alive[slot] = sig[1]
+            self._mark_rows("pods", slot)
             for row in self.term_rows.get(pod.uid, ()):
                 self.t_node[row] = node_idx
                 self.t_valid[row] = True
+                self._mark_rows("terms", row)
             self._pod_sig[pod.uid] = sig
-            self.dirty_pods = True
             return
         slot = self._alloc_slot(pod.uid)
         self._write_pod_row(pod, slot, node_idx, active=True)
         self._set_pod_terms(pod, slot, node_idx)
         self._pod_sig[pod.uid] = sig
-        self.dirty_pods = True
+        self._mark_rows("pods", slot)
 
     def stage_pending(self, pods) -> Tuple[np.ndarray, np.ndarray]:
         """Pre-stage pending pods into the PodMatrix/TermTable with
@@ -426,6 +513,7 @@ class Snapshot:
             # once placed (the device only flips valid/node)
             self._write_pod_row(pod, slot, node_idx=0, active=False)
             self.ep_alive[slot] = pod.metadata.deletion_timestamp is None
+            self._mark_rows("pods", slot)
             pm_rows[i] = slot
             self._set_pod_terms(pod, slot, node_idx=0, active=False)
             per_pod_terms.append(list(self.term_rows.get(pod.uid, ())))
@@ -436,7 +524,6 @@ class Snapshot:
         term_rows = np.full((max(n, 1), tpp), -1, np.int32)
         for i, rows in enumerate(per_pod_terms):
             term_rows[i, :len(rows)] = rows
-        self.dirty_pods = True
         return pm_rows, term_rows
 
     def unstage(self, pod: api.Pod):
@@ -456,7 +543,7 @@ class Snapshot:
             self.ep_alive[slot] = False
             self._free_slots.append(slot)
             self._clear_pod_terms(uid)
-            self.dirty_pods = True
+            self._mark_rows("pods", slot)
 
     # ---- inter-pod affinity term table --------------------------------------
 
@@ -547,6 +634,7 @@ class Snapshot:
                     self.t_op[row, i] = op
                     self.t_vals[row, i, : len(vals)] = vals
             self.t_valid[row] = active
+            self._mark_rows("terms", row)
             rows.append(row)
         self.term_rows[pod.uid] = rows
 
@@ -556,6 +644,7 @@ class Snapshot:
             self.t_kind[row] = enc.TERM_PAD
             self.t_op[row, :] = enc.OP_PAD
             self._free_terms.append(row)
+            self._mark_rows("terms", row)
 
     @property
     def has_affinity_terms(self) -> bool:
@@ -603,43 +692,112 @@ class Snapshot:
             key=self.t_key, op=self.t_op, vals=self.t_vals, valid=self.t_valid,
         )
 
-    def to_device(self, device=None) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.TermTable]:
-        """Upload dirty groups; reuse cached device arrays otherwise."""
+    def _group_host(self, key: str) -> tuple:
+        """The host arrays of one device group, in cache-tuple order
+        (every array's axis 0 is the group's row domain: N, M, or E)."""
+        if key == "res":
+            return (self.requested, self.nonzero, self.pod_count, self.ports)
+        if key == "topo":
+            return (self.alloc, self.allowed_pods, self.labels,
+                    self.label_nums, self.taint_key, self.taint_val,
+                    self.taint_effect, self.cond, self.zone_id, self.img_id,
+                    self.img_size, self.avoid, self.valid)
+        if key == "pods":
+            return (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid,
+                    self.ep_alive, self.ep_req, self.ep_prio)
+        return (self.t_kind, self.t_owner, self.t_node, self.t_tk,
+                self.t_weight, self.t_ns, self.t_key, self.t_op,
+                self.t_vals, self.t_valid)
+
+    @staticmethod
+    def _delta_rows(rows: set, total: int):
+        """Dirty row indices -> a power-of-two-bucketed i32 index vector
+        (pads duplicate the first index), or None when the bucketed
+        fraction makes a full upload cheaper. Index-based scatter —
+        not contiguous ranges — because real churn is scattered: a
+        trickle round's binds land on spread-scored nodes all over the
+        cluster."""
+        k = len(rows)
+        kb = min(max(DELTA_MIN_ROWS, 1 << (k - 1).bit_length()), total)
+        if kb > DELTA_MAX_FRACTION * total:
+            return None
+        srt = sorted(rows)
+        idx = np.full((kb,), srt[0], np.int32)
+        idx[:k] = srt
+        return idx
+
+    def _sync_group(self, jax, key: str, target, full_dirty: bool) -> None:
+        """Bring one cached device group up to date: nothing when clean,
+        a gathered-row delta scatter when the churn is sparse, the whole
+        group otherwise. `target` is a device or NamedSharding (None =
+        default device)."""
+        cache = self._device_cache
+        host = self._group_host(key)
+        rows = self._dirty_rows[key]
+        if key in cache and not full_dirty:
+            if not rows:
+                return
+            idx = self._delta_rows(rows, host[0].shape[0])
+            if idx is not None:
+                updates = tuple(np.ascontiguousarray(a[idx]) for a in host)
+                devs = _row_update()(tuple(cache[key]), updates, idx)
+                self.upload_bytes_total += (
+                    sum(int(u.nbytes) for u in updates) + int(idx.nbytes))
+                # re-commit to the group's target: the scatter output
+                # follows the operand sharding in practice, but pinning
+                # it keeps a compiler-chosen layout out of the kernels'
+                # jit keys (a no-op transfer when already there)
+                cache[key] = (jax.device_put(devs, target)
+                              if target is not None else devs)
+                rows.clear()
+                return
+        self._account_upload(key, host)
+        cache[key] = jax.device_put(host, target)
+        rows.clear()
+
+    def to_device(self, device=None, mesh=None
+                  ) -> Tuple[enc.NodeTensors, enc.PodMatrix, enc.TermTable]:
+        """Upload dirty groups (whole, or just the touched row ranges);
+        reuse cached device arrays otherwise.
+
+        mesh: optional jax.sharding.Mesh — mesh-aware mode commits the
+        node-tensor groups to the "nodes"-axis NamedSharding and the
+        pod/term groups replicated (parallel/mesh.py group_shardings).
+        Callers gate on nodes_divide(mesh, caps.N); switching between
+        mesh and single-device modes invalidates the cache."""
         import jax
 
         cache = self._device_cache
         shapes_key = (self.caps.N, self.caps.K, self.caps.KP, self.caps.R,
                       self.caps.T, self.caps.PP, self.caps.NI, self.caps.M,
                       self.caps.E, self.caps.TE, self.caps.TV, self.caps.TNS)
-        if cache.get("shapes") != shapes_key:
+        if cache.get("shapes") != shapes_key or cache.get("mesh") is not mesh:
             cache.clear()
             self._group_bytes.clear()
             cache["shapes"] = shapes_key
+            cache["mesh"] = mesh
             self.dirty_resources = self.dirty_topology = self.dirty_pods = True
-        if self.dirty_resources or "res" not in cache:
-            host = (self.requested, self.nonzero, self.pod_count, self.ports)
-            self._account_upload("res", host)
-            cache["res"] = jax.device_put(host, device)
-            self.dirty_resources = False
-        if self.dirty_topology or "topo" not in cache:
-            host = (self.alloc, self.allowed_pods, self.labels,
-                    self.label_nums, self.taint_key, self.taint_val,
-                    self.taint_effect, self.cond, self.zone_id, self.img_id,
-                    self.img_size, self.avoid, self.valid)
-            self._account_upload("topo", host)
-            cache["topo"] = jax.device_put(host, device)
-            self.dirty_topology = False
-        if self.dirty_pods or "pods" not in cache:
-            host = (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid,
-                    self.ep_alive, self.ep_req, self.ep_prio)
-            terms = (self.t_kind, self.t_owner, self.t_node, self.t_tk,
-                     self.t_weight, self.t_ns, self.t_key, self.t_op,
-                     self.t_vals, self.t_valid)
-            self._account_upload("pods", host)
-            self._account_upload("terms", terms)
-            cache["pods"] = jax.device_put(host, device)
-            cache["terms"] = jax.device_put(terms, device)
-            self.dirty_pods = False
+            for rows in self._dirty_rows.values():
+                rows.clear()
+        if mesh is not None:
+            from ..parallel.mesh import group_shardings
+
+            node_sh, repl_sh = group_shardings(mesh)
+            targets = {"res": node_sh, "topo": node_sh,
+                       "pods": repl_sh, "terms": repl_sh}
+            self._mesh_devices = [str(d) for d in mesh.devices.flat]
+            self._node_shards = int(mesh.shape["nodes"])
+            self._group_sharded = {"res": True, "topo": True}
+        else:
+            targets = dict.fromkeys(("res", "topo", "pods", "terms"), device)
+            self._mesh_devices = []
+            self._node_shards = 1
+            self._group_sharded = {}
+        self._sync_group(jax, "res", targets["res"], self.dirty_resources)
+        self._sync_group(jax, "topo", targets["topo"], self.dirty_topology)
+        self._sync_group(jax, "pods", targets["pods"], self.dirty_pods)
+        self._sync_group(jax, "terms", targets["terms"], self.dirty_pods)
+        self.dirty_resources = self.dirty_topology = self.dirty_pods = False
         requested, nonzero, pod_count, ports = cache["res"]
         (alloc, allowed_pods, labels, label_nums, taint_key, taint_val,
          taint_effect, cond, zone_id, img_id, img_size, avoid, valid) = cache["topo"]

@@ -274,9 +274,20 @@ class Metrics:
         self.device_jit_compile_seconds = Histogram(
             "device_jit_compile_seconds")
         self.snapshot_hbm_bytes = Gauge("snapshot_hbm_bytes")
+        # per-device footprint under mesh sharding (each device holds
+        # 1/shards of every node group + a full pod/term replica); the
+        # unlabeled gauge above sums TRUE per-shard bytes across devices
+        self.snapshot_hbm_device_bytes = LabeledGauge(
+            "snapshot_hbm_bytes_per_device", ("device",))
         self.snapshot_upload_bytes = Counter("snapshot_upload_bytes_total")
         self.device_fetch_bytes = Counter("device_fetch_bytes_total")
         self.waves_total = LabeledCounter("scheduler_waves_total", ("path",))
+        # degraded-mode visibility: breaker-open pods the hostwave twin
+        # can't encode, routed to the exact per-pod golden path, by
+        # reason (affinity = untwinned inter-pod-affinity plane;
+        # multi_tk = multi-topology-key required terms)
+        self.degraded_golden_pods = LabeledCounter(
+            "scheduler_degraded_golden_pods_total", ("reason",))
 
     def all_series(self):
         out = {}

@@ -54,6 +54,10 @@ def pytest_configure(config):
         "markers", "hostpath: vectorized numpy host twin suite "
                    "(device==host parity, breaker-open degraded waves; "
                    "make chaos)")
+    config.addinivalue_line(
+        "markers", "mesh: mesh-sharded scheduling plane suite "
+                   "(sharded==unsharded parity on the forced 8-device "
+                   "CPU mesh; make multichip)")
 
 
 import pytest  # noqa: E402

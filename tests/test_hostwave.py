@@ -349,6 +349,54 @@ class TestDegradedVectorWave:
         nodes = {store.get("pods", "default", f"anti-{i}").spec.node_name
                  for i in range(3)}
         assert len(nodes) == 3  # one per host, exactly
+        # degraded-mode visibility: the golden-routed pods are counted
+        # by reason, so the untwinned affinity plane shows up on
+        # dashboards instead of silently dragging degraded throughput
+        assert sched.metrics.degraded_golden_pods.value(
+            reason="affinity") == 3
+        assert sched.metrics.degraded_golden_pods.value(
+            reason="multi_tk") == 0
+
+    def test_degraded_golden_reasons_and_ledger_tag(self):
+        """multi-topology-key pods count under reason=multi_tk, and the
+        degraded round's ledger entry carries the per-reason tally."""
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.utils import tracing
+
+        store, sched = _faulted(n_nodes=4, cpu="8", wave=8)
+        rec = tracing.enable()
+        try:
+            # required anti-affinity over TWO topology keys -> the
+            # multi-tk encoding limit (needs_host_path), not just the
+            # untwinned-affinity plane
+            aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required=[
+                    api.PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"g": "y"}),
+                        topology_key="kubernetes.io/hostname"),
+                    api.PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"g": "y"}),
+                        topology_key=api.LABEL_ZONE),
+                ]))
+            # trip the breaker with plain pods FIRST: only pods that
+            # arrive while it's open take the DEGRADED golden route
+            for i in range(3):
+                store.create("pods", make_pod(f"plain-{i}", cpu="1"))
+            assert sched.schedule_pending() == 3
+            assert sched.breaker.state == OPEN
+            store.create("pods", make_pod("multi-tk", cpu="1",
+                                          labels={"g": "y"}, affinity=aff))
+            assert sched.schedule_pending() == 1
+            assert sched.metrics.degraded_golden_pods.value(
+                reason="multi_tk") == 1
+            ledgers = [r for r in rec.ledger_rows()
+                       if r.get("degraded_golden")]
+            assert ledgers, "degraded round ledger entry not tagged"
+            assert ledgers[-1]["degraded_golden"] == {"multi_tk": 1}
+        finally:
+            tracing.disable()
 
     def test_simulate_host_backend_matches_device(self):
         """The autoscaler what-if's host backend returns the same
