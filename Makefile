@@ -29,7 +29,18 @@ test-unit: native
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
-	$(PYTHON) -m pytest tests/ -q -m "faults or chaos or partition or hostpath" \
+	$(PYTHON) -m pytest tests/ -q \
+		-m "faults or chaos or partition or hostpath or telemetry" \
+		--continue-on-collection-errors \
+		-W error::pytest.PytestUnknownMarkWarning
+
+# Observability tier: the flight-recorder / metrics-exposition suite,
+# the numpy-twin parity suite, and the decision-observatory /
+# cluster-telemetry suite (score decomposition, /debug/score, telemetry
+# plane parity).
+obs: native
+	$(PYTHON) -m pytest tests/ -q \
+		-m "observability or hostpath or telemetry" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
@@ -56,4 +67,4 @@ bench-all:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-unit chaos multichip bench bench-all clean
+.PHONY: all native test test-unit chaos obs multichip bench bench-all clean

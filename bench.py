@@ -796,6 +796,33 @@ def stage_breakdown(top=12):
     return {k: round(v, 3) for k, v in prof.step_totals(top=top).items()}
 
 
+def telemetry_trajectory(max_points=32):
+    """Fragmentation/utilization trajectory from the flight recorder's
+    round ledger (present when --telemetry enabled the recorder): the
+    last ring-buffer's worth of rounds, downsampled to max_points —
+    enough to see whether a drain fragments the cluster as it fills."""
+    from kubernetes_tpu.utils import tracing
+
+    rec = tracing.active()
+    if rec is None:
+        return None
+    rows = [r["telemetry"] for r in rec.ledger_rows() if "telemetry" in r]
+    if not rows:
+        return None
+    if len(rows) > max_points:
+        step = (len(rows) - 1) / (max_points - 1)
+        rows_s = [rows[round(i * step)] for i in range(max_points)]
+    else:
+        rows_s = rows
+    return {
+        "rounds": len(rows),
+        "cpu_util": [t["util"].get("cpu") for t in rows_s],
+        "cpu_frag": [t["frag"].get("cpu") for t in rows_s],
+        "mem_frag": [t["frag"].get("memory") for t in rows_s],
+        "headroom_final": rows[-1]["headroom"],
+    }
+
+
 def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
     if placed != pods:
         print(f"FATAL: {name}: placed {placed}/{pods}", file=sys.stderr)
@@ -815,6 +842,9 @@ def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
     stages = stage_breakdown()
     if stages:
         rec["stages"] = stages
+    tele = telemetry_trajectory()
+    if tele:
+        rec["telemetry"] = tele
     print(json.dumps(rec), flush=True)
     print(f"# {name}: placed={placed} wall={dt:.2f}s wave={wave} "
           f"path={path} p99_pod_latency={p99*1e3:.0f}ms "
@@ -879,7 +909,8 @@ DRIVER_SUITE = [
 ]
 
 
-def run_subprocess_suite(suite, wave, cpu, tracing=False, trace_ledger=None):
+def run_subprocess_suite(suite, wave, cpu, tracing=False, trace_ledger=None,
+                         telemetry=False):
     # one subprocess per config: a run's end-of-round result fetch
     # leaves the tunneled TPU runtime in its degraded transfer mode,
     # which would taint every subsequent config in this process
@@ -896,6 +927,8 @@ def run_subprocess_suite(suite, wave, cpu, tracing=False, trace_ledger=None):
         cmd.append("--skip-backend-probe")  # the parent already probed
         if tracing:
             cmd.append("--tracing")
+        if telemetry:
+            cmd.append("--telemetry")
         if trace_ledger:
             # per-config ledgers: concurrent-process appends would
             # interleave otherwise, and per-config files are what the
@@ -976,6 +1009,11 @@ def main():
     ap.add_argument("--trace-ledger", default=None,
                     help="append per-round JSONL ledger records here "
                          "(implies --tracing)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="per-round cluster-state telemetry (implies "
+                         "--tracing): the emitted JSON lines carry "
+                         "fragmentation/utilization trajectories and "
+                         "final feasibility headroom")
     ap.add_argument("--skip-backend-probe", action="store_true",
                     help=argparse.SUPPRESS)  # suite children: parent probed
     args = ap.parse_args()
@@ -1014,12 +1052,14 @@ def main():
     if args.suite:
         run_subprocess_suite(SUITE, args.wave, args.cpu,
                              tracing=args.tracing,
-                             trace_ledger=args.trace_ledger)
+                             trace_ledger=args.trace_ledger,
+                             telemetry=args.telemetry)
         return
     if not explicit:
         run_subprocess_suite(DRIVER_SUITE, args.wave, args.cpu,
                              tracing=args.tracing,
-                             trace_ledger=args.trace_ledger)
+                             trace_ledger=args.trace_ledger,
+                             telemetry=args.telemetry)
         return
 
     # the measured child: the step profiler feeds the per-stage
@@ -1028,7 +1068,7 @@ def main():
     from kubernetes_tpu.utils import profiling
 
     profiling.enable()
-    if args.tracing or args.trace_ledger:
+    if args.tracing or args.trace_ledger or args.telemetry:
         from kubernetes_tpu.utils import tracing as _tracing
 
         _tracing.enable(ledger_path=args.trace_ledger or None)
@@ -1080,6 +1120,9 @@ def main():
         stages = stage_breakdown()
         if stages:
             rec["stages"] = stages
+        tele = telemetry_trajectory()
+        if tele:
+            rec["telemetry"] = tele
         print(json.dumps(rec), flush=True)
         print(f"# {name}: placed={placed} wall={dt:.2f}s "
               f"offered={offered:.0f}pods/s (target {args.rate:.0f}) "

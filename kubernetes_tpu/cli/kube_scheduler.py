@@ -76,6 +76,53 @@ class HealthServer:
                     else:
                         body = json.dumps(rec.chrome_trace()).encode()
                         ctype = "application/json"
+                elif self.path.startswith("/debug/score"):
+                    # decision observatory: per-pod score decomposition
+                    # ("why did node-42 win"). ?uid=<pod uid> for one
+                    # pod (&format=text for the one-line explanation);
+                    # without a uid, an index of recent decisions.
+                    from urllib.parse import parse_qs, urlparse
+
+                    from ..utils import tracing
+
+                    rec = tracing.active()
+                    if rec is None:
+                        body = (b"tracing disabled (run with --tracing)\n")
+                        ctype = "text/plain"
+                    else:
+                        q = parse_qs(urlparse(self.path).query)
+                        uid = (q.get("uid") or [None])[0]
+                        fmt = (q.get("format") or [""])[0]
+                        if uid:
+                            entry = rec.decision(uid)
+                            if entry is None:
+                                body = (f"no decision recorded for uid "
+                                        f"{uid}\n").encode()
+                                self.send_response(404)
+                                self.send_header("Content-Type",
+                                                 "text/plain")
+                                self.send_header("Content-Length",
+                                                 str(len(body)))
+                                self.end_headers()
+                                self.wfile.write(body)
+                                return
+                            if fmt == "text":
+                                body = (tracing.format_decision(uid, entry)
+                                        + "\n").encode()
+                                ctype = "text/plain"
+                            else:
+                                body = json.dumps(
+                                    {"uid": uid, **entry}).encode()
+                                ctype = "application/json"
+                        else:
+                            idx = [{"uid": u, "pod": e.get("pod"),
+                                    "node": e.get("node"),
+                                    "round": e.get("round"),
+                                    "total": e.get("total"),
+                                    "margin": e.get("margin")}
+                                   for u, e in rec.recent_decisions()]
+                            body = json.dumps(idx).encode()
+                            ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")

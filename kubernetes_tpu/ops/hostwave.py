@@ -45,6 +45,7 @@ import numpy as np
 
 from . import encoding as enc
 from .kernel import Weights, WaveResult
+from .scores import SCORE_STACK, SCORE_TOPK, ScoreDeco
 
 F = np.float32
 MAX_PRIORITY = F(10.0)
@@ -395,7 +396,8 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
                        extra_scores=None, *, weights: Weights,
                        num_zones: int, num_label_values: int = 64,
                        has_ipa: bool = False,
-                       usage_in=None) -> WaveResult:
+                       usage_in=None,
+                       collect_scores: bool = False) -> WaveResult:
     """One batched host wave: masks + scores over (P x N), then the
     sequential greedy commit with usage carry — the numpy statement of
     _wave_body's lax.scan. Inter-pod affinity is NOT twinned: callers
@@ -405,6 +407,11 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     gang wrapper and chained degraded waves carry usage the same way
     the device-resident round does). The input planes are never
     mutated — carries are copies.
+
+    collect_scores: emit the per-priority decomposition (WaveResult.deco,
+    see ops/scores.py ScoreDeco) bit-for-bit matching the device
+    kernel's — top-k is argsort-stable descending, exactly lax.top_k's
+    lowest-index-first tie order.
     """
     if has_ipa:
         raise NotImplementedError(
@@ -424,19 +431,41 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     alloc2 = nt.alloc[:, :2]
 
     w = weights
-    aff_raw = node_affinity_raw(nt, pb) if w.node_affinity else np.zeros(
-        (P, N), np.float32)
-    taint_raw = taint_intolerable_raw(nt, pb) if w.taint_toleration else \
-        np.zeros((P, N), np.float32)
-    spread_cnt = (spread_counts(pm, pb, N) if w.selector_spread
+    # mirrors the kernel: under collect_scores the raw planes are
+    # computed even at weight 0, so the decomposition never fabricates
+    # flat rows for priorities a profile disabled
+    aff_raw = (node_affinity_raw(nt, pb)
+               if w.node_affinity or collect_scores
+               else np.zeros((P, N), np.float32))
+    taint_raw = (taint_intolerable_raw(nt, pb)
+                 if w.taint_toleration or collect_scores
+                 else np.zeros((P, N), np.float32))
+    spread_cnt = (spread_counts(pm, pb, N)
+                  if w.selector_spread or collect_scores
                   else np.zeros((P, N), np.int32))
+    # computed once and shared between static_score and the
+    # decomposition (numpy has no CSE to dedupe a second call)
+    avoid_full = (prefer_avoid(nt, pb)
+                  if w.prefer_avoid or collect_scores else None)
+    img_full = (image_locality(nt, pb)
+                if w.image_locality or collect_scores else None)
     static_score = np.zeros((P, N), np.float32)
     if w.image_locality:
-        static_score += F(w.image_locality) * image_locality(nt, pb)
+        static_score += F(w.image_locality) * img_full
     if w.prefer_avoid:
-        static_score += F(w.prefer_avoid) * prefer_avoid(nt, pb)
+        static_score += F(w.prefer_avoid) * avoid_full
     if extra_scores is not None:
         static_score += np.asarray(extra_scores, np.float32)
+    if collect_scores:
+        extra_full = (np.asarray(extra_scores, np.float32)
+                      if extra_scores is not None
+                      else np.zeros((P, N), np.float32))
+        S = len(SCORE_STACK)
+        KK = min(SCORE_TOPK, N)
+        d_cparts = np.zeros((P, S), np.float32)
+        d_tidx = np.zeros((P, KK), np.int32)
+        d_tvals = np.full((P, KK), -1.0, np.float32)
+        d_tparts = np.zeros((P, S, KK), np.float32)
 
     usage0 = usage_in if usage_in is not None else (
         nt.requested, nt.nonzero, nt.pod_count)
@@ -456,28 +485,45 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
         dyn_fits[i] = fits
         feasible = static_nonres[i] & fits & nt.valid & bool(pb.valid[i])
         total = static_score[i]
+        aff_n = (normalize_reduce(aff_raw[i], feasible, False)
+                 if w.node_affinity or collect_scores else None)
         if w.node_affinity:
-            total = total + F(w.node_affinity) * normalize_reduce(
-                aff_raw[i], feasible, False)
+            total = total + F(w.node_affinity) * aff_n
+        taint_n = (normalize_reduce(taint_raw[i], feasible, True)
+                   if w.taint_toleration or collect_scores else None)
         if w.taint_toleration:
-            total = total + F(w.taint_toleration) * normalize_reduce(
-                taint_raw[i], feasible, True)
+            total = total + F(w.taint_toleration) * taint_n
+        spread_n = (spread_reduce(spread_cnt[i], feasible, nt.zone_id,
+                                  num_zones)
+                    if w.selector_spread or collect_scores else None)
         if w.selector_spread:
-            total = total + F(w.selector_spread) * spread_reduce(
-                spread_cnt[i], feasible, nt.zone_id, num_zones)
+            total = total + F(w.selector_spread) * spread_n
+        lr = (least_requested(nz_c, alloc2, pb.nonzero[i])
+              if w.least_requested or collect_scores else None)
         if w.least_requested:
-            total = total + F(w.least_requested) * least_requested(
-                nz_c, alloc2, pb.nonzero[i])
+            total = total + F(w.least_requested) * lr
+        ba = (balanced_allocation(nz_c, alloc2, pb.nonzero[i])
+              if w.balanced or collect_scores else None)
         if w.balanced:
-            total = total + F(w.balanced) * balanced_allocation(
-                nz_c, alloc2, pb.nonzero[i])
+            total = total + F(w.balanced) * ba
+        mr = (most_requested(nz_c, alloc2, pb.nonzero[i])
+              if w.most_requested or collect_scores else None)
         if w.most_requested:
-            total = total + F(w.most_requested) * most_requested(
-                nz_c, alloc2, pb.nonzero[i])
+            total = total + F(w.most_requested) * mr
         sm = np.where(feasible, total, F(-1.0))
         best = np.max(sm) if N else F(-1.0)
         best_s[i] = best
         feas_cnt[i] = int(np.sum(feasible))
+        if collect_scores:
+            zr = np.zeros_like(total)
+            parts = np.stack([
+                lr, ba, mr, aff_n, taint_n, spread_n,
+                avoid_full[i], img_full[i], zr, extra_full[i]])
+            # lax.top_k order: descending value, lowest index on ties
+            order = np.argsort(-sm, kind="stable")[:KK]
+            d_tidx[i] = order.astype(np.int32)
+            d_tvals[i] = sm[order]
+            d_tparts[i] = parts[:, order]
         if best >= 0:
             ties = feasible & (sm == best)
             k = max(int(np.sum(ties)), 1)
@@ -488,6 +534,12 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
             nz_c[c] += pb.nonzero[i]
             cnt_c[c] += 1
             rr += 1
+            if collect_scores:
+                d_cparts[i] = parts[:, c]
+        elif collect_scores:
+            # the device kernel gathers column `safe`=0 for unplaced
+            # pods; mirror it for bitwise parity
+            d_cparts[i] = parts[:, 0]
 
     masks[res_i] = dyn_fits
     prefix_ok = np.cumprod(masks.astype(np.int8), axis=0).astype(bool)
@@ -495,9 +547,12 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
         [np.ones((1,) + masks.shape[1:], bool), prefix_ok[:-1]], axis=0)
     first_fail = ~masks & first & nt.valid[None, None, :]
     fail_counts = np.sum(first_fail.astype(np.int32), axis=-1)
+    deco = (ScoreDeco(chosen_parts=d_cparts, top_idx=d_tidx,
+                      top_vals=d_tvals, top_parts=d_tparts)
+            if collect_scores else None)
     res = WaveResult(chosen=chosen, score=best_s, feasible_count=feas_cnt,
                      fail_counts=fail_counts, masks=masks,
-                     rr_end=np.int32(rr))
+                     rr_end=np.int32(rr), deco=deco)
     return res, (req_c, nz_c, cnt_c)
 
 
@@ -523,6 +578,22 @@ def schedule_gang_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     return GangResult(ok=np.bool_(ok), chosen=chosen,
                       placed=np.int32(placed), fail_counts=res.fail_counts,
                       masks=res.masks, rr_end=rr_end)
+
+
+# -- cluster-state telemetry (ops/telemetry.py twin) --------------------------
+
+
+def cluster_telemetry_host(nt, *, num_zones: int) -> np.ndarray:
+    """Numpy twin of ops/telemetry.py cluster_telemetry: the SAME
+    `_telemetry_body` program evaluated with numpy over the snapshot's
+    host planes — byte-compatible packed output, zero device touch (the
+    breaker-open path must never dispatch to a wedged runtime). The f32
+    resource sums go through the shared fixed halving tree, so the twin
+    is bit-for-bit identical to the device reduction, sharded or not."""
+    from .telemetry import _telemetry_body, shape_requests
+
+    R = nt.alloc.shape[1]
+    return _telemetry_body(nt, shape_requests(R), num_zones, np)
 
 
 # -- preemption what-if (ops/preempt.py twin) ---------------------------------

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,9 @@ from ..utils import faultpoints
 from .affinity import incoming_statics
 from .filters import resource_fit, static_predicate_masks
 from .scores import (
+    SCORE_STACK,
+    SCORE_TOPK,
+    ScoreDeco,
     floor_div,
     balanced_allocation,
     image_locality,
@@ -77,6 +80,10 @@ class WaveResult(NamedTuple):
     fail_counts: jnp.ndarray  # i32 [Q, P]  first-fail per predicate
     masks: jnp.ndarray  # bool [Q, P, N]  per-predicate pass masks
     rr_end: jnp.ndarray  # i32  round-robin counter after the wave
+    # per-priority decomposition of the decision (collect_scores only;
+    # None otherwise — the compiled program is then byte-identical to
+    # the pre-observatory kernel)
+    deco: Optional[ScoreDeco] = None
 
 
 # -- device telemetry --------------------------------------------------------
@@ -124,7 +131,8 @@ def dispatch_bucket(nt, pm, tt, kw, lead=()) -> tuple:
         _device_count(nt.valid),
         int(kw.get("num_label_values", 64)), int(kw.get("num_zones", 0)),
         int(bool(kw.get("has_ipa", False))),
-        int(bool(kw.get("use_pallas", False))))
+        int(bool(kw.get("use_pallas", False))),
+        int(bool(kw.get("collect_scores", False))))
 
 
 def record_dispatch(program: str, bucket_key: tuple, fn):
@@ -174,12 +182,19 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                pb: enc.PodBatch, extra_mask, rr_start, extra_scores,
                weights: Weights, num_zones: int, num_label_values: int,
                has_ipa: bool, use_pallas: bool, pallas_interpret: bool,
-               usage_in=None, taint_ports=None):
+               usage_in=None, taint_ports=None, collect_scores: bool = False):
     """Shared wave computation. usage_in: optional (requested, nonzero,
     pod_count) overriding nt's usage columns — the device-resident carry
     that lets consecutive waves chain without a host roundtrip.
     taint_ports: precomputed (taints_ok, ports_ok) [P, N] from the
-    round path's hoisted Pallas pass. Returns (WaveResult, usage_out)."""
+    round path's hoisted Pallas pass. Returns (WaveResult, usage_out).
+
+    collect_scores (static): keep the per-priority score stack alive
+    through the scan and emit, per pod, the SCORE_STACK contributions of
+    the chosen node plus the top-SCORE_TOPK candidates by weighted total
+    (WaveResult.deco). The weighted-sum feeding argmax is the SAME
+    accumulation expression either way, so placements are bit-identical;
+    off, the program is byte-identical to the pre-observatory kernel."""
     N = nt.valid.shape[0]
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
@@ -198,9 +213,16 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
            if has_ipa else None)
 
     w = weights
-    aff_raw = node_affinity_raw(nt, pb) if w.node_affinity else None
-    taint_raw = taint_intolerable_raw(nt, pb) if w.taint_toleration else None
-    spread_cnt = (spread_counts(pm, pb, N) if w.selector_spread
+    # raw planes also feed the decomposition: under collect_scores they
+    # are computed even at weight 0 (a 0-weight priority still explains
+    # the decision it did not influence — zeroed planes would fabricate
+    # flat 0 / MAX_PRIORITY rows in /debug/score and the ledger)
+    aff_raw = (node_affinity_raw(nt, pb)
+               if w.node_affinity or collect_scores else None)
+    taint_raw = (taint_intolerable_raw(nt, pb)
+                 if w.taint_toleration or collect_scores else None)
+    spread_cnt = (spread_counts(pm, pb, N)
+                  if w.selector_spread or collect_scores
                   else jnp.zeros(static_nonres.shape, jnp.int32))
     static_score = jnp.zeros(static_nonres.shape, jnp.float32)
     if w.image_locality:
@@ -214,9 +236,19 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         aff_raw = jnp.zeros((P, N), jnp.float32)
     if taint_raw is None:
         taint_raw = jnp.zeros((P, N), jnp.float32)
+    if collect_scores:
+        # RAW per-priority planes for the decomposition, computed
+        # regardless of weights (a 0-weight priority still explains the
+        # decision it did not influence); never folded into the total
+        avoid_full = prefer_avoid(nt, pb)
+        img_full = image_locality(nt, pb)
+        extra_full = (extra_scores if extra_scores is not None
+                      else jnp.zeros((P, N), jnp.float32))
 
     def step(carry, x):
         req_c, nz_c, cnt_c, rr, placed = carry
+        if collect_scores:
+            x, (avoid_row, img_row, extra_row) = x[:-3], x[-3:]
         if has_ipa:
             (i, preq, pnz, mask_sn, araw, traw, scnt, sscore, pvalid,
              sym_row, okaff_row, anyaff_s, banti_row, counts_row,
@@ -261,7 +293,8 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         else:
             ipa_ok = jnp.ones_like(feasible)
         total = sscore
-        if has_ipa and w.interpod:
+        fscore = None
+        if has_ipa and (w.interpod or collect_scores):
             cmasked = jnp.where(feasible, counts_row, 0.0)
             cmin = jnp.minimum(jnp.min(cmasked), 0.0)
             cmax = jnp.maximum(jnp.max(cmasked), 0.0)
@@ -269,20 +302,32 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
             fscore = jnp.where(crange > 0,
                                floor_div(10.0 * (counts_row - cmin) / crange),
                                0.0)
+        if has_ipa and w.interpod:
             total = total + w.interpod * fscore
+        aff_n = (normalize_reduce(araw, feasible, False)
+                 if w.node_affinity or collect_scores else None)
         if w.node_affinity:
-            total = total + w.node_affinity * normalize_reduce(araw, feasible, False)
+            total = total + w.node_affinity * aff_n
+        taint_n = (normalize_reduce(traw, feasible, True)
+                   if w.taint_toleration or collect_scores else None)
         if w.taint_toleration:
-            total = total + w.taint_toleration * normalize_reduce(traw, feasible, True)
+            total = total + w.taint_toleration * taint_n
+        spread_n = (spread_reduce(scnt, feasible, nt.zone_id, num_zones)
+                    if w.selector_spread or collect_scores else None)
         if w.selector_spread:
-            total = total + w.selector_spread * spread_reduce(
-                scnt, feasible, nt.zone_id, num_zones)
+            total = total + w.selector_spread * spread_n
+        lr = (least_requested(nz_c, alloc2, pnz)
+              if w.least_requested or collect_scores else None)
         if w.least_requested:
-            total = total + w.least_requested * least_requested(nz_c, alloc2, pnz)
+            total = total + w.least_requested * lr
+        ba = (balanced_allocation(nz_c, alloc2, pnz)
+              if w.balanced or collect_scores else None)
         if w.balanced:
-            total = total + w.balanced * balanced_allocation(nz_c, alloc2, pnz)
+            total = total + w.balanced * ba
+        mr = (most_requested(nz_c, alloc2, pnz)
+              if w.most_requested or collect_scores else None)
         if w.most_requested:
-            total = total + w.most_requested * most_requested(nz_c, alloc2, pnz)
+            total = total + w.most_requested * mr
         sm = jnp.where(feasible, total, -1.0)
         best = jnp.max(sm)
         has = best >= 0
@@ -299,6 +344,22 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         rr = rr + jnp.where(has, 1, 0)
         placed = placed.at[i].set(chosen)
         out = (chosen, best, fits, jnp.sum(feasible.astype(jnp.int32)), ipa_ok)
+        if collect_scores:
+            # SCORE_STACK-ordered raw planes [S, N]; the chosen node's
+            # column and the top-k candidates' columns ride out of the
+            # scan — everything else about the decision is discarded
+            # exactly as before
+            zr = jnp.zeros_like(total)
+            parts = jnp.stack([
+                lr, ba, mr, aff_n, taint_n, spread_n,
+                avoid_row, img_row,
+                fscore if fscore is not None else zr,
+                extra_row,
+            ])
+            kk = min(SCORE_TOPK, N)
+            top_vals, top_idx = lax.top_k(sm, kk)
+            out = out + (parts[:, safe], top_idx.astype(jnp.int32),
+                         top_vals, jnp.take(parts, top_idx, axis=1))
         return (req_c, nz_c, cnt_c, rr, placed), out
 
     usage0 = usage_in if usage_in is not None else (
@@ -317,9 +378,18 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     else:
         xs = (ii, pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw,
               spread_cnt, static_score, pb.valid)
-    (req_end, nz_end, cnt_end, rr_end, _), \
-        (chosen, best, dyn_fits, feas_cnt, ipa_masks) = \
+    if collect_scores:
+        xs = xs + (avoid_full, img_full, extra_full)
+    (req_end, nz_end, cnt_end, rr_end, _), outs = \
         lax.scan(step, carry0, xs)
+    deco = None
+    if collect_scores:
+        (chosen, best, dyn_fits, feas_cnt, ipa_masks,
+         cparts, tidx, tvals, tparts) = outs
+        deco = ScoreDeco(chosen_parts=cparts, top_idx=tidx,
+                         top_vals=tvals, top_parts=tparts)
+    else:
+        chosen, best, dyn_fits, feas_cnt, ipa_masks = outs
 
     masks = masks.at[res_i].set(dyn_fits)
     if has_ipa:
@@ -331,7 +401,8 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     first_fail = ~masks & first & nt.valid[None, None, :]
     fail_counts = jnp.sum(first_fail.astype(jnp.int32), axis=-1)  # [Q, P]
     res = WaveResult(chosen=chosen, score=best, feasible_count=feas_cnt,
-                     fail_counts=fail_counts, masks=masks, rr_end=rr_end)
+                     fail_counts=fail_counts, masks=masks, rr_end=rr_end,
+                     deco=deco)
     return res, (req_end, nz_end, cnt_end)
 
 
@@ -349,13 +420,14 @@ def schedule_wave(*args, **kw):
 
 @functools.partial(jax.jit, static_argnames=(
     "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
-    "pallas_interpret"))
+    "pallas_interpret", "collect_scores"))
 def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                    pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
                    *, weights: Weights,
                    num_zones: int, num_label_values: int = 64,
                    has_ipa: bool = False, use_pallas: bool = False,
-                   pallas_interpret: bool = False) -> WaveResult:
+                   pallas_interpret: bool = False,
+                   collect_scores: bool = False) -> WaveResult:
     """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
     volume predicates) for the rare pods that need them; all-True rows for
     everyone else. Appended to the mask stack as a final "HostPlugins"
@@ -372,7 +444,8 @@ def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     keeps the program identical to the affinity-free kernel."""
     res, _ = _wave_body(nt, pm, tt, pb, extra_mask, rr_start, extra_scores,
                         weights, num_zones, num_label_values, has_ipa,
-                        use_pallas, pallas_interpret)
+                        use_pallas, pallas_interpret,
+                        collect_scores=collect_scores)
     return res
 
 
@@ -417,13 +490,14 @@ def schedule_round(*args, **kw):
 
 @functools.partial(jax.jit, static_argnames=(
     "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
-    "pallas_interpret"))
+    "pallas_interpret", "collect_scores"))
 def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
                     tt: enc.TermTable, pbs: enc.PodBatch,
                     usage, rr_start, pm_rows, term_rows, *,
                    weights: Weights, num_zones: int,
                    num_label_values: int = 64, has_ipa: bool = False,
-                   use_pallas: bool = False, pallas_interpret: bool = False):
+                   use_pallas: bool = False, pallas_interpret: bool = False,
+                   collect_scores: bool = False):
     """An ENTIRE scheduling round as one program: lax.scan over W waves,
     each wave a full _wave_body pass whose placements are staged into the
     pod matrix / term table carries before the next wave runs.
@@ -446,13 +520,17 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
     hoisted Pallas pass before the scan (the fused kernel faults under
     lax.scan on Mosaic; hoisting sidesteps that and amortizes the
     launch), then threaded through the scan as per-wave xs slices.
-    Returns (chosen [W, P], fail_counts [W, Q, P], usage', rr_end)."""
+    Returns (chosen [W, P], fail_counts [W, Q, P], usage', rr_end,
+    deco) — deco a ScoreDeco of [W, P, ...] planes when collect_scores,
+    None otherwise (the compiled program is then unchanged)."""
     W = pbs.req.shape[0]
     P = pbs.req.shape[1]
     N = nt.valid.shape[0]
     ones = jnp.ones((P, N), bool)
 
     Q = len(enc.MASK_STACK_NAMES)
+    S = len(SCORE_STACK)
+    KK = min(SCORE_TOPK, N)
 
     def live_wave(carry, x):
         pm_c, tt_c, usage_c, rr_c = carry
@@ -460,18 +538,29 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
         res, usage_o = _wave_body(nt, pm_c, tt_c, pb, ones, rr_c, None,
                                   weights, num_zones, num_label_values,
                                   has_ipa, False, pallas_interpret,
-                                  usage_in=usage_c, taint_ports=tp)
+                                  usage_in=usage_c, taint_ports=tp,
+                                  collect_scores=collect_scores)
         pm_o, tt_o = _stage_placements(pm_c, tt_c, res.chosen, rows, trows)
-        return (pm_o, tt_o, usage_o, res.rr_end), (res.chosen,
-                                                   res.fail_counts)
+        out = (res.chosen, res.fail_counts)
+        if collect_scores:
+            out = out + tuple(res.deco)
+        return (pm_o, tt_o, usage_o, res.rr_end), out
 
     def padded_wave(carry, x):
         # bucket-padding waves skip the whole body at RUNTIME (lax.cond
         # executes one branch): without this, a padded ipa wave still
         # pays the full O(P*M) precompute — 31 pad waves in a 1-wave
         # warm round cost ~25s of device time for nothing
-        return carry, (jnp.full((P,), -1, jnp.int32),
-                       jnp.zeros((Q, P), jnp.int32))
+        out = (jnp.full((P,), -1, jnp.int32),
+               jnp.zeros((Q, P), jnp.int32))
+        if collect_scores:
+            # pad-wave deco: top_vals at -1 read as "infeasible" so the
+            # host consumer skips them without a special case
+            out = out + (jnp.zeros((P, S), jnp.float32),
+                         jnp.zeros((P, KK), jnp.int32),
+                         jnp.full((P, KK), -1.0, jnp.float32),
+                         jnp.zeros((P, S, KK), jnp.float32))
+        return carry, out
 
     active = jnp.any(pbs.valid, axis=1)  # [W]
     if use_pallas:
@@ -516,8 +605,14 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
         xs = (pbs, pm_rows, term_rows, active)
 
     carry0 = (pm, tt, usage, jnp.asarray(rr_start, jnp.int32))
-    (_, _, usage_end, rr_end), (chosen, fail_counts) = lax.scan(
-        wave, carry0, xs)
-    return chosen, fail_counts, usage_end, rr_end
+    (_, _, usage_end, rr_end), outs = lax.scan(wave, carry0, xs)
+    if collect_scores:
+        chosen, fail_counts, cparts, tidx, tvals, tparts = outs
+        deco = ScoreDeco(chosen_parts=cparts, top_idx=tidx,
+                         top_vals=tvals, top_parts=tparts)
+    else:
+        chosen, fail_counts = outs
+        deco = None
+    return chosen, fail_counts, usage_end, rr_end, deco
 
 

@@ -15,8 +15,11 @@ ops/kernel.py where per-pod feasibility is known.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import encoding as enc
 from .encoding import NodeTensors, PodBatch, PodMatrix
@@ -24,6 +27,53 @@ from .selectors import eval_and_program
 
 MAX_PRIORITY = 10.0
 EPS = 1e-5
+
+# --- score decomposition (the decision observatory) --------------------------
+#
+# The wave scan computes every per-priority score plane and then sums
+# them away before argmax; with collect_scores on (tracing), the scan
+# additionally keeps the stack alive long enough to gather — per pod —
+# the per-priority contributions of the chosen node and the top-k
+# candidates by total score, so "why did node-42 win" is answerable
+# after the fact without recomputing anything. Row order here is the
+# contract for every consumer (ledger, /debug/score, tests).
+SCORE_STACK = (
+    "LeastRequested",
+    "BalancedAllocation",
+    "MostRequested",
+    "NodeAffinity",
+    "TaintToleration",
+    "SelectorSpread",
+    "PreferAvoid",
+    "ImageLocality",
+    "InterPodAffinity",
+    "HostExtra",  # pre-weighted host/extender scores (weight renders as 1)
+)
+# candidates gathered per pod (the chosen node is gathered separately:
+# round-robin tie-breaks can pick a node top_k would rank past K)
+SCORE_TOPK = 4
+
+
+class ScoreDeco(NamedTuple):
+    """Per-pod score decomposition planes fetched alongside a wave's
+    placements (only when tracing): raw 0-10 per-priority scores — NOT
+    weighted — for the chosen node and the top-k nodes by weighted
+    total. Leading axes match the producing program ([P] per wave,
+    [W, P] per round)."""
+
+    chosen_parts: jnp.ndarray  # f32 [..., S]     chosen node's raw scores
+    top_idx: jnp.ndarray  # i32 [..., K]     top-k node indices by total
+    top_vals: jnp.ndarray  # f32 [..., K]     their weighted totals (-1 infeasible)
+    top_parts: jnp.ndarray  # f32 [..., S, K]  their raw per-priority scores
+
+
+def stack_weights(w) -> np.ndarray:
+    """f32 [S] weight vector aligned with SCORE_STACK (HostExtra rows
+    arrive pre-weighted, so weight 1)."""
+    return np.asarray(
+        [w.least_requested, w.balanced, w.most_requested, w.node_affinity,
+         w.taint_toleration, w.selector_spread, w.prefer_avoid,
+         w.image_locality, w.interpod, 1.0], np.float32)
 
 
 def floor_div(x):

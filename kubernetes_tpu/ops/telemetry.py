@@ -1,0 +1,242 @@
+"""On-device cluster-state telemetry: one jitted reduction per round.
+
+The snapshot's node planes are already resident in HBM for scheduling;
+this module answers "what does the cluster look like RIGHT NOW" from
+those same planes without a second data path — the analog of the
+node-exporter / kube-state-metrics aggregations the reference ecosystem
+bolts on externally, computed where the state already lives:
+
+  * per-resource requested / allocatable / free totals, cluster-wide
+    and per zone (zone_id segment sums);
+  * a free-capacity histogram (TELEMETRY_BINS buckets of free fraction
+    per resource) and the inputs of a fragmentation index — the largest
+    single-node free block vs total free, per resource ("180 cores free
+    but no node can take a 16-core pod" is THE fragmentation failure);
+  * feasibility headroom for CANONICAL_SHAPES pod sizes, reusing the
+    wave kernel's resource_fit + node-condition masks ("how many nodes
+    could still take a 4-core pod right now").
+
+Everything packs into ONE f32 vector (integer planes bitcast, exactly
+like ops/preempt.py's stat stack) so the scheduler pays a single
+device->host fetch per traced round.
+
+Determinism contract: the numpy host twin (ops/hostwave.py
+cluster_telemetry_host, used while the device-path breaker is open) must
+be bit-for-bit identical, and sharded must equal unsharded under the
+node-axis mesh. Counts and histograms are integer sums (associative —
+exact in any reduction order); maxes are exact; the only hazard is the
+f32 resource sums, whose value depends on reduction order. Those go
+through `_pairwise_sum`, a fixed halving tree over the (power-of-two
+bucketed) node axis: the SAME association order in numpy, single-device
+XLA, and GSPMD-partitioned XLA, hence the same bits everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from . import encoding as enc
+
+TELEMETRY_BINS = 8  # free-fraction histogram buckets per resource
+
+_GI = float(1024 ** 3)
+# canonical pod shapes for feasibility headroom (name, cpu milli, mem
+# bytes) — the "could a 4-core pod still schedule" probes. Stable order:
+# ledger records and the headroom gauge key off these names.
+CANONICAL_SHAPES = (
+    ("1c-2g", 1000.0, 2 * _GI),
+    ("2c-8g", 2000.0, 8 * _GI),
+    ("4c-16g", 4000.0, 16 * _GI),
+    ("8c-32g", 8000.0, 32 * _GI),
+)
+
+# core resource column names (extended columns are looked up from the
+# snapshot's resource vocab by the exporter)
+CORE_RESOURCE_NAMES = ("cpu", "memory", "ephemeral")
+
+
+def shape_requests(R: int) -> np.ndarray:
+    """f32 [K, R] request vectors for CANONICAL_SHAPES (extended
+    resource columns zero: headroom probes core capacity)."""
+    req = np.zeros((len(CANONICAL_SHAPES), R), np.float32)
+    for i, (_name, cpu, mem) in enumerate(CANONICAL_SHAPES):
+        req[i, enc.RES_CPU] = np.float32(cpu)
+        req[i, enc.RES_MEM] = np.float32(mem)
+    return req
+
+
+def packed_len(R: int, Z: int) -> int:
+    """Length of the packed telemetry vector for R resource columns and
+    Z zone slots."""
+    K = len(CANONICAL_SHAPES)
+    return 4 * R + 2 * Z * R + R * TELEMETRY_BINS + K + 2
+
+
+def _pairwise_sum(x, xp):
+    """Deterministic f32 sum over axis 0 via a fixed halving tree. The
+    node axis is power-of-two bucketed (state/vocab.py bucket_size), but
+    pad defensively — +0.0 is exact. Identical association order in
+    numpy and XLA (and under GSPMD, which partitions the elementwise
+    adds without reassociating them), so the result is bit-identical
+    across backends and shardings."""
+    n = x.shape[0]
+    p = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+    if p != n:
+        x = xp.concatenate(
+            [x, xp.zeros((p - n,) + x.shape[1:], x.dtype)], axis=0)
+    while x.shape[0] > 1:
+        x = x[0::2] + x[1::2]
+    return x[0]
+
+
+def _telemetry_body(nt, shapes_req, num_zones: int, xp):
+    """The reduction, written once over `xp` (numpy or jax.numpy) — the
+    device kernel and the host twin are textually the same program."""
+    R = nt.alloc.shape[1]
+    valid = nt.valid
+    validf = valid[:, None]
+    is_core = xp.arange(R) < enc.RES_FIXED
+
+    alloc = xp.where(validf, nt.alloc, xp.float32(0.0))
+    req = xp.where(validf, nt.requested, xp.float32(0.0))
+    free = xp.maximum(alloc - req, xp.float32(0.0))
+
+    req_total = _pairwise_sum(req, xp)  # f32 [R]
+    alloc_total = _pairwise_sum(alloc, xp)
+    free_total = _pairwise_sum(free, xp)
+    free_max = xp.max(free, axis=0)  # f32 [R], exact in any order
+
+    # per-zone segment sums: one-hot by interned zone id (0 = no zone
+    # key; kept as its own segment so totals still tie out). Looped
+    # over the small static Z axis — a broadcast [N, Z, R] intermediate
+    # would cost Z x the resident planes in HBM (and host RAM on the
+    # degraded path) at 50k nodes; the per-zone masked sum runs the
+    # SAME halving tree over N, so the bits are unchanged.
+    onehot = (nt.zone_id[:, None] == xp.arange(num_zones)[None, :]) \
+        & validf  # [N, Z]
+    zone_req = xp.stack([
+        _pairwise_sum(xp.where(onehot[:, z, None], req, xp.float32(0.0)), xp)
+        for z in range(num_zones)])  # [Z, R]
+    zone_alloc = xp.stack([
+        _pairwise_sum(xp.where(onehot[:, z, None], alloc, xp.float32(0.0)),
+                      xp)
+        for z in range(num_zones)])
+
+    # free-fraction histogram: bin = floor(free/alloc * B) clipped; an
+    # alloc-0 column lands in bin 0. Integer one-hot counts — exact.
+    frac = free / xp.maximum(alloc, xp.float32(1.0))
+    bins = xp.clip(xp.floor(frac * xp.float32(TELEMETRY_BINS)),
+                   0, TELEMETRY_BINS - 1).astype(xp.int32)  # [N, R]
+    hist = xp.sum(
+        ((bins[:, :, None] == xp.arange(TELEMETRY_BINS)[None, None, :])
+         & validf[:, :, None]).astype(xp.int32), axis=0)  # [R, B]
+
+    # feasibility headroom: the wave kernel's own resource fit + the
+    # CheckNodeCondition / CheckNodeUnschedulable masks, counted per
+    # canonical shape
+    c = nt.cond
+    cond_ok = ~(c[:, enc.COND_NOT_READY] | c[:, enc.COND_OUT_OF_DISK]
+                | c[:, enc.COND_NET_UNAVAIL])
+    sched_ok = valid & cond_ok & ~c[:, enc.COND_UNSCHEDULABLE]
+    reqb = shapes_req[:, None, :]  # [K, 1, R]
+    fits_col = nt.requested[None, :, :] + reqb <= nt.alloc[None, :, :]
+    check = is_core[None, None, :] | (reqb > 0)
+    dims_ok = xp.all(fits_col | ~check, axis=-1)  # [K, N]
+    pods_ok = nt.pod_count + 1 <= nt.allowed_pods
+    fits = dims_ok & pods_ok[None, :] & sched_ok[None, :]
+    headroom = xp.sum(fits.astype(xp.int32), axis=1)  # i32 [K]
+
+    counts = xp.stack([xp.sum(valid.astype(xp.int32)),
+                       xp.sum(sched_ok.astype(xp.int32))])  # i32 [2]
+
+    f32_parts = xp.concatenate([
+        req_total, alloc_total, free_total, free_max,
+        zone_req.reshape(-1), zone_alloc.reshape(-1)])
+    i32_parts = xp.concatenate([hist.reshape(-1), headroom, counts])
+    if xp is np:
+        i32_as_f32 = np.ascontiguousarray(
+            i32_parts.astype(np.int32)).view(np.float32)
+    else:
+        from jax import lax
+
+        i32_as_f32 = lax.bitcast_convert_type(
+            i32_parts.astype(xp.int32), xp.float32)
+    return xp.concatenate([f32_parts.astype(xp.float32), i32_as_f32])
+
+
+@functools.partial(jax.jit, static_argnames=("num_zones",))
+def _cluster_telemetry(nt, shapes_req, *, num_zones: int):
+    import jax.numpy as jnp
+
+    return _telemetry_body(nt, shapes_req, num_zones, jnp)
+
+
+def cluster_telemetry(nt, *, num_zones: int):
+    """Device entry point: packed f32 [packed_len(R, Z)] telemetry
+    vector from the resident node tensors. Dispatch is accounted to the
+    jit-cache telemetry like every other kernel."""
+    from .kernel import record_dispatch
+
+    R = nt.alloc.shape[1]
+    sharding = getattr(nt.valid, "sharding", None)
+    try:
+        ndev = len(sharding.device_set) if sharding is not None else 1
+    except Exception:
+        ndev = 1
+    bucket = (nt.valid.shape[0], R, num_zones, ndev)
+    return record_dispatch(
+        "telemetry", bucket,
+        lambda: _cluster_telemetry(nt, shape_requests(R),
+                                   num_zones=num_zones))
+
+
+class ClusterTelemetry:
+    """Host-side view of one packed telemetry vector (device or twin —
+    they are byte-compatible)."""
+
+    def __init__(self, packed, R: int, Z: int):
+        a = np.ascontiguousarray(np.asarray(packed, np.float32))
+        if a.shape != (packed_len(R, Z),):
+            raise ValueError(
+                f"packed telemetry length {a.shape} != {packed_len(R, Z)}")
+        self.packed = a
+        K = len(CANONICAL_SHAPES)
+        B = TELEMETRY_BINS
+        o = 0
+
+        def take(n):
+            nonlocal o
+            v = a[o:o + n]
+            o += n
+            return v
+
+        self.req_total = take(R)
+        self.alloc_total = take(R)
+        self.free_total = take(R)
+        self.free_max = take(R)
+        self.zone_req = take(Z * R).reshape(Z, R)
+        self.zone_alloc = take(Z * R).reshape(Z, R)
+        self.free_hist = np.ascontiguousarray(
+            take(R * B)).view(np.int32).reshape(R, B)
+        self.headroom = np.ascontiguousarray(take(K)).view(np.int32)
+        counts = np.ascontiguousarray(take(2)).view(np.int32)
+        self.nodes_valid = int(counts[0])
+        self.nodes_schedulable = int(counts[1])
+
+    def utilization(self) -> np.ndarray:
+        """requested / allocatable per resource (0 where nothing is
+        allocatable)."""
+        with np.errstate(invalid="ignore", divide="ignore"):
+            u = self.req_total / self.alloc_total
+        return np.where(self.alloc_total > 0, u, 0.0).astype(np.float32)
+
+    def fragmentation(self) -> np.ndarray:
+        """1 - largest_free_block / total_free per resource: 0 when all
+        free capacity sits on one node (a max-size pod can use it), ->1
+        as free capacity shatters into unusably small pieces."""
+        with np.errstate(invalid="ignore", divide="ignore"):
+            f = 1.0 - self.free_max / self.free_total
+        return np.where(self.free_total > 0, f, 0.0).astype(np.float32)

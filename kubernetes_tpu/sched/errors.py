@@ -85,9 +85,13 @@ class FitError(Exception):
     failed_predicates: Dict[str, int] = field(default_factory=dict)
 
     def message(self) -> str:
-        reasons = sorted(
-            f"{count} {reason}" for reason, count in self.failed_predicates.items() if count
-        )
+        # sort by REASON string (reference sortReasonsHistogram,
+        # generic_scheduler.go:72) — sorting the formatted "{count}
+        # {reason}" strings compared lexically on the count, putting
+        # "10 node(s)..." before "2 node(s)..."
+        reasons = [f"{self.failed_predicates[reason]} {reason}"
+                   for reason in sorted(self.failed_predicates)
+                   if self.failed_predicates[reason]]
         return (f"0/{self.num_all_nodes} nodes are available: "
                 f"{', '.join(reasons)}.")
 

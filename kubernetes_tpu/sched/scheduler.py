@@ -47,6 +47,7 @@ from ..state.scrubber import SnapshotScrubber
 from ..state.snapshot import Snapshot
 from ..utils import Metrics, PodBackoff, Trace, faultpoints, tracing
 from ..utils.feature_gates import FeatureGates
+from . import breaker as breaker_mod
 from .breaker import STATE_CODES, DevicePathBreaker
 from .equivalence import EquivalenceCache, equivalence_class
 from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
@@ -250,6 +251,10 @@ class Scheduler:
         # verdict caught the driver bench labeled "pallas" for rounds
         # that hard-code the XLA formulation)
         self._last_path: Optional[str] = None
+        # telemetry gauge children exported last traced round
+        # ({resource names}, {(zone, resource)}) — pruned when the
+        # subject disappears so /metrics never freezes a dead series
+        self._tele_exported: Tuple[set, set] = (set(), set())
         # round-program formulation: None = resolve on first round to
         # pallas_default(); demoted to False permanently if the hoisted
         # pallas round fails on this backend (separate from _use_pallas:
@@ -455,6 +460,239 @@ class Scheduler:
         c = self.snapshot.caps
         return {"nodes": int(np.sum(self.snapshot.valid)),
                 "N": c.N, "M": c.M, "E": c.E}
+
+    def _record_decisions(self, rec, pods: List[api.Pod], chosen,
+                          cparts, tidx, tvals, tparts,
+                          committed: Optional[set] = None) -> Optional[Dict]:
+        """Consume one fetched ScoreDeco slice ([P, ...] numpy arrays
+        aligned with `pods`): per-pod decision entries into the
+        recorder's observatory (/debug/score), margin observations into
+        scheduler_score_margin, weighted per-priority contributions into
+        scheduler_score_priority_points_total, and a per-round aggregate
+        returned for the ledger's `scores` field. Tracing-only by
+        construction — callers gate on the recorder.
+
+        committed: uids whose exact-recheck commit succeeded. A device
+        choice the int64 recheck rejected never became a placement —
+        recording it would have /debug/score claim a binding that
+        never happened."""
+        from ..ops.scores import SCORE_STACK, stack_weights
+
+        w = stack_weights(self.profile.weights())
+        margins: List[float] = []
+        totals: List[float] = []
+        contrib = np.zeros(len(SCORE_STACK), np.float64)
+        names = self.snapshot.node_names
+        placed = 0
+        for i, pod in enumerate(pods):
+            c = int(chosen[i])
+            if c < 0 or c >= len(names):
+                continue
+            if committed is not None and pod.uid not in committed:
+                continue
+            placed += 1
+            total = float(tvals[i][0])  # argmax total == top-1 value
+            totals.append(total)
+            # runner-up: best-scoring DIFFERENT feasible node (the
+            # chosen node usually occupies rank 0; round-robin
+            # tie-breaks can place it deeper, so scan)
+            runner = None
+            for j in range(tidx[i].shape[0]):
+                if int(tidx[i][j]) != c and float(tvals[i][j]) >= 0:
+                    runner = j
+                    break
+            margin = (total - float(tvals[i][runner])
+                      if runner is not None else None)
+            if margin is not None:
+                margins.append(margin)
+                self.metrics.score_margin.observe(margin)
+            wparts = w.astype(np.float64) * cparts[i]
+            contrib += wparts
+            parts = {}
+            for s, name in enumerate(SCORE_STACK):
+                parts[name] = {
+                    "weight": float(w[s]),
+                    "chosen": round(float(cparts[i][s]), 4),
+                    "runner_up": (round(float(tparts[i][s][runner]), 4)
+                                  if runner is not None else None)}
+            top = [{"node": names[int(tidx[i][j])],
+                    "total": round(float(tvals[i][j]), 4)}
+                   for j in range(tidx[i].shape[0])
+                   if float(tvals[i][j]) >= 0 and int(tidx[i][j]) < len(names)]
+            rec.record_decision(pod.uid, {
+                "pod": pod.full_name(),
+                "node": names[c],
+                "round": rec.current().rid,
+                "total": round(total, 4),
+                "margin": None if margin is None else round(margin, 4),
+                "runner_up": (names[int(tidx[i][runner])]
+                              if runner is not None else None),
+                "parts": parts,
+                "top": top,
+            })
+        if not placed:
+            return None
+        for s, name in enumerate(SCORE_STACK):
+            if contrib[s]:
+                self.metrics.score_priority_points.labels(
+                    priority=name).inc(float(contrib[s]))
+        out: Dict = {
+            "min": round(min(totals), 4), "max": round(max(totals), 4),
+            "mean": round(sum(totals) / len(totals), 4),
+            "breakdown": {name: round(float(contrib[s]) / placed, 4)
+                          for s, name in enumerate(SCORE_STACK)
+                          if contrib[s]},
+        }
+        if margins:
+            out["margin"] = {
+                "min": round(min(margins), 4),
+                "mean": round(sum(margins) / len(margins), 4),
+                "max": round(max(margins), 4)}
+        return out
+
+    def _resource_names(self) -> List[str]:
+        """Column -> resource name for the telemetry exports (core
+        columns by convention, extended ones from the resource vocab)."""
+        from ..ops.telemetry import CORE_RESOURCE_NAMES
+
+        names = list(CORE_RESOURCE_NAMES)
+        for c in range(enc.RES_FIXED, self.snapshot.caps.R):
+            try:
+                names.append(self.snapshot.extended.string(
+                    c - enc.RES_FIXED + 1))
+            except Exception:
+                names.append(f"ext{c}")
+        return names
+
+    def _emit_telemetry(self, rt, device_ok: bool = True) -> None:
+        """One cluster-state reduction for a TRACED round (rt is the
+        round trace; callers gate on it, so tracing off costs nothing):
+        the jitted on-device kernel over the resident planes while the
+        breaker allows, the numpy twin otherwise — gauges refreshed,
+        the round-ledger record extended, the stage span marked.
+
+        device_ok: False from degraded rounds — they are entered either
+        with the breaker open or as the immediate fallback after a
+        device failure the breaker hasn't tripped on yet; either way
+        the runtime just misbehaved and a telemetry dispatch could hang
+        the loop where the scheduling path deliberately stepped away."""
+        from ..ops import telemetry as tele
+
+        Z = self.snapshot.caps.Z
+        R = self.snapshot.caps.R
+        packed = None
+        backend = "host"
+        # passive breaker check: allow() would consume the half-open
+        # probe (OPEN -> HALF_OPEN after cooldown) and dispatch an
+        # upload+fetch to a possibly-wedged runtime — the probe belongs
+        # to a scheduling wave, telemetry only rides a CLOSED breaker
+        if device_ok and self.breaker.state == breaker_mod.CLOSED:
+            try:
+                nt, _pm, _tt = self._to_device()
+                packed = np.asarray(tele.cluster_telemetry(nt, num_zones=Z))
+                self.metrics.device_fetch_bytes.inc(packed.nbytes)
+                backend = "device"
+            except Exception:
+                # telemetry must never fail a scheduling round; the
+                # twin serves it from the host planes instead
+                self.metrics.scheduling_errors.labels(
+                    stage="telemetry").inc()
+                packed = None
+        if packed is None:
+            from ..ops import hostwave
+
+            nt, _pm, _tt = self.snapshot.host_tensors()
+            packed = hostwave.cluster_telemetry_host(nt, num_zones=Z)
+        ct = tele.ClusterTelemetry(packed, R, Z)
+        res_names = self._resource_names()
+        util = ct.utilization()
+        frag = ct.fragmentation()
+        m = self.metrics
+        seen_res: set = set()
+        for c, name in enumerate(res_names):
+            if not (ct.alloc_total[c] or ct.req_total[c]):
+                continue
+            seen_res.add(name)
+            m.cluster_requested.labels(resource=name).set(
+                float(ct.req_total[c]))
+            m.cluster_allocatable.labels(resource=name).set(
+                float(ct.alloc_total[c]))
+            m.cluster_free_largest.labels(resource=name).set(
+                float(ct.free_max[c]))
+            m.cluster_fragmentation.labels(resource=name).set(
+                float(frag[c]))
+        for k, (sname, _cpu, _mem) in enumerate(tele.CANONICAL_SHAPES):
+            m.feasibility_headroom.labels(shape=sname).set(
+                int(ct.headroom[k]))
+        zones = {}
+        seen_zone: set = set()
+        # zone slot 0 is "no zone key" (the vocab pad) — real zones only
+        for z in range(1, Z):
+            if not np.any(ct.zone_alloc[z]):
+                continue
+            try:
+                zname = self.snapshot.vocabs.zones.string(z)
+            except Exception:
+                zname = str(z)
+            zu = {}
+            for c, name in enumerate(res_names):
+                if ct.zone_alloc[z][c]:
+                    u = float(ct.zone_req[z][c] / ct.zone_alloc[z][c])
+                    zu[name] = round(u, 4)
+                    seen_zone.add((zname, name))
+                    m.zone_utilization.labels(zone=zname,
+                                              resource=name).set(u)
+            zones[zname] = zu
+        # a zone or resource that disappeared must stop exporting, not
+        # freeze at its last value on /metrics forever
+        prev_res, prev_zone = self._tele_exported
+        for name in prev_res - seen_res:
+            for fam in (m.cluster_requested, m.cluster_allocatable,
+                        m.cluster_free_largest, m.cluster_fragmentation):
+                fam.remove(resource=name)
+        for zname, name in prev_zone - seen_zone:
+            m.zone_utilization.remove(zone=zname, resource=name)
+        self._tele_exported = (seen_res, seen_zone)
+        summary = {
+            "backend": backend,
+            "nodes": ct.nodes_valid,
+            "schedulable": ct.nodes_schedulable,
+            "util": {n: round(float(util[c]), 4)
+                     for c, n in enumerate(res_names)
+                     if ct.alloc_total[c]},
+            "frag": {n: round(float(frag[c]), 4)
+                     for c, n in enumerate(res_names)
+                     if ct.free_total[c]},
+            "headroom": {sname: int(ct.headroom[k])
+                         for k, (sname, _c, _m2) in
+                         enumerate(tele.CANONICAL_SHAPES)},
+            "free_hist": {n: ct.free_hist[c].tolist()
+                          for c, n in enumerate(res_names)
+                          if ct.alloc_total[c]},
+        }
+        if zones:
+            summary["zones"] = zones
+        rt.ledger["telemetry"] = summary
+        rt.mark("telemetry", backend=backend)
+
+    def _count_unschedulable(self, err: FitError) -> None:
+        """scheduler_unschedulable_reasons_total{predicate}: one
+        increment per (failed pod, first-fail predicate) — the FitError
+        text's attribution, finally visible to dashboards."""
+        for reason, count in err.failed_predicates.items():
+            if not count:
+                continue
+            if reason.startswith("Insufficient "):
+                pred = "PodFitsResources"
+            else:
+                pred = REASON_KEYS.get(reason, reason)
+                if pred not in REASONS:
+                    # free-text reasons (filter extenders, host plugins)
+                    # would mint an unbounded, unescaped label value per
+                    # unique message — bucket them; the exact text still
+                    # reaches events via the FitError
+                    pred = "Other"
+            self.metrics.unschedulable_reasons.labels(predicate=pred).inc()
 
     def _to_device(self) -> Tuple[enc.NodeTensors, enc.PodMatrix,
                                   enc.TermTable]:
@@ -709,6 +947,12 @@ class Scheduler:
                 rr0 = replicate(self._active_mesh, rr0)
             if self._round_pallas is None:
                 self._round_pallas = pallas_default()
+            # compile the SAME collect_scores variant the measured
+            # rounds will dispatch: with tracing on they run the
+            # decomposition-carrying program, and warming the other one
+            # would leave a full round compile inside the window this
+            # warm-up exists to protect
+            collect = tracing.active() is not None
 
             def _warm(use_p: bool):
                 out = schedule_round(
@@ -717,7 +961,8 @@ class Scheduler:
                     weights=self.profile.weights(),
                     num_zones=self.snapshot.caps.Z,
                     num_label_values=self.snapshot.num_label_values,
-                    has_ipa=has_ipa, use_pallas=use_p)
+                    has_ipa=has_ipa, use_pallas=use_p,
+                    collect_scores=collect)
                 jax.block_until_ready(out[0])
                 # sacrificial fetch: force the warm execution to actually
                 # run (block_until_ready does not truly wait on tunneled
@@ -875,13 +1120,19 @@ class Scheduler:
         if self._round_pallas is None:
             self._round_pallas = pallas_default()
 
+        # score decomposition rides along EXACTLY when tracing: the
+        # compiled program (and its jit cache bucket) is byte-identical
+        # to the pre-observatory kernel otherwise
+        collect = rt is not None
+
         def _attempt(use_p: bool):
-            chosen_d, fail_d, _usage_end, rr_end = schedule_round(
+            chosen_d, fail_d, _usage_end, rr_end, deco_d = schedule_round(
                 nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,
                 term_rows, weights=self.profile.weights(),
                 num_zones=self.snapshot.caps.Z,
                 num_label_values=self.snapshot.num_label_values,
-                has_ipa=has_ipa, use_pallas=use_p)
+                has_ipa=has_ipa, use_pallas=use_p,
+                collect_scores=collect)
             trace.step("dispatched")
             # FINISH the round before the first fetch: block_until_ready
             # does not poison the transfer path, the fetch does — and a
@@ -893,20 +1144,27 @@ class Scheduler:
                 rt.mark("device_wave", cat="device", waves=nw,
                         path="pallas" if use_p else "xla")
             chosen = np.asarray(chosen_d)
-            self.metrics.device_fetch_bytes.inc(chosen.nbytes)
+            fetched = chosen.nbytes
+            deco = None
+            if deco_d is not None:
+                # the [W, P, S(+K)] decomposition planes are the round's
+                # only extra fetch, bounded by SCORE_TOPK — tracing-only
+                deco = tuple(np.asarray(a) for a in deco_d)
+                fetched += sum(a.nbytes for a in deco)
+            self.metrics.device_fetch_bytes.inc(fetched)
             trace.step("fetched")
             if rt is not None:
-                rt.mark("fetch", cat="device", bytes=int(chosen.nbytes))
-            return chosen, rr_end
+                rt.mark("fetch", cat="device", bytes=int(fetched))
+            return chosen, rr_end, deco
 
         round_pallas = self._round_pallas
         try:
             try:
-                chosen_all, rr_end = _attempt(round_pallas)
+                chosen_all, rr_end, deco_all = _attempt(round_pallas)
                 if round_pallas and not self._round_pallas_checked:
                     # unwarmed process: first-round on-device cross-check
                     # (see warm_pipeline; one-time compile+exec cost)
-                    want, want_rr = _attempt(False)
+                    want, want_rr, want_deco = _attempt(False)
                     if not np.array_equal(chosen_all, want):
                         import sys
 
@@ -914,7 +1172,8 @@ class Scheduler:
                               "formulation on this backend; demoting "
                               "to XLA", file=sys.stderr)
                         self._round_pallas = round_pallas = False
-                        chosen_all, rr_end = want, want_rr
+                        chosen_all, rr_end, deco_all = (want, want_rr,
+                                                        want_deco)
                     self._round_pallas_checked = True
             except Exception as e:
                 if not round_pallas:
@@ -925,7 +1184,7 @@ class Scheduler:
                       f"formulation: {type(e).__name__}: {e}",
                       file=sys.stderr)
                 self._round_pallas = round_pallas = False
-                chosen_all, rr_end = _attempt(False)
+                chosen_all, rr_end, deco_all = _attempt(False)
             self._last_path = "pallas" if round_pallas else "xla"
         except Exception as e:
             # round failed on every formulation: breaker accounting,
@@ -943,6 +1202,7 @@ class Scheduler:
         self.breaker.record_success()
         self._rr = rr_end
         placed = 0
+        committed: set = set()
         retry: List[api.Pod] = []
         for wi, wv in enumerate(waves):
             for i, pod in enumerate(wv):
@@ -952,6 +1212,7 @@ class Scheduler:
                     node_name = self.snapshot.node_names[node_idx]
                     if self._commit(pod, node_name):
                         placed += 1
+                        committed.add(pod.uid)
                         continue
                 # device placement rejected by the exact recheck, or the
                 # pod failed on device: batched device preemption handles
@@ -972,9 +1233,23 @@ class Scheduler:
             if retry:
                 rt.mark("preempt", candidates=len(retry),
                         handled=len(handled))
+            scores = None
+            if deco_all is not None:
+                # flatten the [W, P, ...] planes down to the real pods
+                # (pad waves and pad rows carry no pods by construction)
+                sel = [(wi, i) for wi, wv in enumerate(waves)
+                       for i in range(len(wv))]
+                wi_idx = np.asarray([s[0] for s in sel], np.int64)
+                i_idx = np.asarray([s[1] for s in sel], np.int64)
+                scores = self._record_decisions(
+                    rec, pods, chosen_all[wi_idx, i_idx],
+                    deco_all[0][wi_idx, i_idx], deco_all[1][wi_idx, i_idx],
+                    deco_all[2][wi_idx, i_idx], deco_all[3][wi_idx, i_idx],
+                    committed=committed)
+            self._emit_telemetry(rt)
             rec.end_round(
                 rt, outcome="ok", placed=placed, retried=len(retry),
-                preempted=len(handled),
+                preempted=len(handled), scores=scores,
                 path=self._last_path or "unresolved",
                 snapshot=self._round_snapshot_shape(),
                 breaker=self.breaker.state)
@@ -1241,22 +1516,46 @@ class Scheduler:
         # chunk at wave_size: featurize buckets caps.P by batch length,
         # and a 10k-pod degraded backlog must not balloon the P bucket
         # every later DEVICE wave would recompile under
+        deco_acc: Optional[List] = [] if rt is not None else None
+        committed: set = set()
         for i in range(0, len(pods), self.wave_size):
-            placed += self._host_wave(pods[i:i + self.wave_size], rt)
+            placed += self._host_wave(pods[i:i + self.wave_size], rt,
+                                      deco_acc=deco_acc,
+                                      committed=committed)
         if rt is not None:
+            scores = None
+            if deco_acc:
+                # one decision-recording pass over every twin chunk's
+                # decomposition (the twin computes it in-place — no
+                # fetch; golden-path pods have no decomposition)
+                all_pods = [p for ps, _c, _d in deco_acc for p in ps]
+                chosen_cat = np.concatenate([c for _p, c, _d in deco_acc])
+                planes = [np.concatenate([d[k] for _p, _c, d in deco_acc])
+                          for k in range(4)]
+                scores = self._record_decisions(rec, all_pods, chosen_cat,
+                                                *planes,
+                                                committed=committed)
+            self._emit_telemetry(rt, device_ok=False)
             rec.end_round(rt, outcome="ok", placed=placed, path="host",
-                          breaker=self.breaker.state,
+                          scores=scores, breaker=self.breaker.state,
                           snapshot=self._round_snapshot_shape())
         return placed
 
-    def _host_wave(self, pods: List[api.Pod], rt=None) -> int:
+    def _host_wave(self, pods: List[api.Pod], rt=None,
+                   deco_acc: Optional[List] = None,
+                   committed: Optional[set] = None) -> int:
         """One batched host-twin wave: numpy masks+scores+greedy commit
         over the snapshot's host planes (no device touch — a wedged
         runtime must not be dispatched to), then the same exact int64
         recheck -> assume -> bind commit as the device path. Failures go
         through ONE batched host-twin preemption pass (claimed-capacity
         accounting included), then park with exact FitError attribution
-        from the twin's mask stack."""
+        from the twin's mask stack.
+
+        deco_acc: when tracing, the twin collects the same per-priority
+        score decomposition as the device kernel; (pods, chosen, deco)
+        is appended here for the degraded round's single decision-
+        recording pass."""
         from ..ops import hostwave
 
         if not pods:
@@ -1283,7 +1582,15 @@ class Scheduler:
             nt, pm, tt, pb, extra, self._host_rr, extra_scores,
             weights=self.profile.weights(),
             num_zones=self.snapshot.caps.Z,
-            num_label_values=self.snapshot.num_label_values)
+            num_label_values=self.snapshot.num_label_values,
+            collect_scores=deco_acc is not None)
+        if deco_acc is not None and res.deco is not None:
+            # slice off featurize's P-bucket pad rows: the degraded round
+            # concatenates chunks, so a padded chunk would shift every
+            # later chunk's rows off its pods
+            n = len(pods)
+            deco_acc.append((list(pods), np.asarray(res.chosen[:n]),
+                             tuple(np.asarray(a)[:n] for a in res.deco)))
         self._host_rr = int(res.rr_end)
         self._last_path = "vector"
         trace.step("host wave")
@@ -1297,6 +1604,8 @@ class Scheduler:
             if node_idx >= 0:
                 if self._commit(pod, self.snapshot.node_names[node_idx]):
                     placed += 1
+                    if committed is not None:
+                        committed.add(pod.uid)
                     continue
                 # exact recheck lost a race with f32 arithmetic: retry
                 self.queue.add_if_not_present(pod)
@@ -1312,6 +1621,7 @@ class Scheduler:
             for i, pod in failed:
                 self.metrics.pods_failed.inc()
                 err = self._fit_error(pod, i, res.fail_counts, res)
+                self._count_unschedulable(err)
                 if pod.uid not in handled:
                     self._park_with_backoff(pod)
                 self.store.set_pod_condition(
@@ -1487,7 +1797,10 @@ class Scheduler:
         kw = dict(weights=self.profile.weights(),
                   num_zones=self.snapshot.caps.Z,
                   num_label_values=self.snapshot.num_label_values,
-                  has_ipa=bool(has_ipa))
+                  has_ipa=bool(has_ipa),
+                  # decomposition rides along exactly when tracing; off,
+                  # the compiled program is byte-identical to before
+                  collect_scores=rt is not None)
         try:
             try:
                 res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
@@ -1532,11 +1845,17 @@ class Scheduler:
         if rt is not None:
             rt.mark("device_wave", cat="device", path=self._last_path)
         chosen = np.asarray(res.chosen)
-        self.metrics.device_fetch_bytes.inc(chosen.nbytes)
+        fetched = chosen.nbytes
+        deco = None
+        if res.deco is not None:
+            deco = tuple(np.asarray(a) for a in res.deco)
+            fetched += sum(a.nbytes for a in deco)
+        self.metrics.device_fetch_bytes.inc(fetched)
         trace.step("device wave")
         if rt is not None:
-            rt.mark("fetch", cat="device", bytes=int(chosen.nbytes))
+            rt.mark("fetch", cat="device", bytes=int(fetched))
         placed = 0
+        committed: set = set()
         fail_counts = None
         for i, pod in enumerate(pods):
             self.metrics.schedule_attempts.inc()
@@ -1545,6 +1864,7 @@ class Scheduler:
                 node_name = self.snapshot.node_names[node_idx]
                 if self._commit(pod, node_name):
                     placed += 1
+                    committed.add(pod.uid)
                     continue
                 # exact recheck lost a race with device f32 arithmetic:
                 # retry next wave without counting it unschedulable
@@ -1559,14 +1879,25 @@ class Scheduler:
         if rt is not None:
             rt.mark("commit", placed=placed)
             # scores summary over the wave's placed pods: the round
-            # ledger's (state, placement, outcome) record carries it for
+            # ledger's (state, placement, outcome) record carries the
+            # per-priority breakdown + margin-over-runner-up for
             # offline scoring-weight analysis
-            sc = np.asarray(res.score)
-            won = sc[chosen >= 0]
-            scores = ({"min": round(float(won.min()), 4),
-                       "max": round(float(won.max()), 4),
-                       "mean": round(float(won.mean()), 4)}
-                      if won.size else None)
+            scores = None
+            if deco is not None:
+                scores = self._record_decisions(rec, pods, chosen, *deco,
+                                                committed=committed)
+            if scores is None and committed:
+                # summary only over placements that actually committed —
+                # a device choice the exact recheck rejected never
+                # became a binding and must not produce score stats
+                sc = np.asarray(res.score)
+                won = sc[[i for i, p in enumerate(pods)
+                          if p.uid in committed]]
+                scores = ({"min": round(float(won.min()), 4),
+                           "max": round(float(won.max()), 4),
+                           "mean": round(float(won.mean()), 4)}
+                          if won.size else None)
+            self._emit_telemetry(rt)
             rec.end_round(
                 rt, outcome="ok", placed=placed,
                 failed=len(pods) - placed, path=self._last_path,
@@ -1669,6 +2000,7 @@ class Scheduler:
         if not feasible:
             self.metrics.pods_failed.inc()
             err = FitError(pod.full_name(), len(self.cache.node_infos), reasons)
+            self._count_unschedulable(err)
             if (self.features.enabled("PodPriority")
                     and not self.profile.disable_preemption):
                 # map reason strings back to predicate names for the
@@ -2403,6 +2735,7 @@ class Scheduler:
     def _handle_failure(self, pod: api.Pod, idx: int, fail_counts, res):
         self.metrics.pods_failed.inc()
         err = self._fit_error(pod, idx, fail_counts, res)
+        self._count_unschedulable(err)
         if (self.features.enabled("PodPriority")
                 and not self.profile.disable_preemption):
             t0 = self.clock()

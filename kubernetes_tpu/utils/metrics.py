@@ -125,6 +125,14 @@ class LabeledGauge:
             g = self._children.get(key)
             return g.value if g is not None else 0.0
 
+    def remove(self, **kw) -> None:
+        """Drop a child series so /metrics stops exporting it — a gauge
+        whose subject disappeared (a deleted zone, a drained resource)
+        must vanish, not freeze at its last value."""
+        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
     def children(self) -> List[Gauge]:
         with self._lock:
             return list(self._children.values())
@@ -288,6 +296,37 @@ class Metrics:
         # multi_tk = multi-topology-key required terms)
         self.degraded_golden_pods = LabeledCounter(
             "scheduler_degraded_golden_pods_total", ("reason",))
+        # decision observatory (score decomposition, tracing only):
+        # margin-of-victory distribution over placed pods (winner's
+        # weighted total minus the best DIFFERENT node's), and the
+        # accumulated weighted contribution of each priority to winning
+        # totals — the skew ratio between children says which priority
+        # actually drives placements under the current weights
+        self.score_margin = Histogram("scheduler_score_margin")
+        self.score_priority_points = LabeledCounter(
+            "scheduler_score_priority_points_total", ("priority",))
+        # first-fail predicate attribution for unschedulable pods —
+        # previously reachable only through events and FitError text,
+        # invisible to dashboards
+        self.unschedulable_reasons = LabeledCounter(
+            "scheduler_unschedulable_reasons_total", ("predicate",))
+        # cluster-state telemetry plane (ops/telemetry.py, refreshed
+        # once per traced round): requested/allocatable/free per
+        # resource, the fragmentation index (1 - largest free block /
+        # total free), feasibility headroom per canonical pod shape,
+        # and per-zone utilization
+        self.cluster_requested = LabeledGauge(
+            "scheduler_cluster_requested", ("resource",))
+        self.cluster_allocatable = LabeledGauge(
+            "scheduler_cluster_allocatable", ("resource",))
+        self.cluster_free_largest = LabeledGauge(
+            "scheduler_cluster_free_largest_block", ("resource",))
+        self.cluster_fragmentation = LabeledGauge(
+            "scheduler_cluster_fragmentation_index", ("resource",))
+        self.feasibility_headroom = LabeledGauge(
+            "scheduler_feasibility_headroom", ("shape",))
+        self.zone_utilization = LabeledGauge(
+            "scheduler_zone_utilization", ("zone", "resource"))
 
     def all_series(self):
         out = {}

@@ -31,8 +31,18 @@ from __future__ import annotations
 import json
 import threading
 import time
-from collections import deque
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# Round-ledger JSONL schema version, stamped on every record as "v".
+# Bump when a field changes meaning or disappears; ADDING fields is not
+# a version bump (downstream training jobs must ignore unknown keys).
+# The schema is documented in README "Round-ledger JSONL schema".
+LEDGER_VERSION = 1
+
+# bounded per-pod decision map (the /debug/score backing store): the
+# most recent placement decision per pod UID, evicted oldest-first
+MAX_DECISIONS = 4096
 
 
 class Span:
@@ -174,6 +184,10 @@ class FlightRecorder:
         self._tids: Dict[int, int] = {}
         self._tid_names: Dict[int, str] = {}
         self.ledger_records = 0
+        # decision observatory: pod UID -> the score decomposition of
+        # its most recent placement (scheduler._record_decisions feeds
+        # it; /debug/score?uid= serves it)
+        self.decisions: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
 
     def now(self) -> float:
         return self.clock()
@@ -201,7 +215,11 @@ class FlightRecorder:
     def end_round(self, rt: RoundTrace, **ledger_fields):
         rt.t1 = self.now()
         with self._lock:
-            rt.ledger.update(ledger_fields)
+            # conditional fields are absent, never null-padded (the
+            # documented schema contract): a round that placed nothing
+            # has no `scores` key, not "scores": null
+            rt.ledger.update({k: v for k, v in ledger_fields.items()
+                              if v is not None})
             if self._current is rt:
                 self._current = None
             # record built under the lock (span/event containers are
@@ -231,10 +249,33 @@ class FlightRecorder:
     def pod_span(self, uid: str, name: str, duration: float, **args):
         self.current().pod_span(uid, name, duration, **args)
 
+    # -- decision observatory ------------------------------------------------
+
+    def record_decision(self, uid: str, entry: Dict[str, Any]) -> None:
+        """Store one pod's placement decomposition (bounded; newest
+        decision per UID wins — a requeued pod's final placement is the
+        one that matters)."""
+        with self._lock:
+            self.decisions[uid] = entry
+            self.decisions.move_to_end(uid)
+            while len(self.decisions) > MAX_DECISIONS:
+                self.decisions.popitem(last=False)
+
+    def decision(self, uid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self.decisions.get(uid)
+
+    def recent_decisions(self, n: int = 64) -> List[Tuple[str, Dict[str, Any]]]:
+        """The most recent (uid, entry) pairs, newest last."""
+        with self._lock:
+            items = list(self.decisions.items())
+        return items[-n:]
+
     # -- ledger --------------------------------------------------------------
 
     def _ledger_record(self, rt: RoundTrace) -> Dict[str, Any]:
         rec = {
+            "v": LEDGER_VERSION,
             "round": rt.rid,
             "kind": rt.kind,
             "ts": round(self.epoch_wall + (rt.t0 - self.epoch), 6),
@@ -342,6 +383,31 @@ class FlightRecorder:
             lines.append(f"background: {bg_spans} spans, "
                          f"{bg_events} events")
         return "\n".join(lines) + "\n"
+
+
+def _fmt_score(v) -> str:
+    if v is None:
+        return "-"
+    f = float(v)
+    return f"{int(f)}" if f == int(f) else f"{f:.2f}"
+
+
+def format_decision(uid: str, e: Dict[str, Any]) -> str:
+    """One-line human rendering of a decision entry — the V(10)
+    "Host %s => Score %d" log line, upgraded to an explanation:
+    "p1 -> node-42 won by 3 over node-7: LeastRequested 8 vs 6, ..."."""
+    head = f"{e.get('pod', uid)} -> {e['node']}"
+    margin = e.get("margin")
+    if margin is not None and e.get("runner_up"):
+        head += f" won by {_fmt_score(margin)} over {e['runner_up']}"
+    parts = []
+    for name, p in e.get("parts", {}).items():
+        if not p.get("weight"):
+            continue
+        parts.append(f"{name} {_fmt_score(p.get('chosen'))}"
+                     f" vs {_fmt_score(p.get('runner_up'))}")
+    tail = f" (total {_fmt_score(e.get('total'))}, round {e.get('round')})"
+    return head + ": " + ", ".join(parts) + tail
 
 
 # the active recorder; None = tracing disabled (zero overhead beyond one

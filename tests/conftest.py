@@ -58,6 +58,11 @@ def pytest_configure(config):
         "markers", "mesh: mesh-sharded scheduling plane suite "
                    "(sharded==unsharded parity on the forced 8-device "
                    "CPU mesh; make multichip)")
+    config.addinivalue_line(
+        "markers", "telemetry: decision observatory / cluster-state "
+                   "telemetry suite (score decomposition parity, "
+                   "/debug/score, telemetry plane device==twin; "
+                   "make obs / make chaos)")
 
 
 import pytest  # noqa: E402

@@ -1,0 +1,517 @@
+"""Decision observatory + cluster-state telemetry (ISSUE 9).
+
+Three property groups:
+
+  1. TELEMETRY PARITY — the packed cluster-state vector
+     (ops/telemetry.py) is byte-identical between the jitted device
+     reduction and the numpy twin (ops/hostwave.py
+     cluster_telemetry_host) over randomized snapshots, and between
+     sharded and unsharded dispatch under the 8-device CPU mesh; its
+     unpacked planes tie out internally (histogram counts == valid
+     nodes, headroom <= schedulable nodes).
+  2. SCORE DECOMPOSITION — with collect_scores on, the wave kernel's
+     ScoreDeco planes are bit-for-bit identical to the host twin's,
+     placements are unchanged vs collect_scores off, and the chosen
+     node's per-priority parts recompute to the winning weighted total
+     (the golden-path cross-check: SCORE_STACK . stack_weights ==
+     WaveResult.score).
+  3. OBSERVATORY END-TO-END — a traced scheduler produces per-pod
+     decision entries (served and round-tripped through the
+     HealthServer's /debug/score), round-ledger records carrying the
+     versioned schema, per-priority breakdown + margin, and the
+     telemetry summary; scheduler_unschedulable_reasons_total and the
+     FitError reason-ordering satellite are covered here too.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from helpers import make_node, make_pod
+from kubernetes_tpu.ops import hostwave
+from kubernetes_tpu.ops.scores import SCORE_STACK, SCORE_TOPK, stack_weights
+from kubernetes_tpu.ops.telemetry import (CANONICAL_SHAPES, TELEMETRY_BINS,
+                                          ClusterTelemetry, cluster_telemetry,
+                                          packed_len)
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.errors import FitError
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import tracing
+
+from test_hostwave import _weights, random_world
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    """Tracing is process-global; never leak a recorder between tests."""
+    tracing.disable()
+    yield
+    tracing.disable()
+
+
+# ---------------------------------------------------------------------------
+# telemetry plane parity
+
+
+class TestTelemetryParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_device_host_bitwise_parity(self, seed):
+        """The packed telemetry vector — resource totals, zone sums,
+        free-capacity histogram, fragmentation inputs, feasibility
+        headroom, node counts — byte-identical between the jitted
+        reduction and the numpy twin."""
+        _store, sched, _pending = random_world(seed)
+        Z = sched.snapshot.caps.Z
+        nt_d, _pm, _tt = sched.snapshot.to_device()
+        packed_d = np.asarray(cluster_telemetry(nt_d, num_zones=Z))
+        nt_h, _pm2, _tt2 = sched.snapshot.host_tensors()
+        packed_h = hostwave.cluster_telemetry_host(nt_h, num_zones=Z)
+        assert packed_d.dtype == np.float32
+        assert packed_d.shape == (packed_len(sched.snapshot.caps.R, Z),)
+        assert packed_d.tobytes() == packed_h.tobytes()
+
+    @pytest.mark.mesh
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_sharded_equals_unsharded(self, seed):
+        """Node-axis mesh sharding must not change a single bit: the
+        reductions are integer sums, maxes, and the fixed halving tree,
+        all of which GSPMD partitions without reassociation."""
+        from kubernetes_tpu.parallel.mesh import mesh_for_devices, nodes_divide
+
+        mesh = mesh_for_devices(8)
+        if mesh is None:
+            pytest.skip("single-device backend")
+        _store, sched, _pending = random_world(seed)
+        Z = sched.snapshot.caps.Z
+        assert nodes_divide(mesh, sched.snapshot.caps.N)
+        nt_u, _pm, _tt = sched.snapshot.to_device()
+        packed_u = np.asarray(cluster_telemetry(nt_u, num_zones=Z))
+        nt_s, _pm2, _tt2 = sched.snapshot.to_device(mesh=mesh)
+        packed_s = np.asarray(cluster_telemetry(nt_s, num_zones=Z))
+        assert packed_u.tobytes() == packed_s.tobytes()
+
+    def test_unpacked_planes_tie_out(self):
+        """ClusterTelemetry's views are internally consistent: per-
+        resource histogram counts equal the valid node count, headroom
+        never exceeds schedulable nodes, zone sums never exceed cluster
+        totals, fragmentation in [0, 1]."""
+        _store, sched, _pending = random_world(7)
+        Z = sched.snapshot.caps.Z
+        R = sched.snapshot.caps.R
+        nt, _pm, _tt = sched.snapshot.host_tensors()
+        ct = ClusterTelemetry(
+            hostwave.cluster_telemetry_host(nt, num_zones=Z), R, Z)
+        assert ct.nodes_valid == int(np.sum(sched.snapshot.valid))
+        assert 0 <= ct.nodes_schedulable <= ct.nodes_valid
+        assert ct.free_hist.shape == (R, TELEMETRY_BINS)
+        assert (ct.free_hist.sum(axis=1) == ct.nodes_valid).all()
+        assert len(ct.headroom) == len(CANONICAL_SHAPES)
+        assert (ct.headroom <= ct.nodes_schedulable).all()
+        assert (ct.zone_req.sum(axis=0) <= ct.req_total + 1e-3).all()
+        frag = ct.fragmentation()
+        assert ((frag >= 0) & (frag <= 1)).all()
+        util = ct.utilization()
+        assert (util >= 0).all()
+
+
+# ---------------------------------------------------------------------------
+# score decomposition
+
+
+class TestScoreDecomposition:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_deco_bitwise_parity_and_placements_unchanged(self, seed):
+        """Every ScoreDeco plane identical device vs twin; turning the
+        decomposition on must not move a single placement."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.kernel import schedule_wave
+
+        _store, sched, pending = random_world(seed)
+        pb = sched.featurizer.featurize(pending)
+        P = pb.req.shape[0]
+        extra = np.ones((P, sched.snapshot.caps.N), bool)
+        nt_d, pm_d, tt_d = sched.snapshot.to_device()
+        res_on = schedule_wave(nt_d, pm_d, tt_d, pb, extra,
+                               jnp.asarray(3, jnp.int32), None,
+                               has_ipa=False, collect_scores=True,
+                               **_weights(sched))
+        res_off = schedule_wave(nt_d, pm_d, tt_d, pb, extra,
+                                jnp.asarray(3, jnp.int32), None,
+                                has_ipa=False, **_weights(sched))
+        assert res_off.deco is None
+        assert np.array_equal(np.asarray(res_on.chosen),
+                              np.asarray(res_off.chosen))
+        nt, pm, tt = sched.snapshot.host_tensors()
+        res_h, _usage = hostwave.schedule_wave_host(
+            nt, pm, tt, pb, extra, 3, None, collect_scores=True,
+            **_weights(sched))
+        for field in ("chosen_parts", "top_idx", "top_vals", "top_parts"):
+            d = np.asarray(getattr(res_on.deco, field))
+            h = np.asarray(getattr(res_h.deco, field))
+            assert d.tobytes() == h.tobytes(), field
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_chosen_parts_recompute_to_winning_total(self, seed):
+        """Golden-path cross-check: for every placed pod, the chosen
+        node's raw per-priority parts, weighted by stack_weights,
+        re-accumulate (in f32) to exactly WaveResult.score — and the
+        top-1 candidate value IS the winning total (argmax)."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.kernel import schedule_wave
+
+        _store, sched, pending = random_world(seed)
+        pb = sched.featurizer.featurize(pending)
+        P = pb.req.shape[0]
+        extra = np.ones((P, sched.snapshot.caps.N), bool)
+        nt_d, pm_d, tt_d = sched.snapshot.to_device()
+        res = schedule_wave(nt_d, pm_d, tt_d, pb, extra,
+                            jnp.asarray(0, jnp.int32), None,
+                            has_ipa=False, collect_scores=True,
+                            **_weights(sched))
+        w = stack_weights(sched.profile.weights())
+        chosen = np.asarray(res.chosen)
+        score = np.asarray(res.score)
+        cparts = np.asarray(res.deco.chosen_parts)
+        tvals = np.asarray(res.deco.top_vals)
+        tidx = np.asarray(res.deco.top_idx)
+        assert tidx.shape[1] == min(SCORE_TOPK, sched.snapshot.caps.N)
+        placed = 0
+        for i in range(P):
+            if chosen[i] < 0:
+                continue
+            placed += 1
+            total = np.float32(0.0)
+            for s in range(len(SCORE_STACK)):
+                total = np.float32(
+                    total + np.float32(w[s]) * cparts[i, s])
+            assert total == score[i], (i, total, score[i])
+            assert tvals[i, 0] == score[i]
+        assert placed > 0
+
+
+# ---------------------------------------------------------------------------
+# observatory end-to-end (scheduler + ledger + /debug/score + metrics)
+
+
+def _traced_cluster(nodes=4, pods=12, wave_size=8):
+    rec = tracing.enable()
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=wave_size)
+    for i in range(nodes):
+        store.create("nodes", make_node(f"n{i}", cpu="4"))
+    for i in range(pods):
+        store.create("pods", make_pod(f"p{i}", cpu="100m"))
+    placed = sched.schedule_pending()
+    assert placed == pods
+    return rec, store, sched
+
+
+class TestObservatoryEndToEnd:
+    def test_ledger_carries_versioned_scores_and_telemetry(self):
+        rec, _store, sched = _traced_cluster()
+        rows = rec.ledger_rows()
+        assert rows
+        for r in rows:
+            assert r["v"] == tracing.LEDGER_VERSION
+        pipe = [r for r in rows if r["kind"] == "pipeline"]
+        assert pipe
+        scores = pipe[0]["scores"]
+        assert scores["breakdown"] and "margin" in scores
+        assert set(scores["breakdown"]) <= set(SCORE_STACK)
+        tele = pipe[0]["telemetry"]
+        assert tele["backend"] == "device"
+        assert tele["nodes"] == 4 and tele["schedulable"] == 4
+        assert 0 < tele["util"]["cpu"] < 1
+        assert set(tele["headroom"]) == {n for n, _c, _m in CANONICAL_SHAPES}
+        # telemetry is a stage span too: round coverage stays >= 95%
+        cover = sum(pipe[0]["spans"].values()) / pipe[0]["wall_s"]
+        assert cover >= 0.95
+        sched.close()
+
+    def test_decisions_recorded_and_margin_observed(self):
+        rec, _store, sched = _traced_cluster(pods=6)
+        assert len(rec.decisions) == 6
+        uid, entry = rec.recent_decisions(1)[0]
+        assert entry["node"].startswith("n")
+        assert entry["total"] > 0
+        assert set(entry["parts"]) == set(SCORE_STACK)
+        # 4 feasible identical nodes: a runner-up always exists and the
+        # margin is 0 on the exact tie
+        assert entry["runner_up"] is not None
+        assert entry["margin"] == 0.0
+        assert entry["top"] and entry["top"][0]["total"] == entry["total"]
+        assert sched.metrics.score_margin.total == 6
+        assert sched.metrics.score_priority_points.value(
+            priority="LeastRequested") > 0
+        text = tracing.format_decision(uid, entry)
+        assert "won by" in text and "LeastRequested" in text
+        sched.close()
+
+    def test_debug_score_endpoint_roundtrip(self):
+        from kubernetes_tpu.cli.kube_scheduler import HealthServer
+
+        rec, _store, sched = _traced_cluster(pods=4)
+        hs = HealthServer(lambda: sched)
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hs.port}{path}") as r:
+                    return r.read().decode()
+
+            index = json.loads(get("/debug/score"))
+            assert len(index) == 4
+            uid = index[-1]["uid"]
+            entry = json.loads(get(f"/debug/score?uid={uid}"))
+            assert entry["uid"] == uid
+            assert entry["node"] == rec.decision(uid)["node"]
+            assert set(entry["parts"]) == set(SCORE_STACK)
+            text = get(f"/debug/score?uid={uid}&format=text")
+            assert "->" in text and "vs" in text
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/debug/score?uid=no-such-uid")
+            assert ei.value.code == 404
+        finally:
+            hs.stop()
+            sched.close()
+
+    def test_debug_score_disabled(self):
+        from kubernetes_tpu.cli.kube_scheduler import HealthServer
+
+        store = ObjectStore()
+        sched = Scheduler(store)
+        hs = HealthServer(lambda: sched)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hs.port}/debug/score") as r:
+                assert "tracing disabled" in r.read().decode()
+        finally:
+            hs.stop()
+            sched.close()
+
+    def test_off_costs_no_extra_fetches(self):
+        """Tracing off: no decomposition fetch, no telemetry, no
+        decisions — the fetch counter sees exactly the chosen vector."""
+        assert tracing.active() is None
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        for i in range(2):
+            store.create("nodes", make_node(f"n{i}", cpu="4"))
+        for i in range(4):
+            store.create("pods", make_pod(f"p{i}", cpu="100m"))
+        assert sched.schedule_pending() == 4
+        assert sched.metrics.score_margin.total == 0
+        assert sched.metrics.score_priority_points.total() == 0
+        assert sched.metrics.cluster_requested.children() == []
+        sched.close()
+
+    def test_degraded_round_uses_host_telemetry_and_records(self):
+        """Breaker open: the twin carries the decomposition and the
+        telemetry backend is the host twin."""
+        from kubernetes_tpu.utils import faultpoints
+
+        rec = tracing.enable()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8, breaker_threshold=1)
+        for i in range(3):
+            store.create("nodes", make_node(f"m{i}", cpu="4"))
+        for name in ("kernel.round", "kernel.wave", "kernel.gang"):
+            faultpoints.activate(name, "raise")
+        for i in range(5):
+            store.create("pods", make_pod(f"d{i}", cpu="100m"))
+        assert sched.schedule_pending() == 5
+        deg = [r for r in rec.ledger_rows() if r["kind"] == "degraded"]
+        assert deg
+        assert deg[-1]["telemetry"]["backend"] == "host"
+        assert deg[-1]["scores"]["breakdown"]
+        assert len(rec.decisions) == 5
+        sched.close()
+
+    def test_unschedulable_reasons_metric(self):
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        store.create("nodes", make_node("n0", cpu="2"))
+        store.create("pods", make_pod("huge", cpu="100"))
+        sched.schedule_pending()
+        assert sched.metrics.unschedulable_reasons.value(
+            predicate="PodFitsResources") >= 1
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# observatory regressions
+
+
+class TestObservatoryRegressions:
+    def test_degraded_multichunk_decisions_align(self):
+        """Chunked degraded rounds concatenate per-chunk deco planes;
+        featurize pads each chunk's P up to a power-of-two bucket, so
+        the pad rows must be sliced off before concatenation or every
+        later chunk's decisions shift onto the wrong pods."""
+        from kubernetes_tpu.utils import faultpoints
+
+        rec = tracing.enable()
+        store = ObjectStore()
+        # wave_size 6 buckets to P=8: two pad rows per chunk
+        sched = Scheduler(store, wave_size=6, breaker_threshold=1)
+        for i in range(4):
+            store.create("nodes", make_node(f"n{i}", cpu="8"))
+        for name in ("kernel.round", "kernel.wave", "kernel.gang"):
+            faultpoints.activate(name, "raise")
+        for i in range(12):
+            store.create("pods", make_pod(f"p{i}", cpu="100m"))
+        assert sched.schedule_pending() == 12
+        assert len(rec.decisions) == 12
+        for i in range(12):
+            pod = store.get("pods", "default", f"p{i}")
+            entry = rec.decision(pod.uid)
+            assert entry is not None, pod.metadata.name
+            assert entry["node"] == pod.spec.node_name, pod.metadata.name
+        sched.close()
+
+    def test_zero_weight_priorities_still_explained(self):
+        """A profile that zeroes node_affinity / taint_toleration /
+        selector_spread must still record their REAL raw parts (a
+        0-weight priority still explains the decision it did not
+        influence) — not flat rows normalized from the zeroed score
+        planes; device and twin agree bitwise under those weights."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.api import labels as lbl
+        from kubernetes_tpu.api import types as api
+        from kubernetes_tpu.ops.kernel import schedule_wave
+
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        store.create("nodes", make_node("tainted", cpu="4", taints=[
+            api.Taint(key="dedicated", value="x",
+                      effect="PreferNoSchedule")]))
+        store.create("nodes", make_node("clean", cpu="4",
+                                        labels={"disk": "ssd"}))
+        pref = api.Affinity(node_affinity=api.NodeAffinity(preferred=[
+            api.PreferredSchedulingTerm(
+                weight=10,
+                preference=api.NodeSelectorTerm(match_expressions=[
+                    lbl.Requirement("disk", lbl.IN, ("ssd",))]))]))
+        pod = make_pod("p0", cpu="100m", affinity=pref)
+        w0 = sched.profile.weights()._replace(
+            node_affinity=0.0, taint_toleration=0.0, selector_spread=0.0)
+        pb = sched.featurizer.featurize([pod])
+        P = pb.req.shape[0]
+        extra = np.ones((P, sched.snapshot.caps.N), bool)
+        kw = dict(weights=w0, num_zones=sched.snapshot.caps.Z,
+                  num_label_values=sched.snapshot.num_label_values)
+        nt_d, pm_d, tt_d = sched.snapshot.to_device()
+        res = schedule_wave(nt_d, pm_d, tt_d, pb, extra,
+                            jnp.asarray(0, jnp.int32), None,
+                            has_ipa=False, collect_scores=True, **kw)
+        names = sched.snapshot.node_names
+        tidx = np.asarray(res.deco.top_idx)[0]
+        tvals = np.asarray(res.deco.top_vals)[0]
+        tparts = np.asarray(res.deco.top_parts)[0]  # [S, K]
+        by_name = {}
+        for j in range(tidx.shape[0]):
+            k = int(tidx[j])
+            if tvals[j] >= 0 and 0 <= k < len(names):
+                by_name[names[k]] = tparts[:, j]
+        assert set(by_name) == {"tainted", "clean"}
+        s_taint = SCORE_STACK.index("TaintToleration")
+        s_aff = SCORE_STACK.index("NodeAffinity")
+        assert by_name["clean"][s_taint] == 10.0
+        assert by_name["tainted"][s_taint] == 0.0
+        assert by_name["clean"][s_aff] == 10.0
+        assert by_name["tainted"][s_aff] == 0.0
+        nt, pm, tt = sched.snapshot.host_tensors()
+        res_h, _u = hostwave.schedule_wave_host(
+            nt, pm, tt, pb, extra, 0, None, collect_scores=True, **kw)
+        for field in ("chosen_parts", "top_idx", "top_vals", "top_parts"):
+            assert np.asarray(getattr(res.deco, field)).tobytes() == \
+                np.asarray(getattr(res_h.deco, field)).tobytes(), field
+        sched.close()
+
+    def test_unplaced_round_omits_scores_key(self):
+        """A traced round that places nothing must have no `scores`
+        key at all — the documented schema contract is absent, never
+        null-padded."""
+        rec = tracing.enable()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        store.create("nodes", make_node("n0", cpu="1"))
+        store.create("pods", make_pod("huge", cpu="64"))
+        sched.schedule_pending()
+        rows = rec.ledger_rows()
+        assert rows
+        for r in rows:
+            assert r.get("scores", "absent") is not None
+        assert any("scores" not in r for r in rows)
+        sched.close()
+
+    def test_stale_zone_gauge_pruned(self):
+        """Deleting a zone's last node must remove its utilization
+        series from the export, not freeze it at the last value."""
+        rec = tracing.enable()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        za = {"failure-domain.beta.kubernetes.io/region": "r",
+              "failure-domain.beta.kubernetes.io/zone": "a"}
+        zb = {"failure-domain.beta.kubernetes.io/region": "r",
+              "failure-domain.beta.kubernetes.io/zone": "b"}
+        store.create("nodes", make_node("na", cpu="4", labels=za))
+        store.create("nodes", make_node("nb", cpu="4", labels=zb))
+        store.create("pods", make_pod("p0", cpu="100m"))
+        assert sched.schedule_pending() == 1
+        tele = [r for r in rec.ledger_rows() if "telemetry" in r]
+        assert len(tele[-1]["telemetry"]["zones"]) == 2
+        before = len(sched.metrics.zone_utilization.children())
+        assert before > 0
+        store.delete("nodes", "default", "nb")
+        store.create("pods", make_pod("p1", cpu="100m"))
+        assert sched.schedule_pending() == 1
+        tele = [r for r in rec.ledger_rows() if "telemetry" in r]
+        assert len(tele[-1]["telemetry"]["zones"]) == 1
+        assert len(sched.metrics.zone_utilization.children()) < before
+        sched.close()
+
+    def test_telemetry_never_consumes_half_open_probe(self):
+        """_emit_telemetry must gate on a passive breaker check: with
+        the breaker OPEN and the cooldown elapsed, allow() would flip
+        to HALF_OPEN and aim an upload+fetch at the wedged runtime —
+        the half-open probe belongs to a scheduling wave."""
+        from kubernetes_tpu.sched import breaker as breaker_mod
+
+        rec = tracing.enable()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        store.create("nodes", make_node("n0", cpu="4"))
+        sched.breaker.state = breaker_mod.OPEN
+        sched.breaker.opened_at = sched.breaker.clock() - 1e9
+        rt = rec.begin_round("degraded", pending=0)
+        sched._emit_telemetry(rt)
+        rec.end_round(rt, outcome="ok", placed=0, path="host")
+        assert sched.breaker.state == breaker_mod.OPEN
+        assert rt.ledger["telemetry"]["backend"] == "host"
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# FitError ordering satellite
+
+
+class TestFitErrorOrdering:
+    def test_message_sorts_by_reason_not_formatted_string(self):
+        """sortReasonsHistogram sorts reason strings; sorting the
+        formatted "{count} {reason}" lines compared '10 b...' < '2 a...'
+        lexically and emitted counts out of reason order."""
+        err = FitError("ns/p", 12, {"node(s) zzz": 2, "node(s) aaa": 10})
+        assert err.message() == ("0/12 nodes are available: "
+                                 "10 node(s) aaa, 2 node(s) zzz.")
+
+    def test_zero_count_reasons_dropped(self):
+        err = FitError("ns/p", 3, {"a": 0, "b": 3})
+        assert err.message() == "0/3 nodes are available: 3 b."
