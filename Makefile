@@ -19,18 +19,32 @@ test: native
 test-unit: native
 	$(PYTHON) -m pytest tests/test_kernel_smoke.py tests/test_parity.py -x -q
 
+# Static analysis: ktpu-lint (kubernetes_tpu/analysis), the go vet
+# analog — AST rules enforcing jit-purity, determinism, twin-coverage,
+# f32-reduction discipline, lock discipline, and metrics hygiene.
+# Exits non-zero on any finding that is neither suppressed
+# (`# ktpu: allow[rule]`) nor in analysis/baseline.json.
+lint:
+	$(PYTHON) -m kubernetes_tpu.analysis
+
+# The standing verification surface: static analysis first (cheap,
+# catches invariant drift before any test runs), then the full tier.
+verify: lint test
+
 # Chaos tier: component-crash suite + the fault-injection suite
 # (`faults`/`chaos` markers: scrubber, device-path breaker, fault
 # points, leader failover) + the `partition` zone-disruption suite
 # (eviction storm control under mass node failure) + the `hostpath`
-# numpy-twin suite (breaker-open degraded waves, device==host parity).
+# numpy-twin suite (breaker-open degraded waves, device==host parity)
+# + the `racecheck` lock-order suite (go test -race analog, incl. the
+# runtime-edges ⊆ static-lock-graph bridge against ktpu-lint).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
@@ -67,4 +81,5 @@ bench-all:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-unit chaos obs multichip bench bench-all clean
+.PHONY: all native test test-unit lint verify chaos obs multichip bench \
+	bench-all clean

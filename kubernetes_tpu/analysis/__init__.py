@@ -1,0 +1,57 @@
+"""ktpu-lint: invariant-enforcing static analysis for the scheduling plane.
+
+The reference leans on `go vet` and `go test -race` as standing
+correctness infrastructure (SURVEY §5). This package is the
+reproduction's analog: an AST-based rule engine that machine-checks the
+invariants this codebase has earned the hard way, instead of trusting
+review to remember them:
+
+  jit-purity       no fault points, metrics, clocks, logging, or self-
+                   mutation inside functions reachable from a jax.jit /
+                   lax.scan boundary in ops/ (the PR 2 rule: a fire()
+                   inside a jitted body only runs at trace time, so
+                   injected faults silently vanish once the compile
+                   cache warms)
+  determinism      no iteration over set-typed values in scheduling-
+                   order-sensitive packages (the PR 8 bug: gang members
+                   in a set made placements vary run-to-run with the
+                   uid hash seed)
+  twin-coverage    every public device kernel has a numpy host twin in
+                   ops/hostwave.py and a parity test naming both (the
+                   degraded path must never silently lose coverage)
+  f32-reduction    raw jnp.sum/np.sum over f32 planes in ops/ must use
+                   the _pairwise_sum fixed halving tree so numpy == XLA
+                   == GSPMD bit-for-bit
+  lock-discipline  the statically-extracted lock acquisition graph has
+                   no order inversions, no blocking I/O under component
+                   locks, and no device dispatch under the scheduler
+                   lock from outside the scheduler (the PR 4 rule);
+                   the graph is exported for the runtime LockOrderWatcher
+                   superset check (tests/test_racecheck.py)
+  metrics-hygiene  labeled metric families declare a bounded label set
+                   (values=/open_labels= at construction) or route
+                   dynamic values through utils.metrics.bounded_label
+                   (the PR 9 "Other" bucketing)
+
+Run it:
+
+    python -m kubernetes_tpu.analysis            # whole tree, exit != 0
+                                                 # on non-baselined findings
+    make lint                                    # same, from the Makefile
+
+Per-line suppression (same line or the line directly above):
+
+    for f in list(self._inflight):  # ktpu: allow[determinism] drain-all
+
+Grandfathered findings live in analysis/baseline.json; refresh it with
+`python -m kubernetes_tpu.analysis --update-baseline` after reviewing
+that every newly-baselined finding is intentional. The determinism and
+jit-purity baselines are kept EMPTY by policy — findings there are
+fixed, not grandfathered (tests/test_analysis.py enforces it).
+"""
+
+from .core import Baseline, Finding, Report, load_corpus, run_analysis
+from .rules import ALL_RULES
+
+__all__ = ["ALL_RULES", "Baseline", "Finding", "Report", "load_corpus",
+           "run_analysis"]

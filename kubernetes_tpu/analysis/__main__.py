@@ -1,0 +1,102 @@
+"""CLI: python -m kubernetes_tpu.analysis [paths...]
+
+Exit 0 when every finding is suppressed or baselined; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import Baseline, load_corpus, run_analysis
+from .rules import ALL_RULES, RULES_BY_NAME
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ktpu-lint",
+        description="invariant-enforcing static analysis for the "
+                    "device/host scheduling plane")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative path prefixes to report on "
+                         "(default: everything)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule names (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "kubernetes_tpu/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(review the diff — grandfathering is debt)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            doc = (r.__doc__ or "").strip().split("\n")[0]
+            print(f"{r.name:16s} {doc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        names = [n.strip() for n in args.rules.split(",") if n.strip()]
+        unknown = [n for n in names if n not in RULES_BY_NAME]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        rules = [RULES_BY_NAME[n] for n in names]
+
+    corpus = load_corpus()
+    baseline_path = (Path(args.baseline) if args.baseline
+                     else Baseline.default_path(corpus.root))
+    baseline = (Baseline() if args.no_baseline
+                else Baseline.load(baseline_path))
+    # the baseline is a whole-tree artifact: updating it through a path
+    # filter would silently drop every out-of-path entry
+    paths = () if args.update_baseline else tuple(args.paths)
+    if args.update_baseline and args.paths:
+        print("note: path filters are ignored with --update-baseline "
+              "(the baseline always covers the whole tree)",
+              file=sys.stderr)
+    report = run_analysis(rules=rules, baseline=baseline,
+                          paths=paths, corpus=corpus)
+
+    if args.update_baseline:
+        # entries for rules that did not run this invocation are kept
+        # verbatim — a --rules filter refreshes only its own rules
+        kept = [e for e in baseline.entries
+                if e["rule"] not in set(report.rules_run)]
+        fresh = Baseline.from_findings(report.new + report.baselined)
+        Baseline(kept + fresh.entries).save(baseline_path)
+        print(f"baseline updated: {baseline_path} "
+              f"({len(kept) + len(fresh.entries)} entries)")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) for f in report.new],
+            "baselined": [vars(f) for f in report.baselined],
+            "suppressed": [vars(f) for f in report.suppressed],
+            "stale_baseline": report.stale_baseline,
+            "rules": report.rules_run,
+        }, indent=2))
+    else:
+        for f in report.new:
+            print(f.render())
+            print(f"    {f.snippet}")
+        print(f"ktpu-lint: {report.summary()}")
+        if report.new:
+            print("    (suppress a reviewed exemption with "
+                  "`# ktpu: allow[<rule>] <reason>` on the line or the "
+                  "line above)")
+    return 0 if report.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""Rule engine core: corpus loading, suppressions, baseline, runner.
+
+Design notes:
+
+  * Findings are matched against the baseline by (rule, path, snippet) —
+    the stripped source line — NOT by line number, so unrelated edits
+    above a grandfathered finding don't resurrect it. Matching is
+    multiset one-to-one: a second identical line is a NEW finding.
+  * Suppressions are per-line comments `# ktpu: allow[rule]` (comma list
+    or `all`), honored on the finding's line or the line directly above
+    it. A suppression is an acknowledged, reviewed exemption; the
+    baseline is unreviewed debt — keep the distinction.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*ktpu:\s*allow\[([a-z0-9_,\- ]+)\]")
+
+
+def repo_root() -> Path:
+    """The directory holding the kubernetes_tpu package (and tests/)."""
+    return Path(__file__).resolve().parents[2]
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    snippet: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+
+class SourceFile:
+    """One parsed python file plus its suppression map."""
+
+    def __init__(self, path: Path, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        # line -> set of suppressed rule names ('all' wildcards)
+        self.suppressions: Dict[int, set] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.suppressions[i] = rules
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            rules = self.suppressions.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule=rule, path=self.relpath, line=line,
+                       message=message, snippet=self.snippet(line))
+
+
+class Corpus:
+    """Every analyzable file, keyed by repo-relative path."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self.files: Dict[str, SourceFile] = {}
+        # raw text of tests/*.py for rules that check test coverage
+        self.test_texts: Dict[str, str] = {}
+
+    def under(self, prefix: str) -> List[SourceFile]:
+        return [sf for rel, sf in sorted(self.files.items())
+                if rel.startswith(prefix)]
+
+
+def load_corpus(root: Optional[Path] = None) -> Corpus:
+    root = root or repo_root()
+    corpus = Corpus(root)
+    pkg = root / "kubernetes_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        try:
+            corpus.files[rel] = SourceFile(path, rel)
+        except SyntaxError as e:  # a broken file is itself a finding
+            raise SystemExit(f"ktpu-lint: cannot parse {rel}: {e}")
+    tests = root / "tests"
+    if tests.is_dir():
+        for path in sorted(tests.glob("*.py")):
+            corpus.test_texts[path.name] = path.read_text()
+    return corpus
+
+
+class Baseline:
+    """Checked-in multiset of grandfathered findings."""
+
+    def __init__(self, entries: Sequence[dict] = ()):
+        self.entries: List[dict] = list(entries)
+
+    @staticmethod
+    def default_path(root: Optional[Path] = None) -> Path:
+        return (root or repo_root()) / "kubernetes_tpu" / "analysis" / \
+            "baseline.json"
+
+    @classmethod
+    def load(cls, path: Optional[Path] = None) -> "Baseline":
+        path = path or cls.default_path()
+        if not Path(path).exists():
+            return cls()
+        data = json.loads(Path(path).read_text())
+        return cls(data.get("entries", []))
+
+    def save(self, path: Optional[Path] = None) -> None:
+        path = path or self.default_path()
+        data = {"version": 1,
+                "comment": "grandfathered ktpu-lint findings; regenerate "
+                           "with python -m kubernetes_tpu.analysis "
+                           "--update-baseline",
+                "entries": self.entries}
+        Path(path).write_text(json.dumps(data, indent=2, sort_keys=False)
+                              + "\n")
+
+    @staticmethod
+    def from_findings(findings: Sequence[Finding]) -> "Baseline":
+        return Baseline([
+            {"rule": f.rule, "path": f.path, "snippet": f.snippet}
+            for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line))
+        ])
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """(new, baselined, stale_entries) — one-to-one multiset match."""
+        budget: Dict[Tuple[str, str, str], int] = {}
+        for e in self.entries:
+            k = (e["rule"], e["path"], e["snippet"])
+            budget[k] = budget.get(k, 0) + 1
+        new: List[Finding] = []
+        matched: List[Finding] = []
+        for f in findings:
+            k = f.key()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                matched.append(f)
+            else:
+                new.append(f)
+        stale = []
+        for e in self.entries:
+            k = (e["rule"], e["path"], e["snippet"])
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                stale.append(e)
+        return new, matched, stale
+
+
+@dataclass
+class Report:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    rules_run: List[str] = field(default_factory=list)
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        return self.new + self.baselined + self.suppressed
+
+    def ok(self) -> bool:
+        return not self.new
+
+    def summary(self) -> str:
+        per_rule: Dict[str, int] = {}
+        for f in self.new:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        parts = [f"{len(self.new)} finding(s)"]
+        if per_rule:
+            parts.append("(" + ", ".join(
+                f"{r}: {n}" for r, n in sorted(per_rule.items())) + ")")
+        parts.append(f"{len(self.baselined)} baselined")
+        parts.append(f"{len(self.suppressed)} suppressed")
+        if self.stale_baseline:
+            parts.append(f"{len(self.stale_baseline)} stale baseline "
+                         "entr(y/ies) — run --update-baseline")
+        return ", ".join(parts)
+
+
+def run_analysis(root: Optional[Path] = None,
+                 rules: Optional[Sequence] = None,
+                 baseline: Optional[Baseline] = None,
+                 paths: Sequence[str] = (),
+                 corpus: Optional[Corpus] = None) -> Report:
+    """Run `rules` (default: all) over the tree; classify findings
+    against suppressions and the baseline. `paths` filters findings to
+    repo-relative prefixes (the corpus is always loaded whole — cross-
+    file rules need it)."""
+    from .rules import ALL_RULES
+
+    corpus = corpus or load_corpus(root)
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    baseline = baseline if baseline is not None else Baseline.load(
+        Baseline.default_path(corpus.root))
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.run(corpus))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        sf = corpus.files.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            live.append(f)
+    # classify against the baseline over the WHOLE tree, then filter for
+    # reporting — a path filter must never make out-of-path baseline
+    # entries look stale (they'd get dropped on --update-baseline)
+    new, baselined, stale = baseline.split(live)
+    if paths:
+        def within(fs):
+            return [f for f in fs
+                    if any(f.path.startswith(p) for p in paths)]
+        new, baselined, suppressed = (within(new), within(baselined),
+                                      within(suppressed))
+    return Report(new=new, baselined=baselined, suppressed=suppressed,
+                  stale_baseline=stale,
+                  rules_run=[r.name for r in rules])

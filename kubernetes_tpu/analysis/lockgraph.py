@@ -1,0 +1,313 @@
+"""Static extraction of the lock acquisition graph.
+
+Scans sched/, state/, client/ (and the cluster autoscaler, which takes
+the scheduler's lock) for:
+
+  * lock attributes: `self.x = threading.Lock()/RLock()/Condition()`
+    (including `lock or threading.RLock()` default patterns), named
+    `Class.attr` — e.g. `Scheduler._mu`, `SchedulingQueue._lock`;
+  * component typing: `self.queue = SchedulingQueue(...)` in a method
+    body types `self.queue`, so `self.queue.push()` resolves to
+    `SchedulingQueue.push`;
+  * per-method acquired-lock sets, closed transitively over resolvable
+    calls (self.m(), self.<typed attr>.m(), <typed local>.m());
+  * edges (A, B): lock B is acquired (directly or via a resolved call)
+    inside a `with`/acquire() region holding lock A.
+
+The runtime LockOrderWatcher (utils/racecheck.py), when enabled via the
+scheduler's `racecheck=True` / `--racecheck`, instruments the same locks
+under the same `Class.attr` names — tests/test_racecheck.py asserts the
+edges it observes under live traffic are a SUBSET of this static graph,
+so the static analysis provably covers what runtime race checking can
+see (and keeps seeing paths tests didn't happen to exercise).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Corpus, SourceFile
+from .rules import dotted
+
+SCOPES = ("kubernetes_tpu/sched/", "kubernetes_tpu/state/",
+          "kubernetes_tpu/client/",
+          "kubernetes_tpu/controllers/clusterautoscaler.py")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        name = (dotted(node.func) or "").split(".")[-1]
+        return name in _LOCK_CTORS
+    if isinstance(node, ast.BoolOp):  # lock or threading.RLock()
+        return any(_is_lock_ctor(v) for v in node.values)
+    return False
+
+
+class LockGraph:
+    def __init__(self):
+        # (lock_a, lock_b) -> [(SourceFile, line), ...] where b is taken
+        # while a is held
+        self.edges: Dict[Tuple[str, str], List[Tuple[SourceFile, int]]] = {}
+        # every call made lexically under a lock: (file, line, lock, call)
+        self.calls_under_locks: List[Tuple[SourceFile, int, str, str]] = []
+        # classes whose methods hold each lock natively
+        self.lock_owners: Dict[str, str] = {}  # "Scheduler._mu" -> "Scheduler"
+        self._scheduler_spans: List[Tuple[str, int, int]] = []
+
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        return set(self.edges.keys())
+
+    def add_edge(self, a: str, b: str, sf: SourceFile, line: int):
+        self.edges.setdefault((a, b), []).append((sf, line))
+
+    def site_in_scheduler(self, sf: SourceFile, line: int) -> bool:
+        for rel, lo, hi in self._scheduler_spans:
+            if rel == sf.relpath and lo <= line <= hi:
+                return True
+        return False
+
+
+class _ClassInfo:
+    def __init__(self, sf: SourceFile, node: ast.ClassDef):
+        self.sf = sf
+        self.node = node
+        self.name = node.name
+        self.lock_attrs: Set[str] = set()
+        self.typed_attrs: Dict[str, str] = {}  # attr -> class name
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        for item in ast.walk(node):
+            if isinstance(item, ast.Assign) and len(item.targets) == 1:
+                t = item.targets[0]
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    value = item.value
+                    if isinstance(value, ast.IfExp):
+                        # self.ecache = (EquivalenceCache() if ... else None)
+                        value = (value.body if isinstance(value.body, ast.Call)
+                                 else value.orelse)
+                    if _is_lock_ctor(item.value):
+                        self.lock_attrs.add(t.attr)
+                    elif isinstance(value, ast.Call):
+                        ctor = (dotted(value.func) or "").split(".")[-1]
+                        self.typed_attrs[t.attr] = ctor
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+def extract_lock_graph(corpus: Corpus) -> LockGraph:
+    classes: Dict[str, _ClassInfo] = {}
+    for scope in SCOPES:
+        for sf in corpus.under(scope):
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    info = _ClassInfo(sf, node)
+                    classes[info.name] = info
+    graph = LockGraph()
+    for info in classes.values():
+        for attr in info.lock_attrs:
+            graph.lock_owners[info.lock_id(attr)] = info.name
+        if info.name == "Scheduler":
+            end = max((n.lineno for n in ast.walk(info.node)
+                       if hasattr(n, "lineno")), default=info.node.lineno)
+            graph._scheduler_spans.append(
+                (info.sf.relpath, info.node.lineno, end))
+
+    # unique lock-attr names let `sched._mu` resolve without type info
+    attr_counts: Dict[str, List[str]] = {}
+    for info in classes.values():
+        for attr in info.lock_attrs:
+            attr_counts.setdefault(attr, []).append(info.lock_id(attr))
+    unique_attr = {a: ids[0] for a, ids in attr_counts.items()
+                   if len(ids) == 1}
+
+    resolver = _Resolver(classes, unique_attr)
+    acquires = _method_acquire_fixpoint(classes, resolver)
+    for info in classes.values():
+        for mname, method in info.methods.items():
+            _walk_method(graph, info, method, resolver, acquires)
+    return graph
+
+
+class _Resolver:
+    def __init__(self, classes: Dict[str, _ClassInfo],
+                 unique_attr: Dict[str, str]):
+        self.classes = classes
+        self.unique_attr = unique_attr
+
+    def lock_of_expr(self, info: _ClassInfo, expr: ast.AST,
+                     local_types: Dict[str, str]) -> Optional[str]:
+        """Resolve a with-context / acquire() receiver to a lock id."""
+        name = dotted(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] == "self":
+            if parts[1] in info.lock_attrs:
+                return info.lock_id(parts[1])
+            return None
+        if len(parts) == 3 and parts[0] == "self":
+            comp = self.classes.get(
+                local_types.get(parts[1])
+                or info.typed_attrs.get(parts[1], ""))
+            if comp and parts[2] in comp.lock_attrs:
+                return comp.lock_id(parts[2])
+        if len(parts) >= 2:
+            # typed local (`sched = self.scheduler` has no type) — fall
+            # back to globally-unique lock attr names
+            attr = parts[-1]
+            cname = local_types.get(parts[0])
+            comp = self.classes.get(cname or "")
+            if comp and attr in comp.lock_attrs:
+                return comp.lock_id(attr)
+            return self.unique_attr.get(attr)
+        return None
+
+    def method_of_call(self, info: _ClassInfo, call: ast.Call,
+                       local_types: Dict[str, str]
+                       ) -> Optional[Tuple[str, str]]:
+        """Resolve a call to ('Class', 'method') when possible."""
+        name = dotted(call.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] == "self":
+            if parts[1] in info.methods:
+                return (info.name, parts[1])
+            comp = self.classes.get(info.typed_attrs.get(parts[1], ""))
+            if comp is not None and "__call__" in comp.methods:
+                return (comp.name, "__call__")
+            return None
+        if len(parts) == 3 and parts[0] == "self":
+            comp = self.classes.get(info.typed_attrs.get(parts[1], ""))
+            if comp and parts[2] in comp.methods:
+                return (comp.name, parts[2])
+            return None
+        if len(parts) == 2:
+            comp = self.classes.get(local_types.get(parts[0], ""))
+            if comp and parts[1] in comp.methods:
+                return (comp.name, parts[1])
+        return None
+
+
+def _local_types(info: _ClassInfo, method) -> Dict[str, str]:
+    """`sched = self.scheduler` style aliases: local name -> class name,
+    via the enclosing class's typed attrs or direct constructions."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            src = dotted(node.value)
+            if src and src.startswith("self.") and src.count(".") == 1:
+                attr = src.split(".")[1]
+                if attr in info.typed_attrs:
+                    out[tgt] = info.typed_attrs[attr]
+            elif isinstance(node.value, ast.Call):
+                ctor = (dotted(node.value.func) or "").split(".")[-1]
+                out[tgt] = ctor
+    return out
+
+
+def _method_acquire_fixpoint(classes: Dict[str, _ClassInfo],
+                             resolver: _Resolver
+                             ) -> Dict[Tuple[str, str], Set[str]]:
+    """(class, method) -> every lock the method may acquire, transitively
+    over resolvable calls."""
+    acquires: Dict[Tuple[str, str], Set[str]] = {}
+    direct: Dict[Tuple[str, str], Set[str]] = {}
+    callees: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+    for info in classes.values():
+        for mname, method in info.methods.items():
+            key = (info.name, mname)
+            locks: Set[str] = set()
+            calls: Set[Tuple[str, str]] = set()
+            ltypes = _local_types(info, method)
+            for node in ast.walk(method):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lk = resolver.lock_of_expr(info, item.context_expr,
+                                                   ltypes)
+                        if lk:
+                            locks.add(lk)
+                elif isinstance(node, ast.Call):
+                    name = dotted(node.func) or ""
+                    if name.endswith(".acquire"):
+                        lk = resolver.lock_of_expr(
+                            info, node.func.value, ltypes)
+                        if lk:
+                            locks.add(lk)
+                    else:
+                        m = resolver.method_of_call(info, node, ltypes)
+                        if m:
+                            calls.add(m)
+            direct[key] = locks
+            callees[key] = calls
+            acquires[key] = set(locks)
+    for _ in range(len(acquires)):
+        grew = False
+        for key, locks in acquires.items():
+            for callee in callees.get(key, ()):
+                extra = acquires.get(callee, set()) - locks
+                if extra:
+                    locks.update(extra)
+                    grew = True
+        if not grew:
+            break
+    return acquires
+
+
+def _walk_method(graph: LockGraph, info: _ClassInfo, method,
+                 resolver: _Resolver, acquires) -> None:
+    ltypes = _local_types(info, method)
+
+    def visit(node: ast.AST, held: Tuple[str, ...]):
+        if isinstance(node, ast.With):
+            new_locks = []
+            for item in node.items:
+                lk = resolver.lock_of_expr(info, item.context_expr, ltypes)
+                if lk:
+                    # earlier items of the SAME `with a, b:` statement
+                    # are already held when b is acquired — they form
+                    # edges too, exactly like lexical nesting
+                    for h in held + tuple(new_locks):
+                        if h != lk:
+                            graph.add_edge(h, lk, info.sf, node.lineno)
+                    new_locks.append(lk)
+            inner = held + tuple(new_locks)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name and held:
+                for h in held:
+                    graph.calls_under_locks.append(
+                        (info.sf, node.lineno, h, name))
+                m = resolver.method_of_call(info, node, ltypes)
+                if m:
+                    for lk in acquires.get(m, ()):
+                        for h in held:
+                            if h != lk:
+                                graph.add_edge(h, lk, info.sf, node.lineno)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs (callbacks) execute later, not under the lock
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:
+        visit(stmt, ())
+
+
+def static_lock_graph(corpus: Optional[Corpus] = None) -> Set[Tuple[str, str]]:
+    """The edge set, for the runtime-superset assertion in tests."""
+    from .core import load_corpus
+
+    return extract_lock_graph(corpus or load_corpus()).edge_set()

@@ -1,0 +1,874 @@
+"""The rule set. Each rule is grounded in a bug class this repo hit:
+
+  jit-purity       PR 2: fault points must stay outside the jit boundary
+  determinism      PR 8: gang members in a `set` made placements vary
+                   run-to-run with the uid hash seed
+  twin-coverage    PR 7: the degraded path is only as good as the twin
+  f32-reduction    PR 9: f32 sums must associate identically on numpy,
+                   XLA and GSPMD (_pairwise_sum halving tree)
+  lock-discipline  PR 4: no device dispatch under the scheduler lock
+                   from outside the scheduler; no blocking I/O under
+                   component locks; no static lock-order inversions
+  metrics-hygiene  PR 9: labeled metrics declare a bounded label set or
+                   bucket free text into "Other" (utils.metrics.bounded_label)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Corpus, Finding, SourceFile
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_skipping_nested_functions(body: Iterable[ast.AST]):
+    """Walk statements without descending into nested function/class
+    defs (their bodies are separate scopes)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+
+class JitPurityRule:
+    """Functions reachable from a jax.jit / pallas boundary in ops/ must
+    be pure tracers: no fault points (fire() only runs at trace time and
+    silently stops firing once the compile cache warms — the PR 2 bug),
+    no metrics/tracing/logging/print, no wall clocks or RNG, no file
+    I/O, no mutation of `self`."""
+
+    name = "jit-purity"
+    SCOPE = "kubernetes_tpu/ops/"
+
+    def run(self, corpus: Corpus) -> List[Finding]:
+        modules = {}
+        for sf in corpus.under(self.SCOPE):
+            modules[_module_key(sf)] = _OpsModule(sf)
+        findings: List[Finding] = []
+        roots: List[Tuple[_OpsModule, ast.AST]] = []
+        for mod in modules.values():
+            roots.extend((mod, fn) for fn in mod.jit_roots)
+        seen: Set[Tuple[str, int]] = set()
+        queue = list(roots)
+        while queue:
+            mod, fn = queue.pop()
+            key = (mod.sf.relpath, fn.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.extend(self._check_body(mod, fn))
+            for callee_mod, callee in mod.resolve_calls(fn, modules):
+                queue.append((callee_mod, callee))
+        return findings
+
+    def _check_body(self, mod: "_OpsModule", fn) -> List[Finding]:
+        out: List[Finding] = []
+        sf = mod.sf
+
+        def bad(node, what):
+            out.append(sf.finding(
+                self.name, node,
+                f"{what} inside the jit boundary (reachable from a "
+                f"jax.jit/lax.scan root; hoist it to the host-side entry "
+                f"wrapper like ops/kernel.py schedule_wave)"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name is None:
+                    continue
+                root = name.split(".")[0]
+                if name.endswith(".fire") or name == "fire":
+                    if mod.names_module(root, "faultpoints") or name == "fire":
+                        bad(node, f"fault point `{name}(...)`")
+                elif mod.names_module(root, "time"):
+                    bad(node, f"wall-clock call `{name}(...)`")
+                elif root == "random" or \
+                        name.startswith(("np.random.", "numpy.random.")):
+                    # stdlib/numpy RNG draws fresh state at trace time
+                    # only; jax.random is the trace-pure functional PRNG
+                    # and is deliberately NOT flagged
+                    bad(node, f"RNG call `{name}(...)`")
+                elif name == "print":
+                    bad(node, "print(...)")
+                elif name == "open":
+                    bad(node, "file I/O `open(...)`")
+                elif mod.names_module(root, "logging") or \
+                        mod.names_module(root, "tracing"):
+                    bad(node, f"host-side call `{name}(...)`")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("inc", "observe", "labels"):
+                    recv = dotted(node.func.value) or "<expr>"
+                    bad(node, f"metric call `{recv}.{node.func.attr}(...)`")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        bad(node, f"mutation of `self.{t.attr}`")
+            elif isinstance(node, ast.Global):
+                bad(node, "global statement (trace-time-only side effect)")
+        return out
+
+
+def _module_key(sf: SourceFile) -> str:
+    # 'kubernetes_tpu/ops/kernel.py' -> 'kernel'
+    return sf.relpath.rsplit("/", 1)[-1][:-3]
+
+
+class _OpsModule:
+    """Symbol/import index of one ops/ module for the purity walk."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: Dict[str, ast.AST] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        # alias -> module key it names (both `from . import encoding as
+        # enc` and `from ..utils import faultpoints` land here), and
+        # name -> (modkey, origname) for `from .filters import resource_fit`
+        self.module_aliases: Dict[str, str] = {}
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.module_aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name.split(".")[-1] if a.asname else \
+                        a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                modkey = (node.module or "").split(".")[-1]
+                for a in node.names:
+                    if node.module is None and node.level:
+                        # from . import encoding as enc
+                        self.module_aliases[a.asname or a.name] = a.name
+                    else:
+                        self.from_imports[a.asname or a.name] = \
+                            (modkey, a.name)
+                        # `from ..utils import faultpoints` imports a
+                        # MODULE through ImportFrom — record the alias too
+                        self.module_aliases.setdefault(a.asname or a.name,
+                                                       a.name)
+        self.jit_roots = self._find_jit_roots()
+
+    def names_module(self, alias: str, modname: str) -> bool:
+        return self.module_aliases.get(alias) == modname
+
+    def _find_jit_roots(self) -> List[ast.AST]:
+        roots: List[ast.AST] = []
+        jitted_names: Set[str] = set()
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(dec):
+                        roots.append(node)
+                        break
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    self._is_jit_expr(node.value.func):
+                # f = jax.jit(g)
+                for arg in node.value.args[:1]:
+                    name = dotted(arg)
+                    if name:
+                        jitted_names.add(name.split(".")[-1])
+        for name in jitted_names:
+            if name in self.functions:
+                roots.append(self.functions[name])
+        return roots
+
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        name = dotted(node)
+        if name in ("jax.jit", "jit", "pallas_call", "pl.pallas_call"):
+            return True
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname in ("functools.partial", "partial"):
+                return any(self._is_jit_expr(a) for a in node.args)
+            return self._is_jit_expr(node.func)
+        return False
+
+    def resolve_calls(self, fn, modules: Dict[str, "_OpsModule"]
+                      ) -> List[Tuple["_OpsModule", ast.AST]]:
+        """Callees of `fn` that resolve to functions in the ops corpus
+        (same module by name, cross-module via from-imports / module
+        aliases)."""
+        out: List[Tuple[_OpsModule, ast.AST]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if "." not in name:
+                if name in self.functions and self.functions[name] is not fn:
+                    out.append((self, self.functions[name]))
+                elif name in self.from_imports:
+                    modkey, orig = self.from_imports[name]
+                    target = modules.get(modkey)
+                    if target and orig in target.functions:
+                        out.append((target, target.functions[orig]))
+            else:
+                root, attr = name.split(".")[0], name.split(".")[-1]
+                modkey = self.module_aliases.get(root)
+                target = modules.get(modkey) if modkey else None
+                if target and attr in target.functions:
+                    out.append((target, target.functions[attr]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+
+class DeterminismRule:
+    """No iteration over set-typed values on scheduling-order-sensitive
+    paths (sched/, state/, controllers/, server/). Python string hashes
+    are randomized per process, so set order is not even stable
+    run-to-run — the PR 8 bug class. Order-insensitive consumers
+    (len/any/all/min/max/sum/sorted/set-to-set) are fine; `for` loops,
+    list()/tuple() materialization, and join() are not."""
+
+    name = "determinism"
+    SCOPES = ("kubernetes_tpu/sched/", "kubernetes_tpu/state/",
+              "kubernetes_tpu/controllers/", "kubernetes_tpu/server/")
+    ORDER_FREE_CALLS = {"len", "any", "all", "min", "max", "sum", "sorted",
+                        "set", "frozenset", "bool"}
+    MATERIALIZERS = {"list", "tuple", "enumerate", "iter"}
+    SET_METHODS = {"union", "difference", "intersection",
+                   "symmetric_difference", "copy"}
+
+    def run(self, corpus: Corpus) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope in self.SCOPES:
+            for sf in corpus.under(scope):
+                findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for cls, fn in _functions_with_class(sf.tree):
+            set_attrs = _set_attributes(cls) if cls is not None else set()
+            local_sets = self._local_sets(fn, set_attrs)
+            env = (local_sets, set_attrs)
+            for node in walk_skipping_nested_functions(fn.body):
+                self._check_node(sf, node, env, out)
+        return out
+
+    def _local_sets(self, fn, set_attrs: Set[str]) -> Set[str]:
+        """Names assigned a set-typed expression anywhere in `fn`
+        (fixpoint so chains like a = set(); b = a propagate)."""
+        local: Set[str] = set()
+        for _ in range(4):
+            grew = False
+            for node in walk_skipping_nested_functions(fn.body):
+                if isinstance(node, ast.Assign):
+                    if self._is_set(node.value, (local, set_attrs)):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name) and t.id not in local:
+                                local.add(t.id)
+                                grew = True
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    ann = dotted(node.annotation) or ""
+                    if (self._is_set(node.value, (local, set_attrs))
+                            or ann.split(".")[-1] in ("set", "Set",
+                                                      "FrozenSet")) and \
+                            isinstance(node.target, ast.Name) and \
+                            node.target.id not in local:
+                        local.add(node.target.id)
+                        grew = True
+            if not grew:
+                break
+        return local
+
+    def _is_set(self, node: ast.AST, env) -> bool:
+        local_sets, set_attrs = env
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in ("set", "frozenset"):
+                return True
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in self.SET_METHODS:
+                return self._is_set(node.func.value, env)
+            return False
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return self._is_set(node.left, env) or \
+                self._is_set(node.right, env)
+        if isinstance(node, ast.Name):
+            return node.id in local_sets
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr in set_attrs
+        if isinstance(node, ast.IfExp):
+            return self._is_set(node.body, env) or \
+                self._is_set(node.orelse, env)
+        return False
+
+    def _describe(self, node: ast.AST) -> str:
+        name = dotted(node)
+        if name:
+            return f"`{name}`"
+        if isinstance(node, ast.SetComp):
+            return "a set comprehension"
+        if isinstance(node, ast.BinOp):
+            return "a set expression"
+        if isinstance(node, ast.Call):
+            return f"`{dotted(node.func) or 'set'}(...)`"
+        return "a set"
+
+    def _check_node(self, sf: SourceFile, node: ast.AST, env, out):
+        msg = ("iterates %s in unstable hash order — scheduling-order-"
+               "sensitive paths must sort or use a dict-as-ordered-set "
+               "(the PR 8 gang-members bug class)")
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if self._is_set(node.iter, env):
+                out.append(sf.finding(self.name, node,
+                                      msg % self._describe(node.iter)))
+        elif isinstance(node, (ast.ListComp, ast.DictComp, ast.GeneratorExp)):
+            # SetComp over a set stays order-free and is exempt
+            for gen in node.generators:
+                if self._is_set(gen.iter, env):
+                    out.append(sf.finding(self.name, node,
+                                          msg % self._describe(gen.iter)))
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in self.MATERIALIZERS and node.args and \
+                    self._is_set(node.args[0], env):
+                out.append(sf.finding(
+                    self.name, node,
+                    f"`{name}()` materializes {self._describe(node.args[0])} "
+                    f"in unstable hash order — wrap in sorted() or keep it "
+                    f"a set"))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join" and node.args and \
+                    self._is_set(node.args[0], env):
+                out.append(sf.finding(
+                    self.name, node,
+                    f"join() over {self._describe(node.args[0])} renders in "
+                    f"unstable hash order — sort first"))
+
+
+def _functions_with_class(tree: ast.Module):
+    """Yield (enclosing ClassDef or None, FunctionDef) pairs, including
+    methods and module-level functions (nested defs are visited through
+    their own entry)."""
+    def visit(body, cls):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (cls, node)
+                yield from visit(node.body, cls)
+            elif isinstance(node, ast.ClassDef):
+                yield from visit(node.body, node)
+            elif hasattr(node, "body") and not isinstance(node, ast.Lambda):
+                inner = list(getattr(node, "body", ()))
+                inner += list(getattr(node, "orelse", ()))
+                inner += list(getattr(node, "finalbody", ()))
+                for h in getattr(node, "handlers", ()):
+                    inner += list(h.body)
+                yield from visit(inner, cls)
+    yield from visit(tree.body, None)
+
+
+def _set_attributes(cls: ast.ClassDef) -> Set[str]:
+    """Attributes of `cls` assigned set-typed values anywhere in the
+    class (self.x = set(), or `x: Set[...] = field(default_factory=set)`
+    dataclass fields)."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_plain_set_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    attrs.add(t.attr)
+        elif isinstance(node, ast.AnnAssign):
+            ann = dotted(node.annotation)
+            base = None
+            if ann:
+                base = ann.split(".")[-1]
+            elif isinstance(node.annotation, ast.Subscript):
+                base = (dotted(node.annotation.value) or "").split(".")[-1]
+            is_set_ann = base in ("Set", "FrozenSet", "set", "frozenset")
+            target = node.target
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                if is_set_ann or (node.value is not None
+                                  and _is_plain_set_expr(node.value)):
+                    attrs.add(target.attr)
+            elif isinstance(target, ast.Name) and is_set_ann:
+                # dataclass field at class level
+                attrs.add(target.id)
+    return attrs
+
+
+def _is_plain_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and dotted(node.func) in ("set",
+                                                            "frozenset"):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# twin-coverage
+# ---------------------------------------------------------------------------
+
+
+class TwinCoverageRule:
+    """Every public device kernel in the twinned ops modules must have a
+    numpy host twin in ops/hostwave.py (same name or `<name>_host`) and
+    a parity test that names both — the degraded path's coverage is a
+    checked invariant, not a convention."""
+
+    name = "twin-coverage"
+    KERNEL_MODULES = ("kubernetes_tpu/ops/kernel.py",
+                      "kubernetes_tpu/ops/gang.py",
+                      "kubernetes_tpu/ops/preempt.py",
+                      "kubernetes_tpu/ops/scores.py",
+                      "kubernetes_tpu/ops/telemetry.py")
+    HOSTWAVE = "kubernetes_tpu/ops/hostwave.py"
+
+    def run(self, corpus: Corpus) -> List[Finding]:
+        if corpus.files.get(self.HOSTWAVE) is None:
+            return []
+        findings: List[Finding] = []
+        for sf, fn, twin in self.kernel_twins(corpus):
+            if twin is None:
+                findings.append(sf.finding(
+                    self.name, fn,
+                    f"public kernel `{fn.name}` has no host twin in "
+                    f"ops/hostwave.py (expected `{fn.name}_host` or "
+                    f"`{fn.name}`) — degraded mode silently loses it"))
+                continue
+            if not self._parity_test_exists(corpus, fn.name, twin):
+                findings.append(sf.finding(
+                    self.name, fn,
+                    f"kernel `{fn.name}` / twin `{twin}` have no parity "
+                    f"test naming both under tests/"))
+        return findings
+
+    def kernel_twins(self, corpus: Corpus
+                     ) -> List[Tuple[SourceFile, ast.FunctionDef,
+                                     Optional[str]]]:
+        """(file, kernel fn, twin name or None) for every public kernel.
+        A 'kernel' is a public module-level function that references
+        jnp/lax (directly or through same-module callees) — host-side
+        utilities like dispatch accounting don't need twins."""
+        hostwave = corpus.files.get(self.HOSTWAVE)
+        twin_names = {n.name for n in hostwave.tree.body
+                      if isinstance(n, ast.FunctionDef)} if hostwave else set()
+        out = []
+        for rel in self.KERNEL_MODULES:
+            sf = corpus.files.get(rel)
+            if sf is None:
+                continue
+            fns = {n.name: n for n in sf.tree.body
+                   if isinstance(n, ast.FunctionDef)}
+            device_fns = self._device_functions(fns)
+            for name, fn in sorted(fns.items()):
+                if name.startswith("_") or name not in device_fns:
+                    continue
+                twin = None
+                if f"{name}_host" in twin_names:
+                    twin = f"{name}_host"
+                elif name in twin_names:
+                    twin = name
+                out.append((sf, fn, twin))
+        return out
+
+    def _device_functions(self, fns: Dict[str, ast.FunctionDef]) -> Set[str]:
+        """Fixpoint: functions textually using jnp./lax. or calling a
+        same-module function that does."""
+        device: Set[str] = set()
+        for name, fn in fns.items():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in ("jnp", "lax"):
+                    device.add(name)
+                    break
+        for _ in range(len(fns)):
+            grew = False
+            for name, fn in fns.items():
+                if name in device:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        callee = dotted(node.func)
+                        if callee in device:
+                            device.add(name)
+                            grew = True
+                            break
+            if not grew:
+                break
+        return device
+
+    def _parity_test_exists(self, corpus: Corpus, kernel: str,
+                            twin: str) -> bool:
+        for text in corpus.test_texts.values():
+            if twin == kernel:
+                # same-name twin: the test must reference the name AND
+                # the hostwave module explicitly
+                if kernel in text and "hostwave" in text:
+                    return True
+            elif kernel in text and twin in text:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# f32-reduction
+# ---------------------------------------------------------------------------
+
+
+class F32ReductionRule:
+    """Raw jnp.sum/np.sum over f32 planes in ops/ reassociate
+    differently on numpy vs XLA vs GSPMD; route them through the
+    _pairwise_sum fixed halving tree (ops/telemetry.py). Integer/bool
+    sums are exact in any order and exempt, as are explicit f64
+    accumulations (`dtype=np.float64`, rounded once — exact for the
+    integer-valued planes that use them)."""
+
+    name = "f32-reduction"
+    SCOPE = "kubernetes_tpu/ops/"
+    NUMPY_NAMES = {"np", "jnp", "xp", "numpy"}
+    INT_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+                  "uint32", "uint64", "bool", "bool_"}
+
+    def run(self, corpus: Corpus) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in corpus.under(self.SCOPE):
+            for cls, fn in _functions_with_class(sf.tree):
+                if fn.name == "_pairwise_sum":
+                    continue
+                bool_locals = self._bool_locals(fn)
+                for node in walk_skipping_nested_functions(fn.body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func)
+                    if name is None or not name.endswith(".sum"):
+                        continue
+                    if name.split(".")[0] not in self.NUMPY_NAMES:
+                        continue
+                    if self._exempt(node, bool_locals):
+                        continue
+                    findings.append(sf.finding(
+                        self.name, node,
+                        f"raw `{name}(...)` over a (possibly) f32 plane — "
+                        f"route through the _pairwise_sum halving tree so "
+                        f"numpy == XLA == GSPMD bit-for-bit, or cast to an "
+                        f"integer dtype if the plane is integral"))
+        return findings
+
+    def _bool_locals(self, fn) -> Set[str]:
+        """Names assigned integer/bool-typed expressions (fixpoint so
+        `a = x > 0; b = a & y` propagates)."""
+        out: Set[str] = set()
+        for _ in range(3):
+            grew = False
+            for node in walk_skipping_nested_functions(fn.body):
+                if isinstance(node, ast.Assign) and \
+                        self._int_typed(node.value, out):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in out:
+                            out.add(t.id)
+                            grew = True
+            if not grew:
+                break
+        return out
+
+    def _int_typed(self, node: ast.AST, bool_locals: Set[str]) -> bool:
+        """Type the EXPRESSION, not its subtree: `where(mask, f32, 0.0)`
+        is f32 no matter how boolean the mask is."""
+        if isinstance(node, ast.Compare):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in bool_locals
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, (ast.Invert, ast.Not)):
+            return self._int_typed(node.operand, bool_locals)
+        if isinstance(node, ast.BoolOp):
+            return all(self._int_typed(v, bool_locals) for v in node.values)
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+            # numpy bitwise ops reject float operands, so one int/bool
+            # side proves the whole expression integral
+            return self._int_typed(node.left, bool_locals) or \
+                self._int_typed(node.right, bool_locals)
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            short = (node.func.attr if isinstance(node.func, ast.Attribute)
+                     else name.split(".")[-1])
+            if name == "bool":
+                return True
+            if short == "astype" and node.args:
+                dt = (dotted(node.args[0]) or "").split(".")[-1]
+                return dt in self.INT_DTYPES
+            if short == "where" and len(node.args) == 3:
+                return self._int_typed(node.args[1], bool_locals) and \
+                    self._int_typed(node.args[2], bool_locals)
+            return False
+        if isinstance(node, ast.Subscript):
+            return self._int_typed(node.value, bool_locals)
+        return False
+
+    def _exempt(self, call: ast.Call, bool_locals: Set[str]) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                dt = (dotted(kw.value) or "").split(".")[-1]
+                # explicit f64 accumulation rounded once is the
+                # documented exact-for-integer-planes pattern
+                # (ops/hostwave.py module doc)
+                if dt in self.INT_DTYPES or dt in ("float64", "double"):
+                    return True
+        if not call.args:
+            return False
+        return self._int_typed(call.args[0], bool_locals)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+class LockDisciplineRule:
+    """Three checks over the statically-extracted lock graph (see
+    lockgraph.py): (a) no pair of locks is acquired in both orders
+    (latent deadlock — what `go test -race`'s happens-before analysis
+    would flag); (b) no blocking I/O (sleep/network/subprocess) under a
+    component lock; (c) no device dispatch under the scheduler's lock
+    from OUTSIDE the Scheduler (the PR 4 autoscaler rule — what-ifs
+    build their shadow under `_mu` but must dispatch after release)."""
+
+    name = "lock-discipline"
+
+    BLOCKING = {"time.sleep", "subprocess.run", "subprocess.check_call",
+                "subprocess.check_output", "subprocess.Popen",
+                "urllib.request.urlopen", "urlopen", "socket.create_connection"}
+    BLOCKING_ATTRS = {"request", "urlopen"}  # .request( on rest clients
+    DEVICE_DISPATCH = {"schedule_wave", "schedule_round", "schedule_gang",
+                       "preemption_stats", "cluster_telemetry", "zone_tally",
+                       "simulate_placements", "simulate_refit",
+                       "taint_ports_masks", "block_until_ready"}
+
+    def run(self, corpus: Corpus) -> List[Finding]:
+        from .lockgraph import extract_lock_graph
+
+        graph = extract_lock_graph(corpus)
+        findings: List[Finding] = []
+        reported: Set[frozenset] = set()
+        for (a, b), sites in sorted(graph.edges.items()):
+            if (b, a) in graph.edges and a != b:
+                key = frozenset((a, b))
+                if key in reported:
+                    continue
+                reported.add(key)
+                sf, line = sites[0]
+                other = graph.edges[(b, a)][0]
+                findings.append(sf.finding(
+                    self.name, line,
+                    f"lock-order inversion: `{a}` -> `{b}` here but "
+                    f"`{b}` -> `{a}` at {other[0].relpath}:{other[1]} "
+                    f"(potential deadlock)"))
+        for sf, line, lock, call in graph.calls_under_locks:
+            short = call.split(".")[-1]
+            if call in self.BLOCKING or \
+                    (short in self.BLOCKING_ATTRS and "." in call):
+                findings.append(sf.finding(
+                    self.name, line,
+                    f"blocking call `{call}(...)` under `{lock}` — move "
+                    f"I/O outside the lock (binds and REST calls stall "
+                    f"every thread contending for it)"))
+            elif short in self.DEVICE_DISPATCH and \
+                    lock == "Scheduler._mu" and \
+                    not graph.site_in_scheduler(sf, line):
+                findings.append(sf.finding(
+                    self.name, line,
+                    f"device dispatch `{call}(...)` under the scheduler "
+                    f"lock from outside the Scheduler — build the shadow "
+                    f"under `_mu`, dispatch after release (PR 4 rule: a "
+                    f"first-compile must not stall scheduling)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# metrics-hygiene
+# ---------------------------------------------------------------------------
+
+
+class MetricsHygieneRule:
+    """Label values must be statically bounded: a dynamic value minted
+    per unique string (pod names, free-text errors) grows /metrics
+    without bound and can break exposition parsing. A family declares
+    its closed set via `values={...}` or its intentionally-open,
+    pruned-on-removal labels via `open_labels=(...)` at construction;
+    dynamic call-site values must come from literals, a declared set, or
+    `utils.metrics.bounded_label` (the PR 9 "Other" bucketing)."""
+
+    name = "metrics-hygiene"
+    SCOPE = "kubernetes_tpu/"
+    FAMILY_TYPES = {"LabeledCounter", "LabeledGauge"}
+
+    def run(self, corpus: Corpus) -> List[Finding]:
+        families = self._collect_families(corpus)
+        findings: List[Finding] = []
+        for sf in corpus.under(self.SCOPE):
+            for cls, fn in _functions_with_class(sf.tree):
+                literal_locals = self._literal_locals(fn)
+                for node in walk_skipping_nested_functions(fn.body):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "labels":
+                        self._check_site(sf, fn, node, families,
+                                         literal_locals, findings)
+        return findings
+
+    def _collect_families(self, corpus: Corpus) -> Dict[str, dict]:
+        """family attr name -> {'values': {label: set-or-None},
+        'open': set(labels)} from every LabeledCounter/Gauge
+        construction assigned to an attribute or name."""
+        families: Dict[str, dict] = {}
+        for sf in corpus.under(self.SCOPE):
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                ctor = (dotted(node.value.func) or "").split(".")[-1]
+                if ctor not in self.FAMILY_TYPES:
+                    continue
+                decl = {"values": {}, "open": set(), "kind": ctor}
+                for kw in node.value.keywords:
+                    if kw.arg == "values" and isinstance(kw.value, ast.Dict):
+                        for k, v in zip(kw.value.keys, kw.value.values):
+                            if isinstance(k, ast.Constant):
+                                vals = {e.value for e in ast.walk(v)
+                                        if isinstance(e, ast.Constant)
+                                        and isinstance(e.value, str)}
+                                decl["values"][k.value] = vals
+                    elif kw.arg == "open_labels":
+                        decl["open"] = {e.value for e in ast.walk(kw.value)
+                                        if isinstance(e, ast.Constant)
+                                        and isinstance(e.value, str)}
+                for t in node.targets:
+                    name = dotted(t)
+                    if name:
+                        families[name.split(".")[-1]] = decl
+        return families
+
+    def _literal_locals(self, fn) -> Set[str]:
+        """Names whose every assignment in `fn` is a string literal, an
+        IfExp over such, or a bounded_label(...) call — statically
+        bounded values."""
+        assigned: Dict[str, bool] = {}
+
+        def bounded_expr(v) -> bool:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return True
+            if isinstance(v, ast.IfExp):
+                return bounded_expr(v.body) and bounded_expr(v.orelse)
+            if isinstance(v, ast.Call):
+                return (dotted(v.func) or "").split(".")[-1] == \
+                    "bounded_label"
+            return False
+
+        for node in walk_skipping_nested_functions(fn.body):
+            if isinstance(node, ast.Assign):
+                ok = bounded_expr(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigned[t.id] = assigned.get(t.id, True) and ok
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        assigned[t.id] = False
+        return {n for n, ok in assigned.items() if ok}
+
+    def _resolve_family(self, recv: ast.AST, fn) -> Optional[str]:
+        """`self.metrics.waves_total.labels(...)` -> 'waves_total';
+        follows one local alias hop (`g = self.metrics.pending_pods`)."""
+        name = dotted(recv)
+        if name is None:
+            return None
+        attr = name.split(".")[-1]
+        if "." in name:
+            return attr
+        # bare Name: find its assignment in the function
+        for node in walk_skipping_nested_functions(fn.body):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        src = dotted(node.value)
+                        if src and "." in src:
+                            return src.split(".")[-1]
+        return attr
+
+    def _check_site(self, sf: SourceFile, fn, call: ast.Call,
+                    families: Dict[str, dict], literal_locals: Set[str],
+                    findings: List[Finding]):
+        family_attr = self._resolve_family(call.func.value, fn)
+        decl = families.get(family_attr or "")
+        if decl is None:
+            return  # not a known metric family (e.g. sharding API)
+        for kw in call.keywords:
+            label = kw.arg
+            if label is None:
+                continue
+            v = kw.value
+            if label in decl["open"]:
+                continue
+            declared = decl["values"].get(label)
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                if declared is not None and v.value not in declared:
+                    findings.append(sf.finding(
+                        self.name, call,
+                        f"label {label}={v.value!r} not in the declared "
+                        f"value set of `{family_attr}` — add it to the "
+                        f"family's values= declaration"))
+                continue
+            if isinstance(v, ast.Call) and \
+                    (dotted(v.func) or "").split(".")[-1] == "bounded_label":
+                continue
+            if isinstance(v, ast.Name) and v.id in literal_locals:
+                continue
+            if declared is not None:
+                # the family declares a closed set for this label —
+                # labels() enforces it at runtime, so a dynamic value
+                # here is bounded by construction
+                continue
+            findings.append(sf.finding(
+                self.name, call,
+                f"dynamic value for label `{label}` of `{family_attr}` — "
+                f"declare the bounded set (values=/open_labels= at "
+                f"construction) or bucket through "
+                f"utils.metrics.bounded_label (PR 9 'Other' bucketing)"))
+
+
+ALL_RULES = (JitPurityRule(), DeterminismRule(), TwinCoverageRule(),
+             F32ReductionRule(), LockDisciplineRule(), MetricsHygieneRule())
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}

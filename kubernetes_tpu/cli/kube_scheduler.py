@@ -209,7 +209,8 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
                      breaker_threshold=cfg.breaker_threshold,
                      breaker_cooldown=cfg.breaker_cooldown,
                      metrics=metrics,
-                     bind_max_attempts=cfg.bind_max_attempts)
+                     bind_max_attempts=cfg.bind_max_attempts,
+                     racecheck=cfg.racecheck)
 
 
 def run(cfg: KubeSchedulerConfiguration, server_url: str,
@@ -390,6 +391,11 @@ def main(argv=None) -> int:
                     help="append one structured JSONL record per "
                          "scheduling round to this file (requires "
                          "--tracing)")
+    ap.add_argument("--racecheck", action="store_true",
+                    help="instrument the scheduler/queue locks with the "
+                         "lock-order watcher (go test -race analog; "
+                         "edge names match the ktpu-lint static lock "
+                         "graph)")
     ap.add_argument("--once", action="store_true",
                     help="exit when the queue drains (batch mode)")
     args = ap.parse_args(argv)
@@ -418,6 +424,8 @@ def main(argv=None) -> int:
         cfg.trace_rounds = args.trace_rounds
     if args.round_ledger is not None:
         cfg.round_ledger_path = args.round_ledger
+    if args.racecheck:
+        cfg.racecheck = True
     for kv in filter(None, args.feature_gates.split(",")):
         k, _, v = kv.partition("=")
         cfg.feature_gates[k] = v.lower() in ("true", "1", "")

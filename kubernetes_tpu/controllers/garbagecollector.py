@@ -171,11 +171,11 @@ class GarbageCollector(Controller):
                     self._nodes[ref.uid] = on
                     verify.append(ref.uid)
                 on.dependents.add(uid)
-            for gone in old_uids - new_uids:
+            for gone in sorted(old_uids - new_uids):
                 o = self._nodes.get(gone)
                 if o is not None:
                     o.dependents.discard(uid)
-            for key in n.ident_refs - new_idents:
+            for key in sorted(n.ident_refs - new_idents):
                 deps = self._ident_deps.get(key)
                 if deps is not None:
                     deps.discard(uid)

@@ -62,7 +62,10 @@ class RouteController(Controller):
         # path, route_controller.go:186)
         routed = {t for t, c in want if (t, c) in have}
         errors = 0
-        for target, cidr in want - set(have):
+        # sorted: create/delete order must not follow set hash order —
+        # a mid-pass failure would otherwise leave a different prefix of
+        # routes materialized run-to-run
+        for target, cidr in sorted(want - set(have)):
             try:
                 self.routes.create_route(
                     self.cluster_name, f"{target}-{cidr}",
@@ -71,7 +74,7 @@ class RouteController(Controller):
                 routed.add(target)
             except Exception:
                 errors += 1
-        for stale in set(have) - want:
+        for stale in sorted(set(have) - want):
             try:
                 self.routes.delete_route(self.cluster_name, have[stale])
             except Exception:

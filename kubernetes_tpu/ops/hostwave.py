@@ -366,6 +366,9 @@ def image_locality(nt, pb):
     for i in range(PI):
         pid = pb.img_id[:, i]
         hit = pid[:, None, None] == nt.img_id[None, :, :]
+        # Twin of ops/scores.py image_locality — must mirror the device
+        # op order exactly, not re-associate.
+        # ktpu: allow[f32-reduction] device-mirrored op order
         sz = np.sum(np.where(hit, nt.img_size[None, :, :], F(0.0)), axis=-1)
         total += np.where((pid > 0)[:, None], sz, F(0.0))
     mb = F(1024.0 * 1024.0)

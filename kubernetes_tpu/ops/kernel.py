@@ -475,6 +475,12 @@ def _stage_placements(pm: enc.PodMatrix, tt: enc.TermTable, chosen,
     return pm2, tt2
 
 
+# The round is the device-resident pipeline driver (scan over resident
+# waves); degraded mode deliberately chunks schedule_wave_host instead —
+# whole-round residency is a device-only optimization, not semantics
+# (tests/test_hostwave.py asserts breaker-open placements match the
+# clean device scheduler's).
+# ktpu: allow[twin-coverage] round residency is device-only by design
 def schedule_round(*args, **kw):
     """Entry point for the device-resident round. The fault point fires
     HERE, outside the jit boundary — inside `_schedule_round` it would

@@ -125,6 +125,10 @@ def node_affinity_raw(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
     term_match = eval_and_program(nt.labels, nt.label_nums, pb.pt_key, pb.pt_op,
                                   pb.pt_vals, pb.pt_num, node_ids)  # [P, PT, N]
     w = pb.pt_weight[:, :, None]
+    # Term-axis sum (replicated under GSPMD — the node axis is the
+    # sharded one) of integer-valued weights <= 100*PT: exact in f32 in
+    # any association, and the twin mirrors the op order bit-for-bit.
+    # ktpu: allow[f32-reduction] integer-valued, term axis, twin-mirrored
     return jnp.sum(jnp.where(term_match, w, 0.0), axis=1)
 
 
@@ -202,6 +206,10 @@ def image_locality(nt: NodeTensors, pb: PodBatch) -> jnp.ndarray:
     for i in range(PI):
         pid = pb.img_id[:, i]  # [P]
         hit = pid[:, None, None] == nt.img_id[None, :, :]  # [P, N, NI]
+        # Image-slot axis (short, replicated under GSPMD — the node axis
+        # is the sharded one); device and twin share the identical
+        # expression, parity gated in tests/test_hostwave.py.
+        # ktpu: allow[f32-reduction] image-slot axis, twin-mirrored
         sz = jnp.sum(jnp.where(hit, nt.img_size[None, :, :], 0.0), axis=-1)
         total += jnp.where((pid > 0)[:, None], sz, 0.0)
     mb = 1024.0 * 1024.0

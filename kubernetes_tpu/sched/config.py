@@ -58,6 +58,13 @@ class KubeSchedulerConfiguration:
     tracing: bool = False
     trace_rounds: int = 64
     round_ledger_path: str = ""
+    # runtime race detection (`--racecheck`): instrument the scheduler
+    # and queue locks with utils/racecheck.py's LockOrderWatcher — the
+    # `go test -race` analog. Lock names match the static lock graph
+    # extracted by kubernetes_tpu/analysis, so observed edges are
+    # directly diffable against ktpu-lint's lock-discipline rule.
+    # Dev/test switch: each acquisition pays a dict+list bookkeeping hit.
+    racecheck: bool = False
     # informer kinds mirrored before scheduling starts
     feature_gates: dict = field(default_factory=dict)
 

@@ -45,7 +45,8 @@ from ..state.cache import SchedulerCache
 from ..state.featurize import PodFeaturizer
 from ..state.scrubber import SnapshotScrubber
 from ..state.snapshot import Snapshot
-from ..utils import Metrics, PodBackoff, Trace, faultpoints, tracing
+from ..utils import (Metrics, PodBackoff, Trace, bounded_label, faultpoints,
+                     tracing)
 from ..utils.feature_gates import FeatureGates
 from . import breaker as breaker_mod
 from .breaker import STATE_CODES, DevicePathBreaker
@@ -157,7 +158,8 @@ class Scheduler:
                  scrub_interval: Optional[float] = None,
                  breaker_threshold: int = 3, breaker_cooldown: float = 30.0,
                  metrics: Optional[Metrics] = None,
-                 bind_max_attempts: int = 3):
+                 bind_max_attempts: int = 3,
+                 racecheck: bool = False):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -182,6 +184,25 @@ class Scheduler:
         self.queue = SchedulingQueue(
             pod_priority_enabled=self.features.enabled("PodPriority"),
             clock=clock)
+        # --racecheck: wrap the scheduling-plane locks in the runtime
+        # LockOrderWatcher (utils/racecheck.py), the `go test -race`
+        # analog. Lock names match the STATIC lock graph's ids
+        # (analysis/lockgraph.py), so observed edges are directly
+        # comparable: tests assert runtime edges ⊆ static graph. Must
+        # run before anything captures the raw lock objects — the
+        # scrubber below closes over _mu, and a component holding the
+        # unwrapped lock would silently bypass mutual exclusion with
+        # proxy holders. The cache carries no lock of its own: it is
+        # guarded by Scheduler._mu (see the _mu comment above), so
+        # instrumenting _mu covers cache+snapshot access too.
+        self.racecheck_watcher = None
+        if racecheck:
+            from ..utils.racecheck import LockOrderWatcher, instrument
+
+            self.racecheck_watcher = LockOrderWatcher()
+            instrument(self.racecheck_watcher, self, "_mu", "Scheduler._mu")
+            instrument(self.racecheck_watcher, self.queue, "_lock",
+                       "SchedulingQueue._lock")
         # metrics may be a SHARED registry (cli/kube_scheduler.py hands
         # the same one to the RemoteStore's reflectors so control-plane
         # series land on the same /metrics endpoint as scheduling ones)
@@ -646,11 +667,11 @@ class Scheduler:
         # a zone or resource that disappeared must stop exporting, not
         # freeze at its last value on /metrics forever
         prev_res, prev_zone = self._tele_exported
-        for name in prev_res - seen_res:
+        for name in sorted(prev_res - seen_res):
             for fam in (m.cluster_requested, m.cluster_allocatable,
                         m.cluster_free_largest, m.cluster_fragmentation):
                 fam.remove(resource=name)
-        for zname, name in prev_zone - seen_zone:
+        for zname, name in sorted(prev_zone - seen_zone):
             m.zone_utilization.remove(zone=zname, resource=name)
         self._tele_exported = (seen_res, seen_zone)
         summary = {
@@ -685,13 +706,12 @@ class Scheduler:
             if reason.startswith("Insufficient "):
                 pred = "PodFitsResources"
             else:
-                pred = REASON_KEYS.get(reason, reason)
-                if pred not in REASONS:
-                    # free-text reasons (filter extenders, host plugins)
-                    # would mint an unbounded, unescaped label value per
-                    # unique message — bucket them; the exact text still
-                    # reaches events via the FitError
-                    pred = "Other"
+                # free-text reasons (filter extenders, host plugins)
+                # would mint an unbounded, unescaped label value per
+                # unique message — bucket them into "Other"; the exact
+                # text still reaches events via the FitError
+                pred = bounded_label(REASON_KEYS.get(reason, reason),
+                                     REASONS)
             self.metrics.unschedulable_reasons.labels(predicate=pred).inc()
 
     def _to_device(self) -> Tuple[enc.NodeTensors, enc.PodMatrix,
@@ -2508,6 +2528,7 @@ class Scheduler:
 
         while True:
             with self._inflight_mu:
+                # ktpu: allow[determinism] wait-on-ALL; order irrelevant
                 pending = list(self._inflight)
             if not pending:
                 return
@@ -2784,12 +2805,15 @@ class Scheduler:
         pod.status.nominated_node_name = pr.node_name
         self.store.set_nominated_node(pod, pr.node_name)
         self.queue.update_nominated_pod(pod, pr.node_name)
-        victim_gangs = set()
+        # dict-as-ordered-set (the PR 8 rule): broken-gang teardown below
+        # deletes pods in this iteration order, which must follow victim
+        # order, not the gang keys' hash order
+        victim_gangs: Dict[str, None] = {}
         for victim in pr.victims:
             if self.gangs.active:
                 k = self.gangs.key(victim)
                 if k is not None:
-                    victim_gangs.add(k)
+                    victim_gangs[k] = None
             self.metrics.pod_preemption_victims.inc()
             try:
                 self.store.delete("pods", victim.namespace, victim.metadata.name)

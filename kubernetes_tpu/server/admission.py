@@ -271,7 +271,11 @@ class LimitRanger(AdmissionPlugin):
             for c in obj.spec.containers:
                 for r, v in c.resources.requests.items():
                     req_totals[r] = req_totals.get(r, 0) + v
-                for r in set(c.resources.requests) | set(c.resources.limits):
+                # sorted: the float accumulation below rounds in
+                # iteration order, and set order follows the per-process
+                # string hash seed
+                for r in sorted(set(c.resources.requests)
+                                | set(c.resources.limits)):
                     v = c.resources.limits.get(
                         r, c.resources.requests.get(r, 0))
                     lim_totals[r] = lim_totals.get(r, 0) + v

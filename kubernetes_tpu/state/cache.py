@@ -80,7 +80,7 @@ class SchedulerCache:
     def assumed_pods(self) -> List[api.Pod]:
         """The assumed (bound-copy) pods awaiting confirmation — the set
         a leadership-recovery pass must reconcile against API truth."""
-        return [self._pod_states[uid].pod for uid in list(self._assumed)
+        return [self._pod_states[uid].pod for uid in sorted(self._assumed)
                 if uid in self._pod_states]
 
     def add_pod(self, pod: api.Pod):
@@ -117,7 +117,9 @@ class SchedulerCache:
         """Expire assumed pods whose binding finished > TTL ago
         (reference: cache.go:422 cleanupAssumedPods)."""
         now = now if now is not None else self.clock()
-        for uid in list(self._assumed):
+        # sorted: expiries release capacity in a deterministic order
+        # (set order follows the per-process uid hash seed)
+        for uid in sorted(self._assumed):
             st = self._pod_states[uid]
             if st.binding_finished and st.deadline is not None and now >= st.deadline:
                 # an expiry is never routine: the bind POST reported

@@ -317,7 +317,10 @@ class PodFeaturizer:
             names = set(t.namespaces) if t.namespaces else {pod.namespace}
             sets_.append(names)
         inter = set.intersection(*sets_) if sets_ else set()
-        return sorted(v.namespaces.intern(n) for n in inter)
+        # inner sorted: intern() MINTS ids in iteration order, so
+        # interning in set order would assign namespace ids by the hash
+        # seed — vocab contents must be a pure function of input order
+        return sorted(v.namespaces.intern(n) for n in sorted(inter))
 
     def _compile_combined(self, terms, IE: int, IV: int):
         """All required terms' selectors concatenated into one AND program

@@ -1,6 +1,6 @@
 from .trace import Trace  # noqa: F401
 from .metrics import (Metrics, Histogram, Counter, Gauge,  # noqa: F401
-                      LabeledCounter, LabeledGauge)
+                      LabeledCounter, LabeledGauge, bounded_label)
 from .backoff import PodBackoff  # noqa: F401
 from .feature_gates import FeatureGates, DEFAULT_FEATURES  # noqa: F401
 from . import faultpoints  # noqa: F401

@@ -13,7 +13,20 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
+
+
+def bounded_label(value: str, allowed: Iterable[str],
+                  other: str = "Other") -> str:
+    """Clamp a dynamic label value to a known set, bucketing everything
+    else into `other` — the cardinality guard for labels fed from free
+    text (predicate names from extenders, plugin messages). A label
+    value minted per unique string grows /metrics without bound and can
+    break exposition parsing; ktpu-lint's metrics-hygiene rule requires
+    dynamic label values to route through this helper or come from a
+    family's declared value set."""
+    v = str(value)
+    return v if v in allowed else other
 
 
 class Counter:
@@ -57,22 +70,59 @@ class Gauge:
             self.value -= delta
 
 
+class _LabelDecl:
+    """Per-family label-cardinality declaration, checked at labels()
+    time. `values` maps a label name to its closed value set — an
+    undeclared value raises, so a free-text leak fails the first test
+    that exercises it instead of growing /metrics forever. `open_labels`
+    names labels that are *intentionally* unbounded (zones, resources,
+    devices) and therefore pruned via remove()/zeroing when their
+    subject disappears. ktpu-lint's metrics-hygiene rule reads the same
+    declarations statically."""
+
+    def __init__(self, labelnames, values, open_labels):
+        self.values: Dict[str, frozenset] = {
+            k: frozenset(v) for k, v in (values or {}).items()}
+        self.open_labels = frozenset(open_labels or ())
+        for ln in list(self.values) + list(self.open_labels):
+            if ln not in labelnames:
+                raise ValueError(f"declared label {ln!r} not in {labelnames}")
+
+    def check(self, family: str, labelnames, key) -> None:
+        for ln, v in zip(labelnames, key):
+            allowed = self.values.get(ln)
+            if allowed is not None and v not in allowed:
+                raise ValueError(
+                    f"{family}: label {ln}={v!r} outside the declared "
+                    f"value set {sorted(allowed)} — extend the family's "
+                    f"values= declaration or bucket through "
+                    f"bounded_label()")
+
+
 class LabeledCounter:
     """Counter family over a fixed label set; children render in
     Prometheus exposition form (`name{stage="bind"} 3`). The reference
     registers scheduling error series with a stage label
     (metrics.go `scheduling_errors`-style vectors); this is the minimal
-    analog the registry + /metrics endpoint can serve."""
+    analog the registry + /metrics endpoint can serve.
 
-    def __init__(self, name: str, labelnames=("stage",), help_: str = ""):
+    `values=` declares a closed per-label value set (enforced here,
+    checked statically by ktpu-lint); `open_labels=` marks labels whose
+    value space is intentionally open (see _LabelDecl)."""
+
+    def __init__(self, name: str, labelnames=("stage",), help_: str = "",
+                 values: Optional[Dict[str, Iterable[str]]] = None,
+                 open_labels: Iterable[str] = ()):
         self.name = name
         self.help = help_
         self.labelnames = tuple(labelnames)
+        self.decl = _LabelDecl(self.labelnames, values, open_labels)
         self._children: Dict[tuple, Counter] = {}
         self._lock = threading.Lock()
 
     def labels(self, **kw) -> Counter:
         key = tuple(str(kw[ln]) for ln in self.labelnames)
+        self.decl.check(self.name, self.labelnames, key)
         with self._lock:
             c = self._children.get(key)
             if c is None:
@@ -99,17 +149,22 @@ class LabeledCounter:
 
 class LabeledGauge:
     """Gauge family over a fixed label set (mirrors LabeledCounter —
-    children render as `name{queue="active"} 3`)."""
+    children render as `name{queue="active"} 3`, same values=/open_labels=
+    cardinality declarations)."""
 
-    def __init__(self, name: str, labelnames=("queue",), help_: str = ""):
+    def __init__(self, name: str, labelnames=("queue",), help_: str = "",
+                 values: Optional[Dict[str, Iterable[str]]] = None,
+                 open_labels: Iterable[str] = ()):
         self.name = name
         self.help = help_
         self.labelnames = tuple(labelnames)
+        self.decl = _LabelDecl(self.labelnames, values, open_labels)
         self._children: Dict[tuple, Gauge] = {}
         self._lock = threading.Lock()
 
     def labels(self, **kw) -> Gauge:
         key = tuple(str(kw[ln]) for ln in self.labelnames)
+        self.decl.check(self.name, self.labelnames, key)
         with self._lock:
             g = self._children.get(key)
             if g is None:
@@ -259,12 +314,20 @@ class Metrics:
         # actually executed per zone, evictions due-but-held by the
         # rate limiter or a suspended zone, and zone-suspension entries
         # (FullDisruption transitions)
-        self.zone_health = LabeledGauge("node_lifecycle_zone_health",
-                                        ("zone", "state"))
+        # zone names come from node labels (open, one series per live
+        # zone); the state set is the controller's closed enum
+        # (controllers/nodelifecycle.py ZONE_STATES)
+        self.zone_health = LabeledGauge(
+            "node_lifecycle_zone_health", ("zone", "state"),
+            values={"state": ("Normal", "PartialDisruption",
+                              "FullDisruption")},
+            open_labels=("zone",))
         self.zone_evictions = LabeledCounter(
-            "node_lifecycle_evictions_total", ("zone",))
+            "node_lifecycle_evictions_total", ("zone",),
+            open_labels=("zone",))
         self.eviction_queue_depth = LabeledGauge(
-            "node_lifecycle_eviction_queue_depth", ("zone",))
+            "node_lifecycle_eviction_queue_depth", ("zone",),
+            open_labels=("zone",))
         self.eviction_suspensions = Counter(
             "node_lifecycle_suspensions_total")
         # cluster-autoscaler series (autoscaler's scaled_up/down analogs)
@@ -277,16 +340,25 @@ class Metrics:
         # misses, snapshot HBM footprint + host->device upload bytes,
         # device->host result-fetch bytes, and device-vs-host wave
         # attribution (how much scheduling actually ran on device)
+        # program names are the record_dispatch() call sites; bucket is
+        # intentionally open — one value per compiled shape bucket, the
+        # same cardinality as the jit program cache itself
         self.device_jit_events = LabeledCounter(
-            "device_jit_cache_events_total", ("program", "bucket", "event"))
+            "device_jit_cache_events_total", ("program", "bucket", "event"),
+            values={"program": ("wave", "round", "gang", "telemetry"),
+                    "event": ("hit", "miss")},
+            open_labels=("bucket",))
         self.device_jit_compile_seconds = Histogram(
             "device_jit_compile_seconds")
         self.snapshot_hbm_bytes = Gauge("snapshot_hbm_bytes")
         # per-device footprint under mesh sharding (each device holds
         # 1/shards of every node group + a full pod/term replica); the
         # unlabeled gauge above sums TRUE per-shard bytes across devices
+        # device ids are open (mesh size varies) but bounded by the
+        # visible device count; stale children are zeroed on fallback
         self.snapshot_hbm_device_bytes = LabeledGauge(
-            "snapshot_hbm_bytes_per_device", ("device",))
+            "snapshot_hbm_bytes_per_device", ("device",),
+            open_labels=("device",))
         self.snapshot_upload_bytes = Counter("snapshot_upload_bytes_total")
         self.device_fetch_bytes = Counter("device_fetch_bytes_total")
         self.waves_total = LabeledCounter("scheduler_waves_total", ("path",))
@@ -295,7 +367,8 @@ class Metrics:
         # reason (affinity = untwinned inter-pod-affinity plane;
         # multi_tk = multi-topology-key required terms)
         self.degraded_golden_pods = LabeledCounter(
-            "scheduler_degraded_golden_pods_total", ("reason",))
+            "scheduler_degraded_golden_pods_total", ("reason",),
+            values={"reason": ("affinity", "multi_tk")})
         # decision observatory (score decomposition, tracing only):
         # margin-of-victory distribution over placed pods (winner's
         # weighted total minus the best DIFFERENT node's), and the
@@ -303,8 +376,15 @@ class Metrics:
         # totals — the skew ratio between children says which priority
         # actually drives placements under the current weights
         self.score_margin = Histogram("scheduler_score_margin")
+        # ops/scores.py SCORE_STACK verbatim (tests/test_analysis.py
+        # asserts the two stay in lockstep)
         self.score_priority_points = LabeledCounter(
-            "scheduler_score_priority_points_total", ("priority",))
+            "scheduler_score_priority_points_total", ("priority",),
+            values={"priority": (
+                "LeastRequested", "BalancedAllocation", "MostRequested",
+                "NodeAffinity", "TaintToleration", "SelectorSpread",
+                "PreferAvoid", "ImageLocality", "InterPodAffinity",
+                "HostExtra")})
         # first-fail predicate attribution for unschedulable pods —
         # previously reachable only through events and FitError text,
         # invisible to dashboards
@@ -315,18 +395,30 @@ class Metrics:
         # resource, the fragmentation index (1 - largest free block /
         # total free), feasibility headroom per canonical pod shape,
         # and per-zone utilization
+        # resource/zone labels are open by design (extended resources
+        # and zones come from cluster state) and PRUNED on disappearance
+        # by the telemetry exporter — cardinality tracks the live
+        # cluster, not its history
         self.cluster_requested = LabeledGauge(
-            "scheduler_cluster_requested", ("resource",))
+            "scheduler_cluster_requested", ("resource",),
+            open_labels=("resource",))
         self.cluster_allocatable = LabeledGauge(
-            "scheduler_cluster_allocatable", ("resource",))
+            "scheduler_cluster_allocatable", ("resource",),
+            open_labels=("resource",))
         self.cluster_free_largest = LabeledGauge(
-            "scheduler_cluster_free_largest_block", ("resource",))
+            "scheduler_cluster_free_largest_block", ("resource",),
+            open_labels=("resource",))
         self.cluster_fragmentation = LabeledGauge(
-            "scheduler_cluster_fragmentation_index", ("resource",))
+            "scheduler_cluster_fragmentation_index", ("resource",),
+            open_labels=("resource",))
+        # ops/telemetry.py CANONICAL_SHAPES names verbatim
+        # (tests/test_analysis.py asserts lockstep)
         self.feasibility_headroom = LabeledGauge(
-            "scheduler_feasibility_headroom", ("shape",))
+            "scheduler_feasibility_headroom", ("shape",),
+            values={"shape": ("1c-2g", "2c-8g", "4c-16g", "8c-32g")})
         self.zone_utilization = LabeledGauge(
-            "scheduler_zone_utilization", ("zone", "resource"))
+            "scheduler_zone_utilization", ("zone", "resource"),
+            open_labels=("zone", "resource"))
 
     def all_series(self):
         out = {}

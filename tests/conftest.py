@@ -63,6 +63,14 @@ def pytest_configure(config):
                    "telemetry suite (score decomposition parity, "
                    "/debug/score, telemetry plane device==twin; "
                    "make obs / make chaos)")
+    config.addinivalue_line(
+        "markers", "analysis: ktpu-lint static-analysis rule engine "
+                   "suite (per-rule historical-bug fixtures + the live "
+                   "tree gate behind make lint)")
+    config.addinivalue_line(
+        "markers", "racecheck: runtime lock-order watcher suite incl. "
+                   "the runtime-edges ⊆ static-lock-graph bridge "
+                   "(make chaos)")
 
 
 import pytest  # noqa: E402

@@ -1,0 +1,653 @@
+"""ktpu-lint (kubernetes_tpu/analysis) — the invariant-enforcing static
+analysis pass.
+
+Covers, per rule, the HISTORICAL bug pattern that motivated it
+(reintroduced in fixture corpora and asserted caught):
+
+  determinism      PR 8: gang members kept in a `set`, iterated to build
+                   the member batch — placements varied with the uid
+                   hash seed
+  jit-purity       PR 2: a faultpoints.fire() inside a jitted body runs
+                   only at trace time, so injected faults vanish once
+                   the compile cache warms
+  twin-coverage    PR 7: a device kernel without a hostwave twin loses
+                   the degraded path silently
+  f32-reduction    PR 9: raw f32 sums reassociate differently on numpy
+                   vs XLA vs GSPMD
+  lock-discipline  PR 4: device dispatch under the scheduler lock from
+                   outside the scheduler; lock-order inversions
+  metrics-hygiene  PR 9: unbounded label values grow /metrics forever
+
+plus suppression/baseline mechanics and the live-tree gates: the real
+repo analyzes clean, and the determinism/jit-purity baselines are EMPTY
+by policy (findings there are fixed, never grandfathered).
+"""
+
+import textwrap
+
+import pytest
+
+from kubernetes_tpu.analysis import Baseline, run_analysis
+from kubernetes_tpu.analysis.core import Corpus, SourceFile
+from kubernetes_tpu.analysis.rules import (DeterminismRule, F32ReductionRule,
+                                           JitPurityRule, LockDisciplineRule,
+                                           MetricsHygieneRule,
+                                           TwinCoverageRule)
+
+pytestmark = pytest.mark.analysis
+
+
+def corpus(tmp_path, files, test_texts=None) -> Corpus:
+    """A Corpus over fixture sources written to a scratch tree."""
+    root = tmp_path / "repo"
+    c = Corpus(root)
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        c.files[rel] = SourceFile(p, rel)
+    c.test_texts = dict(test_texts or {})
+    return c
+
+
+# ---------------------------------------------------------------------------
+# determinism — the PR 8 gang-members-in-a-set bug, verbatim pattern
+# ---------------------------------------------------------------------------
+
+PR8_FIXTURE = """
+    class SchedulingQueue:
+        def __init__(self):
+            self._gang_members = set()
+
+        def add(self, uid):
+            self._gang_members.add(uid)
+
+        def _pop_gangmates_locked(self, out):
+            for uid in self._gang_members:
+                out.append(uid)
+"""
+
+
+class TestDeterminismRule:
+    def run(self, tmp_path, src):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": src})
+        return DeterminismRule().run(c)
+
+    def test_catches_the_pr8_gang_set_pattern(self, tmp_path):
+        fs = self.run(tmp_path, PR8_FIXTURE)
+        assert len(fs) == 1
+        assert fs[0].rule == "determinism"
+        assert "self._gang_members" in fs[0].message
+        assert "for uid in self._gang_members" in fs[0].snippet
+
+    def test_local_set_expression_and_materializers(self, tmp_path):
+        fs = self.run(tmp_path, """
+            def stale(have, want):
+                for s in set(have) - want:
+                    print(s)
+
+            def listed(have):
+                return list(set(have))
+
+            def joined(have):
+                return ",".join({h for h in have})
+        """)
+        assert len(fs) == 3
+        assert {f.line for f in fs} == {3, 7, 10}
+
+    def test_order_free_consumers_are_clean(self, tmp_path):
+        fs = self.run(tmp_path, """
+            def ok(have, want):
+                s = set(have)
+                n = len(s)
+                m = sorted(s)
+                if any(x in want for x in m):
+                    return min(s | want, default=None)
+                return n
+        """)
+        assert fs == []
+
+    def test_dict_as_ordered_set_is_the_sanctioned_fix(self, tmp_path):
+        fs = self.run(tmp_path, """
+            from typing import Dict
+
+            def fixed(victims):
+                gangs: Dict[str, None] = {}
+                for v in victims:
+                    gangs[v] = None
+                for k in gangs:
+                    yield k
+        """)
+        assert fs == []
+
+    def test_suppression_on_line_above(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": textwrap.dedent("""
+            def drain(pending):
+                # ktpu: allow[determinism] wait-on-ALL, order irrelevant
+                for p in set(pending):
+                    p.join()
+        """)})
+        report = run_analysis(corpus=c, rules=[DeterminismRule()],
+                              baseline=Baseline())
+        assert report.new == []
+        assert len(report.suppressed) == 1
+
+    def test_out_of_scope_package_is_not_checked(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/kubelet/fix.py": PR8_FIXTURE})
+        assert DeterminismRule().run(c) == []
+
+
+# ---------------------------------------------------------------------------
+# jit-purity — the PR 2 fire()-inside-the-boundary bug, verbatim pattern
+# ---------------------------------------------------------------------------
+
+PR2_FIXTURE = """
+    import functools
+    import time
+
+    import jax
+
+    from ..utils import faultpoints
+
+
+    def schedule_round(*args, **kw):
+        faultpoints.fire("kernel.round")  # correct: outside the boundary
+        return _schedule_round(*args, **kw)
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def _schedule_round(x, *, n):
+        faultpoints.fire("kernel.round.inner")  # the PR 2 bug
+        return _helper(x) * n
+
+
+    def _helper(x):
+        t = time.monotonic()  # reachable from the root: also impure
+        return x + t
+"""
+
+
+class TestJitPurityRule:
+    def test_catches_the_pr2_fire_inside_jit(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/ops/fix.py": PR2_FIXTURE})
+        fs = JitPurityRule().run(c)
+        # the jitted body's fire() and the transitively-reached clock,
+        # NOT the entry wrapper's fire() (that one is the sanctioned
+        # pattern — outside the boundary)
+        fires = [f for f in fs if "fault point" in f.message]
+        assert len(fires) == 1 and "inner" in fires[0].snippet
+        assert any("wall-clock" in f.message for f in fs)
+
+    def test_self_mutation_and_print_flagged(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/ops/fix.py": """
+            import jax
+
+            @jax.jit
+            def body(x):
+                print(x)
+                return x
+        """})
+        fs = JitPurityRule().run(c)
+        assert len(fs) == 1 and "print" in fs[0].message
+
+    def test_assigned_jit_root_is_found(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/ops/fix.py": """
+            import jax
+            import time
+
+            def _body(x):
+                return x + time.time()
+
+            compiled = jax.jit(_body)
+        """})
+        fs = JitPurityRule().run(c)
+        assert len(fs) == 1 and "wall-clock" in fs[0].message
+
+    def test_pure_kernel_is_clean(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/ops/fix.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def body(x):
+                return jnp.sum(x.astype(jnp.int32))
+        """})
+        assert JitPurityRule().run(c) == []
+
+    def test_jax_functional_prng_is_pure_stdlib_rng_is_not(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/ops/fix.py": """
+            import random
+
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def ok(key, x):
+                return x + jax.random.uniform(key, x.shape)
+
+            @jax.jit
+            def bad_std(x):
+                return x + random.random()
+
+            @jax.jit
+            def bad_np(x):
+                return x + np.random.rand()
+        """})
+        fs = [f for f in JitPurityRule().run(c) if "RNG" in f.message]
+        assert {f.snippet for f in fs} == {
+            "return x + random.random()", "return x + np.random.rand()"}
+
+
+# ---------------------------------------------------------------------------
+# twin-coverage
+# ---------------------------------------------------------------------------
+
+
+class TestTwinCoverageRule:
+    KERNELS = """
+        import jax.numpy as jnp
+
+        def covered(x):
+            return jnp.sum(x.astype(jnp.int32))
+
+        def orphan(x):
+            return jnp.max(x)
+
+        def _private(x):
+            return jnp.min(x)
+
+        def host_util(x):
+            return len(x)
+    """
+    HOSTWAVE = """
+        import numpy as np
+
+        def covered(x):
+            return np.sum(x.astype(np.int32))
+    """
+
+    def make(self, tmp_path, test_texts=None):
+        return corpus(tmp_path, {
+            "kubernetes_tpu/ops/gang.py": self.KERNELS,
+            "kubernetes_tpu/ops/hostwave.py": self.HOSTWAVE,
+        }, test_texts)
+
+    def test_missing_twin_and_missing_parity_test(self, tmp_path):
+        c = self.make(tmp_path)
+        fs = TwinCoverageRule().run(c)
+        by_msg = {f.snippet: f.message for f in fs}
+        assert any("orphan" in m and "no host twin" in m
+                   for m in by_msg.values())
+        assert any("covered" in m and "no parity test" in m
+                   for m in by_msg.values())
+        # private and jnp-free functions are not kernels
+        assert not any("_private" in m or "host_util" in m
+                       for m in by_msg.values())
+
+    def test_parity_test_naming_both_clears_it(self, tmp_path):
+        c = self.make(tmp_path, test_texts={
+            "test_x.py": "from kubernetes_tpu.ops import hostwave\n"
+                         "def test_covered_parity(): covered()\n"})
+        fs = TwinCoverageRule().run(c)
+        assert not any("covered" in f.message for f in fs)
+        assert any("orphan" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# f32-reduction
+# ---------------------------------------------------------------------------
+
+
+class TestF32ReductionRule:
+    def test_raw_f32_sum_flagged_exemptions_hold(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/ops/fix.py": """
+            import numpy as np
+
+            def raw(x):
+                return np.sum(x)
+
+            def int_cast(x):
+                return np.sum(x.astype(np.int32))
+
+            def masked(x):
+                m = x > 0
+                return np.sum(m)
+
+            def f64_accum(x):
+                return np.sum(x, dtype=np.float64)
+
+            def where_f32(m, x):
+                return np.sum(np.where(m, x, 0.0))
+        """})
+        fs = F32ReductionRule().run(c)
+        assert {f.snippet for f in fs} == {
+            "return np.sum(x)", "return np.sum(np.where(m, x, 0.0))"}
+        assert all("_pairwise_sum" in f.message for f in fs)
+
+    def test_out_of_scope_is_clean(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": """
+            import numpy as np
+
+            def raw(x):
+                return np.sum(x)
+        """})
+        assert F32ReductionRule().run(c) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+class TestLockDisciplineRule:
+    def test_inversion_detected(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._l1 = threading.Lock()
+                    self._l2 = threading.Lock()
+
+                def m1(self):
+                    with self._l1:
+                        with self._l2:
+                            pass
+
+                def m2(self):
+                    with self._l2:
+                        with self._l1:
+                            pass
+        """})
+        fs = LockDisciplineRule().run(c)
+        inv = [f for f in fs if "inversion" in f.message]
+        assert len(inv) == 1
+        assert "A._l1" in inv[0].message and "A._l2" in inv[0].message
+
+    def test_blocking_io_under_lock(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/state/fix.py": """
+            import threading
+            import time
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def m(self):
+                    with self._lock:
+                        time.sleep(1)
+        """})
+        fs = LockDisciplineRule().run(c)
+        assert len(fs) == 1 and "blocking call" in fs[0].message
+
+    def test_pr4_device_dispatch_under_scheduler_lock(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": """
+            import threading
+
+            class Scheduler:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def fine_inside(self):
+                    with self._mu:
+                        schedule_wave(1)
+        """, "kubernetes_tpu/controllers/clusterautoscaler.py": """
+            class Autoscaler:
+                def __init__(self, sched):
+                    self.sched = Scheduler()
+
+                def whatif(self):
+                    with self.sched._mu:
+                        schedule_wave(1)
+        """})
+        fs = LockDisciplineRule().run(c)
+        outside = [f for f in fs if "outside the Scheduler" in f.message]
+        assert len(outside) == 1
+        assert outside[0].path.endswith("clusterautoscaler.py")
+
+    def test_multi_item_with_statement_forms_edges(self, tmp_path):
+        """`with a, b:` acquires b while a is held — same edge as
+        lexical nesting, and an inversion written that way is caught."""
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._l1 = threading.Lock()
+                    self._l2 = threading.Lock()
+
+                def m1(self):
+                    with self._l1:
+                        with self._l2:
+                            pass
+
+                def m2(self):
+                    with self._l2, self._l1:
+                        pass
+        """})
+        fs = LockDisciplineRule().run(c)
+        assert len([f for f in fs if "inversion" in f.message]) == 1
+
+    def test_transitive_acquisition_builds_the_edge(self, tmp_path):
+        """A method that takes lock B is called under lock A — the edge
+        exists even though no `with` nests lexically."""
+        from kubernetes_tpu.analysis.lockgraph import extract_lock_graph
+
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def push(self, x):
+                    with self._lock:
+                        return x
+
+            class S:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.queue = Q()
+
+                def commit(self, x):
+                    with self._mu:
+                        self.queue.push(x)
+        """})
+        g = extract_lock_graph(c)
+        assert ("S._mu", "Q._lock") in g.edge_set()
+
+
+# ---------------------------------------------------------------------------
+# metrics-hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsHygieneRule:
+    FIXTURE = """
+        from ..utils.metrics import LabeledCounter, bounded_label
+
+
+        class M:
+            def __init__(self):
+                self.errors = LabeledCounter("errs", ("stage",))
+                self.events = LabeledCounter(
+                    "ev", ("kind",), values={"kind": ("a", "b")})
+
+
+        class User:
+            def __init__(self):
+                self.m = M()
+
+            def bad_dynamic(self, s):
+                self.m.errors.labels(stage=s).inc()
+
+            def ok_dynamic_declared(self, k):
+                self.m.events.labels(kind=k).inc()
+
+            def ok_literal(self):
+                self.m.errors.labels(stage="bind").inc()
+
+            def bad_literal_outside_declared(self):
+                self.m.events.labels(kind="zzz").inc()
+
+            def ok_bucketed(self, s):
+                self.m.errors.labels(stage=bounded_label(s, ("x",))).inc()
+
+            def ok_literal_local(self, cond):
+                v = "a" if cond else "b"
+                self.m.errors.labels(stage=v).inc()
+    """
+
+    def test_sites_classified(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": self.FIXTURE})
+        fs = MetricsHygieneRule().run(c)
+        assert len(fs) == 2
+        dynamic = [f for f in fs if "dynamic value" in f.message]
+        outside = [f for f in fs if "not in the declared" in f.message]
+        assert len(dynamic) == 1 and "stage=s" in dynamic[0].snippet
+        assert len(outside) == 1 and "kind='zzz'" in outside[0].message
+
+    def test_runtime_enforcement_matches_the_static_declaration(self):
+        """values= is not documentation: labels() rejects undeclared
+        values, so the static rule's 'declared set' assumption holds at
+        runtime too."""
+        from kubernetes_tpu.utils.metrics import LabeledCounter, bounded_label
+
+        fam = LabeledCounter("x_total", ("kind",),
+                             values={"kind": ("a", "b")})
+        fam.labels(kind="a").inc()
+        with pytest.raises(ValueError, match="declared value set"):
+            fam.labels(kind="zzz")
+        assert bounded_label("zzz", ("a", "b")) == "Other"
+        assert bounded_label("a", ("a", "b")) == "a"
+
+    def test_declarations_stay_in_lockstep_with_their_sources(self):
+        """The literal value sets in utils/metrics.py mirror constants
+        owned elsewhere — pin them together."""
+        from kubernetes_tpu.controllers.nodelifecycle import ZONE_STATES
+        from kubernetes_tpu.ops.scores import SCORE_STACK
+        from kubernetes_tpu.ops.telemetry import CANONICAL_SHAPES
+        from kubernetes_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        assert (m.score_priority_points.decl.values["priority"]
+                == frozenset(SCORE_STACK))
+        assert (m.feasibility_headroom.decl.values["shape"]
+                == frozenset(s[0] for s in CANONICAL_SHAPES))
+        assert (m.zone_health.decl.values["state"]
+                == frozenset(ZONE_STATES))
+
+
+# ---------------------------------------------------------------------------
+# suppression / baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineMechanics:
+    SRC = """
+        def a(have):
+            for x in set(have):
+                print(x)
+
+        def pad():
+            return 1
+
+        def b(have):
+            for x in set(have):
+                print(x)
+    """
+
+    def test_multiset_one_to_one_matching(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": self.SRC})
+        fs = DeterminismRule().run(c)
+        assert len(fs) == 2
+        baseline = Baseline.from_findings(fs[:1])
+        new, matched, stale = baseline.split(fs)
+        # identical snippets: ONE is grandfathered, the second is new
+        assert len(matched) == 1 and len(new) == 1 and stale == []
+
+    def test_baseline_survives_line_shifts(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": self.SRC})
+        fs = DeterminismRule().run(c)
+        baseline = Baseline.from_findings(fs)
+        # same file, findings pushed to different line numbers by edits
+        # above them — keys match on (rule, path, snippet), not line
+        shifted = corpus(tmp_path, {
+            "kubernetes_tpu/sched/fix.py": "\n\n\n\n" + self.SRC})
+        fs2 = DeterminismRule().run(shifted)
+        assert {f.line for f in fs2} != {f.line for f in fs}
+        new, matched, stale = baseline.split(fs2)
+        assert new == [] and len(matched) == 2 and stale == []
+
+    def test_path_filter_never_strands_out_of_path_entries(self, tmp_path):
+        """A path-filtered run classifies the baseline over the WHOLE
+        tree — out-of-path entries must neither surface as stale nor be
+        dropped by a subsequent --update-baseline."""
+        bug = """
+            def f(have):
+                for x in set(have):
+                    print(x)
+        """
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/a.py": bug,
+                              "kubernetes_tpu/state/b.py": bug})
+        baseline = Baseline.from_findings(DeterminismRule().run(c))
+        assert len(baseline.entries) == 2
+        report = run_analysis(corpus=c, rules=[DeterminismRule()],
+                              baseline=baseline,
+                              paths=("kubernetes_tpu/sched/",))
+        assert report.ok()
+        assert report.stale_baseline == []
+        assert len(report.baselined) == 1  # only the in-path one reported
+
+    def test_stale_entries_reported(self, tmp_path):
+        c = corpus(tmp_path, {"kubernetes_tpu/sched/fix.py": """
+            def clean():
+                return 1
+        """})
+        baseline = Baseline([{"rule": "determinism",
+                              "path": "kubernetes_tpu/sched/fix.py",
+                              "snippet": "for x in set(gone):"}])
+        report = run_analysis(corpus=c, rules=[DeterminismRule()],
+                              baseline=baseline)
+        assert report.ok()
+        assert len(report.stale_baseline) == 1
+
+
+# ---------------------------------------------------------------------------
+# the live tree
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTree:
+    def test_whole_tree_is_clean_on_the_committed_baseline(self):
+        """`python -m kubernetes_tpu.analysis` exits 0 — the tier-1 gate
+        behind `make lint`."""
+        from kubernetes_tpu.analysis.__main__ import main
+
+        assert main([]) == 0
+
+    def test_determinism_and_jit_purity_need_no_baseline_at_all(self):
+        """The acceptance bar: these two rules are clean with an EMPTY
+        baseline — every historical finding was fixed, not
+        grandfathered."""
+        report = run_analysis(rules=[DeterminismRule(), JitPurityRule()],
+                              baseline=Baseline())
+        assert report.new == [], [f.render() for f in report.new]
+        assert report.baselined == []
+
+    def test_committed_baseline_holds_no_determinism_or_purity_debt(self):
+        baseline = Baseline.load()
+        rules = {e["rule"] for e in baseline.entries}
+        assert "determinism" not in rules
+        assert "jit-purity" not in rules
+
+    def test_static_lock_graph_covers_the_known_plane(self):
+        """The statically-extracted graph sees the scheduler's real
+        acquisition edges (the runtime-superset bridge lives in
+        tests/test_racecheck.py, driven by live traffic)."""
+        from kubernetes_tpu.analysis.lockgraph import static_lock_graph
+
+        edges = static_lock_graph()
+        assert ("Scheduler._mu", "SchedulingQueue._lock") in edges
+        # and its reverse is absent: no inversion in the live tree
+        assert ("SchedulingQueue._lock", "Scheduler._mu") not in edges

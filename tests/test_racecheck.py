@@ -1,13 +1,26 @@
-"""Race-detection analog + distributed-init tests."""
+"""Race-detection analog + distributed-init tests.
+
+The runtime LockOrderWatcher and the STATIC lock graph extracted by
+ktpu-lint (kubernetes_tpu/analysis/lockgraph.py) check the same
+invariant from opposite sides: the watcher sees the acquisition orders
+tests happen to exercise, the static pass sees every order the code can
+express. TestStaticRuntimeBridge pins them together — edges observed
+under live `--racecheck` traffic must be a subset of the static graph,
+so the static analysis provably covers (at least) everything runtime
+race checking can see.
+"""
 
 import threading
 import time
+
+import pytest
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.runtime.store import ObjectStore
 from kubernetes_tpu.utils.racecheck import LockOrderWatcher, instrument
 
 
+@pytest.mark.racecheck
 class TestLockOrderWatcher:
     def test_detects_inversion(self):
         w = LockOrderWatcher()
@@ -160,6 +173,77 @@ class TestLockOrderWatcher:
         self._srv.stop()
         assert not errors, errors
         w.assert_clean()
+
+
+@pytest.mark.racecheck
+@pytest.mark.analysis
+class TestStaticRuntimeBridge:
+    """Static lock graph ⊇ runtime-observed edges."""
+
+    def _drive(self, racecheck=True, threads=False):
+        from helpers import make_node, make_pod
+
+        from kubernetes_tpu.sched.scheduler import Scheduler
+
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8, racecheck=racecheck)
+        for i in range(4):
+            store.create("nodes", make_node(f"n{i}", cpu="4"))
+        for i in range(10):
+            store.create("pods", make_pod(f"p{i}", cpu="1"))
+        if threads:
+            stop = threading.Event()
+            errors = []
+
+            def pump():
+                while not stop.is_set():
+                    try:
+                        sched.run_once()
+                    except Exception as e:  # pragma: no cover
+                        errors.append(e)
+                    time.sleep(0.002)
+
+            ts = [threading.Thread(target=pump, daemon=True)
+                  for _ in range(2)]
+            for t in ts:
+                t.start()
+            time.sleep(0.5)
+            stop.set()
+            for t in ts:
+                t.join(timeout=5)
+            assert not errors, errors
+        else:
+            sched.schedule_pending()
+        sched.wait_for_binds()
+        return sched
+
+    def test_flag_off_is_free(self):
+        sched = self._drive(racecheck=False)
+        assert sched.racecheck_watcher is None
+
+    def test_runtime_edges_are_a_subset_of_the_static_graph(self):
+        """Every lock-order edge live scheduling traffic produces is one
+        the static extraction already knew about — the analysis pass
+        keeps covering paths tests didn't happen to exercise."""
+        from kubernetes_tpu.analysis.lockgraph import static_lock_graph
+
+        sched = self._drive()
+        w = sched.racecheck_watcher
+        w.assert_clean()
+        assert w.edges, "traffic should have produced at least one edge"
+        static = static_lock_graph()
+        assert w.edges <= static, (
+            f"runtime edges missing from the static lock graph: "
+            f"{sorted(w.edges - static)} — lockgraph.py lost resolution "
+            f"of a lock or a call path")
+
+    def test_concurrent_traffic_stays_clean_and_covered(self):
+        from kubernetes_tpu.analysis.lockgraph import static_lock_graph
+
+        sched = self._drive(threads=True)
+        w = sched.racecheck_watcher
+        w.assert_clean()
+        assert w.edges <= static_lock_graph()
 
 
 class TestDistributed:
