@@ -37,14 +37,16 @@ verify: lint test
 # (eviction storm control under mass node failure) + the `hostpath`
 # numpy-twin suite (breaker-open degraded waves, device==host parity)
 # + the `racecheck` lock-order suite (go test -race analog, incl. the
-# runtime-edges ⊆ static-lock-graph bridge against ktpu-lint).
+# runtime-edges ⊆ static-lock-graph bridge against ktpu-lint)
+# + the `storm` overload-control suite (priority-aware load shedding,
+# device-dispatch watchdog, clock-driven burst SLO gates).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

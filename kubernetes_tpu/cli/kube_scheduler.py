@@ -210,7 +210,11 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
                      breaker_cooldown=cfg.breaker_cooldown,
                      metrics=metrics,
                      bind_max_attempts=cfg.bind_max_attempts,
-                     racecheck=cfg.racecheck)
+                     racecheck=cfg.racecheck,
+                     shed_watermark=cfg.shed_watermark,
+                     shed_priority_threshold=cfg.shed_priority_threshold,
+                     shed_age_s=cfg.shed_age_s,
+                     wave_deadline_s=cfg.wave_deadline_s)
 
 
 def run(cfg: KubeSchedulerConfiguration, server_url: str,
@@ -396,6 +400,22 @@ def main(argv=None) -> int:
                          "lock-order watcher (go test -race analog; "
                          "edge names match the ktpu-lint static lock "
                          "graph)")
+    ap.add_argument("--shed-watermark", type=int, default=None,
+                    help="overload control: pending-depth high watermark "
+                         "above which sub-threshold-priority pods park in "
+                         "the shed area (0 disables shedding)")
+    ap.add_argument("--shed-priority-threshold", type=int, default=None,
+                    help="pods below this priority are sheddable past the "
+                         "watermark (default 1000: system/high classes "
+                         "are never shed)")
+    ap.add_argument("--shed-age", type=float, default=None,
+                    help="seconds a shed pod waits before aging back into "
+                         "the active heap (starvation proof; default 30)")
+    ap.add_argument("--wave-deadline", type=float, default=None,
+                    help="device-dispatch watchdog budget in seconds: an "
+                         "exceeded dispatch is abandoned, trips the "
+                         "breaker, and the round completes via the host "
+                         "twin (0 disables)")
     ap.add_argument("--once", action="store_true",
                     help="exit when the queue drains (batch mode)")
     args = ap.parse_args(argv)
@@ -426,6 +446,14 @@ def main(argv=None) -> int:
         cfg.round_ledger_path = args.round_ledger
     if args.racecheck:
         cfg.racecheck = True
+    if args.shed_watermark is not None:
+        cfg.shed_watermark = args.shed_watermark
+    if args.shed_priority_threshold is not None:
+        cfg.shed_priority_threshold = args.shed_priority_threshold
+    if args.shed_age is not None:
+        cfg.shed_age_s = args.shed_age
+    if args.wave_deadline is not None:
+        cfg.wave_deadline_s = args.wave_deadline
     for kv in filter(None, args.feature_gates.split(",")):
         k, _, v = kv.partition("=")
         cfg.feature_gates[k] = v.lower() in ("true", "1", "")

@@ -96,11 +96,23 @@ class WaveResult(NamedTuple):
 # owns the series (one scheduler per process everywhere real).
 _TELEMETRY = None
 _COMPILED: set = set()
+# Device-dispatch watchdog (utils/watchdog.py), registered by the
+# scheduler exactly like the telemetry hook (set_watchdog; last
+# scheduler built owns it, None disables). Every dispatch through
+# record_dispatch then runs under a deadline budget: a dispatch that
+# exceeds cfg.wave_deadline_s is abandoned with DispatchTimeout so a
+# wedged XLA runtime can never wedge the scheduling loop.
+_WATCHDOG = None
 
 
 def set_telemetry(metrics) -> None:
     global _TELEMETRY
     _TELEMETRY = metrics
+
+
+def set_watchdog(watchdog) -> None:
+    global _WATCHDOG
+    _WATCHDOG = watchdog
 
 
 def _device_count(x) -> int:
@@ -138,13 +150,44 @@ def dispatch_bucket(nt, pm, tt, kw, lead=()) -> tuple:
 def record_dispatch(program: str, bucket_key: tuple, fn):
     """Run one kernel dispatch, classifying it as a program-cache hit or
     miss by shape bucket and timing the miss (trace+lower+compile happen
-    synchronously inside the first call at a new shape). Costs one set
-    lookup when telemetry is registered, nothing when it isn't."""
+    synchronously inside the first call at a new shape). With neither
+    telemetry nor a watchdog registered this costs one kernel.hang
+    fault-point check (a single dict read when inactive) and nothing
+    else.
+
+    This is also the watchdog seam (set_watchdog): with a watchdog
+    registered the dispatch runs on a deadline-budgeted worker thread
+    and raises DispatchTimeout on abandonment — unwarmed buckets get
+    the compile-scaled budget, since a first-shape compile is not a
+    hang. The `kernel.hang` fault point fires INSIDE the guarded
+    dispatch (a `latency` fault there models a wedged XLA dispatch that
+    silently never returns — the failure mode the breaker's
+    exception-only accounting can't see)."""
     tel = _TELEMETRY
-    if tel is None:
+    wd = _WATCHDOG
+    if tel is None and (wd is None or not wd.armed()):
+        # fully unarmed hot path: the chaos seam still fires, nothing
+        # else is paid. (_COMPILED is not fed here; a watchdog armed
+        # later merely grants warm programs the larger compile-scaled
+        # budget once — benign in the safe direction.)
+        faultpoints.fire("kernel.hang")
         return fn()
     key = (program,) + bucket_key
     miss = key not in _COMPILED
+    inner = fn
+
+    def dispatch():
+        faultpoints.fire("kernel.hang")
+        return inner()
+
+    if wd is not None and wd.armed():
+        fn = lambda: wd.run(dispatch, program=program, warm=not miss)
+    else:
+        fn = dispatch
+    if tel is None:
+        out = fn()
+        _COMPILED.add(key)  # warm-tracking feeds the watchdog's scaling
+        return out
     t0 = time.monotonic()
     out = fn()
     _COMPILED.add(key)

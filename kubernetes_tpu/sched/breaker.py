@@ -77,6 +77,17 @@ class DevicePathBreaker:
                 self.state == CLOSED and self.failures >= self.threshold):
             self._trip()
 
+    def record_hang(self) -> None:
+        """A dispatch the watchdog ABANDONED (utils/watchdog.py): trip
+        immediately, ignoring the consecutive-failure threshold. The
+        threshold exists to tolerate transient exceptions that cost
+        milliseconds each; a hang costs a full wave_deadline_s per
+        retry and signals a wedged runtime that won't heal by retrying
+        — the cooldown probe is the right (and only) way back."""
+        self.failures += 1
+        if self.state != OPEN:
+            self._trip()
+
     def record_success(self) -> None:
         self.failures = 0
         if self.state != CLOSED:

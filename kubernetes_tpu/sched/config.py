@@ -52,6 +52,20 @@ class KubeSchedulerConfiguration:
     # bind reconciler: POST attempts per bind before the GET-based
     # succeeded-but-response-lost resolution kicks in
     bind_max_attempts: int = 3
+    # overload control (sched/queue.py "Overload control" +
+    # utils/watchdog.py): shed_watermark bounds the non-shed pending
+    # depth (0 disables shedding); pods below shed_priority_threshold
+    # park in the shed area past the watermark and age back into the
+    # active heap after shed_age_s (starvation-proof);
+    # wave_deadline_s (0 disables) budgets every device dispatch via
+    # the watchdog — an exceeded dispatch is abandoned, trips the
+    # breaker, and the round salvages through the hostwave twin — and
+    # drives the per-round host-stage accounting that adaptively
+    # shrinks the wave size under overload
+    shed_watermark: int = 0
+    shed_priority_threshold: int = 1000
+    shed_age_s: float = 30.0
+    wave_deadline_s: float = 0.0
     # observability: flight recorder (per-pod span tracing served at
     # /debug/trace, opt-in like --profiling), its round ring-buffer
     # depth, and the optional per-round JSONL ledger path

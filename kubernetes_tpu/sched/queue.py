@@ -35,6 +35,31 @@ pop_wave never splits a gang across waves (members travel together so
 the joint-assignment kernel sees the entire gang in one batch). The
 `gang_lookup` hook is wired by the scheduler; when it is None (every
 non-gang deployment) none of this code runs.
+
+Overload control (priority-aware load shedding): every pending pod is
+accounted to a priority CLASS (QUEUE_CLASSES: system / high / normal /
+low, banded from pod priority), and a configurable high watermark
+(`shed_watermark`, 0 = disabled) bounds the non-shed pending depth.
+Past the watermark, newly arriving (and event-flushed) pods whose
+priority sits below `shed_priority_threshold` are PARKED in a shed
+area instead of the active heap — the queue stops growing the working
+set a 5x burst storm would otherwise balloon without bound, while
+system/high-priority pods are never shed. Shedding is
+starvation-proof: a shed pod ages back into the active heap after
+`shed_age_s` seconds with a one-wave exemption from re-shedding, and
+the whole shed area drains (oldest first) as soon as the non-shed
+depth falls back under the watermark. The pop_wave composition
+guarantee follows from the heap order plus shedding: within a wave,
+above-threshold pods always drain before any sub-threshold pod (the
+heap is strict priority-first), and during a storm sub-threshold pods
+are not in the heap at all — so a storm of low-priority pods can
+never starve a system/high-priority wave. Gang members are never shed
+(their admission gate is the gang waiting area; shedding a member
+would deadlock the gang against its own queue).
+
+The `queue.shed` fault point (drop mode) forces the shed decision for
+every sheddable pod regardless of watermark — the chaos rig for
+storm-survival tests that want shedding without a real 5x backlog.
 """
 
 from __future__ import annotations
@@ -46,6 +71,29 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as api
+from ..utils import faultpoints
+
+# Priority-class bands for queue depth accounting and shed decisions.
+# `system` matches the reference's system-critical band (priorities at
+# or above 2e9: system-cluster-critical / system-node-critical);
+# `high` is anything at or above HIGH_PRIORITY_BAND; `normal` is any
+# remaining positive priority; `low` is zero (the unprioritized
+# default) and below — exactly the class a burst storm of bulk pods
+# lands in.
+QUEUE_CLASSES = ("system", "high", "normal", "low")
+SYSTEM_PRIORITY_BAND = 2_000_000_000
+HIGH_PRIORITY_BAND = 1000
+
+
+def pod_class(priority: int) -> str:
+    """Priority-class band of a pod priority value."""
+    if priority >= SYSTEM_PRIORITY_BAND:
+        return "system"
+    if priority >= HIGH_PRIORITY_BAND:
+        return "high"
+    if priority > 0:
+        return "normal"
+    return "low"
 
 
 def _matches_affinity_term(unsched: api.Pod, assigned: api.Pod) -> bool:
@@ -68,9 +116,27 @@ def _matches_affinity_term(unsched: api.Pod, assigned: api.Pod) -> bool:
 
 class SchedulingQueue:
     def __init__(self, pod_priority_enabled: bool = True,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 shed_watermark: int = 0,
+                 shed_priority_threshold: int = HIGH_PRIORITY_BAND,
+                 shed_age_s: float = 30.0):
         self.pod_priority = pod_priority_enabled
         self.clock = clock
+        # overload control (module docstring "Overload control"):
+        # watermark 0 disables shedding entirely — the default, so
+        # deployments that never configure it see the pre-shed queue
+        self.shed_watermark = int(shed_watermark)
+        self.shed_priority_threshold = int(shed_priority_threshold)
+        self.shed_age_s = float(shed_age_s)
+        # uid -> pod parked by load shedding; _shed_at drives aging,
+        # _shed_exempt (dict-as-ordered-set) marks aged-back pods that
+        # get one un-sheddable pass through the active heap
+        self._shed: Dict[str, api.Pod] = {}
+        self._shed_at: Dict[str, float] = {}
+        self._shed_exempt: Dict[str, None] = {}
+        # fired (class_name) on every shed decision — feeds
+        # scheduler_shed_total{class}
+        self.on_shed: Optional[Callable[[str], None]] = None
         self._lock = threading.Condition()
         self._heap: List = []  # (-priority, seq, uid)
         self._items: Dict[str, api.Pod] = {}  # uid -> pod (active)
@@ -106,6 +172,112 @@ class SchedulingQueue:
         self._gang_wait_start: Dict[str, float] = {}
         self._closed = False
 
+    # -- overload control (priority-aware shedding) ---------------------------
+
+    def _depth_locked(self) -> int:
+        """Total pending depth across every area incl. shed — the
+        number an operator's backlog dashboard sums."""
+        return (len(self._items) + len(self._unschedulable)
+                + len(self._backoff) + len(self._shed)
+                + sum(len(w) for w in self._gang_waiting.values()))
+
+    def _working_depth_locked(self) -> int:
+        """Depth the scheduler actually works: everything pending MINUS
+        the shed area. This is what the watermark bounds — shedding
+        exists precisely so this number stops tracking offered load."""
+        return self._depth_locked() - len(self._shed)
+
+    def _should_shed_locked(self, pod: api.Pod) -> bool:
+        """Shed decision for one arriving/flushed pod: only
+        sub-threshold-priority pods, only past the high watermark, never
+        an aged-back exempt pod. The queue.shed fault point (drop mode)
+        forces the decision for any sheddable pod — the storm chaos rig."""
+        if self.shed_watermark <= 0:
+            return False
+        if api.pod_priority(pod) >= self.shed_priority_threshold:
+            return False
+        if pod.uid in self._shed_exempt:
+            return False
+        if faultpoints.fire("queue.shed", payload=pod):
+            return True
+        return self._working_depth_locked() >= self.shed_watermark
+
+    def _shed_locked(self, pod: api.Pod) -> None:
+        self._shed[pod.uid] = pod
+        self._shed_at[pod.uid] = self.clock()
+        # first-enqueue time survives the shed: per-pod e2e latency
+        # honestly counts the time load shedding cost this pod
+        self.added_at.setdefault(pod.uid, self.clock())
+        # wake any blocked popper: it computed its wait bound before
+        # this pod's aging deadline existed and would otherwise sleep
+        # past it (forever, with timeout=None)
+        self._lock.notify()
+        if self.on_shed is not None:
+            self.on_shed(pod_class(api.pod_priority(pod)))
+
+    def _flush_shed_locked(self):
+        """Aging + watermark release. Aged pods (shed longer than
+        shed_age_s) re-enter the active heap UNCONDITIONALLY with a
+        one-wave re-shed exemption — the starvation proof: no pod sheds
+        forever, however long the storm. Separately, once the working
+        depth is back under the watermark the shed area drains oldest
+        first until the watermark is reached again (hysteresis lives in
+        the aging, not a second knob)."""
+        if not self._shed:
+            return
+        now = self.clock()
+        aged = [uid for uid, t in self._shed_at.items()
+                if now - t >= self.shed_age_s]
+        for uid in aged:
+            pod = self._shed.pop(uid)
+            self._shed_at.pop(uid, None)
+            self._shed_exempt[uid] = None
+            self._items[uid] = pod
+            heapq.heappush(self._heap, self._key(pod))
+        # oldest-first release under the watermark: dict preserves
+        # insertion order and _shed_locked appends, so iteration order
+        # IS shed order. An armed queue.shed fault suppresses the
+        # watermark release (aging above still ran — starvation-proof
+        # even under the chaos rig): without this, a forced shed would
+        # be undone by the very next flush under a quiet watermark.
+        # is_armed, not fire(): the probe must not consume a
+        # times-bounded fault's per-pod shed budget
+        if not faultpoints.is_armed("queue.shed", "drop"):
+            while (self._shed
+                   and self._working_depth_locked() < self.shed_watermark):
+                uid = next(iter(self._shed))
+                pod = self._shed.pop(uid)
+                self._shed_at.pop(uid, None)
+                self._items[uid] = pod
+                heapq.heappush(self._heap, self._key(pod))
+                aged.append(uid)
+        if aged:
+            self._lock.notify_all()
+
+    def shed_count(self) -> int:
+        with self._lock:
+            return len(self._shed)
+
+    def shed_pods(self) -> List[api.Pod]:
+        with self._lock:
+            return list(self._shed.values())
+
+    def class_counts(self) -> Dict[str, int]:
+        """Pending depth per priority class across every area (active,
+        backoff, unschedulable, gang-waiting, shed) — the client-go
+        workqueue-depth analog, banded so dashboards can alert on the
+        class that matters (scheduler_queue_class_pods{class=...})."""
+        counts = {c: 0 for c in QUEUE_CLASSES}
+        with self._lock:
+            for area in (self._items, self._unschedulable, self._backoff,
+                         self._shed):
+                for pod in area.values():
+                    counts[pod_class(api.pod_priority(pod))] += 1
+            for waiting in self._gang_waiting.values():
+                for pod in waiting.values():
+                    counts[pod_class(api.pod_priority(pod))] += 1
+        return counts
+
     # -- add / pop -----------------------------------------------------------
 
     def _key(self, pod: api.Pod):
@@ -115,12 +287,18 @@ class SchedulingQueue:
     def add(self, pod: api.Pod):
         released = None
         with self._lock:
-            if pod.uid in self._items:
+            if pod.uid in self._items or pod.uid in self._shed:
                 return
             self._unschedulable.pop(pod.uid, None)
             self._backoff.pop(pod.uid, None)
             info = (self.gang_lookup(pod) if self.gang_lookup is not None
                     else None)
+            # load shedding gates ONLY non-gang pods (a shed gang member
+            # would deadlock its gang's admission against the queue);
+            # gang storms are bounded by the gang waiting area instead
+            if info is None and self._should_shed_locked(pod):
+                self._shed_locked(pod)
+                return
             if info is not None:
                 key, min_member = info
                 self._gang_of[pod.uid] = key
@@ -216,7 +394,7 @@ class SchedulingQueue:
     def add_if_not_present(self, pod: api.Pod):
         with self._lock:
             if (pod.uid in self._items or pod.uid in self._unschedulable
-                    or pod.uid in self._backoff
+                    or pod.uid in self._backoff or pod.uid in self._shed
                     or self._gang_waiting_has_locked(pod.uid)):
                 return
         self.add(pod)
@@ -240,7 +418,7 @@ class SchedulingQueue:
         schedulable again); the backoff gate still applies."""
         with self._lock:
             if (pod.uid in self._items or pod.uid in self._unschedulable
-                    or pod.uid in self._backoff
+                    or pod.uid in self._backoff or pod.uid in self._shed
                     or self._gang_waiting_has_locked(pod.uid)):
                 return
             cycle = self._cycle.pop(pod.uid, self._current_cycle)
@@ -256,6 +434,12 @@ class SchedulingQueue:
         until = self._backoff_until.get(pod.uid, 0.0)
         if until > self.clock():
             self._backoff[pod.uid] = pod
+        elif (pod.uid not in self._gang_of
+                and self._should_shed_locked(pod)):
+            # event-driven flushes respect the watermark too: a storm's
+            # move_all_to_active must not balloon the active heap with
+            # the very pods admission just shed
+            self._shed_locked(pod)
         else:
             self._items[pod.uid] = pod
             heapq.heappush(self._heap, self._key(pod))
@@ -281,6 +465,7 @@ class SchedulingQueue:
         with self._lock:
             while True:
                 self._flush_backoff_locked()
+                self._flush_shed_locked()
                 if self._heap or self._closed:
                     break
                 wait = None
@@ -295,6 +480,14 @@ class SchedulingQueue:
                     if until_next <= 0:
                         continue  # expired while computing: reflush
                     wait = until_next if wait is None else min(wait, until_next)
+                if self._shed:
+                    # shed aging must wake a blocked popper like backoff
+                    # deadlines do — nothing notifies when time passes
+                    nxt = (min(self._shed_at.values()) + self.shed_age_s
+                           - self.clock())
+                    if nxt <= 0:
+                        continue  # aged while computing: reflush
+                    wait = nxt if wait is None else min(wait, nxt)
                 self._lock.wait(wait)
             if self._closed and not self._heap:
                 return None
@@ -305,6 +498,11 @@ class SchedulingQueue:
             _, _, uid = heapq.heappop(self._heap)
             pod = self._items.pop(uid, None)
             if pod is not None:
+                # an aged-back pod's re-shed exemption is consumed by
+                # reaching a wave — if it fails and re-parks during a
+                # still-raging storm it is sheddable again (and will age
+                # back again: bounded, not starved)
+                self._shed_exempt.pop(uid, None)
                 self._current_cycle += 1
                 self._cycle[uid] = self._current_cycle
                 return pod
@@ -406,6 +604,9 @@ class SchedulingQueue:
             if new.uid in self._backoff:
                 self._backoff[new.uid] = new
                 return
+            if new.uid in self._shed:
+                self._shed[new.uid] = new
+                return
             if self._gang_waiting_has_locked(new.uid):
                 self._gang_waiting[self._gang_of[new.uid]][new.uid] = new
                 return
@@ -432,6 +633,9 @@ class SchedulingQueue:
             self._unschedulable.pop(uid, None)
             self._backoff.pop(uid, None)
             self._backoff_until.pop(uid, None)
+            self._shed.pop(uid, None)
+            self._shed_at.pop(uid, None)
+            self._shed_exempt.pop(uid, None)
 
     def delete(self, pod: api.Pod):
         with self._lock:
@@ -439,6 +643,9 @@ class SchedulingQueue:
             self._unschedulable.pop(pod.uid, None)
             self._backoff.pop(pod.uid, None)
             self._backoff_until.pop(pod.uid, None)
+            self._shed.pop(pod.uid, None)
+            self._shed_at.pop(pod.uid, None)
+            self._shed_exempt.pop(pod.uid, None)
             self.added_at.pop(pod.uid, None)
             # gang accounting must shrink with the member, or a stale uid
             # would open the gate early and place a sub-minMember gang;
@@ -466,9 +673,7 @@ class SchedulingQueue:
 
     def pending_count(self) -> int:
         with self._lock:
-            return (len(self._items) + len(self._unschedulable)
-                    + len(self._backoff)
-                    + sum(len(w) for w in self._gang_waiting.values()))
+            return self._depth_locked()
 
     def unschedulable_pods(self) -> List[api.Pod]:
         """Snapshot of the unschedulable map — the cluster autoscaler's
@@ -488,6 +693,7 @@ class SchedulingQueue:
     def active_count(self) -> int:
         with self._lock:
             self._flush_backoff_locked()
+            self._flush_shed_locked()
             return len(self._items)
 
     def backoff_count(self) -> int:

@@ -47,6 +47,7 @@ from ..state.scrubber import SnapshotScrubber
 from ..state.snapshot import Snapshot
 from ..utils import (Metrics, PodBackoff, Trace, bounded_label, faultpoints,
                      tracing)
+from ..utils.watchdog import DispatchTimeout
 from ..utils.feature_gates import FeatureGates
 from . import breaker as breaker_mod
 from .breaker import STATE_CODES, DevicePathBreaker
@@ -159,7 +160,11 @@ class Scheduler:
                  breaker_threshold: int = 3, breaker_cooldown: float = 30.0,
                  metrics: Optional[Metrics] = None,
                  bind_max_attempts: int = 3,
-                 racecheck: bool = False):
+                 racecheck: bool = False,
+                 shed_watermark: int = 0,
+                 shed_priority_threshold: Optional[int] = None,
+                 shed_age_s: float = 30.0,
+                 wave_deadline_s: float = 0.0):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -181,9 +186,19 @@ class Scheduler:
         self.cache = SchedulerCache(ttl=assume_ttl, clock=clock)
         self.snapshot = Snapshot(caps=caps)
         self.featurizer = PodFeaturizer(self.snapshot, GroupLister(store))
+        # overload control: the queue's priority-aware shed plane
+        # (sched/queue.py "Overload control") — watermark 0 keeps it off
+        from .queue import HIGH_PRIORITY_BAND
+
         self.queue = SchedulingQueue(
             pod_priority_enabled=self.features.enabled("PodPriority"),
-            clock=clock)
+            clock=clock,
+            shed_watermark=shed_watermark,
+            shed_priority_threshold=(HIGH_PRIORITY_BAND
+                                     if shed_priority_threshold is None
+                                     else shed_priority_threshold),
+            shed_age_s=shed_age_s)
+        self.queue.on_shed = self._pod_shed
         # --racecheck: wrap the scheduling-plane locks in the runtime
         # LockOrderWatcher (utils/racecheck.py), the `go test -race`
         # analog. Lock names match the STATIC lock graph's ids
@@ -252,6 +267,30 @@ class Scheduler:
         from ..ops import kernel as _kernel
 
         _kernel.set_telemetry(self.metrics)
+        # device-dispatch watchdog (utils/watchdog.py): with
+        # wave_deadline_s > 0 every dispatch through the record_dispatch
+        # seam runs under a deadline budget; an abandoned (wedged)
+        # dispatch trips the breaker immediately and the round salvages
+        # through the hostwave twin. Registered process-globally like
+        # the telemetry hook — None (the default) disarms it, so a
+        # later deadline-free scheduler also clears a predecessor's.
+        self.wave_deadline_s = float(wave_deadline_s)
+        self.watchdog = None
+        if self.wave_deadline_s > 0:
+            from ..utils.watchdog import DispatchWatchdog
+
+            self.watchdog = DispatchWatchdog(
+                self.wave_deadline_s, on_abandon=self._dispatch_abandoned)
+        _kernel.set_watchdog(self.watchdog)
+        # per-round deadline accounting: host-stage (featurize+upload)
+        # overruns degrade the wave size before they degrade latency —
+        # _wave_cap halves on overrun (floor MIN_ADAPTIVE_WAVE) and
+        # recovers toward wave_size on comfortably-fast rounds
+        self._wave_cap = wave_size
+        # class-depth gauge cadence: class_counts() walks every pending
+        # pod under the queue lock — O(1) area gauges export per wave,
+        # the per-class walk at most once per second
+        self._next_class_export = 0.0
         self._upload_bytes_seen = 0
         from .volume_binder import VolumeBinder
 
@@ -458,6 +497,69 @@ class Scheduler:
             rec.event("breaker", state=state,
                       failures=self.breaker.failures)
 
+    def _pod_shed(self, cls: str) -> None:
+        """Queue shed hook: one increment per shed decision, labelled
+        by priority class (sheds of system/high are the SLO violation
+        the storm gates hold at zero)."""
+        self.metrics.shed_total.labels(**{"class": cls}).inc()
+        rec = tracing.active()
+        if rec is not None:
+            rec.event("pod_shed", cls=cls)
+
+    def _dispatch_abandoned(self, program: str, deadline: float) -> None:
+        """Watchdog abandonment hook: the overrun counter's dispatch
+        stage, a span event, and a log line — the wave itself raises
+        DispatchTimeout into the normal device-failure path."""
+        self.metrics.wave_deadline_overruns.labels(stage="dispatch").inc()
+        logging.getLogger(__name__).error(
+            "device dispatch %s abandoned after %.3fs deadline; runtime "
+            "presumed wedged until it returns", program, deadline)
+        rec = tracing.active()
+        if rec is not None:
+            rec.event("dispatch_abandoned", program=program,
+                      deadline_s=round(deadline, 3))
+
+    # floor of the adaptive wave cap: below this the per-wave fixed
+    # costs dominate and halving further only multiplies round count
+    MIN_ADAPTIVE_WAVE = 16
+
+    def _account_host_overrun(self, host_seconds: float) -> None:
+        """Per-round deadline accounting for the HOST stages
+        (featurize/stage/upload): a round whose host side alone exceeds
+        wave_deadline_s halves the adaptive wave cap — smaller waves
+        bound per-round latency at the cost of more rounds — and
+        comfortably-fast rounds (under a quarter of the budget) double
+        it back toward wave_size. No-op while wave_deadline_s is 0."""
+        if self.wave_deadline_s <= 0:
+            return
+        if host_seconds > self.wave_deadline_s:
+            self.metrics.wave_deadline_overruns.labels(stage="host").inc()
+            # floor clamped to wave_size: a scheduler configured BELOW
+            # the adaptive floor must never have overload RAISE its wave
+            self._wave_cap = max(self._wave_cap // 2,
+                                 min(self.MIN_ADAPTIVE_WAVE,
+                                     self.wave_size))
+        elif (host_seconds <= self.wave_deadline_s / 4
+                and self._wave_cap < self.wave_size):
+            self._wave_cap = min(self._wave_cap * 2, self.wave_size)
+        self.metrics.effective_wave_size.set(self._wave_cap)
+
+    def _runtime_wedged(self) -> bool:
+        """Is a watchdog-abandoned dispatch still in flight? The
+        runtime is presumed wedged until that thread returns."""
+        return self.watchdog is not None and bool(
+            self.watchdog.outstanding())
+
+    def _device_admitted(self) -> bool:
+        """May this wave/round dispatch to the device? False while the
+        runtime is wedged: even the breaker's half-open probe must not
+        be spent on it — allow() is deliberately not consulted, so the
+        OPEN -> HALF_OPEN transition (and the probe it admits) is
+        deferred until the wedge clears."""
+        if self._runtime_wedged():
+            return False
+        return self.breaker.allow()
+
     def _gang_released(self, key: str, waited: float) -> None:
         self.metrics.gang_wait_seconds.observe(waited)
         rec = tracing.active()
@@ -607,7 +709,9 @@ class Scheduler:
         # probe (OPEN -> HALF_OPEN after cooldown) and dispatch an
         # upload+fetch to a possibly-wedged runtime — the probe belongs
         # to a scheduling wave, telemetry only rides a CLOSED breaker
-        if device_ok and self.breaker.state == breaker_mod.CLOSED:
+        # (and never a runtime with a watchdog-abandoned wave in flight)
+        if (device_ok and self.breaker.state == breaker_mod.CLOSED
+                and not self._runtime_wedged()):
             try:
                 nt, _pm, _tt = self._to_device()
                 packed = np.asarray(tele.cluster_telemetry(nt, num_zones=Z))
@@ -825,6 +929,18 @@ class Scheduler:
         g.labels(queue="backoff").set(self.queue.backoff_count())
         g.labels(queue="unschedulable").set(self.queue.unschedulable_count())
         g.labels(queue="gang_waiting").set(self.queue.gang_waiting_count())
+        # overload control: the load-shedding parking area, plus depth
+        # banded by priority class (the client-go workqueue-depth
+        # signal, made class-aware so a storm's bulk never hides a
+        # starving high class). The class walk is O(total pending)
+        # under the queue lock, so it runs on a 1s cadence, not per
+        # wave — dashboards scrape slower than that anyway.
+        g.labels(queue="shed").set(self.queue.shed_count())
+        now = self.clock()
+        if now >= self._next_class_export:
+            self._next_class_export = now + 1.0
+            for cls, n in self.queue.class_counts().items():
+                self.metrics.queue_class_pods.labels(**{"class": cls}).set(n)
         # device telemetry: HBM footprint of the resident mirror — the
         # TRUE per-shard sum across devices (node groups tile the mesh's
         # "nodes" axis, pod/term replicas cost full size per device) —
@@ -854,7 +970,7 @@ class Scheduler:
         if self._dormant:
             return 0  # not the leader: informers stay warm, waves don't run
         self._housekeep()
-        pods = self.queue.pop_wave(self.wave_size, timeout=timeout)
+        pods = self.queue.pop_wave(self._wave_cap, timeout=timeout)
         if not pods:
             return 0
         with self._mu:
@@ -883,16 +999,17 @@ class Scheduler:
         self._housekeep()
         all_pods: List[api.Pod] = []
         while True:
-            batch = self.queue.pop_wave(self.wave_size, timeout=0.0)
+            batch = self.queue.pop_wave(self._wave_cap, timeout=0.0)
             if not batch:
                 break
             all_pods.extend(batch)
         if not all_pods:
             return 0
         with self._mu:
-            if not self.breaker.allow():
-                # breaker open: the whole backlog takes the exact host
-                # path — degraded but never stopped
+            if not self._device_admitted():
+                # breaker open (or a wedged dispatch outstanding): the
+                # whole backlog takes the host path — degraded but
+                # never stopped
                 return self._schedule_degraded(all_pods)
             placed = 0
             # gangs bypass the device-resident round: their placements
@@ -912,6 +1029,11 @@ class Scheduler:
                     if not self.featurizer.needs_host_path(p)]
             if not pods:
                 return placed
+            # RE-check admission: a gang dispatch above may have been
+            # watchdog-abandoned (breaker now open, wedge outstanding)
+            # — the round must not dispatch at that runtime
+            if not self._device_admitted():
+                return placed + self._schedule_degraded(pods)
             return placed + self._run_pipeline(pods)
 
     def warm_pipeline(self, pods: List[api.Pod],
@@ -1033,7 +1155,10 @@ class Scheduler:
 
         trace = Trace(f"pipeline of {len(pods)}", clock=self.clock)
         start = self.clock()
-        W = self.wave_size
+        # the ADAPTIVE cap, not wave_size: host-stage overruns under
+        # wave_deadline_s shrink it (see _account_host_overrun); they
+        # are the same number whenever no deadline is configured
+        W = self._wave_cap
         # ipa anywhere in the backlog (or already placed) caps the round
         # at the ipa-safe wave count, even for ipa-free leading rounds
         max_waves = (PIPELINE_MAX_WAVES_IPA
@@ -1107,6 +1232,9 @@ class Scheduler:
                     bytes=self.snapshot.upload_bytes_total - up0,
                     shards=(1 if self._active_mesh is None
                             else int(self._active_mesh.shape["nodes"])))
+        # per-round deadline accounting: featurize+stage+upload overruns
+        # degrade the wave size BEFORE they degrade latency
+        self._account_host_overrun(self.clock() - start)
         usage = (nt.requested, nt.nonzero, nt.pod_count)
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
@@ -1196,6 +1324,8 @@ class Scheduler:
                                                         want_deco)
                     self._round_pallas_checked = True
             except Exception as e:
+                if isinstance(e, DispatchTimeout):
+                    raise  # wedged runtime, not a pallas failure: no retry
                 if not round_pallas:
                     raise
                 import sys
@@ -1214,10 +1344,18 @@ class Scheduler:
             self._device_failure(e)
             for p in pods:
                 self.snapshot.unstage(p)
-                self.queue.add_if_not_present(p)
             if rt is not None:
                 rec.end_round(rt, outcome="device_failure",
                               error=type(e).__name__)
+            if isinstance(e, DispatchTimeout):
+                # partial-round salvage: the dispatch is wedged, not
+                # wrong — the breaker just opened (record_hang) and the
+                # SAME round's pods place NOW through the hostwave twin
+                # instead of re-queueing behind a per-wave retry that
+                # would hang for another deadline
+                return self._schedule_degraded(pods)
+            for p in pods:
+                self.queue.add_if_not_present(p)
             return 0
         self.breaker.record_success()
         self._rr = rr_end
@@ -1723,9 +1861,15 @@ class Scheduler:
         """Account one device-path failure: the labelled error series,
         the breaker's consecutive-failure count, and the log (with
         traceback — the old bare stderr prints were invisible to both
-        dashboards and capture fixtures)."""
+        dashboards and capture fixtures). A watchdog abandonment
+        (DispatchTimeout) trips the breaker IMMEDIATELY: a wedged
+        runtime won't heal by retrying, and each retry would burn a
+        full wave_deadline_s."""
         self.metrics.scheduling_errors.labels(stage="wave").inc()
-        self.breaker.record_failure()
+        if isinstance(exc, DispatchTimeout):
+            self.breaker.record_hang()
+        else:
+            self.breaker.record_failure()
         logging.getLogger(__name__).error(
             "device wave failed (%s consecutive, breaker %s): %s: %s",
             self.breaker.failures, self.breaker.state,
@@ -1735,7 +1879,7 @@ class Scheduler:
         import jax
         import jax.numpy as jnp
 
-        if not self.breaker.allow():
+        if not self._device_admitted():
             return self._schedule_degraded(pods)
         # gang members place through the all-or-nothing joint-assignment
         # path; pop_wave delivers gangs whole, so this partition never
@@ -1747,6 +1891,10 @@ class Scheduler:
             placed_gang = self._schedule_gangs(gang_pods)
             if not pods:
                 return placed_gang
+            if not self._device_admitted():
+                # a gang dispatch was just watchdog-abandoned: the
+                # wave must not follow it onto the wedged runtime
+                return placed_gang + self._schedule_degraded(pods)
         # pods whose required pod-(anti)affinity spans >1 topology key take
         # the exact host path (ops/affinity.py single-anchor limitation)
         host_path = [p for p in pods if self.featurizer.needs_host_path(p)]
@@ -1786,6 +1934,10 @@ class Scheduler:
         if rt is not None:
             rt.mark("upload", cat="device",
                     bytes=self.snapshot.upload_bytes_total - up0)
+        # per-wave deadline accounting, same as the round path: the
+        # live CLI loop runs run_once -> HERE, and host-stage overruns
+        # must shrink the wave there too, not only under the pipeline
+        self._account_host_overrun(self.clock() - start)
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
@@ -1832,6 +1984,14 @@ class Scheduler:
                 # below could never catch it
                 jax.block_until_ready(res)
             except Exception as e:
+                if isinstance(e, DispatchTimeout):
+                    # a watchdog abandonment is not a pallas problem:
+                    # retrying the XLA formulation would dispatch AGAIN
+                    # at the wedged runtime (under the compile-scaled
+                    # budget — the XLA variant was never warmed) and
+                    # burn another deadline; straight to the outer
+                    # handler, which trips the breaker and degrades
+                    raise
                 if not self._use_pallas:
                     raise
                 import sys
@@ -2110,6 +2270,12 @@ class Scheduler:
 
         from ..ops.gang import schedule_gang
 
+        # per-gang admission: an earlier gang in this very batch may
+        # have been watchdog-abandoned — each remaining gang must
+        # re-check before dispatching (and must not burn another full
+        # wave_deadline_s against a runtime already presumed wedged)
+        if not self._device_admitted():
+            return self._schedule_degraded_gang(key, members, rt)
         min_member = self.gangs.min_member(members[0])
         bound = self.gangs.bound_count(self.cache, key,
                                        exclude={p.uid for p in members})
@@ -2177,6 +2343,8 @@ class Scheduler:
                                     use_pallas=self._use_pallas, **kw)
                 jax.block_until_ready(res)
             except Exception as e:
+                if isinstance(e, DispatchTimeout):
+                    raise  # wedged runtime, not a pallas failure: no retry
                 if not self._use_pallas:
                     raise
                 import sys
@@ -2200,11 +2368,17 @@ class Scheduler:
             # and let the breaker route future waves host-side once it
             # trips
             self._device_failure(e)
-            for p in members:
-                self._park_with_backoff(p)
             if rt is not None:
                 rt.ledger.update(outcome="device_failure",
                                  error=type(e).__name__)
+            if isinstance(e, DispatchTimeout):
+                # wedged dispatch: salvage the gang through the host
+                # twin's all-or-nothing plane right now (the breaker
+                # just opened; atomicity is preserved either way)
+                return placed + self._schedule_degraded_gang(key, members,
+                                                             rt)
+            for p in members:
+                self._park_with_backoff(p)
             return placed
         self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"

@@ -14,6 +14,18 @@ Wired points (grep for `faultpoints.fire`):
   kernel.wave      ops/kernel.py schedule_wave entry (per-wave program)
   kernel.round     ops/kernel.py schedule_round entry (device-resident round)
   kernel.gang      ops/gang.py schedule_gang entry (joint-assignment)
+  kernel.hang      ops/kernel.py record_dispatch, INSIDE the guarded
+                   dispatch (on the watchdog's worker thread when one
+                   is armed) — `latency` models a wedged XLA dispatch
+                   that silently never returns: with cfg.wave_deadline_s
+                   set the watchdog abandons it, the breaker trips via
+                   record_hang, and the round salvages through the
+                   hostwave twin
+  queue.shed       sched/queue.py _should_shed_locked — `drop` forces
+                   the shed decision for every sheddable
+                   (sub-threshold-priority, non-gang) pod regardless of
+                   the watermark: the storm chaos rig for shedding
+                   tests that don't want to build a real 5x backlog
   bind.post        sched/scheduler.py _bind_and_finish, before each POST
                    attempt (the bind reconciler retries through it)
   watch.deliver    runtime/store.py _notify, before fan-out
@@ -151,6 +163,22 @@ def fire(name: str, payload=None) -> bool:
         (f.fn or _default_corrupt)(payload)
         return False
     raise (f.exc() if f.exc is not None else FaultInjected(name))
+
+
+def is_armed(name: str, mode: Optional[str] = None) -> bool:
+    """Non-consuming probe: is the point armed (optionally in `mode`)
+    with budget remaining? Unlike fire(), this neither counts a hit
+    nor decrements `times` — for call sites that only need to know
+    whether chaos is active (the queue's watermark-release suppression
+    must not eat the per-pod shed budget of a times-bounded fault)."""
+    if not _active:
+        return False
+    if getattr(_suppress, "on", False):
+        return False
+    f = _active.get(name)
+    if f is None or (mode is not None and f.mode != mode):
+        return False
+    return f.times is None or f.times > 0
 
 
 def activate(name: str, mode: str = "raise", arg: float = 0.0,

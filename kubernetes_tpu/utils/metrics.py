@@ -309,6 +309,24 @@ class Metrics:
         # step — the cluster autoscaler and operators both watch it
         # (a Counter can't report a depth that drains)
         self.pending_pods = LabeledGauge("scheduler_pending_pods", ("queue",))
+        # overload-control plane (sched/queue.py "Overload control" +
+        # utils/watchdog.py): pods parked by priority-aware load
+        # shedding per class, pending depth banded by priority class
+        # (the client-go workqueue-depth signal made class-aware), wave
+        # deadline overruns by stage (dispatch = watchdog-abandoned
+        # device dispatch; host = featurize/upload exceeded the round
+        # budget), and the adaptive wave cap those host overruns drive.
+        # Class values are sched/queue.py QUEUE_CLASSES verbatim.
+        self.shed_total = LabeledCounter(
+            "scheduler_shed_total", ("class",),
+            values={"class": ("system", "high", "normal", "low")})
+        self.queue_class_pods = LabeledGauge(
+            "scheduler_queue_class_pods", ("class",),
+            values={"class": ("system", "high", "normal", "low")})
+        self.wave_deadline_overruns = LabeledCounter(
+            "scheduler_wave_deadline_overruns_total", ("stage",),
+            values={"stage": ("dispatch", "host")})
+        self.effective_wave_size = Gauge("scheduler_effective_wave_size")
         # node lifecycle / eviction storm control: per-zone health state
         # (1 on the current state's child, 0 on the others), evictions
         # actually executed per zone, evictions due-but-held by the

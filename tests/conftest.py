@@ -71,6 +71,11 @@ def pytest_configure(config):
         "markers", "racecheck: runtime lock-order watcher suite incl. "
                    "the runtime-edges ⊆ static-lock-graph bridge "
                    "(make chaos)")
+    config.addinivalue_line(
+        "markers", "storm: overload control / storm survival suite "
+                   "(priority-aware load shedding, device-dispatch "
+                   "watchdog, clock-driven burst SLO gates; tier-1 + "
+                   "make chaos)")
 
 
 import pytest  # noqa: E402

@@ -286,3 +286,99 @@ class TestGCCrash:
             time.sleep(0.01)
         assert store.count("pods") == 0, \
             "orphaned dependents survived the GC restart"
+
+
+class TestWatchdogWedgedDispatch:
+    """ISSUE 11 satellite: breaker half-open probing while a
+    watchdog-abandoned dispatch is still in flight. The probe must NOT
+    dispatch at a runtime with a wedged wave outstanding — the wedge
+    would eat the probe exactly like the abandoned wave — so the
+    OPEN -> HALF_OPEN transition is deferred until the wedge clears."""
+
+    def _fill(self, store):
+        for i in range(4):
+            store.create("nodes", make_node(f"wd-n{i}", cpu="8",
+                                            memory="16Gi"))
+
+    def test_probe_deferred_until_wedged_dispatch_returns(self):
+        from kubernetes_tpu.utils import faultpoints
+
+        # warm the round program in a deadline-free scheduler first so
+        # the guarded scheduler's dispatch budget is the warm one (a
+        # cold compile is not a hang and gets the scaled budget)
+        s1 = ObjectStore()
+        self._fill(s1)
+        a = Scheduler(s1, wave_size=16)
+        for i in range(4):
+            s1.create("pods", make_pod(f"warm-{i}", cpu="100m",
+                                       memory="64Mi"))
+        assert a.schedule_pending() == 4
+
+        store = ObjectStore()
+        self._fill(store)
+        sched = Scheduler(store, wave_size=16, wave_deadline_s=0.1,
+                          breaker_cooldown=0.05)
+        # ONE wedged dispatch: 1.2s hang vs the 0.1s deadline
+        faultpoints.activate("kernel.hang", "latency", arg=1.2, times=1)
+        for i in range(4):
+            store.create("pods", make_pod(f"p-{i}", cpu="100m",
+                                          memory="64Mi"))
+        placed = sched.schedule_pending()
+        assert placed == 4  # salvaged via the hostwave twin
+        assert sched.breaker.state == "open"
+        assert sched.watchdog.outstanding() == 1
+
+        # cooldown elapsed AND the wedge still in flight: scheduling
+        # continues degraded, the probe is NOT spent, the breaker
+        # stays OPEN (allow() was never consulted)
+        time.sleep(0.06)
+        store.create("pods", make_pod("while-wedged", cpu="100m",
+                                      memory="64Mi"))
+        assert sched.schedule_pending() == 1
+        assert sched.breaker.state == "open", \
+            "probe dispatched at a runtime with a wedged wave in flight"
+        assert sched.wave_path() == "vector"
+
+        # the wedged thread returns: the next wave IS the probe, it
+        # succeeds on the healthy runtime, and the breaker closes
+        deadline = time.monotonic() + 3.0
+        while sched.watchdog.outstanding() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sched.watchdog.outstanding() == 0
+        store.create("pods", make_pod("after-heal", cpu="100m",
+                                      memory="64Mi"))
+        assert sched.schedule_pending() == 1
+        assert sched.breaker.state == "closed"
+        assert sched.wave_path() in ("xla", "pallas")
+
+    def test_half_open_probe_failure_reopens_while_hang_mode_persists(self):
+        from kubernetes_tpu.utils import faultpoints
+
+        s1 = ObjectStore()
+        self._fill(s1)
+        a = Scheduler(s1, wave_size=16)
+        for i in range(2):
+            s1.create("pods", make_pod(f"warm2-{i}", cpu="100m",
+                                       memory="64Mi"))
+        assert a.schedule_pending() == 2
+
+        store = ObjectStore()
+        self._fill(store)
+        sched = Scheduler(store, wave_size=16, wave_deadline_s=0.1,
+                          breaker_cooldown=0.05)
+        # EVERY dispatch hangs (a persistently wedged runtime): the
+        # first trip opens; after each cooldown the probe hangs too,
+        # is abandoned, and re-opens with a fresh cooldown — placement
+        # never stops through it all
+        faultpoints.activate("kernel.hang", "latency", arg=0.4)
+        total = 0
+        for i in range(3):
+            store.create("pods", make_pod(f"w-{i}", cpu="100m",
+                                          memory="64Mi"))
+            total += sched.schedule_pending()
+            time.sleep(0.45)  # wedge clears + cooldown elapses
+        assert total == 3
+        assert sched.breaker.state == "open"
+        assert sched.breaker.trips >= 2  # initial trip + >=1 probe re-trip
+        faultpoints.reset()
+        assert sched.watchdog.drain(5.0)  # no orphan dispatch leaks out
