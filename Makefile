@@ -39,24 +39,28 @@ verify: lint test
 # + the `racecheck` lock-order suite (go test -race analog, incl. the
 # runtime-edges ⊆ static-lock-graph bridge against ktpu-lint)
 # + the `storm` overload-control suite (priority-aware load shedding,
-# device-dispatch watchdog, clock-driven burst SLO gates).
+# device-dispatch watchdog, clock-driven burst SLO gates)
+# + the `shadow` weight hot-swap suite (live WeightProfile swap /
+# rollback under a degraded path, candidate==production zero-divergence
+# parity).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
 # Observability tier: the flight-recorder / metrics-exposition suite,
-# the numpy-twin parity suite, and the decision-observatory /
+# the numpy-twin parity suite, the decision-observatory /
 # cluster-telemetry suite (score decomposition, /debug/score, telemetry
-# plane parity).
+# plane parity), and the shadow-scoring observatory suite (live
+# WeightProfile hot swap, counterfactual divergence, /debug/shadow).
 obs: native
 	$(PYTHON) -m pytest tests/ -q \
-		-m "observability or hostpath or telemetry" \
+		-m "observability or hostpath or telemetry or shadow" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

@@ -180,7 +180,32 @@ def _resolve_mesh(spec):
     return mesh_for_devices(None if spec == "auto" else int(spec))
 
 
-def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None):
+# cumulative shadow divergence summary of the measured run (--shadow):
+# collected from the scheduler's weight book after the drain, emitted on
+# the config's JSON line by emit()
+_SHADOW_SUMMARY = None
+
+
+def _load_shadow_profiles(store, path):
+    """--shadow profile.json: create the WeightProfile objects through
+    the object store, exercising the same watch path a live operator
+    uses (the scheduler's weightprofiles informer picks them up). Parse
+    + construction are the shared sched/weights.py helpers, so this
+    path can never drift from --weight-profiles."""
+    from kubernetes_tpu.sched.weights import (parse_profiles_file,
+                                              profile_objects)
+
+    for obj in profile_objects(parse_profiles_file(path)):
+        store.create("weightprofiles", obj)
+
+
+def _collect_shadow(sched):
+    global _SHADOW_SUMMARY
+    _SHADOW_SUMMARY = sched.weightbook.summary()
+
+
+def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None,
+               shadow=None):
     from kubernetes_tpu.ops.encoding import Caps
     from kubernetes_tpu.runtime.store import ObjectStore
     from kubernetes_tpu.sched.scheduler import Scheduler
@@ -215,6 +240,8 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None):
                 E=bucket_size(n_terms + 64) if has_ipa_load else 8,
                 LV=bucket_size(nodes + 256, 64))
     sched = Scheduler(store, wave_size=wave, caps=caps, mesh=mesh)
+    if shadow:
+        _load_shadow_profiles(store, shadow)
     build_cluster(store, nodes,
                   affinity_labels=10 if workload in ("affinity", "mixed") else 0)
 
@@ -245,6 +272,7 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None):
         dt = time.time() - t0
         p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
         p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+        _collect_shadow(sched)
         return placed, dt, p99, p99_round, sched.wave_path()
 
     # warm-up: compile the resident-pipeline kernel with the same shapes
@@ -318,6 +346,7 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None):
     # backlog effects stay separable.
     p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
     p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
+    _collect_shadow(sched)
     return placed, dt, p99, p99_round, sched.wave_path()
 
 
@@ -1166,6 +1195,11 @@ def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
     tele = telemetry_trajectory()
     if tele:
         rec["telemetry"] = tele
+    if _SHADOW_SUMMARY:
+        # per-candidate-profile counterfactual divergence over the whole
+        # run (--shadow profile.json): {profile: {pods, flips,
+        # margin_delta, exact?}} — flips are a top-K lower bound
+        rec["shadow"] = _SHADOW_SUMMARY
     print(json.dumps(rec), flush=True)
     print(f"# {name}: placed={placed} wall={dt:.2f}s wave={wave} "
           f"path={path} p99_pod_latency={p99*1e3:.0f}ms "
@@ -1241,7 +1275,7 @@ DRIVER_SUITE = [
 
 
 def run_subprocess_suite(suite, wave, cpu, tracing=False, trace_ledger=None,
-                         telemetry=False):
+                         telemetry=False, shadow=None):
     # one subprocess per config: a run's end-of-round result fetch
     # leaves the tunneled TPU runtime in its degraded transfer mode,
     # which would taint every subsequent config in this process
@@ -1260,6 +1294,11 @@ def run_subprocess_suite(suite, wave, cpu, tracing=False, trace_ledger=None,
             cmd.append("--tracing")
         if telemetry:
             cmd.append("--telemetry")
+        if shadow:
+            # threaded through every child: configs that drain through
+            # run_config shadow-score the run and emit the divergence
+            # summary on their JSON line; the rest accept and ignore it
+            cmd += ["--shadow", shadow]
         if trace_ledger:
             # per-config ledgers: concurrent-process appends would
             # interleave otherwise, and per-config files are what the
@@ -1356,6 +1395,11 @@ def main():
                          "--tracing): the emitted JSON lines carry "
                          "fragmentation/utilization trajectories and "
                          "final feasibility headroom")
+    ap.add_argument("--shadow", default=None, metavar="PROFILE_JSON",
+                    help="shadow-score the run under the candidate "
+                         "WeightProfiles in this JSON file (implies "
+                         "--tracing); the emitted JSON lines grow a "
+                         "`shadow` divergence summary per profile")
     ap.add_argument("--skip-backend-probe", action="store_true",
                     help=argparse.SUPPRESS)  # suite children: parent probed
     args = ap.parse_args()
@@ -1399,13 +1443,15 @@ def main():
         run_subprocess_suite(SUITE, args.wave, args.cpu,
                              tracing=args.tracing,
                              trace_ledger=args.trace_ledger,
-                             telemetry=args.telemetry)
+                             telemetry=args.telemetry,
+                             shadow=args.shadow)
         return
     if not explicit:
         run_subprocess_suite(DRIVER_SUITE, args.wave, args.cpu,
                              tracing=args.tracing,
                              trace_ledger=args.trace_ledger,
-                             telemetry=args.telemetry)
+                             telemetry=args.telemetry,
+                             shadow=args.shadow)
         return
 
     # the measured child: the step profiler feeds the per-stage
@@ -1414,7 +1460,10 @@ def main():
     from kubernetes_tpu.utils import profiling
 
     profiling.enable()
-    if args.tracing or args.trace_ledger or args.telemetry:
+    if args.tracing or args.trace_ledger or args.telemetry or args.shadow:
+        # --shadow implies tracing: the shadow pass re-weights the
+        # per-priority decomposition, which only rides out of traced
+        # rounds
         from kubernetes_tpu.utils import tracing as _tracing
 
         _tracing.enable(ledger_path=args.trace_ledger or None)
@@ -1500,7 +1549,7 @@ def main():
     else:
         placed, dt, p99, p99_round, path = run_config(
             args.nodes, args.pods, args.wave, args.workload,
-            mesh=_resolve_mesh(args.mesh))
+            mesh=_resolve_mesh(args.mesh), shadow=args.shadow)
     emit(args.name or args.workload, args.nodes, args.pods, placed, dt, p99,
          p99_round, args.wave, path)
 

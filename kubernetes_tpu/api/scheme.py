@@ -130,6 +130,9 @@ register("PodDisruptionBudget", "poddisruptionbudgets", api.PodDisruptionBudget,
          "policy/v1beta1")
 register("PodGroup", "podgroups", api.PodGroup,
          "scheduling.sigs.k8s.io/v1alpha1")
+# scheduler weight profiles (shadow-scoring observatory, sched/weights.py)
+register("WeightProfile", "weightprofiles", api.WeightProfile,
+         "scheduling.sigs.k8s.io/v1alpha1")
 register("PersistentVolume", "persistentvolumes", api.PersistentVolume,
          namespaced=False)
 register("PersistentVolumeClaim", "persistentvolumeclaims", api.PersistentVolumeClaim)

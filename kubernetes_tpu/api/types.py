@@ -1036,6 +1036,40 @@ def pod_group_min_available(pod: "Pod") -> Optional[int]:
         return None
 
 
+# -- scheduler weight profiles (kind "weightprofiles") ------------------------
+#
+# ConfigMap-style objects carrying a scoring weight table for the
+# shadow-scoring observatory (sched/weights.py): candidates are
+# re-scored counterfactually against live traffic, the live one
+# hot-swaps the production weight vector between rounds. No reference
+# analog — the reference's priority weights are process-lifetime
+# Policy/provider config.
+
+WEIGHT_PROFILE_ROLE_CANDIDATE = "candidate"
+WEIGHT_PROFILE_ROLE_LIVE = "live"
+
+
+@dataclass
+class WeightProfileSpec:
+    # SCORE_STACK-keyed raw weights (ops/scores.py), e.g.
+    # {"LeastRequested": 1.0, "MostRequested": 2.5}; unnamed rows
+    # default to 0, HostExtra is pinned to 1 (rows arrive pre-weighted)
+    weights: Dict[str, float] = field(default_factory=dict)
+    # "candidate": shadow-scored only, zero effect on placements;
+    # "live": this profile's vector IS the production weight vector
+    role: str = WEIGHT_PROFILE_ROLE_CANDIDATE
+
+
+@dataclass
+class WeightProfile:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: WeightProfileSpec = field(default_factory=WeightProfileSpec)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+
 @dataclass
 class PodDisruptionBudgetSpec:
     selector: Optional[LabelSelector] = None

@@ -76,6 +76,49 @@ class HealthServer:
                     else:
                         body = json.dumps(rec.chrome_trace()).encode()
                         ctype = "application/json"
+                elif self.path.startswith("/debug/shadow"):
+                    # shadow-scoring observatory: counterfactual
+                    # divergence per candidate WeightProfile.
+                    # ?profile=<name> for one profile's report
+                    # (&format=text for flip explanations: "p1: prod
+                    # chose node-42, candidate flips to node-7 on
+                    # LeastRequested 8→3"); without a profile, an index
+                    # of loaded profiles + the active weights_version.
+                    from urllib.parse import parse_qs, urlparse
+
+                    sched = outer.scheduler_ref()
+                    book = getattr(sched, "weightbook", None)
+                    if book is None:
+                        body = b"scheduler not running\n"
+                        ctype = "text/plain"
+                    else:
+                        q = parse_qs(urlparse(self.path).query)
+                        profile = (q.get("profile") or [None])[0]
+                        fmt = (q.get("format") or [""])[0]
+                        if profile:
+                            if fmt == "text":
+                                text = book.report_text(profile)
+                            else:
+                                entry = book.report(profile)
+                                text = (json.dumps(entry)
+                                        if entry is not None else None)
+                            if text is None:
+                                body = (f"no shadow profile "
+                                        f"{profile}\n").encode()
+                                self.send_response(404)
+                                self.send_header("Content-Type",
+                                                 "text/plain")
+                                self.send_header("Content-Length",
+                                                 str(len(body)))
+                                self.end_headers()
+                                self.wfile.write(body)
+                                return
+                            body = text.encode()
+                            ctype = ("text/plain" if fmt == "text"
+                                     else "application/json")
+                        else:
+                            body = json.dumps(book.index()).encode()
+                            ctype = "application/json"
                 elif self.path.startswith("/debug/score"):
                     # decision observatory: per-pod score decomposition
                     # ("why did node-42 win"). ?uid=<pod uid> for one
@@ -203,18 +246,25 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
         # and resolves <= 1 device to no mesh at all — same semantics as
         # bench.py --mesh
         mesh = mesh_for_devices(cfg.mesh_devices)
-    return Scheduler(store, profile=profile, wave_size=cfg.wave_size,
-                     features=features, mesh=mesh,
-                     scrub_interval=cfg.scrub_interval or None,
-                     breaker_threshold=cfg.breaker_threshold,
-                     breaker_cooldown=cfg.breaker_cooldown,
-                     metrics=metrics,
-                     bind_max_attempts=cfg.bind_max_attempts,
-                     racecheck=cfg.racecheck,
-                     shed_watermark=cfg.shed_watermark,
-                     shed_priority_threshold=cfg.shed_priority_threshold,
-                     shed_age_s=cfg.shed_age_s,
-                     wave_deadline_s=cfg.wave_deadline_s)
+    sched = Scheduler(store, profile=profile, wave_size=cfg.wave_size,
+                      features=features, mesh=mesh,
+                      scrub_interval=cfg.scrub_interval or None,
+                      breaker_threshold=cfg.breaker_threshold,
+                      breaker_cooldown=cfg.breaker_cooldown,
+                      metrics=metrics,
+                      bind_max_attempts=cfg.bind_max_attempts,
+                      racecheck=cfg.racecheck,
+                      shed_watermark=cfg.shed_watermark,
+                      shed_priority_threshold=cfg.shed_priority_threshold,
+                      shed_age_s=cfg.shed_age_s,
+                      wave_deadline_s=cfg.wave_deadline_s,
+                      shadow_exact_interval=cfg.shadow_exact_interval)
+    if cfg.weight_profiles_path:
+        # file-preloaded profiles feed the weight book directly — the
+        # store-watched `weightprofiles` kind is the dynamic path, but
+        # a remote apiserver may not carry it
+        sched.weightbook.load_file(cfg.weight_profiles_path)
+    return sched
 
 
 def run(cfg: KubeSchedulerConfiguration, server_url: str,
@@ -395,6 +445,18 @@ def main(argv=None) -> int:
                     help="append one structured JSONL record per "
                          "scheduling round to this file (requires "
                          "--tracing)")
+    ap.add_argument("--weight-profiles", default=None,
+                    help="JSON file of WeightProfiles ([{name, weights, "
+                         "role}]) preloaded into the shadow-scoring "
+                         "observatory; role=live hot-swaps the "
+                         "production weight vector, candidates are "
+                         "shadow-scored on traced rounds "
+                         "(/debug/shadow; needs --tracing)")
+    ap.add_argument("--shadow-exact-interval", type=int, default=None,
+                    help="exact shadow mode: replay the first wave of "
+                         "every Nth traced round through the numpy twin "
+                         "under each candidate profile (0 disables; the "
+                         "default shadow pass is a top-K lower bound)")
     ap.add_argument("--racecheck", action="store_true",
                     help="instrument the scheduler/queue locks with the "
                          "lock-order watcher (go test -race analog; "
@@ -444,6 +506,10 @@ def main(argv=None) -> int:
         cfg.trace_rounds = args.trace_rounds
     if args.round_ledger is not None:
         cfg.round_ledger_path = args.round_ledger
+    if args.weight_profiles is not None:
+        cfg.weight_profiles_path = args.weight_profiles
+    if args.shadow_exact_interval is not None:
+        cfg.shadow_exact_interval = args.shadow_exact_interval
     if args.racecheck:
         cfg.racecheck = True
     if args.shed_watermark is not None:

@@ -66,7 +66,8 @@ def _schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
                   rr_start, extra_scores, need, *, weights: Weights,
                   num_zones: int, num_label_values: int = 64,
                   has_ipa: bool = False, use_pallas: bool = False,
-                  pallas_interpret: bool = False) -> GangResult:
+                  pallas_interpret: bool = False,
+                  weight_vec=None) -> GangResult:
     """Joint placement of one gang's members under shared capacity.
 
     `need`: traced i32 — how many members must place for the gang to
@@ -84,7 +85,7 @@ def _schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
     res, _usage = _wave_body(nt, pm, tt, pb, extra_mask, rr_start,
                              extra_scores, weights, num_zones,
                              num_label_values, has_ipa, use_pallas,
-                             pallas_interpret)
+                             pallas_interpret, weight_vec=weight_vec)
     placed = jnp.sum((res.chosen >= 0).astype(jnp.int32))
     ok = placed >= jnp.asarray(need, jnp.int32)
     chosen = jnp.where(ok, res.chosen, -1)

@@ -45,7 +45,9 @@ import numpy as np
 
 from . import encoding as enc
 from .kernel import Weights, WaveResult
-from .scores import SCORE_STACK, SCORE_TOPK, ScoreDeco
+from .scores import (SCORE_STACK, SCORE_TOPK, W_AFFINITY, W_AVOID,
+                     W_BALANCED, W_IMAGE, W_LEAST, W_MOST, W_SPREAD,
+                     W_TAINT, ScoreDeco, stack_weights)
 
 F = np.float32
 MAX_PRIORITY = F(10.0)
@@ -400,7 +402,8 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
                        num_zones: int, num_label_values: int = 64,
                        has_ipa: bool = False,
                        usage_in=None,
-                       collect_scores: bool = False) -> WaveResult:
+                       collect_scores: bool = False,
+                       weight_vec=None) -> WaveResult:
     """One batched host wave: masks + scores over (P x N), then the
     sequential greedy commit with usage carry — the numpy statement of
     _wave_body's lax.scan. Inter-pod affinity is NOT twinned: callers
@@ -415,6 +418,13 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     see ops/scores.py ScoreDeco) bit-for-bit matching the device
     kernel's — top-k is argsort-stable descending, exactly lax.top_k's
     lowest-index-first tie order.
+
+    weight_vec: optional f32 [S] SCORE_STACK-aligned weight vector
+    mirroring the kernel's traced live-profile input — supplies the
+    weighted-sum multipliers while `weights` keeps gating which planes
+    compute, in the identical f32 op order (degraded mode and the
+    shadow exact-mode twin run under the same hot-swapped vector the
+    device path uses).
     """
     if has_ipa:
         raise NotImplementedError(
@@ -434,6 +444,11 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     alloc2 = nt.alloc[:, :2]
 
     w = weights
+    # the kernel's wv twin: the caller's live vector, or the static
+    # weights — wv[s] is np.float32, the same scalar the device
+    # multiplies by
+    wv = (np.asarray(weight_vec, np.float32) if weight_vec is not None
+          else stack_weights(w))
     # mirrors the kernel: under collect_scores the raw planes are
     # computed even at weight 0, so the decomposition never fabricates
     # flat rows for priorities a profile disabled
@@ -454,9 +469,9 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
                 if w.image_locality or collect_scores else None)
     static_score = np.zeros((P, N), np.float32)
     if w.image_locality:
-        static_score += F(w.image_locality) * img_full
+        static_score = static_score + wv[W_IMAGE] * img_full
     if w.prefer_avoid:
-        static_score += F(w.prefer_avoid) * avoid_full
+        static_score = static_score + wv[W_AVOID] * avoid_full
     if extra_scores is not None:
         static_score += np.asarray(extra_scores, np.float32)
     if collect_scores:
@@ -491,28 +506,28 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
         aff_n = (normalize_reduce(aff_raw[i], feasible, False)
                  if w.node_affinity or collect_scores else None)
         if w.node_affinity:
-            total = total + F(w.node_affinity) * aff_n
+            total = total + wv[W_AFFINITY] * aff_n
         taint_n = (normalize_reduce(taint_raw[i], feasible, True)
                    if w.taint_toleration or collect_scores else None)
         if w.taint_toleration:
-            total = total + F(w.taint_toleration) * taint_n
+            total = total + wv[W_TAINT] * taint_n
         spread_n = (spread_reduce(spread_cnt[i], feasible, nt.zone_id,
                                   num_zones)
                     if w.selector_spread or collect_scores else None)
         if w.selector_spread:
-            total = total + F(w.selector_spread) * spread_n
+            total = total + wv[W_SPREAD] * spread_n
         lr = (least_requested(nz_c, alloc2, pb.nonzero[i])
               if w.least_requested or collect_scores else None)
         if w.least_requested:
-            total = total + F(w.least_requested) * lr
+            total = total + wv[W_LEAST] * lr
         ba = (balanced_allocation(nz_c, alloc2, pb.nonzero[i])
               if w.balanced or collect_scores else None)
         if w.balanced:
-            total = total + F(w.balanced) * ba
+            total = total + wv[W_BALANCED] * ba
         mr = (most_requested(nz_c, alloc2, pb.nonzero[i])
               if w.most_requested or collect_scores else None)
         if w.most_requested:
-            total = total + F(w.most_requested) * mr
+            total = total + wv[W_MOST] * mr
         sm = np.where(feasible, total, F(-1.0))
         best = np.max(sm) if N else F(-1.0)
         best_s[i] = best
@@ -562,7 +577,7 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
 def schedule_gang_host(nt, pm, tt, pb, extra_mask, rr_start: int,
                        extra_scores, need: int, *, weights: Weights,
                        num_zones: int, num_label_values: int = 64,
-                       has_ipa: bool = False):
+                       has_ipa: bool = False, weight_vec=None):
     """All-or-nothing count feasibility: the ops/gang.py wrapper over the
     host wave. Unless the greedy commit placed >= `need` members, every
     placement is discarded and the round-robin counter rewinds — the
@@ -573,7 +588,8 @@ def schedule_gang_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     res, _usage = schedule_wave_host(
         nt, pm, tt, pb, extra_mask, rr_start, extra_scores,
         weights=weights, num_zones=num_zones,
-        num_label_values=num_label_values, has_ipa=has_ipa)
+        num_label_values=num_label_values, has_ipa=has_ipa,
+        weight_vec=weight_vec)
     placed = int(np.sum(res.chosen >= 0))
     ok = placed >= int(need)
     chosen = res.chosen if ok else np.full_like(res.chosen, -1)

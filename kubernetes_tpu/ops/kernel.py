@@ -39,8 +39,18 @@ from .filters import resource_fit, static_predicate_masks
 from .scores import (
     SCORE_STACK,
     SCORE_TOPK,
+    W_AFFINITY,
+    W_AVOID,
+    W_BALANCED,
+    W_IMAGE,
+    W_INTERPOD,
+    W_LEAST,
+    W_MOST,
+    W_SPREAD,
+    W_TAINT,
     ScoreDeco,
     floor_div,
+    stack_weights,
     balanced_allocation,
     image_locality,
     least_requested,
@@ -135,16 +145,20 @@ def dispatch_bucket(nt, pm, tt, kw, lead=()) -> tuple:
     rows (`lead`), node rows, pod-matrix and term-table caps (vocab
     growth retraces!), the static num_label_values/num_zones, the mesh
     device count (sharded and unsharded dispatches compile separately),
-    and the formulation statics. Weights are deliberately excluded
-    (profile-constant; a weight change would mint one mislabelled 'hit',
-    not a recurring lie)."""
+    and the formulation statics. Weight VALUES are deliberately excluded:
+    the traced weight_vec swaps freely inside one program, and the static
+    gating Weights is profile-constant — an activation-set change would
+    mint one mislabelled 'hit', not a recurring lie. The weight_vec
+    PRESENCE is in the key (None vs array is a different pytree, hence a
+    different compiled program)."""
     return tuple(lead) + (
         nt.valid.shape[0], pm.node.shape[0], tt.node.shape[0],
         _device_count(nt.valid),
         int(kw.get("num_label_values", 64)), int(kw.get("num_zones", 0)),
         int(bool(kw.get("has_ipa", False))),
         int(bool(kw.get("use_pallas", False))),
-        int(bool(kw.get("collect_scores", False))))
+        int(bool(kw.get("collect_scores", False))),
+        int(kw.get("weight_vec") is not None))
 
 
 def record_dispatch(program: str, bucket_key: tuple, fn):
@@ -225,7 +239,8 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                pb: enc.PodBatch, extra_mask, rr_start, extra_scores,
                weights: Weights, num_zones: int, num_label_values: int,
                has_ipa: bool, use_pallas: bool, pallas_interpret: bool,
-               usage_in=None, taint_ports=None, collect_scores: bool = False):
+               usage_in=None, taint_ports=None, collect_scores: bool = False,
+               weight_vec=None):
     """Shared wave computation. usage_in: optional (requested, nonzero,
     pod_count) overriding nt's usage columns — the device-resident carry
     that lets consecutive waves chain without a host roundtrip.
@@ -237,7 +252,18 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     the chosen node plus the top-SCORE_TOPK candidates by weighted total
     (WaveResult.deco). The weighted-sum feeding argmax is the SAME
     accumulation expression either way, so placements are bit-identical;
-    off, the program is byte-identical to the pre-observatory kernel."""
+    off, the program is byte-identical to the pre-observatory kernel.
+
+    weight_vec: optional TRACED f32 [S] SCORE_STACK-aligned weight
+    vector. When given, it supplies the multipliers of the weighted sum
+    — the live WeightProfile hot-swap path (sched/weights.py): a new
+    vector is a new array value inside the SAME compiled program, so a
+    swap or rollback between rounds never recompiles. The static
+    `weights` still gates which score planes are compiled in (a plane
+    the profile activates past a 0 static weight needs a gating bump —
+    gate_weights — and that one activation-set change does retrace).
+    None (direct kernel callers, what-ifs) folds stack_weights(weights)
+    in as a trace-time constant — numerically identical f32 ops."""
     N = nt.valid.shape[0]
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
@@ -256,6 +282,12 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
            if has_ipa else None)
 
     w = weights
+    # the weighted-sum multipliers: the traced weight_vec when the live
+    # profile machinery supplies one, the static weights folded to a
+    # trace-time constant otherwise — wv[s] is an f32 scalar either way,
+    # so the arithmetic (and the twin's mirror of it) is identical
+    wv = (weight_vec if weight_vec is not None
+          else jnp.asarray(stack_weights(w)))
     # raw planes also feed the decomposition: under collect_scores they
     # are computed even at weight 0 (a 0-weight priority still explains
     # the decision it did not influence — zeroed planes would fabricate
@@ -269,9 +301,9 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                   else jnp.zeros(static_nonres.shape, jnp.int32))
     static_score = jnp.zeros(static_nonres.shape, jnp.float32)
     if w.image_locality:
-        static_score += w.image_locality * image_locality(nt, pb)
+        static_score = static_score + wv[W_IMAGE] * image_locality(nt, pb)
     if w.prefer_avoid:
-        static_score += w.prefer_avoid * prefer_avoid(nt, pb)
+        static_score = static_score + wv[W_AVOID] * prefer_avoid(nt, pb)
     if extra_scores is not None:
         static_score += extra_scores
     P = pb.req.shape[0]
@@ -346,31 +378,31 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                                floor_div(10.0 * (counts_row - cmin) / crange),
                                0.0)
         if has_ipa and w.interpod:
-            total = total + w.interpod * fscore
+            total = total + wv[W_INTERPOD] * fscore
         aff_n = (normalize_reduce(araw, feasible, False)
                  if w.node_affinity or collect_scores else None)
         if w.node_affinity:
-            total = total + w.node_affinity * aff_n
+            total = total + wv[W_AFFINITY] * aff_n
         taint_n = (normalize_reduce(traw, feasible, True)
                    if w.taint_toleration or collect_scores else None)
         if w.taint_toleration:
-            total = total + w.taint_toleration * taint_n
+            total = total + wv[W_TAINT] * taint_n
         spread_n = (spread_reduce(scnt, feasible, nt.zone_id, num_zones)
                     if w.selector_spread or collect_scores else None)
         if w.selector_spread:
-            total = total + w.selector_spread * spread_n
+            total = total + wv[W_SPREAD] * spread_n
         lr = (least_requested(nz_c, alloc2, pnz)
               if w.least_requested or collect_scores else None)
         if w.least_requested:
-            total = total + w.least_requested * lr
+            total = total + wv[W_LEAST] * lr
         ba = (balanced_allocation(nz_c, alloc2, pnz)
               if w.balanced or collect_scores else None)
         if w.balanced:
-            total = total + w.balanced * ba
+            total = total + wv[W_BALANCED] * ba
         mr = (most_requested(nz_c, alloc2, pnz)
               if w.most_requested or collect_scores else None)
         if w.most_requested:
-            total = total + w.most_requested * mr
+            total = total + wv[W_MOST] * mr
         sm = jnp.where(feasible, total, -1.0)
         best = jnp.max(sm)
         has = best >= 0
@@ -470,7 +502,8 @@ def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                    num_zones: int, num_label_values: int = 64,
                    has_ipa: bool = False, use_pallas: bool = False,
                    pallas_interpret: bool = False,
-                   collect_scores: bool = False) -> WaveResult:
+                   collect_scores: bool = False,
+                   weight_vec=None) -> WaveResult:
     """extra_mask: bool [P, N] — host-evaluated predicates (NoDiskConflict,
     volume predicates) for the rare pods that need them; all-True rows for
     everyone else. Appended to the mask stack as a final "HostPlugins"
@@ -484,11 +517,15 @@ def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
 
     has_ipa (static): compiles the inter-pod affinity path in. When no
     affinity terms exist anywhere (the common case), the False variant
-    keeps the program identical to the affinity-free kernel."""
+    keeps the program identical to the affinity-free kernel.
+
+    weight_vec: optional traced f32 [S] live weight vector (see
+    _wave_body) — the hot-swap path never recompiles on a value change."""
     res, _ = _wave_body(nt, pm, tt, pb, extra_mask, rr_start, extra_scores,
                         weights, num_zones, num_label_values, has_ipa,
                         use_pallas, pallas_interpret,
-                        collect_scores=collect_scores)
+                        collect_scores=collect_scores,
+                        weight_vec=weight_vec)
     return res
 
 
@@ -546,7 +583,7 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
                    weights: Weights, num_zones: int,
                    num_label_values: int = 64, has_ipa: bool = False,
                    use_pallas: bool = False, pallas_interpret: bool = False,
-                   collect_scores: bool = False):
+                   collect_scores: bool = False, weight_vec=None):
     """An ENTIRE scheduling round as one program: lax.scan over W waves,
     each wave a full _wave_body pass whose placements are staged into the
     pod matrix / term table carries before the next wave runs.
@@ -588,7 +625,8 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
                                   weights, num_zones, num_label_values,
                                   has_ipa, False, pallas_interpret,
                                   usage_in=usage_c, taint_ports=tp,
-                                  collect_scores=collect_scores)
+                                  collect_scores=collect_scores,
+                                  weight_vec=weight_vec)
         pm_o, tt_o = _stage_placements(pm_c, tt_c, res.chosen, rows, trows)
         out = (res.chosen, res.fail_counts)
         if collect_scores:

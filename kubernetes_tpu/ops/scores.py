@@ -53,6 +53,29 @@ SCORE_STACK = (
 # round-robin tie-breaks can pick a node top_k would rank past K)
 SCORE_TOPK = 4
 
+# SCORE_STACK row -> ops/kernel.py Weights field. HostExtra rows arrive
+# pre-weighted (weight renders as 1), so it maps to no field. The live
+# WeightProfile machinery (sched/weights.py) uses this to gate plane
+# compilation and to build SCORE_STACK-aligned vectors from
+# plugin-name-keyed weight tables.
+WEIGHT_FIELDS = {
+    "LeastRequested": "least_requested",
+    "BalancedAllocation": "balanced",
+    "MostRequested": "most_requested",
+    "NodeAffinity": "node_affinity",
+    "TaintToleration": "taint_toleration",
+    "SelectorSpread": "selector_spread",
+    "PreferAvoid": "prefer_avoid",
+    "ImageLocality": "image_locality",
+    "InterPodAffinity": "interpod",
+    "HostExtra": None,
+}
+
+# SCORE_STACK row indices, named — the kernel and its numpy twin index
+# the traced weight vector with these so the contract stays greppable
+(W_LEAST, W_BALANCED, W_MOST, W_AFFINITY, W_TAINT, W_SPREAD, W_AVOID,
+ W_IMAGE, W_INTERPOD, W_EXTRA) = range(len(SCORE_STACK))
+
 
 class ScoreDeco(NamedTuple):
     """Per-pod score decomposition planes fetched alongside a wave's

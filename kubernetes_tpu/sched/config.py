@@ -72,6 +72,15 @@ class KubeSchedulerConfiguration:
     tracing: bool = False
     trace_rounds: int = 64
     round_ledger_path: str = ""
+    # shadow-scoring observatory (sched/weights.py): candidate/live
+    # WeightProfiles preloaded from a JSON file (the store-watched
+    # `weightprofiles` kind is the dynamic path); exact mode replays
+    # the first wave of every Nth traced round through the numpy twin
+    # under each candidate — exact divergence, calibrating the top-K
+    # lower bound (0 disables). Shadow scoring itself rides the traced
+    # decomposition, so it needs `tracing` on.
+    weight_profiles_path: str = ""
+    shadow_exact_interval: int = 0
     # runtime race detection (`--racecheck`): instrument the scheduler
     # and queue locks with utils/racecheck.py's LockOrderWatcher — the
     # `go test -race` analog. Lock names match the static lock graph

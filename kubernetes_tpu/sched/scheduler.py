@@ -164,7 +164,8 @@ class Scheduler:
                  shed_watermark: int = 0,
                  shed_priority_threshold: Optional[int] = None,
                  shed_age_s: float = 30.0,
-                 wave_deadline_s: float = 0.0):
+                 wave_deadline_s: float = 0.0,
+                 shadow_exact_interval: int = 0):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -366,6 +367,19 @@ class Scheduler:
         self._inflight_mu = threading.Lock()
         self._inflight: set = set()
         self.bind_overlap_hwm = 0  # high-water mark of concurrent binds
+        # live weight profiles + the shadow-scoring observatory
+        # (sched/weights.py): the production weight vector is served
+        # from here as a TRACED array (hot-swap/rollback between rounds,
+        # no recompile); candidate profiles are re-scored against every
+        # traced wave's decomposition on host. shadow_exact_interval > 0
+        # additionally replays the first wave of every Nth traced round
+        # through the numpy twin under each candidate — exact
+        # divergence, closing the top-K lower bound on samples.
+        from .weights import WeightBook
+
+        self.weightbook = WeightBook(self.profile.weights())
+        self.shadow_exact_interval = int(shadow_exact_interval)
+        self._shadow_rounds = 0
         self._wire_informers()
 
     # -- informer handlers (reference: factory.go:191-295) --------------------
@@ -391,6 +405,14 @@ class Scheduler:
         SharedInformer(self.store, "podgroups").add_event_handler(
             on_add=lambda o: self.queue.gang_reevaluate(),
             on_update=lambda o, n: self.queue.gang_reevaluate())
+        # live weight profiles: the watch IS the hot-swap/rollback path
+        # — promoting a candidate to role=live (or demoting/deleting the
+        # live one) takes effect on the next round, under _mu so a swap
+        # never interleaves with a wave
+        SharedInformer(self.store, "weightprofiles").add_event_handler(
+            on_add=self._on_weight_profile,
+            on_update=lambda o, n: self._on_weight_profile(n),
+            on_delete=self._on_weight_profile_delete)
         if self.ecache is not None:
             # targeted ecache invalidation (factory.go:191-295 wiring).
             # Must serialize with _run_wave under _mu like the pod/node
@@ -483,6 +505,58 @@ class Scheduler:
     def _invalidate_features(self):
         # group membership may have changed -> equivalence rows are stale
         self.featurizer._cache.clear()
+
+    # -- live weight profiles --------------------------------------------------
+
+    def _on_weight_profile(self, obj):
+        with self._mu:
+            before = self.weightbook.live_version()
+            try:
+                self.weightbook.on_profile(obj)
+            except ValueError as e:
+                # a typo'd weight table must not take down the watch —
+                # the previous table stays in force, the error is loud
+                logging.getLogger(__name__).error(
+                    "rejecting WeightProfile %s: %s",
+                    obj.metadata.name, e)
+                return
+            after = self.weightbook.live_version()
+        if after != before:
+            logging.getLogger(__name__).info(
+                "weight vector hot-swapped: %s -> %s", before, after)
+            tracing.event("weights_swapped", before=before, after=after)
+
+    def _on_weight_profile_delete(self, obj):
+        with self._mu:
+            before = self.weightbook.live_version()
+            self.weightbook.on_profile_delete(obj)
+            after = self.weightbook.live_version()
+        if after != before:
+            logging.getLogger(__name__).info(
+                "weight vector rolled back: %s -> %s", before, after)
+            tracing.event("weights_swapped", before=before, after=after)
+
+    def _weights_kw(self):
+        """(gating Weights, f32 [S] live vector, version string) for one
+        round: the static arg gates which score planes compile, the
+        vector — passed traced as the kernel's weight_vec — supplies the
+        multipliers (so hot-swapping values never recompiles), and the
+        version is what the round's ledger record and decision entries
+        report. Resolved under ONE WeightBook lock hold
+        (dispatch_view), so a swap or rollback landing mid-round can
+        never split the vector a round dispatched under from the
+        version it claims."""
+        return self.weightbook.dispatch_view(self.profile.weights())
+
+    def _golden_reasons(self, pods: List[api.Pod]) -> Dict[str, int]:
+        """{reason: count} of pods routed to the exact golden path —
+        the pods with NO ScoreDeco, i.e. the shadow observatory's
+        per-round coverage gap."""
+        counts: Dict[str, int] = {}
+        for p in pods:
+            r = self.featurizer.golden_reason(p)
+            counts[r] = counts.get(r, 0) + 1
+        return counts
 
     # -- observability hooks ---------------------------------------------------
 
@@ -586,22 +660,35 @@ class Scheduler:
 
     def _record_decisions(self, rec, pods: List[api.Pod], chosen,
                           cparts, tidx, tvals, tparts,
-                          committed: Optional[set] = None) -> Optional[Dict]:
+                          committed: Optional[set] = None,
+                          wvec=None, wver: Optional[str] = None):
         """Consume one fetched ScoreDeco slice ([P, ...] numpy arrays
         aligned with `pods`): per-pod decision entries into the
         recorder's observatory (/debug/score), margin observations into
         scheduler_score_margin, weighted per-priority contributions into
-        scheduler_score_priority_points_total, and a per-round aggregate
-        returned for the ledger's `scores` field. Tracing-only by
-        construction — callers gate on the recorder.
+        scheduler_score_priority_points_total, the counterfactual
+        shadow pass over every candidate WeightProfile, and a
+        (scores, shadow) pair of per-round aggregates for the ledger.
+        Tracing-only by construction — callers gate on the recorder.
 
         committed: uids whose exact-recheck commit succeeded. A device
         choice the int64 recheck rejected never became a placement —
         recording it would have /debug/score claim a binding that
-        never happened."""
-        from ..ops.scores import SCORE_STACK, stack_weights
+        never happened.
 
-        w = stack_weights(self.profile.weights())
+        wvec/wver: the dispatch-time weight view (_weights_kw) — the
+        weights this round ACTUALLY dispatched under. /debug/score and
+        the ledger breakdown must describe the decision that happened,
+        so a live re-read (the None fallback, for direct callers only)
+        would mislabel a round raced by a swap or rollback."""
+        from ..ops.scores import SCORE_STACK
+
+        w = wvec if wvec is not None else self.weightbook.live_vector()
+        if wver is None:
+            wver = self.weightbook.live_version()
+        shadow = self.weightbook.score_wave(
+            pods, chosen, self.snapshot.node_names, cparts, tidx, tvals,
+            tparts, committed=committed, metrics=self.metrics)
         margins: List[float] = []
         totals: List[float] = []
         contrib = np.zeros(len(SCORE_STACK), np.float64)
@@ -650,11 +737,13 @@ class Scheduler:
                 "margin": None if margin is None else round(margin, 4),
                 "runner_up": (names[int(tidx[i][runner])]
                               if runner is not None else None),
+                "weights_version": wver,
+                "weights": [float(x) for x in w],
                 "parts": parts,
                 "top": top,
             })
         if not placed:
-            return None
+            return None, shadow
         for s, name in enumerate(SCORE_STACK):
             if contrib[s]:
                 self.metrics.score_priority_points.labels(
@@ -671,7 +760,59 @@ class Scheduler:
                 "min": round(min(margins), 4),
                 "mean": round(sum(margins) / len(margins), 4),
                 "max": round(max(margins), 4)}
-        return out
+        return out, shadow
+
+    def _shadow_exact_sample(self, wave_pods, pb, chosen_row, rr_start,
+                             has_ipa: bool, gating) -> Optional[Dict]:
+        """Opt-in exact shadow mode (shadow_exact_interval > 0): every
+        Nth traced round replays its FIRST wave through the numpy host
+        twin under each candidate vector — exact candidate placements,
+        calibrating the top-K lower bound on samples. Must run before
+        any commit mutates the snapshot. Costs one host wave per
+        candidate plus one scalar rr fetch per sampled round; inter-pod
+        affinity rounds are skipped (the twin routes those golden)."""
+        if (self.shadow_exact_interval <= 0 or has_ipa
+                or not self.weightbook.has_candidates()):
+            return None
+        self._shadow_rounds += 1
+        if self._shadow_rounds % self.shadow_exact_interval:
+            return None
+        from ..ops import hostwave
+        from .weights import gate_weights
+
+        nt, pm, tt = self.snapshot.host_tensors()
+        P = pb.req.shape[0]
+        extra = np.ones((P, nt.valid.shape[0]), bool)
+        rr0 = 0 if rr_start is None else int(np.asarray(rr_start))
+        n = len(wave_pods)
+        chosen_dev = np.asarray(chosen_row)[:n]
+        out: Dict[str, Dict] = {}
+        for name, vec in self.weightbook.candidate_vectors().items():
+            res, _u = hostwave.schedule_wave_host(
+                nt, pm, tt, pb, extra, rr0, None,
+                weights=gate_weights(gating, vec),
+                num_zones=self.snapshot.caps.Z,
+                num_label_values=self.snapshot.num_label_values,
+                weight_vec=vec)
+            flips = int(np.sum(np.asarray(res.chosen)[:n] != chosen_dev))
+            self.weightbook.record_exact(name, n, flips)
+            out[name] = {"pods": n, "flips": flips}
+        return out or None
+
+    @staticmethod
+    def _merge_exact(shadow: Optional[Dict],
+                     exact_info: Optional[Dict]) -> Optional[Dict]:
+        """Fold a sampled exact-mode result into the round's shadow
+        ledger record (creating profile entries the lower-bound pass
+        produced nothing for)."""
+        if not exact_info:
+            return shadow
+        shadow = shadow or {}
+        for name, ex in exact_info.items():
+            shadow.setdefault(
+                name, {"pods": 0, "flips": 0,
+                       "lower_bound": True})["exact"] = ex
+        return shadow
 
     def _resource_names(self) -> List[str]:
         """Column -> resource name for the telemetry exports (core
@@ -1024,17 +1165,27 @@ class Scheduler:
                 placed += self._schedule_gangs(gang_pods)
             host_path = [p for p in all_pods
                          if self.featurizer.needs_host_path(p)]
+            # golden-path pods have no ScoreDeco: count them by reason
+            # so the round record shows the shadow observatory's
+            # coverage gap alongside the shadow divergence itself
+            golden = self._golden_reasons(host_path)
             placed += self._schedule_host_batch(host_path)
             pods = [p for p in all_pods
                     if not self.featurizer.needs_host_path(p)]
             if not pods:
+                if golden:
+                    tracing.event("golden_gap", **golden)
                 return placed
             # RE-check admission: a gang dispatch above may have been
             # watchdog-abandoned (breaker now open, wedge outstanding)
             # — the round must not dispatch at that runtime
             if not self._device_admitted():
-                return placed + self._schedule_degraded(pods)
-            return placed + self._run_pipeline(pods)
+                # the golden coverage gap travels with the fallback:
+                # it was counted for pods already scheduled above and
+                # must not vanish because the round went degraded
+                return placed + self._schedule_degraded(pods,
+                                                        golden=golden)
+            return placed + self._run_pipeline(pods, golden=golden)
 
     def warm_pipeline(self, pods: List[api.Pod],
                       n_waves: Optional[int] = None) -> None:
@@ -1073,6 +1224,8 @@ class Scheduler:
             pbs_stacked, rows, trows = assemble_round(
                 [pb], [pods], pm_rows, term_rows, wbucket, tpp)
             rr0 = jnp.asarray(0, jnp.int32)
+            gating, wvec, _wver = self._weights_kw()
+            wv = jnp.asarray(wvec)
             if self._active_mesh is not None:
                 from ..parallel.mesh import replicate
 
@@ -1087,6 +1240,7 @@ class Scheduler:
                 # measured round can never hit — recompiling inside the
                 # window this warm-up exists to protect
                 rr0 = replicate(self._active_mesh, rr0)
+                wv = replicate(self._active_mesh, wv)
             if self._round_pallas is None:
                 self._round_pallas = pallas_default()
             # compile the SAME collect_scores variant the measured
@@ -1100,11 +1254,11 @@ class Scheduler:
                 out = schedule_round(
                     nt, pm, tt, pbs_stacked, usage,
                     rr0, rows, trows,
-                    weights=self.profile.weights(),
+                    weights=gating,
                     num_zones=self.snapshot.caps.Z,
                     num_label_values=self.snapshot.num_label_values,
                     has_ipa=has_ipa, use_pallas=use_p,
-                    collect_scores=collect)
+                    collect_scores=collect, weight_vec=wv)
                 jax.block_until_ready(out[0])
                 # sacrificial fetch: force the warm execution to actually
                 # run (block_until_ready does not truly wait on tunneled
@@ -1147,7 +1301,8 @@ class Scheduler:
                 for p in pods:
                     self.snapshot.unstage(p)
 
-    def _run_pipeline(self, pods: List[api.Pod]) -> int:
+    def _run_pipeline(self, pods: List[api.Pod],
+                      golden: Optional[Dict[str, int]] = None) -> int:
         import jax
         import jax.numpy as jnp
 
@@ -1177,12 +1332,20 @@ class Scheduler:
         # flight recorder (utils/tracing.py): one round trace whose marks
         # tile the wall time — featurize / upload / device_wave / fetch /
         # commit / preempt — plus per-pod queue_wait spans keyed by UID
+        # ONE weight view per round: dispatch, decision recording, and
+        # the ledger's weights_version all come from this triple
+        gating, wvec, wver = self._weights_kw()
         rec = tracing.active()
         rt = None
         if rec is not None:
             rt = rec.begin_round("pipeline", pending=len(pods),
-                                 waves=len(waves))
+                                 waves=len(waves), weights_version=wver)
             self._trace_queue_waits(rt, pods)
+            if golden:
+                # golden-path pods scheduled alongside this round have
+                # no ScoreDeco — the shadow observatory's coverage gap,
+                # ledgered per round (carried PR 9 follow-up)
+                rt.ledger["golden"] = golden
         # pass 1: grow every vocab/cap to its final size so pass 2 emits
         # uniform shapes (one compiled program, not one per growth step).
         # When nothing grew — the steady state once caps are pre-sized —
@@ -1238,6 +1401,7 @@ class Scheduler:
         usage = (nt.requested, nt.nonzero, nt.pod_count)
         if self._rr is None:
             self._rr = jnp.asarray(0, jnp.int32)
+        wv = jnp.asarray(wvec)
         if self._use_pallas is None:
             self._use_pallas = pallas_default()
         has_ipa = bool(self.snapshot.has_affinity_terms
@@ -1259,6 +1423,7 @@ class Scheduler:
             pm_rows = replicate(self._active_mesh, pm_rows)
             term_rows = replicate(self._active_mesh, term_rows)
             self._rr = replicate(self._active_mesh, self._rr)
+            wv = replicate(self._active_mesh, wv)
         # the Pallas taint/port kernel is HOISTED out of the round's
         # lax.scan (ops/kernel.py schedule_round: one call covering all
         # waves) — under the scan it faults on Mosaic. A pallas round
@@ -1276,11 +1441,11 @@ class Scheduler:
         def _attempt(use_p: bool):
             chosen_d, fail_d, _usage_end, rr_end, deco_d = schedule_round(
                 nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,
-                term_rows, weights=self.profile.weights(),
+                term_rows, weights=gating,
                 num_zones=self.snapshot.caps.Z,
                 num_label_values=self.snapshot.num_label_values,
                 has_ipa=has_ipa, use_pallas=use_p,
-                collect_scores=collect)
+                collect_scores=collect, weight_vec=wv)
             trace.step("dispatched")
             # FINISH the round before the first fetch: block_until_ready
             # does not poison the transfer path, the fetch does — and a
@@ -1352,12 +1517,21 @@ class Scheduler:
                 # wrong — the breaker just opened (record_hang) and the
                 # SAME round's pods place NOW through the hostwave twin
                 # instead of re-queueing behind a per-wave retry that
-                # would hang for another deadline
+                # would hang for another deadline. golden is NOT
+                # re-passed: this round's (failed) record already
+                # ledgered it at begin_round.
                 return self._schedule_degraded(pods)
             for p in pods:
                 self.queue.add_if_not_present(p)
             return 0
         self.breaker.record_success()
+        # exact shadow sampling runs BEFORE any commit mutates the
+        # snapshot: the twin must replay the identical pre-round state
+        # the device program scored
+        exact_info = None
+        if rt is not None and deco_all is not None:
+            exact_info = self._shadow_exact_sample(
+                waves[0], pbs[0], chosen_all[0], self._rr, has_ipa, gating)
         self._rr = rr_end
         placed = 0
         committed: set = set()
@@ -1391,7 +1565,7 @@ class Scheduler:
             if retry:
                 rt.mark("preempt", candidates=len(retry),
                         handled=len(handled))
-            scores = None
+            scores = shadow = None
             if deco_all is not None:
                 # flatten the [W, P, ...] planes down to the real pods
                 # (pad waves and pad rows carry no pods by construction)
@@ -1399,15 +1573,16 @@ class Scheduler:
                        for i in range(len(wv))]
                 wi_idx = np.asarray([s[0] for s in sel], np.int64)
                 i_idx = np.asarray([s[1] for s in sel], np.int64)
-                scores = self._record_decisions(
+                scores, shadow = self._record_decisions(
                     rec, pods, chosen_all[wi_idx, i_idx],
                     deco_all[0][wi_idx, i_idx], deco_all[1][wi_idx, i_idx],
                     deco_all[2][wi_idx, i_idx], deco_all[3][wi_idx, i_idx],
-                    committed=committed)
+                    committed=committed, wvec=wvec, wver=wver)
+                shadow = self._merge_exact(shadow, exact_info)
             self._emit_telemetry(rt)
             rec.end_round(
                 rt, outcome="ok", placed=placed, retried=len(retry),
-                preempted=len(handled), scores=scores,
+                preempted=len(handled), scores=scores, shadow=shadow,
                 path=self._last_path or "unresolved",
                 snapshot=self._round_snapshot_shape(),
                 breaker=self.breaker.state)
@@ -1628,17 +1803,16 @@ class Scheduler:
         and tag the round-ledger entry, so the untwinned inter-pod
         affinity plane shows up on dashboards instead of silently
         dragging degraded throughput."""
-        counts: Dict[str, int] = {}
-        for p in pods:
-            r = self.featurizer.golden_reason(p)
-            counts[r] = counts.get(r, 0) + 1
-            self.metrics.degraded_golden_pods.labels(reason=r).inc()
+        counts = self._golden_reasons(pods)
+        for r, n in counts.items():
+            self.metrics.degraded_golden_pods.labels(reason=r).inc(n)
         if rt is not None:
             g = rt.ledger.setdefault("degraded_golden", {})
             for r, n in counts.items():
                 g[r] = g.get(r, 0) + n
 
-    def _schedule_degraded(self, pods: List[api.Pod]) -> int:
+    def _schedule_degraded(self, pods: List[api.Pod],
+                           golden: Optional[Dict[str, int]] = None) -> int:
         """Breaker-open degraded mode: the backlog drains through the
         vectorized numpy host twin (ops/hostwave.py) — one batched
         mask+score wave per wave_size chunk, batched host-twin
@@ -1648,11 +1822,22 @@ class Scheduler:
         per-pod golden path, as they do on the device path. Degraded
         mode is merely slower than the device path, not three orders of
         magnitude slower."""
+        # ONE weight view per round (see _run_pipeline); every twin
+        # chunk below dispatches under it
+        gating, wvec, wver = self._weights_kw()
         rec = tracing.active()
         rt = None
         if rec is not None:
-            rt = rec.begin_round("degraded", pending=len(pods))
+            rt = rec.begin_round("degraded", pending=len(pods),
+                                 weights_version=wver)
             self._trace_queue_waits(rt, pods)
+            if golden:
+                # coverage gap counted by the caller BEFORE it fell back
+                # here (golden-path pods it already scheduled) — must
+                # not vanish just because the round went degraded
+                g = rt.ledger.setdefault("golden", {})
+                for r, n in golden.items():
+                    g[r] = g.get(r, 0) + n
         placed = 0
         # gangs stay atomic in degraded mode: the twin's count
         # feasibility IS the joint-assignment proof (host twin). Gangs
@@ -1679,9 +1864,10 @@ class Scheduler:
         for i in range(0, len(pods), self.wave_size):
             placed += self._host_wave(pods[i:i + self.wave_size], rt,
                                       deco_acc=deco_acc,
-                                      committed=committed)
+                                      committed=committed,
+                                      weights_view=(gating, wvec))
         if rt is not None:
-            scores = None
+            scores = shadow = None
             if deco_acc:
                 # one decision-recording pass over every twin chunk's
                 # decomposition (the twin computes it in-place — no
@@ -1690,18 +1876,20 @@ class Scheduler:
                 chosen_cat = np.concatenate([c for _p, c, _d in deco_acc])
                 planes = [np.concatenate([d[k] for _p, _c, d in deco_acc])
                           for k in range(4)]
-                scores = self._record_decisions(rec, all_pods, chosen_cat,
-                                                *planes,
-                                                committed=committed)
+                scores, shadow = self._record_decisions(
+                    rec, all_pods, chosen_cat, *planes,
+                    committed=committed, wvec=wvec, wver=wver)
             self._emit_telemetry(rt, device_ok=False)
             rec.end_round(rt, outcome="ok", placed=placed, path="host",
-                          scores=scores, breaker=self.breaker.state,
+                          scores=scores, shadow=shadow,
+                          breaker=self.breaker.state,
                           snapshot=self._round_snapshot_shape())
         return placed
 
     def _host_wave(self, pods: List[api.Pod], rt=None,
                    deco_acc: Optional[List] = None,
-                   committed: Optional[set] = None) -> int:
+                   committed: Optional[set] = None,
+                   weights_view=None) -> int:
         """One batched host-twin wave: numpy masks+scores+greedy commit
         over the snapshot's host planes (no device touch — a wedged
         runtime must not be dispatched to), then the same exact int64
@@ -1736,12 +1924,17 @@ class Scheduler:
         if rt is not None:
             rt.mark("featurize", pods=len(pods))
         nt, pm, tt = self.snapshot.host_tensors()
+        # the enclosing degraded round's weight view, or (direct calls)
+        # a fresh one — same triple source either way
+        gating, wvec = (weights_view if weights_view is not None
+                        else self._weights_kw()[:2])
         res, _usage = hostwave.schedule_wave_host(
             nt, pm, tt, pb, extra, self._host_rr, extra_scores,
-            weights=self.profile.weights(),
+            weights=gating,
             num_zones=self.snapshot.caps.Z,
             num_label_values=self.snapshot.num_label_values,
-            collect_scores=deco_acc is not None)
+            collect_scores=deco_acc is not None,
+            weight_vec=wvec)
         if deco_acc is not None and res.deco is not None:
             # slice off featurize's P-bucket pad rows: the degraded round
             # concatenates chunks, so a padded chunk would shift every
@@ -1825,11 +2018,13 @@ class Scheduler:
                 self._park_with_backoff(p)
             return 0
         nt, pm, tt = self.snapshot.host_tensors()
+        gating, wvec, _wver = self._weights_kw()
         res = hostwave.schedule_gang_host(
             nt, pm, tt, pb, extra, self._host_rr, extra_scores, need,
-            weights=self.profile.weights(),
+            weights=gating,
             num_zones=self.snapshot.caps.Z,
-            num_label_values=self.snapshot.num_label_values)
+            num_label_values=self.snapshot.num_label_values,
+            weight_vec=wvec)
         self._last_path = "vector"
         if rt is not None:
             rt.mark("host_wave", cat="host", backend="vector", gang=key,
@@ -1899,18 +2094,26 @@ class Scheduler:
         # the exact host path (ops/affinity.py single-anchor limitation)
         host_path = [p for p in pods if self.featurizer.needs_host_path(p)]
         placed_host = placed_gang
+        golden = self._golden_reasons(host_path)
         if host_path:
             pods = [p for p in pods if not self.featurizer.needs_host_path(p)]
             placed_host += self._schedule_host_batch(host_path)
             if not pods:
+                if golden:
+                    tracing.event("golden_gap", **golden)
                 return placed_host
         trace = Trace(f"wave of {len(pods)}", clock=self.clock)
         start = self.clock()
+        # ONE weight view per round (see _run_pipeline)
+        gating, wvec, wver = self._weights_kw()
         rec = tracing.active()
         rt = None
         if rec is not None:
-            rt = rec.begin_round("wave", pending=len(pods))
+            rt = rec.begin_round("wave", pending=len(pods),
+                                 weights_version=wver)
             self._trace_queue_waits(rt, pods)
+            if golden:
+                rt.ledger["golden"] = golden
         pb = self.featurizer.featurize(pods)
         try:
             extra = self._host_plugin_mask(pods, pb.req.shape[0])
@@ -1942,6 +2145,7 @@ class Scheduler:
             self._rr = jnp.asarray(0, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
+        wv = jnp.asarray(wvec)
         if self._active_mesh is not None:
             from ..parallel.mesh import (mesh_divides, replicate, shard_extra,
                                          shard_inputs)
@@ -1951,6 +2155,7 @@ class Scheduler:
             # rounds run before the cluster grew to divide the mesh —
             # mixing commitments in one jit is an error, so re-commit
             self._rr = replicate(mesh, self._rr)
+            wv = replicate(mesh, wv)
             if mesh_divides(mesh, nt.valid.shape[0], pb.req.shape[0]):
                 # nt/pm/tt are already committed by _to_device; re-putting
                 # to the identical shardings transfers nothing — this
@@ -1966,7 +2171,7 @@ class Scheduler:
                 # a multi-device mesh the partitionable XLA formulation is
                 # the correct hot path (GSPMD can't shard a pallas_call)
                 self._use_pallas = False
-        kw = dict(weights=self.profile.weights(),
+        kw = dict(weights=gating, weight_vec=wv,
                   num_zones=self.snapshot.caps.Z,
                   num_label_values=self.snapshot.num_label_values,
                   has_ipa=bool(has_ipa),
@@ -2018,6 +2223,8 @@ class Scheduler:
             if rt is not None:
                 rec.end_round(rt, outcome="device_failure",
                               error=type(e).__name__)
+            # golden is NOT re-passed: this wave's own (failed) round
+            # record already ledgered it at begin_round
             return placed_host + self._schedule_degraded(pods)
         self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"
@@ -2062,10 +2269,11 @@ class Scheduler:
             # ledger's (state, placement, outcome) record carries the
             # per-priority breakdown + margin-over-runner-up for
             # offline scoring-weight analysis
-            scores = None
+            scores = shadow = None
             if deco is not None:
-                scores = self._record_decisions(rec, pods, chosen, *deco,
-                                                committed=committed)
+                scores, shadow = self._record_decisions(
+                    rec, pods, chosen, *deco, committed=committed,
+                    wvec=wvec, wver=wver)
             if scores is None and committed:
                 # summary only over placements that actually committed —
                 # a device choice the exact recheck rejected never
@@ -2081,7 +2289,8 @@ class Scheduler:
             rec.end_round(
                 rt, outcome="ok", placed=placed,
                 failed=len(pods) - placed, path=self._last_path,
-                scores=scores, snapshot=self._round_snapshot_shape(),
+                scores=scores, shadow=shadow,
+                snapshot=self._round_snapshot_shape(),
                 breaker=self.breaker.state)
         trace.log_if_long(0.1)
         return placed + placed_host
@@ -2198,8 +2407,16 @@ class Scheduler:
             self._park_with_backoff(pod)
             self.store.set_pod_condition(pod, ("PodScheduled", "False:" + err.message()))
             return 0
-        # score: golden interpod priority + least-requested tie-breaking
+        # score: golden interpod priority + least-requested tie-breaking.
+        # The interpod weight follows the LIVE vector (a hot-swapped
+        # profile applies to golden-path pods too); lr/ba stay
+        # implicitly weight-1 here — the golden path has always been an
+        # approximation of the full stack, and its pods carry no
+        # ScoreDeco either way (see the round ledger's `golden` field)
+        from ..ops.scores import W_INTERPOD
+
         w = self.profile.weights()
+        w_interpod = float(self.weightbook.live_vector()[W_INTERPOD])
         ipa_scores = golden.interpod_affinity_priority(
             pod, [self.cache.node_infos[n] for n in feasible], view,
             hard_weight=int(w.hard_pod_affinity))
@@ -2218,7 +2435,7 @@ class Scheduler:
         best_name, best_score = None, None
         for name in feasible:
             ni = self.cache.node_infos[name]
-            s = (w.interpod * ipa_scores.get(name, 0)
+            s = (w_interpod * ipa_scores.get(name, 0)
                  + golden.least_requested_map(pod, ni)
                  + golden.balanced_allocation_map(pod, ni)
                  + host_scores.get(name, 0.0))
@@ -2253,7 +2470,9 @@ class Scheduler:
         rec = tracing.active()
         rt = None
         if rec is not None:
-            rt = rec.begin_round("gang", pending=len(members), gang=key)
+            rt = rec.begin_round("gang", pending=len(members), gang=key,
+                                 weights_version=self.weightbook
+                                 .live_version())
             self._trace_queue_waits(rt, members)
         try:
             placed = self._schedule_one_gang_inner(key, members, rt)
@@ -2317,12 +2536,15 @@ class Scheduler:
             self._use_pallas = pallas_default()
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
+        gating, wvec, _wver = self._weights_kw()
+        wv = jnp.asarray(wvec)
         if self._active_mesh is not None:
             from ..parallel.mesh import (mesh_divides, replicate, shard_extra,
                                          shard_inputs)
 
             mesh = self._active_mesh
             self._rr = replicate(mesh, self._rr)  # see _run_wave
+            wv = replicate(mesh, wv)
             if mesh_divides(mesh, nt.valid.shape[0], pb.req.shape[0]):
                 # joint-assignment runs under the mesh like a wave: node
                 # tensors stay sharded, the member batch shards on the
@@ -2331,7 +2553,7 @@ class Scheduler:
                                                      pb, extra)
                 if extra_scores is not None:
                     extra_scores = shard_extra(mesh, extra_scores)
-        kw = dict(weights=self.profile.weights(),
+        kw = dict(weights=gating, weight_vec=wv,
                   num_zones=self.snapshot.caps.Z,
                   num_label_values=self.snapshot.num_label_values,
                   has_ipa=has_ipa)

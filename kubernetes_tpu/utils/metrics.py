@@ -403,6 +403,28 @@ class Metrics:
                 "NodeAffinity", "TaintToleration", "SelectorSpread",
                 "PreferAvoid", "ImageLocality", "InterPodAffinity",
                 "HostExtra")})
+        # counterfactual shadow scoring (sched/weights.py): per
+        # candidate-profile placement divergence (would-have-chosen !=
+        # chosen over the traced decomposition — a top-K lower bound),
+        # pods scored per profile (the rate denominator), and the
+        # margin-over-runner-up delta distribution (candidate margin
+        # minus production margin; negative = the candidate decides
+        # less decisively). {profile} values are the loaded
+        # WeightProfile names — a declared set bounded at
+        # sched/weights.py MAX_PROFILES, overflow bucketed through
+        # bounded_label into "Other"
+        self.shadow_divergence = LabeledCounter(
+            "scheduler_shadow_divergence_total", ("profile",))
+        self.shadow_scored_pods = LabeledCounter(
+            "scheduler_shadow_scored_pods_total", ("profile",))
+        # score-scale buckets (weighted totals live in 0..~100k with the
+        # default PreferAvoid weight; deltas are typically single-digit
+        # and can be negative — sub-first-bucket values land in the
+        # first cumulative bucket, the reservoir keeps exact quantiles)
+        self.shadow_margin_delta = Histogram(
+            "scheduler_shadow_margin_delta",
+            buckets=[-100.0, -50.0, -20.0, -10.0, -5.0, -2.0, -1.0, 0.0,
+                     1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0])
         # first-fail predicate attribution for unschedulable pods —
         # previously reachable only through events and FitError text,
         # invisible to dashboards

@@ -38,7 +38,15 @@ from typing import Any, Dict, List, Optional, Tuple
 # Bump when a field changes meaning or disappears; ADDING fields is not
 # a version bump (downstream training jobs must ignore unknown keys).
 # The schema is documented in README "Round-ledger JSONL schema".
-LEDGER_VERSION = 1
+#
+# v2 (shadow-scoring observatory): every round record carries
+# `weights_version` (the live WeightProfile the round dispatched under,
+# or "static"), and traced rounds may carry `shadow` (per-candidate
+# counterfactual divergence) and `golden` (decomposition coverage
+# gaps). v1 readers that honor the ignore-unknown-keys contract parse
+# v2 records unchanged — the bump marks that `scores`/decision weights
+# now describe the LIVE vector, not necessarily the static defaults.
+LEDGER_VERSION = 2
 
 # bounded per-pod decision map (the /debug/score backing store): the
 # most recent placement decision per pod UID, evicted oldest-first
@@ -406,7 +414,13 @@ def format_decision(uid: str, e: Dict[str, Any]) -> str:
             continue
         parts.append(f"{name} {_fmt_score(p.get('chosen'))}"
                      f" vs {_fmt_score(p.get('runner_up'))}")
-    tail = f" (total {_fmt_score(e.get('total'))}, round {e.get('round')})"
+    tail = f" (total {_fmt_score(e.get('total'))}, round {e.get('round')}"
+    # which weight vector decided this placement — "static", or the
+    # live WeightProfile's name@version (the hot-swap observability)
+    wver = e.get("weights_version")
+    if wver:
+        tail += f", weights {wver}"
+    tail += ")"
     return head + ": " + ", ".join(parts) + tail
 
 

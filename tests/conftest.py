@@ -76,6 +76,10 @@ def pytest_configure(config):
                    "(priority-aware load shedding, device-dispatch "
                    "watchdog, clock-driven burst SLO gates; tier-1 + "
                    "make chaos)")
+    config.addinivalue_line(
+        "markers", "shadow: shadow-scoring observatory suite (live "
+                   "WeightProfile hot swap/rollback, counterfactual "
+                   "divergence, /debug/shadow; make obs / make chaos)")
 
 
 import pytest  # noqa: E402
