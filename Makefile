@@ -42,14 +42,17 @@ verify: lint test
 # device-dispatch watchdog, clock-driven burst SLO gates)
 # + the `shadow` weight hot-swap suite (live WeightProfile swap /
 # rollback under a degraded path, candidate==production zero-divergence
-# parity).
+# parity)
+# + the `meshfault` mesh fault-tolerance suite (device-loss detection,
+# quarantine/probe bisection, the 8->4->2->1->heal reform ladder with
+# twin-salvage placement parity).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

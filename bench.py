@@ -184,6 +184,43 @@ def _resolve_mesh(spec):
 # collected from the scheduler's weight book after the drain, emitted on
 # the config's JSON line by emit()
 _SHADOW_SUMMARY = None
+_MESH_SUMMARY = None
+
+
+def _arm_device_kill(mesh, ordinal):
+    """--kill-device: arm per-device chaos against the mesh's Nth
+    device for the measured window (sched/breaker.py lost_device_fault
+    via the `device.lost` fault point) — the mid-run device-kill leg of
+    the mesh fault plane. No-op without a multi-device mesh."""
+    if mesh is None or int(mesh.devices.size) <= 1:
+        return
+    from kubernetes_tpu.sched.breaker import lost_device_fault
+    from kubernetes_tpu.utils import faultpoints
+
+    victim = str(mesh.devices.flat[ordinal % int(mesh.devices.size)])
+    faultpoints.activate("device.lost", "corrupt",
+                         fn=lost_device_fault(victim))
+    print(f"# kill-device: armed device.lost for {victim}",
+          file=sys.stderr)
+
+
+def _collect_mesh(sched):
+    """Degradation-ladder summary for the emitted JSON line: how many
+    devices the mesh ended on, reforms by direction, quarantined
+    devices. None when no mesh fault plane exists. Device count comes
+    from the live mesh, not the gauge — run_config swaps in a fresh
+    Metrics() after warm-up, which zeroes the gauge until a reform."""
+    global _MESH_SUMMARY
+    if sched.meshfaults is None:
+        return
+    _MESH_SUMMARY = {
+        "devices": (int(sched.mesh.devices.size)
+                    if sched.mesh is not None else 1),
+        "reforms_down": int(sched.metrics.mesh_reforms.value(
+            direction="down")),
+        "reforms_up": int(sched.metrics.mesh_reforms.value(direction="up")),
+        "quarantined": sched.meshfaults.quarantined_names(),
+    }
 
 
 def _load_shadow_profiles(store, path):
@@ -205,7 +242,7 @@ def _collect_shadow(sched):
 
 
 def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None,
-               shadow=None):
+               shadow=None, kill_device=None):
     from kubernetes_tpu.ops.encoding import Caps
     from kubernetes_tpu.runtime.store import ObjectStore
     from kubernetes_tpu.sched.scheduler import Scheduler
@@ -266,6 +303,8 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None,
         for p in warm_gangs:
             store.delete("pods", "default", p.metadata.name)
         sched.metrics = Metrics()  # drop warm-up/compile observations
+        if kill_device is not None:
+            _arm_device_kill(mesh, kill_device)
         make_pods(store, pods, workload)
         t0 = time.time()
         placed = sched.schedule_pending()
@@ -273,6 +312,7 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None,
         p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
         p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
         _collect_shadow(sched)
+        _collect_mesh(sched)
         return placed, dt, p99, p99_round, sched.wave_path()
 
     # warm-up: compile the resident-pipeline kernel with the same shapes
@@ -336,6 +376,8 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None,
         store.delete("pods", "default", p.metadata.name)
 
     sched.metrics = Metrics()  # drop warm-up/compile observations
+    if kill_device is not None:
+        _arm_device_kill(mesh, kill_device)
     make_pods(store, pods, workload)
     t0 = time.time()
     placed = sched.schedule_pending()
@@ -347,6 +389,7 @@ def run_config(nodes, pods, wave, workload="density", warmup=32, mesh=None,
     p99 = sched.metrics.pod_scheduling_latency.quantile(0.99)
     p99_round = sched.metrics.e2e_scheduling_latency.quantile(0.99)
     _collect_shadow(sched)
+    _collect_mesh(sched)
     return placed, dt, p99, p99_round, sched.wave_path()
 
 
@@ -900,7 +943,8 @@ def _p99(samples):
     return s[min(int(len(s) * 0.99), len(s) - 1)]
 
 
-def run_storm_config(nodes, wave, trace="burst", mesh=None):
+def run_storm_config(nodes, wave, trace="burst", mesh=None,
+                     kill_device=None):
     """Replay one synthetic arrival trace through a HollowCluster with
     the overload-control plane armed (shed watermark 2 waves, 1s shed
     aging) and gate the run on per-class SLOs. Returns the gate report;
@@ -992,6 +1036,11 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None):
             pass
     sched.metrics = Metrics()  # drop warm-up observations (the queue's
     # on_shed hook reads sched.metrics at call time — no rebind needed)
+    if kill_device is not None:
+        # mesh fault leg: the first storm dispatch loses a device — the
+        # tick salvages through the twin, the mesh reforms down a rung,
+        # and the SLO gates must still hold on the smaller mesh
+        _arm_device_kill(mesh, kill_device)
 
     created = {}  # uid -> (cls, wall time created)
     latency = {c: [] for c in STORM_PRIORITY}
@@ -1130,6 +1179,7 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None):
         print(f"FATAL: storm[{trace}]: {f}", file=sys.stderr)
     if failures:
         sys.exit(1)
+    _collect_mesh(sched)
     return placed, dt, _p99(latency["high"]), len(created)
 
 
@@ -1200,6 +1250,10 @@ def emit(name, nodes, pods, placed, dt, p99, p99_round, wave, path="?"):
         # run (--shadow profile.json): {profile: {pods, flips,
         # margin_delta, exact?}} — flips are a top-K lower bound
         rec["shadow"] = _SHADOW_SUMMARY
+    if _MESH_SUMMARY:
+        # mesh fault plane (--kill-device / any reform during the run):
+        # final device count, reforms by direction, quarantined devices
+        rec["mesh"] = _MESH_SUMMARY
     print(json.dumps(rec), flush=True)
     print(f"# {name}: placed={placed} wall={dt:.2f}s wave={wave} "
           f"path={path} p99_pod_latency={p99*1e3:.0f}ms "
@@ -1249,6 +1303,12 @@ SUITE = [
     # bounded (run via `make bench-all` / an explicit --workload mixed
     # --nodes 50000 --pods 200000 invocation).
     ("mixed50k", 50000, 200000, "mixed", ["--mesh", "auto"]),
+    # mesh fault tolerance: the mixed workload under --mesh auto with a
+    # mid-run device kill — the round salvages through the twin, the
+    # mesh reforms down a rung, and the run must still place everything
+    # (the JSON line's `mesh` summary records the ladder)
+    ("meshfault", 500, 2000, "mixed", ["--mesh", "auto",
+                                       "--kill-device", "1"]),
 ]
 
 # what a bare `python bench.py` (the driver's fixed command) runs: the
@@ -1369,6 +1429,13 @@ def main():
                          "devices: an integer count, or 'auto' for every "
                          "visible device (placements stay bit-identical "
                          "to single-device; tests/test_mesh.py)")
+    ap.add_argument("--kill-device", type=int, default=None,
+                    metavar="ORDINAL",
+                    help="mesh fault leg: arm a device.lost fault for "
+                         "the mesh's Nth device during the measured run "
+                         "— the round salvages through the twin and the "
+                         "mesh reforms down one rung (requires --mesh); "
+                         "the JSON line gains a `mesh` ladder summary")
     ap.add_argument("--host-preempt", action="store_true",
                     help="preempt workload: run the batched what-if on "
                          "the vectorized numpy host twin instead of the "
@@ -1472,7 +1539,7 @@ def main():
         trace = args.trace or "burst"
         placed, dt, high_p99, arrivals = run_storm_config(
             args.nodes, args.wave, trace=trace,
-            mesh=_resolve_mesh(args.mesh))
+            mesh=_resolve_mesh(args.mesh), kill_device=args.kill_device)
         name = args.name or "storm"
         rec = {
             # the headline is the high-class p99 against its SLO gate —
@@ -1488,6 +1555,8 @@ def main():
         stages = stage_breakdown()
         if stages:
             rec["stages"] = stages
+        if _MESH_SUMMARY:
+            rec["mesh"] = _MESH_SUMMARY
         print(json.dumps(rec), flush=True)
         return
     if args.workload == "preempt":
@@ -1549,7 +1618,8 @@ def main():
     else:
         placed, dt, p99, p99_round, path = run_config(
             args.nodes, args.pods, args.wave, args.workload,
-            mesh=_resolve_mesh(args.mesh), shadow=args.shadow)
+            mesh=_resolve_mesh(args.mesh), shadow=args.shadow,
+            kill_device=args.kill_device)
     emit(args.name or args.workload, args.nodes, args.pods, placed, dt, p99,
          p99_round, args.wave, path)
 

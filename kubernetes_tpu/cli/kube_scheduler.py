@@ -248,6 +248,7 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
         mesh = mesh_for_devices(cfg.mesh_devices)
     sched = Scheduler(store, profile=profile, wave_size=cfg.wave_size,
                       features=features, mesh=mesh,
+                      mesh_min_devices=cfg.mesh_min_devices,
                       scrub_interval=cfg.scrub_interval or None,
                       breaker_threshold=cfg.breaker_threshold,
                       breaker_cooldown=cfg.breaker_cooldown,
@@ -421,6 +422,11 @@ def main(argv=None) -> int:
                     help="shard the scheduling plane's node axis across "
                          "this many devices (0 = single device, -1 = all "
                          "visible devices); placements stay bit-identical")
+    ap.add_argument("--mesh-min-devices", type=int, default=None,
+                    help="degradation-ladder floor: a device loss reforms "
+                         "the mesh down (8->4->2->1) while at least this "
+                         "many devices survive; below it the whole-path "
+                         "breaker takes over (host twin)")
     ap.add_argument("--scrub-interval", type=float, default=None,
                     help="seconds between periodic snapshot scrubs "
                          "(0 disables the cadence; SIGUSR2 always works)")
@@ -496,6 +502,8 @@ def main(argv=None) -> int:
         cfg.wave_size = args.wave_size
     if args.mesh_devices is not None:
         cfg.mesh_devices = args.mesh_devices
+    if args.mesh_min_devices is not None:
+        cfg.mesh_min_devices = args.mesh_min_devices
     if args.scrub_interval is not None:
         cfg.scrub_interval = args.scrub_interval
     if args.healthz_port is not None:

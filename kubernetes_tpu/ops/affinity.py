@@ -26,6 +26,12 @@ Dense shape of the problem:
     the same wave, like the reference's one-at-a-time assume) is handled
     in the commit scan in ops/kernel.py using [P, P] cross-match
     matrices computed here.
+
+This plane is twinned in numpy (ops/hostwave.py incoming_statics_host +
+schedule_wave_host's has_ipa step logic, bitwise parity asserted in
+tests/test_hostwave.py TestInterPodAffinityTwin), so breaker-open and
+mesh-reform-salvage rounds place affinity pods batched instead of
+draining them through the per-pod golden path.
 """
 
 from __future__ import annotations

@@ -17,20 +17,29 @@ slower, not stopped.
 Twinned programs:
 
   schedule_wave_host       ops/kernel.py _wave_body (filters + scores +
-                           sequential greedy commit with usage carry)
+                           sequential greedy commit with usage carry),
+                           INCLUDING the inter-pod affinity plane
+                           (has_ipa: incoming_statics_host below twins
+                           ops/affinity.py incoming_statics, and the
+                           commit loop mirrors the scan's wave-internal
+                           (anti)affinity/symmetry logic) — degraded and
+                           reform-salvage rounds keep batched throughput
+                           for affinity pods instead of draining them
+                           through the per-pod golden path
   schedule_gang_host       ops/gang.py all-or-nothing count feasibility
   preemption_stats_host    ops/preempt.py batched what-if stat planes
 
-Deliberately NOT twinned: the inter-pod affinity plane (ops/affinity.py)
-— pods carrying (anti)affinity terms, and every pod while any existing
-pod holds a required term (symmetry), take the exact golden path, the
-same way multi-topology-key pods always have (needs_host_path). The
-golden oracle remains the semantic ground truth for both backends.
+Still NOT twinned: multi-topology-key required affinity — the same
+single-anchor encoding limit as the device path (needs_host_path); such
+pods take the exact golden path on BOTH backends. The golden oracle
+remains the semantic ground truth for both.
 
 dtype discipline: every float op stays in float32 in the device order of
 operations, so results match XLA's f32 elementwise arithmetic exactly.
 Segment sums accumulate in f64 (np.bincount) and round once to f32 —
-identical for the integer-valued counts/priorities these planes carry.
+identical for the integer-valued counts/priorities these planes carry
+(affinity term weights are API-validated integers, so the [P, E] x
+[E, N] priority contraction is exact in any accumulation order too).
 The one knowingly-unmatched reduction is image_locality's f32 size sum
 (XLA reduce order is unspecified); it is weight-0 in the default
 profile and scores, not masks, so a placement can differ only on an
@@ -46,8 +55,8 @@ import numpy as np
 from . import encoding as enc
 from .kernel import Weights, WaveResult
 from .scores import (SCORE_STACK, SCORE_TOPK, W_AFFINITY, W_AVOID,
-                     W_BALANCED, W_IMAGE, W_LEAST, W_MOST, W_SPREAD,
-                     W_TAINT, ScoreDeco, stack_weights)
+                     W_BALANCED, W_IMAGE, W_INTERPOD, W_LEAST, W_MOST,
+                     W_SPREAD, W_TAINT, ScoreDeco, stack_weights)
 
 F = np.float32
 MAX_PRIORITY = F(10.0)
@@ -394,6 +403,151 @@ def normalize_reduce(raw, feasible, reverse: bool):
     return np.where(m > 0, score, F(0.0))
 
 
+# -- inter-pod affinity (ops/affinity.py twin) --------------------------------
+#
+# Same shapes, same semantics, numpy: the [P, E] term-entry match times
+# the [E, N] same-domain matrix (an exact f32 contraction over 0/1 and
+# integer weights), the deduplicated incoming required/preferred
+# programs anchored through the label-value vocabulary, and the wave-
+# internal [P, P] cross matrices the commit loop consumes. Bitwise
+# parity with the device plane is asserted in tests/test_hostwave.py.
+
+
+def _ipa_ns_match(ns_sets, ns_ids):
+    """affinity.ns_match twin: bool [..., X] — is ns_ids[x] in
+    ns_sets[...]? (0 pad: an all-pad set matches nothing)."""
+    expanded = ns_sets[..., :, None]  # [..., TNS, 1]
+    ids = np.reshape(ns_ids, (1,) * (ns_sets.ndim - 1) + (1, -1))
+    return np.any((expanded == ids) & (expanded > 0), axis=-2)
+
+
+def _ipa_eval_programs(label_matrix, key, op, vals):
+    """affinity._eval_programs twin: AND programs (no numeric ops)
+    against a label matrix; key/op [..., E], vals [..., E, V] ->
+    bool [..., X]."""
+    num = np.full(key.shape, np.nan, np.float32)
+    ids = np.arange(label_matrix.shape[0], dtype=np.int32)
+    return eval_and_program(label_matrix, None, key, op, vals, num, ids)
+
+
+def _ipa_bool_matmul(a, b):
+    """bool [P, E] @ bool [E, N] via f32 — 0/1 sums are integers, exact
+    in f32 regardless of accumulation order (device parity)."""
+    return (a.astype(np.float32) @ b.astype(np.float32)) > 0.5
+
+
+def term_entry_match_host(tt, pb):
+    """affinity.term_entry_match twin: bool [P, E]."""
+    sel = _ipa_eval_programs(pb.pl_val, tt.key, tt.op, tt.vals)  # [E, P]
+    nsm = _ipa_ns_match(tt.ns, pb.ns_id)  # [E, P]
+    return (sel & nsm & tt.valid[:, None]).T
+
+
+def same_domain_host(tt, nt):
+    """affinity.same_domain twin: bool [E, N]."""
+    K = nt.labels.shape[1]
+    tk = np.clip(tt.tk, 0, K - 1)
+    own = np.take_along_axis(nt.labels[tt.node], tk[:, None], axis=1)[:, 0]
+    node_dom = nt.labels[:, tk].T  # [E, N]
+    return ((node_dom == own[:, None]) & (own > 0)[:, None] & (node_dom > 0)
+            & (tt.tk > 0)[:, None] & tt.valid[:, None] & nt.valid[None, :])
+
+
+def node_domains_host(nt, tk):
+    """affinity.node_domains twin: i32 [..., N]."""
+    K = nt.labels.shape[1]
+    flat = np.reshape(tk, (-1,))
+    safe = np.clip(flat, 0, K - 1)
+    dom = nt.labels[:, safe].T  # [B, N]
+    dom = np.where((flat > 0)[:, None], dom, 0)
+    return dom.reshape(tuple(np.shape(tk)) + (nt.labels.shape[0],))
+
+
+def _anchored_hit_host(match, dom_m, num_segments, count=False):
+    """affinity._anchored_hit twin: segment-reduce matching pods by
+    their node's domain value; [P/U, M] -> [P/U, LV]. bincount
+    accumulates in f64 and the counts are integers, so the f32 round is
+    exact (matches the device's f32 segment_sum bit-for-bit)."""
+    contrib = (match & (dom_m > 0)).astype(np.float32)
+    B = match.shape[0]
+    hit = np.zeros((B, num_segments), np.float32)
+    for b in range(B):
+        hit[b] = np.bincount(
+            dom_m[b], weights=contrib[b],
+            minlength=num_segments)[:num_segments].astype(np.float32)
+    return hit if count else hit > 0.5
+
+
+def incoming_statics_host(nt, pm, tt, pb, num_label_values: int,
+                          hard_weight: float):
+    """affinity.incoming_statics twin — the per-wave static (pre-commit)
+    inter-pod affinity state, as the same IncomingStatics tuple over
+    numpy planes."""
+    from .affinity import IncomingStatics
+
+    em = term_entry_match_host(tt, pb)  # [P, E]
+    sd = same_domain_host(tt, nt)  # [E, N]
+    kind = tt.kind
+    sym_blocked = _ipa_bool_matmul(
+        em & (kind == enc.TERM_REQ_ANTI)[None, :], sd)
+
+    # incoming required (anti)affinity, deduplicated (pb.iu_*, row 0 =
+    # never-matches); per-pod views are gathers through ra_uid/rn_uid
+    u_sel = _ipa_eval_programs(pm.labels, pb.iu_key, pb.iu_op,
+                               pb.iu_vals)  # [U, M]
+    u_m = u_sel & _ipa_ns_match(pb.iu_ns, pm.ns) & pm.valid[None, :]
+    node_dom_u = node_domains_host(nt, pb.iu_tk)  # [U, N]
+    dom_m_u = np.take_along_axis(
+        node_dom_u, np.broadcast_to(pm.node[None, :], u_m.shape), axis=1)
+    hit_u = _anchored_hit_host(u_m, dom_m_u, num_label_values)  # [U, LV]
+    ok_u = np.take_along_axis(hit_u, node_dom_u, axis=1) & (node_dom_u > 0)
+    any_u = np.any(u_m, axis=1)  # [U]
+
+    ok_aff = ok_u[pb.ra_uid]  # [P, N]
+    any_aff = any_u[pb.ra_uid]
+    node_dom_ra = node_dom_u[pb.ra_uid]
+    blocked_anti = ok_u[pb.rn_uid]
+    node_dom_rn = node_dom_u[pb.rn_uid]
+
+    # priority counts: hard symmetric weight for required affinity,
+    # signed weights for preferred terms — integer-valued, so the f32
+    # contraction is exact in any order
+    we = np.select(
+        [kind == enc.TERM_REQ_AFF, kind == enc.TERM_PREF_AFF,
+         kind == enc.TERM_PREF_ANTI],
+        [np.full_like(tt.weight, hard_weight), tt.weight, -tt.weight],
+        default=np.zeros_like(tt.weight))
+    counts = (em.astype(np.float32) * we[None, :]) @ sd.astype(np.float32)
+    pu_sel = _ipa_eval_programs(pm.labels, pb.pu_key, pb.pu_op, pb.pu_vals)
+    pu_m = pu_sel & _ipa_ns_match(pb.pu_ns, pm.ns) & pm.valid[None, :]
+    dom_pu = node_domains_host(nt, pb.pu_tk)  # [UP, N]
+    dom_m_pu = np.take_along_axis(
+        dom_pu, np.broadcast_to(pm.node[None, :], pu_m.shape), axis=1)
+    cnt_u = _anchored_hit_host(pu_m, dom_m_pu, num_label_values, count=True)
+    cnt_node_u = (np.take_along_axis(cnt_u, dom_pu, axis=1)
+                  * (dom_pu > 0))  # [UP, N]
+    PA = pb.pa_w.shape[1]
+    for t in range(PA):
+        counts = counts + pb.pa_w[:, t, None] * cnt_node_u[pb.pa_uid[:, t]]
+    counts = counts * nt.valid[None, :]
+
+    # wave-internal cross matrices (pod j vs pod i's required props)
+    wave_aff_sel = _ipa_eval_programs(pb.pl_val, pb.ra_key, pb.ra_op,
+                                      pb.ra_vals)
+    wm_aff = (wave_aff_sel & _ipa_ns_match(pb.ra_ns, pb.ns_id)
+              & pb.ra_has[:, None] & pb.valid[None, :])
+    wave_anti_sel = _ipa_eval_programs(pb.pl_val, pb.rn_key, pb.rn_op,
+                                       pb.rn_vals)
+    wm_anti = (wave_anti_sel & _ipa_ns_match(pb.rn_ns, pb.ns_id)
+               & pb.rn_has[:, None] & pb.valid[None, :])
+
+    return IncomingStatics(
+        sym_blocked=sym_blocked, ok_aff=ok_aff, any_aff=any_aff,
+        blocked_anti=blocked_anti, counts=counts,
+        node_dom_ra=node_dom_ra, node_dom_rn=node_dom_rn,
+        wm_aff=wm_aff, wm_anti=wm_anti)
+
+
 # -- the wave (ops/kernel.py _wave_body twin) ---------------------------------
 
 
@@ -406,8 +560,11 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
                        weight_vec=None) -> WaveResult:
     """One batched host wave: masks + scores over (P x N), then the
     sequential greedy commit with usage carry — the numpy statement of
-    _wave_body's lax.scan. Inter-pod affinity is NOT twinned: callers
-    route affinity-bearing waves to the golden path (see module doc).
+    _wave_body's lax.scan. has_ipa compiles in the inter-pod affinity
+    plane (incoming_statics_host + the wave-internal symmetry /
+    required-(anti)affinity logic mirrored from the scan step), bit-for-
+    bit with the device kernel; only multi-topology-key pods still route
+    golden (needs_host_path), exactly like the device path.
 
     usage_in: optional (requested, nonzero, pod_count) override (the
     gang wrapper and chained degraded waves carry usage the same way
@@ -426,9 +583,6 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     shadow exact-mode twin run under the same hot-swapped vector the
     device path uses).
     """
-    if has_ipa:
-        raise NotImplementedError(
-            "inter-pod affinity is not twinned; route through golden")
     N = nt.valid.shape[0]
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
@@ -438,10 +592,14 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     masks = np.concatenate([masks, ipa_placeholder,
                             np.asarray(extra_mask, bool)[None]], axis=0)
     res_i = enc.PRED_IDX["PodFitsResources"]
+    ipa_i = enc.PRED_IDX["MatchInterPodAffinity"]
     m2 = masks.copy()
     m2[res_i] = True
     static_nonres = np.all(m2, axis=0)  # [P, N]
     alloc2 = nt.alloc[:, :2]
+    ipa = (incoming_statics_host(nt, pm, tt, pb, num_label_values,
+                                 weights.hard_pod_affinity)
+           if has_ipa else None)
 
     w = weights
     # the kernel's wv twin: the caller's live vector, or the static
@@ -496,13 +654,67 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     best_s = np.full((P,), -1.0, np.float32)
     feas_cnt = np.zeros((P,), np.int32)
     dyn_fits = np.zeros((P, N), bool)
+    ipa_masks = np.ones((P, N), bool)
 
     for i in range(P):
         fits = resource_fit(nt.alloc, nt.allowed_pods, req_c, cnt_c,
                             pb.req[i][None, :], is_core)[0]
         dyn_fits[i] = fits
         feasible = static_nonres[i] & fits & nt.valid & bool(pb.valid[i])
+        if has_ipa:
+            # the scan step's wave-internal (anti)affinity logic,
+            # mirrored: `chosen` holds this wave's placements so far
+            # (the device scan's `placed` carry)
+            active = chosen >= 0  # [P]
+            safe_pl = np.clip(chosen, 0, None)
+            dra_row = ipa.node_dom_ra[i]  # [N]
+            # incoming required affinity vs pods placed earlier
+            pl_dom = dra_row[safe_pl]  # [P]
+            src = ipa.wm_aff[i] & active & (pl_dom > 0)
+            wave_aff = np.any(
+                src[:, None] & (pl_dom[:, None] == dra_row[None, :]),
+                axis=0) & (dra_row > 0)
+            any_aff = bool(ipa.any_aff[i]) | bool(
+                np.any(ipa.wm_aff[i] & active))
+            ok_aff = (ipa.ok_aff[i] | wave_aff
+                      | ((not any_aff) & bool(pb.ra_self[i])))
+            ok_aff = np.where(bool(pb.ra_has[i]), ok_aff, True)
+            # incoming required anti-affinity vs wave placements
+            drn_row = ipa.node_dom_rn[i]
+            pl_dom_n = drn_row[safe_pl]
+            srcn = ipa.wm_anti[i] & active & (pl_dom_n > 0)
+            wave_anti = np.any(
+                srcn[:, None] & (pl_dom_n[:, None] == drn_row[None, :]),
+                axis=0) & (drn_row > 0)
+            ok_anti = ~(bool(pb.rn_has[i])
+                        & (ipa.blocked_anti[i] | wave_anti))
+            # symmetry: wave pod j's required anti terms vs me, under
+            # j's topology key
+            node_dom_rn_full = ipa.node_dom_rn  # [P, N]
+            pd_sym = np.take_along_axis(
+                node_dom_rn_full, safe_pl[:, None], axis=1)[:, 0]  # [P]
+            srcs = ipa.wm_anti[:, i] & active & (pd_sym > 0)
+            sym_wave = np.any(
+                srcs[:, None] & (pd_sym[:, None] == node_dom_rn_full)
+                & (node_dom_rn_full > 0), axis=0)
+            ipa_ok = ~(ipa.sym_blocked[i] | sym_wave) & ok_aff & ok_anti
+            feasible = feasible & ipa_ok
+            ipa_masks[i] = ipa_ok
         total = static_score[i]
+        fscore = None
+        if has_ipa and (w.interpod or collect_scores):
+            counts_row = ipa.counts[i]
+            cmasked = np.where(feasible, counts_row, F(0.0))
+            cmin = np.minimum(np.min(cmasked), F(0.0))
+            cmax = np.maximum(np.max(cmasked), F(0.0))
+            crange = cmax - cmin
+            with np.errstate(divide="ignore", invalid="ignore"):
+                fscore = np.where(
+                    crange > 0,
+                    floor_div(F(10.0) * (counts_row - cmin) / crange),
+                    F(0.0))
+        if has_ipa and w.interpod:
+            total = total + wv[W_INTERPOD] * fscore
         aff_n = (normalize_reduce(aff_raw[i], feasible, False)
                  if w.node_affinity or collect_scores else None)
         if w.node_affinity:
@@ -536,7 +748,8 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
             zr = np.zeros_like(total)
             parts = np.stack([
                 lr, ba, mr, aff_n, taint_n, spread_n,
-                avoid_full[i], img_full[i], zr, extra_full[i]])
+                avoid_full[i], img_full[i],
+                fscore if fscore is not None else zr, extra_full[i]])
             # lax.top_k order: descending value, lowest index on ties
             order = np.argsort(-sm, kind="stable")[:KK]
             d_tidx[i] = order.astype(np.int32)
@@ -560,6 +773,8 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
             d_cparts[i] = parts[:, 0]
 
     masks[res_i] = dyn_fits
+    if has_ipa:
+        masks[ipa_i] = ipa_masks
     prefix_ok = np.cumprod(masks.astype(np.int8), axis=0).astype(bool)
     first = np.concatenate(
         [np.ones((1,) + masks.shape[1:], bool), prefix_ok[:-1]], axis=0)

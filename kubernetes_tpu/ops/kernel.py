@@ -113,6 +113,13 @@ _COMPILED: set = set()
 # exceeds cfg.wave_deadline_s is abandoned with DispatchTimeout so a
 # wedged XLA runtime can never wedge the scheduling loop.
 _WATCHDOG = None
+# Active mesh device names (set_devices; () = single device / no mesh).
+# Two consumers: the `device.lost` fault point receives the tuple as its
+# payload so per-device chaos (sched/breaker.py lost_device_fault) fires
+# only while its victim is actually in the dispatch set, and failed
+# dispatches are attributed to a culprit device for the
+# scheduling_errors_total{stage=dispatch, device=...} series.
+_DEVICES: tuple = ()
 
 
 def set_telemetry(metrics) -> None:
@@ -123,6 +130,53 @@ def set_telemetry(metrics) -> None:
 def set_watchdog(watchdog) -> None:
     global _WATCHDOG
     _WATCHDOG = watchdog
+
+
+def set_devices(devices) -> None:
+    """Register the device names the scheduler currently dispatches
+    across (the active mesh's flattened device list; ()/None clears).
+    Refreshed on every mesh reform."""
+    global _DEVICES
+    _DEVICES = tuple(str(d) for d in (devices or ()))
+
+
+def _attribute_device(exc: BaseException) -> str:
+    """Culprit device name for a failed dispatch: the exception carries
+    one (DeviceLost.device), or its text names exactly one active
+    device as an exact token (a name followed by another digit is a
+    different device's id — 'TPU_1' inside 'TPU_10' is not a hit);
+    'unknown' otherwise. Token logic mirrors sched/breaker.py
+    device_name_hits (kept local: ops must not import sched)."""
+    dev = getattr(exc, "device", None)
+    if isinstance(dev, str) and dev in _DEVICES:
+        return dev
+    text = str(exc)
+    hits = []
+    for d in _DEVICES:
+        if not d:
+            continue
+        idx = text.find(d)
+        while idx != -1:
+            end = idx + len(d)
+            if end == len(text) or not text[end].isdigit():
+                hits.append(d)
+                break
+            idx = text.find(d, idx + 1)
+    return hits[0] if len(hits) == 1 else "unknown"
+
+
+def _count_dispatch_error(tel, exc: BaseException) -> None:
+    """Label one failed dispatch on scheduling_errors_total with a
+    bounded device value (the active device set + 'unknown' — never
+    free text, so the family stays metrics-hygiene clean)."""
+    if tel is None:
+        return
+    from ..utils.metrics import bounded_label
+
+    tel.scheduling_errors.labels(
+        stage="dispatch",
+        device=bounded_label(_attribute_device(exc), _DEVICES,
+                             other="unknown")).inc()
 
 
 def _device_count(x) -> int:
@@ -180,11 +234,12 @@ def record_dispatch(program: str, bucket_key: tuple, fn):
     tel = _TELEMETRY
     wd = _WATCHDOG
     if tel is None and (wd is None or not wd.armed()):
-        # fully unarmed hot path: the chaos seam still fires, nothing
+        # fully unarmed hot path: the chaos seams still fire, nothing
         # else is paid. (_COMPILED is not fed here; a watchdog armed
         # later merely grants warm programs the larger compile-scaled
         # budget once — benign in the safe direction.)
         faultpoints.fire("kernel.hang")
+        faultpoints.fire("device.lost", payload=_DEVICES or None)
         return fn()
     key = (program,) + bucket_key
     miss = key not in _COMPILED
@@ -192,6 +247,10 @@ def record_dispatch(program: str, bucket_key: tuple, fn):
 
     def dispatch():
         faultpoints.fire("kernel.hang")
+        # per-device chaos: the payload names the devices this dispatch
+        # runs across, so a corrupt-mode lost_device_fault fires only
+        # while its victim is still in the active mesh
+        faultpoints.fire("device.lost", payload=_DEVICES or None)
         return inner()
 
     if wd is not None and wd.armed():
@@ -203,7 +262,14 @@ def record_dispatch(program: str, bucket_key: tuple, fn):
         _COMPILED.add(key)  # warm-tracking feeds the watchdog's scaling
         return out
     t0 = time.monotonic()
-    out = fn()
+    try:
+        out = fn()
+    except Exception as e:
+        # device-attributed error accounting (the mesh fault plane's
+        # dashboard signal): stage=dispatch, device bounded to the
+        # active set + "unknown"
+        _count_dispatch_error(tel, e)
+        raise
     _COMPILED.add(key)
     bucket = "x".join(str(d) for d in bucket_key)
     tel.device_jit_events.labels(

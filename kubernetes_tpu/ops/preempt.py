@@ -61,10 +61,30 @@ class PreemptStats:
         self.gang_viol = np.ascontiguousarray(packed[4]).view(np.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("num_levels",))
 def preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
                      pb: enc.PodBatch, levels, *, num_levels: int,
                      gang_w=None):
+    """Entry point for the what-if program — routed through the
+    record_dispatch seam (ops/kernel.py) like every other device
+    dispatch, so the watchdog deadline, the `device.lost` chaos point,
+    jit-cache telemetry, and per-device failure attribution all cover
+    the preemption path too (a mid-preempt-chunk device loss must reform
+    the mesh exactly like a mid-wave one)."""
+    from .kernel import _device_count, record_dispatch
+
+    bucket = (pb.req.shape[0], nt.valid.shape[0], pm.node.shape[0],
+              int(num_levels), _device_count(nt.valid),
+              int(gang_w is not None))
+    return record_dispatch(
+        "preempt", bucket,
+        lambda: _preemption_stats(nt, pm, pb, levels,
+                                  num_levels=num_levels, gang_w=gang_w))
+
+
+@functools.partial(jax.jit, static_argnames=("num_levels",))
+def _preemption_stats(nt: enc.NodeTensors, pm: enc.PodMatrix,
+                      pb: enc.PodBatch, levels, *, num_levels: int,
+                      gang_w=None):
     """levels: i32 [num_levels] ascending candidate priority thresholds
     (pad with INT32_MAX). Victim class at level l for failed pod p =
     alive existing pods with priority < min(levels[l], prio_p).

@@ -144,6 +144,40 @@ def shard_inputs(mesh: Mesh, nt: enc.NodeTensors, pm: enc.PodMatrix,
     return nt_s, pm_s, tt_s, pb_s, extra_s
 
 
+def reform_mesh(devices, exclude=(), min_devices: int = 1,
+                wave_parallel: int = 1) -> Optional[Mesh]:
+    """Rebuild a smaller (or, on healing, larger) valid mesh from the
+    surviving devices — the degradation-ladder step (8 -> 4 -> 2 -> 1,
+    and back up as quarantined devices are re-admitted).
+
+    `devices`: the candidate device objects in a STABLE order (the
+    original mesh's flattened device list — order determines which
+    survivors keep serving, so reform is deterministic). `exclude`:
+    device names (str(d)) to drop (quarantined). The reformed mesh takes
+    the leading largest-power-of-two count of survivors: capacity
+    buckets are powers of two (state/vocab.bucket_size), so a
+    power-of-two "nodes" axis keeps `nodes_divide` true without padding
+    whenever N >= shards; a non-power-of-two survivor count (7 of 8)
+    would instead force the node axis to pad to a multiple of 7 on
+    every upload — a worse trade than parking one healthy device until
+    its quarantined peer heals. Returns None when fewer than
+    max(min_devices, 1) devices would remain — the caller falls through
+    to the whole-path breaker (the host-twin rung of the ladder)."""
+    exclude = set(exclude)
+    healthy = [d for d in devices if str(d) not in exclude]
+    n = len(healthy)
+    if n <= 0:
+        return None
+    # largest power of two <= n
+    p = 1 << (n.bit_length() - 1)
+    if p < max(int(min_devices), 1):
+        return None
+    if p % wave_parallel != 0:
+        wave_parallel = 1
+    arr = np.array(healthy[:p]).reshape(wave_parallel, p // wave_parallel)
+    return Mesh(arr, ("wave", "nodes"))
+
+
 def mesh_divides(mesh: Mesh, n_nodes: int, n_wave: int) -> bool:
     """device_put rejects a sharded dim not divisible by its axis size, so
     a wave whose bucketed dims don't line up with the mesh must run

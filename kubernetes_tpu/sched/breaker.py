@@ -24,8 +24,9 @@ client-go's backoff-on-connection-storms, applied to an accelerator):
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional
 
 CLOSED = "closed"
 OPEN = "open"
@@ -101,3 +102,206 @@ class DevicePathBreaker:
         self.trips += 1
         if self.on_trip is not None:
             self.on_trip()
+
+
+# ---------------------------------------------------------------------------
+# Per-device attribution: the mesh rungs ABOVE the whole-path breaker.
+#
+# The DevicePathBreaker above is binary: any device-path failure counts
+# against the WHOLE accelerator plane, and tripping it abandons every
+# chip for the numpy twin — losing 1 of 8 devices used to cost 8/8 of
+# device throughput. With a multi-device mesh (parallel/mesh.py) the
+# right remedy for a single sick chip is a *reform*: quarantine the
+# culprit, rebuild a smaller valid mesh from the survivors, and keep
+# dispatching. The MeshFaultManager owns that per-device state; the
+# classic breaker remains the FINAL rung of the ladder (mesh exhausted,
+# or no mesh at all).
+# ---------------------------------------------------------------------------
+
+
+class DeviceLost(RuntimeError):
+    """A specific mesh device failed. Raised by the `device.lost` fault
+    point in chaos tests (utils/faultpoints.py), and the shape an
+    XLA/runtime error that names a device is normalized to by
+    MeshFaultManager.attribute."""
+
+    def __init__(self, device: str):
+        super().__init__(f"device {device!r} lost")
+        self.device = device
+
+
+def lost_device_fault(device: str):
+    """corrupt-mode fn for the `device.lost` fault point, arming chaos
+    for ONE device: raises DeviceLost(device) when the guarded action
+    involves it — the dispatch seam (ops/kernel.py record_dispatch)
+    passes the active device-name tuple as payload, the recovery probe
+    (sched/scheduler.py _probe_device) passes the probed device's name.
+    Probes of innocent devices and dispatches on a mesh reformed past
+    the victim proceed untouched, so one activation models exactly one
+    lost chip:
+
+        faultpoints.activate("device.lost", "corrupt",
+                             fn=lost_device_fault(str(dev)))
+
+    A None payload (no device registration — a dispatch from a
+    scheduler built after another cleared the process-global
+    set_devices) is a no-op: the fn models a MESH device loss, and
+    killing dispatches whose device set is unknown would keep failing
+    meshes already reformed past the victim.
+    """
+
+    def fn(payload):
+        if payload is None:
+            return
+        if isinstance(payload, str):
+            if payload == device:
+                raise DeviceLost(device)
+            return
+        if device in payload:  # dispatch seam: active device names
+            raise DeviceLost(device)
+
+    return fn
+
+
+def device_name_hits(names, text: str):
+    """Device names appearing in `text` as exact tokens — a name
+    followed by another digit is a DIFFERENT device's id ('TPU_1'
+    inside 'TPU_10'), not a hit; plain substring matching would turn
+    an unambiguous attribution into a 2-hit ambiguity on meshes of 10+
+    devices."""
+    hits = []
+    for n in names:
+        if not n:
+            continue
+        idx = text.find(n)
+        while idx != -1:
+            end = idx + len(n)
+            if end == len(text) or not text[end].isdigit():
+                hits.append(n)
+                break
+            idx = text.find(n, idx + 1)
+    return hits
+
+
+HEALTHY = "healthy"
+QUARANTINED = "quarantined"
+
+
+class MeshFaultManager:
+    """Per-device health for the mesh rungs of the degradation ladder.
+
+    Tracks which of the configured mesh's devices are healthy vs
+    quarantined, attributes dispatch failures to a culprit device (the
+    exception names one — DeviceLost, or an XLA error mentioning the
+    device — else quarantine-and-probe bisection: half the healthy set
+    is quarantined on suspicion and recovery probes re-admit the
+    innocent), and schedules those probes on a cooldown. The scheduler
+    consults `healthy()` to reform the mesh after each change
+    (parallel/mesh.py reform_mesh) and re-forms UPWARD when probes
+    re-admit devices.
+
+    Thread-safety: mutations run under `_lock`; the scheduler calls in
+    while holding Scheduler._mu (the reform must be atomic w.r.t. the
+    device upload), so the static lock graph carries the
+    Scheduler._mu -> MeshFaultManager._lock edge (analysis/lockgraph)."""
+
+    def __init__(self, devices, clock: Callable[[], float] = time.monotonic,
+                 probe_cooldown: float = 30.0):
+        self._lock = threading.Lock()
+        self.clock = clock
+        self.probe_cooldown = float(probe_cooldown)
+        # original mesh order, preserved: reform keeps the leading
+        # survivors, so which devices serve after a loss is deterministic
+        self.devices: List[str] = [str(d) for d in devices]
+        self._objs: Dict[str, object] = {str(d): d for d in devices}
+        # name -> quarantined_at (dict-as-ordered-set: deterministic
+        # iteration for probes and ledger records)
+        self._quarantined: Dict[str, float] = {}
+        self.quarantines = 0  # cumulative, for tests/ledger
+
+    # -- queries -------------------------------------------------------------
+
+    def healthy(self) -> List[object]:
+        """Surviving device objects, original mesh order."""
+        with self._lock:
+            return [self._objs[n] for n in self.devices
+                    if n not in self._quarantined]
+
+    def healthy_names(self) -> List[str]:
+        with self._lock:
+            return [n for n in self.devices if n not in self._quarantined]
+
+    def quarantined_names(self) -> List[str]:
+        with self._lock:
+            return list(self._quarantined)
+
+    def attribute(self, exc: BaseException) -> Optional[str]:
+        """Name the culprit device, if the exception does. DeviceLost
+        carries it; otherwise the error text is scanned for exactly one
+        currently-healthy device name (XLA runtime errors usually embed
+        the failing device's id). Ambiguous or silent errors return
+        None — the bisection path."""
+        seen = set()
+        e: Optional[BaseException] = exc
+        while e is not None and id(e) not in seen:
+            seen.add(id(e))
+            dev = getattr(e, "device", None)
+            if isinstance(dev, str):
+                with self._lock:
+                    if dev in self._objs and dev not in self._quarantined:
+                        return dev
+            e = e.__cause__ or e.__context__
+        text = str(exc)
+        with self._lock:
+            live = [n for n in self.devices if n not in self._quarantined]
+        hits = device_name_hits(live, text)
+        return hits[0] if len(hits) == 1 else None
+
+    # -- mutations -----------------------------------------------------------
+
+    def quarantine(self, name: str) -> bool:
+        """Mark one device quarantined; True if it was healthy."""
+        with self._lock:
+            if name not in self._objs or name in self._quarantined:
+                return False
+            self._quarantined[name] = self.clock()
+            self.quarantines += 1
+            return True
+
+    def quarantine_suspects(self) -> List[str]:
+        """Unattributed failure: bisection step. Quarantine the TRAILING
+        half of the healthy set on suspicion (the leading half keeps
+        serving — reform keeps leading survivors, so this halves the
+        mesh exactly one ladder rung); recovery probes re-admit the
+        innocent. A repeat failure halves again, converging on the
+        culprit in log2(devices) rounds."""
+        with self._lock:
+            healthy = [n for n in self.devices if n not in self._quarantined]
+            if len(healthy) <= 1:
+                return []
+            now = self.clock()
+            suspects = healthy[len(healthy) // 2:]
+            for n in suspects:
+                self._quarantined[n] = now
+                self.quarantines += 1
+            return suspects
+
+    def due_probes(self, now: Optional[float] = None) -> List[object]:
+        """Quarantined devices whose cooldown elapsed — probe these."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            return [self._objs[n] for n, t in self._quarantined.items()
+                    if now - t >= self.probe_cooldown]
+
+    def reprobe_later(self, name: str) -> None:
+        """A probe failed: restart the device's cooldown."""
+        with self._lock:
+            if name in self._quarantined:
+                self._quarantined[name] = self.clock()
+
+    def readmit(self, name: str) -> bool:
+        """A probe succeeded: the device rejoins the healthy set (the
+        caller re-forms the mesh upward)."""
+        with self._lock:
+            return self._quarantined.pop(name, None) is not None

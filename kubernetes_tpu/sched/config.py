@@ -42,6 +42,12 @@ class KubeSchedulerConfiguration:
     # single-device — GSPMD partitioning is an execution strategy, not
     # a semantic change (tests/test_mesh.py asserts it).
     mesh_devices: int = 0
+    # mesh fault tolerance: the degradation ladder's floor. A device
+    # loss reforms the mesh down one power-of-two rung (8 -> 4 -> 2 ->
+    # 1) as long as at least this many devices survive; below the
+    # floor the failure feeds the whole-path breaker instead (host-twin
+    # rung). 1 = ride the ladder all the way down.
+    mesh_min_devices: int = 1
     # robustness layer: periodic snapshot-scrub cadence in seconds
     # (0 disables the cadence; SIGUSR2 always triggers one, the
     # cache_comparer.go analog) and the device-path circuit breaker's

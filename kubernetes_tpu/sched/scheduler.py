@@ -165,7 +165,8 @@ class Scheduler:
                  shed_priority_threshold: Optional[int] = None,
                  shed_age_s: float = 30.0,
                  wave_deadline_s: float = 0.0,
-                 shadow_exact_interval: int = 0):
+                 shadow_exact_interval: int = 0,
+                 mesh_min_devices: int = 1):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -297,9 +298,16 @@ class Scheduler:
 
         self.volume_binder = VolumeBinder(store)
         self._rr = None  # round-robin counter, device i32
-        # host-twin round-robin counter (degraded waves must never touch
-        # the device-resident _rr: fetching it dispatches to the very
-        # runtime the breaker just tripped)
+        # host-side MIRROR of the logical round-robin counter. Degraded
+        # waves must never touch the device-resident _rr (fetching it
+        # dispatches to the very runtime the breaker just tripped), so
+        # the host tracks it exactly: the device counter advances by one
+        # per placement, so a successful device round adds its
+        # chosen>=0 count here; twin waves advance it directly and
+        # null _rr, so a later device round re-seeds from the mirror.
+        # This keeps tie-breaks bit-equal to a clean run ACROSS a
+        # device->twin->device transition (breaker recovery, mesh
+        # reform salvage) instead of rewinding the counter to 0.
         self._host_rr = 0
         # None = not yet resolved; resolved on first wave to
         # pallas_default(), then demoted to False permanently if the fused
@@ -337,6 +345,30 @@ class Scheduler:
         # the mesh actually used by the last _to_device upload (None when
         # caps.N doesn't divide the nodes axis — inputs ran unsharded)
         self._active_mesh = None
+        # -- mesh fault tolerance (sched/breaker.py MeshFaultManager) --
+        # With a multi-device mesh, a device-path failure first walks
+        # the degradation LADDER: attribute the culprit device (or
+        # bisect), quarantine it, reform a smaller mesh
+        # (parallel/mesh.py reform_mesh: 8 -> 4 -> 2 -> 1), salvage the
+        # in-flight round through the hostwave twin, and dispatch the
+        # next round on the reformed mesh. Only when fewer than
+        # mesh_min_devices survive does the failure fall through to the
+        # classic whole-path breaker (the host-twin rung). Recovery
+        # probes (breaker_cooldown cadence) re-admit healed devices and
+        # reform UPWARD. All mesh swaps happen under _mu.
+        self.mesh_min_devices = max(int(mesh_min_devices), 1)
+        self.meshfaults = None
+        if mesh is not None and mesh.devices.size > 1:
+            from .breaker import MeshFaultManager
+
+            self.meshfaults = MeshFaultManager(
+                list(mesh.devices.flat), clock=clock,
+                probe_cooldown=breaker_cooldown)
+            _kernel.set_devices([str(d) for d in mesh.devices.flat])
+        else:
+            _kernel.set_devices(())
+        self.metrics.mesh_devices.set(
+            int(mesh.devices.size) if mesh is not None else 1)
         # preemptions performed by the batched pipeline path (tests +
         # bench assert the pipeline handled them, not per-wave fallback);
         # device_preemption=False routes the batched what-if through the
@@ -769,9 +801,10 @@ class Scheduler:
         twin under each candidate vector — exact candidate placements,
         calibrating the top-K lower bound on samples. Must run before
         any commit mutates the snapshot. Costs one host wave per
-        candidate plus one scalar rr fetch per sampled round; inter-pod
-        affinity rounds are skipped (the twin routes those golden)."""
-        if (self.shadow_exact_interval <= 0 or has_ipa
+        candidate plus one scalar rr fetch per sampled round. The twin
+        carries the inter-pod affinity plane too, so affinity rounds
+        sample exactly like any other."""
+        if (self.shadow_exact_interval <= 0
                 or not self.weightbook.has_candidates()):
             return None
         self._shadow_rounds += 1
@@ -793,6 +826,7 @@ class Scheduler:
                 weights=gate_weights(gating, vec),
                 num_zones=self.snapshot.caps.Z,
                 num_label_values=self.snapshot.num_label_values,
+                has_ipa=has_ipa,
                 weight_vec=vec)
             flips = int(np.sum(np.asarray(res.chosen)[:n] != chosen_dev))
             self.weightbook.record_exact(name, n, flips)
@@ -1058,6 +1092,9 @@ class Scheduler:
             self.backoff.gc()
         self.export_queue_gauges()
         self.scrubber.maybe_scrub()
+        # mesh fault plane: probe quarantined devices past their
+        # cooldown and reform upward when one heals
+        self._maybe_heal_mesh()
 
     def export_queue_gauges(self) -> None:
         """Refresh scheduler_pending_pods{queue=...} — queue depth was
@@ -1400,7 +1437,10 @@ class Scheduler:
         self._account_host_overrun(self.clock() - start)
         usage = (nt.requested, nt.nonzero, nt.pod_count)
         if self._rr is None:
-            self._rr = jnp.asarray(0, jnp.int32)
+            # re-seed from the host mirror: a twin-salvaged round nulls
+            # _rr after advancing _host_rr, so device resumption keeps
+            # the logical counter continuous (bit-equal tie-breaks)
+            self._rr = jnp.asarray(self._host_rr, jnp.int32)
         wv = jnp.asarray(wvec)
         if self._use_pallas is None:
             self._use_pallas = pallas_default()
@@ -1506,20 +1546,22 @@ class Scheduler:
             # then hand the backlog back — schedule_pending's per-wave
             # iteration (or, once tripped, the degraded host path)
             # carries on
-            self._device_failure(e)
+            reformed = self._device_failure(e)
             for p in pods:
                 self.snapshot.unstage(p)
             if rt is not None:
                 rec.end_round(rt, outcome="device_failure",
-                              error=type(e).__name__)
-            if isinstance(e, DispatchTimeout):
-                # partial-round salvage: the dispatch is wedged, not
-                # wrong — the breaker just opened (record_hang) and the
-                # SAME round's pods place NOW through the hostwave twin
-                # instead of re-queueing behind a per-wave retry that
-                # would hang for another deadline. golden is NOT
-                # re-passed: this round's (failed) record already
-                # ledgered it at begin_round.
+                              error=type(e).__name__,
+                              mesh=self._mesh_ledger())
+            if reformed or isinstance(e, DispatchTimeout):
+                # partial-round salvage: the dispatch is wedged or a
+                # mesh device was lost, not a wrong program — the mesh
+                # reformed (or the breaker opened via record_hang) and
+                # the SAME round's pods place NOW through the hostwave
+                # twin instead of re-queueing behind a per-wave retry;
+                # the NEXT round dispatches on the reformed mesh.
+                # golden is NOT re-passed: this round's (failed) record
+                # already ledgered it at begin_round.
                 return self._schedule_degraded(pods)
             for p in pods:
                 self.queue.add_if_not_present(p)
@@ -1533,6 +1575,8 @@ class Scheduler:
             exact_info = self._shadow_exact_sample(
                 waves[0], pbs[0], chosen_all[0], self._rr, has_ipa, gating)
         self._rr = rr_end
+        # mirror: the round's scan advanced rr once per placement
+        self._host_rr += int(np.sum(chosen_all >= 0))
         placed = 0
         committed: set = set()
         retry: List[api.Pod] = []
@@ -1585,7 +1629,7 @@ class Scheduler:
                 preempted=len(handled), scores=scores, shadow=shadow,
                 path=self._last_path or "unresolved",
                 snapshot=self._round_snapshot_shape(),
-                breaker=self.breaker.state)
+                breaker=self.breaker.state, mesh=self._mesh_ledger())
         trace.log_if_long(0.5)
         return placed
 
@@ -1661,32 +1705,52 @@ class Scheduler:
         # gang-sparing nodes first. None for gang-free clusters — same
         # compiled program as before.
         guard, gang_w = self._preempt_gang_weights()
-        if host:
+        def _host_whatif():
             from ..ops.hostwave import preemption_stats_host
 
-            nt, pm, tt = self.snapshot.host_tensors()
-            packed = preemption_stats_host(
-                nt, pm, pb, np.asarray(levels, np.int32),
+            nt_h, pm_h, _tt = self.snapshot.host_tensors()
+            out = preemption_stats_host(
+                nt_h, pm_h, pb, np.asarray(levels, np.int32),
                 num_levels=PREEMPT_LEVELS, gang_w=gang_w)
             trace.step("host what-if")
+            return out
+
+        if not host and not self._device_admitted():
+            # the breaker opened (or the runtime wedged) mid-round — a
+            # preempt chunk must not follow the wave onto a bad runtime
+            host = True
+        if host:
+            packed = _host_whatif()
         else:
             import jax.numpy as jnp
 
             from ..ops.preempt import preemption_stats
 
-            nt, pm, tt = self._to_device()
-            if self._active_mesh is not None:
-                # what-if stats partition along the node axis like the
-                # wave kernels; the failed-pod batch replicates
-                from ..parallel.mesh import replicate
+            try:
+                nt, pm, tt = self._to_device()
+                pb_dev = pb
+                if self._active_mesh is not None:
+                    # what-if stats partition along the node axis like
+                    # the wave kernels; the failed-pod batch replicates
+                    from ..parallel.mesh import replicate
 
-                pb = enc.PodBatch(*replicate(self._active_mesh, tuple(pb)))
-            trace.step("featurized+uploaded")
-            packed = preemption_stats(
-                nt, pm, pb, jnp.asarray(levels, jnp.int32),
-                num_levels=PREEMPT_LEVELS,
-                gang_w=None if gang_w is None else jnp.asarray(gang_w))
-            trace.step("dispatched")
+                    pb_dev = enc.PodBatch(
+                        *replicate(self._active_mesh, tuple(pb)))
+                trace.step("featurized+uploaded")
+                packed_d = preemption_stats(
+                    nt, pm, pb_dev, jnp.asarray(levels, jnp.int32),
+                    num_levels=PREEMPT_LEVELS,
+                    gang_w=None if gang_w is None else jnp.asarray(gang_w))
+                trace.step("dispatched")
+                # the fetch surfaces execution faults too — keep it
+                # inside the try
+                packed = np.asarray(packed_d)
+            except Exception as e:
+                # mid-preempt-chunk device loss: reform (or feed the
+                # breaker) and salvage THIS chunk through the numpy
+                # twin — preemption survives the ladder like waves do
+                self._device_failure(e)
+                packed = _host_whatif()
         st = PreemptStats(np.asarray(packed))  # ONE fetch for all planes
         ok, victims_n = st.ok, st.victims
         psum, pmax = st.prio_sum, st.prio_max
@@ -1785,15 +1849,14 @@ class Scheduler:
 
     def _needs_golden(self, pod: api.Pod) -> bool:
         """Must this pod take the exact golden path instead of the
-        vectorized numpy host wave? True for the encodings the twin
-        deliberately does not carry: multi-topology-key required
-        affinity (needs_host_path, as on the device path) and ANY
-        inter-pod affinity involvement — the pod's own terms, or
-        existing pods' required terms (symmetry blocks every incoming
-        pod, so the whole wave goes golden while terms exist)."""
-        return (self.snapshot.has_affinity_terms
-                or _pod_has_ipa_terms(pod)
-                or self.featurizer.needs_host_path(pod))
+        vectorized numpy host wave? Only for the one encoding the twin
+        (like the device kernel) does not carry: multi-topology-key
+        required affinity (needs_host_path). The inter-pod affinity
+        plane itself is twinned (ops/hostwave.py incoming_statics_host,
+        bitwise parity with ops/affinity.py), so degraded and
+        reform-salvage rounds keep batched throughput for affinity pods
+        — the routing is now identical to the device path's."""
+        return self.featurizer.needs_host_path(pod)
 
     def _count_degraded_golden(self, pods: List[api.Pod], rt=None) -> None:
         """Degraded-mode visibility: pods the hostwave twin can't encode
@@ -1883,7 +1946,8 @@ class Scheduler:
             rec.end_round(rt, outcome="ok", placed=placed, path="host",
                           scores=scores, shadow=shadow,
                           breaker=self.breaker.state,
-                          snapshot=self._round_snapshot_shape())
+                          snapshot=self._round_snapshot_shape(),
+                          mesh=self._mesh_ledger())
         return placed
 
     def _host_wave(self, pods: List[api.Pod], rt=None,
@@ -1928,11 +1992,16 @@ class Scheduler:
         # a fresh one — same triple source either way
         gating, wvec = (weights_view if weights_view is not None
                         else self._weights_kw()[:2])
+        # the same has_ipa resolution as the device path: the twin
+        # carries the full inter-pod affinity plane
+        has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
+                       or pb.rn_has.any() or (pb.pa_w != 0).any())
         res, _usage = hostwave.schedule_wave_host(
             nt, pm, tt, pb, extra, self._host_rr, extra_scores,
             weights=gating,
             num_zones=self.snapshot.caps.Z,
             num_label_values=self.snapshot.num_label_values,
+            has_ipa=has_ipa,
             collect_scores=deco_acc is not None,
             weight_vec=wvec)
         if deco_acc is not None and res.deco is not None:
@@ -1943,6 +2012,7 @@ class Scheduler:
             deco_acc.append((list(pods), np.asarray(res.chosen[:n]),
                              tuple(np.asarray(a)[:n] for a in res.deco)))
         self._host_rr = int(res.rr_end)
+        self._rr = None  # device resumption re-seeds from the mirror
         self._last_path = "vector"
         trace.step("host wave")
         if rt is not None:
@@ -2019,11 +2089,14 @@ class Scheduler:
             return 0
         nt, pm, tt = self.snapshot.host_tensors()
         gating, wvec, _wver = self._weights_kw()
+        has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
+                       or pb.rn_has.any() or (pb.pa_w != 0).any())
         res = hostwave.schedule_gang_host(
             nt, pm, tt, pb, extra, self._host_rr, extra_scores, need,
             weights=gating,
             num_zones=self.snapshot.caps.Z,
             num_label_values=self.snapshot.num_label_values,
+            has_ipa=has_ipa,
             weight_vec=wvec)
         self._last_path = "vector"
         if rt is not None:
@@ -2033,6 +2106,7 @@ class Scheduler:
             self._fail_gang(key, members, need, res)
             return 0
         self._host_rr = int(res.rr_end)
+        self._rr = None  # device resumption re-seeds from the mirror
         pairs: List = []
         leftover: List = []
         for i, pod in enumerate(members):
@@ -2052,23 +2126,177 @@ class Scheduler:
                 self._handle_failure(pod, i, res.fail_counts, res)
         return len(pairs)
 
-    def _device_failure(self, exc: BaseException) -> None:
-        """Account one device-path failure: the labelled error series,
-        the breaker's consecutive-failure count, and the log (with
-        traceback — the old bare stderr prints were invisible to both
-        dashboards and capture fixtures). A watchdog abandonment
-        (DispatchTimeout) trips the breaker IMMEDIATELY: a wedged
+    def _device_failure(self, exc: BaseException) -> bool:
+        """Account one device-path failure. With a multi-device mesh the
+        failure first walks the degradation LADDER (_maybe_reform):
+        quarantine the culprit device and reform a smaller mesh — the
+        caller then salvages the in-flight round through the hostwave
+        twin and the NEXT round dispatches on the reformed mesh, with
+        the whole-path breaker untouched (losing 1 of 8 chips must cost
+        1/8 of device throughput, not 8/8). Only when no reform is
+        possible (mesh exhausted / below --mesh-min-devices / no mesh)
+        does the failure feed the classic breaker: a watchdog
+        abandonment (DispatchTimeout) trips it IMMEDIATELY — a wedged
         runtime won't heal by retrying, and each retry would burn a
-        full wave_deadline_s."""
+        full wave_deadline_s. Returns True when the mesh reformed (the
+        caller must salvage this round through the twin)."""
         self.metrics.scheduling_errors.labels(stage="wave").inc()
-        if isinstance(exc, DispatchTimeout):
-            self.breaker.record_hang()
-        else:
-            self.breaker.record_failure()
+        reformed = self._maybe_reform(exc)
+        if not reformed:
+            if isinstance(exc, DispatchTimeout):
+                self.breaker.record_hang()
+            else:
+                self.breaker.record_failure()
         logging.getLogger(__name__).error(
-            "device wave failed (%s consecutive, breaker %s): %s: %s",
+            "device wave failed (%s consecutive, breaker %s%s): %s: %s",
             self.breaker.failures, self.breaker.state,
+            ", mesh reformed" if reformed else "",
             type(exc).__name__, exc, exc_info=exc)
+        return reformed
+
+    def _maybe_reform(self, exc: BaseException) -> bool:
+        """One ladder step down: attribute the failure to a device (the
+        exception names one — sched/breaker.py DeviceLost or an XLA
+        error embedding the device id — else quarantine-and-probe
+        bisection), quarantine, and rebuild a smaller valid mesh from
+        the survivors. Runs under _mu (callers hold it around the
+        device step), so the swap is atomic w.r.t. the next upload.
+        False when there is nothing to reform — no mesh, single-device
+        mesh, the reform floor (--mesh-min-devices) reached, or the
+        `mesh.reform` fault point failed the reform — in which case the
+        caller falls through to the whole-path breaker."""
+        from ..ops import kernel as _kernel
+        from ..parallel.mesh import reform_mesh
+
+        mf = self.meshfaults
+        if (mf is None or self.mesh is None
+                or int(self.mesh.devices.size) <= 1):
+            return False
+        culprit = mf.attribute(exc)
+        if culprit is not None:
+            mf.quarantine(culprit)
+            newly = [culprit]
+        else:
+            newly = mf.quarantine_suspects()
+        if not newly:
+            return False
+        for name in newly:
+            self.metrics.device_quarantined.labels(device=name).set(1)
+            tracing.event("device_quarantined", device=name,
+                          attributed=culprit is not None)
+        logging.getLogger(__name__).warning(
+            "mesh device(s) quarantined (%s): %s",
+            "attributed" if culprit is not None else "bisection",
+            ", ".join(newly))
+        try:
+            faultpoints.fire("mesh.reform")
+            new_mesh = reform_mesh(mf.healthy(),
+                                   min_devices=self.mesh_min_devices)
+        except Exception as reform_exc:
+            logging.getLogger(__name__).error(
+                "mesh reform failed, falling through to the breaker: %s",
+                reform_exc)
+            new_mesh = None
+        if new_mesh is None:
+            # below the floor: the quarantines stand (probes may still
+            # heal them) but the failure feeds the classic breaker
+            return False
+        self._swap_mesh(new_mesh, direction="down")
+        _kernel.set_devices([str(d) for d in new_mesh.devices.flat])
+        return True
+
+    def _swap_mesh(self, new_mesh, direction: str) -> None:
+        """Install a reformed mesh (under _mu): the next _to_device
+        re-resolves against it, finds a NEW mesh object in the snapshot
+        cache key, and re-commits every node-tensor group to the new
+        "nodes"-axis sharding (full re-upload; delta row tracking
+        resets with the cache — state/snapshot.py to_device). No
+        dispatch happens between the swap and that re-commit: the
+        in-flight round is salvaged host-side."""
+        self.mesh = new_mesh
+        self._active_mesh = None
+        ndev = int(new_mesh.devices.size)
+        self.metrics.mesh_reforms.labels(direction=direction).inc()
+        self.metrics.mesh_devices.set(ndev)
+        tracing.event("mesh_reform", direction=direction, devices=ndev)
+        logging.getLogger(__name__).warning(
+            "mesh reformed %s to %d device(s)", direction, ndev)
+
+    def _mesh_ledger(self) -> Optional[Dict]:
+        """Round-ledger `mesh` record ({devices, reforms, quarantined});
+        None (dropped by end_round) when no mesh fault plane exists."""
+        mf = self.meshfaults
+        if mf is None:
+            return None
+        return {
+            "devices": (int(self.mesh.devices.size)
+                        if self.mesh is not None else 1),
+            "reforms": int(self.metrics.mesh_reforms.total()),
+            "quarantined": mf.quarantined_names(),
+        }
+
+    # one process-global jitted probe program: compiled once per device
+    # it runs on, reused across probes (a fresh jax.jit per probe would
+    # recompile every cooldown tick)
+    _PROBE_FN = None
+
+    def _probe_device(self, dev) -> bool:
+        """Recovery probe for one quarantined device: a trivial jitted
+        op pinned to it, fetched. Runs OUTSIDE _mu (a probe is a device
+        dispatch; lock-discipline forbids blocking device work under
+        the scheduler lock from housekeeping) and never while the
+        runtime is wedged. The `device.lost` fault point fires with the
+        device's name as payload so per-device chaos
+        (lost_device_fault) fails exactly its victim's probes."""
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            if faultpoints.fire("device.lost", payload=str(dev)):
+                return False  # drop mode: the probe was lost
+            if Scheduler._PROBE_FN is None:
+                Scheduler._PROBE_FN = jax.jit(lambda a: a + jnp.float32(1.0))
+            x = jax.device_put(np.float32(1.0), dev)
+            out = Scheduler._PROBE_FN(x)
+            return float(np.asarray(out)) == 2.0
+        except Exception:
+            return False
+
+    def _maybe_heal_mesh(self) -> None:
+        """Probe quarantined devices whose cooldown elapsed; re-admit
+        the healed and reform UPWARD (4 -> 8) so a recovered chip
+        rejoins the serving mesh. Called from housekeeping."""
+        from ..ops import kernel as _kernel
+        from ..parallel.mesh import reform_mesh
+
+        mf = self.meshfaults
+        if mf is None or not mf.quarantined_names():
+            return
+        if self._runtime_wedged():
+            return  # no probes at a wedged runtime
+        healed = False
+        for dev in mf.due_probes(self.clock()):
+            name = str(dev)
+            if self._probe_device(dev):
+                mf.readmit(name)
+                self.metrics.device_quarantined.remove(device=name)
+                tracing.event("device_readmitted", device=name)
+                logging.getLogger(__name__).warning(
+                    "quarantined device %s probed healthy; re-admitted",
+                    name)
+                healed = True
+            else:
+                mf.reprobe_later(name)
+        if not healed:
+            return
+        with self._mu:
+            cur = (int(self.mesh.devices.size)
+                   if self.mesh is not None else 0)
+            new_mesh = reform_mesh(mf.healthy(), min_devices=1)
+            if new_mesh is not None and int(new_mesh.devices.size) > cur:
+                self._swap_mesh(new_mesh, direction="up")
+                _kernel.set_devices(
+                    [str(d) for d in new_mesh.devices.flat])
 
     def _run_wave(self, pods: List[api.Pod]) -> int:
         import jax
@@ -2142,7 +2370,10 @@ class Scheduler:
         # must shrink the wave there too, not only under the pipeline
         self._account_host_overrun(self.clock() - start)
         if self._rr is None:
-            self._rr = jnp.asarray(0, jnp.int32)
+            # re-seed from the host mirror: a twin-salvaged round nulls
+            # _rr after advancing _host_rr, so device resumption keeps
+            # the logical counter continuous (bit-equal tie-breaks)
+            self._rr = jnp.asarray(self._host_rr, jnp.int32)
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
         wv = jnp.asarray(wvec)
@@ -2219,10 +2450,14 @@ class Scheduler:
             # every formulation failed: count it against the breaker
             # and degrade THIS wave to the exact host path — a device
             # fault must cost a slower wave, never a stopped scheduler
+            # reform or breaker accounting either way — this wave
+            # ALWAYS degrades to the host path (a device fault must cost
+            # a slower wave, never a stopped scheduler)
             self._device_failure(e)
             if rt is not None:
                 rec.end_round(rt, outcome="device_failure",
-                              error=type(e).__name__)
+                              error=type(e).__name__,
+                              mesh=self._mesh_ledger())
             # golden is NOT re-passed: this wave's own (failed) round
             # record already ledgered it at begin_round
             return placed_host + self._schedule_degraded(pods)
@@ -2232,6 +2467,8 @@ class Scheduler:
         if rt is not None:
             rt.mark("device_wave", cat="device", path=self._last_path)
         chosen = np.asarray(res.chosen)
+        # mirror: one rr advance per placement (see _host_rr)
+        self._host_rr += int(np.sum(chosen >= 0))
         fetched = chosen.nbytes
         deco = None
         if res.deco is not None:
@@ -2291,7 +2528,7 @@ class Scheduler:
                 failed=len(pods) - placed, path=self._last_path,
                 scores=scores, shadow=shadow,
                 snapshot=self._round_snapshot_shape(),
-                breaker=self.breaker.state)
+                breaker=self.breaker.state, mesh=self._mesh_ledger())
         trace.log_if_long(0.1)
         return placed + placed_host
 
@@ -2479,7 +2716,8 @@ class Scheduler:
         finally:
             if rt is not None and rt.t1 is None:
                 rec.end_round(rt, snapshot=self._round_snapshot_shape(),
-                              breaker=self.breaker.state)
+                              breaker=self.breaker.state,
+                              mesh=self._mesh_ledger())
         return placed
 
     def _schedule_one_gang_inner(self, key: str, members: List[api.Pod],
@@ -2531,7 +2769,10 @@ class Scheduler:
         if rt is not None:
             rt.mark("upload", cat="device")
         if self._rr is None:
-            self._rr = jnp.asarray(0, jnp.int32)
+            # re-seed from the host mirror: a twin-salvaged round nulls
+            # _rr after advancing _host_rr, so device resumption keeps
+            # the logical counter continuous (bit-equal tie-breaks)
+            self._rr = jnp.asarray(self._host_rr, jnp.int32)
         if self._use_pallas is None:
             self._use_pallas = pallas_default()
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
@@ -2589,14 +2830,16 @@ class Scheduler:
             # gang for retry (atomicity is preserved — nothing placed)
             # and let the breaker route future waves host-side once it
             # trips
-            self._device_failure(e)
+            reformed = self._device_failure(e)
             if rt is not None:
                 rt.ledger.update(outcome="device_failure",
                                  error=type(e).__name__)
-            if isinstance(e, DispatchTimeout):
-                # wedged dispatch: salvage the gang through the host
-                # twin's all-or-nothing plane right now (the breaker
-                # just opened; atomicity is preserved either way)
+            if reformed or isinstance(e, DispatchTimeout):
+                # wedged dispatch or a lost mesh device: salvage the
+                # gang through the host twin's all-or-nothing plane
+                # right now (the mesh reformed, or the breaker just
+                # opened; atomicity is preserved either way) — the next
+                # gang dispatches on the reformed mesh
                 return placed + self._schedule_degraded_gang(key, members,
                                                              rt)
             for p in members:
@@ -2616,6 +2859,7 @@ class Scheduler:
             self._fail_gang(key, members, need, res)
             return placed
         self._rr = res.rr_end
+        self._host_rr += int(np.sum(chosen >= 0))  # see _host_rr mirror
         pairs: List = []
         leftover: List = []
         for i, pod in enumerate(members):

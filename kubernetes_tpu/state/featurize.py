@@ -299,12 +299,14 @@ class PodFeaturizer:
         return False
 
     def golden_reason(self, pod: api.Pod) -> str:
-        """Why a degraded-mode pod bypasses the vectorized numpy twin
-        for the exact per-pod golden path: 'multi_tk' — required
-        (anti)affinity spanning multiple topology keys, the same
-        encoding limit as the device path — vs 'affinity' — any other
-        inter-pod-affinity involvement (the plane the twin does not
-        carry). The label set of
+        """Why a pod bypasses the batched kernels (device AND numpy
+        twin) for the exact per-pod golden path: 'multi_tk' — required
+        (anti)affinity spanning multiple topology keys, the shared
+        encoding limit. 'affinity' is retained for direct callers that
+        classify pods the twin-era degraded path no longer routes
+        golden (the inter-pod affinity plane is twinned —
+        ops/hostwave.py incoming_statics_host — so the count should
+        stay zero in degraded rounds). The label set of
         scheduler_degraded_golden_pods_total{reason=...}."""
         return "multi_tk" if self.needs_host_path(pod) else "affinity"
 

@@ -21,6 +21,21 @@ Wired points (grep for `faultpoints.fire`):
                    set the watchdog abandons it, the breaker trips via
                    record_hang, and the round salvages through the
                    hostwave twin
+  device.lost      ops/kernel.py record_dispatch, inside the guarded
+                   dispatch (next to kernel.hang), AND sched/scheduler.py
+                   _probe_device (the quarantined-device recovery probe).
+                   Payload: the active mesh device-name tuple at the
+                   dispatch seam, the probed device's name (str) at the
+                   probe. Arm per-device with `corrupt` mode and
+                   sched.breaker.lost_device_fault(str(dev)) — raises
+                   DeviceLost(dev) only while the victim is in the
+                   payload, so a reformed mesh stops failing and only
+                   the victim's probes fail; a plain `raise` models an
+                   unattributed device loss (the bisection path)
+  mesh.reform      sched/scheduler.py _maybe_reform, BEFORE the new mesh
+                   is built — a `raise` fails the reform so the failure
+                   falls through to the whole-path breaker (host-twin
+                   rung); hits() counts reforms for chaos asserts
   queue.shed       sched/queue.py _should_shed_locked — `drop` forces
                    the shed decision for every sheddable
                    (sub-threshold-priority, non-gang) pod regardless of

@@ -121,19 +121,25 @@ class LabeledCounter:
         self._lock = threading.Lock()
 
     def labels(self, **kw) -> Counter:
-        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        # a label omitted by the caller defaults to "" and is dropped
+        # from the rendered series (Prometheus treats an empty label
+        # value as absent) — so a family can grow a dimension (e.g.
+        # scheduling_errors_total's `device`) without touching every
+        # existing call site or renaming their series
+        key = tuple(str(kw.get(ln, "")) for ln in self.labelnames)
         self.decl.check(self.name, self.labelnames, key)
         with self._lock:
             c = self._children.get(key)
             if c is None:
                 rendered = ",".join(
-                    f'{ln}="{v}"' for ln, v in zip(self.labelnames, key))
+                    f'{ln}="{v}"' for ln, v in zip(self.labelnames, key)
+                    if v != "")
                 c = Counter(f"{self.name}{{{rendered}}}")
                 self._children[key] = c
             return c
 
     def value(self, **kw) -> float:
-        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        key = tuple(str(kw.get(ln, "")) for ln in self.labelnames)
         with self._lock:
             c = self._children.get(key)
             return c.value if c is not None else 0.0
@@ -163,19 +169,22 @@ class LabeledGauge:
         self._lock = threading.Lock()
 
     def labels(self, **kw) -> Gauge:
-        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        # omitted labels default to "" and are dropped from the rendered
+        # series — same dimension-growth contract as LabeledCounter
+        key = tuple(str(kw.get(ln, "")) for ln in self.labelnames)
         self.decl.check(self.name, self.labelnames, key)
         with self._lock:
             g = self._children.get(key)
             if g is None:
                 rendered = ",".join(
-                    f'{ln}="{v}"' for ln, v in zip(self.labelnames, key))
+                    f'{ln}="{v}"' for ln, v in zip(self.labelnames, key)
+                    if v != "")
                 g = Gauge(f"{self.name}{{{rendered}}}")
                 self._children[key] = g
             return g
 
     def value(self, **kw) -> float:
-        key = tuple(str(kw[ln]) for ln in self.labelnames)
+        key = tuple(str(kw.get(ln, "")) for ln in self.labelnames)
         with self._lock:
             g = self._children.get(key)
             return g.value if g is not None else 0.0
@@ -281,10 +290,15 @@ class Metrics:
         self.pods_scheduled = Counter("pods_scheduled_total")
         self.pods_failed = Counter("pods_failed_total")
         # robustness layer: per-stage error attribution (bind worker /
-        # device wave / extender webhook), snapshot scrubber audit
-        # series, and device-path circuit-breaker trips
+        # device wave / extender webhook / device dispatch), snapshot
+        # scrubber audit series, and device-path circuit-breaker trips.
+        # `device` is filled only by stage=dispatch (ops/kernel.py
+        # record_dispatch attributes the culprit mesh device, bounded to
+        # the active set + "unknown"); every other site omits it and
+        # keeps its un-suffixed series
         self.scheduling_errors = LabeledCounter("scheduling_errors_total",
-                                                ("stage",))
+                                                ("stage", "device"),
+                                                open_labels=("device",))
         self.snapshot_scrub_runs = Counter("snapshot_scrub_runs_total")
         self.snapshot_scrub_divergences = Counter(
             "snapshot_scrub_divergences_total")
@@ -363,7 +377,8 @@ class Metrics:
         # same cardinality as the jit program cache itself
         self.device_jit_events = LabeledCounter(
             "device_jit_cache_events_total", ("program", "bucket", "event"),
-            values={"program": ("wave", "round", "gang", "telemetry"),
+            values={"program": ("wave", "round", "gang", "telemetry",
+                                "preempt"),
                     "event": ("hit", "miss")},
             open_labels=("bucket",))
         self.device_jit_compile_seconds = Histogram(
@@ -379,6 +394,22 @@ class Metrics:
             open_labels=("device",))
         self.snapshot_upload_bytes = Counter("snapshot_upload_bytes_total")
         self.device_fetch_bytes = Counter("device_fetch_bytes_total")
+        # mesh fault tolerance (sched/breaker.py MeshFaultManager +
+        # parallel/mesh.py reform_mesh): how many devices the scheduling
+        # mesh currently spans (the degradation ladder's live rung: 8 ->
+        # 4 -> 2 -> 1; 1 when unsharded), reforms by direction (down =
+        # device loss shrank the mesh, up = a healed device re-admitted
+        # by a recovery probe grew it back), and a per-device quarantine
+        # flag (1 while quarantined; the child is removed on re-admit so
+        # /metrics never freezes a healed device at 1). Device names are
+        # open but bounded by the visible device count, like the
+        # per-device HBM gauge above.
+        self.mesh_devices = Gauge("scheduler_mesh_devices")
+        self.mesh_reforms = LabeledCounter(
+            "mesh_reform_total", ("direction",),
+            values={"direction": ("down", "up")})
+        self.device_quarantined = LabeledGauge(
+            "device_quarantined", ("device",), open_labels=("device",))
         self.waves_total = LabeledCounter("scheduler_waves_total", ("path",))
         # degraded-mode visibility: breaker-open pods the hostwave twin
         # can't encode, routed to the exact per-pod golden path, by

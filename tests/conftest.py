@@ -80,6 +80,11 @@ def pytest_configure(config):
         "markers", "shadow: shadow-scoring observatory suite (live "
                    "WeightProfile hot swap/rollback, counterfactual "
                    "divergence, /debug/shadow; make obs / make chaos)")
+    config.addinivalue_line(
+        "markers", "meshfault: mesh fault-tolerance suite (device-loss "
+                   "detection, quarantine/probe, reform ladder "
+                   "8->4->2->1->heal, twin salvage parity; make chaos + "
+                   "make multichip)")
 
 
 import pytest  # noqa: E402

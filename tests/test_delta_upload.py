@@ -191,6 +191,43 @@ def test_mode_switch_invalidates_cache():
     assert len(nt_back.valid.sharding.device_set) == 1
 
 
+def test_reform_invalidates_delta_tracking():
+    """Mesh reform (parallel/mesh.py reform_mesh) regression: a NEW mesh
+    object must drop the whole device cache — pending dirty rows were
+    tracked against the OLD sharding and applying them as a delta
+    scatter against re-committed arrays would be wrong. The reformed
+    upload is FULL (bytes == resident footprint), delta tracking resets,
+    and the re-committed groups match a from-scratch sharded upload
+    bit-for-bit; subsequent churn deltas engage again."""
+    from kubernetes_tpu.parallel.mesh import make_mesh, reform_mesh
+
+    mesh = make_mesh(8)
+    rng = random.Random(17)
+    nodes, existing, _ = random_world(rng, n_nodes=20, n_existing=24)
+    cache, snap = build(nodes, existing)
+    snap.to_device(mesh=mesh)
+    # dirty some rows against the 8-way sharding, then reform to 4
+    _churn(rng, cache, snap, nodes, n_ops=12)
+    assert any(snap._dirty_rows.values())
+    small = reform_mesh(list(mesh.devices.flat),
+                        exclude={str(mesh.devices.flat[3])})
+    assert small.devices.size == 4
+    before = snap.upload_bytes_total
+    nt, _, _ = snap.to_device(mesh=small)
+    # full re-upload to the new sharding, delta tracking reset
+    assert snap.upload_bytes_total - before >= sum(
+        snap._group_bytes.values())
+    assert not any(snap._dirty_rows.values())
+    assert len(nt.valid.sharding.device_set) == 4
+    _assert_matches_fresh(snap, mesh=small)
+    # churn against the reformed mesh: deltas engage and stay bitwise
+    _churn(rng, cache, snap, nodes, n_ops=12)
+    _assert_matches_fresh(snap, mesh=small)
+    # healing back upward re-commits again, same contract
+    _churn(rng, cache, snap, nodes, n_ops=6)
+    _assert_matches_fresh(snap, mesh=mesh)
+
+
 def test_trickle_upload_bytes_cut_10x():
     """The acceptance gate: steady-state upload bytes per trickle round
     are >=10x below the whole-mirror re-upload the pre-delta scheduler

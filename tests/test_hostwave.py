@@ -13,7 +13,10 @@ Two properties under test:
      (breaker-open), the scheduler drains whole backlogs through the
      twin: placements match an identical un-faulted device scheduler,
      preemption stays batched, gang atomicity holds, and inter-pod
-     affinity pods still take the exact golden path.
+     affinity pods ride the twin's batched affinity plane
+     (incoming_statics_host) instead of draining through the per-pod
+     golden path; only multi-topology-key pods still route golden,
+     exactly like the device path.
 """
 
 import numpy as np
@@ -330,10 +333,11 @@ class TestDegradedVectorWave:
         assert all(not store.get("pods", "default", f"toobig-{j}").spec.node_name
                    for j in range(4))
 
-    def test_degraded_affinity_pods_take_golden_path(self):
-        """Inter-pod anti-affinity is not twinned: breaker-open
-        placement of anti-affine pods goes through the exact golden
-        path and still honors the constraint."""
+    def test_degraded_affinity_pods_take_the_twin(self):
+        """The inter-pod affinity plane IS twinned: breaker-open
+        placement of anti-affine pods stays on the batched numpy twin
+        (no per-pod golden routing — reason=affinity stays zero) and
+        still honors the constraint exactly."""
         from kubernetes_tpu.api.labels import LabelSelector
 
         store, sched = _faulted(n_nodes=3, cpu="4", wave=8)
@@ -349,13 +353,14 @@ class TestDegradedVectorWave:
         nodes = {store.get("pods", "default", f"anti-{i}").spec.node_name
                  for i in range(3)}
         assert len(nodes) == 3  # one per host, exactly
-        # degraded-mode visibility: the golden-routed pods are counted
-        # by reason, so the untwinned affinity plane shows up on
-        # dashboards instead of silently dragging degraded throughput
+        # the affinity coverage gap is CLOSED: no pod went golden for
+        # reason=affinity — the twin carried the whole wave batched
         assert sched.metrics.degraded_golden_pods.value(
-            reason="affinity") == 3
+            reason="affinity") == 0
         assert sched.metrics.degraded_golden_pods.value(
             reason="multi_tk") == 0
+        # and the twin actually ran (host waves, not golden pods/s)
+        assert sched.metrics.waves_total.value(path="host") >= 1
 
     def test_degraded_golden_reasons_and_ledger_tag(self):
         """multi-topology-key pods count under reason=multi_tk, and the
@@ -415,3 +420,188 @@ class TestDegradedVectorWave:
         v_h = simulate.simulate_placements(shadow, pb, backend="host", **kw)
         assert np.array_equal(v_d.chosen, v_h.chosen)
         assert np.array_equal(v_d.feasible, v_h.feasible)
+
+
+class TestInterPodAffinityTwin:
+    """Bitwise parity of the twinned inter-pod affinity plane
+    (ops/hostwave.py incoming_statics_host + the has_ipa commit loop)
+    against the device kernel — the coverage gap the degraded path used
+    to pay for with per-pod golden routing."""
+
+    @staticmethod
+    def _ipa_world(seed, n_nodes=10, n_existing=8, n_pods=12):
+        """Randomized world that is GUARANTEED affinity-rich: required
+        (anti)affinity, preferred terms, and existing pods carrying
+        required anti terms (the symmetry plane)."""
+        import random
+
+        from kubernetes_tpu.api.labels import LabelSelector
+        from test_parity import build
+
+        rng = random.Random(seed)
+        nodes = [make_node(f"n{i}", cpu="8", memory="16Gi",
+                           labels={"kubernetes.io/hostname": f"n{i}",
+                                   api.LABEL_ZONE: f"z{i % 3}"})
+                 for i in range(n_nodes)]
+        existing = []
+        for i in range(n_existing):
+            aff = None
+            if rng.random() < 0.5:
+                aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                    required=[api.PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"grp": f"g{i % 3}"}),
+                        topology_key="kubernetes.io/hostname")]))
+            existing.append(make_pod(
+                f"ex-{i}", cpu="200m", memory="256Mi",
+                labels={"grp": f"g{i % 3}", "app": "web"},
+                node_name=f"n{i % n_nodes}", affinity=aff))
+        pods = []
+        for i in range(n_pods):
+            r = rng.random()
+            aff = None
+            if r < 0.3:
+                aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                    required=[api.PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"grp": f"g{i % 3}"}),
+                        topology_key="kubernetes.io/hostname")]))
+            elif r < 0.5:
+                aff = api.Affinity(pod_affinity=api.PodAffinity(
+                    required=[api.PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"grp": f"g{(i + 1) % 3}"}),
+                        topology_key=api.LABEL_ZONE)]))
+            elif r < 0.7:
+                aff = api.Affinity(pod_affinity=api.PodAffinity(
+                    preferred=[api.WeightedPodAffinityTerm(
+                        weight=rng.randint(1, 100),
+                        pod_affinity_term=api.PodAffinityTerm(
+                            label_selector=LabelSelector(
+                                match_labels={"app": "web"}),
+                            topology_key=api.LABEL_ZONE))]))
+            pods.append(make_pod(
+                f"p{i}", cpu=f"{rng.randint(1, 8) * 100}m", memory="128Mi",
+                labels={"grp": f"g{i % 3}", "app": "web"}, affinity=aff))
+        cache, snap = build(nodes, existing)
+        return rng, snap, pods
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_ipa_wave_bitwise_parity(self, seed):
+        """Device kernel == numpy twin on affinity-rich worlds: chosen,
+        score, rr, fail counts, the FULL mask stack (incl. the
+        MatchInterPodAffinity row), and the score decomposition."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.kernel import Weights, schedule_wave
+        from kubernetes_tpu.state.featurize import PodFeaturizer
+
+        rng, snap, pods = self._ipa_world(seed)
+        feat = PodFeaturizer(snap, group_selectors=lambda p: [])
+        pb = feat.featurize(pods)
+        nt, pm, tt = snap.to_device()
+        P = pb.req.shape[0]
+        extra = np.ones((P, snap.caps.N), bool)
+        kw = dict(weights=Weights(), num_zones=snap.caps.Z,
+                  num_label_values=snap.num_label_values, has_ipa=True)
+        rr0 = rng.randint(0, 5)
+        dev = schedule_wave(nt, pm, tt, pb, extra,
+                            jnp.asarray(rr0, jnp.int32),
+                            collect_scores=True, **kw)
+        nth, pmh, tth = snap.host_tensors()
+        host, _u = hostwave.schedule_wave_host(
+            nth, pmh, tth, pb, extra, rr0, None, collect_scores=True, **kw)
+        # the statics twin actually saw affinity programs
+        assert (np.any(pb.ra_has) or np.any(pb.rn_has)
+                or np.any(pb.pa_w != 0) or np.any(np.asarray(tth.valid)))
+        np.testing.assert_array_equal(np.asarray(dev.chosen), host.chosen)
+        np.testing.assert_array_equal(np.asarray(dev.score), host.score)
+        np.testing.assert_array_equal(np.asarray(dev.fail_counts),
+                                      host.fail_counts)
+        np.testing.assert_array_equal(np.asarray(dev.masks), host.masks)
+        assert int(np.asarray(dev.rr_end)) == int(host.rr_end)
+        for a, b in zip(dev.deco, host.deco):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_ipa_gang_bitwise_parity(self, seed):
+        """The all-or-nothing gang wrapper under has_ipa: device ==
+        twin on ok / chosen / placed / rr."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.gang import schedule_gang
+        from kubernetes_tpu.ops.kernel import Weights
+        from kubernetes_tpu.state.featurize import PodFeaturizer
+
+        rng, snap, pods = self._ipa_world(seed + 100, n_pods=6)
+        feat = PodFeaturizer(snap, group_selectors=lambda p: [])
+        pb = feat.featurize(pods)
+        nt, pm, tt = snap.to_device()
+        P = pb.req.shape[0]
+        extra = np.ones((P, snap.caps.N), bool)
+        kw = dict(weights=Weights(), num_zones=snap.caps.Z,
+                  num_label_values=snap.num_label_values, has_ipa=True)
+        need = rng.randint(1, len(pods))
+        dev = schedule_gang(nt, pm, tt, pb, extra,
+                            jnp.asarray(0, jnp.int32), None,
+                            jnp.asarray(need, jnp.int32), **kw)
+        nth, pmh, tth = snap.host_tensors()
+        host = hostwave.schedule_gang_host(
+            nth, pmh, tth, pb, extra, 0, None, need, **kw)
+        assert bool(np.asarray(dev.ok)) == bool(host.ok)
+        np.testing.assert_array_equal(np.asarray(dev.chosen), host.chosen)
+        assert int(np.asarray(dev.placed)) == int(host.placed)
+        assert int(np.asarray(dev.rr_end)) == int(host.rr_end)
+
+    def test_degraded_affinity_e2e_matches_clean_device_run(self):
+        """Breaker-open end-to-end with required (anti)affinity, a
+        preferred term, and symmetry from existing pods: the degraded
+        scheduler's placements equal a clean device scheduler's exactly
+        — and no pod was routed golden for reason=affinity."""
+        from kubernetes_tpu.api.labels import LabelSelector
+
+        def world(store):
+            for i in range(8):
+                store.create("nodes", make_node(
+                    f"n{i}", cpu="8", memory="16Gi",
+                    labels={"kubernetes.io/hostname": f"n{i}",
+                            api.LABEL_ZONE: f"z{i % 2}"}))
+            for i in range(12):
+                aff = None
+                labels = {"app": "w"}
+                if i % 4 == 0:
+                    labels = {"anti": "a", "app": "w"}
+                    aff = api.Affinity(
+                        pod_anti_affinity=api.PodAntiAffinity(
+                            required=[api.PodAffinityTerm(
+                                label_selector=LabelSelector(
+                                    match_labels={"anti": "a"}),
+                                topology_key="kubernetes.io/hostname")]))
+                elif i % 4 == 1:
+                    aff = api.Affinity(pod_affinity=api.PodAffinity(
+                        preferred=[api.WeightedPodAffinityTerm(
+                            weight=10,
+                            pod_affinity_term=api.PodAffinityTerm(
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "w"}),
+                                topology_key=api.LABEL_ZONE))]))
+                store.create("pods", make_pod(
+                    f"p{i}", cpu="500m", memory="128Mi", labels=labels,
+                    affinity=aff))
+
+        ref_store = ObjectStore()
+        ref = Scheduler(ref_store, wave_size=8)
+        world(ref_store)
+        assert ref.schedule_pending() == 12
+
+        store, sched = _faulted(n_nodes=0, wave=8)
+        world(store)
+        assert sched.schedule_pending() == 12
+        assert sched.breaker.state == OPEN
+        want = sorted((p.metadata.name, p.spec.node_name)
+                      for p in ref_store.list("pods"))
+        got = sorted((p.metadata.name, p.spec.node_name)
+                     for p in store.list("pods"))
+        assert got == want
+        assert sched.metrics.degraded_golden_pods.value(
+            reason="affinity") == 0

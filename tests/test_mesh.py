@@ -292,6 +292,87 @@ def test_hbm_accounting_per_device():
     sched.close()
 
 
+def test_reform_ladder_walk_bit_equal_at_every_rung():
+    """Randomized degradation-ladder walk (8 -> 4 -> 2 -> 1 -> heal ->
+    8): after every rung change, a fresh pod batch schedules and the
+    cumulative placements, round-robin state (host mirror), and fail
+    counts stay bit-equal to a clean single-device run of the same
+    batch sequence."""
+    import random
+
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched import breaker as breaker_mod
+    from kubernetes_tpu.sched.breaker import lost_device_fault
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.utils import faultpoints
+
+    rng = random.Random(1234)
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return Clock.t
+
+    # one shared random batch plan (pod names + cpu sizes drawn ONCE),
+    # replayed identically against both schedulers
+    plan = [
+        [(f"r{k}-p{i}", f"{rng.randint(1, 4) * 100}m")
+         for i in range(rng.randint(8, 24))]
+        for k in range(6)]
+
+    def batch(store, specs):
+        for name, cpu in specs:
+            store.create("pods", make_pod(name, cpu=cpu, memory="128Mi",
+                                          labels={"app": "w"}))
+
+    ref_store = ObjectStore()
+    ref = Scheduler(ref_store, wave_size=8)
+    _make_world(ref_store, n_nodes=16, n_pods=0)
+    ref_results = []
+    for specs in plan:
+        batch(ref_store, specs)
+        ref.schedule_pending()
+        ref_results.append((sorted(
+            (p.metadata.name, p.spec.node_name)
+            for p in ref_store.list("pods")), ref._host_rr,
+            int(ref.metrics.pods_failed.value)))
+    ref.close()
+
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=8, mesh=mesh, clock=Clock(),
+                      breaker_cooldown=30.0)
+    _make_world(store, n_nodes=16, n_pods=0)
+    devs = [str(d) for d in mesh.devices.flat]
+    # rung schedule: kill one serving device before batches 0/1/2 (8 ->
+    # 4, stay, 4 -> ... depending on survivor count), heal before 4
+    kills = {0: devs[2], 1: devs[0], 2: devs[5]}
+    sizes = []
+    for k, specs in enumerate(plan):
+        if k in kills:
+            faultpoints.activate("device.lost", "corrupt",
+                                 fn=lost_device_fault(kills[k]))
+        if k == 4:
+            # heal everything: probes re-admit, the mesh reforms upward
+            faultpoints.reset()
+            Clock.t += 31.0
+        batch(store, specs)
+        sched.schedule_pending()
+        faultpoints.deactivate("device.lost")
+        got = (sorted((p.metadata.name, p.spec.node_name)
+                      for p in store.list("pods")), sched._host_rr,
+               int(sched.metrics.pods_failed.value))
+        assert got == ref_results[k], f"rung {k} diverged"
+        sizes.append(int(sched.metrics.mesh_devices.value))
+    # the ladder moved down and healed back to the full mesh
+    assert sizes[0] == 4 and sizes[-1] == 8
+    assert sizes[2] <= sizes[1] <= 4
+    assert sched.breaker.state == breaker_mod.CLOSED
+    assert sched.metrics.mesh_reforms.value(direction="up") >= 1
+    sched.close()
+
+
 def test_scheduler_with_mesh_affinity_pods():
     """Sharded wave handles inter-pod affinity pods (the all-to-all along
     the pods axis — SURVEY.md §5's ring-attention analog)."""

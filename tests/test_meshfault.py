@@ -1,0 +1,529 @@
+"""Mesh fault tolerance: device-loss detection, quarantine-and-probe,
+and the degradation LADDER (8 -> 4 -> 2 -> 1 -> heal -> 8).
+
+The acceptance proofs of the mesh fault plane: with `device.lost`
+killing 1 of 8 mesh devices mid-wave / mid-gang / mid-preempt-chunk,
+the in-flight round salvages through the numpy twin, the NEXT round
+dispatches on a reformed smaller mesh (no full breaker-open), placements
+stay bit-equal to a clean single-device run, a healed device is
+re-admitted by an upward reform — all clock-driven — and the ladder is
+visible in scheduler_mesh_devices / mesh_reform_total / the round
+ledger's `mesh` record.
+
+Runs on the 8 virtual CPU devices forced by conftest.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.parallel.mesh import make_mesh, reform_mesh
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched import breaker as breaker_mod
+from kubernetes_tpu.sched.breaker import (DeviceLost, MeshFaultManager,
+                                          lost_device_fault)
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import faultpoints
+
+from helpers import make_node, make_pod
+
+pytestmark = [pytest.mark.meshfault, pytest.mark.mesh]
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _make_world(store, n_nodes=16, prefix="b0", n_pods=48, with_aff=False):
+    for i in range(n_nodes):
+        if store.get("nodes", "default", f"n{i}") is None:
+            store.create("nodes", make_node(
+                f"n{i}", cpu="8", memory="16Gi",
+                labels={"kubernetes.io/hostname": f"n{i}",
+                        api.LABEL_ZONE: f"z{i % 3}"}))
+    for i in range(n_pods):
+        aff = None
+        labels = {"app": "w"}
+        if with_aff and i % 3 == 0:
+            # the anti-affinity label rides ONLY on the affinity pods
+            # (8 per group vs 16 nodes), so every batch stays feasible
+            labels = {"grp": f"{prefix}-g{i % 2}", "app": "w"}
+            aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required=[api.PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"grp": f"{prefix}-g{i % 2}"}),
+                    topology_key="kubernetes.io/hostname")]))
+        store.create("pods", make_pod(
+            f"{prefix}-p{i}", cpu="100m", memory="128Mi",
+            labels=labels, affinity=aff))
+
+
+def _bindings(store):
+    return sorted((p.metadata.name, p.spec.node_name)
+                  for p in store.list("pods"))
+
+
+def _clean_reference(batches, **world_kw):
+    """Single-device scheduler run over the same batch sequence — the
+    bit-equality oracle for every chaos scenario below."""
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=16)
+    out = []
+    for prefix in batches:
+        _make_world(store, prefix=prefix, **world_kw)
+        sched.schedule_pending()
+        out.append(_bindings(store))
+    sched.close()
+    return out
+
+
+# -- units --------------------------------------------------------------------
+
+
+def test_reform_mesh_ladder_counts():
+    devs = jax.devices()[:8]
+    assert reform_mesh(devs).devices.size == 8
+    m4 = reform_mesh(devs, exclude={str(devs[3])})
+    assert m4.devices.size == 4
+    assert str(devs[3]) not in {str(d) for d in m4.devices.flat}
+    assert reform_mesh(devs,
+                       exclude={str(d) for d in devs[:6]}).devices.size == 2
+    m1 = reform_mesh(devs, exclude={str(d) for d in devs[1:]})
+    assert m1.devices.size == 1
+    assert reform_mesh(devs, exclude={str(d) for d in devs}) is None
+    # the --mesh-min-devices floor
+    assert reform_mesh(devs, exclude={str(d) for d in devs[4:]},
+                       min_devices=8) is None
+    # reform keeps the LEADING survivors (deterministic membership)
+    assert [str(d) for d in m4.devices.flat] == [
+        str(d) for d in (devs[0], devs[1], devs[2], devs[4])]
+
+
+def test_manager_attribution_and_bisection():
+    devs = jax.devices()[:8]
+    clock = FakeClock()
+    mf = MeshFaultManager(devs, clock=clock, probe_cooldown=30.0)
+    # the exception names the device
+    assert mf.attribute(DeviceLost(str(devs[2]))) == str(devs[2])
+    # ...or its text embeds exactly one device id (XLA runtime errors)
+    assert mf.attribute(
+        RuntimeError(f"XLA:CPU failed on {devs[5]}")) == str(devs[5])
+    # silent/ambiguous errors are unattributed
+    assert mf.attribute(RuntimeError("wedged")) is None
+    # bisection: the trailing half goes under suspicion
+    suspects = mf.quarantine_suspects()
+    assert suspects == [str(d) for d in devs[4:]]
+    assert mf.healthy_names() == [str(d) for d in devs[:4]]
+    # probes come due only after the cooldown
+    assert mf.due_probes() == []
+    clock.advance(31.0)
+    assert [str(d) for d in mf.due_probes()] == suspects
+    # a failed probe restarts the cooldown; a passed one re-admits
+    mf.reprobe_later(suspects[0])
+    assert str(devs[4]) not in {str(d) for d in mf.due_probes()}
+    for n in suspects:
+        mf.readmit(n)
+    assert mf.healthy_names() == [str(d) for d in devs]
+
+
+def test_attribution_is_token_exact_not_substring():
+    """'TPU_1' inside 'TPU_10' is a DIFFERENT device's id: attribution
+    must treat names as exact tokens or big meshes (10+ devices) turn
+    unambiguous losses into 2-hit ambiguities (bisection)."""
+    from kubernetes_tpu.sched.breaker import device_name_hits
+
+    names = [f"TPU_{i}" for i in range(12)]
+    assert device_name_hits(names, "XLA failed on TPU_10 (slice 0)") == \
+        ["TPU_10"]
+    assert device_name_hits(names, "TPU_1 wedged") == ["TPU_1"]
+
+    class Fake:
+        def __str__(self):
+            return self.s
+
+    devs = []
+    for i in range(12):
+        d = Fake()
+        d.s = f"TPU_{i}"
+        devs.append(d)
+    mf = MeshFaultManager(devs)
+    assert mf.attribute(RuntimeError("device TPU_10 went away")) == "TPU_10"
+    assert mf.attribute(RuntimeError("TPU_1 and TPU_2 both sick")) is None
+
+
+def test_lost_device_fault_none_payload_is_noop():
+    """An unregistered dispatch (payload None — another scheduler
+    cleared the process-global device set) must NOT be killed: the fn
+    models a MESH device loss, and a reformed mesh must stay healthy."""
+    fn = lost_device_fault("TPU_3")
+    fn(None)  # no raise
+    fn(("TPU_0", "TPU_1"))  # victim absent: no raise
+    fn("TPU_0")  # innocent probe: no raise
+    with pytest.raises(DeviceLost):
+        fn(("TPU_0", "TPU_3"))
+    with pytest.raises(DeviceLost):
+        fn("TPU_3")
+
+
+def test_attributed_exception_cause_chain():
+    devs = jax.devices()[:8]
+    mf = MeshFaultManager(devs)
+    try:
+        try:
+            raise DeviceLost(str(devs[6]))
+        except DeviceLost as inner:
+            raise RuntimeError("wave failed") from inner
+    except RuntimeError as e:
+        assert mf.attribute(e) == str(devs[6])
+
+
+# -- the chaos proofs ---------------------------------------------------------
+
+
+def test_device_lost_mid_wave_salvages_reforms_and_stays_bit_equal():
+    """Kill 1 of 8 mid-wave: the round salvages through the twin, the
+    next round dispatches on a reformed 4-device mesh, the whole-path
+    breaker never opens, and placements stay bit-equal to a clean
+    single-device run."""
+    ref = _clean_reference(["b0", "b1"], with_aff=True)
+
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh)
+    victim = str(mesh.devices.flat[3])
+    _make_world(store, prefix="b0", with_aff=True)
+    faultpoints.activate("device.lost", "corrupt",
+                         fn=lost_device_fault(victim))
+    dev_waves0 = sched.metrics.waves_total.value(path="device")
+    assert sched.schedule_pending() == 48
+    # round 1 was salvaged through the twin...
+    assert _bindings(store) == ref[0]
+    assert sched.metrics.waves_total.value(path="host") >= 1
+    # ...after ONE downward reform, with the breaker still closed
+    assert sched.metrics.mesh_reforms.value(direction="down") == 1
+    assert sched.metrics.mesh_devices.value == 4
+    assert sched.breaker.state == breaker_mod.CLOSED
+    assert sched.metrics.device_quarantined.value(device=victim) == 1
+    assert int(sched.mesh.devices.size) == 4
+    assert victim not in {str(d) for d in sched.mesh.devices.flat}
+
+    # next batch: the DEVICE path serves it on the reformed mesh (the
+    # armed fault stays active — the victim is out of the payload now,
+    # so nothing raises: throughput degrades proportionally, not to 0)
+    _make_world(store, prefix="b1", with_aff=True)
+    assert sched.schedule_pending() == 48
+    assert _bindings(store) == ref[1]
+    assert sched.metrics.waves_total.value(path="device") > dev_waves0
+    assert sched.breaker.state == breaker_mod.CLOSED
+    # dispatch errors were attributed to the culprit device
+    assert sched.metrics.scheduling_errors.value(
+        stage="dispatch", device=victim) >= 1
+    sched.close()
+
+
+def test_device_lost_mid_gang_stays_atomic_and_bit_equal():
+    """Kill during the joint-assignment dispatch: the gang salvages
+    ATOMICALLY through the twin's all-or-nothing plane and later gangs
+    dispatch on the reformed mesh; placements match the clean run."""
+    def _gangs(store):
+        for i in range(8):
+            if store.get("nodes", "default", f"n{i}") is None:
+                store.create("nodes", make_node(
+                    f"n{i}", cpu="8", memory="16Gi",
+                    labels={"kubernetes.io/hostname": f"n{i}"}))
+        for g in range(3):
+            for j in range(4):
+                p = make_pod(f"gang{g}-{j}", cpu="1", memory="1Gi")
+                p.metadata.annotations = {
+                    "pod-group.scheduling.k8s.io/name": f"g{g}",
+                    "pod-group.scheduling.k8s.io/min-available": "4"}
+                store.create("pods", p)
+
+    store_ref = ObjectStore()
+    sref = Scheduler(store_ref, wave_size=16)
+    _gangs(store_ref)
+    assert sref.schedule_pending() == 12
+    sref.close()
+
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh)
+    victim = str(mesh.devices.flat[5])
+    _gangs(store)
+    faultpoints.activate("device.lost", "corrupt",
+                         fn=lost_device_fault(victim))
+    assert sched.schedule_pending() == 12
+    assert _bindings(store) == _bindings(store_ref)
+    # every gang placed whole (atomicity preserved through the salvage)
+    for g in range(3):
+        nodes = [store.get("pods", "default", f"gang{g}-{j}").spec.node_name
+                 for j in range(4)]
+        assert all(nodes)
+    assert sched.metrics.mesh_reforms.value(direction="down") == 1
+    assert sched.breaker.state == breaker_mod.CLOSED
+    sched.close()
+
+
+def test_device_lost_mid_preempt_chunk_salvages_through_twin():
+    """Kill during the batched preemption what-if dispatch: the chunk
+    salvages through the twin's stat planes, evictions still happen,
+    and the outcome matches the clean single-device run."""
+    def _preempt_world(store):
+        for i in range(8):
+            if store.get("nodes", "default", f"n{i}") is None:
+                store.create("nodes", make_node(
+                    f"n{i}", cpu="4", memory="8Gi",
+                    labels={"kubernetes.io/hostname": f"n{i}"}))
+
+    def _run(mesh, arm):
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8, mesh=mesh)
+        from kubernetes_tpu.utils.backoff import PodBackoff
+
+        sched.backoff = PodBackoff(initial=0.001)
+        _preempt_world(store)
+        for i in range(8):
+            store.create("pods", make_pod(f"hog-{i}", cpu="3500m",
+                                          priority=1))
+        assert sched.schedule_pending() == 8
+        if arm:
+            victim = str(mesh.devices.flat[2])
+            calls = {"n": 0}
+
+            def fn(payload):
+                # let the round program through; kill the NEXT dispatch
+                # (the preemption what-if) while the victim still serves
+                calls["n"] += 1
+                if calls["n"] >= 2 and (payload is None
+                                        or victim in payload):
+                    raise DeviceLost(victim)
+
+            faultpoints.activate("device.lost", "corrupt", fn=fn)
+        for i in range(4):
+            store.create("pods", make_pod(f"vip-{i}", cpu="3500m",
+                                          priority=100))
+        placed = 0
+        for _ in range(60):
+            placed += sched.schedule_pending()
+            if placed >= 4:
+                break
+            import time as _t
+
+            _t.sleep(0.005)
+        out = dict(
+            placed=placed,
+            evicted=int(sched.metrics.pod_preemption_victims.value),
+            pipeline=sched.pipeline_preemptions,
+            vips=sorted(p.spec.node_name for p in store.list("pods")
+                        if p.metadata.name.startswith("vip")))
+        reforms = sched.metrics.mesh_reforms.value(direction="down")
+        state = sched.breaker.state
+        sched.close()
+        faultpoints.reset()
+        return out, reforms, state
+
+    ref, _r, _s = _run(None, arm=False)
+    got, reforms, state = _run(make_mesh(8), arm=True)
+    assert got == ref
+    assert reforms >= 1  # the kill landed and reformed the mesh
+    assert state == breaker_mod.CLOSED  # no full breaker-open
+
+
+def test_heal_readmits_device_and_reforms_upward():
+    """Clock-driven recovery: after the victim heals, the probe
+    re-admits it and the mesh reforms UPWARD back to 8 — and placements
+    remain bit-equal to the clean run throughout."""
+    ref = _clean_reference(["b0", "b1", "b2"], n_pods=32)
+
+    clock = FakeClock()
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh, clock=clock,
+                      breaker_cooldown=30.0)
+    victim = str(mesh.devices.flat[1])
+    _make_world(store, prefix="b0", n_pods=32)
+    faultpoints.activate("device.lost", "corrupt",
+                         fn=lost_device_fault(victim))
+    assert sched.schedule_pending() == 32
+    assert _bindings(store) == ref[0]
+    assert sched.metrics.mesh_devices.value == 4
+
+    # still broken: cooldown elapses, the probe FAILS (fault armed),
+    # the device stays quarantined and the cooldown restarts
+    clock.advance(31.0)
+    _make_world(store, prefix="b1", n_pods=32)
+    assert sched.schedule_pending() == 32
+    assert _bindings(store) == ref[1]
+    assert sched.metrics.mesh_devices.value == 4
+    assert sched.meshfaults.quarantined_names() == [victim]
+
+    # healed: the fault clears, the next due probe re-admits, the mesh
+    # reforms upward, and the full 8 devices serve the next batch
+    faultpoints.deactivate("device.lost")
+    clock.advance(31.0)
+    _make_world(store, prefix="b2", n_pods=32)
+    assert sched.schedule_pending() == 32
+    assert _bindings(store) == ref[2]
+    assert sched.metrics.mesh_reforms.value(direction="up") == 1
+    assert sched.metrics.mesh_devices.value == 8
+    assert int(sched.mesh.devices.size) == 8
+    assert sched.meshfaults.quarantined_names() == []
+    # the quarantine gauge child was REMOVED, not frozen at 1
+    assert all(victim not in c.name for c in
+               sched.metrics.device_quarantined.children())
+    sched.close()
+
+
+def test_unattributed_failure_bisects_and_heals():
+    """A failure that names no device (plain FaultInjected) quarantines
+    the trailing half on suspicion; probes then re-admit the innocent
+    devices and the mesh reforms back up."""
+    clock = FakeClock()
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh, clock=clock)
+    _make_world(store, prefix="b0", n_pods=32)
+    faultpoints.activate("device.lost", "raise", times=1)  # unattributed
+    assert sched.schedule_pending() == 32
+    assert sched.metrics.mesh_devices.value == 4
+    assert len(sched.meshfaults.quarantined_names()) == 4
+    assert sched.breaker.state == breaker_mod.CLOSED
+    # all four suspects probe healthy after the cooldown -> back to 8
+    clock.advance(31.0)
+    _make_world(store, prefix="b1", n_pods=32)
+    assert sched.schedule_pending() == 32
+    assert sched.metrics.mesh_devices.value == 8
+    assert sched.meshfaults.quarantined_names() == []
+    assert sched.metrics.mesh_reforms.value(direction="up") >= 1
+    sched.close()
+
+
+def test_min_devices_floor_falls_through_to_breaker():
+    """--mesh-min-devices: below the floor no reform happens — the
+    failure feeds the whole-path breaker and the twin carries the
+    backlog (scheduling never stops)."""
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh,
+                      mesh_min_devices=8, breaker_threshold=1)
+    victim = str(mesh.devices.flat[0])
+    _make_world(store, prefix="b0", n_pods=32)
+    faultpoints.activate("device.lost", "corrupt",
+                         fn=lost_device_fault(victim))
+    assert sched.schedule_pending() == 32
+    # no reform (floor is 8): the breaker opened instead and the twin
+    # salvaged the round
+    assert sched.metrics.mesh_reforms.value(direction="down") == 0
+    assert sched.breaker.state == breaker_mod.OPEN
+    assert sched.metrics.waves_total.value(path="host") >= 1
+    # the culprit is still quarantined for the probe cycle
+    assert sched.meshfaults.quarantined_names() == [victim]
+    sched.close()
+
+
+def test_reform_fault_point_fails_the_reform():
+    """mesh.reform armed `raise`: the reform itself fails, the failure
+    falls through to the breaker path, and scheduling still completes
+    through the twin."""
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh, breaker_threshold=1)
+    victim = str(mesh.devices.flat[2])
+    _make_world(store, prefix="b0", n_pods=32)
+    faultpoints.activate("device.lost", "corrupt",
+                         fn=lost_device_fault(victim))
+    faultpoints.activate("mesh.reform", "raise")
+    assert sched.schedule_pending() == 32
+    assert faultpoints.hits("mesh.reform") == 1
+    assert sched.metrics.mesh_reforms.value(direction="down") == 0
+    assert sched.breaker.state == breaker_mod.OPEN
+    sched.close()
+
+
+def test_round_ledger_carries_the_mesh_record():
+    """The round ledger's `mesh` record ({devices, reforms,
+    quarantined}) makes the ladder visible per round."""
+    from kubernetes_tpu.utils import tracing
+
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh)
+    victim = str(mesh.devices.flat[3])
+    rec = tracing.enable()
+    try:
+        _make_world(store, prefix="b0", n_pods=32)
+        faultpoints.activate("device.lost", "corrupt",
+                             fn=lost_device_fault(victim))
+        assert sched.schedule_pending() == 32
+        rows = rec.ledger_rows()
+        mesh_rows = [r["mesh"] for r in rows if "mesh" in r]
+        assert mesh_rows, f"no mesh record in ledger: {rows}"
+        # the failed round recorded the post-reform state; the salvage
+        # round repeats it
+        last = mesh_rows[-1]
+        assert last["devices"] == 4
+        assert last["reforms"] == 1
+        assert last["quarantined"] == [victim]
+    finally:
+        tracing.disable()
+        sched.close()
+
+
+def test_full_ladder_walk_down_to_one_device():
+    """Sequential losses walk the whole ladder: 8 -> 4 -> 2 -> 1, each
+    rung serving traffic bit-equal to the clean run; exhausting the
+    last device finally opens the breaker (host-twin rung)."""
+    batches = ["b0", "b1", "b2", "b3"]
+    ref = _clean_reference(batches, n_pods=24)
+
+    store = ObjectStore()
+    mesh = make_mesh(8)
+    sched = Scheduler(store, wave_size=16, mesh=mesh)
+    devs = [str(d) for d in mesh.devices.flat]
+    expected_sizes = []
+    # each batch: pre-quarantine some devices by hand, then arm ONE
+    # armed loss on a still-serving device — its failure triggers the
+    # reform against the accumulated quarantine set, forcing a strictly
+    # smaller rung: 8 -> 4 -> 2 -> 1 (then 1 keeps serving)
+    kill_plan = [
+        ([], devs[3]),                       # 7 healthy -> rung 4
+        ([devs[0], devs[1], devs[2]], devs[4]),  # 3 healthy -> rung 2
+        ([devs[5]], devs[6]),                # 1 healthy  -> rung 1
+        ([], None),                          # steady state on 1 device
+    ]
+    for prefix, (manual, armed) in zip(batches, kill_plan):
+        for victim in manual:
+            sched.meshfaults.quarantine(victim)
+        if armed is not None:
+            faultpoints.activate("device.lost", "corrupt",
+                                 fn=lost_device_fault(armed))
+        _make_world(store, prefix=prefix, n_pods=24)
+        assert sched.schedule_pending() == 24
+        faultpoints.deactivate("device.lost")
+        assert _bindings(store) == ref[len(expected_sizes)]
+        expected_sizes.append(int(sched.metrics.mesh_devices.value))
+    assert expected_sizes == [4, 2, 1, 1]
+    assert sched.breaker.state == breaker_mod.CLOSED
+    sched.close()
+
+
+def test_reform_lock_edge_is_in_the_static_graph():
+    """ktpu-lint's lock-discipline graph covers the reform path: the
+    scheduler quarantines/reforms under _mu, so the static graph must
+    carry the Scheduler._mu -> MeshFaultManager._lock edge (and no
+    inversion)."""
+    from kubernetes_tpu.analysis.lockgraph import static_lock_graph
+
+    edges = static_lock_graph()
+    assert ("Scheduler._mu", "MeshFaultManager._lock") in edges
+    assert ("MeshFaultManager._lock", "Scheduler._mu") not in edges
