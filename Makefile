@@ -45,14 +45,17 @@ verify: lint test
 # parity)
 # + the `meshfault` mesh fault-tolerance suite (device-loss detection,
 # quarantine/probe bisection, the 8->4->2->1->heal reform ladder with
-# twin-salvage placement parity).
+# twin-salvage placement parity)
+# + the `poison` poison-work isolation suite (input-fault attribution
+# vs device faults, wave bisection, pod quarantine/re-probe, the
+# kernel's numeric-integrity sentinels).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

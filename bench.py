@@ -944,11 +944,20 @@ def _p99(samples):
 
 
 def run_storm_config(nodes, wave, trace="burst", mesh=None,
-                     kill_device=None):
+                     kill_device=None, poison_frac=0.0):
     """Replay one synthetic arrival trace through a HollowCluster with
     the overload-control plane armed (shed watermark 2 waves, 1s shed
     aging) and gate the run on per-class SLOs. Returns the gate report;
-    violations FAIL the bench."""
+    violations FAIL the bench.
+
+    poison_frac > 0 is the `poisonstorm` leg: that fraction of the
+    low-class arrivals carry a genuinely malformed spec (NaN cpu
+    request — the input-fault class the poison-isolation plane exists
+    for). The SLO gates for the CLEAN classes are IDENTICAL to the
+    plain storm's, and three poison gates are added: every poison pod
+    convicted (never placed), ZERO device-path breaker trips, and zero
+    mesh reforms — bad work must cost the bad pods, not the device
+    plane or the protected classes."""
     import time as _t
 
     from kubernetes_tpu.api import types as api
@@ -1047,6 +1056,12 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None,
     bound_seen = {}
     severed = []
     seq = [0]
+    # poisonstorm bookkeeping: poison pods are tracked SEPARATELY from
+    # `created` — they can never place, so the starvation/drain gates
+    # must not wait on them; their own gate is conviction
+    poison_uids = {}
+    low_seen = [0]
+    poison_every = int(round(1.0 / poison_frac)) if poison_frac > 0 else 0
 
     def _arrive(cls, count):
         for _ in range(count):
@@ -1056,8 +1071,21 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None,
                 # high single can only place by evicting gang members
                 p.spec.containers[0].resources.requests["cpu"] = 4000
             seq[0] += 1
+            poisoned = False
+            if poison_every and cls == "low":
+                low_seen[0] += 1
+                if low_seen[0] % poison_every == 0:
+                    # a genuinely malformed spec (the canonical-map
+                    # constructors reject NaN, so this models a
+                    # corrupted object reaching the scheduler)
+                    p.spec.containers[0].resources.requests["cpu"] = \
+                        float("nan")
+                    poisoned = True
             store.create("pods", p)
-            created[p.uid] = (cls, _t.time())
+            if poisoned:
+                poison_uids[p.uid] = None
+            else:
+                created[p.uid] = (cls, _t.time())
 
     def _account():
         now = _t.time()
@@ -1169,12 +1197,47 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None,
             nb = sum(1 for p in members if p.spec.node_name)
             if nb not in (0, 8):
                 failures.append(f"gang {g} partially placed ({nb}/8)")
+    if poison_uids:
+        # the poisonstorm gates: every poison pod convicted and never
+        # placed, and the device plane never blamed for bad work —
+        # breaker trips and mesh reforms both pinned at zero.
+        # Conviction is gated PER POD (the Poisoned condition each
+        # conviction stamps), not on the cumulative counter — one pod
+        # re-convicted twice must not cover for another that escaped
+        # the isolation plane entirely
+        bound_poison = 0
+        unconvicted = dict(poison_uids)
+        for p in store.list("pods"):
+            if p.uid not in poison_uids:
+                continue
+            if p.spec.node_name:
+                bound_poison += 1
+            if any("poisoned" in c[1] for c in p.status.conditions
+                   if c[0] == "PodScheduled"):
+                unconvicted.pop(p.uid, None)
+        if bound_poison:
+            failures.append(f"{bound_poison} poison pods were PLACED")
+        if unconvicted:
+            failures.append(
+                f"{len(unconvicted)} of {len(poison_uids)} poison pods "
+                f"were never convicted")
+        if sched.breaker.trips:
+            failures.append(
+                f"poison work tripped the device-path breaker "
+                f"{sched.breaker.trips}x (gate: 0)")
+        if int(m.mesh_reforms.total()):
+            failures.append("poison work reformed the mesh (gate: 0)")
     detail = " ".join(
         f"{c}:p99={_p99(latency[c])*1e3:.0f}ms/shed={sheds[c]}"
         for c in ("system", "high", "normal", "low"))
+    poison_note = (f" poison={len(poison_uids)} "
+                   f"convictions={sched.poison_convictions} "
+                   f"quarantined={sched.queue.quarantine_count()}"
+                   if poison_uids else "")
     print(f"# storm[{trace}]: arrivals={len(created)} placed={placed} "
           f"wall={dt:.2f}s {detail} "
-          f"evicted={evicted_seen if compound else 0}", file=sys.stderr)
+          f"evicted={evicted_seen if compound else 0}{poison_note}",
+          file=sys.stderr)
     for f in failures:
         print(f"FATAL: storm[{trace}]: {f}", file=sys.stderr)
     if failures:
@@ -1294,6 +1357,14 @@ SUITE = [
     # inside the 5s STORM_SLO_P99 gate either way); wider waves on CPU
     # would spend the SLO gate on wave cost, not storm behavior
     ("storm", 100, 0, "storm", ["--trace", "burst", "--wave", "64"]),
+    # poison-work isolation under load: the same burst trace with 1% of
+    # the low-class arrivals carrying malformed (NaN request) specs.
+    # Gates: the CLEAN classes hold the identical storm SLOs (a poison
+    # pod must not cost its wavemates), every poison pod is convicted
+    # and quarantined, and the device plane is never blamed — breaker
+    # trips and mesh reforms both pinned at ZERO
+    ("poisonstorm", 100, 0, "storm", ["--trace", "burst", "--wave", "64",
+                                      "--poison", "0.01"]),
     ("mixed5k", 5000, 30000, "mixed", []),
     # fleet scale: 50k nodes / 200k pod churn under the mesh-sharded
     # scheduling plane (--mesh auto shards the node axis across every
@@ -1436,6 +1507,13 @@ def main():
                          "— the round salvages through the twin and the "
                          "mesh reforms down one rung (requires --mesh); "
                          "the JSON line gains a `mesh` ladder summary")
+    ap.add_argument("--poison", type=float, default=0.0, metavar="FRAC",
+                    help="storm workload: poison this fraction of the "
+                         "low-class arrivals with a malformed (NaN "
+                         "request) spec — the poisonstorm leg; gates "
+                         "add every-poison-convicted + zero breaker "
+                         "trips + zero mesh reforms on top of the "
+                         "plain storm's clean-class SLOs")
     ap.add_argument("--host-preempt", action="store_true",
                     help="preempt workload: run the batched what-if on "
                          "the vectorized numpy host twin instead of the "
@@ -1539,7 +1617,8 @@ def main():
         trace = args.trace or "burst"
         placed, dt, high_p99, arrivals = run_storm_config(
             args.nodes, args.wave, trace=trace,
-            mesh=_resolve_mesh(args.mesh), kill_device=args.kill_device)
+            mesh=_resolve_mesh(args.mesh), kill_device=args.kill_device,
+            poison_frac=args.poison)
         name = args.name or "storm"
         rec = {
             # the headline is the high-class p99 against its SLO gate —

@@ -43,6 +43,10 @@ class GangResult(NamedTuple):
     fail_counts: jnp.ndarray  # i32 [Q, G]  first-fail per predicate
     masks: jnp.ndarray  # bool [Q, G, N]  per-predicate pass masks
     rr_end: jnp.ndarray  # i32  round-robin counter (rr_start unless ok)
+    # numeric-integrity sentinel per member (ops/kernel.py
+    # WaveResult.finite): one poisoned member discards and quarantines
+    # the whole gang — atomicity extends to conviction
+    finite: jnp.ndarray = None  # bool [G]
 
 
 def schedule_gang(*args, **kw):
@@ -94,4 +98,4 @@ def _schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
     rr_end = jnp.where(ok, res.rr_end, jnp.asarray(rr_start, jnp.int32))
     return GangResult(ok=ok, chosen=chosen, placed=placed,
                       fail_counts=res.fail_counts, masks=res.masks,
-                      rr_end=rr_end)
+                      rr_end=rr_end, finite=res.finite)

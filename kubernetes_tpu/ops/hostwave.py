@@ -783,9 +783,15 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     deco = (ScoreDeco(chosen_parts=d_cparts, top_idx=d_tidx,
                       top_vals=d_tvals, top_parts=d_tparts)
             if collect_scores else None)
+    # numeric-integrity sentinel, bitwise with the device kernel
+    # (ops/kernel.py WaveResult.finite): the pod's own inputs plus its
+    # winning score — np.max propagates NaN exactly like jnp.max
+    finite = (np.all(np.isfinite(pb.req), axis=1)
+              & np.all(np.isfinite(pb.nonzero), axis=1)
+              & np.isfinite(best_s))
     res = WaveResult(chosen=chosen, score=best_s, feasible_count=feas_cnt,
                      fail_counts=fail_counts, masks=masks,
-                     rr_end=np.int32(rr), deco=deco)
+                     rr_end=np.int32(rr), deco=deco, finite=finite)
     return res, (req_c, nz_c, cnt_c)
 
 
@@ -811,7 +817,7 @@ def schedule_gang_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     rr_end = res.rr_end if ok else np.int32(rr_start)
     return GangResult(ok=np.bool_(ok), chosen=chosen,
                       placed=np.int32(placed), fail_counts=res.fail_counts,
-                      masks=res.masks, rr_end=rr_end)
+                      masks=res.masks, rr_end=rr_end, finite=res.finite)
 
 
 # -- cluster-state telemetry (ops/telemetry.py twin) --------------------------

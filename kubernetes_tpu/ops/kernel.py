@@ -94,6 +94,15 @@ class WaveResult(NamedTuple):
     # None otherwise — the compiled program is then byte-identical to
     # the pre-observatory kernel)
     deco: Optional[ScoreDeco] = None
+    # numeric-integrity sentinel: bool [P] — False where the pod's own
+    # inputs (req/nonzero) or its winning score are non-finite. A NaN
+    # req poisons the scan's usage carry through `preq * 0.0` even for
+    # an unplaced pod, silently shifting every LATER pod's placement —
+    # the host must discard the whole round and quarantine the flagged
+    # pods (sched/scheduler.py poison-work isolation). Computed inside
+    # the same program and fetched alongside `chosen`: zero extra
+    # dispatch. The hostwave twin mirrors it bitwise.
+    finite: Optional[jnp.ndarray] = None
 
 
 # -- device telemetry --------------------------------------------------------
@@ -541,9 +550,17 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         [jnp.ones((1,) + masks.shape[1:], bool), prefix_ok[:-1]], axis=0)
     first_fail = ~masks & first & nt.valid[None, None, :]
     fail_counts = jnp.sum(first_fail.astype(jnp.int32), axis=-1)  # [Q, P]
+    # numeric-integrity sentinel (see WaveResult.finite): per-pod, over
+    # the pod's OWN inputs plus its winning score — a NaN injected via
+    # extra_scores surfaces through jnp.max's NaN propagation in `best`,
+    # while input NaN names the culprit directly even when the pod never
+    # placed. Pad rows carry zeroed inputs and best == -1: always finite.
+    finite = (jnp.all(jnp.isfinite(pb.req), axis=1)
+              & jnp.all(jnp.isfinite(pb.nonzero), axis=1)
+              & jnp.isfinite(best))
     res = WaveResult(chosen=chosen, score=best, feasible_count=feas_cnt,
                      fail_counts=fail_counts, masks=masks, rr_end=rr_end,
-                     deco=deco)
+                     deco=deco, finite=finite)
     return res, (req_end, nz_end, cnt_end)
 
 
@@ -673,8 +690,10 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
     lax.scan on Mosaic; hoisting sidesteps that and amortizes the
     launch), then threaded through the scan as per-wave xs slices.
     Returns (chosen [W, P], fail_counts [W, Q, P], usage', rr_end,
-    deco) — deco a ScoreDeco of [W, P, ...] planes when collect_scores,
-    None otherwise (the compiled program is then unchanged)."""
+    deco, finite) — deco a ScoreDeco of [W, P, ...] planes when
+    collect_scores, None otherwise (the compiled program is then
+    unchanged); finite the [W, P] numeric-integrity sentinel
+    (WaveResult.finite semantics, pad waves all-True)."""
     W = pbs.req.shape[0]
     P = pbs.req.shape[1]
     N = nt.valid.shape[0]
@@ -697,6 +716,7 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
         out = (res.chosen, res.fail_counts)
         if collect_scores:
             out = out + tuple(res.deco)
+        out = out + (res.finite,)
         return (pm_o, tt_o, usage_o, res.rr_end), out
 
     def padded_wave(carry, x):
@@ -713,6 +733,8 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
                          jnp.zeros((P, KK), jnp.int32),
                          jnp.full((P, KK), -1.0, jnp.float32),
                          jnp.zeros((P, S, KK), jnp.float32))
+        # pad waves schedule nothing: their sentinel rows are clean
+        out = out + (jnp.ones((P,), bool),)
         return carry, out
 
     active = jnp.any(pbs.valid, axis=1)  # [W]
@@ -760,12 +782,15 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
     carry0 = (pm, tt, usage, jnp.asarray(rr_start, jnp.int32))
     (_, _, usage_end, rr_end), outs = lax.scan(wave, carry0, xs)
     if collect_scores:
-        chosen, fail_counts, cparts, tidx, tvals, tparts = outs
+        chosen, fail_counts, cparts, tidx, tvals, tparts, finite = outs
         deco = ScoreDeco(chosen_parts=cparts, top_idx=tidx,
                          top_vals=tvals, top_parts=tparts)
     else:
-        chosen, fail_counts = outs
+        chosen, fail_counts, finite = outs
         deco = None
-    return chosen, fail_counts, usage_end, rr_end, deco
+    # finite [W, P]: the per-wave numeric-integrity sentinel planes ride
+    # out with the chosen planes — the host checks them in the SAME
+    # fetch and discards any round a poison pod contaminated
+    return chosen, fail_counts, usage_end, rr_end, deco, finite
 
 

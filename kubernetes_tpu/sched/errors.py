@@ -37,6 +37,12 @@ REASONS = {
     # scan could not place minMember pods simultaneously. Deliberately
     # NOT in UNRESOLVABLE — evicting victims can free gang capacity.
     "Gang": "pod group could not be placed in full",
+    # poison-work isolation (forward-port of 1.11's per-pod predicate
+    # error returns to the batched plane): the pod's spec crashed or
+    # numerically poisoned the shared Filter+Score pass and the pod was
+    # quarantined — the reason on its FitError-style condition/event.
+    "Poisoned": "pod spec poisoned the batched scheduling pass "
+                "(quarantined)",
 }
 
 # Failure reasons preemption cannot resolve by evicting pods — EXACTLY the
@@ -73,6 +79,18 @@ REASON_KEYS = {v: k for k, v in REASONS.items()}
 def insufficient_resource_reason(resource: str) -> str:
     """Reference: predicates.go NewInsufficientResourceError .GetReason()."""
     return f"Insufficient {resource}"
+
+
+class PoisonError(Exception):
+    """Input-fault verdict for a failed batched pass: the WORK is bad,
+    not the runtime (the numpy twin reproduced the failure, or the
+    numeric-integrity sentinel flagged non-finite planes). `uids` names
+    the convicted pods when attribution is direct; empty means the
+    culprit is unknown and the caller must bisect the wave."""
+
+    def __init__(self, message: str, uids=()):
+        super().__init__(message)
+        self.uids = tuple(uids)
 
 
 @dataclass

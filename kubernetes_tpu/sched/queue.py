@@ -60,6 +60,25 @@ would deadlock the gang against its own queue).
 The `queue.shed` fault point (drop mode) forces the shed decision for
 every sheddable pod regardless of watermark — the chaos rig for
 storm-survival tests that want shedding without a real 5x backlog.
+
+Poison-work quarantine (sched/scheduler.py input-fault isolation): pods
+CONVICTED of poisoning the batched scheduling pass — a spec that
+crashes the featurizer, non-finite planes the kernel sentinel flagged,
+or a wave-bisection verdict — park in a QUARANTINE area, separate from
+every other area and deliberately immune to event-driven flushes
+(move_all_to_active must never feed a known-poison pod back into the
+shared wave). Each entry carries a re-probe deadline (the scheduler's
+capped poison backoff): past it the pod re-enters the active heap for
+one fresh attempt — still poisoned, it re-convicts with a doubled
+deadline; fixed, it places and the ladder clears. A genuine SPEC EDIT
+releases the pod immediately (the operator fixed it; waiting out the
+old deadline would punish the fix). The area exports as
+scheduler_pending_pods{queue=quarantine} and the 1.11 analog is the
+unschedulable map — see PARITY.md.
+
+The `queue.quarantine` fault point (drop mode) refuses quarantine
+admissions — a lost conviction; the scheduler then falls back to a
+plain backoff park.
 """
 
 from __future__ import annotations
@@ -137,6 +156,11 @@ class SchedulingQueue:
         # fired (class_name) on every shed decision — feeds
         # scheduler_shed_total{class}
         self.on_shed: Optional[Callable[[str], None]] = None
+        # poison-work quarantine (module docstring "Poison-work
+        # quarantine"): uid -> pod convicted by the scheduler's
+        # input-fault isolation plane, uid -> re-probe deadline
+        self._quarantine: Dict[str, api.Pod] = {}
+        self._quarantine_until: Dict[str, float] = {}
         self._lock = threading.Condition()
         self._heap: List = []  # (-priority, seq, uid)
         self._items: Dict[str, api.Pod] = {}  # uid -> pod (active)
@@ -175,17 +199,21 @@ class SchedulingQueue:
     # -- overload control (priority-aware shedding) ---------------------------
 
     def _depth_locked(self) -> int:
-        """Total pending depth across every area incl. shed — the
-        number an operator's backlog dashboard sums."""
+        """Total pending depth across every area incl. shed and
+        quarantine — the number an operator's backlog dashboard sums."""
         return (len(self._items) + len(self._unschedulable)
                 + len(self._backoff) + len(self._shed)
+                + len(self._quarantine)
                 + sum(len(w) for w in self._gang_waiting.values()))
 
     def _working_depth_locked(self) -> int:
         """Depth the scheduler actually works: everything pending MINUS
-        the shed area. This is what the watermark bounds — shedding
-        exists precisely so this number stops tracking offered load."""
-        return self._depth_locked() - len(self._shed)
+        the shed and quarantine areas. This is what the watermark
+        bounds — shedding exists precisely so this number stops
+        tracking offered load, and quarantined pods are not schedulable
+        work until their re-probe deadline."""
+        return (self._depth_locked() - len(self._shed)
+                - len(self._quarantine))
 
     def _should_shed_locked(self, pod: api.Pod) -> bool:
         """Shed decision for one arriving/flushed pod: only
@@ -270,13 +298,111 @@ class SchedulingQueue:
         counts = {c: 0 for c in QUEUE_CLASSES}
         with self._lock:
             for area in (self._items, self._unschedulable, self._backoff,
-                         self._shed):
+                         self._shed, self._quarantine):
                 for pod in area.values():
                     counts[pod_class(api.pod_priority(pod))] += 1
             for waiting in self._gang_waiting.values():
                 for pod in waiting.values():
                     counts[pod_class(api.pod_priority(pod))] += 1
         return counts
+
+    # -- poison-work quarantine ------------------------------------------------
+
+    def quarantine(self, pod: api.Pod, until: float) -> bool:
+        """Park one CONVICTED pod in the quarantine area until its
+        re-probe deadline. Removes it from every other pending area;
+        gang membership is kept (a quarantined gang re-probes and
+        re-forms as a unit). False when the `queue.quarantine` fault
+        point dropped the admission (a lost conviction — the caller
+        falls back to a plain backoff park)."""
+        if faultpoints.fire("queue.quarantine", payload=pod):
+            return False
+        with self._lock:
+            self._items.pop(pod.uid, None)
+            self._unschedulable.pop(pod.uid, None)
+            self._backoff.pop(pod.uid, None)
+            self._shed.pop(pod.uid, None)
+            self._shed_at.pop(pod.uid, None)
+            self._shed_exempt.pop(pod.uid, None)
+            key = self._gang_of.get(pod.uid)
+            if key is not None:
+                waiting = self._gang_waiting.get(key)
+                if waiting is not None:
+                    waiting.pop(pod.uid, None)
+                    if not waiting:
+                        del self._gang_waiting[key]
+                        self._gang_wait_start.pop(key, None)
+            self._quarantine[pod.uid] = pod
+            self._quarantine_until[pod.uid] = until
+            # first-enqueue time survives conviction: e2e latency counts
+            # quarantine time for a pod that eventually recovers
+            self.added_at.setdefault(pod.uid, self.clock())
+            # a blocked popper computed its wait bound before this
+            # deadline existed — wake it so the bound is recomputed
+            self._lock.notify()
+        return True
+
+    def _flush_quarantine_locked(self):
+        """Re-probe release: quarantined pods past their deadline get
+        one fresh pass through the active heap. Still poisoned, the
+        scheduler re-convicts with a doubled (capped) deadline; fixed,
+        the pod places and its ladder clears — never starved, never
+        permanently wedging the wave either. Gang-ATOMIC like
+        conviction and the spec-edit release: a due member brings its
+        quarantined mates with it (per-uid ladders can diverge, and a
+        partial release would ride waves as a sub-minMember fragment
+        failing gang admission until the last ladder expired)."""
+        if not self._quarantine:
+            return
+        now = self.clock()
+        due = [uid for uid, t in self._quarantine_until.items()
+               if t <= now]
+        released = False
+        for uid in due:
+            pod = self._quarantine.pop(uid, None)
+            if pod is None:
+                continue  # already released as a due mate's gangmate
+            self._quarantine_until.pop(uid, None)
+            self._items[uid] = pod
+            heapq.heappush(self._heap, self._key(pod))
+            released = True
+            key = self._gang_of.get(uid)
+            if key is None:
+                continue
+            for muid in self._gang_members.get(key, ()):
+                mate = self._quarantine.pop(muid, None)
+                if mate is not None:
+                    self._quarantine_until.pop(muid, None)
+                    self._items[muid] = mate
+                    heapq.heappush(self._heap, self._key(mate))
+        if released:
+            self._lock.notify_all()
+
+    def quarantine_count(self) -> int:
+        with self._lock:
+            return len(self._quarantine)
+
+    def quarantined_pods(self) -> List[api.Pod]:
+        with self._lock:
+            return list(self._quarantine.values())
+
+    def gang_pending_pods(self, key: str) -> List[api.Pod]:
+        """Every member of gang `key` currently held in a pending area
+        (active/backoff/unschedulable/shed/gang-waiting) — the
+        conviction plane quarantines them ATOMICALLY with a poisoned
+        member (a sub-minMember remnant would wedge against its own
+        gang's admission gate forever)."""
+        out: List[api.Pod] = []
+        with self._lock:
+            waiting = self._gang_waiting.get(key, {})
+            for uid in self._gang_members.get(key, ()):
+                for area in (self._items, self._backoff,
+                             self._unschedulable, self._shed, waiting):
+                    p = area.get(uid)
+                    if p is not None:
+                        out.append(p)
+                        break
+        return out
 
     # -- add / pop -----------------------------------------------------------
 
@@ -287,7 +413,8 @@ class SchedulingQueue:
     def add(self, pod: api.Pod):
         released = None
         with self._lock:
-            if pod.uid in self._items or pod.uid in self._shed:
+            if (pod.uid in self._items or pod.uid in self._shed
+                    or pod.uid in self._quarantine):
                 return
             self._unschedulable.pop(pod.uid, None)
             self._backoff.pop(pod.uid, None)
@@ -395,6 +522,7 @@ class SchedulingQueue:
         with self._lock:
             if (pod.uid in self._items or pod.uid in self._unschedulable
                     or pod.uid in self._backoff or pod.uid in self._shed
+                    or pod.uid in self._quarantine
                     or self._gang_waiting_has_locked(pod.uid)):
                 return
         self.add(pod)
@@ -419,6 +547,7 @@ class SchedulingQueue:
         with self._lock:
             if (pod.uid in self._items or pod.uid in self._unschedulable
                     or pod.uid in self._backoff or pod.uid in self._shed
+                    or pod.uid in self._quarantine
                     or self._gang_waiting_has_locked(pod.uid)):
                 return
             cycle = self._cycle.pop(pod.uid, self._current_cycle)
@@ -466,6 +595,7 @@ class SchedulingQueue:
             while True:
                 self._flush_backoff_locked()
                 self._flush_shed_locked()
+                self._flush_quarantine_locked()
                 if self._heap or self._closed:
                     break
                 wait = None
@@ -487,6 +617,13 @@ class SchedulingQueue:
                            - self.clock())
                     if nxt <= 0:
                         continue  # aged while computing: reflush
+                    wait = nxt if wait is None else min(wait, nxt)
+                if self._quarantine:
+                    # quarantine re-probe deadlines bound the wait too
+                    nxt = (min(self._quarantine_until.values())
+                           - self.clock())
+                    if nxt <= 0:
+                        continue  # due while computing: reflush
                     wait = nxt if wait is None else min(wait, nxt)
                 self._lock.wait(wait)
             if self._closed and not self._heap:
@@ -596,8 +733,56 @@ class SchedulingQueue:
 
         return strip(old) != strip(new)
 
+    @staticmethod
+    def _spec_edited(old: api.Pod, new: api.Pod) -> bool:
+        """NaN-tolerant flavor of _is_pod_updated for the quarantine
+        release test. The poison class this area exists for is OFTEN a
+        NaN resource quantity — and NaN != NaN after the store's
+        deepcopy, so plain dataclass equality reads every STATUS-ONLY
+        write (the conviction's own condition update!) as a spec edit
+        and releases the pod right back into the wave. Fall back to a
+        repr comparison, under which NaN is stable."""
+        import dataclasses
+
+        def strip(p: api.Pod):
+            meta = dataclasses.replace(p.metadata, resource_version=0)
+            return (meta, p.spec)
+
+        a, b = strip(old), strip(new)
+        if a == b:
+            return False
+        return repr(a) != repr(b)
+
     def update(self, old: Optional[api.Pod], new: api.Pod):
         with self._lock:
+            if new.uid in self._quarantine:
+                if old is not None and self._spec_edited(old, new):
+                    # a genuine SPEC edit releases a convicted pod
+                    # immediately for a fresh attempt — the fix is the
+                    # recovery path, and waiting out the old re-probe
+                    # deadline would punish it; a re-poisoned edit just
+                    # re-convicts with the (capped) escalated backoff
+                    self._quarantine.pop(new.uid)
+                    self._quarantine_until.pop(new.uid, None)
+                    self._items[new.uid] = new
+                    heapq.heappush(self._heap, self._key(new))
+                    # conviction was gang-ATOMIC, so release is too:
+                    # the fixed member's quarantined mates come back
+                    # with it, or it would ride waves as a sub-minMember
+                    # fragment until their own deadlines expired
+                    key = self._gang_of.get(new.uid)
+                    if key is not None:
+                        for uid in self._gang_members.get(key, ()):
+                            mate = self._quarantine.pop(uid, None)
+                            if mate is not None:
+                                self._quarantine_until.pop(uid, None)
+                                self._items[uid] = mate
+                                heapq.heappush(self._heap,
+                                               self._key(mate))
+                    self._lock.notify()
+                else:
+                    self._quarantine[new.uid] = new  # status-only change
+                return
             if new.uid in self._items:
                 self._items[new.uid] = new
                 return
@@ -636,6 +821,8 @@ class SchedulingQueue:
             self._shed.pop(uid, None)
             self._shed_at.pop(uid, None)
             self._shed_exempt.pop(uid, None)
+            self._quarantine.pop(uid, None)
+            self._quarantine_until.pop(uid, None)
 
     def delete(self, pod: api.Pod):
         with self._lock:
@@ -646,6 +833,8 @@ class SchedulingQueue:
             self._shed.pop(pod.uid, None)
             self._shed_at.pop(pod.uid, None)
             self._shed_exempt.pop(pod.uid, None)
+            self._quarantine.pop(pod.uid, None)
+            self._quarantine_until.pop(pod.uid, None)
             self.added_at.pop(pod.uid, None)
             # gang accounting must shrink with the member, or a stale uid
             # would open the gate early and place a sub-minMember gang;
@@ -694,6 +883,7 @@ class SchedulingQueue:
         with self._lock:
             self._flush_backoff_locked()
             self._flush_shed_locked()
+            self._flush_quarantine_locked()
             return len(self._items)
 
     def backoff_count(self) -> int:

@@ -42,7 +42,7 @@ from ..plugins.registry import Profile, default_profile
 from ..runtime.informer import SharedInformer
 from ..runtime.store import ObjectStore
 from ..state.cache import SchedulerCache
-from ..state.featurize import PodFeaturizer
+from ..state.featurize import PodFeaturizeError, PodFeaturizer
 from ..state.scrubber import SnapshotScrubber
 from ..state.snapshot import Snapshot
 from ..utils import (Metrics, PodBackoff, Trace, bounded_label, faultpoints,
@@ -52,7 +52,8 @@ from ..utils.feature_gates import FeatureGates
 from . import breaker as breaker_mod
 from .breaker import STATE_CODES, DevicePathBreaker
 from .equivalence import EquivalenceCache, equivalence_class
-from .errors import REASON_KEYS, REASONS, FitError, insufficient_resource_reason
+from .errors import (REASON_KEYS, REASONS, FitError, PoisonError,
+                     insufficient_resource_reason)
 from .extender import ExtenderError
 from .gang import GangDirectory
 from .preemption import (GangGuard, PreemptionResult,
@@ -166,7 +167,8 @@ class Scheduler:
                  shed_age_s: float = 30.0,
                  wave_deadline_s: float = 0.0,
                  shadow_exact_interval: int = 0,
-                 mesh_min_devices: int = 1):
+                 mesh_min_devices: int = 1,
+                 poison_backoff_s: float = 5.0):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -246,6 +248,20 @@ class Scheduler:
         self.queue.on_gang_released = self._gang_released
         self.backoff = PodBackoff(clock=clock)
         self._next_backoff_gc = 0.0
+        # poison-work isolation: capped re-probe backoff for CONVICTED
+        # pods (sched/queue.py quarantine area). Deliberately separate
+        # from the scheduling backoff: a poison conviction is a
+        # different fault class (the spec needs an EDIT, not a cluster
+        # event), its ladder starts higher and caps far higher, and it
+        # only clears on a successful bind or pod deletion.
+        self.poison_backoff = PodBackoff(
+            initial=max(float(poison_backoff_s), 0.001),
+            maximum=max(float(poison_backoff_s), 0.001) * 64,
+            clock=clock)
+        # cumulative convictions — schedule_pending treats a conviction
+        # as progress (the survivors re-run the pipeline), and tests /
+        # bench assert on it
+        self.poison_convictions = 0
         # snapshot scrubber (state/scrubber.py): audits the HBM mirror
         # against the host cache on SIGUSR2 / the periodic cadence and
         # repairs divergent rows in place. Shares _mu so a scrub can
@@ -1057,12 +1073,15 @@ class Scheduler:
                     and not self.profile.extenders
                     and not self.profile.host_scores):
                 pre = self.pipeline_preemptions
+                pre_poison = self.poison_convictions
                 n = self._schedule_pipelined()
                 placed += n
-                if n > 0 or self.pipeline_preemptions > pre:
-                    # preemptions are progress too: victims were evicted,
-                    # the preemptors return after their backoff — keep
-                    # the pipeline on for the follow-up rounds
+                if (n > 0 or self.pipeline_preemptions > pre
+                        or self.poison_convictions > pre_poison):
+                    # preemptions and poison convictions are progress
+                    # too: victims were evicted / culprits quarantined,
+                    # and the survivors should re-run the PIPELINE (so
+                    # their placements stay bit-equal a clean run's)
                     continue
                 # zero progress is systemic (host plugins/extenders in
                 # play, or an unplaceable backlog): disable the pipeline
@@ -1090,6 +1109,7 @@ class Scheduler:
         if now >= self._next_backoff_gc:
             self._next_backoff_gc = now + self.BACKOFF_GC_PERIOD
             self.backoff.gc()
+            self.poison_backoff.gc()
         self.export_queue_gauges()
         self.scrubber.maybe_scrub()
         # mesh fault plane: probe quarantined devices past their
@@ -1114,6 +1134,8 @@ class Scheduler:
         # under the queue lock, so it runs on a 1s cadence, not per
         # wave — dashboards scrape slower than that anyway.
         g.labels(queue="shed").set(self.queue.shed_count())
+        # poison-work isolation: convicted pods awaiting their re-probe
+        g.labels(queue="quarantine").set(self.queue.quarantine_count())
         now = self.clock()
         if now >= self._next_class_export:
             self._next_class_export = now + 1.0
@@ -1243,7 +1265,12 @@ class Scheduler:
                     if not self.featurizer.needs_host_path(p)][:self.wave_size]
             if not pods:
                 return
-            self.featurizer.featurize(pods)
+            # guarded: a poison pod in the warm batch convicts here
+            # instead of crashing the warm-up (the warm-up must never
+            # be the thing a bad spec takes down)
+            _pb0, pods = self._featurize_guarded(pods)
+            if not pods:
+                return
             pm_rows, term_rows = self.snapshot.stage_pending(pods)
             pb = self.featurizer.featurize(pods)
             P = pb.req.shape[0]
@@ -1319,11 +1346,9 @@ class Scheduler:
                         # window, never in a measured run)
                         want = _warm(False)
                         if not np.array_equal(got, want):
-                            import sys
-
-                            print("# pallas round MISMATCHES the XLA "
-                                  "formulation on this backend; "
-                                  "demoting to XLA", file=sys.stderr)
+                            self._pallas_demoted(
+                                "round", "MISMATCHES the XLA formulation "
+                                "on this backend (warm-up self-check)")
                             self._round_pallas = False
                         self._round_pallas_checked = True
                 except Exception:
@@ -1388,14 +1413,27 @@ class Scheduler:
         # When nothing grew — the steady state once caps are pre-sized —
         # pass 1's batches already have the final shapes and pass 2 is
         # skipped (featurize was ~25% of round wall time when run twice).
+        # A PodFeaturizeError mid-pass is a DIRECT poison conviction
+        # (typed, uid-carrying — no bisection): quarantine the culprit,
+        # re-chunk the survivors, and featurize again.
         import dataclasses
 
-        sig0 = (self.featurizer.vocabs.version(),
-                dataclasses.astuple(self.snapshot.caps))
-        pass1 = [self.featurizer.featurize(wv) for wv in waves]
-        if (self.featurizer.vocabs.version(),
-                dataclasses.astuple(self.snapshot.caps)) != sig0:
-            pass1 = [self.featurizer.featurize(wv) for wv in waves]
+        while True:
+            try:
+                sig0 = (self.featurizer.vocabs.version(),
+                        dataclasses.astuple(self.snapshot.caps))
+                pass1 = [self.featurizer.featurize(wv) for wv in waves]
+                if (self.featurizer.vocabs.version(),
+                        dataclasses.astuple(self.snapshot.caps)) != sig0:
+                    pass1 = [self.featurizer.featurize(wv) for wv in waves]
+                break
+            except PodFeaturizeError as e:
+                pods = self._convict_featurize_victim(e, pods)
+                if not pods:
+                    if rt is not None:
+                        rec.end_round(rt, outcome="input_fault")
+                    return 0
+                waves = [pods[i:i + W] for i in range(0, len(pods), W)]
         pbs = []
         try:
             for wv, pb_w in zip(waves, pass1):
@@ -1419,6 +1457,28 @@ class Scheduler:
             if rt is not None:
                 rec.end_round(rt, outcome="extender_error")
             return 0
+        try:
+            # chaos seam, per wave, while the batches are still host-side
+            # numpy (pre-stack, pre-upload): a crash-kind poison here
+            # reproduces on the attribution replay (same seam) and
+            # classifies as an input fault; nan-kind corrupts the
+            # victim's row for the sentinel path
+            for wv_pods, pb_w in zip(waves, pbs):
+                self._wave_poison_seam(wv_pods, pb_w)
+        except Exception as e:
+            verdict = self._input_fault_verdict(pods, e)
+            if rt is not None:
+                rec.end_round(rt, outcome=("input_fault"
+                                           if verdict is not None
+                                           else "device_failure"),
+                              error=type(e).__name__)
+            if verdict is None:
+                # transient (a times-bounded fault drained): requeue for
+                # a clean retry
+                for p in pods:
+                    self.queue.add_if_not_present(p)
+                return 0
+            return self._isolate_poison(pods, verdict, self._run_pipeline)
         pm_rows_all, term_rows_all = self.snapshot.stage_pending(pods)
         tpp = term_rows_all.shape[1]
         trace.step("featurized+staged")
@@ -1479,7 +1539,8 @@ class Scheduler:
         collect = rt is not None
 
         def _attempt(use_p: bool):
-            chosen_d, fail_d, _usage_end, rr_end, deco_d = schedule_round(
+            (chosen_d, fail_d, _usage_end, rr_end, deco_d,
+             fin_d) = schedule_round(
                 nt, pm, tt, pbs_stacked, usage, self._rr, pm_rows,
                 term_rows, weights=gating,
                 num_zones=self.snapshot.caps.Z,
@@ -1497,7 +1558,9 @@ class Scheduler:
                 rt.mark("device_wave", cat="device", waves=nw,
                         path="pallas" if use_p else "xla")
             chosen = np.asarray(chosen_d)
-            fetched = chosen.nbytes
+            # the numeric-integrity sentinel planes ride the SAME fetch
+            fin = np.asarray(fin_d)
+            fetched = chosen.nbytes + fin.nbytes
             deco = None
             if deco_d is not None:
                 # the [W, P, S(+K)] decomposition planes are the round's
@@ -1508,40 +1571,47 @@ class Scheduler:
             trace.step("fetched")
             if rt is not None:
                 rt.mark("fetch", cat="device", bytes=int(fetched))
-            return chosen, rr_end, deco
+            return chosen, rr_end, deco, fin
 
         round_pallas = self._round_pallas
         try:
             try:
-                chosen_all, rr_end, deco_all = _attempt(round_pallas)
+                chosen_all, rr_end, deco_all, fin_all = \
+                    _attempt(round_pallas)
                 if round_pallas and not self._round_pallas_checked:
                     # unwarmed process: first-round on-device cross-check
                     # (see warm_pipeline; one-time compile+exec cost)
-                    want, want_rr, want_deco = _attempt(False)
+                    want, want_rr, want_deco, want_fin = _attempt(False)
                     if not np.array_equal(chosen_all, want):
-                        import sys
-
-                        print("# pallas round MISMATCHES the XLA "
-                              "formulation on this backend; demoting "
-                              "to XLA", file=sys.stderr)
+                        self._pallas_demoted(
+                            "round", "MISMATCHES the XLA formulation on "
+                            "this backend")
                         self._round_pallas = round_pallas = False
-                        chosen_all, rr_end, deco_all = (want, want_rr,
-                                                        want_deco)
+                        chosen_all, rr_end, deco_all, fin_all = (
+                            want, want_rr, want_deco, want_fin)
                     self._round_pallas_checked = True
             except Exception as e:
                 if isinstance(e, DispatchTimeout):
                     raise  # wedged runtime, not a pallas failure: no retry
                 if not round_pallas:
                     raise
-                import sys
-
-                print(f"# pallas round failed, retrying on the pure-XLA "
-                      f"formulation: {type(e).__name__}: {e}",
-                      file=sys.stderr)
+                self._pallas_demoted("round", f"{type(e).__name__}: {e}",
+                                     exc=e)
                 self._round_pallas = round_pallas = False
-                chosen_all, rr_end, deco_all = _attempt(False)
+                chosen_all, rr_end, deco_all, fin_all = _attempt(False)
             self._last_path = "pallas" if round_pallas else "xla"
         except Exception as e:
+            # input-fault attribution BEFORE breaker/reform accounting:
+            # bad work must never blame (or reform) the runtime
+            verdict = self._input_fault_verdict(pods, e)
+            if verdict is not None:
+                for p in pods:
+                    self.snapshot.unstage(p)
+                if rt is not None:
+                    rec.end_round(rt, outcome="input_fault",
+                                  error=type(e).__name__)
+                return self._isolate_poison(pods, verdict,
+                                            self._run_pipeline)
             # round failed on every formulation: breaker accounting,
             # then hand the backlog back — schedule_pending's per-wave
             # iteration (or, once tripped, the degraded host path)
@@ -1567,6 +1637,22 @@ class Scheduler:
                 self.queue.add_if_not_present(p)
             return 0
         self.breaker.record_success()
+        # numeric-integrity sentinel, fetched with the round's chosen
+        # planes: any non-finite row means a poison pod contaminated the
+        # scan's shared usage carry — DISCARD the whole round (a NaN
+        # carry silently shifts innocent pods' placements), convict the
+        # flagged pods, and re-run the survivors, whose placements are
+        # then bit-equal a clean run's. rr deliberately not advanced.
+        bad = [wv_pods[i].uid for wi, wv_pods in enumerate(waves)
+               for i in range(len(wv_pods)) if not fin_all[wi, i]]
+        if bad:
+            for p in pods:
+                self.snapshot.unstage(p)
+            if rt is not None:
+                rec.end_round(rt, outcome="input_fault", poison=len(bad))
+            return self._isolate_poison(
+                pods, PoisonError("numeric-integrity sentinel", uids=bad),
+                self._run_pipeline)
         # exact shadow sampling runs BEFORE any commit mutates the
         # snapshot: the twin must replay the identical pre-round state
         # the device program scored
@@ -1693,7 +1779,9 @@ class Scheduler:
 
         t0 = self.clock()
         trace = Trace(f"preempt chunk of {len(cands)}", clock=self.clock)
-        pb = self.featurizer.featurize(cands)
+        pb, cands = self._featurize_guarded(cands)
+        if not cands:
+            return set()
         # candidate thresholds: distinct priorities of live existing pods
         # (+1 so "< level" removes that class); always keep the HIGHEST
         # so the remove-all-lower option survives the level cap
@@ -1974,7 +2062,12 @@ class Scheduler:
         start = self.clock()
         for _p in pods:
             self.metrics.schedule_attempts.inc()
-        pb = self.featurizer.featurize(pods)
+        runner = (lambda ps: self._host_wave(
+            ps, rt, deco_acc=deco_acc, committed=committed,
+            weights_view=weights_view))
+        pb, pods = self._featurize_guarded(pods)
+        if not pods:
+            return 0  # the whole chunk was convicted at featurize time
         P = pb.req.shape[0]
         try:
             extra = self._host_plugin_mask(pods, P)
@@ -1996,14 +2089,45 @@ class Scheduler:
         # carries the full inter-pod affinity plane
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
-        res, _usage = hostwave.schedule_wave_host(
-            nt, pm, tt, pb, extra, self._host_rr, extra_scores,
-            weights=gating,
-            num_zones=self.snapshot.caps.Z,
-            num_label_values=self.snapshot.num_label_values,
-            has_ipa=has_ipa,
-            collect_scores=deco_acc is not None,
-            weight_vec=wvec)
+        try:
+            self._wave_poison_seam(pods, pb)
+            res, _usage = hostwave.schedule_wave_host(
+                nt, pm, tt, pb, extra, self._host_rr, extra_scores,
+                weights=gating,
+                num_zones=self.snapshot.caps.Z,
+                num_label_values=self.snapshot.num_label_values,
+                has_ipa=has_ipa,
+                collect_scores=deco_acc is not None,
+                weight_vec=wvec)
+        except Exception as e:
+            # a crash on the HOST path follows the data by construction
+            # (no runtime to blame): input fault — bisect to the
+            # culprit. Known infrastructure errors are exempt (store /
+            # REST / OS — never the work's fault); a deterministic twin
+            # BUG does still convict the batch pod by pod, a deliberate
+            # tradeoff: each conviction logs loudly and re-probes on the
+            # capped ladder, where the pre-isolation behavior crashed
+            # the scheduling loop outright.
+            if self._infra_error(e):
+                self.metrics.scheduling_errors.labels(stage="wave").inc()
+                logging.getLogger(__name__).error(
+                    "host wave failed on infrastructure, parking %d "
+                    "pods", len(pods), exc_info=e)
+                for p in pods:
+                    self._park_with_backoff(p)
+                return 0
+            verdict = (e if isinstance(e, (PoisonError, PodFeaturizeError))
+                       else PoisonError(f"host twin pass failed: "
+                                        f"{type(e).__name__}: {e}"))
+            return self._isolate_poison(pods, verdict, runner)
+        # numeric-integrity sentinel: discard the chunk, convict the
+        # flagged pods, re-run the survivors (host rr not advanced)
+        fin = np.asarray(res.finite)
+        bad = [pods[i].uid for i in range(len(pods)) if not fin[i]]
+        if bad:
+            return self._isolate_poison(
+                pods, PoisonError("numeric-integrity sentinel", uids=bad),
+                runner)
         if deco_acc is not None and res.deco is not None:
             # slice off featurize's P-bucket pad rows: the degraded round
             # concatenates chunks, so a padded chunk would shift every
@@ -2077,7 +2201,12 @@ class Scheduler:
         bound = self.gangs.bound_count(self.cache, key,
                                        exclude={p.uid for p in members})
         need = max(min_member - bound, 0)
-        pb = self.featurizer.featurize(members)
+        try:
+            pb = self.featurizer.featurize(members)
+        except PodFeaturizeError as e:
+            # gang-atomic conviction, exactly like the device path
+            self._gang_input_fault(members, e, rt)
+            return 0
         P = pb.req.shape[0]
         try:
             extra = self._host_plugin_mask(members, P)
@@ -2091,17 +2220,36 @@ class Scheduler:
         gating, wvec, _wver = self._weights_kw()
         has_ipa = bool(self.snapshot.has_affinity_terms or pb.ra_has.any()
                        or pb.rn_has.any() or (pb.pa_w != 0).any())
-        res = hostwave.schedule_gang_host(
-            nt, pm, tt, pb, extra, self._host_rr, extra_scores, need,
-            weights=gating,
-            num_zones=self.snapshot.caps.Z,
-            num_label_values=self.snapshot.num_label_values,
-            has_ipa=has_ipa,
-            weight_vec=wvec)
+        try:
+            self._wave_poison_seam(members, pb)
+            res = hostwave.schedule_gang_host(
+                nt, pm, tt, pb, extra, self._host_rr, extra_scores, need,
+                weights=gating,
+                num_zones=self.snapshot.caps.Z,
+                num_label_values=self.snapshot.num_label_values,
+                has_ipa=has_ipa,
+                weight_vec=wvec)
+        except Exception as e:
+            # a host-path crash follows the data: the gang convicts whole
+            verdict = (e if isinstance(e, (PoisonError, PodFeaturizeError))
+                       else PoisonError(f"host twin gang pass failed: "
+                                        f"{type(e).__name__}: {e}"))
+            self._gang_input_fault(members, verdict, rt)
+            return 0
         self._last_path = "vector"
         if rt is not None:
             rt.mark("host_wave", cat="host", backend="vector", gang=key,
                     pods=len(members))
+        fin = np.asarray(res.finite)
+        bad = [members[i].uid for i in range(len(members)) if not fin[i]]
+        if bad:
+            # sentinel verdict: the twin discarded nothing on its own
+            # (count feasibility may even have passed) — the gang
+            # convicts atomically before any commit
+            self._gang_input_fault(
+                members,
+                PoisonError("numeric-integrity sentinel", uids=bad), rt)
+            return 0
         if not bool(res.ok):
             self._fail_gang(key, members, need, res)
             return 0
@@ -2298,6 +2446,261 @@ class Scheduler:
                 _kernel.set_devices(
                     [str(d) for d in new_mesh.devices.flat])
 
+    # -- poison-work isolation (input-fault attribution) -----------------------
+    #
+    # Batching Filter+Score into one (pods x nodes) device computation
+    # collapsed the per-pod error isolation 1.11's genericScheduler got
+    # for free: one pod whose spec crashes the featurizer — or whose
+    # NaN request poisons the scan's shared usage carry — used to look
+    # exactly like a device fault, so the breaker blamed the runtime,
+    # the reform ladder quarantined innocent DEVICES, the hostwave
+    # salvage crashed on the same input, and the pods requeued into the
+    # same wave forever. This plane restores the isolation: classify
+    # every failure as device-fault vs INPUT-fault before any breaker /
+    # reform accounting (replay through the numpy twin — a runtime
+    # fault cannot follow the data onto the host), attribute directly
+    # when the evidence names a pod (typed featurizer errors, the
+    # kernel's numeric-integrity sentinel), BISECT the wave along the
+    # pod axis otherwise (the PR 14 device-bisection mirror), and park
+    # convicted pods in the queue's quarantine area with a capped
+    # re-probe backoff. Breaker and mesh never move for bad work.
+
+    # attribution-replay bound, in waves (see _input_fault_verdict):
+    # enough to cover every pipeline round shape the tests and the
+    # acceptance proof exercise while keeping the failure path's twin
+    # cost bounded on huge backlogs
+    ATTRIBUTION_REPLAY_MAX_WAVES = 4
+
+    def _wave_poison_seam(self, pods: List[api.Pod], pb) -> None:
+        """The `wave.poison` chaos seam: fired before EVERY batched pass
+        over a pod list — device round/wave/gang dispatches, degraded
+        host-twin waves, and the input-fault attribution replay — with
+        (pods, host-side PodBatch) as payload, so an injected poison
+        follows the DATA across backends (state/featurize.py
+        poison_pod_fault). One dict check when unarmed."""
+        faultpoints.fire("wave.poison", payload=(pods, pb))
+
+    def _featurize_guarded(self, pods: List[api.Pod]):
+        """(PodBatch, survivors): featurize a batch, convicting pods
+        whose spec crashes (or numerically poisons) the featurizer —
+        PodFeaturizeError carries the culprit UID, so attribution is
+        direct and the innocent podmates featurize clean on the retry.
+        Returns (None, []) when every pod was convicted."""
+        pods = list(pods)
+        while pods:
+            try:
+                return self.featurizer.featurize(pods), pods
+            except PodFeaturizeError as e:
+                pods = self._convict_featurize_victim(e, pods)
+        return None, []
+
+    def _convict_featurize_victim(self, e: PodFeaturizeError,
+                                  pods: List[api.Pod]) -> List[api.Pod]:
+        """The convict-and-filter step of a guarded featurize retry
+        (shared by _featurize_guarded and _run_pipeline's two-pass
+        loop): quarantine the pod the typed error names, return the
+        survivors. Re-raises when the error names a pod outside the
+        batch — that is a bug, not poison."""
+        victims = [p for p in pods if p.uid == e.uid]
+        if not victims:
+            raise e
+        self._convict(victims, reason="featurize", error=str(e),
+                      cohort=pods)
+        return [p for p in pods if p.uid != e.uid]
+
+    def _input_fault_verdict(self, pods: List[api.Pod],
+                             exc: BaseException):
+        """Fault ATTRIBUTION, run before any breaker/reform accounting:
+        replay the failed batch through the numpy twin over the host
+        planes (commits discarded, rr untouched). The twin failing too
+        — or its numeric-integrity sentinel flagging non-finite planes
+        — convicts the WORK, because a runtime fault cannot follow the
+        data onto the host: returns the verdict exception (uids when
+        attribution is direct, empty for the bisection path). A clean
+        replay returns None: genuine device fault, the mesh ladder and
+        the whole-path breaker own it. DispatchTimeout skips the replay
+        outright — a wedge is a runtime property, never the work's."""
+        if isinstance(exc, DispatchTimeout):
+            return None
+        if isinstance(exc, (PoisonError, PodFeaturizeError)):
+            return exc
+        from ..ops import hostwave
+
+        # the replay is a FAILURE-path cost paid before a genuine
+        # device fault's salvage re-runs the same twin waves: bound it.
+        # A poison beyond the cap is not lost — misclassifying it as a
+        # device fault routes the batch to the degraded/salvage path,
+        # whose own host waves carry the identical sentinel + crash
+        # isolation and convict it there (at the price of one wrongly
+        # charged breaker count).
+        replay = pods[:self.ATTRIBUTION_REPLAY_MAX_WAVES * self.wave_size]
+        gating, wvec, _wver = self._weights_kw()
+        try:
+            for s in range(0, len(replay), self.wave_size):
+                chunk = replay[s:s + self.wave_size]
+                pb = self.featurizer.featurize(chunk)
+                self._wave_poison_seam(chunk, pb)
+                nt, pm, tt = self.snapshot.host_tensors()
+                extra = np.ones((pb.req.shape[0], nt.valid.shape[0]), bool)
+                has_ipa = bool(self.snapshot.has_affinity_terms
+                               or pb.ra_has.any() or pb.rn_has.any()
+                               or (pb.pa_w != 0).any())
+                res, _usage = hostwave.schedule_wave_host(
+                    nt, pm, tt, pb, extra, self._host_rr, None,
+                    weights=gating, num_zones=self.snapshot.caps.Z,
+                    num_label_values=self.snapshot.num_label_values,
+                    has_ipa=has_ipa, weight_vec=wvec)
+                fin = np.asarray(res.finite)
+                bad = [p.uid for j, p in enumerate(chunk) if not fin[j]]
+                if bad:
+                    return PoisonError(
+                        "numeric-integrity sentinel flagged the twin "
+                        "replay", uids=bad)
+        except PodFeaturizeError as fe:
+            return fe
+        except Exception as replay_exc:
+            if self._infra_error(replay_exc):
+                # the REPLAY itself failed on infrastructure (store /
+                # OS), which proves nothing about the work — fall back
+                # to the device-fault path rather than convicting
+                # innocents on a broken jury
+                return None
+            return PoisonError(
+                f"twin replay reproduced the failure: "
+                f"{type(replay_exc).__name__}: {replay_exc}")
+        return None
+
+    def _isolate_poison(self, pods: List[api.Pod], verdict,
+                        runner: Callable[[List[api.Pod]], int]) -> int:
+        """Input-fault isolation. Direct conviction when the verdict
+        names UIDs (typed featurizer error / sentinel planes) — the
+        survivors requeue and place bit-equal a clean run on the next
+        round. Otherwise WAVE BISECTION along the pod axis, mirroring
+        PR 14's device bisection: split in half preserving order and
+        re-run each half through `runner` — the clean half places
+        normally (order and the snapshot-carried usage/rr flows make it
+        bit-equal a clean run), the poisoned half fails again and
+        recurses, converging on the culprit in log2(wave) rounds.
+        Returns pods placed by the retries."""
+        self.metrics.scheduling_errors.labels(stage="poison").inc()
+        victims, reason = self._verdict_attribution(verdict, pods)
+        if victims:
+            vuids = {p.uid for p in victims}
+            self._convict(victims, reason=reason, error=str(verdict),
+                          cohort=pods)
+            for p in pods:
+                if p.uid not in vuids:
+                    self.queue.add_if_not_present(p)
+            return 0
+        if len(pods) <= 1:
+            self._convict(list(pods), reason="bisect", error=str(verdict),
+                          cohort=pods)
+            return 0
+        mid = (len(pods) + 1) // 2
+        tracing.event("poison_bisect", pods=len(pods))
+        logging.getLogger(__name__).warning(
+            "input fault with no direct attribution: bisecting a "
+            "%d-pod wave (%s)", len(pods), verdict)
+        return runner(pods[:mid]) + runner(pods[mid:])
+
+    def _convict(self, pods: List[api.Pod], reason: str, error: str = "",
+                 cohort=()) -> None:
+        """Quarantine convicted poison work. Gang-atomic: a poisoned
+        member convicts its WHOLE gang — pending members are pulled
+        from every queue area (and from `cohort`, the in-hand wave
+        mates) and quarantined together, because a sub-minMember
+        remnant would wedge against its own admission gate forever.
+        Every conviction gets a FitError-style condition/event, the
+        scheduler_poison_pods_total{reason} increment, and a capped-
+        backoff re-probe deadline (specs get edited; a spec EDIT
+        releases immediately via the queue's update path)."""
+        # dict-as-ordered-set: conviction order follows victim order
+        victims: Dict[str, tuple] = {}
+        for p in pods:
+            victims[p.uid] = (p, reason)
+        if self.gangs.active:
+            keys: Dict[str, None] = {}
+            for p in pods:
+                k = self.gangs.key(p)
+                if k is not None:
+                    keys[k] = None
+            for k in keys:
+                for mate in self.queue.gang_pending_pods(k):
+                    victims.setdefault(mate.uid, (mate, "gang"))
+                for mate in cohort:
+                    if (mate.uid not in victims
+                            and self.gangs.key(mate) == k):
+                        victims[mate.uid] = (mate, "gang")
+        n_nodes = int(np.sum(self.snapshot.valid))
+        log = logging.getLogger(__name__)
+        for uid, (pod, r) in victims.items():
+            d = self.poison_backoff.bump(uid)
+            until = self.clock() + d
+            if not self.queue.quarantine(pod, until):
+                # queue.quarantine drop-mode chaos: a lost conviction —
+                # degrade to the plain backoff park so the pod still
+                # leaves the wave (pre-isolation behavior, never a wedge)
+                self._park_with_backoff(pod)
+                continue
+            self.poison_convictions += 1
+            self.metrics.pods_failed.inc()
+            self.metrics.poison_pods.labels(reason=r).inc()
+            err = FitError(pod.full_name(), n_nodes,
+                           {REASONS["Poisoned"]: 1})
+            self.store.set_pod_condition(
+                pod, ("PodScheduled", "False:" + err.message()))
+            tracing.event("pod_quarantined", pod=uid, reason=r,
+                          reprobe_s=round(d, 3))
+            log.error(
+                "poison pod %s quarantined (%s; re-probe in %.1fs): %s",
+                pod.full_name(), r, d, error or reason)
+
+    def _gang_input_fault(self, members: List[api.Pod], verdict,
+                          rt=None) -> None:
+        """Gang flavor of _isolate_poison: no bisection WITHIN a gang —
+        one poisoned member quarantines the group atomically (the
+        culprit keeps its direct reason when the verdict names it, the
+        mates are booked under reason=gang)."""
+        self.metrics.scheduling_errors.labels(stage="poison").inc()
+        culprits, reason = self._verdict_attribution(verdict, members)
+        if not culprits:
+            culprits = list(members)
+        self._convict(culprits, reason=reason, error=str(verdict),
+                      cohort=members)
+        if rt is not None:
+            rt.ledger["outcome"] = "input_fault"
+
+    @staticmethod
+    def _verdict_attribution(verdict, pods: List[api.Pod]):
+        """(culprits, reason) for one input-fault verdict: the pods it
+        names directly — a typed featurizer error's uid or the
+        sentinel's uids — with the matching conviction reason, or
+        ([], "bisect") when attribution is indirect."""
+        uids = set(getattr(verdict, "uids", ()) or ())
+        one = getattr(verdict, "uid", None)
+        if one:
+            uids.add(one)
+        culprits = [p for p in pods if p.uid in uids]
+        if not culprits:
+            return [], "bisect"
+        return culprits, ("featurize"
+                          if isinstance(verdict, PodFeaturizeError)
+                          else "sentinel")
+
+    def _pallas_demoted(self, program: str, why: str,
+                        exc: Optional[BaseException] = None) -> None:
+        """Pallas-path demotion visibility (the PR 2 _bind_done
+        convention): what used to be a bare stderr print becomes
+        scheduling_errors_total{stage=pallas} + a logged traceback + a
+        flight-recorder event, so dashboards and traces can see the
+        fast path silently falling back to XLA."""
+        self.metrics.scheduling_errors.labels(stage="pallas").inc()
+        logging.getLogger(__name__).error(
+            "pallas %s demoted to the XLA formulation: %s", program, why,
+            exc_info=exc)
+        tracing.event("pallas_demoted", program=program, why=why,
+                      error=type(exc).__name__ if exc is not None else "")
+
     def _run_wave(self, pods: List[api.Pod]) -> int:
         import jax
         import jax.numpy as jnp
@@ -2342,7 +2745,12 @@ class Scheduler:
             self._trace_queue_waits(rt, pods)
             if golden:
                 rt.ledger["golden"] = golden
-        pb = self.featurizer.featurize(pods)
+        pb, pods = self._featurize_guarded(pods)
+        if not pods:
+            # the whole wave was convicted at featurize time
+            if rt is not None:
+                rec.end_round(rt, outcome="input_fault")
+            return placed_host
         try:
             extra = self._host_plugin_mask(pods, pb.req.shape[0])
             extra_scores = self._host_score_matrix(pods, pb.req.shape[0])
@@ -2361,6 +2769,28 @@ class Scheduler:
         if rt is not None:
             rt.mark("featurize", pods=len(pods))
             up0 = self.snapshot.upload_bytes_total
+        try:
+            # chaos seam, fired while pb is still the host-side batch:
+            # a crash-kind poison here reproduces on the attribution
+            # replay (which fires the same seam) and classifies as an
+            # input fault; nan-kind corrupts the row pre-upload for the
+            # sentinel path
+            self._wave_poison_seam(pods, pb)
+        except Exception as e:
+            verdict = self._input_fault_verdict(pods, e)
+            if rt is not None:
+                rec.end_round(rt, outcome=("input_fault"
+                                           if verdict is not None
+                                           else "device_failure"),
+                              error=type(e).__name__)
+            if verdict is None:
+                # transient (a times-bounded fault drained): park the
+                # wave for a clean retry
+                for p in pods:
+                    self._park_with_backoff(p)
+                return placed_host
+            return placed_host + self._isolate_poison(pods, verdict,
+                                                      self._run_wave)
         nt, pm, tt = self._to_device()
         if rt is not None:
             rt.mark("upload", cat="device",
@@ -2430,11 +2860,8 @@ class Scheduler:
                     raise
                 if not self._use_pallas:
                     raise
-                import sys
-
-                print(f"# wave failed with pallas enabled, retrying on the "
-                      f"pure-XLA path: {type(e).__name__}: {e}",
-                      file=sys.stderr)
+                self._pallas_demoted("wave", f"{type(e).__name__}: {e}",
+                                     exc=e)
                 self._use_pallas = False
                 try:
                     res = schedule_wave(nt, pm, tt, pb, extra, self._rr,
@@ -2447,12 +2874,18 @@ class Scheduler:
                     self._use_pallas = True
                     raise
         except Exception as e:
+            # input-fault attribution BEFORE breaker/reform accounting:
+            # bad work must never blame — or degrade — the runtime
+            verdict = self._input_fault_verdict(pods, e)
+            if verdict is not None:
+                if rt is not None:
+                    rec.end_round(rt, outcome="input_fault",
+                                  error=type(e).__name__)
+                return placed_host + self._isolate_poison(pods, verdict,
+                                                          self._run_wave)
             # every formulation failed: count it against the breaker
             # and degrade THIS wave to the exact host path — a device
             # fault must cost a slower wave, never a stopped scheduler
-            # reform or breaker accounting either way — this wave
-            # ALWAYS degrades to the host path (a device fault must cost
-            # a slower wave, never a stopped scheduler)
             self._device_failure(e)
             if rt is not None:
                 rec.end_round(rt, outcome="device_failure",
@@ -2463,13 +2896,28 @@ class Scheduler:
             return placed_host + self._schedule_degraded(pods)
         self.breaker.record_success()
         self._last_path = "pallas" if self._use_pallas else "xla"
+        chosen = np.asarray(res.chosen)
+        fin = np.asarray(res.finite)
+        # numeric-integrity sentinel, fetched alongside `chosen` (same
+        # program — zero extra dispatch): non-finite rows mean a poison
+        # pod contaminated the scan's shared carries, so the WHOLE wave
+        # is discarded (a NaN carry silently shifts innocent pods'
+        # placements), the flagged pods convict, and the survivors
+        # re-run — placing bit-equal a clean run. The rr carry is
+        # deliberately not advanced for a discarded wave.
+        bad = [pods[i].uid for i in range(len(pods)) if not fin[i]]
+        if bad:
+            if rt is not None:
+                rec.end_round(rt, outcome="input_fault", poison=len(bad))
+            return placed_host + self._isolate_poison(
+                pods, PoisonError("numeric-integrity sentinel", uids=bad),
+                self._run_wave)
         self._rr = res.rr_end
         if rt is not None:
             rt.mark("device_wave", cat="device", path=self._last_path)
-        chosen = np.asarray(res.chosen)
         # mirror: one rr advance per placement (see _host_rr)
         self._host_rr += int(np.sum(chosen >= 0))
-        fetched = chosen.nbytes
+        fetched = chosen.nbytes + fin.nbytes
         deco = None
         if res.deco is not None:
             deco = tuple(np.asarray(a) for a in res.deco)
@@ -2547,14 +2995,75 @@ class Scheduler:
         """Golden path for a batch: the ClusterView and the extender
         node-labels map are built ONCE for the round and shared across
         every pod's pass (they read live cache state, so commits and
-        evictions inside the loop stay visible)."""
+        evictions inside the loop stay visible). The per-pod loop IS
+        the fault domain here, so a spec that crashes the golden pass
+        gets attribution for free: convict just that pod and keep
+        draining the batch."""
         if not pods:
             return 0
         view = golden.ClusterView(self.cache.node_infos)
         node_labels = self._extender_node_labels()
-        return sum(self._schedule_host_path(p, view=view,
-                                            node_labels=node_labels)
-                   for p in pods)
+        placed = 0
+        crashed: List[Tuple[api.Pod, BaseException]] = []
+        for p in pods:
+            try:
+                placed += self._schedule_host_path(p, view=view,
+                                                   node_labels=node_labels)
+            except Exception as e:
+                if self._infra_error(e):
+                    # the golden pass also preempts and commits: a
+                    # transient store/REST failure there is NOT the
+                    # pod's fault — plain backoff park, never a
+                    # conviction (a poison verdict escalates a x2..x64
+                    # ladder an innocent pod would have to re-probe
+                    # down)
+                    self.metrics.scheduling_errors.labels(
+                        stage="bind").inc()
+                    logging.getLogger(__name__).error(
+                        "golden pass failed on infrastructure, "
+                        "parking %s", p.full_name(), exc_info=e)
+                    self._park_with_backoff(p)
+                    continue
+                crashed.append((p, e))
+        if crashed and len(crashed) == len(pods) and len(pods) > 1:
+            # EVERY pod in the batch crashed the golden pass: that is a
+            # systemic fault (a buggy host plugin, corrupt shared
+            # state), not per-pod poison — park the batch instead of
+            # quarantining an entire innocent class behind Poisoned
+            # conditions. A single-pod batch can't be disambiguated and
+            # keeps the conviction (the re-probe ladder bounds a wrong
+            # call).
+            self.metrics.scheduling_errors.labels(stage="wave").inc()
+            logging.getLogger(__name__).error(
+                "golden pass crashed for ALL %d pods (systemic, not "
+                "poison); parking batch", len(pods),
+                exc_info=crashed[0][1])
+            for p, _e in crashed:
+                self._park_with_backoff(p)
+            return placed
+        for p, e in crashed:
+            self._convict([p], reason="golden",
+                          error=f"{type(e).__name__}: {e}")
+        return placed
+
+    @staticmethod
+    def _infra_error(exc: BaseException) -> bool:
+        """Is this exception an infrastructure failure (store/REST/OS)
+        rather than something the pod's own spec can cause? Conviction
+        paths that wrap phases with side effects (commit, preemption)
+        must not misattribute these to the work."""
+        from ..runtime.store import Conflict
+
+        if isinstance(exc, (OSError, TimeoutError, Conflict, KeyError)):
+            return True
+        try:
+            from ..client.rest import APIStatusError
+
+            if isinstance(exc, APIStatusError):
+                return True
+        except Exception:
+            pass
+        return False
 
     def _schedule_host_path(self, pod: api.Pod, view=None,
                             node_labels=None) -> int:
@@ -2751,7 +3260,14 @@ class Scheduler:
                        if not self.featurizer.needs_host_path(p)]
             if not members:
                 return placed
-        pb = self.featurizer.featurize(members)
+        try:
+            pb = self.featurizer.featurize(members)
+        except PodFeaturizeError as e:
+            # gang-atomic conviction: one poisoned member quarantines
+            # the whole group (a sub-minMember remnant would wedge
+            # against its own admission gate forever)
+            self._gang_input_fault(members, e, rt)
+            return placed
         P = pb.req.shape[0]
         try:
             extra = self._host_plugin_mask(members, P)
@@ -2765,6 +3281,19 @@ class Scheduler:
             return placed
         if rt is not None:
             rt.mark("featurize", pods=len(members))
+        try:
+            # chaos seam while pb is still host-side (see _run_wave)
+            self._wave_poison_seam(members, pb)
+        except Exception as e:
+            verdict = self._input_fault_verdict(members, e)
+            if verdict is None:
+                for p in members:
+                    self._park_with_backoff(p)
+                if rt is not None:
+                    rt.ledger["outcome"] = "device_failure"
+                return placed
+            self._gang_input_fault(members, verdict, rt)
+            return placed
         nt, pm, tt = self._to_device()
         if rt is not None:
             rt.mark("upload", cat="device")
@@ -2810,11 +3339,8 @@ class Scheduler:
                     raise  # wedged runtime, not a pallas failure: no retry
                 if not self._use_pallas:
                     raise
-                import sys
-
-                print(f"# gang wave failed with pallas enabled, retrying on "
-                      f"the pure-XLA path: {type(e).__name__}: {e}",
-                      file=sys.stderr)
+                self._pallas_demoted("gang", f"{type(e).__name__}: {e}",
+                                     exc=e)
                 self._use_pallas = False
                 try:
                     res = schedule_gang(nt, pm, tt, pb, extra, self._rr,
@@ -2826,6 +3352,12 @@ class Scheduler:
                     self._use_pallas = True
                     raise
         except Exception as e:
+            # input-fault attribution first: a poisoned member must
+            # quarantine its gang, never feed the breaker or the ladder
+            verdict = self._input_fault_verdict(members, e)
+            if verdict is not None:
+                self._gang_input_fault(members, verdict, rt)
+                return placed
             # the joint-assignment kernel IS the device path: park the
             # gang for retry (atomicity is preserved — nothing placed)
             # and let the breaker route future waves host-side once it
@@ -2851,7 +3383,17 @@ class Scheduler:
         if rt is not None:
             rt.mark("device_wave", cat="device", path=self._last_path)
         chosen = np.asarray(res.chosen)
-        self.metrics.device_fetch_bytes.inc(chosen.nbytes)
+        fin = np.asarray(res.finite)
+        self.metrics.device_fetch_bytes.inc(chosen.nbytes + fin.nbytes)
+        # numeric-integrity sentinel (same fetch): a poisoned member
+        # discards the whole gang's placements and convicts the group
+        # atomically — rr not advanced, nothing committed
+        bad = [members[i].uid for i in range(len(members)) if not fin[i]]
+        if bad:
+            self._gang_input_fault(
+                members,
+                PoisonError("numeric-integrity sentinel", uids=bad), rt)
+            return placed
         if not bool(np.asarray(res.ok)):
             if rt is not None:
                 rt.ledger.update(outcome="gang_unplaceable",
@@ -3157,6 +3699,9 @@ class Scheduler:
             self.metrics.pod_scheduling_latency.observe(self.clock() - added)
         self.metrics.pods_scheduled.inc()
         self.backoff.clear(pod.uid)
+        # a successful bind clears the poison ladder too: an edited
+        # (recovered) spec starts fresh on any future conviction
+        self.poison_backoff.clear(pod.uid)
         self.queue.clear_backoff(pod.uid)
         self.queue.update_nominated_pod(pod, "")
         return True

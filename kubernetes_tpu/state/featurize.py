@@ -22,6 +22,7 @@ import numpy as np
 from ..api import labels as lbl
 from ..api import types as api
 from ..ops import encoding as enc
+from ..utils import faultpoints
 from .snapshot import Snapshot, _parse_label_num
 from .vocab import VocabSet, bucket_size
 
@@ -56,6 +57,82 @@ class _PodRow:
 
 class FeaturizeError(Exception):
     pass
+
+
+class PodFeaturizeError(FeaturizeError):
+    """One pod's spec crashed the featurizer — or featurized into
+    non-finite planes (a NaN/inf resource quantity would poison the
+    device scan's usage carry and shift every later pod's placement).
+    Typed and UID-carrying so the scheduler's poison-isolation plane
+    (sched/scheduler.py) convicts the culprit DIRECTLY, without wave
+    bisection: the batched Filter+Score pass collapses 1.11's free
+    per-pod error isolation, and this error is what restores exact
+    attribution for spec-level faults."""
+
+    def __init__(self, pod, cause: Exception):
+        self.uid = getattr(pod, "uid", "")
+        self.pod_name = (pod.full_name() if hasattr(pod, "full_name")
+                         else str(pod))
+        super().__init__(
+            f"pod {self.pod_name} (uid {self.uid}) poisons featurization: "
+            f"{type(cause).__name__}: {cause}")
+
+
+def poison_pod_fault(uid: str, kind: str = "nan"):
+    """corrupt-mode fn poisoning exactly ONE pod UID — the
+    lost_device_fault (sched/breaker.py) analog for *work* instead of
+    devices. Two seams consume it:
+
+      featurize.poison  payload (pod, row-dict), fired AFTER the
+                        featurizer's finite validation — kind="nan"
+                        writes NaN into the victim's req columns
+                        (models post-validation in-flight corruption:
+                        slips past the featurizer, MUST be caught by
+                        the kernel's numeric-integrity sentinel);
+                        kind="crash" raises PodFeaturizeError (direct
+                        attribution, no bisection needed).
+      wave.poison       payload (pods, PodBatch), fired before BOTH the
+                        device dispatch and every numpy-twin pass over
+                        the same pods — kind="crash" raises whenever
+                        the victim rides in the batch, so the fault
+                        follows the DATA across backends: the twin
+                        replay crashes too, classification lands on
+                        input-fault, and wave bisection isolates the
+                        victim; kind="nan" corrupts the victim's
+                        host-side PodBatch row pre-upload (sentinel
+                        path).
+
+    Everything without the victim proceeds untouched, so one activation
+    models exactly one poison pod:
+
+        faultpoints.activate("wave.poison", "corrupt",
+                             fn=poison_pod_fault(pod.uid, "crash"))
+    """
+
+    def fn(payload):
+        if payload is None:
+            return
+        first = payload[0] if isinstance(payload, tuple) else None
+        if first is not None and not isinstance(first, (list, tuple)):
+            pod, d = payload  # featurize seam
+            if getattr(pod, "uid", None) != uid:
+                return
+            if kind == "crash":
+                raise PodFeaturizeError(
+                    pod, RuntimeError("injected poison spec"))
+            d["req"] = np.full_like(d["req"], np.nan)
+            return
+        pods, pb = payload  # wave seam
+        for i, p in enumerate(pods):
+            if getattr(p, "uid", None) == uid:
+                if kind == "crash":
+                    raise RuntimeError(
+                        f"injected poison work riding pod uid {uid!r}")
+                # host-side batch, pre-upload: numpy in place
+                pb.req[i] = np.nan
+                return
+
+    return fn
 
 
 class PodFeaturizer:
@@ -276,6 +353,38 @@ class PodFeaturizer:
         d["prio"] = np.int32(api.pod_priority(pod))
         return d
 
+    def _featurize_pod_guarded(self, pod: api.Pod) -> Dict[str, np.ndarray]:
+        """_featurize_pod hardened for poison isolation: any crash is
+        re-raised as a typed, UID-carrying PodFeaturizeError, and rows
+        whose resource columns came out non-finite (a 'NaN'-quantity
+        spec parses without error) are rejected HERE — before they can
+        reach a device program and poison the whole wave's usage carry.
+        The featurize.poison chaos seam fires AFTER the validation:
+        corrupt-mode injection models post-validation corruption, which
+        only the kernel's numeric-integrity sentinel can catch."""
+        try:
+            d = self._featurize_pod(pod)
+        except PodFeaturizeError:
+            raise
+        except (MemoryError, OSError, TimeoutError):
+            # environmental, not spec-caused: convicting the pod that
+            # HAPPENED to be featurizing when memory ran out would
+            # quarantine an innocent — propagate raw, like before
+            raise
+        except Exception as e:
+            raise PodFeaturizeError(pod, e) from e
+        if not (np.isfinite(d["req"]).all()
+                and np.isfinite(d["nonzero"]).all()):
+            raise PodFeaturizeError(
+                pod, ValueError("non-finite resource request"))
+        try:
+            faultpoints.fire("featurize.poison", payload=(pod, d))
+        except PodFeaturizeError:
+            raise
+        except Exception as e:
+            raise PodFeaturizeError(pod, e) from e
+        return d
+
     # -- inter-pod affinity ----------------------------------------------------
 
     @staticmethod
@@ -463,7 +572,7 @@ class PodFeaturizer:
             if cached is not None and cached.vocab_version == ver and self._caps_match(cached.data):
                 d = cached.data
             else:
-                d = self._featurize_pod(pod)
+                d = self._featurize_pod_guarded(pod)
                 ver = self.vocabs.version()  # may have grown during featurize
                 if sig:
                     self._cache[sig] = _PodRow(d, pod.spec.node_name, ver)
@@ -472,7 +581,7 @@ class PodFeaturizer:
         # any row that no longer matches current caps
         for i, (pod, d) in enumerate(zip(pods, rows)):
             if not self._caps_match(d):
-                rows[i] = self._featurize_pod(pod)
+                rows[i] = self._featurize_pod_guarded(pod)
                 sig = equivalence_class(pod)
                 if sig:
                     self._cache[sig] = _PodRow(rows[i], pod.spec.node_name, self.vocabs.version())

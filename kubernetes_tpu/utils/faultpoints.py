@@ -77,6 +77,30 @@ Wired points (grep for `faultpoints.fire`):
                    forces the per-zone health reduction onto the exact
                    host fallback (and feeds the circuit breaker when
                    one is wired)
+  featurize.poison state/featurize.py _featurize_pod_guarded, AFTER the
+                   per-pod finite validation (payload: (pod, row-dict)).
+                   Arm `corrupt` with state.featurize.poison_pod_fault
+                   (uid, kind): kind="crash" raises PodFeaturizeError
+                   for exactly that pod (direct poison attribution);
+                   kind="nan" silently NaNs the victim's req row —
+                   post-validation corruption only the kernel's
+                   numeric-integrity sentinel catches
+  wave.poison      sched/scheduler.py, before EVERY batched pass over a
+                   pod list — the device round/wave/gang dispatches,
+                   the degraded host-twin waves, AND the input-fault
+                   attribution replay (payload: (pods, PodBatch)). With
+                   poison_pod_fault(uid, "crash") the fault follows the
+                   DATA across backends: device fails, the twin replay
+                   fails identically, the failure classifies as an
+                   input fault (breaker untouched, mesh untouched) and
+                   wave bisection isolates the victim in log2(wave)
+                   rounds; "nan" corrupts the victim's batch row
+                   pre-upload (sentinel path, one-round conviction)
+  queue.quarantine sched/queue.py quarantine entry (payload: pod) —
+                   `drop` refuses the quarantine (a lost conviction:
+                   the scheduler falls back to a plain backoff park, so
+                   chaos can probe that poison handling degrades to
+                   pre-PR-15 behavior instead of wedging)
 
 Modes:
 

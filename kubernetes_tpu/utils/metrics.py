@@ -341,6 +341,18 @@ class Metrics:
             "scheduler_wave_deadline_overruns_total", ("stage",),
             values={"stage": ("dispatch", "host")})
         self.effective_wave_size = Gauge("scheduler_effective_wave_size")
+        # poison-work isolation (sched/scheduler.py input-fault plane):
+        # pods convicted of poisoning the batched scheduling pass, by
+        # attribution route — featurize (typed PodFeaturizeError, direct
+        # uid), sentinel (the kernel's numeric-integrity isfinite plane),
+        # bisect (wave bisection converged on the culprit), gang
+        # (quarantined with a convicted gangmate — atomicity extends to
+        # conviction), golden (the exact per-pod path crashed on the
+        # pod, attribution free)
+        self.poison_pods = LabeledCounter(
+            "scheduler_poison_pods_total", ("reason",),
+            values={"reason": ("featurize", "sentinel", "bisect", "gang",
+                               "golden")})
         # node lifecycle / eviction storm control: per-zone health state
         # (1 on the current state's child, 0 on the others), evictions
         # actually executed per zone, evictions due-but-held by the

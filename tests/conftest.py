@@ -85,6 +85,11 @@ def pytest_configure(config):
                    "detection, quarantine/probe, reform ladder "
                    "8->4->2->1->heal, twin salvage parity; make chaos + "
                    "make multichip)")
+    config.addinivalue_line(
+        "markers", "poison: poison-work isolation suite (input-fault "
+                   "attribution vs device faults, wave bisection, pod "
+                   "quarantine/re-probe, numeric-integrity sentinels; "
+                   "make chaos)")
 
 
 import pytest  # noqa: E402
