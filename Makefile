@@ -48,14 +48,17 @@ verify: lint test
 # twin-salvage placement parity)
 # + the `poison` poison-work isolation suite (input-fault attribution
 # vs device faults, wave bisection, pod quarantine/re-probe, the
-# kernel's numeric-integrity sentinels).
+# kernel's numeric-integrity sentinels)
+# + the `autopilot` promotion-pipeline suite (trainer fault points,
+# gate rejections, force-promote -> regression-watch auto-rollback,
+# candidate-deleted-mid-gating races).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

@@ -874,20 +874,19 @@ def run_preempt_config(nodes, pods, wave, device=True, mesh=None):
 # placement for every class (shedding must delay low pods, never
 # starve them).
 
-# class -> pod priority (sched/queue.py bands: system >= 2e9,
-# high >= 1000, normal > 0, low <= 0)
-STORM_PRIORITY = {"system": 2_000_000_000, "high": 10_000,
-                  "normal": 10, "low": 0}
-# p99 SLO gates in seconds for the PROTECTED classes — the ones above
-# the shed threshold, which the overload plane exists to defend.
-# normal/low sit below the threshold, shed legitimately under storms,
-# and are gated on eventual placement instead (their p99 is still
-# reported). The floor of high-class latency is one wave's wall time
-# (~1.3s on an otherwise-idle CPU backend at the suite shape, ~3s
+# The class->priority map and the protected-class p99 gates are shared
+# with the autopilot's promotion CI (autopilot/replay.py holds the
+# canonical copies) so the bench gates and the gates a candidate weight
+# profile must clear before going live cannot drift apart. Rationale:
+# normal/low sit below the shed threshold, shed legitimately under
+# storms, and are gated on eventual placement instead (their p99 is
+# still reported). The floor of high-class latency is one wave's wall
+# time (~1.3s on an otherwise-idle CPU backend at the suite shape, ~3s
 # under CPU contention) — the gates carry that headroom while still
 # failing loudly on starvation, which shows as tens-of-seconds p99
-# (low's burst p99 is ~80-120s while it sheds)
-STORM_SLO_P99 = {"system": 5.0, "high": 5.0}
+# (low's burst p99 is ~80-120s while it sheds).
+from kubernetes_tpu.autopilot.replay import (STORM_PRIORITY,  # noqa: E402
+                                             STORM_SLO_P99)
 
 
 def _storm_traces(wave):

@@ -7,6 +7,7 @@ argument (or by the name it was invoked as). Here:
     python -m kubernetes_tpu.cli.hyperkube <component> [args...]
 
 with components kubectl, kube-scheduler, kube-proxy, kubeadm,
+autopilot (offline weight training + standalone promotion CI), and
 csi-mock-driver (the standalone mock CSI driver process).
 """
 
@@ -28,6 +29,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             from . import kube_proxy as m
         elif name == "kubeadm":
             from . import kubeadm as m
+        elif name == "autopilot":
+            from . import autopilot as m
         elif name == "csi-mock-driver":
             from ..volume import csi as m
         else:
@@ -36,7 +39,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     usage = ("usage: hyperkube <component> [args...]\n"
              "components: kubectl kube-scheduler kube-proxy kubeadm "
-             "csi-mock-driver")
+             "autopilot csi-mock-driver")
     if argv and argv[0] in ("-h", "--help", "help"):
         print(usage)  # requested help: stdout, success
         return 0

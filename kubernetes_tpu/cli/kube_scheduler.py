@@ -119,6 +119,24 @@ class HealthServer:
                         else:
                             body = json.dumps(book.index()).encode()
                             ctype = "application/json"
+                elif self.path.startswith("/debug/autopilot"):
+                    # autopilot promotion pipeline: current phase,
+                    # candidate under evaluation, gate reports and the
+                    # bounded transition history
+                    # (autopilot/controller.py status())
+                    sched = outer.scheduler_ref()
+                    ap = getattr(sched, "autopilot", None)
+                    if ap is None:
+                        body = b"no autopilot controller attached\n"
+                        self.send_response(404)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    body = json.dumps(ap.status()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/debug/score"):
                     # decision observatory: per-pod score decomposition
                     # ("why did node-42 win"). ?uid=<pod uid> for one
@@ -292,7 +310,10 @@ def run(cfg: KubeSchedulerConfiguration, server_url: str,
 
         trace_owned = tracing.active() is None
         tracing.enable(max_rounds=cfg.trace_rounds,
-                       ledger_path=cfg.round_ledger_path or None)
+                       ledger_path=cfg.round_ledger_path or None,
+                       ledger_max_bytes=(cfg.round_ledger_max_bytes
+                                         if cfg.round_ledger_max_bytes >= 0
+                                         else None))
     try:
         return _run_inner(cfg, server_url, token, stop, once, ca_cert_pem,
                           client_cert_pem, client_key_pem,
@@ -451,6 +472,10 @@ def main(argv=None) -> int:
                     help="append one structured JSONL record per "
                          "scheduling round to this file (requires "
                          "--tracing)")
+    ap.add_argument("--round-ledger-max-bytes", type=int, default=None,
+                    help="rotate the round ledger to <path>.1 before it "
+                         "exceeds this many bytes (one generation kept; "
+                         "0 disables rotation, default 64MiB)")
     ap.add_argument("--weight-profiles", default=None,
                     help="JSON file of WeightProfiles ([{name, weights, "
                          "role}]) preloaded into the shadow-scoring "
@@ -514,6 +539,8 @@ def main(argv=None) -> int:
         cfg.trace_rounds = args.trace_rounds
     if args.round_ledger is not None:
         cfg.round_ledger_path = args.round_ledger
+    if args.round_ledger_max_bytes is not None:
+        cfg.round_ledger_max_bytes = args.round_ledger_max_bytes
     if args.weight_profiles is not None:
         cfg.weight_profiles_path = args.weight_profiles
     if args.shadow_exact_interval is not None:

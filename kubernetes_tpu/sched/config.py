@@ -78,6 +78,10 @@ class KubeSchedulerConfiguration:
     tracing: bool = False
     trace_rounds: int = 64
     round_ledger_path: str = ""
+    # ledger size cap in bytes: the file rotates to "<path>.1" (one
+    # generation kept) before exceeding it; 0 disables rotation, -1
+    # keeps the tracing default (utils/tracing.py LEDGER_MAX_BYTES)
+    round_ledger_max_bytes: int = -1
     # shadow-scoring observatory (sched/weights.py): candidate/live
     # WeightProfiles preloaded from a JSON file (the store-watched
     # `weightprofiles` kind is the dynamic path); exact mode replays

@@ -428,6 +428,9 @@ class Scheduler:
         self.weightbook = WeightBook(self.profile.weights())
         self.shadow_exact_interval = int(shadow_exact_interval)
         self._shadow_rounds = 0
+        # the autopilot controller (autopilot/controller.py) registers
+        # itself here; the HealthServer serves it at /debug/autopilot
+        self.autopilot = None
         self._wire_informers()
 
     # -- informer handlers (reference: factory.go:191-295) --------------------

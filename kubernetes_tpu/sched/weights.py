@@ -215,8 +215,13 @@ class WeightBook:
                 # selection would turn nondeterministic)
                 self._synthetic_version += 1
                 version = self._synthetic_version
+            prev = self._profiles.get(obj.metadata.name)
             self._profiles[obj.metadata.name] = {
-                "vec": vec, "version": version, "role": role}
+                "vec": vec, "version": version, "role": role,
+                # the autopilot pre-compile gating flag survives object
+                # updates: re-emitting a candidate mid-evaluation must
+                # not silently drop its planes from the compiled program
+                "gate": bool(prev and prev.get("gate"))}
             self._stats.setdefault(obj.metadata.name, _ProfileStats())
 
     def on_profile_delete(self, obj) -> None:
@@ -249,6 +254,51 @@ class WeightBook:
             for p in self._profiles.values():
                 p["role"] = api.WEIGHT_PROFILE_ROLE_CANDIDATE
 
+    def set_role(self, name: str, role: str) -> bool:
+        """Targeted in-memory role change for one profile (the
+        autopilot's promote/demote lever when the profile has no store
+        object). Demoting only the promoted candidate — instead of
+        rollback()'s demote-everything — restores whatever was live
+        before it (highest-version live wins again). False when the
+        profile isn't loaded."""
+        with self._lock:
+            p = self._profiles.get(name)
+            if p is None:
+                return False
+            p["role"] = role
+            return True
+
+    def set_gating(self, name: str, flag: bool = True) -> bool:
+        """Autopilot pre-compile gating: include this candidate's
+        vector in the kernel's static gating Weights while it is under
+        evaluation. Planes only the candidate activates then compile at
+        evaluation START (one compile, before any gate verdict), so a
+        later promotion to live is a pure traced-value swap — zero
+        recompiles at the moment that matters. False when the profile
+        isn't loaded."""
+        with self._lock:
+            p = self._profiles.get(name)
+            if p is None:
+                return False
+            p["gate"] = bool(flag)
+            return True
+
+    def has_profile(self, name: str) -> bool:
+        with self._lock:
+            return name in self._profiles
+
+    def stats_snapshot(self, name: str) -> Dict[str, float]:
+        """Raw cumulative shadow counters for one profile — the
+        autopilot shadow gate diffs two snapshots to score exactly its
+        gating window, not the profile's lifetime."""
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                return {"pods": 0, "flips": 0, "delta_n": 0,
+                        "delta_sum": 0.0}
+            return {"pods": st.pods, "flips": st.flips,
+                    "delta_n": st.delta_n, "delta_sum": st.delta_sum}
+
     # -- live vector ---------------------------------------------------------
 
     def _live_item(self):
@@ -278,14 +328,24 @@ class WeightBook:
                 return STATIC_VERSION
             return f"{item[0]}@{item[1]['version']}"
 
+    def _gating_vecs(self):
+        """Vectors of profiles under autopilot pre-compile gating.
+        Caller holds _lock."""
+        return [p["vec"] for p in self._profiles.values()
+                if p.get("gate")]
+
     def gate(self, base: Weights) -> Weights:
         """The kernel's static gating Weights for the current live
-        vector (see gate_weights)."""
+        vector plus any candidates under autopilot pre-compile gating
+        (see gate_weights / set_gating)."""
         with self._lock:
             item = self._live_item()
-            if item is None:
+            vecs = self._gating_vecs()
+            if item is not None:
+                vecs.append(item[1]["vec"])
+            if not vecs:
                 return base
-            return gate_weights(base, item[1]["vec"])
+            return gate_weights(base, *vecs)
 
     def dispatch_view(self, base: Weights):
         """(gating Weights, live f32 [S] vector, version string) under
@@ -293,13 +353,20 @@ class WeightBook:
         records decisions, and ledgers with. Resolving the triple
         atomically means a concurrent swap or rollback() (which takes
         only this lock, not the scheduler lock) can never split the
-        vector a round dispatched under from the version it reports."""
+        vector a round dispatched under from the version it reports.
+        Gating folds in set_gating candidates so promoting one later
+        leaves the gating Weights — and therefore the jit cache key —
+        unchanged."""
         with self._lock:
             item = self._live_item()
+            vecs = self._gating_vecs()
             if item is None:
-                return base, self._static_vec, STATIC_VERSION
+                if not vecs:
+                    return base, self._static_vec, STATIC_VERSION
+                return (gate_weights(base, *vecs), self._static_vec,
+                        STATIC_VERSION)
             name, p = item
-            return (gate_weights(base, p["vec"]), p["vec"],
+            return (gate_weights(base, p["vec"], *vecs), p["vec"],
                     f"{name}@{p['version']}")
 
     # -- shadow candidates ---------------------------------------------------
@@ -466,6 +533,8 @@ class WeightBook:
                                 for s in range(len(SCORE_STACK))
                                 if p["vec"][s]},
                 }
+                if p.get("gate"):
+                    entry["gating"] = True
                 if st is not None:
                     entry.update(st.as_dict())
                 profiles[name] = entry

@@ -101,6 +101,16 @@ Wired points (grep for `faultpoints.fire`):
                    the scheduler falls back to a plain backoff park, so
                    chaos can probe that poison handling degrades to
                    pre-PR-15 behavior instead of wedging)
+  autopilot.train  autopilot/trainer.py Trainer.fit entry (payload: the
+                   LedgerDataset) — a `raise` fails a training job
+                   cleanly before any candidate is emitted; `latency`
+                   models a slow fit on a big ledger
+  autopilot.promote  autopilot/controller.py _promote, BEFORE the
+                   role=live write (payload: candidate name) — a
+                   `raise` aborts the pipeline at the most dangerous
+                   instant; the chaos assert is that nothing was
+                   promoted, the gating flag is dropped, and the
+                   outcome ledgered as `aborted`
 
 Modes:
 

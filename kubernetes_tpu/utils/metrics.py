@@ -468,6 +468,15 @@ class Metrics:
             "scheduler_shadow_margin_delta",
             buckets=[-100.0, -50.0, -20.0, -10.0, -5.0, -2.0, -1.0, 0.0,
                      1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0])
+        # autopilot promotion pipeline (autopilot/controller.py):
+        # terminal verdicts per candidate run — promoted counts the
+        # go-live transition, rolled_back the regression watch firing
+        # after one (a force-promoted regression increments both)
+        self.autopilot_promotions = LabeledCounter(
+            "scheduler_autopilot_promotions_total", ("outcome",),
+            values={"outcome": (
+                "promoted", "rejected_shadow", "rejected_replay",
+                "rolled_back", "aborted")})
         # first-fail predicate attribution for unschedulable pods —
         # previously reachable only through events and FitError text,
         # invisible to dashboards

@@ -29,10 +29,11 @@ so every instrumentation site costs one attribute read.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # Round-ledger JSONL schema version, stamped on every record as "v".
 # Bump when a field changes meaning or disappears; ADDING fields is not
@@ -46,11 +47,30 @@ from typing import Any, Dict, List, Optional, Tuple
 # gaps). v1 readers that honor the ignore-unknown-keys contract parse
 # v2 records unchanged — the bump marks that `scores`/decision weights
 # now describe the LIVE vector, not necessarily the static defaults.
+#
+# v2 additions (autopilot, no bump — additive): standalone
+# `kind: "autopilot"` records (round 0, no spans) ledger every
+# candidate-lifecycle transition of the promotion pipeline; the file
+# itself is size-capped and rotates to "<path>.1" (LEDGER_MAX_BYTES).
 LEDGER_VERSION = 2
 
 # bounded per-pod decision map (the /debug/score backing store): the
 # most recent placement decision per pod UID, evicted oldest-first
 MAX_DECISIONS = 4096
+
+# ledger rotation: the JSONL file is size-capped — when an append would
+# push it past the cap, the file is renamed to "<path>.1" (replacing any
+# previous rotation) and a fresh file starts. One rotation generation
+# keeps at most 2x the cap on disk, so a long autopilot run can never
+# fill the volume; readers (autopilot/dataset.py) stream "<path>.1"
+# first, then "<path>", so rotation loses at most one generation of
+# history, never recent records. 0 disables the cap (unbounded append,
+# the pre-rotation behavior).
+LEDGER_MAX_BYTES = 64 * 1024 * 1024
+
+# standalone (round-less) ledger records retained in memory for
+# ledger_rows() / /debug endpoints — autopilot transitions and the like
+MAX_EXTRA_RECORDS = 256
 
 
 class Span:
@@ -177,9 +197,16 @@ class FlightRecorder:
 
     def __init__(self, max_rounds: int = 64,
                  ledger_path: Optional[str] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 ledger_max_bytes: int = LEDGER_MAX_BYTES):
         self.clock = clock
         self.ledger_path = ledger_path
+        self.ledger_max_bytes = int(ledger_max_bytes)
+        self.ledger_rotations = 0
+        # file appends serialize on their own lock, never _lock: a slow
+        # or rotating disk write must not block span recording
+        self._ledger_io = threading.Lock()
+        self._ledger_bytes: Optional[int] = None
         self._lock = threading.Lock()
         self.epoch = clock()
         self.epoch_wall = time.time()
@@ -196,6 +223,13 @@ class FlightRecorder:
         # its most recent placement (scheduler._record_decisions feeds
         # it; /debug/score?uid= serves it)
         self.decisions: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        # standalone records appended outside any round (autopilot
+        # promotion transitions), served alongside round records
+        self.extra_records: deque = deque(maxlen=MAX_EXTRA_RECORDS)
+        # round observers: called with each finished round's ledger
+        # record, OUTSIDE the recorder lock (the autopilot regression
+        # watch subscribes here). An observer must never fail a round.
+        self.observers: List[Callable[[Dict[str, Any]], None]] = []
 
     def now(self) -> float:
         return self.clock()
@@ -233,13 +267,59 @@ class FlightRecorder:
             # record built under the lock (span/event containers are
             # append-racy from binder threads); the file write is not
             rec = self._ledger_record(rt)
-        if self.ledger_path:
+        self._write_ledger_line(rec)
+        for fn in list(self.observers):
             try:
+                fn(rec)
+            except Exception:
+                pass  # an observer must never fail a scheduling round
+
+    def _write_ledger_line(self, rec: Dict[str, Any]) -> None:
+        """Append one record to the JSONL ledger, rotating the file to
+        `<path>.1` when the append would push it past ledger_max_bytes.
+        Serialized on _ledger_io (never _lock): end_round and
+        append_record can land from different threads and the
+        size-check + rename + write must be atomic against each other."""
+        if not self.ledger_path:
+            return
+        line = json.dumps(rec) + "\n"
+        with self._ledger_io:
+            try:
+                if self._ledger_bytes is None:
+                    # adopt whatever an earlier run left behind so the
+                    # cap holds across process restarts
+                    try:
+                        self._ledger_bytes = os.path.getsize(
+                            self.ledger_path)
+                    except OSError:
+                        self._ledger_bytes = 0
+                if (self.ledger_max_bytes > 0 and self._ledger_bytes > 0
+                        and self._ledger_bytes + len(line)
+                        > self.ledger_max_bytes):
+                    os.replace(self.ledger_path, self.ledger_path + ".1")
+                    self.ledger_rotations += 1
+                    self._ledger_bytes = 0
                 with open(self.ledger_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
+                    f.write(line)
+                self._ledger_bytes += len(line)
                 self.ledger_records += 1
             except OSError:
                 pass  # a full disk must never fail a scheduling round
+
+    def append_record(self, kind: str, **fields) -> Dict[str, Any]:
+        """Standalone ledger record outside any round — the autopilot
+        controller ledgers every candidate-lifecycle transition through
+        here (kind "autopilot"). Carries the schema version and a
+        round of 0 (no round envelope); conditional fields follow the
+        absent-not-null contract like round records."""
+        rec: Dict[str, Any] = {
+            "v": LEDGER_VERSION, "round": 0, "kind": kind,
+            "ts": round(self.epoch_wall + (self.now() - self.epoch), 6)}
+        rec.update({k: v for k, v in fields.items() if v is not None})
+        with self._lock:
+            self.extra_records.append(rec)
+        self._write_ledger_line(rec)
+        return rec
 
     def current(self) -> RoundTrace:
         """The in-flight round, or the background pseudo-round."""
@@ -302,10 +382,11 @@ class FlightRecorder:
 
     def ledger_rows(self) -> List[Dict[str, Any]]:
         """The ring buffer's rounds as ledger records (finished rounds
-        only) — what the JSONL file would contain, served live."""
+        only) plus buffered standalone records — what the JSONL file
+        would contain, served live."""
         with self._lock:
-            return [self._ledger_record(r) for r in self.rounds
-                    if r.t1 is not None]
+            return ([self._ledger_record(r) for r in self.rounds
+                     if r.t1 is not None] + list(self.extra_records))
 
     # -- exports -------------------------------------------------------------
 
@@ -430,17 +511,23 @@ _ACTIVE: Optional[FlightRecorder] = None
 
 
 def enable(max_rounds: int = 64, ledger_path: Optional[str] = None,
-           clock=time.monotonic) -> FlightRecorder:
+           clock=time.monotonic,
+           ledger_max_bytes: Optional[int] = None) -> FlightRecorder:
     """Install the process-global recorder. An already-active recorder
-    is returned as-is EXCEPT that a newly-requested ledger path is
-    adopted (the caller asked for a ledger; losing it silently cost a
-    run's records) — ring size and clock stay with the original."""
+    is returned as-is EXCEPT that a newly-requested ledger path (and
+    its rotation cap) is adopted (the caller asked for a ledger; losing
+    it silently cost a run's records) — ring size and clock stay with
+    the original."""
     global _ACTIVE
     if _ACTIVE is None:
-        _ACTIVE = FlightRecorder(max_rounds=max_rounds,
-                                 ledger_path=ledger_path, clock=clock)
+        _ACTIVE = FlightRecorder(
+            max_rounds=max_rounds, ledger_path=ledger_path, clock=clock,
+            ledger_max_bytes=(LEDGER_MAX_BYTES if ledger_max_bytes is None
+                              else ledger_max_bytes))
     elif ledger_path and not _ACTIVE.ledger_path:
         _ACTIVE.ledger_path = ledger_path
+        if ledger_max_bytes is not None:
+            _ACTIVE.ledger_max_bytes = int(ledger_max_bytes)
     return _ACTIVE
 
 

@@ -90,6 +90,10 @@ def pytest_configure(config):
                    "attribution vs device faults, wave bisection, pod "
                    "quarantine/re-probe, numeric-integrity sentinels; "
                    "make chaos)")
+    config.addinivalue_line(
+        "markers", "autopilot: autopilot suite (ledger dataset + ridge "
+                   "trainer, shadow/replay promotion gates, regression "
+                   "watch auto-rollback, /debug/autopilot; make chaos)")
 
 
 import pytest  # noqa: E402

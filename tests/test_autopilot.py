@@ -1,0 +1,541 @@
+"""Scheduler autopilot: offline trainer + gated auto-promotion (ISSUE 16).
+
+Property groups:
+
+  1. LEDGER ROTATION — the round ledger rotates to <path>.1 before
+     exceeding its byte cap (counter-visible), 0 disables, and the
+     dataset loader reads the rotated generation oldest-first.
+  2. DATASET — ledger JSONL streams into dense feature/outcome
+     matrices tolerant of unknown keys, mixed schema versions,
+     recordless rounds, and torn lines (the ignore-unknown-keys
+     ledger contract, exercised).
+  3. TRAINER — the ridge fit boosts the priority whose contribution
+     share correlates with round quality (bounded by `step`),
+     introduces zero-base priorities only on positive evidence, fails
+     loudly below the evidence floor, and emits candidates through the
+     store watch path. The policy-gradient seam stays a seam.
+  4. REPLAY CI — the storm trace-replay gate passes the static
+     defaults and shares its SLO constants with bench.py bitwise.
+  5. PROMOTION PIPELINE E2E — a trainer-emitted candidate passes the
+     shadow + replay gates and goes live with ZERO recompiles
+     (cache-size asserted); a seeded regression candidate is rejected
+     at the shadow gate; force-promoted anyway, the regression watch
+     auto-rolls-back and restores the prior live vector — every
+     transition ledgered (kind "autopilot"), metered, and served at
+     /debug/autopilot. Candidate deletion mid-gating aborts cleanly.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from helpers import make_node, make_pod
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.autopilot import (AutopilotConfig, AutopilotController,
+                                      OUTCOMES, workload_profiles_path)
+from kubernetes_tpu.autopilot.dataset import (FEATURES, build_dataset,
+                                              load_dataset, load_records,
+                                              round_quality)
+from kubernetes_tpu.autopilot.replay import (STORM_PRIORITY, STORM_SLO_P99,
+                                             run_replay)
+from kubernetes_tpu.autopilot.trainer import (PolicyGradientTrainer,
+                                              RidgeTrainer, emit_candidate)
+from kubernetes_tpu.plugins.registry import default_profile
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import faultpoints, tracing
+
+pytestmark = pytest.mark.autopilot
+
+# replay CI shape used throughout: matches the live test cluster (3
+# 8-core nodes, wave 8) so a promotion adds zero jit entries, with SLO
+# headroom for contended CI hosts
+_REPLAY_KW = dict(replay_nodes=3, replay_wave=8, replay_slo_scale=4.0)
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    tracing.disable()
+    yield
+    tracing.disable()
+
+
+def _profile(name, weights, role="candidate"):
+    return api.WeightProfile(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.WeightProfileSpec(weights=weights, role=role))
+
+
+def _skewed_cluster():
+    """3 identical nodes at strictly distinct usage (6/3/0 cores of 8):
+    LeastRequested-family defaults pick n2, MostRequested strictly
+    prefers n0 — flips are strict, margins ~4 score units."""
+    rec = tracing.enable()
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=8)
+    for i in range(3):
+        store.create("nodes", make_node(f"n{i}", cpu="8"))
+    for i in range(6):
+        p = make_pod(f"pre0-{i}", cpu="1")
+        p.spec.node_name = "n0"
+        store.create("pods", p)
+    for i in range(3):
+        p = make_pod(f"pre1-{i}", cpu="1")
+        p.spec.node_name = "n1"
+        store.create("pods", p)
+    return rec, store, sched
+
+
+def _controller(sched, store, **over):
+    kw = dict(min_shadow_pods=3, watch_rounds=2, watch_margin_floor=1.0,
+              **_REPLAY_KW)
+    kw.update(over)
+    return AutopilotController(sched, store=store,
+                               config=AutopilotConfig(**kw))
+
+
+def _run_rounds(store, sched, n, tag):
+    for i in range(n):
+        store.create("pods", make_pod(f"{tag}-{i}", cpu="100m"))
+        assert sched.schedule_pending() == 1
+
+
+def _round_rec(rid, util, frag, breakdown, version="static", **extra):
+    """A synthetic v2 round-ledger record with a scores aggregate."""
+    total = float(sum(breakdown.values()))
+    rec = {"v": 2, "round": rid, "kind": "round", "placed": 8,
+           "pending": 0, "wall_s": 0.01, "weights_version": version,
+           "scores": {"min": total, "max": total, "mean": total,
+                      "breakdown": dict(breakdown),
+                      "margin": {"min": 1.0, "mean": 2.0, "max": 4.0}},
+           "telemetry": {"util": {"cpu": util}, "frag": {"cpu": frag}}}
+    rec.update(extra)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# 1. ledger rotation
+
+
+class TestLedgerRotation:
+    def test_rotates_with_counter(self, tmp_path):
+        path = str(tmp_path / "rounds.jsonl")
+        rec = tracing.FlightRecorder(ledger_path=path,
+                                     ledger_max_bytes=400)
+        for i in range(20):
+            rec.append_record("autopilot", state="shadowing",
+                              profile=f"cand-{i:04d}")
+        assert rec.ledger_rotations >= 1
+        assert (tmp_path / "rounds.jsonl.1").exists()
+        # every surviving line in BOTH generations still parses
+        for p in (path + ".1", path):
+            for line in open(p):
+                assert json.loads(line)["kind"] == "autopilot"
+        # the live file respects the cap (rotation happens BEFORE the
+        # write that would exceed it)
+        import os
+
+        assert os.path.getsize(path) <= 400
+
+    def test_zero_cap_disables_rotation(self, tmp_path):
+        path = str(tmp_path / "rounds.jsonl")
+        rec = tracing.FlightRecorder(ledger_path=path, ledger_max_bytes=0)
+        for i in range(50):
+            rec.append_record("autopilot", state="x", profile="p")
+        assert rec.ledger_rotations == 0
+        assert not (tmp_path / "rounds.jsonl.1").exists()
+        assert rec.ledger_records == 50
+
+    def test_loader_reads_rotated_generation_first(self, tmp_path):
+        path = str(tmp_path / "rounds.jsonl")
+        with open(path + ".1", "w") as f:
+            f.write(json.dumps({"v": 2, "round": 1}) + "\n")
+        with open(path, "w") as f:
+            f.write(json.dumps({"v": 2, "round": 2}) + "\n")
+        records, skipped = load_records(path)
+        assert [r["round"] for r in records] == [1, 2]
+        assert skipped == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. dataset robustness
+
+
+class TestDatasetRobustness:
+    def test_unknown_keys_mixed_versions_torn_lines(self, tmp_path):
+        path = str(tmp_path / "rounds.jsonl")
+        rows = [
+            _round_rec(1, 0.5, 0.2, {"LeastRequested": 8.0}),
+            # unknown keys ride along untouched (the ledger contract)
+            _round_rec(2, 0.6, 0.1, {"LeastRequested": 9.0},
+                       version="cand@3", future_key={"x": 1}),
+            # a v99 record with a scores aggregate still trains
+            _round_rec(3, 0.4, 0.3, {"BalancedAllocation": 2.0}, v=99),
+            # recordless rounds / transition records are skipped
+            {"v": 2, "round": 4, "kind": "autopilot", "state": "promoted"},
+            {"v": 1, "round": 5, "placed": 3},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+            f.write("[1, 2, 3]\n")        # decodable, not a record
+            f.write('{"torn": "lin')      # a crash mid-write
+        ds = load_dataset(path)
+        assert len(ds) == 3
+        assert ds.features.shape == (3, len(FEATURES))
+        assert ds.skipped == 4  # 2 recordless + 1 non-dict + 1 torn
+        assert ds.versions[1] == "cand@3"
+        assert set(ds.active_priorities()) == {"LeastRequested",
+                                               "BalancedAllocation"}
+
+    def test_missing_file_is_empty_dataset(self, tmp_path):
+        ds = load_dataset(str(tmp_path / "nope.jsonl"))
+        assert len(ds) == 0
+        assert ds.skipped == 0
+
+    def test_round_quality_prefers_packed_decisive_rounds(self):
+        good = _round_rec(1, 0.9, 0.1, {"LeastRequested": 8.0})
+        bad = _round_rec(2, 0.2, 0.8, {"LeastRequested": 8.0})
+        assert round_quality(good) > round_quality(bad)
+
+
+# ---------------------------------------------------------------------------
+# 3. trainer
+
+
+def _planted_records(n=16, prio="LeastRequested", anti="BalancedAllocation",
+                     invert=False):
+    """Rounds where `prio`'s contribution share tracks round quality
+    (utilization) and `anti`'s anti-tracks it — the signal a fit must
+    recover. invert=True flips the correlation."""
+    out = []
+    for i in range(n):
+        share = i / (n - 1)
+        util = 0.2 + 0.6 * ((1 - share) if invert else share)
+        out.append(_round_rec(i, util, 0.2,
+                              {prio: 1.0 + 9.0 * share,
+                               anti: 1.0 + 9.0 * (1 - share)}))
+    return out
+
+
+class TestRidgeTrainer:
+    def _base(self):
+        return default_profile(None).weights()
+
+    def test_boosts_correlated_priority_bounded_by_step(self):
+        trainer = RidgeTrainer(self._base(), step=0.5)
+        out = trainer.fit(build_dataset(_planted_records()))
+        # LeastRequested (base 1.0) moves up, BalancedAllocation down,
+        # each by at most `step` of its base
+        assert 1.0 < out["LeastRequested"] <= 1.5
+        assert 0.5 <= out["BalancedAllocation"] < 1.0
+        # priorities with no evidence keep their base weight
+        assert out["PreferAvoid"] == 10000.0
+
+    def test_zero_base_priority_needs_positive_evidence(self):
+        # MostRequested has base weight 0; positive correlation
+        # introduces it, negative correlation must NOT (negative
+        # evidence about an inactive plane keeps it off)
+        up = RidgeTrainer(self._base()).fit(build_dataset(
+            _planted_records(prio="MostRequested")))
+        assert up.get("MostRequested", 0.0) > 0.0
+        down = RidgeTrainer(self._base()).fit(build_dataset(
+            _planted_records(prio="MostRequested", invert=True)))
+        assert "MostRequested" not in down
+
+    def test_evidence_floor_and_no_signal_errors(self):
+        trainer = RidgeTrainer(self._base(), min_rounds=4)
+        with pytest.raises(ValueError, match="scored rounds"):
+            trainer.fit(build_dataset(_planted_records(n=3)))
+        # rounds whose breakdowns carry no tunable contribution
+        blank = [_round_rec(i, 0.5, 0.2, {"HostExtra": 5.0})
+                 for i in range(8)]
+        with pytest.raises(ValueError, match="no tunable"):
+            trainer.fit(build_dataset(blank))
+
+    def test_policy_gradient_is_a_seam(self):
+        with pytest.raises(NotImplementedError, match="policy-gradient"):
+            PolicyGradientTrainer(self._base()).fit(
+                build_dataset(_planted_records()))
+
+    def test_train_faultpoint(self):
+        trainer = RidgeTrainer(self._base())
+        with faultpoints.injected("autopilot.train", "raise"):
+            with pytest.raises(faultpoints.FaultInjected):
+                trainer.fit(build_dataset(_planted_records()))
+        assert faultpoints.hits("autopilot.train") == 1
+
+    def test_emit_candidate_through_store_watch_path(self):
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=8)
+        try:
+            emit_candidate(store, "trained", {"LeastRequested": 1.4})
+            # the scheduler's informer loaded it — same path as an
+            # operator-applied WeightProfile
+            assert sched.weightbook.has_profile("trained")
+            wp = store.get("weightprofiles", "default", "trained")
+            assert wp.spec.role == api.WEIGHT_PROFILE_ROLE_CANDIDATE
+            # a retrain supersedes in place (and re-demotes to candidate)
+            wp.spec.role = api.WEIGHT_PROFILE_ROLE_LIVE
+            store.update("weightprofiles", wp)
+            emit_candidate(store, "trained", {"LeastRequested": 1.8})
+            wp2 = store.get("weightprofiles", "default", "trained")
+            assert wp2.spec.weights == {"LeastRequested": 1.8}
+            assert wp2.spec.role == api.WEIGHT_PROFILE_ROLE_CANDIDATE
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. replay CI
+
+
+class TestReplayCI:
+    def test_baseline_replay_passes_gates(self):
+        rep = run_replay(None, nodes=3, wave=8, slo_scale=4.0)
+        assert rep.passed and not rep.failures
+        assert rep.placed == rep.total > 0
+        assert rep.version == "static"
+        assert 0.0 < rep.util <= 1.0
+        assert set(rep.p99) <= set(STORM_PRIORITY)
+        json.dumps(rep.as_dict())  # /debug + CI output must serialize
+
+    def test_storm_gates_shared_with_bench(self):
+        # bench.py's storm harness and the promotion CI must gate on the
+        # SAME objects — drift-proof by identity, not equality
+        import bench
+
+        assert bench.STORM_SLO_P99 is STORM_SLO_P99
+        assert bench.STORM_PRIORITY is STORM_PRIORITY
+
+
+# ---------------------------------------------------------------------------
+# 5. promotion pipeline end to end
+
+
+class TestPromotionPipeline:
+    def test_trained_candidate_promoted_with_zero_recompiles(self):
+        from kubernetes_tpu.ops.kernel import _schedule_round
+
+        rec, store, sched = _skewed_cluster()
+        try:
+            ctl = _controller(sched, store)
+            # offline half: fit on a planted ledger, emit the candidate
+            # through the store watch path
+            trained = RidgeTrainer(default_profile(None).weights()).fit(
+                build_dataset(_planted_records()))
+            assert trained["LeastRequested"] > 1.0
+            emit_candidate(store, "trained", trained)
+            assert ctl.start("trained") == "shadowing"
+            # live traffic accumulates shadow evidence; the boosted
+            # table agrees with production on this cluster (no flips)
+            _run_rounds(store, sched, 3, "gate")
+            cache0 = _schedule_round._cache_size()
+            assert ctl.step() == "watching"
+            assert ctl.outcome == "promoted"
+            live = sched.weightbook.live_version()
+            assert live.startswith("trained@")
+            # THE acceptance bit: gates + promotion + replay CI added
+            # zero jit entries — the swap is a traced value
+            assert _schedule_round._cache_size() == cache0
+            # clean watch window completes the run
+            _run_rounds(store, sched, 2, "watch")
+            assert ctl.state == "completed"
+            assert _schedule_round._cache_size() == cache0
+            # transitions ledgered + metered + reported
+            states = [r["state"] for r in rec.ledger_rows()
+                      if r.get("kind") == "autopilot"]
+            assert states == ["shadowing", "replaying", "promoted",
+                              "watching", "completed"]
+            assert sched.metrics.autopilot_promotions.value(
+                outcome="promoted") == 1
+            assert ctl.reports["shadow"]["flip_rate"] <= 0.25
+            assert ctl.reports["replay"]["candidate"]["passed"] is True
+            # post-promotion rounds carry the candidate's version
+            placed = [r for r in rec.ledger_rows() if r.get("placed")]
+            assert placed[-1]["weights_version"] == live
+        finally:
+            sched.close()
+
+    def test_regression_candidate_rejected_at_shadow_gate(self):
+        rec, store, sched = _skewed_cluster()
+        try:
+            ctl = _controller(sched, store)
+            # MostRequested flips EVERY placement on the skewed cluster.
+            # ImageLocality (inert: no images) rides along so the gating
+            # set this test compiles ({MostRequested, ImageLocality})
+            # stays disjoint from the {MostRequested} set test_shadow's
+            # promote-compiles-once assertion expects to compile fresh —
+            # the jit cache is process-global across test files.
+            emit_candidate(store, "packer",
+                           {"MostRequested": 5.0, "ImageLocality": 0.5})
+            ctl.start("packer")
+            _run_rounds(store, sched, 4, "gate")
+            assert ctl.step() == "rejected_shadow"
+            assert ctl.reports["shadow"]["flip_rate"] == 1.0
+            # nothing promoted, pre-compile gating dropped
+            assert sched.weightbook.live_version() == "static"
+            assert "gating" not in \
+                sched.weightbook.index()["profiles"]["packer"]
+            assert sched.metrics.autopilot_promotions.value(
+                outcome="rejected_shadow") == 1
+        finally:
+            sched.close()
+
+    def test_force_promoted_regression_auto_rolled_back(self):
+        rec, store, sched = _skewed_cluster()
+        try:
+            # a prior live profile proves rollback restores IT, not
+            # just the static defaults
+            store.create("weightprofiles",
+                         _profile("good", {"LeastRequested": 2.0,
+                                           "PreferAvoid": 10000.0},
+                                  role="live"))
+            prior = sched.weightbook.live_version()
+            assert prior.startswith("good@")
+            ctl = _controller(sched, store)
+            # near-zero weights collapse decision margins (~0.002 vs
+            # the ~4.0 the watch floor of 1.0 expects)
+            emit_candidate(store, "tiny", {"LeastRequested": 0.001})
+            ctl.start("tiny", force=True)
+            assert ctl.step() == "watching"
+            assert sched.weightbook.live_version().startswith("tiny@")
+            # first watched round breaches the margin floor -> the
+            # observer demotes IN MEMORY before the next round
+            _run_rounds(store, sched, 1, "breach")
+            assert ctl.state == "rolled_back"
+            assert sched.weightbook.live_version() == prior
+            reason = ctl.history[-1]["reason"]
+            assert "margin" in reason
+            # the next round is decided (and ledgered) by the restored
+            # vector
+            _run_rounds(store, sched, 1, "after")
+            placed = [r for r in rec.ledger_rows() if r.get("placed")]
+            assert placed[-1]["weights_version"] == prior
+            # step() reconciles the store object the observer could not
+            # touch (deadlock-free rollback is in-memory only)
+            ctl.step()
+            assert store.get("weightprofiles", "default",
+                             "tiny").spec.role == \
+                api.WEIGHT_PROFILE_ROLE_CANDIDATE
+            states = [r["state"] for r in rec.ledger_rows()
+                      if r.get("kind") == "autopilot"]
+            assert states == ["shadowing", "promoted", "watching",
+                              "rolled_back"]
+            assert sched.metrics.autopilot_promotions.value(
+                outcome="promoted") == 1
+            assert sched.metrics.autopilot_promotions.value(
+                outcome="rolled_back") == 1
+        finally:
+            sched.close()
+
+    def test_candidate_deleted_mid_gating_aborts(self):
+        rec, store, sched = _skewed_cluster()
+        try:
+            ctl = _controller(sched, store)
+            emit_candidate(store, "ghost", {"LeastRequested": 1.2})
+            ctl.start("ghost")
+            _run_rounds(store, sched, 1, "gate")
+            store.delete("weightprofiles", "default", "ghost")
+            assert ctl.step() == "aborted"
+            assert "deleted" in ctl.history[-1]["reason"]
+            assert sched.weightbook.live_version() == "static"
+            assert sched.metrics.autopilot_promotions.value(
+                outcome="aborted") == 1
+            # the controller is reusable after a terminal state
+            emit_candidate(store, "next", {"LeastRequested": 1.2})
+            assert ctl.start("next") == "shadowing"
+        finally:
+            sched.close()
+
+    def test_promote_faultpoint_aborts_cleanly(self):
+        rec, store, sched = _skewed_cluster()
+        try:
+            ctl = _controller(sched, store)
+            emit_candidate(store, "cand", {"LeastRequested": 1.2})
+            ctl.start("cand", force=True)
+            with faultpoints.injected("autopilot.promote", "raise"):
+                assert ctl.step() == "aborted"
+            # the most dangerous instant failed: nothing went live, the
+            # gating flag was dropped
+            assert sched.weightbook.live_version() == "static"
+            assert "gating" not in \
+                sched.weightbook.index()["profiles"]["cand"]
+            assert sched.metrics.autopilot_promotions.value(
+                outcome="aborted") == 1
+        finally:
+            sched.close()
+
+    def test_outcomes_match_declared_metric_values(self):
+        from kubernetes_tpu.utils.metrics import Metrics
+
+        decl = Metrics().autopilot_promotions.decl
+        assert set(decl.values["outcome"]) == set(OUTCOMES)
+
+    def test_debug_autopilot_endpoint(self):
+        from kubernetes_tpu.cli.kube_scheduler import HealthServer
+
+        rec, store, sched = _skewed_cluster()
+        hs = HealthServer(lambda: sched)
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hs.port}{path}") as r:
+                    return r.read().decode()
+
+            # no controller attached yet -> 404, not a crash
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/debug/autopilot")
+            assert ei.value.code == 404
+            ctl = _controller(sched, store)
+            emit_candidate(store, "cand", {"LeastRequested": 1.2})
+            ctl.start("cand")
+            status = json.loads(get("/debug/autopilot"))
+            assert status["state"] == "shadowing"
+            assert status["candidate"] == "cand"
+            assert status["history"][0]["state"] == "shadowing"
+            assert status["weights_version"] == "static"
+            assert status["config"]["watch_rounds"] == 2
+        finally:
+            hs.stop()
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. the checked-in per-workload weight table
+
+
+class TestWorkloadProfiles:
+    def test_table_loads_as_candidate_pool(self):
+        rec, store, sched = _skewed_cluster()
+        try:
+            n = sched.weightbook.load_file(workload_profiles_path())
+            assert n == 4
+            idx = sched.weightbook.index()["profiles"]
+            assert set(idx) == {"density", "trickle", "gang", "storm"}
+            # all candidates: nothing goes live by checking in a file
+            assert sched.weightbook.live_version() == "static"
+            # each entry is a valid autopilot candidate: the controller
+            # opens a gating window on one directly
+            ctl = _controller(sched, store)
+            assert ctl.start("density") == "shadowing"
+            assert sched.weightbook.index()["profiles"]["density"][
+                "gating"] is True
+        finally:
+            sched.close()
+
+    def test_profiles_shape_density_vs_trickle(self):
+        # the tables encode opposite packing intents; guard the file
+        # against a refactor flattening them into one
+        entries = {e["name"]: e["weights"] for e in
+                   json.load(open(workload_profiles_path()))}
+        assert entries["density"]["MostRequested"] > 0
+        assert "LeastRequested" not in entries["density"]
+        assert entries["trickle"]["LeastRequested"] >= 2
+        assert "MostRequested" not in entries["trickle"]
+        assert entries["gang"]["InterPodAffinity"] >= \
+            max(v for k, v in entries["gang"].items()
+                if k != "PreferAvoid" and k != "InterPodAffinity")
