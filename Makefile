@@ -51,14 +51,18 @@ verify: lint test
 # kernel's numeric-integrity sentinels)
 # + the `autopilot` promotion-pipeline suite (trainer fault points,
 # gate rejections, force-promote -> regression-watch auto-rollback,
-# candidate-deleted-mid-gating races).
+# candidate-deleted-mid-gating races)
+# + the `campaign` chaos-campaign suite (kubernetes_tpu/chaos/:
+# cluster-invariant checker mutation tests, fault-point registry drift
+# guard, KTPU_FAULTPOINTS parse hardening, a fixed-seed ~8-schedule
+# campaign smoke, and the broken-build catch-and-shrink acceptance).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot or campaign" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
@@ -85,6 +89,17 @@ multichip:
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
+# Full budgeted chaos campaign (test/e2e/chaosmonkey analog): 200
+# seeded composed fault schedules replayed against the HollowCluster
+# scenario with every cluster invariant checked after each round,
+# capped at 10 minutes of wall clock. Violations exit non-zero and
+# print a shrunk KTPU_FAULTPOINTS reproducer; re-trigger one with
+#   KTPU_FAULTPOINTS='<spec>' $(PYTHON) -m kubernetes_tpu.chaos --repro --seed <seed>
+# The fast fixed-seed smoke lives in `make chaos` (campaign marker).
+chaos-campaign:
+	JAX_PLATFORMS=cpu $(PYTHON) -m kubernetes_tpu.chaos \
+		--seed 7 --schedules 200 --budget 600
+
 # The driver's benchmark surface (real TPU when available; CPU otherwise).
 bench:
 	$(PYTHON) bench.py
@@ -96,5 +111,5 @@ bench-all:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native test test-unit lint verify chaos obs multichip bench \
-	bench-all clean
+.PHONY: all native test test-unit lint verify chaos chaos-campaign obs \
+	multichip bench bench-all clean

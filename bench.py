@@ -50,6 +50,12 @@ measured against the reference's 100 pods/s "healthy" warning level
                 kubemark's HollowCluster with per-priority-class SLO
                 gates (p99 for system/high, zero high-class sheds, no
                 permanent starvation) that FAIL the bench on violation
+  chaoscampaign fixed-seed chaos campaign (kubernetes_tpu/chaos/): 50
+                composed fault schedules replayed against a HollowCluster
+                scenario with every cluster invariant checked after each
+                round; any violation FAILS the bench and prints its
+                shrunk KTPU_FAULTPOINTS reproducer (--seed/--schedules
+                override the grid defaults)
 
 --suite runs the BASELINE config grid and prints one JSON line each;
 a bare `python bench.py` (the driver's command) runs DRIVER_SUITE.
@@ -1044,6 +1050,12 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None,
             pass
     sched.metrics = Metrics()  # drop warm-up observations (the queue's
     # on_shed hook reads sched.metrics at call time — no rebind needed)
+    # continuously-checked invariants ride every storm leg: strict=False
+    # records violations without aborting mid-trace, and the gate below
+    # fails the bench if any round ever broke one
+    from kubernetes_tpu.chaos.invariants import InvariantChecker
+    checker = InvariantChecker(metrics=sched.metrics, strict=False)
+    sched.invariants = checker
     if kill_device is not None:
         # mesh fault leg: the first storm dispatch loses a device — the
         # tick salvages through the twin, the mesh reforms down a rung,
@@ -1181,6 +1193,12 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None,
     if unbound:
         failures.append(f"{len(unbound)} pods never placed "
                         f"(permanent starvation)")
+    if checker.violations:
+        v = checker.violations[0]
+        failures.append(
+            f"{len(checker.violations)} cluster-invariant violation(s) "
+            f"across {checker.checks} checks — first: {v.invariant}: "
+            f"{v.detail}")
     if trace == "burst" and not sheds["low"]:
         failures.append("burst never engaged the shed plane "
                         "(low-class sheds == 0)")
@@ -1243,6 +1261,38 @@ def run_storm_config(nodes, wave, trace="burst", mesh=None,
         sys.exit(1)
     _collect_mesh(sched)
     return placed, dt, _p99(latency["high"]), len(created)
+
+
+def run_chaoscampaign_config(seed=7, schedules=50, ticks=8, budget_s=None):
+    """Fixed-seed chaos campaign as a bench gate: sample `schedules`
+    composed fault schedules, replay each against the HollowCluster
+    scenario with the invariant checker armed strict, and FAIL the
+    bench on any violation (each finding prints its shrunk
+    KTPU_FAULTPOINTS reproducer first). A campaign that injected zero
+    faults is also a failure — a silently-dead injector would turn
+    this gate into a no-op."""
+    from kubernetes_tpu.chaos.campaign import run_campaign
+
+    t0 = time.perf_counter()
+    res = run_campaign(seed, schedules, ticks=ticks, budget_s=budget_s)
+    dt = time.perf_counter() - t0
+    failures = []
+    if res.injected_total == 0:
+        failures.append("campaign injected 0 faults (dead injector?)")
+    for f in res.findings:
+        failures.append(
+            f"invariant {f.outcome.violation}: {f.outcome.detail} — "
+            f"repro: KTPU_FAULTPOINTS='{f.env}' python -m "
+            f"kubernetes_tpu.chaos --repro --seed {f.seed} "
+            f"(env re-triggers: {f.env_retriggers})")
+    print(f"# chaoscampaign: seed={res.seed} schedules={res.schedules} "
+          f"checks={res.checks_total} injected={res.injected_total} "
+          f"findings={len(res.findings)} wall={dt:.2f}s", file=sys.stderr)
+    for f in failures:
+        print(f"FATAL: chaoscampaign: {f}", file=sys.stderr)
+    if failures:
+        sys.exit(1)
+    return res, dt
 
 
 def stage_breakdown(top=12):
@@ -1364,6 +1414,12 @@ SUITE = [
     # trips and mesh reforms both pinned at ZERO
     ("poisonstorm", 100, 0, "storm", ["--trace", "burst", "--wave", "64",
                                       "--poison", "0.01"]),
+    # chaos campaign: 50 seeded composed fault schedules against the
+    # HollowCluster scenario with every cluster invariant checked after
+    # each round — any violation fails the bench and prints its shrunk
+    # KTPU_FAULTPOINTS reproducer (nodes/pods come from the campaign
+    # scenario, not the grid numbers)
+    ("chaoscampaign", 2, 0, "chaoscampaign", []),
     ("mixed5k", 5000, 30000, "mixed", []),
     # fleet scale: 50k nodes / 200k pod churn under the mesh-sharded
     # scheduling plane (--mesh auto shards the node axis across every
@@ -1487,7 +1543,7 @@ def main():
                     choices=["density", "affinity", "spreading",
                              "antiaffinity", "mixed", "gang", "preempt",
                              "trickle", "paced", "autoscale", "partition",
-                             "degraded", "storm"])
+                             "degraded", "storm", "chaoscampaign"])
     ap.add_argument("--trace", default=None,
                     choices=["burst", "diurnal", "gangstorm", "compound"],
                     help="storm workload: which synthetic arrival trace "
@@ -1513,6 +1569,12 @@ def main():
                          "add every-poison-convicted + zero breaker "
                          "trips + zero mesh reforms on top of the "
                          "plain storm's clean-class SLOs")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="chaoscampaign workload: campaign seed "
+                         "(workload derivation + schedule sampling)")
+    ap.add_argument("--schedules", type=int, default=50,
+                    help="chaoscampaign workload: fault schedules to "
+                         "sample and replay")
     ap.add_argument("--host-preempt", action="store_true",
                     help="preempt workload: run the batched what-if on "
                          "the vectorized numpy host twin instead of the "
@@ -1612,6 +1674,25 @@ def main():
 
         _tracing.enable(ledger_path=args.trace_ledger or None)
 
+    if args.workload == "chaoscampaign":
+        res, dt = run_chaoscampaign_config(seed=args.seed,
+                                           schedules=args.schedules)
+        name = args.name or "chaoscampaign"
+        rec = {
+            # the headline is clean schedules survived — the gate
+            # already sys.exit(1)'d if any schedule violated an
+            # invariant or the injector went dead
+            "metric": f"scheduler_{name}_clean_schedules_"
+                      f"seed{res.seed}",
+            "value": res.schedules,
+            "unit": "schedules",
+            "vs_baseline": 1.0,
+            "checks": res.checks_total,
+            "injected": res.injected_total,
+            "wall_s": round(dt, 2),
+        }
+        print(json.dumps(rec), flush=True)
+        return
     if args.workload == "storm":
         trace = args.trace or "burst"
         placed, dt, high_p99, arrivals = run_storm_config(

@@ -1,0 +1,17 @@
+"""Chaos engineering surface: continuously-checked cluster invariants
+(invariants.py) + the seeded fault-schedule campaign runner with
+failing-schedule shrinking (campaign.py, `python -m kubernetes_tpu.chaos`).
+
+The reference exercises failure paths by killing whole components
+(test/e2e/chaosmonkey); this framework's failure surface is internal —
+~25 named fault points (utils/faultpoints.py) across the kernel, bind,
+watch, snapshot, mesh, and autopilot planes. The campaign composes
+those points into randomized fault *schedules*, replays them against a
+kubemark HollowCluster with the invariant checker armed after every
+scheduling round, and shrinks any violating schedule to a minimal
+`KTPU_FAULTPOINTS` reproducer string.
+"""
+
+from .invariants import InvariantChecker, InvariantViolation
+
+__all__ = ["InvariantChecker", "InvariantViolation"]

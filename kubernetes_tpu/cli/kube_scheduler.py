@@ -277,7 +277,8 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
                       shed_priority_threshold=cfg.shed_priority_threshold,
                       shed_age_s=cfg.shed_age_s,
                       wave_deadline_s=cfg.wave_deadline_s,
-                      shadow_exact_interval=cfg.shadow_exact_interval)
+                      shadow_exact_interval=cfg.shadow_exact_interval,
+                      invariants=cfg.invariants)
     if cfg.weight_profiles_path:
         # file-preloaded profiles feed the weight book directly — the
         # store-watched `weightprofiles` kind is the dynamic path, but
@@ -488,6 +489,13 @@ def main(argv=None) -> int:
                          "every Nth traced round through the numpy twin "
                          "under each candidate profile (0 disables; the "
                          "default shadow pass is a top-K lower bound)")
+    ap.add_argument("--invariants", action="store_true",
+                    help="continuously-checked cluster invariants: run "
+                         "the chaos invariant checker after every "
+                         "scheduling round (conservation, double-bind, "
+                         "capacity, snapshot-vs-residents, gang "
+                         "atomicity, state-machine sanity); a violation "
+                         "raises with a full state digest")
     ap.add_argument("--racecheck", action="store_true",
                     help="instrument the scheduler/queue locks with the "
                          "lock-order watcher (go test -race analog; "
@@ -545,6 +553,8 @@ def main(argv=None) -> int:
         cfg.weight_profiles_path = args.weight_profiles
     if args.shadow_exact_interval is not None:
         cfg.shadow_exact_interval = args.shadow_exact_interval
+    if args.invariants:
+        cfg.invariants = True
     if args.racecheck:
         cfg.racecheck = True
     if args.shed_watermark is not None:

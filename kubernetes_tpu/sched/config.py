@@ -98,6 +98,13 @@ class KubeSchedulerConfiguration:
     # directly diffable against ktpu-lint's lock-discipline rule.
     # Dev/test switch: each acquisition pays a dict+list bookkeeping hit.
     racecheck: bool = False
+    # continuously-checked cluster invariants (`--invariants`): arm the
+    # chaos/invariants.py checker after every scheduling round —
+    # conservation, double-bind, capacity, snapshot-vs-residents, gang
+    # atomicity, breaker/mesh/watchdog sanity. A violation raises
+    # InvariantViolation with a state digest. Chaos/dev switch: each
+    # round pays an O(pods + nodes) sweep; off costs one None check.
+    invariants: bool = False
     # informer kinds mirrored before scheduling starts
     feature_gates: dict = field(default_factory=dict)
 

@@ -168,7 +168,8 @@ class Scheduler:
                  wave_deadline_s: float = 0.0,
                  shadow_exact_interval: int = 0,
                  mesh_min_devices: int = 1,
-                 poison_backoff_s: float = 5.0):
+                 poison_backoff_s: float = 5.0,
+                 invariants: bool = False):
         self.store = store
         # jax.sharding.Mesh with ("wave", "nodes") axes: wave inputs are
         # committed to NamedShardings before each device step and GSPMD
@@ -431,6 +432,21 @@ class Scheduler:
         # the autopilot controller (autopilot/controller.py) registers
         # itself here; the HealthServer serves it at /debug/autopilot
         self.autopilot = None
+        # continuously-checked cluster invariants (chaos/invariants.py):
+        # opt-in post-round observer; None costs one attribute check per
+        # round (the tracing pattern). A checker can also be attached
+        # externally (strict=False for end-of-run gating — bench.py).
+        self.invariants = None
+        if invariants:
+            from ..chaos.invariants import InvariantChecker
+
+            self.invariants = InvariantChecker(metrics=self.metrics)
+        # gang-commit rollback test hook: the chaos campaign's
+        # deliberately-broken-build acceptance check flips this False to
+        # prove a partial gang commit without rollback is caught by the
+        # conservation/gang_atomic invariants. NEVER disable outside a
+        # test.
+        self._gang_rollback_enabled = True
         self._wire_informers()
 
     # -- informer handlers (reference: factory.go:191-295) --------------------
@@ -1078,6 +1094,7 @@ class Scheduler:
                 pre = self.pipeline_preemptions
                 pre_poison = self.poison_convictions
                 n = self._schedule_pipelined()
+                self._check_invariants()
                 placed += n
                 if (n > 0 or self.pipeline_preemptions > pre
                         or self.poison_convictions > pre_poison):
@@ -1098,6 +1115,7 @@ class Scheduler:
                 break
         self.wait_for_binds()
         self.export_queue_gauges()
+        self._check_invariants()
         return placed
 
     def _housekeep(self) -> None:
@@ -1177,7 +1195,21 @@ class Scheduler:
         if not pods:
             return 0
         with self._mu:
-            return self._run_wave(pods)
+            n = self._run_wave(pods)
+        self._check_invariants()
+        return n
+
+    def _check_invariants(self) -> None:
+        """Post-round invariant check (chaos/invariants.py) — runs at
+        every round boundary when a checker is armed (--invariants /
+        Scheduler(invariants=True)); one attribute check when off.
+        Holds _mu so informer delivery and the check see a consistent
+        cache/snapshot, exactly like a wave."""
+        chk = self.invariants
+        if chk is None:
+            return
+        with self._mu:
+            chk.check(self)
 
     def _schedule_pipelined(self) -> int:
         """Device-resident scheduling round: chain every pending wave on
@@ -3510,6 +3542,12 @@ class Scheduler:
             self.snapshot.add_pod(bound)
             assumed.append((pod, bound, node_name, vol_rollback))
         if not ok:
+            if not self._gang_rollback_enabled:
+                # test hook (see __init__): leave the partial commit in
+                # place — the invariant checker must catch the orphaned
+                # assumed members (conservation) and the split gang
+                # (gang_atomic)
+                return False
             for pod, bound, node_name, vol_rollback in reversed(assumed):
                 try:
                     self.cache.forget_pod(bound)

@@ -129,6 +129,14 @@ context manager) or via the environment:
 
   KTPU_FAULTPOINTS="kernel.wave=raise,bind.post=latency:0.05:3"
                     name=mode[:arg[:times]]  (comma-separated)
+
+Environment specs are validated by parse(): an unknown point name, an
+unknown mode, or a malformed arg/times field raises ValueError naming
+the offending token at activation — a chaos run with a typoed spec
+must fail loudly, not silently run fault-free. The point-name check is
+against registered_points(), the docstring registry above, which a
+drift-guard test keeps exactly equal to the fire() call sites in the
+tree (both directions).
 """
 
 from __future__ import annotations
@@ -290,29 +298,98 @@ def suppressed():
         _suppress.on = prev
 
 
-def _parse_env(spec: str) -> None:
-    """KTPU_FAULTPOINTS="name=mode[:arg[:times]],..." — activation from
-    the environment so a running binary can be chaos-tested without
-    code changes."""
+_MODES = ("raise", "latency", "drop", "corrupt")
+
+
+def registered_points() -> frozenset:
+    """The point names documented in this module's registry docstring
+    (the 'Wired points' section) — the authority parse() validates
+    against and the drift-guard test holds equal to the fire() call
+    sites in the tree."""
+    names = []
+    in_registry = False
+    for ln in (__doc__ or "").splitlines():
+        if ln.startswith("Wired points"):
+            in_registry = True
+            continue
+        if in_registry:
+            if ln.startswith("Modes:"):
+                break
+            # entries are indented exactly two spaces; continuation
+            # lines are indented further
+            if ln.startswith("  ") and len(ln) > 2 and ln[2] != " ":
+                names.append(ln.split()[0])
+    return frozenset(names)
+
+
+def parse(spec: str):
+    """Parse "name=mode[:arg[:times]],..." into a list of
+    (name, mode, arg, times) tuples. Raises ValueError naming the
+    offending token for an unknown point, an unknown mode, a
+    non-float arg, a negative/non-int times, or extra fields — a
+    malformed chaos spec must fail loudly, not silently arm nothing."""
+    out = []
+    points = registered_points()
     for item in spec.split(","):
         item = item.strip()
-        if not item or "=" not in item:
+        if not item:
             continue
+        if "=" not in item:
+            raise ValueError(
+                f"KTPU_FAULTPOINTS: malformed token {item!r} "
+                f"(expected name=mode[:arg[:times]])")
         name, rest = item.split("=", 1)
         name = name.strip()
+        if name not in points:
+            raise ValueError(
+                f"KTPU_FAULTPOINTS: unknown fault point {name!r} in "
+                f"token {item!r} (see the utils/faultpoints.py registry)")
         parts = rest.split(":")
-        mode = parts[0] or "raise"
-        try:
-            arg = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
-            times = int(parts[2]) if len(parts) > 2 and parts[2] else None
-            if name:
-                activate(name, mode, arg=arg, times=times)
-        except ValueError:
-            # env config must never crash the process at import; a
-            # malformed entry is simply not armed
-            continue
+        if len(parts) > 3:
+            raise ValueError(
+                f"KTPU_FAULTPOINTS: too many fields in token {item!r} "
+                f"(expected name=mode[:arg[:times]])")
+        mode = parts[0].strip() or "raise"
+        if mode not in _MODES:
+            raise ValueError(
+                f"KTPU_FAULTPOINTS: unknown mode {mode!r} in token "
+                f"{item!r} (modes: {', '.join(_MODES)})")
+        arg = 0.0
+        if len(parts) > 1 and parts[1]:
+            try:
+                arg = float(parts[1])
+            except ValueError:
+                raise ValueError(
+                    f"KTPU_FAULTPOINTS: non-numeric arg {parts[1]!r} in "
+                    f"token {item!r}") from None
+            if arg < 0:
+                raise ValueError(
+                    f"KTPU_FAULTPOINTS: negative arg {parts[1]!r} in "
+                    f"token {item!r}")
+        times = None
+        if len(parts) > 2 and parts[2]:
+            try:
+                times = int(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"KTPU_FAULTPOINTS: non-integer times {parts[2]!r} "
+                    f"in token {item!r}") from None
+            if times < 0:
+                raise ValueError(
+                    f"KTPU_FAULTPOINTS: negative times {parts[2]!r} in "
+                    f"token {item!r}")
+        out.append((name, mode, arg, times))
+    return out
+
+
+def activate_spec(spec: str) -> None:
+    """Validate + arm a full KTPU_FAULTPOINTS spec string (the chaos
+    campaign's reproducer strings re-enter here). All-or-nothing: a
+    ValueError from parse() arms no point."""
+    for name, mode, arg, times in parse(spec):
+        activate(name, mode, arg=arg, times=times)
 
 
 _env = os.environ.get("KTPU_FAULTPOINTS", "")
 if _env:
-    _parse_env(_env)
+    activate_spec(_env)

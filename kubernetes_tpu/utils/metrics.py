@@ -353,6 +353,15 @@ class Metrics:
             "scheduler_poison_pods_total", ("reason",),
             values={"reason": ("featurize", "sentinel", "bisect", "gang",
                                "golden")})
+        # continuously-checked cluster invariants (chaos/invariants.py):
+        # one child per named invariant the post-round checker can fail.
+        # Any nonzero child is a scheduler bug — the chaos campaign and
+        # the storm/meshfault benches gate on the family staying zero.
+        self.invariant_violations = LabeledCounter(
+            "scheduler_invariant_violations_total", ("invariant",),
+            values={"invariant": ("conservation", "double_bind",
+                                  "capacity", "snapshot_usage",
+                                  "gang_atomic", "state_machine")})
         # node lifecycle / eviction storm control: per-zone health state
         # (1 on the current state's child, 0 on the others), evictions
         # actually executed per zone, evictions due-but-held by the

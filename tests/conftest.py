@@ -94,6 +94,12 @@ def pytest_configure(config):
         "markers", "autopilot: autopilot suite (ledger dataset + ridge "
                    "trainer, shadow/replay promotion gates, regression "
                    "watch auto-rollback, /debug/autopilot; make chaos)")
+    config.addinivalue_line(
+        "markers", "campaign: chaos-campaign suite (cluster-invariant "
+                   "checker, seeded fault-schedule sampling/replay, "
+                   "failing-schedule shrinking, KTPU_FAULTPOINTS "
+                   "reproducers; make chaos — full budgeted run behind "
+                   "make chaos-campaign)")
 
 
 import pytest  # noqa: E402

@@ -1,0 +1,365 @@
+"""Chaos-campaign suite (kubernetes_tpu/chaos/): the cluster-invariant
+checker's mutation coverage, the fault-point registry drift guard, the
+KTPU_FAULTPOINTS parse hardening, a fixed-seed campaign smoke, and the
+deliberately-broken-build catch-and-shrink acceptance.
+
+The mutation tests are the checker's own chaos tier: each seeds ONE
+canonical bug class directly into a live scheduler's state (a lost pod,
+a double-booked pod, a cache double-bind, a split gang) and asserts the
+NAMED invariant fires with the offender in its digest. The
+eventually-consistent invariants (conservation, gang_atomic) use
+two-consecutive-checks hysteresis — those tests call check() twice and
+assert the first pass stays quiet (a transient must not fire).
+"""
+
+import pathlib
+import re
+
+import pytest
+
+from kubernetes_tpu.chaos.campaign import (FaultSpec, env_string, replay,
+                                           run_campaign, sample_schedule,
+                                           shrink)
+from kubernetes_tpu.chaos.invariants import (INVARIANTS, InvariantChecker,
+                                             InvariantViolation)
+from kubernetes_tpu.ops.encoding import Caps
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import faultpoints
+
+from helpers import make_node, make_pod
+
+pytestmark = pytest.mark.campaign
+
+
+@pytest.fixture(autouse=True)
+def _clean_faultpoints():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+# -- KTPU_FAULTPOINTS parse hardening (utils/faultpoints.parse) --------------
+
+class TestParse:
+    def test_valid_tokens(self):
+        out = faultpoints.parse(
+            "kernel.wave=raise,bind.post=latency:0.05:3,queue.shed=drop::2")
+        assert out == [("kernel.wave", "raise", 0.0, None),
+                       ("bind.post", "latency", 0.05, 3),
+                       ("queue.shed", "drop", 0.0, 2)]
+
+    def test_empty_mode_defaults_to_raise(self):
+        assert faultpoints.parse("kernel.wave=") == [
+            ("kernel.wave", "raise", 0.0, None)]
+
+    def test_blank_and_whitespace_tokens_skipped(self):
+        assert faultpoints.parse(" , kernel.wave=raise ,") == [
+            ("kernel.wave", "raise", 0.0, None)]
+
+    @pytest.mark.parametrize("spec,fragment", [
+        ("kernel.wav=raise", "unknown fault point"),
+        ("kernel.wave=explode", "unknown mode"),
+        ("kernel.wave", "malformed token"),
+        ("kernel.wave=latency:fast", "non-numeric arg"),
+        ("kernel.wave=latency:-1", "negative arg"),
+        ("kernel.wave=raise::1.5", "non-integer times"),
+        ("kernel.wave=raise::-2", "negative times"),
+        ("kernel.wave=raise:0:1:9", "too many fields"),
+    ])
+    def test_malformed_tokens_raise_naming_the_token(self, spec, fragment):
+        with pytest.raises(ValueError) as ei:
+            faultpoints.parse(spec)
+        msg = str(ei.value)
+        assert fragment in msg
+        # the offending token is quoted in the message so a typoed
+        # multi-token spec points at the right entry
+        assert spec.split(",")[0].split("=")[0] in msg
+
+    def test_activate_spec_is_all_or_nothing(self):
+        with pytest.raises(ValueError):
+            faultpoints.activate_spec("kernel.wave=raise,bogus.point=drop")
+        assert not faultpoints.active()
+
+    def test_activate_spec_arms_with_budget(self):
+        faultpoints.activate_spec("queue.shed=drop::2")
+        assert faultpoints.is_armed("queue.shed", "drop")
+        assert faultpoints.fire("queue.shed") is True
+        assert faultpoints.fire("queue.shed") is True
+        assert faultpoints.fire("queue.shed") is False  # budget spent
+        assert faultpoints.hits("queue.shed") == 2
+
+    def test_lost_device_fault_matches_only_its_victim(self):
+        """The payload-matching corrupt helper for device.lost: raises
+        DeviceLost only while the armed device rides in the payload."""
+        from kubernetes_tpu.sched.breaker import DeviceLost, lost_device_fault
+
+        faultpoints.activate("device.lost", "corrupt",
+                             fn=lost_device_fault("tpu:1"))
+        assert faultpoints.fire("device.lost", payload=None) is False
+        assert faultpoints.fire("device.lost", payload="tpu:0") is False
+        with pytest.raises(DeviceLost):
+            faultpoints.fire("device.lost", payload=("tpu:0", "tpu:1"))
+        with pytest.raises(DeviceLost):
+            faultpoints.fire("device.lost", payload="tpu:1")
+
+    def test_poison_pod_fault_matches_only_its_victim(self):
+        """The payload-matching corrupt helper for wave.poison: crashes
+        only when the victim uid rides in the batch."""
+        from kubernetes_tpu.state.featurize import poison_pod_fault
+
+        victim = make_pod("victim", cpu="100m", memory="64Mi")
+        victim.metadata.uid = "uid-victim"
+        bystander = make_pod("bystander", cpu="100m", memory="64Mi")
+        bystander.metadata.uid = "uid-bystander"
+        faultpoints.activate("wave.poison", "corrupt", times=None,
+                             fn=poison_pod_fault("uid-victim", "crash"))
+        assert faultpoints.fire("wave.poison",
+                                payload=([bystander], None)) is False
+        with pytest.raises(Exception):
+            faultpoints.fire("wave.poison",
+                             payload=([bystander, victim], None))
+
+
+# -- fault-point registry drift guard ----------------------------------------
+
+class TestRegistryDriftGuard:
+    # matches the literal first argument of every faultpoints.fire()
+    # call; \s* spans a wrapped call's newline
+    _FIRE = re.compile(r"""faultpoints\.fire\(\s*["']([a-z0-9_.]+)["']""")
+
+    def _fire_sites(self):
+        root = pathlib.Path(faultpoints.__file__).resolve().parents[1]
+        sites = {}
+        for path in sorted(root.rglob("*.py")):
+            if path.name == "faultpoints.py":
+                continue  # the registry itself
+            for name in self._FIRE.findall(path.read_text()):
+                sites.setdefault(name, []).append(
+                    str(path.relative_to(root)))
+        return sites
+
+    def test_every_fire_site_is_registered(self):
+        """A fire() call at a point name missing from the docstring
+        registry means parse() would reject a valid reproducer spec."""
+        sites = self._fire_sites()
+        unregistered = set(sites) - faultpoints.registered_points()
+        assert not unregistered, (
+            f"fire() call sites not in the faultpoints registry "
+            f"docstring: "
+            f"{ {n: sites[n] for n in sorted(unregistered)} }")
+
+    def test_every_registered_point_is_wired(self):
+        """A registry entry with no fire() call site is dead
+        documentation: campaigns would arm it and inject nothing."""
+        sites = self._fire_sites()
+        dead = faultpoints.registered_points() - set(sites)
+        assert not dead, (
+            f"registry docstring entries with no fire() call site in "
+            f"the tree: {sorted(dead)}")
+
+    def test_samplable_matrix_is_a_registry_subset(self):
+        from kubernetes_tpu.chaos.campaign import SAMPLABLE
+        points = {p for p, _ in SAMPLABLE}
+        assert points <= faultpoints.registered_points()
+
+
+# -- invariant-checker mutation tests ----------------------------------------
+
+def _mk_world(n_nodes=2):
+    store = ObjectStore()
+    sched = Scheduler(store, wave_size=8, caps=Caps(M=16, P=8, LV=16))
+    checker = InvariantChecker(metrics=sched.metrics, strict=False)
+    sched.invariants = checker
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", cpu="16", memory="32Gi"))
+    return store, sched, checker
+
+
+def _check(sched, checker):
+    with sched._mu:
+        return checker.check(sched)
+
+
+def _gang_pod(name, gang, min_member, cpu="100m"):
+    p = make_pod(name, cpu=cpu, memory="64Mi")
+    p.metadata.annotations = {
+        "pod-group.scheduling.k8s.io/name": gang,
+        "pod-group.scheduling.k8s.io/min-available": str(min_member)}
+    return p
+
+
+class TestCheckerMutations:
+    def test_clean_world_is_clean(self):
+        store, sched, checker = _mk_world()
+        try:
+            for i in range(4):
+                store.create("pods", make_pod(f"ok-{i}", cpu="100m",
+                                              memory="64Mi"))
+            sched.schedule_pending()
+            assert not _check(sched, checker)
+            assert checker.checks > 1  # schedule_pending checked too
+        finally:
+            sched.close()
+
+    def test_lost_pod_fires_conservation_after_hysteresis(self):
+        store, sched, checker = _mk_world()
+        try:
+            pod = make_pod("lost-1", cpu="100m", memory="64Mi")
+            store.create("pods", pod)
+            # the seeded bug: the pod vanishes from every queue area
+            # while still Pending in the store
+            sched.queue.delete(pod)
+            assert not _check(sched, checker)  # transient: quiet
+            vs = _check(sched, checker)        # persistent: fires
+            assert [v.invariant for v in vs] == ["conservation"]
+            assert pod.uid in vs[0].digest["lost"]
+            assert "lost" in vs[0].detail
+        finally:
+            sched.close()
+
+    def test_double_booked_pod_fires_conservation(self):
+        store, sched, checker = _mk_world()
+        try:
+            pod = make_pod("dbl-1", cpu="100m", memory="64Mi")
+            store.create("pods", pod)  # sits in the active area
+            # the seeded bug: bound in the store but never removed from
+            # the queue (a rollback that forgot to un-park)
+            pod.spec.node_name = "n0"
+            assert not _check(sched, checker)
+            vs = _check(sched, checker)
+            assert [v.invariant for v in vs] == ["conservation"]
+            booked = vs[0].digest["double_booked"]
+            assert any(pod.uid in b and "placed+" in b for b in booked)
+        finally:
+            sched.close()
+
+    def test_cache_double_bind_fires_immediately(self):
+        """double_bind has no hysteresis: the cache never legitimately
+        holds one pod's capacity on two nodes, even transiently."""
+        store, sched, checker = _mk_world()
+        try:
+            pod = make_pod("twice-1", cpu="100m", memory="64Mi")
+            pod.spec.node_name = "n0"
+            store.create("pods", pod)
+            sched.cache.node_infos["n1"].pods.append(pod)
+            vs = _check(sched, checker)
+            assert [v.invariant for v in vs] == ["double_bind"]
+            assert any(pod.uid in d for d in vs[0].digest["cache_dupes"])
+        finally:
+            sched.close()
+
+    def test_split_gang_fires_gang_atomic_after_hysteresis(self):
+        store, sched, checker = _mk_world()
+        try:
+            bound = _gang_pod("gs-0", "gsplit", 3)
+            # the seeded bug: one member committed, the rest abandoned
+            # (a partial gang commit without rollback)
+            bound.spec.node_name = "n0"
+            store.create("pods", bound)
+            for i in (1, 2):
+                store.create("pods", _gang_pod(f"gs-{i}", "gsplit", 3))
+            assert not _check(sched, checker)
+            vs = _check(sched, checker)
+            assert [v.invariant for v in vs] == ["gang_atomic"]
+            assert any("gsplit" in g and "(1/3)" in g
+                       for g in vs[0].digest["partial_gangs"])
+        finally:
+            sched.close()
+
+    def test_strict_raises_and_counts_the_metric(self):
+        store, sched, checker = _mk_world()
+        checker.strict = True
+        try:
+            pod = make_pod("lost-2", cpu="100m", memory="64Mi")
+            store.create("pods", pod)
+            sched.queue.delete(pod)
+            _check(sched, checker)
+            with pytest.raises(InvariantViolation) as ei:
+                _check(sched, checker)
+            assert ei.value.invariant in INVARIANTS
+            assert sched.metrics.invariant_violations.value(
+                invariant="conservation") >= 1
+        finally:
+            sched.close()
+
+
+# -- schedule sampling + the fixed-seed smoke --------------------------------
+
+class TestCampaign:
+    def test_sampler_is_deterministic_and_env_expressible(self):
+        import random
+        a = [sample_schedule(random.Random(11)) for _ in range(20)]
+        b = [sample_schedule(random.Random(11)) for _ in range(20)]
+        assert a == b
+        for specs in a:
+            assert 2 <= len(specs) <= 4
+            points = [s.point for s in specs]
+            assert len(points) == len(set(points))
+            # every sampled schedule round-trips through the env-string
+            # grammar (the shrinker's reproducer form)
+            parsed = faultpoints.parse(env_string(specs))
+            assert [p[0] for p in parsed] == points
+
+    def test_fixed_seed_smoke_runs_clean(self):
+        """The tier-1 campaign smoke: a healthy build survives 8 seeded
+        composed fault schedules with zero invariant violations, and
+        the injector demonstrably fired."""
+        res = run_campaign(seed=3, schedules=8)
+        assert res.ok, [f.outcome.detail for f in res.findings]
+        assert res.schedules == 8
+        assert res.checks_total > 0
+        assert res.injected_total > 0  # a dead injector must not pass
+
+    def test_budget_stops_sampling_early(self):
+        res = run_campaign(seed=5, schedules=50, budget_s=0.0)
+        assert res.schedules < 50
+
+
+# -- the deliberately-broken-build acceptance --------------------------------
+
+def _disable_gang_rollback(sched):
+    sched._gang_rollback_enabled = False
+
+
+class TestBrokenBuildAcceptance:
+    """ISSUE 17 acceptance: disable the gang-commit rollback (the
+    scheduler's test hook), and the campaign machinery must catch the
+    resulting partial-commit leak, shrink the schedule to a minimal
+    reproducer, and re-trigger it from the env string alone — while the
+    healthy build tolerates the identical schedule."""
+
+    # snapshot.write=corrupt inflates a node row's allocatable; the
+    # next heartbeat uploads it, the gang kernel over-proposes, the
+    # exact host recheck fails mid-commit — rollback (when enabled)
+    # cleans up; without it, assumed members leak
+    SCHEDULE = [FaultSpec("snapshot.write", "corrupt", times=4, tick=0)]
+    SEED = 7
+
+    def test_catch_shrink_and_env_retrigger(self):
+        broken = replay(self.SCHEDULE, self.SEED,
+                        configure=_disable_gang_rollback)
+        assert broken.violated
+        assert broken.violation in ("conservation", "gang_atomic")
+        assert broken.digest  # evidence captured at the violating round
+
+        minimal, mo = shrink(self.SCHEDULE, self.SEED,
+                             configure=_disable_gang_rollback)
+        assert mo.violated
+        assert len(minimal) == 1
+        assert minimal[0].point == "snapshot.write"
+        assert minimal[0].times == 1  # one corrupt write is enough
+        assert minimal[0].tick == 0   # env-activation form is exact
+
+        env = env_string(minimal)
+        assert env == "snapshot.write=corrupt::1"
+        again = replay((), self.SEED, env_spec=env,
+                       configure=_disable_gang_rollback)
+        assert again.violated  # the paste-able reproducer re-triggers
+        assert again.injected.get("snapshot.write", 0) >= 1
+
+    def test_healthy_build_tolerates_the_same_schedule(self):
+        out = replay(self.SCHEDULE, self.SEED)
+        assert not out.violated
+        assert out.injected.get("snapshot.write", 0) >= 1
+        assert out.checks > 0

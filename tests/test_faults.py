@@ -64,14 +64,18 @@ class TestFaultPoints:
         assert faultpoints.hits("pt") == 1  # hit history survives
 
     def test_env_spec_parsing(self):
-        faultpoints._parse_env("a=raise, b=latency:0.5, c=drop::3, =bad,")
+        faultpoints.activate_spec(
+            "kernel.wave=raise, bind.post=latency:0.5, queue.shed=drop::3,")
         try:
-            assert faultpoints._active["a"].mode == "raise"
-            assert faultpoints._active["b"].mode == "latency"
-            assert faultpoints._active["b"].arg == 0.5
-            assert faultpoints._active["c"].times == 3
+            assert faultpoints._active["kernel.wave"].mode == "raise"
+            assert faultpoints._active["bind.post"].mode == "latency"
+            assert faultpoints._active["bind.post"].arg == 0.5
+            assert faultpoints._active["queue.shed"].times == 3
         finally:
             faultpoints.reset()
+        # malformed tokens fail loudly instead of silently arming nothing
+        with pytest.raises(ValueError):
+            faultpoints.activate_spec("=bad")
 
     def test_watch_delivery_drop_loses_event_until_relist(self):
         """The lost-watch-event scenario: a dropped delivery leaves

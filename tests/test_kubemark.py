@@ -14,7 +14,10 @@ class TestHollowCluster:
     def test_load_and_churn(self):
         store = ObjectStore()
         hc = HollowCluster(store, n_nodes=5)
-        sched = Scheduler(store, wave_size=32)
+        # invariants=True: every round of this e2e churn is also a
+        # cluster-invariant check (strict — a violation fails the test
+        # at the round that broke it)
+        sched = Scheduler(store, wave_size=32, invariants=True)
         assert store.count("nodes") == 5
         hc.create_pods(20, prefix="load")
         placed = 0
@@ -45,6 +48,8 @@ class TestHollowCluster:
         hc.sync_once()
         assert sum(1 for p in store.list("pods")
                    if p.status.phase == "Running") == 20
+        assert sched.invariants.checks > 0
+        assert not sched.invariants.violations
         hc.stop()
 
     def test_zones_and_proxy(self):

@@ -23,8 +23,12 @@ class FakeClock:
 
 def make_world(n_nodes=4, clock=None, **node_kw):
     store = ObjectStore()
-    sched = (Scheduler(store, wave_size=16) if clock is None
-             else Scheduler(store, wave_size=16, clock=clock))
+    # invariants=True: every e2e round here doubles as a strict
+    # cluster-invariant check (chaos/invariants.py)
+    sched = (Scheduler(store, wave_size=16, invariants=True)
+             if clock is None
+             else Scheduler(store, wave_size=16, clock=clock,
+                            invariants=True))
     for i in range(n_nodes):
         store.create("nodes", make_node(f"n{i}", **node_kw))
     return store, sched
