@@ -1295,6 +1295,182 @@ def run_chaoscampaign_config(seed=7, schedules=50, ticks=8, budget_s=None):
     return res, dt
 
 
+def run_outagestorm_config(nodes, pods, wave):
+    """Control-plane outage survival under load: a steady arrival
+    stream through a HollowCluster with the store path SEVERED
+    mid-run (duration-armed `store.outage` raise — every bind POST and
+    truth GET fails until healed). The scheduler must keep scoring
+    against its cache, spool bind intents into the durable journal,
+    and drain the spool through the bind-ambiguity path after the
+    heal. Gates (any violation FAILS the bench):
+
+      - the outage actually engaged: store-path breaker tripped >= 1
+        and binds_spooled > 0 (a run that never disconnected would
+        turn this gate into a no-op)
+      - zero cluster-invariant violations across every round (the
+        checker's double-bind / conservation / capacity sweeps run
+        strict=False and are tallied here)
+      - spool drained within OUTAGE_DRAIN_ROUNDS post-heal rounds
+      - every pod placed exactly once: no lost pods (all arrivals
+        bound), no double-binds (store node_name is the single bind
+        each uid ever got; journal fully resolved, assumptions empty)
+    """
+    import os as _os
+    import tempfile
+    import time as _t
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.chaos.invariants import InvariantChecker
+    from kubernetes_tpu.kubemark.hollow import HollowCluster
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import Metrics, faultpoints
+    from kubernetes_tpu.utils.backoff import PodBackoff
+
+    OUTAGE_DRAIN_ROUNDS = 8  # post-heal rounds the spool may take
+
+    store = ObjectStore()
+    vclock = [1000.0]
+    jdir = tempfile.mkdtemp(prefix="ktpu-outagestorm-")
+    jpath = _os.path.join(jdir, "bind.journal")
+    caps = Caps(M=bucket_size(2 * pods + 64), P=wave,
+                LV=bucket_size(nodes + 256, 64))
+    sched = Scheduler(store, wave_size=wave, caps=caps,
+                      clock=lambda: vclock[0],
+                      # short cooldown + pinned jitter: the heal tick's
+                      # 5s vclock step is always past retry_at, so the
+                      # first post-heal housekeep probes and drains
+                      store_breaker_cooldown=2.0,
+                      bind_journal_path=jpath)
+    sched.storehealth.jitter = lambda: 0.5
+    sched.backoff = PodBackoff(initial=0.01, maximum=0.1)
+    cluster = HollowCluster(store, nodes, clock=lambda: vclock[0])
+    for n in cluster.nodes:
+        n.kubelet.register_node()
+
+    # warm the wave kernel outside the measured window — compile time
+    # is a backend property, not an outage property
+    warm = []
+    for i in range(min(wave, 64)):
+        p = _base_pod(api, f"warmup-{i}", "warmup")
+        store.create("pods", p)
+        warm.append(p)
+    sched.warm_pipeline(warm, n_waves=1)
+    while sched.run_once(timeout=0.0):
+        pass
+    for p in warm:
+        try:
+            store.delete("pods", "default", p.metadata.name)
+        except KeyError:
+            pass
+    sched.metrics = Metrics()
+    checker = InvariantChecker(metrics=sched.metrics, strict=False)
+    sched.invariants = checker
+
+    created = set()
+    seq = [0]
+
+    def _arrive(count):
+        for _ in range(count):
+            p = _base_pod(api, f"outage-{seq[0]}", "outage")
+            seq[0] += 1
+            store.create("pods", p)
+            created.add(p.uid)
+
+    # 10 arrival ticks; the store is dark for ticks [3, 8) — arrivals
+    # keep flowing THROUGH the outage (the informer mirror is a
+    # separate path from the bind/truth writes the fault severs)
+    arrive_ticks = 10
+    sever_at, heal_at = 3, 8
+    per_tick = max(1, pods // arrive_ticks)
+    spool_peak = 0
+    heal_rounds = -1
+    t0 = _t.time()
+    try:
+        for t in range(arrive_ticks):
+            vclock[0] += 5.0
+            if t == sever_at:
+                faultpoints.activate("store.outage", "raise",
+                                     times=10 ** 6)
+            if t == heal_at:
+                faultpoints.deactivate("store.outage")
+            want = per_tick if t < arrive_ticks - 1 \
+                else pods - per_tick * (arrive_ticks - 1)
+            _arrive(want)
+            sched.run_once(timeout=0.0)
+            spool_peak = max(spool_peak, sched.spool_count())
+        # post-heal: the spool must drain within its bounded round
+        # budget, then every survivor must place (wall-bounded so a
+        # wedge fails loudly instead of hanging)
+        rounds = 0
+        stalled = 0
+        while stalled < 2000:
+            vclock[0] += 5.0
+            n = sched.schedule_pending()
+            rounds += 1
+            if heal_rounds < 0 and sched.spool_count() == 0:
+                heal_rounds = rounds
+            live = [p for p in store.list("pods") if p.uid in created]
+            unbound = [p for p in live if not p.spec.node_name]
+            if not unbound and sched.spool_count() == 0:
+                break
+            stalled = stalled + 1 if n == 0 else 0
+            _t.sleep(0.002)
+    finally:
+        faultpoints.reset()
+    dt = _t.time() - t0
+
+    # -- the gates -------------------------------------------------------------
+    m = sched.metrics
+    trips = sched.storehealth.trips
+    spooled = int(m.binds_spooled.value)
+    bound = {}
+    for p in store.list("pods"):
+        if p.uid in created and p.spec.node_name:
+            bound[p.uid] = p.spec.node_name
+    placed = len(bound)
+    failures = []
+    if trips < 1:
+        failures.append("store-path breaker never tripped "
+                        "(outage never engaged?)")
+    if spooled == 0:
+        failures.append("no binds were spooled during the outage "
+                        "(disconnected mode never engaged?)")
+    if heal_rounds < 0 or heal_rounds > OUTAGE_DRAIN_ROUNDS:
+        failures.append(
+            f"spool not drained within {OUTAGE_DRAIN_ROUNDS} post-heal "
+            f"rounds (drained after "
+            f"{'never' if heal_rounds < 0 else heal_rounds})")
+    if placed != len(created):
+        failures.append(f"{len(created) - placed} pods never placed "
+                        f"(lost across the outage)")
+    leftover = sched.cache.assumed_pods()
+    if leftover:
+        failures.append(f"{len(leftover)} assumption(s) outlived the "
+                        f"drain (bind intent leaked)")
+    unresolved = sched.journal.unresolved() if sched.journal else []
+    if unresolved:
+        failures.append(f"{len(unresolved)} journal intent(s) never "
+                        f"resolved after the heal")
+    if checker.violations:
+        v = checker.violations[0]
+        failures.append(
+            f"{len(checker.violations)} cluster-invariant violation(s) "
+            f"across {checker.checks} checks — first: {v.invariant}: "
+            f"{v.detail}")
+    print(f"# outagestorm: arrivals={len(created)} placed={placed} "
+          f"wall={dt:.2f}s trips={trips} spooled={spooled} "
+          f"spool_peak={spool_peak} heal_rounds={heal_rounds} "
+          f"journal={jpath}", file=sys.stderr)
+    for f in failures:
+        print(f"FATAL: outagestorm: {f}", file=sys.stderr)
+    if failures:
+        sys.exit(1)
+    return placed, dt, spool_peak, heal_rounds
+
+
 def stage_breakdown(top=12):
     """Per-stage wall-time totals from the step profiler (fed by every
     Trace the scheduler emits) — the bench json carries WHERE the run's
@@ -1420,6 +1596,12 @@ SUITE = [
     # KTPU_FAULTPOINTS reproducer (nodes/pods come from the campaign
     # scenario, not the grid numbers)
     ("chaoscampaign", 2, 0, "chaoscampaign", []),
+    # control-plane outage survival: the store path severed for half
+    # the arrival window (store.outage raise) — scheduling continues
+    # against the cache, binds spool into the durable intent journal,
+    # and the spool must drain within 8 post-heal rounds with zero
+    # double-binds, zero lost pods, and zero invariant violations
+    ("outagestorm", 100, 400, "outagestorm", ["--wave", "64"]),
     ("mixed5k", 5000, 30000, "mixed", []),
     # fleet scale: 50k nodes / 200k pod churn under the mesh-sharded
     # scheduling plane (--mesh auto shards the node axis across every
@@ -1543,7 +1725,8 @@ def main():
                     choices=["density", "affinity", "spreading",
                              "antiaffinity", "mixed", "gang", "preempt",
                              "trickle", "paced", "autoscale", "partition",
-                             "degraded", "storm", "chaoscampaign"])
+                             "degraded", "storm", "chaoscampaign",
+                             "outagestorm"])
     ap.add_argument("--trace", default=None,
                     choices=["burst", "diurnal", "gangstorm", "compound"],
                     help="storm workload: which synthetic arrival trace "
@@ -1689,6 +1872,26 @@ def main():
             "vs_baseline": 1.0,
             "checks": res.checks_total,
             "injected": res.injected_total,
+            "wall_s": round(dt, 2),
+        }
+        print(json.dumps(rec), flush=True)
+        return
+    if args.workload == "outagestorm":
+        placed, dt, spool_peak, heal_rounds = run_outagestorm_config(
+            args.nodes or 100, args.pods or 400, args.wave or 64)
+        name = args.name or "outagestorm"
+        rec = {
+            # the headline is post-heal drain rounds — how fast the
+            # spooled outage backlog reconciles once the store returns
+            # (the hard gates — zero double-binds / lost pods /
+            # invariant violations — already sys.exit(1)'d above)
+            "metric": f"scheduler_{name}_heal_rounds_"
+                      f"{args.nodes or 100}n_{placed}p",
+            "value": heal_rounds,
+            "unit": "rounds",
+            "vs_baseline": (round(8.0 / heal_rounds, 2)
+                            if heal_rounds > 0 else 0.0),
+            "spool_peak": spool_peak,
             "wall_s": round(dt, 2),
         }
         print(json.dumps(rec), flush=True)

@@ -70,6 +70,12 @@ SAMPLABLE: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("wave.poison", ("raise",)),
     ("queue.quarantine", ("drop",)),
     ("lease.renew", ("raise", "drop")),
+    # control-plane outage: severs bind POSTs and truth GETs together;
+    # sampled times (1-3) stay below the spool threshold (3 failed
+    # attempts trip the breaker, the 4th fire darkens the truth GET),
+    # so a healthy build conserves without ever spooling — duration
+    # outages are driven explicitly by the outage tests/bench
+    ("store.outage", ("raise",)),
 )
 
 # point-pairs with a history of interacting badly (ISSUE 17): a device
@@ -231,7 +237,9 @@ def _workload(seed: int, ticks: int) -> Dict[int, list]:
 
 def replay(specs: Sequence[FaultSpec], seed: int, ticks: int = 8,
            env_spec: Optional[str] = None,
-           configure: Optional[Callable] = None) -> ReplayOutcome:
+           configure: Optional[Callable] = None,
+           journal_path: Optional[str] = None,
+           restart_tick: Optional[int] = None) -> ReplayOutcome:
     """Replay one fault schedule against the seeded scenario with the
     invariant checker armed. Returns the outcome; never raises for a
     violation (the campaign decides what to do with it).
@@ -241,7 +249,14 @@ def replay(specs: Sequence[FaultSpec], seed: int, ticks: int = 8,
     path, verifying a shrunk schedule re-triggers in its env form.
     configure: optional hook(sched) run before the first tick (the
     deliberately-broken-build acceptance test disables the gang
-    rollback through it)."""
+    rollback through it).
+    journal_path: durable bind-intent journal for the scenario
+    scheduler (control-plane outage coverage).
+    restart_tick: kill -9 analog — at this tick the scheduler is
+    abandoned mid-flight (no drain, no farewell) and a fresh one is
+    constructed over the same store + journal; construction replays
+    the journal before its first wave, and the same invariant checker
+    keeps watching across the restart."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -259,9 +274,20 @@ def replay(specs: Sequence[FaultSpec], seed: int, ticks: int = 8,
     faultpoints.reset()
     store = ObjectStore()
     vclock = [1000.0]
-    sched = Scheduler(store, wave_size=8, caps=Caps(M=64, P=16, LV=64),
+
+    def _mk_sched() -> "Scheduler":
+        s = Scheduler(store, wave_size=8, caps=Caps(M=64, P=16, LV=64),
                       clock=lambda: vclock[0], shed_watermark=8,
-                      shed_age_s=1.0)
+                      shed_age_s=1.0,
+                      # short, deterministic store-probe window: the
+                      # jitter pin makes retry_at = trip + cooldown
+                      # exactly, so outage recovery is tick-predictable
+                      store_breaker_cooldown=2.0,
+                      bind_journal_path=journal_path)
+        s.storehealth.jitter = lambda: 0.5
+        return s
+
+    sched = _mk_sched()
     checker = InvariantChecker(metrics=sched.metrics, strict=True)
     sched.invariants = checker
     if configure is not None:
@@ -274,6 +300,11 @@ def replay(specs: Sequence[FaultSpec], seed: int, ticks: int = 8,
         if env_spec is not None:
             faultpoints.activate_spec(env_spec)
         for t in range(ticks + 2):  # +2 drain ticks, faults quiescent
+            if restart_tick is not None and t == restart_tick:
+                sched = _mk_sched()
+                sched.invariants = checker
+                if configure is not None:
+                    configure(sched)
             for s in by_tick.get(t, ()):
                 faultpoints.activate(s.point, s.mode, arg=s.arg,
                                      times=s.times)

@@ -59,6 +59,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import types as api
+from ..sched.storehealth import CONNECTED as STORE_CONNECTED
 
 # capped list lengths inside digests: a 30k-pod run's violation must
 # not serialize 30k uids to name three offenders
@@ -167,8 +168,40 @@ class InvariantChecker:
                 double[p.uid] = f"{p.uid}({'+'.join(queued)})"
             elif not placed and not queued:
                 lost.append(p.uid)
+        # disconnected-mode spool (control-plane outage survival): a
+        # spooled bind intent is the LEGAL assumed-but-unbound state —
+        # but only paired with a live assumption (or the bind already
+        # landed), and only while the outage lasts. An intent still
+        # spooled with the store path CONNECTED at two consecutive
+        # checks means the drain/replay machinery is broken: the next
+        # housekeeping pass after a reconnect must drain it.
+        spool_fn = getattr(sched, "spool_uids", None)
+        spool = spool_fn() if callable(spool_fn) else frozenset()
+        bound_uids = {p.uid for p in pods if p.spec.node_name}
+        unpaired = [uid for uid in spool
+                    if uid not in assumed and uid not in bound_uids]
+        health = getattr(sched, "storehealth", None)
+        stale = self._persistent(
+            "spool_stale",
+            spool if (health is not None
+                      and health.state == STORE_CONNECTED) else ())
         lost = self._persistent("lost", lost)
         double_ids = self._persistent("double", double)
+        unpaired = self._persistent("spool_unpaired", unpaired)
+        if unpaired:
+            found.append((
+                "conservation",
+                f"{len(unpaired)} spooled bind intent(s) hold no "
+                f"assumption and no binding (capacity not reserved), "
+                f"e.g. {_cap(unpaired)[:3]}",
+                {"spool_unpaired": _cap(unpaired)}))
+        if stale:
+            found.append((
+                "conservation",
+                f"{len(stale)} spooled bind intent(s) outlived the "
+                f"outage (store CONNECTED across consecutive checks), "
+                f"e.g. {_cap(stale)[:3]}",
+                {"spool_stale": _cap(stale)}))
         if lost:
             found.append((
                 "conservation",
@@ -355,6 +388,24 @@ class InvariantChecker:
                 "state_machine",
                 f"watchdog outstanding ({wd.outstanding()}) exceeds "
                 f"abandoned_total ({wd.abandoned_total})", {}))
+        sh = getattr(sched, "storehealth", None)
+        if sh is not None:
+            from ..sched.storehealth import (DISCONNECTED,
+                                             STATE_CODES as SH_CODES)
+            if sh.state not in SH_CODES:
+                found.append((
+                    "state_machine",
+                    f"store breaker in unknown state {sh.state!r}", {}))
+            if sh.failures < 0 or sh.trips < 0:
+                found.append((
+                    "state_machine",
+                    f"store breaker counters negative "
+                    f"(failures={sh.failures}, trips={sh.trips})", {}))
+            if sh.state == DISCONNECTED and sh.trips < 1:
+                found.append((
+                    "state_machine",
+                    "store breaker DISCONNECTED with zero recorded "
+                    "trips", {}))
         return found
 
     # -- evidence -------------------------------------------------------------
@@ -373,6 +424,14 @@ class InvariantChecker:
                         "failures": sched.breaker.failures,
                         "trips": sched.breaker.trips},
         }
+        sh = getattr(sched, "storehealth", None)
+        if sh is not None:
+            spool_fn = getattr(sched, "spool_uids", None)
+            d["storehealth"] = {"state": sh.state,
+                                "failures": sh.failures,
+                                "trips": sh.trips,
+                                "spool": _cap(spool_fn())
+                                if callable(spool_fn) else []}
         if sched.meshfaults is not None:
             d["mesh"] = {
                 "devices": len(sched.meshfaults.devices),

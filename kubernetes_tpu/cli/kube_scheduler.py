@@ -119,6 +119,24 @@ class HealthServer:
                         else:
                             body = json.dumps(book.index()).encode()
                             ctype = "application/json"
+                elif self.path.startswith("/debug/store"):
+                    # control-plane outage observatory: store-path
+                    # breaker state, bind-spool depth/watermark,
+                    # journal stats and per-op store error counters
+                    # (sched/scheduler.py store_debug())
+                    sched = outer.scheduler_ref()
+                    dbg = getattr(sched, "store_debug", None)
+                    if dbg is None:
+                        body = b"scheduler not running\n"
+                        self.send_response(404)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    body = json.dumps(dbg()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/debug/autopilot"):
                     # autopilot promotion pipeline: current phase,
                     # candidate under evaluation, gate reports and the
@@ -278,7 +296,12 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
                       shed_age_s=cfg.shed_age_s,
                       wave_deadline_s=cfg.wave_deadline_s,
                       shadow_exact_interval=cfg.shadow_exact_interval,
-                      invariants=cfg.invariants)
+                      invariants=cfg.invariants,
+                      store_breaker_threshold=cfg.store_breaker_threshold,
+                      store_breaker_cooldown=cfg.store_breaker_cooldown,
+                      bind_journal_path=cfg.bind_journal_path or None,
+                      bind_journal_max_bytes=cfg.bind_journal_max_bytes,
+                      spool_watermark=cfg.spool_watermark)
     if cfg.weight_profiles_path:
         # file-preloaded profiles feed the weight book directly — the
         # store-watched `weightprofiles` kind is the dynamic path, but
@@ -517,6 +540,23 @@ def main(argv=None) -> int:
                          "exceeded dispatch is abandoned, trips the "
                          "breaker, and the round completes via the host "
                          "twin (0 disables)")
+    ap.add_argument("--bind-journal", default=None,
+                    help="durable bind-intent journal path: binds "
+                         "spooled during a control-plane outage are "
+                         "journaled (fsync'd JSONL) and replayed on "
+                         "restart before the first wave (empty "
+                         "disables durability)")
+    ap.add_argument("--spool-watermark", type=int, default=None,
+                    help="disconnected-mode spool depth above which new "
+                         "sheddable admissions are held in the shed "
+                         "area until the store heals (0 = never hold)")
+    ap.add_argument("--store-breaker-threshold", type=int, default=None,
+                    help="consecutive store failures (bind/GET/LIST) "
+                         "before the store-path breaker declares "
+                         "DISCONNECTED (default 3)")
+    ap.add_argument("--store-breaker-cooldown", type=float, default=None,
+                    help="base seconds between jittered half-open store "
+                         "probes while DISCONNECTED (default 30)")
     ap.add_argument("--once", action="store_true",
                     help="exit when the queue drains (batch mode)")
     args = ap.parse_args(argv)
@@ -565,6 +605,14 @@ def main(argv=None) -> int:
         cfg.shed_age_s = args.shed_age
     if args.wave_deadline is not None:
         cfg.wave_deadline_s = args.wave_deadline
+    if args.bind_journal is not None:
+        cfg.bind_journal_path = args.bind_journal
+    if args.spool_watermark is not None:
+        cfg.spool_watermark = args.spool_watermark
+    if args.store_breaker_threshold is not None:
+        cfg.store_breaker_threshold = args.store_breaker_threshold
+    if args.store_breaker_cooldown is not None:
+        cfg.store_breaker_cooldown = args.store_breaker_cooldown
     for kv in filter(None, args.feature_gates.split(",")):
         k, _, v = kv.partition("=")
         cfg.feature_gates[k] = v.lower() in ("true", "1", "")

@@ -38,6 +38,7 @@ from ..api import scheme
 from ..api import types as api
 from ..runtime.store import ADDED, DELETED, MODIFIED, Conflict, Event
 from ..utils import faultpoints
+from ..utils.backoff import exp_step, jittered
 from .rest import APIStatusError, RESTClient
 
 
@@ -48,7 +49,9 @@ class Reflector:
                  max_relist_backoff: float = 30.0,
                  stale_after: float = 60.0,
                  watch_timeout: float = 10.0,
+                 list_timeout: Optional[float] = None,
                  metrics=None,
+                 health=None,
                  clock: Callable[[], float] = time.monotonic,
                  jitter: Callable[[], float] = random.random):
         self.client = client
@@ -56,6 +59,14 @@ class Reflector:
         self.on_event = on_event
         self.relist_backoff = relist_backoff
         self.max_relist_backoff = max_relist_backoff
+        # per-relist budget for the LIST request (None = the client's
+        # socket default): a hung LIST during an apiserver outage must
+        # fail within the cycle so the backoff ladder and staleness
+        # accounting keep moving
+        self.list_timeout = list_timeout
+        # optional sched.storehealth.StorePathBreaker: relist outcomes
+        # feed the consecutive-failure count on the LIST path
+        self.health = health
         # watchdog deadline: a stream with no events for this long is
         # declared stale and torn down for a relist. Must exceed the
         # per-stream server timeout (watch_timeout) by a healthy margin
@@ -67,6 +78,10 @@ class Reflector:
         self.jitter = jitter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # the live rung of the relist ladder — exposed (not a run()
+        # local) so outage tests can assert the ladder capped at
+        # max_relist_backoff and that the first post-heal relist reset it
+        self.backoff = relist_backoff
         self.last_sync_rv = 0
         self.synced = threading.Event()  # set after the first list completes
         self.relists = 0       # list+watch cycles entered
@@ -89,23 +104,29 @@ class Reflector:
     def _record_error(self, exc: BaseException):
         """A failed list+watch cycle is never silent: traceback to the
         log, stage=reflector into the labelled error series (matching
-        the PR 2 bind/wave/extender attribution)."""
+        the PR 2 bind/wave/extender attribution), and — when a store-
+        path breaker is wired — one consecutive-failure tick on the
+        LIST path."""
         if self.metrics is not None:
             self.metrics.scheduling_errors.labels(stage="reflector").inc()
+            self.metrics.store_errors.labels(op="list").inc()
+        if self.health is not None:
+            self.health.record_failure()
         logging.getLogger(__name__).error(
             "reflector %s: list+watch failed: %s: %s", self.plural,
             type(exc).__name__, exc, exc_info=exc)
 
     def _backoff_wait(self, backoff: float) -> float:
         """Sleep a jittered backoff (interruptible by stop()) and return
-        the next, doubled backoff. Jitter spans 0.5x-1.5x so a fleet of
-        reflectors knocked over by one apiserver flap doesn't relist in
-        lockstep forever after."""
-        self._stop.wait(backoff * (0.5 + self.jitter()))
-        return min(backoff * 2, self.max_relist_backoff)
+        the next, doubled backoff (utils/backoff.py — the one shared
+        ladder shape). Jitter spans 0.5x-1.5x so a fleet of reflectors
+        knocked over by one apiserver flap doesn't relist in lockstep
+        forever after."""
+        self._stop.wait(jittered(backoff, self.jitter))
+        return exp_step(backoff, self.max_relist_backoff)
 
     def run(self):
-        backoff = self.relist_backoff
+        self.backoff = self.relist_backoff
         while not self._stop.is_set():
             try:
                 # chaos seam: a `raise` here fails the whole cycle before
@@ -113,24 +134,32 @@ class Reflector:
                 # exponential backoff exists for
                 faultpoints.fire("reflector.relist")
                 self._list_and_watch()
-                backoff = self.relist_backoff  # clean cycle: reset
+                self.backoff = self.relist_backoff  # clean cycle: reset
             except APIStatusError as e:
                 if e.code == 410:
                     # expected expiry: relist immediately, and a clean
                     # list resets the backoff ladder
-                    backoff = self.relist_backoff
+                    self.backoff = self.relist_backoff
                     continue
                 self._record_error(e)
-                backoff = self._backoff_wait(backoff)
+                self.backoff = self._backoff_wait(self.backoff)
             except Exception as e:
                 self._record_error(e)
-                backoff = self._backoff_wait(backoff)
+                self.backoff = self._backoff_wait(self.backoff)
+
+    def _list(self):
+        faultpoints.fire("store.outage", payload=("list", self.plural))
+        if self.list_timeout is not None:
+            return self.client.list(self.plural, timeout=self.list_timeout)
+        return self.client.list(self.plural)
 
     def _list_and_watch(self):
         self.relists += 1
         if self.metrics is not None:
             self.metrics.reflector_relists.inc()
-        items, rv = self.client.list(self.plural)
+        items, rv = self._list()
+        if self.health is not None:
+            self.health.record_success()  # the store answered a LIST
         # delta replay against the known set (DeltaFIFO Replace semantics,
         # tools/cache/delta_fifo.go Replace: sync adds + implicit deletes)
         new_keys = set()
@@ -204,6 +233,11 @@ class RemoteStore:
     # binder thread for the full 30s default socket timeout
     bind_timeout = 5.0
 
+    # per-relist deadline on the reflector LIST: during an outage the
+    # relist must fail fast enough that the backoff ladder (capped at
+    # 30s) is what paces recovery, not the socket default stacked on it
+    list_timeout = 15.0
+
     def __init__(self, client: RESTClient, metrics=None,
                  stale_after: float = 60.0):
         self.client = client
@@ -212,6 +246,12 @@ class RemoteStore:
         # scheduler's own series on the same /metrics endpoint
         self.metrics = metrics
         self.stale_after = stale_after
+        # optional sched.storehealth.StorePathBreaker, assigned by the
+        # CLI after the scheduler is built (the scheduler owns the
+        # breaker; this store feeds it from the write + LIST paths —
+        # bind outcomes are fed by the reconciler seam instead, so one
+        # failed POST is never double-counted)
+        self.health = None
         self._lock = threading.RLock()
         self._mirrors: Dict[str, Dict[str, object]] = {}
         self._watchers: List[tuple] = []
@@ -226,10 +266,21 @@ class RemoteStore:
             self._mirrors[kind] = {}
             refl = Reflector(self.client, kind, self._on_event,
                              metrics=self.metrics,
-                             stale_after=self.stale_after)
+                             stale_after=self.stale_after,
+                             list_timeout=self.list_timeout,
+                             health=self.health)
             self._reflectors[kind] = refl
             refl.start()
         return self
+
+    def set_health(self, breaker) -> None:
+        """Wire a StorePathBreaker after construction (the scheduler —
+        which owns the breaker — is built against an already-mirroring
+        store, so existing reflectors must pick it up too)."""
+        with self._lock:
+            self.health = breaker
+            for refl in self._reflectors.values():
+                refl.health = breaker
 
     def stop(self):
         for refl in self._reflectors.values():
@@ -296,9 +347,33 @@ class RemoteStore:
             return max((r.last_sync_rv for r in self._reflectors.values()),
                        default=0)
 
+    def _guard(self, op: str, fn):
+        """Run one REST op under store-path accounting: the
+        `store.outage` fault point fires first (raise = severed
+        transport, latency = a slow apiserver), transport failures
+        count into store_errors_total{op} and the breaker's consecutive
+        count, and ANY server answer — including a 409/404
+        APIStatusError — counts as the store being reachable."""
+        faultpoints.fire("store.outage", payload=op)
+        try:
+            out = fn()
+        except APIStatusError:
+            if self.health is not None:
+                self.health.record_success()
+            raise
+        except Exception:
+            if self.metrics is not None:
+                self.metrics.store_errors.labels(op=op).inc()
+            if self.health is not None:
+                self.health.record_failure()
+            raise
+        if self.health is not None:
+            self.health.record_success()
+        return out
+
     def create(self, kind: str, obj) -> object:
         try:
-            return self.client.create(kind, obj)
+            return self._guard("create", lambda: self.client.create(kind, obj))
         except APIStatusError as e:
             if e.code == 409:
                 raise Conflict(str(e))
@@ -347,13 +422,20 @@ class RemoteStore:
 
     def delete(self, kind: str, namespace: str, name: str):
         try:
-            self.client.delete(kind, namespace, name)
+            self._guard("delete",
+                        lambda: self.client.delete(kind, namespace, name))
         except APIStatusError as e:
             if e.code == 404:
                 raise KeyError(f"{kind} {namespace}/{name} not found")
             raise
 
     def bind(self, pod: api.Pod, node_name: str):
+        # no breaker recording and no store.outage fire here: bind
+        # outcomes are fed to the breaker by the scheduler's reconciler
+        # seam (per POST attempt), and the fault point fires at the
+        # scheduler's bind/truth seams — both cover this path AND the
+        # in-process ObjectStore; doubling them here would double-count
+        # failures and burn injected `times` budgets twice per attempt
         try:
             self.client.bind(pod.metadata.namespace, pod.metadata.name,
                              node_name, timeout=self.bind_timeout)

@@ -182,15 +182,20 @@ class RESTClient:
     # -- verbs -----------------------------------------------------------------
 
     def list(self, plural: str, namespace: Optional[str] = None,
-             label_selector=None, field_selector=None
+             label_selector=None, field_selector=None,
+             timeout: Optional[float] = None
              ) -> Tuple[List[object], int]:
         """Returns (items, list resourceVersion). Selectors may be
         {key: value} dicts or raw selector STRINGS (set-based
         expressions like "tier in (a,b)" pass through verbatim to the
-        server's parser)."""
+        server's parser). `timeout` bounds the whole request — callers
+        on the leader loop (reflector relists, recovery truth checks)
+        pass a budget so one hung LIST can't ride the 30s socket
+        default."""
         return self._list_once(plural, namespace,
                                _selector_query(label_selector,
-                                               field_selector))
+                                               field_selector),
+                               timeout=timeout)
 
     def list_paged(self, plural: str, namespace: Optional[str] = None,
                    page_size: int = 500) -> Tuple[List[object], int]:
@@ -213,19 +218,21 @@ class RESTClient:
             if not cont:
                 return items, rv
 
-    def _list_once(self, plural, namespace, q):
+    def _list_once(self, plural, namespace, q, timeout=None):
         path = self._path(plural, namespace, None)
         if self.binary:
             from ..api import binary
 
             raw, ctype = self.request_bytes("GET", path,
                                             query="&".join(q),
-                                            accept=binary.CONTENT_TYPE)
+                                            accept=binary.CONTENT_TYPE,
+                                            timeout=timeout)
             if ctype.startswith(binary.CONTENT_TYPE):
                 return binary.loads_list(raw)
             data = json.loads(raw or b"{}")
         else:
-            data = self.request("GET", path, query="&".join(q))
+            data = self.request("GET", path, query="&".join(q),
+                                timeout=timeout)
         kind = scheme.kind_for_plural(plural)
         items = [scheme.decode(kind, d) for d in data.get("items", [])]
         rv = int(data.get("metadata", {}).get("resourceVersion", "0"))

@@ -58,6 +58,20 @@ class KubeSchedulerConfiguration:
     # bind reconciler: POST attempts per bind before the GET-based
     # succeeded-but-response-lost resolution kicks in
     bind_max_attempts: int = 3
+    # control-plane outage survival (sched/storehealth.py +
+    # state/journal.py): consecutive store failures across
+    # bind/GET/LIST before the store-path breaker declares
+    # DISCONNECTED, the jittered half-open probe cooldown, the durable
+    # bind-intent journal path ("" disables durability — the spool is
+    # then memory-only and a crash mid-outage loses it, the reference's
+    # exposure), the journal segment cap (-1 = state/journal.py
+    # default), and the spool watermark above which new sheddable
+    # admissions are held in the shed area (0 = never hold)
+    store_breaker_threshold: int = 3
+    store_breaker_cooldown: float = 30.0
+    bind_journal_path: str = ""
+    bind_journal_max_bytes: int = -1
+    spool_watermark: int = 0
     # overload control (sched/queue.py "Overload control" +
     # utils/watchdog.py): shed_watermark bounds the non-shed pending
     # depth (0 disables shedding); pods below shed_priority_threshold

@@ -156,6 +156,14 @@ class SchedulingQueue:
         # fired (class_name) on every shed decision — feeds
         # scheduler_shed_total{class}
         self.on_shed: Optional[Callable[[str], None]] = None
+        # admission hold (control-plane outage plane): when this
+        # predicate returns True, every sheddable arrival parks in the
+        # shed area regardless of the watermark — the scheduler wires it
+        # to "store DISCONNECTED and the bind spool is at its
+        # watermark", so assumed capacity stops drifting from API truth
+        # while the outage lasts. Same machinery, same exemptions
+        # (system/high priority never held), same aging starvation proof
+        self.hold_admissions: Optional[Callable[[], bool]] = None
         # poison-work quarantine (module docstring "Poison-work
         # quarantine"): uid -> pod convicted by the scheduler's
         # input-fault isolation plane, uid -> re-probe deadline
@@ -220,12 +228,18 @@ class SchedulingQueue:
         sub-threshold-priority pods, only past the high watermark, never
         an aged-back exempt pod. The queue.shed fault point (drop mode)
         forces the decision for any sheddable pod — the storm chaos rig."""
-        if self.shed_watermark <= 0:
+        # the outage admission hold works even where shedding proper is
+        # disabled (watermark 0): it parks pods in the shed area on the
+        # hold predicate alone, priority/exemption rules unchanged
+        hold = self.hold_admissions is not None and self.hold_admissions()
+        if self.shed_watermark <= 0 and not hold:
             return False
         if api.pod_priority(pod) >= self.shed_priority_threshold:
             return False
         if pod.uid in self._shed_exempt:
             return False
+        if hold:
+            return True
         if faultpoints.fire("queue.shed", payload=pod):
             return True
         return self._working_depth_locked() >= self.shed_watermark
@@ -269,8 +283,11 @@ class SchedulingQueue:
         # even under the chaos rig): without this, a forced shed would
         # be undone by the very next flush under a quiet watermark.
         # is_armed, not fire(): the probe must not consume a
-        # times-bounded fault's per-pod shed budget
-        if not faultpoints.is_armed("queue.shed", "drop"):
+        # times-bounded fault's per-pod shed budget. An active admission
+        # hold suppresses the release the same way — flushing under a
+        # quiet watermark would undo the outage hold every round.
+        if not faultpoints.is_armed("queue.shed", "drop") and not (
+                self.hold_admissions is not None and self.hold_admissions()):
             while (self._shed
                    and self._working_depth_locked() < self.shed_watermark):
                 uid = next(iter(self._shed))

@@ -38,6 +38,7 @@ from typing import Callable, Optional, Tuple
 
 from ..runtime.store import Conflict
 from ..utils import tracing
+from ..utils.backoff import JitteredLadder
 
 log = logging.getLogger(__name__)
 
@@ -53,9 +54,15 @@ class BindReconciler:
                  metrics=None, max_attempts: int = 3,
                  base_delay: float = 0.05, max_delay: float = 1.0,
                  sleep: Callable[[float], None] = time.sleep,
-                 jitter: Callable[[], float] = random.random):
+                 jitter: Callable[[], float] = random.random,
+                 on_transport_error: Optional[Callable[[], None]] = None,
+                 on_transport_ok: Optional[Callable[[], None]] = None):
         """get_truth(pod) -> the pod from API truth (None if deleted);
-        must bypass local mirrors and raise when truth is unreachable."""
+        must bypass local mirrors and raise when truth is unreachable.
+        on_transport_error/on_transport_ok fire once per POST attempt
+        that failed on transport / succeeded — the store-path breaker's
+        consecutive-failure feed (definitive 409/404 answers count as
+        the store ANSWERING, so they fire on_transport_ok)."""
         self.get_truth = get_truth
         self.metrics = metrics
         self.max_attempts = max(1, max_attempts)
@@ -63,6 +70,8 @@ class BindReconciler:
         self.max_delay = max_delay
         self.sleep = sleep
         self.jitter = jitter
+        self.on_transport_error = on_transport_error
+        self.on_transport_ok = on_transport_ok
 
     def reconcile(self, pod, node_name: str,
                   attempt: Callable[[], None]) -> Tuple[str, Optional[object]]:
@@ -70,7 +79,8 @@ class BindReconciler:
         resolve any remaining ambiguity against API truth. Returns
         (outcome, truth_pod_or_None); the caller owns the cache/queue
         consequences of each outcome."""
-        delay = self.base_delay
+        ladder = JitteredLadder(self.base_delay, self.max_delay,
+                                jitter=self.jitter)
         last_exc: Optional[BaseException] = None
         for i in range(self.max_attempts):
             if i > 0:
@@ -82,18 +92,23 @@ class BindReconciler:
                               attempt=i + 1,
                               error=type(last_exc).__name__
                               if last_exc is not None else "")
-                self.sleep(delay * (0.5 + self.jitter()))
-                delay = min(delay * 2, self.max_delay)
+                self.sleep(ladder.bump())
             try:
                 attempt()
+                if self.on_transport_ok is not None:
+                    self.on_transport_ok()
                 return BOUND, None
             except (Conflict, KeyError) as e:
                 # a definitive server answer (409 already-bound, 404
                 # pod gone), not a transport fault: retrying the POST
                 # can't change it — go straight to truth resolution
+                if self.on_transport_ok is not None:
+                    self.on_transport_ok()
                 last_exc = e
                 break
             except Exception as e:  # noqa: BLE001 — transport errors retry
+                if self.on_transport_error is not None:
+                    self.on_transport_error()
                 last_exc = e
         # retries exhausted: the POST may or may not have landed (a lost
         # RESPONSE is indistinguishable from a lost REQUEST out here) —
@@ -103,10 +118,11 @@ class BindReconciler:
         except Exception as e:  # truth unreachable: reference fallback
             log.warning(
                 "bind of %s/%s -> %s failed after %d attempts (%s: %s) and "
-                "API truth is unreachable (%s: %s); falling back to "
-                "forget-on-error", pod.namespace, pod.name, node_name,
-                self.max_attempts, type(last_exc).__name__, last_exc,
-                type(e).__name__, e)
+                "API truth is unreachable (%s: %s); orphaned without truth "
+                "— the scheduler spools the intent (outage mode) or falls "
+                "back to forget-on-error", pod.namespace, pod.name,
+                node_name, self.max_attempts, type(last_exc).__name__,
+                last_exc, type(e).__name__, e)
             return ORPHANED, None
         if truth is None:
             return GONE, None

@@ -63,6 +63,10 @@ from .preemption import (GangGuard, PreemptionResult,
                          select_victims_on_node)
 from .queue import SchedulingQueue
 from .reconciler import BOUND, CONFIRMED, GONE, ORPHANED, BindReconciler
+from .storehealth import DISCONNECTED as STORE_DISCONNECTED
+from .storehealth import STATE_CODES as STORE_STATE_CODES
+from .storehealth import StorePathBreaker
+from ..state.journal import BindJournal
 
 
 # Max chained waves per device-resident round; rounds compile per
@@ -159,6 +163,11 @@ class Scheduler:
                  bind_workers: int = 4,
                  scrub_interval: Optional[float] = None,
                  breaker_threshold: int = 3, breaker_cooldown: float = 30.0,
+                 store_breaker_threshold: int = 3,
+                 store_breaker_cooldown: float = 30.0,
+                 bind_journal_path: Optional[str] = None,
+                 bind_journal_max_bytes: int = -1,
+                 spool_watermark: int = 0,
                  metrics: Optional[Metrics] = None,
                  bind_max_attempts: int = 3,
                  racecheck: bool = False,
@@ -234,9 +243,11 @@ class Scheduler:
         # bind reconciler: per-attempt-bounded jittered retries on the
         # bind POST, then GET-against-API-truth resolution of the
         # succeeded-but-response-lost ambiguity (sched/reconciler.py)
-        self.reconciler = BindReconciler(self._pod_truth,
-                                         metrics=self.metrics,
-                                         max_attempts=bind_max_attempts)
+        self.reconciler = BindReconciler(
+            self._pod_truth, metrics=self.metrics,
+            max_attempts=bind_max_attempts,
+            on_transport_error=self._store_bind_failed,
+            on_transport_ok=self._store_bind_ok)
         # dormant = leadership lost: waves stop, binds drained, informers
         # stay warm; recover_leadership() reconciles + resumes
         self._dormant = False
@@ -280,6 +291,43 @@ class Scheduler:
             on_trip=self.metrics.device_path_trips.inc,
             on_state=self._breaker_state_changed)
         self.metrics.breaker_state.set(STATE_CODES[self.breaker.state])
+        # store-path circuit breaker (sched/storehealth.py): consecutive
+        # transport failures across bind/GET/LIST trip disconnected-mode
+        # scheduling — waves keep scoring against the informer cache,
+        # binds spool into the durable intent journal, and the oldest
+        # spooled intent's own POST serves as the jittered half-open
+        # probe. Fed by the reconciler's per-attempt callbacks, the
+        # truth-GET seam (_pod_truth) and — for RemoteStore — the
+        # reflector relist path (set_health below).
+        self.storehealth = StorePathBreaker(
+            threshold=store_breaker_threshold,
+            cooldown=store_breaker_cooldown, clock=clock,
+            on_trip=self.metrics.store_breaker_trips.inc,
+            on_state=self._store_state_changed,
+            on_reconnect=self._store_reconnected)
+        self.metrics.store_breaker_state.set(
+            STORE_STATE_CODES[self.storehealth.state])
+        set_health = getattr(store, "set_health", None)
+        if set_health is not None:
+            set_health(self.storehealth)
+        # disconnected-mode bind spool: arrival-ordered
+        # (pod, bound, node_name, vol_rollback, journal_seq) intents
+        # whose POST is deferred until the store heals. The pod STAYS
+        # assumed (capacity held; post-heal placements bit-identical to
+        # an outage-free run) and the journal holds the durable copy
+        # for crash-restart replay. Guarded by _mu.
+        self._spool: List[tuple] = []
+        self._spool_uids: set = set()
+        self._spool_drain_due = False
+        self.spool_watermark = int(spool_watermark)
+        self.journal = (BindJournal(bind_journal_path,
+                                    max_bytes=bind_journal_max_bytes)
+                        if bind_journal_path else None)
+        # admission hold: while DISCONNECTED with the spool at its
+        # watermark, sheddable arrivals park in the shed area (the PR 11
+        # overload machinery) instead of growing assumed capacity —
+        # the spool stays bounded by watermark + in-queue backlog
+        self.queue.hold_admissions = self._admissions_held
         # device telemetry: kernel dispatches account jit cache events
         # into this scheduler's registry; snapshot upload bytes are
         # drained into counters by export_queue_gauges
@@ -447,7 +495,24 @@ class Scheduler:
         # conservation/gang_atomic invariants. NEVER disable outside a
         # test.
         self._gang_rollback_enabled = True
+        # crash-journal replay test hook: the chaos campaign's
+        # broken-build acceptance flips this False to prove that a
+        # build which neither drains the spool nor replays the journal
+        # is caught by the conservation invariant's
+        # spool-outlived-the-outage rule. NEVER disable outside a test.
+        self._journal_replay_enabled = True
         self._wire_informers()
+        # a warm store (crash restart / failover) backfills bound pods
+        # BEFORE their nodes above, so the per-event snapshot adds can
+        # land against absent node rows — rebuild the mirror from host
+        # truth exactly like recover_leadership does, before the first
+        # wave ever reads it
+        if any(ni.pods for ni in self.cache.node_infos.values()):
+            self.scrubber.rebuild()
+        # after informer backfill (which re-queues Pending pods a prior
+        # process had claimed) so replay can retire journal-claimed pods
+        # from the queue before the first wave
+        self.recover_from_journal()
 
     # -- informer handlers (reference: factory.go:191-295) --------------------
 
@@ -637,6 +702,72 @@ class Scheduler:
         if rec is not None:
             rec.event("breaker", state=state,
                       failures=self.breaker.failures)
+
+    def _store_state_changed(self, state: str) -> None:
+        """Store-path breaker transitions land on the state gauge
+        (0=connected, 1=degraded, 2=disconnected) and as a span event —
+        like the device breaker, operators need to see the DEGRADED
+        window, not only the trip counter."""
+        self.metrics.store_breaker_state.set(STORE_STATE_CODES[state])
+        rec = tracing.active()
+        if rec is not None:
+            rec.event("store_breaker", state=state,
+                      failures=self.storehealth.failures,
+                      spool=len(self._spool))
+
+    def _store_reconnected(self) -> None:
+        """record_success fires this from whatever thread observed the
+        heal (a binder, the reflector, a recovery GET) — draining
+        inline there could re-enter the reconciler from its own
+        callback, so only flag it; the next housekeeping pass drains on
+        the scheduling thread."""
+        self._spool_drain_due = True
+
+    def _store_bind_failed(self) -> None:
+        # reconciler on_transport_error: one failed bind POST attempt
+        self.metrics.store_errors.labels(op="bind").inc()
+        self.storehealth.record_failure()
+
+    def _store_bind_ok(self) -> None:
+        self.storehealth.record_success()
+
+    def _admissions_held(self) -> bool:
+        """queue.hold_admissions hook — outage with the spool at its
+        watermark: park sheddable arrivals in the shed area until the
+        store heals (system/high classes are never held, exactly like
+        overload shedding)."""
+        return (self.spool_watermark > 0
+                and self.storehealth.state == STORE_DISCONNECTED
+                and len(self._spool) >= self.spool_watermark)
+
+    def spool_count(self) -> int:
+        with self._mu:
+            return len(self._spool)
+
+    def spool_uids(self) -> frozenset:
+        """UIDs currently spooled — the invariant checker's legal
+        assumed-but-unbound set for the duration of an outage."""
+        with self._mu:
+            return frozenset(self._spool_uids)
+
+    def store_debug(self) -> Dict[str, object]:
+        """The /debug/store payload: breaker snapshot, spool depth,
+        journal stats, per-op store error counters."""
+        out = self.storehealth.snapshot()
+        with self._mu:
+            out["spool"] = {
+                "depth": len(self._spool),
+                "watermark": self.spool_watermark,
+                "oldest_seq": self._spool[0][4] if self._spool else None,
+                "drain_due": self._spool_drain_due,
+            }
+        out["journal"] = (self.journal.stats()
+                          if self.journal is not None else None)
+        out["errors"] = {
+            op: self.metrics.store_errors.value(op=op)
+            for op in ("get", "list", "bind", "create", "update",
+                       "delete", "watch")}
+        return out
 
     def _pod_shed(self, cls: str) -> None:
         """Queue shed hook: one increment per shed decision, labelled
@@ -1126,6 +1257,16 @@ class Scheduler:
         snapshot scrubber if its signal or cadence fired."""
         with self._mu:
             self.cache.cleanup_expired()
+        # disconnected-mode spool: drain when the store path is healthy
+        # again (reconnect flagged by the breaker), or use the oldest
+        # spooled intent as the half-open probe once the jittered
+        # cooldown elapses (allow() admits exactly one). Gated on the
+        # replay hook so the chaos broken-build acceptance can model a
+        # build that never drains.
+        if (self._journal_replay_enabled and self._spool
+                and (self.storehealth.state != STORE_DISCONNECTED
+                     or self.storehealth.allow())):
+            self._drain_spool()
         now = self.clock()
         if now >= self._next_backoff_gc:
             self._next_backoff_gc = now + self.BACKOFF_GC_PERIOD
@@ -1157,6 +1298,8 @@ class Scheduler:
         g.labels(queue="shed").set(self.queue.shed_count())
         # poison-work isolation: convicted pods awaiting their re-probe
         g.labels(queue="quarantine").set(self.queue.quarantine_count())
+        # control-plane outage: bind intents spooled for the store heal
+        g.labels(queue="spool").set(self.spool_count())
         now = self.clock()
         if now >= self._next_class_export:
             self._next_class_export = now + 1.0
@@ -3640,21 +3783,22 @@ class Scheduler:
             logging.getLogger(__name__).error(
                 "bind worker raised", exc_info=exc)
 
-    def _bind_and_finish(self, pod: api.Pod, bound: api.Pod,
-                         node_name: str, vol_rollback=None) -> bool:
-        """The bind POST + cache confirmation; runs outside _mu. The
-        POST goes through the bind reconciler (sched/reconciler.py):
-        jittered retries first, then GET-against-API-truth resolution —
-        so a lost bind RESPONSE confirms the assumption while a lost
-        bind REQUEST rolls it back (forget + PVC rollback +
-        backoff-requeue; reference forget-on-failure, scheduler.go:
-        409-432, which tolerated the ambiguity this resolves)."""
-        t0 = self.clock()
+    def _bind_attempt(self, pod: api.Pod, node_name: str):
+        """One bind POST as a closure — shared by the live bind path
+        and the spool drain, so both replay through identical fault
+        seams and extender routing."""
 
         def _attempt():
             # chaos seam: a raise here exercises retry, then the full
             # rollback/confirm resolution path
             faultpoints.fire("bind.post", payload=pod)
+            # store-path outage seam: covers the ObjectStore and
+            # RemoteStore bind paths exactly once per attempt
+            # (RemoteStore.bind deliberately does NOT fire it — doubling
+            # would double-count breaker failures and burn injected
+            # `times` budgets twice)
+            if faultpoints.fire("store.outage", payload=("bind", pod.uid)):
+                raise ConnectionError("store.outage: bind request dropped")
             # reference scheduler.go:409 GetBinder: an extender with a bind
             # verb performs the binding; the in-process store is then updated
             # so informers observe the placement either way
@@ -3664,7 +3808,31 @@ class Scheduler:
                 binder.bind(pod, node_name)
             self.store.bind(pod, node_name)
 
-        outcome, truth = self.reconciler.reconcile(pod, node_name, _attempt)
+        return _attempt
+
+    def _bind_and_finish(self, pod: api.Pod, bound: api.Pod,
+                         node_name: str, vol_rollback=None) -> bool:
+        """The bind POST + cache confirmation; runs outside _mu. The
+        POST goes through the bind reconciler (sched/reconciler.py):
+        jittered retries first, then GET-against-API-truth resolution —
+        so a lost bind RESPONSE confirms the assumption while a lost
+        bind REQUEST rolls it back (forget + PVC rollback +
+        backoff-requeue; reference forget-on-failure, scheduler.go:
+        409-432, which tolerated the ambiguity this resolves).
+
+        Disconnected mode changes exactly two things here: a POST is
+        not even attempted while the store-path breaker is dark
+        (allow() False -> spool the intent straight away), and the
+        retries-exhausted-AND-truth-unreachable resolution — which the
+        reconciler reports as (ORPHANED, None) — spools instead of
+        forgetting: that signature is a store outage, not a placement
+        problem, and forgetting would re-place the pod post-heal,
+        breaking placement parity with an outage-free run."""
+        t0 = self.clock()
+        if not self.storehealth.allow():
+            return self._spool_bind(pod, bound, node_name, vol_rollback)
+        outcome, truth = self.reconciler.reconcile(
+            pod, node_name, self._bind_attempt(pod, node_name))
         rec = tracing.active()
         if rec is not None:
             # per-pod async bind span (UID-keyed); retries inside the
@@ -3677,6 +3845,16 @@ class Scheduler:
                 # against API truth
                 rec.event("bind_resolution", pod=pod.uid, outcome=outcome,
                           node=node_name)
+        if outcome == ORPHANED and truth is None:
+            return self._spool_bind(pod, bound, node_name, vol_rollback)
+        return self._apply_bind_outcome(pod, bound, node_name, vol_rollback,
+                                        outcome, truth, t0)
+
+    def _apply_bind_outcome(self, pod: api.Pod, bound: api.Pod,
+                            node_name: str, vol_rollback,
+                            outcome: str, truth, t0: float) -> bool:
+        """The cache/queue consequences of one reconciled bind outcome —
+        shared by the live bind path and the spool drain."""
         if outcome == CONFIRMED:
             # the bind landed server-side and only the response was
             # lost: adopt API truth instead of rolling back. add_pod
@@ -3745,6 +3923,195 @@ class Scheduler:
         self.poison_backoff.clear(pod.uid)
         self.queue.clear_backoff(pod.uid)
         self.queue.update_nominated_pod(pod, "")
+        return True
+
+    # -- disconnected-mode bind spool + durable intent journal -----------------
+
+    def _spool_bind(self, pod: api.Pod, bound: api.Pod, node_name: str,
+                    vol_rollback=None, seq: Optional[int] = None) -> bool:
+        """Disconnected-mode bind: keep the assumption (capacity stays
+        held, so post-heal placements are bit-identical to an
+        outage-free run), append the intent to the durable journal, and
+        park the POST in the in-memory spool in arrival order. The
+        reconnect drain replays it through the full reconciler
+        ambiguity path. Returns True — the pod IS placed; only the
+        store write is deferred."""
+        with self._mu:
+            if pod.uid in self._spool_uids:
+                return True
+            if seq is None and self.journal is not None:
+                try:
+                    seq = self.journal.append_intent(bound, node_name)
+                except Exception:
+                    # full disk / IO fault at the worst moment: the
+                    # intent still spools in memory (a crash now loses
+                    # it — exactly the reference's pre-journal exposure)
+                    logging.getLogger(__name__).exception(
+                        "bind journal append failed; intent for %s/%s "
+                        "spools in memory only", pod.namespace, pod.name)
+            self._spool.append((pod, bound, node_name, vol_rollback, seq))
+            self._spool_uids.add(pod.uid)
+            depth = len(self._spool)
+        self.metrics.binds_spooled.inc()
+        rec = tracing.active()
+        if rec is not None:
+            rec.event("bind_spooled", pod=pod.uid, node=node_name,
+                      seq=seq if seq is not None else -1, depth=depth)
+        return True
+
+    def _drain_spool(self) -> Dict[str, int]:
+        """Replay spooled bind intents head-first (arrival order)
+        through the reconciler. Stops at the first intent whose store
+        path is still dark — that entry stays at the head for the next
+        probe window and the breaker has already re-tripped via the
+        per-attempt callbacks. Every resolved intent is removed from
+        the spool and marked resolved in the journal."""
+        stats = {"bound": 0, "confirmed": 0, "orphaned": 0, "gone": 0}
+        while True:
+            with self._mu:
+                if not self._spool:
+                    break
+                entry = self._spool[0]
+            if not self._flush_intent(entry, stats):
+                break
+        self._spool_drain_due = False
+        if any(stats.values()):
+            logging.getLogger(__name__).info(
+                "bind spool drained: %(bound)d bound, %(confirmed)d "
+                "confirmed, %(orphaned)d orphaned+requeued, "
+                "%(gone)d gone", stats)
+        return stats
+
+    def _flush_intent(self, entry, stats) -> bool:
+        """POST one spooled intent and apply its outcome. False = the
+        store is still dark (entry stays spooled at the head)."""
+        pod, bound, node_name, vol_rollback, seq = entry
+        t0 = self.clock()
+        outcome, truth = self.reconciler.reconcile(
+            pod, node_name, self._bind_attempt(pod, node_name))
+        if outcome == ORPHANED and truth is None:
+            return False  # still unreachable: keep the intent spooled
+        with self._mu:
+            try:
+                self._spool.remove(entry)
+            except ValueError:
+                pass
+            self._spool_uids.discard(pod.uid)
+        self._apply_bind_outcome(pod, bound, node_name, vol_rollback,
+                                 outcome, truth, t0)
+        if seq is not None and self.journal is not None:
+            self.journal.resolve(
+                seq, CONFIRMED if outcome in (BOUND, CONFIRMED) else outcome)
+        stats[outcome if outcome != BOUND else "bound"] += 1
+        rec = tracing.active()
+        if rec is not None:
+            rec.event("bind_despooled", pod=pod.uid, node=node_name,
+                      outcome=outcome)
+        return True
+
+    def recover_from_journal(self) -> Dict[str, int]:
+        """Crash-restart replay: re-own every unresolved bind intent in
+        the journal before the first wave. API truth decides each one:
+        already bound -> adopt (the crash lost only the confirmation);
+        still pending -> re-assume and re-spool (the POST never got
+        out, or its fate was lost with the process); deleted or
+        recreated under a new UID -> resolve as gone; truth unreachable
+        (the outage outlived the crash) -> re-assume from the local
+        mirror and re-spool for the post-heal drain. Runs at
+        construction (AFTER informer backfill, so journal-claimed pods
+        can be retired from the pending queue) and again on
+        recover_leadership()."""
+        stats = {"adopted": 0, "respooled": 0, "requeued": 0, "gone": 0,
+                 "unreachable": 0}
+        if self.journal is None or not self._journal_replay_enabled:
+            return stats
+
+        class _PodRef:
+            # pod-shaped stub for the truth GET: namespace/name/uid are
+            # all the journal recorded
+            def __init__(self, ns, name, uid):
+                self.namespace, self.name, self.uid = ns, name, uid
+                self.metadata = type("M", (), {"name": name})()
+
+        for it in self.journal.unresolved():
+            uid, node, seq = it.get("uid"), it.get("node"), it.get("seq")
+            ns, name = it.get("ns"), it.get("name")
+            with self._mu:
+                if uid in self._spool_uids:
+                    continue  # the live spool owns it (leadership
+                    #           bounce, not a crash)
+            local = self.store.get("pods", ns, name)
+            reachable = True
+            try:
+                truth = self._pod_truth(local if local is not None
+                                        else _PodRef(ns, name, uid))
+            except Exception:
+                truth, reachable = None, False
+            if not reachable:
+                # outage persists across the restart: re-own the intent
+                # from the mirror copy so capacity is held and the
+                # post-heal drain resolves it; without a mirror copy the
+                # intent stays unresolved for the next replay
+                if local is not None and self._respool_local(local, node,
+                                                             seq):
+                    stats["respooled"] += 1
+                else:
+                    stats["unreachable"] += 1
+                continue
+            if truth is None or truth.uid != uid:
+                # deleted (or the name reused by a NEW pod) while down
+                self.journal.resolve(seq, GONE)
+                stats["gone"] += 1
+            elif truth.spec.node_name:
+                # the bind landed before the crash; adopt it and retire
+                # the pod from the queue informer backfill re-added
+                with self._mu:
+                    self.cache.add_pod(truth)  # insert-or-confirm
+                    self.queue.remove_if_pending(uid)
+                    self.queue.assigned_pod_added(truth)
+                self.journal.resolve(seq, CONFIRMED)
+                stats["adopted"] += 1
+            else:
+                # still Pending in API truth: the intent never landed.
+                # Re-assume onto the journaled node and re-spool under
+                # the SAME seq (the drain POSTs it as soon as the path
+                # is confirmed healthy — which this GET just did).
+                if self._respool_local(truth, node, seq):
+                    stats["respooled"] += 1
+                else:
+                    # node vanished while down: the pod stays queued
+                    # (informer backfill already re-added it) and
+                    # schedules fresh
+                    self.journal.resolve(seq, ORPHANED)
+                    stats["requeued"] += 1
+        if any(stats.values()):
+            logging.getLogger(__name__).info(
+                "bind-journal replay: %(adopted)d adopted, %(respooled)d "
+                "re-spooled, %(requeued)d requeued fresh, %(gone)d gone, "
+                "%(unreachable)d unreachable (kept for next replay)",
+                stats)
+            self.export_queue_gauges()
+        return stats
+
+    def _respool_local(self, pod: api.Pod, node_name: str,
+                       seq: Optional[int]) -> bool:
+        """Re-own one journaled intent: assume the pod onto its
+        journaled node (if that node still exists) and re-spool it."""
+        bound = api.with_node_name(pod, node_name)
+        with self._mu:
+            ni = self.cache.node_infos.get(node_name)
+            if ni is None:
+                return False
+            try:
+                self.cache.assume_pod(bound)
+            except KeyError:
+                pass  # already assumed/known — capacity already held
+            else:
+                self.snapshot.refresh_node_resources(
+                    self.cache.node_infos[node_name])
+                self.snapshot.add_pod(bound)
+            self.queue.remove_if_pending(bound.uid)
+        self._spool_bind(pod, bound, node_name, None, seq=seq)
         return True
 
     def wait_for_binds(self) -> None:
@@ -3875,6 +4242,11 @@ class Scheduler:
                         # deleted while we weren't looking (the DELETED
                         # event may have been lost too)
                         self.queue.delete(pod)
+            # crash-journal replay re-runs on every leadership
+            # recovery: a prior incarnation (or the dormant spell's
+            # binds) may have left unresolved intents behind; anything
+            # the live spool already owns is skipped
+            self.recover_from_journal()
             self.scrubber.rebuild()
             self._dormant = False
         # anything another leader failed to place may be schedulable
@@ -3896,18 +4268,38 @@ class Scheduler:
         store is a RemoteStore — its get() serves the reflector mirror,
         whose staleness is exactly what bind reconciliation and the
         recovery pass must not trust. None = deleted; raises when truth
-        is unreachable."""
-        client = getattr(self.store, "client", None)
-        if client is not None:
-            from ..client.rest import APIStatusError
-            try:
-                return client.get("pods", pod.namespace, pod.metadata.name,
-                                  timeout=self.TRUTH_GET_TIMEOUT)
-            except APIStatusError as e:
-                if e.code == 404:
-                    return None
-                raise
-        return self.store.get("pods", pod.namespace, pod.name)
+        is unreachable.
+
+        This is also the store-path breaker's GET feed: a transport
+        failure counts against the consecutive-failure ladder (op=get),
+        any ANSWER — including 404/409 — counts as the store being
+        reachable. The `store.outage` fault point fires here so chaos
+        can sever the truth path together with the bind path."""
+        try:
+            if faultpoints.fire("store.outage", payload=("get", pod.uid)):
+                raise ConnectionError("store.outage: truth GET dropped")
+            client = getattr(self.store, "client", None)
+            if client is not None:
+                from ..client.rest import APIStatusError
+                try:
+                    truth = client.get("pods", pod.namespace,
+                                       pod.metadata.name,
+                                       timeout=self.TRUTH_GET_TIMEOUT)
+                except APIStatusError as e:
+                    self.storehealth.record_success()  # the store ANSWERED
+                    if e.code == 404:
+                        return None
+                    raise
+            else:
+                truth = self.store.get("pods", pod.namespace, pod.name)
+        except Exception as e:
+            from ..client.rest import APIStatusError as _APIErr
+            if not isinstance(e, _APIErr):
+                self.metrics.store_errors.labels(op="get").inc()
+                self.storehealth.record_failure()
+            raise
+        self.storehealth.record_success()
+        return truth
 
     # -- failure path ----------------------------------------------------------
 

@@ -1,12 +1,62 @@
-"""Per-pod exponential backoff (reference: pkg/scheduler/util/
+"""Exponential backoff, shared by every retry ladder in the tree.
+
+PodBackoff is the per-pod map (reference: pkg/scheduler/util/
 backoff_utils.go:97-112 — 1s initial, doubling, 60s max, entries GC'd
-after 2*maxDuration of idleness)."""
+after 2*maxDuration of idleness). JitteredLadder is the single-stream
+variant used by the reflector's relist loop, the bind reconciler's
+retry loop, and the store-path breaker's probe cooldown: each bump
+yields `delay * (0.5 + jitter())` (full-jitter over [0.5x, 1.5x), so
+concurrent ladders never synchronize) and doubles the base toward the
+cap. Before this module owned it, the same three lines lived
+copy-pasted in client/reflector.py and sched/reconciler.py and a
+third unjittered copy in the autoscaler's duration doubling — one
+shape, one place.
+"""
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-from typing import Dict, Tuple
+from typing import Callable, Dict, Tuple
+
+
+def jittered(delay: float,
+             jitter: Callable[[], float] = random.random) -> float:
+    """Full-jitter: a uniform draw over [0.5x, 1.5x) of `delay`."""
+    return delay * (0.5 + jitter())
+
+
+def exp_step(delay: float, maximum: float) -> float:
+    """One rung up the doubling ladder, capped at `maximum`."""
+    return min(delay * 2.0, maximum)
+
+
+class JitteredLadder:
+    """A single jittered-exponential retry ladder.
+
+    bump() returns the jittered wait for THIS failure and doubles the
+    base (capped) for the next one; reset() drops back to the initial
+    rung after a clean cycle. `delay` is the un-jittered base — tests
+    assert ladder position against it without fighting the jitter.
+    """
+
+    __slots__ = ("initial", "maximum", "jitter", "delay")
+
+    def __init__(self, initial: float, maximum: float,
+                 jitter: Callable[[], float] = random.random):
+        self.initial = initial
+        self.maximum = maximum
+        self.jitter = jitter
+        self.delay = initial
+
+    def bump(self) -> float:
+        d = jittered(self.delay, self.jitter)
+        self.delay = exp_step(self.delay, self.maximum)
+        return d
+
+    def reset(self) -> None:
+        self.delay = self.initial
 
 
 class _Entry:
@@ -46,7 +96,7 @@ class PodBackoff:
                 e = _Entry(self.initial, now)
                 self._entries[pod_id] = e
             d = e.duration
-            e.duration = min(e.duration * 2, self.maximum)
+            e.duration = exp_step(e.duration, self.maximum)
             e.last_update = now
             return d
 

@@ -111,6 +111,25 @@ Wired points (grep for `faultpoints.fire`):
                    instant; the chaos assert is that nothing was
                    promoted, the gating flag is dropped, and the
                    outcome ledgered as `aborted`
+  store.outage     the store-path outage seam, fired once per
+                   control-plane round trip the scheduler depends on:
+                   sched/scheduler.py _bind_attempt (before each bind
+                   POST; payload ("bind", uid)) and _pod_truth (before
+                   each truth GET; payload ("get", uid)),
+                   client/reflector.py Reflector._list (payload
+                   ("list", plural)) and RemoteStore._guard (payload:
+                   the op string). A duration-armed `raise` severs the
+                   whole store path: the store breaker
+                   (sched/storehealth.py) trips to DISCONNECTED, binds
+                   spool into the intent journal, and the post-heal
+                   drain must leave placements bit-identical to an
+                   outage-free run
+  journal.append   state/journal.py _append_locked, BEFORE the write
+                   (payload: the record dict) — `raise` models a full
+                   disk / IO error at the worst moment (the intent
+                   then spools in memory only), `drop` models a write
+                   the OS acknowledged but never persisted (the
+                   crash-restart replay must tolerate the hole)
 
 Modes:
 

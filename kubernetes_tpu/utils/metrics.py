@@ -319,6 +319,20 @@ class Metrics:
         self.watch_stale = Counter("watch_stale_total")
         self.bind_retries = Counter("bind_retries_total")
         self.cache_assumed_expired = Counter("cache_assumed_expired_total")
+        # control-plane outage plane (sched/storehealth.py + the bind
+        # spool): store-path breaker state (0=connected, 1=degraded,
+        # 2=disconnected) set on every transition, trips into
+        # DISCONNECTED, per-op store failures, and bind intents spooled
+        # into the journal while disconnected (the spool DEPTH rides
+        # scheduler_pending_pods{queue="spool"})
+        self.store_breaker_state = Gauge("scheduler_store_breaker_state")
+        self.store_breaker_trips = Counter(
+            "scheduler_store_breaker_trips_total")
+        self.store_errors = LabeledCounter(
+            "store_errors_total", ("op",),
+            values={"op": ("get", "list", "bind", "create", "update",
+                           "delete", "watch")})
+        self.binds_spooled = Counter("scheduler_binds_spooled_total")
         # queue depth per area, refreshed by the scheduler housekeeping
         # step — the cluster autoscaler and operators both watch it
         # (a Counter can't report a depth that drains)

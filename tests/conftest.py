@@ -100,6 +100,11 @@ def pytest_configure(config):
                    "failing-schedule shrinking, KTPU_FAULTPOINTS "
                    "reproducers; make chaos — full budgeted run behind "
                    "make chaos-campaign)")
+    config.addinivalue_line(
+        "markers", "outage: control-plane outage survival suite "
+                   "(store-path breaker, disconnected-mode bind spool, "
+                   "durable intent journal, crash-restart replay; "
+                   "make chaos)")
 
 
 import pytest  # noqa: E402

@@ -1,0 +1,171 @@
+"""Durable bind-intent journal (state/journal.py): the
+disconnected-mode write-ahead log.
+
+Tier-1 coverage for the format and the two crash-hardening behaviors
+the outage plane leans on: size-cap rotation (one `.1` generation,
+replay streams both segments so rotation never loses unresolved
+intents) and torn-line tolerance (a crash can tear the final line
+mid-write; replay skips it and the next append repairs the tail so new
+records stay parseable). The `journal.append` fault point is exercised
+in both modes: raise models a full disk at the worst moment, drop
+models a write the OS acknowledged but never persisted.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.state import journal as journal_mod
+from kubernetes_tpu.state.journal import (CONFIRMED, GONE, ORPHANED,
+                                          BindJournal)
+from kubernetes_tpu.utils import faultpoints
+
+from helpers import make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+def _journal(tmp_path, **kw):
+    return BindJournal(str(tmp_path / "bind.journal"), **kw)
+
+
+class TestFormat:
+    def test_append_intent_record_shape(self, tmp_path):
+        j = _journal(tmp_path, clock=lambda: 123.456)
+        pod = make_pod("web-1")
+        seq = j.append_intent(pod, "node-a")
+        assert seq == 0
+        lines = open(j.path).read().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec == {"v": 1, "k": "intent", "seq": 0, "uid": pod.uid,
+                       "ns": "default", "name": "web-1",
+                       "node": "node-a", "ts": 123.456}
+
+    def test_seq_monotonic_and_resolve_record(self, tmp_path):
+        j = _journal(tmp_path)
+        s0 = j.append_intent(make_pod("a"), "n0")
+        s1 = j.append_intent(make_pod("b"), "n1")
+        assert (s0, s1) == (0, 1)
+        j.resolve(s0, CONFIRMED)
+        recs = [json.loads(l) for l in open(j.path).read().splitlines()]
+        assert recs[-1] == {"v": 1, "k": "resolved", "seq": 0,
+                            "outcome": "confirmed"}
+
+    def test_unresolved_is_set_difference_in_seq_order(self, tmp_path):
+        j = _journal(tmp_path)
+        seqs = [j.append_intent(make_pod(f"p{i}"), f"n{i}")
+                for i in range(4)]
+        j.resolve(seqs[1], GONE)
+        j.resolve(seqs[3], ORPHANED)
+        left = j.unresolved()
+        assert [r["seq"] for r in left] == [seqs[0], seqs[2]]
+        assert [r["name"] for r in left] == ["p0", "p2"]
+
+    def test_fresh_path_has_no_unresolved(self, tmp_path):
+        j = _journal(tmp_path)
+        assert j.unresolved() == []
+        assert j.stats()["unresolved"] == 0
+
+    def test_seq_resumes_past_prior_generation(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_intent(make_pod("a"), "n0")
+        j.append_intent(make_pod("b"), "n1")
+        # a restarted process must never reuse a live seq — resolve
+        # records are matched by seq across generations
+        j2 = _journal(tmp_path)
+        assert j2.append_intent(make_pod("c"), "n2") == 2
+
+
+class TestRotation:
+    def test_rotation_keeps_unresolved_across_segments(self, tmp_path):
+        # one generation (`.1`) is kept, so size the cap for exactly
+        # ONE rotation: 2.5 lines — the 3rd intent rotates the first
+        # two out to `.1`; replay must still see all four
+        probe = BindJournal(str(tmp_path / "probe.journal"),
+                            clock=lambda: 100.0)
+        probe.append_intent(make_pod("rot0"), "n0")
+        line = os.path.getsize(probe.path)
+        j = BindJournal(str(tmp_path / "bind.journal"),
+                        max_bytes=int(2.5 * line), clock=lambda: 100.0)
+        seqs = [j.append_intent(make_pod(f"rot{i}"), f"n{i}")
+                for i in range(4)]
+        assert j.rotations == 1
+        assert os.path.exists(j.path + ".1")
+        assert [r["seq"] for r in j.unresolved()] == seqs
+        # resolving an intent that lives in the OLD segment works: the
+        # resolved record lands in the new one, matched by seq
+        j.resolve(seqs[0], CONFIRMED)
+        assert seqs[0] not in {r["seq"] for r in j.unresolved()}
+
+    def test_default_cap_comes_from_module(self, tmp_path):
+        assert _journal(tmp_path, max_bytes=-1).max_bytes == \
+            journal_mod.JOURNAL_MAX_BYTES
+
+
+class TestTornLines:
+    def test_torn_tail_skipped_not_fatal(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_intent(make_pod("ok"), "n0")
+        # crash mid-write: the final line is half a record
+        with open(j.path, "ab") as f:
+            f.write(b'{"v":1,"k":"intent","seq":1,"uid":"torn')
+        left = j.unresolved()
+        assert [r["name"] for r in left] == ["ok"]
+        assert j.skipped_lines == 1
+
+    def test_append_after_torn_tail_repairs_line_boundary(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_intent(make_pod("ok"), "n0")
+        with open(j.path, "ab") as f:
+            f.write(b'{"v":1,"k":"int')
+        # the next append must terminate the torn line first — both the
+        # old and the new record stay individually parseable
+        j.append_intent(make_pod("after"), "n1")
+        names = [r["name"] for r in j.unresolved()]
+        assert names == ["ok", "after"]
+        assert j.skipped_lines == 1
+
+    def test_garbage_line_in_middle_skipped(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_intent(make_pod("a"), "n0")
+        with open(j.path, "ab") as f:
+            f.write(b"\x00\xff not json at all\n")
+        j.append_intent(make_pod("b"), "n1")
+        assert [r["name"] for r in j.unresolved()] == ["a", "b"]
+
+
+class TestFaultPoint:
+    def test_raise_mode_propagates_to_caller(self, tmp_path):
+        # full disk at the worst moment: append_intent raises, nothing
+        # is written, and the caller decides about a memory-only spool
+        j = _journal(tmp_path)
+        faultpoints.activate("journal.append", "raise", times=1)
+        with pytest.raises(faultpoints.FaultInjected):
+            j.append_intent(make_pod("a"), "n0")
+        assert not os.path.exists(j.path)
+        # once the disk "recovers" the journal works again
+        j.append_intent(make_pod("b"), "n1")
+        assert [r["name"] for r in j.unresolved()] == ["b"]
+
+    def test_drop_mode_loses_exactly_the_acked_write(self, tmp_path):
+        j = _journal(tmp_path)
+        j.append_intent(make_pod("kept"), "n0")
+        faultpoints.activate("journal.append", "drop", times=1)
+        j.append_intent(make_pod("lost"), "n1")  # OS lied: no error, no data
+        assert [r["name"] for r in j.unresolved()] == ["kept"]
+
+    def test_dropped_resolve_means_reverify_not_corruption(self, tmp_path):
+        j = _journal(tmp_path)
+        s = j.append_intent(make_pod("a"), "n0")
+        faultpoints.activate("journal.append", "drop", times=1)
+        j.resolve(s, CONFIRMED)  # the resolved record never lands
+        # the intent stays unresolved — replay re-verifies it against
+        # truth, which is idempotent by design
+        assert [r["seq"] for r in j.unresolved()] == [s]

@@ -1,0 +1,561 @@
+"""Control-plane outage survival (ISSUE 18): the store-path breaker,
+disconnected-mode scheduling with the durable bind-intent spool, and
+crash-restart journal recovery.
+
+The layers under test, bottom-up:
+
+  * StorePathBreaker (sched/storehealth.py): CONNECTED -> DEGRADED ->
+    DISCONNECTED on consecutive store failures, jittered half-open
+    probes, reconnect callbacks — clock-driven unit coverage.
+  * Disconnected-mode e2e: with `store.outage` severing every bind
+    POST and truth GET, the scheduler keeps scoring against its cache,
+    spools intents (durably, when a journal is configured), HOLDS new
+    sheddable admissions past the spool watermark, and drains the
+    spool through the bind-ambiguity path after the heal — with
+    placements bit-identical to an outage-free run of the same
+    arrivals.
+  * Crash-restart: a scheduler killed mid-outage (abandoned, no
+    farewell) is replaced by a fresh process over the same store +
+    journal; construction replays the unresolved intents before the
+    first wave — zero double-binds, zero lost pods, strict invariant
+    checker clean throughout.
+  * The reflector's full-outage behavior: relist ladder climbs to its
+    cap while the store is dark (feeding the breaker's LIST path), the
+    clock-driven staleness watchdog keeps forcing relists once streams
+    open but deliver nothing, and the first post-heal clean cycle
+    resets the ladder and reconnects the breaker.
+  * Campaign acceptance: a deliberately-broken build (journal replay +
+    spool drain disabled) is caught by the conservation invariant's
+    spool-outlived-the-outage rule, shrunk to a minimal paste-able
+    reproducer, and re-triggered from the env string alone — while the
+    healthy build tolerates the identical schedule, and a kill -9
+    restart mid-campaign replays clean.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.chaos.campaign import FaultSpec, env_string, replay, shrink
+from kubernetes_tpu.chaos.invariants import InvariantChecker
+from kubernetes_tpu.client.reflector import Reflector
+from kubernetes_tpu.ops.encoding import Caps
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.sched.storehealth import (CONNECTED, DEGRADED,
+                                              DISCONNECTED, StorePathBreaker)
+from kubernetes_tpu.state.journal import BindJournal
+from kubernetes_tpu.utils import faultpoints
+from kubernetes_tpu.utils.metrics import Metrics
+
+from helpers import make_node, make_pod
+
+pytestmark = pytest.mark.outage
+
+
+@pytest.fixture(autouse=True)
+def _clean_faultpoints():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+def _wait(cond, timeout=10.0, interval=0.01, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- the breaker's state machine, clock-driven -------------------------------
+
+class TestStorePathBreaker:
+    def _mk(self, clock, **kw):
+        kw.setdefault("threshold", 3)
+        kw.setdefault("cooldown", 10.0)
+        kw.setdefault("jitter", lambda: 0.5)  # retry_at = trip + cooldown
+        return StorePathBreaker(clock=lambda: clock[0], **kw)
+
+    def test_threshold_consecutive_failures_disconnect(self):
+        clock = [0.0]
+        b = self._mk(clock)
+        assert b.state == CONNECTED
+        b.record_failure()
+        assert b.state == DEGRADED and b.failures == 1
+        b.record_failure()
+        assert b.state == DEGRADED
+        b.record_failure()
+        assert b.state == DISCONNECTED and b.trips == 1
+        assert b.retry_at == 10.0  # jitter pinned: exactly one cooldown
+
+    def test_success_resets_the_consecutive_count(self):
+        clock = [0.0]
+        b = self._mk(clock)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        assert b.state == CONNECTED and b.failures == 0
+        # the count restarts: two more failures are NOT a trip
+        b.record_failure()
+        b.record_failure()
+        assert b.state == DEGRADED and b.trips == 0
+
+    def test_allow_admits_exactly_one_probe_per_deadline(self):
+        clock = [0.0]
+        b = self._mk(clock)
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == DISCONNECTED
+        clock[0] = 5.0
+        assert not b.allow()  # deadline not elapsed: binds spool
+        clock[0] = 10.0
+        assert b.allow()  # THIS attempt is the probe
+        assert b.state == DEGRADED
+        # the probe fails: fresh jittered deadline, not a tight loop
+        b.record_failure()
+        assert b.state == DISCONNECTED and b.trips == 2
+        assert b.retry_at == 20.0
+
+    def test_probe_success_reconnects_and_fires_callback(self):
+        clock = [0.0]
+        events = []
+        b = self._mk(clock, on_reconnect=lambda: events.append("up"),
+                     on_trip=lambda: events.append("trip"),
+                     on_state=lambda s: events.append(s))
+        for _ in range(3):
+            b.record_failure()
+        clock[0] = 10.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == CONNECTED
+        assert events.count("trip") == 1
+        assert events.count("up") == 1  # the spool-drain hook
+        assert events[-2:] == ["connected", "up"]  # state set before drain
+
+    def test_snapshot_reports_probe_deadline(self):
+        clock = [0.0]
+        b = self._mk(clock)
+        assert b.snapshot() == {"state": "connected", "failures": 0,
+                                "trips": 0, "probe_in_s": 0.0}
+        for _ in range(3):
+            b.record_failure()
+        clock[0] = 4.0
+        assert b.snapshot()["probe_in_s"] == 6.0
+
+
+# -- disconnected-mode scheduling, end to end --------------------------------
+
+def _world(journal_path=None, n_nodes=2, **kw):
+    """Scheduler over an ObjectStore on a virtual clock, outage knobs
+    pinned deterministic (cooldown 2s, jitter 0.5 => retry exactly
+    trip+2s)."""
+    store = ObjectStore()
+    vclock = [1000.0]
+    for i in range(n_nodes):
+        store.create("nodes", make_node(f"n{i}", cpu="16", memory="32Gi"))
+    sched = Scheduler(store, wave_size=8, caps=Caps(M=64, P=16, LV=64),
+                      clock=lambda: vclock[0],
+                      store_breaker_cooldown=2.0,
+                      bind_journal_path=journal_path, **kw)
+    sched.storehealth.jitter = lambda: 0.5
+    return store, sched, vclock
+
+
+def _bound(store):
+    return {p.metadata.name: p.spec.node_name
+            for p in store.list("pods") if p.spec.node_name}
+
+
+class TestDisconnectedMode:
+    def test_outage_spools_then_heal_drains_exactly_once(self, tmp_path):
+        jp = str(tmp_path / "bind.journal")
+        store, sched, vclock = _world(journal_path=jp)
+        try:
+            faultpoints.activate("store.outage", "raise", times=10 ** 6)
+            for i in range(4):
+                store.create("pods", make_pod(f"p{i}", cpu="100m",
+                                              memory="64Mi"))
+            sched.run_once()
+            # the store is dark: nothing bound, everything spooled —
+            # scheduling (scoring + assuming) continued against the cache
+            assert sched.storehealth.state == DISCONNECTED
+            assert sched.storehealth.trips >= 1
+            assert sched.spool_count() == 4
+            assert _bound(store) == {}
+            assert int(sched.metrics.binds_spooled.value) == 4
+            assert len(sched.journal.unresolved()) == 4
+            assumed = {p.uid for p in sched.cache.assumed_pods()}
+            assert sched.spool_uids() <= assumed  # capacity stays held
+            # new arrivals DURING the outage still schedule (onto cache)
+            # and spool without ever attempting a POST
+            hits_before = faultpoints.hits("store.outage")
+            store.create("pods", make_pod("late", cpu="100m",
+                                          memory="64Mi"))
+            sched.run_once()
+            assert sched.spool_count() == 5
+            # the late bind never touched the store path: a housekeep
+            # probe costs one hit per run_once at most
+            assert faultpoints.hits("store.outage") <= hits_before + 2
+
+            # heal; the next housekeep's probe drains the whole spool
+            faultpoints.deactivate("store.outage")
+            vclock[0] += 5.0  # past retry_at
+            sched.run_once()
+            assert sched.spool_count() == 0
+            assert sched.storehealth.state == CONNECTED
+            bound = _bound(store)
+            assert sorted(bound) == ["late", "p0", "p1", "p2", "p3"]
+            assert sched.journal.unresolved() == []
+            assert sched.cache.assumed_pods() == []  # all confirmed
+        finally:
+            sched.close()
+
+    def test_post_heal_placements_match_outage_free_run(self, tmp_path):
+        """The acceptance bar: an outage must not CHANGE any placement
+        decision — only delay its durability. Same arrivals, same wave
+        boundaries, with and without a mid-run outage: identical
+        pod -> node maps."""
+        def run(outage):
+            store, sched, vclock = _world(
+                journal_path=str(tmp_path / f"j-{outage}"))
+            try:
+                for i in range(6):
+                    store.create("pods", make_pod(
+                        f"p{i}", cpu=f"{(i % 3 + 1) * 100}m",
+                        memory="64Mi"))
+                sched.run_once()
+                if outage:
+                    faultpoints.activate("store.outage", "raise",
+                                         times=10 ** 6)
+                for i in range(6, 12):
+                    store.create("pods", make_pod(
+                        f"p{i}", cpu=f"{(i % 3 + 1) * 100}m",
+                        memory="64Mi"))
+                sched.run_once()
+                if outage:
+                    assert sched.spool_count() > 0
+                    faultpoints.deactivate("store.outage")
+                vclock[0] += 5.0
+                for _ in range(4):
+                    sched.run_once()
+                assert sched.spool_count() == 0
+                return _bound(store)
+            finally:
+                sched.close()
+                faultpoints.reset()
+
+        clean = run(outage=False)
+        survived = run(outage=True)
+        assert len(clean) == 12
+        assert survived == clean
+
+    def test_spool_watermark_holds_sheddable_admissions(self, tmp_path):
+        store, sched, vclock = _world(
+            journal_path=str(tmp_path / "j"),
+            spool_watermark=2, shed_watermark=50, shed_age_s=1.0)
+        try:
+            faultpoints.activate("store.outage", "raise", times=10 ** 6)
+            for i in range(3):
+                store.create("pods", make_pod(f"p{i}", cpu="100m",
+                                              memory="64Mi"))
+            sched.run_once()
+            assert sched.spool_count() == 3  # watermark crossed
+            assert sched.storehealth.state == DISCONNECTED
+            # a new sheddable arrival is PARKED, not scheduled: the
+            # spool must not grow without bound during the outage
+            store.create("pods", make_pod("held", cpu="100m",
+                                          memory="64Mi"))
+            sched.run_once()
+            assert sched.queue.shed_count() == 1
+            assert sched.spool_count() == 3
+            # a system/high-priority arrival is NEVER held — critical
+            # work schedules (onto the cache + spool) even now
+            store.create("pods", make_pod("critical", cpu="100m",
+                                          memory="64Mi", priority=2000))
+            sched.run_once()
+            assert sched.spool_count() == 4
+            assert sched.queue.shed_count() == 1
+
+            # heal: spool drains, the hold lifts, the parked pod places
+            faultpoints.deactivate("store.outage")
+            vclock[0] += 5.0
+            for _ in range(3):
+                sched.run_once()
+            assert sched.spool_count() == 0
+            assert sched.queue.shed_count() == 0
+            assert "held" in _bound(store)
+        finally:
+            sched.close()
+
+
+# -- crash-restart recovery --------------------------------------------------
+
+class TestCrashRestartRecovery:
+    def test_kill_mid_outage_then_fresh_process_recovers(self, tmp_path):
+        jp = str(tmp_path / "bind.journal")
+        store, sched1, vclock = _world(journal_path=jp)
+        faultpoints.activate("store.outage", "raise", times=10 ** 6)
+        for i in range(3):
+            store.create("pods", make_pod(f"p{i}", cpu="100m",
+                                          memory="64Mi"))
+        sched1.run_once()
+        assert sched1.spool_count() == 3
+        sched1.close()  # kill -9 analog: no drain, journal left behind
+
+        # fresh process over the same store + journal, store STILL dark:
+        # construction replays the journal and re-spools every intent
+        # from the local mirror before the first wave
+        sched2 = Scheduler(store, wave_size=8,
+                           caps=Caps(M=64, P=16, LV=64),
+                           clock=lambda: vclock[0],
+                           store_breaker_cooldown=2.0,
+                           bind_journal_path=jp)
+        sched2.storehealth.jitter = lambda: 0.5
+        checker = InvariantChecker(metrics=sched2.metrics, strict=True)
+        sched2.invariants = checker
+        try:
+            assert sched2.spool_count() == 3
+            assert len(sched2.journal.unresolved()) == 3
+            # heal: drain through the bind-ambiguity path — every pod
+            # placed exactly once, under the STRICT checker
+            faultpoints.deactivate("store.outage")
+            vclock[0] += 5.0
+            sched2.run_once()
+            assert sched2.spool_count() == 0
+            bound = _bound(store)
+            assert sorted(bound) == ["p0", "p1", "p2"]
+            assert sched2.journal.unresolved() == []
+            assert sched2.cache.assumed_pods() == []
+            # the drained round had no wave (everything rode the
+            # spool), so sweep explicitly: strict => raises on any leak
+            with sched2._mu:
+                checker.check(sched2)
+                checker.check(sched2)  # hysteresis pass too
+            assert checker.checks >= 2
+        finally:
+            sched2.close()
+
+    def test_landed_bind_is_adopted_not_rebound(self, tmp_path):
+        """Crash AFTER the bind POST landed but BEFORE the resolve
+        record: replay must adopt API truth, not double-bind."""
+        jp = str(tmp_path / "bind.journal")
+        store = ObjectStore()
+        store.create("nodes", make_node("n0", cpu="16", memory="32Gi"))
+        pod = make_pod("landed", cpu="100m", memory="64Mi")
+        store.create("pods", pod)
+        j = BindJournal(jp)
+        j.append_intent(pod, "n0")
+        store.bind(pod, "n0")  # the POST that landed pre-crash
+
+        sched = Scheduler(store, wave_size=8,
+                          caps=Caps(M=64, P=16, LV=64),
+                          bind_journal_path=jp)
+        try:
+            assert sched.spool_count() == 0  # adopted, not re-spooled
+            assert sched.journal.unresolved() == []  # resolved confirmed
+            assert sched.cache.assumed_pods() == []
+            assert _bound(store) == {"landed": "n0"}
+            assert sched.queue.pending_count() == 0  # not re-queued
+        finally:
+            sched.close()
+
+    def test_deleted_pod_resolves_gone(self, tmp_path):
+        jp = str(tmp_path / "bind.journal")
+        store = ObjectStore()
+        store.create("nodes", make_node("n0", cpu="16", memory="32Gi"))
+        pod = make_pod("gone", cpu="100m", memory="64Mi")
+        j = BindJournal(jp)
+        j.append_intent(pod, "n0")  # intent journaled; pod never created
+
+        sched = Scheduler(store, wave_size=8,
+                          caps=Caps(M=64, P=16, LV=64),
+                          bind_journal_path=jp)
+        try:
+            assert sched.spool_count() == 0
+            assert sched.journal.unresolved() == []
+            assert sched.cache.assumed_pods() == []
+        finally:
+            sched.close()
+
+
+# -- the reflector under a full outage (clock-driven) ------------------------
+
+class _FakeWatchClient:
+    """Empty lists, instantly-closing watch streams."""
+
+    def __init__(self):
+        self.lists = 0
+
+    def list(self, plural):
+        self.lists += 1
+        return [], 0
+
+    def watch(self, plural, resource_version=None, timeout_seconds=10.0,
+              stop=None, label_selector=None):
+        time.sleep(0.002)
+        return iter(())
+
+
+class TestReflectorFullOutage:
+    def test_outage_caps_ladder_feeds_breaker_heal_resets(self):
+        metrics = Metrics()
+        health = StorePathBreaker(threshold=3, cooldown=60.0,
+                                  jitter=lambda: 0.5)
+        rclock = [0.0]
+        refl = Reflector(_FakeWatchClient(), "pods", lambda ev: None,
+                         relist_backoff=0.01, max_relist_backoff=0.04,
+                         stale_after=5.0, metrics=metrics, health=health,
+                         clock=lambda: rclock[0], jitter=lambda: 0.5)
+        faultpoints.activate("store.outage", "raise", times=10 ** 6)
+        refl.start()
+        try:
+            # every relist fails: the jittered ladder climbs to its cap
+            # and each failure ticks the breaker's LIST path — three
+            # consecutive ones declare the store DISCONNECTED
+            _wait(lambda: refl.backoff == 0.04, msg="ladder at cap")
+            _wait(lambda: health.state == DISCONNECTED,
+                  msg="LIST failures tripped the store breaker")
+            assert metrics.store_errors.value(op="list") >= 3
+            assert not refl.synced.is_set()
+
+            # heal. The first clean cycle lists, records a breaker
+            # success (reconnect), and syncs; the stream then stays
+            # quiet, so advancing the reflector's CLOCK past the
+            # staleness deadline forces watchdog relists — and each
+            # cycle end resets the ladder to its initial rung
+            faultpoints.deactivate("store.outage")
+            _wait(lambda: refl.synced.is_set(), msg="post-heal sync")
+            assert health.state == CONNECTED
+            stale0 = refl.stale_relists
+            rclock[0] += 6.0  # > stale_after: declare the stream stale
+            _wait(lambda: refl.stale_relists > stale0,
+                  msg="clock-driven staleness watchdog")
+            _wait(lambda: refl.backoff == 0.01,
+                  msg="clean cycle reset the ladder")
+            assert metrics.watch_stale.value >= 1
+        finally:
+            refl.stop()
+
+
+# -- campaign acceptance: the deliberately-broken build ----------------------
+
+def _disable_outage_recovery(sched):
+    sched._journal_replay_enabled = False
+
+
+class TestBrokenBuildOutageAcceptance:
+    """ISSUE 18 acceptance: disable journal replay + spool drain (the
+    scheduler's test hook) and the campaign machinery must catch the
+    spooled-intents-outlived-the-outage conservation leak, shrink the
+    schedule to a minimal reproducer, and re-trigger it from the env
+    string alone — while the healthy build tolerates the identical
+    schedule."""
+
+    # times=4: three bind-POST failures trip the breaker (threshold 3)
+    # and the fourth firing darkens the truth GET, so the intent spools;
+    # the fault then exhausts, a later bind's probe reconnects, and the
+    # stuck spool survives two consecutive CONNECTED checks — the leak
+    # signature. times<=3 resolves through ORPHANED+truth and conserves.
+    SCHEDULE = [FaultSpec("store.outage", "raise", times=6, tick=0)]
+    SEED = 7
+
+    def test_catch_shrink_and_env_retrigger(self):
+        broken = replay(self.SCHEDULE, self.SEED,
+                        configure=_disable_outage_recovery)
+        assert broken.violated
+        assert broken.violation == "conservation"
+        assert "outlived the outage" in broken.detail
+        assert broken.digest
+
+        minimal, mo = shrink(self.SCHEDULE, self.SEED,
+                             configure=_disable_outage_recovery)
+        assert mo.violated
+        assert len(minimal) == 1
+        assert minimal[0].point == "store.outage"
+        assert minimal[0].times == 4  # the minimal spool-then-reconnect
+        assert minimal[0].tick == 0
+
+        env = env_string(minimal)
+        assert env == "store.outage=raise::4"
+        again = replay((), self.SEED, env_spec=env,
+                       configure=_disable_outage_recovery)
+        assert again.violated
+        assert again.injected.get("store.outage", 0) >= 4
+
+    def test_healthy_build_tolerates_the_same_schedule(self):
+        out = replay(self.SCHEDULE, self.SEED)
+        assert not out.violated
+        assert out.injected.get("store.outage", 0) >= 1
+        assert out.checks > 0
+
+    def test_restart_mid_outage_replays_clean(self, tmp_path):
+        """kill -9 at tick 4 with the outage armed and a journal wired:
+        the fresh scheduler's construction replay recovers the spool
+        and the same strict checker stays quiet across the restart."""
+        out = replay([FaultSpec("store.outage", "raise", times=6,
+                                tick=0)],
+                     self.SEED, journal_path=str(tmp_path / "j"),
+                     restart_tick=4)
+        assert not out.violated
+        assert out.placed > 0
+        assert os.path.exists(str(tmp_path / "j"))
+
+
+# -- /debug/store ------------------------------------------------------------
+
+class TestDebugStoreEndpoint:
+    def test_serves_breaker_spool_journal_and_errors(self, tmp_path):
+        from kubernetes_tpu.cli.kube_scheduler import HealthServer
+
+        store, sched, vclock = _world(
+            journal_path=str(tmp_path / "bind.journal"))
+        hs = HealthServer(lambda: sched)
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hs.port}{path}") as r:
+                    return r.read().decode()
+
+            dbg = json.loads(get("/debug/store"))
+            assert dbg["state"] == "connected"
+            assert dbg["spool"] == {"depth": 0, "watermark": 0,
+                                    "oldest_seq": None,
+                                    "drain_due": False}
+            assert dbg["journal"]["unresolved"] == 0
+            assert dbg["errors"]["bind"] == 0
+
+            # sever the store, spool one bind: the endpoint is the
+            # outage observatory — disconnected state, spool depth,
+            # per-op error counts all visible
+            faultpoints.activate("store.outage", "raise", times=10 ** 6)
+            store.create("pods", make_pod("p0", cpu="100m",
+                                          memory="64Mi"))
+            sched.run_once()
+            dbg = json.loads(get("/debug/store"))
+            assert dbg["state"] == "disconnected"
+            assert dbg["trips"] >= 1
+            assert dbg["spool"]["depth"] == 1
+            assert dbg["spool"]["oldest_seq"] == 0
+            assert dbg["journal"]["appends"] >= 1
+            assert dbg["errors"]["bind"] >= 3  # the tripping POSTs
+        finally:
+            hs.stop()
+            sched.close()
+
+    def test_404_when_scheduler_not_running(self):
+        from kubernetes_tpu.cli.kube_scheduler import HealthServer
+
+        hs = HealthServer(lambda: None)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{hs.port}/debug/store")
+            assert ei.value.code == 404
+        finally:
+            hs.stop()
