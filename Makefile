@@ -55,25 +55,30 @@ verify: lint test
 # + the `campaign` chaos-campaign suite (kubernetes_tpu/chaos/:
 # cluster-invariant checker mutation tests, fault-point registry drift
 # guard, KTPU_FAULTPOINTS parse hardening, a fixed-seed ~8-schedule
-# campaign smoke, and the broken-build catch-and-shrink acceptance).
+# campaign smoke, and the broken-build catch-and-shrink acceptance)
+# + the `topology` topology & heterogeneity suite (PodTopologySpread
+# kernels incl. breaker-open degraded enforcement, dense
+# rack/superpod/accel-gen columns, gang compactness scoring).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot or campaign or outage" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot or campaign or outage or topology" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 
 # Observability tier: the flight-recorder / metrics-exposition suite,
 # the numpy-twin parity suite, the decision-observatory /
 # cluster-telemetry suite (score decomposition, /debug/score, telemetry
-# plane parity), and the shadow-scoring observatory suite (live
-# WeightProfile hot swap, counterfactual divergence, /debug/shadow).
+# plane parity), the shadow-scoring observatory suite (live
+# WeightProfile hot swap, counterfactual divergence, /debug/shadow),
+# and the topology suite (its score planes extend the round ledger's
+# keyed-by-plane-name breakdown records — see _record_decisions).
 obs: native
 	$(PYTHON) -m pytest tests/ -q \
-		-m "observability or hostpath or telemetry or shadow" \
+		-m "observability or hostpath or telemetry or shadow or topology" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
 

@@ -56,6 +56,11 @@ measured against the reference's 100 pods/s "healthy" warning level
                 round; any violation FAILS the bench and prints its
                 shrunk KTPU_FAULTPOINTS reproducer (--seed/--schedules
                 override the grid defaults)
+  hetero        heterogeneous topology: rack/superpod/accel-gen labeled
+                cluster scheduling zone-spread DoNotSchedule pods and
+                priority gangs; hard gates on exact spread-skew
+                enforcement and on the TopologyCompactness plane beating
+                a compactness-zeroed scattered baseline by a rack margin
 
 --suite runs the BASELINE config grid and prints one JSON line each;
 a bare `python bench.py` (the driver's command) runs DRIVER_SUITE.
@@ -1471,6 +1476,165 @@ def run_outagestorm_config(nodes, pods, wave):
     return placed, dt, spool_peak, heal_rounds
 
 
+# -- heterogeneous topology workload (--workload hetero) ----------------------
+#
+# A rack/superpod/accel-gen labeled cluster (state/snapshot.py's dense
+# topology columns, ops/topology.py's kernels) under two hard gates:
+#   1. spread skew gate: zone-spread pods under a maxSkew=1
+#      DoNotSchedule constraint must land with per-zone counts
+#      differing by <= 1 — checked from the STORE's bindings after the
+#      drain, not from the kernel's own claim
+#   2. compactness margin gate: priority gangs placed under the default
+#      profile (TopologyCompactness on) must use fewer distinct racks
+#      per gang than the identical workload with the plane zeroed (the
+#      scattered baseline), by >= HETERO_MARGIN racks on average
+
+HETERO_MARGIN = 0.25
+HETERO_GANG = 6
+
+
+def _hetero_store(nodes, racks=8, gens=3):
+    """Cluster with the full topology label set: 3 zones, `racks` racks
+    nested pairwise under superpods, accel generations cycling by rack
+    (whole racks share a generation, like real pod-slice deployments)."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.runtime.store import ObjectStore
+
+    store = ObjectStore()
+    for i in range(nodes):
+        rack = i % racks
+        labels = {
+            api.LABEL_HOSTNAME: f"node-{i}",
+            api.LABEL_ZONE: f"zone-{i % 3}",
+            api.LABEL_RACK: f"rack-{rack}",
+            api.LABEL_SUPERPOD: f"sp-{rack // 2}",
+            api.LABEL_ACCEL_GEN: str(1 + rack % gens),
+        }
+        store.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name=f"node-{i}", labels=labels),
+            status=api.NodeStatus(
+                allocatable=api.resource_list(cpu="16", memory="32Gi",
+                                              pods=110,
+                                              ephemeral_storage="200Gi"),
+                conditions=[api.NodeCondition(api.NODE_READY,
+                                              api.COND_TRUE)])))
+    return store
+
+
+def _gang_rack_mean(store, api):
+    """Mean distinct racks per placed gang — the compactness observable."""
+    node_rack = {n.metadata.name: (n.metadata.labels or {}).get(
+        api.LABEL_RACK, "") for n in store.list("nodes")}
+    gangs = {}
+    for p in store.list("pods"):
+        g = (p.metadata.annotations or {}).get(
+            "pod-group.scheduling.k8s.io/name")
+        if g and p.spec.node_name:
+            gangs.setdefault(g, set()).add(node_rack[p.spec.node_name])
+    if not gangs:
+        return 0.0
+    return sum(len(r) for r in gangs.values()) / len(gangs)
+
+
+def run_hetero_config(nodes, pods, wave, mesh=None, margin=HETERO_MARGIN):
+    """Phase 1: pods//2 zone-spread DoNotSchedule pods (skew gate).
+    Phase 2: the gang workload placed twice against fresh stores —
+    default profile vs TopologyCompactness zeroed — for the margin
+    gate. Returns (placed, dt, compact_racks, scattered_racks, skew)."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.api.labels import LabelSelector
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.plugins.registry import default_profile
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+
+    n_spread = pods // 2
+    n_gang = pods - n_spread
+
+    def sched_for(store, compact=True):
+        prof = default_profile(store)
+        if not compact:
+            prof.score_weights = dict(prof.score_weights)
+            # weight 0 compiles the plane out entirely (the kernel's
+            # static weight gate) — the baseline is scattered by
+            # construction, not merely down-weighted
+            prof.score_weights["TopologyCompactnessPriority"] = 0
+        # P=16 keeps each gang in one joint program, like run_config's
+        # gang leg; spread pods drain through 16-wide waves
+        caps = Caps(M=bucket_size(pods + 64), P=16, E=8,
+                    LV=bucket_size(nodes + 256, 64))
+        return Scheduler(store, profile=prof, wave_size=wave, caps=caps,
+                         mesh=mesh)
+
+    t0 = time.time()
+    store_s = _hetero_store(nodes)
+    sched_s = sched_for(store_s)
+    for i in range(n_spread):
+        pod = _base_pod(api, f"hetero-spread-{i}", "hetero-spread")
+        pod.spec.topology_spread_constraints = [api.TopologySpreadConstraint(
+            max_skew=1, topology_key=api.LABEL_ZONE,
+            when_unsatisfiable=api.DO_NOT_SCHEDULE,
+            label_selector=LabelSelector(
+                match_labels={"type": "hetero-spread"}))]
+        store_s.create("pods", pod)
+    placed_s = sched_s.schedule_pending()
+    node_zone = {n.metadata.name: (n.metadata.labels or {}).get(
+        api.LABEL_ZONE, "") for n in store_s.list("nodes")}
+    counts = {z: 0 for z in set(node_zone.values())}
+    for p in store_s.list("pods"):
+        if p.spec.node_name and (p.metadata.labels or {}).get(
+                "type") == "hetero-spread":
+            counts[node_zone[p.spec.node_name]] += 1
+    skew = max(counts.values()) - min(counts.values())
+
+    def make_gangs(store):
+        made, g = 0, 0
+        while made < n_gang:
+            size = min(HETERO_GANG, n_gang - made)
+            for j in range(size):
+                p = _base_pod(api, f"hetero-gang-{made + j}", "hetero-gang")
+                p.spec.priority = 5  # accel-gen steering needs prio > 0
+                p.metadata.annotations = {
+                    "pod-group.scheduling.k8s.io/name": f"hgang-{g}",
+                    "pod-group.scheduling.k8s.io/min-available": str(size)}
+                store.create("pods", p)
+            made += size
+            g += 1
+
+    store_c = _hetero_store(nodes)
+    sched_c = sched_for(store_c, compact=True)
+    make_gangs(store_c)
+    placed_c = sched_c.schedule_pending()
+    store_x = _hetero_store(nodes)
+    sched_x = sched_for(store_x, compact=False)
+    make_gangs(store_x)
+    placed_x = sched_x.schedule_pending()
+    dt = time.time() - t0
+
+    compact_racks = _gang_rack_mean(store_c, api)
+    scattered_racks = _gang_rack_mean(store_x, api)
+
+    failures = []
+    if placed_s != n_spread:
+        failures.append(f"spread phase placed {placed_s}/{n_spread}")
+    if skew > 1:
+        failures.append(f"DoNotSchedule zone skew {skew} > maxSkew 1 "
+                        f"(zone counts {counts})")
+    if placed_c != n_gang or placed_x != n_gang:
+        failures.append(f"gang phase placed compact={placed_c} "
+                        f"scattered={placed_x} of {n_gang}")
+    if scattered_racks - compact_racks < margin:
+        failures.append(
+            f"compactness margin {scattered_racks - compact_racks:.2f} < "
+            f"{margin} (compact {compact_racks:.2f} vs scattered "
+            f"{scattered_racks:.2f} racks/gang)")
+    for f in failures:
+        print(f"FATAL: hetero: {f}", file=sys.stderr)
+    if failures:
+        sys.exit(1)
+    return placed_s + placed_c, dt, compact_racks, scattered_racks, skew
+
+
 def stage_breakdown(top=12):
     """Per-stage wall-time totals from the step profiler (fed by every
     Trace the scheduler emits) — the bench json carries WHERE the run's
@@ -1602,6 +1766,11 @@ SUITE = [
     # and the spool must drain within 8 post-heal rounds with zero
     # double-binds, zero lost pods, and zero invariant violations
     ("outagestorm", 100, 400, "outagestorm", ["--wave", "64"]),
+    # heterogeneous topology: rack/superpod/accel-gen labeled cluster;
+    # hard gates on DoNotSchedule zone skew (<= maxSkew, read back from
+    # the store) and on gang rack-compactness beating the
+    # compactness-zeroed scattered baseline by >= HETERO_MARGIN
+    ("hetero", 24, 240, "hetero", ["--wave", "16"]),
     ("mixed5k", 5000, 30000, "mixed", []),
     # fleet scale: 50k nodes / 200k pod churn under the mesh-sharded
     # scheduling plane (--mesh auto shards the node axis across every
@@ -1726,7 +1895,7 @@ def main():
                              "antiaffinity", "mixed", "gang", "preempt",
                              "trickle", "paced", "autoscale", "partition",
                              "degraded", "storm", "chaoscampaign",
-                             "outagestorm"])
+                             "outagestorm", "hetero"])
     ap.add_argument("--trace", default=None,
                     choices=["burst", "diurnal", "gangstorm", "compound"],
                     help="storm workload: which synthetic arrival trace "
@@ -1895,6 +2064,31 @@ def main():
             "wall_s": round(dt, 2),
         }
         print(json.dumps(rec), flush=True)
+        return
+    if args.workload == "hetero":
+        placed, dt, compact_racks, scattered_racks, skew = run_hetero_config(
+            args.nodes, args.pods, args.wave, mesh=_resolve_mesh(args.mesh))
+        name = args.name or "hetero"
+        rec = {
+            # the headline is the rack-compactness margin over the
+            # scattered baseline — the hard gates (skew <= maxSkew,
+            # margin >= HETERO_MARGIN, full placement in every phase)
+            # already sys.exit(1)'d inside run_hetero_config
+            "metric": f"scheduler_{name}_rack_margin_"
+                      f"{args.nodes}n_{args.pods}p",
+            "value": round(scattered_racks - compact_racks, 2),
+            "unit": "racks/gang",
+            "vs_baseline": (round(scattered_racks / compact_racks, 2)
+                            if compact_racks else 0.0),
+            "compact_racks": round(compact_racks, 2),
+            "scattered_racks": round(scattered_racks, 2),
+            "spread_skew": skew,
+            "wave": args.wave,
+        }
+        print(json.dumps(rec), flush=True)
+        print(f"# {name}: placed={placed} wall={dt:.2f}s "
+              f"compact={compact_racks:.2f} scattered={scattered_racks:.2f} "
+              f"racks/gang skew={skew}", file=sys.stderr)
         return
     if args.workload == "storm":
         trace = args.trace or "burst"

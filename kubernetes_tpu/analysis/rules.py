@@ -452,7 +452,8 @@ class TwinCoverageRule:
                       "kubernetes_tpu/ops/gang.py",
                       "kubernetes_tpu/ops/preempt.py",
                       "kubernetes_tpu/ops/scores.py",
-                      "kubernetes_tpu/ops/telemetry.py")
+                      "kubernetes_tpu/ops/telemetry.py",
+                      "kubernetes_tpu/ops/topology.py")
     HOSTWAVE = "kubernetes_tpu/ops/hostwave.py"
 
     def run(self, corpus: Corpus) -> List[Finding]:

@@ -259,6 +259,24 @@ class Affinity:
     pod_anti_affinity: Optional[PodAntiAffinity] = None
 
 
+# whenUnsatisfiable values (api/core/v1 UnsatisfiableConstraintAction).
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """Forward-ported from modern core/v1 (no 1.11 analog): bound the
+    skew of matching pods across the domains of topology_key.
+    DoNotSchedule constraints are hard filters; ScheduleAnyway only
+    steers the TopologySpread score plane (ops/topology.py)."""
+
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    label_selector: Optional[LabelSelector] = None
+
+
 # --- pod --------------------------------------------------------------------
 
 
@@ -288,6 +306,8 @@ class PodSpec:
     node_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[Affinity] = None
+    topology_spread_constraints: List[TopologySpreadConstraint] = \
+        field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
@@ -347,6 +367,15 @@ class Pod:
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
 LABEL_REGION = "failure-domain.beta.kubernetes.io/region"
+
+# Interconnect-topology + heterogeneity labels (no 1.11 analog; the
+# forward-ported topology subsystem, ops/topology.py). Racks nest inside
+# superpods — get_rack_key/get_superpod_key encode the hierarchy so a
+# rack id's string key is prefixed by its superpod's, and link distance
+# is derivable from interned-id prefixes.
+LABEL_RACK = "topology.kubernetes.io/rack"
+LABEL_SUPERPOD = "topology.kubernetes.io/superpod"
+LABEL_ACCEL_GEN = "accelerator.kubernetes.io/generation"
 
 # Node condition types (reference: api/core/v1/types.go NodeConditionType).
 NODE_READY = "Ready"
@@ -424,6 +453,34 @@ def get_zone_key(node: Node) -> str:
     if not region and not zone:
         return ""
     return region + ":\x00:" + zone
+
+
+def get_superpod_key(node: Node) -> str:
+    """Hierarchical superpod key ("sp:<v>"); empty when unlabeled."""
+    v = (node.metadata.labels or {}).get(LABEL_SUPERPOD, "")
+    return f"sp:{v}" if v else ""
+
+
+def get_rack_key(node: Node) -> str:
+    """Hierarchical rack key ("sp:<v>/rk:<r>"): prefixed by the node's
+    superpod key so two racks in the same superpod share a string (and
+    therefore an interned-id) prefix; empty when no rack label."""
+    labels = node.metadata.labels or {}
+    rack = labels.get(LABEL_RACK, "")
+    if not rack:
+        return ""
+    return f"{get_superpod_key(node) or 'sp:'}/rk:{rack}"
+
+
+def get_accel_gen(node: Node) -> int:
+    """Accelerator generation rank from LABEL_ACCEL_GEN (0 = unlabeled
+    or unparseable; negative ranks clamp to 0 so the dense i32 column's
+    zero stays the "no information" value)."""
+    raw = (node.metadata.labels or {}).get(LABEL_ACCEL_GEN, "")
+    try:
+        return max(0, int(raw))
+    except (TypeError, ValueError):
+        return 0
 
 
 # --- persistent volumes ------------------------------------------------------

@@ -292,7 +292,10 @@ def replay(specs: Sequence[FaultSpec], seed: int, ticks: int = 8,
     sched.invariants = checker
     if configure is not None:
         configure(sched)
-    cluster = HollowCluster(store, 2, clock=lambda: vclock[0])
+    # racks/generations stamped so fault injection also exercises the
+    # dense topology columns (rack_id/superpod_id/accel_gen scatter).
+    cluster = HollowCluster(store, 2, racks=2, generations=2,
+                            clock=lambda: vclock[0])
     out = ReplayOutcome()
     try:
         for node in cluster.nodes:

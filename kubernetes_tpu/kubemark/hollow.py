@@ -62,9 +62,16 @@ class HollowCluster:
 
     def __init__(self, store, n_nodes: int,
                  zones: int = 3,
+                 racks: int = 0,
+                 generations: int = 0,
                  allocatable: Optional[Dict[str, int]] = None,
                  with_proxy: bool = False,
                  heartbeat_period: float = 10.0, clock=None):
+        """racks>0 stamps each node with rack/superpod topology labels
+        (rack-{i%racks} nested under a superpod per racks-pair);
+        generations>0 stamps accelerator-generation labels cycling
+        gen 1..generations — both feed the dense topology columns
+        (state/snapshot.py rack_id/superpod_id/accel_gen)."""
         self.store = store
         alloc = allocatable or api.resource_list(cpu="16", memory="32Gi",
                                                  pods=110,
@@ -75,6 +82,11 @@ class HollowCluster:
                 api.LABEL_HOSTNAME: f"hollow-{i}",
                 api.LABEL_ZONE: f"zone-{i % zones}",
             }
+            if racks > 0:
+                labels[api.LABEL_RACK] = f"rack-{i % racks}"
+                labels[api.LABEL_SUPERPOD] = f"sp-{(i % racks) // 2}"
+            if generations > 0:
+                labels[api.LABEL_ACCEL_GEN] = str(1 + i % generations)
             self.nodes.append(HollowNode(
                 store, f"hollow-{i}", allocatable=dict(alloc), labels=labels,
                 with_proxy=with_proxy and i == 0,

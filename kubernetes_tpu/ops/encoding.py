@@ -142,6 +142,7 @@ class Caps:
     LV: int = 64  # label-value vocab bucket (segment count for domain anchoring)
     UI: int = 8  # unique required (anti)affinity programs per wave (dedup table)
     UP: int = 4  # unique preferred pod-affinity terms per wave (dedup table)
+    TS: int = 2  # topologySpreadConstraints per pod
 
 
 class NodeTensors(NamedTuple):
@@ -160,6 +161,14 @@ class NodeTensors(NamedTuple):
     cond: np.ndarray  # bool [N, N_COND]
     ports: np.ndarray  # i32 [N, PP]  interned proto/port ids (0 pad)
     zone_id: np.ndarray  # i32 [N]  (0 = no zone key)
+    # interconnect topology + heterogeneity columns (ops/topology.py):
+    # rack/superpod ids are interned into the shared zone vocabulary with
+    # hierarchical keys ("sp:<v>" / "sp:<v>/rk:<r>"), so link distance is
+    # derivable from id prefixes and every rack/superpod segment-sum
+    # reuses the num_zones segment count
+    rack_id: np.ndarray  # i32 [N]  (0 = no rack label)
+    superpod_id: np.ndarray  # i32 [N]  (0 = no superpod label)
+    accel_gen: np.ndarray  # i32 [N]  accelerator generation rank (0 = unlabeled)
     img_id: np.ndarray  # i32 [N, NI]
     img_size: np.ndarray  # f32 [N, NI]
     avoid: np.ndarray  # bool [N]  preferAvoidPods annotation present
@@ -268,6 +277,18 @@ class PodBatch(NamedTuple):
     img_id: np.ndarray  # i32 [P, PI]
     prio: np.ndarray  # i32 [P]  pod priority
     valid: np.ndarray  # bool [P]
+    # topologySpreadConstraints (forward-port; ops/topology.py). One row
+    # per constraint: the topology key (node-label key id), maxSkew, a
+    # hard/soft flag (DoNotSchedule vs ScheduleAnyway), and a selector
+    # program over the existing-pod label space (TermTable conventions:
+    # key 0 + OP_PAD rows are padding, so an empty selector matches all).
+    ts_valid: np.ndarray  # bool [P, TS]
+    ts_hard: np.ndarray  # bool [P, TS]  whenUnsatisfiable == DoNotSchedule
+    ts_skew: np.ndarray  # f32 [P, TS]  maxSkew
+    ts_tk: np.ndarray  # i32 [P, TS]  topology key (node-label key id; 0 invalid)
+    ts_key: np.ndarray  # i32 [P, TS, TE]  selector program over pod-label keys
+    ts_op: np.ndarray  # i32 [P, TS, TE]
+    ts_vals: np.ndarray  # i32 [P, TS, TE, TV]
     # Dedup tables for the O(P x M) hot paths in ops/affinity.py: pods
     # from the same controller share identical (anti)affinity programs,
     # so the wave's REQUIRED programs are interned into one [UI, ...]
@@ -304,6 +325,10 @@ DEVICE_PREDICATES = (
     "CheckNodeMemoryPressure",
     "CheckNodeDiskPressure",
     "CheckNodePIDPressure",
+    # forward-ported (no 1.11 analog): hard topologySpreadConstraints
+    # (whenUnsatisfiable=DoNotSchedule) evaluated wave-internally by
+    # ops/topology.py — counts include same-wave placements
+    "PodTopologySpread",
     "MatchInterPodAffinity",  # last, as in predicatesOrdering (predicates.go:139)
 )
 PRED_IDX = {name: i for i, name in enumerate(DEVICE_PREDICATES)}

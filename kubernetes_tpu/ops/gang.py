@@ -52,24 +52,30 @@ class GangResult(NamedTuple):
 def schedule_gang(*args, **kw):
     """Entry point for the joint-assignment kernel; the fault point
     fires outside the jit boundary (see ops/kernel.py schedule_round)."""
+    import numpy as np
+
     from ..utils import faultpoints
     from .kernel import dispatch_bucket, record_dispatch
 
     faultpoints.fire("kernel.gang")
     nt, pm, tt, pb = args[0], args[1], args[2], args[3]
+    # static like has_ipa: spread-free gangs keep the pre-topology
+    # program (the compactness plane itself is weight-gated, not static)
+    kw.setdefault("has_ts", bool(np.any(np.asarray(pb.ts_valid))))
     bucket = dispatch_bucket(nt, pm, tt, kw, lead=(pb.req.shape[0],))
     return record_dispatch("gang", bucket,
                            lambda: _schedule_gang(*args, **kw))
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
-    "pallas_interpret"))
+    "weights", "num_zones", "num_label_values", "has_ipa", "has_ts",
+    "use_pallas", "pallas_interpret"))
 def _schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
                   tt: enc.TermTable, pb: enc.PodBatch, extra_mask,
                   rr_start, extra_scores, need, *, weights: Weights,
                   num_zones: int, num_label_values: int = 64,
-                  has_ipa: bool = False, use_pallas: bool = False,
+                  has_ipa: bool = False, has_ts: bool = False,
+                  use_pallas: bool = False,
                   pallas_interpret: bool = False,
                   weight_vec=None) -> GangResult:
     """Joint placement of one gang's members under shared capacity.
@@ -89,7 +95,8 @@ def _schedule_gang(nt: enc.NodeTensors, pm: enc.PodMatrix,
     res, _usage = _wave_body(nt, pm, tt, pb, extra_mask, rr_start,
                              extra_scores, weights, num_zones,
                              num_label_values, has_ipa, use_pallas,
-                             pallas_interpret, weight_vec=weight_vec)
+                             pallas_interpret, weight_vec=weight_vec,
+                             has_ts=has_ts)
     placed = jnp.sum((res.chosen >= 0).astype(jnp.int32))
     ok = placed >= jnp.asarray(need, jnp.int32)
     chosen = jnp.where(ok, res.chosen, -1)

@@ -55,8 +55,9 @@ import numpy as np
 from . import encoding as enc
 from .kernel import Weights, WaveResult
 from .scores import (SCORE_STACK, SCORE_TOPK, W_AFFINITY, W_AVOID,
-                     W_BALANCED, W_IMAGE, W_INTERPOD, W_LEAST, W_MOST,
-                     W_SPREAD, W_TAINT, ScoreDeco, stack_weights)
+                     W_BALANCED, W_COMPACT, W_IMAGE, W_INTERPOD, W_LEAST,
+                     W_MOST, W_SPREAD, W_TAINT, W_TOPO_SPREAD, ScoreDeco,
+                     stack_weights)
 
 F = np.float32
 MAX_PRIORITY = F(10.0)
@@ -548,13 +549,55 @@ def incoming_statics_host(nt, pm, tt, pb, num_label_values: int,
         wm_aff=wm_aff, wm_anti=wm_anti)
 
 
+# -- topology spread (ops/topology.py twin) -----------------------------------
+
+
+def topo_statics_host(nt, pm, pb, num_label_values: int):
+    """ops/topology.py topo_statics twin — the per-wave static
+    PodTopologySpread state as the same TopoStatics tuple over numpy
+    planes. Counts go through the f64 bincount + f32 round of
+    _anchored_hit_host (integer-valued, so bitwise with the device's
+    f32 segment_sum)."""
+    from .topology import TopoStatics
+
+    P, TS = pb.ts_tk.shape
+    N = nt.labels.shape[0]
+    dom = node_domains_host(nt, pb.ts_tk)  # [P, TS, N]
+    dom = dom * nt.valid[None, None, :]
+    dom_f = dom.reshape(P * TS, N)
+
+    live = pb.ts_valid[:, :, None]  # [P, TS, 1]
+    sel = _ipa_eval_programs(pm.labels, pb.ts_key, pb.ts_op,
+                             pb.ts_vals)  # [P, TS, M]
+    same_ns = (pm.ns[None, None, :] == pb.ns_id[:, None, None])
+    match = sel & same_ns & (pm.valid & pm.alive)[None, None, :] & live
+    M = pm.labels.shape[0]
+    dom_m = np.take_along_axis(
+        dom_f, np.broadcast_to(pm.node[None, :], (P * TS, M)), axis=1)
+    counts = _anchored_hit_host(match.reshape(P * TS, M), dom_m,
+                                num_label_values, count=True)
+    present = _anchored_hit_host(
+        np.broadcast_to(nt.valid[None, :], (P * TS, N)), dom_f,
+        num_label_values)
+
+    wsel = _ipa_eval_programs(pb.pl_val, pb.ts_key, pb.ts_op,
+                              pb.ts_vals)  # [P, TS, P]
+    wave_ns = (pb.ns_id[None, None, :] == pb.ns_id[:, None, None])
+    wm = wsel & wave_ns & pb.valid[None, None, :] & live
+    selfm = wm[np.arange(P), :, np.arange(P)]  # [P, TS]
+    return TopoStatics(node_dom=dom.astype(np.int32),
+                       counts=counts.reshape(P, TS, num_label_values),
+                       present=present.reshape(P, TS, num_label_values),
+                       wm=wm, selfm=selfm)
+
+
 # -- the wave (ops/kernel.py _wave_body twin) ---------------------------------
 
 
 def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
                        extra_scores=None, *, weights: Weights,
                        num_zones: int, num_label_values: int = 64,
-                       has_ipa: bool = False,
+                       has_ipa: bool = False, has_ts=None,
                        usage_in=None,
                        collect_scores: bool = False,
                        weight_vec=None) -> WaveResult:
@@ -586,13 +629,20 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     N = nt.valid.shape[0]
     P = pb.req.shape[0]
     R = nt.alloc.shape[1]
+    # the device wrapper's has_ts derivation (ops/kernel.py
+    # schedule_wave): spread-free waves skip the topology plane exactly
+    # like the compiled program does
+    if has_ts is None:
+        has_ts = bool(np.any(pb.ts_valid))
     is_core = np.arange(R) < enc.RES_FIXED
-    masks = static_predicate_masks(nt, pb, is_core)  # [Q-2, P, N]
+    masks = static_predicate_masks(nt, pb, is_core)  # [Q-3, P, N]
+    ts_placeholder = np.ones((1, P, N), bool)
     ipa_placeholder = np.ones((1, P, N), bool)
-    masks = np.concatenate([masks, ipa_placeholder,
+    masks = np.concatenate([masks, ts_placeholder, ipa_placeholder,
                             np.asarray(extra_mask, bool)[None]], axis=0)
     res_i = enc.PRED_IDX["PodFitsResources"]
     ipa_i = enc.PRED_IDX["MatchInterPodAffinity"]
+    ts_i = enc.PRED_IDX["PodTopologySpread"]
     m2 = masks.copy()
     m2[res_i] = True
     static_nonres = np.all(m2, axis=0)  # [P, N]
@@ -600,6 +650,9 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     ipa = (incoming_statics_host(nt, pm, tt, pb, num_label_values,
                                  weights.hard_pod_affinity)
            if has_ipa else None)
+    topo = (topo_statics_host(nt, pm, pb, num_label_values)
+            if has_ts else None)
+    lv_ids = np.arange(num_label_values, dtype=np.int32)
 
     w = weights
     # the kernel's wv twin: the caller's live vector, or the static
@@ -648,6 +701,9 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     req_c = np.array(usage0[0], np.float32, copy=True)
     nz_c = np.array(usage0[1], np.float32, copy=True)
     cnt_c = np.array(usage0[2], np.int32, copy=True)
+    # wave-start pod counts: the compactness plane's baseline (the
+    # kernel's pod_count0 closure)
+    cnt0 = cnt_c.copy()
     rr = int(rr_start)
 
     chosen = np.full((P,), -1, np.int32)
@@ -655,6 +711,7 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
     feas_cnt = np.zeros((P,), np.int32)
     dyn_fits = np.zeros((P, N), bool)
     ipa_masks = np.ones((P, N), bool)
+    ts_masks = np.ones((P, N), bool)
 
     for i in range(P):
         fits = resource_fit(nt.alloc, nt.allowed_pods, req_c, cnt_c,
@@ -700,6 +757,38 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
             ipa_ok = ~(ipa.sym_blocked[i] | sym_wave) & ok_aff & ok_anti
             feasible = feasible & ipa_ok
             ipa_masks[i] = ipa_ok
+        if has_ts:
+            # the scan step's PodTopologySpread logic, mirrored:
+            # resident counts + same-wave placements via `chosen`
+            active_t = chosen >= 0
+            safe_pl_t = np.clip(chosen, 0, None)
+            tdom = topo.node_dom[i]  # [TS, N]
+            tcnt = topo.counts[i]  # [TS, LV]
+            tpres = topo.present[i]  # [TS, LV]
+            twm = topo.wm[i]  # [TS, P]
+            pl_dom_ts = tdom[:, safe_pl_t]  # [TS, P]
+            addm = twm & active_t[None, :] & (pl_dom_ts > 0)
+            onehot = ((pl_dom_ts[:, :, None] == lv_ids[None, None, :])
+                      & addm[:, :, None])
+            # integer-valued one-hot sum, device-mirrored op order.
+            # ktpu: allow[f32-reduction] integer-valued, twin of kernel
+            cnt_dyn = tcnt + np.sum(onehot.astype(np.float32), axis=1)
+            cnt_at = np.take_along_axis(cnt_dyn, tdom, axis=1)  # [TS, N]
+            key_ok = tdom > 0
+            anyp = np.any(tpres, axis=1)  # [TS]
+            minm = np.where(
+                anyp,
+                np.min(np.where(tpres, cnt_dyn, F(np.inf)), axis=1),
+                F(0.0))
+            cand = cnt_at + topo.selfm[i][:, None].astype(np.float32)
+            hard = (pb.ts_valid[i] & pb.ts_hard[i])[:, None]
+            ok_rows = np.where(
+                hard,
+                key_ok & ((cand - minm[:, None]) <= pb.ts_skew[i][:, None]),
+                True)
+            ts_ok = np.all(ok_rows, axis=0)  # [N]
+            feasible = feasible & ts_ok
+            ts_masks[i] = ts_ok
         total = static_score[i]
         fscore = None
         if has_ipa and (w.interpod or collect_scores):
@@ -740,6 +829,41 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
               if w.most_requested or collect_scores else None)
         if w.most_requested:
             total = total + wv[W_MOST] * mr
+        ts_n = None
+        if has_ts and (w.topology_spread or collect_scores):
+            maxm = np.where(
+                anyp,
+                np.max(np.where(tpres, cnt_dyn, F(-np.inf)), axis=1),
+                F(0.0))
+            # TS-axis sum of integer-valued f32, device-mirrored.
+            # ktpu: allow[f32-reduction] twin of kernel ts_raw
+            ts_raw = np.sum(
+                np.where(key_ok & pb.ts_valid[i][:, None],
+                         np.maximum(maxm[:, None] - cnt_at, F(0.0)),
+                         F(0.0)),
+                axis=0)
+            ts_n = normalize_reduce(ts_raw, feasible, False)
+        if has_ts and w.topology_spread:
+            total = total + wv[W_TOPO_SPREAD] * ts_n
+        compact_n = None
+        if w.topology_compactness or collect_scores:
+            # kernel compactness plane, mirrored: this wave's placements
+            # per rack/superpod (f64 bincount -> f32, integer-exact) with
+            # the rack-over-superpod gradient and accel-gen priority bias
+            wave_placed = (cnt_c - cnt0).astype(np.float32)
+            rsum = np.bincount(
+                nt.rack_id, weights=wave_placed,
+                minlength=num_zones)[:num_zones].astype(np.float32)
+            rackc = rsum[nt.rack_id] * (nt.rack_id > 0)
+            ssum = np.bincount(
+                nt.superpod_id, weights=wave_placed,
+                minlength=num_zones)[:num_zones].astype(np.float32)
+            spc = ssum[nt.superpod_id] * (nt.superpod_id > 0)
+            gen = nt.accel_gen.astype(np.float32) * (pb.prio[i] > 0)
+            compact_raw = F(3.0) * rackc + spc + gen
+            compact_n = normalize_reduce(compact_raw, feasible, False)
+        if w.topology_compactness:
+            total = total + wv[W_COMPACT] * compact_n
         sm = np.where(feasible, total, F(-1.0))
         best = np.max(sm) if N else F(-1.0)
         best_s[i] = best
@@ -749,7 +873,10 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
             parts = np.stack([
                 lr, ba, mr, aff_n, taint_n, spread_n,
                 avoid_full[i], img_full[i],
-                fscore if fscore is not None else zr, extra_full[i]])
+                fscore if fscore is not None else zr,
+                ts_n if ts_n is not None else zr,
+                compact_n if compact_n is not None else zr,
+                extra_full[i]])
             # lax.top_k order: descending value, lowest index on ties
             order = np.argsort(-sm, kind="stable")[:KK]
             d_tidx[i] = order.astype(np.int32)
@@ -773,6 +900,8 @@ def schedule_wave_host(nt, pm, tt, pb, extra_mask, rr_start: int,
             d_cparts[i] = parts[:, 0]
 
     masks[res_i] = dyn_fits
+    if has_ts:
+        masks[ts_i] = ts_masks
     if has_ipa:
         masks[ipa_i] = ipa_masks
     prefix_ok = np.cumprod(masks.astype(np.int8), axis=0).astype(bool)

@@ -32,22 +32,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
 from . import encoding as enc
 from ..utils import faultpoints
 from .affinity import incoming_statics
 from .filters import resource_fit, static_predicate_masks
+from .topology import topo_statics
 from .scores import (
     SCORE_STACK,
     SCORE_TOPK,
     W_AFFINITY,
     W_AVOID,
     W_BALANCED,
+    W_COMPACT,
     W_IMAGE,
     W_INTERPOD,
     W_LEAST,
     W_MOST,
     W_SPREAD,
     W_TAINT,
+    W_TOPO_SPREAD,
     ScoreDeco,
     floor_div,
     stack_weights,
@@ -78,6 +83,10 @@ class Weights(NamedTuple):
     prefer_avoid: float = 10000.0
     image_locality: float = 0.0
     interpod: float = 1.0
+    # forward-ported topology planes (ops/topology.py): PodTopologySpread
+    # skew score + gang rack/superpod compactness & accel-gen steering
+    topology_spread: float = 1.0
+    topology_compactness: float = 1.0
     # HardPodAffinitySymmetricWeight (componentconfig default 1,
     # pkg/apis/componentconfig/types.go)
     hard_pod_affinity: float = 1.0
@@ -219,6 +228,7 @@ def dispatch_bucket(nt, pm, tt, kw, lead=()) -> tuple:
         _device_count(nt.valid),
         int(kw.get("num_label_values", 64)), int(kw.get("num_zones", 0)),
         int(bool(kw.get("has_ipa", False))),
+        int(bool(kw.get("has_ts", False))),
         int(bool(kw.get("use_pallas", False))),
         int(bool(kw.get("collect_scores", False))),
         int(kw.get("weight_vec") is not None))
@@ -315,7 +325,7 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                weights: Weights, num_zones: int, num_label_values: int,
                has_ipa: bool, use_pallas: bool, pallas_interpret: bool,
                usage_in=None, taint_ports=None, collect_scores: bool = False,
-               weight_vec=None):
+               weight_vec=None, has_ts: bool = False):
     """Shared wave computation. usage_in: optional (requested, nonzero,
     pod_count) overriding nt's usage columns — the device-resident carry
     that lets consecutive waves chain without a host roundtrip.
@@ -346,15 +356,22 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     masks = static_predicate_masks(nt, pb, is_core, use_pallas,
                                    pallas_interpret,
                                    taint_ports)  # [Q-1, P, N]
-    ipa_placeholder = jnp.ones((1, P, N), bool)  # filled post-scan
-    masks = jnp.concatenate([masks, ipa_placeholder, extra_mask[None]], axis=0)
+    # placeholder rows for the scan-filled predicates (PodTopologySpread,
+    # MatchInterPodAffinity), in DEVICE_PREDICATES order
+    ts_placeholder = jnp.ones((1, P, N), bool)
+    ipa_placeholder = jnp.ones((1, P, N), bool)
+    masks = jnp.concatenate([masks, ts_placeholder, ipa_placeholder,
+                             extra_mask[None]], axis=0)
     res_i = enc.PRED_IDX["PodFitsResources"]
     ipa_i = enc.PRED_IDX["MatchInterPodAffinity"]
+    ts_i = enc.PRED_IDX["PodTopologySpread"]
     static_nonres = jnp.all(masks.at[res_i].set(True), axis=0)  # [P, N]
     alloc2 = nt.alloc[:, :2]
     ipa = (incoming_statics(nt, pm, tt, pb, num_label_values,
                             weights.hard_pod_affinity)
            if has_ipa else None)
+    topo = (topo_statics(nt, pm, pb, num_label_values) if has_ts else None)
+    lv_ids = jnp.arange(num_label_values, dtype=jnp.int32)
 
     w = weights
     # the weighted-sum multipliers: the traced weight_vec when the live
@@ -395,10 +412,20 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         extra_full = (extra_scores if extra_scores is not None
                       else jnp.zeros((P, N), jnp.float32))
 
+    usage0 = usage_in if usage_in is not None else (
+        nt.requested, nt.nonzero, nt.pod_count)
+    # wave-start pod counts: the compactness plane measures co-location
+    # against placements made THIS wave (the gang's members), not the
+    # cluster's standing population
+    pod_count0 = usage0[2]
+
     def step(carry, x):
         req_c, nz_c, cnt_c, rr, placed = carry
         if collect_scores:
             x, (avoid_row, img_row, extra_row) = x[:-3], x[-3:]
+        if has_ts:
+            x, (tsv, tsh, tss, tdom, tcnt, tpres, twm, tself) = x[:-8], x[-8:]
+        x, pprio = x[:-1], x[-1]
         if has_ipa:
             (i, preq, pnz, mask_sn, araw, traw, scnt, sscore, pvalid,
              sym_row, okaff_row, anyaff_s, banti_row, counts_row,
@@ -442,6 +469,34 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
             feasible &= ipa_ok
         else:
             ipa_ok = jnp.ones_like(feasible)
+        if has_ts:
+            # PodTopologySpread vs resident pods + same-wave placements
+            # (upstream's assume semantics, like the ipa block above)
+            active_t = placed >= 0
+            safe_pl_t = jnp.clip(placed, 0)
+            pl_dom_ts = tdom[:, safe_pl_t]  # [TS, P] placement domains
+            addm = twm & active_t[None, :] & (pl_dom_ts > 0)
+            onehot = ((pl_dom_ts[:, :, None] == lv_ids[None, None, :])
+                      & addm[:, :, None])
+            # ktpu: allow[f32-reduction] integer-valued one-hot sum, exact in f32 in any association, twin-mirrored
+            cnt_dyn = tcnt + jnp.sum(onehot.astype(jnp.float32), axis=1)
+            cnt_at = jnp.take_along_axis(cnt_dyn, tdom, axis=1)  # [TS, N]
+            key_ok = tdom > 0  # node has the constraint's topology key
+            anyp = jnp.any(tpres, axis=1)  # [TS]
+            minm = jnp.where(
+                anyp,
+                jnp.min(jnp.where(tpres, cnt_dyn, jnp.inf), axis=1), 0.0)
+            # skew = count-if-placed-here minus global min; self counts
+            # only when the pod matches its own selector (selfMatchNum)
+            cand = cnt_at + tself[:, None].astype(jnp.float32)
+            hard = (tsv & tsh)[:, None]
+            ok_rows = jnp.where(
+                hard,
+                key_ok & ((cand - minm[:, None]) <= tss[:, None]), True)
+            ts_ok = jnp.all(ok_rows, axis=0)  # [N]
+            feasible &= ts_ok
+        else:
+            ts_ok = None
         total = sscore
         fscore = None
         if has_ipa and (w.interpod or collect_scores):
@@ -478,6 +533,43 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
               if w.most_requested or collect_scores else None)
         if w.most_requested:
             total = total + wv[W_MOST] * mr
+        ts_n = None
+        if has_ts and (w.topology_spread or collect_scores):
+            # raw spread score: headroom below the fullest domain — a
+            # node in a less-crowded domain scores higher; key-less
+            # nodes score 0 (upstream scores them lowest)
+            maxm = jnp.where(
+                anyp,
+                jnp.max(jnp.where(tpres, cnt_dyn, -jnp.inf), axis=1), 0.0)
+            # ktpu: allow[f32-reduction] TS-axis (2 rows) of integer-valued f32, twin-mirrored
+            ts_raw = jnp.sum(
+                jnp.where(key_ok & tsv[:, None],
+                          jnp.maximum(maxm[:, None] - cnt_at, 0.0), 0.0),
+                axis=0)
+            ts_n = normalize_reduce(ts_raw, feasible, False)
+        if has_ts and w.topology_spread:
+            total = total + wv[W_TOPO_SPREAD] * ts_n
+        compact_n = None
+        if w.topology_compactness or collect_scores:
+            # gang compactness + heterogeneity steering: count this
+            # wave's placements per rack/superpod (ids intern into the
+            # shared zones vocab — state/snapshot.py — so num_zones
+            # bounds the segment-sums), prefer co-located nodes with a
+            # rack-over-superpod gradient, and bias priority-bearing
+            # (throughput-sensitive) pods toward newer accelerator
+            # generations. All-zero columns make this plane exactly 0.
+            wave_placed = (cnt_c - pod_count0).astype(jnp.float32)
+            rsum = jax.ops.segment_sum(wave_placed, nt.rack_id,
+                                       num_segments=num_zones)
+            rackc = rsum[nt.rack_id] * (nt.rack_id > 0)
+            ssum = jax.ops.segment_sum(wave_placed, nt.superpod_id,
+                                       num_segments=num_zones)
+            spc = ssum[nt.superpod_id] * (nt.superpod_id > 0)
+            gen = nt.accel_gen.astype(jnp.float32) * (pprio > 0)
+            compact_raw = 3.0 * rackc + spc + gen
+            compact_n = normalize_reduce(compact_raw, feasible, False)
+        if w.topology_compactness:
+            total = total + wv[W_COMPACT] * compact_n
         sm = jnp.where(feasible, total, -1.0)
         best = jnp.max(sm)
         has = best >= 0
@@ -494,6 +586,8 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
         rr = rr + jnp.where(has, 1, 0)
         placed = placed.at[i].set(chosen)
         out = (chosen, best, fits, jnp.sum(feasible.astype(jnp.int32)), ipa_ok)
+        if has_ts:
+            out = out + (ts_ok,)
         if collect_scores:
             # SCORE_STACK-ordered raw planes [S, N]; the chosen node's
             # column and the top-k candidates' columns ride out of the
@@ -504,6 +598,8 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                 lr, ba, mr, aff_n, taint_n, spread_n,
                 avoid_row, img_row,
                 fscore if fscore is not None else zr,
+                ts_n if ts_n is not None else zr,
+                compact_n if compact_n is not None else zr,
                 extra_row,
             ])
             kk = min(SCORE_TOPK, N)
@@ -512,8 +608,6 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                          top_vals, jnp.take(parts, top_idx, axis=1))
         return (req_c, nz_c, cnt_c, rr, placed), out
 
-    usage0 = usage_in if usage_in is not None else (
-        nt.requested, nt.nonzero, nt.pod_count)
     carry0 = (usage0[0], usage0[1], usage0[2],
               jnp.asarray(rr_start, jnp.int32), jnp.full((P,), -1, jnp.int32))
     ii = jnp.arange(P, dtype=jnp.int32)
@@ -528,20 +622,28 @@ def _wave_body(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
     else:
         xs = (ii, pb.req, pb.nonzero, static_nonres, aff_raw, taint_raw,
               spread_cnt, static_score, pb.valid)
+    xs = xs + (pb.prio,)
+    if has_ts:
+        xs = xs + (pb.ts_valid, pb.ts_hard, pb.ts_skew, topo.node_dom,
+                   topo.counts, topo.present, topo.wm, topo.selfm)
     if collect_scores:
         xs = xs + (avoid_full, img_full, extra_full)
     (req_end, nz_end, cnt_end, rr_end, _), outs = \
         lax.scan(step, carry0, xs)
+    chosen, best, dyn_fits, feas_cnt, ipa_masks = outs[:5]
+    rest = outs[5:]
+    ts_masks = None
+    if has_ts:
+        ts_masks, rest = rest[0], rest[1:]
     deco = None
     if collect_scores:
-        (chosen, best, dyn_fits, feas_cnt, ipa_masks,
-         cparts, tidx, tvals, tparts) = outs
+        cparts, tidx, tvals, tparts = rest
         deco = ScoreDeco(chosen_parts=cparts, top_idx=tidx,
                          top_vals=tvals, top_parts=tparts)
-    else:
-        chosen, best, dyn_fits, feas_cnt, ipa_masks = outs
 
     masks = masks.at[res_i].set(dyn_fits)
+    if has_ts:
+        masks = masks.at[ts_i].set(ts_masks)
     if has_ipa:
         masks = masks.at[ipa_i].set(ipa_masks)
     # short-circuit first-fail attribution in predicate order
@@ -571,19 +673,24 @@ def schedule_wave(*args, **kw):
     would silently stop firing."""
     faultpoints.fire("kernel.wave")
     nt, pm, tt, pb = args[0], args[1], args[2], args[3]
+    # has_ts is static like has_ipa: derived host-side from the wave's
+    # featurized batch (numpy in every real call path) so spread-free
+    # waves keep the exact pre-topology program
+    kw.setdefault("has_ts", bool(np.any(np.asarray(pb.ts_valid))))
     bucket = dispatch_bucket(nt, pm, tt, kw, lead=(pb.req.shape[0],))
     return record_dispatch("wave", bucket,
                            lambda: _schedule_wave(*args, **kw))
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
-    "pallas_interpret", "collect_scores"))
+    "weights", "num_zones", "num_label_values", "has_ipa", "has_ts",
+    "use_pallas", "pallas_interpret", "collect_scores"))
 def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                    pb: enc.PodBatch, extra_mask, rr_start, extra_scores=None,
                    *, weights: Weights,
                    num_zones: int, num_label_values: int = 64,
-                   has_ipa: bool = False, use_pallas: bool = False,
+                   has_ipa: bool = False, has_ts: bool = False,
+                   use_pallas: bool = False,
                    pallas_interpret: bool = False,
                    collect_scores: bool = False,
                    weight_vec=None) -> WaveResult:
@@ -608,7 +715,7 @@ def _schedule_wave(nt: enc.NodeTensors, pm: enc.PodMatrix, tt: enc.TermTable,
                         weights, num_zones, num_label_values, has_ipa,
                         use_pallas, pallas_interpret,
                         collect_scores=collect_scores,
-                        weight_vec=weight_vec)
+                        weight_vec=weight_vec, has_ts=has_ts)
     return res
 
 
@@ -651,6 +758,7 @@ def schedule_round(*args, **kw):
     the first compile."""
     faultpoints.fire("kernel.round")
     nt, pm, tt, pbs = args[0], args[1], args[2], args[3]
+    kw.setdefault("has_ts", bool(np.any(np.asarray(pbs.ts_valid))))
     bucket = dispatch_bucket(nt, pm, tt, kw,
                              lead=(pbs.req.shape[0], pbs.req.shape[1]))
     return record_dispatch("round", bucket,
@@ -658,13 +766,14 @@ def schedule_round(*args, **kw):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "weights", "num_zones", "num_label_values", "has_ipa", "use_pallas",
-    "pallas_interpret", "collect_scores"))
+    "weights", "num_zones", "num_label_values", "has_ipa", "has_ts",
+    "use_pallas", "pallas_interpret", "collect_scores"))
 def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
                     tt: enc.TermTable, pbs: enc.PodBatch,
                     usage, rr_start, pm_rows, term_rows, *,
                    weights: Weights, num_zones: int,
                    num_label_values: int = 64, has_ipa: bool = False,
+                   has_ts: bool = False,
                    use_pallas: bool = False, pallas_interpret: bool = False,
                    collect_scores: bool = False, weight_vec=None):
     """An ENTIRE scheduling round as one program: lax.scan over W waves,
@@ -711,7 +820,7 @@ def _schedule_round(nt: enc.NodeTensors, pm: enc.PodMatrix,
                                   has_ipa, False, pallas_interpret,
                                   usage_in=usage_c, taint_ports=tp,
                                   collect_scores=collect_scores,
-                                  weight_vec=weight_vec)
+                                  weight_vec=weight_vec, has_ts=has_ts)
         pm_o, tt_o = _stage_placements(pm_c, tt_c, res.chosen, rows, trows)
         out = (res.chosen, res.fail_counts)
         if collect_scores:

@@ -47,6 +47,8 @@ SCORE_STACK = (
     "PreferAvoid",
     "ImageLocality",
     "InterPodAffinity",
+    "TopologySpread",  # PodTopologySpread skew score (ops/topology.py)
+    "TopologyCompactness",  # gang rack/superpod co-location + accel-gen steering
     "HostExtra",  # pre-weighted host/extender scores (weight renders as 1)
 )
 # candidates gathered per pod (the chosen node is gathered separately:
@@ -68,13 +70,16 @@ WEIGHT_FIELDS = {
     "PreferAvoid": "prefer_avoid",
     "ImageLocality": "image_locality",
     "InterPodAffinity": "interpod",
+    "TopologySpread": "topology_spread",
+    "TopologyCompactness": "topology_compactness",
     "HostExtra": None,
 }
 
 # SCORE_STACK row indices, named — the kernel and its numpy twin index
 # the traced weight vector with these so the contract stays greppable
 (W_LEAST, W_BALANCED, W_MOST, W_AFFINITY, W_TAINT, W_SPREAD, W_AVOID,
- W_IMAGE, W_INTERPOD, W_EXTRA) = range(len(SCORE_STACK))
+ W_IMAGE, W_INTERPOD, W_TOPO_SPREAD, W_COMPACT,
+ W_EXTRA) = range(len(SCORE_STACK))
 
 
 class ScoreDeco(NamedTuple):
@@ -96,7 +101,8 @@ def stack_weights(w) -> np.ndarray:
     return np.asarray(
         [w.least_requested, w.balanced, w.most_requested, w.node_affinity,
          w.taint_toleration, w.selector_spread, w.prefer_avoid,
-         w.image_locality, w.interpod, 1.0], np.float32)
+         w.image_locality, w.interpod, w.topology_spread,
+         w.topology_compactness, 1.0], np.float32)
 
 
 def floor_div(x):

@@ -366,6 +366,73 @@ def interpod_affinity_predicate(pod: api.Pod, ni: NodeInfo,
     return True, []
 
 
+def has_hard_spread(pod: api.Pod) -> bool:
+    """True when the pod carries any DoNotSchedule topology spread
+    constraint — callers that need the cluster-wide what-if view
+    (preemption) key off this, exactly like with_affinity."""
+    return any(c.when_unsatisfiable == api.DO_NOT_SCHEDULE
+               for c in (pod.spec.topology_spread_constraints or ()))
+
+
+def topology_spread_predicate(pod: api.Pod, ni: NodeInfo,
+                              view: ClusterView) -> PredicateResult:
+    """PodTopologySpread filter (forward-port; upstream plugin's Filter
+    phase) for the host what-if paths — the scalar mirror of the dense
+    hard-mask plane in ops/kernel.py, with the SAME documented
+    simplifications (ops/topology.py module doc): the global minimum
+    reduces over domains of ALL nodes carrying the key (empty domains
+    pull it down), a nil selector matches nothing, and the incoming pod
+    counts itself only when it matches its own selector (selfMatchNum).
+    Nodes missing the constraint's key fail hard, and counted pods are
+    live (no deletion timestamp) same-namespace matches — matching
+    pm.valid & pm.alive on the device plane. Preemption's clone/reprieve
+    loop reads the override node through `view`, so victim removal
+    lowers that domain's count exactly like meta.RemovePod upstream."""
+    cons = [c for c in (pod.spec.topology_spread_constraints or ())
+            if c.when_unsatisfiable == api.DO_NOT_SCHEDULE]
+    if not cons:
+        return True, []
+    node = ni.node
+    if node is None:
+        return False, [REASONS["NodeUnknownCondition"]]
+    ov = view.override
+    ov_name = (ov.node.name if ov is not None and ov.node is not None
+               else None)
+    for c in cons:
+        key = c.topology_key
+        dom = node.metadata.labels.get(key) if key else None
+        if dom is None:
+            return False, [REASONS["PodTopologySpread"]]
+        # domains enumerated from the node set (value -> matching count)
+        counts: Dict[str, int] = {}
+        for name, vni in view.node_infos.items():
+            vni = ov if name == ov_name else vni
+            if vni.node is None:
+                continue
+            d = vni.node.metadata.labels.get(key)
+            if d is not None:
+                counts.setdefault(d, 0)
+        if ov_name is not None and ov_name not in view.node_infos \
+                and ov.node is not None:
+            d = ov.node.metadata.labels.get(key)
+            if d is not None:
+                counts.setdefault(d, 0)
+        for p, eni in view.iter_pods():
+            if (eni.node is None or p.namespace != pod.namespace
+                    or p.metadata.deletion_timestamp is not None):
+                continue
+            d = eni.node.metadata.labels.get(key)
+            if (d in counts and c.label_selector is not None
+                    and c.label_selector.matches(p.metadata.labels)):
+                counts[d] += 1
+        minm = min(counts.values()) if counts else 0
+        selfm = int(c.label_selector is not None
+                    and c.label_selector.matches(pod.metadata.labels))
+        if counts.get(dom, 0) + selfm - minm > c.max_skew:
+            return False, [REASONS["PodTopologySpread"]]
+    return True, []
+
+
 def interpod_affinity_priority(pod: api.Pod, feasible: Sequence[NodeInfo],
                                view: ClusterView,
                                hard_weight: int = 1) -> Dict[str, int]:
@@ -460,6 +527,10 @@ def pod_fits_on_node(pod: api.Pod, ni: NodeInfo,
         ok, r = interpod_affinity_predicate(pod, ni, view)
         if not ok:
             reasons.extend(r)
+        if not reasons:
+            ok, r = topology_spread_predicate(pod, ni, view)
+            if not ok:
+                reasons.extend(r)
     return not reasons, reasons
 
 

@@ -43,6 +43,9 @@ _SCORE_FIELDS = {
     "NodePreferAvoidPodsPriority": "prefer_avoid",
     "ImageLocalityPriority": "image_locality",
     "InterPodAffinityPriority": "interpod",
+    # forward-ported topology planes (ops/topology.py)
+    "PodTopologySpreadPriority": "topology_spread",
+    "TopologyCompactnessPriority": "topology_compactness",
 }
 
 
@@ -102,6 +105,10 @@ def default_profile(store=None) -> Profile:
             "NodePreferAvoidPodsPriority": 10000,
             "NodeAffinityPriority": 1,
             "TaintTolerationPriority": 1,
+            # forward-ported topology planes: spread skew score + gang
+            # compactness / accel-gen steering (ops/topology.py)
+            "PodTopologySpreadPriority": 1,
+            "TopologyCompactnessPriority": 1,
         },
     )
 

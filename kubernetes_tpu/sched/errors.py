@@ -37,6 +37,12 @@ REASONS = {
     # scan could not place minMember pods simultaneously. Deliberately
     # NOT in UNRESOLVABLE — evicting victims can free gang capacity.
     "Gang": "pod group could not be placed in full",
+    # PodTopologySpread (forward-port, ops/topology.py + the golden
+    # predicate): reason string matches the plugin's
+    # ErrReasonConstraintsNotMatch. Deliberately NOT in UNRESOLVABLE —
+    # evicting matching pods from a crowded domain reduces its skew.
+    "PodTopologySpread":
+        "node(s) didn't match pod topology spread constraints",
     # poison-work isolation (forward-port of 1.11's per-pod predicate
     # error returns to the batched plane): the pod's spec crashed or
     # numerically poisoned the shared Filter+Score pass and the pod was

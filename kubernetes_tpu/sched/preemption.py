@@ -252,11 +252,12 @@ def vector_candidate_order(pod: api.Pod, snapshot,
     if (featurizer.needs_host_path(pod)
             or snapshot.has_affinity_terms
             or (aff is not None and (aff.pod_affinity is not None
-                                     or aff.pod_anti_affinity is not None))):
-        # the twin carries no inter-pod affinity plane: an affinity-
-        # blind top-K cut could drop the only affinity-feasible node
-        # before exact validation — such pods keep the full
-        # validate-every-resolvable-node loop
+                                     or aff.pod_anti_affinity is not None))
+            or golden.has_hard_spread(pod)):
+        # the twin carries no inter-pod affinity (or topology spread)
+        # plane: a constraint-blind top-K cut could drop the only
+        # feasible node before exact validation — such pods keep the
+        # full validate-every-resolvable-node loop
         return None
     live = snapshot.ep_valid & snapshot.ep_alive
     levels = hostwave.victim_levels(snapshot.ep_prio, live, PRUNE_LEVELS)
@@ -294,7 +295,12 @@ def preempt(pod: api.Pod, cache: SchedulerCache,
     also validates only its top-K device-ranked candidates."""
     if not pod_eligible_to_preempt_others(pod, cache):
         return None
-    node_infos = cache.node_infos if with_affinity else None
+    # topology spread's what-if needs the cluster-wide domain counts
+    # just like affinity needs the cluster's pods: without the view the
+    # golden fit is spread-blind and reports ts-infeasible nodes as
+    # zero-victim candidates (observed as a hot nominate/requeue loop)
+    node_infos = (cache.node_infos
+                  if with_affinity or golden.has_hard_spread(pod) else None)
     helpful = nodes_where_preemption_might_help(failed_predicates)
     node_order: List[str] = helpful
     pruned = -1

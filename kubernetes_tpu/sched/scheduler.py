@@ -946,6 +946,11 @@ class Scheduler:
             if contrib[s]:
                 self.metrics.score_priority_points.labels(
                     priority=name).inc(float(contrib[s]))
+        # schema note: parts/breakdown/weights are keyed (and ordered) by
+        # SCORE_STACK, so growing the stack — e.g. the TopologySpread /
+        # TopologyCompactness planes — extends these records in place.
+        # Readers must key by plane NAME, never by position or a fixed
+        # plane count; that is what makes stack growth version-bump-free.
         out: Dict = {
             "min": round(min(totals), 4), "max": round(max(totals), 4),
             "mean": round(sum(totals) / len(totals), 4),
@@ -2045,7 +2050,10 @@ class Scheduler:
             with_aff = bool(self.snapshot.has_affinity_terms
                             or (aff is not None
                                 and (aff.pod_affinity is not None
-                                     or aff.pod_anti_affinity is not None)))
+                                     or aff.pod_anti_affinity is not None))
+                            # spread's what-if reads cluster-wide domain
+                            # counts through the view, like affinity
+                            or golden.has_hard_spread(pod))
             node_infos = self.cache.node_infos if with_aff else None
             validated = {}
             tried = 0
@@ -4391,8 +4399,15 @@ class Scheduler:
                          snapshot=self.snapshot,
                          featurizer=self.featurizer)
             self.metrics.preemption_evaluation.observe(self.clock() - t0)
-            if pr is not None:
+            if pr is not None and pr.victims:
                 self._perform_preemption(pod, pr)
+            # a zero-victim candidate means the what-if thinks the pod
+            # fits as-is (a racing eviction freed capacity, or the host
+            # fit diverged from the device mask): same discipline as
+            # _preempt_chunk — don't nominate, just park and retry. The
+            # nomination's store write echoes through the informer and
+            # re-activates the pod BEFORE the park below, so a divergent
+            # zero-victim nominate becomes a backoff-less hot loop.
         self._park_with_backoff(pod)
         self.store.set_pod_condition(pod, ("PodScheduled", "False:" + err.message()))
 

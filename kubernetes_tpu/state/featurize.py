@@ -338,6 +338,51 @@ class PodFeaturizer:
                 break
         d["sg_valid"], d["sg_key"], d["sg_op"], d["sg_vals"], d["sg_num"] = (
             sg_valid, sg_key, sg_op, sg_vals, sg_num)
+        # topologySpreadConstraints (forward-port; ops/topology.py).
+        # Selector programs run over the existing-pod label space, like
+        # inter-pod-affinity terms; a nil selector matches nothing
+        # (labels.Nothing, same convention as _compile_combined).
+        cons = [t for t in pod.spec.topology_spread_constraints
+                if t.topology_key]
+        while True:
+            c = self.snap.caps
+            if len(cons) > c.TS:
+                self.snap._grow(TS=len(cons))
+                continue
+            ts_valid = np.zeros((c.TS,), bool)
+            ts_hard = np.zeros((c.TS,), bool)
+            ts_skew = np.zeros((c.TS,), np.float32)
+            ts_tk = np.zeros((c.TS,), np.int32)
+            ts_key = np.zeros((c.TS, c.TE), np.int32)
+            ts_op = np.full((c.TS, c.TE), enc.OP_PAD, np.int32)
+            ts_vals = np.full((c.TS, c.TE, c.TV), -1, np.int32)
+            ok = True
+            for ti, con in enumerate(cons):
+                if con.label_selector is None:
+                    prog = "nothing"
+                else:
+                    reqs = con.label_selector.to_selector().requirements
+                    prog = self._compile_reqs(reqs, v.pod_label_keys,
+                                              c.TE, c.TV, node_space=False)
+                    if prog is None:
+                        self.snap._grow(
+                            TE=len(reqs),
+                            TV=max((len(r.values) for r in reqs), default=0))
+                        ok = False
+                        break
+                ts_valid[ti] = True
+                ts_hard[ti] = con.when_unsatisfiable != api.SCHEDULE_ANYWAY
+                ts_skew[ti] = max(1, int(con.max_skew))
+                ts_tk[ti] = self.snap.label_key_col(con.topology_key)
+                if prog == "nothing":
+                    ts_op[ti, 0] = enc.OP_FALSE
+                else:
+                    ts_key[ti], ts_op[ti], ts_vals[ti], _ = prog
+            if ok:
+                break
+        d["ts_valid"], d["ts_hard"], d["ts_skew"], d["ts_tk"] = (
+            ts_valid, ts_hard, ts_skew, ts_tk)
+        d["ts_key"], d["ts_op"], d["ts_vals"] = ts_key, ts_op, ts_vals
         # inter-pod affinity
         self._featurize_interpod(pod, d)
         # misc
@@ -654,6 +699,13 @@ class PodFeaturizer:
             img_id=stack("img_id", (c.PI,), np.int32),
             prio=stack("prio", (), np.int32),
             valid=np.arange(P) < len(pods),
+            ts_valid=stack("ts_valid", (c.TS,), bool),
+            ts_hard=stack("ts_hard", (c.TS,), bool),
+            ts_skew=stack("ts_skew", (c.TS,), np.float32),
+            ts_tk=stack("ts_tk", (c.TS,), np.int32),
+            ts_key=stack("ts_key", (c.TS, c.TE), np.int32),
+            ts_op=stack("ts_op", (c.TS, c.TE), np.int32, enc.OP_PAD),
+            ts_vals=stack("ts_vals", (c.TS, c.TE, c.TV), np.int32, -1),
             **self._dedup_tables(rows, P),
         )
         return batch
@@ -743,4 +795,6 @@ class PodFeaturizer:
             and d["pa_key"].shape == (c.PA, c.TE)
             and d["pa_vals"].shape == (c.PA, c.TE, c.TV)
             and d["pa_ns"].shape == (c.PA, c.TNS)
+            and d["ts_key"].shape == (c.TS, c.TE)
+            and d["ts_vals"].shape == (c.TS, c.TE, c.TV)
         )

@@ -81,7 +81,8 @@ class ScrubReport:
 _RESOURCE_FIELDS = ("requested", "nonzero", "pod_count")
 _TOPOLOGY_FIELDS = ("alloc", "allowed_pods", "labels", "label_nums",
                     "taint_key", "taint_val", "taint_effect", "cond",
-                    "zone_id", "avoid")
+                    "zone_id", "rack_id", "superpod_id", "accel_gen",
+                    "avoid")
 
 
 def _rows_equal(a, b, fill=0) -> bool:

@@ -177,6 +177,12 @@ class Snapshot:
         self.cond = np.zeros((c.N, enc.N_COND), bool)
         self.ports = np.zeros((c.N, c.PP), np.int32)
         self.zone_id = np.zeros((c.N,), np.int32)
+        # topology + heterogeneity columns (ops/topology.py): rack and
+        # superpod ids live in the shared zone vocabulary (hierarchical
+        # keys, see api.get_rack_key), so they are bounded by caps.Z
+        self.rack_id = np.zeros((c.N,), np.int32)
+        self.superpod_id = np.zeros((c.N,), np.int32)
+        self.accel_gen = np.zeros((c.N,), np.int32)
         self.img_id = np.zeros((c.N, c.NI), np.int32)
         self.img_size = np.zeros((c.N, c.NI), np.float32)
         self.avoid = np.zeros((c.N,), bool)
@@ -234,6 +240,9 @@ class Snapshot:
         self.cond = pad(self.cond, (c.N, enc.N_COND))
         self.ports = pad(self.ports, (c.N, c.PP))
         self.zone_id = pad(self.zone_id, (c.N,))
+        self.rack_id = pad(self.rack_id, (c.N,))
+        self.superpod_id = pad(self.superpod_id, (c.N,))
+        self.accel_gen = pad(self.accel_gen, (c.N,))
         self.img_id = pad(self.img_id, (c.N, c.NI))
         self.img_size = pad(self.img_size, (c.N, c.NI))
         self.avoid = pad(self.avoid, (c.N,))
@@ -349,6 +358,20 @@ class Snapshot:
         if zid >= self.caps.Z:
             self._grow(Z=zid + 1)
         self.zone_id[idx] = zid
+        # rack / superpod: interned into the SAME zone vocabulary with
+        # hierarchical keys ("sp:<v>", "sp:<v>/rk:<r>"), so both ids stay
+        # under caps.Z and every topology segment-sum reuses num_zones as
+        # its segment count — no new static kernel args
+        spk = api.get_superpod_key(node)
+        spid = v.zones.intern(spk) if spk else 0
+        rk = api.get_rack_key(node)
+        rid = v.zones.intern(rk) if rk else 0
+        top = max(spid, rid)
+        if top >= self.caps.Z:
+            self._grow(Z=top + 1)
+        self.superpod_id[idx] = spid
+        self.rack_id[idx] = rid
+        self.accel_gen[idx] = api.get_accel_gen(node)
         # images
         imgs = list(ni.image_sizes.items())
         if len(imgs) > self.caps.NI:
@@ -667,7 +690,9 @@ class Snapshot:
             labels=self.labels, label_nums=self.label_nums,
             taint_key=self.taint_key, taint_val=self.taint_val,
             taint_effect=self.taint_effect, cond=self.cond, ports=self.ports,
-            zone_id=self.zone_id, img_id=self.img_id, img_size=self.img_size,
+            zone_id=self.zone_id, rack_id=self.rack_id,
+            superpod_id=self.superpod_id, accel_gen=self.accel_gen,
+            img_id=self.img_id, img_size=self.img_size,
             avoid=self.avoid, valid=self.valid,
         )
 
@@ -700,7 +725,8 @@ class Snapshot:
         if key == "topo":
             return (self.alloc, self.allowed_pods, self.labels,
                     self.label_nums, self.taint_key, self.taint_val,
-                    self.taint_effect, self.cond, self.zone_id, self.img_id,
+                    self.taint_effect, self.cond, self.zone_id, self.rack_id,
+                    self.superpod_id, self.accel_gen, self.img_id,
                     self.img_size, self.avoid, self.valid)
         if key == "pods":
             return (self.ep_labels, self.ep_ns, self.ep_node, self.ep_valid,
@@ -800,7 +826,8 @@ class Snapshot:
         self.dirty_resources = self.dirty_topology = self.dirty_pods = False
         requested, nonzero, pod_count, ports = cache["res"]
         (alloc, allowed_pods, labels, label_nums, taint_key, taint_val,
-         taint_effect, cond, zone_id, img_id, img_size, avoid, valid) = cache["topo"]
+         taint_effect, cond, zone_id, rack_id, superpod_id, accel_gen,
+         img_id, img_size, avoid, valid) = cache["topo"]
         (ep_labels, ep_ns, ep_node, ep_valid, ep_alive, ep_req,
          ep_prio) = cache["pods"]
         (t_kind, t_owner, t_node, t_tk, t_weight, t_ns, t_key, t_op, t_vals,
@@ -810,6 +837,7 @@ class Snapshot:
             pod_count=pod_count, allowed_pods=allowed_pods, labels=labels,
             label_nums=label_nums, taint_key=taint_key, taint_val=taint_val,
             taint_effect=taint_effect, cond=cond, ports=ports, zone_id=zone_id,
+            rack_id=rack_id, superpod_id=superpod_id, accel_gen=accel_gen,
             img_id=img_id, img_size=img_size, avoid=avoid, valid=valid,
         )
         pm = enc.PodMatrix(labels=ep_labels, ns=ep_ns, node=ep_node,

@@ -468,6 +468,7 @@ class Metrics:
                 "LeastRequested", "BalancedAllocation", "MostRequested",
                 "NodeAffinity", "TaintToleration", "SelectorSpread",
                 "PreferAvoid", "ImageLocality", "InterPodAffinity",
+                "TopologySpread", "TopologyCompactness",
                 "HostExtra")})
         # counterfactual shadow scoring (sched/weights.py): per
         # candidate-profile placement divergence (would-have-chosen !=

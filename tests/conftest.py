@@ -101,6 +101,11 @@ def pytest_configure(config):
                    "reproducers; make chaos — full budgeted run behind "
                    "make chaos-campaign)")
     config.addinivalue_line(
+        "markers", "topology: topology & heterogeneity suite "
+                   "(PodTopologySpread kernels, dense rack/superpod/"
+                   "accel-gen columns, gang compactness scoring, "
+                   "device==twin parity; make chaos + make obs)")
+    config.addinivalue_line(
         "markers", "outage: control-plane outage survival suite "
                    "(store-path breaker, disconnected-mode bind spool, "
                    "durable intent journal, crash-restart replay; "

@@ -149,6 +149,9 @@ def test_predicate_parity(seed):
             for q, name in enumerate(enc.DEVICE_PREDICATES):
                 if name == "MatchInterPodAffinity":
                     continue  # parity covered in test_interpod.py
+                if name == "PodTopologySpread":
+                    continue  # scan-filled plane (ops/topology.py), not
+                    # in static_predicate_masks; parity in test_topology.py
                 dev = bool(masks[q, pi, ni_idx])
                 if name == "CheckNodeCondition":
                     ok, reasons = golden.check_node_condition(pod, ninfo)
