@@ -58,16 +58,31 @@ verify: lint test
 # campaign smoke, and the broken-build catch-and-shrink acceptance)
 # + the `topology` topology & heterogeneity suite (PodTopologySpread
 # kernels incl. breaker-open degraded enforcement, dense
-# rack/superpod/accel-gen columns, gang compactness scoring).
+# rack/superpod/accel-gen columns, gang compactness scoring)
+# + the `soak` resource-exhaustion suite (HBM budget governor, vocab &
+# row compaction, capacity-fault OOM recovery that never convicts a
+# device or pod, churn-plateau regression gates).
 # Unregistered-marker warnings are ERRORS here so fault-point/marker
 # drift is caught at test time.
 chaos: native
 	$(PYTHON) -m pytest tests/test_chaos.py -q \
 		-W error::pytest.PytestUnknownMarkWarning
 	$(PYTHON) -m pytest tests/ -q \
-		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot or campaign or outage or topology" \
+		-m "faults or chaos or partition or hostpath or telemetry or racecheck or storm or shadow or meshfault or poison or autopilot or campaign or outage or topology or soak" \
 		--continue-on-collection-errors \
 		-W error::pytest.PytestUnknownMarkWarning
+
+# Resource-exhaustion soak tier: the `soak`-marked pytest suite
+# (compaction + capacity-fault recovery) followed by the bench soak
+# harness — multi-day churn compressed onto the virtual clock, gating
+# vocab/HBM/RSS/recompile plateaus, placement bit-parity across a
+# forced compaction, and a device.oom storm surviving with zero
+# breaker trips / mesh reforms / pod convictions.
+soak: native
+	$(PYTHON) -m pytest tests/ -q -m soak \
+		--continue-on-collection-errors \
+		-W error::pytest.PytestUnknownMarkWarning
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --workload soak
 
 # Observability tier: the flight-recorder / metrics-exposition suite,
 # the numpy-twin parity suite, the decision-observatory /
@@ -117,4 +132,4 @@ clean:
 	$(MAKE) -C native clean
 
 .PHONY: all native test test-unit lint verify chaos chaos-campaign obs \
-	multichip bench bench-all clean
+	multichip soak bench bench-all clean

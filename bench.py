@@ -61,6 +61,16 @@ measured against the reference's 100 pods/s "healthy" warning level
                 priority gangs; hard gates on exact spread-skew
                 enforcement and on the TopologyCompactness plane beating
                 a compactness-zeroed scattered baseline by a rack margin
+  soak          resource-exhaustion survival: multi-day node/pod churn
+                (fresh hostnames/labels/images every epoch — the vocab
+                leak reproducer) compressed onto the virtual clock, with
+                housekeeping compactions on cadence and the invariant
+                checker armed. Gates: vocab sizes / HBM bytes / host RSS
+                / post-warmup recompile count all plateau; a probe
+                wave's placements are bit-equal across a mid-run forced
+                compaction; an injected device.oom storm ends with zero
+                breaker trips, zero mesh reforms, zero pod convictions,
+                and every storm pod placed
 
 --suite runs the BASELINE config grid and prints one JSON line each;
 a bare `python bench.py` (the driver's command) runs DRIVER_SUITE.
@@ -1476,6 +1486,272 @@ def run_outagestorm_config(nodes, pods, wave):
     return placed, dt, spool_peak, heal_rounds
 
 
+# -- resource-exhaustion soak (--workload soak) -------------------------------
+
+def run_soak_config(nodes, pods, wave, epochs=None):
+    """Resource-exhaustion survival under multi-day churn, compressed
+    onto the virtual clock: every epoch retires a slice of nodes and
+    bound pods and joins replacements with FRESH hostnames, zone/label
+    values, and image names — the vocabulary-leak reproducer (interners
+    are append-only between compactions). The memory-governance plane
+    (HBM budget governor + cadence compaction, state/scrubber.py) must
+    hold every footprint flat. Gates (any violation FAILS the bench):
+
+      - vocab plateau: every interner's final size stays within a fixed
+        band of its post-warmup baseline (the un-compacted leak grows
+        linearly in epochs)
+      - HBM plateau: the projected device footprint ends <= 2x baseline
+      - host RSS: ru_maxrss grows < SOAK_MAX_RSS_MB past the warmup
+      - recompile plateau: jit cache misses after the first quarter of
+        epochs stay under SOAK_MAX_RECOMPILES (grow/shrink cycles must
+        re-use the bucketed shapes, not mint new ones)
+      - compaction parity: a probe wave's placements (by node NAME) are
+        bit-equal immediately before and after a forced mid-run
+        compaction
+      - capacity-fault storm: device.oom armed for a burst — ZERO
+        breaker trips, ZERO mesh reforms, ZERO pod convictions, every
+        storm pod placed
+      - zero cluster-invariant violations, and compactions actually ran
+    """
+    import resource as _resource
+    import time as _t
+
+    import numpy as np
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.chaos.invariants import InvariantChecker
+    from kubernetes_tpu.ops.encoding import Caps
+    from kubernetes_tpu.runtime.store import ObjectStore
+    from kubernetes_tpu.sched.scheduler import Scheduler
+    from kubernetes_tpu.state.vocab import bucket_size
+    from kubernetes_tpu.utils import faultpoints
+
+    SOAK_MAX_RSS_MB = 512       # backstop: a real leak grows unbounded
+    SOAK_MAX_RECOMPILES = 24    # post-warmup jit misses (shape churn)
+    SOAK_VOCAB_BAND = 64        # entries a vocab may drift past baseline
+    epochs = epochs or 48
+    churn_nodes = max(1, nodes // 8)
+    churn_pods = max(4, pods // (2 * epochs))
+
+    store = ObjectStore()
+    vclock = [1000.0]
+    caps = Caps(M=bucket_size(2 * pods + 64), P=wave,
+                LV=bucket_size(4 * nodes + 256, 64))
+    sched = Scheduler(store, wave_size=wave, caps=caps,
+                      clock=lambda: vclock[0],
+                      # cadence compaction every ~2 epochs of vclock; a
+                      # generous budget keeps the governor out of the
+                      # way unless a leak actually grows the footprint
+                      compact_interval=100.0,
+                      hbm_budget_bytes=256 * 1024 * 1024)
+    checker = InvariantChecker(metrics=sched.metrics, strict=False)
+    sched.invariants = checker
+
+    def _mk_node(i, epoch):
+        name = f"soak-{epoch}-{i}"
+        return api.Node(
+            metadata=api.ObjectMeta(name=name, labels={
+                api.LABEL_HOSTNAME: name,
+                api.LABEL_ZONE: f"zone-{epoch}-{i % 3}",
+                "soak/rev": f"r{epoch}",
+            }),
+            status=api.NodeStatus(
+                allocatable=api.resource_list(cpu="16", memory="32Gi",
+                                              pods=110),
+                conditions=[api.NodeCondition(type="Ready",
+                                              status="True")]))
+
+    def _mk_pod(name, epoch):
+        p = _base_pod(api, name, "soak",
+                      labels={"type": "soak", "rev": f"r{epoch}"})
+        p.spec.containers[0].image = f"registry.example/app:{epoch}.{name}"
+        return p
+
+    def _miss_count():
+        return sum(c.value
+                   for c in sched.metrics.device_jit_events.children()
+                   if 'event="miss"' in c.name)
+
+    def _twin_names(probe):
+        # non-committing placement probe through the numpy twin (the
+        # same replay the input-fault verdict uses): placements by node
+        # NAME, because compaction renumbers rows but must preserve
+        # relative order (argmax tie-breaks)
+        from kubernetes_tpu.ops import hostwave
+
+        gating, wvec, _wver = sched._weights_kw()
+        pb = sched.featurizer.featurize(probe)
+        nt, pm, tt = sched.snapshot.host_tensors()
+        extra = np.ones((pb.req.shape[0], nt.valid.shape[0]), bool)
+        res, _usage = hostwave.schedule_wave_host(
+            nt, pm, tt, pb, extra, sched._host_rr, None,
+            weights=gating, num_zones=sched.snapshot.caps.Z,
+            num_label_values=sched.snapshot.num_label_values,
+            has_ipa=False, weight_vec=wvec)
+        chosen = np.asarray(res.chosen)
+        return [sched.snapshot.node_names[c] if c >= 0 else None
+                for c in chosen[:len(probe)]]
+
+    # -- warmup: base cluster + first waves + a settling compaction ----------
+    node_ring = []  # (epoch, index) join order, oldest first
+    for i in range(nodes):
+        store.create("nodes", _mk_node(i, 0))
+        node_ring.append(f"soak-0-{i}")
+    for i in range(min(pods, 2 * wave)):
+        store.create("pods", _mk_pod(f"warm-{i}", 0))
+    t0 = _t.time()
+    sched._housekeep()
+    sched.schedule_pending()
+    sched.scrubber.compact(trigger="cadence", force=True)
+    base_vocabs = dict(sched.snapshot.vocabs.sizes())
+    base_hbm = sched.snapshot.projected_hbm_bytes()
+    base_rss_kb = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+    warm_misses = None  # sampled after the first quarter of epochs
+
+    storm = {"trips": 0.0, "reforms": 0.0, "convictions": 0,
+             "placed": 0, "pods": 0}
+    parity = None
+    seq = [0]
+    failures = []
+    try:
+        for epoch in range(1, epochs + 1):
+            vclock[0] += 60.0
+            # retire the oldest nodes (their pods go with them) and
+            # join fresh ones: new hostnames, new zone values, new rev
+            for name in node_ring[:churn_nodes]:
+                for p in store.list("pods"):
+                    if p.spec.node_name == name:
+                        try:
+                            store.delete("pods", p.metadata.namespace,
+                                         p.metadata.name)
+                        except KeyError:
+                            pass
+                try:
+                    store.delete("nodes", "default", name)
+                except KeyError:
+                    pass
+            node_ring = node_ring[churn_nodes:]
+            for i in range(churn_nodes):
+                store.create("nodes", _mk_node(i, epoch))
+                node_ring.append(f"soak-{epoch}-{i}")
+            # fresh pods with fresh labels + image names
+            for _ in range(churn_pods):
+                store.create("pods", _mk_pod(f"churn-{seq[0]}", epoch))
+                seq[0] += 1
+            sched._housekeep()
+            sched.schedule_pending()
+            if epoch == max(2, epochs // 4) and warm_misses is None:
+                warm_misses = _miss_count()
+            if epoch == epochs // 2:
+                # compaction parity: probe placements bit-equal across
+                # a forced sweep (pods NOT created in the store — the
+                # twin probe commits nothing)
+                probe = [_mk_pod(f"probe-{i}", epoch) for i in range(8)]
+                before = _twin_names(probe)
+                summary = sched.scrubber.compact(trigger="governor",
+                                                 force=True)
+                after = _twin_names(probe)
+                parity = (before == after, before, after,
+                          summary is not None)
+                # capacity-fault storm on the live path
+                trips0 = sched.metrics.device_path_trips.value
+                reforms0 = sched.metrics.mesh_reforms.total()
+                conv0 = sched.poison_convictions
+                storm_pods = [_mk_pod(f"storm-{i}", epoch)
+                              for i in range(16)]
+                for p in storm_pods:
+                    store.create("pods", p)
+                faultpoints.activate("device.oom", "raise", times=3)
+                try:
+                    sched._housekeep()
+                    sched.schedule_pending()
+                finally:
+                    faultpoints.deactivate("device.oom")
+                bound = {p.uid for p in store.list("pods")
+                         if p.spec.node_name}
+                storm = {
+                    "trips": sched.metrics.device_path_trips.value
+                             - trips0,
+                    "reforms": sched.metrics.mesh_reforms.total()
+                               - reforms0,
+                    "convictions": sched.poison_convictions - conv0,
+                    "placed": sum(1 for p in storm_pods
+                                  if p.uid in bound),
+                    "pods": len(storm_pods),
+                }
+    finally:
+        faultpoints.reset()
+    dt = _t.time() - t0
+
+    # -- the gates -------------------------------------------------------------
+    final_vocabs = sched.snapshot.vocabs.sizes()
+    final_hbm = sched.snapshot.projected_hbm_bytes()
+    rss_grow_mb = (_resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+                   - base_rss_kb) / 1024.0
+    compactions = sched.metrics.snapshot_compactions_total.total()
+    post_warm_misses = (_miss_count() - warm_misses
+                        if warm_misses is not None else 0.0)
+    for vocab, size in final_vocabs.items():
+        if size > base_vocabs.get(vocab, 0) + SOAK_VOCAB_BAND:
+            failures.append(
+                f"vocab {vocab} leaked: {base_vocabs.get(vocab)} -> "
+                f"{size} (band {SOAK_VOCAB_BAND})")
+    if final_hbm > 2 * base_hbm:
+        failures.append(f"HBM footprint grew {base_hbm} -> {final_hbm} "
+                        f"bytes (> 2x baseline)")
+    if rss_grow_mb > SOAK_MAX_RSS_MB:
+        failures.append(f"host RSS grew {rss_grow_mb:.0f} MB past the "
+                        f"warmup (> {SOAK_MAX_RSS_MB} MB)")
+    if post_warm_misses > SOAK_MAX_RECOMPILES:
+        failures.append(f"{post_warm_misses:.0f} post-warmup jit "
+                        f"recompiles (> {SOAK_MAX_RECOMPILES}: the "
+                        f"grow/shrink cycle is thrashing shapes)")
+    if compactions < 2:
+        failures.append(f"only {compactions:.0f} compaction(s) ran — "
+                        f"the cadence never engaged, the soak gated "
+                        f"nothing")
+    if parity is None or not parity[3]:
+        failures.append("mid-run forced compaction did not run "
+                        "(parity gate is a no-op)")
+    elif not parity[0]:
+        failures.append(f"placements diverged across the mid-run "
+                        f"compaction: {parity[1]} != {parity[2]}")
+    if storm["pods"] == 0:
+        failures.append("device.oom storm never ran")
+    if storm["trips"] != 0:
+        failures.append(f"device.oom storm tripped the breaker "
+                        f"{storm['trips']:.0f}x (capacity faults must "
+                        f"never convict the device path)")
+    if storm["reforms"] != 0:
+        failures.append(f"device.oom storm reformed the mesh "
+                        f"{storm['reforms']:.0f}x")
+    if storm["convictions"] != 0:
+        failures.append(f"device.oom storm convicted "
+                        f"{storm['convictions']} pod(s)")
+    if storm["pods"] and storm["placed"] != storm["pods"]:
+        failures.append(f"device.oom storm: only {storm['placed']}/"
+                        f"{storm['pods']} storm pods placed")
+    if checker.violations:
+        v = checker.violations[0]
+        failures.append(
+            f"{len(checker.violations)} cluster-invariant violation(s) "
+            f"across {checker.checks} checks — first: {v.invariant}: "
+            f"{v.detail}")
+    print(f"# soak: epochs={epochs} churn={churn_nodes}n/"
+          f"{churn_pods}p per epoch wall={dt:.2f}s "
+          f"compactions={compactions:.0f} "
+          f"vocabs={base_vocabs}->{final_vocabs} "
+          f"hbm={base_hbm}->{final_hbm} rss_grow={rss_grow_mb:.0f}MB "
+          f"recompiles_post_warm={post_warm_misses:.0f}", file=sys.stderr)
+    for f in failures:
+        print(f"FATAL: soak: {f}", file=sys.stderr)
+    if failures:
+        sched.close()
+        sys.exit(1)
+    sched.close()
+    return epochs, dt, compactions, final_hbm
+
+
 # -- heterogeneous topology workload (--workload hetero) ----------------------
 #
 # A rack/superpod/accel-gen labeled cluster (state/snapshot.py's dense
@@ -1766,6 +2042,13 @@ SUITE = [
     # and the spool must drain within 8 post-heal rounds with zero
     # double-binds, zero lost pods, and zero invariant violations
     ("outagestorm", 100, 400, "outagestorm", ["--wave", "64"]),
+    # resource-exhaustion soak: multi-day node/pod churn (fresh
+    # hostnames / zone values / images every epoch — the vocab-leak
+    # reproducer) compressed onto the virtual clock; gates vocab/HBM/
+    # RSS/recompile plateaus, a bit-equal probe wave across a forced
+    # compaction, and a device.oom storm surviving with zero breaker
+    # trips / mesh reforms / pod convictions
+    ("soak", 32, 256, "soak", ["--wave", "32"]),
     # heterogeneous topology: rack/superpod/accel-gen labeled cluster;
     # hard gates on DoNotSchedule zone skew (<= maxSkew, read back from
     # the store) and on gang rack-compactness beating the
@@ -1895,7 +2178,7 @@ def main():
                              "antiaffinity", "mixed", "gang", "preempt",
                              "trickle", "paced", "autoscale", "partition",
                              "degraded", "storm", "chaoscampaign",
-                             "outagestorm", "hetero"])
+                             "outagestorm", "soak", "hetero"])
     ap.add_argument("--trace", default=None,
                     choices=["burst", "diurnal", "gangstorm", "compound"],
                     help="storm workload: which synthetic arrival trace "
@@ -2061,6 +2344,26 @@ def main():
             "vs_baseline": (round(8.0 / heal_rounds, 2)
                             if heal_rounds > 0 else 0.0),
             "spool_peak": spool_peak,
+            "wall_s": round(dt, 2),
+        }
+        print(json.dumps(rec), flush=True)
+        return
+    if args.workload == "soak":
+        epochs, dt, compactions, final_hbm = run_soak_config(
+            args.nodes or 32, args.pods or 256, args.wave or 32)
+        name = args.name or "soak"
+        rec = {
+            # the headline is compactions per epoch — how often the
+            # memory-governance plane had to sweep to hold the
+            # footprints flat (the hard gates — vocab/HBM/RSS/recompile
+            # plateaus, probe parity across a compaction, zero-trip
+            # device.oom storm — already sys.exit(1)'d above)
+            "metric": f"scheduler_{name}_compactions_"
+                      f"{args.nodes or 32}n_{epochs}e",
+            "value": compactions,
+            "unit": "compactions",
+            "vs_baseline": round(compactions / epochs, 3),
+            "hbm_bytes": final_hbm,
             "wall_s": round(dt, 2),
         }
         print(json.dumps(rec), flush=True)

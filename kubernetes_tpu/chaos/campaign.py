@@ -76,6 +76,14 @@ SAMPLABLE: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # so a healthy build conserves without ever spooling — duration
     # outages are driven explicitly by the outage tests/bench
     ("store.outage", ("raise",)),
+    # capacity chaos: a raise here models HBM RESOURCE_EXHAUSTED at the
+    # dispatch seam — the capacity-fault ladder must compact and retry
+    # without a device conviction, a mesh reform, or a pod conviction
+    ("device.oom", ("raise",)),
+    # compaction chaos: a crash or stall at the compaction entry must
+    # leave the live snapshot untouched (the scratch rebuild only swaps
+    # in after it fully succeeds)
+    ("snapshot.compact", ("raise", "latency")),
 )
 
 # point-pairs with a history of interacting badly (ISSUE 17): a device
@@ -86,6 +94,10 @@ NASTY_PAIRS: Tuple[Tuple[Tuple[str, str], Tuple[str, str]], ...] = (
     (("device.lost", "raise"), ("wave.poison", "raise")),
     (("kernel.hang", "latency"), ("heartbeat.deliver", "drop")),
     (("bind.post", "raise"), ("lease.renew", "raise")),
+    # a capacity fault whose recovery compaction itself crashes: the
+    # ladder must salvage through the host twin (guarded compact),
+    # never wedge the round or trip the breaker via a false conviction
+    (("device.oom", "raise"), ("snapshot.compact", "raise")),
 )
 
 _LATENCY_ARGS = (0.005, 0.01, 0.02)
@@ -283,6 +295,11 @@ def replay(specs: Sequence[FaultSpec], seed: int, ticks: int = 8,
                       # jitter pin makes retry_at = trip + cooldown
                       # exactly, so outage recovery is tick-predictable
                       store_breaker_cooldown=2.0,
+                      # housekeeping compaction cadence: gives the
+                      # snapshot.compact chaos point a fire path in
+                      # schedules that churn rows (the oom ladder's
+                      # forced compactions fire it regardless)
+                      compact_interval=2.0,
                       bind_journal_path=journal_path)
         s.storehealth.jitter = lambda: 0.5
         return s

@@ -286,6 +286,8 @@ def build_scheduler(cfg: KubeSchedulerConfiguration, store,
                       features=features, mesh=mesh,
                       mesh_min_devices=cfg.mesh_min_devices,
                       scrub_interval=cfg.scrub_interval or None,
+                      compact_interval=cfg.compact_interval or None,
+                      hbm_budget_bytes=cfg.hbm_budget_bytes,
                       breaker_threshold=cfg.breaker_threshold,
                       breaker_cooldown=cfg.breaker_cooldown,
                       metrics=metrics,
@@ -475,6 +477,18 @@ def main(argv=None) -> int:
     ap.add_argument("--scrub-interval", type=float, default=None,
                     help="seconds between periodic snapshot scrubs "
                          "(0 disables the cadence; SIGUSR2 always works)")
+    ap.add_argument("--compact-interval", type=float, default=None,
+                    help="seconds between housekeeping snapshot "
+                         "compaction sweeps — shrink over-grown row "
+                         "buckets and rebuild the shared vocabularies "
+                         "from live objects (0 disables the cadence; "
+                         "OOM recovery and the HBM governor can still "
+                         "force one)")
+    ap.add_argument("--hbm-budget-bytes", type=int, default=None,
+                    help="projected device-memory budget in bytes: a "
+                         "snapshot grow that would exceed it compacts "
+                         "first instead of letting the backend throw "
+                         "RESOURCE_EXHAUSTED (0 = unbudgeted)")
     ap.add_argument("--healthz-port", type=int, default=None,
                     help="-1 disables; 0 picks a free port")
     ap.add_argument("--feature-gates", default="",
@@ -579,6 +593,10 @@ def main(argv=None) -> int:
         cfg.mesh_min_devices = args.mesh_min_devices
     if args.scrub_interval is not None:
         cfg.scrub_interval = args.scrub_interval
+    if args.compact_interval is not None:
+        cfg.compact_interval = args.compact_interval
+    if args.hbm_budget_bytes is not None:
+        cfg.hbm_budget_bytes = args.hbm_budget_bytes
     if args.healthz_port is not None:
         cfg.healthz_port = args.healthz_port
     if args.tracing:

@@ -259,6 +259,7 @@ def record_dispatch(program: str, bucket_key: tuple, fn):
         # budget once — benign in the safe direction.)
         faultpoints.fire("kernel.hang")
         faultpoints.fire("device.lost", payload=_DEVICES or None)
+        faultpoints.fire("device.oom", payload=_DEVICES or None)
         return fn()
     key = (program,) + bucket_key
     miss = key not in _COMPILED
@@ -270,6 +271,9 @@ def record_dispatch(program: str, bucket_key: tuple, fn):
         # runs across, so a corrupt-mode lost_device_fault fires only
         # while its victim is still in the active mesh
         faultpoints.fire("device.lost", payload=_DEVICES or None)
+        # capacity chaos: an HBM RESOURCE_EXHAUSTED at the dispatch —
+        # classified as a capacity fault upstream, never a device fault
+        faultpoints.fire("device.oom", payload=_DEVICES or None)
         return inner()
 
     if wd is not None and wd.armed():

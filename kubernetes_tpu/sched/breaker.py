@@ -163,6 +163,64 @@ def lost_device_fault(device: str):
     return fn
 
 
+class ResourceExhausted(RuntimeError):
+    """Device allocation failure — the capacity-fault class. Raised by
+    the `device.oom` fault point in chaos tests, and the shape a real
+    XLA RESOURCE_EXHAUSTED / allocation-site MemoryError is classified
+    into by is_capacity_error. NOT a device fault: no device is sick,
+    the working set is too big — the remedy is compaction, a smaller
+    wave, or the host twin, never quarantine or a mesh reform."""
+
+
+def oom_fault(message: str = "RESOURCE_EXHAUSTED: out of memory "
+                             "while trying to allocate"):
+    """corrupt-mode fn for the `device.oom` fault point — the
+    lost_device_fault analog for capacity faults: raises
+    ResourceExhausted at the dispatch seam (ops/kernel.py
+    record_dispatch passes the active device-name tuple as payload).
+    A None payload (no device registration) is a no-op, matching
+    lost_device_fault's contract:
+
+        faultpoints.activate("device.oom", "corrupt", fn=oom_fault())
+    """
+
+    def fn(payload):
+        if payload is None:
+            return
+        raise ResourceExhausted(message)
+
+    return fn
+
+
+# markers an XLA/runtime allocation failure embeds in its message; the
+# gRPC status name is what real TPU runtimes surface. "device.oom"
+# covers the raise-mode FaultInjected of that point ("fault injected at
+# 'device.oom'"), so KTPU_FAULTPOINTS="device.oom=raise" is a
+# paste-able capacity-chaos reproducer without a custom corrupt fn.
+_CAPACITY_MARKERS = ("RESOURCE_EXHAUSTED", "resource exhausted",
+                     "out of memory", "OOM when allocating",
+                     "device.oom")
+
+
+def is_capacity_error(exc: BaseException) -> bool:
+    """True when the exception chain is a capacity miss — an
+    allocation-site MemoryError, a ResourceExhausted, or an error whose
+    text carries an XLA RESOURCE_EXHAUSTED marker. Walks __cause__/
+    __context__ like MeshFaultManager.attribute: jax wraps backend
+    errors, and the classification must see through the wrapping."""
+    seen = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, (MemoryError, ResourceExhausted)):
+            return True
+        text = str(e)
+        if any(m in text for m in _CAPACITY_MARKERS):
+            return True
+        e = e.__cause__ or e.__context__
+    return False
+
+
 def device_name_hits(names, text: str):
     """Device names appearing in `text` as exact tokens — a name
     followed by another digit is a DIFFERENT device's id ('TPU_1'

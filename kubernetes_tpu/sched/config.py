@@ -55,6 +55,16 @@ class KubeSchedulerConfiguration:
     scrub_interval: float = 0.0
     breaker_threshold: int = 3
     breaker_cooldown: float = 30.0
+    # memory-governance plane (state/scrubber.py compaction +
+    # state/snapshot.py HBM budget governor — the kubelet
+    # eviction-manager analog for device memory): cadence in seconds
+    # between housekeeping compaction sweeps (0 disables the cadence;
+    # the OOM-recovery ladder and the governor can still force one)
+    # and the projected-HBM budget in bytes above which a snapshot
+    # grow compacts first instead of letting the backend throw
+    # RESOURCE_EXHAUSTED (0 = unbudgeted)
+    compact_interval: float = 0.0
+    hbm_budget_bytes: int = 0
     # bind reconciler: POST attempts per bind before the GET-based
     # succeeded-but-response-lost resolution kicks in
     bind_max_attempts: int = 3

@@ -50,7 +50,7 @@ from ..utils import (Metrics, PodBackoff, Trace, bounded_label, faultpoints,
 from ..utils.watchdog import DispatchTimeout
 from ..utils.feature_gates import FeatureGates
 from . import breaker as breaker_mod
-from .breaker import STATE_CODES, DevicePathBreaker
+from .breaker import STATE_CODES, DevicePathBreaker, is_capacity_error
 from .equivalence import EquivalenceCache, equivalence_class
 from .errors import (REASON_KEYS, REASONS, FitError, PoisonError,
                      insufficient_resource_reason)
@@ -162,6 +162,8 @@ class Scheduler:
                  assume_ttl: float = 30.0, caps=None, mesh=None,
                  bind_workers: int = 4,
                  scrub_interval: Optional[float] = None,
+                 compact_interval: Optional[float] = None,
+                 hbm_budget_bytes: int = 0,
                  breaker_threshold: int = 3, breaker_cooldown: float = 30.0,
                  store_breaker_threshold: int = 3,
                  store_breaker_cooldown: float = 30.0,
@@ -199,6 +201,12 @@ class Scheduler:
         self._mu = threading.RLock()
         self.cache = SchedulerCache(ttl=assume_ttl, clock=clock)
         self.snapshot = Snapshot(caps=caps)
+        # HBM budget governor: 0 = unlimited (no budget). When set, any
+        # _grow that would push the projected device footprint over the
+        # budget demands a compaction (the kubelet eviction-manager
+        # analog for the scheduler's own memory plane) instead of
+        # letting XLA throw RESOURCE_EXHAUSTED mid-wave.
+        self.snapshot.hbm_budget_bytes = int(hbm_budget_bytes)
         self.featurizer = PodFeaturizer(self.snapshot, GroupLister(store))
         # overload control: the queue's priority-aware shed plane
         # (sched/queue.py "Overload control") — watermark 0 keeps it off
@@ -280,7 +288,15 @@ class Scheduler:
         # never interleave with a wave's upload.
         self.scrubber = SnapshotScrubber(
             self.cache, self.snapshot, metrics=self.metrics, clock=clock,
-            period=scrub_interval, lock=self._mu)
+            period=scrub_interval, lock=self._mu,
+            compact_period=compact_interval)
+        # capacity-fault strike ladder (RESOURCE_EXHAUSTED / MemoryError
+        # at the device boundary — never a device conviction, never a
+        # mesh reform, never a pod conviction): strike 1 compacts and
+        # retries, strike 2 additionally halves the adaptive wave cap,
+        # strike 3 salvages the round through the host twin. Reset on
+        # any successful device round.
+        self._capacity_strikes = 0
         # device-path circuit breaker: consecutive device failures route
         # whole waves through the exact host path until a half-open
         # probe succeeds; recovery forces a full snapshot rebuild
@@ -1279,6 +1295,19 @@ class Scheduler:
             self.poison_backoff.gc()
         self.export_queue_gauges()
         self.scrubber.maybe_scrub()
+        # memory governance: compact when the HBM governor demanded it
+        # (an over-budget _grow) or the cadence elapsed with removals
+        # outstanding — the vocab mark-and-sweep + bucket shrink that
+        # bounds a long-lived scheduler's footprint under churn. A
+        # compaction crash (the snapshot.compact chaos point) costs the
+        # compaction, never the housekeeping pass: the live snapshot
+        # only swaps in after the scratch rebuild fully succeeds.
+        try:
+            self.scrubber.maybe_compact()
+        except Exception as ce:
+            logging.getLogger(__name__).error(
+                "housekeeping compaction failed (live snapshot "
+                "unchanged): %s: %s", type(ce).__name__, ce)
         # mesh fault plane: probe quarantined devices past their
         # cooldown and reform upward when one heals
         self._maybe_heal_mesh()
@@ -1317,6 +1346,14 @@ class Scheduler:
         # accrued since the last export (snapshot counts, the registry
         # exposes)
         self.metrics.snapshot_hbm_bytes.set(self.snapshot.hbm_bytes())
+        # memory governance: budget headroom (only meaningful with a
+        # budget configured — without one the gauge stays 0) and the
+        # per-interner vocabulary sizes the soak gate watches for leaks
+        headroom = self.snapshot.hbm_headroom_bytes()
+        if headroom is not None:
+            self.metrics.hbm_headroom_bytes.set(headroom)
+        for vocab, size in self.snapshot.vocabs.sizes().items():
+            self.metrics.snapshot_vocab_size.labels(vocab=vocab).set(size)
         per_dev = self.snapshot.hbm_bytes_per_device()
         for dev, b in per_dev.items():
             self.metrics.snapshot_hbm_device_bytes.labels(device=dev).set(b)
@@ -1617,6 +1654,17 @@ class Scheduler:
                         rec.end_round(rt, outcome="input_fault")
                     return 0
                 waves = [pods[i:i + W] for i in range(0, len(pods), W)]
+            except Exception as e:
+                # an allocation-site MemoryError (state/featurize.py
+                # deliberately propagates it raw — environmental, not
+                # spec-caused) is a CAPACITY fault at the round
+                # boundary: compact and retry rather than crash the
+                # scheduling loop or convict the pod that happened to
+                # be featurizing when memory ran out
+                if not is_capacity_error(e):
+                    raise
+                return self._capacity_fault(pods, e, rt, rec,
+                                            self._run_pipeline)
         pbs = []
         try:
             for wv, pb_w in zip(waves, pass1):
@@ -1784,6 +1832,17 @@ class Scheduler:
                 chosen_all, rr_end, deco_all, fin_all = _attempt(False)
             self._last_path = "pallas" if round_pallas else "xla"
         except Exception as e:
+            # capacity-fault attribution FIRST: a device OOM replays
+            # clean on the host twin, so the input-fault verdict would
+            # misclassify it as a device fault — and the scheduler's
+            # own footprint must never convict a device, reform the
+            # mesh, or convict a pod (sched/breaker.py
+            # is_capacity_error walks the cause chain)
+            if is_capacity_error(e):
+                for p in pods:
+                    self.snapshot.unstage(p)
+                return self._capacity_fault(pods, e, rt, rec,
+                                            self._run_pipeline)
             # input-fault attribution BEFORE breaker/reform accounting:
             # bad work must never blame (or reform) the runtime
             verdict = self._input_fault_verdict(pods, e)
@@ -1820,6 +1879,7 @@ class Scheduler:
                 self.queue.add_if_not_present(p)
             return 0
         self.breaker.record_success()
+        self._capacity_strikes = 0
         # numeric-integrity sentinel, fetched with the round's chosen
         # planes: any non-finite row means a poison pod contaminated the
         # scan's shared usage carry — DISCARD the whole round (a NaN
@@ -2488,6 +2548,84 @@ class Scheduler:
             type(exc).__name__, exc, exc_info=exc)
         return reformed
 
+    def _capacity_fault(self, pods: List[api.Pod], exc: BaseException,
+                        rt, rec, retry_fn) -> int:
+        """Capacity-fault recovery ladder (RESOURCE_EXHAUSTED /
+        MemoryError at the device boundary). A capacity fault is the
+        scheduler's OWN footprint outgrowing the device — never the
+        device's fault and never the work's, so it must not convict a
+        device, reform the mesh, or convict a pod. Strike 1 compacts
+        the snapshot (vocab mark-and-sweep + bucket shrink,
+        state/scrubber.py) and retries; strike 2 additionally halves
+        the adaptive wave cap (floor MIN_ADAPTIVE_WAVE); strike 3
+        salvages the round through the hostwave twin, which needs no
+        device memory at all. The breaker sees a failure ONLY when
+        compaction itself cannot restore headroom (budget configured
+        and still exceeded after the sweep). Strikes reset on the next
+        successful device round."""
+        self._capacity_strikes += 1
+        strike = self._capacity_strikes
+        self.metrics.capacity_faults.inc()
+        logging.getLogger(__name__).warning(
+            "capacity fault (strike %d), compacting: %s: %s", strike,
+            type(exc).__name__, exc)
+        summary = self._compact_guarded(trigger="oom")
+        if strike >= 2:
+            # same floor discipline as _account_host_overrun: a
+            # scheduler configured below the adaptive floor must never
+            # have a fault RAISE its wave
+            self._wave_cap = max(self._wave_cap // 2,
+                                 min(self.MIN_ADAPTIVE_WAVE,
+                                     self.wave_size))
+            self.metrics.effective_wave_size.set(self._wave_cap)
+        headroom = self.snapshot.hbm_headroom_bytes()
+        exhausted = headroom is not None and headroom < 0
+        if exhausted:
+            # compaction could not restore headroom: only now does the
+            # fault feed the breaker — threshold trips route waves
+            # through the host twin until a half-open probe clears
+            self.breaker.record_failure()
+        if rt is not None:
+            rec.end_round(rt, outcome="capacity_fault",
+                          error=type(exc).__name__,
+                          memory=self._memory_ledger())
+        if strike >= 3 or summary is None or exhausted:
+            # third strike, compaction deferred (staged rows held by a
+            # concurrent round), or budget still exceeded: salvage the
+            # round host-side instead of burning another dispatch
+            return self._schedule_degraded(pods)
+        return retry_fn(pods)
+
+    def _compact_guarded(self, trigger: str):
+        """scrubber.compact hardened for the scheduling loop: a crash
+        inside compaction (the `snapshot.compact` chaos point, or a
+        real bug) must cost the compaction, never the round — the live
+        snapshot is untouched until the scratch rebuild fully succeeds
+        (state/snapshot.py _compact swaps in at the end), so failure
+        here just means no shrink happened. Returns the summary, or
+        None when compaction failed or was deferred."""
+        try:
+            return self.scrubber.compact(trigger=trigger, force=True)
+        except Exception as ce:
+            logging.getLogger(__name__).error(
+                "snapshot compaction failed (live snapshot unchanged): "
+                "%s: %s", type(ce).__name__, ce)
+            return None
+
+    def _memory_ledger(self) -> Dict:
+        """Round-ledger `memory` record: {hbm_bytes, budget, headroom,
+        vocabs, compactions, capacity_strikes}. headroom is None when
+        no budget is configured."""
+        return {
+            "hbm_bytes": int(self.snapshot.projected_hbm_bytes()),
+            "budget": int(self.snapshot.hbm_budget_bytes),
+            "headroom": self.snapshot.hbm_headroom_bytes(),
+            "vocabs": self.snapshot.vocabs.sizes(),
+            "compactions": int(
+                self.metrics.snapshot_compactions_total.total()),
+            "capacity_strikes": int(self._capacity_strikes),
+        }
+
     def _maybe_reform(self, exc: BaseException) -> bool:
         """One ladder step down: attribute the failure to a device (the
         exception names one — sched/breaker.py DeviceLost or an XLA
@@ -2931,7 +3069,16 @@ class Scheduler:
             self._trace_queue_waits(rt, pods)
             if golden:
                 rt.ledger["golden"] = golden
-        pb, pods = self._featurize_guarded(pods)
+        try:
+            pb, pods = self._featurize_guarded(pods)
+        except Exception as e:
+            # allocation-site MemoryError routed into the capacity
+            # verdict (see _run_pipeline's featurize loop) instead of
+            # propagating raw out of the scheduling loop
+            if not is_capacity_error(e):
+                raise
+            return placed_host + self._capacity_fault(pods, e, rt, rec,
+                                                      self._run_wave)
         if not pods:
             # the whole wave was convicted at featurize time
             if rt is not None:
@@ -3060,6 +3207,12 @@ class Scheduler:
                     self._use_pallas = True
                     raise
         except Exception as e:
+            # capacity-fault attribution FIRST (see _run_pipeline's
+            # catch): the scheduler's own footprint must never blame
+            # the device or the work
+            if is_capacity_error(e):
+                return placed_host + self._capacity_fault(
+                    pods, e, rt, rec, self._run_wave)
             # input-fault attribution BEFORE breaker/reform accounting:
             # bad work must never blame — or degrade — the runtime
             verdict = self._input_fault_verdict(pods, e)
@@ -3081,6 +3234,7 @@ class Scheduler:
             # record already ledgered it at begin_round
             return placed_host + self._schedule_degraded(pods)
         self.breaker.record_success()
+        self._capacity_strikes = 0
         self._last_path = "pallas" if self._use_pallas else "xla"
         chosen = np.asarray(res.chosen)
         fin = np.asarray(res.finite)
@@ -3538,6 +3692,21 @@ class Scheduler:
                     self._use_pallas = True
                     raise
         except Exception as e:
+            # capacity-fault attribution first (see _run_pipeline's
+            # catch): compact and salvage the gang through the host
+            # twin's all-or-nothing plane — never a device conviction,
+            # mesh reform, or gang quarantine for the scheduler's own
+            # footprint
+            if is_capacity_error(e):
+                self._capacity_strikes += 1
+                self.metrics.capacity_faults.inc()
+                self._compact_guarded(trigger="oom")
+                if rt is not None:
+                    rt.ledger.update(outcome="capacity_fault",
+                                     error=type(e).__name__,
+                                     memory=self._memory_ledger())
+                return placed + self._schedule_degraded_gang(key, members,
+                                                             rt)
             # input-fault attribution first: a poisoned member must
             # quarantine its gang, never feed the breaker or the ladder
             verdict = self._input_fault_verdict(members, e)
@@ -3564,6 +3733,7 @@ class Scheduler:
                 self._park_with_backoff(p)
             return placed
         self.breaker.record_success()
+        self._capacity_strikes = 0
         self._last_path = "pallas" if self._use_pallas else "xla"
         self.metrics.waves_total.labels(path="device").inc()
         if rt is not None:

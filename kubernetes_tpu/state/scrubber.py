@@ -43,7 +43,8 @@ import numpy as np
 from ..api import types as api
 from ..utils import faultpoints
 from .node_info import NodeInfo, Resource
-from .snapshot import Snapshot
+from .snapshot import SNAPSHOT_DIMS, Snapshot
+from .vocab import VocabSet
 
 
 @dataclass
@@ -111,16 +112,20 @@ class SnapshotScrubber:
     def __init__(self, cache, snapshot: Snapshot, metrics=None,
                  clock: Callable[[], float] = time.monotonic,
                  period: Optional[float] = None,
-                 lock: Optional[threading.RLock] = None):
+                 lock: Optional[threading.RLock] = None,
+                 compact_period: Optional[float] = None):
         self.cache = cache
         self.snapshot = snapshot
         self.metrics = metrics
         self.clock = clock
         self.period = period  # None/0 disables the cadence trigger
+        self.compact_period = compact_period  # None/0 disables cadence
         self._lock = lock or threading.RLock()
         self._requested = False
         self._last_run = clock()
+        self._last_compact = clock()
         self.last_report: Optional[ScrubReport] = None
+        self.last_compaction: Optional[dict] = None
 
     # -- triggers -------------------------------------------------------------
 
@@ -408,3 +413,111 @@ class SnapshotScrubber:
             live.dirty_resources = live.dirty_topology = True
             live.dirty_pods = True
             live._device_cache.clear()
+
+    # -- compaction (vocab mark-and-sweep + row/bucket shrink) ----------------
+
+    def compact_due(self) -> bool:
+        """Governor demand, or the cadence elapsed with something to
+        reclaim (row removals since the last compaction — churn is the
+        only way vocab garbage accrues)."""
+        live = self.snapshot
+        if live.compaction_requested:
+            return True
+        return bool(self.compact_period) and \
+            live.removals_since_compact > 0 and \
+            self.clock() - self._last_compact >= self.compact_period
+
+    def maybe_compact(self) -> Optional[dict]:
+        """Run a compaction if the governor demanded one or the cadence
+        elapsed. Called from the scheduler's housekeeping step."""
+        if not self.compact_due():
+            return None
+        if self.snapshot.compaction_requested:
+            # governor demand: reclaiming HBM outranks jit-cache
+            # stability, so any smaller bucket is taken
+            return self.compact(trigger="governor", force=True)
+        return self.compact(trigger="cadence")
+
+    def compact(self, trigger: str = "cadence",
+                force: bool = False) -> Optional[dict]:
+        """Vocab mark-and-sweep + row compaction: rebuild a scratch
+        snapshot from host truth against a FRESH VocabSet (only strings
+        live objects still reference survive), then adopt it into the
+        live snapshot in place (Snapshot._compact — array swap, vocab
+        adopt, generation bump, full re-upload). Returns a summary
+        dict, or None when deferred (staged rows outstanding: device
+        kernels hold staged row indices mid-round, so the request is
+        parked for the next housekeeping pass)."""
+        live = self.snapshot
+        with self._lock:
+            # the chaos seam fires BEFORE entering suppressed() — a
+            # raise/latency-mode fault must be able to hit the
+            # housekeeping path like any other subsystem
+            faultpoints.fire("snapshot.compact", payload=(live, trigger))
+            if live.has_staged_rows():
+                live.compaction_requested = True
+                return None
+            start = self.clock()
+            with faultpoints.suppressed():
+                before = live.vocabs.sizes()
+                before_hbm = live.projected_hbm_bytes()
+                scratch = self._compact_scratch()
+                shrunk = live._compact(scratch, force=force)
+            summary = {
+                "trigger": trigger,
+                "shrunk": shrunk,
+                "vocabs_before": before,
+                "vocabs_after": live.vocabs.sizes(),
+                "hbm_before": before_hbm,
+                "hbm_after": live.projected_hbm_bytes(),
+                "duration": self.clock() - start,
+            }
+        self._last_compact = self.clock()
+        self.last_compaction = summary
+        if self.metrics is not None:
+            self.metrics.snapshot_compactions_total.labels(
+                trigger=trigger).inc()
+        return summary
+
+    def _compact_scratch(self) -> Snapshot:
+        """Scratch snapshot re-featurized from the host cache against a
+        fresh VocabSet, with every snapshot-owned Caps dim reset to its
+        floor so the rebuild discovers the minimal buckets. Node rows
+        keep the live snapshot's relative index order and pod rows the
+        live slot order: row order feeds every argmax tie-break, so
+        preserving it is what makes placements bit-equal across the
+        compaction."""
+        live = self.snapshot
+        caps = dataclasses.replace(live.caps)
+        floors = type(live.caps)()
+        for d in SNAPSHOT_DIMS:
+            setattr(caps, d, getattr(floors, d))
+        scratch = Snapshot(vocabs=VocabSet(), caps=caps)
+        placed = set()
+        for idx, name in enumerate(live.node_names):
+            if live.node_index.get(name) != idx:
+                continue  # freed row whose name was never overwritten
+            ni = self.cache.node_infos.get(name)
+            if ni is not None and ni.node is not None:
+                scratch.set_node(ni)
+                placed.add(name)
+        for name, ni in self.cache.node_infos.items():
+            # host truth the live snapshot never saw (possible only
+            # between an event and its apply; harmless to include)
+            if name not in placed and ni.node is not None:
+                scratch.set_node(ni)
+        pods_by_uid = {}
+        for _name, ni in self.cache.node_infos.items():
+            for pod in ni.pods:
+                pods_by_uid[pod.uid] = pod
+        added = set()
+        for uid, _slot in sorted(live.pod_slot.items(),
+                                 key=lambda kv: kv[1]):
+            pod = pods_by_uid.get(uid)
+            if pod is not None:
+                scratch.add_pod(pod)
+                added.add(uid)
+        for uid, pod in pods_by_uid.items():
+            if uid not in added:
+                scratch.add_pod(pod)
+        return scratch

@@ -53,6 +53,23 @@ def _parse_label_num(v: str) -> float:
 DELTA_MAX_FRACTION = 0.5
 DELTA_MIN_ROWS = 16
 
+# Caps dims the snapshot itself grows — and a compaction may shrink.
+# Every other Caps dim (P, UI, the pod-batch dims...) belongs to the
+# featurizer/wave plane and is never touched by _compact.
+SNAPSHOT_DIMS = ("N", "Z", "K", "KP", "R", "T", "PP", "NI", "M", "E",
+                 "TE", "TV", "TNS", "LV")
+
+# every numpy plane _grow pads and _compact adopts, in _grow order
+SNAPSHOT_ARRAYS = (
+    "alloc", "requested", "nonzero", "pod_count", "allowed_pods",
+    "labels", "label_nums", "taint_key", "taint_val", "taint_effect",
+    "cond", "ports", "zone_id", "rack_id", "superpod_id", "accel_gen",
+    "img_id", "img_size", "avoid", "valid",
+    "ep_labels", "ep_ns", "ep_node", "ep_valid", "ep_alive", "ep_req",
+    "ep_prio",
+    "t_kind", "t_owner", "t_node", "t_tk", "t_weight", "t_ns", "t_key",
+    "t_op", "t_vals", "t_valid")
+
 _ROW_UPDATE = None
 
 
@@ -123,6 +140,16 @@ class Snapshot:
         self._group_sharded: Dict[str, bool] = {}
         self._mesh_devices: List[str] = []
         self._node_shards = 1
+        # HBM budget governor: 0 = unlimited. A _grow that pushes the
+        # projected footprint past the budget sets compaction_requested
+        # (the growth itself proceeds — the rows must land somewhere)
+        # and the scheduler's housekeeping compacts before the next
+        # round commits the bigger footprint for good.
+        self.hbm_budget_bytes = 0
+        self.compaction_requested = False
+        # node/pod row removals since the last compaction — the cadence
+        # trigger's "is there anything to reclaim" signal
+        self.removals_since_compact = 0
 
     def _mark_rows(self, group: str, *rows: int) -> None:
         self._dirty_rows[group].update(rows)
@@ -159,6 +186,29 @@ class Snapshot:
         for g, b in self._group_bytes.items():
             per += b // self._node_shards if self._group_sharded.get(g) else b
         return {d: per for d in self._mesh_devices}
+
+    def projected_hbm_bytes(self) -> int:
+        """What the device mirror will occupy after the next full
+        upload, computed from the HOST arrays under the same sharding
+        accounting as hbm_bytes() — the governor's check input.
+        hbm_bytes() lags until an upload actually lands; a budget check
+        against it would admit one over-budget round first."""
+        ndev = max(len(self._mesh_devices), 1)
+        total = 0
+        for g in ("res", "topo", "pods", "terms"):
+            b = sum(int(a.nbytes) for a in self._group_host(g))
+            if ndev > 1:
+                b = (b * (ndev // self._node_shards)
+                     if self._group_sharded.get(g) else b * ndev)
+            total += b
+        return total
+
+    def hbm_headroom_bytes(self) -> Optional[int]:
+        """Budget minus projected footprint (negative = over budget),
+        None when no budget is configured."""
+        if not self.hbm_budget_bytes:
+            return None
+        return self.hbm_budget_bytes - self.projected_hbm_bytes()
 
     # ---- allocation / growth ----------------------------------------------
 
@@ -269,6 +319,80 @@ class Snapshot:
         self.dirty_resources = self.dirty_topology = self.dirty_pods = True
         for rows in self._dirty_rows.values():
             rows.clear()
+        # HBM budget governor: over-budget growth demands a compaction
+        # instead of letting the next upload hit XLA's allocator
+        if self.hbm_budget_bytes and \
+                self.projected_hbm_bytes() > self.hbm_budget_bytes:
+            self.compaction_requested = True
+
+    def has_staged_rows(self) -> bool:
+        """True while any pipeline-staged pod row is outstanding. A
+        compaction renumbers every row index, but the device kernels
+        hold staged pm_rows/term_rows by INDEX mid-round — compacting
+        under them would scatter placements into the wrong rows, so
+        callers must defer (or unstage first)."""
+        return any(sig[0] == "staged" for sig in self._pod_sig.values())
+
+    def _compact(self, scratch: "Snapshot", force: bool = False
+                 ) -> Dict[str, Tuple[int, int]]:
+        """Adopt a freshly-rebuilt scratch snapshot in place — the
+        inverse of _grow. The scratch (built by the scrubber's
+        golden-row machinery against a FRESH VocabSet) holds the same
+        live rows densely renumbered with freshly-assigned vocab ids;
+        this commit step swaps its arrays, registries, and vocabularies
+        into the live snapshot.
+
+        Shrink hysteresis: a dim only shrinks when its rebuilt bucket
+        is at most HALF the current one — at least one power-of-two
+        step of slack beyond the grow threshold, so a grow right after
+        a cadence compaction can't thrash the jit cache. force=True
+        (governor/OOM demand) takes any smaller bucket: reclaiming HBM
+        outranks a retrace. Dims that don't shrink are re-grown on the
+        scratch to the live bucket first, keeping shapes_key stable.
+
+        Returns {dim: (old, new)} for every dim that shrank. Vocab
+        identity is preserved (adopt_all rewrites contents in place)
+        and the generation bump invalidates every featurizer cache."""
+        assert not self.has_staged_rows(), \
+            "compaction with staged rows outstanding"
+        regrow: Dict[str, int] = {}
+        shrunk: Dict[str, Tuple[int, int]] = {}
+        for d in SNAPSHOT_DIMS:
+            cur = getattr(self.caps, d)
+            tgt = getattr(scratch.caps, d)
+            if tgt >= cur:
+                continue
+            if (tgt < cur) if force else (tgt * 2 <= cur):
+                shrunk[d] = (cur, tgt)
+            else:
+                regrow[d] = cur
+        if regrow:
+            scratch._grow(**regrow)
+        self.vocabs.adopt_all(scratch.vocabs)
+        for d in SNAPSHOT_DIMS:
+            setattr(self.caps, d, getattr(scratch.caps, d))
+        for name in SNAPSHOT_ARRAYS:
+            setattr(self, name, getattr(scratch, name))
+        self.node_index = dict(scratch.node_index)
+        self.node_names = list(scratch.node_names)
+        self._free_nodes = list(scratch._free_nodes)
+        self.pod_slot = dict(scratch.pod_slot)
+        self._free_slots = list(scratch._free_slots)
+        self._next_slot = scratch._next_slot
+        self.term_rows = {uid: list(rows)
+                          for uid, rows in scratch.term_rows.items()}
+        self._free_terms = list(scratch._free_terms)
+        self._next_term = scratch._next_term
+        self._pod_sig = dict(scratch._pod_sig)
+        # everything the device holds is now stale: full re-upload
+        self.dirty_resources = self.dirty_topology = self.dirty_pods = True
+        for rows in self._dirty_rows.values():
+            rows.clear()
+        self._device_cache.clear()
+        self._group_bytes.clear()
+        self.compaction_requested = False
+        self.removals_since_compact = 0
+        return shrunk
 
     # ---- resource columns ---------------------------------------------------
 
@@ -392,6 +516,12 @@ class Snapshot:
     def remove_node(self, name: str):
         idx = self.node_index.pop(name, None)
         if idx is not None:
+            # sweep hook: the row is freed but every label/zone/rack/
+            # image string this node interned stays in the vocabularies
+            # until a compaction rebuilds them — count the garbage so
+            # the housekeeping cadence knows a sweep has something to
+            # reclaim (the append-only vocab leak, ISSUE 20)
+            self.removals_since_compact += 1
             self.valid[idx] = False
             self._free_nodes.append(idx)
             # Drop this node's rows from the pod matrix so a future node
@@ -562,6 +692,7 @@ class Snapshot:
         slot = self.pod_slot.pop(uid, None)
         self._pod_sig.pop(uid, None)
         if slot is not None:
+            self.removals_since_compact += 1
             self.ep_valid[slot] = False
             self.ep_alive[slot] = False
             self._free_slots.append(slot)

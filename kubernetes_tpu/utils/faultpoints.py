@@ -32,6 +32,19 @@ Wired points (grep for `faultpoints.fire`):
                    payload, so a reformed mesh stops failing and only
                    the victim's probes fail; a plain `raise` models an
                    unattributed device loss (the bisection path)
+  device.oom       ops/kernel.py record_dispatch, inside the guarded
+                   dispatch (next to device.lost; payload: the active
+                   mesh device-name tuple). The capacity-fault seam: a
+                   `raise` (or `corrupt` with sched.breaker.oom_fault()
+                   raising ResourceExhausted) models an HBM
+                   RESOURCE_EXHAUSTED — the scheduler must classify it
+                   as a capacity fault (compact, halve the wave, host
+                   twin), NEVER convict a device or reform the mesh
+  snapshot.compact state/scrubber.py compact entry, BEFORE the
+                   fault-suppressed rebuild (payload: (snapshot,
+                   trigger)) — a `raise` fails the housekeeping
+                   compaction; `latency` models a slow sweep holding
+                   the scheduler lock
   mesh.reform      sched/scheduler.py _maybe_reform, BEFORE the new mesh
                    is built — a `raise` fails the reform so the failure
                    falls through to the whole-path breaker (host-twin

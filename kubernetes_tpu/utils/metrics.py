@@ -428,6 +428,25 @@ class Metrics:
             "snapshot_hbm_bytes_per_device", ("device",),
             open_labels=("device",))
         self.snapshot_upload_bytes = Counter("snapshot_upload_bytes_total")
+        # memory-governance plane (ISSUE 20): per-vocabulary interner
+        # sizes (the closed label set IS VocabSet.NAMES — the soak
+        # harness gates on every child plateauing under node churn),
+        # HBM budget headroom (budget - projected footprint; negative =
+        # over budget, only exported when a budget is configured),
+        # compactions by trigger, and round-boundary capacity faults
+        # (RESOURCE_EXHAUSTED / MemoryError classified as
+        # capacity, not device faults)
+        self.snapshot_vocab_size = LabeledGauge(
+            "snapshot_vocab_size", ("vocab",),
+            values={"vocab": ("label_keys", "label_values", "taint_keys",
+                              "taint_values", "resources", "ports",
+                              "namespaces", "zones", "images",
+                              "pod_label_keys")})
+        self.hbm_headroom_bytes = Gauge("scheduler_hbm_headroom_bytes")
+        self.snapshot_compactions_total = LabeledCounter(
+            "snapshot_compactions_total", ("trigger",),
+            values={"trigger": ("cadence", "governor", "oom")})
+        self.capacity_faults = Counter("scheduler_capacity_faults_total")
         self.device_fetch_bytes = Counter("device_fetch_bytes_total")
         # mesh fault tolerance (sched/breaker.py MeshFaultManager +
         # parallel/mesh.py reform_mesh): how many devices the scheduling

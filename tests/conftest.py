@@ -110,6 +110,11 @@ def pytest_configure(config):
                    "(store-path breaker, disconnected-mode bind spool, "
                    "durable intent journal, crash-restart replay; "
                    "make chaos)")
+    config.addinivalue_line(
+        "markers", "soak: resource-exhaustion survival suite (HBM "
+                   "budget governor, vocab & row compaction, "
+                   "capacity-fault OOM recovery, churn-plateau "
+                   "regression gates; make chaos + make soak)")
 
 
 import pytest  # noqa: E402

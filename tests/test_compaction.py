@@ -1,0 +1,491 @@
+"""Resource-exhaustion survival: HBM budget governor, vocab & row
+compaction, and capacity-fault (OOM) recovery (ISSUE 20).
+
+The snapshot's shared interners are append-only between sweeps and its
+row buckets only ever grow, so multi-day node/pod churn — fresh
+hostnames, zone values, label values, images every generation — leaks
+device memory until XLA throws RESOURCE_EXHAUSTED. These tests are the
+acceptance proofs for the memory-governance plane:
+
+  * churned vocabularies PLATEAU under the housekeeping compaction
+    cadence (and demonstrably leak without it — the regression guard);
+  * compaction is invisible to placement: the same pending batch
+    places bit-identically with and without a forced sweep in between;
+  * the golden-row scrubber finds zero divergence in a compacted
+    snapshot, and per-row delta uploads re-engage after the
+    compaction's full re-upload (single-device and 8-way mesh);
+  * the HBM budget governor turns an over-budget grow into a demanded
+    compaction instead of a backend throw;
+  * a device.oom storm is classified as a CAPACITY fault: compacted
+    and retried — never a breaker trip, never a mesh reform, never a
+    pod conviction (the exact over-trigger matrix test_poison pins for
+    input faults, applied to the third verdict class).
+
+Runs single-device except the explicitly mesh-marked case.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.runtime.store import ObjectStore
+from kubernetes_tpu.sched import breaker as breaker_mod
+from kubernetes_tpu.sched.breaker import (ResourceExhausted,
+                                          is_capacity_error, oom_fault)
+from kubernetes_tpu.sched.scheduler import Scheduler
+from kubernetes_tpu.utils import faultpoints
+
+from helpers import make_node, make_pod
+
+pytestmark = pytest.mark.soak
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _world(n_nodes=8, clock=None, **kw):
+    store = ObjectStore()
+    for i in range(n_nodes):
+        store.create("nodes", make_node(
+            f"n{i}", cpu="32", memory="64Gi",
+            labels={"kubernetes.io/hostname": f"n{i}",
+                    api.LABEL_ZONE: f"z{i % 3}"}))
+    if clock is not None:
+        kw["clock"] = clock
+    sched = Scheduler(store, wave_size=kw.pop("wave_size", 32), **kw)
+    return store, sched
+
+
+def _pods(store, n, prefix="p", labels=None):
+    pods = []
+    for i in range(n):
+        p = make_pod(f"{prefix}{i}", cpu="100m", memory="128Mi",
+                     labels=labels)
+        store.create("pods", p)
+        pods.append(p)
+    return pods
+
+
+def _placements(store):
+    return sorted((p.metadata.name, p.spec.node_name)
+                  for p in store.list("pods") if p.spec.node_name)
+
+
+def _assert_capacity_never_convicts(sched):
+    """The over-trigger matrix: a capacity fault must move NONE of the
+    fault planes that device faults and input faults own."""
+    assert sched.breaker.state == breaker_mod.CLOSED
+    assert int(sched.metrics.device_path_trips.value) == 0
+    assert int(sched.metrics.mesh_reforms.total()) == 0
+    assert sched.poison_convictions == 0
+    assert sched.queue.quarantine_count() == 0
+
+
+def _churn_generation(store, sched, gen, n_nodes=4, n_pods=6):
+    """One epoch of multi-day churn: every string is generation-fresh
+    (hostnames, zone values, pod label values) — the vocab leak."""
+    if gen:
+        for p in store.list("pods"):
+            if p.metadata.labels.get("rev") == f"r{gen - 1}":
+                try:
+                    store.delete("pods", "default", p.metadata.name)
+                except KeyError:
+                    pass
+        for i in range(n_nodes):
+            try:
+                store.delete("nodes", "default", f"g{gen - 1}-n{i}")
+            except KeyError:
+                pass
+    for i in range(n_nodes):
+        name = f"g{gen}-n{i}"
+        store.create("nodes", make_node(
+            name, cpu="32", memory="64Gi",
+            labels={"kubernetes.io/hostname": name,
+                    api.LABEL_ZONE: f"zone-{gen}"}))
+    for i in range(n_pods):
+        store.create("pods", make_pod(
+            f"g{gen}-p{i}", cpu="100m", memory="128Mi",
+            labels={"rev": f"r{gen}", "app": f"app-{gen}"}))
+
+
+# -- the vocab leak and its plateau (satellite a) ------------------------------
+
+
+class TestVocabPlateau:
+    GENS = 10
+
+    def _run(self, compact_interval):
+        clk = FakeClock()
+        store = ObjectStore()
+        sched = Scheduler(store, wave_size=16, clock=clk,
+                          compact_interval=compact_interval)
+        for gen in range(self.GENS):
+            _churn_generation(store, sched, gen)
+            clk.advance(60.0)
+            sched._housekeep()
+            sched.schedule_pending()
+        sizes = dict(sched.snapshot.vocabs.sizes())
+        sched.close()
+        return sizes
+
+    def test_churned_vocabs_plateau_under_cadence(self):
+        """With the compaction cadence armed, generation churn leaves
+        only the LIVE generation's strings interned (plus the one that
+        arrived since the last sweep) — without it, every retired
+        hostname/zone/label value is retained forever. The leaked run
+        is the regression control: if interners ever learn to forget on
+        their own, the control stops leaking and this test demands a
+        look."""
+        leaked = self._run(compact_interval=0.0)
+        governed = self._run(compact_interval=50.0)
+        # control: append-only interners retain all GENS generations
+        assert leaked["zones"] >= self.GENS
+        assert leaked["label_values"] >= self.GENS
+        # governed: bounded by the ~2 generations alive between sweeps
+        assert governed["zones"] <= 4, governed
+        assert governed["label_values"] < leaked["label_values"] // 2
+        assert governed["pod_label_keys"] <= leaked["pod_label_keys"]
+
+    def test_removals_counter_gates_cadence(self):
+        """The cadence only sweeps when churn actually retired rows —
+        a static cluster pays nothing for an armed interval."""
+        clk = FakeClock()
+        store, sched = _world(clock=clk, compact_interval=10.0)
+        _pods(store, 8)
+        sched.schedule_pending()
+        clk.advance(1000.0)
+        sched._housekeep()
+        assert sched.metrics.snapshot_compactions_total.total() == 0
+        # retire one pod: the next elapsed cadence has work to do
+        store.delete("pods", "default", "p0")
+        clk.advance(20.0)
+        sched._housekeep()
+        assert sched.metrics.snapshot_compactions_total.total() == 1
+        sched.close()
+
+
+# -- compaction is invisible to placement --------------------------------------
+
+
+class TestCompactionParity:
+    def _run(self, compact_between):
+        store, sched = _world(n_nodes=8)
+        _pods(store, 16, prefix="warm-")
+        sched.schedule_pending()
+        # churn so the sweep has garbage to reclaim
+        for i in range(8):
+            store.delete("pods", "default", f"warm-{i}")
+        sched._housekeep()
+        if compact_between:
+            summary = sched.scrubber.compact(force=True)
+            assert summary is not None
+        _pods(store, 12, prefix="batch-")
+        sched.schedule_pending()
+        out = _placements(store)
+        sched.close()
+        return out
+
+    def test_placements_bit_equal_across_compaction(self):
+        assert self._run(False) == self._run(True)
+
+    def test_version_bump_invalidates_featurizer_cache(self):
+        """The vocab generation leads the version tuple: a compacted
+        vocabulary must never serve a featurize cache entry built
+        against the old ids."""
+        _, sched = _world()
+        v0 = sched.snapshot.vocabs.version()
+        sched.scrubber.compact(force=True)
+        v1 = sched.snapshot.vocabs.version()
+        assert v0 != v1
+        assert v1[0] == v0[0] + 1
+        sched.close()
+
+    def test_hysteresis_resists_bucket_thrash(self):
+        """Un-forced sweeps only shrink a bucket when the target is a
+        full power-of-two rung below the live one — otherwise a
+        grow/shrink cycle at a bucket boundary would mint a fresh jit
+        cache entry per round."""
+        store, sched = _world(n_nodes=8)
+        _pods(store, 100)  # past the 64-row default: M grows to 128
+        sched.schedule_pending()
+        grown_m = sched.snapshot.caps.M
+        assert grown_m > 64
+        # retire a sliver — live rows stay well above half the bucket
+        for i in range(10):
+            store.delete("pods", "default", f"p{i}")
+        sched._housekeep()
+        summary = sched.scrubber.compact()
+        assert summary is not None
+        assert sched.snapshot.caps.M == grown_m, summary["shrunk"]
+        # retire nearly everything: the rung is earned, the sweep takes it
+        for i in range(10, 90):
+            store.delete("pods", "default", f"p{i}")
+        sched._housekeep()
+        summary = sched.scrubber.compact()
+        assert sched.snapshot.caps.M < grown_m, summary["shrunk"]
+        sched.close()
+
+    def test_staged_rows_defer_compaction(self):
+        """Device kernels hold staged row indices mid-round: a sweep
+        then would renumber them under the kernel. The request parks
+        and the next housekeeping pass (rows unstaged) serves it."""
+        store, sched = _world()
+        p = make_pod("staged", cpu="100m", memory="128Mi")
+        sched.snapshot.stage_pending([p])
+        assert sched.snapshot.has_staged_rows()
+        assert sched.scrubber.compact(force=True) is None
+        assert sched.snapshot.compaction_requested
+        sched.snapshot.unstage(p)
+        assert sched.scrubber.maybe_compact() is not None
+        assert not sched.snapshot.compaction_requested
+        sched.close()
+
+
+# -- the HBM budget governor ---------------------------------------------------
+
+
+class TestGovernor:
+    def test_over_budget_grow_demands_compaction(self):
+        store, sched = _world()
+        _pods(store, 8)
+        sched.schedule_pending()
+        assert sched.snapshot.hbm_headroom_bytes() is None  # unbudgeted
+        sched.snapshot.hbm_budget_bytes = \
+            sched.snapshot.projected_hbm_bytes() + 1
+        assert sched.snapshot.hbm_headroom_bytes() > 0
+        # push the pod bucket past its rung: the grow lands (never a
+        # throw) but flags the governor
+        _pods(store, int(sched.snapshot.caps.M), prefix="burst-")
+        sched.schedule_pending()
+        sched._housekeep()
+        assert sched.metrics.snapshot_compactions_total.value(
+            trigger="governor") >= 1
+        sched.close()
+
+    def test_headroom_gauge_exported(self):
+        _, sched = _world(hbm_budget_bytes=1 << 30)
+        sched.schedule_pending()
+        sched.export_queue_gauges()
+        head = sched.metrics.hbm_headroom_bytes.value
+        assert 0 < head <= 1 << 30
+        assert sched.metrics.snapshot_vocab_size.value(vocab="zones") >= 1
+        sched.close()
+
+
+# -- golden rows and delta uploads across a sweep (satellite d) ----------------
+
+
+class TestCompactedSnapshotTransport:
+    def _settled(self, **kw):
+        store, sched = _world(n_nodes=8, **kw)
+        _pods(store, 24)
+        sched.schedule_pending()
+        for i in range(12):  # garbage for the sweep
+            store.delete("pods", "default", f"p{i}")
+        sched._housekeep()
+        return store, sched
+
+    def _assert_cache_matches_fresh(self, snap, mesh=None):
+        snap.to_device(mesh=mesh)
+        got = {g: [np.asarray(a) for a in snap._device_cache[g]]
+               for g in ("res", "topo", "pods", "terms")}
+        snap._device_cache.clear()
+        snap.to_device(mesh=mesh)
+        for g, arrays in got.items():
+            for i, (a, b) in enumerate(zip(arrays, snap._device_cache[g])):
+                np.testing.assert_array_equal(
+                    a, np.asarray(b),
+                    err_msg=f"group {g} array {i} diverged after the "
+                            f"post-compaction delta path")
+
+    def test_scrub_finds_compacted_snapshot_clean(self):
+        _, sched = self._settled()
+        assert sched.scrubber.compact(force=True) is not None
+        rep = sched.scrubber.scrub()
+        assert rep.clean and rep.repaired == 0, rep.divergences
+        sched.close()
+
+    def test_delta_uploads_reengage_after_compaction(self):
+        """A sweep swaps every array, so the first post-sweep upload
+        must be FULL (stale dirty ranges against reallocated arrays
+        would corrupt silently) — and the next row of churn must ride
+        the cheap delta path again, bitwise-equal a fresh upload."""
+        store, sched = self._settled()
+        snap = sched.snapshot
+        snap.to_device()
+        assert sched.scrubber.compact(force=True) is not None
+        before = snap.upload_bytes_total
+        snap.to_device()
+        # the sweep cleared _group_bytes with the stale cache, so the
+        # footprint is only measurable after this (full) re-upload
+        full = sum(snap._group_bytes.values())
+        assert snap.upload_bytes_total - before >= full > 0
+        # one bind of churn: delta engages
+        node = snap.node_names[0]
+        p = make_pod("delta-probe", cpu="100m", node_name=node)
+        sched.cache.add_pod(p)
+        snap.refresh_node_resources(sched.cache.node_infos[node])
+        snap.add_pod(p)
+        before = snap.upload_bytes_total
+        snap.to_device()
+        moved = snap.upload_bytes_total - before
+        assert 0 < moved < full // 4, (moved, full)
+        self._assert_cache_matches_fresh(snap)
+        sched.close()
+
+    @pytest.mark.mesh
+    def test_compaction_parity_under_mesh(self):
+        """The full re-upload and re-engaged deltas against an 8-way
+        node-sharded device cache."""
+        from kubernetes_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        store, sched = self._settled()
+        snap = sched.snapshot
+        snap.to_device(mesh=mesh)
+        assert sched.scrubber.compact(force=True) is not None
+        self._assert_cache_matches_fresh(snap, mesh=mesh)
+        node = snap.node_names[0]
+        p = make_pod("mesh-probe", cpu="100m", node_name=node)
+        sched.cache.add_pod(p)
+        snap.refresh_node_resources(sched.cache.node_infos[node])
+        snap.add_pod(p)
+        self._assert_cache_matches_fresh(snap, mesh=mesh)
+        sched.close()
+
+
+# -- capacity-fault classification (satellites b + c) --------------------------
+
+
+class TestCapacityClassifier:
+    def test_instances_and_markers(self):
+        assert is_capacity_error(MemoryError("alloc"))
+        assert is_capacity_error(ResourceExhausted("hbm"))
+        assert is_capacity_error(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 2.1G"))
+        assert is_capacity_error(RuntimeError("OOM when allocating"))
+        assert not is_capacity_error(ValueError("bad shape"))
+        assert not is_capacity_error(RuntimeError("device lost"))
+
+    def test_sees_through_wrapping(self):
+        try:
+            try:
+                raise MemoryError("backend alloc")
+            except MemoryError as inner:
+                raise RuntimeError("jit wrapper") from inner
+        except RuntimeError as wrapped:
+            assert is_capacity_error(wrapped)
+
+    def test_cycle_guarded(self):
+        a = RuntimeError("a")
+        b = RuntimeError("b")
+        a.__cause__, b.__cause__ = b, a
+        assert not is_capacity_error(a)
+
+    def test_raise_mode_fault_point_classifies(self):
+        """KTPU_FAULTPOINTS='device.oom=raise' must land in the
+        capacity class without a custom corrupt fn — the paste-able
+        reproducer contract."""
+        faultpoints.activate("device.oom", "raise", times=1)
+        try:
+            with pytest.raises(faultpoints.FaultInjected) as ei:
+                faultpoints.fire("device.oom", payload=("TPU_0",))
+            assert is_capacity_error(ei.value)
+        finally:
+            faultpoints.deactivate("device.oom")
+
+    def test_oom_fault_corrupt_helper(self):
+        fn = oom_fault()
+        fn(None)  # unarmed dispatch: no-op, matching lost_device_fault
+        with pytest.raises(ResourceExhausted):
+            fn(("TPU_0",))
+
+
+class TestCapacityRecovery:
+    def test_device_oom_storm_never_convicts(self):
+        """The mirror of test_poison's over-trigger matrix for the
+        third verdict class: a device.oom burst mid-schedule ends with
+        every pod placed, the breaker CLOSED, zero mesh reforms, zero
+        convictions — and the compaction ladder visibly engaged."""
+        store, sched = _world()
+        _pods(store, 32)
+        faultpoints.activate("device.oom", "raise", times=2)
+        try:
+            placed = sched.schedule_pending()
+        finally:
+            faultpoints.deactivate("device.oom")
+        assert placed == 32
+        _assert_capacity_never_convicts(sched)
+        assert int(sched.metrics.capacity_faults.value) == 2
+        assert sched.metrics.snapshot_compactions_total.value(
+            trigger="oom") >= 1
+        # the round that finally succeeded reset the strike ladder
+        assert sched._capacity_strikes == 0
+        sched.close()
+
+    def test_memoryerror_at_featurize_is_capacity_not_poison(self):
+        """featurize deliberately propagates MemoryError raw (it is an
+        environment fault, not the pod's) — the scheduler must route it
+        to the capacity ladder, never to a PodFeaturizeError
+        conviction."""
+        store, sched = _world()
+        _pods(store, 16)
+        orig = sched.featurizer.featurize
+        state = {"raised": False}
+
+        def flaky(pods, *a, **kw):
+            if not state["raised"]:
+                state["raised"] = True
+                raise MemoryError("host arena exhausted featurizing")
+            return orig(pods, *a, **kw)
+
+        sched.featurizer.featurize = flaky
+        placed = sched.schedule_pending()
+        assert state["raised"] and placed == 16
+        _assert_capacity_never_convicts(sched)
+        assert int(sched.metrics.capacity_faults.value) >= 1
+        sched.close()
+
+    def test_breaker_charged_only_when_headroom_stays_negative(self):
+        """Compaction that cannot restore headroom is the ONLY path
+        from a capacity fault to the whole-path breaker — and even
+        then the round degrades to the host twin and places."""
+        store, sched = _world()
+        _pods(store, 16)
+        sched.snapshot.hbm_budget_bytes = 1  # unsatisfiable
+        faultpoints.activate("device.oom", "raise", times=1)
+        try:
+            placed = sched.schedule_pending()
+        finally:
+            faultpoints.deactivate("device.oom")
+        assert placed == 16
+        assert sched.breaker.failures >= 1  # charged…
+        assert int(sched.metrics.device_path_trips.value) == 0  # …not tripped
+        assert sched.poison_convictions == 0
+        assert int(sched.metrics.mesh_reforms.total()) == 0
+        sched.close()
+
+    def test_healthy_budget_keeps_breaker_unchanged(self):
+        """With headroom restored by the sweep, the breaker sees the
+        capacity fault not at all — consecutive-failure accounting
+        belongs to genuine device faults."""
+        store, sched = _world(hbm_budget_bytes=1 << 30)
+        _pods(store, 16)
+        faultpoints.activate("device.oom", "raise", times=1)
+        try:
+            placed = sched.schedule_pending()
+        finally:
+            faultpoints.deactivate("device.oom")
+        assert placed == 16
+        assert sched.breaker.failures == 0
+        _assert_capacity_never_convicts(sched)
+        sched.close()
